@@ -1,0 +1,126 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's
+capabilities, built from scratch on JAX/XLA/Pallas.
+
+API surface mirrors `import paddle` (reference: python/paddle/__init__.py);
+execution is TPU-first: eager ops run as JAX primitives with a VJP-tape
+autograd, and `paddle_tpu.jit.to_static` compiles whole train steps (forward +
+backward + optimizer) into a single XLA program over a `jax.sharding.Mesh`.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from paddle_tpu.core.tensor import Parameter, Tensor  # noqa: F401
+from paddle_tpu.core import dtype as _dtype_mod
+from paddle_tpu.core.dtype import (  # noqa: F401
+    bfloat16,
+    bool,  # noqa: A004
+    complex64,
+    complex128,
+    float16,
+    float32,
+    float64,
+    get_default_dtype,
+    int8,
+    int16,
+    int32,
+    int64,
+    set_default_dtype,
+    uint8,
+)
+from paddle_tpu.core.device import (  # noqa: F401
+    CPUPlace,
+    CUDAPinnedPlace,
+    CUDAPlace,
+    TPUPlace,
+    XPUPlace,
+    device_count,
+    get_device,
+    is_compiled_with_cuda,
+    is_compiled_with_rocm,
+    is_compiled_with_tpu,
+    is_compiled_with_xpu,
+    set_device,
+)
+
+# tensor ops into the root namespace (paddle.add, paddle.reshape, ...)
+from paddle_tpu.tensor import *  # noqa: F401,F403
+from paddle_tpu.tensor import einsum  # noqa: F401
+
+from paddle_tpu.core import ops_binding as _ops_binding
+
+_ops_binding.bind_all()
+
+from paddle_tpu.autograd import enable_grad, grad, no_grad, set_grad_enabled  # noqa: F401,E402
+from paddle_tpu.framework.state import get_flags, seed, set_flags  # noqa: F401,E402
+from paddle_tpu.framework.io import load, save  # noqa: F401,E402
+
+from paddle_tpu import (  # noqa: F401,E402
+    amp,
+    autograd,
+    distributed,
+    distribution,
+    framework,
+    inference,
+    io,
+    jit,
+    linalg,
+    metric,
+    nn,
+    optimizer,
+    static,
+    sparse,
+    tensor,
+    utils,
+    vision,
+)
+from paddle_tpu.hapi.model import Model  # noqa: F401,E402
+from paddle_tpu.jit.api import to_static  # noqa: F401,E402
+from paddle_tpu.nn.layer.layers import disable_static, enable_static  # noqa: F401,E402
+
+
+def is_grad_enabled():
+    from paddle_tpu.core import engine
+    return engine.is_grad_enabled()
+
+
+def in_dynamic_mode():
+    return framework.in_dynamic_mode()
+
+
+# `paddle.Tensor`-style namespace helpers
+def numel(x, name=None):
+    return tensor.numel(x)
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def get_cudnn_version():
+    return None
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Rough analytic FLOPs counter (reference: python/paddle/hapi/dynamic_flops.py)."""
+    import numpy as _np
+    total = [0]
+    from paddle_tpu.nn.layer import layers as _L
+
+    def hook(layer, inp, out):
+        import paddle_tpu.nn as _nn
+        if isinstance(layer, _nn.Linear):
+            total[0] += 2 * _np.prod(inp[0].shape) * layer.weight.shape[-1]
+        elif isinstance(layer, _nn.Conv2D):
+            oshape = out.shape
+            k = _np.prod(layer.weight.shape[1:])
+            total[0] += 2 * _np.prod(oshape) * k
+    hooks = [l.register_forward_post_hook(hook) for l in net.sublayers()]
+    import paddle_tpu as _p
+    x = _p.zeros(input_size)
+    net(x)
+    for h in hooks:
+        h.remove()
+    if print_detail:
+        print(f"Total FLOPs: {total[0]}")
+    return total[0]

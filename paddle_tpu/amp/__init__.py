@@ -1,0 +1,2 @@
+from paddle_tpu.amp.auto_cast import amp_guard, auto_cast, decorate  # noqa: F401
+from paddle_tpu.amp.grad_scaler import GradScaler  # noqa: F401

@@ -1,0 +1,96 @@
+"""AMP autocast. Reference: python/paddle/amp/auto_cast.py.
+
+TPU-first: the native mixed-precision dtype is bfloat16 (MXU-native, no loss
+scaling needed). auto_cast(O1) casts inputs of matmul/conv-class ops to bf16;
+O2 ('pure') keeps params in bf16. float16 is accepted and mapped to the same
+machinery (with GradScaler doing real loss scaling for fp16).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.dtype import convert_dtype
+
+# ops whose inputs are cast down at O1 (matmul/conv-class = MXU ops)
+WHITE_LIST = {"matmul", "mm", "bmm", "conv1d", "conv2d", "conv3d", "linear",
+              "einsum", "fn"}
+# ops kept in fp32 for stability
+BLACK_LIST = {"softmax", "log_softmax", "cross_entropy", "layer_norm", "norm",
+              "mean", "sum", "exp", "log", "logsumexp"}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_state = _AmpState()
+
+
+def amp_state():
+    return _state
+
+
+class auto_cast:
+    """Context manager enabling autocast. paddle.amp.auto_cast parity."""
+
+    def __init__(self, enable=True, custom_white_list=None,
+                 custom_black_list=None, level="O1", dtype="bfloat16"):
+        self.enable = enable
+        self.level = level
+        self.dtype = jnp.bfloat16 if "bf" in str(dtype) else jnp.float16
+        self.white = set(custom_white_list or [])
+        self.black = set(custom_black_list or [])
+
+    def __enter__(self):
+        self._prev = (_state.enabled, _state.dtype, _state.level,
+                      _state.custom_white, _state.custom_black)
+        _state.enabled = self.enable
+        _state.dtype = self.dtype
+        _state.level = self.level
+        _state.custom_white = self.white
+        _state.custom_black = self.black
+        return self
+
+    def __exit__(self, *exc):
+        (_state.enabled, _state.dtype, _state.level,
+         _state.custom_white, _state.custom_black) = self._prev
+        return False
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2: cast model params to bf16/fp16 (master weights stay fp32 inside
+    the optimizer's fp32 accumulators). Reference: paddle.amp.decorate."""
+    dt = convert_dtype("bfloat16" if "bf" in str(dtype) else "float16")
+    single = not isinstance(models, (list, tuple))
+    ms = [models] if single else list(models)
+    for m in ms:
+        m.to(dtype=dt)
+    if optimizers is None:
+        return models if single else ms
+    return (models, optimizers)
+
+
+def maybe_autocast_value(opname, v):
+    """Hook for the dispatch layer: cast per white/black list when enabled."""
+    if not _state.enabled:
+        return v
+    name = opname
+    if name in (_state.custom_black | BLACK_LIST):
+        if v.dtype in (jnp.bfloat16, jnp.float16):
+            return v.astype(jnp.float32)
+        return v
+    if name in (_state.custom_white | WHITE_LIST):
+        if v.dtype == jnp.float32:
+            return v.astype(_state.dtype)
+    return v

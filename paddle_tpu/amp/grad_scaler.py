@@ -1,0 +1,98 @@
+"""Dynamic loss scaling. Reference: python/paddle/amp/grad_scaler.py.
+
+Needed for fp16; bf16 on TPU trains unscaled (scaler becomes ~no-op with
+enable=False or incr/decr ratios left at defaults but scale 1).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.engine import no_grad
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.framework.state import register_state_tensor
+
+
+class GradScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = Tensor(jnp.asarray(init_loss_scaling if enable else 1.0,
+                                         jnp.float32), name="loss_scaling")
+        self._scale.persistable = True
+        register_state_tensor(self._scale)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        from paddle_tpu.core.dispatch import apply
+        return apply(lambda v, s: v * s, var, self._scale)
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        with no_grad():
+            inv = 1.0 / self._scale._value
+            found = jnp.asarray(False)
+            for p in optimizer._params():
+                if p.grad is not None:
+                    g = p.grad._value * inv
+                    p.grad._set_value(g)
+                    found = found | ~jnp.all(jnp.isfinite(g))
+            self._found_inf = bool(found)
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def update(self):
+        if not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale._set_value(jnp.maximum(
+                    self._scale._value * self._decr_ratio, 1.0))
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale._set_value(self._scale._value * self._incr_ratio)
+                self._good_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return float(self._scale._value)
+
+    def state_dict(self):
+        return {"scale": self._scale.numpy(), "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, sd):
+        self._scale._set_value(jnp.asarray(sd["scale"], jnp.float32))
+        self._good_steps = sd.get("good_steps", 0)
+        self._bad_steps = sd.get("bad_steps", 0)

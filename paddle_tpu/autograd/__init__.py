@@ -1,0 +1,102 @@
+"""Autograd public API. Reference: python/paddle/autograd/__init__.py."""
+from __future__ import annotations
+
+import jax
+
+from paddle_tpu.core.engine import (  # noqa: F401
+    backward as _engine_backward,
+    enable_grad,
+    is_grad_enabled,
+    no_grad,
+    set_grad_enabled,
+)
+from paddle_tpu.core.tensor import Tensor
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    for t, g in zip(tensors, grad_tensors):
+        _engine_backward(t, g, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad — compute grads of outputs wrt inputs without touching .grad."""
+    outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+    inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+    saved = [(i.grad, i.__dict__.pop("_grad_hooks", None)) for i in inputs]
+    for i in inputs:
+        i.grad = None
+    retain = True if retain_graph is None else retain_graph
+    backward(outputs, grad_outputs, retain_graph=retain)
+    grads = []
+    for i, (old, hooks) in zip(inputs, saved):
+        g = i.grad
+        if g is None and not allow_unused:
+            from paddle_tpu.tensor.creation import zeros_like
+            g = zeros_like(i)
+        grads.append(g)
+        i.grad = old
+        if hooks is not None:
+            i.__dict__["_grad_hooks"] = hooks
+    return grads
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayer:
+    """Custom op with user fwd/bwd. Reference: python/paddle/autograd/py_layer.py."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from paddle_tpu.core import engine
+        ctx = PyLayerContext()
+        out = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(out, (tuple, list))
+        outs = (out,) if single else tuple(out)
+        in_tensors = [a for a in args if isinstance(a, Tensor)]
+        if engine.is_grad_enabled() and any(not t.stop_gradient for t in in_tensors):
+            def pullback(cots):
+                if single:
+                    cots = (cots,)
+                gts = cls.backward(ctx, *[Tensor(c, stop_gradient=True) for c in (
+                    cots if isinstance(cots, tuple) else (cots,))])
+                if isinstance(gts, Tensor):
+                    gts = (gts,)
+                return tuple(None if g is None else g._value for g in gts)
+            new_outs = []
+            for o in outs:
+                t = Tensor(o._value, stop_gradient=False)
+                new_outs.append(t)
+            node = engine.Node(in_tensors, tuple(new_outs), pullback, name=cls.__name__)
+            for t in new_outs:
+                t._node = node
+            outs = tuple(new_outs)
+        return outs[0] if single else outs

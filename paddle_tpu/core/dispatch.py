@@ -1,0 +1,92 @@
+"""Op dispatch: every paddle_tpu op funnels through :func:`apply`.
+
+Replaces the reference's per-op C++ kernel dispatch (paddle/phi/core/kernel_*)
+with: run the pure-JAX op function eagerly (or on tracers under jit), and — if
+any input requires grad — record a ``jax.vjp`` pullback Node for the eager
+autograd engine.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import engine
+from paddle_tpu.core.tensor import Tensor, _is_diff_dtype
+
+_tree = jax.tree_util
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def apply(fn, *args, **kwargs):
+    """Execute ``fn`` (a pure function over jnp arrays) on Tensor/array args.
+
+    Tensors anywhere in (nested) args/kwargs are unwrapped; if grad recording
+    is active and any differentiable-dtype input has stop_gradient=False, the
+    op runs under ``jax.vjp`` and a Node is recorded. Multi-output fns return
+    tuples of Tensors.
+    """
+    leaves, treedef = _tree.tree_flatten((args, kwargs), is_leaf=_is_tensor)
+    vals = [l._value if isinstance(l, Tensor) else l for l in leaves]
+
+    diff_idx = []
+    if engine.is_grad_enabled():
+        for i, l in enumerate(leaves):
+            if (
+                isinstance(l, Tensor)
+                and not l.stop_gradient
+                and _is_diff_dtype(l._value.dtype)
+            ):
+                diff_idx.append(i)
+
+    def run(values):
+        a, kw = _tree.tree_unflatten(treedef, values)
+        out = fn(*a, **kw)
+        return tuple(out) if isinstance(out, list) else out
+
+    if not diff_idx:
+        out = run(vals)
+        if isinstance(out, tuple):
+            return tuple(Tensor(o, stop_gradient=True) for o in out)
+        return Tensor(out, stop_gradient=True)
+
+    def closed(diff_vals):
+        vs = list(vals)
+        for i, v in zip(diff_idx, diff_vals):
+            vs[i] = v
+        return run(vs)
+
+    out_val, pull = jax.vjp(closed, [vals[i] for i in diff_idx])
+
+    def pullback(cot):
+        (gs,) = pull(cot)
+        return gs
+
+    in_tensors = [leaves[i] for i in diff_idx]
+    if isinstance(out_val, tuple):
+        outs = tuple(Tensor(o, stop_gradient=False) for o in out_val)
+        node = engine.Node(in_tensors, outs, pullback, name=getattr(fn, "__name__", "op"))
+        for o in outs:
+            o._node = node
+        return outs
+    out = Tensor(out_val, stop_gradient=False)
+    node = engine.Node(in_tensors, (out,), pullback, name=getattr(fn, "__name__", "op"))
+    out._node = node
+    return out
+
+
+def unwrap(x):
+    """Tensor -> jax array (pass through others, recursively on lists/tuples)."""
+    if isinstance(x, Tensor):
+        return x._value
+    if isinstance(x, (list, tuple)):
+        return type(x)(unwrap(v) for v in x)
+    return x
+
+
+def wrap(x, stop_gradient=True):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(jnp.asarray(x), stop_gradient=stop_gradient)

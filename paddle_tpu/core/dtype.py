@@ -1,0 +1,89 @@
+"""Dtype registry, paddle-style dtype names over JAX dtypes.
+
+Reference parity: python/paddle/framework/dtype.py (paddle.float32 etc.).
+TPU-first divergence (documented): with jax x64 disabled, float64 maps to
+float32 and int64 to int32 — TPUs have no 64-bit ALU path, and paddle's
+int64-by-default indices would otherwise double index-bandwidth. The dtype
+NAMES remain accepted everywhere for API parity.
+"""
+from __future__ import annotations
+
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+
+warnings.filterwarnings(
+    "ignore", message=".*requested dtype.*(int64|uint64|float64).*",
+    category=UserWarning)
+
+# Canonical dtype objects are numpy dtypes (what jax uses internally).
+bool = np.dtype("bool")  # noqa: A001 - paddle exports `paddle.bool`
+uint8 = np.dtype("uint8")
+int8 = np.dtype("int8")
+int16 = np.dtype("int16")
+int32 = np.dtype("int32")
+int64 = np.dtype("int64")
+float16 = np.dtype("float16")
+bfloat16 = jnp.bfloat16.dtype
+float32 = np.dtype("float32")
+float64 = np.dtype("float64")
+complex64 = np.dtype("complex64")
+complex128 = np.dtype("complex128")
+
+_ALIASES = {
+    "bool": bool, "uint8": uint8, "int8": int8, "int16": int16,
+    "int32": int32, "int64": int64, "float16": float16, "bfloat16": bfloat16,
+    "float32": float32, "float64": float64, "complex64": complex64,
+    "complex128": complex128,
+    # paddle VarDesc-style names
+    "FP16": float16, "FP32": float32, "FP64": float64, "BF16": bfloat16,
+    "INT8": int8, "INT16": int16, "INT32": int32, "INT64": int64,
+    "BOOL": bool, "UINT8": uint8,
+}
+
+_default_dtype = [float32]
+
+
+def convert_dtype(dtype):
+    """Normalize str / np.dtype / jnp dtype / python type to np.dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype in _ALIASES:
+            return _ALIASES[dtype]
+        return np.dtype(dtype)
+    if dtype is float:
+        return _default_dtype[0]
+    if dtype is int:
+        return int64
+    if dtype is __import__("builtins").bool:
+        return np.dtype("bool")
+    try:
+        return np.dtype(dtype)
+    except TypeError:
+        return jnp.dtype(dtype)
+
+
+def set_default_dtype(d):
+    d = convert_dtype(d)
+    if d not in (float16, bfloat16, float32, float64):
+        raise TypeError(f"set_default_dtype only supports float dtypes, got {d}")
+    _default_dtype[0] = d
+
+
+def get_default_dtype():
+    return _default_dtype[0]
+
+
+def is_floating_dtype(d):
+    return jnp.issubdtype(convert_dtype(d), jnp.floating)
+
+
+def is_integer_dtype(d):
+    d = convert_dtype(d)
+    return jnp.issubdtype(d, jnp.integer) or d == np.dtype("bool")
+
+
+def is_complex_dtype(d):
+    return jnp.issubdtype(convert_dtype(d), jnp.complexfloating)

@@ -1,0 +1,180 @@
+"""Eager-mode autograd engine: per-op VJP tape.
+
+Reference parity: paddle's C++ autograd engine
+(paddle/fluid/eager/backward.cc, grad_node_info) — re-designed for JAX: each
+eager op records a `jax.vjp` pullback in a Node; `backward()` walks nodes in
+reverse creation order accumulating cotangents. Under `paddle_tpu.jit.to_static`
+the same machinery runs on JAX tracers, so the entire forward+backward+update
+step fuses into one XLA program — the TPU-native execution model.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+float0 = jax.dtypes.float0
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_state = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _state.enabled
+
+
+def set_grad_enabled(mode: bool):
+    _state.enabled = bool(mode)
+
+
+class no_grad:
+    """Context manager / decorator disabling gradient recording (paddle.no_grad)."""
+
+    def __enter__(self):
+        self._prev = _state.enabled
+        _state.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self._prev
+        return False
+
+    def __call__(self, func):
+        import functools
+
+        @functools.wraps(func)
+        def wrapper(*a, **kw):
+            with no_grad():
+                return func(*a, **kw)
+
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = _state.enabled
+        _state.enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self._prev
+        return False
+
+
+_node_counter = [0]
+
+
+class Node:
+    """One recorded differentiable op."""
+
+    __slots__ = (
+        "idx",
+        "inputs",
+        "in_versions",
+        "out_refs",
+        "out_versions",
+        "out_avals",
+        "pullback",
+        "name",
+    )
+
+    def __init__(self, inputs, out_tensors, pullback, name=""):
+        _node_counter[0] += 1
+        self.idx = _node_counter[0]
+        self.inputs = tuple(inputs)
+        self.in_versions = tuple(t._version for t in inputs)
+        self.out_refs = tuple(weakref.ref(t) for t in out_tensors)
+        self.out_versions = tuple(t._version for t in out_tensors)
+        self.out_avals = tuple(
+            (tuple(t._value.shape), t._value.dtype) for t in out_tensors
+        )
+        self.pullback = pullback
+        self.name = name
+
+
+def _zero_cotangent(shape, dtype):
+    if jnp.issubdtype(dtype, jnp.floating) or jnp.issubdtype(dtype, jnp.complexfloating):
+        return jnp.zeros(shape, dtype)
+    return np.zeros(shape, dtype=float0)
+
+
+def backward(root, grad=None, retain_graph=False):
+    """Run reverse-mode accumulation from `root` tensor into leaf `.grad`s."""
+    from paddle_tpu.core.tensor import Tensor
+
+    if root._node is None:
+        if not root.stop_gradient:
+            # leaf with requires-grad: grad of itself
+            g = grad if grad is not None else jnp.ones_like(root._value)
+            root._accumulate_grad(g)
+        return
+
+    if grad is None:
+        if root._value.size != 1:
+            raise RuntimeError(
+                "grad can be implicitly created only for scalar outputs; "
+                f"got shape {root._value.shape}"
+            )
+        grad = jnp.ones_like(root._value)
+    elif isinstance(grad, Tensor):
+        grad = grad._value
+
+    # Collect reachable nodes.
+    seen = {}
+    stack = [root._node]
+    while stack:
+        node = stack.pop()
+        if node.idx in seen:
+            continue
+        seen[node.idx] = node
+        for t in node.inputs:
+            if t._node is not None and t._node.idx not in seen:
+                stack.append(t._node)
+    order = sorted(seen.values(), key=lambda n: n.idx, reverse=True)
+
+    cot = {(id(root), root._version): grad}
+
+    for node in order:
+        if node.pullback is None:
+            raise RuntimeError(
+                "Trying to backward through the graph a second time "
+                "(set retain_graph=True on the first backward)."
+            )
+        cots = []
+        any_live = False
+        for ref, ver, (shape, dtype) in zip(
+            node.out_refs, node.out_versions, node.out_avals
+        ):
+            t = ref()
+            key = (id(t), ver) if t is not None else None
+            if key is not None and key in cot:
+                cots.append(cot.pop(key))
+                any_live = True
+            else:
+                cots.append(_zero_cotangent(shape, dtype))
+        if not any_live:
+            continue
+        in_grads = node.pullback(tuple(cots) if len(cots) > 1 else cots[0])
+        for t, ver, g in zip(node.inputs, node.in_versions, in_grads):
+            if g is None or (hasattr(g, "dtype") and g.dtype == float0):
+                continue
+            if t.stop_gradient:
+                continue
+            if t._node is None:
+                t._accumulate_grad(g)
+            else:
+                key = (id(t), ver)
+                if key in cot:
+                    cot[key] = cot[key] + g
+                else:
+                    cot[key] = g
+        if not retain_graph:
+            node.pullback = None

@@ -1,0 +1,109 @@
+"""Bind tensor.* free functions as Tensor methods + operator dunders.
+
+Reference parity: python/paddle/fluid/dygraph/math_op_patch.py /
+varbase_patch_methods.py (monkey-patching of the eager Tensor).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu import tensor as T
+
+
+def _swap(fn):
+    def op(self, other):
+        return fn(other, self)
+    return op
+
+
+def bind_all():
+    # Methods mirroring free functions (paddle patches these onto Tensor).
+    method_names = [
+        # math
+        "add", "subtract", "multiply", "divide", "floor_divide", "remainder",
+        "mod", "pow", "maximum", "minimum", "fmax", "fmin", "abs", "exp",
+        "expm1", "sqrt", "rsqrt", "ceil", "floor", "round", "trunc", "sign",
+        "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh", "tanh",
+        "asinh", "acosh", "atanh", "square", "reciprocal", "erf", "erfinv",
+        "log", "log2", "log10", "log1p", "logit", "clip", "sum", "mean",
+        "prod", "max", "min", "amax", "amin", "logsumexp", "cumsum",
+        "cumprod", "all", "any", "matmul", "mm", "inner", "outer", "kron",
+        "lerp", "atan2", "scale", "stanh", "nansum", "nanmean",
+        "count_nonzero", "isfinite", "isinf", "isnan", "nan_to_num",
+        "heaviside", "diff", "neg", "trace", "diagonal", "digamma", "lgamma",
+        "frac", "take", "conj", "angle", "rad2deg", "deg2rad", "add_",
+        "subtract_", "multiply_", "clip_", "scale_", "exp_", "sqrt_",
+        "rsqrt_", "reciprocal_", "round_", "ceil_", "floor_", "tanh_",
+        # logic
+        "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+        "less_equal", "equal_all", "allclose", "isclose", "logical_and",
+        "logical_or", "logical_xor", "logical_not", "bitwise_and",
+        "bitwise_or", "bitwise_xor", "bitwise_not",
+        # manipulation
+        "reshape", "reshape_", "transpose", "moveaxis", "squeeze", "squeeze_",
+        "unsqueeze", "unsqueeze_", "flatten", "flatten_", "gather",
+        "gather_nd", "scatter", "scatter_", "scatter_nd_add", "tile",
+        "expand", "expand_as", "broadcast_to", "flip", "roll", "rot90",
+        "unique", "unique_consecutive", "masked_select", "masked_fill",
+        "index_select", "index_sample", "index_add", "take_along_axis",
+        "put_along_axis", "repeat_interleave", "split", "chunk", "unstack",
+        "as_complex", "as_real", "unbind",
+        # linalg
+        "dot", "bmm", "mv", "t", "cross", "norm", "dist", "cholesky", "det",
+        "slogdet", "svd", "qr", "eig", "eigvals", "pinv", "inverse", "solve",
+        "matrix_power", "cov", "corrcoef",
+        # search
+        "argmax", "argmin", "argsort", "sort", "topk", "where", "nonzero",
+        "kthvalue", "mode", "bucketize",
+        # stat
+        "std", "var", "numel", "median", "nanmedian", "quantile",
+        "histogram", "bincount",
+        # creation
+        "tril", "triu", "diag", "diagflat", "zeros_like", "ones_like",
+        "full_like",
+        # attribute
+        "real", "imag",
+        # random
+        "uniform_", "normal_", "bernoulli_", "exponential_", "multinomial",
+    ]
+    alias = {"inverse": "inv", "unbind": "unstack"}
+    for name in method_names:
+        target = alias.get(name, name)
+        fn = getattr(T, target, None)
+        if fn is not None and not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)
+
+    # Operator dunders.
+    Tensor.__add__ = T.add
+    Tensor.__radd__ = _swap(T.add)
+    Tensor.__sub__ = T.subtract
+    Tensor.__rsub__ = _swap(T.subtract)
+    Tensor.__mul__ = T.multiply
+    Tensor.__rmul__ = _swap(T.multiply)
+    Tensor.__truediv__ = T.divide
+    Tensor.__rtruediv__ = _swap(T.divide)
+    Tensor.__floordiv__ = T.floor_divide
+    Tensor.__rfloordiv__ = _swap(T.floor_divide)
+    Tensor.__mod__ = T.remainder
+    Tensor.__rmod__ = _swap(T.remainder)
+    Tensor.__pow__ = T.pow
+    Tensor.__rpow__ = _swap(T.pow)
+    Tensor.__matmul__ = T.matmul
+    Tensor.__rmatmul__ = _swap(T.matmul)
+    Tensor.__neg__ = lambda self: apply(jnp.negative, self)
+    Tensor.__pos__ = lambda self: self
+    Tensor.__abs__ = T.abs
+    Tensor.__invert__ = lambda self: apply(
+        lambda v: jnp.logical_not(v) if v.dtype == jnp.bool_ else jnp.bitwise_not(v), self)
+    Tensor.__and__ = T.bitwise_and
+    Tensor.__or__ = T.bitwise_or
+    Tensor.__xor__ = T.bitwise_xor
+    Tensor.__eq__ = T.equal
+    Tensor.__ne__ = T.not_equal
+    Tensor.__lt__ = T.less_than
+    Tensor.__le__ = T.less_equal
+    Tensor.__gt__ = T.greater_than
+    Tensor.__ge__ = T.greater_equal
+    Tensor.__hash__ = lambda self: id(self)

@@ -1,0 +1,84 @@
+"""paddle_tpu.distributed. Reference: python/paddle/distributed/__init__.py.
+
+TPU-native: a jax.sharding.Mesh + XLA collectives over ICI/DCN replace the
+reference's NCCL/gloo process groups; multi-host init is jax.distributed.
+"""
+from paddle_tpu.distributed import fleet  # noqa: F401
+from paddle_tpu.distributed.collective import (  # noqa: F401
+    Group,
+    ReduceOp,
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    all_to_all_single,
+    alltoall,
+    barrier,
+    broadcast,
+    get_group,
+    get_rank,
+    get_world_size,
+    new_group,
+    ppermute,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    wait,
+)
+from paddle_tpu.distributed.mesh import (  # noqa: F401
+    collective_axis,
+    get_mesh,
+    init_mesh,
+    named_sharding,
+    set_mesh,
+    shard_tensor,
+)
+from paddle_tpu.distributed.parallel import DataParallel  # noqa: F401
+from paddle_tpu.distributed.recompute import recompute  # noqa: F401
+
+_parallel_env_initialized = [False]
+
+
+def init_parallel_env(coordinator_address=None, num_processes=None,
+                      process_id=None):
+    """Reference: python/paddle/distributed/parallel.py init_parallel_env
+    (NCCL bootstrap). TPU-native: jax.distributed.initialize for multi-host
+    (DCN coordination), then install the global mesh over all devices."""
+    import jax
+    if _parallel_env_initialized[0]:
+        return
+    if coordinator_address is not None or num_processes not in (None, 1):
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    from paddle_tpu.distributed.mesh import ensure_mesh
+    ensure_mesh()
+    _parallel_env_initialized[0] = True
+
+
+def is_initialized():
+    return _parallel_env_initialized[0]
+
+
+class ParallelEnv:
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    local_rank = rank
+    nranks = world_size
+
+
+def spawn(func, args=(), nprocs=-1, **kwargs):
+    """Single-controller JAX doesn't fork per device; run inline (the mesh
+    gives SPMD parallelism). Multi-host launch is via paddle_tpu.distributed.launch."""
+    return func(*args)

@@ -1,0 +1,48 @@
+"""Auto-parallel API. Reference: python/paddle/distributed/auto_parallel/.
+
+Thin TPU-native surface: ProcessMesh ~= jax.sharding.Mesh; shard_tensor
+attaches PartitionSpecs (consumed by to_static's state lifting); shard_op is
+a sharding-constraint wrapper.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from paddle_tpu.distributed import mesh as dmesh
+
+
+class ProcessMesh:
+    def __init__(self, mesh=None, dim_names=None, shape=None, process_ids=None):
+        if mesh is not None:
+            arr = np.asarray(mesh)
+            self.shape = list(arr.shape)
+        else:
+            self.shape = list(shape or [])
+        self.dim_names = list(dim_names or [f"d{i}" for i in range(len(self.shape))])
+
+    def to_jax(self):
+        devs = np.asarray(jax.devices()[:int(np.prod(self.shape))])
+        return Mesh(devs.reshape(self.shape), tuple(self.dim_names))
+
+
+def shard_tensor(x, process_mesh=None, shard_spec=None, mesh=None, placements=None):
+    """paddle.distributed.shard_tensor parity: annotate + place."""
+    spec = shard_spec if shard_spec is not None else placements
+    if process_mesh is not None and dmesh.get_mesh() is None:
+        dmesh.set_mesh(process_mesh.to_jax())
+    if spec is None:
+        return dmesh.shard_tensor(x)
+    return dmesh.shard_tensor(x, *spec)
+
+
+def shard_op(op_fn, process_mesh=None, in_shard_specs=None, out_shard_specs=None):
+    def wrapped(*args, **kwargs):
+        out = op_fn(*args, **kwargs)
+        if out_shard_specs:
+            from paddle_tpu.distributed.fleet.meta_parallel import _constrain
+            out = _constrain(out, *out_shard_specs[0])
+        return out
+    return wrapped

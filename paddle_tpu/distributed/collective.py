@@ -1,0 +1,257 @@
+"""Collective ops. Reference: python/paddle/distributed/collective.py.
+
+The reference's c_allreduce/c_broadcast/... ops dispatch NCCL kernels; here
+each collective is an XLA collective on a mesh axis:
+  - inside a shard_map body (collective_axis set): lax.psum / all_gather /
+    ppermute / all_to_all — compiled onto ICI.
+  - eager multi-host (jax.distributed): multihost_utils fallbacks over DCN.
+  - single process, no axis: identity (world of one).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed import mesh as dmesh
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A mesh-axis-backed communication group."""
+
+    def __init__(self, axis=None, ranks=None, id=0):
+        self.axis = axis
+        self.ranks = ranks or []
+        self.id = id
+
+    @property
+    def nranks(self):
+        if self.axis is not None:
+            return dmesh.axis_size(self.axis)
+        return jax.process_count()
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return rank
+
+    @property
+    def rank(self):
+        return get_rank()
+
+
+_default_group = Group()
+
+
+def new_group(ranks=None, backend=None, axis=None):
+    return Group(axis=axis, ranks=ranks, id=1)
+
+
+def get_group(gid=0):
+    return _default_group
+
+
+def _axis_of(group):
+    if group is not None and getattr(group, "axis", None):
+        return group.axis
+    return dmesh.current_collective_axis()
+
+
+def get_rank(group=None):
+    axis = _axis_of(group)
+    if axis is not None:
+        # Inside a shard_map body this is a per-shard traced value — return
+        # it as-is so rank-dependent code computes with the true rank on each
+        # shard (an int() here would silently collapse every shard to rank 0).
+        return jax.lax.axis_index(axis)
+    return jax.process_index()
+
+
+def get_world_size(group=None):
+    axis = _axis_of(group)
+    if axis is not None:
+        return dmesh.axis_size(axis)
+    return jax.process_count()
+
+
+def _reduce_fn(op):
+    def pprod(v, axis):
+        return jnp.exp(jax.lax.psum(jnp.log(v), axis))
+
+    def pavg(v, axis):
+        return jax.lax.pmean(v, axis)
+
+    table = {
+        ReduceOp.SUM: jax.lax.psum,
+        ReduceOp.MAX: jax.lax.pmax,
+        ReduceOp.MIN: jax.lax.pmin,
+        ReduceOp.AVG: pavg,
+        ReduceOp.PROD: pprod,
+    }
+    if op not in table:
+        raise ValueError(f"unsupported ReduceOp {op!r}")
+    return table[op]
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    axis = _axis_of(group)
+    if axis is not None:
+        fn = _reduce_fn(op)
+        out = apply(lambda v: fn(v, axis), tensor)
+        tensor._inplace_assign(out)
+        return tensor
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        g = multihost_utils.process_allgather(tensor._value)
+        red = {ReduceOp.SUM: jnp.sum, ReduceOp.MAX: jnp.max,
+               ReduceOp.MIN: jnp.min, ReduceOp.PROD: jnp.prod,
+               ReduceOp.AVG: jnp.mean}
+        if op not in red:
+            raise ValueError(f"unsupported ReduceOp {op!r}")
+        tensor._set_value(red[op](g, axis=0))
+    return tensor
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    axis = _axis_of(group)
+    if axis is not None:
+        out = apply(lambda v: jax.lax.all_gather(v, axis), tensor)
+        n = dmesh.axis_size(axis)
+        for i in range(n):
+            tensor_list.append(out[i])
+        return tensor_list
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        g = multihost_utils.process_allgather(tensor._value)
+        for i in range(g.shape[0]):
+            tensor_list.append(Tensor(g[i]))
+        return tensor_list
+    tensor_list.append(tensor.clone())
+    return tensor_list
+
+
+def all_gather_object(object_list, obj, group=None):
+    object_list.append(obj)
+    return object_list
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
+    axis = _axis_of(group)
+    from paddle_tpu.tensor.manipulation import concat
+    stacked = concat(tensor_list, axis=0) if isinstance(tensor_list, (list, tuple)) \
+        else tensor_list
+    if axis is not None:
+        out = apply(lambda v: jax.lax.psum_scatter(v, axis, tiled=True), stacked)
+        tensor._inplace_assign(out)
+        return tensor
+    tensor._set_value(stacked._value)
+    return tensor
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    axis = _axis_of(group)
+    if axis is not None:
+        def fn(v):
+            idx = jax.lax.axis_index(axis)
+            return jax.lax.psum(jnp.where(idx == src, v, jnp.zeros_like(v)), axis)
+        out = apply(fn, tensor)
+        tensor._inplace_assign(out)
+        return tensor
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        tensor._set_value(multihost_utils.broadcast_one_to_all(tensor._value))
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    axis = _axis_of(group)
+    if tensor_list is None:
+        return tensor
+    from paddle_tpu.tensor.manipulation import stack
+    stacked = stack(tensor_list, axis=0)
+    if axis is not None:
+        def fn(v):
+            idx = jax.lax.axis_index(axis)
+            return jnp.take(v, idx, axis=0)
+        out = apply(fn, stacked)
+        tensor._inplace_assign(out)
+        return tensor
+    tensor._set_value(tensor_list[0]._value)
+    return tensor
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    axis = _axis_of(group)
+    from paddle_tpu.tensor.manipulation import stack
+    stacked = stack(in_tensor_list, axis=0) if isinstance(in_tensor_list, (list, tuple)) \
+        else in_tensor_list
+    if axis is not None:
+        out = apply(lambda v: jax.lax.all_to_all(v, axis, split_axis=0,
+                                                 concat_axis=0, tiled=False), stacked)
+        n = dmesh.axis_size(axis)
+        if out_tensor_list is not None:
+            for i in range(n):
+                out_tensor_list.append(out[i])
+            return out_tensor_list
+        return out
+    if out_tensor_list is not None:
+        out_tensor_list.extend([t.clone() for t in in_tensor_list])
+        return out_tensor_list
+    return stacked
+
+
+def all_to_all_single(out_tensor, in_tensor, group=None, sync_op=True):
+    axis = _axis_of(group)
+    if axis is not None:
+        out = apply(lambda v: jax.lax.all_to_all(
+            v, axis, split_axis=0, concat_axis=0, tiled=True), in_tensor)
+        out_tensor._inplace_assign(out)
+        return out_tensor
+    out_tensor._set_value(in_tensor._value)
+    return out_tensor
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    axis = _axis_of(group)
+    if axis is None:
+        raise RuntimeError("send/recv require a mesh axis (pipeline context)")
+    # point-to-point on TPU == ppermute ring step; paired with recv
+    raise RuntimeError("use paddle_tpu.distributed.p2p.ppermute_send_recv "
+                       "inside shard_map (XLA has no one-sided send)")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    return send(tensor, src, group, sync_op)
+
+
+def ppermute(tensor, perm, axis=None, group=None):
+    """TPU-native p2p: permute values along a mesh axis ring (ICI neighbor
+    exchange). perm: list of (src, dst)."""
+    ax = axis or _axis_of(group)
+    return apply(lambda v: jax.lax.ppermute(v, ax, perm), tensor)
+
+
+def barrier(group=None):
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    tensor._value.block_until_ready()
+    return tensor

@@ -1,0 +1,164 @@
+"""Fleet distributed-training API. Reference: python/paddle/distributed/fleet/.
+
+TPU-native mapping:
+  fleet.init(strategy) — builds the hybrid Mesh (dp × pp × tp × sp) from
+      strategy.hybrid_configs (the analogue of HybridCommunicateGroup's
+      process-group topology).
+  fleet.distributed_model(model) — annotates parameter shardings (replicated
+      on dp; meta_parallel layers carry their own tp specs).
+  fleet.distributed_optimizer(opt) — returns the optimizer unchanged: grad
+      sync is an XLA AllReduce inserted by sharding propagation when the step
+      is jit'd over the mesh (no NCCL hooks to install).
+"""
+from __future__ import annotations
+
+import jax
+
+from paddle_tpu.distributed.fleet.meta_parallel import (  # noqa: F401
+    ColumnParallelLinear,
+    PipelineLayer,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+
+
+class DistributedStrategy:
+    """Reference: fleet/base/distributed_strategy.py (protobuf-backed).
+    Plain attribute bag with the commonly used knobs."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1, "sharding_degree": 1,
+            "sep_degree": 1,
+        }
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.pipeline = False
+        self.pipeline_configs = {}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.lamb = False
+        self.dgc = False
+        self.find_unused_parameters = False
+        self.without_graph_optimization = True
+
+
+class _HybridCommunicateGroup:
+    """Topology info (reference: fleet/base/topology.py). Axis sizes come
+    from the global mesh."""
+
+    def __init__(self, mesh):
+        self._mesh = mesh
+
+    def _axis(self, name):
+        return self._mesh.shape[name] if (
+            self._mesh is not None and name in self._mesh.axis_names) else 1
+
+    def get_data_parallel_world_size(self):
+        return self._axis("dp")
+
+    def get_model_parallel_world_size(self):
+        return self._axis("tp")
+
+    def get_pipe_parallel_world_size(self):
+        return self._axis("pp")
+
+    def get_sharding_parallel_world_size(self):
+        return self._axis("dp")
+
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_model_parallel_group(self):
+        from paddle_tpu.distributed.collective import Group
+        return Group(axis="tp")
+
+    def get_data_parallel_group(self):
+        from paddle_tpu.distributed.collective import Group
+        return Group(axis="dp")
+
+    def get_pipe_parallel_group(self):
+        from paddle_tpu.distributed.collective import Group
+        return Group(axis="pp")
+
+
+class _Fleet:
+    def __init__(self):
+        self._strategy = None
+        self._hcg = None
+        self._initialized = False
+
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        from paddle_tpu.distributed import mesh as dmesh
+        self._strategy = strategy or DistributedStrategy()
+        hc = self._strategy.hybrid_configs
+        n = jax.device_count()
+        dp = hc.get("dp_degree", 1) or 1
+        mp = hc.get("mp_degree", 1) or 1
+        pp = hc.get("pp_degree", 1) or 1
+        sep = hc.get("sep_degree", 1) or 1
+        prod = dp * mp * pp * sep
+        if prod == 1 and n > 1:
+            dp = n
+            prod = n
+        if prod != n:
+            raise ValueError(
+                f"hybrid degrees dp{dp}*mp{mp}*pp{pp}*sep{sep}={prod} != "
+                f"{n} devices")
+        shape = {"dp": dp, "pp": pp, "sp": sep, "tp": mp}
+        mesh = dmesh.init_mesh(shape)
+        self._hcg = _HybridCommunicateGroup(mesh)
+        self._initialized = True
+        return self
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    def distributed_model(self, model):
+        from paddle_tpu.distributed.mesh import get_dist_spec, shard_tensor
+        for p in model.parameters():
+            if get_dist_spec(p) is None:
+                shard_tensor(p)  # replicated
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return optimizer
+
+    @property
+    def worker_num(self):
+        return jax.process_count()
+
+    def worker_index(self):
+        return jax.process_index()
+
+    def is_first_worker(self):
+        return jax.process_index() == 0
+
+    def barrier_worker(self):
+        from paddle_tpu.distributed.collective import barrier
+        barrier()
+
+
+fleet = _Fleet()
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+get_hybrid_communicate_group = fleet.get_hybrid_communicate_group
+
+
+def worker_num():
+    return jax.process_count()
+
+
+def worker_index():
+    return jax.process_index()

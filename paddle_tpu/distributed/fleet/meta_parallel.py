@@ -1,0 +1,170 @@
+"""Tensor/pipeline-parallel layers.
+
+Reference: python/paddle/distributed/fleet/meta_parallel/parallel_layers/
+(mp_layers.py ColumnParallelLinear/RowParallelLinear/VocabParallelEmbedding,
+pp_layers.py PipelineLayer).
+
+TPU-native design: instead of manually splitting weights per rank + inserting
+c_allreduce ops, each layer holds the FULL logical weight annotated with a
+PartitionSpec on the `tp` mesh axis. Under pjit, XLA partitions the matmul
+and inserts the reduce (RowParallel) / gather (gather_output) collectives on
+ICI automatically — same math, compiler-placed communication.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.distributed.mesh import get_dist_spec, shard_tensor
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layer.layers import Layer
+
+
+def _constrain(x, *spec):
+    """with_sharding_constraint when a multi-device mesh is active."""
+    from paddle_tpu.distributed.mesh import get_mesh
+    from jax.sharding import NamedSharding, PartitionSpec
+    mesh = get_mesh()
+    if mesh is None or len(mesh.devices.flat) == 1:
+        return x
+    sp = PartitionSpec(*spec)
+    return apply(lambda v: jax.lax.with_sharding_constraint(
+        v, NamedSharding(mesh, sp)), x)
+
+
+class ColumnParallelLinear(Layer):
+    """W: [in, out] sharded over tp on the OUT (column) dim."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        shard_tensor(self.weight, None, "tp")
+        self.bias = None
+        if has_bias:
+            self.bias = self.create_parameter(shape=[out_features], is_bias=True)
+            shard_tensor(self.bias, "tp")
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            y = _constrain(y)  # replicated -> XLA all-gathers
+        else:
+            y = _constrain(y, *([None] * (len(y.shape) - 1)), "tp")
+        return y
+
+
+class RowParallelLinear(Layer):
+    """W: [in, out] sharded over tp on the IN (row) dim; XLA inserts the
+    partial-sum AllReduce."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        shard_tensor(self.weight, "tp", None)
+        self.bias = None
+        if has_bias:
+            self.bias = self.create_parameter(shape=[out_features], is_bias=True)
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = _constrain(x, *([None] * (len(x.shape) - 1)), "tp")
+        y = F.linear(x, self.weight, self.bias)
+        return _constrain(y)  # replicated output => psum over tp
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding table sharded over tp on the vocab dim."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            shape=[num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.Normal(0.0, 0.02))
+        shard_tensor(self.weight, "tp", None)
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        return _constrain(out)
+
+
+class ParallelCrossEntropy(Layer):
+    """Vocab-parallel CE: logits sharded over tp on the class dim; XLA
+    handles the two psums (max + sumexp) from shardings."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)
+
+
+class LayerDesc:
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_func, forward_func=None, shared_weight_attr=
+                 "weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """Reference: pp_layers.py PipelineLayer.
+
+    Holds the full LayerList; `num_stages` records the intended pipeline
+    split. In the TPU design the stage boundary materializes when the train
+    step is compiled: paddle_tpu.distributed.pipeline.pipeline_forward runs
+    stages under shard_map over the `pp` axis with ppermute microbatch
+    rotation (see distributed/pipeline.py). Single-mesh execution (pp=1)
+    runs the layers sequentially.
+    """
+
+    def __init__(self, layers, num_stages=1, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0, **kwargs):
+        super().__init__()
+        from paddle_tpu.nn.layer.container import LayerList
+        built = [d.build_layer() if isinstance(d, LayerDesc) else d
+                 for d in layers]
+        self.run_function = LayerList(built)
+        self.num_stages = num_stages
+        self.loss_fn = loss_fn
+        self.recompute_interval = recompute_interval
+
+    def forward(self, x):
+        from paddle_tpu.distributed.recompute import recompute as _rc
+        for i, layer in enumerate(self.run_function):
+            if self.recompute_interval > 0 and i % self.recompute_interval == 0 \
+                    and self.training:
+                x = _rc(layer, x)
+            else:
+                x = layer(x)
+        return x
+
+    def get_stage_layers(self, stage_id):
+        n = len(self.run_function)
+        per = (n + self.num_stages - 1) // self.num_stages
+        return list(self.run_function)[stage_id * per:(stage_id + 1) * per]

@@ -1,0 +1,127 @@
+"""Global device-mesh state.
+
+TPU-native core of paddle_tpu.distributed: one `jax.sharding.Mesh` over all
+devices (ICI-adjacent axes first) plays the role of the reference's process
+groups (python/paddle/distributed/collective.py Group). Axes:
+  dp — data parallel (gradient psum)
+  pp — pipeline stages (ppermute microbatch schedule)
+  tp — tensor/model parallel (sharded weights, XLA-inserted collectives)
+  sp — sequence/context parallel (long-context; ring attention)
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+P = PartitionSpec
+
+_state = threading.local()
+_global_mesh = [None]
+
+
+def init_mesh(mesh_shape=None, axis_names=None, devices=None):
+    """Create + install the global mesh.
+
+    mesh_shape: dict axis->size or tuple sizes; product must equal #devices.
+    Default: all devices on the `dp` axis.
+    """
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if mesh_shape is None:
+        axis_names = axis_names or ("dp",)
+        shape = (n,) * 1 if len(axis_names) == 1 else None
+        if shape is None:
+            raise ValueError("mesh_shape required for multi-axis mesh")
+    elif isinstance(mesh_shape, dict):
+        axis_names = tuple(mesh_shape.keys())
+        shape = tuple(mesh_shape.values())
+    else:
+        shape = tuple(mesh_shape)
+        axis_names = tuple(axis_names or ("dp", "pp", "tp")[:len(shape)])
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh shape {shape} != {n} devices")
+    mesh = Mesh(np.asarray(devices).reshape(shape), axis_names)
+    _global_mesh[0] = mesh
+    return mesh
+
+
+def set_mesh(mesh):
+    _global_mesh[0] = mesh
+    return mesh
+
+
+def get_mesh():
+    return _global_mesh[0]
+
+
+def ensure_mesh():
+    if _global_mesh[0] is None:
+        init_mesh()
+    return _global_mesh[0]
+
+
+def axis_size(name):
+    m = get_mesh()
+    if m is None or name not in m.axis_names:
+        return 1
+    return m.shape[name]
+
+
+# ---- collective-axis context (inside shard_map bodies) ----
+def push_collective_axis(axis):
+    stack = getattr(_state, "coll_axes", None)
+    if stack is None:
+        stack = _state.coll_axes = []
+    stack.append(axis)
+
+
+def pop_collective_axis():
+    _state.coll_axes.pop()
+
+
+def current_collective_axis():
+    stack = getattr(_state, "coll_axes", None)
+    return stack[-1] if stack else None
+
+
+class collective_axis:
+    """Context manager marking that code runs inside a shard_map body over
+    `axis`, so eager-API collectives (dist.all_reduce etc.) lower to XLA
+    psum/all_gather on that axis."""
+
+    def __init__(self, axis):
+        self.axis = axis
+
+    def __enter__(self):
+        push_collective_axis(self.axis)
+        return self
+
+    def __exit__(self, *exc):
+        pop_collective_axis()
+        return False
+
+
+def named_sharding(*spec):
+    return NamedSharding(ensure_mesh(), P(*spec))
+
+
+def shard_tensor(t, *spec):
+    """Annotate a Tensor with a PartitionSpec; to_static lifts it with this
+    sharding (and eagerly places the value if a real multi-device mesh is
+    active). Analogue of paddle.distributed.shard_tensor (auto_parallel)."""
+    from paddle_tpu.core.tensor import Tensor
+    sp = P(*spec)
+    t.__dict__["dist_spec"] = sp
+    mesh = get_mesh()
+    if mesh is not None and len(mesh.devices.flat) > 1 and not isinstance(
+            t._value, jax.core.Tracer):
+        t._value = jax.device_put(t._value, NamedSharding(mesh, sp))
+    return t
+
+
+def get_dist_spec(t):
+    return t.__dict__.get("dist_spec")

@@ -1,0 +1,56 @@
+"""DataParallel. Reference: python/paddle/fluid/dygraph/parallel.py.
+
+TPU-native: no gradient-fusion buckets or NCCL allreduce hooks — the model's
+parameters are replicated over the `dp` mesh axis and the batch is sharded;
+when the train step runs under to_static over the mesh, XLA inserts a single
+fused AllReduce for the gradients (ICI-optimal). In eager multi-host mode,
+grad sync happens explicitly in `apply_collective_grads`.
+"""
+from __future__ import annotations
+
+import jax
+
+from paddle_tpu.core.engine import no_grad
+from paddle_tpu.nn.layer.layers import Layer
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.group = group
+        self.add_sublayer("_layers_holder", layers)
+
+    @property
+    def _inner(self):
+        return self._sub_layers["_layers_holder"]
+
+    def forward(self, *inputs, **kwargs):
+        return self._inner(*inputs, **kwargs)
+
+    def state_dict(self, *a, **kw):
+        return self._inner.state_dict(*a, **kw)
+
+    def set_state_dict(self, sd, *a, **kw):
+        return self._inner.set_state_dict(sd, *a, **kw)
+
+    @no_grad()
+    def apply_collective_grads(self):
+        """Average gradients across data-parallel workers (eager path)."""
+        from paddle_tpu.distributed.collective import all_reduce, get_world_size
+        ws = get_world_size(self.group)
+        if ws <= 1:
+            return
+        for p in self._inner.parameters():
+            if p.grad is not None:
+                all_reduce(p.grad, group=self.group)
+                p.grad._set_value(p.grad._value / ws)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def no_sync(self):
+        import contextlib
+        return contextlib.nullcontext()

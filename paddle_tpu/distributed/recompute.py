@@ -1,0 +1,78 @@
+"""Activation recomputation. Reference: python/paddle/distributed/fleet/recompute/.
+
+TPU-native: `jax.checkpoint` (rematerialization) — XLA recomputes the segment
+in the backward pass, trading FLOPs for HBM. The wrapped Layer's parameters
+are lifted to explicit arguments of the checkpointed function (temporarily
+re-bound during the inner run) so parameter gradients flow through the
+rematerialized region in both eager-tape and to_static modes.
+"""
+from __future__ import annotations
+
+import jax
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.core.tensor import Tensor
+
+
+def _owner_layer(function):
+    from paddle_tpu.nn.layer.layers import Layer
+    if isinstance(function, Layer):
+        return function
+    self_obj = getattr(function, "__self__", None)
+    if isinstance(self_obj, Layer):
+        return self_obj
+    return None
+
+
+def recompute(function, *args, **kwargs):
+    kwargs.pop("preserve_rng_state", True)
+    kwargs.pop("use_reentrant", True)
+
+    layer = _owner_layer(function)
+    params = list(layer.parameters()) if layer is not None else []
+    buffers = list(layer.buffers()) if layer is not None else []
+    state = params + buffers
+    n_args = len(args)
+    arg_is_tensor = [isinstance(a, Tensor) for a in args]
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+
+    @jax.checkpoint
+    def inner(arg_vals, state_vals):
+        saved = [(t._value, t._version, t._node, t.stop_gradient) for t in state]
+        try:
+            for t, v in zip(state, state_vals):
+                t._value = v
+                t._node = None
+            it = iter(arg_vals)
+            call_args = []
+            for i in range(n_args):
+                if arg_is_tensor[i]:
+                    nt = Tensor(next(it))
+                    nt.stop_gradient = False
+                    call_args.append(nt)
+                else:
+                    call_args.append(args[i])
+            out = function(*call_args, **kwargs)
+            if isinstance(out, (tuple, list)):
+                return tuple(o._value if isinstance(o, Tensor) else o for o in out)
+            return out._value if isinstance(out, Tensor) else out
+        finally:
+            for t, (v, ver, node, sg) in zip(state, saved):
+                t._value = v
+                t._version = ver
+                t._node = node
+                t.stop_gradient = sg
+
+    def fn(*vals):
+        avals = list(vals[:len(tensor_args)])
+        svals = list(vals[len(tensor_args):])
+        return inner(avals, svals)
+
+    return apply(fn, *tensor_args, *state)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    out = args
+    for fn in functions:
+        out = recompute(fn, *(out if isinstance(out, tuple) else (out,)), **kwargs)
+    return out

@@ -1,0 +1,326 @@
+"""Probability distributions. Reference: python/paddle/distribution/*."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply, unwrap, wrap
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.framework.state import next_key
+
+
+def _v(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x, jnp.float32)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return list(self._batch_shape)
+
+    @property
+    def event_shape(self):
+        return list(self._event_shape)
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        from paddle_tpu.tensor.math import exp
+        return exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + tuple(self._batch_shape)
+        z = jax.random.normal(next_key(), shape)
+        return Tensor(self.loc + self.scale * z)
+
+    def log_prob(self, value):
+        def fn(v):
+            var = self.scale ** 2
+            return -((v - self.loc) ** 2) / (2 * var) - jnp.log(self.scale) \
+                - 0.5 * math.log(2 * math.pi)
+        return apply(fn, value)
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi) +
+                      jnp.log(self.scale) * jnp.ones(self._batch_shape))
+
+    def kl_divergence(self, other):
+        var_ratio = (self.scale / other.scale) ** 2
+        t1 = ((self.loc - other.loc) / other.scale) ** 2
+        return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _v(low)
+        self.high = _v(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape, self.high.shape))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + tuple(self._batch_shape)
+        u = jax.random.uniform(next_key(), shape)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        def fn(v):
+            inside = (v >= self.low) & (v < self.high)
+            return jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf)
+        return apply(fn, value)
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _v(logits)
+        super().__init__(self.logits.shape[:-1])
+
+    def sample(self, shape=()):
+        shape = tuple(shape)
+        return Tensor(jax.random.categorical(
+            next_key(), self.logits, shape=shape + tuple(self._batch_shape)))
+
+    @property
+    def probs(self):
+        return Tensor(jax.nn.softmax(self.logits, axis=-1))
+
+    def log_prob(self, value):
+        def fn(v):
+            logp = jax.nn.log_softmax(self.logits, axis=-1)
+            return jnp.take_along_axis(logp, v[..., None].astype(jnp.int32),
+                                       axis=-1)[..., 0]
+        return apply(fn, value)
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        p = jnp.exp(logp)
+        return Tensor(-jnp.sum(p * logp, axis=-1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_ = _v(probs)
+        super().__init__(self.probs_.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + tuple(self._batch_shape)
+        return Tensor((jax.random.uniform(next_key(), shape) <
+                       self.probs_).astype(jnp.float32))
+
+    def log_prob(self, value):
+        def fn(v):
+            p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+        return apply(fn, value)
+
+    def entropy(self):
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = _v(alpha)
+        self.beta = _v(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape, self.beta.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + tuple(self._batch_shape)
+        return Tensor(jax.random.beta(next_key(), self.alpha, self.beta, shape))
+
+    def log_prob(self, value):
+        from jax.scipy.special import betaln
+        def fn(v):
+            return (self.alpha - 1) * jnp.log(v) + (self.beta - 1) * \
+                jnp.log1p(-v) - betaln(self.alpha, self.beta)
+        return apply(fn, value)
+
+    def entropy(self):
+        from jax.scipy.special import betaln, digamma
+        a, b = self.alpha, self.beta
+        return Tensor(betaln(a, b) - (a - 1) * digamma(a) - (b - 1) * digamma(b)
+                      + (a + b - 2) * digamma(a + b))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = _v(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.dirichlet(next_key(), self.concentration,
+                                           tuple(shape) + tuple(self._batch_shape)))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        def fn(v):
+            a = self.concentration
+            return jnp.sum((a - 1) * jnp.log(v), axis=-1) + \
+                gammaln(jnp.sum(a, -1)) - jnp.sum(gammaln(a), -1)
+        return apply(fn, value)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = total_count
+        self.probs_ = _v(probs)
+        super().__init__(self.probs_.shape[:-1], self.probs_.shape[-1:])
+
+    def sample(self, shape=()):
+        n = self.total_count
+        cat = jax.random.categorical(
+            next_key(), jnp.log(jnp.maximum(self.probs_, 1e-30)),
+            shape=tuple(shape) + (n,) + tuple(self._batch_shape))
+        k = self.probs_.shape[-1]
+        onehot = jax.nn.one_hot(cat, k)
+        return Tensor(jnp.sum(onehot, axis=len(shape)))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        def fn(v):
+            logp = jnp.log(jnp.maximum(self.probs_, 1e-30))
+            return gammaln(jnp.sum(v, -1) + 1) - jnp.sum(gammaln(v + 1), -1) + \
+                jnp.sum(v * logp, -1)
+        return apply(fn, value)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate):
+        self.rate = _v(rate)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + tuple(self._batch_shape)
+        return Tensor(jax.random.exponential(next_key(), shape) / self.rate)
+
+    def log_prob(self, value):
+        return apply(lambda v: jnp.log(self.rate) - self.rate * v, value)
+
+    def entropy(self):
+        return Tensor(1.0 - jnp.log(self.rate))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + tuple(self._batch_shape)
+        return Tensor(self.loc + self.scale * jax.random.gumbel(next_key(), shape))
+
+    def log_prob(self, value):
+        def fn(v):
+            z = (v - self.loc) / self.scale
+            return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+        return apply(fn, value)
+
+
+class Geometric(Distribution):
+    def __init__(self, probs):
+        self.probs_ = _v(probs)
+        super().__init__(self.probs_.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + tuple(self._batch_shape)
+        u = jax.random.uniform(next_key(), shape)
+        return Tensor(jnp.floor(jnp.log1p(-u) / jnp.log1p(-self.probs_)))
+
+    def log_prob(self, value):
+        return apply(lambda v: v * jnp.log1p(-self.probs_) +
+                     jnp.log(self.probs_), value)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + tuple(self._batch_shape)
+        return Tensor(self.loc + self.scale * jax.random.laplace(next_key(), shape))
+
+    def log_prob(self, value):
+        return apply(lambda v: -jnp.abs(v - self.loc) / self.scale -
+                     jnp.log(2 * self.scale), value)
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + tuple(self._batch_shape)
+        return Tensor(jnp.exp(self.loc + self.scale *
+                              jax.random.normal(next_key(), shape)))
+
+    def log_prob(self, value):
+        def fn(v):
+            logv = jnp.log(v)
+            return -((logv - self.loc) ** 2) / (2 * self.scale ** 2) - logv - \
+                jnp.log(self.scale) - 0.5 * math.log(2 * math.pi)
+        return apply(fn, value)
+
+
+class Poisson(Distribution):
+    def __init__(self, rate):
+        self.rate = _v(rate)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + tuple(self._batch_shape)
+        return Tensor(jax.random.poisson(next_key(), self.rate, shape).astype(
+            jnp.float32))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        return apply(lambda v: v * jnp.log(self.rate) - self.rate -
+                     gammaln(v + 1), value)
+
+
+def kl_divergence(p, q):
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        return p.kl_divergence(q)
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        lp = jax.nn.log_softmax(p.logits, -1)
+        lq = jax.nn.log_softmax(q.logits, -1)
+        return Tensor(jnp.sum(jnp.exp(lp) * (lp - lq), -1))
+    if isinstance(p, Uniform) and isinstance(q, Uniform):
+        return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
+    if isinstance(p, Bernoulli) and isinstance(q, Bernoulli):
+        pp = jnp.clip(p.probs_, 1e-7, 1 - 1e-7)
+        qq = jnp.clip(q.probs_, 1e-7, 1 - 1e-7)
+        return Tensor(pp * (jnp.log(pp) - jnp.log(qq)) +
+                      (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq)))
+    raise NotImplementedError(f"kl({type(p).__name__}, {type(q).__name__})")

@@ -1,0 +1,30 @@
+"""Framework namespace. Reference: python/paddle/framework/__init__.py."""
+from paddle_tpu.core.device import (  # noqa: F401
+    CPUPlace,
+    CUDAPinnedPlace,
+    CUDAPlace,
+    TPUPlace,
+    XPUPlace,
+    _default_place,
+    get_device,
+    set_device,
+)
+from paddle_tpu.core.dtype import (  # noqa: F401
+    get_default_dtype,
+    set_default_dtype,
+)
+from paddle_tpu.core.tensor import Parameter, Tensor  # noqa: F401
+from paddle_tpu.framework.state import (  # noqa: F401
+    get_flags,
+    seed,
+    set_flags,
+)
+
+
+def in_dynamic_mode():
+    from paddle_tpu.jit.api import _in_to_static_trace
+    return not _in_to_static_trace()
+
+
+def in_dygraph_mode():
+    return in_dynamic_mode()

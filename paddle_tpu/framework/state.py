@@ -1,0 +1,98 @@
+"""Global state-tensor registry + PRNG state.
+
+The registry is the TPU-native replacement for the reference's global Scope /
+persistable variables (paddle/fluid/framework/scope.h): every Parameter,
+Layer buffer and optimizer accumulator registers here, so
+``paddle_tpu.jit.to_static`` can lift ALL mutable framework state into pytree
+arguments of one jitted function (whole-program functionalization).
+
+PRNG: paddle's global seed (paddle.seed) maps to a threaded, splitting JAX
+key — every random op consumes a fresh split, keeping eager semantics while
+remaining trace-safe.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+
+import jax
+import jax.numpy as jnp
+
+_state_tensors = weakref.WeakSet()
+_registry_version = [0]
+_serial = [0]
+
+
+def register_state_tensor(t):
+    _state_tensors.add(t)
+    _registry_version[0] += 1
+    _serial[0] += 1
+    t.__dict__["_state_serial"] = _serial[0]
+
+
+def state_tensors():
+    return list(_state_tensors)
+
+
+def registry_version():
+    return _registry_version[0]
+
+
+class _RNG(threading.local):
+    def __init__(self):
+        self.key_tensor = None
+        self.seed_val = 0
+
+
+_rng = _RNG()
+
+
+def _key_tensor():
+    if _rng.key_tensor is None:
+        from paddle_tpu.core.tensor import Tensor
+        t = Tensor(jax.random.key_data(jax.random.key(0)), name="global_rng_key")
+        t.persistable = True
+        t.__dict__["_reinit"] = lambda: jax.random.key_data(
+            jax.random.key(_rng.seed_val))
+        register_state_tensor(t)
+        _rng.key_tensor = t
+    return _rng.key_tensor
+
+
+def seed(s: int):
+    t = _key_tensor()
+    t._set_value(jax.random.key_data(jax.random.key(int(s))))
+    _rng.seed_val = int(s)
+    return _rng
+
+
+def get_rng_state():
+    return _key_tensor()._value
+
+
+def set_rng_state(key_data):
+    _key_tensor()._set_value(key_data)
+
+
+def next_key():
+    """Split the global key. The key lives in a registered state Tensor, so
+    under to_static the key is a lifted input/output of the compiled step —
+    every compiled step sees fresh randomness (dropout works), no retrace."""
+    t = _key_tensor()
+    key = jax.random.wrap_key_data(t._value)
+    new, sub = jax.random.split(key)
+    t._set_value(jax.random.key_data(new))
+    return sub
+
+
+_flags = {}
+
+
+def set_flags(d):
+    _flags.update(d)
+
+
+def get_flags(keys):
+    if isinstance(keys, str):
+        keys = [keys]
+    return {k: _flags.get(k) for k in keys}

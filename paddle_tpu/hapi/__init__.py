@@ -1,0 +1,2 @@
+from paddle_tpu.hapi import callbacks  # noqa: F401
+from paddle_tpu.hapi.model import Model  # noqa: F401

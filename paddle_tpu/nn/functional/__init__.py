@@ -1,0 +1,41 @@
+"""nn.functional namespace. Reference: python/paddle/nn/functional/__init__.py."""
+from paddle_tpu.nn.functional.activation import *  # noqa: F401,F403
+from paddle_tpu.nn.functional.common import *  # noqa: F401,F403
+from paddle_tpu.nn.functional.conv import (  # noqa: F401
+    conv1d,
+    conv1d_transpose,
+    conv2d,
+    conv2d_transpose,
+    conv3d,
+    conv3d_transpose,
+)
+from paddle_tpu.nn.functional.distance import cdist, pairwise_distance, pdist  # noqa: F401
+from paddle_tpu.nn.functional.extension import (  # noqa: F401
+    diag_embed,
+    sequence_mask,
+    temporal_shift,
+)
+from paddle_tpu.nn.functional.input import embedding, one_hot  # noqa: F401
+from paddle_tpu.nn.functional.loss import *  # noqa: F401,F403
+from paddle_tpu.nn.functional.norm import (  # noqa: F401
+    batch_norm,
+    group_norm,
+    instance_norm,
+    layer_norm,
+    local_response_norm,
+    normalize,
+    rms_norm,
+    spectral_norm,
+)
+from paddle_tpu.nn.functional.pooling import *  # noqa: F401,F403
+from paddle_tpu.nn.functional.transformer import (  # noqa: F401
+    scaled_dot_product_attention,
+    sparse_attention,
+)
+from paddle_tpu.nn.functional.vision import (  # noqa: F401
+    affine_grid,
+    channel_shuffle,
+    grid_sample,
+    pixel_shuffle,
+    pixel_unshuffle,
+)

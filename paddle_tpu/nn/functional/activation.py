@@ -1,0 +1,179 @@
+"""Activation functions. Reference: python/paddle/nn/functional/activation.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply
+
+
+def relu(x, name=None):
+    return apply(jax.nn.relu, x)
+
+
+def relu_(x, name=None):
+    return x._inplace_assign(relu(x))
+
+
+def relu6(x, name=None):
+    return apply(lambda v: jnp.clip(v, 0.0, 6.0), x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply(lambda v: jax.nn.elu(v, alpha), x)
+
+
+def elu_(x, alpha=1.0, name=None):
+    return x._inplace_assign(elu(x, alpha))
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply(lambda v: jax.nn.celu(v, alpha), x)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply(lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)), x)
+
+
+def gelu(x, approximate=False, name=None):
+    return apply(lambda v: jax.nn.gelu(v, approximate=approximate), x)
+
+
+def sigmoid(x, name=None):
+    return apply(jax.nn.sigmoid, x)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply(lambda v: jnp.clip(slope * v + offset, 0.0, 1.0), x)
+
+
+def hardswish(x, name=None):
+    return apply(lambda v: v * jnp.clip(v + 3.0, 0.0, 6.0) / 6.0, x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply(lambda v: jnp.clip(v, min, max), x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply(lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0), x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(
+        lambda v: jnp.where(v > threshold, v - threshold,
+                            jnp.where(v < -threshold, v + threshold, 0.0)), x)
+
+
+def tanhshrink(x, name=None):
+    return apply(lambda v: v - jnp.tanh(v), x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply(lambda v: jax.nn.leaky_relu(v, negative_slope), x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fn(v, w):
+        if w.size == 1:
+            return jnp.where(v >= 0, v, w.reshape(()) * v)
+        shape = [1] * v.ndim
+        ch_axis = 1 if data_format[1] == "C" else v.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(v >= 0, v, w.reshape(shape) * v)
+    return apply(fn, x, weight)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    from paddle_tpu.framework.state import next_key
+    def fn(v):
+        if training:
+            a = jax.random.uniform(next_key(), v.shape, jnp.float32, lower, upper).astype(v.dtype)
+        else:
+            a = (lower + upper) / 2.0
+        return jnp.where(v >= 0, v, a * v)
+    return apply(fn, x)
+
+
+def log_sigmoid(x, name=None):
+    return apply(jax.nn.log_sigmoid, x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        new_shape = v.shape[:ax] + (groups, c // groups) + v.shape[ax + 1:]
+        return jnp.max(v.reshape(new_shape), axis=ax)
+    return apply(fn, x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from paddle_tpu.core.dtype import convert_dtype
+    dt = convert_dtype(dtype)
+    def fn(v):
+        if dt is not None:
+            v = v.astype(dt)
+        return jax.nn.softmax(v, axis=axis)
+    return apply(fn, x)
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    return x._inplace_assign(softmax(x, axis, dtype))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from paddle_tpu.core.dtype import convert_dtype
+    dt = convert_dtype(dtype)
+    def fn(v):
+        if dt is not None:
+            v = v.astype(dt)
+        return jax.nn.log_softmax(v, axis=axis)
+    return apply(fn, x)
+
+
+def softplus(x, beta=1, threshold=20, name=None):
+    return apply(
+        lambda v: jnp.where(beta * v > threshold, v,
+                            jnp.log1p(jnp.exp(beta * jnp.minimum(v, threshold / beta))) / beta), x)
+
+
+def softsign(x, name=None):
+    return apply(jax.nn.soft_sign, x)
+
+
+def swish(x, name=None):
+    return apply(jax.nn.silu, x)
+
+
+silu = swish
+
+
+def mish(x, name=None):
+    return apply(lambda v: v * jnp.tanh(jax.nn.softplus(v)), x)
+
+
+def tanh(x, name=None):
+    return apply(jnp.tanh, x)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply(lambda v: jnp.where(v > threshold, v, value), x)
+
+
+def glu(x, axis=-1, name=None):
+    return apply(lambda v: jax.nn.glu(v, axis=axis), x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from paddle_tpu.framework.state import next_key
+
+    def fn(v):
+        g = jax.random.gumbel(next_key(), v.shape, v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:  # straight-through estimator
+            oh = jax.nn.one_hot(jnp.argmax(y, axis=axis), v.shape[axis],
+                                axis=axis, dtype=y.dtype)
+            y = y + jax.lax.stop_gradient(oh - y)
+        return y
+    return apply(fn, x)

@@ -1,0 +1,234 @@
+"""Common NN functionals. Reference: python/paddle/nn/functional/common.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.framework.state import next_key
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with W: [in, out] (paddle layout -> MXU matmul)."""
+    def fn(v, w, b):
+        y = jnp.matmul(v, w)
+        if b is not None:
+            y = y + b
+        return y
+    return apply(fn, x, weight, bias)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        return x if mode == "upscale_in_train" else apply(lambda v: v * (1.0 - p), x)
+    def fn(v):
+        if axis is None:
+            shape = v.shape
+        else:
+            axes = (axis,) if isinstance(axis, int) else tuple(axis)
+            shape = tuple(v.shape[i] if i in axes else 1 for i in range(v.ndim))
+        keep = jax.random.bernoulli(next_key(), 1.0 - p, shape).astype(v.dtype)
+        if mode == "upscale_in_train":
+            return v * keep / (1.0 - p)
+        return v * keep
+    return apply(fn, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    a = ((1 - p) * (1 + p * alpha_p ** 2)) ** -0.5
+    b = -a * alpha_p * p
+    def fn(v):
+        keep = jax.random.bernoulli(next_key(), 1.0 - p, v.shape)
+        return a * jnp.where(keep, v, alpha_p) + b
+    return apply(fn, x)
+
+
+def _pad_nd(v, pad, mode, value, data_format):
+    nd = v.ndim
+    if len(pad) == 2 * nd:
+        # paddle "all-dims" format: [(before,after) per dim] flattened, dim0 first
+        widths = [(int(pad[2 * i]), int(pad[2 * i + 1])) for i in range(nd)]
+    else:
+        # spatial-only pairs, reversed (last spatial dim first), like torch
+        n_spatial = len(pad) // 2
+        widths = [(0, 0)] * nd
+        if data_format.startswith("NC"):
+            spatial = list(range(2, nd))
+        else:
+            spatial = list(range(1, nd - 1))
+        for i in range(n_spatial):
+            d = spatial[len(spatial) - 1 - i]
+            widths[d] = (int(pad[2 * i]), int(pad[2 * i + 1]))
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(v, widths, mode="constant", constant_values=value)
+    return jnp.pad(v, widths, mode=jmode)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from paddle_tpu.core.tensor import Tensor
+    if isinstance(pad, Tensor):
+        pad = [int(p) for p in np.asarray(pad._value).reshape(-1)]
+    pad = [int(p) for p in pad]
+    return apply(lambda v: _pad_nd(v, pad, mode, value, data_format), x)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def fn(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+    return apply(fn, x1, x2)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def fn(a, b, w, bi):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bi is not None:
+            out = out + bi
+        return out
+    return apply(fn, x1, x2, weight, bias)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def fn(lab, prior):
+        k = lab.shape[-1]
+        if prior is None:
+            return (1.0 - epsilon) * lab + epsilon / k
+        return (1.0 - epsilon) * lab + epsilon * prior
+    return apply(fn, label, prior_dist)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    ks = (kernel_sizes,) * 2 if isinstance(kernel_sizes, int) else tuple(kernel_sizes)
+    st = (strides,) * 2 if isinstance(strides, int) else tuple(strides)
+    dl = (dilations,) * 2 if isinstance(dilations, int) else tuple(dilations)
+    pd = (paddings,) * 4 if isinstance(paddings, int) else tuple(paddings)
+    if len(pd) == 2:
+        pd = (pd[0], pd[0], pd[1], pd[1])
+
+    def fn(v):
+        n, c, h, w = v.shape
+        v = jnp.pad(v, [(0, 0), (0, 0), (pd[0], pd[2]), (pd[1], pd[3])])
+        oh = (v.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (v.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        patches = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                sl = v[:, :, i * dl[0]: i * dl[0] + oh * st[0]: st[0],
+                       j * dl[1]: j * dl[1] + ow * st[1]: st[1]]
+                patches.append(sl)
+        out = jnp.stack(patches, axis=2)  # [N, C, kh*kw, oh, ow]
+        return out.reshape(n, c * ks[0] * ks[1], oh * ow)
+    return apply(fn, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    os_ = (output_sizes,) * 2 if isinstance(output_sizes, int) else tuple(output_sizes)
+    ks = (kernel_sizes,) * 2 if isinstance(kernel_sizes, int) else tuple(kernel_sizes)
+    st = (strides,) * 2 if isinstance(strides, int) else tuple(strides)
+    dl = (dilations,) * 2 if isinstance(dilations, int) else tuple(dilations)
+    pd = (paddings,) * 4 if isinstance(paddings, int) else tuple(paddings)
+    if len(pd) == 2:
+        pd = (pd[0], pd[0], pd[1], pd[1])
+
+    def fn(v):
+        n, ckk, L = v.shape
+        c = ckk // (ks[0] * ks[1])
+        ph, pw = os_[0] + pd[0] + pd[2], os_[1] + pd[1] + pd[3]
+        oh = (ph - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (pw - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        v = v.reshape(n, c, ks[0], ks[1], oh, ow)
+        out = jnp.zeros((n, c, ph, pw), v.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                out = out.at[:, :, i * dl[0]: i * dl[0] + oh * st[0]: st[0],
+                             j * dl[1]: j * dl[1] + ow * st[1]: st[1]].add(v[:, :, i, j])
+        return out[:, :, pd[0]: ph - pd[2], pd[1]: pw - pd[3]]
+    return apply(fn, x)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    from paddle_tpu.core.tensor import Tensor
+    if isinstance(size, Tensor):
+        size = [int(s) for s in np.asarray(size._value)]
+    elif size is not None and not isinstance(size, (list, tuple)):
+        size = [int(size)]
+    if isinstance(scale_factor, Tensor):
+        scale_factor = [float(s) for s in np.asarray(scale_factor._value).reshape(-1)]
+
+    def fn(v):
+        chan_last = not data_format.startswith("NC")
+        nd = v.ndim - 2
+        spatial = v.shape[1:-1] if chan_last else v.shape[2:]
+        if size is not None:
+            out_spatial = tuple(int(s) for s in size)
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * nd
+            out_spatial = tuple(int(np.floor(s * f)) for s, f in zip(spatial, sf))
+        jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+                 "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode.lower()]
+        if chan_last:
+            out_shape = (v.shape[0],) + out_spatial + (v.shape[-1],)
+            axes = tuple(range(1, 1 + nd))
+        else:
+            out_shape = v.shape[:2] + out_spatial
+            axes = tuple(range(2, 2 + nd))
+        if jmode == "nearest":
+            # paddle nearest (align_corners=False): floor(i * scale)
+            idx = []
+            for a, (si, so) in zip(axes, zip(spatial, out_spatial)):
+                scale = si / so
+                ind = jnp.floor(jnp.arange(so) * scale).astype(jnp.int32)
+                idx.append((a, jnp.clip(ind, 0, si - 1)))
+            out = v
+            for a, ind in idx:
+                out = jnp.take(out, ind, axis=a)
+            return out
+        if mode.lower() in ("bilinear", "linear", "trilinear", "bicubic") and align_corners:
+            # jax.image.resize has no align_corners; emulate via coordinate map
+            out = v
+            for a, (si, so) in zip(axes, zip(spatial, out_spatial)):
+                pos = jnp.linspace(0.0, si - 1.0, so)
+                lo = jnp.floor(pos).astype(jnp.int32)
+                hi = jnp.clip(lo + 1, 0, si - 1)
+                wgt = (pos - lo).astype(v.dtype)
+                shape = [1] * out.ndim
+                shape[a] = so
+                wgt = wgt.reshape(shape)
+                out = jnp.take(out, lo, axis=a) * (1 - wgt) + jnp.take(out, hi, axis=a) * wgt
+            return out
+        return jax.image.resize(v, out_shape, method=jmode)
+    return apply(fn, x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError("class_center_sample: planned (distributed margin losses)")

@@ -1,0 +1,177 @@
+"""Convolutions. Reference: python/paddle/nn/functional/conv.py.
+
+TPU-first: all convs lower to a single `lax.conv_general_dilated`, which XLA
+tiles onto the MXU (the conv is where ResNet's FLOPs live). We keep paddle's
+NCHW default at the API level and let XLA's layout assignment pick the
+TPU-optimal internal layout.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dispatch import apply
+
+
+def _tuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    t = tuple(int(x) for x in v)
+    return t * n if len(t) == 1 else t
+
+
+def _padding(padding, n, data_format):
+    """Normalize paddle padding spec -> lax [(lo, hi)] per spatial dim or str."""
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding), int(padding))] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, (int, np.integer)) for p in padding):
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    # nested [[lo,hi],...] possibly including batch/channel dims
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        pads = [tuple(int(q) for q in p) for p in padding]
+        if len(pads) == n:
+            return pads
+        # strip N, C dims according to data_format
+        if data_format.startswith("NC"):
+            return pads[2:]
+        return pads[1:-1]
+    raise ValueError(f"bad padding {padding!r}")
+
+
+def _dim_numbers(nd, channel_last):
+    if nd == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if nd == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, nd, data_format):
+    channel_last = not data_format.startswith("NC")
+    stride = _tuple(stride, nd)
+    dilation = _tuple(dilation, nd)
+    pad = _padding(padding, nd, data_format)
+    lhs_dn, rhs_dn, out_dn = _dim_numbers(nd, channel_last)
+
+    def fn(v, w, b):
+        # paddle weight layout is [out_c, in_c/groups, *k] == OIHW
+        if channel_last:
+            perm = tuple(range(2, 2 + nd)) + (1, 0)  # OIHW->HWIO
+            w = jnp.transpose(w, perm)
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=stride, padding=pad,
+            rhs_dilation=dilation, feature_group_count=groups,
+            dimension_numbers=(lhs_dn, rhs_dn, out_dn),
+            preferred_element_type=None)
+        if b is not None:
+            shape = [1] * out.ndim
+            shape[out_dn.index("C")] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+    return apply(fn, x, weight, bias)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    df = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, df)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, groups,
+                    dilation, nd, data_format, output_size=None):
+    channel_last = not data_format.startswith("NC")
+    stride = _tuple(stride, nd)
+    dilation = _tuple(dilation, nd)
+    opad = _tuple(output_padding, nd) if output_padding is not None else (0,) * nd
+    pad = _padding(padding, nd, data_format)
+    lhs_dn, rhs_dn, out_dn = _dim_numbers(nd, channel_last)
+
+    def fn(v, w, b):
+        # paddle transpose-conv weight layout: [in_c, out_c/groups, *k]
+        # grad-of-conv formulation: conv with transposed spatial dilation
+        if isinstance(pad, str):
+            pads = None
+        else:
+            pads = pad
+        k = w.shape[2:]
+        eff_k = tuple(dilation[i] * (k[i] - 1) + 1 for i in range(nd))
+        if pads is None:
+            lo_hi = [(0, 0)] * nd if pad == "VALID" else [
+                ((eff_k[i] - 1) // 2, eff_k[i] // 2) for i in range(nd)]
+        else:
+            lo_hi = pads
+        tpad = [
+            (eff_k[i] - 1 - lo_hi[i][0], eff_k[i] - 1 - lo_hi[i][1] + opad[i])
+            for i in range(nd)
+        ]
+        # flip spatial dims, swap I/O: [in, out/g, *k] -> [out, in/g, *k]
+        wf = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+        if groups > 1:
+            ic = wf.shape[0]
+            ocg = wf.shape[1]
+            wf = wf.reshape((groups, ic // groups, ocg) + k)
+            wf = jnp.swapaxes(wf, 1, 2)
+            wf = wf.reshape((groups * ocg, ic // groups) + k)
+        else:
+            wf = jnp.swapaxes(wf, 0, 1)
+        if channel_last:
+            perm = tuple(range(2, 2 + nd)) + (1, 0)
+            wf = jnp.transpose(wf, perm)
+        out = jax.lax.conv_general_dilated(
+            v, wf, window_strides=(1,) * nd, padding=tpad,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            feature_group_count=groups,
+            dimension_numbers=(lhs_dn, rhs_dn, out_dn))
+        if output_size is not None:
+            tgt = _tuple(output_size, nd)
+            sl = [slice(None)] * out.ndim
+            for i in range(nd):
+                ax = (1 + i) if channel_last else (2 + i)
+                cur = out.shape[ax]
+                if cur > tgt[i]:
+                    sl[ax] = slice(0, tgt[i])
+            out = out[tuple(sl)]
+        if b is not None:
+            shape = [1] * out.ndim
+            shape[out_dn.index("C")] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+    return apply(fn, x, weight, bias)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    df = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           groups, dilation, 1, df, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           groups, dilation, 2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           groups, dilation, 3, data_format, output_size)

@@ -1,0 +1,35 @@
+"""Distance functionals. Reference: python/paddle/nn/functional/distance.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def fn(a, b):
+        d = a - b + epsilon
+        return jnp.sum(jnp.abs(d) ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+    return apply(fn, x, y)
+
+
+def pdist(x, p=2.0, name=None):
+    def fn(v):
+        n = v.shape[0]
+        diff = v[:, None, :] - v[None, :, :]
+        dm = jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+        iu = jnp.triu_indices(n, k=1)
+        return dm[iu]
+    return apply(fn, x)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
+    def fn(a, b):
+        if p == 2.0 and "use_mm" in compute_mode:
+            a2 = jnp.sum(a * a, axis=-1, keepdims=True)
+            b2 = jnp.sum(b * b, axis=-1, keepdims=True)
+            d2 = a2 + jnp.swapaxes(b2, -1, -2) - 2 * (a @ jnp.swapaxes(b, -1, -2))
+            return jnp.sqrt(jnp.maximum(d2, 0.0))
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+    return apply(fn, x, y)

@@ -1,0 +1,46 @@
+"""Extension functionals. Reference: python/paddle/nn/functional/extension.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply, unwrap
+from paddle_tpu.core.tensor import Tensor
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    from paddle_tpu.core.dtype import convert_dtype
+    import numpy as np
+    ml = maxlen
+    if ml is None:
+        ml = int(np.asarray(unwrap(x)).max())
+    elif isinstance(ml, Tensor):
+        ml = int(ml._value)
+    def fn(v):
+        ar = jnp.arange(ml)
+        return (ar < v[..., None]).astype(convert_dtype(dtype))
+    return apply(fn, x)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    def fn(v):
+        cl = data_format == "NHWC"
+        if cl:
+            v = jnp.transpose(v, (0, 3, 1, 2))
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        v = v.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate([v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])], axis=1)
+        right = jnp.concatenate([jnp.zeros_like(v[:, :1, fold:2 * fold]),
+                                 v[:, :-1, fold:2 * fold]], axis=1)
+        mid = v[:, :, 2 * fold:]
+        out = jnp.concatenate([left, right, mid], axis=2).reshape(nt, c, h, w)
+        if cl:
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+    return apply(fn, x)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    from paddle_tpu.tensor.creation import diag_embed as de
+    return de(input, offset, dim1, dim2)

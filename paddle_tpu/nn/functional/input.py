@@ -1,0 +1,24 @@
+"""Input encodings. Reference: python/paddle/nn/functional/input.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply
+
+
+def one_hot(x, num_classes, name=None):
+    return apply(lambda v: jax.nn.one_hot(v, num_classes, dtype=jnp.float32), x)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Gather rows of `weight`. On TPU this lowers to a dynamic-gather that
+    XLA vectorizes; `sparse` is accepted for API parity (gradient is dense —
+    the TPU-native equivalent of the reference's selected-rows grad)."""
+    def fn(ids, w):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+        return out
+    return apply(fn, x, weight)

@@ -1,0 +1,352 @@
+"""Loss functionals. Reference: python/paddle/nn/functional/loss.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    def fn(logits, lab, w):
+        logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(
+            jnp.maximum(logits, 1e-30))
+        c = logits.shape[axis]
+        if soft_label or (lab.ndim == logits.ndim and lab.shape == logits.shape):
+            tgt = lab
+            if label_smoothing > 0:
+                tgt = (1 - label_smoothing) * tgt + label_smoothing / c
+            per = -jnp.sum(tgt * logp, axis=axis)
+            return _reduce(per, reduction)
+        lab_int = lab
+        if lab_int.ndim == logits.ndim:  # trailing 1 dim
+            lab_int = jnp.squeeze(lab_int, axis=axis)
+        valid = lab_int != ignore_index
+        safe = jnp.where(valid, lab_int, 0)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(safe, axis).astype(jnp.int32), axis=axis)
+        per = -jnp.squeeze(picked, axis=axis)
+        if label_smoothing > 0:
+            smooth = -jnp.mean(logp, axis=axis)
+            per = (1 - label_smoothing) * per + label_smoothing * smooth
+        if w is not None:
+            per = per * jnp.take(w, safe)
+        per = jnp.where(valid, per, 0.0)
+        if reduction == "mean":
+            if w is not None:
+                denom = jnp.sum(jnp.where(valid, jnp.take(w, safe), 0.0))
+            else:
+                denom = jnp.sum(valid.astype(per.dtype))
+            return jnp.sum(per) / jnp.maximum(denom, 1e-12)
+        if reduction == "sum":
+            return jnp.sum(per)
+        return per
+    return apply(fn, input, label, weight)
+
+
+softmax_with_cross_entropy = None  # defined below
+
+
+def _softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                                numeric_stable_mode=True, return_softmax=False,
+                                axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    from paddle_tpu.nn.functional.activation import softmax as _softmax
+    from paddle_tpu.tensor.manipulation import unsqueeze
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+softmax_with_cross_entropy = _softmax_with_cross_entropy
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    def fn(logp, lab, w):
+        valid = lab != ignore_index
+        safe = jnp.where(valid, lab, 0)
+        if logp.ndim > 2:  # [N, C, d1...] -> [N, d1..., C]
+            logp2 = jnp.moveaxis(logp, 1, -1)
+        else:
+            logp2 = logp
+        picked = jnp.take_along_axis(logp2, safe[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        per = -picked
+        if w is not None:
+            per = per * jnp.take(w, safe)
+        per = jnp.where(valid, per, 0.0)
+        if reduction == "mean":
+            denom = jnp.sum(jnp.where(valid, jnp.take(w, safe) if w is not None
+                                      else jnp.ones_like(per), 0.0))
+            return jnp.sum(per) / jnp.maximum(denom, 1e-12)
+        if reduction == "sum":
+            return jnp.sum(per)
+        return per
+    return apply(fn, input, label, weight)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce(jnp.square(a - b), reduction), input, label)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        per = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        # paddle smooth_l1_loss uses huber with delta scaling
+        return _reduce(per * delta, reduction)
+    return apply(fn, input, label)
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        per = jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(per, reduction)
+    return apply(fn, input, label)
+
+
+def bce_loss(input, label, weight=None, reduction="mean", name=None):
+    def fn(p, y, w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-7)
+        per = -(y * jnp.log(p) + (1 - y) * jnp.log1p(-p))
+        if w is not None:
+            per = per * w
+        return _reduce(per, reduction)
+    return apply(fn, input, label, weight)
+
+
+binary_cross_entropy = bce_loss
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def fn(z, y, w, pw):
+        log_sig = jax.nn.log_sigmoid(z)
+        log_sig_neg = jax.nn.log_sigmoid(-z)
+        if pw is not None:
+            per = -(pw * y * log_sig + (1 - y) * log_sig_neg)
+        else:
+            per = -(y * log_sig + (1 - y) * log_sig_neg)
+        if w is not None:
+            per = per * w
+        return _reduce(per, reduction)
+    return apply(fn, logit, label, weight, pos_weight)
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def fn(logp, y):
+        per = y * (jnp.log(jnp.maximum(y, 1e-30)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(per) / logp.shape[0]
+        return _reduce(per, reduction)
+    return apply(fn, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    def fn(a, b, y):
+        per = jnp.maximum(0.0, -y * (a - b) + margin)
+        return _reduce(per, reduction)
+    return apply(fn, input, other, label)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def fn(a, y):
+        per = jnp.where(y == 1.0, a, jnp.maximum(0.0, margin - a))
+        return _reduce(per, reduction)
+    return apply(fn, input, label)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def fn(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        per = jnp.where(y == 1, 1.0 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(per, reduction)
+    return apply(fn, input1, input2, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def fn(a, pos, neg):
+        def dist(u, v):
+            return jnp.sum(jnp.abs(u - v + epsilon) ** p, axis=-1) ** (1.0 / p)
+        dp = dist(a, pos)
+        dn = dist(a, neg)
+        if swap:
+            dn = jnp.minimum(dn, dist(pos, neg))
+        per = jnp.maximum(dp - dn + margin, 0.0)
+        return _reduce(per, reduction)
+    return apply(fn, input, positive, negative)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin=margin,
+                                   swap=swap, reduction=reduction)
+    dp = distance_function(input, positive)
+    dn = distance_function(input, negative)
+    if swap:
+        from paddle_tpu.tensor.math import minimum
+        dn = minimum(dn, distance_function(positive, negative))
+    return apply(lambda a, b: _reduce(jnp.maximum(a - b + margin, 0.0), reduction), dp, dn)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    def fn(a, y):
+        per = jnp.log1p(jnp.exp(-y * a))
+        return _reduce(per, reduction)
+    return apply(fn, input, label)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean", name=None):
+    def fn(a, y, w):
+        per = -(y * jax.nn.log_sigmoid(a) + (1 - y) * jax.nn.log_sigmoid(-a))
+        if w is not None:
+            per = per * w
+        per = jnp.mean(per, axis=-1)
+        return _reduce(per, reduction)
+    return apply(fn, input, label, weight)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def fn(a, y):
+        if log_input:
+            per = jnp.exp(a) - y * a
+        else:
+            per = a - y * jnp.log(a + epsilon)
+        if full:
+            stirling = y * jnp.log(y) - y + 0.5 * jnp.log(2 * jnp.pi * y)
+            per = per + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(per, reduction)
+    return apply(fn, input, label)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def fn(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        per = 0.5 * (jnp.log(var) + jnp.square(y - mu) / var)
+        if full:
+            per = per + 0.5 * jnp.log(2 * jnp.pi)
+        return _reduce(per, reduction)
+    return apply(fn, input, label, variance)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the standard log-alpha forward recursion as a `lax.scan`
+    (TPU-friendly: static shapes, no host loop).
+    Reference: paddle warpctc op (paddle/fluid/operators/warpctc_op.*)."""
+    def fn(lp, lab, in_len, lab_len):
+        # lp: [T, N, C] logits (paddle passes logits; take log_softmax)
+        lp = jax.nn.log_softmax(lp, axis=-1)
+        T, N, C = lp.shape
+        S = lab.shape[1]
+        ext = 2 * S + 1
+        # extended label seq: blank l1 blank l2 ... blank
+        ext_labels = jnp.full((N, ext), blank, dtype=lab.dtype)
+        ext_labels = ext_labels.at[:, 1::2].set(lab)
+        neg_inf = jnp.asarray(-1e30, lp.dtype)
+        alpha0 = jnp.full((N, ext), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        first_lab = jnp.take_along_axis(lp[0], ext_labels[:, 1:2].astype(jnp.int32), axis=1)[:, 0]
+        alpha0 = alpha0.at[:, 1].set(first_lab)
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((N, 2), dtype=bool),
+             ext_labels[:, 2:] == ext_labels[:, :-2]], axis=1)
+        is_blank = ext_labels == blank
+
+        def step(alpha, t):
+            lp_t = lp[t]
+            emit = jnp.take_along_axis(lp_t, ext_labels.astype(jnp.int32), axis=1)
+            a_prev = alpha
+            a_shift1 = jnp.concatenate([jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a_shift2 = jnp.concatenate([jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)
+            allow_skip = (~is_blank) & (~same_as_prev2)
+            cand = jnp.logaddexp(a_prev, a_shift1)
+            cand = jnp.where(allow_skip, jnp.logaddexp(cand, a_shift2), cand)
+            new_alpha = cand + emit
+            # mask steps beyond input length: keep old alpha
+            active = (t < in_len)[:, None]
+            return jnp.where(active, new_alpha, alpha), None
+
+        alpha_T, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        end_idx = (2 * lab_len).astype(jnp.int32)
+        a_last = jnp.take_along_axis(alpha_T, end_idx[:, None], axis=1)[:, 0]
+        a_prev_last = jnp.take_along_axis(
+            alpha_T, jnp.maximum(end_idx - 1, 0)[:, None], axis=1)[:, 0]
+        ll = jnp.logaddexp(a_last, a_prev_last)
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lab_len.astype(loss.dtype), 1.0))
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+    return apply(fn, log_probs, labels, input_lengths, label_lengths)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def fn(z, y, norm):
+        p = jax.nn.sigmoid(z)
+        ce = -(y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+        pt = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        per = a_t * ((1 - pt) ** gamma) * ce
+        if norm is not None:
+            per = per / norm
+        return _reduce(per, reduction)
+    return apply(fn, logit, label, normalizer)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def fn(p, y):
+        y1 = jax.nn.one_hot(y[..., 0], p.shape[-1], dtype=p.dtype)
+        red = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * y1, axis=red)
+        union = jnp.sum(p, axis=red) + jnp.sum(y1, axis=red)
+        return jnp.mean(1.0 - (2 * inter + epsilon) / (union + epsilon))
+    return apply(fn, input, label)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def fn(p, y):
+        return -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon)
+    return apply(fn, input, label)
+
+
+def square_error_cost(input, label):
+    return apply(lambda a, b: jnp.square(a - b), input, label)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def fn(a, p, y):
+        sim = a @ p.T
+        n = a.shape[0]
+        tgt = (y[:, None] == y[None, :]).astype(a.dtype)
+        tgt = tgt / jnp.sum(tgt, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        ce = -jnp.mean(jnp.sum(tgt * logp, axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, 1)) + jnp.mean(jnp.sum(p * p, 1))) * 0.25
+        return ce + reg
+    return apply(fn, anchor, positive, labels)

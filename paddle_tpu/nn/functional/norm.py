@@ -1,0 +1,174 @@
+"""Normalization functionals. Reference: python/paddle/nn/functional/norm.py.
+
+batch_norm follows paddle semantics: in training mode it normalizes with
+batch statistics and updates running stats in-place (value rebind — captured
+functionally under to_static); in eval mode it uses running stats.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.core.engine import no_grad
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def fn(v):
+        norm = jnp.sum(jnp.abs(v) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return v / jnp.maximum(norm, epsilon)
+    return apply(fn, x)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    channel_axis = 1 if data_format.startswith("NC") else -1
+    use_batch_stats = training and not use_global_stats
+
+    if use_batch_stats:
+        # compute batch stats and update running stats (paddle: r = m*r + (1-m)*b)
+        def fn(v, rm, rv, w, b):
+            axes = tuple(i for i in range(v.ndim) if i != channel_axis % v.ndim)
+            mean = jnp.mean(v, axis=axes)
+            var = jnp.var(v, axis=axes)
+            shape = [1] * v.ndim
+            shape[channel_axis % v.ndim] = -1
+            out = (v - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + epsilon)
+            if w is not None:
+                out = out * w.reshape(shape)
+            if b is not None:
+                out = out + b.reshape(shape)
+            return out, mean, var
+        out, mean_t, var_t = apply(fn, x, running_mean, running_var, weight, bias)
+        with no_grad():
+            n = int(np.prod([s for i, s in enumerate(x.shape)
+                             if i != channel_axis % x.ndim]))
+            unbias = n / max(n - 1, 1)
+            running_mean._set_value(
+                momentum * running_mean._value + (1 - momentum) * mean_t._value)
+            running_var._set_value(
+                momentum * running_var._value + (1 - momentum) * var_t._value * unbias)
+        return out
+
+    def fn_eval(v, rm, rv, w, b):
+        shape = [1] * v.ndim
+        shape[channel_axis % v.ndim] = -1
+        out = (v - rm.reshape(shape)) / jnp.sqrt(rv.reshape(shape) + epsilon)
+        if w is not None:
+            out = out * w.reshape(shape)
+        if b is not None:
+            out = out + b.reshape(shape)
+        return out
+    return apply(fn_eval, x, running_mean, running_var, weight, bias)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    nd = len(tuple(normalized_shape))
+
+    def fn(v, w, b):
+        axes = tuple(range(v.ndim - nd, v.ndim))
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) / jnp.sqrt(var + epsilon)
+        if w is not None:
+            out = out * w
+        if b is not None:
+            out = out + b
+        return out
+    return apply(fn, x, weight, bias)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-05,
+                  data_format="NCHW", name=None):
+    def fn(v, w, b):
+        axes = tuple(range(2, v.ndim))
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) / jnp.sqrt(var + eps)
+        if w is not None:
+            shape = [1, -1] + [1] * (v.ndim - 2)
+            out = out * w.reshape(shape)
+        if b is not None:
+            shape = [1, -1] + [1] * (v.ndim - 2)
+            out = out + b.reshape(shape)
+        return out
+    return apply(fn, x, weight, bias)
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    def fn(v, w, b):
+        cl = not data_format.startswith("NC")
+        if cl:
+            v = jnp.moveaxis(v, -1, 1)
+        n, c = v.shape[:2]
+        g = num_groups
+        vv = v.reshape((n, g, c // g) + v.shape[2:])
+        axes = tuple(range(2, vv.ndim))
+        mean = jnp.mean(vv, axis=axes, keepdims=True)
+        var = jnp.var(vv, axis=axes, keepdims=True)
+        out = ((vv - mean) / jnp.sqrt(var + epsilon)).reshape(v.shape)
+        shape = [1, -1] + [1] * (v.ndim - 2)
+        if w is not None:
+            out = out * w.reshape(shape)
+        if b is not None:
+            out = out + b.reshape(shape)
+        if cl:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    return apply(fn, x, weight, bias)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def fn(v):
+        cl = not data_format.startswith("NC")
+        if cl:
+            v = jnp.moveaxis(v, -1, 1)
+        sq = jnp.square(v)
+        c = v.shape[1]
+        half = size // 2
+        pad_lo, pad_hi = half, size - half - 1
+        sqp = jnp.pad(sq, [(0, 0), (pad_lo, pad_hi)] + [(0, 0)] * (v.ndim - 2))
+        acc = jnp.zeros_like(v)
+        for i in range(size):
+            acc = acc + sqp[:, i:i + c]
+        out = v / (k + alpha * acc) ** beta
+        if cl:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    return apply(fn, x)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (TPU-friendly LLM building block; also via pallas kernel)."""
+    def fn(v, w):
+        ms = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=-1, keepdims=True)
+        out = (v.astype(jnp.float32) / jnp.sqrt(ms + epsilon)).astype(v.dtype)
+        if w is not None:
+            out = out * w
+        return out
+    return apply(fn, x, weight)
+
+
+def spectral_norm(weight, weight_u, weight_v, dim=0, power_iters=1, eps=1e-12,
+                  name=None):
+    def fn(w, u, v):
+        wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        for _ in range(power_iters):
+            v = wm.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = wm @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        sigma = u @ wm @ v
+        return w / sigma, u, v
+    out, u_new, v_new = apply(fn, weight, weight_u, weight_v)
+    # persist the power iteration so u/v converge across steps
+    with no_grad():
+        weight_u._set_value(u_new._value)
+        weight_v._set_value(v_new._value)
+    return out

@@ -1,0 +1,79 @@
+"""Attention functionals.
+
+Reference: python/paddle/nn/functional/ (scaled_dot_product_attention appears
+in later paddle; incubate flash_attention). TPU-first: the hot path calls the
+Pallas flash-attention kernel (paddle_tpu/ops/pallas/flash_attention.py) when
+shapes allow; otherwise an XLA einsum softmax fallback (still MXU-bound).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply
+
+
+def _sdpa_ref(q, k, v, mask, dropout_p, causal, scale):
+    # q, k, v: [batch, seq, heads, head_dim] (paddle layout)
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * jnp.asarray(s, q.dtype)
+    if causal:
+        ql, kl = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
+        logits = jnp.where(cm, logits, jnp.asarray(-1e30, logits.dtype))
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, jnp.asarray(-1e30, logits.dtype))
+        else:
+            logits = logits + mask
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True,
+                                 scale=None, name=None):
+    """query/key/value: [batch, seq, num_heads, head_dim] (paddle convention)."""
+    use_flash = attn_mask is None and dropout_p == 0.0
+    if use_flash:
+        try:
+            from paddle_tpu.ops.pallas.flash_attention import flash_attention_bshd
+            return apply(lambda q, k, v: flash_attention_bshd(q, k, v, causal=is_causal,
+                                                              scale=scale),
+                         query, key, value)
+        except Exception:
+            pass
+    def fn(q, k, v, m):
+        return _sdpa_ref(q, k, v, m, dropout_p, is_causal, scale)
+    return apply(fn, query, key, value, attn_mask)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block-sparse attention. Reference: nn/functional/sparse_attention.py.
+    TPU note: implemented as dense attention with a sparsity mask built from
+    the CSR pattern (XLA handles masked softmax efficiently); a pallas
+    block-sparse kernel is the planned fast path."""
+    def fn(q, k, v, offs, cols):
+        b, h, ql, d = q.shape
+        kl = k.shape[2]
+        mask = jnp.zeros((b, h, ql, kl), bool)
+        # CSR rows -> dense mask (static pattern assumed)
+        import numpy as np
+        offs_np = np.asarray(offs)
+        cols_np = np.asarray(cols)
+        m = np.zeros((b, h, ql, kl), dtype=bool)
+        for bi in range(b):
+            for hi in range(h):
+                o = offs_np[bi, hi]
+                c = cols_np[bi, hi]
+                for r in range(ql):
+                    m[bi, hi, r, c[o[r]:o[r + 1]]] = True
+        mask = jnp.asarray(m)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(
+            jnp.asarray(d, jnp.float32)).astype(q.dtype)
+        logits = jnp.where(mask, logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return apply(fn, query, key, value, sparse_csr_offset, sparse_csr_columns)

@@ -1,0 +1,112 @@
+"""Vision functionals. Reference: python/paddle/nn/functional/vision.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c // (r * r), r, r, h, w)
+            v = jnp.transpose(v, (0, 1, 4, 2, 5, 3))
+            return v.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, r, r, c // (r * r))
+        v = jnp.transpose(v, (0, 1, 3, 2, 4, 5))
+        return v.reshape(n, h * r, w * r, c // (r * r))
+    return apply(fn, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c, h // r, r, w // r, r)
+            v = jnp.transpose(v, (0, 1, 3, 5, 2, 4))
+            return v.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h // r, r, w // r, r, c)
+        v = jnp.transpose(v, (0, 1, 3, 2, 4, 5))
+        return v.reshape(n, h // r, w // r, c * r * r)
+    return apply(fn, x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, groups, c // groups, h, w)
+            v = jnp.swapaxes(v, 1, 2)
+            return v.reshape(n, c, h, w)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, groups, c // groups)
+        v = jnp.swapaxes(v, 3, 4)
+        return v.reshape(n, h, w, c)
+    return apply(fn, x)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    def fn(th):
+        n, _, h, w = [int(s) for s in out_shape] if len(out_shape) == 4 else (
+            int(out_shape[0]), 0, int(out_shape[1]), int(out_shape[2]))
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, h)
+            xs = jnp.linspace(-1.0, 1.0, w)
+        else:
+            ys = (jnp.arange(h) * 2 + 1) / h - 1
+            xs = (jnp.arange(w) * 2 + 1) / w - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)  # [h, w, 3]
+        return jnp.einsum("hwk,nck->nhwc", base, th)
+    return apply(fn, theta)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    def fn(v, g):
+        n, c, h, w = v.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def sample(ix, iy):
+            if padding_mode == "border":
+                ix = jnp.clip(ix, 0, w - 1)
+                iy = jnp.clip(iy, 0, h - 1)
+                valid = jnp.ones_like(ix, dtype=bool)
+            elif padding_mode == "reflection":
+                ix = jnp.abs(jnp.mod(ix, 2 * (w - 1)) - (w - 1)) if w > 1 else ix * 0
+                iy = jnp.abs(jnp.mod(iy, 2 * (h - 1)) - (h - 1)) if h > 1 else iy * 0
+                valid = jnp.ones_like(ix, dtype=bool)
+            else:
+                valid = (ix >= 0) & (ix <= w - 1) & (iy >= 0) & (iy <= h - 1)
+                ix = jnp.clip(ix, 0, w - 1)
+                iy = jnp.clip(iy, 0, h - 1)
+            vals = v[jnp.arange(n)[:, None, None], :, iy.astype(jnp.int32),
+                     ix.astype(jnp.int32)]  # [n, gh, gw, c]
+            return jnp.where(valid[..., None], vals, 0.0)
+
+        if mode == "nearest":
+            out = sample(jnp.round(fx), jnp.round(fy))
+        else:
+            x0, y0 = jnp.floor(fx), jnp.floor(fy)
+            x1, y1 = x0 + 1, y0 + 1
+            wa = (x1 - fx) * (y1 - fy)
+            wb = (x1 - fx) * (fy - y0)
+            wc = (fx - x0) * (y1 - fy)
+            wd = (fx - x0) * (fy - y0)
+            out = (sample(x0, y0) * wa[..., None] + sample(x0, y1) * wb[..., None]
+                   + sample(x1, y0) * wc[..., None] + sample(x1, y1) * wd[..., None])
+        return jnp.transpose(out, (0, 3, 1, 2))
+    return apply(fn, x, grid)

@@ -1,0 +1,89 @@
+"""Activation layers. Reference: python/paddle/nn/layer/activation.py."""
+from __future__ import annotations
+
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layer.layers import Layer
+
+
+def _simple(fname, **defaults):
+    class _Act(Layer):
+        def __init__(self, *args, name=None, **kwargs):
+            super().__init__()
+            merged = dict(defaults)
+            keys = list(defaults.keys())
+            for i, a in enumerate(args):
+                merged[keys[i]] = a
+            merged.update({k: v for k, v in kwargs.items() if k in merged})
+            self._kwargs = merged
+
+        def forward(self, x):
+            return getattr(F, fname)(x, **self._kwargs)
+    return _Act
+
+
+ReLU = _simple("relu")
+ReLU6 = _simple("relu6")
+GELU = _simple("gelu", approximate=False)
+Sigmoid = _simple("sigmoid")
+Tanh = _simple("tanh")
+LogSigmoid = _simple("log_sigmoid")
+Hardsigmoid = _simple("hardsigmoid")
+Hardswish = _simple("hardswish")
+Hardtanh = _simple("hardtanh", min=-1.0, max=1.0)
+ELU = _simple("elu", alpha=1.0)
+CELU = _simple("celu", alpha=1.0)
+SELU = _simple("selu", scale=1.0507009873554805, alpha=1.6732632423543772)
+LeakyReLU = _simple("leaky_relu", negative_slope=0.01)
+Hardshrink = _simple("hardshrink", threshold=0.5)
+Softshrink = _simple("softshrink", threshold=0.5)
+Tanhshrink = _simple("tanhshrink")
+Softplus = _simple("softplus", beta=1, threshold=20)
+Softsign = _simple("softsign")
+Swish = _simple("swish")
+Silu = _simple("silu")
+Mish = _simple("mish")
+ThresholdedReLU = _simple("thresholded_relu", threshold=1.0)
+GLU = _simple("glu", axis=-1)
+RReLU = _simple("rrelu", lower=0.125, upper=1.0 / 3.0)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, self.axis)
+
+
+class LogSoftmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.log_softmax(x, self.axis)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            shape=[num_parameters], attr=weight_attr,
+            default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self._data_format)
+
+
+class Maxout(Layer):
+    def __init__(self, groups, axis=1, name=None):
+        super().__init__()
+        self.groups = groups
+        self.axis = axis
+
+    def forward(self, x):
+        return F.maxout(x, self.groups, self.axis)

@@ -1,0 +1,16 @@
+"""Distance layers. Reference: python/paddle/nn/layer/distance.py."""
+from __future__ import annotations
+
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.layer.layers import Layer
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon, self.keepdim)

@@ -1,0 +1,266 @@
+"""Norm layers. Reference: python/paddle/nn/layer/norm.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layer.layers import Layer
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self._num_features = num_features
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self._use_global_stats = use_global_stats
+        self.weight = None
+        self.bias = None
+        if weight_attr is not False:
+            self.weight = self.create_parameter(
+                shape=[num_features],
+                attr=weight_attr if weight_attr is not True else None,
+                default_initializer=I.Constant(1.0))
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                shape=[num_features],
+                attr=bias_attr if bias_attr is not True else None, is_bias=True)
+        self.register_buffer("_mean", Tensor(jnp.zeros(num_features, self._dtype)))
+        self.register_buffer("_variance", Tensor(jnp.ones(num_features, self._dtype)))
+
+    def forward(self, input):
+        return F.batch_norm(
+            input, self._mean, self._variance, self.weight, self.bias,
+            training=self.training, momentum=self._momentum,
+            epsilon=self._epsilon, data_format=self._data_format,
+            use_global_stats=self._use_global_stats)
+
+
+class BatchNorm(_BatchNormBase):
+    """fluid-style BatchNorm (acts like BatchNorm2D with act support)."""
+
+    def __init__(self, num_channels, act=None, momentum=0.9, epsilon=1e-05,
+                 data_format="NCHW", **kwargs):
+        super().__init__(num_channels, momentum, epsilon, data_format=data_format)
+        self._act = act
+
+    def forward(self, input):
+        out = super().forward(input)
+        if self._act:
+            out = getattr(F, self._act)(out)
+        return out
+
+
+class BatchNorm1D(_BatchNormBase):
+    pass
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW", **kw):
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr, data_format)
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Cross-replica batch norm.
+
+    Reference: nn/layer/norm.py SyncBatchNorm (NCCL allreduce of stats).
+    TPU-native: inside pjit/shard_map the mean/var reduction becomes an XLA
+    AllReduce over the `dp` mesh axis automatically when the batch axis is
+    sharded — so plain batch_norm with psum'd statistics. Single-process
+    eager mode falls back to local stats.
+    """
+
+    def forward(self, input):
+        from paddle_tpu.distributed import mesh as dmesh
+        axis = dmesh.current_collective_axis()
+        if axis is None:
+            return super().forward(input)
+        # Under shard_map: psum batch statistics across the dp axis.
+        import jax
+        from paddle_tpu.core.dispatch import apply
+        from paddle_tpu.core.engine import no_grad
+        ca = 1 if self._data_format.startswith("NC") else -1
+
+        def fn(v, w, b):
+            axes = tuple(i for i in range(v.ndim) if i != ca % v.ndim)
+            cnt = np.prod([v.shape[i] for i in axes])
+            s = jax.lax.psum(jnp.sum(v, axis=axes), axis)
+            ss = jax.lax.psum(jnp.sum(v * v, axis=axes), axis)
+            n = jax.lax.psum(jnp.asarray(cnt, jnp.float32), axis)
+            mean = s / n
+            var = ss / n - mean * mean
+            shape = [1] * v.ndim
+            shape[ca % v.ndim] = -1
+            out = (v - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + self._epsilon)
+            if w is not None:
+                out = out * w.reshape(shape)
+            if b is not None:
+                out = out + b.reshape(shape)
+            return out, mean, var
+        out, mean_t, var_t = apply(fn, input, self.weight, self.bias)
+        if self.training:
+            with no_grad():
+                m = self._momentum
+                self._mean._set_value(m * self._mean._value + (1 - m) * mean_t._value)
+                self._variance._set_value(m * self._variance._value + (1 - m) * var_t._value)
+        return out
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        out = layer
+        if isinstance(layer, _BatchNormBase) and not isinstance(layer, SyncBatchNorm):
+            out = SyncBatchNorm(layer._num_features, layer._momentum,
+                                layer._epsilon, data_format=layer._data_format)
+            if layer.weight is not None:
+                out.weight.set_value(layer.weight)
+            if layer.bias is not None:
+                out.bias.set_value(layer.bias)
+            out._mean.set_value(layer._mean)
+            out._variance.set_value(layer._variance)
+        for name, sub in list(layer._sub_layers.items()):
+            out.add_sublayer(name, cls.convert_sync_batchnorm(sub))
+        return out
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-05, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._normalized_shape = list(normalized_shape)
+        self._epsilon = epsilon
+        self.weight = None
+        self.bias = None
+        if weight_attr is not False:
+            self.weight = self.create_parameter(
+                shape=self._normalized_shape,
+                attr=weight_attr if weight_attr is not True else None,
+                default_initializer=I.Constant(1.0))
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                shape=self._normalized_shape,
+                attr=bias_attr if bias_attr is not True else None, is_bias=True)
+
+    def forward(self, input):
+        return F.layer_norm(input, self._normalized_shape, self.weight,
+                            self.bias, self._epsilon)
+
+
+class RMSNorm(Layer):
+    """TPU-friendly RMSNorm (used by LLM blocks; pallas fused kernel backs
+    the hot path)."""
+
+    def __init__(self, hidden_size, epsilon=1e-6, weight_attr=None, name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        self.weight = self.create_parameter(
+            shape=[hidden_size], attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+
+    def forward(self, input):
+        return F.rms_norm(input, self.weight, self._epsilon)
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self.weight = None
+        self.bias = None
+        if weight_attr is not False:
+            self.weight = self.create_parameter(
+                shape=[num_channels],
+                attr=weight_attr if weight_attr is not True else None,
+                default_initializer=I.Constant(1.0))
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                shape=[num_channels],
+                attr=bias_attr if bias_attr is not True else None, is_bias=True)
+
+    def forward(self, input):
+        return F.group_norm(input, self._num_groups, self._epsilon,
+                            self.weight, self.bias, self._data_format)
+
+
+class _InstanceNormBase(Layer):
+    def __init__(self, num_features, epsilon=1e-05, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        self.weight = None
+        self.bias = None
+        if weight_attr is not False:
+            self.weight = self.create_parameter(
+                shape=[num_features],
+                attr=weight_attr if weight_attr is not True else None,
+                default_initializer=I.Constant(1.0))
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                shape=[num_features],
+                attr=bias_attr if bias_attr is not True else None, is_bias=True)
+
+    def forward(self, input):
+        return F.instance_norm(input, weight=self.weight, bias=self.bias,
+                               eps=self._epsilon)
+
+
+class InstanceNorm1D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm2D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm3D(_InstanceNormBase):
+    pass
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=0.0001, beta=0.75, k=1.0,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.size = size
+        self.alpha = alpha
+        self.beta = beta
+        self.k = k
+        self.data_format = data_format
+
+    def forward(self, input):
+        return F.local_response_norm(input, self.size, self.alpha, self.beta,
+                                     self.k, self.data_format)
+
+
+class SpectralNorm(Layer):
+    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12,
+                 dtype="float32"):
+        super().__init__()
+        self._dim = dim
+        self._power_iters = power_iters
+        self._epsilon = epsilon
+        h = weight_shape[dim]
+        w = int(np.prod(weight_shape)) // h
+        self.register_buffer("weight_u", Tensor(
+            jnp.asarray(np.random.default_rng(0).normal(size=h), jnp.float32)))
+        self.register_buffer("weight_v", Tensor(
+            jnp.asarray(np.random.default_rng(1).normal(size=w), jnp.float32)))
+
+    def forward(self, weight):
+        return F.spectral_norm(weight, self.weight_u, self.weight_v, self._dim,
+                               self._power_iters, self._epsilon)

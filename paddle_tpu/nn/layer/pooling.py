@@ -1,0 +1,120 @@
+"""Pooling layers. Reference: python/paddle/nn/layer/pooling.py."""
+from __future__ import annotations
+
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.layer.layers import Layer
+
+
+class _Pool(Layer):
+    def __init__(self, kernel_size=None, stride=None, padding=0, **kwargs):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.kw = kwargs
+
+
+class MaxPool1D(_Pool):
+    def forward(self, x):
+        return F.max_pool1d(x, self.kernel_size, self.stride, self.padding,
+                            self.kw.get("return_mask", False),
+                            self.kw.get("ceil_mode", False))
+
+
+class MaxPool2D(_Pool):
+    def forward(self, x):
+        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            self.kw.get("return_mask", False),
+                            self.kw.get("ceil_mode", False),
+                            self.kw.get("data_format", "NCHW"))
+
+
+class MaxPool3D(_Pool):
+    def forward(self, x):
+        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding,
+                            self.kw.get("return_mask", False),
+                            self.kw.get("ceil_mode", False),
+                            self.kw.get("data_format", "NCDHW"))
+
+
+class AvgPool1D(_Pool):
+    def forward(self, x):
+        return F.avg_pool1d(x, self.kernel_size, self.stride, self.padding,
+                            self.kw.get("exclusive", True),
+                            self.kw.get("ceil_mode", False))
+
+
+class AvgPool2D(_Pool):
+    def forward(self, x):
+        return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            self.kw.get("ceil_mode", False),
+                            self.kw.get("exclusive", True),
+                            self.kw.get("divisor_override"),
+                            self.kw.get("data_format", "NCHW"))
+
+
+class AvgPool3D(_Pool):
+    def forward(self, x):
+        return F.avg_pool3d(x, self.kernel_size, self.stride, self.padding,
+                            self.kw.get("ceil_mode", False),
+                            self.kw.get("exclusive", True),
+                            self.kw.get("divisor_override"),
+                            self.kw.get("data_format", "NCDHW"))
+
+
+class _AdaptivePool(Layer):
+    def __init__(self, output_size, return_mask=False, **kwargs):
+        super().__init__()
+        self.output_size = output_size
+        self.return_mask = return_mask
+        self.kw = kwargs
+
+
+class AdaptiveAvgPool1D(_AdaptivePool):
+    def forward(self, x):
+        return F.adaptive_avg_pool1d(x, self.output_size)
+
+
+class AdaptiveAvgPool2D(_AdaptivePool):
+    def forward(self, x):
+        return F.adaptive_avg_pool2d(x, self.output_size,
+                                     self.kw.get("data_format", "NCHW"))
+
+
+class AdaptiveAvgPool3D(_AdaptivePool):
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, self.output_size,
+                                     self.kw.get("data_format", "NCDHW"))
+
+
+class AdaptiveMaxPool1D(_AdaptivePool):
+    def forward(self, x):
+        return F.adaptive_max_pool1d(x, self.output_size, self.return_mask)
+
+
+class AdaptiveMaxPool2D(_AdaptivePool):
+    def forward(self, x):
+        return F.adaptive_max_pool2d(x, self.output_size, self.return_mask)
+
+
+class AdaptiveMaxPool3D(_AdaptivePool):
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, self.output_size, self.return_mask)
+
+
+class MaxUnPool1D(_Pool):
+    def forward(self, x, indices):
+        return F.max_unpool1d(x, indices, self.kernel_size, self.stride,
+                              self.padding, output_size=self.kw.get("output_size"))
+
+
+class MaxUnPool2D(_Pool):
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, self.kernel_size, self.stride,
+                              self.padding, output_size=self.kw.get("output_size"))
+
+
+class MaxUnPool3D(_Pool):
+    def forward(self, x, indices):
+        return F.max_unpool3d(x, indices, self.kernel_size, self.stride,
+                              self.padding, output_size=self.kw.get("output_size"))

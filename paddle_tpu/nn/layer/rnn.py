@@ -1,0 +1,338 @@
+"""Recurrent layers. Reference: python/paddle/nn/layer/rnn.py.
+
+TPU-first: the time loop is a `lax.scan` (single compiled loop body, static
+shapes) instead of the reference's per-timestep op dispatch / cuDNN RNN.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layer.container import LayerList
+from paddle_tpu.nn.layer.layers import Layer
+from paddle_tpu.tensor import manipulation as M
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0, batch_dim_idx=0):
+        from paddle_tpu.tensor.creation import full
+        b = batch_ref.shape[batch_dim_idx]
+        shape = shape or self.state_shape
+        if isinstance(shape, (list, tuple)) and isinstance(shape[0], (list, tuple)):
+            return tuple(full([b] + list(s), init_value, dtype) for s in shape)
+        return full([b] + list(shape), init_value, dtype)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.input_size = input_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        def fn(x, h, wi, wh, bi, bh):
+            act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+            return act(x @ wi.T + bi + h @ wh.T + bh)
+        h = apply(fn, inputs, states, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh)
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.input_size = input_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs, self.state_shape)
+        h, c = states
+        def fn(x, hv, cv, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + hv @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            new_c = f * cv + i * g
+            new_h = o * jnp.tanh(new_c)
+            return new_h, new_c
+        new_h, new_c = apply(fn, inputs, h, c, self.weight_ih, self.weight_hh,
+                             self.bias_ih, self.bias_hh)
+        return new_h, (new_h, new_c)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.input_size = input_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        def fn(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            return (1 - z) * c + z * h
+        h = apply(fn, inputs, states, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh)
+        return h, h
+
+
+class RNN(Layer):
+    """Run a cell over time with lax.scan."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if initial_states is None:
+            batch_ref = inputs if self.time_major else inputs
+            initial_states = self.cell.get_initial_states(
+                batch_ref, batch_dim_idx=1 if self.time_major else 0)
+        # gather cell parameters for the scan-carried closure
+        cell = self.cell
+        params = {k: p for k, p in cell._parameters.items()}
+        from paddle_tpu.core.dispatch import apply as _apply
+
+        single_state = not isinstance(initial_states, (tuple, list))
+        states_t = (initial_states,) if single_state else tuple(initial_states)
+        param_names = list(params.keys())
+
+        def fn(x, *rest):
+            n_state = len(states_t)
+            svals = rest[:n_state]
+            pvals = dict(zip(param_names, rest[n_state:]))
+            xm = jnp.swapaxes(x, 0, 1) if not self.time_major else x
+            if self.is_reverse:
+                xm = jnp.flip(xm, 0)
+
+            def body(carry, xt):
+                out_h, new_carry = _cell_pure(cell, xt, carry, pvals)
+                return new_carry, out_h
+
+            carry, outs = jax.lax.scan(body, tuple(svals), xm)
+            if self.is_reverse:
+                outs = jnp.flip(outs, 0)
+            if not self.time_major:
+                outs = jnp.swapaxes(outs, 0, 1)
+            return (outs,) + tuple(carry)
+
+        res = _apply(fn, inputs, *states_t, *[params[k] for k in param_names])
+        outs = res[0]
+        final = res[1:]
+        final_states = final[0] if single_state else tuple(final)
+        return outs, final_states
+
+
+def _cell_pure(cell, xt, carry, pvals):
+    """Pure-array versions of the cell recurrences for use inside scan."""
+    if isinstance(cell, LSTMCell):
+        h, c = carry
+        gates = xt @ pvals["weight_ih"].T + pvals["bias_ih"] + \
+            h @ pvals["weight_hh"].T + pvals["bias_hh"]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        new_c = f * c + i * g
+        new_h = o * jnp.tanh(new_c)
+        return new_h, (new_h, new_c)
+    if isinstance(cell, GRUCell):
+        (h,) = carry
+        gi = xt @ pvals["weight_ih"].T + pvals["bias_ih"]
+        gh = h @ pvals["weight_hh"].T + pvals["bias_hh"]
+        ir, iz, ic = jnp.split(gi, 3, axis=-1)
+        hr, hz, hc = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(ir + hr)
+        z = jax.nn.sigmoid(iz + hz)
+        c = jnp.tanh(ic + r * hc)
+        new_h = (1 - z) * c + z * h
+        return new_h, (new_h,)
+    if isinstance(cell, SimpleRNNCell):
+        (h,) = carry
+        act = jnp.tanh if cell.activation == "tanh" else jax.nn.relu
+        new_h = act(xt @ pvals["weight_ih"].T + pvals["bias_ih"] +
+                    h @ pvals["weight_hh"].T + pvals["bias_hh"])
+        return new_h, (new_h,)
+    raise TypeError(f"unsupported cell {type(cell)}")
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if initial_states is None:
+            fw_states = bw_states = None
+        else:
+            fw_states, bw_states = initial_states
+        out_fw, st_fw = self.rnn_fw(inputs, fw_states, sequence_length)
+        out_bw, st_bw = self.rnn_bw(inputs, bw_states, sequence_length)
+        out = M.concat([out_fw, out_bw], axis=-1)
+        return out, (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    _cell_cls = None
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **cell_kwargs):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        bidirect = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if bidirect else 1
+        self.direction = direction
+        layers = []
+        for i in range(num_layers):
+            in_size = input_size if i == 0 else hidden_size * self.num_directions
+            if bidirect:
+                layers.append(BiRNN(self._cell_cls(in_size, hidden_size, **cell_kwargs),
+                                    self._cell_cls(in_size, hidden_size, **cell_kwargs),
+                                    time_major))
+            else:
+                layers.append(RNN(self._cell_cls(in_size, hidden_size, **cell_kwargs),
+                                  is_reverse=(direction == "backward"),
+                                  time_major=time_major))
+        self.layer_list = LayerList(layers)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        out = inputs
+        finals = []
+        for i, rnn in enumerate(self.layer_list):
+            st = None if initial_states is None else _layer_states(
+                initial_states, i, self.num_directions, self._is_lstm())
+            out, fs = rnn(out, st, sequence_length)
+            finals.append(fs)
+            if self.dropout > 0 and i < self.num_layers - 1:
+                from paddle_tpu.nn.functional.common import dropout as _dropout
+                out = _dropout(out, self.dropout, training=self.training)
+        stacked = _stack_states(finals, self.num_directions, self._is_lstm())
+        return out, stacked
+
+    def _is_lstm(self):
+        return self._cell_cls is LSTMCell
+
+
+def _layer_states(initial_states, i, num_directions, is_lstm):
+    if is_lstm:
+        h, c = initial_states
+        if num_directions == 2:
+            return ((h[2 * i], c[2 * i]), (h[2 * i + 1], c[2 * i + 1]))
+        return (h[i], c[i])
+    h = initial_states
+    if num_directions == 2:
+        return (h[2 * i], h[2 * i + 1])
+    return h[i]
+
+
+def _stack_states(finals, num_directions, is_lstm):
+    from paddle_tpu.tensor.manipulation import stack
+    if is_lstm:
+        hs, cs = [], []
+        for fs in finals:
+            if num_directions == 2:
+                (h1, c1), (h2, c2) = fs
+                hs += [h1, h2]
+                cs += [c1, c2]
+            else:
+                h, c = fs
+                hs.append(h)
+                cs.append(c)
+        return stack(hs, 0), stack(cs, 0)
+    hs = []
+    for fs in finals:
+        if num_directions == 2:
+            h1, h2 = fs
+            hs += [h1, h2]
+        else:
+            hs.append(fs)
+    return stack(hs, 0)
+
+
+class SimpleRNN(_RNNBase):
+    _cell_cls = SimpleRNNCell
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation=activation)
+
+
+class LSTM(_RNNBase):
+    _cell_cls = LSTMCell
+
+
+class GRU(_RNNBase):
+    _cell_cls = GRUCell

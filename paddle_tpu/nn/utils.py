@@ -1,0 +1,114 @@
+"""nn.utils. Reference: python/paddle/nn/utils/*."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.engine import no_grad
+from paddle_tpu.core.tensor import Tensor
+
+
+def parameters_to_vector(parameters, name=None):
+    from paddle_tpu.tensor.manipulation import concat, reshape
+    return concat([reshape(p, [-1]) for p in parameters], axis=0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    with no_grad():
+        for p in parameters:
+            n = int(np.prod(p._value.shape))
+            p._set_value(vec._value[offset:offset + n].reshape(p._value.shape))
+            offset += n
+
+
+class _WeightNorm:
+    def __init__(self, name, dim):
+        self.name = name
+        self.dim = dim
+
+    @staticmethod
+    def apply(layer, name, dim):
+        w = getattr(layer, name)
+        wn = _WeightNorm(name, dim)
+        dims = tuple(i for i in range(w._value.ndim) if i != (dim if dim is not None else 0))
+        if dim is None:
+            g0 = jnp.sqrt(jnp.sum(jnp.square(w._value)))
+        else:
+            g0 = jnp.sqrt(jnp.sum(jnp.square(w._value), axis=dims, keepdims=False))
+        from paddle_tpu.core.tensor import Parameter
+        layer.add_parameter(name + "_g", Parameter(g0))
+        layer.add_parameter(name + "_v", Parameter(w._value))
+        del layer._parameters[name]
+        hook = layer.register_forward_pre_hook(
+            lambda l, inp: wn._recompute(l) or None)
+        layer.__dict__.setdefault("_weight_norm_hooks", {})[name] = (wn, hook)
+        wn._recompute(layer)
+        return wn
+
+    def _recompute(self, layer):
+        from paddle_tpu.core.dispatch import apply
+        g = layer._parameters[self.name + "_g"]
+        v = layer._parameters[self.name + "_v"]
+        dim = self.dim
+
+        def fn(gv, vv):
+            if dim is None:
+                norm = jnp.sqrt(jnp.sum(jnp.square(vv)))
+                return vv * (gv / norm)
+            dims = tuple(i for i in range(vv.ndim) if i != dim)
+            norm = jnp.sqrt(jnp.sum(jnp.square(vv), axis=dims, keepdims=True))
+            shape = [1] * vv.ndim
+            shape[dim] = -1
+            return vv / norm * gv.reshape(shape)
+        w = apply(fn, g, v)
+        object.__setattr__(layer, self.name, w)
+
+
+def weight_norm(layer, name="weight", dim=0):
+    _WeightNorm.apply(layer, name, dim)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    hooks = layer.__dict__.get("_weight_norm_hooks", {})
+    if name in hooks:
+        wn, hook = hooks.pop(name)
+        g = layer._parameters.pop(name + "_g")
+        v = layer._parameters.pop(name + "_v")
+        hook.remove()
+        from paddle_tpu.core.tensor import Parameter
+        dim = wn.dim
+        if dim is None:
+            norm = jnp.sqrt(jnp.sum(jnp.square(v._value)))
+            w = v._value * (g._value / norm)
+        else:
+            dims = tuple(i for i in range(v._value.ndim) if i != dim)
+            norm = jnp.sqrt(jnp.sum(jnp.square(v._value), axis=dims, keepdims=True))
+            shape = [1] * v._value.ndim
+            shape[dim] = -1
+            w = v._value / norm * g._value.reshape(shape)
+        if name in layer.__dict__:
+            del layer.__dict__[name]
+        layer.add_parameter(name, Parameter(w))
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=None):
+    """Wrap a layer's weight with spectral normalization (paddle.nn.utils)."""
+    from paddle_tpu.nn.layer.norm import SpectralNorm as _SN
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    sn = _SN(tuple(w._value.shape), dim=dim, power_iters=n_power_iterations,
+             epsilon=eps)
+    layer.add_sublayer(name + "_spectral_norm", sn)
+    orig = layer._parameters[name]
+    layer._parameters[name + "_orig"] = orig
+    del layer._parameters[name]
+
+    def pre_hook(l, inp):
+        object.__setattr__(l, name, sn(l._parameters[name + "_orig"]))
+    layer.register_forward_pre_hook(pre_hook)
+    pre_hook(layer, None)
+    return layer

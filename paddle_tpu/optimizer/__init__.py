@@ -1,0 +1,6 @@
+"""Optimizers. Reference: python/paddle/optimizer/__init__.py."""
+from paddle_tpu.optimizer import lr  # noqa: F401
+from paddle_tpu.optimizer.adam import Adam, Adamax, AdamW, Lamb  # noqa: F401
+from paddle_tpu.optimizer.optimizer import Optimizer  # noqa: F401
+from paddle_tpu.optimizer.rmsprop import Adadelta, Adagrad, RMSProp  # noqa: F401
+from paddle_tpu.optimizer.sgd import SGD, Momentum  # noqa: F401

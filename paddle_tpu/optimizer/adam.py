@@ -1,0 +1,120 @@
+"""Adam / AdamW / Adamax / Lamb. Reference: python/paddle/optimizer/adam*.py, lamb.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.optimizer.optimizer import Optimizer
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _update_param(self, p, g, lr_mult):
+        lr = self._lr_value() * lr_mult
+        g = g.astype(jnp.float32)
+        m = self._acc("moment1", p, dtype=jnp.float32)
+        v = self._acc("moment2", p, dtype=jnp.float32)
+        b1p = self._acc("beta1_pow", p, init=1.0, shape=(), dtype=jnp.float32)
+        b2p = self._acc("beta2_pow", p, init=1.0, shape=(), dtype=jnp.float32)
+        b1p._set_value(b1p._value * self._beta1)
+        b2p._set_value(b2p._value * self._beta2)
+        new_m = self._beta1 * m._value + (1 - self._beta1) * g
+        new_v = self._beta2 * v._value + (1 - self._beta2) * g * g
+        m._set_value(new_m)
+        v._set_value(new_v)
+        mhat = new_m / (1 - b1p._value)
+        vhat = new_v / (1 - b2p._value)
+        upd = lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        p._set_value((p._value.astype(jnp.float32) - upd).astype(p._value.dtype))
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, name)
+        self._coeff = weight_decay if isinstance(weight_decay, float) else 0.01
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _update_param(self, p, g, lr_mult):
+        if self._lr_ratio is not None:
+            lr_mult = lr_mult * self._lr_ratio(p)
+        lr = self._lr_value() * lr_mult
+        if self._apply_decay_param_fun is None or self._apply_decay_param_fun(p.name):
+            p._set_value((p._value.astype(jnp.float32) *
+                          (1.0 - lr * self._coeff)).astype(p._value.dtype))
+        super()._update_param(p, g, lr_mult)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _update_param(self, p, g, lr_mult):
+        lr = self._lr_value() * lr_mult
+        g = g.astype(jnp.float32)
+        m = self._acc("moment", p, dtype=jnp.float32)
+        u = self._acc("inf_norm", p, dtype=jnp.float32)
+        b1p = self._acc("beta1_pow", p, init=1.0, shape=(), dtype=jnp.float32)
+        b1p._set_value(b1p._value * self._beta1)
+        new_m = self._beta1 * m._value + (1 - self._beta1) * g
+        new_u = jnp.maximum(self._beta2 * u._value, jnp.abs(g))
+        m._set_value(new_m)
+        u._set_value(new_u)
+        upd = lr * new_m / ((1 - b1p._value) * (new_u + self._epsilon))
+        p._set_value((p._value.astype(jnp.float32) - upd).astype(p._value.dtype))
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-06, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _update_param(self, p, g, lr_mult):
+        lr = self._lr_value() * lr_mult
+        g = g.astype(jnp.float32)
+        m = self._acc("moment1", p, dtype=jnp.float32)
+        v = self._acc("moment2", p, dtype=jnp.float32)
+        b1p = self._acc("beta1_pow", p, init=1.0, shape=(), dtype=jnp.float32)
+        b2p = self._acc("beta2_pow", p, init=1.0, shape=(), dtype=jnp.float32)
+        b1p._set_value(b1p._value * self._beta1)
+        b2p._set_value(b2p._value * self._beta2)
+        new_m = self._beta1 * m._value + (1 - self._beta1) * g
+        new_v = self._beta2 * v._value + (1 - self._beta2) * g * g
+        m._set_value(new_m)
+        v._set_value(new_v)
+        mhat = new_m / (1 - b1p._value)
+        vhat = new_v / (1 - b2p._value)
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon)
+        wd = 0.0 if (self._exclude_fn is not None and self._exclude_fn(p)) \
+            else self._lamb_wd
+        pf = p._value.astype(jnp.float32)
+        update = r + wd * pf
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(pf)))
+        u_norm = jnp.sqrt(jnp.sum(jnp.square(update)))
+        trust = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+        p._set_value((pf - lr * trust * update).astype(p._value.dtype))

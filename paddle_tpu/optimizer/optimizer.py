@@ -1,0 +1,154 @@
+"""Optimizer base. Reference: python/paddle/optimizer/optimizer.py.
+
+Design: paddle's imperative `opt.step()` API, functional underneath — every
+accumulator (moments etc.) and the learning-rate live as registered state
+Tensors, so a `to_static` train step traces forward+backward+update into ONE
+XLA program (the lr is a lifted scalar input, not a baked constant, so LR
+schedules don't retrigger compilation).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.engine import no_grad
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.framework.state import register_state_tensor
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        from paddle_tpu.optimizer.lr import LRScheduler
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._lr_scheduler = None
+        if isinstance(learning_rate, LRScheduler):
+            self._lr_scheduler = learning_rate
+            lr0 = learning_rate()
+        else:
+            lr0 = float(learning_rate)
+        self._lr_tensor = Tensor(jnp.asarray(lr0, jnp.float32), name="learning_rate")
+        self._lr_tensor.persistable = True
+        register_state_tensor(self._lr_tensor)
+        if self._lr_scheduler is not None:
+            self._lr_scheduler._bind(self._lr_tensor)
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._accumulators = {}
+
+    # ---- lr ----
+    def get_lr(self):
+        if self._lr_scheduler is not None:
+            return self._lr_scheduler()
+        return float(self._lr_tensor._value)
+
+    def set_lr(self, value):
+        if self._lr_scheduler is not None:
+            raise RuntimeError("cannot set_lr when LRScheduler is used")
+        self._lr_tensor._set_value(jnp.asarray(float(value), jnp.float32))
+
+    @property
+    def _learning_rate(self):
+        return self._lr_scheduler if self._lr_scheduler is not None else \
+            float(self._lr_tensor._value)
+
+    def _lr_value(self):
+        """Traced lr read used inside update rules."""
+        return self._lr_tensor._value
+
+    # ---- accumulators ----
+    def _acc(self, name, p, init=0.0, shape=None, dtype=None):
+        key = (name, id(p))
+        if key not in self._accumulators:
+            shp = tuple(shape) if shape is not None else tuple(
+                jnp.shape(p._value))
+            dt = dtype or p._value.dtype
+            t = Tensor(jnp.full(shp, init, dt), name=f"{p.name}_{name}")
+            t.persistable = True
+            # lazy creation can happen inside a to_static trace; record how to
+            # rebuild a concrete initial value (see jit.api._StateSnapshot)
+            t.__dict__["_reinit"] = lambda: jnp.full(shp, init, dt)
+            register_state_tensor(t)
+            self._accumulators[key] = t
+        return self._accumulators[key]
+
+    # ---- grads ----
+    def _params(self):
+        if self._parameter_list is None:
+            raise ValueError("optimizer constructed without parameters")
+        return [p for p in self._parameter_list if not p.stop_gradient]
+
+    def _params_grads(self):
+        pg = []
+        for p in self._params():
+            if p.grad is not None:
+                pg.append((p, p.grad))
+        return pg
+
+    def _apply_decay(self, p, g):
+        from paddle_tpu.regularizer import L1Decay, L2Decay
+        # per-parameter regularizer (ParamAttr) takes precedence and applies
+        # even when the optimizer-level weight_decay is None (paddle semantics)
+        if getattr(p, "regularizer", None) is not None:
+            reg = p.regularizer
+            if isinstance(reg, L2Decay):
+                return g + reg._coeff * p._value
+            if isinstance(reg, L1Decay):
+                return g + reg._coeff * jnp.sign(p._value)
+            return g
+        wd = self._weight_decay
+        if wd is None:
+            return g
+        if isinstance(wd, float):
+            return g + wd * p._value
+        if isinstance(wd, L2Decay):
+            return g + wd._coeff * p._value
+        if isinstance(wd, L1Decay):
+            return g + wd._coeff * jnp.sign(p._value)
+        return g
+
+    @no_grad()
+    def step(self):
+        pg = self._params_grads()
+        if self._grad_clip is not None:
+            pg = self._grad_clip(pg)
+        for p, g in pg:
+            lr_mult = getattr(p, "optimize_attr", {}).get("learning_rate", 1.0) \
+                if hasattr(p, "optimize_attr") else 1.0
+            gv = self._apply_decay(p, g._value.astype(jnp.float32)
+                                   if g._value.dtype != p._value.dtype else g._value)
+            self._update_param(p, gv, lr_mult)
+
+    def _update_param(self, p, g, lr_mult):
+        raise NotImplementedError
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, self._params_grads()
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._params():
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    # ---- state ----
+    def state_dict(self):
+        sd = {}
+        for (name, pid), t in self._accumulators.items():
+            sd[f"{t.name}"] = t
+        sd["LR_Scheduler"] = {"last_epoch": self._lr_scheduler.last_epoch,
+                              "last_lr": self._lr_scheduler.last_lr} \
+            if self._lr_scheduler is not None else {}
+        return sd
+
+    def set_state_dict(self, state_dict):
+        import numpy as np
+        for (name, pid), t in self._accumulators.items():
+            if t.name in state_dict:
+                v = state_dict[t.name]
+                v = v._value if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+                t._set_value(v.astype(t._value.dtype))
+        sched = state_dict.get("LR_Scheduler")
+        if sched and self._lr_scheduler is not None:
+            self._lr_scheduler.last_epoch = sched.get("last_epoch", 0)

@@ -1,0 +1,76 @@
+"""RMSProp / Adagrad / Adadelta. Reference: python/paddle/optimizer/*."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.optimizer.optimizer import Optimizer
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _update_param(self, p, g, lr_mult):
+        lr = self._lr_value() * lr_mult
+        g = g.astype(jnp.float32)
+        ms = self._acc("mean_square", p, dtype=jnp.float32)
+        mom = self._acc("momentum", p, dtype=jnp.float32)
+        new_ms = self._rho * ms._value + (1 - self._rho) * g * g
+        ms._set_value(new_ms)
+        if self._centered:
+            mg = self._acc("mean_grad", p, dtype=jnp.float32)
+            new_mg = self._rho * mg._value + (1 - self._rho) * g
+            mg._set_value(new_mg)
+            denom = jnp.sqrt(new_ms - new_mg * new_mg + self._epsilon)
+        else:
+            denom = jnp.sqrt(new_ms + self._epsilon)
+        new_mom = self._momentum * mom._value + lr * g / denom
+        mom._set_value(new_mom)
+        p._set_value((p._value.astype(jnp.float32) - new_mom).astype(p._value.dtype))
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _update_param(self, p, g, lr_mult):
+        lr = self._lr_value() * lr_mult
+        g = g.astype(jnp.float32)
+        acc = self._acc("moment", p, init=self._init_acc, dtype=jnp.float32)
+        new_acc = acc._value + g * g
+        acc._set_value(new_acc)
+        p._set_value((p._value.astype(jnp.float32) -
+                      lr * g / (jnp.sqrt(new_acc) + self._epsilon)).astype(
+            p._value.dtype))
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _update_param(self, p, g, lr_mult):
+        lr = self._lr_value() * lr_mult
+        g = g.astype(jnp.float32)
+        avg_sq_g = self._acc("avg_squared_grad", p, dtype=jnp.float32)
+        avg_sq_u = self._acc("avg_squared_update", p, dtype=jnp.float32)
+        new_asg = self._rho * avg_sq_g._value + (1 - self._rho) * g * g
+        avg_sq_g._set_value(new_asg)
+        update = -jnp.sqrt((avg_sq_u._value + self._epsilon) /
+                           (new_asg + self._epsilon)) * g
+        avg_sq_u._set_value(self._rho * avg_sq_u._value +
+                            (1 - self._rho) * update * update)
+        p._set_value((p._value.astype(jnp.float32) + lr * update).astype(
+            p._value.dtype))

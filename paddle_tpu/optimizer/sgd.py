@@ -1,0 +1,40 @@
+"""SGD / Momentum. Reference: python/paddle/optimizer/{sgd,momentum}.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.optimizer.optimizer import Optimizer
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _update_param(self, p, g, lr_mult):
+        lr = self._lr_value() * lr_mult
+        p._set_value((p._value.astype(jnp.float32) -
+                      lr * g.astype(jnp.float32)).astype(p._value.dtype))
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, rescale_grad=1.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+        self._rescale_grad = rescale_grad
+
+    def _update_param(self, p, g, lr_mult):
+        lr = self._lr_value() * lr_mult
+        g = g.astype(jnp.float32) * self._rescale_grad
+        vel = self._acc("velocity", p, dtype=jnp.float32)
+        new_v = self._momentum * vel._value + g
+        vel._set_value(new_v)
+        if self._use_nesterov:
+            update = g + self._momentum * new_v
+        else:
+            update = new_v
+        p._set_value((p._value.astype(jnp.float32) - lr * update).astype(
+            p._value.dtype))

@@ -1,0 +1,12 @@
+"""Weight-decay regularizers. Reference: python/paddle/regularizer.py."""
+from __future__ import annotations
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)

@@ -1,0 +1,76 @@
+"""Sparse tensors. Reference: python/paddle/sparse/ (COO/CSR).
+
+TPU-native: backed by jax.experimental.sparse BCOO (XLA-lowerable); dense
+fallbacks keep API parity where BCOO lacks an op.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dispatch import apply, unwrap
+from paddle_tpu.core.tensor import Tensor
+
+try:
+    from jax.experimental import sparse as jsparse
+    _HAS_BCOO = True
+except Exception:  # pragma: no cover
+    _HAS_BCOO = False
+
+
+class SparseCooTensor(Tensor):
+    def __init__(self, indices, values, shape, stop_gradient=True):
+        iv = unwrap(indices)
+        vv = unwrap(values)
+        self._bcoo = jsparse.BCOO((vv, jnp.swapaxes(iv, 0, 1)),
+                                  shape=tuple(int(s) for s in shape))
+        super().__init__(self._bcoo.todense(), stop_gradient=stop_gradient)
+        self._indices = Tensor(iv)
+        self._values = Tensor(vv)
+
+    def indices(self):
+        return self._indices
+
+    def values(self):
+        return self._values
+
+    def to_dense(self):
+        return Tensor(self._value)
+
+    def is_sparse_coo(self):
+        return True
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    if shape is None:
+        iv = np.asarray(unwrap(indices))
+        shape = tuple(int(m) + 1 for m in iv.max(axis=1))
+    return SparseCooTensor(indices, values, shape, stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    crows_v = np.asarray(unwrap(crows))
+    cols_v = np.asarray(unwrap(cols))
+    rows = np.repeat(np.arange(len(crows_v) - 1), np.diff(crows_v))
+    indices = np.stack([rows, cols_v])
+    return SparseCooTensor(indices, values, shape, stop_gradient)
+
+
+def matmul(x, y, name=None):
+    xv = x.to_dense() if isinstance(x, SparseCooTensor) else x
+    from paddle_tpu.tensor.math import matmul as dense_matmul
+    return dense_matmul(xv, y)
+
+
+def add(x, y, name=None):
+    from paddle_tpu.tensor.math import add as dense_add
+    xv = x.to_dense() if isinstance(x, SparseCooTensor) else x
+    yv = y.to_dense() if isinstance(y, SparseCooTensor) else y
+    return dense_add(xv, yv)
+
+
+def relu(x, name=None):
+    from paddle_tpu.nn.functional.activation import relu as dense_relu
+    return dense_relu(x.to_dense() if isinstance(x, SparseCooTensor) else x)

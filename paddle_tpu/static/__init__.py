@@ -1,0 +1,81 @@
+"""paddle_tpu.static — static-graph compatibility surface.
+
+Reference: python/paddle/static/__init__.py. In the TPU-native design there
+is no separate static interpreter: InputSpec feeds to_static/AOT shapes, and
+save/load_inference_model persist state for the inference Predictor.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.dtype import convert_dtype
+
+
+class InputSpec:
+    """Reference: python/paddle/static/input.py."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape)
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name or tensor.name)
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(list(ndarray.shape), ndarray.dtype, name)
+
+    def batch(self, batch_size):
+        self.shape = [batch_size] + self.shape
+        return self
+
+    def unbatch(self):
+        self.shape = self.shape[1:]
+        return self
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+    def example(self):
+        import jax.numpy as jnp
+        from paddle_tpu.core.tensor import Tensor
+        shape = [1 if (s is None or s == -1) else s for s in self.shape]
+        return Tensor(jnp.zeros(shape, self.dtype))
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         **kwargs):
+    from paddle_tpu.jit import save as jit_save
+    program = kwargs.get("program")
+    jit_save(program if program is not None else _DummyLayer(), path_prefix)
+
+
+class _DummyLayer:
+    pass
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    from paddle_tpu.jit import load as jit_load
+    return jit_load(path_prefix)
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    return InputSpec(shape, dtype, name)
+
+
+class Program:
+    """Placeholder for paddle.static.Program (not used in the TPU design)."""
+
+    def __init__(self):
+        pass
+
+
+def default_main_program():
+    return Program()
+
+
+def default_startup_program():
+    return Program()

@@ -1,0 +1,35 @@
+"""Tensor attribute ops. Reference: python/paddle/tensor/attribute.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply, unwrap
+from paddle_tpu.core.tensor import Tensor
+
+
+def shape(input):
+    return Tensor(jnp.asarray(unwrap(input).shape, dtype=jnp.int32))
+
+
+def rank(input):
+    return Tensor(jnp.asarray(unwrap(input).ndim, dtype=jnp.int32))
+
+
+def is_complex(x):
+    return jnp.issubdtype(unwrap(x).dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(unwrap(x).dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(unwrap(x).dtype, jnp.integer)
+
+
+def real(x, name=None):
+    return apply(jnp.real, x)
+
+
+def imag(x, name=None):
+    return apply(jnp.imag, x)

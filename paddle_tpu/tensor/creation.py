@@ -1,0 +1,202 @@
+"""Tensor creation ops. Reference parity: python/paddle/tensor/creation.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dispatch import apply, unwrap
+from paddle_tpu.core.dtype import convert_dtype, get_default_dtype
+from paddle_tpu.core.tensor import Parameter, Tensor
+from paddle_tpu.core.device import _default_place
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._value))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._value) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    if isinstance(data, Tensor):
+        v = data._value
+    else:
+        if isinstance(data, (list, tuple)):
+            data = np.asarray(data)
+        v = jnp.asarray(data)
+    if dtype is not None:
+        v = v.astype(convert_dtype(dtype))
+    elif not isinstance(data, Tensor) and v.dtype == jnp.float64:
+        v = v.astype(get_default_dtype())
+    if place is not None:
+        v = jax.device_put(v, place.jax_device)
+    return Tensor(v, stop_gradient=stop_gradient)
+
+
+def zeros(shape, dtype=None, name=None):
+    dtype = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jnp.zeros(_shape(shape), dtype))
+
+
+def ones(shape, dtype=None, name=None):
+    dtype = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jnp.ones(_shape(shape), dtype))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    dtype = convert_dtype(dtype)
+    if dtype is None:
+        dtype = (
+            np.dtype("bool") if isinstance(fill_value, bool)
+            else np.dtype("int64") if isinstance(fill_value, int)
+            else get_default_dtype()
+        )
+    return Tensor(jnp.full(_shape(shape), fill_value, dtype))
+
+
+def zeros_like(x, dtype=None, name=None):
+    return apply(lambda v: jnp.zeros_like(v, dtype=convert_dtype(dtype)), x)
+
+
+def ones_like(x, dtype=None, name=None):
+    return apply(lambda v: jnp.ones_like(v, dtype=convert_dtype(dtype)), x)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return apply(lambda v: jnp.full_like(v, fill_value, dtype=convert_dtype(dtype)), x)
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start, end, step = (v.item() if isinstance(v, Tensor) else v for v in (start, end, step))
+    if end is None:
+        start, end = 0, start
+    dtype = convert_dtype(dtype)
+    if dtype is None:
+        dtype = (
+            np.dtype("int64")
+            if all(isinstance(v, (int, np.integer)) for v in (start, end, step))
+            else get_default_dtype()
+        )
+    return Tensor(jnp.arange(start, end, step, dtype=dtype))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    start, stop, num = (v.item() if isinstance(v, Tensor) else v for v in (start, stop, num))
+    dtype = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=dtype))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    start, stop, num = (v.item() if isinstance(v, Tensor) else v for v in (start, stop, num))
+    dtype = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jnp.logspace(start, stop, int(num), base=base, dtype=dtype))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    dtype = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=dtype))
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    outs = apply(lambda *vs: tuple(jnp.meshgrid(*vs, indexing="ij")), *args)
+    return list(outs)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def fn(v):
+        if v.ndim == 1:
+            d = jnp.diag(v, k=offset)
+            if padding_value != 0:
+                mask = jnp.eye(*d.shape, k=offset, dtype=bool)
+                d = jnp.where(mask, d, jnp.asarray(padding_value, d.dtype))
+            return d
+        return jnp.diag(v, k=offset)
+    return apply(fn, x)
+
+
+def diagflat(x, offset=0, name=None):
+    return apply(lambda v: jnp.diagflat(v, k=offset), x)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def fn(v):
+        n = v.shape[-1] + abs(offset)
+        out = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        idx = jnp.arange(v.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = out.at[..., r, c].set(v)
+        if (dim1, dim2) != (-2, -1):
+            out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+        return out
+    return apply(fn, x)
+
+
+def tril(x, diagonal=0, name=None):
+    return apply(lambda v: jnp.tril(v, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    return apply(lambda v: jnp.triu(v, k=diagonal), x)
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=convert_dtype(dtype)))
+
+
+def assign(x, output=None):
+    v = unwrap(x)
+    if isinstance(v, (list, tuple, int, float, bool, np.ndarray)):
+        v = jnp.asarray(np.asarray(v))
+    if output is None:
+        return Tensor(v)
+    output._set_value(v.astype(output._value.dtype))
+    return output
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def complex(real, imag, name=None):
+    return apply(jax.lax.complex, real, imag)
+
+
+def polar(abs, angle, name=None):
+    return apply(lambda a, t: a * jnp.exp(1j * t.astype(jnp.complex64)), abs, angle)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from paddle_tpu.nn import initializer as I
+    dtype = convert_dtype(dtype) or get_default_dtype()
+    init = default_initializer or (I.Constant(0.0) if is_bias else I.XavierNormal())
+    p = Parameter(jnp.zeros(_shape(shape), dtype), name=name)
+    init(p)
+    return p
+
+
+def clone_tensor(x):
+    return x.clone()

@@ -1,0 +1,14 @@
+"""Einstein summation. Reference: python/paddle/tensor/einsum.py.
+
+On TPU, einsum lowers straight to MXU dot_generals via XLA — far better than
+the reference's plan-based CUDA implementation; we delegate to jnp.einsum.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply
+
+
+def einsum(equation, *operands):
+    return apply(lambda *vs: jnp.einsum(equation, *vs), *operands)

@@ -1,0 +1,284 @@
+"""Linear algebra ops. Reference: python/paddle/tensor/linalg.py.
+
+Matmul-family ops hit the MXU via XLA dot_general; decompositions use
+jnp.linalg (QR/SVD/eigh lower to XLA custom calls or CPU fallback).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dispatch import apply, unwrap
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.tensor.math import matmul, mm  # noqa: F401 re-export
+
+
+def dot(x, y, name=None):
+    return apply(lambda a, b: jnp.sum(a * b, axis=-1), x, y)
+
+
+def bmm(x, y, name=None):
+    return apply(jnp.matmul, x, y)
+
+
+def mv(x, vec, name=None):
+    return apply(jnp.matmul, x, vec)
+
+
+def t(input, name=None):
+    def fn(v):
+        if v.ndim < 2:
+            return v
+        return jnp.swapaxes(v, -1, -2) if v.ndim == 2 else jnp.transpose(v)
+    return apply(fn, input)
+
+
+def transpose(x, perm, name=None):
+    from paddle_tpu.tensor.manipulation import transpose as tr
+    return tr(x, perm)
+
+
+def cross(x, y, axis=9, name=None):
+    def fn(a, b):
+        ax = axis
+        if ax == 9:
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+    return apply(fn, x, y)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def fn(v):
+        if axis is None:
+            vv = v.reshape(-1)
+            if p is None or p == "fro" or p == 2:
+                out = jnp.sqrt(jnp.sum(jnp.square(vv)))
+            elif p == np.inf or p == "inf":
+                out = jnp.max(jnp.abs(vv))
+            elif p == -np.inf:
+                out = jnp.min(jnp.abs(vv))
+            elif p == 0:
+                out = jnp.sum((vv != 0).astype(v.dtype))
+            elif p == 1:
+                out = jnp.sum(jnp.abs(vv))
+            else:
+                out = jnp.sum(jnp.abs(vv) ** p) ** (1.0 / p)
+            return out.reshape((1,) * v.ndim) if keepdim else out
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+        pp = 2 if p is None or p == "fro" else p
+        if len(ax) == 1:
+            a = ax[0]
+            if pp == np.inf:
+                return jnp.max(jnp.abs(v), axis=a, keepdims=keepdim)
+            if pp == -np.inf:
+                return jnp.min(jnp.abs(v), axis=a, keepdims=keepdim)
+            if pp == 0:
+                return jnp.sum((v != 0).astype(v.dtype), axis=a, keepdims=keepdim)
+            return jnp.sum(jnp.abs(v) ** pp, axis=a, keepdims=keepdim) ** (1.0 / pp)
+        # matrix norm over two axes
+        if pp in ("fro", 2, None):
+            return jnp.sqrt(jnp.sum(jnp.square(v), axis=ax, keepdims=keepdim))
+        if pp == np.inf:
+            return jnp.max(jnp.sum(jnp.abs(v), axis=ax[1], keepdims=True), axis=ax[0],
+                           keepdims=True) if keepdim else jnp.max(
+                jnp.sum(jnp.abs(v), axis=ax[1]), axis=ax[0] if ax[0] < ax[1] else ax[0] - 1)
+        if pp == 1:
+            return jnp.max(jnp.sum(jnp.abs(v), axis=ax[0], keepdims=True), axis=ax[1],
+                           keepdims=True) if keepdim else jnp.max(
+                jnp.sum(jnp.abs(v), axis=ax[0]), axis=ax[1] - 1 if ax[0] < ax[1] else ax[1])
+        return jnp.sum(jnp.abs(v) ** pp, axis=ax, keepdims=keepdim) ** (1.0 / pp)
+    return apply(fn, x)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return norm(x, p=p, axis=list(axis), keepdim=keepdim)
+
+
+def dist(x, y, p=2, name=None):
+    def fn(a, b):
+        d = (a - b).reshape(-1)
+        if p == 0:
+            return jnp.sum((d != 0).astype(a.dtype))
+        if p == np.inf:
+            return jnp.max(jnp.abs(d))
+        if p == -np.inf:
+            return jnp.min(jnp.abs(d))
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+    return apply(fn, x, y)
+
+
+def cholesky(x, upper=False, name=None):
+    def fn(v):
+        L = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+    return apply(fn, x)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def fn(b, L):
+        return jax.scipy.linalg.cho_solve((L, not upper), b)
+    return apply(fn, x, y)
+
+
+def det(x, name=None):
+    return apply(jnp.linalg.det, x)
+
+
+def slogdet(x, name=None):
+    def fn(v):
+        sign, logdet = jnp.linalg.slogdet(v)
+        return jnp.stack([sign, logdet])
+    return apply(fn, x)
+
+
+def svd(x, full_matrices=False, name=None):
+    def fn(v):
+        u, s, vh = jnp.linalg.svd(v, full_matrices=full_matrices)
+        return u, s, jnp.swapaxes(vh, -1, -2).conj()
+    return apply(fn, x)
+
+
+def svdvals(x, name=None):
+    return apply(lambda v: jnp.linalg.svd(v, compute_uv=False), x)
+
+
+def qr(x, mode="reduced", name=None):
+    def fn(v):
+        return tuple(jnp.linalg.qr(v, mode=mode)) if mode != "r" else (jnp.linalg.qr(v, mode="r"),)
+    out = apply(fn, x)
+    return out if isinstance(out, tuple) and len(out) > 1 else out[0]
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def fn(v):
+        lu_mat, piv = jax.scipy.linalg.lu_factor(v)
+        return lu_mat, (piv + 1).astype(jnp.int32)
+    lu_mat, piv = apply(fn, x)
+    if get_infos:
+        info = Tensor(jnp.zeros(unwrap(x).shape[:-2] or (1,), jnp.int32))
+        return lu_mat, piv, info
+    return lu_mat, piv
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True, name=None):
+    def fn(lu_mat, piv):
+        m, n = lu_mat.shape[-2:]
+        k = min(m, n)
+        L = jnp.tril(lu_mat[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_mat.dtype)
+        U = jnp.triu(lu_mat[..., :k, :])
+        p = jnp.arange(m)
+        def body(i, p):
+            j = piv[i] - 1
+            pi, pj = p[i], p[j]
+            return p.at[i].set(pj).at[j].set(pi)
+        p = jax.lax.fori_loop(0, piv.shape[-1], body, p)
+        P = jnp.eye(m, dtype=lu_mat.dtype)[p].T
+        return P, L, U
+    return apply(fn, lu_data, lu_pivots)
+
+
+def eig(x, name=None):
+    v = np.asarray(unwrap(x))
+    w, vec = np.linalg.eig(v)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(vec))
+
+
+def eigh(x, UPLO="L", name=None):
+    def fn(v):
+        return tuple(jnp.linalg.eigh(v, symmetrize_input=True))
+    return apply(fn, x)
+
+
+def eigvals(x, name=None):
+    v = np.asarray(unwrap(x))
+    return Tensor(jnp.asarray(np.linalg.eigvals(v)))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply(lambda v: jnp.linalg.eigvalsh(v), x)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply(lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian), x)
+
+
+def inv(x, name=None):
+    return apply(jnp.linalg.inv, x)
+
+
+def solve(x, y, name=None):
+    def fn(a, b):
+        if b.ndim == a.ndim - 1:
+            return jnp.linalg.solve(a, b[..., None])[..., 0]
+        return jnp.linalg.solve(a, b)
+    return apply(fn, x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def fn(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return apply(fn, x, y)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def fn(a, b):
+        sol, res, rank_, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank_.astype(jnp.int32), sv
+    return apply(fn, x, y)
+
+
+def matrix_power(x, n, name=None):
+    return apply(lambda v: jnp.linalg.matrix_power(v, n), x)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply(lambda v: jnp.linalg.matrix_rank(v, rtol=tol).astype(jnp.int64), x)
+
+
+def multi_dot(x, name=None):
+    return apply(lambda *vs: jnp.linalg.multi_dot(vs), *x)
+
+
+def matrix_exp(x, name=None):
+    return apply(jax.scipy.linalg.expm, x)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    def fn(v, fw, aw):
+        return jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0, fweights=fw, aweights=aw)
+    return apply(fn, x, fweights, aweights)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply(lambda v: jnp.corrcoef(v, rowvar=rowvar), x)
+
+
+def householder_product(x, tau, name=None):
+    def fn(a, t):
+        m, n = a.shape[-2:]
+        def make_h(carry, i):
+            q = carry
+            v = jnp.where(jnp.arange(m) < i, 0.0, a[..., :, i].at[..., i].set(1.0))
+            v = a[..., :, i] * (jnp.arange(m) > i) + (jnp.arange(m) == i)
+            h = jnp.eye(m, dtype=a.dtype) - t[..., i] * jnp.outer(v, v)
+            return q @ h, None
+        q0 = jnp.eye(m, dtype=a.dtype)
+        q, _ = jax.lax.scan(make_h, q0, jnp.arange(t.shape[-1]))
+        return q[..., :, :n]
+    return apply(fn, x, tau)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    def fn(v):
+        qq = q or min(6, *v.shape[-2:])
+        vv = v - jnp.mean(v, axis=-2, keepdims=True) if center else v
+        u, s, vh = jnp.linalg.svd(vv, full_matrices=False)
+        return u[..., :qq], s[..., :qq], jnp.swapaxes(vh, -1, -2)[..., :qq]
+    return apply(fn, x)

@@ -1,0 +1,436 @@
+"""Shape/layout manipulation ops. Reference: python/paddle/tensor/manipulation.py."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dispatch import apply, unwrap
+from paddle_tpu.core.tensor import Tensor
+
+
+def _ints(seq):
+    if isinstance(seq, Tensor):
+        return tuple(int(v) for v in np.asarray(seq._value).reshape(-1))
+    if isinstance(seq, (int, np.integer)):
+        return (int(seq),)
+    return tuple(int(s._value) if isinstance(s, Tensor) else int(s) for s in seq)
+
+
+def reshape(x, shape, name=None):
+    shape = _ints(shape)
+    return apply(lambda v: jnp.reshape(v, shape), x)
+
+
+def reshape_(x, shape, name=None):
+    return x._inplace_assign(reshape(x, shape))
+
+
+def transpose(x, perm, name=None):
+    perm = _ints(perm)
+    return apply(lambda v: jnp.transpose(v, perm), x)
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply(lambda v: jnp.moveaxis(v, _ints(source), _ints(destination)), x)
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply(lambda v: jnp.swapaxes(v, axis0, axis1), x)
+
+
+transpose_last_2 = None
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis._value)
+    return apply(lambda *vs: jnp.concatenate(vs, axis=axis), *x)
+
+
+def stack(x, axis=0, name=None):
+    return apply(lambda *vs: jnp.stack(vs, axis=axis), *x)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    n = num or x.shape[axis]
+    out = apply(lambda v: tuple(jnp.moveaxis(v, axis, 0)[i] for i in range(n)), x)
+    return list(out)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis._value)
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"split: dimension {dim} along axis {axis} is not divisible "
+                f"by num {num_or_sections}")
+        sections = [dim // num_or_sections] * num_or_sections
+    else:
+        sections = list(_ints(num_or_sections))
+        if -1 in sections:
+            rest = dim - sum(s for s in sections if s != -1)
+            sections = [rest if s == -1 else s for s in sections]
+    offsets = np.cumsum([0] + sections)
+
+    def fn(v):
+        return tuple(
+            jax.lax.slice_in_dim(v, int(offsets[i]), int(offsets[i + 1]), axis=axis)
+            for i in range(len(sections))
+        )
+    return list(apply(fn, x))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    if isinstance(num_or_indices, int):
+        out = apply(lambda v: tuple(jnp.array_split(v, num_or_indices, axis=axis)), x)
+    else:
+        out = apply(lambda v: tuple(jnp.split(v, list(_ints(num_or_indices)), axis=axis)), x)
+    return list(out)
+
+
+def squeeze(x, axis=None, name=None):
+    def fn(v):
+        if axis is None:
+            return jnp.squeeze(v)
+        axes = _ints(axis) if not isinstance(axis, int) else (axis,)
+        axes = tuple(a % v.ndim for a in axes)
+        axes = tuple(a for a in axes if v.shape[a] == 1)
+        return jnp.squeeze(v, axis=axes) if axes else v
+    return apply(fn, x)
+
+
+def squeeze_(x, axis=None, name=None):
+    return x._inplace_assign(squeeze(x, axis))
+
+
+def unsqueeze(x, axis, name=None):
+    axes = _ints(axis) if not isinstance(axis, int) else (axis,)
+    return apply(lambda v: jnp.expand_dims(v, axes), x)
+
+
+def unsqueeze_(x, axis, name=None):
+    return x._inplace_assign(unsqueeze(x, axis))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def fn(v):
+        nd = v.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = v.shape[:s] + (-1,) + v.shape[e + 1:]
+        return jnp.reshape(v, new_shape)
+    return apply(fn, x)
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    return x._inplace_assign(flatten(x, start_axis, stop_axis))
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis._value)
+    return apply(lambda v, i: jnp.take(v, i.reshape(-1) if i.ndim > 1 else i, axis=axis), x, index)
+
+
+def gather_nd(x, index, name=None):
+    def fn(v, idx):
+        return v[tuple(jnp.moveaxis(idx, -1, 0))]
+    return apply(fn, x, index)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def fn(v, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return v.at[i].set(u)
+        z = v.at[i].set(jnp.zeros_like(u) if u.ndim else 0)
+        return z.at[i].add(u)
+    return apply(fn, x, index, updates)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return x._inplace_assign(scatter(x, index, updates, overwrite))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    shape = _ints(shape)
+    def fn(i, u):
+        z = jnp.zeros(shape, u.dtype)
+        return z.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+    return apply(fn, index, updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def fn(v, i, u):
+        return v.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+    return apply(fn, x, index, updates)
+
+
+def slice(input, axes, starts, ends, name=None):
+    axes = _ints(axes)
+    starts = _ints(starts)
+    ends = _ints(ends)
+    def fn(v):
+        idx = [builtins_slice(None)] * v.ndim
+        for a, s, e in zip(axes, starts, ends):
+            idx[a] = builtins_slice(s, min(e, v.shape[a]) if e > 0 else e)
+        return v[tuple(idx)]
+    return apply(fn, input)
+
+
+builtins_slice = __import__("builtins").slice
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    axes = _ints(axes)
+    starts = _ints(starts)
+    ends = _ints(ends)
+    strides = _ints(strides)
+    def fn(v):
+        idx = [builtins_slice(None)] * v.ndim
+        for a, s, e, st in zip(axes, starts, ends, strides):
+            idx[a] = builtins_slice(s, e, st)
+        return v[tuple(idx)]
+    return apply(fn, x)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shape = _ints(shape)
+    offsets = _ints(offsets) if offsets is not None else (0,) * len(shape)
+    def fn(v):
+        idx = tuple(
+            builtins_slice(o, o + (s if s != -1 else v.shape[i] - o))
+            for i, (o, s) in enumerate(zip(offsets, shape))
+        )
+        return v[idx]
+    return apply(fn, x)
+
+
+def tile(x, repeat_times, name=None):
+    return apply(lambda v: jnp.tile(v, _ints(repeat_times)), x)
+
+
+def expand(x, shape, name=None):
+    shape = _ints(shape)
+    def fn(v):
+        tgt = tuple(v.shape[i - (len(shape) - v.ndim)] if s == -1 else s
+                    for i, s in enumerate(shape))
+        return jnp.broadcast_to(v, tgt)
+    return apply(fn, x)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def expand_as(x, y, name=None):
+    return apply(lambda v, w: jnp.broadcast_to(v, w.shape), x, y)
+
+
+def broadcast_tensors(inputs, name=None):
+    out = apply(lambda *vs: tuple(jnp.broadcast_arrays(*vs)), *inputs)
+    return list(out)
+
+
+def flip(x, axis, name=None):
+    axes = _ints(axis) if not isinstance(axis, int) else (axis,)
+    return apply(lambda v: jnp.flip(v, axis=axes), x)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply(lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    def fn(v):
+        sh = _ints(shifts) if not isinstance(shifts, int) else shifts
+        ax = None if axis is None else (_ints(axis) if not isinstance(axis, int) else axis)
+        return jnp.roll(v, sh, axis=ax)
+    return apply(fn, x)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    v = np.asarray(unwrap(x))
+    res = np.unique(v, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    v = np.asarray(unwrap(x))
+    if axis is None:
+        v = v.reshape(-1)
+        ax = 0
+    else:
+        ax = axis
+    if v.shape[ax] == 0:
+        outs = [Tensor(jnp.asarray(v))]
+    else:
+        sl = [np.s_[:]] * v.ndim
+        sl[ax] = np.s_[1:]
+        sl_prev = [np.s_[:]] * v.ndim
+        sl_prev[ax] = np.s_[:-1]
+        other = tuple(i for i in range(v.ndim) if i != ax)
+        change = np.any(v[tuple(sl)] != v[tuple(sl_prev)], axis=other) if other else (v[tuple(sl)] != v[tuple(sl_prev)])
+        keep = np.concatenate([[True], change])
+        outs = [Tensor(jnp.asarray(np.compress(keep, v, axis=ax)))]
+        if return_inverse:
+            outs.append(Tensor(jnp.asarray(np.cumsum(keep) - 1)))
+        if return_counts:
+            idx = np.flatnonzero(keep)
+            counts = np.diff(np.append(idx, v.shape[ax]))
+            outs.append(Tensor(jnp.asarray(counts)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def masked_select(x, mask, name=None):
+    return apply(lambda v, m: v[m], x, mask)
+
+
+def masked_fill(x, mask, value, name=None):
+    return apply(lambda v, m, val: jnp.where(m, jnp.asarray(val, v.dtype), v), x, mask, value)
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply(lambda v, i: jnp.take(v, i.reshape(-1), axis=axis), x, index)
+
+
+def index_sample(x, index, name=None):
+    return apply(lambda v, i: jnp.take_along_axis(v, i, axis=1), x, index)
+
+
+def index_add(x, index, axis, value, name=None):
+    def fn(v, i, val):
+        vm = jnp.moveaxis(v, axis, 0)
+        vm = vm.at[i.reshape(-1)].add(jnp.moveaxis(val, axis, 0))
+        return jnp.moveaxis(vm, 0, axis)
+    return apply(fn, x, index, value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def fn(v, val, *idx):
+        if accumulate:
+            return v.at[idx].add(val)
+        return v.at[idx].set(val)
+    return apply(fn, x, value, *indices)
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    def fn(v, i):
+        i = jnp.broadcast_to(i, i.shape) if i.shape == v.shape else i
+        return jnp.take_along_axis(v, i, axis=axis)
+    return apply(fn, arr, indices)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    def fn(v, i, val):
+        val = jnp.broadcast_to(jnp.asarray(val, v.dtype), i.shape)
+        dims = [jnp.arange(s).reshape([-1 if k == d else 1 for k in range(i.ndim)])
+                for d, s in enumerate(i.shape)]
+        idx = tuple(i if d == axis else jnp.broadcast_to(dims[d], i.shape)
+                    for d in range(i.ndim))
+        if reduce == "add":
+            return v.at[idx].add(val)
+        if reduce in ("mul", "multiply"):
+            return v.at[idx].multiply(val)
+        return v.at[idx].set(val)
+    return apply(fn, arr, indices, values)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    def fn(v, r):
+        if axis is None:
+            v = v.reshape(-1)
+            ax = 0
+        else:
+            ax = axis
+        if isinstance(r, (int, np.integer)):
+            return jnp.repeat(v, int(r), axis=ax)
+        total = int(np.asarray(unwrap(repeats)).sum())
+        return jnp.repeat(v, r, axis=ax, total_repeat_length=total)
+    return apply(fn, x, repeats)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def fn(v):
+        shard_size = (index_num + nshards - 1) // nshards
+        lo = shard_id * shard_size
+        in_shard = (v >= lo) & (v < lo + shard_size)
+        return jnp.where(in_shard, v - lo, ignore_value)
+    return apply(fn, input)
+
+
+def as_complex(x, name=None):
+    return apply(lambda v: jax.lax.complex(v[..., 0], v[..., 1]), x)
+
+
+def as_real(x, name=None):
+    return apply(lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), x)
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return x.astype(shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply(jnp.atleast_1d, x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply(jnp.atleast_2d, x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply(jnp.atleast_3d, x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def hstack(x, name=None):
+    return apply(lambda *vs: jnp.hstack(vs), *x)
+
+
+def vstack(x, name=None):
+    return apply(lambda *vs: jnp.vstack(vs), *x)
+
+
+def dstack(x, name=None):
+    return apply(lambda *vs: jnp.dstack(vs), *x)
+
+
+def row_stack(x, name=None):
+    return vstack(x)
+
+
+def column_stack(x, name=None):
+    return apply(lambda *vs: jnp.column_stack(vs), *x)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def hsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=1 if x.ndim > 1 else 0)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)

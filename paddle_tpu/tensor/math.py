@@ -1,0 +1,465 @@
+"""Math ops. Reference parity: python/paddle/tensor/math.py (~93 public fns).
+
+All ops are thin pure-JAX functions routed through ``apply`` so they are
+eager-differentiable and jit-traceable unchanged.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dispatch import apply, unwrap, wrap
+from paddle_tpu.core.dtype import convert_dtype, get_default_dtype
+from paddle_tpu.core.tensor import Tensor
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        a = np.asarray(axis._value)
+        return tuple(int(v) for v in a.reshape(-1))
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+# ---- binary elementwise ----
+def add(x, y, name=None):
+    return apply(jnp.add, x, y)
+
+
+def subtract(x, y, name=None):
+    return apply(jnp.subtract, x, y)
+
+
+def multiply(x, y, name=None):
+    return apply(jnp.multiply, x, y)
+
+
+def divide(x, y, name=None):
+    return apply(jnp.true_divide, x, y)
+
+
+def floor_divide(x, y, name=None):
+    return apply(jnp.floor_divide, x, y)
+
+
+def remainder(x, y, name=None):
+    return apply(jnp.remainder, x, y)
+
+
+mod = remainder
+floor_mod = remainder
+
+
+def pow(x, y, name=None):
+    return apply(jnp.power, x, y)
+
+
+def maximum(x, y, name=None):
+    return apply(jnp.maximum, x, y)
+
+
+def minimum(x, y, name=None):
+    return apply(jnp.minimum, x, y)
+
+
+def fmax(x, y, name=None):
+    return apply(jnp.fmax, x, y)
+
+
+def fmin(x, y, name=None):
+    return apply(jnp.fmin, x, y)
+
+
+def logaddexp(x, y, name=None):
+    return apply(jnp.logaddexp, x, y)
+
+
+def atan2(x, y, name=None):
+    return apply(jnp.arctan2, x, y)
+
+
+def heaviside(x, y, name=None):
+    return apply(jnp.heaviside, x, y)
+
+
+def gcd(x, y, name=None):
+    return apply(jnp.gcd, x, y)
+
+
+def lcm(x, y, name=None):
+    return apply(jnp.lcm, x, y)
+
+
+def inner(x, y, name=None):
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=(-1, -1)) if a.ndim and b.ndim else a * b, x, y)
+
+
+def outer(x, y, name=None):
+    return apply(lambda a, b: jnp.outer(a, b), x, y)
+
+
+def kron(x, y, name=None):
+    return apply(jnp.kron, x, y)
+
+
+def lerp(x, y, weight, name=None):
+    return apply(lambda a, b, w: a + w * (b - a), x, y, weight)
+
+
+def nextafter(x, y, name=None):
+    return apply(jnp.nextafter, x, y)
+
+
+def copysign(x, y, name=None):
+    return apply(jnp.copysign, x, y)
+
+
+def hypot(x, y, name=None):
+    return apply(lambda a, b: jnp.sqrt(a * a + b * b), x, y)
+
+
+# ---- unary elementwise ----
+def _unary(jfn):
+    def op(x, name=None):
+        return apply(jfn, x)
+    op.__name__ = jfn.__name__
+    return op
+
+
+exp = _unary(jnp.exp)
+expm1 = _unary(jnp.expm1)
+sqrt = _unary(jnp.sqrt)
+rsqrt = _unary(jax.lax.rsqrt)
+abs = _unary(jnp.abs)
+ceil = _unary(jnp.ceil)
+floor = _unary(jnp.floor)
+round = _unary(jnp.round)
+trunc = _unary(jnp.trunc)
+sign = _unary(jnp.sign)
+sin = _unary(jnp.sin)
+cos = _unary(jnp.cos)
+tan = _unary(jnp.tan)
+asin = _unary(jnp.arcsin)
+acos = _unary(jnp.arccos)
+atan = _unary(jnp.arctan)
+sinh = _unary(jnp.sinh)
+cosh = _unary(jnp.cosh)
+tanh = _unary(jnp.tanh)
+asinh = _unary(jnp.arcsinh)
+acosh = _unary(jnp.arccosh)
+atanh = _unary(jnp.arctanh)
+square = _unary(jnp.square)
+reciprocal = _unary(lambda v: 1.0 / v)
+erf = _unary(jax.scipy.special.erf)
+erfinv = _unary(jax.scipy.special.erfinv)
+digamma = _unary(jax.scipy.special.digamma)
+lgamma = _unary(jax.scipy.special.gammaln)
+i0 = _unary(jnp.i0)
+rad2deg = _unary(jnp.rad2deg)
+deg2rad = _unary(jnp.deg2rad)
+angle = _unary(jnp.angle)
+conj = _unary(jnp.conj)
+frac = _unary(lambda v: v - jnp.trunc(v))
+sgn = _unary(jnp.sign)
+neg = _unary(jnp.negative)
+
+
+def log(x, name=None):
+    return apply(jnp.log, x)
+
+
+def log2(x, name=None):
+    return apply(jnp.log2, x)
+
+
+def log10(x, name=None):
+    return apply(jnp.log10, x)
+
+
+def log1p(x, name=None):
+    return apply(jnp.log1p, x)
+
+
+def logit(x, eps=None, name=None):
+    def fn(v):
+        if eps is not None:
+            v = jnp.clip(v, eps, 1.0 - eps)
+        return jnp.log(v / (1.0 - v))
+    return apply(fn, x)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply(lambda v: scale_b * jnp.tanh(scale_a * v), x)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def fn(v, s):
+        return v * s + bias if bias_after_scale else (v + bias) * s
+    return apply(fn, x, scale)
+
+
+def clip(x, min=None, max=None, name=None):
+    return apply(lambda v, lo, hi: jnp.clip(v, lo, hi), x, min, max)
+
+
+def increment(x, value=1.0, name=None):
+    x._set_value(x._value + value)
+    return x
+
+
+# ---- reductions ----
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    dt = convert_dtype(dtype)
+    return apply(lambda v: jnp.sum(v, axis=_axis(axis), dtype=dt, keepdims=keepdim), x)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    dt = convert_dtype(dtype)
+    return apply(lambda v: jnp.nansum(v, axis=_axis(axis), dtype=dt, keepdims=keepdim), x)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.mean(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.nanmean(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    dt = convert_dtype(dtype)
+    return apply(lambda v: jnp.prod(v, axis=_axis(axis), dtype=dt, keepdims=keepdim), x)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.max(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.min(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+amax = max
+amin = min
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jax.scipy.special.logsumexp(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.count_nonzero(v, axis=_axis(axis), keepdims=keepdim).astype(jnp.int64), x)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.all(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.any(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+# ---- scans ----
+def cumsum(x, axis=None, dtype=None, name=None):
+    dt = convert_dtype(dtype)
+    def fn(v):
+        if axis is None:
+            return jnp.cumsum(v.reshape(-1), dtype=dt)
+        return jnp.cumsum(v, axis=int(axis), dtype=dt)
+    return apply(fn, x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    dt = convert_dtype(dtype)
+    def fn(v):
+        if dim is None:
+            return jnp.cumprod(v.reshape(-1), dtype=dt)
+        return jnp.cumprod(v, axis=int(dim), dtype=dt)
+    return apply(fn, x)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def fn(v):
+        a = 0 if axis is None else int(axis)
+        vv = v.reshape(-1) if axis is None else v
+        vals = jax.lax.associative_scan(jnp.maximum, vv, axis=a)
+        n = vv.shape[a]
+        ar = jnp.arange(n).reshape([-1 if i == a else 1 for i in range(vv.ndim)])
+        first = jnp.where(vv == vals, ar, -1)
+        inds = jax.lax.associative_scan(jnp.maximum, first, axis=a)
+        return vals, inds.astype(convert_dtype(dtype))
+    return apply(fn, x)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def fn(v):
+        a = 0 if axis is None else int(axis)
+        vv = v.reshape(-1) if axis is None else v
+        vals = jax.lax.associative_scan(jnp.minimum, vv, axis=a)
+        n = vv.shape[a]
+        ar = jnp.arange(n).reshape([-1 if i == a else 1 for i in range(vv.ndim)])
+        first = jnp.where(vv == vals, ar, -1)
+        inds = jax.lax.associative_scan(jnp.maximum, first, axis=a)
+        return vals, inds.astype(convert_dtype(dtype))
+    return apply(fn, x)
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def fn(v):
+        a = 0 if axis is None else int(axis)
+        vv = v.reshape(-1) if axis is None else v
+        return jax.lax.associative_scan(jnp.logaddexp, vv, axis=a)
+    return apply(fn, x)
+
+
+# ---- composite ----
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    return apply(lambda *vs: jnp.sum(jnp.stack(vs), axis=0) if len(vs) > 1 else vs[0], *inputs)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply(lambda i, a, b: beta * i + alpha * (a @ b), input, x, y)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return apply(fn, x, y)
+
+
+def mm(input, mat2, name=None):
+    return apply(jnp.matmul, input, mat2)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    def fn(v, pre, app):
+        return jnp.diff(v, n=n, axis=axis, prepend=pre, append=app)
+    return apply(fn, x, prepend, append)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def fn(yv, xv):
+        if xv is None:
+            return jax.scipy.integrate.trapezoid(yv, dx=(1.0 if dx is None else dx), axis=axis)
+        return jax.scipy.integrate.trapezoid(yv, x=xv, axis=axis)
+    return apply(fn, y, x)
+
+
+cumulative_trapezoid = None  # set below
+
+
+def _cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def fn(yv, xv):
+        d = 1.0 if dx is None else dx
+        sl1 = [slice(None)] * yv.ndim
+        sl2 = [slice(None)] * yv.ndim
+        sl1[axis] = slice(1, None)
+        sl2[axis] = slice(None, -1)
+        if xv is not None:
+            d = jnp.diff(xv, axis=axis) if xv.ndim > 1 else jnp.diff(xv)
+            if xv.ndim == 1:
+                shape = [1] * yv.ndim
+                shape[axis] = -1
+                d = d.reshape(shape)
+        avg = (yv[tuple(sl1)] + yv[tuple(sl2)]) / 2.0
+        return jnp.cumsum(avg * d, axis=axis)
+    return apply(fn, y, x)
+
+
+cumulative_trapezoid = _cumulative_trapezoid
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def isfinite(x, name=None):
+    return apply(jnp.isfinite, x)
+
+
+def isinf(x, name=None):
+    return apply(jnp.isinf, x)
+
+
+def isnan(x, name=None):
+    return apply(jnp.isnan, x)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply(lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf, neginf=neginf), x)
+
+
+def take(x, index, mode="raise", name=None):
+    def fn(v, i):
+        i = i.reshape(-1)
+        flat = v.reshape(-1)
+        if mode == "wrap":
+            i = i % flat.shape[0]
+        elif mode == "clip":
+            i = jnp.clip(i, 0, flat.shape[0] - 1)
+        else:
+            i = jnp.where(i < 0, i + flat.shape[0], i)
+        out = flat[i]
+        iv = index._value if isinstance(index, Tensor) else jnp.asarray(index)
+        return out.reshape(iv.shape)
+    return apply(fn, x, index)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda v: jnp.diagonal(v, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def multiplex(inputs, index, name=None):
+    def fn(idx, *vs):
+        stacked = jnp.stack(vs)  # [n, batch, ...]
+        return jnp.take_along_axis(
+            stacked, idx.reshape(1, -1, *([1] * (stacked.ndim - 2))), axis=0
+        )[0]
+    return apply(fn, index, *inputs)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    def fn(v):
+        dims = tuple(i for i in range(v.ndim) if i != axis)
+        norms = jnp.sum(jnp.abs(v) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return v * factor
+    return apply(fn, x)
+
+
+# in-place variants (paddle `op_` convention): rebind value on the same Tensor
+def _make_inplace(op):
+    def inplace(x, *a, **kw):
+        out = op(x, *a, **kw)
+        return x._inplace_assign(out)
+    inplace.__name__ = op.__name__ + "_"
+    return inplace
+
+
+add_ = _make_inplace(add)
+subtract_ = _make_inplace(subtract)
+multiply_ = _make_inplace(multiply)
+clip_ = _make_inplace(clip)
+scale_ = _make_inplace(scale)
+ceil_ = _make_inplace(ceil)
+floor_ = _make_inplace(floor)
+exp_ = _make_inplace(exp)
+sqrt_ = _make_inplace(sqrt)
+rsqrt_ = _make_inplace(rsqrt)
+reciprocal_ = _make_inplace(reciprocal)
+round_ = _make_inplace(round)
+tanh_ = _make_inplace(tanh)

@@ -1,0 +1,144 @@
+"""Random ops. Reference: python/paddle/tensor/random.py.
+
+paddle's global-seed RNG maps to a splitting JAX PRNG key held in
+framework.state; each call consumes a fresh subkey, so eager semantics match
+the reference while staying functional underneath.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dispatch import apply, unwrap
+from paddle_tpu.core.dtype import convert_dtype, get_default_dtype
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.framework.state import next_key
+from paddle_tpu.tensor.creation import _shape
+
+
+def seed(s):
+    from paddle_tpu.framework import state
+    state.seed(s)
+
+
+def rand(shape, dtype=None, name=None):
+    dtype = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jax.random.uniform(next_key(), _shape(shape), dtype))
+
+
+def randn(shape, dtype=None, name=None):
+    dtype = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jax.random.normal(next_key(), _shape(shape), dtype))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        def fn(m, s):
+            shp = jnp.broadcast_shapes(
+                jnp.shape(m), jnp.shape(s)) if shape is None else _shape(shape)
+            return m + s * jax.random.normal(next_key(), shp, get_default_dtype())
+        return apply(fn, mean, std)
+    shp = _shape(shape) if shape is not None else ()
+    return Tensor(mean + std * jax.random.normal(next_key(), shp, get_default_dtype()))
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._set_value(mean + std * jax.random.normal(next_key(), tuple(unwrap(x).shape),
+                                                unwrap(x).dtype))
+    return x
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    dtype = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(jax.random.uniform(next_key(), _shape(shape), dtype, min, max))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    v = unwrap(x)
+    x._set_value(jax.random.uniform(next_key(), tuple(v.shape), v.dtype, min, max))
+    return x
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    dtype = convert_dtype(dtype)
+    return Tensor(jax.random.randint(next_key(), _shape(shape), low, high, dtype))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    v = unwrap(x)
+    if high is None:
+        low, high = 0, low
+    dtype = convert_dtype(dtype) or v.dtype
+    return Tensor(jax.random.randint(next_key(), tuple(v.shape), low, high, dtype))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(next_key(), n).astype(convert_dtype(dtype)))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    def fn(v):
+        logits = jnp.log(jnp.maximum(v, 1e-30))
+        if replacement:
+            return jax.random.categorical(
+                next_key(), logits, axis=-1, shape=v.shape[:-1] + (num_samples,)
+            ).astype(jnp.int64)
+        # without replacement: Gumbel top-k trick
+        g = jax.random.gumbel(next_key(), v.shape)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx.astype(jnp.int64)
+    return apply(fn, x)
+
+
+def bernoulli(x, name=None):
+    def fn(v):
+        return (jax.random.uniform(next_key(), v.shape) < v).astype(v.dtype)
+    return apply(fn, x)
+
+
+def bernoulli_(x, p=0.5, name=None):
+    v = unwrap(x)
+    x._set_value((jax.random.uniform(next_key(), tuple(v.shape)) < p).astype(v.dtype))
+    return x
+
+
+def poisson(x, name=None):
+    def fn(v):
+        return jax.random.poisson(next_key(), v).astype(v.dtype)
+    return apply(fn, x)
+
+
+def binomial(count, prob, name=None):
+    def fn(n, p):
+        return jax.random.binomial(next_key(), n.astype(jnp.float32), p).astype(jnp.int64)
+    return apply(fn, count, prob)
+
+
+def exponential_(x, lam=1.0, name=None):
+    v = unwrap(x)
+    x._set_value(jax.random.exponential(next_key(), tuple(v.shape), v.dtype) / lam)
+    return x
+
+
+def rand_like(x, dtype=None, name=None):
+    v = unwrap(x)
+    dtype = convert_dtype(dtype) or v.dtype
+    return Tensor(jax.random.uniform(next_key(), tuple(v.shape), dtype))
+
+
+def randn_like(x, dtype=None, name=None):
+    v = unwrap(x)
+    dtype = convert_dtype(dtype) or v.dtype
+    return Tensor(jax.random.normal(next_key(), tuple(v.shape), dtype))
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    dtype = convert_dtype(dtype) or get_default_dtype()
+    return Tensor(mean + std * jax.random.normal(next_key(), _shape(shape), dtype))

@@ -1,0 +1,146 @@
+"""Search / sort ops. Reference: python/paddle/tensor/search.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dispatch import apply, unwrap
+from paddle_tpu.core.dtype import convert_dtype
+from paddle_tpu.core.tensor import Tensor
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    dt = convert_dtype(dtype)
+    def fn(v):
+        if axis is None:
+            out = jnp.argmax(v.reshape(-1))
+            return out.reshape((1,) * v.ndim).astype(dt) if keepdim else out.astype(dt)
+        return jnp.argmax(v, axis=axis, keepdims=keepdim).astype(dt)
+    return apply(fn, x)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    dt = convert_dtype(dtype)
+    def fn(v):
+        if axis is None:
+            out = jnp.argmin(v.reshape(-1))
+            return out.reshape((1,) * v.ndim).astype(dt) if keepdim else out.astype(dt)
+        return jnp.argmin(v, axis=axis, keepdims=keepdim).astype(dt)
+    return apply(fn, x)
+
+
+def argsort(x, axis=-1, descending=False, stable=True, name=None):
+    def fn(v):
+        idx = jnp.argsort(v, axis=axis, stable=stable, descending=descending)
+        return idx.astype(jnp.int64)
+    return apply(fn, x)
+
+
+def sort(x, axis=-1, descending=False, stable=True, name=None):
+    def fn(v):
+        out = jnp.sort(v, axis=axis, stable=stable, descending=descending)
+        return out
+    return apply(fn, x)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    import jax.lax
+    if isinstance(k, Tensor):
+        k = int(k._value)
+    def fn(v):
+        ax = v.ndim - 1 if axis is None else axis % v.ndim
+        vm = jnp.moveaxis(v, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(vm, k)
+        else:
+            vals, idx = jax.lax.top_k(-vm, k)
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int64), -1, ax)
+    return apply(fn, x)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=False)
+    return apply(lambda c, a, b: jnp.where(c, a, b), condition, x, y)
+
+
+def where_(condition, x, y, name=None):
+    out = where(condition, x, y)
+    return x._inplace_assign(out)
+
+
+def nonzero(x, as_tuple=False):
+    v = np.asarray(unwrap(x))
+    nz = np.nonzero(v)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i.astype(np.int64))) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def masked_select(x, mask, name=None):
+    from paddle_tpu.tensor.manipulation import masked_select as ms
+    return ms(x, mask)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def fn(v):
+        ax = axis % v.ndim
+        sv = jnp.sort(v, axis=ax)
+        si = jnp.argsort(v, axis=ax)
+        vals = jnp.take(sv, k - 1, axis=ax)
+        idx = jnp.take(si, k - 1, axis=ax).astype(jnp.int64)
+        if keepdim:
+            vals = jnp.expand_dims(vals, ax)
+            idx = jnp.expand_dims(idx, ax)
+        return vals, idx
+    return apply(fn, x)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    v = np.asarray(unwrap(x))
+    ax = axis % v.ndim
+    vm = np.moveaxis(v, ax, -1)
+    flat = vm.reshape(-1, vm.shape[-1])
+    vals = np.empty(flat.shape[0], dtype=v.dtype)
+    idxs = np.empty(flat.shape[0], dtype=np.int64)
+    for i, row in enumerate(flat):
+        uniq, counts = np.unique(row, return_counts=True)
+        # paddle returns the largest value among ties; np.unique sorts ascending
+        best = uniq[counts == counts.max()][-1]
+        vals[i] = best
+        idxs[i] = np.where(row == best)[0][-1]
+    out_shape = vm.shape[:-1]
+    vals = vals.reshape(out_shape)
+    idxs = idxs.reshape(out_shape)
+    if keepdim:
+        vals = np.expand_dims(vals, ax)
+        idxs = np.expand_dims(idxs, ax)
+    return Tensor(jnp.asarray(vals)), Tensor(jnp.asarray(idxs))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def fn(s, v):
+        side = "right" if right else "left"
+        if s.ndim == 1:
+            out = jnp.searchsorted(s, v, side=side)
+        else:
+            import jax as _jax
+            out = _jax.vmap(lambda ss, vv: jnp.searchsorted(ss, vv, side=side))(
+                s.reshape(-1, s.shape[-1]), v.reshape(-1, v.shape[-1])
+            ).reshape(v.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+    return apply(fn, sorted_sequence, values)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    def fn(v, s):
+        side = "right" if right else "left"
+        out = jnp.searchsorted(s, v, side=side)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+    return apply(fn, x, sorted_sequence)
+
+
+def index_select(x, index, axis=0, name=None):
+    from paddle_tpu.tensor.manipulation import index_select as isel
+    return isel(x, index, axis)

@@ -1,0 +1,92 @@
+"""Statistics ops. Reference: python/paddle/tensor/stat.py."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dispatch import apply, unwrap
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.tensor.math import _axis, mean  # noqa: F401 (mean re-export)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(lambda v: jnp.std(v, axis=_axis(axis), ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), x)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(lambda v: jnp.var(v, axis=_axis(axis), ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), x)
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(int(np.prod(unwrap(x).shape)), dtype=jnp.int64))
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def fn(v):
+        if mode == "avg":
+            return jnp.median(v, axis=_axis(axis), keepdims=keepdim)
+        # 'min' mode: lower of the two middles + its index
+        ax = -1 if axis is None else axis
+        vv = v.reshape(-1) if axis is None else v
+        n = vv.shape[ax]
+        k = (n - 1) // 2
+        sv = jnp.sort(vv, axis=ax)
+        vals = jnp.take(sv, k, axis=ax)
+        if keepdim and axis is not None:
+            vals = jnp.expand_dims(vals, ax)
+        return vals
+    return apply(fn, x)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    def fn(v):
+        return jnp.nanmedian(v, axis=_axis(axis), keepdims=keepdim)
+    return apply(fn, x)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qv = unwrap(q) if isinstance(q, Tensor) else jnp.asarray(q)
+    def fn(v):
+        ax = _axis(axis)
+        if isinstance(ax, tuple):
+            ax = ax[0] if len(ax) == 1 else None
+        return jnp.quantile(v.astype(jnp.float32), qv, axis=ax, keepdims=keepdim,
+                            method=interpolation)
+    return apply(fn, x)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qv = unwrap(q) if isinstance(q, Tensor) else jnp.asarray(q)
+    def fn(v):
+        ax = _axis(axis)
+        if isinstance(ax, tuple):
+            ax = ax[0] if len(ax) == 1 else None
+        return jnp.nanquantile(v.astype(jnp.float32), qv, axis=ax, keepdims=keepdim,
+                               method=interpolation)
+    return apply(fn, x)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    v = np.asarray(unwrap(input))
+    lo, hi = (min, max) if (min != 0 or max != 0) else (v.min(), v.max())
+    hist, _ = np.histogram(v, bins=bins, range=(lo, hi))
+    return Tensor(jnp.asarray(hist.astype(np.int64)))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    v = np.asarray(unwrap(x))
+    w = np.asarray(unwrap(weights)) if weights is not None else None
+    hist, edges = np.histogramdd(v, bins=bins, range=ranges, density=density, weights=w)
+    return Tensor(jnp.asarray(hist)), [Tensor(jnp.asarray(e)) for e in edges]
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    def fn(v, w):
+        length = builtins_max(minlength, int(np.asarray(unwrap(x)).max()) + 1 if np.asarray(unwrap(x)).size else minlength)
+        return jnp.bincount(v, weights=w, length=length or 1)
+    return apply(fn, x, weights)
+
+
+builtins_max = __import__("builtins").max

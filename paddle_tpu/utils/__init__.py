@@ -1,0 +1,38 @@
+"""Misc utils. Reference: python/paddle/utils/__init__.py."""
+from __future__ import annotations
+
+
+def try_import(name):
+    import importlib
+    try:
+        return importlib.import_module(name)
+    except ImportError as e:
+        raise ImportError(f"{name} is required: {e}") from e
+
+
+def run_check():
+    """paddle.utils.run_check parity: verify the TPU backend works."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as P
+    x = P.ones([2, 2])
+    y = (x @ x).numpy()
+    assert y.shape == (2, 2)
+    devs = jax.devices()
+    print(f"paddle_tpu is installed successfully! backend={jax.default_backend()} "
+          f"devices={devs}")
+    return True
+
+
+def unique_name(prefix="tmp"):
+    from paddle_tpu.core.tensor import Tensor
+    Tensor._tensor_id[0] += 1
+    return f"{prefix}_{Tensor._tensor_id[0]}"
+
+
+class deprecated:
+    def __init__(self, update_to="", since="", reason=""):
+        self.update_to = update_to
+
+    def __call__(self, fn):
+        return fn

@@ -1,0 +1,97 @@
+"""Vision datasets. Reference: python/paddle/vision/datasets/*.
+
+Zero-egress environment: datasets synthesize deterministic procedural data
+when the on-disk files are absent (download=False semantics), keeping the
+full Dataset API so training pipelines run unmodified.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from paddle_tpu.io import Dataset
+
+
+class MNIST(Dataset):
+    """Reference: python/paddle/vision/datasets/mnist.py."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        loaded = False
+        if image_path and label_path and os.path.exists(image_path):
+            with gzip.open(image_path, "rb") as f:
+                magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                self.images = np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+            with gzip.open(label_path, "rb") as f:
+                f.read(8)
+                self.labels = np.frombuffer(f.read(), np.uint8).astype(np.int64)
+            loaded = True
+        if not loaded:
+            # deterministic synthetic digits: class-dependent patterns
+            n = 6000 if mode == "train" else 1000
+            rng = np.random.default_rng(42 if mode == "train" else 7)
+            self.labels = rng.integers(0, 10, n).astype(np.int64)
+            base = rng.normal(0, 1, (10, 28, 28)).astype(np.float32)
+            noise = rng.normal(0, 0.3, (n, 28, 28)).astype(np.float32)
+            img = base[self.labels] + noise
+            img = (img - img.min()) / (img.max() - img.min())
+            self.images = (img * 255).astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32)[None] / 255.0
+        return img, np.asarray(label, np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    """Reference: python/paddle/vision/datasets/cifar.py."""
+
+    _classes = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        n = 5000 if mode == "train" else 1000
+        rng = np.random.default_rng(1 if mode == "train" else 2)
+        self.labels = rng.integers(0, self._classes, n).astype(np.int64)
+        base = rng.normal(0, 1, (self._classes, 32, 32, 3)).astype(np.float32)
+        noise = rng.normal(0, 0.4, (n, 32, 32, 3)).astype(np.float32)
+        img = base[self.labels] + noise
+        img = (img - img.min()) / (img.max() - img.min())
+        self.data = (img * 255).astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.data[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = np.transpose(img.astype(np.float32) / 255.0, (2, 0, 1))
+        return img, np.asarray(self.labels[idx], np.int64)
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Cifar100(Cifar10):
+    _classes = 100
+
+
+class Flowers(Cifar10):
+    _classes = 102

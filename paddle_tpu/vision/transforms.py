@@ -1,0 +1,233 @@
+"""Vision transforms. Reference: python/paddle/vision/transforms/transforms.py.
+
+Numpy/host-side preprocessing (runs in DataLoader workers, feeding the
+device pipeline).
+"""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class BaseTransform:
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def __call__(self, inputs):
+        return self._apply_image(inputs)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+def _to_hwc(img):
+    img = np.asarray(img)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return img
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        img = _to_hwc(img).astype(np.float32)
+        if img.dtype == np.uint8 or img.max() > 1.5:
+            img = img / 255.0
+        if self.data_format == "CHW":
+            img = np.transpose(img, (2, 0, 1))
+        return Tensor(img)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        super().__init__(keys)
+        self.mean = np.asarray(mean, np.float32).reshape(-1)
+        self.std = np.asarray(std, np.float32).reshape(-1)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        if isinstance(img, Tensor):
+            img = img.numpy()
+        img = np.asarray(img, np.float32)
+        if self.data_format == "CHW":
+            shape = (-1, 1, 1)
+        else:
+            shape = (1, 1, -1)
+        out = (img - self.mean.reshape(shape)) / self.std.reshape(shape)
+        return Tensor(out) if isinstance(img, np.ndarray) else out
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        import jax
+        import jax.numpy as jnp
+        arr = _to_hwc(img)
+        method = {"nearest": "nearest", "bilinear": "linear",
+                  "bicubic": "cubic"}.get(self.interpolation, "linear")
+        out = jax.image.resize(jnp.asarray(arr, jnp.float32),
+                               self.size + (arr.shape[2],), method=method)
+        return np.asarray(out).astype(arr.dtype)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, numbers.Number) else tuple(size)
+
+    def _apply_image(self, img):
+        arr = _to_hwc(img)
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        i = max(0, (h - th) // 2)
+        j = max(0, (w - tw) // 2)
+        return arr[i:i + th, j:j + tw]
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, numbers.Number) else tuple(size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        arr = _to_hwc(img)
+        if self.padding:
+            p = self.padding if isinstance(self.padding, (list, tuple)) \
+                else (self.padding,) * 4
+            arr = np.pad(arr, [(p[1], p[3]), (p[0], p[2]), (0, 0)])
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        i = np.random.randint(0, max(h - th, 0) + 1)
+        j = np.random.randint(0, max(w - tw, 0) + 1)
+        return arr[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return _to_hwc(img)[:, ::-1].copy()
+        return _to_hwc(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return _to_hwc(img)[::-1].copy()
+        return _to_hwc(img)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.resize = Resize(self.size, interpolation)
+
+    def _apply_image(self, img):
+        arr = _to_hwc(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            tw = int(round(np.sqrt(target * ar)))
+            th = int(round(np.sqrt(target / ar)))
+            if 0 < tw <= w and 0 < th <= h:
+                i = np.random.randint(0, h - th + 1)
+                j = np.random.randint(0, w - tw + 1)
+                return self.resize._apply_image(arr[i:i + th, j:j + tw])
+        return self.resize._apply_image(CenterCrop(min(h, w))._apply_image(arr))
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        return np.transpose(_to_hwc(img), self.order)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = _to_hwc(img).astype(np.float32)
+        factor = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return np.clip(arr * factor, 0, 255).astype(np.uint8) \
+            if arr.max() > 1.5 else np.clip(arr * factor, 0, 1)
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0, keys=None):
+        super().__init__(keys)
+        self.brightness = brightness
+
+    def _apply_image(self, img):
+        if self.brightness:
+            return BrightnessTransform(self.brightness)._apply_image(img)
+        return _to_hwc(img)
+
+
+def to_tensor(pic, data_format="CHW"):
+    return ToTensor(data_format)(pic)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format, to_rgb)(img)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)
+
+
+def hflip(img):
+    return _to_hwc(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    return _to_hwc(img)[::-1].copy()
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)(img)
+
+
+def crop(img, top, left, height, width):
+    return _to_hwc(img)[top:top + height, left:left + width]

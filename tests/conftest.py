@@ -1,0 +1,23 @@
+"""Test env: 8 virtual CPU devices (multi-chip sharding tests run here).
+
+Must set the env BEFORE jax initializes its backends (backend selection is
+lazy — first jax.devices() call wins).
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as P
+    P.seed(0)
+    np.random.seed(0)
+    yield

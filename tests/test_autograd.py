@@ -1,0 +1,150 @@
+"""Autograd engine tests: eager tape vs jax.grad oracle (SURVEY.md §4)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as P
+
+
+def leaf(a):
+    t = P.to_tensor(a)
+    t.stop_gradient = False
+    return t
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        x = leaf(np.asarray([1.0, 2.0, 3.0], np.float32))
+        y = (x * x + 2 * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 2 * x.numpy() + 2)
+
+    def test_oracle_mlp(self):
+        a = np.random.default_rng(0).standard_normal((4, 5)).astype(np.float32)
+        w1 = np.random.default_rng(1).standard_normal((5, 8)).astype(np.float32)
+        w2 = np.random.default_rng(2).standard_normal((8, 1)).astype(np.float32)
+
+        def f(w1v, w2v):
+            h = jnp.tanh(a @ w1v)
+            return jnp.sum((h @ w2v) ** 2)
+
+        g1, g2 = jax.grad(f, argnums=(0, 1))(w1, w2)
+        tw1, tw2 = leaf(w1), leaf(w2)
+        h = P.tanh(P.to_tensor(a) @ tw1)
+        loss = ((h @ tw2) ** 2).sum()
+        loss.backward()
+        np.testing.assert_allclose(tw1.grad.numpy(), g1, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(tw2.grad.numpy(), g2, rtol=1e-4, atol=1e-5)
+
+    def test_grad_accumulation(self):
+        x = leaf(np.ones(3, np.float32))
+        (x * 2).sum().backward()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5, 5, 5])
+
+    def test_shared_subexpression(self):
+        x = leaf(np.asarray([2.0], np.float32))
+        y = x * x      # used twice
+        z = (y + y).sum()
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+    def test_stop_gradient(self):
+        x = leaf(np.ones(3, np.float32))
+        y = P.to_tensor(np.ones(3, np.float32))  # stop_gradient=True
+        (x * y).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [1, 1, 1])
+        assert y.grad is None
+
+    def test_detach(self):
+        x = leaf(np.asarray([3.0], np.float32))
+        y = x * 2
+        z = y.detach() * x
+        z.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])  # only via z, not y
+
+    def test_multi_output_op(self):
+        x = leaf(np.arange(6, dtype=np.float32).reshape(2, 3))
+        a, b = P.split(x, 2, axis=0)
+        (a.sum() * 2 + b.sum() * 3).backward()
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   [[2, 2, 2], [3, 3, 3]])
+
+    def test_no_grad(self):
+        x = leaf(np.ones(3, np.float32))
+        with P.no_grad():
+            y = x * 2
+        assert y._node is None
+        z = x * 2
+        assert z._node is not None
+
+    def test_double_backward_error(self):
+        x = leaf(np.ones(3, np.float32))
+        y = (x * x).sum()
+        y.backward()
+        try:
+            y.backward()
+            raised = False
+        except RuntimeError:
+            raised = True
+        assert raised
+
+    def test_retain_graph(self):
+        x = leaf(np.ones(3, np.float32))
+        y = (x * x).sum()
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4, 4, 4])
+
+    def test_nonscalar_backward_with_grad(self):
+        x = leaf(np.ones((2, 2), np.float32))
+        y = x * 3
+        y.backward(P.ones([2, 2]))
+        np.testing.assert_allclose(x.grad.numpy(), np.full((2, 2), 3.0))
+
+    def test_paddle_grad_api(self):
+        x = leaf(np.asarray([2.0], np.float32))
+        y = x * x
+        (g,) = P.grad(y, x)
+        np.testing.assert_allclose(g.numpy(), [4.0])
+        assert x.grad is None  # .grad untouched
+
+    def test_register_hook(self):
+        x = leaf(np.ones(2, np.float32))
+        x.register_hook(lambda g: g * 10)
+        (x * 2).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [20, 20])
+
+    def test_indexing_grad(self):
+        x = leaf(np.arange(6, dtype=np.float32).reshape(2, 3))
+        y = x[0].sum() * 2 + x[1, 1] * 5
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [[2, 2, 2], [0, 5, 0]])
+
+    def test_setitem_grad(self):
+        v = leaf(np.asarray([10.0, 20.0], np.float32))
+        x = P.zeros([4])
+        x.stop_gradient = False
+        x[1:3] = v
+        x.sum().backward()
+        np.testing.assert_allclose(v.grad.numpy(), [1.0, 1.0])
+
+
+class TestPyLayer:
+    def test_custom_vjp(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class Double(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, grad):
+                return grad * 2
+
+        x = leaf(np.ones(3, np.float32))
+        y = Double.apply(x)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2, 2, 2])

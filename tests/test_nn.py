@@ -1,0 +1,375 @@
+"""Layer tests: forward shapes/values, state_dict, train/eval (SURVEY.md §4).
+Numeric oracles: torch (CPU) where convenient, else numpy."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def t(a, sg=True):
+    x = P.to_tensor(np.asarray(a, np.float32))
+    x.stop_gradient = sg
+    return x
+
+
+class TestLinearConv:
+    def test_linear(self):
+        layer = nn.Linear(4, 3)
+        x = t(np.random.default_rng(0).standard_normal((2, 4)))
+        y = layer(x)
+        assert y.shape == [2, 3]
+        exp = x.numpy() @ layer.weight.numpy() + layer.bias.numpy()
+        np.testing.assert_allclose(y.numpy(), exp, rtol=1e-5)
+
+    def test_conv2d_vs_torch(self):
+        import torch
+        import torch.nn.functional as TF
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 3, 8, 8)).astype(np.float32)
+        w = rng.standard_normal((5, 3, 3, 3)).astype(np.float32)
+        b = rng.standard_normal(5).astype(np.float32)
+        ours = F.conv2d(t(x), t(w), t(b), stride=2, padding=1).numpy()
+        theirs = TF.conv2d(torch.tensor(x), torch.tensor(w), torch.tensor(b),
+                           stride=2, padding=1).numpy()
+        np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-4)
+
+    def test_conv2d_groups_dilation(self):
+        import torch
+        import torch.nn.functional as TF
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((1, 4, 9, 9)).astype(np.float32)
+        w = rng.standard_normal((8, 2, 3, 3)).astype(np.float32)
+        ours = F.conv2d(t(x), t(w), None, padding=2, dilation=2, groups=2).numpy()
+        theirs = TF.conv2d(torch.tensor(x), torch.tensor(w), None,
+                           padding=2, dilation=2, groups=2).numpy()
+        np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-4)
+
+    def test_conv_transpose_vs_torch(self):
+        import torch
+        import torch.nn.functional as TF
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((2, 4, 5, 5)).astype(np.float32)
+        w = rng.standard_normal((4, 3, 3, 3)).astype(np.float32)
+        ours = F.conv2d_transpose(t(x), t(w), stride=2, padding=1,
+                                  output_padding=1).numpy()
+        theirs = TF.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                                     stride=2, padding=1, output_padding=1).numpy()
+        np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-4)
+
+    def test_conv1d_3d(self):
+        x1 = t(np.random.default_rng(0).standard_normal((2, 3, 10)))
+        y1 = nn.Conv1D(3, 6, 3, padding=1)(x1)
+        assert y1.shape == [2, 6, 10]
+        x3 = t(np.random.default_rng(0).standard_normal((1, 2, 4, 4, 4)))
+        y3 = nn.Conv3D(2, 4, 3, padding=1)(x3)
+        assert y3.shape == [1, 4, 4, 4, 4]
+
+
+class TestNormPool:
+    def test_batchnorm_train_eval(self):
+        bn = nn.BatchNorm2D(3)
+        x = t(np.random.default_rng(0).standard_normal((4, 3, 5, 5)) * 2 + 1)
+        bn.train()
+        y = bn(x)
+        m = y.numpy().mean(axis=(0, 2, 3))
+        np.testing.assert_allclose(m, 0, atol=1e-5)
+        # running stats moved toward batch stats
+        assert not np.allclose(bn._mean.numpy(), 0)
+        bn.eval()
+        y2 = bn(x)
+        assert y2.shape == [4, 3, 5, 5]
+
+    def test_batchnorm_vs_torch(self):
+        import torch
+        x = np.random.default_rng(0).standard_normal((4, 3, 5, 5)).astype(np.float32)
+        ours_bn = nn.BatchNorm2D(3, momentum=0.9)
+        ours = ours_bn(t(x))
+        tb = torch.nn.BatchNorm2d(3, momentum=0.1)
+        tb.train()
+        theirs = tb(torch.tensor(x)).detach().numpy()
+        np.testing.assert_allclose(ours.numpy(), theirs, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(ours_bn._mean.numpy(),
+                                   tb.running_mean.numpy(), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(ours_bn._variance.numpy(),
+                                   tb.running_var.numpy(), rtol=1e-4, atol=1e-4)
+
+    def test_layernorm_groupnorm(self):
+        import torch
+        x = np.random.default_rng(0).standard_normal((2, 6, 4)).astype(np.float32)
+        ours = nn.LayerNorm(4)(t(x)).numpy()
+        theirs = torch.nn.LayerNorm(4)(torch.tensor(x)).detach().numpy()
+        np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-4)
+        xg = np.random.default_rng(0).standard_normal((2, 6, 4, 4)).astype(np.float32)
+        ours_g = nn.GroupNorm(3, 6)(t(xg)).numpy()
+        theirs_g = torch.nn.GroupNorm(3, 6)(torch.tensor(xg)).detach().numpy()
+        np.testing.assert_allclose(ours_g, theirs_g, rtol=1e-4, atol=1e-4)
+
+    def test_pools_vs_torch(self):
+        import torch
+        import torch.nn.functional as TF
+        x = np.random.default_rng(0).standard_normal((2, 3, 8, 8)).astype(np.float32)
+        np.testing.assert_allclose(
+            F.max_pool2d(t(x), 2, 2).numpy(),
+            TF.max_pool2d(torch.tensor(x), 2, 2).numpy(), rtol=1e-6)
+        np.testing.assert_allclose(
+            F.avg_pool2d(t(x), 3, 2, 1).numpy(),
+            TF.avg_pool2d(torch.tensor(x), 3, 2, 1,
+                          count_include_pad=False).numpy(),
+            rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            F.adaptive_avg_pool2d(t(x), (3, 3)).numpy(),
+            TF.adaptive_avg_pool2d(torch.tensor(x), (3, 3)).numpy(),
+            rtol=1e-5, atol=1e-6)
+
+    def test_maxpool_ceil_mode(self):
+        import torch
+        import torch.nn.functional as TF
+        x = np.random.default_rng(0).standard_normal((1, 1, 7, 7)).astype(np.float32)
+        ours = F.max_pool2d(t(x), 3, 2, 0, ceil_mode=True).numpy()
+        theirs = TF.max_pool2d(torch.tensor(x), 3, 2, 0, ceil_mode=True).numpy()
+        np.testing.assert_allclose(ours, theirs)
+
+
+class TestActivationsLoss:
+    def test_activations_vs_torch(self):
+        import torch
+        import torch.nn.functional as TF
+        x = np.linspace(-3, 3, 50, dtype=np.float32)
+        tx = torch.tensor(x)
+        for ours_fn, theirs in [
+            (F.relu, TF.relu(tx)), (F.gelu, TF.gelu(tx)),
+            (F.sigmoid, torch.sigmoid(tx)), (F.silu, TF.silu(tx)),
+            (F.softplus, TF.softplus(tx)), (F.mish, TF.mish(tx)),
+            (F.hardswish, TF.hardswish(tx)), (F.elu, TF.elu(tx)),
+            (F.leaky_relu, TF.leaky_relu(tx)),
+            (F.log_sigmoid, TF.logsigmoid(tx)),
+        ]:
+            np.testing.assert_allclose(ours_fn(t(x)).numpy(), theirs.numpy(),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_softmax_logsoftmax(self):
+        x = np.random.default_rng(0).standard_normal((3, 5)).astype(np.float32)
+        s = F.softmax(t(x), axis=-1).numpy()
+        np.testing.assert_allclose(s.sum(-1), 1, rtol=1e-5)
+        ls = F.log_softmax(t(x), axis=-1).numpy()
+        np.testing.assert_allclose(np.exp(ls), s, rtol=1e-5)
+
+    def test_cross_entropy_vs_torch(self):
+        import torch
+        import torch.nn.functional as TF
+        rng = np.random.default_rng(0)
+        logits = rng.standard_normal((6, 10)).astype(np.float32)
+        labels = rng.integers(0, 10, 6)
+        ours = F.cross_entropy(t(logits), P.to_tensor(labels)).numpy()
+        theirs = TF.cross_entropy(torch.tensor(logits),
+                                  torch.tensor(labels)).numpy()
+        np.testing.assert_allclose(ours, theirs, rtol=1e-5)
+
+    def test_cross_entropy_ignore_soft(self):
+        import torch
+        import torch.nn.functional as TF
+        rng = np.random.default_rng(0)
+        logits = rng.standard_normal((6, 10)).astype(np.float32)
+        labels = rng.integers(0, 10, 6)
+        labels[2] = -100
+        ours = F.cross_entropy(t(logits), P.to_tensor(labels),
+                               ignore_index=-100).numpy()
+        theirs = TF.cross_entropy(torch.tensor(logits), torch.tensor(labels),
+                                  ignore_index=-100).numpy()
+        np.testing.assert_allclose(ours, theirs, rtol=1e-5)
+        soft = rng.random((6, 10)).astype(np.float32)
+        soft /= soft.sum(-1, keepdims=True)
+        ours_s = F.cross_entropy(t(logits), t(soft), soft_label=True).numpy()
+        theirs_s = TF.cross_entropy(torch.tensor(logits),
+                                    torch.tensor(soft)).numpy()
+        np.testing.assert_allclose(ours_s, theirs_s, rtol=1e-5)
+
+    def test_other_losses(self):
+        import torch
+        import torch.nn.functional as TF
+        rng = np.random.default_rng(0)
+        a = rng.standard_normal((4, 5)).astype(np.float32)
+        b = rng.standard_normal((4, 5)).astype(np.float32)
+        np.testing.assert_allclose(F.mse_loss(t(a), t(b)).numpy(),
+                                   TF.mse_loss(torch.tensor(a),
+                                               torch.tensor(b)).numpy(),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(F.l1_loss(t(a), t(b)).numpy(),
+                                   TF.l1_loss(torch.tensor(a),
+                                              torch.tensor(b)).numpy(),
+                                   rtol=1e-5)
+        p = 1 / (1 + np.exp(-a))
+        y = (rng.random((4, 5)) > 0.5).astype(np.float32)
+        np.testing.assert_allclose(
+            F.binary_cross_entropy(t(p), t(y)).numpy(),
+            TF.binary_cross_entropy(torch.tensor(p), torch.tensor(y)).numpy(),
+            rtol=1e-4)
+        np.testing.assert_allclose(
+            F.binary_cross_entropy_with_logits(t(a), t(y)).numpy(),
+            TF.binary_cross_entropy_with_logits(torch.tensor(a),
+                                                torch.tensor(y)).numpy(),
+            rtol=1e-4)
+
+    def test_ctc_loss_vs_torch(self):
+        import torch
+        rng = np.random.default_rng(0)
+        T_, N, C, S = 12, 2, 5, 4
+        logits = rng.standard_normal((T_, N, C)).astype(np.float32)
+        labels = rng.integers(1, C, (N, S)).astype(np.int32)
+        in_len = np.asarray([12, 10], np.int32)
+        lab_len = np.asarray([4, 3], np.int32)
+        ours = F.ctc_loss(t(logits), P.to_tensor(labels), P.to_tensor(in_len),
+                          P.to_tensor(lab_len), blank=0, reduction="none").numpy()
+        lp = torch.log_softmax(torch.tensor(logits), -1)
+        theirs = torch.nn.functional.ctc_loss(
+            lp, torch.tensor(labels.astype(np.int64)),
+            torch.tensor(in_len.astype(np.int64)),
+            torch.tensor(lab_len.astype(np.int64)), blank=0,
+            reduction="none").numpy()
+        np.testing.assert_allclose(ours, theirs, rtol=1e-3, atol=1e-3)
+
+
+class TestLayerMachinery:
+    def test_state_dict_roundtrip(self):
+        m1 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        m2.set_state_dict(m1.state_dict())
+        x = t(np.random.default_rng(0).standard_normal((3, 4)))
+        np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+    def test_named_parameters(self):
+        m = nn.Sequential(nn.Linear(2, 3), nn.Linear(3, 4))
+        names = [n for n, _ in m.named_parameters()]
+        assert names == ["0.weight", "0.bias", "1.weight", "1.bias"]
+
+    def test_apply_and_modes(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        m.eval()
+        assert not m[1].training
+        m.train()
+        assert m[1].training
+
+    def test_hooks(self):
+        m = nn.Linear(2, 2)
+        calls = []
+        h = m.register_forward_post_hook(lambda l, i, o: calls.append(1))
+        m(t(np.ones((1, 2))))
+        assert calls == [1]
+        h.remove()
+        m(t(np.ones((1, 2))))
+        assert calls == [1]
+
+    def test_parameters_to_vector(self):
+        m = nn.Linear(3, 2)
+        vec = nn.utils.parameters_to_vector(m.parameters())
+        assert vec.shape == [3 * 2 + 2]
+        nn.utils.vector_to_parameters(vec * 0, m.parameters())
+        assert m.weight.numpy().sum() == 0
+
+    def test_save_load(self, tmp_path):
+        m = nn.Linear(3, 2)
+        P.save(m.state_dict(), str(tmp_path / "m.pdparams"))
+        sd = P.load(str(tmp_path / "m.pdparams"))
+        m2 = nn.Linear(3, 2)
+        m2.set_state_dict(sd)
+        np.testing.assert_allclose(m.weight.numpy(), m2.weight.numpy())
+
+
+class TestDropoutEmbedding:
+    def test_dropout_train_eval(self):
+        d = nn.Dropout(0.5)
+        x = t(np.ones((100, 100)))
+        d.train()
+        y = d(x).numpy()
+        frac = (y == 0).mean()
+        assert 0.4 < frac < 0.6
+        np.testing.assert_allclose(y[y != 0], 2.0)
+        d.eval()
+        np.testing.assert_allclose(d(x).numpy(), 1.0)
+
+    def test_embedding(self):
+        e = nn.Embedding(10, 4, padding_idx=0)
+        idx = P.to_tensor(np.asarray([[1, 2], [0, 3]]))
+        out = e(idx)
+        assert out.shape == [2, 2, 4]
+        np.testing.assert_allclose(out.numpy()[1, 0], 0.0)
+
+    def test_one_hot(self):
+        out = F.one_hot(P.to_tensor(np.asarray([0, 2])), 4).numpy()
+        np.testing.assert_array_equal(out, [[1, 0, 0, 0], [0, 0, 1, 0]])
+
+
+class TestRNNTransformer:
+    def test_lstm_shapes(self):
+        lstm = nn.LSTM(8, 16, num_layers=2)
+        x = t(np.random.default_rng(0).standard_normal((4, 10, 8)))
+        out, (h, c) = lstm(x)
+        assert out.shape == [4, 10, 16]
+        assert h.shape == [2, 4, 16] and c.shape == [2, 4, 16]
+
+    def test_bilstm(self):
+        lstm = nn.LSTM(8, 16, direction="bidirect")
+        x = t(np.random.default_rng(0).standard_normal((4, 10, 8)))
+        out, (h, c) = lstm(x)
+        assert out.shape == [4, 10, 32]
+        assert h.shape == [2, 4, 16]
+
+    def test_gru_grad(self):
+        gru = nn.GRU(4, 8)
+        x = t(np.random.default_rng(0).standard_normal((2, 5, 4)))
+        out, h = gru(x)
+        out.sum().backward()
+        for p in gru.parameters():
+            assert p.grad is not None
+
+    def test_lstm_vs_torch(self):
+        import torch
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 6, 4)).astype(np.float32)
+        ours = nn.LSTM(4, 5)
+        theirs = torch.nn.LSTM(4, 5, batch_first=True)
+        sd = {}
+        cell = ours.layer_list[0].cell
+        theirs.weight_ih_l0.data = torch.tensor(cell.weight_ih.numpy())
+        theirs.weight_hh_l0.data = torch.tensor(cell.weight_hh.numpy())
+        theirs.bias_ih_l0.data = torch.tensor(cell.bias_ih.numpy())
+        theirs.bias_hh_l0.data = torch.tensor(cell.bias_hh.numpy())
+        out_o, _ = ours(t(x))
+        out_t, _ = theirs(torch.tensor(x))
+        np.testing.assert_allclose(out_o.numpy(), out_t.detach().numpy(),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_mha(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = t(np.random.default_rng(0).standard_normal((2, 5, 16)))
+        out = mha(x, x, x)
+        assert out.shape == [2, 5, 16]
+
+    def test_transformer_encoder(self):
+        enc_layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(enc_layer, 2)
+        x = t(np.random.default_rng(0).standard_normal((2, 5, 16)))
+        out = enc(x)
+        assert out.shape == [2, 5, 16]
+        out.sum().backward()
+
+    def test_sdpa_matches_reference(self):
+        rng = np.random.default_rng(0)
+        q = rng.standard_normal((2, 6, 2, 8)).astype(np.float32)
+        out = F.scaled_dot_product_attention(t(q), t(q), t(q), is_causal=True)
+        assert out.shape == [2, 6, 2, 8]
+        # causal: first position attends only to itself
+        np.testing.assert_allclose(out.numpy()[:, 0], q[:, 0], rtol=1e-5)
+
+
+class TestClip:
+    def test_global_norm_clip(self):
+        m = nn.Linear(4, 4)
+        x = t(np.random.default_rng(0).standard_normal((2, 4)) * 100)
+        (m(x) ** 2).sum().backward()
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        clip([(p, p.grad) for p in m.parameters()])
+        total = np.sqrt(sum((p.grad.numpy() ** 2).sum() for p in m.parameters()))
+        assert total <= 1.01

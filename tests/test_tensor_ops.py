@@ -1,0 +1,282 @@
+"""Per-op numeric tests vs NumPy (reference test strategy: SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+
+
+def npt(*shape, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal(shape).astype(np.float32)
+
+
+class TestCreation:
+    def test_to_tensor(self):
+        x = P.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert x.shape == [2, 2]
+        np.testing.assert_allclose(x.numpy(), [[1, 2], [3, 4]])
+
+    def test_zeros_ones_full(self):
+        assert P.zeros([2, 3]).numpy().sum() == 0
+        assert P.ones([2, 3]).numpy().sum() == 6
+        assert (P.full([2, 2], 7).numpy() == 7).all()
+
+    def test_arange_linspace(self):
+        np.testing.assert_array_equal(P.arange(5).numpy(), np.arange(5))
+        np.testing.assert_allclose(P.linspace(0, 1, 5).numpy(),
+                                   np.linspace(0, 1, 5))
+
+    def test_eye_tril_triu(self):
+        np.testing.assert_array_equal(P.eye(3).numpy(), np.eye(3, dtype=np.float32))
+        a = npt(4, 4)
+        np.testing.assert_array_equal(P.tril(P.to_tensor(a)).numpy(), np.tril(a))
+        np.testing.assert_array_equal(P.triu(P.to_tensor(a)).numpy(), np.triu(a))
+
+    def test_int_dtype_default(self):
+        # TPU-first: int64 requests run as int32 (x64 disabled); API accepts
+        # the names for parity with the reference.
+        assert P.arange(3).dtype in (np.dtype("int64"), np.dtype("int32"))
+        assert P.to_tensor([1, 2]).dtype in (np.dtype("int64"), np.dtype("int32"))
+
+
+class TestMath:
+    def test_elementwise(self):
+        a, b = npt(3, 4), npt(3, 4, seed=1)
+        x, y = P.to_tensor(a), P.to_tensor(b)
+        np.testing.assert_allclose((x + y).numpy(), a + b, rtol=1e-6)
+        np.testing.assert_allclose((x * y).numpy(), a * b, rtol=1e-6)
+        np.testing.assert_allclose((x - y).numpy(), a - b, rtol=1e-6)
+        np.testing.assert_allclose((x / y).numpy(), a / b, rtol=1e-5)
+        np.testing.assert_allclose(P.maximum(x, y).numpy(), np.maximum(a, b))
+
+    def test_broadcasting(self):
+        a, b = npt(3, 1), npt(1, 4)
+        out = (P.to_tensor(a) + P.to_tensor(b)).numpy()
+        np.testing.assert_allclose(out, a + b, rtol=1e-6)
+
+    def test_scalar_ops(self):
+        a = npt(2, 3)
+        x = P.to_tensor(a)
+        np.testing.assert_allclose((x + 1).numpy(), a + 1, rtol=1e-6)
+        np.testing.assert_allclose((2 * x).numpy(), 2 * a, rtol=1e-6)
+        np.testing.assert_allclose((1 - x).numpy(), 1 - a, rtol=1e-6)
+        np.testing.assert_allclose((x ** 2).numpy(), a ** 2, rtol=1e-6)
+
+    def test_unary(self):
+        a = np.abs(npt(3, 3)) + 0.1
+        x = P.to_tensor(a)
+        np.testing.assert_allclose(P.sqrt(x).numpy(), np.sqrt(a), rtol=1e-6)
+        np.testing.assert_allclose(P.log(x).numpy(), np.log(a), rtol=1e-5)
+        np.testing.assert_allclose(P.exp(x).numpy(), np.exp(a), rtol=1e-5)
+        np.testing.assert_allclose(P.tanh(x).numpy(), np.tanh(a), rtol=1e-6)
+
+    def test_reductions(self):
+        a = npt(3, 4, 5)
+        x = P.to_tensor(a)
+        np.testing.assert_allclose(P.sum(x).numpy(), a.sum(), rtol=1e-5)
+        np.testing.assert_allclose(P.sum(x, axis=1).numpy(), a.sum(1), rtol=1e-5)
+        np.testing.assert_allclose(P.mean(x, axis=[0, 2]).numpy(),
+                                   a.mean((0, 2)), rtol=1e-5)
+        np.testing.assert_allclose(P.max(x, axis=1, keepdim=True).numpy(),
+                                   a.max(1, keepdims=True))
+        np.testing.assert_allclose(P.prod(x, axis=0).numpy(), a.prod(0), rtol=1e-4)
+
+    def test_cumsum_logsumexp(self):
+        a = npt(4, 5)
+        x = P.to_tensor(a)
+        np.testing.assert_allclose(P.cumsum(x, axis=1).numpy(),
+                                   np.cumsum(a, 1), rtol=1e-5)
+        from scipy.special import logsumexp as sls
+        np.testing.assert_allclose(P.logsumexp(x, axis=1).numpy(),
+                                   sls(a, axis=1), rtol=1e-5)
+
+    def test_matmul(self):
+        a, b = npt(3, 4), npt(4, 5)
+        np.testing.assert_allclose(
+            P.matmul(P.to_tensor(a), P.to_tensor(b)).numpy(), a @ b, rtol=1e-5)
+        np.testing.assert_allclose(
+            P.matmul(P.to_tensor(a), P.to_tensor(b.T), transpose_y=True).numpy(),
+            a @ b, rtol=1e-5)
+
+    def test_clip(self):
+        a = npt(3, 3)
+        np.testing.assert_allclose(P.clip(P.to_tensor(a), -0.5, 0.5).numpy(),
+                                   np.clip(a, -0.5, 0.5))
+
+    def test_inplace(self):
+        x = P.to_tensor([1.0, 2.0])
+        x.add_(P.to_tensor([1.0, 1.0]))
+        np.testing.assert_allclose(x.numpy(), [2, 3])
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        a = npt(2, 3, 4)
+        x = P.to_tensor(a)
+        assert P.reshape(x, [4, 6]).shape == [4, 6]
+        np.testing.assert_array_equal(
+            P.transpose(x, [2, 0, 1]).numpy(), a.transpose(2, 0, 1))
+
+    def test_concat_split_stack(self):
+        a, b = npt(2, 3), npt(2, 3, seed=1)
+        x, y = P.to_tensor(a), P.to_tensor(b)
+        np.testing.assert_array_equal(P.concat([x, y], axis=0).numpy(),
+                                      np.concatenate([a, b], 0))
+        np.testing.assert_array_equal(P.stack([x, y], axis=1).numpy(),
+                                      np.stack([a, b], 1))
+        parts = P.split(P.to_tensor(npt(6, 2)), 3, axis=0)
+        assert len(parts) == 3 and parts[0].shape == [2, 2]
+        parts = P.split(P.to_tensor(npt(7, 2)), [3, -1], axis=0)
+        assert parts[1].shape == [4, 2]
+
+    def test_squeeze_unsqueeze_flatten(self):
+        x = P.ones([2, 1, 3, 1])
+        assert P.squeeze(x).shape == [2, 3]
+        assert P.squeeze(x, axis=1).shape == [2, 3, 1]
+        assert P.unsqueeze(x, [0]).shape == [1, 2, 1, 3, 1]
+        assert P.flatten(x, 1, 2).shape == [2, 3, 1]
+
+    def test_gather_scatter(self):
+        a = npt(5, 3)
+        idx = np.asarray([0, 2, 4])
+        np.testing.assert_array_equal(
+            P.gather(P.to_tensor(a), P.to_tensor(idx)).numpy(), a[idx])
+        base = np.zeros((5, 2), np.float32)
+        upd = npt(3, 2)
+        out = P.scatter(P.to_tensor(base), P.to_tensor(np.asarray([1, 3, 4])),
+                        P.to_tensor(upd)).numpy()
+        exp = base.copy()
+        exp[[1, 3, 4]] = upd
+        np.testing.assert_array_equal(out, exp)
+
+    def test_gather_nd(self):
+        a = npt(3, 4, 5)
+        idx = np.asarray([[0, 1], [2, 3]])
+        np.testing.assert_array_equal(
+            P.gather_nd(P.to_tensor(a), P.to_tensor(idx)).numpy(),
+            a[idx[:, 0], idx[:, 1]])
+
+    def test_tile_expand_flip_roll(self):
+        a = npt(2, 3)
+        x = P.to_tensor(a)
+        np.testing.assert_array_equal(P.tile(x, [2, 1]).numpy(), np.tile(a, (2, 1)))
+        np.testing.assert_array_equal(P.expand(P.ones([1, 3]), [4, 3]).shape, [4, 3])
+        np.testing.assert_array_equal(P.flip(x, [0]).numpy(), a[::-1])
+        np.testing.assert_array_equal(P.roll(x, 1, axis=0).numpy(),
+                                      np.roll(a, 1, 0))
+
+    def test_indexing(self):
+        a = npt(4, 5)
+        x = P.to_tensor(a)
+        np.testing.assert_array_equal(x[1].numpy(), a[1])
+        np.testing.assert_array_equal(x[1:3, ::2].numpy(), a[1:3, ::2])
+        np.testing.assert_array_equal(x[:, None].shape, [4, 1, 5])
+        mask = a > 0
+        np.testing.assert_array_equal(x[P.to_tensor(mask)].numpy(), a[mask])
+
+    def test_setitem(self):
+        a = npt(3, 3)
+        x = P.to_tensor(a.copy())
+        x[1] = 0.0
+        exp = a.copy()
+        exp[1] = 0
+        np.testing.assert_array_equal(x.numpy(), exp)
+
+    def test_take_along_put_along(self):
+        a = npt(3, 4)
+        idx = np.argsort(a, axis=1)
+        np.testing.assert_array_equal(
+            P.take_along_axis(P.to_tensor(a), P.to_tensor(idx), 1).numpy(),
+            np.take_along_axis(a, idx, 1))
+
+
+class TestLogicSearch:
+    def test_comparisons(self):
+        a, b = npt(3, 3), npt(3, 3, seed=1)
+        np.testing.assert_array_equal(
+            (P.to_tensor(a) > P.to_tensor(b)).numpy(), a > b)
+        assert bool(P.allclose(P.to_tensor(a), P.to_tensor(a.copy())))
+
+    def test_argmax_sort_topk(self):
+        a = npt(4, 6)
+        x = P.to_tensor(a)
+        np.testing.assert_array_equal(P.argmax(x, axis=1).numpy(), a.argmax(1))
+        np.testing.assert_allclose(P.sort(x, axis=1).numpy(), np.sort(a, 1))
+        vals, idx = P.topk(x, 3, axis=1)
+        exp = np.sort(a, 1)[:, ::-1][:, :3]
+        np.testing.assert_allclose(vals.numpy(), exp, rtol=1e-6)
+
+    def test_where_nonzero(self):
+        a = npt(3, 3)
+        out = P.where(P.to_tensor(a > 0), P.to_tensor(a), P.to_tensor(-a))
+        np.testing.assert_allclose(out.numpy(), np.abs(a), rtol=1e-6)
+        nz = P.nonzero(P.to_tensor(a > 0)).numpy()
+        np.testing.assert_array_equal(nz, np.stack(np.nonzero(a > 0), 1))
+
+    def test_unique(self):
+        a = np.asarray([3, 1, 2, 1, 3])
+        out = P.unique(P.to_tensor(a))
+        np.testing.assert_array_equal(out.numpy(), [1, 2, 3])
+
+
+class TestLinalgStat:
+    def test_norm_det_inverse(self):
+        a = npt(3, 3) + np.eye(3, dtype=np.float32) * 3
+        x = P.to_tensor(a)
+        np.testing.assert_allclose(P.linalg.norm(x).numpy(),
+                                   np.linalg.norm(a), rtol=1e-5)
+        np.testing.assert_allclose(P.linalg.det(x).numpy(),
+                                   np.linalg.det(a), rtol=1e-4)
+        np.testing.assert_allclose(P.linalg.inv(x).numpy(),
+                                   np.linalg.inv(a), rtol=1e-4, atol=1e-5)
+
+    def test_svd_qr_cholesky(self):
+        a = npt(4, 3)
+        u, s, v = P.linalg.svd(P.to_tensor(a))
+        np.testing.assert_allclose(
+            (u.numpy() * s.numpy()) @ v.numpy().T, a, rtol=1e-4, atol=1e-5)
+        spd = a.T @ a + np.eye(3, dtype=np.float32)
+        L = P.linalg.cholesky(P.to_tensor(spd)).numpy()
+        np.testing.assert_allclose(L @ L.T, spd, rtol=1e-4, atol=1e-5)
+
+    def test_solve(self):
+        a = npt(3, 3) + np.eye(3, dtype=np.float32) * 3
+        b = npt(3, 2)
+        out = P.linalg.solve(P.to_tensor(a), P.to_tensor(b)).numpy()
+        np.testing.assert_allclose(a @ out, b, rtol=1e-4, atol=1e-5)
+
+    def test_std_var_median(self):
+        a = npt(4, 5)
+        x = P.to_tensor(a)
+        np.testing.assert_allclose(P.std(x).numpy(), a.std(ddof=1), rtol=1e-5)
+        np.testing.assert_allclose(P.var(x, axis=0).numpy(),
+                                   a.var(0, ddof=1), rtol=1e-5)
+        np.testing.assert_allclose(P.median(x).numpy(), np.median(a), rtol=1e-6)
+
+    def test_einsum(self):
+        a, b = npt(3, 4), npt(4, 5)
+        np.testing.assert_allclose(
+            P.einsum("ij,jk->ik", P.to_tensor(a), P.to_tensor(b)).numpy(),
+            a @ b, rtol=1e-5)
+
+
+class TestRandom:
+    def test_shapes_and_determinism(self):
+        P.seed(123)
+        a = P.randn([3, 4])
+        P.seed(123)
+        b = P.randn([3, 4])
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+        assert P.rand([2, 2]).shape == [2, 2]
+        r = P.randint(0, 10, [100]).numpy()
+        assert r.min() >= 0 and r.max() < 10
+        perm = np.sort(P.randperm(10).numpy())
+        np.testing.assert_array_equal(perm, np.arange(10))
+
+    def test_bernoulli_multinomial(self):
+        p = P.full([1000], 0.3)
+        frac = P.bernoulli(p).numpy().mean()
+        assert 0.2 < frac < 0.4
+        probs = P.to_tensor([[0.1, 0.9]])
+        samples = P.multinomial(probs, 50, replacement=True).numpy()
+        assert samples.mean() > 0.6
