@@ -11,6 +11,13 @@ if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+# The env var alone can be overridden by an externally-forced platform
+# (e.g. a site-installed TPU plugin exporting JAX_PLATFORMS); the config
+# update wins regardless, as long as it happens before backend init.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
