@@ -23,15 +23,20 @@ from paddle_tpu.nn.layer.layers import Layer
 
 
 def _constrain(x, *spec):
-    """with_sharding_constraint when a multi-device mesh is active."""
+    """with_sharding_constraint when a multi-device mesh is active.
+
+    Spec entries naming axes absent from the installed mesh degrade to
+    replicated (None), so tp/sp-annotated layers run unchanged on e.g. a
+    pure-dp mesh.
+    """
     from paddle_tpu.distributed.mesh import get_mesh
     from jax.sharding import NamedSharding, PartitionSpec
     mesh = get_mesh()
     if mesh is None or len(mesh.devices.flat) == 1:
         return x
-    sp = PartitionSpec(*spec)
+    cleaned = tuple(s if s in mesh.axis_names else None for s in spec)
     return apply(lambda v: jax.lax.with_sharding_constraint(
-        v, NamedSharding(mesh, sp)), x)
+        v, NamedSharding(mesh, PartitionSpec(*cleaned))), x)
 
 
 class ColumnParallelLinear(Layer):

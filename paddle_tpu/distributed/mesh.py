@@ -112,14 +112,17 @@ def named_sharding(*spec):
 def shard_tensor(t, *spec):
     """Annotate a Tensor with a PartitionSpec; to_static lifts it with this
     sharding (and eagerly places the value if a real multi-device mesh is
-    active). Analogue of paddle.distributed.shard_tensor (auto_parallel)."""
-    from paddle_tpu.core.tensor import Tensor
-    sp = P(*spec)
-    t.__dict__["dist_spec"] = sp
+    active). Analogue of paddle.distributed.shard_tensor (auto_parallel).
+
+    Axes named in `spec` but absent from the installed mesh degrade to
+    replicated, so tp/sp-annotated layers build unchanged on a smaller mesh.
+    """
+    t.__dict__["dist_spec"] = P(*spec)
     mesh = get_mesh()
     if mesh is not None and len(mesh.devices.flat) > 1 and not isinstance(
             t._value, jax.core.Tracer):
-        t._value = jax.device_put(t._value, NamedSharding(mesh, sp))
+        cleaned = tuple(s if s in mesh.axis_names else None for s in spec)
+        t._value = jax.device_put(t._value, NamedSharding(mesh, P(*cleaned)))
     return t
 
 

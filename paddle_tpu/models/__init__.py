@@ -1,0 +1,16 @@
+"""Flagship model families (parity targets from BASELINE.json configs).
+
+Reference counterparts live in PaddleNLP/PaddleClas model zoos built on the
+reference framework's fleet meta-parallel layers
+(python/paddle/distributed/fleet/meta_parallel/parallel_layers/mp_layers.py);
+here each model is built TPU-first on paddle_tpu's mesh-sharded layers.
+"""
+from paddle_tpu.models import gpt  # noqa: F401
+from paddle_tpu.models.gpt import (  # noqa: F401
+    GPTConfig,
+    GPTForCausalLM,
+    GPTModel,
+    GPTPretrainingCriterion,
+    gpt3_1p3b,
+    gpt3_tiny,
+)

@@ -1,0 +1,223 @@
+"""GPT-3-style causal LM — the flagship hybrid-parallel model.
+
+Reference parity: PaddleNLP GPT-3 built on the reference framework's
+fleet meta-parallel layers (reference:
+python/paddle/distributed/fleet/meta_parallel/parallel_layers/mp_layers.py —
+ColumnParallelLinear/RowParallelLinear/VocabParallelEmbedding; hybrid DP/MP/PP
+topology from python/paddle/distributed/fleet/base/topology.py).
+
+TPU-native design: one logical model over a `Mesh(("dp","pp","tp","sp"))`.
+Weights carry PartitionSpecs (tp-sharded qkv/ffn columns, rows for the output
+projections); activations are constrained to [batch→dp, seq→sp]; XLA's
+sharding propagation inserts the AllReduce/AllGather collectives over ICI that
+the reference expresses as explicit c_allreduce ops on NCCL. Attention runs
+through F.scaled_dot_product_attention (Pallas flash-attention fast path).
+"""
+from __future__ import annotations
+
+import paddle_tpu
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    _constrain,
+)
+from paddle_tpu.distributed.recompute import recompute
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+
+
+class GPTConfig:
+    """Model hyperparameters (GPT-3 naming)."""
+
+    def __init__(self, vocab_size=50304, hidden_size=2048, num_layers=24,
+                 num_heads=16, ffn_hidden_size=None, max_seq_len=2048,
+                 dropout=0.1, attention_dropout=0.1, initializer_range=0.02,
+                 layer_norm_epsilon=1e-5, use_recompute=False,
+                 tie_word_embeddings=True):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.ffn_hidden_size = ffn_hidden_size or 4 * hidden_size
+        self.max_seq_len = max_seq_len
+        self.dropout = dropout
+        self.attention_dropout = attention_dropout
+        self.initializer_range = initializer_range
+        self.layer_norm_epsilon = layer_norm_epsilon
+        self.use_recompute = use_recompute
+        self.tie_word_embeddings = tie_word_embeddings
+
+
+def gpt3_1p3b(**kw):
+    """GPT-3 1.3B (the BASELINE.json Fleet hybrid-parallel config)."""
+    cfg = dict(vocab_size=50304, hidden_size=2048, num_layers=24, num_heads=16,
+               max_seq_len=2048)
+    cfg.update(kw)
+    return GPTConfig(**cfg)
+
+
+def gpt3_tiny(**kw):
+    """Tiny config for tests / compile checks."""
+    cfg = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+               max_seq_len=128, dropout=0.0, attention_dropout=0.0)
+    cfg.update(kw)
+    return GPTConfig(**cfg)
+
+
+class GPTEmbeddings(nn.Layer):
+    """Word (vocab-parallel) + learned position embeddings."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.word_embeddings = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size,
+            weight_attr=I.ParamAttr(initializer=I.Normal(
+                0.0, config.initializer_range)))
+        self.position_embeddings = nn.Embedding(
+            config.max_seq_len, config.hidden_size,
+            weight_attr=I.ParamAttr(initializer=I.Normal(
+                0.0, config.initializer_range)))
+        self.dropout = nn.Dropout(config.dropout)
+
+    def forward(self, input_ids, position_ids=None):
+        if position_ids is None:
+            seq_len = input_ids.shape[-1]
+            position_ids = paddle_tpu.arange(seq_len, dtype="int64")
+        h = self.word_embeddings(input_ids) + self.position_embeddings(
+            position_ids)
+        h = _constrain(h, "dp", "sp", None)
+        return self.dropout(h)
+
+
+class GPTAttention(nn.Layer):
+    """Causal self-attention; fused qkv column-parallel, row-parallel output.
+
+    qkv columns are laid out [head, 3*head_dim] so the tp shards own whole
+    heads — attention then needs NO communication; the only tp collective in
+    the block is the AllReduce after out_proj (XLA inserts it from the
+    row-sharded weight spec).
+    """
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.num_heads = config.num_heads
+        self.head_dim = config.hidden_size // config.num_heads
+        init = I.ParamAttr(initializer=I.Normal(0.0, config.initializer_range))
+        self.qkv_proj = ColumnParallelLinear(
+            config.hidden_size, 3 * config.hidden_size, weight_attr=init,
+            gather_output=False)
+        self.out_proj = RowParallelLinear(
+            config.hidden_size, config.hidden_size, weight_attr=init,
+            input_is_parallel=True)
+        self.attn_dropout_p = config.attention_dropout
+
+    def forward(self, hidden):
+        b, s = hidden.shape[0], hidden.shape[1]
+        qkv = self.qkv_proj(hidden)
+        qkv = qkv.reshape([b, s, self.num_heads, 3 * self.head_dim])
+        qkv = _constrain(qkv, "dp", "sp", "tp", None)
+        q, k, v = qkv.split(3, axis=-1)
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True,
+            dropout_p=self.attn_dropout_p if self.training else 0.0,
+            training=self.training)
+        out = out.reshape([b, s, self.num_heads * self.head_dim])
+        return self.out_proj(out)
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        init = I.ParamAttr(initializer=I.Normal(0.0, config.initializer_range))
+        self.fc1 = ColumnParallelLinear(
+            config.hidden_size, config.ffn_hidden_size, weight_attr=init,
+            gather_output=False)
+        self.fc2 = RowParallelLinear(
+            config.ffn_hidden_size, config.hidden_size, weight_attr=init,
+            input_is_parallel=True)
+
+    def forward(self, x):
+        return self.fc2(F.gelu(self.fc1(x), approximate=True))
+
+
+class GPTDecoderLayer(nn.Layer):
+    """Pre-LN transformer decoder block."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(config.hidden_size,
+                                epsilon=config.layer_norm_epsilon)
+        self.attn = GPTAttention(config)
+        self.ln2 = nn.LayerNorm(config.hidden_size,
+                                epsilon=config.layer_norm_epsilon)
+        self.mlp = GPTMLP(config)
+        self.dropout = nn.Dropout(config.dropout)
+
+    def forward(self, x):
+        x = x + self.dropout(self.attn(self.ln1(x)))
+        x = x + self.dropout(self.mlp(self.ln2(x)))
+        return _constrain(x, "dp", "sp", None)
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = GPTEmbeddings(config)
+        self.layers = nn.LayerList(
+            [GPTDecoderLayer(config) for _ in range(config.num_layers)])
+        self.final_ln = nn.LayerNorm(config.hidden_size,
+                                     epsilon=config.layer_norm_epsilon)
+
+    def forward(self, input_ids, position_ids=None):
+        h = self.embeddings(input_ids, position_ids)
+        for layer in self.layers:
+            if self.config.use_recompute and self.training:
+                h = recompute(layer, h)
+            else:
+                h = layer(h)
+        return self.final_ln(h)
+
+
+class GPTForCausalLM(nn.Layer):
+    """LM head ties the (vocab-parallel) embedding table; logits are
+    tp-sharded on the vocab dim — ParallelCrossEntropy consumes them without
+    an AllGather of the [b, s, vocab] tensor."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        if not config.tie_word_embeddings:
+            self.lm_head_weight = self.create_parameter(
+                shape=[config.hidden_size, config.vocab_size],
+                default_initializer=I.Normal(0.0, config.initializer_range))
+            from paddle_tpu.distributed.mesh import shard_tensor
+            shard_tensor(self.lm_head_weight, None, "tp")
+
+    def forward(self, input_ids, position_ids=None):
+        h = self.gpt(input_ids, position_ids)
+        if self.config.tie_word_embeddings:
+            w = self.gpt.embeddings.word_embeddings.weight
+            logits = paddle_tpu.matmul(h, w, transpose_y=True)
+        else:
+            logits = paddle_tpu.matmul(h, self.lm_head_weight)
+        return _constrain(logits, "dp", "sp", "tp")
+
+
+class GPTPretrainingCriterion(nn.Layer):
+    """Masked LM loss (reference: PaddleNLP GPTPretrainingCriterion —
+    ParallelCrossEntropy when mp_degree>1; here the vocab-sharded logits make
+    the same softmax tp-parallel via sharding propagation)."""
+
+    def __init__(self):
+        super().__init__()
+
+    def forward(self, logits, labels, loss_mask=None):
+        loss = F.cross_entropy(logits, labels, reduction="none")
+        if loss_mask is not None:
+            mask = loss_mask.reshape(loss.shape).astype(loss.dtype)
+            return (loss * mask).sum() / mask.sum().clip(min=1.0)
+        return loss.mean()

@@ -11,9 +11,10 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.dispatch import apply
+from paddle_tpu.framework.state import next_key
 
 
-def _sdpa_ref(q, k, v, mask, dropout_p, causal, scale):
+def _sdpa_ref(q, k, v, mask, dropout_p, causal, scale, dropout_key=None):
     # q, k, v: [batch, seq, heads, head_dim] (paddle layout)
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
@@ -28,6 +29,10 @@ def _sdpa_ref(q, k, v, mask, dropout_p, causal, scale):
         else:
             logits = logits + mask
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_key is not None and dropout_p > 0.0:
+        keep = jax.random.bernoulli(
+            dropout_key, 1.0 - dropout_p, probs.shape).astype(probs.dtype)
+        probs = probs * keep / (1.0 - dropout_p)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
@@ -35,7 +40,8 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False, training=True,
                                  scale=None, name=None):
     """query/key/value: [batch, seq, num_heads, head_dim] (paddle convention)."""
-    use_flash = attn_mask is None and dropout_p == 0.0
+    apply_dropout = dropout_p > 0.0 and training
+    use_flash = attn_mask is None and not apply_dropout
     if use_flash:
         try:
             from paddle_tpu.ops.pallas.flash_attention import flash_attention_bshd
@@ -45,7 +51,9 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         except Exception:
             pass
     def fn(q, k, v, m):
-        return _sdpa_ref(q, k, v, m, dropout_p, is_causal, scale)
+        key_ = next_key() if apply_dropout else None
+        return _sdpa_ref(q, k, v, m, dropout_p if apply_dropout else 0.0,
+                         is_causal, scale, dropout_key=key_)
     return apply(fn, query, key, value, attn_mask)
 
 
