@@ -1,0 +1,74 @@
+"""Headline benchmark: ResNet-50 training throughput (images/sec/chip).
+
+Baseline (SURVEY.md §6 / BASELINE.json): PaddleClas ResNet-50 on A100 fp16
+≈ 800-1000 img/s; TPU v5e target ≥ 1000 img/s bf16, batch 256, to_static path.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 1000.0
+
+
+def main():
+    import jax
+
+    on_tpu = any(d.platform not in ("cpu",) for d in jax.devices())
+    if not on_tpu:
+        # CPU fallback keeps the pipeline testable without a chip
+        batch, warmup, iters = 16, 1, 3
+    else:
+        batch, warmup, iters = 256, 3, 10
+
+    import paddle_tpu as P
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.vision.models import resnet50
+
+    P.seed(0)
+    model = resnet50(num_classes=1000)
+    opt = P.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                               parameters=model.parameters())
+
+    @P.jit.to_static
+    def train_step(x, y):
+        opt.clear_grad()
+        with P.amp.auto_cast(level="O1", dtype="bfloat16"):
+            logits = model(x)
+        loss = F.cross_entropy(logits, y)
+        loss.backward()
+        opt.step()
+        return loss
+
+    rng = np.random.default_rng(0)
+    x = P.to_tensor(
+        rng.standard_normal((batch, 3, 224, 224)).astype(np.float32))
+    y = P.to_tensor(rng.integers(0, 1000, (batch,)), dtype="int64")
+
+    for _ in range(warmup):
+        loss = train_step(x, y)
+    jax.block_until_ready(loss._value)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = train_step(x, y)
+    jax.block_until_ready(loss._value)
+    dt = time.perf_counter() - t0
+
+    img_s = batch * iters / dt
+    print(json.dumps({
+        "metric": "resnet50_train_throughput",
+        "value": round(img_s, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

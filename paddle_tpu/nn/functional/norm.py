@@ -68,6 +68,16 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=
         normalized_shape = (normalized_shape,)
     nd = len(tuple(normalized_shape))
 
+    if nd == 1:
+        # last-axis layernorm: fused Pallas kernel on TPU (custom VJP)
+        try:
+            from paddle_tpu.ops.pallas.norm import _on_tpu, fused_layer_norm
+            if _on_tpu():
+                return apply(lambda v, w, b: fused_layer_norm(
+                    v, w, b, epsilon), x, weight, bias)
+        except Exception:
+            pass
+
     def fn(v, w, b):
         axes = tuple(range(v.ndim - nd, v.ndim))
         mean = jnp.mean(v, axis=axes, keepdims=True)
@@ -146,6 +156,13 @@ def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
 
 def rms_norm(x, weight=None, epsilon=1e-6, name=None):
     """RMSNorm (TPU-friendly LLM building block; also via pallas kernel)."""
+    try:
+        from paddle_tpu.ops.pallas.norm import _on_tpu, fused_rms_norm
+        if _on_tpu():
+            return apply(lambda v, w: fused_rms_norm(v, w, epsilon), x, weight)
+    except Exception:
+        pass
+
     def fn(v, w):
         ms = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=-1, keepdims=True)
         out = (v.astype(jnp.float32) / jnp.sqrt(ms + epsilon)).astype(v.dtype)

@@ -1,0 +1,7 @@
+"""TPU kernel layer: Pallas kernels for the hot ops.
+
+This package is the TPU-native replacement for the reference's C++ kernel
+library (reference: paddle/phi/kernels/ — per-op CUDA kernels). Only the ops
+where a hand-written kernel beats XLA fusion live here; everything else is
+jnp/lax and left to XLA.
+"""

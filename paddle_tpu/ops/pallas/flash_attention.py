@@ -1,0 +1,339 @@
+"""Flash attention for TPU (Pallas), forward + custom-VJP backward.
+
+Reference parity: the reference exposes fused attention via
+paddle.incubate.nn.functional.fused_attention / flash-attn CUDA kernels
+(paddle/phi/kernels/gpu/flash_attn_kernel.cu in later branches). TPU-native
+design: an online-softmax kernel tiled for the MXU — q blocks stream through
+VMEM while k/v live in VMEM per (batch, head); fp32 accumulators; causal
+blocks above the diagonal are skipped entirely (not masked), so causal
+attention does ~half the FLOPs.
+
+Layouts: public entry `flash_attention_bshd` takes paddle's [batch, seq,
+heads, head_dim]; kernels run in [batch, heads, seq, head_dim].
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only imports on TPU builds; interpret mode works without it
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_NEG_INF = -1e30
+
+
+def _vmem_spec(*args, **kwargs):
+    if _VMEM is not None:
+        kwargs["memory_space"] = _VMEM
+    return pl.BlockSpec(*args, **kwargs)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_q, block_k, seq_k, seq_k_padded):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale          # [bq, d]
+    bq, d = q.shape
+
+    num_kb = seq_k_padded // block_k
+    if causal:
+        # last k block whose start is <= this q block's end
+        num_kb = jax.lax.min(num_kb, (qi + 1) * block_q // block_k +
+                             (1 if block_q % block_k else 0))
+
+    def body(kb, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [bq,bk]
+        col = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        mask = col < seq_k
+        if causal:
+            row = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            mask = jnp.logical_and(mask, col <= row)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, num_kb, body, (acc0, m0, l0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l_safe))[:, 0]
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+                   scale, causal, block_q, block_k, seq_k, seq_k_padded):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, None]
+    delta = delta_ref[0][:, None]
+    bq, d = q.shape
+
+    num_kb = seq_k_padded // block_k
+    if causal:
+        num_kb = jax.lax.min(num_kb, (qi + 1) * block_q // block_k +
+                             (1 if block_q % block_k else 0))
+
+    def body(kb, dq):
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+        col = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        mask = col < seq_k
+        if causal:
+            row = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            mask = jnp.logical_and(mask, col <= row)
+        s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse)                                   # [bq,bk]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, num_kb,
+                           body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, causal, block_q, block_k,
+                    seq_q, seq_q_padded, seq_k):
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)                   # [bk, d]
+    v = v_ref[0].astype(jnp.float32)
+    bk, d = k.shape
+
+    num_qb = seq_q_padded // block_q
+    start_qb = 0
+    if causal:
+        start_qb = ki * block_k // block_q
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qb * block_q, block_q)][:, None]
+        delta = delta_ref[0, pl.ds(qb * block_q, block_q)][:, None]
+        s = scale * jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+        row = qb * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, bk), 0)
+        col = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, bk), 1)
+        mask = jnp.logical_and(row < seq_q, col < seq_k)
+        if causal:
+            mask = jnp.logical_and(mask, col <= row)
+        s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse)                                   # [bq?,bk]
+        dv_new = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk_new = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    z = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start_qb, num_qb, body, (z, z))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _pad_to(x, mult, axis):
+    n = x.shape[axis]
+    pad = (-n) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_bhsd(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, max(sq, 8))
+    block_k = min(block_k, max(sk, 8))
+    qp = _pad_to(q, block_q, 2)
+    kp = _pad_to(k, block_k, 2)
+    vp = _pad_to(v, block_k, 2)
+    sqp, skp = qp.shape[2], kp.shape[2]
+    qp = qp.reshape(b * h, sqp, d)
+    kp = kp.reshape(b * h, skp, d)
+    vp = vp.reshape(b * h, skp, d)
+
+    grid = (b * h, sqp // block_q)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, seq_k=sk, seq_k_padded=skp)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            _vmem_spec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            _vmem_spec((1, skp, d), lambda bh, qi: (bh, 0, 0)),
+            _vmem_spec((1, skp, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            _vmem_spec((1, block_q), lambda bh, qi: (bh, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sqp, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sqp), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    o = o.reshape(b, h, sqp, d)[:, :, :sq, :]
+    lse = lse.reshape(b, h, sqp)[:, :, :sq]
+    return o, lse
+
+
+def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, max(sq, 8))
+    block_k = min(block_k, max(sk, 8))
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                                    # [b,h,sq]
+
+    qp = _pad_to(q, block_q, 2).reshape(b * h, -1, d)
+    dop = _pad_to(do, block_q, 2).reshape(b * h, -1, d)
+    lsep = _pad_to(lse, block_q, 2).reshape(b * h, -1)
+    deltap = _pad_to(delta, block_q, 2).reshape(b * h, -1)
+    kp = _pad_to(k, block_k, 2).reshape(b * h, -1, d)
+    vp = _pad_to(v, block_k, 2).reshape(b * h, -1, d)
+    sqp, skp = qp.shape[1], kp.shape[1]
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, seq_k=sk, seq_k_padded=skp)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b * h, sqp // block_q),
+        in_specs=[
+            _vmem_spec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            _vmem_spec((1, skp, d), lambda bh, qi: (bh, 0, 0)),
+            _vmem_spec((1, skp, d), lambda bh, qi: (bh, 0, 0)),
+            _vmem_spec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            _vmem_spec((1, block_q), lambda bh, qi: (bh, qi)),
+            _vmem_spec((1, block_q), lambda bh, qi: (bh, qi)),
+        ],
+        out_specs=_vmem_spec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sqp, d), q.dtype),
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, deltap)
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, seq_q=sq, seq_q_padded=sqp, seq_k=sk)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b * h, skp // block_k),
+        in_specs=[
+            _vmem_spec((1, sqp, d), lambda bh, ki: (bh, 0, 0)),
+            _vmem_spec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            _vmem_spec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            _vmem_spec((1, sqp, d), lambda bh, ki: (bh, 0, 0)),
+            _vmem_spec((1, sqp), lambda bh, ki: (bh, 0)),
+            _vmem_spec((1, sqp), lambda bh, ki: (bh, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            _vmem_spec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, skp, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, skp, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, deltap)
+
+    dq = dq.reshape(b, h, sqp, d)[:, :, :sq, :]
+    dk = dk.reshape(b, h, skp, d)[:, :, :sk, :]
+    dv = dv.reshape(b, h, skp, d)[:, :, :sk, :]
+    return dq, dk, dv
+
+
+_flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def _on_tpu():
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def flash_attention_bshd(q, k, v, causal=False, scale=None, block_q=None,
+                         block_k=None, interpret=None):
+    """Flash attention on [batch, seq, heads, head_dim] inputs (paddle
+    layout). Differentiable (custom VJP). Raises on CPU unless
+    `interpret=True` — callers fall back to the XLA sdpa path."""
+    if interpret is None:
+        interpret = False
+        if not _on_tpu():
+            raise NotImplementedError(
+                "pallas flash attention requires TPU (or interpret=True)")
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    scale = float(scale)
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    o = _flash_bhsd(qt, kt, vt, bool(causal), scale,
+                    block_q or DEFAULT_BLOCK_Q, block_k or DEFAULT_BLOCK_K,
+                    bool(interpret))
+    return jnp.transpose(o, (0, 2, 1, 3))
+
+
+def flash_attention_bhsd(q, k, v, causal=False, scale=None, **kw):
+    """Same kernel on [batch, heads, seq, head_dim] inputs."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    kw.setdefault("interpret", not _on_tpu())
+    return _flash_bhsd(q, k, v, bool(causal), float(scale),
+                       kw.get("block_q") or DEFAULT_BLOCK_Q,
+                       kw.get("block_k") or DEFAULT_BLOCK_K,
+                       bool(kw["interpret"]))
