@@ -5,7 +5,26 @@ reference framework's fleet meta-parallel layers
 (python/paddle/distributed/fleet/meta_parallel/parallel_layers/mp_layers.py);
 here each model is built TPU-first on paddle_tpu's mesh-sharded layers.
 """
-from paddle_tpu.models import gpt  # noqa: F401
+from paddle_tpu.models import bert, ernie, gpt, vit  # noqa: F401
+from paddle_tpu.models.bert import (  # noqa: F401
+    BertConfig,
+    BertForPretraining,
+    BertForSequenceClassification,
+    BertModel,
+    BertPretrainingCriterion,
+    bert_base,
+    bert_large,
+    bert_tiny,
+)
+from paddle_tpu.models.ernie import (  # noqa: F401
+    ErnieConfig,
+    ErnieForPretraining,
+    ErnieForSequenceClassification,
+    ErnieModel,
+    ernie_3_0_base,
+    ernie_3_0_medium,
+    ernie_tiny,
+)
 from paddle_tpu.models.gpt import (  # noqa: F401
     GPTConfig,
     GPTForCausalLM,
@@ -13,4 +32,12 @@ from paddle_tpu.models.gpt import (  # noqa: F401
     GPTPretrainingCriterion,
     gpt3_1p3b,
     gpt3_tiny,
+)
+from paddle_tpu.models.vit import (  # noqa: F401
+    ViT,
+    ViTConfig,
+    VisionTransformer,
+    vit_b_16,
+    vit_l_16,
+    vit_tiny,
 )

@@ -1,0 +1,236 @@
+"""BERT-base encoder family (and the ERNIE-3.0 variant in ernie.py).
+
+Reference parity: PaddleNLP BertModel/BertForPretraining built on the
+reference framework (nn.TransformerEncoder — reference:
+python/paddle/nn/layer/transformer.py:900+). TPU-native: mesh-sharded
+attention/ffn (tp), batch→dp / seq→sp activation shardings, flash-attention
+fast path, bf16-friendly (fp32 layernorm accumulators inside the fused
+kernel).
+"""
+from __future__ import annotations
+
+import paddle_tpu
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    _constrain,
+)
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768, num_layers=12,
+                 num_heads=12, ffn_hidden_size=None, max_position=512,
+                 type_vocab_size=2, dropout=0.1, attention_dropout=0.1,
+                 initializer_range=0.02, layer_norm_epsilon=1e-12,
+                 pad_token_id=0):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.ffn_hidden_size = ffn_hidden_size or 4 * hidden_size
+        self.max_position = max_position
+        self.type_vocab_size = type_vocab_size
+        self.dropout = dropout
+        self.attention_dropout = attention_dropout
+        self.initializer_range = initializer_range
+        self.layer_norm_epsilon = layer_norm_epsilon
+        self.pad_token_id = pad_token_id
+
+
+def bert_base(**kw):
+    return BertConfig(**kw)
+
+
+def bert_large(**kw):
+    cfg = dict(hidden_size=1024, num_layers=24, num_heads=16)
+    cfg.update(kw)
+    return BertConfig(**cfg)
+
+
+def bert_tiny(**kw):
+    cfg = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+               max_position=128, dropout=0.0, attention_dropout=0.0)
+    cfg.update(kw)
+    return BertConfig(**cfg)
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        init = I.ParamAttr(initializer=I.Normal(0.0, config.initializer_range))
+        self.word_embeddings = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size, weight_attr=init)
+        self.position_embeddings = nn.Embedding(
+            config.max_position, config.hidden_size, weight_attr=init)
+        self.token_type_embeddings = nn.Embedding(
+            config.type_vocab_size, config.hidden_size, weight_attr=init)
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       epsilon=config.layer_norm_epsilon)
+        self.dropout = nn.Dropout(config.dropout)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        s = input_ids.shape[-1]
+        if position_ids is None:
+            position_ids = paddle_tpu.arange(s, dtype="int64")
+        if token_type_ids is None:
+            token_type_ids = paddle_tpu.zeros_like(input_ids)
+        h = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        h = _constrain(h, "dp", "sp", None)
+        return self.dropout(self.layer_norm(h))
+
+
+class BertSelfAttention(nn.Layer):
+    """Bidirectional attention; same tp head-sharded layout as GPTAttention."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.num_heads = config.num_heads
+        self.head_dim = config.hidden_size // config.num_heads
+        init = I.ParamAttr(initializer=I.Normal(0.0, config.initializer_range))
+        self.qkv_proj = ColumnParallelLinear(
+            config.hidden_size, 3 * config.hidden_size, weight_attr=init,
+            gather_output=False)
+        self.out_proj = RowParallelLinear(
+            config.hidden_size, config.hidden_size, weight_attr=init,
+            input_is_parallel=True)
+        self.attn_dropout_p = config.attention_dropout
+
+    def forward(self, hidden, attn_mask=None):
+        b, s = hidden.shape[0], hidden.shape[1]
+        qkv = self.qkv_proj(hidden)
+        qkv = qkv.reshape([b, s, self.num_heads, 3 * self.head_dim])
+        qkv = _constrain(qkv, "dp", "sp", "tp", None)
+        q, k, v = qkv.split(3, axis=-1)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self.attn_dropout_p if self.training else 0.0,
+            training=self.training)
+        out = out.reshape([b, s, self.num_heads * self.head_dim])
+        return self.out_proj(out)
+
+
+class BertLayer(nn.Layer):
+    """Post-LN encoder block (original BERT residual placement)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        init = I.ParamAttr(initializer=I.Normal(0.0, config.initializer_range))
+        self.attention = BertSelfAttention(config)
+        self.ln1 = nn.LayerNorm(config.hidden_size,
+                                epsilon=config.layer_norm_epsilon)
+        self.fc1 = ColumnParallelLinear(
+            config.hidden_size, config.ffn_hidden_size, weight_attr=init,
+            gather_output=False)
+        self.fc2 = RowParallelLinear(
+            config.ffn_hidden_size, config.hidden_size, weight_attr=init,
+            input_is_parallel=True)
+        self.ln2 = nn.LayerNorm(config.hidden_size,
+                                epsilon=config.layer_norm_epsilon)
+        self.dropout = nn.Dropout(config.dropout)
+
+    def forward(self, x, attn_mask=None):
+        x = self.ln1(x + self.dropout(self.attention(x, attn_mask)))
+        x = self.ln2(x + self.dropout(self.fc2(F.gelu(self.fc1(x)))))
+        return _constrain(x, "dp", "sp", None)
+
+
+class BertPooler(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.dense = nn.Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, hidden):
+        return paddle_tpu.tanh(self.dense(hidden[:, 0]))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.encoder = nn.LayerList(
+            [BertLayer(config) for _ in range(config.num_layers)])
+        self.pooler = BertPooler(config)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        if attention_mask is not None and len(attention_mask.shape) == 2:
+            # [b, s] padding mask -> additive [b, 1, 1, s] logits bias
+            m = (1.0 - attention_mask.astype("float32")) * -1e4
+            attention_mask = m.unsqueeze(1).unsqueeze(2)
+        h = self.embeddings(input_ids, token_type_ids, position_ids)
+        for layer in self.encoder:
+            h = layer(h, attention_mask)
+        return h, self.pooler(h)
+
+
+class BertLMHead(nn.Layer):
+    def __init__(self, config: BertConfig, embedding_weight):
+        super().__init__()
+        self.transform = nn.Linear(config.hidden_size, config.hidden_size)
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       epsilon=config.layer_norm_epsilon)
+        self.decoder_weight = embedding_weight  # tied [vocab, hidden]
+        self.decoder_bias = self.create_parameter(
+            shape=[config.vocab_size], is_bias=True)
+
+    def forward(self, h):
+        h = self.layer_norm(F.gelu(self.transform(h)))
+        return paddle_tpu.matmul(h, self.decoder_weight,
+                                 transpose_y=True) + self.decoder_bias
+
+
+class BertForPretraining(nn.Layer):
+    """MLM + NSP heads (reference: PaddleNLP BertForPretraining)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.cls = BertLMHead(
+            config, self.bert.embeddings.word_embeddings.weight)
+        self.nsp = nn.Linear(config.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        h, pooled = self.bert(input_ids, token_type_ids,
+                              attention_mask=attention_mask)
+        return self.cls(h), self.nsp(pooled)
+
+
+class BertForSequenceClassification(nn.Layer):
+    def __init__(self, config: BertConfig, num_classes=2):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = nn.Dropout(config.dropout)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids,
+                              attention_mask=attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+class BertPretrainingCriterion(nn.Layer):
+    def __init__(self, vocab_size):
+        super().__init__()
+        self.vocab_size = vocab_size
+
+    def forward(self, prediction_scores, seq_relationship_score,
+                masked_lm_labels, next_sentence_labels=None,
+                masked_lm_weights=None):
+        mlm = F.cross_entropy(prediction_scores, masked_lm_labels,
+                              reduction="none", ignore_index=-100)
+        if masked_lm_weights is not None:
+            w = masked_lm_weights.reshape(mlm.shape).astype(mlm.dtype)
+            mlm = (mlm * w).sum() / w.sum().clip(min=1.0)
+        else:
+            mlm = mlm.mean()
+        if next_sentence_labels is None:
+            return mlm
+        nsp = F.cross_entropy(seq_relationship_score, next_sentence_labels)
+        return mlm + nsp

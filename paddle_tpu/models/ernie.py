@@ -1,0 +1,102 @@
+"""ERNIE-3.0 (BASELINE.json parity target: ERNIE-3.0 pretraining tokens/s).
+
+Reference parity: PaddleNLP ErnieModel — architecturally a BERT-style
+encoder with task/type embeddings and shared underlying layers; the
+framework-level machinery (fleet DP allreduce → XLA dp-psum, AMP, to_static)
+is identical to bert.py, so ERNIE shares the Bert building blocks here, same
+as PaddleNLP shares its TransformerEncoder.
+"""
+from __future__ import annotations
+
+from paddle_tpu import nn
+from paddle_tpu.models.bert import (
+    BertConfig,
+    BertLayer,
+    BertLMHead,
+    BertModel,
+    BertPooler,
+    BertPretrainingCriterion,
+)
+from paddle_tpu.nn import functional as F
+
+
+class ErnieConfig(BertConfig):
+    def __init__(self, task_type_vocab_size=3, use_task_id=True, **kw):
+        kw.setdefault("vocab_size", 40000)
+        kw.setdefault("layer_norm_epsilon", 1e-5)
+        super().__init__(**kw)
+        self.task_type_vocab_size = task_type_vocab_size
+        self.use_task_id = use_task_id
+
+
+def ernie_3_0_base(**kw):
+    cfg = dict(hidden_size=768, num_layers=12, num_heads=12)
+    cfg.update(kw)
+    return ErnieConfig(**cfg)
+
+
+def ernie_3_0_medium(**kw):
+    cfg = dict(hidden_size=768, num_layers=6, num_heads=12)
+    cfg.update(kw)
+    return ErnieConfig(**cfg)
+
+
+def ernie_tiny(**kw):
+    cfg = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+               max_position=128, dropout=0.0, attention_dropout=0.0)
+    cfg.update(kw)
+    return ErnieConfig(**cfg)
+
+
+class ErnieModel(BertModel):
+    """BERT encoder + task-type embedding (ERNIE-3.0 universal
+    representation)."""
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__(config)
+        if config.use_task_id:
+            self.task_type_embeddings = nn.Embedding(
+                config.task_type_vocab_size, config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, task_type_ids=None):
+        if attention_mask is not None and len(attention_mask.shape) == 2:
+            m = (1.0 - attention_mask.astype("float32")) * -1e4
+            attention_mask = m.unsqueeze(1).unsqueeze(2)
+        h = self.embeddings(input_ids, token_type_ids, position_ids)
+        if self.config.use_task_id and task_type_ids is not None:
+            h = h + self.task_type_embeddings(task_type_ids)
+        for layer in self.encoder:
+            h = layer(h, attention_mask)
+        return h, self.pooler(h)
+
+
+class ErnieForPretraining(nn.Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.cls = BertLMHead(
+            config, self.ernie.embeddings.word_embeddings.weight)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                task_type_ids=None):
+        h, _ = self.ernie(input_ids, token_type_ids,
+                          attention_mask=attention_mask,
+                          task_type_ids=task_type_ids)
+        return self.cls(h)
+
+
+class ErnieForSequenceClassification(nn.Layer):
+    def __init__(self, config: ErnieConfig, num_classes=2):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.dropout = nn.Dropout(config.dropout)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.ernie(input_ids, token_type_ids,
+                               attention_mask=attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+ErniePretrainingCriterion = BertPretrainingCriterion

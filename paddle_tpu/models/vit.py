@@ -1,0 +1,136 @@
+"""Vision Transformer (ViT-B parity target from BASELINE.json configs).
+
+Reference parity: PaddleClas ViT (ppcls/arch/backbone/model_zoo/
+vision_transformer.py in the PaddleClas zoo) built on the reference
+framework. TPU-native: patchify as a single conv (MXU), encoder blocks share
+the tp/sp-sharded attention+ffn design, class-token pooling.
+"""
+from __future__ import annotations
+
+import paddle_tpu
+from paddle_tpu.distributed.fleet.meta_parallel import _constrain
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+
+
+class ViTConfig:
+    def __init__(self, image_size=224, patch_size=16, in_channels=3,
+                 hidden_size=768, num_layers=12, num_heads=12,
+                 ffn_hidden_size=None, num_classes=1000, dropout=0.0,
+                 attention_dropout=0.0, drop_path=0.0,
+                 layer_norm_epsilon=1e-6, representation_size=None):
+        self.image_size = image_size
+        self.patch_size = patch_size
+        self.in_channels = in_channels
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.ffn_hidden_size = ffn_hidden_size or 4 * hidden_size
+        self.num_classes = num_classes
+        self.dropout = dropout
+        self.attention_dropout = attention_dropout
+        self.drop_path = drop_path
+        self.layer_norm_epsilon = layer_norm_epsilon
+        self.representation_size = representation_size
+        self.num_patches = (image_size // patch_size) ** 2
+
+
+def vit_b_16(**kw):
+    return ViTConfig(**kw)
+
+
+def vit_l_16(**kw):
+    cfg = dict(hidden_size=1024, num_layers=24, num_heads=16)
+    cfg.update(kw)
+    return ViTConfig(**cfg)
+
+
+def vit_tiny(**kw):
+    cfg = dict(image_size=32, patch_size=8, hidden_size=64, num_layers=2,
+               num_heads=4, num_classes=10)
+    cfg.update(kw)
+    return ViTConfig(**cfg)
+
+
+class PatchEmbed(nn.Layer):
+    def __init__(self, config: ViTConfig):
+        super().__init__()
+        self.proj = nn.Conv2D(config.in_channels, config.hidden_size,
+                              kernel_size=config.patch_size,
+                              stride=config.patch_size)
+
+    def forward(self, x):
+        x = self.proj(x)                       # [b, hid, gh, gw]
+        b, c = x.shape[0], x.shape[1]
+        return x.reshape([b, c, -1]).transpose([0, 2, 1])   # [b, n, hid]
+
+
+class ViTBlock(nn.Layer):
+    """Pre-LN encoder block (same residual form as GPT, bidirectional)."""
+
+    def __init__(self, config: ViTConfig):
+        super().__init__()
+        self.num_heads = config.num_heads
+        self.head_dim = config.hidden_size // config.num_heads
+        self.ln1 = nn.LayerNorm(config.hidden_size,
+                                epsilon=config.layer_norm_epsilon)
+        self.qkv = nn.Linear(config.hidden_size, 3 * config.hidden_size)
+        self.proj = nn.Linear(config.hidden_size, config.hidden_size)
+        self.ln2 = nn.LayerNorm(config.hidden_size,
+                                epsilon=config.layer_norm_epsilon)
+        self.fc1 = nn.Linear(config.hidden_size, config.ffn_hidden_size)
+        self.fc2 = nn.Linear(config.ffn_hidden_size, config.hidden_size)
+        self.dropout = nn.Dropout(config.dropout)
+        self.attn_dropout_p = config.attention_dropout
+
+    def forward(self, x):
+        b, n = x.shape[0], x.shape[1]
+        h = self.ln1(x)
+        qkv = self.qkv(h).reshape([b, n, self.num_heads, 3 * self.head_dim])
+        q, k, v = qkv.split(3, axis=-1)
+        attn = F.scaled_dot_product_attention(
+            q, k, v,
+            dropout_p=self.attn_dropout_p if self.training else 0.0,
+            training=self.training)
+        attn = attn.reshape([b, n, self.num_heads * self.head_dim])
+        x = x + self.dropout(self.proj(attn))
+        x = x + self.dropout(self.fc2(F.gelu(self.fc1(self.ln2(x)),
+                                             approximate=True)))
+        return _constrain(x, "dp", None, None)
+
+
+class VisionTransformer(nn.Layer):
+    def __init__(self, config: ViTConfig):
+        super().__init__()
+        self.config = config
+        self.patch_embed = PatchEmbed(config)
+        self.cls_token = self.create_parameter(
+            shape=[1, 1, config.hidden_size],
+            default_initializer=I.TruncatedNormal(std=0.02))
+        self.pos_embed = self.create_parameter(
+            shape=[1, config.num_patches + 1, config.hidden_size],
+            default_initializer=I.TruncatedNormal(std=0.02))
+        self.pos_drop = nn.Dropout(config.dropout)
+        self.blocks = nn.LayerList(
+            [ViTBlock(config) for _ in range(config.num_layers)])
+        self.norm = nn.LayerNorm(config.hidden_size,
+                                 epsilon=config.layer_norm_epsilon)
+        self.head = nn.Linear(config.hidden_size, config.num_classes) \
+            if config.num_classes > 0 else None
+
+    def forward(self, x):
+        x = self.patch_embed(x)
+        b = x.shape[0]
+        cls = self.cls_token.expand([b, 1, self.config.hidden_size])
+        x = paddle_tpu.concat([cls, x], axis=1) + self.pos_embed
+        x = self.pos_drop(x)
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.norm(x)
+        if self.head is None:
+            return x
+        return self.head(x[:, 0])
+
+
+ViT = VisionTransformer

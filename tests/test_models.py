@@ -1,0 +1,122 @@
+"""Model-family smoke tests: BERT, ERNIE, ViT (GPT covered in test_gpt.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu.models import (BertForPretraining, BertForSequenceClassification,
+                               BertPretrainingCriterion, ErnieForPretraining,
+                               VisionTransformer, bert_tiny, ernie_tiny,
+                               vit_tiny)
+
+
+def _ids(cfg, b=2, s=32, seed=0):
+    rng = np.random.default_rng(seed)
+    return P.to_tensor(rng.integers(0, cfg.vocab_size, (b, s)), dtype="int64")
+
+
+class TestBert:
+    def test_pretraining_forward_backward(self):
+        P.seed(0)
+        cfg = bert_tiny()
+        model = BertForPretraining(cfg)
+        crit = BertPretrainingCriterion(cfg.vocab_size)
+        ids = _ids(cfg)
+        labels = _ids(cfg, seed=1)
+        nsp_labels = P.to_tensor(np.array([0, 1]), dtype="int64")
+        scores, nsp = model(ids)
+        assert scores.shape == [2, 32, cfg.vocab_size]
+        assert nsp.shape == [2, 2]
+        loss = crit(scores, nsp, labels, nsp_labels)
+        assert np.isfinite(float(loss))
+        loss.backward()
+        for name, p in model.named_parameters():
+            assert p.grad is not None, name
+
+    def test_padding_mask_ignores_padded_tokens(self):
+        P.seed(0)
+        cfg = bert_tiny()
+        model = BertForSequenceClassification(cfg, num_classes=3)
+        model.eval()
+        ids = _ids(cfg, b=1, s=16)
+        mask = P.to_tensor(np.concatenate(
+            [np.ones((1, 8)), np.zeros((1, 8))], axis=1), dtype="int64")
+        base = model(ids, attention_mask=mask).numpy()
+        # mutate only padded-out positions -> pooled output must not change
+        ids2 = ids.numpy().copy()
+        ids2[0, 8:] = (ids2[0, 8:] + 1) % cfg.vocab_size
+        out2 = model(P.to_tensor(ids2, dtype="int64"),
+                     attention_mask=mask).numpy()
+        np.testing.assert_allclose(base, out2, atol=1e-5)
+
+    def test_to_static_training_step(self):
+        P.seed(0)
+        cfg = bert_tiny()
+        model = BertForPretraining(cfg)
+        crit = BertPretrainingCriterion(cfg.vocab_size)
+        opt = P.optimizer.AdamW(learning_rate=1e-3,
+                                parameters=model.parameters())
+
+        @P.jit.to_static
+        def step(ids, labels):
+            opt.clear_grad()
+            scores, _ = model(ids)
+            loss = crit(scores, None, labels)
+            loss.backward()
+            opt.step()
+            return loss
+
+        ids, labels = _ids(cfg), _ids(cfg, seed=1)
+        l0 = float(step(ids, labels))
+        l1 = float(step(ids, labels))
+        assert l1 < l0
+
+
+class TestErnie:
+    def test_pretraining_with_task_ids(self):
+        P.seed(0)
+        cfg = ernie_tiny()
+        model = ErnieForPretraining(cfg)
+        ids = _ids(cfg)
+        task_ids = P.zeros_like(ids)
+        scores = model(ids, task_type_ids=task_ids)
+        assert scores.shape == [2, 32, cfg.vocab_size]
+        loss = P.nn.functional.cross_entropy(scores, _ids(cfg, seed=1))
+        loss.backward()
+        assert model.ernie.task_type_embeddings.weight.grad is not None
+
+
+class TestViT:
+    def test_forward_backward(self):
+        P.seed(0)
+        cfg = vit_tiny()
+        model = VisionTransformer(cfg)
+        x = P.to_tensor(np.random.default_rng(0).standard_normal(
+            (2, 3, 32, 32)).astype(np.float32))
+        logits = model(x)
+        assert logits.shape == [2, 10]
+        loss = P.nn.functional.cross_entropy(
+            logits, P.to_tensor(np.array([1, 2]), dtype="int64"))
+        loss.backward()
+        assert model.cls_token.grad is not None
+        assert model.patch_embed.proj.weight.grad is not None
+
+    def test_train_step_decreases_loss(self):
+        P.seed(0)
+        cfg = vit_tiny()
+        model = VisionTransformer(cfg)
+        opt = P.optimizer.AdamW(learning_rate=1e-3,
+                                parameters=model.parameters())
+        x = P.to_tensor(np.random.default_rng(0).standard_normal(
+            (4, 3, 32, 32)).astype(np.float32))
+        y = P.to_tensor(np.array([0, 1, 2, 3]), dtype="int64")
+
+        @P.jit.to_static
+        def step(x, y):
+            opt.clear_grad()
+            loss = P.nn.functional.cross_entropy(model(x), y)
+            loss.backward()
+            opt.step()
+            return loss
+
+        losses = [float(step(x, y)) for _ in range(5)]
+        assert losses[-1] < losses[0]
