@@ -1,1 +1,26 @@
 """Pallas TPU kernels (flash attention, fused norms, ring attention)."""
+from __future__ import annotations
+
+import jax
+
+
+def compute_platform() -> str:
+    """Platform the computation will actually run on: the installed mesh's
+    devices if any (a CPU mesh can be active while the default backend is a
+    real TPU chip — e.g. the driver's virtual-device dryrun), else the
+    default backend."""
+    try:
+        from paddle_tpu.distributed.mesh import get_mesh
+        m = get_mesh()
+        if m is not None:
+            return m.devices.flat[0].platform
+    except Exception:  # pragma: no cover
+        pass
+    try:
+        return jax.devices()[0].platform
+    except Exception:  # pragma: no cover
+        return "cpu"
+
+
+def on_tpu() -> bool:
+    return compute_platform() == "tpu"

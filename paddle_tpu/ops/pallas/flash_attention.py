@@ -300,10 +300,8 @@ _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 def _on_tpu():
-    try:
-        return jax.devices()[0].platform == "tpu"
-    except Exception:  # pragma: no cover
-        return False
+    from paddle_tpu.ops.pallas import on_tpu
+    return on_tpu()
 
 
 def flash_attention_bshd(q, k, v, causal=False, scale=None, block_q=None,

@@ -31,10 +31,8 @@ def _vmem_spec(*args, **kwargs):
 
 
 def _on_tpu():
-    try:
-        return jax.devices()[0].platform == "tpu"
-    except Exception:  # pragma: no cover
-        return False
+    from paddle_tpu.ops.pallas import on_tpu
+    return on_tpu()
 
 
 def _ln_kernel(x_ref, w_ref, b_ref, o_ref, *, eps, has_affine):
