@@ -52,15 +52,14 @@ def main():
 
     for _ in range(warmup):
         loss = train_step(x, y)
-    float(loss)  # device→host transfer: the only reliable sync on the
-    # tunneled TPU platform, where block_until_ready returns early
+    loss.block_until_ready()
 
     t0 = time.perf_counter()
     for _ in range(iters):
         loss = train_step(x, y)
     # the final loss is serially dependent on every step (params chain
-    # through the optimizer), so fetching it waits for the whole run
-    float(loss)
+    # through the optimizer), so syncing on it waits for the whole run
+    loss.block_until_ready()
     dt = time.perf_counter() - t0
 
     img_s = batch * iters / dt

@@ -28,6 +28,24 @@ def _is_diff_dtype(dt) -> bool:
     return jnp.issubdtype(dt, jnp.floating) or jnp.issubdtype(dt, jnp.complexfloating)
 
 
+def sync_array(value):
+    """Reliably wait for ``value``'s computation to finish.
+
+    On the tunneled TPU platform ("axon") ``block_until_ready`` can return
+    before execution completes; a device→host fetch of one element is the
+    only dependable barrier there. Fetching a single scalar keeps the
+    transfer negligible while still forcing the producing computation.
+    """
+    value.block_until_ready()
+    try:
+        platform = next(iter(value.devices())).platform
+        if value.size and platform != "cpu":
+            jax.device_get(value.ravel()[0])
+    except Exception:
+        pass
+    return value
+
+
 class Tensor:
     __slots__ = (
         "_value",
@@ -176,7 +194,7 @@ class Tensor:
         return out
 
     def block_until_ready(self):
-        self._value.block_until_ready()
+        sync_array(self._value)
         return self
 
     # ---- autograd ----

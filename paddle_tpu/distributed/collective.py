@@ -253,5 +253,6 @@ def barrier(group=None):
 
 
 def wait(tensor, group=None, use_calc_stream=True):
-    tensor._value.block_until_ready()
+    from paddle_tpu.core.tensor import sync_array
+    sync_array(tensor._value)
     return tensor
