@@ -40,7 +40,9 @@ def sync_array(value):
     try:
         platform = next(iter(value.devices())).platform
         if value.size and platform != "cpu":
-            jax.device_get(value.ravel()[0])
+            # index one element (not ravel — that would reshard the whole
+            # array when it's distributed) to force the producing computation
+            jax.device_get(value[(0,) * value.ndim])
     except Exception:
         pass
     return value

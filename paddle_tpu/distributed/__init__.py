@@ -36,6 +36,14 @@ from paddle_tpu.distributed.mesh import (  # noqa: F401
 )
 from paddle_tpu.distributed.parallel import DataParallel  # noqa: F401
 from paddle_tpu.distributed.recompute import recompute  # noqa: F401
+from paddle_tpu.distributed.context_parallel import (  # noqa: F401
+    all_to_all_attention,
+    all_to_all_attention_bshd,
+    gather_sequence,
+    ring_attention,
+    ring_attention_bshd,
+    split_sequence,
+)
 
 _parallel_env_initialized = [False]
 

@@ -1,0 +1,226 @@
+"""Sequence/context parallelism for long sequences.
+
+Reference parity: the reference scales sequence length via its fleet
+sequence-parallel utilities (reference:
+python/paddle/distributed/fleet/layers/mpu/mp_layers.py +
+mp_ops.py `split`/`_c_split`/`_c_concat` over NCCL groups) and, in
+derived suites, ring-style P2P attention. TPU-native design: the sequence
+axis of the activations is a mesh axis (`sp`); k/v blocks rotate around the
+ring with `lax.ppermute` over ICI while each step's partial attention is
+merged online-softmax style — no materialised [s, s] score matrix and no
+full k/v gather. The all-to-all variant (DeepSpeed-Ulysses-style) trades two
+`lax.all_to_all`s for head-sharded full-sequence attention.
+
+Both paths are plain differentiable JAX: reverse-mode AD through
+`lax.scan` + `ppermute` yields the reverse ring automatically, and
+`jax.checkpoint` on the ring step keeps scan residuals O(local kv) instead
+of O(full kv).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.distributed import mesh as mesh_mod
+
+_NEG_INF = -1e30
+
+
+def _axis_size(axis_name, axis_size=None):
+    if axis_size is not None:
+        return int(axis_size)
+    try:
+        return int(lax.axis_size(axis_name))
+    except Exception:
+        m = mesh_mod.get_mesh()
+        if m is None or axis_name not in m.axis_names:
+            raise ValueError(f"unknown mesh axis {axis_name!r}")
+        return int(m.shape[axis_name])
+
+
+# ---------------------------------------------------------------------------
+# Ring attention (inside shard_map; seq axis sharded over `axis_name`)
+# ---------------------------------------------------------------------------
+
+def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None,
+                   axis_size=None):
+    """Exact attention with the sequence dim sharded over `axis_name`.
+
+    Call INSIDE a shard_map body. q/k/v: [batch, heads, s_local, head_dim]
+    (each device owns a contiguous chunk of the sequence, chunk index ==
+    axis index). Returns [batch, heads, s_local, head_dim].
+
+    k/v rotate around the ring: at step t, device i holds the chunk that
+    started on device (i - t) mod n, so after n steps every q block has seen
+    every kv block. Partial results merge with running (max, sum) softmax
+    stats in fp32. Causal masking is by chunk index — a fully-future chunk
+    contributes exp(-inf)=0 rows; the diagonal chunk masks col<=row.
+    """
+    n = _axis_size(axis_name, axis_size)
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    scale = float(scale)
+    if n == 1:
+        return _sdpa_ref(q, k, v, causal=causal, scale=scale)
+
+    my_idx = lax.axis_index(axis_name)
+    b, h, sq, d = q.shape
+    qf = q.astype(jnp.float32) * scale
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def accumulate(acc, m, l, kt, vt, t):
+        kv_idx = (my_idx - t) % n
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kt.astype(jnp.float32))
+        if causal:
+            row = lax.broadcasted_iota(jnp.int32, (sq, kt.shape[2]), 0)
+            col = lax.broadcasted_iota(jnp.int32, (sq, kt.shape[2]), 1)
+            visible = jnp.logical_or(
+                kv_idx < my_idx,
+                jnp.logical_and(kv_idx == my_idx, col <= row))
+            s = jnp.where(visible, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vt.astype(jnp.float32))
+        return acc_new, m_new, l_new
+
+    def step(carry, t):
+        # permute at loop entry so only n-1 ring hops run (the t=0 local
+        # block is folded in before the scan)
+        acc, m, l, kt, vt = carry
+        kt = lax.ppermute(kt, axis_name, perm)
+        vt = lax.ppermute(vt, axis_name, perm)
+        acc, m, l = accumulate(acc, m, l, kt, vt, t)
+        return (acc, m, l, kt, vt), None
+
+    acc0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    m0 = jnp.full((b, h, sq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq, 1), jnp.float32)
+    acc, m, l = accumulate(acc0, m0, l0, k, v, 0)
+    carry, _ = lax.scan(jax.checkpoint(step),
+                        (acc, m, l, k, v), jnp.arange(1, n))
+    acc, m, l = carry[0], carry[1], carry[2]
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    return (acc / l_safe).astype(q.dtype)
+
+
+def _sdpa_ref(q, k, v, causal, scale):
+    s = jnp.einsum("bhqd,bhkd->bhqk",
+                   q.astype(jnp.float32) * scale, k.astype(jnp.float32))
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        row = lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        col = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(col <= row, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# All-to-all (Ulysses-style) sequence parallel attention
+# ---------------------------------------------------------------------------
+
+def all_to_all_attention(q, k, v, axis_name="sp", causal=False, scale=None,
+                         axis_size=None, attn_fn=None):
+    """Sequence-parallel attention via two all-to-alls (inside shard_map).
+
+    q/k/v: [batch, heads, s_local, head_dim] with heads % axis_size == 0.
+    First all-to-all regathers the full sequence while scattering heads
+    (s_local→s_full, heads→heads/n); full-sequence attention runs locally on
+    the owned heads (so `causal` is exact); the second all-to-all restores
+    the [heads, s_local] layout. Two all-to-alls ride ICI vs. the ring's
+    n-1 ppermutes — better for moderate n, and it reuses the single-device
+    flash kernel unchanged.
+    """
+    n = _axis_size(axis_name, axis_size)
+    if attn_fn is None:
+        if scale is None:
+            scale = 1.0 / (q.shape[-1] ** 0.5)
+        attn_fn = functools.partial(_sdpa_ref, causal=causal,
+                                    scale=float(scale))
+    elif causal or scale is not None:
+        raise ValueError("attn_fn owns masking and scaling — do not also "
+                         "pass causal/scale")
+    if n == 1:
+        return attn_fn(q, k, v)
+    if q.shape[1] % n:
+        raise ValueError(f"heads {q.shape[1]} not divisible by axis {n}")
+
+    def seq_gather(x):   # [b, h, s_loc, d] -> [b, h/n, s_full, d]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def seq_scatter(x):  # [b, h/n, s_full, d] -> [b, h, s_loc, d]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    o = attn_fn(seq_gather(q), seq_gather(k), seq_gather(v))
+    return seq_scatter(o)
+
+
+# ---------------------------------------------------------------------------
+# Whole-array wrappers (shard_map over the installed mesh) — eager/test use
+# ---------------------------------------------------------------------------
+
+def _wrap_bshd(fn, q, k, v, axis_name, mesh):
+    mesh = mesh or mesh_mod.ensure_mesh()
+    spec = P(None, axis_name, None, None)   # [b, s, h, d], seq sharded
+
+    def body(qb, kb, vb):
+        # transpose to [b, h, s_loc, d] for the kernels
+        o = fn(jnp.transpose(qb, (0, 2, 1, 3)),
+               jnp.transpose(kb, (0, 2, 1, 3)),
+               jnp.transpose(vb, (0, 2, 1, 3)))
+        return jnp.transpose(o, (0, 2, 1, 3))
+
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+
+def ring_attention_bshd(q, k, v, causal=False, scale=None, axis_name="sp",
+                        mesh=None):
+    """Ring attention over whole [batch, seq, heads, head_dim] arrays; this
+    wrapper owns the shard_map (seq sharded over `axis_name`)."""
+    mesh = mesh or mesh_mod.ensure_mesh()
+    n = mesh.shape[axis_name]
+    fn = functools.partial(ring_attention, axis_name=axis_name, causal=causal,
+                           scale=scale, axis_size=n)
+    return _wrap_bshd(fn, q, k, v, axis_name, mesh)
+
+
+def all_to_all_attention_bshd(q, k, v, causal=False, scale=None,
+                              axis_name="sp", mesh=None):
+    """Ulysses attention over whole [batch, seq, heads, head_dim] arrays."""
+    mesh = mesh or mesh_mod.ensure_mesh()
+    n = mesh.shape[axis_name]
+    fn = functools.partial(all_to_all_attention, axis_name=axis_name,
+                           causal=causal, scale=scale, axis_size=n)
+    return _wrap_bshd(fn, q, k, v, axis_name, mesh)
+
+
+# ---------------------------------------------------------------------------
+# Sequence scatter/gather helpers (reference mp_ops.split/_c_concat analogue)
+# ---------------------------------------------------------------------------
+
+def split_sequence(x, axis_name="sp", seq_axis=1):
+    """Shard `x` along its sequence dim over the mesh axis (device_put with a
+    NamedSharding — the TPU analogue of mp_ops.split on the activations)."""
+    mesh = mesh_mod.ensure_mesh()
+    spec = [None] * x.ndim
+    spec[seq_axis] = axis_name
+    return jax.device_put(x, jax.sharding.NamedSharding(mesh, P(*spec)))
+
+
+def gather_sequence(x, axis_name="sp", seq_axis=1):
+    """Replicate a sequence-sharded array (analogue of mp_ops._c_concat)."""
+    mesh = mesh_mod.ensure_mesh()
+    return jax.device_put(
+        x, jax.sharding.NamedSharding(mesh, P(*([None] * x.ndim))))

@@ -230,13 +230,20 @@ def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
 
 def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, do):
     q, k, v, o, lse = res
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                                    # [b,h,sq]
+    return _flash_bwd_impl(q, k, v, do, lse, delta, causal, scale,
+                           block_q, block_k, interpret)
+
+
+def _flash_bwd_impl(q, k, v, do, lse, delta, causal, scale, block_q, block_k,
+                    interpret):
+    """dq/dk/dv given precomputed delta (= sum(do*o) for the plain kernel;
+    ring attention folds the lse cotangent in as delta - dlse)."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
     block_q = min(block_q, max(sq, 8))
     block_k = min(block_k, max(sk, 8))
-
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1)                                    # [b,h,sq]
 
     qp = _pad_to(q, block_q, 2).reshape(b * h, -1, d)
     dop = _pad_to(do, block_q, 2).reshape(b * h, -1, d)
