@@ -36,6 +36,13 @@ from paddle_tpu.distributed.mesh import (  # noqa: F401
 )
 from paddle_tpu.distributed.parallel import DataParallel  # noqa: F401
 from paddle_tpu.distributed.recompute import recompute  # noqa: F401
+from paddle_tpu.distributed.pipeline import (  # noqa: F401
+    microbatch,
+    pipeline_forward,
+    stack_stage_params,
+    unmicrobatch,
+    unstack_stage_params,
+)
 from paddle_tpu.distributed.context_parallel import (  # noqa: F401
     all_to_all_attention,
     all_to_all_attention_bshd,

@@ -75,21 +75,29 @@ def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None,
 
     def accumulate(acc, m, l, kt, vt, t):
         kv_idx = (my_idx - t) % n
-        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kt.astype(jnp.float32))
-        if causal:
-            row = lax.broadcasted_iota(jnp.int32, (sq, kt.shape[2]), 0)
-            col = lax.broadcasted_iota(jnp.int32, (sq, kt.shape[2]), 1)
-            visible = jnp.logical_or(
-                kv_idx < my_idx,
-                jnp.logical_and(kv_idx == my_idx, col <= row))
-            s = jnp.where(visible, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * corr + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, vt.astype(jnp.float32))
-        return acc_new, m_new, l_new
+
+        def compute(acc, m, l):
+            s = jnp.einsum("bhqd,bhkd->bhqk", qf, kt.astype(jnp.float32))
+            if causal:
+                row = lax.broadcasted_iota(jnp.int32, (sq, kt.shape[2]), 0)
+                col = lax.broadcasted_iota(jnp.int32, (sq, kt.shape[2]), 1)
+                visible = jnp.logical_or(
+                    kv_idx < my_idx,
+                    jnp.logical_and(kv_idx == my_idx, col <= row))
+                s = jnp.where(visible, s, _NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m - m_new)
+            l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+            acc_new = acc * corr + jnp.einsum(
+                "bhqk,bhkd->bhqd", p, vt.astype(jnp.float32))
+            return acc_new, m_new, l_new
+
+        if not causal:
+            return compute(acc, m, l)
+        # fully-future chunk: skip the einsums entirely, not mask-to--inf
+        return lax.cond(kv_idx > my_idx,
+                        lambda acc, m, l: (acc, m, l), compute, acc, m, l)
 
     def step(carry, t):
         # permute at loop entry so only n-1 ring hops run (the t=0 local

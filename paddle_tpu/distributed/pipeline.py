@@ -1,0 +1,121 @@
+"""SPMD pipeline parallelism over the `pp` mesh axis.
+
+Reference parity: python/paddle/distributed/fleet/meta_parallel/
+pipeline_parallel.py (PipelineParallel: 1F1B/FThenB microbatch schedules
+driven by NCCL p2p send/recv between stage ranks) and
+pp_layers.py PipelineLayer (stage segmentation).
+
+TPU-native design: no p2p runtime and no per-rank programs — ONE SPMD
+program where each device along the `pp` axis owns one stage's weights
+(stacked pytree sharded on the leading stage dim) and activations hop
+stage→stage+1 with `lax.ppermute` over ICI. The microbatch loop is a
+`lax.scan` of M + n - 1 ticks: stage 0 injects microbatch t, stage n-1
+drains tick t's result into the output buffer; every device runs the same
+`stage_fn` each tick so the MXU stays busy once the bubble fills. Reverse-
+mode AD through scan+ppermute yields the backward pipeline automatically
+(FThenB/GPipe schedule); `jax.checkpoint` on the tick keeps residuals to
+one activation per tick.
+
+Constraint (idiomatic for SPMD pipelining): all stages share one param
+pytree structure and one inter-stage activation shape — put the embedding
+and the head outside the pipelined trunk.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.distributed import mesh as mesh_mod
+
+
+def stack_stage_params(stage_params):
+    """Stack a list of per-stage param pytrees (identical structure/shapes)
+    along a new leading `stage` dim — the dim sharded over `pp`."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *stage_params)
+
+
+def unstack_stage_params(stacked, num_stages):
+    """Inverse of stack_stage_params (host-side convenience)."""
+    return [jax.tree_util.tree_map(lambda x: x[i], stacked)
+            for i in range(num_stages)]
+
+
+def pipeline_spmd_fn(stage_fn, axis_name="pp", axis_size=None,
+                     checkpoint=True):
+    """Build the per-device pipeline body (call INSIDE shard_map).
+
+    stage_fn(params, x_mb) -> y_mb with x_mb/y_mb the same shape/dtype.
+    Returned body(params_local, x) takes the local stage's params (leading
+    stage dim of size 1) and the full microbatch stream x: [M, mb, ...],
+    and returns [M, mb, ...] on every device (psum-broadcast from the last
+    stage).
+    """
+    from paddle_tpu.distributed.context_parallel import _axis_size
+
+    def body(params_local, x):
+        n = _axis_size(axis_name, axis_size)
+        stage = lax.axis_index(axis_name)
+        params = jax.tree_util.tree_map(lambda p: p[0], params_local)
+        M = x.shape[0]
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def tick(prev_y, t):
+            # carry stays O(mb): per-tick results leave as stacked scan
+            # outputs, not via an [M, ...] buffer in the carry (which would
+            # make scan AD residuals O(M^2*mb))
+            inbound = lax.ppermute(prev_y, axis_name, perm)
+            inp = jnp.where(stage == 0, x[jnp.clip(t, 0, M - 1)], inbound)
+            y = stage_fn(params, inp)
+            return y, y
+
+        y0 = jnp.zeros(x.shape[1:], x.dtype)
+        fn = jax.checkpoint(tick) if checkpoint else tick
+        _, ys = lax.scan(fn, y0, jnp.arange(M + n - 1))
+        # ticks n-1 .. M+n-2 drain microbatches 0..M-1 from the last stage;
+        # zero elsewhere + psum broadcasts them to every pp rank
+        outputs = jnp.where(stage == n - 1, ys[n - 1:], 0.0)
+        return lax.psum(outputs, axis_name)
+
+    return body
+
+
+def pipeline_forward(stage_fn, stacked_params, x, axis_name="pp", mesh=None,
+                     checkpoint=True):
+    """Whole-array pipeline apply; owns the shard_map.
+
+    stacked_params: pytree with leading stage dim n (stack_stage_params).
+    x: [num_microbatches, microbatch, ...] inter-stage activations.
+    Returns [num_microbatches, microbatch, ...], replicated over `pp`.
+    """
+    mesh = mesh or mesh_mod.ensure_mesh()
+    n = mesh.shape[axis_name]
+    body = pipeline_spmd_fn(stage_fn, axis_name=axis_name, axis_size=n,
+                            checkpoint=checkpoint)
+    param_specs = jax.tree_util.tree_map(
+        lambda p: P(*([axis_name] + [None] * (p.ndim - 1))), stacked_params)
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(param_specs, P(*([None] * x.ndim))),
+        out_specs=P(*([None] * x.ndim)),
+        check_vma=False)(stacked_params, x)
+
+
+def microbatch(x, num_microbatches, batch_axis=0):
+    """[B, ...] -> [M, B/M, ...] microbatch stream."""
+    B = x.shape[batch_axis]
+    if B % num_microbatches:
+        raise ValueError(f"batch {B} not divisible by {num_microbatches} "
+                         "microbatches")
+    x = jnp.moveaxis(x, batch_axis, 0)
+    return x.reshape((num_microbatches, B // num_microbatches) + x.shape[1:])
+
+
+def unmicrobatch(x, batch_axis=0):
+    """[M, mb, ...] -> [B, ...]."""
+    y = x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])
+    return jnp.moveaxis(y, 0, batch_axis)

@@ -73,10 +73,11 @@ def _merge(o1, lse1, o2, lse2):
     w2 = jnp.exp(lse2 - m_safe)
     tot = w1 + w2
     tot_safe = jnp.where(tot == 0.0, 1.0, tot)
+    # fp32 out: the running accumulator must not round to bf16 every step
     o = (o1.astype(jnp.float32) * w1[..., None] +
          o2.astype(jnp.float32) * w2[..., None]) / tot_safe[..., None]
     lse = jnp.where(seen, m_safe + jnp.log(tot_safe), m)
-    return o.astype(o1.dtype), lse
+    return o, lse
 
 
 def ring_flash_attention(q, k, v, axis_name="sp", causal=False, scale=None,
@@ -142,29 +143,22 @@ def ring_flash_attention(q, k, v, axis_name="sp", causal=False, scale=None,
         o, lse = accumulate(o, lse, kt, vt, t)
         return (o, lse, kt, vt), None
 
-    o0 = jnp.zeros((b, h, sq, d), q.dtype)
+    o0 = jnp.zeros((b, h, sq, d), jnp.float32)
     lse0 = jnp.full((b, h, sq), _NEG_INF, jnp.float32)
     o, lse = accumulate(o0, lse0, k, v, 0)
     carry, _ = lax.scan(jax.checkpoint(step), (o, lse, k, v),
                         jnp.arange(1, n))
-    return carry[0]
+    return carry[0].astype(q.dtype)
 
 
 def ring_flash_attention_bshd(q, k, v, causal=False, scale=None,
                               axis_name="sp", mesh=None, interpret=None):
     """Whole-array wrapper: [batch, seq, heads, head_dim], seq sharded over
     `axis_name` of the mesh; owns the shard_map."""
-    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.distributed.context_parallel import _wrap_bshd
     mesh = mesh or mesh_mod.ensure_mesh()
-    n = mesh.shape[axis_name]
-    spec = P(None, axis_name, None, None)
-
-    def body(qb, kb, vb):
-        o = ring_flash_attention(
-            jnp.transpose(qb, (0, 2, 1, 3)), jnp.transpose(kb, (0, 2, 1, 3)),
-            jnp.transpose(vb, (0, 2, 1, 3)), axis_name=axis_name,
-            causal=causal, scale=scale, axis_size=n, interpret=interpret)
-        return jnp.transpose(o, (0, 2, 1, 3))
-
-    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+    fn = functools.partial(ring_flash_attention, axis_name=axis_name,
+                           causal=causal, scale=scale,
+                           axis_size=mesh.shape[axis_name],
+                           interpret=interpret)
+    return _wrap_bshd(fn, q, k, v, axis_name, mesh)

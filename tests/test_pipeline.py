@@ -1,0 +1,108 @@
+"""SPMD pipeline parallelism: pipeline over `pp` mesh axis must equal
+running the stages sequentially (forward AND grads) — SURVEY §4 'PP ==
+no-PP'."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.distributed.pipeline import (
+    microbatch,
+    pipeline_forward,
+    stack_stage_params,
+    unmicrobatch,
+    unstack_stage_params,
+)
+
+N_STAGES = 4
+D = 8
+
+
+def stage_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def make_stages(seed=0):
+    rng = np.random.RandomState(seed)
+    return [{"w": jnp.asarray(rng.randn(D, D).astype(np.float32) * 0.5),
+             "b": jnp.asarray(rng.randn(D).astype(np.float32) * 0.1)}
+            for _ in range(N_STAGES)]
+
+
+def sequential(stages, x):
+    for p in stages:
+        x = stage_fn(p, x)
+    return x
+
+
+@pytest.fixture(scope="module")
+def pp_mesh():
+    old = mesh_mod.get_mesh()
+    mesh = mesh_mod.init_mesh({"dp": 2, "pp": N_STAGES})
+    yield mesh
+    mesh_mod.set_mesh(old)
+
+
+def test_pipeline_matches_sequential(pp_mesh):
+    stages = make_stages()
+    stacked = stack_stage_params(stages)
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(16, D).astype(np.float32))
+    xm = microbatch(x, 8)
+    out = unmicrobatch(pipeline_forward(stage_fn, stacked, xm))
+    ref = sequential(stages, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_pipeline_grads_match_sequential(pp_mesh):
+    stages = make_stages()
+    stacked = stack_stage_params(stages)
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(8, D).astype(np.float32))
+
+    def loss_pp(p, x):
+        return jnp.sum(pipeline_forward(stage_fn, p, microbatch(x, 4)) ** 2)
+
+    def loss_seq(ps, x):
+        return jnp.sum(sequential(ps, x) ** 2)
+
+    g_pp = jax.grad(loss_pp)(stacked, x)
+    g_seq = jax.grad(loss_seq)(stages, x)
+    g_seq_stacked = stack_stage_params(g_seq)
+    for a, b in zip(jax.tree_util.tree_leaves(g_pp),
+                    jax.tree_util.tree_leaves(g_seq_stacked)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_pipeline_under_jit(pp_mesh):
+    stages = make_stages()
+    stacked = stack_stage_params(stages)
+    x = jnp.ones((8, D), jnp.float32)
+
+    @jax.jit
+    def f(p, xm):
+        return pipeline_forward(stage_fn, p, xm)
+
+    out = unmicrobatch(f(stacked, microbatch(x, 4)))
+    ref = sequential(stages, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_stack_unstack_roundtrip():
+    stages = make_stages()
+    back = unstack_stage_params(stack_stage_params(stages), N_STAGES)
+    for a, b in zip(stages, back):
+        np.testing.assert_array_equal(np.asarray(a["w"]), np.asarray(b["w"]))
+
+
+def test_microbatch_roundtrip():
+    x = jnp.arange(24, dtype=jnp.float32).reshape(12, 2)
+    np.testing.assert_array_equal(
+        np.asarray(unmicrobatch(microbatch(x, 4))), np.asarray(x))
+    with pytest.raises(ValueError):
+        microbatch(x, 5)
