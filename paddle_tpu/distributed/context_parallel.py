@@ -30,16 +30,7 @@ from paddle_tpu.distributed import mesh as mesh_mod
 _NEG_INF = -1e30
 
 
-def _axis_size(axis_name, axis_size=None):
-    if axis_size is not None:
-        return int(axis_size)
-    try:
-        return int(lax.axis_size(axis_name))
-    except Exception:
-        m = mesh_mod.get_mesh()
-        if m is None or axis_name not in m.axis_names:
-            raise ValueError(f"unknown mesh axis {axis_name!r}")
-        return int(m.shape[axis_name])
+_axis_size = mesh_mod.resolve_axis_size
 
 
 # ---------------------------------------------------------------------------
@@ -159,8 +150,10 @@ def all_to_all_attention(q, k, v, axis_name="sp", causal=False, scale=None,
                          "pass causal/scale")
     if n == 1:
         return attn_fn(q, k, v)
-    if q.shape[1] % n:
-        raise ValueError(f"heads {q.shape[1]} not divisible by axis {n}")
+    for name, t in (("q", q), ("k", k), ("v", v)):
+        if t.shape[1] % n:
+            raise ValueError(f"{name} heads {t.shape[1]} not divisible by "
+                             f"axis {n} (GQA/MQA needs kv heads % {n} == 0)")
 
     def seq_gather(x):   # [b, h, s_loc, d] -> [b, h/n, s_full, d]
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
@@ -178,7 +171,7 @@ def all_to_all_attention(q, k, v, axis_name="sp", causal=False, scale=None,
 # Whole-array wrappers (shard_map over the installed mesh) — eager/test use
 # ---------------------------------------------------------------------------
 
-def _wrap_bshd(fn, q, k, v, axis_name, mesh):
+def wrap_bshd(fn, q, k, v, axis_name, mesh):
     mesh = mesh or mesh_mod.ensure_mesh()
     spec = P(None, axis_name, None, None)   # [b, s, h, d], seq sharded
 
@@ -201,7 +194,7 @@ def ring_attention_bshd(q, k, v, causal=False, scale=None, axis_name="sp",
     n = mesh.shape[axis_name]
     fn = functools.partial(ring_attention, axis_name=axis_name, causal=causal,
                            scale=scale, axis_size=n)
-    return _wrap_bshd(fn, q, k, v, axis_name, mesh)
+    return wrap_bshd(fn, q, k, v, axis_name, mesh)
 
 
 def all_to_all_attention_bshd(q, k, v, causal=False, scale=None,
@@ -211,7 +204,7 @@ def all_to_all_attention_bshd(q, k, v, causal=False, scale=None,
     n = mesh.shape[axis_name]
     fn = functools.partial(all_to_all_attention, axis_name=axis_name,
                            causal=causal, scale=scale, axis_size=n)
-    return _wrap_bshd(fn, q, k, v, axis_name, mesh)
+    return wrap_bshd(fn, q, k, v, axis_name, mesh)
 
 
 # ---------------------------------------------------------------------------

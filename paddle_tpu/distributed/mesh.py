@@ -71,6 +71,23 @@ def axis_size(name):
     return m.shape[name]
 
 
+def resolve_axis_size(axis_name, axis_size=None):
+    """Axis size for shard_map bodies: explicit override, else the bound
+    axis env (inside shard_map), else the installed mesh — and unlike
+    :func:`axis_size`, an axis unknown everywhere is an ERROR, not 1
+    (silently degrading to single-device would compute wrong results)."""
+    import jax
+    if axis_size is not None:
+        return int(axis_size)
+    try:
+        return int(jax.lax.axis_size(axis_name))
+    except Exception:
+        m = get_mesh()
+        if m is None or axis_name not in m.axis_names:
+            raise ValueError(f"unknown mesh axis {axis_name!r}")
+        return int(m.shape[axis_name])
+
+
 # ---- collective-axis context (inside shard_map bodies) ----
 def push_collective_axis(axis):
     stack = getattr(_state, "coll_axes", None)

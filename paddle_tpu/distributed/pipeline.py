@@ -55,10 +55,8 @@ def pipeline_spmd_fn(stage_fn, axis_name="pp", axis_size=None,
     and returns [M, mb, ...] on every device (psum-broadcast from the last
     stage).
     """
-    from paddle_tpu.distributed.context_parallel import _axis_size
-
     def body(params_local, x):
-        n = _axis_size(axis_name, axis_size)
+        n = mesh_mod.resolve_axis_size(axis_name, axis_size)
         stage = lax.axis_index(axis_name)
         params = jax.tree_util.tree_map(lambda p: p[0], params_local)
         M = x.shape[0]

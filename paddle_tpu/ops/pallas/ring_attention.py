@@ -97,8 +97,7 @@ def ring_flash_attention(q, k, v, axis_name="sp", causal=False, scale=None,
     scale = float(scale)
     block_q = block_q or DEFAULT_BLOCK_Q
     block_k = block_k or DEFAULT_BLOCK_K
-    from paddle_tpu.distributed.context_parallel import _axis_size
-    n = _axis_size(axis_name, axis_size)
+    n = mesh_mod.resolve_axis_size(axis_name, axis_size)
 
     def blk(qx, kx, vx, c):
         # positional-only: custom_vjp rejects keyword args at call time
@@ -155,10 +154,10 @@ def ring_flash_attention_bshd(q, k, v, causal=False, scale=None,
                               axis_name="sp", mesh=None, interpret=None):
     """Whole-array wrapper: [batch, seq, heads, head_dim], seq sharded over
     `axis_name` of the mesh; owns the shard_map."""
-    from paddle_tpu.distributed.context_parallel import _wrap_bshd
+    from paddle_tpu.distributed.context_parallel import wrap_bshd
     mesh = mesh or mesh_mod.ensure_mesh()
     fn = functools.partial(ring_flash_attention, axis_name=axis_name,
                            causal=causal, scale=scale,
                            axis_size=mesh.shape[axis_name],
                            interpret=interpret)
-    return _wrap_bshd(fn, q, k, v, axis_name, mesh)
+    return wrap_bshd(fn, q, k, v, axis_name, mesh)

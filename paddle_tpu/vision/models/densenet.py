@@ -1,0 +1,150 @@
+"""DenseNet. Reference: python/paddle/vision/models/densenet.py."""
+from __future__ import annotations
+
+import paddle_tpu
+import paddle_tpu.nn as nn
+
+
+class BNACConvLayer(nn.Layer):
+    """BN -> ReLU -> Conv (pre-activation)."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 pad=0, groups=1):
+        super().__init__()
+        self.batch_norm = nn.BatchNorm2D(num_channels)
+        self.relu = nn.ReLU()
+        self.conv = nn.Conv2D(num_channels, num_filters, filter_size,
+                              stride=stride, padding=pad, groups=groups,
+                              bias_attr=False)
+
+    def forward(self, x):
+        return self.conv(self.relu(self.batch_norm(x)))
+
+
+class DenseLayer(nn.Layer):
+    def __init__(self, num_channels, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.dropout = dropout
+        self.bn_ac_func1 = BNACConvLayer(num_channels, bn_size * growth_rate,
+                                         1)
+        self.bn_ac_func2 = BNACConvLayer(bn_size * growth_rate, growth_rate,
+                                         3, pad=1)
+        if dropout:
+            self.dropout_func = nn.Dropout(p=dropout)
+
+    def forward(self, x):
+        conv = self.bn_ac_func1(x)
+        conv = self.bn_ac_func2(conv)
+        if self.dropout:
+            conv = self.dropout_func(conv)
+        return paddle_tpu.concat([x, conv], axis=1)
+
+
+class DenseBlock(nn.Layer):
+    def __init__(self, num_channels, num_layers, bn_size, growth_rate,
+                 dropout):
+        super().__init__()
+        self.dense_layer_func = nn.LayerList()
+        pre_channel = num_channels
+        for _ in range(num_layers):
+            self.dense_layer_func.append(
+                DenseLayer(pre_channel, growth_rate, bn_size, dropout))
+            pre_channel += growth_rate
+
+    def forward(self, x):
+        for func in self.dense_layer_func:
+            x = func(x)
+        return x
+
+
+class TransitionLayer(nn.Layer):
+    def __init__(self, num_channels, num_output_features):
+        super().__init__()
+        self.conv_ac_func = BNACConvLayer(num_channels, num_output_features,
+                                          1)
+        self.pool2d_avg = nn.AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool2d_avg(self.conv_ac_func(x))
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        supported = {
+            121: (64, 32, [6, 12, 24, 16]),
+            161: (96, 48, [6, 12, 36, 24]),
+            169: (64, 32, [6, 12, 32, 32]),
+            201: (64, 32, [6, 12, 48, 32]),
+            264: (64, 32, [6, 12, 64, 48]),
+        }
+        assert layers in supported, f"supported layers {list(supported)}"
+        num_init_features, growth_rate, block_config = supported[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        self.conv1_func = nn.Sequential(
+            nn.Conv2D(3, num_init_features, 7, stride=2, padding=3,
+                      bias_attr=False),
+            nn.BatchNorm2D(num_init_features),
+            nn.ReLU())
+        self.pool2d_max = nn.MaxPool2D(3, stride=2, padding=1)
+
+        self.block_config = block_config
+        self.dense_block_func_list = nn.LayerList()
+        self.transition_func_list = nn.LayerList()
+        pre_num_channels = num_init_features
+        num_features = num_init_features
+        for i, num_layers in enumerate(block_config):
+            self.dense_block_func_list.append(DenseBlock(
+                pre_num_channels, num_layers, bn_size, growth_rate, dropout))
+            num_features = pre_num_channels + num_layers * growth_rate
+            pre_num_channels = num_features
+            if i != len(block_config) - 1:
+                self.transition_func_list.append(
+                    TransitionLayer(num_features, num_features // 2))
+                pre_num_channels = num_features // 2
+
+        self.batch_norm = nn.BatchNorm2D(num_features)
+        self.relu = nn.ReLU()
+        if with_pool:
+            self.pool2d_avg = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.out = nn.Linear(num_features, num_classes)
+
+    def forward(self, x):
+        x = self.conv1_func(x)
+        x = self.pool2d_max(x)
+        for i, block in enumerate(self.dense_block_func_list):
+            x = block(x)
+            if i != len(self.block_config) - 1:
+                x = self.transition_func_list[i](x)
+        x = self.relu(self.batch_norm(x))
+        if self.with_pool:
+            x = self.pool2d_avg(x)
+        if self.num_classes > 0:
+            from paddle_tpu.tensor.manipulation import flatten
+            x = flatten(x, 1)
+            x = self.out(x)
+        return x
+
+
+def densenet121(pretrained=False, **kwargs):
+    return DenseNet(layers=121, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return DenseNet(layers=161, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return DenseNet(layers=169, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return DenseNet(layers=201, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return DenseNet(layers=264, **kwargs)

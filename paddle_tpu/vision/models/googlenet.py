@@ -1,0 +1,119 @@
+"""GoogLeNet (Inception v1). Reference:
+python/paddle/vision/models/googlenet.py — returns (out, out1, out2) with the
+two auxiliary classifier heads, like the reference."""
+from __future__ import annotations
+
+import paddle_tpu
+import paddle_tpu.nn as nn
+
+
+class ConvLayer(nn.Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 groups=1):
+        super().__init__()
+        self.conv = nn.Conv2D(num_channels, num_filters, filter_size,
+                              stride=stride,
+                              padding=(filter_size - 1) // 2, groups=groups,
+                              bias_attr=False)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.relu(self.conv(x))
+
+
+class Inception(nn.Layer):
+    def __init__(self, input_channels, output_channels, filter1, filter3R,
+                 filter3, filter5R, filter5, proj):
+        super().__init__()
+        self.branch1 = ConvLayer(input_channels, filter1, 1)
+        self.branch2_a = ConvLayer(input_channels, filter3R, 1)
+        self.branch2_b = ConvLayer(filter3R, filter3, 3)
+        self.branch3_a = ConvLayer(input_channels, filter5R, 1)
+        self.branch3_b = ConvLayer(filter5R, filter5, 5)
+        self.branch4_pool = nn.MaxPool2D(3, stride=1, padding=1)
+        self.branch4_conv = ConvLayer(input_channels, proj, 1)
+
+    def forward(self, x):
+        return paddle_tpu.concat([
+            self.branch1(x),
+            self.branch2_b(self.branch2_a(x)),
+            self.branch3_b(self.branch3_a(x)),
+            self.branch4_conv(self.branch4_pool(x)),
+        ], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        self.conv1 = ConvLayer(3, 64, 7, stride=2)
+        self.pool1 = nn.MaxPool2D(3, stride=2, ceil_mode=True)
+        self.conv2_1 = ConvLayer(64, 64, 1)
+        self.conv2_2 = ConvLayer(64, 192, 3)
+        self.pool2 = nn.MaxPool2D(3, stride=2, ceil_mode=True)
+
+        self.ince3a = Inception(192, 192, 64, 96, 128, 16, 32, 32)
+        self.ince3b = Inception(256, 256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, stride=2, ceil_mode=True)
+
+        self.ince4a = Inception(480, 480, 192, 96, 208, 16, 48, 64)
+        self.ince4b = Inception(512, 512, 160, 112, 224, 24, 64, 64)
+        self.ince4c = Inception(512, 512, 128, 128, 256, 24, 64, 64)
+        self.ince4d = Inception(512, 512, 112, 144, 288, 32, 64, 64)
+        self.ince4e = Inception(528, 528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, stride=2, ceil_mode=True)
+
+        self.ince5a = Inception(832, 832, 256, 160, 320, 32, 128, 128)
+        self.ince5b = Inception(832, 832, 384, 192, 384, 48, 128, 128)
+
+        if with_pool:
+            self.pool5 = nn.AdaptiveAvgPool2D(1)
+        self.dropout = nn.Dropout(0.4)
+        if num_classes > 0:
+            self.out = nn.Linear(1024, num_classes)
+            # aux heads: reference fc dims are 128*3*3=1152; adaptive pool
+            # pins the 3x3 spatial for any input size (the reference's fixed
+            # AvgPool2D(5,3) only matches at its blessed input resolution)
+            self.pool_o1 = nn.AdaptiveAvgPool2D((3, 3))
+            self.conv_o1 = ConvLayer(512, 128, 1)
+            self.fc_o1 = nn.Linear(1152, 1024)
+            self.dropout_o1 = nn.Dropout(0.7)
+            self.out_o1 = nn.Linear(1024, num_classes)
+            # aux head 2
+            self.pool_o2 = nn.AdaptiveAvgPool2D((3, 3))
+            self.conv_o2 = ConvLayer(528, 128, 1)
+            self.fc_o2 = nn.Linear(1152, 1024)
+            self.dropout_o2 = nn.Dropout(0.7)
+            self.out_o2 = nn.Linear(1024, num_classes)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        from paddle_tpu.tensor.manipulation import flatten
+        x = self.pool1(self.conv1(x))
+        x = self.pool2(self.conv2_2(self.conv2_1(x)))
+        x = self.pool3(self.ince3b(self.ince3a(x)))
+        ince4a = self.ince4a(x)
+        ince4d = self.ince4d(self.ince4c(self.ince4b(ince4a)))
+        x = self.pool4(self.ince4e(ince4d))
+        x = self.ince5b(self.ince5a(x))
+        if self.with_pool:
+            x = self.pool5(x)
+        x = self.dropout(x)
+        if self.num_classes <= 0:
+            return x
+        out = self.out(flatten(x, 1))
+
+        o1 = self.conv_o1(self.pool_o1(ince4a))
+        o1 = self.relu(self.fc_o1(flatten(o1, 1)))
+        out1 = self.out_o1(self.dropout_o1(o1))
+
+        o2 = self.conv_o2(self.pool_o2(ince4d))
+        o2 = self.relu(self.fc_o2(flatten(o2, 1)))
+        out2 = self.out_o2(self.dropout_o2(o2))
+        return [out, out1, out2]
+
+
+def googlenet(pretrained=False, **kwargs):
+    return GoogLeNet(**kwargs)

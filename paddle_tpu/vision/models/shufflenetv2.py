@@ -1,0 +1,170 @@
+"""ShuffleNetV2. Reference: python/paddle/vision/models/shufflenetv2.py.
+
+Channel shuffle uses nn.ChannelShuffle (reshape+transpose — free under XLA
+layout assignment).
+"""
+from __future__ import annotations
+
+import paddle_tpu
+import paddle_tpu.nn as nn
+
+
+def create_activation_layer(act):
+    if act == "swish":
+        return nn.Swish
+    if act == "relu":
+        return nn.ReLU
+    if act is None:
+        return None
+    raise ValueError(f"unsupported activation {act}")
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, in_channels, out_channels, stride, act_layer=nn.ReLU):
+        super().__init__()
+        self._conv_pw = nn.Sequential(
+            nn.Conv2D(in_channels // 2, out_channels // 2, 1, bias_attr=False),
+            nn.BatchNorm2D(out_channels // 2), act_layer())
+        self._conv_dw = nn.Sequential(
+            nn.Conv2D(out_channels // 2, out_channels // 2, 3, stride=stride,
+                      padding=1, groups=out_channels // 2, bias_attr=False),
+            nn.BatchNorm2D(out_channels // 2))
+        self._conv_linear = nn.Sequential(
+            nn.Conv2D(out_channels // 2, out_channels // 2, 1,
+                      bias_attr=False),
+            nn.BatchNorm2D(out_channels // 2), act_layer())
+        self._shuffle = nn.ChannelShuffle(2)
+
+    def forward(self, x):
+        x1, x2 = paddle_tpu.split(x, 2, axis=1)
+        x2 = self._conv_pw(x2)
+        x2 = self._conv_dw(x2)
+        x2 = self._conv_linear(x2)
+        out = paddle_tpu.concat([x1, x2], axis=1)
+        return self._shuffle(out)
+
+
+class InvertedResidualDS(nn.Layer):
+    """Downsampling variant: both branches convolve, stride 2."""
+
+    def __init__(self, in_channels, out_channels, stride, act_layer=nn.ReLU):
+        super().__init__()
+        self._conv_dw_1 = nn.Sequential(
+            nn.Conv2D(in_channels, in_channels, 3, stride=stride, padding=1,
+                      groups=in_channels, bias_attr=False),
+            nn.BatchNorm2D(in_channels))
+        self._conv_linear_1 = nn.Sequential(
+            nn.Conv2D(in_channels, out_channels // 2, 1, bias_attr=False),
+            nn.BatchNorm2D(out_channels // 2), act_layer())
+        self._conv_pw_2 = nn.Sequential(
+            nn.Conv2D(in_channels, out_channels // 2, 1, bias_attr=False),
+            nn.BatchNorm2D(out_channels // 2), act_layer())
+        self._conv_dw_2 = nn.Sequential(
+            nn.Conv2D(out_channels // 2, out_channels // 2, 3, stride=stride,
+                      padding=1, groups=out_channels // 2, bias_attr=False),
+            nn.BatchNorm2D(out_channels // 2))
+        self._conv_linear_2 = nn.Sequential(
+            nn.Conv2D(out_channels // 2, out_channels // 2, 1,
+                      bias_attr=False),
+            nn.BatchNorm2D(out_channels // 2), act_layer())
+        self._shuffle = nn.ChannelShuffle(2)
+
+    def forward(self, x):
+        x1 = self._conv_linear_1(self._conv_dw_1(x))
+        x2 = self._conv_linear_2(self._conv_dw_2(self._conv_pw_2(x)))
+        out = paddle_tpu.concat([x1, x2], axis=1)
+        return self._shuffle(out)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.scale = scale
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        stage_repeats = [4, 8, 4]
+        act_layer = create_activation_layer(act)
+
+        if scale == 0.25:
+            stage_out_channels = [-1, 24, 24, 48, 96, 512]
+        elif scale == 0.33:
+            stage_out_channels = [-1, 24, 32, 64, 128, 512]
+        elif scale == 0.5:
+            stage_out_channels = [-1, 24, 48, 96, 192, 1024]
+        elif scale == 1.0:
+            stage_out_channels = [-1, 24, 116, 232, 464, 1024]
+        elif scale == 1.5:
+            stage_out_channels = [-1, 24, 176, 352, 704, 1024]
+        elif scale == 2.0:
+            stage_out_channels = [-1, 24, 244, 488, 976, 2048]
+        else:
+            raise NotImplementedError(f"scale {scale} not supported")
+
+        self._conv1 = nn.Sequential(
+            nn.Conv2D(3, stage_out_channels[1], 3, stride=2, padding=1,
+                      bias_attr=False),
+            nn.BatchNorm2D(stage_out_channels[1]), act_layer())
+        self._max_pool = nn.MaxPool2D(3, stride=2, padding=1)
+
+        blocks = []
+        for stage_id, num_repeat in enumerate(stage_repeats):
+            for i in range(num_repeat):
+                if i == 0:
+                    blocks.append(InvertedResidualDS(
+                        stage_out_channels[stage_id + 1],
+                        stage_out_channels[stage_id + 2], 2, act_layer))
+                else:
+                    blocks.append(InvertedResidual(
+                        stage_out_channels[stage_id + 2],
+                        stage_out_channels[stage_id + 2], 1, act_layer))
+        self._blocks = nn.Sequential(*blocks)
+        self._last_conv = nn.Sequential(
+            nn.Conv2D(stage_out_channels[-2], stage_out_channels[-1], 1,
+                      bias_attr=False),
+            nn.BatchNorm2D(stage_out_channels[-1]), act_layer())
+        if with_pool:
+            self._pool2d_avg = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self._fc = nn.Linear(stage_out_channels[-1], num_classes)
+
+    def forward(self, x):
+        x = self._conv1(x)
+        x = self._max_pool(x)
+        x = self._blocks(x)
+        x = self._last_conv(x)
+        if self.with_pool:
+            x = self._pool2d_avg(x)
+        if self.num_classes > 0:
+            from paddle_tpu.tensor.manipulation import flatten
+            x = flatten(x, 1)
+            x = self._fc(x)
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.25, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.33, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.5, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=2.0, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, act="swish", **kwargs)

@@ -1,0 +1,87 @@
+"""SqueezeNet 1.0/1.1. Reference: python/paddle/vision/models/squeezenet.py."""
+from __future__ import annotations
+
+import paddle_tpu
+import paddle_tpu.nn as nn
+
+
+class MakeFire(nn.Layer):
+    def __init__(self, in_channels, squeeze_channels, expand1x1_channels,
+                 expand3x3_channels):
+        super().__init__()
+        self.squeeze = nn.Conv2D(in_channels, squeeze_channels, 1)
+        self.expand1x1 = nn.Conv2D(squeeze_channels, expand1x1_channels, 1)
+        self.expand3x3 = nn.Conv2D(squeeze_channels, expand3x3_channels, 3,
+                                   padding=1)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        x = self.relu(self.squeeze(x))
+        return paddle_tpu.concat(
+            [self.relu(self.expand1x1(x)), self.relu(self.expand3x3(x))],
+            axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.version = version
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        if version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2),
+                nn.ReLU(),
+                nn.MaxPool2D(3, stride=2, ceil_mode=True),
+                MakeFire(96, 16, 64, 64),
+                MakeFire(128, 16, 64, 64),
+                MakeFire(128, 32, 128, 128),
+                nn.MaxPool2D(3, stride=2, ceil_mode=True),
+                MakeFire(256, 32, 128, 128),
+                MakeFire(256, 48, 192, 192),
+                MakeFire(384, 48, 192, 192),
+                MakeFire(384, 64, 256, 256),
+                nn.MaxPool2D(3, stride=2, ceil_mode=True),
+                MakeFire(512, 64, 256, 256),
+            )
+        else:
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2, padding=1),
+                nn.ReLU(),
+                nn.MaxPool2D(3, stride=2, ceil_mode=True),
+                MakeFire(64, 16, 64, 64),
+                MakeFire(128, 16, 64, 64),
+                nn.MaxPool2D(3, stride=2, ceil_mode=True),
+                MakeFire(128, 32, 128, 128),
+                MakeFire(256, 32, 128, 128),
+                nn.MaxPool2D(3, stride=2, ceil_mode=True),
+                MakeFire(256, 48, 192, 192),
+                MakeFire(384, 48, 192, 192),
+                MakeFire(384, 64, 256, 256),
+                MakeFire(512, 64, 256, 256),
+            )
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Dropout(0.5),
+                nn.Conv2D(512, num_classes, 1),
+                nn.ReLU())
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = self.classifier(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        from paddle_tpu.tensor.manipulation import flatten
+        return flatten(x, 1)
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    return SqueezeNet(version="1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    return SqueezeNet(version="1.1", **kwargs)

@@ -1,0 +1,78 @@
+"""Vision model zoo smoke tests: forward shapes on small inputs (SURVEY §4
+model smoke tests). 64x64 inputs keep CPU runtime sane; aux-head models are
+checked for their multi-output contract."""
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu.vision import models
+
+
+def _x(n=1, size=64):
+    rng = np.random.RandomState(0)
+    return paddle_tpu.to_tensor(
+        rng.randn(n, 3, size, size).astype(np.float32))
+
+
+SINGLE_OUT = [
+    ("alexnet", dict(), 64),
+    ("vgg11", dict(num_classes=10), 64),
+    ("mobilenet_v1", dict(num_classes=10, scale=0.25), 64),
+    ("mobilenet_v2", dict(num_classes=10, scale=0.25), 64),
+    ("mobilenet_v3_small", dict(num_classes=10, scale=0.5), 64),
+    ("mobilenet_v3_large", dict(num_classes=10, scale=0.5), 64),
+    ("squeezenet1_0", dict(num_classes=10), 64),
+    ("squeezenet1_1", dict(num_classes=10), 64),
+    ("shufflenet_v2_x0_25", dict(num_classes=10), 64),
+    ("shufflenet_v2_swish", dict(num_classes=10), 64),
+    ("densenet121", dict(num_classes=10), 64),
+    ("inception_v3", dict(num_classes=10), 96),
+]
+
+
+@pytest.mark.parametrize("name,kwargs,size", SINGLE_OUT,
+                         ids=[c[0] for c in SINGLE_OUT])
+def test_forward_shape(name, kwargs, size):
+    model = getattr(models, name)(**kwargs)
+    model.eval()
+    out = model(_x(size=size))
+    n_cls = kwargs.get("num_classes", 1000)
+    assert tuple(out.shape) == (1, n_cls)
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_vgg16_bn_forward():
+    model = models.vgg16(batch_norm=True, num_classes=7)
+    model.eval()
+    assert tuple(model(_x()).shape) == (1, 7)
+
+
+def test_googlenet_aux_heads():
+    model = models.googlenet(num_classes=10)
+    model.eval()
+    out, aux1, aux2 = model(_x(size=96))
+    assert tuple(out.shape) == (1, 10)
+    assert tuple(aux1.shape) == (1, 10)
+    assert tuple(aux2.shape) == (1, 10)
+
+
+def test_mobilenet_v2_train_step_runs():
+    """One train step must run through backward (BN train mode, dropout)."""
+    from paddle_tpu import nn, optimizer
+    model = models.mobilenet_v2(num_classes=10, scale=0.25)
+    model.train()
+    opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+    x = _x(n=2)
+    y = paddle_tpu.to_tensor(np.array([1, 3], np.int64))
+    loss = loss_fn(model(x), y)
+    loss.backward()
+    opt.step()
+    assert np.isfinite(float(loss))
+
+
+def test_no_classifier_head():
+    model = models.resnet18(num_classes=0)
+    model.eval()
+    out = model(_x())
+    assert tuple(out.shape) == (1, 512, 1, 1)
