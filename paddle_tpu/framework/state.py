@@ -42,6 +42,7 @@ class _RNG(threading.local):
     def __init__(self):
         self.key_tensor = None
         self.seed_val = 0
+        self.seeded = False  # True once the user called seed() explicitly
 
 
 _rng = _RNG()
@@ -63,6 +64,7 @@ def seed(s: int):
     t = _key_tensor()
     t._set_value(jax.random.key_data(jax.random.key(int(s))))
     _rng.seed_val = int(s)
+    _rng.seeded = True
     return _rng
 
 

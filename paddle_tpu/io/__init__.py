@@ -307,6 +307,7 @@ class DataLoader:
             self.batch_sampler = None
             self.batch_size = batch_size
         self.drop_last = drop_last
+        self._native_loader = None
 
     def __len__(self):
         if self._iterable_mode:
@@ -327,7 +328,67 @@ class DataLoader:
             for idx_batch in self.batch_sampler:
                 yield self.collate_fn([self.dataset[i] for i in idx_batch])
 
+    # ---- native (C++) fast path ----
+    def _native_arrays(self):
+        """Contiguous host arrays backing the dataset, or None. Datasets can
+        opt in by defining native_arrays() (only valid when __getitem__ does
+        no per-sample Python transform work)."""
+        if self.collate_fn is not default_collate_fn:
+            return None
+        if hasattr(self.dataset, "native_arrays"):
+            try:
+                return [np.ascontiguousarray(a)
+                        for a in self.dataset.native_arrays()]
+            except Exception:
+                return None
+        if isinstance(self.dataset, TensorDataset):
+            try:
+                return [np.ascontiguousarray(
+                    t._value if isinstance(t, Tensor) else t)
+                    for t in self.dataset.tensors]
+            except Exception:
+                return None
+        return None
+
+    def _native_iter(self):
+        """C++ epoch pipeline (shuffle+gather+prefetch off-GIL) when the
+        dataset is array-backed and the sampling pattern is expressible
+        (plain sequential/shuffled full-epoch BatchSampler)."""
+        from paddle_tpu import native
+        if not native.available() or self._iterable_mode:
+            return None
+        bs = self.batch_sampler
+        if type(bs) is not BatchSampler:
+            return None
+        if type(bs.sampler) is SequenceSampler:
+            shuffle = False
+        elif type(bs.sampler) is RandomSampler and \
+                not bs.sampler.replacement and bs.sampler._num_samples is None:
+            shuffle = True
+        else:
+            return None
+        if self._native_loader is None:
+            arrays = self._native_arrays()
+            if arrays is None or arrays[0].shape[0] == 0:
+                return None
+            # match the Python path's shuffle entropy: deterministic only
+            # when the user explicitly seeded the framework
+            seed = _rng.seed_val if _rng.seeded else int(
+                np.random.SeedSequence().entropy & ((1 << 63) - 1))
+            self._native_loader = native.NativeLoader(
+                arrays, bs.batch_size, seed=seed, shuffle=shuffle,
+                drop_last=bs.drop_last, nthreads=self.num_workers or None)
+
+        def gen():
+            for bufs in self._native_loader:
+                yield tuple(Tensor(b) for b in bufs)
+        return gen()
+
     def __iter__(self):
+        nat = self._native_iter()
+        if nat is not None:
+            yield from nat
+            return
         if self.num_workers == 0:
             yield from self._iter_batches()
             return

@@ -15,7 +15,7 @@ def create_activation_layer(act):
     if act == "relu":
         return nn.ReLU
     if act is None:
-        return None
+        return nn.Identity   # "no activation" must still be constructible
     raise ValueError(f"unsupported activation {act}")
 
 

@@ -1,0 +1,126 @@
+"""Native (C++) data pipeline: libptdata correctness vs the Python path."""
+import numpy as np
+import pytest
+
+from paddle_tpu import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="libptdata build unavailable")
+
+
+def test_shuffle_is_permutation_and_deterministic():
+    a = native.shuffle_indices(1000, seed=42)
+    b = native.shuffle_indices(1000, seed=42)
+    c = native.shuffle_indices(1000, seed=43)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+    np.testing.assert_array_equal(np.sort(a), np.arange(1000))
+
+
+def test_gather_rows_matches_numpy():
+    rng = np.random.RandomState(0)
+    src = rng.randn(257, 7, 3).astype(np.float32)
+    idx = rng.randint(0, 257, size=100)
+    np.testing.assert_array_equal(native.gather_rows(src, idx), src[idx])
+
+
+def test_shard_indices_cover_dataset():
+    n, nranks = 103, 4
+    shards = [native.shard_indices(n, seed=7, shuffle=True, nranks=nranks,
+                                   rank=r) for r in range(nranks)]
+    per = (n + nranks - 1) // nranks
+    assert all(len(s) == per for s in shards)
+    all_idx = np.concatenate(shards)
+    # padded total covers every sample at least once
+    assert set(all_idx.tolist()) == set(range(n))
+
+
+def test_native_loader_sequential():
+    x = np.arange(20, dtype=np.float32).reshape(10, 2)
+    y = np.arange(10, dtype=np.int64)
+    loader = native.NativeLoader([x, y], batch_size=3, shuffle=False)
+    assert len(loader) == 4
+    got_x, got_y = [], []
+    for bx, by in loader:
+        got_x.append(bx)
+        got_y.append(by)
+    np.testing.assert_array_equal(np.concatenate(got_x), x)
+    np.testing.assert_array_equal(np.concatenate(got_y), y)
+    # second epoch works after auto-reset
+    n2 = sum(1 for _ in loader)
+    assert n2 == 4
+    loader.close()
+
+
+def test_native_loader_shuffle_covers_all():
+    x = np.arange(64, dtype=np.float32).reshape(64, 1)
+    loader = native.NativeLoader([x], batch_size=8, seed=5, shuffle=True)
+    seen = np.concatenate([b[0].ravel() for b in loader])
+    assert set(seen.tolist()) == set(range(64))
+    loader.close()
+
+
+def test_native_loader_drop_last():
+    x = np.zeros((10, 1), np.float32)
+    loader = native.NativeLoader([x], batch_size=3, drop_last=True)
+    assert len(loader) == 3
+    assert sum(b[0].shape[0] for b in loader) == 9
+    loader.close()
+
+
+def test_dataloader_uses_native_path_for_tensordataset():
+    import paddle_tpu
+    from paddle_tpu.io import DataLoader, TensorDataset
+    x = paddle_tpu.to_tensor(np.arange(24, dtype=np.float32).reshape(12, 2))
+    y = paddle_tpu.to_tensor(np.arange(12, dtype=np.int64))
+    dl = DataLoader(TensorDataset([x, y]), batch_size=4)
+    batches = list(dl)
+    assert dl._native_loader is not None, "native path not engaged"
+    assert len(batches) == 3
+    np.testing.assert_array_equal(
+        np.concatenate([np.asarray(b[0]._value) for b in batches]),
+        np.asarray(x._value))
+    # epoch 2
+    assert len(list(dl)) == 3
+
+
+def test_dataloader_python_path_unaffected_by_transform_datasets():
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class Custom(Dataset):
+        def __len__(self):
+            return 6
+
+        def __getitem__(self, i):
+            return np.full((2,), i, np.float32), np.int64(i)
+
+    dl = DataLoader(Custom(), batch_size=2, shuffle=False)
+    batches = list(dl)
+    assert dl._native_loader is None
+    assert len(batches) == 3
+    np.testing.assert_array_equal(np.asarray(batches[0][0]._value),
+                                  [[0, 0], [1, 1]])
+
+
+def test_shard_indices_pad_exceeds_n():
+    # pad > n regression: n=2, nranks=5 must not read out of bounds
+    shards = [native.shard_indices(2, seed=1, shuffle=True, nranks=5, rank=r)
+              for r in range(5)]
+    for s in shards:
+        assert len(s) == 1 and 0 <= s[0] < 2
+
+
+def test_native_loader_restarts_after_early_break():
+    x = np.arange(12, dtype=np.float32).reshape(12, 1)
+    loader = native.NativeLoader([x], batch_size=4, shuffle=False)
+    it = iter(loader)
+    next(it)          # abandon mid-epoch
+    first = next(iter(loader))[0]
+    np.testing.assert_array_equal(first.ravel(), [0, 1, 2, 3])
+    assert sum(1 for _ in loader) == 3
+    loader.close()
+
+
+def test_shufflenet_act_none_constructible():
+    from paddle_tpu.vision.models import ShuffleNetV2
+    ShuffleNetV2(scale=0.25, act=None, num_classes=4)
