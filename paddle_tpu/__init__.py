@@ -60,6 +60,7 @@ from paddle_tpu import (  # noqa: F401,E402
     autograd,
     distributed,
     distribution,
+    fft,
     framework,
     inference,
     io,
@@ -68,6 +69,8 @@ from paddle_tpu import (  # noqa: F401,E402
     metric,
     nn,
     optimizer,
+    profiler,
+    signal,
     static,
     sparse,
     tensor,

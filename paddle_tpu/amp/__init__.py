@@ -1,2 +1,3 @@
 from paddle_tpu.amp.auto_cast import amp_guard, auto_cast, decorate  # noqa: F401
 from paddle_tpu.amp.grad_scaler import GradScaler  # noqa: F401
+from paddle_tpu.amp import debugging  # noqa: F401

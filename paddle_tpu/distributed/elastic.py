@@ -1,0 +1,181 @@
+"""Failure detection / elastic supervision.
+
+Reference parity: python/paddle/distributed/elastic (+ fleet elastic
+manager): etcd-backed node watchdogs that detect dead trainers and
+trigger job restart. TPU-native design: JAX is single-controller per host,
+so in-process failure detection is (a) a step-progress watchdog (training
+stall = hung collective / wedged device — the moral equivalent of a NCCL
+timeout) and (b) multi-host liveness via the jax.distributed coordination
+service, which already evicts dead hosts at barrier timeout. The watchdog
+runs as a daemon thread; on stall it snapshots live stacks (for the bug
+report) and invokes the user callback (default: log + optional abort).
+"""
+from __future__ import annotations
+
+import faulthandler
+import os
+import sys
+import threading
+import time
+
+
+class Watchdog:
+    """Step-progress heartbeat. Call beat() every train step; if no beat
+    arrives within `timeout` seconds the stall callback fires (once per
+    stall episode).
+
+    Usage:
+        wd = Watchdog(timeout=300, abort=True)
+        for batch in loader:
+            train_step(batch)
+            wd.beat(step)
+        wd.stop()
+    """
+
+    def __init__(self, timeout=300.0, on_stall=None, abort=False,
+                 poll_interval=None):
+        self.timeout = float(timeout)
+        self.on_stall = on_stall
+        self.abort = abort
+        self._poll = poll_interval or min(self.timeout / 4, 10.0)
+        self._last_beat = time.monotonic()
+        self._last_step = None
+        self._stalled = False
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="paddle_tpu-watchdog")
+        self._thread.start()
+
+    def beat(self, step=None):
+        self._last_beat = time.monotonic()
+        self._last_step = step
+        self._stalled = False
+
+    def _run(self):
+        while not self._stop.wait(self._poll):
+            idle = time.monotonic() - self._last_beat
+            if idle > self.timeout and not self._stalled:
+                self._stalled = True
+                self._fire(idle)
+
+    def _fire(self, idle):
+        msg = (f"[paddle_tpu.elastic] WATCHDOG: no training progress for "
+               f"{idle:.0f}s (last step {self._last_step}); likely a hung "
+               f"collective or wedged device")
+        print(msg, file=sys.stderr, flush=True)
+        try:
+            faulthandler.dump_traceback(file=sys.stderr)  # live stacks
+        except Exception:
+            pass
+        if self.on_stall is not None:
+            try:
+                self.on_stall(idle, self._last_step)
+            except Exception:
+                pass
+        if self.abort:
+            os._exit(43)  # distinct exit code: watchdog kill -> relaunch
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+class HeartbeatServer:
+    """Multi-host liveness over the jax.distributed KV store: every host
+    publishes a timestamp; rank 0 flags hosts whose heartbeat is stale.
+    Degrades to a no-op in single-process runs."""
+
+    def __init__(self, interval=30.0, stale_after=120.0, on_dead=None):
+        self.interval = interval
+        self.stale_after = stale_after
+        self.on_dead = on_dead
+        self._client = None
+        self._stop = threading.Event()
+        try:
+            from jax._src.distributed import global_state
+            self._client = global_state.client
+        except Exception:
+            self._client = None
+        if self._client is not None:
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+
+    def _run(self):
+        import jax
+        pid = jax.process_index()
+        nproc = jax.process_count()
+        while not self._stop.wait(self.interval):
+            now = str(time.time())
+            try:
+                # fixed key per rank (overwritten each beat) — O(nranks)
+                # store size, not O(beats)
+                try:
+                    self._client.key_value_set(f"ptpu/hb/{pid}", now,
+                                               allow_overwrite=True)
+                except TypeError:  # older client without the kwarg
+                    self._client.key_value_delete(f"ptpu/hb/{pid}")
+                    self._client.key_value_set(f"ptpu/hb/{pid}", now)
+                if pid == 0:
+                    dirs = self._client.key_value_dir_get("ptpu/hb/")
+                    latest = {}
+                    for k, v in dirs:
+                        r = int(k.rsplit("/", 1)[-1])
+                        latest[r] = max(latest.get(r, 0.0), float(v))
+                    cutoff = time.time() - self.stale_after
+                    dead = [r for r in range(nproc)
+                            if latest.get(r, 0.0) < cutoff]
+                    if dead and self.on_dead is not None:
+                        self.on_dead(dead)
+            except Exception:
+                pass
+
+    def stop(self):
+        self._stop.set()
+
+
+class ElasticManager:
+    """Reference: fleet elastic manager — here a thin supervisor combining
+    the step watchdog with host heartbeats."""
+
+    def __init__(self, timeout=300.0, abort_on_stall=True):
+        self.watchdog = Watchdog(timeout=timeout, abort=abort_on_stall)
+        self.heartbeats = HeartbeatServer()
+
+    def beat(self, step=None):
+        self.watchdog.beat(step)
+
+    def stop(self):
+        self.watchdog.stop()
+        self.heartbeats.stop()
+
+
+# ---- global progress hook ------------------------------------------------
+# The launch CLI installs a manager here; Optimizer.step() calls
+# notify_progress() so a watchdog started by the launcher sees heartbeats
+# WITHOUT the training script knowing about it (otherwise a CLI-configured
+# watchdog would fire on perfectly healthy runs).
+_active_manager = None
+_step_counter = [0]
+
+
+def install_manager(manager):
+    global _active_manager
+    _active_manager = manager
+    return manager
+
+
+def get_manager():
+    return _active_manager
+
+
+def notify_progress():
+    if _active_manager is not None:
+        _step_counter[0] += 1
+        _active_manager.beat(_step_counter[0])

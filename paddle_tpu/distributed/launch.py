@@ -1,0 +1,79 @@
+"""Multi-host launcher. Reference: python/paddle/distributed/launch (the
+`python -m paddle.distributed.launch --nnodes ... train.py` CLI that spawns
+per-GPU worker processes and wires NCCL env).
+
+TPU-native design: one process per HOST (JAX single-controller-per-host
+SPMD), not one per chip; coordination over DCN via jax.distributed
+(coordinator address + process id), after which jax.devices() spans every
+chip in the pod slice and the global Mesh covers them. So `launch` just
+initializes the coordination service from CLI/env and execs the training
+script in-process — no worker fan-out needed on a TPU host.
+
+Usage:
+  python -m paddle_tpu.distributed.launch \
+      --master 10.0.0.1:8476 --nnodes 4 --rank $NODE_RANK train.py [args...]
+
+Env fallbacks: PADDLE_MASTER, PADDLE_NNODES, PADDLE_TRAINER_ID (reference
+names), or the standard JAX TPU metadata autodetection when none is given.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import sys
+
+
+def _from_env(args):
+    if args.master is None:
+        args.master = os.environ.get("PADDLE_MASTER")
+    if args.nnodes is None:
+        v = os.environ.get("PADDLE_NNODES")
+        args.nnodes = int(v) if v else None
+    if args.rank is None:
+        v = os.environ.get("PADDLE_TRAINER_ID")
+        args.rank = int(v) if v else None
+    return args
+
+
+def launch(master=None, nnodes=None, rank=None, watchdog_timeout=None):
+    """Initialize multi-host coordination; returns (process_index,
+    process_count). Safe to call on single host (no-op init)."""
+    import jax
+    if master is not None and nnodes and nnodes > 1:
+        jax.distributed.initialize(coordinator_address=master,
+                                   num_processes=nnodes, process_id=rank)
+    else:
+        try:
+            jax.distributed.initialize()  # TPU metadata autodetect
+        except Exception:
+            pass  # single host, no coordination service
+    from paddle_tpu.distributed.mesh import ensure_mesh
+    ensure_mesh()
+    if watchdog_timeout:
+        from paddle_tpu.distributed import elastic
+        # beats arrive via elastic.notify_progress() from Optimizer.step(),
+        # so the script needs no changes for the watchdog to see progress
+        launch._elastic = elastic.install_manager(
+            elastic.ElasticManager(timeout=watchdog_timeout))
+    return jax.process_index(), jax.process_count()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="paddle_tpu.distributed.launch")
+    p.add_argument("--master", default=None,
+                   help="coordinator host:port (rank 0)")
+    p.add_argument("--nnodes", type=int, default=None)
+    p.add_argument("--rank", type=int, default=None, help="this node's rank")
+    p.add_argument("--watchdog-timeout", type=float, default=None)
+    p.add_argument("script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = _from_env(p.parse_args(argv))
+
+    launch(args.master, args.nnodes, args.rank, args.watchdog_timeout)
+    sys.argv = [args.script] + list(args.script_args)
+    runpy.run_path(args.script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,106 @@
+"""paddle_tpu.fft — discrete Fourier transforms.
+
+Reference: python/paddle/fft.py (~1300 lines over phi fft kernels/cuFFT).
+TPU-native: jnp.fft (XLA FFT HLO). Norm conventions follow the reference:
+"backward" (default), "ortho", "forward".
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply
+
+
+def _norm(norm):
+    if norm is None:
+        return "backward"
+    if norm not in ("backward", "ortho", "forward"):
+        raise ValueError(f"invalid norm {norm!r}")
+    return norm
+
+
+def _wrap1(fn):
+    def op(x, n=None, axis=-1, norm=None, name=None):
+        return apply(lambda v: fn(v, n=n, axis=axis, norm=_norm(norm)), x)
+    return op
+
+
+def _wrap2(fn):
+    def op(x, s=None, axes=(-2, -1), norm=None, name=None):
+        return apply(lambda v: fn(v, s=s, axes=axes, norm=_norm(norm)), x)
+    return op
+
+
+def _wrapn(fn):
+    def op(x, s=None, axes=None, norm=None, name=None):
+        return apply(lambda v: fn(v, s=s, axes=axes, norm=_norm(norm)), x)
+    return op
+
+
+fft = _wrap1(jnp.fft.fft)
+ifft = _wrap1(jnp.fft.ifft)
+rfft = _wrap1(jnp.fft.rfft)
+irfft = _wrap1(jnp.fft.irfft)
+hfft = _wrap1(jnp.fft.hfft)
+ihfft = _wrap1(jnp.fft.ihfft)
+
+fft2 = _wrap2(jnp.fft.fft2)
+ifft2 = _wrap2(jnp.fft.ifft2)
+rfft2 = _wrap2(jnp.fft.rfft2)
+irfft2 = _wrap2(jnp.fft.irfft2)
+
+fftn = _wrapn(jnp.fft.fftn)
+ifftn = _wrapn(jnp.fft.ifftn)
+rfftn = _wrapn(jnp.fft.rfftn)
+irfftn = _wrapn(jnp.fft.irfftn)
+
+
+def _swap_norm(norm):
+    # hfft(x, norm) == irfft(conj(x), swapped norm) — the forward-style
+    # Hermitian transform carries the inverse transform's scaling swapped
+    return {"backward": "forward", "forward": "backward",
+            "ortho": "ortho"}[_norm(norm)]
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm=None, name=None):
+    """2-D FFT of Hermitian-symmetric input -> real output with last
+    transformed dim 2*(m-1) (paddle/scipy semantics)."""
+    return apply(lambda v: jnp.fft.irfft2(
+        jnp.conj(v), s=s, axes=axes, norm=_swap_norm(norm)), x)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm=None, name=None):
+    """Inverse of hfft2: real input -> Hermitian half-spectrum
+    (last transformed dim m//2+1)."""
+    return apply(lambda v: jnp.conj(
+        jnp.fft.rfft2(v, s=s, axes=axes, norm=_swap_norm(norm))), x)
+
+
+def hfftn(x, s=None, axes=None, norm=None, name=None):
+    return apply(lambda v: jnp.fft.irfftn(
+        jnp.conj(v), s=s, axes=axes, norm=_swap_norm(norm)), x)
+
+
+def ihfftn(x, s=None, axes=None, norm=None, name=None):
+    return apply(lambda v: jnp.conj(
+        jnp.fft.rfftn(v, s=s, axes=axes, norm=_swap_norm(norm))), x)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from paddle_tpu.core.tensor import Tensor
+    out = jnp.fft.fftfreq(n, d)
+    return Tensor(out.astype(dtype) if dtype else out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from paddle_tpu.core.tensor import Tensor
+    out = jnp.fft.rfftfreq(n, d)
+    return Tensor(out.astype(dtype) if dtype else out)
+
+
+def fftshift(x, axes=None, name=None):
+    return apply(lambda v: jnp.fft.fftshift(v, axes=axes), x)
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply(lambda v: jnp.fft.ifftshift(v, axes=axes), x)

@@ -308,6 +308,8 @@ class DataLoader:
             self.batch_size = batch_size
         self.drop_last = drop_last
         self._native_loader = None
+        self._native_src_ids = None
+        self._native_active = False
 
     def __len__(self):
         if self._iterable_mode:
@@ -337,18 +339,22 @@ class DataLoader:
             return None
         if hasattr(self.dataset, "native_arrays"):
             try:
-                return [np.ascontiguousarray(a)
-                        for a in self.dataset.native_arrays()]
+                arrays = [np.asarray(a) for a in self.dataset.native_arrays()]
             except Exception:
                 return None
-        if isinstance(self.dataset, TensorDataset):
+        elif isinstance(self.dataset, TensorDataset):
             try:
-                return [np.ascontiguousarray(
-                    t._value if isinstance(t, Tensor) else t)
-                    for t in self.dataset.tensors]
+                arrays = [np.asarray(t._value if isinstance(t, Tensor) else t)
+                          for t in self.dataset.tensors]
             except Exception:
                 return None
-        return None
+        else:
+            return None
+        # zero-copy only: a contiguity COPY would silently freeze the data
+        # (in-place mutation visible on the Python path, stale here)
+        if any(not a.flags["C_CONTIGUOUS"] for a in arrays):
+            return None
+        return arrays
 
     def _native_iter(self):
         """C++ epoch pipeline (shuffle+gather+prefetch off-GIL) when the
@@ -367,10 +373,23 @@ class DataLoader:
             shuffle = True
         else:
             return None
-        if self._native_loader is None:
+        if self._native_active:
+            # a live iterator already owns the native stream; nested or
+            # concurrent iteration falls back to the Python path (correct,
+            # independent epochs — just not accelerated)
+            return None
+        src_ids = self._native_source_ids()
+        if src_ids is None:
+            return None
+        if self._native_loader is None or src_ids != self._native_src_ids:
+            # (re)build when the backing tensors were rebound — keeps the
+            # native path semantics aligned with the Python path, which
+            # re-reads the dataset every epoch
             arrays = self._native_arrays()
             if arrays is None or arrays[0].shape[0] == 0:
                 return None
+            if self._native_loader is not None:
+                self._native_loader.close()
             # match the Python path's shuffle entropy: deterministic only
             # when the user explicitly seeded the framework
             seed = _rng.seed_val if _rng.seeded else int(
@@ -378,11 +397,31 @@ class DataLoader:
             self._native_loader = native.NativeLoader(
                 arrays, bs.batch_size, seed=seed, shuffle=shuffle,
                 drop_last=bs.drop_last, nthreads=self.num_workers or None)
+            self._native_src_ids = src_ids
 
         def gen():
-            for bufs in self._native_loader:
-                yield tuple(Tensor(b) for b in bufs)
+            self._native_active = True
+            try:
+                for bufs in self._native_loader:
+                    yield tuple(Tensor(b) for b in bufs)
+            finally:
+                self._native_active = False
         return gen()
+
+    def _native_source_ids(self):
+        """Identity snapshot of the dataset's backing buffers (to detect
+        rebound tensors between epochs). None = not array-backed."""
+        if self.collate_fn is not default_collate_fn:
+            return None
+        if hasattr(self.dataset, "native_arrays"):
+            try:
+                return tuple(id(a) for a in self.dataset.native_arrays())
+            except Exception:
+                return None
+        if isinstance(self.dataset, TensorDataset):
+            return tuple(id(t._value) if isinstance(t, Tensor) else id(t)
+                         for t in self.dataset.tensors)
+        return None
 
     def __iter__(self):
         nat = self._native_iter()

@@ -108,6 +108,8 @@ class Optimizer:
 
     @no_grad()
     def step(self):
+        from paddle_tpu.distributed import elastic
+        elastic.notify_progress()   # launcher-installed watchdog heartbeat
         pg = self._params_grads()
         if self._grad_clip is not None:
             pg = self._grad_clip(pg)

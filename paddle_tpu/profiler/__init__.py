@@ -1,0 +1,201 @@
+"""paddle_tpu.profiler — tracing/profiling subsystem.
+
+Reference: python/paddle/profiler/profiler.py (Profiler with
+ProfilerTargets, scheduler, on_trace_ready exporting Chrome traces via the
+C++ HostTracer/CudaTracer). TPU-native: jax.profiler — traces capture XLA
+compilation, TPU device activity, and host Python, viewable in
+TensorBoard/Perfetto. RecordEvent maps to jax.profiler.TraceAnnotation.
+"""
+from __future__ import annotations
+
+import contextlib
+import enum
+import os
+import time
+
+import jax
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2  # extension: the real target here
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(*, closed, ready, record, repeat=0, skip_first=0):
+    """Step-state scheduler, same shape as the reference's make_scheduler."""
+    total = closed + ready + record
+
+    def schedule(step):
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * total:
+            return ProfilerState.CLOSED
+        pos = s % total
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == total - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    """on_trace_ready callback: jax writes TensorBoard/Perfetto traces into
+    dir_name (the reference writes Chrome json; same consumer workflow)."""
+    def handler(prof):
+        prof._trace_dir = dir_name
+    return handler
+
+
+export_protobuf = export_chrome_tracing
+
+
+class Profiler:
+    """paddle.profiler.Profiler-compatible surface over jax.profiler.
+
+    Usage (same as reference):
+        with Profiler(targets=[...], scheduler=(2, 5)) as p:
+            for batch in loader:
+                train_step(batch)
+                p.step()
+    """
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 record_shapes=False, profile_memory=False, timer_only=False,
+                 emit_nvtx=False, custom_device_types=None):
+        self._trace_dir = os.path.join(os.getcwd(), "profiler_log")
+        self.on_trace_ready = on_trace_ready
+        if on_trace_ready is not None:
+            on_trace_ready(self)
+        if isinstance(scheduler, (tuple, list)):
+            start, end = scheduler
+            self.scheduler = make_scheduler(
+                closed=max(start, 0), ready=0, record=end - start, repeat=1)
+        elif callable(scheduler):
+            self.scheduler = scheduler
+        else:
+            self.scheduler = None
+        self.timer_only = timer_only
+        self.step_num = 0
+        self._active = False
+        self._step_times = []
+        self._last_step_t = None
+
+    # ---- lifecycle ----
+    def start(self):
+        self._last_step_t = time.perf_counter()
+        if self.scheduler is None and not self.timer_only:
+            self._begin_trace()
+
+    def stop(self):
+        if self._active:
+            self._end_trace()
+
+    def _begin_trace(self):
+        if not self._active and not self.timer_only:
+            os.makedirs(self._trace_dir, exist_ok=True)
+            jax.profiler.start_trace(self._trace_dir)
+            self._active = True
+
+    def _end_trace(self):
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            # the reference contract: the callback fires when a recorded
+            # window's trace is ready (init-time call only configures dirs)
+            if self.on_trace_ready is not None:
+                self.on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last_step_t is not None:
+            self._step_times.append(now - self._last_step_t)
+        self._last_step_t = now
+        self.step_num += 1
+        if self.scheduler is not None:
+            state = self.scheduler(self.step_num)
+            if state in (ProfilerState.RECORD,
+                         ProfilerState.RECORD_AND_RETURN):
+                self._begin_trace()
+            else:
+                self._end_trace()
+
+    def step_info(self, unit=None):
+        if not self._step_times:
+            return "no steps recorded"
+        import numpy as np
+        ts = np.asarray(self._step_times)
+        return (f"avg {ts.mean()*1e3:.3f} ms, min {ts.min()*1e3:.3f} ms, "
+                f"max {ts.max()*1e3:.3f} ms over {len(ts)} steps")
+
+    def summary(self, **kwargs):
+        return self.step_info()
+
+    def export(self, path=None, format=None):
+        pass  # traces are written by stop_trace
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+class RecordEvent:
+    """Annotate a host-side region so it shows up on the trace timeline
+    (reference: paddle.profiler.RecordEvent -> here TraceAnnotation)."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._ann = None
+
+    def begin(self):
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+
+    def end(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+@contextlib.contextmanager
+def record_function(name):
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def start_profiler(dir_name="profiler_log"):
+    os.makedirs(dir_name, exist_ok=True)
+    jax.profiler.start_trace(dir_name)
+
+
+def stop_profiler(dir_name=None):
+    jax.profiler.stop_trace()
+
+
+def load_profiler_result(path):
+    raise NotImplementedError(
+        "open the trace directory with TensorBoard or Perfetto")

@@ -1,0 +1,130 @@
+"""paddle_tpu.signal — STFT / ISTFT.
+
+Reference: python/paddle/signal.py (stft/istft over phi frame+fft kernels).
+TPU-native: frame extraction is a gather-free strided reshape under XLA
+(jnp.stack of slices compiles to one windowed gather); FFT via jnp.fft.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.core.tensor import Tensor
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice into overlapping frames (reference: signal.frame). axis=-1:
+    [..., seq] -> [..., frame_length, num_frames]; axis=0: [seq, ...] ->
+    [frame_length, num_frames, ...]."""
+    if axis not in (-1, 0):
+        raise ValueError("frame supports axis -1 or 0")
+
+    def impl(v):
+        if axis == 0:
+            v = jnp.moveaxis(v, 0, -1)         # -> [..., seq]
+        n = v.shape[-1]
+        if frame_length > n:
+            raise ValueError("frame_length > signal length")
+        num = 1 + (n - frame_length) // hop_length
+        starts = jnp.arange(num) * hop_length
+        idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+        out = v[..., idx]                      # [..., num, frame_length]
+        out = jnp.swapaxes(out, -1, -2)        # [..., frame_length, num]
+        if axis == 0:
+            out = jnp.moveaxis(out, (-2, -1), (0, 1))
+        return out
+    return apply(impl, x)
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame (reference: signal.overlap_add). axis=-1 takes
+    [..., frame_length, num_frames]; axis=0 takes
+    [frame_length, num_frames, ...] and returns [seq, ...]."""
+    if axis not in (-1, 0):
+        raise ValueError("overlap_add supports axis -1 or 0")
+
+    def impl(v):
+        if axis == 0:                          # -> [..., fl, num]
+            v = jnp.moveaxis(v, (0, 1), (-2, -1))
+        fl, num = v.shape[-2], v.shape[-1]
+        n = fl + hop_length * (num - 1)
+        out = jnp.zeros(v.shape[:-2] + (n,), v.dtype)
+        idx = (jnp.arange(num) * hop_length)[:, None] + \
+            jnp.arange(fl)[None, :]            # [num, fl]
+        upd = jnp.swapaxes(v, -1, -2)          # [..., num, fl]
+        out = out.at[..., idx].add(upd)
+        if axis == 0:
+            out = jnp.moveaxis(out, -1, 0)     # -> [seq, ...]
+        return out
+    return apply(impl, x)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform, matching the reference's semantics:
+    input [batch?, signal], output [batch?, freq, frames] complex."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    def impl(v, w):
+        if w is None:
+            w = jnp.ones(win_length, v.dtype)
+        if win_length < n_fft:
+            lpad = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+        if center:
+            pads = [(0, 0)] * (v.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            v = jnp.pad(v, pads, mode=pad_mode)
+        n = v.shape[-1]
+        num = 1 + (n - n_fft) // hop_length
+        starts = jnp.arange(num) * hop_length
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+        frames = v[..., idx] * w               # [..., num, n_fft]
+        spec = jnp.fft.rfft(frames, axis=-1) if onesided else \
+            jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.swapaxes(spec, -1, -2)      # [..., freq, frames]
+
+    w = window._value if isinstance(window, Tensor) else window
+    return apply(lambda v: impl(v, w), x)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT (reference: signal.istft): least-squares overlap-add with
+    window-envelope normalization."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    def impl(v, w):
+        if w is None:
+            w = jnp.ones(win_length, jnp.float32)
+        if win_length < n_fft:
+            lpad = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+        spec = jnp.swapaxes(v, -1, -2)         # [..., frames, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided else \
+            jnp.fft.ifft(spec, axis=-1).real
+        frames = frames * w
+        num = frames.shape[-2]
+        n = n_fft + hop_length * (num - 1)
+        idx = (jnp.arange(num) * hop_length)[:, None] + \
+            jnp.arange(n_fft)[None, :]
+        sig = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
+        sig = sig.at[..., idx].add(frames)
+        env = jnp.zeros((n,), frames.dtype).at[idx].add(
+            (w * w)[None, :].repeat(num, 0))
+        sig = sig / jnp.where(env > 1e-11, env, 1.0)
+        if center:
+            sig = sig[..., n_fft // 2: n - n_fft // 2]
+        if length is not None:
+            sig = sig[..., :length]
+        return sig
+
+    w = window._value if isinstance(window, Tensor) else window
+    return apply(lambda v: impl(v, w), x)
