@@ -98,6 +98,7 @@ class HeartbeatServer:
         self.on_dead = on_dead
         self._client = None
         self._stop = threading.Event()
+        self._start_time = time.time()
         try:
             from jax._src.distributed import global_state
             self._client = global_state.client
@@ -129,8 +130,14 @@ class HeartbeatServer:
                         r = int(k.rsplit("/", 1)[-1])
                         latest[r] = max(latest.get(r, 0.0), float(v))
                     cutoff = time.time() - self.stale_after
+                    # a rank with NO heartbeat yet is only "dead" after the
+                    # startup grace period — else slow-starting hosts get
+                    # flagged (and possibly restarted) on rank 0's first poll
+                    grace_over = time.time() - self._start_time > \
+                        self.stale_after
                     dead = [r for r in range(nproc)
-                            if latest.get(r, 0.0) < cutoff]
+                            if (latest[r] < cutoff if r in latest
+                                else grace_over)]
                     if dead and self.on_dead is not None:
                         self.on_dead(dead)
             except Exception:

@@ -40,6 +40,11 @@ def launch(master=None, nnodes=None, rank=None, watchdog_timeout=None):
     """Initialize multi-host coordination; returns (process_index,
     process_count). Safe to call on single host (no-op init)."""
     import jax
+    if master is not None and (nnodes is None or nnodes < 2):
+        raise ValueError(
+            f"--master {master} given but nnodes={nnodes}: a multi-host "
+            "launch needs --nnodes >= 2 (or PADDLE_NNODES); refusing to "
+            "silently train standalone")
     if master is not None and nnodes and nnodes > 1:
         jax.distributed.initialize(coordinator_address=master,
                                    num_processes=nnodes, process_id=rank)

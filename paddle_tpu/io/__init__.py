@@ -373,15 +373,16 @@ class DataLoader:
             shuffle = True
         else:
             return None
-        if self._native_active:
-            # a live iterator already owns the native stream; nested or
-            # concurrent iteration falls back to the Python path (correct,
-            # independent epochs — just not accelerated)
+        srcs = self._native_sources()
+        if srcs is None:
             return None
-        src_ids = self._native_source_ids()
-        if src_ids is None:
-            return None
-        if self._native_loader is None or src_ids != self._native_src_ids:
+        rebuild = self._native_loader is None or \
+            self._native_src_ids is None or \
+            len(srcs) != len(self._native_src_ids) or \
+            any(a is not b for a, b in zip(srcs, self._native_src_ids))
+        if rebuild and self._native_active:
+            return None   # can't swap the loader under a live iterator
+        if rebuild:
             # (re)build when the backing tensors were rebound — keeps the
             # native path semantics aligned with the Python path, which
             # re-reads the dataset every epoch
@@ -397,9 +398,15 @@ class DataLoader:
             self._native_loader = native.NativeLoader(
                 arrays, bs.batch_size, seed=seed, shuffle=shuffle,
                 drop_last=bs.drop_last, nthreads=self.num_workers or None)
-            self._native_src_ids = src_ids
+            self._native_src_ids = srcs   # strong refs: identity is stable
 
         def gen():
+            # claim the native stream at FIRST consumption (not creation):
+            # a second live iterator falls back to the Python path instead
+            # of resetting the shared producer mid-epoch
+            if self._native_active:
+                yield from self._iter_batches()
+                return
             self._native_active = True
             try:
                 for bufs in self._native_loader:
@@ -408,19 +415,20 @@ class DataLoader:
                 self._native_active = False
         return gen()
 
-    def _native_source_ids(self):
-        """Identity snapshot of the dataset's backing buffers (to detect
-        rebound tensors between epochs). None = not array-backed."""
+    def _native_sources(self):
+        """The dataset's backing buffer objects (STRONG refs — identity
+        comparison detects rebinds; holding them prevents id reuse).
+        None = not array-backed."""
         if self.collate_fn is not default_collate_fn:
             return None
         if hasattr(self.dataset, "native_arrays"):
             try:
-                return tuple(id(a) for a in self.dataset.native_arrays())
+                return list(self.dataset.native_arrays())
             except Exception:
                 return None
         if isinstance(self.dataset, TensorDataset):
-            return tuple(id(t._value) if isinstance(t, Tensor) else id(t)
-                         for t in self.dataset.tensors)
+            return [t._value if isinstance(t, Tensor) else t
+                    for t in self.dataset.tensors]
         return None
 
     def __iter__(self):

@@ -41,3 +41,4 @@ from paddle_tpu.models.vit import (  # noqa: F401
     vit_l_16,
     vit_tiny,
 )
+from paddle_tpu.models.deepfm import DeepFM, DeepFMCriterion, SparseEmbeddingBag  # noqa: F401

@@ -96,8 +96,12 @@ class Profiler:
     # ---- lifecycle ----
     def start(self):
         self._last_step_t = time.perf_counter()
-        if self.scheduler is None and not self.timer_only:
+        if self.timer_only:
+            return
+        if self.scheduler is None:
             self._begin_trace()
+        else:
+            self._apply_state(self.scheduler(0))   # batch 0 is traceable
 
     def stop(self):
         if self._active:
@@ -125,12 +129,15 @@ class Profiler:
         self._last_step_t = now
         self.step_num += 1
         if self.scheduler is not None:
-            state = self.scheduler(self.step_num)
-            if state in (ProfilerState.RECORD,
-                         ProfilerState.RECORD_AND_RETURN):
-                self._begin_trace()
-            else:
-                self._end_trace()
+            # step() marks the END of batch step_num-1; the new state covers
+            # the UPCOMING batch step_num
+            self._apply_state(self.scheduler(self.step_num))
+
+    def _apply_state(self, state):
+        if state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            self._begin_trace()
+        else:
+            self._end_trace()
 
     def step_info(self, unit=None):
         if not self._step_times:

@@ -98,6 +98,8 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
     window-envelope normalization."""
     hop_length = hop_length or n_fft // 4
     win_length = win_length or n_fft
+    if return_complex and onesided:
+        raise ValueError("return_complex requires onesided=False")
 
     def impl(v, w):
         if w is None:
@@ -108,8 +110,12 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
         spec = jnp.swapaxes(v, -1, -2)         # [..., frames, freq]
         if normalized:
             spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
-        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided else \
-            jnp.fft.ifft(spec, axis=-1).real
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-1)
+            if not return_complex:
+                frames = frames.real
         frames = frames * w
         num = frames.shape[-2]
         n = n_fft + hop_length * (num - 1)

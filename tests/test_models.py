@@ -120,3 +120,52 @@ class TestViT:
 
         losses = [float(step(x, y)) for _ in range(5)]
         assert losses[-1] < losses[0]
+
+
+class TestDeepFM:
+    def test_forward_backward_and_learns(self):
+        import numpy as np
+        import paddle_tpu
+        from paddle_tpu import optimizer
+        from paddle_tpu.models.deepfm import DeepFM, DeepFMCriterion
+
+        rng = np.random.RandomState(0)
+        model = DeepFM(vocab_size=128, num_fields=6, embedding_dim=8,
+                       dense_dim=4, mlp_sizes=(32, 16))
+        opt = optimizer.Adam(learning_rate=0.01,
+                             parameters=model.parameters())
+        crit = DeepFMCriterion()
+        ids = paddle_tpu.to_tensor(
+            rng.randint(0, 128, (32, 6)).astype(np.int64))
+        dense = paddle_tpu.to_tensor(rng.randn(32, 4).astype(np.float32))
+        # learnable target: label depends on one field's id parity
+        y = paddle_tpu.to_tensor(
+            (np.asarray(ids._value)[:, 0] % 2).astype(np.float32))
+        first = last = None
+        for _ in range(40):
+            opt.clear_grad()
+            loss = crit(model(ids, dense), y)
+            loss.backward()
+            opt.step()
+            first = first if first is not None else float(loss)
+            last = float(loss)
+        assert last < first * 0.5, (first, last)
+
+    def test_sharded_embedding_on_mesh(self):
+        import numpy as np
+        import paddle_tpu
+        from paddle_tpu.distributed import mesh as mesh_mod
+        from paddle_tpu.models.deepfm import SparseEmbeddingBag
+
+        old = mesh_mod.get_mesh()
+        try:
+            mesh_mod.init_mesh({"mp": 8})
+            emb = SparseEmbeddingBag(64, 16, mesh_axis="mp")
+            assert not emb.weight._value.sharding.is_fully_replicated
+            ids = paddle_tpu.to_tensor(np.arange(10, dtype=np.int64))
+            out = emb(ids)
+            np.testing.assert_allclose(
+                np.asarray(out._value),
+                np.asarray(emb.weight._value)[:10], atol=1e-6)
+        finally:
+            mesh_mod.set_mesh(old)
