@@ -31,3 +31,16 @@ from paddle_tpu.tensor.linalg import (  # noqa: F401
     triangular_solve,
     vector_norm,
 )
+
+# tensor-namespace linear algebra also exposed here (reference parity:
+# python/paddle/linalg.py re-exports these from paddle.tensor.linalg)
+from paddle_tpu.tensor.linalg import (  # noqa: F401
+    bmm,
+    cross,
+    dist,
+    dot,
+    mv,
+    t,
+    transpose,
+)
+from paddle_tpu.tensor.stat import histogram  # noqa: F401

@@ -434,3 +434,34 @@ def hsplit(x, num_or_indices, name=None):
 
 def vsplit(x, num_or_indices, name=None):
     return tensor_split(x, num_or_indices, axis=0)
+
+
+def unbind(input, axis=0, name=None):
+    """Split into a list of tensors along axis, removing it (reference:
+    python/paddle/tensor/manipulation.py unbind)."""
+    n = input.shape[axis]
+    from paddle_tpu.core.dispatch import apply
+    return [apply(lambda v, i=i: jnp.take(v, i, axis=axis), input)
+            for i in range(n)]
+
+
+def tensordot(x, y, axes=2, name=None):
+    """Reference: python/paddle/tensor/manipulation.py tensordot."""
+    from paddle_tpu.core.dispatch import apply
+    from paddle_tpu.core.tensor import Tensor
+    ax = axes
+    if isinstance(ax, Tensor):
+        ax = np.asarray(ax._value).tolist()
+    if isinstance(ax, (list, tuple)):
+        if all(isinstance(a, (int, np.integer)) for a in ax):
+            # paddle semantics: a FLAT int sequence names the contracted
+            # axes of BOTH operands
+            flat = tuple(int(a) for a in ax)
+            ax = (flat, flat)
+        else:
+            ax = tuple(tuple(np.asarray(
+                a._value if isinstance(a, Tensor) else a).ravel().tolist())
+                for a in ax)
+            if len(ax) == 1:
+                ax = (ax[0], ax[0])
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=ax), x, y)

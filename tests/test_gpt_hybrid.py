@@ -1,0 +1,80 @@
+"""4-D hybrid GPT (dp×pp×tp×sp explicit shard_map program): the 8-device
+hybrid must match the same math on a 1-device mesh — loss AND grads — and
+train."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.models.gpt import GPTConfig
+from paddle_tpu.models.gpt_hybrid import (
+    init_hybrid_gpt_params,
+    make_hybrid_loss_fn,
+    make_hybrid_train_step,
+)
+
+
+def _cfg():
+    return GPTConfig(vocab_size=96, hidden_size=32, num_layers=4,
+                     num_heads=4, max_seq_len=64, dropout=0.0)
+
+
+def _data(mesh):
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 96, (4, 32)).astype(np.int32)
+    labels = rng.integers(0, 96, (4, 32)).astype(np.int32)
+    sh = NamedSharding(mesh, P("dp", "sp"))
+    return jax.device_put(ids, sh), jax.device_put(labels, sh)
+
+
+def _host_params(params):
+    return jax.tree_util.tree_map(np.asarray, params)
+
+
+@pytest.fixture
+def meshes():
+    old = mesh_mod.get_mesh()
+    yield
+    mesh_mod.set_mesh(old)
+
+
+def test_hybrid_matches_single_device(meshes):
+    cfg = _cfg()
+    mesh8 = mesh_mod.init_mesh({"dp": 1, "pp": 2, "tp": 2, "sp": 2})
+    params8 = init_hybrid_gpt_params(cfg, mesh8, seed=0)
+    host = _host_params(params8)
+
+    loss8 = make_hybrid_loss_fn(cfg, mesh8, num_microbatches=2)
+    ids8, labels8 = _data(mesh8)
+    l8, g8 = jax.jit(jax.value_and_grad(loss8))(params8, ids8, labels8)
+
+    mesh1 = mesh_mod.init_mesh(
+        {"dp": 1, "pp": 1, "tp": 1, "sp": 1}, devices=jax.devices()[:1])
+    params1 = jax.tree_util.tree_map(jnp.asarray, host)
+    loss1 = make_hybrid_loss_fn(cfg, mesh1, num_microbatches=2)
+    ids1, labels1 = _data(mesh1)
+    l1, g1 = jax.jit(jax.value_and_grad(loss1))(params1, ids1, labels1)
+
+    np.testing.assert_allclose(float(l8), float(l1), rtol=2e-5)
+    flat8 = jax.tree_util.tree_leaves(g8)
+    flat1 = jax.tree_util.tree_leaves(g1)
+    for a, b in zip(flat8, flat1):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-3)
+
+
+def test_hybrid_trains(meshes):
+    cfg = _cfg()
+    mesh = mesh_mod.init_mesh({"dp": 2, "pp": 2, "tp": 2, "sp": 1})
+    params = init_hybrid_gpt_params(cfg, mesh, seed=0)
+    step = make_hybrid_train_step(cfg, mesh, lr=0.1, num_microbatches=2)
+    ids, labels = _data(mesh)
+    losses = []
+    for _ in range(6):
+        params, loss = step(params, ids, labels)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
