@@ -67,31 +67,67 @@ class Model:
         out = self.network(*inputs)
         return [out.numpy() if isinstance(out, Tensor) else out]
 
+    def _init_callbacks(self, callbacks, epochs, save_dir, save_freq,
+                        verbose):
+        from paddle_tpu.hapi.callbacks import ModelCheckpoint
+        cbs = list(callbacks) if callbacks else []
+        if save_dir and not any(isinstance(c, ModelCheckpoint) for c in cbs):
+            cbs.append(ModelCheckpoint(save_freq=save_freq,
+                                       save_dir=save_dir))
+        for c in cbs:
+            c.set_model(self)
+            c.set_params({"epochs": epochs, "verbose": verbose})
+        return cbs
+
+    @staticmethod
+    def _cb(cbs, hook, *args):
+        for c in cbs:
+            getattr(c, hook)(*args)
+
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None):
-        from paddle_tpu.io import DataLoader, Dataset
+        from paddle_tpu.io import DataLoader
         loader = train_data if isinstance(train_data, DataLoader) else \
             DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
                        drop_last=drop_last, num_workers=num_workers)
+        cbs = self._init_callbacks(callbacks, epochs, save_dir, save_freq,
+                                   verbose)
+        self._cb(cbs, "on_train_begin")
         history = []
+        res = None
         for epoch in range(epochs):
+            self._cb(cbs, "on_epoch_begin", epoch)
             for m in self._metrics:
                 m.reset()
             it = 0
+            loss_val = None
             for batch in loader:
                 data, label = batch[0], batch[1]
+                self._cb(cbs, "on_train_batch_begin", it)
                 res = self.train_batch(data, label)
+                loss_val = res[0][0] if isinstance(res, tuple) else res[0]
+                self._cb(cbs, "on_train_batch_end", it,
+                         {"loss": [loss_val]})
                 it += 1
                 if num_iters is not None and it >= num_iters:
                     break
                 if verbose and log_freq and it % log_freq == 0:
-                    loss_val = res[0][0] if isinstance(res, tuple) else res[0]
                     print(f"epoch {epoch} step {it}: loss={loss_val:.4f}")
             history.append(res)
+            logs = {"loss": [loss_val]} if loss_val is not None else {}
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_data, batch_size=batch_size, verbose=verbose)
+                eval_out = self.evaluate(eval_data, batch_size=batch_size,
+                                         verbose=verbose)
+                # paddle hapi convention: eval results carry eval_ prefix so
+                # the train 'loss' survives in the epoch logs
+                logs.update({f"eval_{k}": v for k, v in eval_out.items()})
+                self._cb(cbs, "on_eval_end", eval_out)
+            self._cb(cbs, "on_epoch_end", epoch, logs)
+            if any(getattr(c, "stop_training", False) for c in cbs):
+                break
+        self._cb(cbs, "on_train_end")
         return history
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
