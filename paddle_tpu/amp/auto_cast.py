@@ -14,8 +14,10 @@ import jax.numpy as jnp
 from paddle_tpu.core.dtype import convert_dtype
 
 # ops whose inputs are cast down at O1 (matmul/conv-class = MXU ops)
-WHITE_LIST = {"matmul", "mm", "bmm", "conv1d", "conv2d", "conv3d", "linear",
-              "einsum", "fn"}
+WHITE_LIST = {"matmul", "mm", "bmm", "mv", "dot", "addmm",
+              "conv1d", "conv2d", "conv3d",
+              "conv1d_transpose", "conv2d_transpose", "conv3d_transpose",
+              "linear", "einsum"}
 # ops kept in fp32 for stability
 BLACK_LIST = {"softmax", "log_softmax", "cross_entropy", "layer_norm", "norm",
               "mean", "sum", "exp", "log", "logsumexp"}
@@ -81,16 +83,23 @@ def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
     return (models, optimizers)
 
 
-def maybe_autocast_value(opname, v):
-    """Hook for the dispatch layer: cast per white/black list when enabled."""
+def downcast_inputs(*arrays, opname="matmul"):
+    """The autocast hook, called INSIDE the op implementations.
+
+    White-listed (MXU-class) ops: fp32 inputs drop to the autocast dtype so
+    the contraction runs in bf16. Black-listed ops (incl. custom): low-
+    precision inputs are raised to fp32 for stability (matters under O2
+    where params live in bf16). Anything else passes through."""
     if not _state.enabled:
-        return v
-    name = opname
-    if name in (_state.custom_black | BLACK_LIST):
-        if v.dtype in (jnp.bfloat16, jnp.float16):
-            return v.astype(jnp.float32)
-        return v
-    if name in (_state.custom_white | WHITE_LIST):
-        if v.dtype == jnp.float32:
-            return v.astype(_state.dtype)
-    return v
+        return arrays
+    if opname in (_state.custom_black | BLACK_LIST):
+        return tuple(
+            a.astype(jnp.float32)
+            if hasattr(a, "dtype") and a.dtype in (jnp.bfloat16, jnp.float16)
+            else a for a in arrays)
+    if opname in (_state.custom_white | WHITE_LIST):
+        return tuple(
+            a.astype(_state.dtype)
+            if hasattr(a, "dtype") and a.dtype == jnp.float32 else a
+            for a in arrays)
+    return arrays

@@ -161,6 +161,15 @@ class Beta(Distribution):
                 jnp.log1p(-v) - betaln(self.alpha, self.beta)
         return apply(fn, value)
 
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        a, b = self.alpha, self.beta
+        return Tensor(a * b / ((a + b) ** 2 * (a + b + 1)))
+
     def entropy(self):
         from jax.scipy.special import betaln, digamma
         a, b = self.alpha, self.beta

@@ -12,9 +12,11 @@ from paddle_tpu.framework.state import next_key
 def linear(x, weight, bias=None, name=None):
     """y = x @ W + b with W: [in, out] (paddle layout -> MXU matmul)."""
     def fn(v, w, b):
+        from paddle_tpu.amp.auto_cast import downcast_inputs
+        v, w = downcast_inputs(v, w, opname="linear")
         y = jnp.matmul(v, w)
         if b is not None:
-            y = y + b
+            y = y + b.astype(y.dtype)
         return y
     return apply(fn, x, weight, bias)
 

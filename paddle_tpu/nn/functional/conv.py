@@ -60,6 +60,8 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, nd, data_format):
     lhs_dn, rhs_dn, out_dn = _dim_numbers(nd, channel_last)
 
     def fn(v, w, b):
+        from paddle_tpu.amp.auto_cast import downcast_inputs
+        v, w = downcast_inputs(v, w, opname=f"conv{nd}d")
         # paddle weight layout is [out_c, in_c/groups, *k] == OIHW
         if channel_last:
             perm = tuple(range(2, 2 + nd)) + (1, 0)  # OIHW->HWIO
@@ -72,7 +74,7 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, nd, data_format):
         if b is not None:
             shape = [1] * out.ndim
             shape[out_dn.index("C")] = b.shape[0]
-            out = out + b.reshape(shape)
+            out = out + b.reshape(shape).astype(out.dtype)
         return out
     return apply(fn, x, weight, bias)
 
@@ -103,6 +105,8 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, groups,
     lhs_dn, rhs_dn, out_dn = _dim_numbers(nd, channel_last)
 
     def fn(v, w, b):
+        from paddle_tpu.amp.auto_cast import downcast_inputs
+        v, w = downcast_inputs(v, w, opname=f"conv{nd}d_transpose")
         # paddle transpose-conv weight layout: [in_c, out_c/groups, *k]
         # grad-of-conv formulation: conv with transposed spatial dilation
         if isinstance(pad, str):
@@ -150,7 +154,7 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, groups,
         if b is not None:
             shape = [1] * out.ndim
             shape[out_dn.index("C")] = b.shape[0]
-            out = out + b.reshape(shape)
+            out = out + b.reshape(shape).astype(out.dtype)
         return out
     return apply(fn, x, weight, bias)
 

@@ -11,4 +11,8 @@ from paddle_tpu.core.dispatch import apply
 
 
 def einsum(equation, *operands):
-    return apply(lambda *vs: jnp.einsum(equation, *vs), *operands)
+    def fn(*vs):
+        from paddle_tpu.amp.auto_cast import downcast_inputs
+        vs = downcast_inputs(*vs, opname="einsum")
+        return jnp.einsum(equation, *vs)
+    return apply(fn, *operands)

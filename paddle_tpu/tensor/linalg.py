@@ -15,15 +15,27 @@ from paddle_tpu.tensor.math import matmul, mm  # noqa: F401 re-export
 
 
 def dot(x, y, name=None):
-    return apply(lambda a, b: jnp.sum(a * b, axis=-1), x, y)
+    def fn(a, b):
+        from paddle_tpu.amp.auto_cast import downcast_inputs
+        a, b = downcast_inputs(a, b, opname="dot")
+        return jnp.sum(a * b, axis=-1)
+    return apply(fn, x, y)
 
 
 def bmm(x, y, name=None):
-    return apply(jnp.matmul, x, y)
+    def fn(a, b):
+        from paddle_tpu.amp.auto_cast import downcast_inputs
+        a, b = downcast_inputs(a, b, opname="bmm")
+        return jnp.matmul(a, b)
+    return apply(fn, x, y)
 
 
 def mv(x, vec, name=None):
-    return apply(jnp.matmul, x, vec)
+    def fn(a, b):
+        from paddle_tpu.amp.auto_cast import downcast_inputs
+        a, b = downcast_inputs(a, b, opname="mv")
+        return jnp.matmul(a, b)
+    return apply(fn, x, vec)
 
 
 def t(input, name=None):

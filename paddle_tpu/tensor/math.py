@@ -324,11 +324,17 @@ def add_n(inputs, name=None):
 
 
 def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
-    return apply(lambda i, a, b: beta * i + alpha * (a @ b), input, x, y)
+    def fn(i, a, b):
+        from paddle_tpu.amp.auto_cast import downcast_inputs
+        a, b = downcast_inputs(a, b, opname="addmm")
+        return beta * i + alpha * (a @ b).astype(i.dtype)
+    return apply(fn, input, x, y)
 
 
 def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
     def fn(a, b):
+        from paddle_tpu.amp.auto_cast import downcast_inputs
+        a, b = downcast_inputs(a, b, opname="matmul")
         if transpose_x:
             a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
         if transpose_y:
@@ -338,7 +344,11 @@ def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
 
 
 def mm(input, mat2, name=None):
-    return apply(jnp.matmul, input, mat2)
+    def fn(a, b):
+        from paddle_tpu.amp.auto_cast import downcast_inputs
+        a, b = downcast_inputs(a, b, opname="mm")
+        return jnp.matmul(a, b)
+    return apply(fn, input, mat2)
 
 
 def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):

@@ -1,0 +1,129 @@
+"""amp (auto_cast + GradScaler), paddle.metric, paddle.distribution."""
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import amp, metric, nn, optimizer
+from paddle_tpu import distribution as D
+
+
+class TestAutoCast:
+    def test_matmul_runs_bf16_inside_autocast(self):
+        x = paddle_tpu.ones([4, 4], dtype="float32")
+        with amp.auto_cast(level="O1", dtype="bfloat16"):
+            y = paddle_tpu.matmul(x, x)
+        assert str(y.dtype).endswith("bfloat16")
+        y2 = paddle_tpu.matmul(x, x)
+        assert str(y2.dtype).endswith("float32")
+
+    def test_training_under_autocast_converges(self):
+        rng = np.random.RandomState(0)
+        model = nn.Linear(8, 1)
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=model.parameters())
+        x = paddle_tpu.to_tensor(rng.randn(32, 8).astype(np.float32))
+        y = paddle_tpu.to_tensor(rng.randn(32, 1).astype(np.float32))
+        losses = []
+        for _ in range(20):
+            opt.clear_grad()
+            with amp.auto_cast(dtype="bfloat16"):
+                loss = nn.MSELoss()(model(x), y)
+            loss.backward()
+            opt.step()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5
+
+
+class TestGradScaler:
+    def test_scale_and_step(self):
+        model = nn.Linear(4, 1)
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=model.parameters())
+        scaler = amp.GradScaler(init_loss_scaling=128.0)
+        x = paddle_tpu.ones([2, 4])
+        w_before = np.asarray(model.weight._value).copy()
+        loss = model(x).sum()
+        scaled = scaler.scale(loss)
+        assert abs(float(scaled) - float(loss) * 128.0) < 1e-3
+        scaled.backward()
+        scaler.step(opt)
+        scaler.update()
+        # gradient was unscaled before the update: step size reflects the
+        # TRUE gradient, not 128x it
+        w_after = np.asarray(model.weight._value)
+        np.testing.assert_allclose(w_after, w_before - 0.1 * 2.0, atol=1e-5)
+
+    def test_inf_grad_skips_step_and_decays_scale(self):
+        model = nn.Linear(2, 1)
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=model.parameters())
+        scaler = amp.GradScaler(init_loss_scaling=64.0,
+                                decr_every_n_nan_or_inf=1)
+        w_before = np.asarray(model.weight._value).copy()
+        x = paddle_tpu.to_tensor(np.array([[np.inf, 1.0]], np.float32))
+        loss = model(x).sum()
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_allclose(np.asarray(model.weight._value), w_before)
+        assert float(scaler._scale._value) < 64.0
+
+
+class TestMetrics:
+    def test_accuracy(self):
+        m = metric.Accuracy()
+        pred = paddle_tpu.to_tensor(
+            np.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]], np.float32))
+        label = paddle_tpu.to_tensor(np.array([[0], [1], [1]], np.int64))
+        m.update(m.compute(pred, label).numpy())
+        assert abs(m.accumulate() - 2.0 / 3.0) < 1e-6
+
+    def test_precision_recall(self):
+        preds = np.array([0.8, 0.4, 0.9, 0.2], np.float32)  # -> 1,0,1,0
+        labels = np.array([1, 1, 0, 0], np.int64)
+        p = metric.Precision()
+        p.update(preds, labels)
+        assert abs(p.accumulate() - 0.5) < 1e-6      # tp=1 fp=1
+        r = metric.Recall()
+        r.update(preds, labels)
+        assert abs(r.accumulate() - 0.5) < 1e-6      # tp=1 fn=1
+
+    def test_auc(self):
+        m = metric.Auc()
+        preds = np.stack([1 - np.array([0.1, 0.4, 0.35, 0.8]),
+                          np.array([0.1, 0.4, 0.35, 0.8])], axis=1)
+        labels = np.array([[0], [0], [1], [1]])
+        m.update(preds, labels)
+        assert abs(m.accumulate() - 0.75) < 0.05
+
+
+class TestDistributions:
+    def test_normal_sample_logprob(self):
+        d = D.Normal(loc=0.0, scale=2.0)
+        s = d.sample([2000])
+        arr = np.asarray(s._value if hasattr(s, "_value") else s)
+        assert abs(arr.std() - 2.0) < 0.2
+        lp = d.log_prob(paddle_tpu.to_tensor(np.array([0.0], np.float32)))
+        ref = -0.5 * np.log(2 * np.pi * 4.0)
+        np.testing.assert_allclose(np.asarray(lp._value), [ref], atol=1e-5)
+
+    def test_categorical(self):
+        probs = np.array([0.2, 0.3, 0.5], np.float32)
+        d = D.Categorical(paddle_tpu.to_tensor(np.log(probs)))
+        s = np.asarray(d.sample([4000])._value)
+        freq = np.bincount(s, minlength=3) / 4000
+        np.testing.assert_allclose(freq, probs, atol=0.05)
+
+    def test_kl_normal(self):
+        p = D.Normal(loc=0.0, scale=1.0)
+        q = D.Normal(loc=1.0, scale=1.0)
+        kl = D.kl_divergence(p, q)
+        np.testing.assert_allclose(np.asarray(kl._value), 0.5, atol=1e-5)
+
+    def test_beta_dirichlet_shapes(self):
+        b = D.Beta(paddle_tpu.to_tensor(2.0), paddle_tpu.to_tensor(3.0))
+        assert abs(float(b.mean) - 0.4) < 1e-5
+        dd = D.Dirichlet(paddle_tpu.to_tensor(
+            np.array([1.0, 2.0, 3.0], np.float32)))
+        s = np.asarray(dd.sample([10])._value)
+        np.testing.assert_allclose(s.sum(-1), np.ones(10), atol=1e-5)
