@@ -13,8 +13,10 @@ import jax.numpy as jnp
 
 from paddle_tpu.core.dtype import convert_dtype
 
-# ops whose inputs are cast down at O1 (matmul/conv-class = MXU ops)
-WHITE_LIST = {"matmul", "mm", "bmm", "mv", "dot", "addmm",
+# ops whose inputs are cast down at O1 (matmul/conv-class = MXU ops; each
+# implementation calls downcast_inputs(opname=...) at its entry — explicit
+# per-op instrumentation, since the generic dispatch funnel has no op names)
+WHITE_LIST = {"matmul", "mm", "bmm", "mv", "addmm",
               "conv1d", "conv2d", "conv3d",
               "conv1d_transpose", "conv2d_transpose", "conv3d_transpose",
               "linear", "einsum"}

@@ -114,6 +114,8 @@ def softmax(x, axis=-1, dtype=None, name=None):
     def fn(v):
         if dt is not None:
             v = v.astype(dt)
+        from paddle_tpu.amp.auto_cast import downcast_inputs
+        (v,) = downcast_inputs(v, opname="softmax")
         return jax.nn.softmax(v, axis=axis)
     return apply(fn, x)
 
@@ -128,6 +130,8 @@ def log_softmax(x, axis=-1, dtype=None, name=None):
     def fn(v):
         if dt is not None:
             v = v.astype(dt)
+        from paddle_tpu.amp.auto_cast import downcast_inputs
+        (v,) = downcast_inputs(v, opname="log_softmax")
         return jax.nn.log_softmax(v, axis=axis)
     return apply(fn, x)
 

@@ -19,6 +19,8 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
                   soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
                   name=None):
     def fn(logits, lab, w):
+        from paddle_tpu.amp.auto_cast import downcast_inputs
+        (logits,) = downcast_inputs(logits, opname="cross_entropy")
         logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(
             jnp.maximum(logits, 1e-30))
         c = logits.shape[axis]

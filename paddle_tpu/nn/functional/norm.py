@@ -6,6 +6,7 @@ functionally under to_static); in eval mode it uses running stats.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -30,36 +31,50 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         # compute batch stats and update running stats (paddle: r = m*r + (1-m)*b)
         def fn(v, rm, rv, w, b):
             axes = tuple(i for i in range(v.ndim) if i != channel_axis % v.ndim)
-            mean = jnp.mean(v, axis=axes)
-            var = jnp.var(v, axis=axes)
+            # statistics in fp32 regardless of activation dtype (bf16 sums
+            # over N*H*W elements lose too many bits); output keeps v.dtype
+            vf = v.astype(jnp.float32)
+            mean = jnp.mean(vf, axis=axes)
+            var = jnp.var(vf, axis=axes)
             shape = [1] * v.ndim
             shape[channel_axis % v.ndim] = -1
-            out = (v - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + epsilon)
+            out = (vf - mean.reshape(shape)) * jax.lax.rsqrt(
+                var.reshape(shape) + epsilon)
             if w is not None:
-                out = out * w.reshape(shape)
+                out = out * w.reshape(shape).astype(jnp.float32)
             if b is not None:
-                out = out + b.reshape(shape)
-            return out, mean, var
+                out = out + b.reshape(shape).astype(jnp.float32)
+            return out.astype(v.dtype), mean, var
         out, mean_t, var_t = apply(fn, x, running_mean, running_var, weight, bias)
         with no_grad():
             n = int(np.prod([s for i, s in enumerate(x.shape)
                              if i != channel_axis % x.ndim]))
             unbias = n / max(n - 1, 1)
+            # update in fp32, then cast BACK to the buffer dtype — the fp32
+            # stats must not silently promote bf16 (O2) running buffers
+            rm_dt = running_mean._value.dtype
+            rv_dt = running_var._value.dtype
             running_mean._set_value(
-                momentum * running_mean._value + (1 - momentum) * mean_t._value)
+                (momentum * running_mean._value.astype(jnp.float32) +
+                 (1 - momentum) * mean_t._value).astype(rm_dt))
             running_var._set_value(
-                momentum * running_var._value + (1 - momentum) * var_t._value * unbias)
+                (momentum * running_var._value.astype(jnp.float32) +
+                 (1 - momentum) * var_t._value * unbias).astype(rv_dt))
         return out
 
     def fn_eval(v, rm, rv, w, b):
         shape = [1] * v.ndim
         shape[channel_axis % v.ndim] = -1
-        out = (v - rm.reshape(shape)) / jnp.sqrt(rv.reshape(shape) + epsilon)
+        # normalize in fp32 (stats/affine may be bf16 under O2 decorate);
+        # output keeps the activation dtype
+        inv = jax.lax.rsqrt(rv.reshape(shape).astype(jnp.float32) + epsilon)
+        out = (v.astype(jnp.float32) -
+               rm.reshape(shape).astype(jnp.float32)) * inv
         if w is not None:
-            out = out * w.reshape(shape)
+            out = out * w.reshape(shape).astype(jnp.float32)
         if b is not None:
-            out = out + b.reshape(shape)
-        return out
+            out = out + b.reshape(shape).astype(jnp.float32)
+        return out.astype(v.dtype)
     return apply(fn_eval, x, running_mean, running_var, weight, bias)
 
 
@@ -79,6 +94,8 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=
             pass
 
     def fn(v, w, b):
+        from paddle_tpu.amp.auto_cast import downcast_inputs
+        (v,) = downcast_inputs(v, opname="layer_norm")
         axes = tuple(range(v.ndim - nd, v.ndim))
         mean = jnp.mean(v, axis=axes, keepdims=True)
         var = jnp.var(v, axis=axes, keepdims=True)

@@ -15,11 +15,9 @@ from paddle_tpu.tensor.math import matmul, mm  # noqa: F401 re-export
 
 
 def dot(x, y, name=None):
-    def fn(a, b):
-        from paddle_tpu.amp.auto_cast import downcast_inputs
-        a, b = downcast_inputs(a, b, opname="dot")
-        return jnp.sum(a * b, axis=-1)
-    return apply(fn, x, y)
+    # NOT autocast-white-listed: this lowers to an elementwise sum, which
+    # would accumulate in bf16 (unlike matmul's fp32 MXU accumulator)
+    return apply(lambda a, b: jnp.sum(a * b, axis=-1), x, y)
 
 
 def bmm(x, y, name=None):

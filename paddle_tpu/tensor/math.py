@@ -248,7 +248,12 @@ amin = min
 
 
 def logsumexp(x, axis=None, keepdim=False, name=None):
-    return apply(lambda v: jax.scipy.special.logsumexp(v, axis=_axis(axis), keepdims=keepdim), x)
+    def fn(v):
+        from paddle_tpu.amp.auto_cast import downcast_inputs
+        (v,) = downcast_inputs(v, opname="logsumexp")
+        return jax.scipy.special.logsumexp(v, axis=_axis(axis),
+                                           keepdims=keepdim)
+    return apply(fn, x)
 
 
 def count_nonzero(x, axis=None, keepdim=False, name=None):
@@ -327,7 +332,8 @@ def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
     def fn(i, a, b):
         from paddle_tpu.amp.auto_cast import downcast_inputs
         a, b = downcast_inputs(a, b, opname="addmm")
-        return beta * i + alpha * (a @ b).astype(i.dtype)
+        # normal promotion semantics: a bf16 product + fp32 input -> fp32
+        return beta * i + alpha * (a @ b)
     return apply(fn, input, x, y)
 
 

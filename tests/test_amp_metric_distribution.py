@@ -127,3 +127,29 @@ class TestDistributions:
             np.array([1.0, 2.0, 3.0], np.float32)))
         s = np.asarray(dd.sample([10])._value)
         np.testing.assert_allclose(s.sum(-1), np.ones(10), atol=1e-5)
+
+
+class TestAutoCastBlackList:
+    def test_softmax_upcasts_bf16_under_amp(self):
+        import paddle_tpu.nn.functional as F
+        x = paddle_tpu.ones([2, 8], dtype="bfloat16")
+        with amp.auto_cast(dtype="bfloat16"):
+            out = F.softmax(x)
+        assert "float32" in str(out.dtype)
+
+    def test_custom_black_list_blocks_matmul_downcast(self):
+        x = paddle_tpu.ones([4, 4], dtype="float32")
+        with amp.auto_cast(dtype="bfloat16", custom_black_list={"matmul"}):
+            y = paddle_tpu.matmul(x, x)
+        assert "float32" in str(y.dtype)
+
+    def test_bn_running_stats_keep_buffer_dtype(self):
+        model = nn.BatchNorm2D(3)
+        model.train()
+        x = paddle_tpu.ones([2, 3, 4, 4], dtype="float32")
+        model(x)
+        assert "float32" in str(model._mean.dtype)
+        # bf16 buffers (O2) must stay bf16 after a train step
+        model.to(dtype="bfloat16")
+        model(paddle_tpu.ones([2, 3, 4, 4], dtype="bfloat16"))
+        assert "bfloat16" in str(model._mean.dtype)
