@@ -92,7 +92,17 @@ class Predictor:
     def __init__(self, config):
         self.config = config
         self._model = getattr(config, "_model", None)
-        if self._model is None and config.params_file:
+        self._translated = None
+        if self._model is None and config.prog_file:
+            # serialized StableHLO program (jit.save with input_spec):
+            # reload + run with no Python model class
+            prefix = config.prog_file
+            if prefix.endswith(".pdmodel"):
+                prefix = prefix[: -len(".pdmodel")]
+            from paddle_tpu.jit.serialization import load_program
+            self._translated = load_program(
+                prefix, params_path=config.params_file or None)
+        elif self._model is None and config.params_file:
             import pickle
             with open(config.params_file, "rb") as f:
                 self._params = pickle.load(f)
@@ -119,21 +129,8 @@ class Predictor:
         if key not in self._compiled:
             model = self._model
             params = {k: v._value for k, v in model.state_dict().items()}
-
-            def fwd(params_vals, xs):
-                sd = model.state_dict()
-                saved = [(t, t._value) for t in sd.values()]
-                try:
-                    for (k, t) in sd.items():
-                        t._value = params_vals[k]
-                    outs = model(*[Tensor(x) for x in xs])
-                    if isinstance(outs, (list, tuple)):
-                        return [o._value for o in outs]
-                    return [outs._value]
-                finally:
-                    for t, v in saved:
-                        t._value = v
-            self._compiled[key] = (jax.jit(fwd), params)
+            from paddle_tpu.jit.serialization import functional_forward
+            self._compiled[key] = (jax.jit(functional_forward(model)), params)
         return self._compiled[key]
 
     def run(self, inputs=None):
@@ -141,8 +138,13 @@ class Predictor:
             arrs = [jnp.asarray(np.asarray(x)) for x in inputs]
         else:
             arrs = [self._inputs[k] for k in sorted(self._inputs)]
-        fn, params = self._get_compiled(arrs)
-        outs = fn(params, arrs)
+        if self._translated is not None:
+            out = self._translated(*arrs)
+            outs = [o._value for o in (out if isinstance(out, list)
+                                       else [out])]
+        else:
+            fn, params = self._get_compiled(arrs)
+            outs = fn(params, *arrs)
         self._outputs = {f"output_{i}": o for i, o in enumerate(outs)}
         return [np.asarray(o) for o in outs]
 

@@ -12,24 +12,28 @@ from paddle_tpu.jit.api import (  # noqa: F401
 
 
 def save(layer, path, input_spec=None, **configs):
-    """Persist a Layer's parameters + structure info.
+    """Serialize a Layer as a portable compiled inference program.
 
-    Reference: python/paddle/jit/api.py jit.save (saves ProgramDesc +
-    params). TPU-native: parameters/buffers as numpy arrays plus the input
-    spec; inference reload compiles the forward fresh with XLA (AOT via
-    paddle_tpu.inference)."""
+    Reference: python/paddle/jit/api.py jit.save (ProgramDesc + params).
+    TPU-native: with input_spec, the forward is functionalized and exported
+    as versioned StableHLO (jit/serialization.py) — reloadable and runnable
+    WITHOUT the model's Python class, the role ProgramDesc played. Without
+    input_spec, falls back to params+meta only (reload needs the class)."""
     import numpy as np
     from paddle_tpu.nn.layer.layers import Layer
 
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    if input_spec and isinstance(layer, Layer):
+        from paddle_tpu.jit.serialization import save_program
+        save_program(layer, path, input_spec)
+        return
     if isinstance(layer, Layer):
         sd = {k: np.asarray(v._value) for k, v in layer.state_dict().items()}
     else:
         sd = {}
     meta = {
         "class": type(layer).__name__,
-        "input_spec": [getattr(s, "_asdict", lambda: repr(s))() if hasattr(s, "_asdict")
-                       else repr(s) for s in (input_spec or [])],
+        "input_spec": [],
     }
     with open(path + ".pdiparams", "wb") as f:
         pickle.dump(sd, f)
@@ -38,6 +42,12 @@ def save(layer, path, input_spec=None, **configs):
 
 
 def load(path, **configs):
+    """Reload a jit.save artifact: a TranslatedLayer (callable compiled
+    program) when the .pdmodel holds StableHLO, else the params dict."""
+    with open(path + ".pdmodel", "rb") as f:
+        meta = pickle.load(f)
+    if isinstance(meta, dict) and "stablehlo" in meta:
+        from paddle_tpu.jit.serialization import load_program
+        return load_program(path)
     with open(path + ".pdiparams", "rb") as f:
-        sd = pickle.load(f)
-    return sd
+        return pickle.load(f)

@@ -1,0 +1,150 @@
+"""Serialized inference programs via jax.export (StableHLO).
+
+Reference parity: python/paddle/jit/api.py jit.save/jit.load +
+static.save/load_inference_model (ProgramDesc + params on disk; the
+AnalysisPredictor reloads and runs them without the Python model class).
+TPU-native design: the Layer's forward is functionalized (params lifted to
+arguments), jit-traced ONCE per input signature, and exported as versioned
+StableHLO bytes — a portable compiled-program artifact that reloads and
+runs WITHOUT the model's Python code, which is exactly the role
+ProgramDesc played. Params ride alongside as a pickle.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+import jax
+from jax import export as jax_export
+
+from paddle_tpu.core.tensor import Tensor
+
+_FORMAT_VERSION = 1
+
+
+def functional_forward(layer):
+    """(params_dict, *arrays) -> tuple of output arrays, via temporary
+    param rebinding. Shared by jit serialization and inference.Predictor."""
+    def fwd(params_vals, *xs):
+        sd = layer.state_dict()
+        saved = [(t, t._value) for t in sd.values()]
+        try:
+            for k, t in sd.items():
+                t._value = params_vals[k]
+            outs = layer(*[Tensor(x) for x in xs])
+            if isinstance(outs, (list, tuple)):
+                return tuple(o._value for o in outs)
+            return (outs._value,)
+        finally:
+            for t, v in saved:
+                t._value = v
+    return fwd
+
+
+def _specs_to_sds(specs):
+    """InputSpec/Tensor/array list -> ShapeDtypeStructs; None/-1 dims become
+    jax.export symbolic dims (one shared scope), so the serialized program
+    accepts ANY size there — the Paddle 'variable batch' semantics."""
+    import jax.numpy as jnp
+    from paddle_tpu.core.dtype import convert_dtype
+    from paddle_tpu.static import InputSpec
+
+    scope = jax_export.SymbolicScope()
+    counter = [0]
+
+    def dim(s):
+        if s is None or (isinstance(s, int) and s < 0):
+            name = f"d{counter[0]}"
+            counter[0] += 1
+            return jax_export.symbolic_shape(name, scope=scope)[0]
+        return int(s)
+
+    out = []
+    for spec in specs:
+        if isinstance(spec, InputSpec):
+            shape = tuple(dim(s) for s in spec.shape)
+            out.append(jax.ShapeDtypeStruct(
+                shape, convert_dtype(spec.dtype) or jnp.float32))
+        elif isinstance(spec, Tensor):
+            out.append(jax.ShapeDtypeStruct(tuple(spec.shape),
+                                            spec._value.dtype))
+        else:
+            arr = np.asarray(spec)
+            out.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))
+    return out
+
+
+def save_program(layer, path, input_spec):
+    """Export layer.forward(input_spec...) as StableHLO + params.
+
+    Writes path.pdmodel (serialized exported program + meta) and
+    path.pdiparams (params pickle)."""
+    was_training = getattr(layer, "training", False)
+    layer.eval()
+    try:
+        sd = layer.state_dict()
+        params = {k: t._value for k, t in sd.items()}
+        fwd = functional_forward(layer)
+
+        param_sds = {k: jax.ShapeDtypeStruct(np.shape(v), v.dtype)
+                     for k, v in params.items()}
+        in_sds = _specs_to_sds(input_spec)
+        exported = jax_export.export(jax.jit(fwd))(param_sds, *in_sds)
+        blob = exported.serialize()
+    finally:
+        if was_training:
+            layer.train()
+
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump({"version": _FORMAT_VERSION, "stablehlo": blob,
+                     "class": type(layer).__name__,
+                     "n_inputs": len(in_sds)}, f)
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump({k: np.asarray(v) for k, v in params.items()}, f)
+
+
+class TranslatedLayer:
+    """A reloaded serialized program: callable like the original Layer's
+    forward, with NO dependence on the original Python class (reference:
+    paddle.jit.TranslatedLayer)."""
+
+    def __init__(self, exported, params, meta):
+        self._exported = exported
+        self._params = params
+        self._meta = meta
+
+    def __call__(self, *args):
+        import jax.numpy as jnp
+        arrs = [a._value if isinstance(a, Tensor) else jnp.asarray(a)
+                for a in args]
+        outs = self._exported.call(self._params, *arrs)
+        outs = [Tensor(o) for o in outs]
+        return outs[0] if len(outs) == 1 else list(outs)
+
+    forward = __call__
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError("TranslatedLayer is an inference program")
+
+    def state_dict(self):
+        return {k: Tensor(v) for k, v in self._params.items()}
+
+
+def load_program(path, params_path=None):
+    with open(path + ".pdmodel", "rb") as f:
+        meta = pickle.load(f)
+    if meta.get("version") != _FORMAT_VERSION:
+        raise ValueError(f"unsupported program version {meta.get('version')}")
+    with open(params_path or path + ".pdiparams", "rb") as f:
+        import jax.numpy as jnp
+        params = {k: jnp.asarray(v) for k, v in pickle.load(f).items()}
+    exported = jax_export.deserialize(meta["stablehlo"])
+    return TranslatedLayer(exported, params, meta)

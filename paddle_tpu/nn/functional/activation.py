@@ -113,9 +113,11 @@ def softmax(x, axis=-1, dtype=None, name=None):
     dt = convert_dtype(dtype)
     def fn(v):
         if dt is not None:
+            # explicit dtype request wins over the amp black-list upcast
             v = v.astype(dt)
-        from paddle_tpu.amp.auto_cast import downcast_inputs
-        (v,) = downcast_inputs(v, opname="softmax")
+        else:
+            from paddle_tpu.amp.auto_cast import downcast_inputs
+            (v,) = downcast_inputs(v, opname="softmax")
         return jax.nn.softmax(v, axis=axis)
     return apply(fn, x)
 
@@ -129,9 +131,11 @@ def log_softmax(x, axis=-1, dtype=None, name=None):
     dt = convert_dtype(dtype)
     def fn(v):
         if dt is not None:
+            # explicit dtype request wins over the amp black-list upcast
             v = v.astype(dt)
-        from paddle_tpu.amp.auto_cast import downcast_inputs
-        (v,) = downcast_inputs(v, opname="log_softmax")
+        else:
+            from paddle_tpu.amp.auto_cast import downcast_inputs
+            (v,) = downcast_inputs(v, opname="log_softmax")
         return jax.nn.log_softmax(v, axis=axis)
     return apply(fn, x)
 

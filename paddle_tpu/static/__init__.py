@@ -48,9 +48,13 @@ class InputSpec:
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
                          **kwargs):
+    """feed_vars (InputSpecs/Tensors) become the exported program's input
+    signature; `program` kwarg carries the Layer (TPU design: the compiled
+    StableHLO export IS the inference model)."""
     from paddle_tpu.jit import save as jit_save
     program = kwargs.get("program")
-    jit_save(program if program is not None else _DummyLayer(), path_prefix)
+    jit_save(program if program is not None else _DummyLayer(), path_prefix,
+             input_spec=list(feed_vars) if feed_vars else None)
 
 
 class _DummyLayer:

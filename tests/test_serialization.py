@@ -1,0 +1,90 @@
+"""jit.save/load as serialized StableHLO programs + inference Predictor."""
+import numpy as np
+
+import paddle_tpu
+from paddle_tpu import nn
+from paddle_tpu.static import InputSpec
+
+
+def _mlp():
+    paddle_tpu.seed(0)
+    return nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+
+
+class TestSerializedProgram:
+    def test_save_load_runs_without_class(self, tmp_path):
+        model = _mlp()
+        model.eval()
+        x = paddle_tpu.to_tensor(
+            np.random.RandomState(0).randn(3, 4).astype(np.float32))
+        ref = model(x).numpy()
+        path = str(tmp_path / "prog")
+        paddle_tpu.jit.save(model, path,
+                            input_spec=[InputSpec([3, 4], "float32")])
+        loaded = paddle_tpu.jit.load(path)
+        out = loaded(x)
+        np.testing.assert_allclose(out.numpy(), ref, atol=1e-5)
+        # callable without any reference to the original class
+        assert type(loaded).__name__ == "TranslatedLayer"
+
+    def test_params_only_fallback(self, tmp_path):
+        model = _mlp()
+        path = str(tmp_path / "params_only")
+        paddle_tpu.jit.save(model, path)       # no input_spec
+        sd = paddle_tpu.jit.load(path)
+        assert isinstance(sd, dict) and len(sd) == 4
+
+    def test_predictor_runs_serialized_program(self, tmp_path):
+        from paddle_tpu.inference import Config, create_predictor
+        model = _mlp()
+        model.eval()
+        x = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+        ref = model(paddle_tpu.to_tensor(x)).numpy()
+        path = str(tmp_path / "prog2")
+        paddle_tpu.jit.save(model, path,
+                            input_spec=[InputSpec([3, 4], "float32")])
+        config = Config(path + ".pdmodel", path + ".pdiparams")
+        predictor = create_predictor(config)
+        (out,) = predictor.run([x])
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_save_inference_model_roundtrip(self, tmp_path):
+        from paddle_tpu.static import (load_inference_model,
+                                       save_inference_model)
+        model = _mlp()
+        model.eval()
+        path = str(tmp_path / "inf")
+        save_inference_model(path, [InputSpec([2, 4], "float32")], None,
+                             program=model)
+        loaded = load_inference_model(path)
+        x = paddle_tpu.ones([2, 4])
+        np.testing.assert_allclose(loaded(x).numpy(), model(x).numpy(),
+                                   atol=1e-5)
+
+
+class TestReviewRegressions:
+    def test_dynamic_batch_dim(self, tmp_path):
+        model = _mlp()
+        model.eval()
+        path = str(tmp_path / "dyn")
+        paddle_tpu.jit.save(model, path,
+                            input_spec=[InputSpec([None, 4], "float32")])
+        loaded = paddle_tpu.jit.load(path)
+        for b in (1, 5, 32):
+            x = paddle_tpu.ones([b, 4])
+            assert tuple(loaded(x).shape) == (b, 2)
+
+    def test_save_restores_training_mode(self, tmp_path):
+        model = _mlp()
+        model.train()
+        paddle_tpu.jit.save(model, str(tmp_path / "t"),
+                            input_spec=[InputSpec([2, 4], "float32")])
+        assert model.training
+
+    def test_softmax_explicit_dtype_wins_over_amp(self):
+        from paddle_tpu import amp
+        import paddle_tpu.nn.functional as F
+        x = paddle_tpu.ones([2, 4], dtype="float32")
+        with amp.auto_cast(dtype="bfloat16"):
+            out = F.softmax(x, dtype="bfloat16")
+        assert "bfloat16" in str(out.dtype)
