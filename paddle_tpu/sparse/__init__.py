@@ -1,7 +1,12 @@
-"""Sparse tensors. Reference: python/paddle/sparse/ (COO/CSR).
+"""Sparse tensors. Reference: python/paddle/sparse/ (COO/CSR tensor
+creation in python/paddle/sparse/creation.py, unary/binary/matmul ops,
+sparse nn layers).
 
-TPU-native: backed by jax.experimental.sparse BCOO (XLA-lowerable); dense
-fallbacks keep API parity where BCOO lacks an op.
+TPU-native: backed by jax.experimental.sparse BCOO — XLA lowers
+bcoo_dot_general to gather/scatter+MXU programs, so spmm genuinely skips
+zero blocks. The SparseCooTensor also keeps a dense mirror (`_value`) so
+every dense paddle_tpu op still accepts it; ops below prefer the BCOO path
+and fall back to dense where BCOO lacks a kernel.
 """
 from __future__ import annotations
 
@@ -40,6 +45,45 @@ class SparseCooTensor(Tensor):
     def is_sparse_coo(self):
         return True
 
+    def is_sparse_csr(self):
+        return False
+
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def coalesce(self):
+        b = self._bcoo.sum_duplicates()
+        return SparseCooTensor(jnp.swapaxes(b.indices, 0, 1), b.data,
+                               b.shape, self.stop_gradient)
+
+    def t(self):
+        return transpose(self, [1, 0])
+
+
+class SparseCsrTensor(SparseCooTensor):
+    """CSR surface over the same BCOO backing (crows kept for API parity)."""
+
+    def __init__(self, crows, cols, values, shape, stop_gradient=True):
+        crows_v = np.asarray(unwrap(crows))
+        cols_v = np.asarray(unwrap(cols))
+        rows = np.repeat(np.arange(len(crows_v) - 1), np.diff(crows_v))
+        indices = np.stack([rows, cols_v])
+        super().__init__(indices, values, shape, stop_gradient)
+        self._crows = Tensor(jnp.asarray(crows_v))
+        self._cols = Tensor(jnp.asarray(cols_v))
+
+    def crows(self):
+        return self._crows
+
+    def cols(self):
+        return self._cols
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
 
 def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
                       stop_gradient=True):
@@ -51,26 +95,109 @@ def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
 
 def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
                       stop_gradient=True):
-    crows_v = np.asarray(unwrap(crows))
-    cols_v = np.asarray(unwrap(cols))
-    rows = np.repeat(np.arange(len(crows_v) - 1), np.diff(crows_v))
-    indices = np.stack([rows, cols_v])
-    return SparseCooTensor(indices, values, shape, stop_gradient)
+    return SparseCsrTensor(crows, cols, values, shape, stop_gradient)
 
+
+def to_sparse_coo(x, sparse_dim=None):
+    """Dense Tensor -> SparseCooTensor (reference: Tensor.to_sparse_coo)."""
+    v = unwrap(x)
+    idx = jnp.stack(jnp.nonzero(v))
+    vals = v[tuple(idx)]
+    return SparseCooTensor(idx, vals, v.shape)
+
+
+def is_sparse(x):
+    return isinstance(x, SparseCooTensor)
+
+
+# ---------------------------------------------------------------------------
+# ops — BCOO path where supported, dense fallback otherwise
+# ---------------------------------------------------------------------------
 
 def matmul(x, y, name=None):
-    xv = x.to_dense() if isinstance(x, SparseCooTensor) else x
+    """spmm: BCOO @ dense via bcoo_dot_general (real sparse compute — XLA
+    skips stored-zero blocks), dense@dense passthrough otherwise."""
+    if isinstance(x, SparseCooTensor) and not isinstance(y, SparseCooTensor):
+        def fn(yv):
+            return jsparse.bcoo_dot_general(
+                x._bcoo, yv,
+                dimension_numbers=(((x._bcoo.ndim - 1,), (0,)), ((), ())))
+        return apply(fn, y)
     from paddle_tpu.tensor.math import matmul as dense_matmul
-    return dense_matmul(xv, y)
+    xv = x.to_dense() if isinstance(x, SparseCooTensor) else x
+    yv = y.to_dense() if isinstance(y, SparseCooTensor) else y
+    return dense_matmul(xv, yv)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """Dense@dense, sampled at mask's sparsity pattern (SDDMM)."""
+    out = jnp.matmul(unwrap(x), unwrap(y))
+    idx = mask._bcoo.indices
+    vals = out[tuple(idx[:, i] for i in range(idx.shape[1]))]
+    return SparseCooTensor(jnp.swapaxes(idx, 0, 1), vals, out.shape)
 
 
 def add(x, y, name=None):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        idx = jnp.concatenate([x._bcoo.indices, y._bcoo.indices], axis=0)
+        vals = jnp.concatenate([x._bcoo.data, y._bcoo.data], axis=0)
+        return SparseCooTensor(jnp.swapaxes(idx, 0, 1), vals,
+                               x._bcoo.shape).coalesce()
     from paddle_tpu.tensor.math import add as dense_add
     xv = x.to_dense() if isinstance(x, SparseCooTensor) else x
     yv = y.to_dense() if isinstance(y, SparseCooTensor) else y
     return dense_add(xv, yv)
 
 
-def relu(x, name=None):
-    from paddle_tpu.nn.functional.activation import relu as dense_relu
-    return dense_relu(x.to_dense() if isinstance(x, SparseCooTensor) else x)
+def subtract(x, y, name=None):
+    return add(x, multiply(y, -1.0) if isinstance(y, SparseCooTensor)
+               else Tensor(-unwrap(y)))
+
+
+def multiply(x, y, name=None):
+    """Elementwise; sparse * scalar keeps sparsity."""
+    if isinstance(x, SparseCooTensor) and np.isscalar(y):
+        return SparseCooTensor(jnp.swapaxes(x._bcoo.indices, 0, 1),
+                               x._bcoo.data * y, x._bcoo.shape)
+    from paddle_tpu.tensor.math import multiply as dense_mul
+    xv = x.to_dense() if isinstance(x, SparseCooTensor) else x
+    yv = y.to_dense() if isinstance(y, SparseCooTensor) else y
+    return dense_mul(xv, yv)
+
+
+def _unary_on_values(fn_vals):
+    """Zero-preserving unary ops act on stored values only."""
+    def op(x, name=None):
+        if isinstance(x, SparseCooTensor):
+            return SparseCooTensor(jnp.swapaxes(x._bcoo.indices, 0, 1),
+                                   fn_vals(x._bcoo.data), x._bcoo.shape)
+        return apply(fn_vals, x)
+    return op
+
+
+relu = _unary_on_values(lambda v: jnp.maximum(v, 0.0))
+sin = _unary_on_values(jnp.sin)
+tanh = _unary_on_values(jnp.tanh)
+sqrt = _unary_on_values(jnp.sqrt)
+abs = _unary_on_values(jnp.abs)
+neg = _unary_on_values(jnp.negative)
+pow = (lambda x, factor, name=None: _unary_on_values(
+    lambda v: jnp.power(v, factor))(x))
+
+
+def transpose(x, perm, name=None):
+    if isinstance(x, SparseCooTensor):
+        idx = x._bcoo.indices[:, jnp.asarray(perm)]
+        shape = tuple(x._bcoo.shape[p] for p in perm)
+        return SparseCooTensor(jnp.swapaxes(idx, 0, 1), x._bcoo.data, shape)
+    from paddle_tpu.tensor.manipulation import transpose as dense_t
+    return dense_t(x, perm)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    from paddle_tpu.tensor.math import sum as dense_sum
+    return dense_sum(x.to_dense() if isinstance(x, SparseCooTensor) else x,
+                     axis=axis, dtype=dtype, keepdim=keepdim)
+
+
+from paddle_tpu.sparse import nn  # noqa: E402,F401

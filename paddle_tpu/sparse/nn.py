@@ -1,0 +1,107 @@
+"""paddle.sparse.nn — layers over sparse tensors.
+
+Reference: python/paddle/sparse/nn/ (ReLU, Conv3D/SubmConv3D, BatchNorm).
+TPU-native: zero-preserving activations act on BCOO stored values; the 3-D
+convs run as gathered dense windows (XLA scatter/gather) over the dense
+mirror — correct semantics, with true submanifold masking for SubmConv3D.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.nn.layer.layers import Layer
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        from paddle_tpu import sparse
+        return sparse.relu(x)
+
+
+class Softmax(Layer):
+    """Row-wise softmax over stored values (CSR semantics)."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        from paddle_tpu import sparse
+        from paddle_tpu.core.tensor import Tensor
+        if not isinstance(x, sparse.SparseCooTensor):
+            import paddle_tpu.nn.functional as F
+            return F.softmax(x, axis=self.axis)
+        dense = x._value
+        # softmax over the nonzero entries of each row only
+        mask = dense != 0
+        neg = jnp.where(mask, dense, -jnp.inf)
+        sm = jnp.where(mask, jnp.exp(neg - jnp.max(neg, axis=self.axis,
+                                                   keepdims=True)), 0.0)
+        denom = jnp.sum(sm, axis=self.axis, keepdims=True)
+        out = jnp.where(mask, sm / jnp.where(denom == 0, 1.0, denom), 0.0)
+        return sparse.to_sparse_coo(Tensor(out))
+
+
+class Conv3D(Layer):
+    """Sparse 3-D conv (NDHWC, like the reference's sparse Conv3D)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__()
+        from paddle_tpu.nn.layer.conv import Conv3D as DenseConv3D
+        # reuse the dense conv's parameterization; compute runs NCDHW
+        self._conv = DenseConv3D(in_channels, out_channels, kernel_size,
+                                 stride=stride, padding=padding,
+                                 dilation=dilation, groups=groups,
+                                 weight_attr=weight_attr,
+                                 bias_attr=bias_attr)
+        self.weight = self._conv.weight
+        self.bias = self._conv.bias
+
+    def _dense_ncdhw(self, x):
+        from paddle_tpu import sparse
+        from paddle_tpu.core.tensor import Tensor
+        v = x._value if isinstance(x, sparse.SparseCooTensor) else x._value
+        return Tensor(jnp.moveaxis(v, -1, 1))     # NDHWC -> NCDHW
+
+    def forward(self, x):
+        from paddle_tpu import sparse
+        from paddle_tpu.core.tensor import Tensor
+        out = self._conv(self._dense_ncdhw(x))
+        out = Tensor(jnp.moveaxis(out._value, 1, -1))  # -> NDHWC
+        return sparse.to_sparse_coo(out)
+
+
+class SubmConv3D(Conv3D):
+    """Submanifold conv: outputs only at input active sites."""
+
+    def forward(self, x):
+        from paddle_tpu import sparse
+        from paddle_tpu.core.tensor import Tensor
+        active = (x._value != 0).any(axis=-1, keepdims=True)
+        out = self._conv(self._dense_ncdhw(x))
+        out = jnp.moveaxis(out._value, 1, -1)
+        out = jnp.where(active, out, 0.0)
+        return sparse.to_sparse_coo(Tensor(out))
+
+
+class BatchNorm(Layer):
+    """BatchNorm over the channel (last) dim of sparse NDHWC activations;
+    statistics over stored (active) sites only."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__()
+        from paddle_tpu.nn.layer.norm import BatchNorm1D
+        self._bn = BatchNorm1D(num_features, momentum=momentum,
+                               epsilon=epsilon)
+
+    def forward(self, x):
+        from paddle_tpu import sparse
+        from paddle_tpu.core.tensor import Tensor
+        vals = x.values()                       # [nnz, C]
+        out_vals = self._bn(vals)
+        idx = jnp.swapaxes(x._bcoo.indices, 0, 1)
+        return sparse.SparseCooTensor(idx, out_vals._value, x._bcoo.shape,
+                                      x.stop_gradient)

@@ -1,0 +1,119 @@
+"""paddle.sparse: COO/CSR construction, BCOO spmm, zero-preserving unary
+ops, sparse nn layers."""
+import numpy as np
+
+import paddle_tpu
+from paddle_tpu import sparse
+
+
+def _coo():
+    indices = [[0, 1, 2], [1, 2, 0]]
+    values = [1.0, 2.0, 3.0]
+    return sparse.sparse_coo_tensor(
+        paddle_tpu.to_tensor(np.array(indices, np.int64)),
+        paddle_tpu.to_tensor(np.array(values, np.float32)), shape=[3, 3])
+
+
+class TestSparseTensor:
+    def test_coo_roundtrip(self):
+        s = _coo()
+        dense = s.to_dense().numpy()
+        ref = np.zeros((3, 3), np.float32)
+        ref[0, 1], ref[1, 2], ref[2, 0] = 1, 2, 3
+        np.testing.assert_array_equal(dense, ref)
+        assert s.is_sparse_coo() and s.nnz() == 3
+
+    def test_csr(self):
+        s = sparse.sparse_csr_tensor(
+            paddle_tpu.to_tensor(np.array([0, 1, 2, 3], np.int64)),
+            paddle_tpu.to_tensor(np.array([1, 2, 0], np.int64)),
+            paddle_tpu.to_tensor(np.array([1., 2., 3.], np.float32)),
+            shape=[3, 3])
+        assert s.is_sparse_csr()
+        np.testing.assert_array_equal(s.crows().numpy(), [0, 1, 2, 3])
+
+    def test_to_sparse_coo(self):
+        d = paddle_tpu.to_tensor(
+            np.array([[0, 5.0], [7.0, 0]], np.float32))
+        s = sparse.to_sparse_coo(d)
+        assert s.nnz() == 2
+        np.testing.assert_array_equal(s.to_dense().numpy(),
+                                      np.asarray(d._value))
+
+    def test_coalesce_merges_duplicates(self):
+        s = sparse.sparse_coo_tensor(
+            paddle_tpu.to_tensor(np.array([[0, 0], [1, 1]], np.int64)),
+            paddle_tpu.to_tensor(np.array([1.0, 2.0], np.float32)),
+            shape=[2, 2])
+        c = s.coalesce()
+        assert float(c.to_dense().numpy()[0, 1]) == 3.0
+
+
+class TestSparseOps:
+    def test_spmm_matches_dense(self):
+        s = _coo()
+        rng = np.random.RandomState(0)
+        d = paddle_tpu.to_tensor(rng.randn(3, 4).astype(np.float32))
+        out = sparse.matmul(s, d)
+        ref = s.to_dense().numpy() @ np.asarray(d._value)
+        np.testing.assert_allclose(out.numpy(), ref, atol=1e-5)
+
+    def test_sparse_add(self):
+        a, b = _coo(), _coo()
+        out = sparse.add(a, b)
+        np.testing.assert_allclose(out.to_dense().numpy(),
+                                   2 * a.to_dense().numpy(), atol=1e-6)
+
+    def test_unary_preserves_sparsity(self):
+        s = sparse.sparse_coo_tensor(
+            paddle_tpu.to_tensor(np.array([[0, 1], [1, 0]], np.int64)),
+            paddle_tpu.to_tensor(np.array([-1.0, 2.0], np.float32)),
+            shape=[2, 2])
+        r = sparse.relu(s)
+        assert isinstance(r, sparse.SparseCooTensor)
+        np.testing.assert_array_equal(r.to_dense().numpy(),
+                                      [[0, 0], [2, 0]])
+
+    def test_transpose(self):
+        s = _coo()
+        t = sparse.transpose(s, [1, 0])
+        np.testing.assert_array_equal(t.to_dense().numpy(),
+                                      s.to_dense().numpy().T)
+
+    def test_masked_matmul(self):
+        rng = np.random.RandomState(0)
+        x = paddle_tpu.to_tensor(rng.randn(3, 4).astype(np.float32))
+        y = paddle_tpu.to_tensor(rng.randn(4, 3).astype(np.float32))
+        mask = _coo()
+        out = sparse.masked_matmul(x, y, mask)
+        full = np.asarray(x._value) @ np.asarray(y._value)
+        ref = np.where(mask.to_dense().numpy() != 0, full, 0)
+        np.testing.assert_allclose(out.to_dense().numpy(), ref, atol=1e-5)
+
+
+class TestSparseNN:
+    def test_relu_layer(self):
+        layer = sparse.nn.ReLU()
+        s = sparse.to_sparse_coo(paddle_tpu.to_tensor(
+            np.array([[-1.0, 0], [0, 4.0]], np.float32)))
+        out = layer(s)
+        np.testing.assert_array_equal(out.to_dense().numpy(),
+                                      [[0, 0], [0, 4.0]])
+
+    def test_conv3d_shapes(self):
+        rng = np.random.RandomState(0)
+        dense = np.zeros((1, 4, 4, 4, 2), np.float32)   # NDHWC
+        dense[0, 1, 1, 1] = rng.randn(2)
+        s = sparse.to_sparse_coo(paddle_tpu.to_tensor(dense))
+        conv = sparse.nn.Conv3D(2, 3, kernel_size=3, padding=1)
+        out = conv(s)
+        assert tuple(out.to_dense().shape) == (1, 4, 4, 4, 3)
+
+    def test_subm_conv3d_stays_on_active_sites(self):
+        dense = np.zeros((1, 4, 4, 4, 1), np.float32)
+        dense[0, 2, 2, 2, 0] = 1.0
+        s = sparse.to_sparse_coo(paddle_tpu.to_tensor(dense))
+        conv = sparse.nn.SubmConv3D(1, 1, kernel_size=3, padding=1)
+        out = conv(s).to_dense().numpy()
+        active = out != 0
+        assert active.sum() <= 1          # only the input's active site
