@@ -39,12 +39,14 @@ def sync_array(value):
     value.block_until_ready()
     try:
         platform = next(iter(value.devices())).platform
-        if value.size and platform != "cpu":
-            # index one element (not ravel — that would reshard the whole
-            # array when it's distributed) to force the producing computation
-            jax.device_get(value[(0,) * value.ndim])
-    except Exception:
-        pass
+    except (AttributeError, StopIteration):  # tracers / committed-less vals
+        return value
+    if value.size and platform != "cpu":
+        # index one element (not ravel — that would reshard the whole
+        # array when it's distributed) to force the producing computation.
+        # Deliberately NOT under a blanket except: a failing fetch here is
+        # a real execution failure and must surface, not be masked.
+        jax.device_get(value[(0,) * value.ndim])
     return value
 
 

@@ -112,6 +112,7 @@ class HeartbeatServer:
         import jax
         pid = jax.process_index()
         nproc = jax.process_count()
+        consecutive_failures = 0
         while not self._stop.wait(self.interval):
             now = str(time.time())
             try:
@@ -140,8 +141,22 @@ class HeartbeatServer:
                                 else grace_over)]
                     if dead and self.on_dead is not None:
                         self.on_dead(dead)
-            except Exception:
-                pass
+                consecutive_failures = 0
+            except Exception as e:
+                # a silently-dead heartbeat loop would disable dead-host
+                # detection with no trace; log (rate-limited) and give up
+                # loudly after repeated failures so operators can see it
+                consecutive_failures += 1
+                if consecutive_failures <= 3 or \
+                        consecutive_failures % 20 == 0:
+                    print(f"[paddle_tpu.elastic] heartbeat poll failed "
+                          f"({consecutive_failures}x): {type(e).__name__}: "
+                          f"{e}", file=sys.stderr, flush=True)
+                if consecutive_failures >= 60:
+                    print("[paddle_tpu.elastic] heartbeat DISABLED after "
+                          "60 consecutive failures — liveness monitoring "
+                          "is NOT functioning", file=sys.stderr, flush=True)
+                    return
 
     def stop(self):
         self._stop.set()

@@ -2,9 +2,10 @@
 (Config, create_predictor wrapping AnalysisPredictor).
 
 TPU-native Predictor: the loaded/attached model's forward is frozen
-(params become compile-time-donated constants or lifted inputs), AOT-compiled
-by XLA into a single executable per input signature, with warmup — the
-analogue of the reference's IR-pass + TensorRT engine path.
+(params lifted to inputs), AOT-compiled by XLA into a single executable per
+input signature (`jit(...).lower(...).compile()` — the analogue of the
+reference's IR-pass + TensorRT engine build), with explicit warmup and
+optional input-buffer donation (`Config.enable_memory_optim`).
 """
 from __future__ import annotations
 
@@ -33,9 +34,11 @@ class Config:
         self.prog_file = prog_file
         self.params_file = params_file
         self._model = None
+        self._input_spec = None
         self._use_tpu = True
         self._precision = PrecisionType.Bfloat16
         self._memory_pool_mb = 0
+        self._donate_inputs = False
 
     def set_model(self, prog_file=None, params_file=None):
         self.prog_file = prog_file
@@ -56,9 +59,12 @@ class Config:
         self._use_tpu = False
 
     def enable_memory_optim(self):
-        pass
+        """Donate input buffers to the executable (XLA reuses them for
+        outputs). Handles must be re-bound (copy_from_cpu) between run()s."""
+        self._donate_inputs = True
 
     def switch_ir_optim(self, flag=True):
+        # XLA always runs its optimization pipeline; nothing to switch.
         pass
 
     def set_cpu_math_library_num_threads(self, n):
@@ -93,6 +99,8 @@ class Predictor:
         self.config = config
         self._model = getattr(config, "_model", None)
         self._translated = None
+        self._input_names = None
+        self._output_names = None
         if self._model is None and config.prog_file:
             # serialized StableHLO program (jit.save with input_spec):
             # reload + run with no Python model class
@@ -102,21 +110,42 @@ class Predictor:
             from paddle_tpu.jit.serialization import load_program
             self._translated = load_program(
                 prefix, params_path=config.params_file or None)
+            self._input_names = list(self._translated.input_names)
+            self._output_names = list(self._translated.output_names)
         elif self._model is None and config.params_file:
-            import pickle
-            with open(config.params_file, "rb") as f:
-                self._params = pickle.load(f)
+            from paddle_tpu.jit.serialization import load_params_npz
+            self._params = load_params_npz(config.params_file)
         self._inputs = {}
         self._outputs = {}
         self._compiled = {}
         if self._model is not None:
             self._model.eval()
+            self._input_names = self._derive_layer_input_names()
+
+    def _derive_layer_input_names(self):
+        spec = getattr(self.config, "_input_spec", None) or []
+        names = []
+        for i, s in enumerate(spec):
+            names.append(getattr(s, "name", None) or f"input_{i}")
+        if names:
+            return names
+        # fall back to the forward signature's positional arg names
+        import inspect
+        try:
+            sig = inspect.signature(self._model.forward)
+            return [p.name for p in sig.parameters.values()
+                    if p.default is inspect.Parameter.empty
+                    and p.kind in (p.POSITIONAL_ONLY,
+                                   p.POSITIONAL_OR_KEYWORD)]
+        except (TypeError, ValueError):
+            return ["input_0"]
 
     def get_input_names(self):
-        return ["input_0"]
+        return list(self._input_names or ["input_0"])
 
     def get_output_names(self):
-        return list(self._outputs.keys()) or ["output_0"]
+        return list(self._output_names or self._outputs.keys() or
+                    ["output_0"])
 
     def get_input_handle(self, name):
         return PredictTensor(name, self)
@@ -124,20 +153,50 @@ class Predictor:
     def get_output_handle(self, name):
         return PredictTensor(name, self)
 
-    def _get_compiled(self, avals):
-        key = tuple((tuple(a.shape), str(a.dtype)) for a in avals)
+    def _get_compiled(self, arrs):
+        """AOT-compile the functionalized forward for this signature."""
+        key = tuple((tuple(a.shape), str(a.dtype)) for a in arrs)
         if key not in self._compiled:
             model = self._model
             params = {k: v._value for k, v in model.state_dict().items()}
             from paddle_tpu.jit.serialization import functional_forward
-            self._compiled[key] = (jax.jit(functional_forward(model)), params)
+            donate = (tuple(range(1, 1 + len(arrs)))
+                      if self.config._donate_inputs else ())
+            jitted = jax.jit(functional_forward(model),
+                             donate_argnums=donate)
+            compiled = jitted.lower(
+                params, *[jax.ShapeDtypeStruct(a.shape, a.dtype)
+                          for a in arrs]).compile()
+            self._compiled[key] = (compiled, params)
         return self._compiled[key]
 
-    def run(self, inputs=None):
-        if inputs is not None:
-            arrs = [jnp.asarray(np.asarray(x)) for x in inputs]
+    def warmup(self, inputs=None):
+        """Compile + run once on the bound (or given) inputs and discard the
+        result, so the first run() serves at steady-state latency."""
+        arrs = self._gather_inputs(inputs)
+        if self._translated is not None:
+            out = self._translated(*arrs)
+            outs = out if isinstance(out, list) else [out]
+            for o in outs:
+                o._value.block_until_ready()
         else:
-            arrs = [self._inputs[k] for k in sorted(self._inputs)]
+            fn, params = self._get_compiled(arrs)
+            for o in fn(params, *arrs):
+                o.block_until_ready()
+            if self.config._donate_inputs:
+                self._inputs = {}  # donated buffers are dead now
+        return self
+
+    def _gather_inputs(self, inputs):
+        if inputs is not None:
+            return [jnp.asarray(np.asarray(x)) for x in inputs]
+        names = self.get_input_names()
+        if self._inputs and all(n in self._inputs for n in names):
+            return [self._inputs[n] for n in names]
+        return [self._inputs[k] for k in sorted(self._inputs)]
+
+    def run(self, inputs=None):
+        arrs = self._gather_inputs(inputs)
         if self._translated is not None:
             out = self._translated(*arrs)
             outs = [o._value for o in (out if isinstance(out, list)
@@ -145,7 +204,10 @@ class Predictor:
         else:
             fn, params = self._get_compiled(arrs)
             outs = fn(params, *arrs)
-        self._outputs = {f"output_{i}": o for i, o in enumerate(outs)}
+            if self.config._donate_inputs:
+                self._inputs = {}  # donated buffers are dead now
+        self._output_names = [f"output_{i}" for i in range(len(outs))]
+        self._outputs = dict(zip(self._output_names, outs))
         return [np.asarray(o) for o in outs]
 
 
@@ -153,5 +215,40 @@ def create_predictor(config):
     return Predictor(config)
 
 
-def convert_to_mixed_precision(*args, **kwargs):
-    raise NotImplementedError("planned: bf16 weight conversion pass")
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision=None,
+                               backend=None, **kwargs):
+    """Rewrite a serialized program's params to bf16/fp16 on disk.
+
+    Reference: paddle.inference.convert_to_mixed_precision (an offline graph
+    pass). TPU-native: the StableHLO program keeps its traced dtypes —
+    TranslatedLayer casts params to the program's expected dtypes at call
+    time — so this halves checkpoint size + host→device transfer; for a
+    bf16 *compute* program, export under amp.auto_cast instead.
+    """
+    import ml_dtypes
+    from paddle_tpu.jit.serialization import (load_params_npz,
+                                              read_model_file,
+                                              save_params_npz,
+                                              write_model_file)
+
+    if mixed_precision in (PrecisionType.Half, "float16", "fp16"):
+        target = np.dtype(np.float16)
+    elif mixed_precision in (None, PrecisionType.Bfloat16, "bfloat16",
+                             "bf16"):
+        target = np.dtype(ml_dtypes.bfloat16)
+    else:
+        raise ValueError(
+            f"unsupported mixed_precision {mixed_precision!r}: only "
+            f"bfloat16 (default) and float16 are supported")
+
+    header, blob = read_model_file(model_file)
+    params = load_params_npz(params_file)
+    cast = {k: (v.astype(target)
+                if np.issubdtype(v.dtype, np.floating) or
+                v.dtype == np.dtype(ml_dtypes.bfloat16) else v)
+            for k, v in params.items()}
+    header.pop("version", None)
+    header["mixed_precision"] = str(target)
+    write_model_file(mixed_model_file, header, blob)
+    save_params_npz(mixed_params_file, cast)

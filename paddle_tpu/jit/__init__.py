@@ -1,6 +1,5 @@
 """paddle_tpu.jit. Reference: python/paddle/jit/__init__.py."""
 import os
-import pickle
 
 from paddle_tpu.jit.api import (  # noqa: F401
     ProgramTranslator,
@@ -18,36 +17,37 @@ def save(layer, path, input_spec=None, **configs):
     TPU-native: with input_spec, the forward is functionalized and exported
     as versioned StableHLO (jit/serialization.py) — reloadable and runnable
     WITHOUT the model's Python class, the role ProgramDesc played. Without
-    input_spec, falls back to params+meta only (reload needs the class)."""
+    input_spec, falls back to params+meta only (reload needs the class).
+    Artifacts are non-executable (JSON + StableHLO + npz): loading never
+    unpickles untrusted data."""
     import numpy as np
+    from paddle_tpu.jit.serialization import (save_params_npz, save_program,
+                                              write_model_file)
     from paddle_tpu.nn.layer.layers import Layer
 
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     if input_spec and isinstance(layer, Layer):
-        from paddle_tpu.jit.serialization import save_program
         save_program(layer, path, input_spec)
         return
     if isinstance(layer, Layer):
         sd = {k: np.asarray(v._value) for k, v in layer.state_dict().items()}
     else:
         sd = {}
-    meta = {
+    save_params_npz(path + ".pdiparams", sd)
+    write_model_file(path + ".pdmodel", {
+        "stablehlo": False,
         "class": type(layer).__name__,
         "input_spec": [],
-    }
-    with open(path + ".pdiparams", "wb") as f:
-        pickle.dump(sd, f)
-    with open(path + ".pdmodel", "wb") as f:
-        pickle.dump(meta, f)
+    })
 
 
 def load(path, **configs):
     """Reload a jit.save artifact: a TranslatedLayer (callable compiled
     program) when the .pdmodel holds StableHLO, else the params dict."""
-    with open(path + ".pdmodel", "rb") as f:
-        meta = pickle.load(f)
-    if isinstance(meta, dict) and "stablehlo" in meta:
-        from paddle_tpu.jit.serialization import load_program
+    from paddle_tpu.jit.serialization import (load_params_npz, load_program,
+                                              read_model_file)
+
+    meta, _ = read_model_file(path + ".pdmodel")
+    if meta.get("stablehlo"):
         return load_program(path)
-    with open(path + ".pdiparams", "rb") as f:
-        return pickle.load(f)
+    return load_params_npz(path + ".pdiparams")

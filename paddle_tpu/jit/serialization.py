@@ -7,12 +7,20 @@ TPU-native design: the Layer's forward is functionalized (params lifted to
 arguments), jit-traced ONCE per input signature, and exported as versioned
 StableHLO bytes — a portable compiled-program artifact that reloads and
 runs WITHOUT the model's Python code, which is exactly the role
-ProgramDesc played. Params ride alongside as a pickle.
+ProgramDesc played.
+
+Artifact format (deliberately NON-executable — loading never unpickles,
+so a downloaded model file cannot run code, unlike pickle):
+  <path>.pdmodel   = b"PTPU" + u32 header_len + JSON header + StableHLO bytes
+  <path>.pdiparams = .npz archive (np.savez, allow_pickle=False on load);
+                     extension dtypes (bfloat16) ride as uint16 with the
+                     true dtype recorded in the npz's __dtypes__ JSON entry.
 """
 from __future__ import annotations
 
+import json
 import os
-import pickle
+import struct
 
 import numpy as np
 
@@ -21,9 +29,82 @@ from jax import export as jax_export
 
 from paddle_tpu.core.tensor import Tensor
 
-_FORMAT_VERSION = 1
+_FORMAT_VERSION = 2
+_MAGIC = b"PTPU"
+_DTYPES_KEY = "__dtypes__"
 
 
+# ---------------------------------------------------------------- containers
+def write_model_file(path, header: dict, blob: bytes = b"") -> None:
+    """Write the .pdmodel container: magic + JSON header + raw program."""
+    header = dict(header)
+    header["version"] = _FORMAT_VERSION
+    hdr = json.dumps(header).encode("utf-8")
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<I", len(hdr)))
+        f.write(hdr)
+        f.write(blob)
+
+
+def read_model_file(path):
+    """-> (header dict, program bytes). Rejects legacy/foreign files."""
+    with open(path, "rb") as f:
+        magic = f.read(4)
+        if magic != _MAGIC:
+            raise ValueError(
+                f"{path}: not a paddle_tpu serialized program (bad magic "
+                f"{magic!r}; legacy pickle artifacts are not supported — "
+                f"re-save with jit.save)")
+        (hdr_len,) = struct.unpack("<I", f.read(4))
+        header = json.loads(f.read(hdr_len).decode("utf-8"))
+        blob = f.read()
+    if header.get("version") != _FORMAT_VERSION:
+        raise ValueError(
+            f"unsupported program version {header.get('version')}")
+    return header, blob
+
+
+def save_params_npz(path, params) -> None:
+    """Save a {name: array} dict as npz. bfloat16 (and any other extension
+    dtype numpy can't natively serialize) is stored as a same-width uint
+    view, with true dtypes recorded under __dtypes__."""
+    arrays = {}
+    dtypes = {}
+    for k, v in params.items():
+        if k == _DTYPES_KEY:
+            raise ValueError(f"reserved param name {k!r}")
+        a = np.asarray(v)
+        dtypes[k] = str(a.dtype)
+        if a.dtype.kind not in "biufc" or a.dtype.hasobject:
+            a = a.view(np.dtype(f"u{a.dtype.itemsize}"))
+        arrays[k] = a
+    meta = np.frombuffer(json.dumps(dtypes).encode("utf-8"), dtype=np.uint8)
+    with open(path, "wb") as f:
+        np.savez(f, **{_DTYPES_KEY: meta}, **arrays)
+
+
+def load_params_npz(path):
+    """Inverse of save_params_npz -> {name: np.ndarray} (true dtypes)."""
+    import ml_dtypes
+
+    out = {}
+    with np.load(path, allow_pickle=False) as z:
+        dtypes = {}
+        if _DTYPES_KEY in z.files:
+            dtypes = json.loads(bytes(z[_DTYPES_KEY]).decode("utf-8"))
+        for k in z.files:
+            if k == _DTYPES_KEY:
+                continue
+            a = z[k]
+            want = dtypes.get(k)
+            if want and want != str(a.dtype):
+                a = a.view(np.dtype(getattr(ml_dtypes, want)))
+            out[k] = a
+    return out
+
+
+# ---------------------------------------------------------------- export
 def functional_forward(layer):
     """(params_dict, *arrays) -> tuple of output arrays, via temporary
     param rebinding. Shared by jit serialization and inference.Predictor."""
@@ -62,25 +143,29 @@ def _specs_to_sds(specs):
         return int(s)
 
     out = []
-    for spec in specs:
+    names = []
+    for i, spec in enumerate(specs):
         if isinstance(spec, InputSpec):
             shape = tuple(dim(s) for s in spec.shape)
             out.append(jax.ShapeDtypeStruct(
                 shape, convert_dtype(spec.dtype) or jnp.float32))
+            names.append(getattr(spec, "name", None) or f"input_{i}")
         elif isinstance(spec, Tensor):
             out.append(jax.ShapeDtypeStruct(tuple(spec.shape),
                                             spec._value.dtype))
+            names.append(spec.name or f"input_{i}")
         else:
             arr = np.asarray(spec)
             out.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))
-    return out
+            names.append(f"input_{i}")
+    return out, names
 
 
 def save_program(layer, path, input_spec):
     """Export layer.forward(input_spec...) as StableHLO + params.
 
-    Writes path.pdmodel (serialized exported program + meta) and
-    path.pdiparams (params pickle)."""
+    Writes path.pdmodel (JSON header + StableHLO bytes) and
+    path.pdiparams (npz)."""
     was_training = getattr(layer, "training", False)
     layer.eval()
     try:
@@ -90,8 +175,9 @@ def save_program(layer, path, input_spec):
 
         param_sds = {k: jax.ShapeDtypeStruct(np.shape(v), v.dtype)
                      for k, v in params.items()}
-        in_sds = _specs_to_sds(input_spec)
+        in_sds, in_names = _specs_to_sds(input_spec)
         exported = jax_export.export(jax.jit(fwd))(param_sds, *in_sds)
+        n_outputs = len(exported.out_avals)
         blob = exported.serialize()
     finally:
         if was_training:
@@ -100,12 +186,14 @@ def save_program(layer, path, input_spec):
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path + ".pdmodel", "wb") as f:
-        pickle.dump({"version": _FORMAT_VERSION, "stablehlo": blob,
-                     "class": type(layer).__name__,
-                     "n_inputs": len(in_sds)}, f)
-    with open(path + ".pdiparams", "wb") as f:
-        pickle.dump({k: np.asarray(v) for k, v in params.items()}, f)
+    write_model_file(path + ".pdmodel", {
+        "stablehlo": True,
+        "class": type(layer).__name__,
+        "n_inputs": len(in_sds),
+        "input_names": in_names,
+        "output_names": [f"output_{i}" for i in range(n_outputs)],
+    }, blob)
+    save_params_npz(path + ".pdiparams", params)
 
 
 class TranslatedLayer:
@@ -117,12 +205,38 @@ class TranslatedLayer:
         self._exported = exported
         self._params = params
         self._meta = meta
+        self._call_params = None  # params cast to the program's dtypes
+
+    def _program_params(self):
+        """Params cast to the exported program's traced dtypes (cached).
+        Lets bf16-on-disk params (convert_to_mixed_precision) run a program
+        traced in fp32: the upcast happens once, on device."""
+        if self._call_params is None:
+            import jax.tree_util as jtu
+            args, _ = jtu.tree_unflatten(
+                self._exported.in_tree, list(self._exported.in_avals))
+            expected = args[0]
+            self._call_params = {
+                k: (v if v.dtype == expected[k].dtype
+                    else v.astype(expected[k].dtype))
+                for k, v in self._params.items()}
+        return self._call_params
+
+    @property
+    def input_names(self):
+        n = self._meta.get("n_inputs", 0)
+        return self._meta.get("input_names") or [
+            f"input_{i}" for i in range(n)]
+
+    @property
+    def output_names(self):
+        return self._meta.get("output_names") or ["output_0"]
 
     def __call__(self, *args):
         import jax.numpy as jnp
         arrs = [a._value if isinstance(a, Tensor) else jnp.asarray(a)
                 for a in args]
-        outs = self._exported.call(self._params, *arrs)
+        outs = self._exported.call(self._program_params(), *arrs)
         outs = [Tensor(o) for o in outs]
         return outs[0] if len(outs) == 1 else list(outs)
 
@@ -137,14 +251,29 @@ class TranslatedLayer:
     def state_dict(self):
         return {k: Tensor(v) for k, v in self._params.items()}
 
+    def astype(self, dtype):
+        """Cast all floating params to `dtype` (bf16 storage; used by
+        inference.convert_to_mixed_precision). The StableHLO program keeps
+        its traced dtypes — _program_params() casts back at call time — so
+        this halves host memory + host→device transfer, not compute. For a
+        bf16 compute program, export under amp.auto_cast."""
+        from paddle_tpu.core.dtype import convert_dtype
+        dt = convert_dtype(dtype)
+        self._params = {
+            k: (v.astype(dt) if np.issubdtype(np.asarray(v).dtype,
+                                              np.floating) else v)
+            for k, v in self._params.items()}
+        self._call_params = None
+        return self
+
 
 def load_program(path, params_path=None):
-    with open(path + ".pdmodel", "rb") as f:
-        meta = pickle.load(f)
-    if meta.get("version") != _FORMAT_VERSION:
-        raise ValueError(f"unsupported program version {meta.get('version')}")
-    with open(params_path or path + ".pdiparams", "rb") as f:
-        import jax.numpy as jnp
-        params = {k: jnp.asarray(v) for k, v in pickle.load(f).items()}
-    exported = jax_export.deserialize(meta["stablehlo"])
+    meta, blob = read_model_file(path + ".pdmodel")
+    if not meta.get("stablehlo"):
+        raise ValueError(f"{path}.pdmodel holds no serialized program")
+    import jax.numpy as jnp
+    params = {k: jnp.asarray(v)
+              for k, v in load_params_npz(
+                  params_path or path + ".pdiparams").items()}
+    exported = jax_export.deserialize(blob)
     return TranslatedLayer(exported, params, meta)

@@ -55,7 +55,10 @@ def export_chrome_tracing(dir_name, worker_name=None):
     """on_trace_ready callback: jax writes TensorBoard/Perfetto traces into
     dir_name (the reference writes Chrome json; same consumer workflow)."""
     def handler(prof):
-        prof._trace_dir = dir_name
+        pass  # jax already wrote the trace into handler._ptpu_trace_dir
+    # _begin_trace reads this; on_trace_ready itself only fires when a
+    # recorded window's trace is ready (reference contract)
+    handler._ptpu_trace_dir = dir_name
     return handler
 
 
@@ -77,8 +80,6 @@ class Profiler:
                  emit_nvtx=False, custom_device_types=None):
         self._trace_dir = os.path.join(os.getcwd(), "profiler_log")
         self.on_trace_ready = on_trace_ready
-        if on_trace_ready is not None:
-            on_trace_ready(self)
         if isinstance(scheduler, (tuple, list)):
             start, end = scheduler
             self.scheduler = make_scheduler(
@@ -109,6 +110,10 @@ class Profiler:
 
     def _begin_trace(self):
         if not self._active and not self.timer_only:
+            custom_dir = getattr(self.on_trace_ready, "_ptpu_trace_dir",
+                                 None)
+            if custom_dir:
+                self._trace_dir = custom_dir
             os.makedirs(self._trace_dir, exist_ok=True)
             jax.profiler.start_trace(self._trace_dir)
             self._active = True
@@ -117,8 +122,8 @@ class Profiler:
         if self._active:
             jax.profiler.stop_trace()
             self._active = False
-            # the reference contract: the callback fires when a recorded
-            # window's trace is ready (init-time call only configures dirs)
+            # the reference contract: the callback fires only when a
+            # recorded window's trace is ready
             if self.on_trace_ready is not None:
                 self.on_trace_ready(self)
 

@@ -181,8 +181,13 @@ tanh = _unary_on_values(jnp.tanh)
 sqrt = _unary_on_values(jnp.sqrt)
 abs = _unary_on_values(jnp.abs)
 neg = _unary_on_values(jnp.negative)
-pow = (lambda x, factor, name=None: _unary_on_values(
-    lambda v: jnp.power(v, factor))(x))
+def pow(x, factor, name=None):
+    """Zero-preserving only for factor > 0 (0**f == 0); otherwise implicit
+    zeros would become 1 (f == 0) or inf (f < 0), so fall back to dense."""
+    if np.isscalar(factor) and factor > 0:
+        return _unary_on_values(lambda v: jnp.power(v, factor))(x)
+    xv = x.to_dense() if isinstance(x, SparseCooTensor) else x
+    return Tensor(jnp.power(unwrap(xv), factor))
 
 
 def transpose(x, perm, name=None):

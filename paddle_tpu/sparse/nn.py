@@ -7,7 +7,9 @@ mirror — correct semantics, with true submanifold masking for SubmConv3D.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 
 from paddle_tpu.nn.layer.layers import Layer
 
@@ -27,19 +29,27 @@ class Softmax(Layer):
 
     def forward(self, x):
         from paddle_tpu import sparse
-        from paddle_tpu.core.tensor import Tensor
         if not isinstance(x, sparse.SparseCooTensor):
             import paddle_tpu.nn.functional as F
             return F.softmax(x, axis=self.axis)
-        dense = x._value
-        # softmax over the nonzero entries of each row only
-        mask = dense != 0
-        neg = jnp.where(mask, dense, -jnp.inf)
-        sm = jnp.where(mask, jnp.exp(neg - jnp.max(neg, axis=self.axis,
-                                                   keepdims=True)), 0.0)
-        denom = jnp.sum(sm, axis=self.axis, keepdims=True)
-        out = jnp.where(mask, sm / jnp.where(denom == 0, 1.0, denom), 0.0)
-        return sparse.to_sparse_coo(Tensor(out))
+        if self.axis not in (-1, x._value.ndim - 1):
+            raise ValueError("sparse softmax supports only the last axis")
+        # softmax over the STORED entries of each row (CSR nnz semantics:
+        # explicitly-stored zeros participate; implicit zeros do not)
+        bcoo = x._bcoo
+        vals = bcoo.data
+        idx = bcoo.indices  # (nnz, ndim)
+        shape = bcoo.shape
+        # linearize all leading dims into one segment id per row
+        row = jnp.zeros(idx.shape[0], dtype=jnp.int32)
+        for d in range(len(shape) - 1):
+            row = row * shape[d] + idx[:, d].astype(jnp.int32)
+        nrows = int(np.prod(shape[:-1])) or 1
+        mx = jax.ops.segment_max(vals, row, num_segments=nrows)
+        e = jnp.exp(vals - mx[row])
+        denom = jax.ops.segment_sum(e, row, num_segments=nrows)
+        out = e / denom[row]
+        return sparse.SparseCooTensor(jnp.swapaxes(idx, 0, 1), out, shape)
 
 
 class Conv3D(Layer):

@@ -62,6 +62,85 @@ class TestSerializedProgram:
                                    atol=1e-5)
 
 
+class TestPredictorCompleteness:
+    """r2: real IO names, mixed-precision conversion, warmup, donation."""
+
+    def test_artifact_is_not_pickle(self, tmp_path):
+        model = _mlp()
+        path = str(tmp_path / "safe")
+        paddle_tpu.jit.save(model, path,
+                            input_spec=[InputSpec([2, 4], "float32")])
+        with open(path + ".pdmodel", "rb") as f:
+            assert f.read(4) == b"PTPU"      # JSON+StableHLO container
+        with open(path + ".pdiparams", "rb") as f:
+            assert f.read(2) == b"PK"        # npz (zip), not pickle
+
+    def test_io_names_from_signature(self, tmp_path):
+        from paddle_tpu.inference import Config, create_predictor
+        model = _mlp()
+        model.eval()
+        path = str(tmp_path / "named")
+        paddle_tpu.jit.save(
+            model, path,
+            input_spec=[InputSpec([2, 4], "float32", name="feats")])
+        config = Config(path + ".pdmodel", path + ".pdiparams")
+        predictor = create_predictor(config)
+        assert predictor.get_input_names() == ["feats"]
+        h = predictor.get_input_handle("feats")
+        h.copy_from_cpu(np.ones((2, 4), np.float32))
+        predictor.run()
+        assert predictor.get_output_names() == ["output_0"]
+        out = predictor.get_output_handle("output_0").copy_to_cpu()
+        assert out.shape == (2, 2)
+
+    def test_convert_to_mixed_precision(self, tmp_path):
+        import ml_dtypes
+        from paddle_tpu.inference import (Config, PrecisionType,
+                                          convert_to_mixed_precision,
+                                          create_predictor)
+        from paddle_tpu.jit.serialization import load_params_npz
+        model = _mlp()
+        model.eval()
+        x = np.random.RandomState(2).randn(3, 4).astype(np.float32)
+        ref = model(paddle_tpu.to_tensor(x)).numpy()
+        path = str(tmp_path / "fp32")
+        paddle_tpu.jit.save(model, path,
+                            input_spec=[InputSpec([3, 4], "float32")])
+        mixed = str(tmp_path / "bf16")
+        convert_to_mixed_precision(
+            path + ".pdmodel", path + ".pdiparams",
+            mixed + ".pdmodel", mixed + ".pdiparams",
+            mixed_precision=PrecisionType.Bfloat16)
+        cast = load_params_npz(mixed + ".pdiparams")
+        assert all(v.dtype == np.dtype(ml_dtypes.bfloat16)
+                   for v in cast.values())
+        predictor = create_predictor(
+            Config(mixed + ".pdmodel", mixed + ".pdiparams"))
+        (out,) = predictor.run([x])
+        np.testing.assert_allclose(out, ref, atol=2e-2)  # bf16 storage
+
+    def test_live_layer_warmup_and_donation(self):
+        from paddle_tpu.inference import Config, create_predictor
+        model = _mlp()
+        config = Config()
+        config.set_layer(model)
+        config.enable_memory_optim()
+        predictor = create_predictor(config)
+        x = np.random.RandomState(3).randn(3, 4).astype(np.float32)
+        predictor.warmup([x])
+        (out,) = predictor.run([x])
+        ref = model(paddle_tpu.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_bf16_params_only_roundtrip(self, tmp_path):
+        model = _mlp()
+        model.to(dtype="bfloat16") if hasattr(model, "to") else None
+        path = str(tmp_path / "bf16_params")
+        paddle_tpu.jit.save(model, path)
+        sd = paddle_tpu.jit.load(path)
+        assert len(sd) == 4
+
+
 class TestReviewRegressions:
     def test_dynamic_batch_dim(self, tmp_path):
         model = _mlp()

@@ -91,6 +91,36 @@ class TestSparseOps:
         np.testing.assert_allclose(out.to_dense().numpy(), ref, atol=1e-5)
 
 
+class TestAdvisorRegressions:
+    def test_pow_nonpositive_exponent_dense_semantics(self):
+        s = sparse.sparse_coo_tensor(
+            paddle_tpu.to_tensor(np.array([[0], [0]], np.int64)),
+            paddle_tpu.to_tensor(np.array([2.0], np.float32)),
+            shape=[2, 2])
+        out0 = sparse.pow(s, 0.0)      # implicit zeros must become 1
+        ref0 = np.power(s.to_dense().numpy(), 0.0)
+        np.testing.assert_allclose(np.asarray(out0._value), ref0)
+        out2 = sparse.pow(s, 2.0)      # positive path stays sparse
+        assert isinstance(out2, sparse.SparseCooTensor)
+        np.testing.assert_allclose(out2.to_dense().numpy(),
+                                   s.to_dense().numpy() ** 2)
+
+    def test_softmax_over_stored_entries_including_zero(self):
+        from paddle_tpu.sparse.nn import Softmax
+        # row 0 stores values [0.0, 1.0] — the stored 0 must PARTICIPATE
+        idx = paddle_tpu.to_tensor(np.array([[0, 0, 1], [0, 1, 2]],
+                                            np.int64))
+        vals = paddle_tpu.to_tensor(np.array([0.0, 1.0, 5.0], np.float32))
+        s = sparse.sparse_coo_tensor(idx, vals, shape=[2, 3])
+        out = Softmax(axis=-1)(s)
+        assert isinstance(out, sparse.SparseCooTensor)
+        got = np.asarray(out.values()._value)
+        e = np.exp(np.array([0.0, 1.0]) - 1.0)
+        ref_row0 = e / e.sum()
+        np.testing.assert_allclose(got[:2], ref_row0, atol=1e-6)
+        np.testing.assert_allclose(got[2], 1.0, atol=1e-6)
+
+
 class TestSparseNN:
     def test_relu_layer(self):
         layer = sparse.nn.ReLU()
