@@ -1,0 +1,66 @@
+"""Functional vocab-parallel primitives for explicit shard_map programs.
+
+Reference: python/paddle/distributed/fleet/layers/mpu/mp_layers.py:37
+(VocabParallelEmbedding: per-rank table slice + masked lookup + allreduce)
+and :500 (ParallelCrossEntropy → c_softmax_with_cross_entropy, the fused
+sharded-logits CE with two allreduces).
+
+TPU-native: these are pure functions over LOCAL shards, meant to be called
+inside a shard_map body whose table/logits are partitioned over the `tp`
+mesh axis on the vocab dim. The layer classes in meta_parallel.py cover the
+pjit/propagation path; these cover the explicit-collectives path (the
+hybrid GPT flagship) where no full-vocab tensor may ever materialize.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def vocab_parallel_embedding(table_local, ids, axis_name="tp"):
+    """Gather rows of a vocab-sharded embedding table.
+
+    table_local: [V/tp, H] — this shard's contiguous slice of the table
+                 (shard i holds global rows [i*V/tp, (i+1)*V/tp)).
+    ids:         integer array of GLOBAL vocab ids, any shape.
+    Returns [*ids.shape, H], replicated over `axis_name` (one psum).
+    Out-of-shard ids contribute zero locally; the psum assembles the row
+    from whichever shard owns it — Megatron's masked-lookup + allreduce.
+    """
+    idx = lax.axis_index(axis_name)
+    v_loc = table_local.shape[0]
+    local = ids.astype(jnp.int32) - idx * v_loc
+    ok = (local >= 0) & (local < v_loc)
+    rows = table_local[jnp.clip(local, 0, v_loc - 1)]
+    rows = jnp.where(ok[..., None], rows, 0)
+    return lax.psum(rows, axis_name)
+
+
+def vocab_parallel_cross_entropy(logits_local, labels, axis_name="tp"):
+    """Softmax cross-entropy over vocab-sharded logits.
+
+    logits_local: [..., V/tp] — this shard's slice of the class dim.
+    labels:       [...] GLOBAL class ids.
+    Returns per-token nll [...], replicated over `axis_name`.
+
+    No [..., V] tensor is ever formed: the softmax runs as a local
+    max/sum-exp plus pmax+psum over the vocab axis, and the target logit is
+    fetched by the owning shard only (masked + psum) — the TPU analogue of
+    the reference's fused c_softmax_with_cross_entropy.
+    """
+    idx = lax.axis_index(axis_name)
+    v_loc = logits_local.shape[-1]
+    # global max via all_gather (pmax has no AD rule, even under
+    # stop_gradient — the tracer reaches it first); the shift is a
+    # constant wrt grad, the standard logsumexp trick
+    m = lax.stop_gradient(jnp.max(
+        lax.all_gather(jnp.max(logits_local, axis=-1), axis_name), axis=0))
+    denom = lax.psum(
+        jnp.sum(jnp.exp(logits_local - m[..., None]), axis=-1), axis_name)
+    local_lab = labels.astype(jnp.int32) - idx * v_loc
+    ok = (local_lab >= 0) & (local_lab < v_loc)
+    tgt = jnp.take_along_axis(
+        logits_local, jnp.clip(local_lab, 0, v_loc - 1)[..., None],
+        axis=-1)[..., 0]
+    tgt = lax.psum(jnp.where(ok, tgt, 0.0), axis_name)
+    return jnp.log(denom) + m - tgt

@@ -103,6 +103,127 @@ def pipeline_forward(stage_fn, stacked_params, x, axis_name="pp", mesh=None,
         check_vma=False)(stacked_params, x)
 
 
+def schedule_1f1b(num_microbatches, num_stages):
+    """Pure-python rendering of the SPMD 1F1B timetable (for tests/docs).
+
+    Returns {stage: [(tick, op, microbatch), ...]} with op in {"F","B"}.
+    Forward of microbatch m runs on stage s at tick m + s; backward at tick
+    2*(num_stages-1) + m - s. In steady state every stage alternates one
+    forward and one backward per tick — the 1F1B invariant; at most
+    2*(num_stages-1)+1 microbatches are ever in flight on stage 0
+    (vs num_microbatches for GPipe/FThenB).
+    """
+    M, n = num_microbatches, num_stages
+    out = {s: [] for s in range(n)}
+    for t in range(M + 2 * (n - 1)):
+        for s in range(n):
+            f = t - s
+            if 0 <= f < M:
+                out[s].append((t, "F", f))
+            b = t - 2 * (n - 1) + s
+            if 0 <= b < M:
+                out[s].append((t, "B", b))
+    return out
+
+
+def pipeline_1f1b_fn(stage_fn, loss_fn, axis_name="pp", axis_size=None):
+    """Explicit 1F1B forward+backward pipeline schedule (call INSIDE
+    shard_map). Reference: fleet/meta_parallel/pipeline_parallel.py:117
+    `forward_backward_pipeline` ("use the 1f1b scheduling strategy").
+
+    TPU-native: the reference drives 1F1B with per-rank NCCL p2p send/recv;
+    here ONE lax.scan of M + 2*(pp-1) ticks runs on every pp rank, each tick
+    doing one forward (activation hops forward via ppermute) AND one
+    backward (cotangent hops backward via a reverse ppermute). Backward is
+    explicit (jax.vjp per stage with recompute from a saved stage input),
+    NOT outer AD — that is what lets fwd and bwd interleave. Stage inputs
+    live in a ring buffer of min(M, 2*pp-1) slots, so activation memory is
+    O(pp), independent of the microbatch count (GPipe stores O(M + pp)
+    per-tick residuals).
+
+    stage_fn(stage_params, x) -> y      same x/y shape across stages
+    loss_fn(loss_params, y, aux) -> scalar loss of ONE microbatch
+        (runs on the last stage: e.g. final norm + LM head + CE)
+
+    Returns body(params_local, loss_params, x_mb, aux_mb) ->
+        (loss_sum, stage_grads_local, loss_param_grads, dx_mb)
+    where stage_grads_local has the same leading stage dim of 1 as
+    params_local, loss_param_grads/dx_mb are psum-replicated over pp, and
+    loss_sum is the SUM over microbatches (caller normalizes).
+    """
+    def body(params_local, loss_params, x, aux):
+        n = mesh_mod.resolve_axis_size(axis_name, axis_size)
+        stage = lax.axis_index(axis_name)
+        is_last = stage == n - 1
+        params = jax.tree_util.tree_map(lambda p: p[0], params_local)
+        M = x.shape[0]
+        R = min(M, 2 * n - 1)
+        T = M + 2 * (n - 1)
+        fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+        bwd_perm = [(i, (i - 1) % n) for i in range(n)]
+        zero_y = jnp.zeros(x.shape[1:], x.dtype)
+
+        def tick(c, t):
+            # ---------- forward half ----------
+            f_mb = t - stage
+            f_valid = (f_mb >= 0) & (f_mb < M)
+            f_idx = jnp.clip(f_mb, 0, M - 1)
+            inbound = lax.ppermute(c["fwd_out"], axis_name, fwd_perm)
+            inp = jnp.where(stage == 0, x[f_idx], inbound)
+            y = stage_fn(params, inp)
+            slot = f_idx % R
+            saved = c["saved"].at[slot].set(
+                jnp.where(f_valid, inp, c["saved"][slot]))
+            # last stage closes this microbatch NOW: loss + dy (1F1B's
+            # defining move — backward starts the tick forward finishes)
+            loss_m, (d_lp, dy) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1))(loss_params, y, aux[f_idx])
+            # ---------- backward half ----------
+            b_mb = t - 2 * (n - 1) + stage
+            b_valid = (b_mb >= 0) & (b_mb < M)
+            b_idx = jnp.clip(b_mb, 0, M - 1)
+            g_in = lax.ppermute(c["bwd_out"], axis_name, bwd_perm)
+            g = jnp.where(is_last, dy, g_in)
+            g = jnp.where(b_valid, g, 0.0)       # zero cotangent => zero
+            x_saved = saved[b_idx % R]           # grads (vjp is linear)
+            _, vjp = jax.vjp(stage_fn, params, x_saved)
+            d_params, d_x = vjp(g)
+            keep_loss = f_valid & is_last
+            carry = {
+                "fwd_out": y,
+                "bwd_out": d_x,
+                "saved": saved,
+                "gparams": jax.tree_util.tree_map(
+                    lambda a, b: a + b, c["gparams"], d_params),
+                "gloss": jax.tree_util.tree_map(
+                    lambda a, b: a + jnp.where(keep_loss, b, 0.0),
+                    c["gloss"], d_lp),
+                "loss": c["loss"] + jnp.where(keep_loss, loss_m, 0.0),
+            }
+            return carry, d_x
+
+        init = {
+            "fwd_out": zero_y,
+            "bwd_out": zero_y,
+            "saved": jnp.zeros((R,) + x.shape[1:], x.dtype),
+            "gparams": jax.tree_util.tree_map(jnp.zeros_like, params),
+            "gloss": jax.tree_util.tree_map(jnp.zeros_like, loss_params),
+            "loss": jnp.asarray(0.0, jnp.float32),
+        }
+        c, dxs = lax.scan(tick, init, jnp.arange(T))
+        # stage 0's backward of mb m ran at tick 2*(n-1) + m
+        dx_mb = lax.psum(
+            jnp.where(stage == 0, dxs[2 * (n - 1):], 0.0), axis_name)
+        loss_sum = lax.psum(c["loss"], axis_name)     # nonzero on last only
+        gloss = jax.tree_util.tree_map(
+            lambda a: lax.psum(a, axis_name), c["gloss"])
+        stage_grads = jax.tree_util.tree_map(lambda a: a[None],
+                                             c["gparams"])
+        return loss_sum, stage_grads, gloss, dx_mb
+
+    return body
+
+
 def microbatch(x, num_microbatches, batch_axis=0):
     """[B, ...] -> [M, B/M, ...] microbatch stream."""
     B = x.shape[batch_axis]

@@ -36,6 +36,8 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from paddle_tpu.distributed.context_parallel import ring_attention
+from paddle_tpu.distributed.fleet.mp_ops import (vocab_parallel_cross_entropy,
+                                                 vocab_parallel_embedding)
 
 
 # ---------------------------------------------------------------------------
@@ -87,9 +89,9 @@ def init_hybrid_gpt_params(cfg, mesh, seed=0):
 def hybrid_param_specs():
     """PartitionSpecs: stage dim over pp; Megatron col/row layouts over tp."""
     return {
-        "wte": P(None, None),        # embeddings+head replicated (small vs
-        "wpe": P(None, None),        # trunk at scale; vocab-tp is a later
-        "lnf_g": P(None),            # optimization)
+        "wte": P("tp", None),        # vocab-parallel table + tied head:
+        "wpe": P(None, None),        # no full-vocab logits ever materialize
+        "lnf_g": P(None),            # (fleet/mp_ops.py)
         "lnf_b": P(None),
         "stages": {
             "ln1_g": P("pp", None),
@@ -185,15 +187,19 @@ def make_hybrid_loss_fn(cfg, mesh, num_microbatches=2):
         raise ValueError("num_heads must divide by tp degree")
     if cfg.num_layers % pp:
         raise ValueError("num_layers must divide by pp degree")
+    if cfg.vocab_size % tp:
+        raise ValueError("vocab_size must divide by tp degree")
     heads_local = cfg.num_heads // tp
     M = num_microbatches
 
     def local_loss(params, ids, labels):
         b_loc, s_loc = ids.shape
         sp_idx = lax.axis_index("sp")
-        # embed (replicated tables; global positions from the sp shard idx)
+        # embed: vocab-parallel table (wte sharded over tp on the vocab dim;
+        # masked local lookup + psum), positions global via the sp shard idx
         pos = sp_idx * s_loc + jnp.arange(s_loc)
-        h = params["wte"][ids] + params["wpe"][pos][None, :, :]
+        h = vocab_parallel_embedding(params["wte"], ids, "tp") \
+            + params["wpe"][pos][None, :, :]
         # microbatch the local batch for the pipeline
         h = h.reshape(M, b_loc // M, s_loc, -1)
         block = functools.partial(_decoder_block,
@@ -201,10 +207,10 @@ def make_hybrid_loss_fn(cfg, mesh, num_microbatches=2):
         h = _pipeline_trunk(params["stages"], h, block, pp)
         h = h.reshape(b_loc, s_loc, -1)
         h = _layer_norm(h, params["lnf_g"], params["lnf_b"])
-        logits = h @ params["wte"].T           # tied head, replicated
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32),
-                                   axis=-1)[..., 0]
+        # tied head against the LOCAL vocab shard: [b, s, V/tp] is the
+        # largest logits tensor that ever exists; CE runs sharded
+        logits_local = h @ params["wte"].T
+        nll = vocab_parallel_cross_entropy(logits_local, labels, "tp")
         total = lax.psum(jnp.sum(nll), ("dp", "sp"))
         count = lax.psum(jnp.asarray(nll.size, jnp.float32), ("dp", "sp"))
         return total / count

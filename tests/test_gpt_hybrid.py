@@ -66,6 +66,49 @@ def test_hybrid_matches_single_device(meshes):
                                    atol=2e-4, rtol=2e-3)
 
 
+def test_vocab_table_is_sharded_not_replicated(meshes):
+    """r2 (VERDICT #3): wte must shard over tp — each tp shard holds
+    V/tp rows, so no device stores the full table or full-vocab logits."""
+    cfg = _cfg()
+    mesh = mesh_mod.init_mesh({"dp": 1, "pp": 2, "tp": 2, "sp": 2})
+    params = init_hybrid_gpt_params(cfg, mesh, seed=0)
+    wte = params["wte"]
+    spec = wte.sharding.spec
+    assert spec[0] == "tp", f"wte vocab dim not tp-sharded: {spec}"
+    for shard in wte.addressable_shards:
+        assert shard.data.shape == (cfg.vocab_size // 2, cfg.hidden_size)
+
+
+def test_vocab_parallel_primitives_match_dense():
+    """mp_ops on a pure-tp mesh == dense embedding/CE."""
+    import paddle_tpu  # noqa: F401  (conftest sets the 8-dev CPU platform)
+    from paddle_tpu.distributed.fleet.mp_ops import (
+        vocab_parallel_cross_entropy, vocab_parallel_embedding)
+
+    mesh = mesh_mod.init_mesh({"tp": 8})
+    rng = np.random.default_rng(1)
+    V, H, B = 64, 16, 5
+    table = rng.normal(0, 1, (V, H)).astype(np.float32)
+    ids = rng.integers(0, V, (B,)).astype(np.int32)
+    logits = rng.normal(0, 1, (B, V)).astype(np.float32)
+    labels = rng.integers(0, V, (B,)).astype(np.int32)
+
+    emb_fn = jax.shard_map(
+        lambda t, i: vocab_parallel_embedding(t, i, "tp"), mesh=mesh,
+        in_specs=(P("tp", None), P()), out_specs=P(), check_vma=False)
+    got = np.asarray(emb_fn(table, ids))
+    np.testing.assert_allclose(got, table[ids], atol=1e-6)
+
+    ce_fn = jax.shard_map(
+        lambda lg, lb: vocab_parallel_cross_entropy(lg, lb, "tp"), mesh=mesh,
+        in_specs=(P(None, "tp"), P()), out_specs=P(), check_vma=False)
+    got = np.asarray(ce_fn(logits, labels))
+    ref = -(logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+            )[np.arange(B), labels]
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+    mesh_mod.set_mesh(None)
+
+
 def test_hybrid_trains(meshes):
     cfg = _cfg()
     mesh = mesh_mod.init_mesh({"dp": 2, "pp": 2, "tp": 2, "sp": 1})
