@@ -106,16 +106,44 @@ class VocabParallelEmbedding(Layer):
 
 
 class ParallelCrossEntropy(Layer):
-    """Vocab-parallel CE: logits sharded over tp on the class dim; XLA
-    handles the two psums (max + sumexp) from shardings."""
+    """Vocab-parallel CE for the pjit/propagation path.
+
+    Reference: python/paddle/distributed/fleet/layers/mpu/mp_layers.py:500
+    (ParallelCrossEntropy → c_softmax_with_cross_entropy). TPU-native: the
+    logits' class dim stays sharded over `tp` through the whole loss — the
+    log-sum-exp reduces the sharded dim directly (XLA inserts the max/sum
+    collectives) and the target logit is extracted with a one-hot
+    multiply-sum that propagation shards the same way. No replicated
+    [..., V] tensor is ever materialized, matching the explicit-collectives
+    primitive in fleet/mp_ops.py (vocab_parallel_cross_entropy)."""
 
     def __init__(self, mp_group=None, name=None, ignore_index=-100):
         super().__init__()
         self.ignore_index = ignore_index
 
     def forward(self, input, label):
-        return F.cross_entropy(input, label, reduction="none",
-                               ignore_index=self.ignore_index)
+        ignore_index = self.ignore_index
+
+        def fn(logits, lab):
+            v = logits.shape[-1]
+            # keep the class dim sharded over tp (no-op off-mesh)
+            lf = logits.astype(jnp.float32)
+            m = jax.lax.stop_gradient(
+                jnp.max(lf, axis=-1, keepdims=True))
+            shifted = lf - m
+            lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1)) + m[..., 0]
+            lab_i = lab.astype(jnp.int32)
+            safe = jnp.clip(lab_i, 0, v - 1)
+            onehot = jax.nn.one_hot(safe, v, dtype=lf.dtype)
+            tgt = jnp.sum(shifted * onehot, axis=-1) + m[..., 0]
+            nll = lse - tgt
+            return jnp.where(lab_i == ignore_index, 0.0, nll)
+
+        squeeze = len(label.shape) == len(input.shape)
+        lab = label.reshape(label.shape[:-1]) if squeeze else label
+        x = _constrain(input, *([None] * (len(input.shape) - 1)), "tp")
+        out = apply(fn, x, lab)
+        return out.unsqueeze(-1) if squeeze else out
 
 
 class LayerDesc:

@@ -13,11 +13,67 @@ hybrid GPT flagship) where no full-vocab tensor may ever materialize.
 """
 from __future__ import annotations
 
+import functools
+
+import jax
 import jax.numpy as jnp
 from jax import lax
 
 
-def vocab_parallel_embedding(table_local, ids, axis_name="tp"):
+# ---------------------------------------------------------------------------
+# Tensor-parallel region boundary ops (explicit-backward path)
+#
+# Reference: fleet/layers/mpu/mp_ops.py `_c_identity` (identity fwd,
+# allreduce bwd) and `_c_allreduce`/`_mp_allreduce` (allreduce fwd, identity
+# bwd) — the Megatron region-boundary pair. They matter here because
+# jax.vjp taken INSIDE a shard_map with check_vma=False transposes
+# lax.psum to another psum, over-counting replicated cotangents by the
+# axis size; whole-program outer AD self-corrects, an inner vjp (the 1F1B
+# pipeline's per-stage backward) does not. These two custom-VJP ops pin the
+# correct semantics for inner vjps: use them (not bare lax.psum) in any
+# code differentiated by an explicit per-stage vjp.
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_tp_region(x, axis_name):
+    """Identity forward; backward all-reduces the cotangent over
+    `axis_name`. Insert where a replicated activation enters per-shard
+    compute (e.g. before a column-parallel matmul)."""
+    return x
+
+
+def _copy_fwd(x, axis_name):
+    return x, None
+
+
+def _copy_bwd(axis_name, _, ct):
+    return (lax.psum(ct, axis_name),)
+
+
+copy_to_tp_region.defvjp(_copy_fwd, _copy_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_from_tp_region(x, axis_name):
+    """All-reduce forward; backward passes the cotangent through
+    untouched. Use in place of lax.psum after a row-parallel matmul when
+    the surrounding code is differentiated with an explicit jax.vjp."""
+    return lax.psum(x, axis_name)
+
+
+def _reduce_fwd(x, axis_name):
+    return lax.psum(x, axis_name), None
+
+
+def _reduce_bwd(axis_name, _, ct):
+    return (ct,)
+
+
+reduce_from_tp_region.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+def vocab_parallel_embedding(table_local, ids, axis_name="tp",
+                             explicit_bwd=False):
     """Gather rows of a vocab-sharded embedding table.
 
     table_local: [V/tp, H] — this shard's contiguous slice of the table
@@ -26,6 +82,10 @@ def vocab_parallel_embedding(table_local, ids, axis_name="tp"):
     Returns [*ids.shape, H], replicated over `axis_name` (one psum).
     Out-of-shard ids contribute zero locally; the psum assembles the row
     from whichever shard owns it — Megatron's masked-lookup + allreduce.
+
+    explicit_bwd=True switches the allreduce to the identity-backward
+    region op — required when the caller differentiates with an explicit
+    jax.vjp (1F1B pipeline) rather than whole-program AD.
     """
     idx = lax.axis_index(axis_name)
     v_loc = table_local.shape[0]
@@ -33,10 +93,13 @@ def vocab_parallel_embedding(table_local, ids, axis_name="tp"):
     ok = (local >= 0) & (local < v_loc)
     rows = table_local[jnp.clip(local, 0, v_loc - 1)]
     rows = jnp.where(ok[..., None], rows, 0)
+    if explicit_bwd:
+        return reduce_from_tp_region(rows, axis_name)
     return lax.psum(rows, axis_name)
 
 
-def vocab_parallel_cross_entropy(logits_local, labels, axis_name="tp"):
+def vocab_parallel_cross_entropy(logits_local, labels, axis_name="tp",
+                                 explicit_bwd=False):
     """Softmax cross-entropy over vocab-sharded logits.
 
     logits_local: [..., V/tp] — this shard's slice of the class dim.
@@ -48,6 +111,13 @@ def vocab_parallel_cross_entropy(logits_local, labels, axis_name="tp"):
     fetched by the owning shard only (masked + psum) — the TPU analogue of
     the reference's fused c_softmax_with_cross_entropy.
     """
+    # custom_vjp rejects keyword args at call time — bind positionally
+    if explicit_bwd:
+        def reduce(x):
+            return reduce_from_tp_region(x, axis_name)
+    else:
+        def reduce(x):
+            return lax.psum(x, axis_name)
     idx = lax.axis_index(axis_name)
     v_loc = logits_local.shape[-1]
     # global max via all_gather (pmax has no AD rule, even under
@@ -55,12 +125,12 @@ def vocab_parallel_cross_entropy(logits_local, labels, axis_name="tp"):
     # constant wrt grad, the standard logsumexp trick
     m = lax.stop_gradient(jnp.max(
         lax.all_gather(jnp.max(logits_local, axis=-1), axis_name), axis=0))
-    denom = lax.psum(
-        jnp.sum(jnp.exp(logits_local - m[..., None]), axis=-1), axis_name)
+    denom = reduce(
+        jnp.sum(jnp.exp(logits_local - m[..., None]), axis=-1))
     local_lab = labels.astype(jnp.int32) - idx * v_loc
     ok = (local_lab >= 0) & (local_lab < v_loc)
     tgt = jnp.take_along_axis(
         logits_local, jnp.clip(local_lab, 0, v_loc - 1)[..., None],
         axis=-1)[..., 0]
-    tgt = lax.psum(jnp.where(ok, tgt, 0.0), axis_name)
+    tgt = reduce(jnp.where(ok, tgt, 0.0))
     return jnp.log(denom) + m - tgt

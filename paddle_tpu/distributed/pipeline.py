@@ -153,9 +153,30 @@ def pipeline_1f1b_fn(stage_fn, loss_fn, axis_name="pp", axis_size=None):
     """
     def body(params_local, loss_params, x, aux):
         n = mesh_mod.resolve_axis_size(axis_name, axis_size)
+        params = jax.tree_util.tree_map(lambda p: p[0], params_local)
+        loss_sum, gparams, gloss, dx_mb = pipeline_1f1b_body(
+            stage_fn, loss_fn, params, loss_params, x, aux,
+            axis_name=axis_name, axis_size=n)
+        stage_grads = jax.tree_util.tree_map(lambda a: a[None], gparams)
+        return loss_sum, stage_grads, gloss, dx_mb
+
+    return body
+
+
+def pipeline_1f1b_body(stage_fn, loss_fn, params, loss_params, x, aux,
+                       axis_name="pp", axis_size=None):
+    """Core 1F1B schedule on per-device stage params (no leading-dim
+    convention) — shared by pipeline_1f1b_fn and the hybrid GPT flagship
+    (models/gpt_hybrid.py), whose stage params carry a local layer stack.
+
+    Returns (loss_sum, stage_param_grads_local, loss_param_grads, dx_mb);
+    loss_param_grads and dx_mb are psum-replicated over `axis_name`,
+    stage_param_grads stay local to this stage.
+    """
+    def body(params, loss_params, x, aux):
+        n = mesh_mod.resolve_axis_size(axis_name, axis_size)
         stage = lax.axis_index(axis_name)
         is_last = stage == n - 1
-        params = jax.tree_util.tree_map(lambda p: p[0], params_local)
         M = x.shape[0]
         R = min(M, 2 * n - 1)
         T = M + 2 * (n - 1)
@@ -217,11 +238,9 @@ def pipeline_1f1b_fn(stage_fn, loss_fn, axis_name="pp", axis_size=None):
         loss_sum = lax.psum(c["loss"], axis_name)     # nonzero on last only
         gloss = jax.tree_util.tree_map(
             lambda a: lax.psum(a, axis_name), c["gloss"])
-        stage_grads = jax.tree_util.tree_map(lambda a: a[None],
-                                             c["gparams"])
-        return loss_sum, stage_grads, gloss, dx_mb
+        return loss_sum, c["gparams"], gloss, dx_mb
 
-    return body
+    return body(params, loss_params, x, aux)
 
 
 def microbatch(x, num_microbatches, batch_axis=0):

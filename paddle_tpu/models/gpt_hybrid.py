@@ -36,8 +36,11 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from paddle_tpu.distributed.context_parallel import ring_attention
-from paddle_tpu.distributed.fleet.mp_ops import (vocab_parallel_cross_entropy,
+from paddle_tpu.distributed.fleet.mp_ops import (copy_to_tp_region,
+                                                 reduce_from_tp_region,
+                                                 vocab_parallel_cross_entropy,
                                                  vocab_parallel_embedding)
+from paddle_tpu.distributed.pipeline import pipeline_1f1b_body
 
 
 # ---------------------------------------------------------------------------
@@ -121,13 +124,31 @@ def _layer_norm(x, g, b, eps=1e-5):
     return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
 
 
-def _decoder_block(p, h, num_heads_local, sp_size):
+def _decoder_block(p, h, num_heads_local, sp_size, explicit_tp_bwd=False):
     """One decoder layer on local shards: tp-split heads/ffn, sp-ring attn.
-    h: [mb, s_loc, H]. p leaves are single-layer (no leading layer dim)."""
+    h: [mb, s_loc, H]. p leaves are single-layer (no leading layer dim).
+
+    explicit_tp_bwd=True brackets the tp region with Megatron's
+    identity/allreduce boundary pair (fleet/mp_ops.py) so an explicit
+    per-stage jax.vjp (the 1F1B schedule) transposes the tp collectives
+    correctly; the default bare-psum form is for whole-program outer AD."""
+    if explicit_tp_bwd:
+        def enter(x):
+            return copy_to_tp_region(x, "tp")
+
+        def reduce(x):
+            return reduce_from_tp_region(x, "tp")
+    else:
+        def enter(x):
+            return x
+
+        def reduce(x):
+            return lax.psum(x, "tp")
+
     mb, s_loc, H = h.shape
     # --- attention ---
     x = _layer_norm(h, p["ln1_g"], p["ln1_b"])
-    qkv = x @ p["w_qkv"] + p["b_qkv"]          # [mb, s_loc, 3H/tp]
+    qkv = enter(x) @ p["w_qkv"] + p["b_qkv"]   # [mb, s_loc, 3H/tp]
     head_dim = p["w_qkv"].shape[1] // 3 // num_heads_local
     qkv = qkv.reshape(mb, s_loc, num_heads_local, 3 * head_dim)
     qkv = jnp.moveaxis(qkv, 2, 1)              # [mb, h_loc, s_loc, 3hd]
@@ -136,12 +157,12 @@ def _decoder_block(p, h, num_heads_local, sp_size):
                        axis_size=sp_size)      # exact causal over sp ring
     o = jnp.moveaxis(o, 1, 2).reshape(mb, s_loc, -1)
     attn = o @ p["w_o"]                        # partial sums over tp shard
-    attn = lax.psum(attn, "tp") + p["b_o"]     # row-parallel reduce
+    attn = reduce(attn) + p["b_o"]             # row-parallel reduce
     h = h + attn
     # --- mlp ---
     x = _layer_norm(h, p["ln2_g"], p["ln2_b"])
-    y = jax.nn.gelu(x @ p["w1"] + p["b1"], approximate=True)
-    y = lax.psum(y @ p["w2"], "tp") + p["b2"]  # row-parallel reduce
+    y = jax.nn.gelu(enter(x) @ p["w1"] + p["b1"], approximate=True)
+    y = reduce(y @ p["w2"]) + p["b2"]          # row-parallel reduce
     return h + y
 
 
@@ -175,12 +196,9 @@ def _pipeline_trunk(stage_params, h_mb, block_fn, pp_size):
     return lax.psum(outputs, "pp")
 
 
-def make_hybrid_loss_fn(cfg, mesh, num_microbatches=2):
-    """Whole-array loss(params, ids, labels) -> scalar; jit/grad-able.
-
-    ids/labels: [B, S] sharded (dp, sp). Composes the dp/pp/tp/sp program
-    described in the module docstring inside one shard_map.
-    """
+def _hybrid_degrees(cfg, mesh):
+    """Validate cfg divisibility against the mesh; returns
+    (tp, sp, pp, heads_local) — shared by both schedule factories."""
     shape = dict(mesh.shape)
     tp, sp, pp = shape["tp"], shape["sp"], shape["pp"]
     if cfg.num_heads % tp:
@@ -189,19 +207,39 @@ def make_hybrid_loss_fn(cfg, mesh, num_microbatches=2):
         raise ValueError("num_layers must divide by pp degree")
     if cfg.vocab_size % tp:
         raise ValueError("vocab_size must divide by tp degree")
-    heads_local = cfg.num_heads // tp
+    return tp, sp, pp, cfg.num_heads // tp
+
+
+def _embed_fn(ids, num_microbatches, explicit_bwd):
+    """Shared token+position embedding closure: vocab-parallel table
+    (wte tp-sharded on the vocab dim; masked local lookup + psum), global
+    positions via the sp shard index, reshaped into the [M, mb, s_loc, H]
+    microbatch stream the pipeline consumes."""
+    b_loc, s_loc = ids.shape
+    pos = lax.axis_index("sp") * s_loc + jnp.arange(s_loc)
+
+    def embed(wte, wpe):
+        h = vocab_parallel_embedding(wte, ids, "tp",
+                                     explicit_bwd=explicit_bwd) \
+            + wpe[pos][None, :, :]
+        return h.reshape(num_microbatches, b_loc // num_microbatches,
+                         s_loc, -1)
+
+    return embed
+
+
+def make_hybrid_loss_fn(cfg, mesh, num_microbatches=2):
+    """Whole-array loss(params, ids, labels) -> scalar; jit/grad-able.
+
+    ids/labels: [B, S] sharded (dp, sp). Composes the dp/pp/tp/sp program
+    described in the module docstring inside one shard_map.
+    """
+    tp, sp, pp, heads_local = _hybrid_degrees(cfg, mesh)
     M = num_microbatches
 
     def local_loss(params, ids, labels):
         b_loc, s_loc = ids.shape
-        sp_idx = lax.axis_index("sp")
-        # embed: vocab-parallel table (wte sharded over tp on the vocab dim;
-        # masked local lookup + psum), positions global via the sp shard idx
-        pos = sp_idx * s_loc + jnp.arange(s_loc)
-        h = vocab_parallel_embedding(params["wte"], ids, "tp") \
-            + params["wpe"][pos][None, :, :]
-        # microbatch the local batch for the pipeline
-        h = h.reshape(M, b_loc // M, s_loc, -1)
+        h = _embed_fn(ids, M, False)(params["wte"], params["wpe"])
         block = functools.partial(_decoder_block,
                                   num_heads_local=heads_local, sp_size=sp)
         h = _pipeline_trunk(params["stages"], h, block, pp)
@@ -222,18 +260,108 @@ def make_hybrid_loss_fn(cfg, mesh, num_microbatches=2):
                          out_specs=P(), check_vma=False)
 
 
-def make_hybrid_train_step(cfg, mesh, lr=1e-3, num_microbatches=2):
-    """SGD train step over the hybrid loss; returns jitted
+def make_hybrid_grad_fn(cfg, mesh, num_microbatches=2):
+    """Explicit 1F1B loss+grad for the flagship (r3, VERDICT #3).
+
+    Reference: fleet/meta_parallel/pipeline_parallel.py:117
+    (`forward_backward_pipeline`, "the 1f1b scheduling strategy"). Unlike
+    make_hybrid_loss_fn (whose GPipe trunk differentiates via outer AD),
+    this composes distributed/pipeline.py's explicit 1F1B schedule — the
+    per-tick interleaved forward/backward with an O(pp) activation ring
+    buffer — with the same tp psums and sp ring attention, so the schedule
+    that shrinks pipeline memory actually runs under the flagship's 4-D
+    sharding. The embedding and tied head sit outside the schedule: the
+    embed's VJP is applied to the dx_mb the pipeline returns, and the head
+    grads ride the schedule's loss_params slot.
+
+    Returns fn(params, ids, labels) -> (loss, grads) for the whole mesh.
+    """
+    tp, sp, pp, heads_local = _hybrid_degrees(cfg, mesh)
+    M = num_microbatches
+
+    def local_step(params, ids, labels):
+        b_loc, s_loc = ids.shape
+        embed = _embed_fn(ids, M, True)
+        h_mb, embed_vjp = jax.vjp(embed, params["wte"], params["wpe"])
+        labels_mb = labels.reshape(M, b_loc // M, s_loc)
+        block = functools.partial(_decoder_block,
+                                  num_heads_local=heads_local, sp_size=sp,
+                                  explicit_tp_bwd=True)
+
+        def stage_fn(stage_params, x):
+            def one(xc, pl):
+                return jax.checkpoint(block)(pl, xc), None
+            out, _ = lax.scan(one, x, stage_params)
+            return out
+
+        def loss_fn(lp, y, lab):
+            h = _layer_norm(y, lp["lnf_g"], lp["lnf_b"])
+            # copy_to_tp_region: the head consumes the replicated h on
+            # every tp rank — its vjp must psum the cotangent back
+            logits_local = copy_to_tp_region(h, "tp") @ lp["wte"].T
+            nll = vocab_parallel_cross_entropy(logits_local, lab, "tp",
+                                               explicit_bwd=True)
+            return jnp.sum(nll)
+
+        loss_params = {"lnf_g": params["lnf_g"], "lnf_b": params["lnf_b"],
+                       "wte": params["wte"]}
+        loss_sum, g_stages, gloss, dx_mb = pipeline_1f1b_body(
+            stage_fn, loss_fn, params["stages"], loss_params,
+            h_mb, labels_mb, axis_name="pp", axis_size=pp)
+        d_wte_e, d_wpe = embed_vjp(dx_mb)
+
+        total = lax.psum(loss_sum, ("dp", "sp"))
+        count = lax.psum(jnp.asarray(b_loc * s_loc, jnp.float32),
+                         ("dp", "sp"))
+        inv = 1.0 / count
+        grads = {
+            "wte": gloss["wte"] + d_wte_e,
+            "wpe": d_wpe,
+            "lnf_g": gloss["lnf_g"],
+            "lnf_b": gloss["lnf_b"],
+            "stages": g_stages,
+        }
+        grads = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, ("dp", "sp")) * inv, grads)
+        return total * inv, grads
+
+    specs = hybrid_param_specs()
+    data_spec = P("dp", "sp")
+    return jax.shard_map(local_step, mesh=mesh,
+                         in_specs=(specs, data_spec, data_spec),
+                         out_specs=(P(), specs), check_vma=False)
+
+
+def make_hybrid_train_step(cfg, mesh, lr=1e-3, num_microbatches=2,
+                           schedule="1f1b"):
+    """SGD train step over the hybrid program; returns jitted
     step(params, ids, labels) -> (params, loss). Update is elementwise, so
     every param keeps its hybrid sharding (dp grad-sync fell out of the
-    shard_map transpose as psums over dp/sp)."""
-    loss_fn = make_hybrid_loss_fn(cfg, mesh, num_microbatches)
+    shard_map transpose — or, on the 1F1B path, explicit dp/sp psums).
 
-    @jax.jit
-    def step(params, ids, labels):
-        loss, grads = jax.value_and_grad(loss_fn)(params, ids, labels)
-        params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params,
-                                        grads)
-        return params, loss
+    schedule: "1f1b" (explicit interleaved fwd/bwd pipeline, the flagship
+    default) or "gpipe" (scan+ppermute forward trunk, outer AD backward).
+    """
+    if schedule == "1f1b":
+        grad_fn = make_hybrid_grad_fn(cfg, mesh, num_microbatches)
 
+        @jax.jit
+        def step(params, ids, labels):
+            loss, grads = grad_fn(params, ids, labels)
+            params = jax.tree_util.tree_map(lambda p, g: p - lr * g,
+                                            params, grads)
+            return params, loss
+    elif schedule == "gpipe":
+        loss_fn = make_hybrid_loss_fn(cfg, mesh, num_microbatches)
+
+        @jax.jit
+        def step(params, ids, labels):
+            loss, grads = jax.value_and_grad(loss_fn)(params, ids, labels)
+            params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params,
+                                            grads)
+            return params, loss
+    else:
+        raise ValueError(f"unknown pipeline schedule: {schedule!r}")
+
+    step.schedule = schedule
     return step

@@ -31,19 +31,24 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         # compute batch stats and update running stats (paddle: r = m*r + (1-m)*b)
         def fn(v, rm, rv, w, b):
             axes = tuple(i for i in range(v.ndim) if i != channel_axis % v.ndim)
-            # statistics in fp32 regardless of activation dtype (bf16 sums
-            # over N*H*W elements lose too many bits); output keeps v.dtype
+            # centered two-pass variance: E[(x-m)²], NOT E[x²]-E[x]² — the
+            # one-pass form catastrophically cancels in fp32 when |mean| >>
+            # std (e.g. un-centered raw features), and the corrupted var
+            # would poison running_var for eval. fp32 accumulation
+            # regardless of activation dtype; output keeps v.dtype.
             vf = v.astype(jnp.float32)
             mean = jnp.mean(vf, axis=axes)
             var = jnp.var(vf, axis=axes)
             shape = [1] * v.ndim
             shape[channel_axis % v.ndim] = -1
-            out = (vf - mean.reshape(shape)) * jax.lax.rsqrt(
-                var.reshape(shape) + epsilon)
-            if w is not None:
-                out = out * w.reshape(shape).astype(jnp.float32)
+            # fold the affine into per-channel scale/shift so the normalize
+            # is a single fused multiply-add pass over the activation
+            inv = jax.lax.rsqrt(var + epsilon)
+            scale = inv if w is None else inv * w.astype(jnp.float32)
+            shift = -mean * scale
             if b is not None:
-                out = out + b.reshape(shape).astype(jnp.float32)
+                shift = shift + b.astype(jnp.float32)
+            out = vf * scale.reshape(shape) + shift.reshape(shape)
             return out.astype(v.dtype), mean, var
         out, mean_t, var_t = apply(fn, x, running_mean, running_var, weight, bias)
         with no_grad():
@@ -65,15 +70,16 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     def fn_eval(v, rm, rv, w, b):
         shape = [1] * v.ndim
         shape[channel_axis % v.ndim] = -1
-        # normalize in fp32 (stats/affine may be bf16 under O2 decorate);
-        # output keeps the activation dtype
-        inv = jax.lax.rsqrt(rv.reshape(shape).astype(jnp.float32) + epsilon)
-        out = (v.astype(jnp.float32) -
-               rm.reshape(shape).astype(jnp.float32)) * inv
-        if w is not None:
-            out = out * w.reshape(shape).astype(jnp.float32)
+        # per-channel scale/shift computed on (C,) vectors in fp32 (stats/
+        # affine may be bf16 under O2 decorate), then ONE fused multiply-add
+        # pass over the activation; output keeps the activation dtype
+        inv = jax.lax.rsqrt(rv.astype(jnp.float32) + epsilon)
+        scale = inv if w is None else inv * w.astype(jnp.float32)
+        shift = -rm.astype(jnp.float32) * scale
         if b is not None:
-            out = out + b.reshape(shape).astype(jnp.float32)
+            shift = shift + b.astype(jnp.float32)
+        out = (v.astype(jnp.float32) * scale.reshape(shape)
+               + shift.reshape(shape))
         return out.astype(v.dtype)
     return apply(fn_eval, x, running_mean, running_var, weight, bias)
 

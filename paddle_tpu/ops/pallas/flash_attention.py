@@ -261,13 +261,24 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
         block_k=block_k, seq_k=sk)
+    # causal: clamp the k index map to the diagonal so the skipped
+    # above-diagonal steps re-map to an already-resident block and Pallas
+    # elides their K/V DMA entirely (pl.when alone skips compute, not the
+    # prefetch)
+    if causal:
+        def kv_index(bh, qi, ki):
+            return (bh, jnp.minimum(
+                ki, (qi * block_q + block_q - 1) // block_k), 0)
+    else:
+        def kv_index(bh, qi, ki):
+            return (bh, ki, 0)
     o, lse8 = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             _vmem_spec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            _vmem_spec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
-            _vmem_spec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            _vmem_spec((1, block_k, d), kv_index),
+            _vmem_spec((1, block_k, d), kv_index),
         ],
         out_specs=[
             _vmem_spec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
@@ -329,6 +340,22 @@ def _flash_bwd_impl(q, k, v, do, lse, delta, causal, scale, block_q, block_k,
     sqp, skp = qp.shape[1], kp.shape[1]
     nq, nk = sqp // block_q, skp // block_k
 
+    # causal DMA elision (see _flash_fwd): skipped blocks re-map to a
+    # resident block index so their copies are elided
+    if causal:
+        def kv_index(bh, qi, ki):
+            return (bh, jnp.minimum(
+                ki, (qi * block_q + block_q - 1) // block_k), 0)
+
+        def q_index(bh, ki, qi):
+            return (bh, jnp.maximum(qi, (ki * block_k) // block_q), 0)
+    else:
+        def kv_index(bh, qi, ki):
+            return (bh, ki, 0)
+
+        def q_index(bh, ki, qi):
+            return (bh, qi, 0)
+
     dq_kernel = functools.partial(
         _bwd_dq_kernel, scale=scale, causal=causal, block_q=block_q,
         block_k=block_k, seq_k=sk)
@@ -337,8 +364,8 @@ def _flash_bwd_impl(q, k, v, do, lse, delta, causal, scale, block_q, block_k,
         grid=(bh, nq, nk),
         in_specs=[
             _vmem_spec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            _vmem_spec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
-            _vmem_spec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            _vmem_spec((1, block_k, d), kv_index),
+            _vmem_spec((1, block_k, d), kv_index),
             _vmem_spec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
             _vmem_spec((1, block_q, _LSE_LANES),
                        lambda bh, qi, ki: (bh, qi, 0)),
@@ -361,14 +388,12 @@ def _flash_bwd_impl(q, k, v, do, lse, delta, causal, scale, block_q, block_k,
         dkv_kernel,
         grid=(bh, nk, nq),
         in_specs=[
-            _vmem_spec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0)),
+            _vmem_spec((1, block_q, d), q_index),
             _vmem_spec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
             _vmem_spec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),
-            _vmem_spec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0)),
-            _vmem_spec((1, block_q, _LSE_LANES),
-                       lambda bh, ki, qi: (bh, qi, 0)),
-            _vmem_spec((1, block_q, _LSE_LANES),
-                       lambda bh, ki, qi: (bh, qi, 0)),
+            _vmem_spec((1, block_q, d), q_index),
+            _vmem_spec((1, block_q, _LSE_LANES), q_index),
+            _vmem_spec((1, block_q, _LSE_LANES), q_index),
         ],
         out_specs=[
             _vmem_spec((1, block_k, d), lambda bh, ki, qi: (bh, ki, 0)),

@@ -251,6 +251,71 @@ class TestTensorParallelEquivalence:
         ref = emb.weight.numpy()[ids.numpy()]
         np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
 
+    def test_parallel_cross_entropy_matches_dense(self):
+        """r3 (VERDICT #7): the layer-API ParallelCrossEntropy must be
+        genuinely vocab-parallel — values and grads match dense CE while
+        the class dim stays tp-sharded end to end."""
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            ParallelCrossEntropy)
+
+        _mesh({"tp": 8})
+        rng = np.random.default_rng(7)
+        B, V = 6, 64
+        logits_np = rng.standard_normal((B, V)).astype(np.float32)
+        labels_np = rng.integers(0, V, (B,)).astype(np.int64)
+        labels_np[2] = -100                      # ignore_index row
+        ce = ParallelCrossEntropy(ignore_index=-100)
+
+        logits = paddle.to_tensor(logits_np)
+        logits.stop_gradient = False
+        labels = paddle.to_tensor(labels_np)
+        loss = ce(logits, labels)
+        loss.sum().backward()
+
+        # dense reference
+        m = logits_np.max(-1, keepdims=True)
+        p = np.exp(logits_np - m)
+        p /= p.sum(-1, keepdims=True)
+        safe = np.clip(labels_np, 0, V - 1)
+        nll = -np.log(p[np.arange(B), safe])
+        nll[labels_np == -100] = 0.0
+        np.testing.assert_allclose(loss.numpy(), nll, rtol=1e-5, atol=1e-6)
+
+        gref = p.copy()
+        gref[np.arange(B), safe] -= 1.0
+        gref[labels_np == -100] = 0.0
+        np.testing.assert_allclose(logits.grad.numpy(), gref,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_parallel_cross_entropy_never_materializes_full_vocab(self):
+        """Compiled SPMD partition must hold only [B, V/tp] slices of the
+        class dim — no replicated full-vocab tensor anywhere (the r2 layer
+        fed dense F.cross_entropy and relied on propagation luck)."""
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            ParallelCrossEntropy)
+
+        mesh = _mesh({"tp": 8})
+        B, V = 4, 512
+        ce = ParallelCrossEntropy()
+
+        def loss_fn(logits, labels):
+            t_logits = Tensor(logits)
+            t_labels = Tensor(labels)
+            return ce(t_logits, t_labels)._value.sum()
+
+        jmesh = mesh.jax_mesh if hasattr(mesh, "jax_mesh") else mesh
+        sh_logits = NamedSharding(jmesh, P(None, "tp"))
+        sh_labels = NamedSharding(jmesh, P(None))
+        compiled = jax.jit(
+            loss_fn, in_shardings=(sh_logits, sh_labels)).lower(
+            jax.ShapeDtypeStruct((B, V), jnp.float32),
+            jax.ShapeDtypeStruct((B,), jnp.int32)).compile()
+        txt = compiled.as_text()
+        # per-partition HLO shows local shapes: V/8 = 64 per shard. Any
+        # f32[...,512] tensor would mean a replicated full-vocab value.
+        assert f"f32[{B},{V}]" not in txt, \
+            "full-vocab replicated tensor found in partitioned HLO"
+
     def test_tp_linear_backward_matches_dense(self):
         from paddle_tpu.distributed.fleet.meta_parallel import (
             ColumnParallelLinear)

@@ -121,3 +121,47 @@ def test_hybrid_trains(meshes):
         losses.append(float(loss))
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0]
+
+
+def test_hybrid_1f1b_matches_single_device(meshes):
+    """r3 (VERDICT #3): the flagship on the explicit 1F1B schedule — tp
+    psums + sp ring attention composed with pipeline_1f1b_body — must match
+    the same math on a 1-device mesh, loss AND grads."""
+    from paddle_tpu.models.gpt_hybrid import make_hybrid_grad_fn
+
+    cfg = _cfg()
+    mesh8 = mesh_mod.init_mesh({"dp": 1, "pp": 2, "tp": 2, "sp": 2})
+    params8 = init_hybrid_gpt_params(cfg, mesh8, seed=0)
+    host = _host_params(params8)
+
+    grad8 = make_hybrid_grad_fn(cfg, mesh8, num_microbatches=2)
+    ids8, labels8 = _data(mesh8)
+    l8, g8 = jax.jit(grad8)(params8, ids8, labels8)
+
+    mesh1 = mesh_mod.init_mesh(
+        {"dp": 1, "pp": 1, "tp": 1, "sp": 1}, devices=jax.devices()[:1])
+    params1 = jax.tree_util.tree_map(jnp.asarray, host)
+    loss1 = make_hybrid_loss_fn(cfg, mesh1, num_microbatches=2)
+    ids1, labels1 = _data(mesh1)
+    l1, g1 = jax.jit(jax.value_and_grad(loss1))(params1, ids1, labels1)
+
+    np.testing.assert_allclose(float(l8), float(l1), rtol=2e-5)
+    flat8 = jax.tree_util.tree_leaves(g8)
+    flat1 = jax.tree_util.tree_leaves(g1)
+    for a, b in zip(flat8, flat1):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-3)
+
+
+def test_hybrid_1f1b_train_step_decreases_loss(meshes):
+    cfg = _cfg()
+    mesh = mesh_mod.init_mesh({"dp": 1, "pp": 2, "tp": 2, "sp": 2})
+    params = init_hybrid_gpt_params(cfg, mesh, seed=0)
+    step = make_hybrid_train_step(cfg, mesh, lr=0.1, num_microbatches=2,
+                                  schedule="1f1b")
+    ids, labels = _data(mesh)
+    losses = []
+    for _ in range(4):
+        params, loss = step(params, ids, labels)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
