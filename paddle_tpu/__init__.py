@@ -62,6 +62,7 @@ from paddle_tpu import (  # noqa: F401,E402
     distribution,
     fft,
     framework,
+    incubate,
     inference,
     io,
     jit,

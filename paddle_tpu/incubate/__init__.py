@@ -1,0 +1,8 @@
+"""paddle.incubate parity namespace (reference: python/paddle/incubate).
+
+Hosts the fused transformer ops/layers; the rest of the reference's
+incubate surface either graduated into core namespaces here (flash
+attention lives in ops/pallas + nn.functional.scaled_dot_product_attention)
+or is GPU-runtime-specific with no TPU analogue.
+"""
+from paddle_tpu.incubate import nn  # noqa: F401

@@ -1,0 +1,170 @@
+"""Fused transformer layers.
+
+Reference parity: python/paddle/incubate/nn/layer/fused_transformer.py —
+FusedMultiHeadAttention (:191), FusedFeedForward (:478),
+FusedTransformerEncoderLayer (:706). Thin Layer wrappers over the
+functionals in incubate.nn.functional (which place the fusion on the XLA
+compiler + Pallas kernels instead of the reference's monolithic CUDA
+ops).
+"""
+from __future__ import annotations
+
+from paddle_tpu.incubate.nn import functional  # noqa: F401
+from paddle_tpu.incubate.nn.functional import (fused_feedforward,
+                                               fused_multi_head_attention)
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layer.layers import Layer
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer"]
+
+
+class FusedMultiHeadAttention(Layer):
+    """Reference incubate/nn/layer/fused_transformer.py:191."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        assert embed_dim > 0 and num_heads > 0
+        assert embed_dim % num_heads == 0
+        head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self._dropout_rate = dropout_rate
+        self._attn_dropout_rate = attn_dropout_rate
+        self._epsilon = epsilon
+        self.qkv_weight = self.create_parameter(
+            shape=[3, num_heads, head_dim, embed_dim], attr=qkv_weight_attr,
+            default_initializer=I.XavierUniform())
+        self.qkv_bias = None
+        if qkv_bias_attr is not False:
+            self.qkv_bias = self.create_parameter(
+                shape=[3, num_heads, head_dim], attr=qkv_bias_attr,
+                is_bias=True)
+        self.linear_weight = self.create_parameter(
+            shape=[embed_dim, embed_dim], attr=linear_weight_attr,
+            default_initializer=I.XavierUniform())
+        self.linear_bias = None
+        if linear_bias_attr is not False:
+            self.linear_bias = self.create_parameter(
+                shape=[embed_dim], attr=linear_bias_attr, is_bias=True)
+        ones = I.Constant(1.0)
+        zeros = I.Constant(0.0)
+        self.pre_ln_scale = self.create_parameter(
+            shape=[embed_dim], attr=pre_ln_scale_attr,
+            default_initializer=ones)
+        self.pre_ln_bias = self.create_parameter(
+            shape=[embed_dim], attr=pre_ln_bias_attr,
+            default_initializer=zeros, is_bias=True)
+        self.ln_scale = self.create_parameter(
+            shape=[embed_dim], attr=ln_scale_attr,
+            default_initializer=ones)
+        self.ln_bias = self.create_parameter(
+            shape=[embed_dim], attr=ln_bias_attr,
+            default_initializer=zeros, is_bias=True)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        return fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self.normalize_before,
+            pre_ln_scale=self.pre_ln_scale, pre_ln_bias=self.pre_ln_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            pre_ln_epsilon=self._epsilon, qkv_bias=self.qkv_bias,
+            linear_bias=self.linear_bias, cache_kv=cache,
+            attn_mask=attn_mask, dropout_rate=self._dropout_rate,
+            attn_dropout_rate=self._attn_dropout_rate,
+            ln_epsilon=self._epsilon, training=self.training)
+
+
+class FusedFeedForward(Layer):
+    """Reference incubate/nn/layer/fused_transformer.py:478."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        assert d_model > 0 and dim_feedforward > 0
+        self._normalize_before = normalize_before
+        self._dropout_rate = dropout_rate
+        self._act_dropout_rate = dropout_rate if act_dropout_rate is None \
+            else act_dropout_rate
+        self._activation = activation
+        self._epsilon = epsilon
+        self.linear1_weight = self.create_parameter(
+            shape=[d_model, dim_feedforward], attr=linear1_weight_attr,
+            default_initializer=I.XavierUniform())
+        self.linear1_bias = self.create_parameter(
+            shape=[dim_feedforward], attr=linear1_bias_attr, is_bias=True)
+        self.linear2_weight = self.create_parameter(
+            shape=[dim_feedforward, d_model], attr=linear2_weight_attr,
+            default_initializer=I.XavierUniform())
+        self.linear2_bias = self.create_parameter(
+            shape=[d_model], attr=linear2_bias_attr, is_bias=True)
+        ones = I.Constant(1.0)
+        zeros = I.Constant(0.0)
+        self.ln1_scale = self.create_parameter(
+            shape=[d_model], attr=ln1_scale_attr, default_initializer=ones)
+        self.ln1_bias = self.create_parameter(
+            shape=[d_model], attr=ln1_bias_attr, default_initializer=zeros,
+            is_bias=True)
+        self.ln2_scale = self.create_parameter(
+            shape=[d_model], attr=ln2_scale_attr, default_initializer=ones)
+        self.ln2_bias = self.create_parameter(
+            shape=[d_model], attr=ln2_bias_attr, default_initializer=zeros,
+            is_bias=True)
+
+    def forward(self, src, cache=None):
+        return fused_feedforward(
+            src, self.linear1_weight, self.linear2_weight,
+            self.linear1_bias, self.linear2_bias, self.ln1_scale,
+            self.ln1_bias, self.ln2_scale, self.ln2_bias,
+            dropout1_rate=self._act_dropout_rate,
+            dropout2_rate=self._dropout_rate,
+            activation=self._activation, ln1_epsilon=self._epsilon,
+            ln2_epsilon=self._epsilon,
+            pre_layer_norm=self._normalize_before, training=self.training)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """Reference incubate/nn/layer/fused_transformer.py:706."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        attn_dropout_rate = dropout_rate if attn_dropout_rate is None \
+            else attn_dropout_rate
+        act_dropout_rate = dropout_rate if act_dropout_rate is None \
+            else act_dropout_rate
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate,
+            normalize_before=normalize_before,
+            qkv_weight_attr=weight_attr, qkv_bias_attr=bias_attr,
+            linear_weight_attr=weight_attr, linear_bias_attr=bias_attr)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before,
+            linear1_weight_attr=weight_attr, linear1_bias_attr=bias_attr,
+            linear2_weight_attr=weight_attr, linear2_bias_attr=bias_attr)
+
+    def forward(self, src, src_mask=None, cache=None):
+        if cache is None:
+            out = self.fused_attn(src, attn_mask=src_mask)
+        else:
+            out, cache = self.fused_attn(src, attn_mask=src_mask,
+                                         cache=cache)
+        out = self.ffn(out)
+        return out if cache is None else (out, cache)

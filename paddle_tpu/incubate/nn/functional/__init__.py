@@ -1,0 +1,194 @@
+"""Fused transformer functionals.
+
+Reference parity: python/paddle/incubate/nn/functional/fused_transformer.py
+— fused_feedforward (:31), fused_multi_head_attention (:462); plus
+fused_linear (fused_matmul_bias.py).
+
+TPU-native design: the reference lowers these to monolithic CUDA fused
+kernels (fused_feedforward_op / fused_attention_op). Here the fusion is
+split between the XLA compiler (bias+activation+dropout+residual
+epilogues fuse into the matmuls automatically under jit) and Pallas
+kernels for the pieces XLA fuses poorly: the layer norms run on the
+fused Pallas norm kernel and the attention core takes the flash-attention
+kernel whenever no additive mask / attention dropout forces the dense
+path. Same math, compiler-placed fusion.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.framework.state import next_key
+from paddle_tpu.ops.pallas.norm import fused_layer_norm
+
+__all__ = ["fused_feedforward", "fused_multi_head_attention",
+           "fused_linear"]
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else (
+        None if x is None else jnp.asarray(x))
+
+
+def _t(x):
+    if x is None or isinstance(x, Tensor):
+        return x
+    return Tensor(jnp.asarray(x))
+
+
+def _apply_opt(fn, *args):
+    """apply() over a mixed (Tensor | None) argument list: None slots are
+    closed over; Tensor slots participate in autograd."""
+    tensors = [a for a in args if a is not None]
+    idx = [i for i, a in enumerate(args) if a is not None]
+
+    def wrapper(*vals):
+        full = [None] * len(args)
+        for i, v in zip(idx, vals):
+            full[i] = v
+        return fn(*full)
+
+    return apply(wrapper, *tensors)
+
+
+def _dropout_val(v, rate, training, mode):
+    if not training or rate == 0.0:
+        return v if mode == "upscale_in_train" else v * (1.0 - rate)
+    keep = jax.random.bernoulli(next_key(), 1.0 - rate,
+                                v.shape).astype(v.dtype)
+    if mode == "upscale_in_train":
+        return v * keep / (1.0 - rate)
+    return v * keep
+
+
+def _ln(v, scale, bias, eps):
+    return fused_layer_norm(v, scale, bias, eps).astype(v.dtype)
+
+
+_ACTS = {
+    "relu": jax.nn.relu,
+    "gelu": jax.nn.gelu,
+}
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """matmul + bias add in one op (reference fused_matmul_bias)."""
+    def fn(xv, wv, bv):
+        w = wv.T if transpose_weight else wv
+        y = xv @ w
+        return y if bv is None else y + bv
+
+    return _apply_opt(fn, _t(x), _t(weight), _t(bias))
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", ring_id=-1,
+                      add_residual=True, name=None):
+    """Transformer FFN block: (pre-)LN -> linear1 -> act -> dropout1 ->
+    linear2 -> dropout2 -> (+residual) -> (post-)LN.
+    Reference: incubate/nn/functional/fused_transformer.py:31."""
+    act = _ACTS[activation]
+
+    def fn(xv, w1, w2, b1, b2, g1, be1, g2, be2):
+        residual = xv
+        out = _ln(xv, g1, be1, ln1_epsilon) if pre_layer_norm else xv
+        out = out @ w1
+        if b1 is not None:
+            out = out + b1
+        out = _dropout_val(act(out), dropout1_rate, training, mode)
+        out = out @ w2
+        if b2 is not None:
+            out = out + b2
+        out = _dropout_val(out, dropout2_rate, training, mode)
+        if add_residual:
+            out = residual + out
+        if not pre_layer_norm:
+            out = _ln(out, g2, be2, ln2_epsilon)
+        return out
+
+    return _apply_opt(fn, _t(x), _t(linear1_weight), _t(linear2_weight),
+                      _t(linear1_bias), _t(linear2_bias), _t(ln1_scale),
+                      _t(ln1_bias), _t(ln2_scale), _t(ln2_bias))
+
+
+def _convert_mask(mask, dtype):
+    if mask.dtype == jnp.bool_:
+        return jnp.where(mask, 0.0, jnp.finfo(jnp.float32).min)
+    if jnp.issubdtype(mask.dtype, jnp.integer):
+        return jnp.where(mask != 0, 0.0, jnp.finfo(jnp.float32).min)
+    return mask.astype(jnp.float32)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5,
+                               qkv_bias=None, linear_bias=None,
+                               cache_kv=None, attn_mask=None,
+                               dropout_rate=0.5, attn_dropout_rate=0.5,
+                               ln_epsilon=1e-5, training=True,
+                               mode="upscale_in_train", ring_id=-1,
+                               add_residual=True, name=None):
+    """Fused self-attention block. qkv_weight: [3, n_head, head_dim,
+    embed_dim]; qkv_bias: [3, n_head, head_dim]. With cache_kv
+    ([2, b, n, s_cache, d]) returns (out, updated_cache).
+    Reference: incubate/nn/functional/fused_transformer.py:462.
+
+    The attention core runs the Pallas flash kernel when no additive mask
+    and no attention dropout require materializing the score matrix."""
+    has_cache = cache_kv is not None
+
+    def fn(xv, qkvw, lw, pg, pb, g, b, qkvb, lb, cache, mask):
+        bsz, s, e = xv.shape
+        _, n, hd, _ = qkvw.shape
+        residual = xv
+        out = _ln(xv, pg, pb, pre_ln_epsilon) if pre_layer_norm else xv
+        w = qkvw.reshape(3 * n * hd, e)
+        qkv = out @ w.T                                  # [b, s, 3nd]
+        if qkvb is not None:
+            qkv = qkv + qkvb.reshape(3 * n * hd)
+        qkv = qkv.reshape(bsz, s, 3, n, hd)
+        qkv = jnp.moveaxis(qkv, 2, 0)                    # [3, b, s, n, d]
+        q, k, v = (jnp.swapaxes(t, 1, 2) for t in qkv)   # [b, n, s, d]
+        if cache is not None:
+            k = jnp.concatenate([cache[0], k], axis=2)
+            v = jnp.concatenate([cache[1], v], axis=2)
+            new_cache = jnp.stack([k, v], axis=0)
+        scale = float(hd) ** -0.5
+        drop_attn = training and attn_dropout_rate > 0.0
+        if mask is None and not drop_attn:
+            from paddle_tpu.ops.pallas.flash_attention import (
+                flash_attention_bhsd)
+            ctx = flash_attention_bhsd(q, k, v, causal=False, scale=scale)
+        else:
+            s_qk = (q * scale) @ jnp.swapaxes(k, -1, -2)
+            if mask is not None:
+                s_qk = s_qk + _convert_mask(mask, s_qk.dtype)
+            p = jax.nn.softmax(s_qk.astype(jnp.float32), axis=-1) \
+                .astype(xv.dtype)
+            p = _dropout_val(p, attn_dropout_rate, training, mode)
+            ctx = p @ v
+        ctx = jnp.swapaxes(ctx, 1, 2).reshape(bsz, s, n * hd)
+        out = ctx @ lw
+        if lb is not None:
+            out = out + lb
+        out = _dropout_val(out, dropout_rate, training, mode)
+        if add_residual:
+            out = residual + out
+        if not pre_layer_norm:
+            out = _ln(out, g, b, ln_epsilon)
+        if cache is not None:
+            return out, new_cache
+        return out
+
+    return _apply_opt(fn, _t(x), _t(qkv_weight), _t(linear_weight),
+                      _t(pre_ln_scale), _t(pre_ln_bias), _t(ln_scale),
+                      _t(ln_bias), _t(qkv_bias), _t(linear_bias),
+                      _t(cache_kv) if has_cache else None, _t(attn_mask))

@@ -41,14 +41,15 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
             var = jnp.var(vf, axis=axes)
             shape = [1] * v.ndim
             shape[channel_axis % v.ndim] = -1
-            # fold the affine into per-channel scale/shift so the normalize
-            # is a single fused multiply-add pass over the activation
+            # subtract the mean BEFORE scaling (fold only the affine into
+            # the per-channel scale): vf*scale - mean*scale would cancel
+            # catastrophically when |mean| >> std; (vf - mean) keeps the
+            # bits and still fuses into one elementwise pass
             inv = jax.lax.rsqrt(var + epsilon)
             scale = inv if w is None else inv * w.astype(jnp.float32)
-            shift = -mean * scale
+            out = (vf - mean.reshape(shape)) * scale.reshape(shape)
             if b is not None:
-                shift = shift + b.astype(jnp.float32)
-            out = vf * scale.reshape(shape) + shift.reshape(shape)
+                out = out + b.astype(jnp.float32).reshape(shape)
             return out.astype(v.dtype), mean, var
         out, mean_t, var_t = apply(fn, x, running_mean, running_var, weight, bias)
         with no_grad():
@@ -70,16 +71,15 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     def fn_eval(v, rm, rv, w, b):
         shape = [1] * v.ndim
         shape[channel_axis % v.ndim] = -1
-        # per-channel scale/shift computed on (C,) vectors in fp32 (stats/
-        # affine may be bf16 under O2 decorate), then ONE fused multiply-add
-        # pass over the activation; output keeps the activation dtype
+        # per-channel scale computed on (C,) vectors in fp32 (stats/affine
+        # may be bf16 under O2 decorate); mean subtracted before scaling
+        # (see training path: the folded form cancels for |mean| >> std)
         inv = jax.lax.rsqrt(rv.astype(jnp.float32) + epsilon)
         scale = inv if w is None else inv * w.astype(jnp.float32)
-        shift = -rm.astype(jnp.float32) * scale
+        out = (v.astype(jnp.float32) - rm.astype(jnp.float32)
+               .reshape(shape)) * scale.reshape(shape)
         if b is not None:
-            shift = shift + b.astype(jnp.float32)
-        out = (v.astype(jnp.float32) * scale.reshape(shape)
-               + shift.reshape(shape))
+            out = out + b.astype(jnp.float32).reshape(shape)
         return out.astype(v.dtype)
     return apply(fn_eval, x, running_mean, running_var, weight, bias)
 

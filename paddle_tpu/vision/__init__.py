@@ -1,1 +1,1 @@
-from paddle_tpu.vision import datasets, models, transforms  # noqa: F401
+from paddle_tpu.vision import datasets, models, ops, transforms  # noqa: F401

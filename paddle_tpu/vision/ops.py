@@ -1,0 +1,592 @@
+"""Detection / geometry vision ops.
+
+Reference parity: python/paddle/vision/ops.py — yolo_box (:283),
+deform_conv2d (:850) + DeformConv2D (:1088), psroi_pool (:1545) +
+PSRoIPool (:1632), roi_pool (:1677) + RoIPool (:1771), roi_align (:1818)
++ RoIAlign (:1959), nms (:2064), ConvNormActivation (:2007); numeric
+semantics match the phi CPU kernels (paddle/phi/kernels/cpu/
+{yolo_box,psroi_pool,roi_pool,roi_align,deformable_conv}_kernel.cc).
+
+TPU-native design: the reference implements these as per-element CUDA/C++
+loops; here every op is a dense, statically-shaped jnp computation —
+masked-sum einsums for the pooling ops (the variable-extent bins of the
+scalar kernels become bin-membership weight masks contracted on the MXU),
+vectorized bilinear gathers for roi_align / deform_conv2d, and a
+lax.fori_loop suppression sweep for nms. All ops differentiate through
+the standard JAX AD rules (the reference's hand-written grad kernels come
+for free).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer.layers import Layer
+
+__all__ = [
+    "yolo_box", "deform_conv2d", "DeformConv2D", "psroi_pool", "PSRoIPool",
+    "roi_pool", "RoIPool", "roi_align", "RoIAlign", "nms",
+    "ConvNormActivation",
+]
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# yolo_box
+# ---------------------------------------------------------------------------
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, name=None, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5):
+    """Decode YOLOv3 head output into boxes + scores.
+
+    x: [N, C, H, W] with C = S*(5+class_num) (S anchors), or S*(6+class_num)
+    when iou_aware. img_size: [N, 2] (h, w). Returns (boxes [N, S*H*W, 4]
+    xyxy in image scale, scores [N, S*H*W, class_num]); rows whose
+    conf*<=conf_thresh have zero scores, matching the phi kernel.
+    """
+    anchors = np.asarray(anchors, np.float32).reshape(-1, 2)  # (S, [w,h])
+    S = anchors.shape[0]
+
+    def fn(xv, img):
+        N, C, H, W = xv.shape
+        attrs = C // S
+        xv = xv.reshape(N, S, attrs, H, W)
+        if iou_aware:
+            iou_pred = jax.nn.sigmoid(xv[:, :, 0])           # [N,S,H,W]
+            xv = xv[:, :, 1:]
+        grid_x = jnp.arange(W, dtype=jnp.float32)
+        grid_y = jnp.arange(H, dtype=jnp.float32)
+        sx = float(scale_x_y)
+        bias = -0.5 * (sx - 1.0)
+        bx = (jax.nn.sigmoid(xv[:, :, 0]) * sx + bias + grid_x) / W
+        by = (jax.nn.sigmoid(xv[:, :, 1]) * sx + bias
+              + grid_y[:, None]) / H
+        in_w = float(downsample_ratio) * W
+        in_h = float(downsample_ratio) * H
+        pw = anchors[:, 0][None, :, None, None] / in_w
+        ph = anchors[:, 1][None, :, None, None] / in_h
+        bw = jnp.exp(xv[:, :, 2]) * pw
+        bh = jnp.exp(xv[:, :, 3]) * ph
+        conf = jax.nn.sigmoid(xv[:, :, 4])
+        if iou_aware:
+            conf = conf ** (1.0 - iou_aware_factor) * \
+                iou_pred ** iou_aware_factor
+        cls = jax.nn.sigmoid(xv[:, :, 5:])                   # [N,S,cn,H,W]
+
+        imgh = img[:, 0].astype(jnp.float32)[:, None, None, None]
+        imgw = img[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (bx - bw / 2) * imgw
+        y1 = (by - bh / 2) * imgh
+        x2 = (bx + bw / 2) * imgw
+        y2 = (by + bh / 2) * imgh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0.0, imgw - 1.0)
+            y1 = jnp.clip(y1, 0.0, imgh - 1.0)
+            x2 = jnp.clip(x2, 0.0, imgw - 1.0)
+            y2 = jnp.clip(y2, 0.0, imgh - 1.0)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)          # [N,S,H,W,4]
+        # phi kernel: anchors with conf < conf_thresh emit all-zero box
+        # AND score rows (downstream consumers use zero boxes as the drop
+        # marker); conf == thresh is kept
+        keep = conf >= conf_thresh                            # [N,S,H,W]
+        boxes = jnp.where(keep[..., None], boxes, 0.0)
+        sc = conf[:, :, None] * cls                           # [N,S,cn,H,W]
+        sc = jnp.where(keep[:, :, None], sc, 0.0)
+        boxes = boxes.reshape(N, S * H * W, 4)
+        sc = jnp.moveaxis(sc, 2, -1).reshape(N, S * H * W, class_num)
+        return boxes, sc
+
+    out = apply(fn, x if isinstance(x, Tensor) else Tensor(jnp.asarray(x)),
+                img_size if isinstance(img_size, Tensor)
+                else Tensor(jnp.asarray(img_size)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Bilinear sampling helper (roi_align, deform_conv2d)
+# ---------------------------------------------------------------------------
+
+def _bilinear_gather(feat, ys, xs):
+    """Sample feat [C, H, W] at fractional (ys, xs) [...]; zero outside
+    [-1, H] x [-1, W] (phi kernels' boundary convention). Returns
+    [C, ...]."""
+    H, W = feat.shape[-2:]
+    valid = (ys > -1.0) & (ys < H) & (xs > -1.0) & (xs < W)
+    y = jnp.clip(ys, 0.0, H - 1.0)
+    x = jnp.clip(xs, 0.0, W - 1.0)
+    y0 = jnp.floor(y).astype(jnp.int32)
+    x0 = jnp.floor(x).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, H - 1)
+    x1 = jnp.minimum(x0 + 1, W - 1)
+    ly = y - y0
+    lx = x - x0
+    hy = 1.0 - ly
+    hx = 1.0 - lx
+    v00 = feat[:, y0, x0]
+    v01 = feat[:, y0, x1]
+    v10 = feat[:, y1, x0]
+    v11 = feat[:, y1, x1]
+    out = (v00 * (hy * hx) + v01 * (hy * lx)
+           + v10 * (ly * hx) + v11 * (ly * lx))
+    return jnp.where(valid, out, 0.0)
+
+
+def _batch_ids(boxes_num, num_rois):
+    """Expand per-image box counts into a per-roi batch index (host-side:
+    counts define static gather shapes, mirroring the phi rois_num path)."""
+    counts = np.asarray(boxes_num, np.int64)
+    return np.repeat(np.arange(len(counts)), counts).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# roi_align
+# ---------------------------------------------------------------------------
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (Mask R-CNN). boxes [R, 4] xyxy; boxes_num [N] per-image
+    counts. Returns [R, C, ph, pw]. sampling_ratio <= 0 uses the adaptive
+    ceil(bin) count, resolved on host from the (eager) box values —
+    pass a positive sampling_ratio for fully-traced use."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    bv = _val(boxes)
+    bids = _batch_ids(np.asarray(_val(boxes_num)), bv.shape[0])
+
+    def one_roi(feat, box, sh, sw):
+        """Pool one roi from feat [C, H, W] with an sh x sw sample grid
+        per bin (sh/sw static)."""
+        off = 0.5 if aligned else 0.0
+        bx = box * spatial_scale
+        x1, y1 = bx[0] - off, bx[1] - off
+        rw = bx[2] - bx[0]
+        rh = bx[3] - bx[1]
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        ys = y1 + (jnp.arange(ph)[:, None]
+                   + (jnp.arange(sh) + 0.5)[None, :] / sh) * bin_h  # [ph,sh]
+        xs = x1 + (jnp.arange(pw)[:, None]
+                   + (jnp.arange(sw) + 0.5)[None, :] / sw) * bin_w  # [pw,sw]
+        yy = jnp.broadcast_to(ys[:, :, None, None], (ph, sh, pw, sw))
+        xx = jnp.broadcast_to(xs[None, None, :, :], (ph, sh, pw, sw))
+        vals = _bilinear_gather(feat, yy, xx)        # [C, ph, sh, pw, sw]
+        return vals.mean(axis=(2, 4))                # [C, ph, pw]
+
+    if sampling_ratio > 0:
+        s = int(sampling_ratio)
+
+        def fn(xv, bv):
+            feats = xv[jnp.asarray(bids)]            # [R, C, H, W]
+            return jax.vmap(lambda f, b: one_roi(f, b, s, s))(feats, bv)
+
+        return apply(fn, x if isinstance(x, Tensor)
+                     else Tensor(jnp.asarray(x)),
+                     boxes if isinstance(boxes, Tensor)
+                     else Tensor(jnp.asarray(boxes)))
+
+    # adaptive (reference default): per-roi ceil(bin) sample counts are
+    # data-dependent → resolved on host per roi (eager path; pass a
+    # positive sampling_ratio for fully-traced use)
+    b_host = np.asarray(jax.device_get(bv), np.float32)
+    rw = (b_host[:, 2] - b_host[:, 0]) * spatial_scale
+    rh = (b_host[:, 3] - b_host[:, 1]) * spatial_scale
+    if not aligned:
+        rw = np.maximum(rw, 1.0)
+        rh = np.maximum(rh, 1.0)
+    shs = np.maximum(np.ceil(rh / ph), 1).astype(int)
+    sws = np.maximum(np.ceil(rw / pw), 1).astype(int)
+
+    def fn(xv, bv):
+        outs = []
+        for r in range(bv.shape[0]):
+            outs.append(one_roi(xv[int(bids[r])], bv[r],
+                                int(shs[r]), int(sws[r])))
+        return jnp.stack(outs, 0) if outs else \
+            jnp.zeros((0, xv.shape[1], ph, pw), xv.dtype)
+
+    return apply(fn, x if isinstance(x, Tensor) else Tensor(jnp.asarray(x)),
+                 boxes if isinstance(boxes, Tensor)
+                 else Tensor(jnp.asarray(boxes)))
+
+
+# ---------------------------------------------------------------------------
+# roi_pool / psroi_pool — masked-sum einsum formulation
+# ---------------------------------------------------------------------------
+
+def _bin_masks(starts, ends, size):
+    """Membership mask [..., size] of positions i with start <= i < end."""
+    idx = jnp.arange(size, dtype=jnp.float32)
+    return ((idx >= starts[..., None]) & (idx < ends[..., None])) \
+        .astype(jnp.float32)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """RoI max pooling (Fast R-CNN). Quantized-bin max, phi rounding:
+    start = round(coord * scale), bins floored/ceiled; empty bins -> 0."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    bids = _batch_ids(np.asarray(_val(boxes_num)), _val(boxes).shape[0])
+
+    def fn(xv, bv):
+        N, C, H, W = xv.shape
+        r0 = jnp.round(bv * spatial_scale)
+        x1, y1, x2, y2 = r0[:, 0], r0[:, 1], r0[:, 2], r0[:, 3]
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        phi_ = jnp.arange(ph, dtype=jnp.float32)
+        pwi = jnp.arange(pw, dtype=jnp.float32)
+        hs = jnp.clip(jnp.floor(phi_[None] * bin_h[:, None]) + y1[:, None],
+                      0, H)
+        he = jnp.clip(jnp.ceil((phi_[None] + 1) * bin_h[:, None])
+                      + y1[:, None], 0, H)
+        ws = jnp.clip(jnp.floor(pwi[None] * bin_w[:, None]) + x1[:, None],
+                      0, W)
+        we = jnp.clip(jnp.ceil((pwi[None] + 1) * bin_w[:, None])
+                      + x1[:, None], 0, W)
+        mh = _bin_masks(hs, he, H)                            # [R, ph, H]
+        mw = _bin_masks(ws, we, W)                            # [R, pw, W]
+        feats = xv[jnp.asarray(bids)]                         # [R, C, H, W]
+        neg = jnp.finfo(jnp.float32).min
+        # one masked reduction per output bin, reusing the [R,C,H,W]
+        # feature gather — a dense [R,C,ph,pw,H,W] broadcast would be
+        # tens of GB at detection sizes
+        rows = []
+        for i in range(ph):
+            cols = []
+            for j in range(pw):
+                m = mh[:, i, :, None] * mw[:, j, None, :]     # [R, H, W]
+                v = jnp.where(m[:, None] > 0, feats, neg).max((-2, -1))
+                cols.append(v)                                # [R, C]
+            rows.append(jnp.stack(cols, -1))                  # [R, C, pw]
+        out = jnp.stack(rows, -2)                             # [R,C,ph,pw]
+        empty = (mh.sum(-1)[:, :, None] * mw.sum(-1)[:, None, :]) == 0
+        return jnp.where(empty[:, None], 0.0, out)
+
+    return apply(fn, x if isinstance(x, Tensor) else Tensor(jnp.asarray(x)),
+                 boxes if isinstance(boxes, Tensor)
+                 else Tensor(jnp.asarray(boxes)))
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI average pooling (R-FCN). Input channels must
+    equal out_channels * ph * pw; each output bin (c, ph, pw) averages its
+    own input channel over the bin extent (phi rounding: round(coord),
+    end+1, min-size 0.1)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    bids = _batch_ids(np.asarray(_val(boxes_num)), _val(boxes).shape[0])
+
+    def fn(xv, bv):
+        N, C, H, W = xv.shape
+        if C % (ph * pw):
+            raise ValueError(
+                "psroi_pool: input channels must be a multiple of "
+                f"output_size h*w, got {C} vs {ph}x{pw}")
+        c_out = C // (ph * pw)
+        rs = jnp.round(bv)
+        y1 = rs[:, 1] * spatial_scale
+        x1 = rs[:, 0] * spatial_scale
+        y2 = (rs[:, 3] + 1.0) * spatial_scale
+        x2 = (rs[:, 2] + 1.0) * spatial_scale
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        phi_ = jnp.arange(ph, dtype=jnp.float32)
+        pwi = jnp.arange(pw, dtype=jnp.float32)
+        hs = jnp.clip(jnp.floor(phi_[None] * bin_h[:, None] + y1[:, None]),
+                      0, H)
+        he = jnp.clip(jnp.ceil((phi_[None] + 1) * bin_h[:, None]
+                               + y1[:, None]), 0, H)
+        ws = jnp.clip(jnp.floor(pwi[None] * bin_w[:, None] + x1[:, None]),
+                      0, W)
+        we = jnp.clip(jnp.ceil((pwi[None] + 1) * bin_w[:, None]
+                               + x1[:, None]), 0, W)
+        mh = _bin_masks(hs, he, H)                            # [R, ph, H]
+        mw = _bin_masks(ws, we, W)                            # [R, pw, W]
+        feats = xv[jnp.asarray(bids)]                         # [R, C, H, W]
+        feats = feats.reshape(feats.shape[0], c_out, ph, pw, H, W)
+        # masked sum contracted on the MXU: bin membership is a weight mask
+        s = jnp.einsum("rcpqhw,rph,rqw->rcpq", feats, mh, mw)
+        area = mh.sum(-1)[:, :, None] * mw.sum(-1)[:, None, :]  # [R,ph,pw]
+        return jnp.where(area[:, None] > 0, s / jnp.maximum(area[:, None],
+                                                            1.0), 0.0)
+
+    return apply(fn, x if isinstance(x, Tensor) else Tensor(jnp.asarray(x)),
+                 boxes if isinstance(boxes, Tensor)
+                 else Tensor(jnp.asarray(boxes)))
+
+
+# ---------------------------------------------------------------------------
+# deform_conv2d (DCNv1/v2)
+# ---------------------------------------------------------------------------
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution. offset: [N, 2*dg*kh*kw, Hout, Wout] with
+    channel pairs (dy, dx) per kernel tap (phi deformable_conv_functor
+    layout); mask (DCNv2): [N, dg*kh*kw, Hout, Wout] multiplies the
+    bilinear-sampled value. weight: [Cout, Cin/groups, kh, kw]."""
+    stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    padding = (padding, padding) if isinstance(padding, int) \
+        else tuple(padding)
+    dilation = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+
+    def fn(xv, ov, wv, bv, mv):
+        N, Cin, H, W = xv.shape
+        Cout, _, kh, kw = wv.shape
+        Ho, Wo = ov.shape[2], ov.shape[3]
+        dg = deformable_groups
+        K = kh * kw
+        ov = ov.reshape(N, dg, K, 2, Ho, Wo)
+        base_y = jnp.arange(Ho, dtype=jnp.float32) * stride[0] - padding[0]
+        base_x = jnp.arange(Wo, dtype=jnp.float32) * stride[1] - padding[1]
+        tap_y = (jnp.arange(K) // kw).astype(jnp.float32) * dilation[0]
+        tap_x = (jnp.arange(K) % kw).astype(jnp.float32) * dilation[1]
+        # unperturbed sample grid per kernel tap: [K, Ho, Wo]
+        sample_y = tap_y[:, None, None] + base_y[None, :, None] \
+            + jnp.zeros((1, 1, Wo))
+        sample_x = tap_x[:, None, None] + base_x[None, None, :] \
+            + jnp.zeros((1, Ho, 1))
+
+        def per_image(feat, off_i, mask_i):
+            # feat [Cin, H, W]; off_i [dg, K, 2, Ho, Wo]
+            yy = sample_y[None] + off_i[:, :, 0]              # [dg,K,Ho,Wo]
+            xx = sample_x[None] + off_i[:, :, 1]
+            featg = feat.reshape(dg, Cin // dg, H, W)
+            vals = jax.vmap(_bilinear_gather)(featg, yy, xx)  # [dg,cpg,K,..]
+            if mask_i is not None:
+                vals = vals * mask_i[:, None]
+            return vals.reshape(Cin, K, Ho, Wo)
+
+        if mv is not None:
+            mvr = mv.reshape(N, dg, K, Ho, Wo)
+            cols = jax.vmap(per_image)(xv, ov, mvr)
+        else:
+            cols = jax.vmap(lambda f, o: per_image(f, o, None))(xv, ov)
+        # cols: [N, Cin, K, Ho, Wo]; contract with weight on the MXU
+        cpg = Cin // groups
+        opg = Cout // groups
+        colsg = cols.reshape(N, groups, cpg, K, Ho, Wo)
+        wg = wv.reshape(groups, opg, cpg, kh * kw)
+        out = jnp.einsum("ngckhw,gock->ngohw", colsg, wg)
+        out = out.reshape(N, Cout, Ho, Wo)
+        if bv is not None:
+            out = out + bv[None, :, None, None]
+        return out
+
+    args = [x, offset, weight]
+    tensors = [a if isinstance(a, Tensor) else Tensor(jnp.asarray(a))
+               for a in args]
+    b_t = bias if bias is None or isinstance(bias, Tensor) \
+        else Tensor(jnp.asarray(bias))
+    m_t = mask if mask is None or isinstance(mask, Tensor) \
+        else Tensor(jnp.asarray(mask))
+    if b_t is not None and m_t is not None:
+        return apply(fn, *tensors, b_t, m_t)
+    if b_t is not None:
+        return apply(lambda xv, ov, wv, bv: fn(xv, ov, wv, bv, None),
+                     *tensors, b_t)
+    if m_t is not None:
+        return apply(lambda xv, ov, wv, mv: fn(xv, ov, wv, None, mv),
+                     *tensors, m_t)
+    return apply(lambda xv, ov, wv: fn(xv, ov, wv, None, None), *tensors)
+
+
+class DeformConv2D(Layer):
+    """Layer wrapper over deform_conv2d (reference vision/ops.py:1088)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from paddle_tpu.nn import initializer as I
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        self.weight = self.create_parameter(
+            shape=[out_channels, in_channels // groups, *ks],
+            attr=weight_attr, default_initializer=I.XavierUniform())
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                shape=[out_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             self._stride, self._padding, self._dilation,
+                             self._deformable_groups, self._groups, mask)
+
+
+# ---------------------------------------------------------------------------
+# nms
+# ---------------------------------------------------------------------------
+
+def _box_iou_matrix(b):
+    """Pairwise IoU of [R, 4] xyxy boxes (area convention of the phi nms
+    kernel: plain (x2-x1)*(y2-y1))."""
+    area = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    lt = jnp.maximum(b[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(b[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area[:, None] + area[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _nms_keep_mask(boxes_sorted, iou_threshold):
+    """Greedy suppression over pre-sorted boxes; returns bool keep mask.
+    Device-side O(R²) sweep (one fori_loop over rows)."""
+    iou = _box_iou_matrix(boxes_sorted)
+    R = boxes_sorted.shape[0]
+
+    def body(i, keep):
+        sup = (iou[i] > iou_threshold) & (jnp.arange(R) > i) & keep[i]
+        return keep & ~sup
+
+    return lax.fori_loop(0, R, body, jnp.ones((R,), bool))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Reference vision/ops.py:2064. Greedy NMS; with scores, boxes are
+    ranked by score first; with categories, NMS runs per category and
+    results merge score-sorted; top_k truncates. Returns kept indices
+    (int64, host-materialized — output size is data-dependent)."""
+    bv = _val(boxes)
+    if scores is None:
+        keep = np.asarray(jax.device_get(_nms_keep_mask(bv, iou_threshold)))
+        return Tensor(jnp.asarray(np.nonzero(keep)[0].astype(np.int64)))
+
+    sv = _val(scores)
+    if category_idxs is None:
+        order = jnp.argsort(-sv)
+        keep = _nms_keep_mask(bv[order], iou_threshold)
+        keep_np = np.asarray(jax.device_get(keep))
+        order_np = np.asarray(jax.device_get(order))
+        out = order_np[np.nonzero(keep_np)[0]]
+        if top_k is not None:
+            out = out[:top_k]
+        return Tensor(jnp.asarray(out.astype(np.int64)))
+
+    assert categories is not None, \
+        "categories is required when category_idxs is given"
+    cv = np.asarray(jax.device_get(_val(category_idxs)))
+    sv_np = np.asarray(jax.device_get(sv))
+    kept = []
+    for cat in categories:
+        idxs = np.nonzero(cv == cat)[0]
+        if idxs.size == 0:
+            continue
+        if idxs.size == 1:
+            kept.append(idxs)
+            continue
+        order = idxs[np.argsort(-sv_np[idxs], kind="stable")]
+        keep = np.asarray(jax.device_get(
+            _nms_keep_mask(bv[jnp.asarray(order)], iou_threshold)))
+        kept.append(order[keep])
+    if kept:
+        kept = np.concatenate(kept)
+    else:
+        kept = np.zeros((0,), np.int64)
+    kept = kept[np.argsort(-sv_np[kept], kind="stable")]
+    if top_k is not None:
+        kept = kept[:top_k]
+    return Tensor(jnp.asarray(kept.astype(np.int64)))
+
+
+# ---------------------------------------------------------------------------
+# ConvNormActivation
+# ---------------------------------------------------------------------------
+
+_DEFAULT = object()
+
+
+def ConvNormActivation(in_channels, out_channels, kernel_size=3, stride=1,
+                       padding=None, groups=1, norm_layer=_DEFAULT,
+                       activation_layer=_DEFAULT, dilation=1, bias=None):
+    """Conv2D + norm + activation block (reference vision/ops.py:2007).
+    norm_layer/activation_layer default to BatchNorm2D/ReLU; passing None
+    explicitly SKIPS that stage (and a skipped norm enables the conv
+    bias), matching the reference semantics."""
+    from paddle_tpu.nn import BatchNorm2D, Conv2D, ReLU, Sequential
+
+    if padding is None:
+        padding = (kernel_size - 1) // 2 * dilation
+    if norm_layer is _DEFAULT:
+        norm_layer = BatchNorm2D
+    if activation_layer is _DEFAULT:
+        activation_layer = ReLU
+    if bias is None:
+        bias = norm_layer is None
+    layers = [Conv2D(in_channels, out_channels, kernel_size, stride,
+                     padding, dilation=dilation, groups=groups,
+                     bias_attr=None if bias else False)]
+    if norm_layer is not None:
+        layers.append(norm_layer(out_channels))
+    if activation_layer is not None:
+        layers.append(activation_layer())
+    return Sequential(*layers)
+
+
+class PSRoIPool(Layer):
+    """Reference vision/ops.py:1632."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._output_size,
+                          self._spatial_scale)
+
+
+class RoIPool(Layer):
+    """Reference vision/ops.py:1771."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        self._spatial_scale)
+
+
+class RoIAlign(Layer):
+    """Reference vision/ops.py:1959."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale, aligned=aligned)

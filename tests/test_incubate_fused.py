@@ -1,0 +1,179 @@
+"""Fused transformer ops == their unfused compositions (SURVEY §4:
+parity tests against the reference pseudo-code semantics)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.nn.functional as FI
+import paddle_tpu.nn.functional as F
+
+
+def _ln_np(x, g, b, eps=1e-5):
+    m = x.mean(-1, keepdims=True)
+    v = x.var(-1, keepdims=True)
+    return (x - m) / np.sqrt(v + eps) * g + b
+
+
+class TestFusedFeedForward:
+    def _data(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 6, 16)).astype(np.float32)
+        w1 = (rng.standard_normal((16, 32)) * 0.1).astype(np.float32)
+        w2 = (rng.standard_normal((32, 16)) * 0.1).astype(np.float32)
+        b1 = rng.standard_normal(32).astype(np.float32)
+        b2 = rng.standard_normal(16).astype(np.float32)
+        g = rng.standard_normal(16).astype(np.float32)
+        be = rng.standard_normal(16).astype(np.float32)
+        return x, w1, w2, b1, b2, g, be
+
+    @pytest.mark.parametrize("pre_ln", [True, False])
+    @pytest.mark.parametrize("act", ["relu", "gelu"])
+    def test_matches_unfused(self, pre_ln, act):
+        x, w1, w2, b1, b2, g, be = self._data()
+        out = FI.fused_feedforward(
+            paddle.to_tensor(x), paddle.to_tensor(w1), paddle.to_tensor(w2),
+            paddle.to_tensor(b1), paddle.to_tensor(b2),
+            ln1_scale=paddle.to_tensor(g), ln1_bias=paddle.to_tensor(be),
+            ln2_scale=paddle.to_tensor(g), ln2_bias=paddle.to_tensor(be),
+            dropout1_rate=0.0, dropout2_rate=0.0, activation=act,
+            pre_layer_norm=pre_ln).numpy()
+
+        h = _ln_np(x, g, be) if pre_ln else x
+        a = np.maximum(h @ w1 + b1, 0) if act == "relu" else None
+        if act == "gelu":
+            import jax
+            import jax.numpy as jnp
+            a = np.asarray(jax.nn.gelu(jnp.asarray(h @ w1 + b1)))
+        want = x + (a @ w2 + b2)
+        if not pre_ln:
+            want = _ln_np(want, g, be)
+        np.testing.assert_allclose(out, want, rtol=2e-4, atol=2e-4)
+
+    def test_no_residual_and_dropout_scaling(self):
+        x, w1, w2, b1, b2, g, be = self._data()
+        out = FI.fused_feedforward(
+            paddle.to_tensor(x), paddle.to_tensor(w1), paddle.to_tensor(w2),
+            dropout1_rate=0.0, dropout2_rate=0.0, pre_layer_norm=True,
+            add_residual=False,
+            ln1_scale=paddle.to_tensor(g), ln1_bias=paddle.to_tensor(be))
+        want = np.maximum(_ln_np(x, g, be) @ w1, 0) @ w2
+        np.testing.assert_allclose(out.numpy(), want, rtol=2e-4, atol=2e-4)
+
+    def test_grads_flow(self):
+        x, w1, w2, b1, b2, g, be = self._data()
+        xt = paddle.to_tensor(x)
+        xt.stop_gradient = False
+        w1t = paddle.to_tensor(w1)
+        w1t.stop_gradient = False
+        out = FI.fused_feedforward(xt, w1t, paddle.to_tensor(w2),
+                                   dropout1_rate=0.0, dropout2_rate=0.0,
+                                   pre_layer_norm=True,
+                                   ln1_scale=paddle.to_tensor(g),
+                                   ln1_bias=paddle.to_tensor(be))
+        out.sum().backward()
+        assert xt.grad is not None and float(
+            np.abs(xt.grad.numpy()).sum()) > 0
+        assert w1t.grad is not None and float(
+            np.abs(w1t.grad.numpy()).sum()) > 0
+
+
+class TestFusedMHA:
+    def _data(self, b=2, s=5, e=16, n=4):
+        rng = np.random.default_rng(1)
+        hd = e // n
+        x = rng.standard_normal((b, s, e)).astype(np.float32)
+        qkvw = (rng.standard_normal((3, n, hd, e)) * 0.1).astype(np.float32)
+        qkvb = rng.standard_normal((3, n, hd)).astype(np.float32)
+        lw = (rng.standard_normal((e, e)) * 0.1).astype(np.float32)
+        lb = rng.standard_normal(e).astype(np.float32)
+        g = np.ones(e, np.float32)
+        be = np.zeros(e, np.float32)
+        return x, qkvw, qkvb, lw, lb, g, be, n, hd
+
+    def _oracle(self, x, qkvw, qkvb, lw, lb, g, be, n, hd, pre_ln,
+                mask=None):
+        b, s, e = x.shape
+        h = _ln_np(x, g, be) if pre_ln else x
+        w = qkvw.reshape(3 * n * hd, e)
+        qkv = (h @ w.T + qkvb.reshape(-1)).reshape(b, s, 3, n, hd)
+        qkv = np.moveaxis(qkv, 2, 0)
+        q, k, v = (np.swapaxes(t, 1, 2) for t in qkv)    # [b,n,s,d]
+        sc = (q * hd ** -0.5) @ np.swapaxes(k, -1, -2)
+        if mask is not None:
+            sc = sc + mask
+        p = np.exp(sc - sc.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        ctx = np.swapaxes(p @ v, 1, 2).reshape(b, s, e)
+        out = x + (ctx @ lw + lb)
+        if not pre_ln:
+            out = _ln_np(out, g, be)
+        return out
+
+    @pytest.mark.parametrize("pre_ln", [True, False])
+    def test_matches_unfused(self, pre_ln):
+        x, qkvw, qkvb, lw, lb, g, be, n, hd = self._data()
+        out = FI.fused_multi_head_attention(
+            paddle.to_tensor(x), paddle.to_tensor(qkvw),
+            paddle.to_tensor(lw), pre_layer_norm=pre_ln,
+            pre_ln_scale=paddle.to_tensor(g),
+            pre_ln_bias=paddle.to_tensor(be),
+            ln_scale=paddle.to_tensor(g), ln_bias=paddle.to_tensor(be),
+            qkv_bias=paddle.to_tensor(qkvb),
+            linear_bias=paddle.to_tensor(lb),
+            dropout_rate=0.0, attn_dropout_rate=0.0).numpy()
+        want = self._oracle(x, qkvw, qkvb, lw, lb, g, be, n, hd, pre_ln)
+        np.testing.assert_allclose(out, want, rtol=2e-3, atol=2e-3)
+
+    def test_bool_mask(self):
+        x, qkvw, qkvb, lw, lb, g, be, n, hd = self._data()
+        b, s, e = x.shape
+        bool_mask = np.tril(np.ones((s, s), bool))[None, None]
+        out = FI.fused_multi_head_attention(
+            paddle.to_tensor(x), paddle.to_tensor(qkvw),
+            paddle.to_tensor(lw),
+            ln_scale=paddle.to_tensor(g), ln_bias=paddle.to_tensor(be),
+            qkv_bias=paddle.to_tensor(qkvb),
+            linear_bias=paddle.to_tensor(lb),
+            attn_mask=paddle.to_tensor(bool_mask),
+            dropout_rate=0.0, attn_dropout_rate=0.0).numpy()
+        fmask = np.where(bool_mask, 0.0,
+                         np.finfo(np.float32).min).astype(np.float32)
+        want = self._oracle(x, qkvw, qkvb, lw, lb, g, be, n, hd, False,
+                            mask=fmask)
+        np.testing.assert_allclose(out, want, rtol=2e-3, atol=2e-3)
+
+    def test_cache_kv(self):
+        x, qkvw, qkvb, lw, lb, g, be, n, hd = self._data(s=1)
+        b = x.shape[0]
+        cache = np.random.default_rng(2).standard_normal(
+            (2, b, n, 3, hd)).astype(np.float32)
+        out, new_cache = FI.fused_multi_head_attention(
+            paddle.to_tensor(x), paddle.to_tensor(qkvw),
+            paddle.to_tensor(lw),
+            ln_scale=paddle.to_tensor(g), ln_bias=paddle.to_tensor(be),
+            qkv_bias=paddle.to_tensor(qkvb),
+            linear_bias=paddle.to_tensor(lb),
+            cache_kv=paddle.to_tensor(cache),
+            dropout_rate=0.0, attn_dropout_rate=0.0)
+        assert list(new_cache.shape) == [2, b, n, 4, hd]
+        np.testing.assert_allclose(new_cache.numpy()[:, :, :, :3], cache,
+                                   rtol=1e-5, atol=1e-6)
+        assert out.shape == [b, 1, x.shape[2]]
+
+
+class TestFusedLayers:
+    def test_encoder_layer_runs_and_trains(self):
+        from paddle_tpu.incubate.nn import FusedTransformerEncoderLayer
+        paddle.seed(0)
+        layer = FusedTransformerEncoderLayer(32, 4, 64, dropout_rate=0.0)
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=layer.parameters())
+        x = paddle.to_tensor(np.random.default_rng(3).standard_normal(
+            (2, 6, 32)).astype(np.float32))
+        y = layer(x)
+        assert y.shape == [2, 6, 32]
+        loss = (y ** 2).mean()
+        loss.backward()
+        opt.step()
+        assert all(p.grad is not None for p in layer.parameters()
+                   if not p.stop_gradient)

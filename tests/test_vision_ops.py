@@ -1,0 +1,314 @@
+"""Detection/geometry vision ops vs scalar numpy oracles (SURVEY §4 style:
+oracles re-implement the reference phi CPU kernel algorithms)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+# ---------------------------------------------------------------- oracles
+def _roi_align_oracle(x, boxes, bids, out, scale, ratio, aligned):
+    N, C, H, W = x.shape
+    ph, pw = out
+    R = boxes.shape[0]
+    res = np.zeros((R, C, ph, pw), np.float32)
+
+    def bil(feat, y, xx):
+        if y < -1 or y > H or xx < -1 or xx > W:
+            return np.zeros(C, np.float32)
+        y = min(max(y, 0), H - 1)
+        xx = min(max(xx, 0), W - 1)
+        y0, x0 = int(np.floor(y)), int(np.floor(xx))
+        y1, x1 = min(y0 + 1, H - 1), min(x0 + 1, W - 1)
+        ly, lx = y - y0, xx - x0
+        return (feat[:, y0, x0] * (1 - ly) * (1 - lx)
+                + feat[:, y0, x1] * (1 - ly) * lx
+                + feat[:, y1, x0] * ly * (1 - lx)
+                + feat[:, y1, x1] * ly * lx)
+
+    for r in range(R):
+        off = 0.5 if aligned else 0.0
+        x1, y1, x2, y2 = boxes[r] * scale
+        x1, y1 = x1 - off, y1 - off
+        rw, rh = x2 - boxes[r][0] * scale, y2 - boxes[r][1] * scale
+        if not aligned:
+            rw, rh = max(rw, 1.0), max(rh, 1.0)
+        bh, bw = rh / ph, rw / pw
+        sh = ratio if ratio > 0 else max(1, int(np.ceil(rh / ph)))
+        sw = ratio if ratio > 0 else max(1, int(np.ceil(rw / pw)))
+        feat = x[bids[r]]
+        for i in range(ph):
+            for j in range(pw):
+                acc = np.zeros(C, np.float32)
+                for si in range(sh):
+                    for sj in range(sw):
+                        yy = y1 + (i + (si + 0.5) / sh) * bh
+                        xx = x1 + (j + (sj + 0.5) / sw) * bw
+                        acc += bil(feat, yy, xx)
+                res[r, :, i, j] = acc / (sh * sw)
+    return res
+
+
+def _psroi_oracle(x, boxes, bids, out, scale):
+    N, C, H, W = x.shape
+    ph, pw = out
+    c_out = C // (ph * pw)
+    R = boxes.shape[0]
+    res = np.zeros((R, c_out, ph, pw), np.float32)
+    for r in range(R):
+        x1 = round(boxes[r][0]) * scale
+        y1 = round(boxes[r][1]) * scale
+        x2 = (round(boxes[r][2]) + 1.0) * scale
+        y2 = (round(boxes[r][3]) + 1.0) * scale
+        rh, rw = max(y2 - y1, 0.1), max(x2 - x1, 0.1)
+        bh, bw = rh / ph, rw / pw
+        for c in range(c_out):
+            for i in range(ph):
+                for j in range(pw):
+                    hs = min(max(int(np.floor(i * bh + y1)), 0), H)
+                    he = min(max(int(np.ceil((i + 1) * bh + y1)), 0), H)
+                    ws = min(max(int(np.floor(j * bw + x1)), 0), W)
+                    we = min(max(int(np.ceil((j + 1) * bw + x1)), 0), W)
+                    ch = (c * ph + i) * pw + j
+                    if he <= hs or we <= ws:
+                        continue
+                    patch = x[bids[r], ch, hs:he, ws:we]
+                    res[r, c, i, j] = patch.sum() / patch.size
+    return res
+
+
+def _roi_pool_oracle(x, boxes, bids, out, scale):
+    N, C, H, W = x.shape
+    ph, pw = out
+    R = boxes.shape[0]
+    res = np.zeros((R, C, ph, pw), np.float32)
+    for r in range(R):
+        x1 = round(boxes[r][0] * scale)
+        y1 = round(boxes[r][1] * scale)
+        x2 = round(boxes[r][2] * scale)
+        y2 = round(boxes[r][3] * scale)
+        rh, rw = max(y2 - y1 + 1, 1), max(x2 - x1 + 1, 1)
+        bh, bw = rh / ph, rw / pw
+        for i in range(ph):
+            for j in range(pw):
+                hs = min(max(int(np.floor(i * bh)) + y1, 0), H)
+                he = min(max(int(np.ceil((i + 1) * bh)) + y1, 0), H)
+                ws = min(max(int(np.floor(j * bw)) + x1, 0), W)
+                we = min(max(int(np.ceil((j + 1) * bw)) + x1, 0), W)
+                if he <= hs or we <= ws:
+                    continue
+                res[r, :, i, j] = x[bids[r], :, hs:he, ws:we].max((1, 2))
+    return res
+
+
+# ------------------------------------------------------------------ tests
+class TestRoiOps:
+    def _data(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 8, 16, 16)).astype(np.float32)
+        boxes = np.array([[1.2, 2.0, 9.7, 11.5],
+                          [0.0, 0.0, 15.0, 15.0],
+                          [4.1, 4.9, 8.0, 14.2]], np.float32)
+        boxes_num = np.array([2, 1], np.int32)
+        bids = np.array([0, 0, 1])
+        return x, boxes, boxes_num, bids
+
+    @pytest.mark.parametrize("ratio,aligned", [(2, True), (2, False),
+                                               (-1, True)])
+    def test_roi_align(self, ratio, aligned):
+        x, boxes, boxes_num, bids = self._data()
+        got = V.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                          paddle.to_tensor(boxes_num), (4, 4),
+                          spatial_scale=0.5, sampling_ratio=ratio,
+                          aligned=aligned).numpy()
+        want = _roi_align_oracle(x, boxes, bids, (4, 4), 0.5, ratio, aligned)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_roi_align_grad_flows(self):
+        x, boxes, boxes_num, _ = self._data()
+
+        def f(xv):
+            return jnp.sum(V.roi_align(
+                paddle.Tensor(xv), paddle.to_tensor(boxes),
+                paddle.to_tensor(boxes_num), (4, 4), 0.5,
+                sampling_ratio=2)._value)
+
+        g = jax.grad(f)(jnp.asarray(x))
+        assert g.shape == x.shape
+        assert float(jnp.abs(g).sum()) > 0
+
+    def test_psroi_pool(self):
+        rng = np.random.default_rng(1)
+        ph = pw = 3
+        c_out = 2
+        x = rng.standard_normal((2, c_out * ph * pw, 12, 12)) \
+            .astype(np.float32)
+        boxes = np.array([[1.0, 2.0, 8.0, 9.0], [3.0, 1.0, 10.0, 10.0]],
+                         np.float32)
+        boxes_num = np.array([1, 1], np.int32)
+        got = V.psroi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                           paddle.to_tensor(boxes_num), (ph, pw),
+                           spatial_scale=0.5).numpy()
+        want = _psroi_oracle(x, boxes, np.array([0, 1]), (ph, pw), 0.5)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_roi_pool(self):
+        x, boxes, boxes_num, bids = self._data()
+        got = V.roi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                         paddle.to_tensor(boxes_num), (4, 4),
+                         spatial_scale=0.5).numpy()
+        want = _roi_pool_oracle(x, boxes, bids, (4, 4), 0.5)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_layers(self):
+        x, boxes, boxes_num, _ = self._data()
+        t = (paddle.to_tensor(x), paddle.to_tensor(boxes),
+             paddle.to_tensor(boxes_num))
+        assert V.RoIAlign((2, 2), 0.5)(*t).shape == [3, 8, 2, 2]
+        assert V.RoIPool((2, 2), 0.5)(*t).shape == [3, 8, 2, 2]
+        xps = paddle.to_tensor(
+            np.random.default_rng(2).standard_normal((2, 2 * 4, 8, 8))
+            .astype(np.float32))
+        assert V.PSRoIPool(2, 1.0)(xps, t[1], t[2]).shape == [3, 2, 2, 2]
+
+
+class TestYoloBox:
+    def test_decode_matches_formula(self):
+        rng = np.random.default_rng(3)
+        N, S, cn, H, W = 2, 3, 5, 4, 4
+        anchors = [10, 13, 16, 30, 33, 23]
+        x = rng.standard_normal((N, S * (5 + cn), H, W)).astype(np.float32)
+        img = np.array([[320, 480], [288, 288]], np.int32)
+        ds = 32
+        boxes, scores = V.yolo_box(paddle.to_tensor(x),
+                                   paddle.to_tensor(img), anchors, cn,
+                                   0.01, ds, clip_bbox=True)
+        boxes, scores = boxes.numpy(), scores.numpy()
+        assert boxes.shape == (N, S * H * W, 4)
+        assert scores.shape == (N, S * H * W, cn)
+
+        def sig(v):
+            return 1 / (1 + np.exp(-v))
+
+        # check one (n, anchor, h, w) cell by hand
+        n, a, i, j = 1, 2, 1, 3
+        cell = x[n].reshape(S, 5 + cn, H, W)[a, :, i, j]
+        bx = (sig(cell[0]) + j) / W
+        by = (sig(cell[1]) + i) / H
+        bw = anchors[2 * a] * np.exp(cell[2]) / (ds * W)
+        bh = anchors[2 * a + 1] * np.exp(cell[3]) / (ds * H)
+        imgh, imgw = img[n]
+        want = np.array([
+            np.clip((bx - bw / 2) * imgw, 0, imgw - 1),
+            np.clip((by - bh / 2) * imgh, 0, imgh - 1),
+            np.clip((bx + bw / 2) * imgw, 0, imgw - 1),
+            np.clip((by + bh / 2) * imgh, 0, imgh - 1)])
+        idx = a * H * W + i * W + j
+        np.testing.assert_allclose(boxes[n, idx], want, rtol=1e-4,
+                                   atol=1e-4)
+        conf = sig(cell[4])
+        np.testing.assert_allclose(scores[n, idx],
+                                   conf * sig(cell[5:]), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_conf_thresh_zeroes(self):
+        x = np.full((1, 1 * 6, 2, 2), -10.0, np.float32)  # conf ~ 0
+        boxes, scores = V.yolo_box(paddle.to_tensor(x),
+                                   paddle.to_tensor(
+                                       np.array([[64, 64]], np.int32)),
+                                   [10, 10], 1, 0.5, 32)
+        # phi kernel zeroes BOTH the box row and the scores of dropped rows
+        assert float(scores.numpy().sum()) == 0.0
+        assert float(np.abs(boxes.numpy()).sum()) == 0.0
+
+
+class TestDeformConv:
+    def test_zero_offset_equals_conv(self):
+        """With zero offsets and unit mask, deform_conv2d == conv2d."""
+        import paddle_tpu.nn.functional as F
+        rng = np.random.default_rng(4)
+        x = rng.standard_normal((2, 4, 9, 9)).astype(np.float32)
+        w = rng.standard_normal((6, 4, 3, 3)).astype(np.float32)
+        off = np.zeros((2, 2 * 9, 7, 7), np.float32)
+        got = V.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                              paddle.to_tensor(w)).numpy()
+        want = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_integer_offset_shifts_sampling(self):
+        """Integer offsets sample exactly the shifted positions (1x1
+        kernel makes the expectation directly checkable)."""
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((1, 2, 6, 6)).astype(np.float32)
+        w = np.ones((2, 2, 1, 1), np.float32)
+        off = np.zeros((1, 2, 6, 6), np.float32)
+        off[:, 0] = 1.0   # dy = 1
+        got = V.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                              paddle.to_tensor(w)).numpy()
+        shifted = np.zeros_like(x)
+        shifted[:, :, :5, :] = x[:, :, 1:, :]   # sample (y+1, x)
+        want = shifted.sum(1, keepdims=True).repeat(2, 1)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_mask_and_layer(self):
+        rng = np.random.default_rng(6)
+        x = paddle.to_tensor(
+            rng.standard_normal((1, 4, 8, 8)).astype(np.float32))
+        layer = V.DeformConv2D(4, 6, 3, padding=1, deformable_groups=2)
+        off = paddle.to_tensor(
+            rng.standard_normal((1, 2 * 2 * 9, 8, 8)).astype(np.float32)
+            * 0.1)
+        mask = paddle.to_tensor(
+            np.full((1, 2 * 9, 8, 8), 0.5, np.float32))
+        y_half = layer(x, off, mask).numpy()
+        y_full = layer(x, off, paddle.to_tensor(
+            np.ones((1, 2 * 9, 8, 8), np.float32))).numpy()
+        b = layer.bias.numpy()[None, :, None, None]
+        np.testing.assert_allclose(y_half - b, (y_full - b) * 0.5,
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestNMS:
+    def test_basic_greedy(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30],
+                          [0, 0, 5, 5]], np.float32)
+        # box1 overlaps box0 (IoU ~0.68) -> suppressed; box3 IoU 0.25 -> kept
+        keep = V.nms(paddle.to_tensor(boxes), iou_threshold=0.5).numpy()
+        np.testing.assert_array_equal(keep, [0, 2, 3])
+
+    def test_scores_reorder(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11],
+                          [20, 20, 30, 30]], np.float32)
+        scores = np.array([0.5, 0.9, 0.7], np.float32)
+        keep = V.nms(paddle.to_tensor(boxes), 0.5,
+                     paddle.to_tensor(scores)).numpy()
+        np.testing.assert_array_equal(keep, [1, 2])
+
+    def test_categories_and_topk(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11],
+                          [0, 0, 10, 10], [20, 20, 30, 30]], np.float32)
+        scores = np.array([0.9, 0.8, 0.95, 0.3], np.float32)
+        cats = np.array([0, 0, 1, 1], np.int64)
+        keep = V.nms(paddle.to_tensor(boxes), 0.5,
+                     paddle.to_tensor(scores), paddle.to_tensor(cats),
+                     categories=[0, 1], top_k=3).numpy()
+        # cat0 keeps box0 (0.9 beats 0.8-overlap), cat1 keeps 2 and 3;
+        # merged score-sorted: [2 (0.95), 0 (0.9), 3 (0.3)]
+        np.testing.assert_array_equal(keep, [2, 0, 3])
+
+
+def test_conv_norm_activation_block():
+    block = V.ConvNormActivation(3, 8, 3)
+    x = paddle.to_tensor(
+        np.random.default_rng(7).standard_normal((2, 3, 8, 8))
+        .astype(np.float32))
+    assert block(x).shape == [2, 8, 8, 8]
+    # reference semantics: norm_layer=None skips the norm and enables bias
+    no_norm = V.ConvNormActivation(3, 8, 3, norm_layer=None)
+    names = [type(m).__name__ for m in no_norm]
+    assert "BatchNorm2D" not in names
+    assert no_norm[0].bias is not None
