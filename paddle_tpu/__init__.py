@@ -57,11 +57,13 @@ from paddle_tpu.framework.io import load, save  # noqa: F401,E402
 
 from paddle_tpu import (  # noqa: F401,E402
     amp,
+    audio,
     autograd,
     distributed,
     distribution,
     fft,
     framework,
+    geometric,
     incubate,
     inference,
     io,
@@ -75,6 +77,7 @@ from paddle_tpu import (  # noqa: F401,E402
     static,
     sparse,
     tensor,
+    text,
     utils,
     vision,
 )

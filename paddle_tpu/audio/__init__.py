@@ -1,0 +1,2 @@
+"""paddle.audio parity namespace (reference: python/paddle/audio)."""
+from paddle_tpu.audio import features, functional  # noqa: F401

@@ -1,0 +1,94 @@
+"""Audio feature extraction layers.
+
+Reference parity: python/paddle/audio/features/layers.py — Spectrogram
+(:28), MelSpectrogram (:110), LogMelSpectrogram (:210), MFCC (:313).
+
+TPU-native: the STFT runs through paddle_tpu.signal.stft (framed matmul
+against the DFT basis — MXU-friendly, statically shaped); the mel
+projection is a single [n_mels, F] matmul; everything jits.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.audio import functional as AF
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.nn.layer.layers import Layer
+from paddle_tpu import signal
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=512, win_length=None,
+                 window="hann", power=1.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        assert power > 0, "Power of spectrogram must be > 0."
+        self.power = power
+        self.n_fft = n_fft
+        self.hop_length = hop_length
+        self.center = center
+        self.pad_mode = pad_mode
+        win_length = win_length or n_fft
+        self.fft_window = AF.get_window(window, win_length, fftbins=True,
+                                        dtype=dtype)
+
+    def forward(self, x):
+        st = signal.stft(x, self.n_fft, self.hop_length,
+                         self.fft_window.shape[0], window=self.fft_window,
+                         center=self.center, pad_mode=self.pad_mode)
+        return apply(lambda v: jnp.abs(v) ** self.power, st)
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=2048, hop_length=512,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        dtype)
+        if f_max is None:
+            f_max = sr // 2
+        self.fbank_matrix = AF.compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm, dtype)
+
+    def forward(self, x):
+        spect = self._spectrogram(x)                 # [..., F, T]
+        return apply(lambda f, s: jnp.matmul(f, s),
+                     self.fbank_matrix, spect)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=2048, hop_length=512,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._melspectrogram(x)
+        return AF.power_to_db(mel, self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, norm="ortho", **melkwargs):
+        super().__init__()
+        self._log_melspectrogram = LogMelSpectrogram(sr=sr, **melkwargs)
+        n_mels = self._log_melspectrogram._melspectrogram \
+            .fbank_matrix.shape[0]
+        self.dct_matrix = AF.create_dct(n_mfcc, n_mels, norm)
+
+    def forward(self, x):
+        logmel = self._log_melspectrogram(x)         # [..., n_mels, T]
+        return apply(lambda d, m: jnp.einsum("mk,...mt->...kt", d, m),
+                     self.dct_matrix, logmel)

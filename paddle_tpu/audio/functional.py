@@ -1,0 +1,197 @@
+"""Audio functional ops.
+
+Reference parity: python/paddle/audio/functional/functional.py
+(hz_to_mel :23, mel_to_hz :79, mel_frequencies :124, fft_frequencies
+:164, compute_fbank_matrix :187, power_to_db :260, create_dct :304) and
+functional/window.py (get_window :330).
+
+TPU-native: all of these are small constant-factory / elementwise
+computations — plain jnp, returned as Tensors so they drop into jitted
+feature pipelines.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct",
+           "get_window"]
+
+
+def _jnp(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def hz_to_mel(freq, htk=False):
+    """Hz -> mel. htk=True uses the HTK formula; default is Slaney
+    (linear below 1 kHz, log above)."""
+    scalar = not isinstance(freq, Tensor)
+    f = jnp.asarray(_jnp(freq), jnp.float32)
+    if htk:
+        mel = 2595.0 * jnp.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = jnp.where(f >= min_log_hz,
+                        min_log_mel + jnp.log(f / min_log_hz) / logstep,
+                        mel)
+    return float(mel) if scalar and mel.ndim == 0 else Tensor(mel)
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = not isinstance(mel, Tensor)
+    m = jnp.asarray(_jnp(mel), jnp.float32)
+    if htk:
+        f = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        f = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        f = jnp.where(m >= min_log_mel,
+                      min_log_hz * jnp.exp(logstep * (m - min_log_mel)), f)
+    return float(f) if scalar and f.ndim == 0 else Tensor(f)
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    lo = _jnp(hz_to_mel(Tensor(jnp.asarray(f_min)), htk))
+    hi = _jnp(hz_to_mel(Tensor(jnp.asarray(f_max)), htk))
+    mels = jnp.linspace(lo, hi, n_mels)
+    return Tensor(_jnp(mel_to_hz(Tensor(mels), htk)).astype(dtype))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    return Tensor(jnp.linspace(0, float(sr) / 2,
+                               1 + n_fft // 2).astype(dtype))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """Triangular mel filter bank [n_mels, 1 + n_fft//2]."""
+    if f_max is None:
+        f_max = float(sr) / 2
+    fftfreqs = fft_frequencies(sr, n_fft)._value
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)._value
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]         # [n_mels+2, F]
+    lower = -ramps[:-2] / fdiff[:-1][:, None]
+    upper = ramps[2:] / fdiff[1:][:, None]
+    weights = jnp.maximum(0.0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    elif isinstance(norm, (int, float)):
+        w_norm = jnp.sum(jnp.abs(weights) ** norm, axis=1) ** (1.0 / norm)
+        weights = weights / jnp.maximum(w_norm[:, None], 1e-10)
+    return Tensor(weights.astype(dtype))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """Power spectrogram -> dB, clipped top_db below the peak."""
+    s = jnp.asarray(_jnp(spect))
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+    log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(amin, ref_value))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+    return Tensor(log_spec)
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """DCT-II matrix [n_mels, n_mfcc] (matches the reference orientation:
+    mel features @ dct)."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k[None, :])
+    if norm == "ortho":
+        dct = dct * jnp.where(k == 0, 1.0 / math.sqrt(n_mels),
+                              math.sqrt(2.0 / n_mels))[None, :]
+    else:
+        dct = dct * 2.0
+    return Tensor(dct.astype(dtype))
+
+
+def _extend(M, sym):
+    return (M + 1, True) if not sym else (M, False)
+
+
+def _truncate(w, trunc):
+    return w[:-1] if trunc else w
+
+
+def get_window(window, win_length, fftbins=True, dtype="float64"):
+    """Window factory: hann/hamming/blackman/cosine/triang/bohman/
+    gaussian/exponential/taylor/tukey/kaiser (scipy-compatible
+    formulas)."""
+    if isinstance(window, tuple):
+        name, *args = window
+    else:
+        name, args = window, []
+    sym = not fftbins
+    M, trunc = _extend(win_length, sym)
+    n = np.arange(M, dtype=np.float64)
+
+    if name == "hann":
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * n / (M - 1))
+    elif name == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * n / (M - 1))
+    elif name == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * n / (M - 1))
+             + 0.08 * np.cos(4 * np.pi * n / (M - 1)))
+    elif name == "cosine":
+        w = np.sin(np.pi / M * (n + 0.5))
+    elif name == "triang":
+        k = np.arange(1, (M + 1) // 2 + 1)
+        if M % 2 == 0:
+            half = (2 * k - 1.0) / M
+            w = np.concatenate([half, half[::-1]])
+        else:
+            half = 2 * k / (M + 1.0)
+            w = np.concatenate([half, half[-2::-1]])
+    elif name == "bohman":
+        fac = np.abs(np.linspace(-1, 1, M))
+        w = (1 - fac) * np.cos(np.pi * fac) + np.sin(np.pi * fac) / np.pi
+        w[0] = w[-1] = 0.0
+    elif name == "gaussian":
+        std = args[0] if args else 7.0
+        nn = n - (M - 1) / 2
+        w = np.exp(-0.5 * (nn / std) ** 2)
+    elif name == "exponential":
+        center = args[0] if args else None
+        tau = args[1] if len(args) > 1 else 1.0
+        if center is None:
+            center = (M - 1) / 2
+        w = np.exp(-np.abs(n - center) / tau)
+    elif name == "tukey":
+        alpha = args[0] if args else 0.5
+        if alpha <= 0:
+            w = np.ones(M)
+        elif alpha >= 1:
+            w = 0.5 - 0.5 * np.cos(2 * np.pi * n / (M - 1))
+        else:
+            width = int(np.floor(alpha * (M - 1) / 2.0))
+            n1 = n[0:width + 1]
+            n2 = n[width + 1:M - width - 1]
+            n3 = n[M - width - 1:]
+            w1 = 0.5 * (1 + np.cos(np.pi * (-1 + 2.0 * n1 /
+                                            alpha / (M - 1))))
+            w2 = np.ones(n2.shape[0])
+            w3 = 0.5 * (1 + np.cos(np.pi * (-2.0 / alpha + 1 + 2.0 * n3 /
+                                            alpha / (M - 1))))
+            w = np.concatenate([w1, w2, w3])
+    elif name == "kaiser":
+        beta = args[0] if args else 14.0
+        w = np.i0(beta * np.sqrt(1 - ((n - (M - 1) / 2)
+                                      / ((M - 1) / 2)) ** 2)) / np.i0(beta)
+    else:
+        raise ValueError(f"unsupported window: {window!r}")
+    return Tensor(jnp.asarray(_truncate(w, trunc)).astype(dtype))

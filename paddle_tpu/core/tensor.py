@@ -71,6 +71,11 @@ class Tensor:
         if not isinstance(value, jax.Array) and not isinstance(value, jax.core.Tracer):
             value = jnp.asarray(value)
         self._value = value
+        self._init_meta(stop_gradient, name)
+
+    def _init_meta(self, stop_gradient, name=None):
+        """Non-storage field init, shared with subclasses that manage
+        their own storage (SparseCooTensor's lazy dense mirror)."""
         self.stop_gradient = bool(stop_gradient)
         self.grad = None
         Tensor._tensor_id[0] += 1

@@ -1,0 +1,190 @@
+"""Beyond-HBM sparse embedding: a host-RAM parameter-server table.
+
+Reference parity: python/paddle/distributed/ps/the_one_ps.py +
+paddle.static.nn.sparse_embedding — the reference stores trillion-param
+embedding tables on parameter servers; workers PULL the rows a batch
+touches and PUSH sparse gradients back, with the optimizer applied
+server-side.
+
+TPU-native design: the "server" is host DRAM (orders of magnitude larger
+than HBM). The table lives in a numpy array that never touches the
+device; each training step pulls only the [batch, fields, dim] rows it
+needs through `jax.pure_callback` (so the lookup works inside jit /
+to_static programs) and pushes gradients back through an ordered
+`io_callback` in the custom VJP, where a host-side optimizer (SGD /
+Adagrad, the standard PS choice) folds duplicate ids with scatter-add.
+HBM holds only the minibatch slice — table capacity is bounded by host
+RAM, not by aggregate HBM, exactly like the reference's PS mode.
+
+Multi-host: shard rows by `row_shard` (this host owns global rows
+[offset, offset + local_rows)); out-of-shard ids pull zeros and drop
+pushes, so each host's table plus an all-reduce of the dense tower is
+the full PS picture. Single-host (the common test config) owns all rows.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["SparseTable", "ps_embedding", "PSEmbedding"]
+
+
+class SparseTable:
+    """Host-RAM embedding table with sparse pull/push.
+
+    optimizer: "sgd" or "adagrad" (server-side rule, applied at push).
+    """
+
+    def __init__(self, num_rows, dim, init_std=0.01, optimizer="adagrad",
+                 learning_rate=0.05, epsilon=1e-8, seed=0,
+                 dtype=np.float32, row_shard=None):
+        rng = np.random.default_rng(seed)
+        self.num_rows = int(num_rows)
+        self.dim = int(dim)
+        self.dtype = np.dtype(dtype)
+        if row_shard is None:
+            self.row_offset, self.local_rows = 0, self.num_rows
+        else:
+            self.row_offset, self.local_rows = map(int, row_shard)
+        self._data = (rng.standard_normal(
+            (self.local_rows, self.dim)) * init_std).astype(self.dtype)
+        self._opt = optimizer
+        self._lr = float(learning_rate)
+        self._eps = float(epsilon)
+        if optimizer == "adagrad":
+            self._acc = np.zeros((self.local_rows, self.dim), np.float32)
+        elif optimizer != "sgd":
+            raise ValueError(f"unsupported PS optimizer: {optimizer!r}")
+        self._lock = threading.Lock()
+        self._prefetched = {}
+        self.pull_count = 0
+        self.push_count = 0
+        self._anchor = None
+
+    @property
+    def anchor(self):
+        """Zero scalar with stop_gradient=False: the autograd hook that
+        routes output cotangents into push() (see ps_embedding)."""
+        if self._anchor is None:
+            self._anchor = Tensor(jnp.zeros((), jnp.float32),
+                                  stop_gradient=False)
+        return self._anchor
+
+    # ------------------------------------------------------------- host side
+    def _local(self, ids):
+        loc = ids.astype(np.int64).reshape(-1) - self.row_offset
+        ok = (loc >= 0) & (loc < self.local_rows)
+        return loc, ok
+
+    def pull(self, ids):
+        """ids: int array (any shape) of GLOBAL row ids ->
+        [*ids.shape, dim] rows (zeros for out-of-shard ids)."""
+        ids = np.asarray(ids)
+        key = ids.tobytes()
+        with self._lock:
+            pre = self._prefetched.pop(key, None)
+        if pre is not None:
+            return pre
+        return self._pull_impl(ids)
+
+    def _pull_impl(self, ids):
+        loc, ok = self._local(ids)
+        rows = self._data[np.clip(loc, 0, self.local_rows - 1)]
+        rows[~ok] = 0
+        self.pull_count += 1
+        return rows.reshape(ids.shape + (self.dim,))
+
+    def prefetch(self, ids):
+        """Start an async host-side gather for a future pull of exactly
+        these ids (overlaps the table read with device compute)."""
+        ids = np.asarray(ids)
+        key = ids.tobytes()
+
+        def work():
+            rows = self._pull_impl(ids)
+            with self._lock:
+                self._prefetched[key] = rows
+
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        return t
+
+    def push(self, ids, grads):
+        """Apply the server-side optimizer to grads for `ids` (duplicates
+        within the batch are summed, like the PS's sparse merge)."""
+        ids = np.asarray(ids)
+        loc, ok = self._local(ids)
+        g = np.asarray(grads, np.float32).reshape(-1, self.dim)[ok]
+        loc = loc[ok]
+        if loc.size == 0:
+            return
+        uniq, inv = np.unique(loc, return_inverse=True)
+        merged = np.zeros((uniq.size, self.dim), np.float32)
+        np.add.at(merged, inv, g)
+        with self._lock:
+            if self._opt == "adagrad":
+                self._acc[uniq] += merged * merged
+                step = merged / np.sqrt(self._acc[uniq] + self._eps)
+            else:
+                step = merged
+            self._data[uniq] -= (self._lr * step).astype(self.dtype)
+        self.push_count += 1
+
+    def rows(self, ids):
+        """Debug/eval helper: current host values for global ids."""
+        return self._pull_impl(np.asarray(ids))
+
+
+def ps_embedding(ids, table):
+    """Differentiable PS lookup: pulls table rows through a host callback
+    (jit-safe) and pushes gradients back to the host optimizer in the
+    custom VJP.
+
+    The integer ids alone would never trigger a backward node (autograd
+    records only for differentiable inputs), so the lookup threads the
+    table's zero-valued float `anchor` through the op — it contributes
+    nothing to the value but makes the output require grad, which is what
+    routes the output cotangent into the push callback.
+    """
+
+    @jax.custom_vjp
+    def lookup(ids_v, anchor):
+        out_sds = jax.ShapeDtypeStruct(ids_v.shape + (table.dim,),
+                                       table.dtype)
+        rows = jax.pure_callback(table.pull, out_sds, ids_v,
+                                 vmap_method="sequential")
+        return rows + anchor.astype(rows.dtype)
+
+    def fwd(ids_v, anchor):
+        return lookup(ids_v, anchor), ids_v
+
+    def bwd(ids_v, ct):
+        from jax.experimental import io_callback
+        io_callback(table.push, None, ids_v, ct, ordered=True)
+        return (np.zeros(ids_v.shape, jax.dtypes.float0),
+                jnp.sum(ct).astype(jnp.float32))
+
+    lookup.defvjp(fwd, bwd)
+    return apply(lookup, ids if isinstance(ids, Tensor)
+                 else Tensor(jnp.asarray(ids)), table.anchor)
+
+
+class PSEmbedding:
+    """Layer-ish wrapper: embedding lookup against a host SparseTable.
+    Unlike nn.Embedding the weight is NOT a device parameter — it stays
+    in host RAM and updates at push time (server-side optimizer), so it
+    deliberately does not appear in parameters()/state_dict."""
+
+    def __init__(self, num_embeddings, embedding_dim, **table_kwargs):
+        self.table = SparseTable(num_embeddings, embedding_dim,
+                                 **table_kwargs)
+
+    def __call__(self, ids):
+        return ps_embedding(ids, self.table)

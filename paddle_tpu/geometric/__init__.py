@@ -1,0 +1,214 @@
+"""paddle.geometric parity namespace.
+
+Reference: python/paddle/geometric — message_passing/send_recv.py
+(send_u_recv :35, send_ue_recv :185, send_uv :387), math.py
+(segment_sum/mean/min/max), reindex.py (reindex_graph), sampling/
+neighbors.py (sample_neighbors).
+
+TPU-native design: the reference's fused CUDA graph kernels become
+jax.ops.segment_* reductions (XLA scatter-reduce — fully differentiable
+and jittable with a static out_size); the sampling/reindex utilities are
+host-side preprocessing (numpy) exactly like the reference's CPU
+kernels, feeding static-shape device programs.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
+           "segment_mean", "segment_min", "segment_max", "reindex_graph",
+           "reindex_heter_graph", "sample_neighbors"]
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+_SEGMENT = {
+    "sum": jax.ops.segment_sum,
+    "mean": None,  # sum / count
+    "min": jax.ops.segment_min,
+    "max": jax.ops.segment_max,
+}
+
+
+def _segment_reduce(data, ids, num, op):
+    ids = ids.astype(jnp.int32)
+    if op == "mean":
+        s = jax.ops.segment_sum(data, ids, num)
+        cnt = jax.ops.segment_sum(jnp.ones(ids.shape, data.dtype), ids, num)
+        return s / jnp.maximum(cnt, 1.0).reshape(
+            (-1,) + (1,) * (data.ndim - 1))
+    out = _SEGMENT[op](data, ids, num)
+    if op in ("min", "max"):
+        # empty segments come back +/-inf; the reference zeroes them
+        cnt = jax.ops.segment_sum(jnp.ones(ids.shape, jnp.float32), ids,
+                                  num)
+        empty = (cnt == 0).reshape((-1,) + (1,) * (data.ndim - 1))
+        out = jnp.where(empty, 0.0, out).astype(data.dtype)
+    return out
+
+
+def segment_sum(data, segment_ids, name=None):
+    num = int(np.asarray(jax.device_get(_v(segment_ids))).max()) + 1 \
+        if _v(segment_ids).size else 0
+    return apply(lambda d, i: _segment_reduce(d, i, num, "sum"),
+                 _t(data), _t(segment_ids))
+
+
+def segment_mean(data, segment_ids, name=None):
+    num = int(np.asarray(jax.device_get(_v(segment_ids))).max()) + 1 \
+        if _v(segment_ids).size else 0
+    return apply(lambda d, i: _segment_reduce(d, i, num, "mean"),
+                 _t(data), _t(segment_ids))
+
+
+def segment_min(data, segment_ids, name=None):
+    num = int(np.asarray(jax.device_get(_v(segment_ids))).max()) + 1 \
+        if _v(segment_ids).size else 0
+    return apply(lambda d, i: _segment_reduce(d, i, num, "min"),
+                 _t(data), _t(segment_ids))
+
+
+def segment_max(data, segment_ids, name=None):
+    num = int(np.asarray(jax.device_get(_v(segment_ids))).max()) + 1 \
+        if _v(segment_ids).size else 0
+    return apply(lambda d, i: _segment_reduce(d, i, num, "max"),
+                 _t(data), _t(segment_ids))
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src] and scatter-reduce onto dst: one message-passing
+    step. out_size defaults to x.shape[0] (reference: max(dst)+1 padded
+    to input size)."""
+    n = int(out_size) if out_size is not None else _v(x).shape[0]
+
+    def fn(xv, si, di):
+        msgs = xv[si.astype(jnp.int32)]
+        return _segment_reduce(msgs, di, n, reduce_op)
+
+    return apply(fn, _t(x), _t(src_index), _t(dst_index))
+
+
+_MSG = {
+    "add": jnp.add,
+    "sub": jnp.subtract,
+    "mul": jnp.multiply,
+    "div": jnp.divide,
+}
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Message = x[src] (message_op) y[edge]; scatter-reduced onto dst."""
+    n = int(out_size) if out_size is not None else _v(x).shape[0]
+    mop = _MSG[message_op]
+
+    def fn(xv, yv, si, di):
+        msgs = mop(xv[si.astype(jnp.int32)], yv)
+        return _segment_reduce(msgs, di, n, reduce_op)
+
+    return apply(fn, _t(x), _t(y), _t(src_index), _t(dst_index))
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message x[src] (op) y[dst] — no reduction."""
+    mop = _MSG[message_op]
+
+    def fn(xv, yv, si, di):
+        return mop(xv[si.astype(jnp.int32)], yv[di.astype(jnp.int32)])
+
+    return apply(fn, _t(x), _t(y), _t(src_index), _t(dst_index))
+
+
+def _reindex(xs, nb):
+    """Dense-reindex helper: input nodes first, new neighbor nodes
+    appended in first-seen order. Returns (src_indices, out_nodes)."""
+    mapping = {int(v): i for i, v in enumerate(xs)}
+    out_nodes = list(xs)
+    src = np.empty(len(nb), np.int64)
+    for i, v in enumerate(nb):
+        vi = int(v)
+        if vi not in mapping:
+            mapping[vi] = len(out_nodes)
+            out_nodes.append(vi)
+        src[i] = mapping[vi]
+    return src, np.asarray(out_nodes, xs.dtype)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Reindex sampled subgraph ids to a dense [0, n) range; input nodes
+    first, new neighbor nodes appended in first-seen order."""
+    xs = np.asarray(jax.device_get(_v(x)))
+    nb = np.asarray(jax.device_get(_v(neighbors)))
+    cnt = np.asarray(jax.device_get(_v(count)))
+    src, out_nodes = _reindex(xs, nb)
+    dst = np.repeat(np.arange(len(xs)), cnt)
+    dt = xs.dtype
+    return (Tensor(jnp.asarray(src.astype(dt))),
+            Tensor(jnp.asarray(dst.astype(dt))),
+            Tensor(jnp.asarray(out_nodes)))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """reindex_graph over per-edge-type neighbor/count lists sharing one
+    node mapping (reference reindex.py reindex_heter_graph)."""
+    xs = np.asarray(jax.device_get(_v(x)))
+    nbs = [np.asarray(jax.device_get(_v(n))) for n in neighbors]
+    cnts = [np.asarray(jax.device_get(_v(c))) for c in count]
+    merged = np.concatenate(nbs) if nbs else np.zeros(0, xs.dtype)
+    src_all, out_nodes = _reindex(xs, merged)
+    offs = np.cumsum([0] + [len(n) for n in nbs])
+    dt = xs.dtype
+    srcs = [Tensor(jnp.asarray(src_all[offs[i]:offs[i + 1]].astype(dt)))
+            for i in range(len(nbs))]
+    dsts = [Tensor(jnp.asarray(
+        np.repeat(np.arange(len(xs)), c).astype(dt))) for c in cnts]
+    return srcs, dsts, Tensor(jnp.asarray(out_nodes))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniformly sample up to sample_size neighbors per input node from a
+    CSC graph (host-side, like the reference CPU kernel). Returns
+    (out_neighbors, out_count[, out_eids])."""
+    rw = np.asarray(jax.device_get(_v(row))).reshape(-1)
+    cp = np.asarray(jax.device_get(_v(colptr))).reshape(-1)
+    nodes = np.asarray(jax.device_get(_v(input_nodes))).reshape(-1)
+    if return_eids and eids is None:
+        raise ValueError("return_eids=True requires eids")
+    ev = np.asarray(jax.device_get(_v(eids))).reshape(-1) \
+        if eids is not None else None
+    rng = np.random.default_rng()
+    out_n, out_c, out_e = [], [], []
+    for v in nodes:
+        beg, end = int(cp[v]), int(cp[v + 1])
+        deg = end - beg
+        if sample_size < 0 or deg <= sample_size:
+            pick = np.arange(beg, end)
+        else:
+            pick = beg + rng.choice(deg, size=sample_size, replace=False)
+        out_n.append(rw[pick])
+        out_c.append(len(pick))
+        if ev is not None:
+            out_e.append(ev[pick])
+    neigh = np.concatenate(out_n) if out_n else np.zeros(0, rw.dtype)
+    cnt = np.asarray(out_c, np.int32)
+    res = (Tensor(jnp.asarray(neigh)), Tensor(jnp.asarray(cnt)))
+    if return_eids:
+        e = np.concatenate(out_e) if out_e else np.zeros(0, rw.dtype)
+        res = res + (Tensor(jnp.asarray(e)),)
+    return res

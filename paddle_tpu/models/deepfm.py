@@ -91,3 +91,55 @@ class DeepFMCriterion(nn.Layer):
     def forward(self, logits, labels):
         return F.binary_cross_entropy_with_logits(
             logits, labels.astype(logits.dtype).reshape(logits.shape))
+
+
+class DeepFMPS(nn.Layer):
+    """DeepFM with BEYOND-HBM embedding tables (r3, VERDICT #6).
+
+    Reference parity: the trillion-parameter PS configuration
+    (distributed/ps/the_one_ps.py + sparse_embedding): embedding rows
+    live in host RAM (distributed/ps.py SparseTable), each step pulls
+    only the touched [batch, fields, dim] slice to the device and pushes
+    sparse gradients back to the host optimizer. The dense tower (FM +
+    MLP) remains an ordinary device model trained by a normal optimizer;
+    the tables never enter parameters()/HBM, so capacity is bounded by
+    host RAM — the scale story the mesh-sharded DeepFM (above) cannot
+    reach past aggregate HBM.
+    """
+
+    def __init__(self, vocab_size=1000000, num_fields=26, embedding_dim=16,
+                 dense_dim=13, mlp_sizes=(400, 400, 400), ps_optimizer=
+                 "adagrad", ps_learning_rate=0.05, seed=0):
+        super().__init__()
+        from paddle_tpu.distributed.ps import PSEmbedding
+
+        self.num_fields = num_fields
+        self.embedding_dim = embedding_dim
+        self.fo_embedding = PSEmbedding(
+            vocab_size, 1, optimizer=ps_optimizer,
+            learning_rate=ps_learning_rate, seed=seed)
+        self.embedding = PSEmbedding(
+            vocab_size, embedding_dim, optimizer=ps_optimizer,
+            learning_rate=ps_learning_rate, seed=seed + 1)
+        self.fo_dense = nn.Linear(dense_dim, 1)
+        self.dense_proj = nn.Linear(dense_dim, embedding_dim)
+        layers = []
+        in_dim = (num_fields + 1) * embedding_dim
+        for h in mlp_sizes:
+            layers += [nn.Linear(in_dim, h), nn.ReLU()]
+            in_dim = h
+        layers.append(nn.Linear(in_dim, 1))
+        self.mlp = nn.Sequential(*layers)
+
+    def forward(self, sparse_ids, dense):
+        b = sparse_ids.shape[0]
+        fo = self.fo_embedding(sparse_ids).reshape([b, self.num_fields])
+        first = fo.sum(axis=1, keepdim=True) + self.fo_dense(dense)
+        emb = self.embedding(sparse_ids)                  # [b, fields, k]
+        dense_emb = self.dense_proj(dense).unsqueeze(1)
+        feats = paddle_tpu.concat([emb, dense_emb], axis=1)
+        sum_sq = feats.sum(axis=1).pow(2)
+        sq_sum = feats.pow(2).sum(axis=1)
+        second = (0.5 * (sum_sq - sq_sum)).sum(axis=1, keepdim=True)
+        deep = self.mlp(feats.reshape([b, -1]))
+        return first + second + deep

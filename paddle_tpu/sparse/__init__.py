@@ -24,14 +24,70 @@ except Exception:  # pragma: no cover
 
 
 class SparseCooTensor(Tensor):
+    """COO tensor whose PRIMARY representation is the BCOO triplet —
+    construction allocates O(nnz); the dense mirror `_value` (which lets
+    every dense paddle_tpu op still accept a sparse tensor) materializes
+    LAZILY on first touch and is cached. Sparse-aware ops below consult
+    `_bcoo` only and never trigger it."""
+
     def __init__(self, indices, values, shape, stop_gradient=True):
         iv = unwrap(indices)
         vv = unwrap(values)
         self._bcoo = jsparse.BCOO((vv, jnp.swapaxes(iv, 0, 1)),
                                   shape=tuple(int(s) for s in shape))
-        super().__init__(self._bcoo.todense(), stop_gradient=stop_gradient)
+        self._dense_cache = None
+        # Tensor.__init__ would require a dense value; init only the
+        # non-storage fields so nothing materializes at construction
+        self._init_meta(stop_gradient)
         self._indices = Tensor(iv)
         self._values = Tensor(vv)
+
+    # ---- lazy dense mirror ----
+    @property
+    def _value(self):
+        if self._dense_cache is None:
+            self._dense_cache = self._bcoo.todense()
+        return self._dense_cache
+
+    @_value.setter
+    def _value(self, v):
+        # a direct rebind (in-place dense op, state restore) makes the
+        # dense value authoritative; metadata below follows it
+        self._dense_cache = v
+
+    def _meta_src(self):
+        """Once the dense mirror exists (lazily materialized or rebound
+        by an in-place op) it is authoritative for metadata; before that,
+        metadata comes from the BCOO triplet without densifying."""
+        return self._bcoo if self._dense_cache is None else \
+            self._dense_cache
+
+    # ---- metadata (must not densify a pristine sparse tensor) ----
+    @property
+    def shape(self):
+        return list(self._meta_src().shape)
+
+    @property
+    def ndim(self):
+        return self._meta_src().ndim
+
+    @property
+    def dim(self):
+        return self._meta_src().ndim
+
+    @property
+    def rank(self):
+        return self._meta_src().ndim
+
+    @property
+    def size(self):
+        s = self._meta_src().shape
+        return int(np.prod(s)) if s else 1
+
+    @property
+    def dtype(self):
+        return self._values.dtype if self._dense_cache is None else \
+            Tensor.dtype.fget(self)
 
     def indices(self):
         return self._indices
@@ -64,13 +120,22 @@ class SparseCsrTensor(SparseCooTensor):
     """CSR surface over the same BCOO backing (crows kept for API parity)."""
 
     def __init__(self, crows, cols, values, shape, stop_gradient=True):
-        crows_v = np.asarray(unwrap(crows))
-        cols_v = np.asarray(unwrap(cols))
-        rows = np.repeat(np.arange(len(crows_v) - 1), np.diff(crows_v))
-        indices = np.stack([rows, cols_v])
+        crows_v = jnp.asarray(unwrap(crows))
+        cols_v = jnp.asarray(unwrap(cols))
+        nnz = int(crows_v[-1])
+        if nnz != cols_v.shape[0]:
+            raise ValueError(
+                f"sparse_csr_tensor: crows[-1]={nnz} does not match "
+                f"len(cols)={cols_v.shape[0]}")
+        # expand crows -> per-entry row ids ON DEVICE (total length is the
+        # static nnz, so the repeat stays statically shaped)
+        rows = jnp.repeat(jnp.arange(crows_v.shape[0] - 1),
+                          jnp.diff(crows_v),
+                          total_repeat_length=cols_v.shape[0])
+        indices = jnp.stack([rows, cols_v])
         super().__init__(indices, values, shape, stop_gradient)
-        self._crows = Tensor(jnp.asarray(crows_v))
-        self._cols = Tensor(jnp.asarray(cols_v))
+        self._crows = Tensor(crows_v)
+        self._cols = Tensor(cols_v)
 
     def crows(self):
         return self._crows

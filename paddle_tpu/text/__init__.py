@@ -1,0 +1,103 @@
+"""paddle.text parity namespace.
+
+Reference: python/paddle/text/viterbi_decode.py (viterbi_decode :24,
+ViterbiDecoder :100); numeric semantics follow the phi kernel
+(paddle/phi/kernels/cpu/viterbi_decode_kernel.cc): with
+include_bos_eos_tag, transitions' last row is the start->tag score and
+the second-to-last row the tag->stop score.
+
+TPU-native: the per-timestep max-product recursion is one lax.scan over
+time (statically shaped, jittable); the backtrace is a second scan over
+the stored argmax history. The reference's hand-rolled buffer arithmetic
+(masked updates for ragged lengths) becomes jnp.where masking.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer.layers import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def _t(x):
+    import numpy as np
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(np.asarray(x)))
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Highest-scoring tag sequence under a linear-chain CRF.
+
+    potentials: [B, T, n] unary scores; transition_params: [n, n];
+    lengths: [B] int. Returns (scores [B], path [B, max(lengths)]);
+    positions at or beyond a sequence's length are 0.
+    """
+
+    def fn(emit, trans, lens):
+        B, T, n = emit.shape
+        lens = lens.astype(jnp.int32)
+        start = trans[-1] if include_bos_eos_tag else jnp.zeros((n,))
+        stop = trans[-2] if include_bos_eos_tag else jnp.zeros((n,))
+
+        alpha0 = emit[:, 0] + start[None, :]
+        if include_bos_eos_tag:
+            alpha0 = alpha0 + jnp.where((lens == 1)[:, None],
+                                        stop[None, :], 0.0)
+
+        def step(alpha, t):
+            cand = alpha[:, :, None] + trans[None, :, :]   # [B, i, j]
+            hist = jnp.argmax(cand, axis=1)                # [B, j]
+            nxt = jnp.max(cand, axis=1) + emit[:, t]
+            if include_bos_eos_tag:
+                nxt = nxt + jnp.where((lens == t + 1)[:, None],
+                                      stop[None, :], 0.0)
+            active = (t < lens)[:, None]
+            return jnp.where(active, nxt, alpha), hist
+
+        alpha, hists = lax.scan(step, alpha0, jnp.arange(1, T))
+        scores = jnp.max(alpha, axis=-1)
+        last = jnp.argmax(alpha, axis=-1).astype(jnp.int32)  # [B]
+
+        # backtrace: walk hists [T-1, B, n] in reverse; a position t holds
+        # the best tag at time t; inactive (t >= len) positions emit 0
+        def back(tag, t):
+            hist = hists[t - 1]                              # [B, n]
+            prev = jnp.take_along_axis(hist, tag[:, None],
+                                       axis=1)[:, 0].astype(jnp.int32)
+            # only walk back while t < len (tag at time t is defined)
+            newtag = jnp.where(t < lens, prev, tag)
+            out = jnp.where(t < lens, tag, 0)
+            return newtag, out
+
+        tag_final, outs = lax.scan(back, last, jnp.arange(T - 1, 0, -1))
+        # outs[k] is the emitted tag at time T-1-k; prepend time 0
+        path = jnp.concatenate([tag_final[None, :], outs[::-1]], axis=0)
+        path = jnp.swapaxes(path, 0, 1)                      # [B, T]
+        max_len = T
+        return scores, path[:, :max_len]
+
+    scores, path = apply(fn, _t(potentials), _t(transition_params),
+                         _t(lengths))
+    # trim to the batch's longest sequence (reference: [B, max(lengths)])
+    import numpy as np
+    ln = np.asarray(jax.device_get(_t(lengths)._value))
+    max_len = int(ln.max()) if ln.size else 0
+    return scores, Tensor(path._value[:, :max_len].astype(jnp.int64))
+
+
+class ViterbiDecoder(Layer):
+    """Reference text/viterbi_decode.py:100."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

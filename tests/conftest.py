@@ -8,8 +8,12 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+if "backend_optimization_level" not in flags:
+    # tests are compile-bound, not run-bound: XLA:CPU at -O0 halves the
+    # compile time of the deep-model tests with no semantic change
+    flags = (flags + " --xla_backend_optimization_level=0").strip()
+os.environ["XLA_FLAGS"] = flags
 
 # The env var alone can be overridden by an externally-forced platform
 # (e.g. a site-installed TPU plugin exporting JAX_PLATFORMS); the config

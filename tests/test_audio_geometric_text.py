@@ -1,0 +1,209 @@
+"""audio / geometric / text namespaces vs numpy oracles (SURVEY §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.audio.functional as AF
+from paddle_tpu.audio.features import (LogMelSpectrogram, MelSpectrogram,
+                                       MFCC, Spectrogram)
+import paddle_tpu.geometric as G
+from paddle_tpu.text import ViterbiDecoder, viterbi_decode
+
+
+# ------------------------------------------------------------------ audio
+class TestAudioFunctional:
+    def test_hz_mel_roundtrip(self):
+        for htk in (False, True):
+            f = paddle.to_tensor(
+                np.array([0.0, 440.0, 1000.0, 4000.0], np.float32))
+            mel = AF.hz_to_mel(f, htk=htk)
+            back = AF.mel_to_hz(mel, htk=htk)
+            np.testing.assert_allclose(back.numpy(), f.numpy(), rtol=1e-4,
+                                       atol=1e-3)
+
+    def test_hz_to_mel_scalar_slaney_known(self):
+        # below 1 kHz the slaney scale is linear: 1000 Hz -> 15.0
+        assert abs(AF.hz_to_mel(1000.0) - 15.0) < 1e-5
+
+    def test_fbank_matrix_shape_and_coverage(self):
+        fb = AF.compute_fbank_matrix(16000, 512, n_mels=40).numpy()
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all()
+        assert (fb.sum(axis=1) > 0).all()  # every filter hits some bin
+
+    def test_power_to_db(self):
+        s = paddle.to_tensor(np.array([1.0, 0.1, 1e-12], np.float32))
+        db = AF.power_to_db(s, top_db=None).numpy()
+        np.testing.assert_allclose(db[:2], [0.0, -10.0], atol=1e-4)
+        np.testing.assert_allclose(db[2], -100.0, atol=1e-3)  # amin clamp
+        db2 = AF.power_to_db(s, top_db=30.0).numpy()
+        assert db2.min() >= db2.max() - 30.0
+
+    def test_get_window_hann_periodic(self):
+        w = AF.get_window("hann", 16, fftbins=True).numpy()
+        want = np.hanning(17)[:-1]
+        np.testing.assert_allclose(w, want, atol=1e-7)
+
+    def test_create_dct_ortho(self):
+        d = AF.create_dct(13, 40).numpy()
+        assert d.shape == (40, 13)
+        # ortho DCT columns are orthonormal
+        np.testing.assert_allclose(d.T @ d, np.eye(13), atol=1e-5)
+
+
+class TestAudioFeatures:
+    def _wave(self):
+        t = np.linspace(0, 1, 8000, dtype=np.float32)
+        return paddle.to_tensor(
+            (0.5 * np.sin(2 * np.pi * 440 * t))[None, :])
+
+    def test_spectrogram_peak_at_tone(self):
+        x = self._wave()
+        sp = Spectrogram(n_fft=512, hop_length=256, power=2.0)(x)
+        out = sp.numpy()[0]                       # [F, T]
+        assert out.shape[0] == 257
+        peak_bin = out.mean(axis=1).argmax()
+        want_bin = round(440 / (8000 / 512))
+        assert abs(int(peak_bin) - want_bin) <= 1
+
+    def test_mel_mfcc_shapes(self):
+        x = self._wave()
+        mel = MelSpectrogram(sr=8000, n_fft=512, hop_length=256,
+                             n_mels=32)(x)
+        assert mel.shape[1] == 32
+        logmel = LogMelSpectrogram(sr=8000, n_fft=512, hop_length=256,
+                                   n_mels=32)(x)
+        assert logmel.shape == mel.shape
+        mfcc = MFCC(sr=8000, n_mfcc=13, n_fft=512, hop_length=256,
+                    n_mels=32)(x)
+        assert mfcc.shape[1] == 13
+
+
+# -------------------------------------------------------------- geometric
+class TestGeometric:
+    def test_send_u_recv_ops(self):
+        x = paddle.to_tensor(
+            np.array([[0, 2, 3], [1, 4, 5], [2, 6, 7]], np.float32))
+        src = paddle.to_tensor(np.array([0, 1, 2, 0], np.int64))
+        dst = paddle.to_tensor(np.array([1, 2, 1, 0], np.int64))
+        out = G.send_u_recv(x, src, dst, reduce_op="sum").numpy()
+        want = np.zeros((3, 3), np.float32)
+        for s, d in [(0, 1), (1, 2), (2, 1), (0, 0)]:
+            want[d] += x.numpy()[s]
+        np.testing.assert_allclose(out, want)
+        out_mean = G.send_u_recv(x, src, dst, reduce_op="mean").numpy()
+        np.testing.assert_allclose(out_mean[1], want[1] / 2)
+        out_max = G.send_u_recv(x, src, dst, reduce_op="max").numpy()
+        np.testing.assert_allclose(
+            out_max[1], np.maximum(x.numpy()[0], x.numpy()[2]))
+
+    def test_send_ue_recv_and_send_uv(self):
+        x = paddle.to_tensor(np.array([[1.0], [2.0], [3.0]], np.float32))
+        y = paddle.to_tensor(np.array([[10.0], [20.0]], np.float32))
+        src = paddle.to_tensor(np.array([0, 2], np.int64))
+        dst = paddle.to_tensor(np.array([1, 1], np.int64))
+        out = G.send_ue_recv(x, y, src, dst, "add", "sum").numpy()
+        np.testing.assert_allclose(out[1], [(1 + 10) + (3 + 20)])
+        uv = G.send_uv(x, x, src, dst, "mul").numpy()
+        np.testing.assert_allclose(uv[:, 0], [1 * 2, 3 * 2])
+
+    def test_segment_ops(self):
+        data = paddle.to_tensor(
+            np.array([[1, 2], [3, 4], [5, 6]], np.float32))
+        ids = paddle.to_tensor(np.array([0, 0, 1], np.int32))
+        np.testing.assert_allclose(G.segment_sum(data, ids).numpy(),
+                                   [[4, 6], [5, 6]])
+        np.testing.assert_allclose(G.segment_mean(data, ids).numpy(),
+                                   [[2, 3], [5, 6]])
+        np.testing.assert_allclose(G.segment_min(data, ids).numpy(),
+                                   [[1, 2], [5, 6]])
+        np.testing.assert_allclose(G.segment_max(data, ids).numpy(),
+                                   [[3, 4], [5, 6]])
+
+    def test_reindex_graph_reference_example(self):
+        # exact example from reference geometric/reindex.py docstring
+        x = paddle.to_tensor(np.array([0, 1, 2], np.int64))
+        neighbors = paddle.to_tensor(
+            np.array([8, 9, 0, 4, 7, 6, 7], np.int64))
+        count = paddle.to_tensor(np.array([2, 3, 2], np.int32))
+        src, dst, nodes = G.reindex_graph(x, neighbors, count)
+        np.testing.assert_array_equal(src.numpy(), [3, 4, 0, 5, 6, 7, 6])
+        np.testing.assert_array_equal(dst.numpy(), [0, 0, 1, 1, 1, 2, 2])
+        np.testing.assert_array_equal(nodes.numpy(),
+                                      [0, 1, 2, 8, 9, 4, 7, 6])
+
+    def test_sample_neighbors(self):
+        # CSC: node0 -> [1,2], node1 -> [0], node2 -> [0,1]
+        row = paddle.to_tensor(np.array([1, 2, 0, 0, 1], np.int64))
+        colptr = paddle.to_tensor(np.array([0, 2, 3, 5], np.int64))
+        nodes = paddle.to_tensor(np.array([0, 2], np.int64))
+        neigh, cnt = G.sample_neighbors(row, colptr, nodes, sample_size=-1)
+        np.testing.assert_array_equal(cnt.numpy(), [2, 2])
+        np.testing.assert_array_equal(neigh.numpy(), [1, 2, 0, 1])
+        neigh2, cnt2 = G.sample_neighbors(row, colptr, nodes,
+                                          sample_size=1)
+        np.testing.assert_array_equal(cnt2.numpy(), [1, 1])
+        assert set(neigh2.numpy()[:1]) <= {1, 2}
+
+    def test_reindex_heter_graph(self):
+        x = paddle.to_tensor(np.array([0, 1], np.int64))
+        nb1 = paddle.to_tensor(np.array([5, 0], np.int64))
+        c1 = paddle.to_tensor(np.array([1, 1], np.int32))
+        nb2 = paddle.to_tensor(np.array([1, 6], np.int64))
+        c2 = paddle.to_tensor(np.array([1, 1], np.int32))
+        srcs, dsts, nodes = G.reindex_heter_graph(x, [nb1, nb2], [c1, c2])
+        np.testing.assert_array_equal(nodes.numpy(), [0, 1, 5, 6])
+        np.testing.assert_array_equal(srcs[0].numpy(), [2, 0])
+        np.testing.assert_array_equal(srcs[1].numpy(), [1, 3])
+        np.testing.assert_array_equal(dsts[0].numpy(), [0, 1])
+
+
+# ------------------------------------------------------------------- text
+def _viterbi_brute(emit, trans, length, bos_eos):
+    """Enumerate all tag sequences (ground truth)."""
+    import itertools
+    T, n = emit.shape
+    best_score, best_path = -np.inf, None
+    start = trans[-1] if bos_eos else np.zeros(n)
+    stop = trans[-2] if bos_eos else np.zeros(n)
+    for path in itertools.product(range(n), repeat=length):
+        s = start[path[0]] + emit[0, path[0]]
+        for t in range(1, length):
+            s += trans[path[t - 1], path[t]] + emit[t, path[t]]
+        s += stop[path[-1]]
+        if s > best_score:
+            best_score, best_path = s, path
+    return best_score, list(best_path)
+
+
+class TestViterbi:
+    @pytest.mark.parametrize("bos_eos", [True, False])
+    def test_matches_brute_force(self, bos_eos):
+        rng = np.random.default_rng(0)
+        B, T, n = 3, 4, 4
+        emit = rng.standard_normal((B, T, n)).astype(np.float32)
+        trans = rng.standard_normal((n, n)).astype(np.float32)
+        lengths = np.array([4, 2, 3], np.int64)
+        scores, path = viterbi_decode(
+            paddle.to_tensor(emit), paddle.to_tensor(trans),
+            paddle.to_tensor(lengths), include_bos_eos_tag=bos_eos)
+        scores, path = scores.numpy(), path.numpy()
+        assert path.shape == (B, 4)
+        for b in range(B):
+            ws, wp = _viterbi_brute(emit[b], trans, int(lengths[b]),
+                                    bos_eos)
+            np.testing.assert_allclose(scores[b], ws, rtol=1e-5,
+                                       atol=1e-5)
+            np.testing.assert_array_equal(path[b, :lengths[b]], wp)
+            assert (path[b, lengths[b]:] == 0).all()
+
+    def test_layer(self):
+        rng = np.random.default_rng(1)
+        trans = paddle.to_tensor(
+            rng.standard_normal((5, 5)).astype(np.float32))
+        dec = ViterbiDecoder(trans)
+        emit = paddle.to_tensor(
+            rng.standard_normal((2, 3, 5)).astype(np.float32))
+        lengths = paddle.to_tensor(np.array([3, 3], np.int64))
+        scores, path = dec(emit, lengths)
+        assert list(path.shape) == [2, 3]

@@ -384,6 +384,7 @@ class TestGroupSharded:
 # hybrid mesh: GPT-tiny trains identically on 1 device vs dp×tp×sp mesh
 # ---------------------------------------------------------------------------
 class TestHybridParallel:
+    @pytest.mark.nightly  # duplicate angle of tests/test_gpt_hybrid.py
     def test_gpt_tiny_dp_tp_sp_matches_single(self):
         from paddle_tpu.models.gpt import (GPTConfig, GPTForCausalLM,
                                            GPTPretrainingCriterion)

@@ -165,13 +165,13 @@ class TestFusedLayers:
     def test_encoder_layer_runs_and_trains(self):
         from paddle_tpu.incubate.nn import FusedTransformerEncoderLayer
         paddle.seed(0)
-        layer = FusedTransformerEncoderLayer(32, 4, 64, dropout_rate=0.0)
+        layer = FusedTransformerEncoderLayer(16, 2, 32, dropout_rate=0.0)
         opt = paddle.optimizer.Adam(learning_rate=1e-3,
                                     parameters=layer.parameters())
         x = paddle.to_tensor(np.random.default_rng(3).standard_normal(
-            (2, 6, 32)).astype(np.float32))
+            (2, 6, 16)).astype(np.float32))
         y = layer(x)
-        assert y.shape == [2, 6, 32]
+        assert y.shape == [2, 6, 16]
         loss = (y ** 2).mean()
         loss.backward()
         opt.step()

@@ -1,0 +1,146 @@
+"""Beyond-HBM parameter-server embedding (distributed/ps.py): the table
+lives in host RAM; only minibatch-sized slices ever become device arrays;
+gradients stream back through the server-side optimizer."""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.ps import PSEmbedding, SparseTable, ps_embedding
+
+
+class TestSparseTable:
+    def test_pull_push_sgd(self):
+        t = SparseTable(100, 4, optimizer="sgd", learning_rate=0.5, seed=0)
+        before = t.rows(np.array([3, 7]))
+        ids = np.array([[3, 7, 3]])
+        g = np.ones((1, 3, 4), np.float32)
+        t.push(ids, g)
+        after = t.rows(np.array([3, 7]))
+        # duplicate id 3 merges: grad 2, id 7: grad 1
+        np.testing.assert_allclose(after[0], before[0] - 0.5 * 2,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(after[1], before[1] - 0.5 * 1,
+                                   rtol=1e-6)
+        # untouched rows unchanged
+        np.testing.assert_array_equal(t.rows(np.array([50])),
+                                      t.rows(np.array([50])))
+
+    def test_adagrad_scales_update(self):
+        t = SparseTable(10, 2, optimizer="adagrad", learning_rate=1.0,
+                        seed=1)
+        r0 = t.rows(np.array([2])).copy()
+        t.push(np.array([[2]]), np.full((1, 1, 2), 2.0, np.float32))
+        r1 = t.rows(np.array([2]))
+        # adagrad first step: g / sqrt(g^2 + eps) ~ 1.0
+        np.testing.assert_allclose(r0 - r1, [[1.0, 1.0]], atol=1e-3)
+
+    def test_row_sharding_drops_foreign_ids(self):
+        t = SparseTable(100, 2, row_shard=(50, 50), optimizer="sgd",
+                        learning_rate=1.0, seed=2)
+        rows = t.pull(np.array([10, 60]))
+        assert (rows[0] == 0).all()          # not owned -> zeros
+        assert not (rows[1] == 0).all()
+        before = t._data.copy()
+        t.push(np.array([10]), np.ones((1, 2), np.float32))
+        np.testing.assert_array_equal(t._data, before)  # foreign push drop
+
+    def test_prefetch_serves_pull(self):
+        t = SparseTable(20, 3, seed=3)
+        ids = np.array([1, 2, 3])
+        th = t.prefetch(ids)
+        th.join()
+        base = t.pull_count
+        rows = t.pull(ids)
+        assert t.pull_count == base          # served from prefetch cache
+        np.testing.assert_allclose(rows, t.rows(ids))
+
+
+class TestPSEmbeddingAutograd:
+    def test_eager_backward_pushes(self):
+        emb = PSEmbedding(50, 4, optimizer="sgd", learning_rate=0.1,
+                          seed=0)
+        ids = paddle.to_tensor(np.array([[1, 2], [2, 4]], np.int64))
+        before = emb.table.rows(np.array([1, 2, 4])).copy()
+        out = emb(ids)
+        assert list(out.shape) == [2, 2, 4]
+        out.sum().backward()
+        after = emb.table.rows(np.array([1, 2, 4]))
+        np.testing.assert_allclose(after[0], before[0] - 0.1, rtol=1e-5)
+        np.testing.assert_allclose(after[1], before[1] - 0.2, rtol=1e-5)
+        np.testing.assert_allclose(after[2], before[2] - 0.1, rtol=1e-5)
+
+    def test_to_static_lookup_and_push(self):
+        """pull/push fire inside a compiled train step (pure_callback +
+        ordered io_callback) — the to_static path of the PS story."""
+        emb = PSEmbedding(30, 2, optimizer="sgd", learning_rate=0.5,
+                          seed=1)
+        lin = paddle.nn.Linear(2, 1)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters())
+
+        @paddle.jit.to_static
+        def step(ids):
+            opt.clear_grad()
+            loss = lin(emb(ids)).sum()
+            loss.backward()
+            opt.step()
+            return loss
+
+        ids = paddle.to_tensor(np.array([[7, 8]], np.int64))
+        before = emb.table.rows(np.array([7, 8])).copy()
+        for _ in range(2):
+            loss = step(ids)
+        assert np.isfinite(float(loss.numpy()))
+        after = emb.table.rows(np.array([7, 8]))
+        assert not np.allclose(after, before), "push never reached host"
+        assert emb.table.push_count >= 2
+
+
+def test_deepfm_ps_trains_and_stays_off_hbm():
+    """The VERDICT #6 criterion: a table larger than a device-memory cap
+    trains; HBM only ever sees minibatch slices; touched rows move,
+    untouched rows stay."""
+    from paddle_tpu.models.deepfm import DeepFMCriterion, DeepFMPS
+
+    paddle.seed(0)
+    vocab = 200000          # 200k x 16 floats = 12.8 MB host table
+    model = DeepFMPS(vocab_size=vocab, num_fields=4, embedding_dim=16,
+                     dense_dim=3, mlp_sizes=(32, 16),
+                     ps_learning_rate=0.1)
+    crit = DeepFMCriterion()
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=model.parameters())
+
+    # embedding tables are NOT device parameters
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    assert n_params < vocab, "table leaked into device parameters"
+
+    rng = np.random.default_rng(0)
+    ids_np = rng.integers(0, vocab, (16, 4))
+    ids = paddle.to_tensor(ids_np.astype(np.int64))
+    dense = paddle.to_tensor(
+        rng.standard_normal((16, 3)).astype(np.float32))
+    labels = paddle.to_tensor(rng.integers(0, 2, (16, 1)).astype(
+        np.float32))
+
+    untouched = np.setdiff1d(np.arange(vocab), ids_np.reshape(-1))[:5]
+    before_untouched = model.embedding.table.rows(untouched).copy()
+    before_touched = model.embedding.table.rows(
+        ids_np.reshape(-1)[:5]).copy()
+
+    losses = []
+    for _ in range(25):
+        opt.clear_grad()
+        loss = crit(model(ids, dense), labels)
+        loss.backward()
+        opt.step()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.7, losses
+
+    after_untouched = model.embedding.table.rows(untouched)
+    np.testing.assert_array_equal(after_untouched, before_untouched)
+    assert not np.allclose(model.embedding.table.rows(
+        ids_np.reshape(-1)[:5]), before_touched)
+    assert model.embedding.table.push_count >= 25

@@ -147,3 +147,54 @@ class TestSparseNN:
         out = conv(s).to_dense().numpy()
         active = out != 0
         assert active.sum() <= 1          # only the input's active site
+
+
+class TestLazySparse:
+    def test_construction_is_o_nnz(self):
+        """r3 (VERDICT #10): a 100k x 100k COO tensor (40 GB dense) with
+        10 entries must construct and operate without ever materializing
+        the dense mirror."""
+        import numpy as np
+        n = 100_000
+        idx = np.stack([np.arange(10) * 7, np.arange(10) * 11])
+        vals = np.arange(10, dtype=np.float32) + 1
+        t = sparse.sparse_coo_tensor(idx, vals, (n, n))
+        assert t._dense_cache is None
+        assert t.shape == [n, n]
+        assert t.nnz() == 10
+        assert t.dtype == np.float32
+        # sparse-aware ops keep the dense mirror unmaterialized
+        r = sparse.relu(t)
+        s = sparse.multiply(t, 2.0)
+        tt = sparse.transpose(t, [1, 0])
+        assert t._dense_cache is None
+        assert r._dense_cache is None and s._dense_cache is None
+        assert tt._dense_cache is None
+        # spmm consumes the BCOO directly
+        dense = paddle_tpu.to_tensor(
+            np.random.default_rng(0).standard_normal((n, 4))
+            .astype(np.float32))
+        out = sparse.matmul(t, dense)
+        assert list(out.shape) == [n, 4]
+        assert t._dense_cache is None
+
+    def test_dense_mirror_lazy_and_cached(self):
+        import numpy as np
+        idx = np.array([[0, 1], [1, 0]])
+        vals = np.array([2.0, 3.0], np.float32)
+        t = sparse.sparse_coo_tensor(idx, vals, (2, 2))
+        assert t._dense_cache is None
+        d = t.to_dense().numpy()            # first touch materializes
+        np.testing.assert_allclose(d, [[0, 2], [3, 0]])
+        assert t._dense_cache is not None
+
+    def test_csr_device_construction(self):
+        import numpy as np
+        t = sparse.sparse_csr_tensor(
+            np.array([0, 2, 3]), np.array([0, 2, 1]),
+            np.array([1.0, 2.0, 3.0], np.float32), (2, 3))
+        assert t._dense_cache is None
+        np.testing.assert_array_equal(
+            np.asarray(t.indices().numpy()), [[0, 0, 1], [0, 2, 1]])
+        np.testing.assert_allclose(t.to_dense().numpy(),
+                                   [[1, 0, 2], [0, 3, 0]])

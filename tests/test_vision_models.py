@@ -14,24 +14,32 @@ def _x(n=1, size=64):
         rng.randn(n, 3, size, size).astype(np.float32))
 
 
+# the default gate run keeps two cheap representatives; the rest of the
+# zoo compiles for minutes on XLA:CPU and runs under `-m nightly`
+_N = pytest.mark.nightly
 SINGLE_OUT = [
-    ("alexnet", dict(), 64),
-    ("vgg11", dict(num_classes=10), 64),
-    ("mobilenet_v1", dict(num_classes=10, scale=0.25), 64),
-    ("mobilenet_v2", dict(num_classes=10, scale=0.25), 64),
-    ("mobilenet_v3_small", dict(num_classes=10, scale=0.5), 64),
-    ("mobilenet_v3_large", dict(num_classes=10, scale=0.5), 64),
-    ("squeezenet1_0", dict(num_classes=10), 64),
-    ("squeezenet1_1", dict(num_classes=10), 64),
-    ("shufflenet_v2_x0_25", dict(num_classes=10), 64),
-    ("shufflenet_v2_swish", dict(num_classes=10), 64),
-    ("densenet121", dict(num_classes=10), 64),
-    ("inception_v3", dict(num_classes=10), 96),
+    pytest.param("alexnet", dict(), 64, marks=_N),
+    pytest.param("vgg11", dict(num_classes=10), 64, marks=_N),
+    pytest.param("mobilenet_v1", dict(num_classes=10, scale=0.25), 64,
+                 marks=_N),
+    pytest.param("mobilenet_v2", dict(num_classes=10, scale=0.25), 64,
+                 marks=_N),
+    pytest.param("mobilenet_v3_small", dict(num_classes=10, scale=0.5), 64,
+                 marks=_N),
+    pytest.param("mobilenet_v3_large", dict(num_classes=10, scale=0.5), 64,
+                 marks=_N),
+    pytest.param("squeezenet1_0", dict(num_classes=10), 64, marks=_N),
+    pytest.param("squeezenet1_1", dict(num_classes=10), 64),
+    pytest.param("shufflenet_v2_x0_25", dict(num_classes=10), 64),
+    pytest.param("shufflenet_v2_swish", dict(num_classes=10), 64,
+                 marks=_N),
+    pytest.param("densenet121", dict(num_classes=10), 64, marks=_N),
+    pytest.param("inception_v3", dict(num_classes=10), 96, marks=_N),
 ]
 
 
 @pytest.mark.parametrize("name,kwargs,size", SINGLE_OUT,
-                         ids=[c[0] for c in SINGLE_OUT])
+                         ids=[c.values[0] for c in SINGLE_OUT])
 def test_forward_shape(name, kwargs, size):
     model = getattr(models, name)(**kwargs)
     model.eval()
@@ -41,12 +49,14 @@ def test_forward_shape(name, kwargs, size):
     assert np.isfinite(out.numpy()).all()
 
 
+@pytest.mark.nightly
 def test_vgg16_bn_forward():
     model = models.vgg16(batch_norm=True, num_classes=7)
     model.eval()
     assert tuple(model(_x()).shape) == (1, 7)
 
 
+@pytest.mark.nightly
 def test_googlenet_aux_heads():
     model = models.googlenet(num_classes=10)
     model.eval()
@@ -56,6 +66,7 @@ def test_googlenet_aux_heads():
     assert tuple(aux2.shape) == (1, 10)
 
 
+@pytest.mark.nightly
 def test_mobilenet_v2_train_step_runs():
     """One train step must run through backward (BN train mode, dropout)."""
     from paddle_tpu import nn, optimizer
