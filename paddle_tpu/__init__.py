@@ -131,3 +131,12 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
     if print_detail:
         print(f"Total FLOPs: {total[0]}")
     return total[0]
+
+
+def __getattr__(name):
+    # paddle_tpu.onnx loads lazily: its protoc-generated binding needs
+    # google.protobuf, which only ONNX exporters should have to carry
+    if name == "onnx":
+        import importlib
+        return importlib.import_module("paddle_tpu.onnx")
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

@@ -24,6 +24,8 @@ from __future__ import annotations
 
 import functools
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -241,6 +243,99 @@ def pipeline_1f1b_body(stage_fn, loss_fn, params, loss_params, x, aux,
         return loss_sum, c["gparams"], gloss, dx_mb
 
     return body(params, loss_params, x, aux)
+
+
+def interleave_layer_permutation(num_layers, pp, v):
+    """Row permutation placing layers for the interleaved schedule.
+
+    With V virtual chunks per device, device d's chunk c is LOGICAL stage
+    l = c*pp + d (Megatron's interleaved assignment, reference
+    pipeline_parallel.py:461 PipelineParallelWithInterleave). The stacked
+    layer array is sharded contiguously over pp, so stored row
+    d*(L/pp) + c*(L/(pp*v)) + j must hold logical layer
+    (c*pp + d)*(L/(pp*v)) + j. Returns `perm` with
+    stored[i] = logical[perm[i]].
+    """
+    if num_layers % (pp * v):
+        raise ValueError("num_layers must divide by pp*v")
+    lc = num_layers // (pp * v)       # layers per chunk
+    l_loc = num_layers // pp          # layers per device
+    perm = np.empty(num_layers, np.int64)
+    for d in range(pp):
+        for c in range(v):
+            for j in range(lc):
+                perm[d * l_loc + c * lc + j] = (c * pp + d) * lc + j
+    return perm
+
+
+def pipeline_interleaved_forward_fn(chunk_fn, axis_name="pp",
+                                    axis_size=None, num_chunks=1):
+    """Interleaved (virtual-stage) pipeline forward — call INSIDE
+    shard_map. Reference: fleet/meta_parallel/pipeline_parallel.py:461
+    (PipelineParallelWithInterleave).
+
+    TPU-native rendering: ONE folded ring. Each device holds `num_chunks`
+    (V) model chunks; a microbatch makes pp*V hops around the pp-device
+    ring, crossing to its next chunk each time it wraps past the last
+    device (the seam). Each tick every device runs ONE chunk — 1/V of a
+    non-interleaved stage — so the fill/drain bubble costs (pp-1) CHUNK
+    units instead of (pp-1) full-stage units: the bubble shrinks by V,
+    which is the whole point of the interleaved schedule. Injection of
+    new microbatches at device 0 is phase-gated (groups of pp, Megatron's
+    grouping) so it never collides with a seam crossing. Backward is the
+    AD transpose of the scan — it replays the same interleaved schedule
+    in reverse (the explicit-1F1B composition stays with the
+    non-interleaved body, pipeline_1f1b_body).
+
+    chunk_fn(chunk_params, x) -> y; the body below receives
+    params_chunks whose leaves carry a leading [V, ...] chunk dim (see
+    interleave_layer_permutation for the storage layout).
+
+    Returned body(params_chunks, x) maps [M, mb, ...] -> [M, mb, ...]
+    (replicated over pp). M must divide by pp (pad the microbatch count).
+    """
+    v = num_chunks
+
+    def body(params_chunks, x):
+        pp = mesh_mod.resolve_axis_size(axis_name, axis_size)
+        d = lax.axis_index(axis_name)
+        M = x.shape[0]
+        if M % pp:
+            raise ValueError(f"microbatches {M} must divide by pp {pp}")
+        period = pp * v
+        S = M * v                      # total stream ticks per device
+        T = S + pp - 1                 # + ring fill
+        perm = [(i, (i + 1) % pp) for i in range(pp)]
+        zero_y = jnp.zeros(x.shape[1:], x.dtype)
+
+        def tick(y_prev, t):
+            inbound = lax.ppermute(y_prev, axis_name, perm)
+            s = t - d                  # this device's stream coordinate
+            c = (s % period) // pp     # chunk being run this tick
+            g = s // period            # microbatch group
+            mb = g * pp + (s % pp)
+            inject = jnp.logical_and(d == 0, c == 0)
+            inp = jnp.where(inject, x[jnp.clip(mb, 0, M - 1)], inbound)
+            params_c = jax.tree_util.tree_map(
+                lambda p: lax.dynamic_index_in_dim(
+                    p, jnp.clip(c, 0, v - 1), 0, keepdims=False),
+                params_chunks)
+            y = chunk_fn(params_c, inp)
+            # final logical stage (last device, last chunk) emits
+            emit = jnp.logical_and(d == pp - 1, c == v - 1)
+            valid = jnp.logical_and(s >= 0, s < S)
+            out = jnp.where(jnp.logical_and(emit, valid), y, 0.0)
+            return y, out
+
+        _, outs = lax.scan(jax.checkpoint(tick), zero_y, jnp.arange(T))
+        # mb m finishes on device pp-1 at tick
+        #   t(m) = (m//pp)*period + (v-1)*pp + (m%pp) + (pp-1)
+        m_idx = jnp.arange(M)
+        t_out = (m_idx // pp) * period + (v - 1) * pp + (m_idx % pp) \
+            + (pp - 1)
+        return lax.psum(outs[t_out], axis_name)
+
+    return body
 
 
 def microbatch(x, num_microbatches, batch_axis=0):

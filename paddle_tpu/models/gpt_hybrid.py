@@ -40,18 +40,25 @@ from paddle_tpu.distributed.fleet.mp_ops import (copy_to_tp_region,
                                                  reduce_from_tp_region,
                                                  vocab_parallel_cross_entropy,
                                                  vocab_parallel_embedding)
-from paddle_tpu.distributed.pipeline import pipeline_1f1b_body
+from paddle_tpu.distributed.pipeline import (interleave_layer_permutation,
+                                             pipeline_1f1b_body,
+                                             pipeline_interleaved_forward_fn)
 
 
 # ---------------------------------------------------------------------------
 # Parameter init / sharding specs
 # ---------------------------------------------------------------------------
 
-def init_hybrid_gpt_params(cfg, mesh, seed=0):
+def init_hybrid_gpt_params(cfg, mesh, seed=0, virtual_chunks=1):
     """Whole-array params, device_put with their hybrid PartitionSpecs.
 
     cfg needs: vocab_size, hidden_size, num_layers, num_heads, ffn size via
     4*hidden, max_seq_len. num_layers must be divisible by the pp degree.
+
+    virtual_chunks > 1 stores the stacked layers in the INTERLEAVED layout
+    (device d's shard holds its V non-adjacent logical chunks — see
+    interleave_layer_permutation); the logical model is identical, only
+    row placement changes.
     """
     H = cfg.hidden_size
     F = getattr(cfg, "ffn_hidden_size", None) or 4 * H
@@ -76,6 +83,14 @@ def init_hybrid_gpt_params(cfg, mesh, seed=0):
         "w2": norm(L, F, H),
         "b2": np.zeros((L, H), np.float32),
     }
+    if virtual_chunks > 1:
+        pp = dict(mesh.shape)["pp"]
+        perm = interleave_layer_permutation(L, pp, virtual_chunks)
+        stages = {k: v[perm] for k, v in stages.items()}
+    # record the storage layout on cfg so the schedule factories can
+    # refuse a mismatched virtual_chunks (identical shapes would otherwise
+    # silently train a layer-permuted model)
+    cfg.pipeline_virtual_chunks = virtual_chunks
     params = {
         "wte": norm(cfg.vocab_size, H),
         "wpe": norm(cfg.max_seq_len, H),
@@ -196,6 +211,16 @@ def _pipeline_trunk(stage_params, h_mb, block_fn, pp_size):
     return lax.psum(outputs, "pp")
 
 
+def _check_layout(cfg, virtual_chunks):
+    stored = getattr(cfg, "pipeline_virtual_chunks", 1)
+    if stored != virtual_chunks:
+        raise ValueError(
+            f"params were initialized with virtual_chunks={stored} but the "
+            f"schedule was built with virtual_chunks={virtual_chunks}; "
+            "layer placement would silently be wrong "
+            "(init_hybrid_gpt_params and the schedule factory must agree)")
+
+
 def _hybrid_degrees(cfg, mesh):
     """Validate cfg divisibility against the mesh; returns
     (tp, sp, pp, heads_local) — shared by both schedule factories."""
@@ -228,13 +253,21 @@ def _embed_fn(ids, num_microbatches, explicit_bwd):
     return embed
 
 
-def make_hybrid_loss_fn(cfg, mesh, num_microbatches=2):
+def make_hybrid_loss_fn(cfg, mesh, num_microbatches=2, pipeline="gpipe",
+                        virtual_chunks=1):
     """Whole-array loss(params, ids, labels) -> scalar; jit/grad-able.
 
     ids/labels: [B, S] sharded (dp, sp). Composes the dp/pp/tp/sp program
     described in the module docstring inside one shard_map.
+
+    pipeline: "gpipe" (scan+ppermute trunk) or "interleave"
+    (virtual-stage folded ring, `virtual_chunks` chunks per device —
+    params must come from init_hybrid_gpt_params(virtual_chunks=V)).
+    Both differentiate via outer AD; the explicit 1F1B schedule lives in
+    make_hybrid_grad_fn.
     """
     tp, sp, pp, heads_local = _hybrid_degrees(cfg, mesh)
+    _check_layout(cfg, virtual_chunks if pipeline == "interleave" else 1)
     M = num_microbatches
 
     def local_loss(params, ids, labels):
@@ -242,7 +275,23 @@ def make_hybrid_loss_fn(cfg, mesh, num_microbatches=2):
         h = _embed_fn(ids, M, False)(params["wte"], params["wpe"])
         block = functools.partial(_decoder_block,
                                   num_heads_local=heads_local, sp_size=sp)
-        h = _pipeline_trunk(params["stages"], h, block, pp)
+        if pipeline == "interleave":
+            v = virtual_chunks
+
+            def chunk_fn(chunk_params, xmb):
+                def one(xc, pl):
+                    return jax.checkpoint(block)(pl, xc), None
+                out, _ = lax.scan(one, xmb, chunk_params)
+                return out
+
+            chunked = jax.tree_util.tree_map(
+                lambda p: p.reshape((v, p.shape[0] // v) + p.shape[1:]),
+                params["stages"])
+            body = pipeline_interleaved_forward_fn(
+                chunk_fn, "pp", axis_size=pp, num_chunks=v)
+            h = body(chunked, h)
+        else:
+            h = _pipeline_trunk(params["stages"], h, block, pp)
         h = h.reshape(b_loc, s_loc, -1)
         h = _layer_norm(h, params["lnf_g"], params["lnf_b"])
         # tied head against the LOCAL vocab shard: [b, s, V/tp] is the
@@ -333,14 +382,17 @@ def make_hybrid_grad_fn(cfg, mesh, num_microbatches=2):
 
 
 def make_hybrid_train_step(cfg, mesh, lr=1e-3, num_microbatches=2,
-                           schedule="1f1b"):
+                           schedule="1f1b", virtual_chunks=1):
     """SGD train step over the hybrid program; returns jitted
     step(params, ids, labels) -> (params, loss). Update is elementwise, so
     every param keeps its hybrid sharding (dp grad-sync fell out of the
     shard_map transpose — or, on the 1F1B path, explicit dp/sp psums).
 
     schedule: "1f1b" (explicit interleaved fwd/bwd pipeline, the flagship
-    default) or "gpipe" (scan+ppermute forward trunk, outer AD backward).
+    default), "gpipe" (scan+ppermute forward trunk, outer AD backward),
+    or "interleave" (virtual-stage folded ring, `virtual_chunks` chunks
+    per device, outer AD backward — init params with the matching
+    virtual_chunks layout).
     """
     if schedule == "1f1b":
         grad_fn = make_hybrid_grad_fn(cfg, mesh, num_microbatches)
@@ -351,8 +403,11 @@ def make_hybrid_train_step(cfg, mesh, lr=1e-3, num_microbatches=2,
             params = jax.tree_util.tree_map(lambda p, g: p - lr * g,
                                             params, grads)
             return params, loss
-    elif schedule == "gpipe":
-        loss_fn = make_hybrid_loss_fn(cfg, mesh, num_microbatches)
+    elif schedule in ("gpipe", "interleave"):
+        loss_fn = make_hybrid_loss_fn(
+            cfg, mesh, num_microbatches,
+            pipeline="interleave" if schedule == "interleave" else "gpipe",
+            virtual_chunks=virtual_chunks)
 
         @jax.jit
         def step(params, ids, labels):

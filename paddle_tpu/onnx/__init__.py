@@ -1,0 +1,3 @@
+"""paddle.onnx parity namespace (reference: python/paddle/onnx/export.py)."""
+from paddle_tpu.onnx.export import export  # noqa: F401
+from paddle_tpu.onnx import numpy_runtime  # noqa: F401

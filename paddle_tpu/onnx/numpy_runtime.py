@@ -1,0 +1,241 @@
+"""Pure-numpy evaluator for the ONNX subset the exporter emits.
+
+Lets exported models be executed and round-trip-verified with no
+onnxruntime dependency (this image has none). Covers exactly the ops
+export.py can produce; anything else raises.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.onnx import onnx_pb2 as pb
+
+_NP_OF = {
+    pb.TensorProto.FLOAT: np.float32,
+    pb.TensorProto.DOUBLE: np.float64,
+    pb.TensorProto.INT32: np.int32,
+    pb.TensorProto.INT64: np.int64,
+    pb.TensorProto.BOOL: np.bool_,
+    pb.TensorProto.INT8: np.int8,
+    pb.TensorProto.UINT8: np.uint8,
+    pb.TensorProto.FLOAT16: np.float16,
+}
+
+
+def tensor_to_np(t):
+    dt = _NP_OF[t.data_type]
+    if t.raw_data:
+        return np.frombuffer(t.raw_data, dt).reshape(list(t.dims)).copy()
+    if t.float_data:
+        return np.asarray(t.float_data, dt).reshape(list(t.dims))
+    if t.int64_data:
+        return np.asarray(t.int64_data, dt).reshape(list(t.dims))
+    if t.int32_data:
+        return np.asarray(t.int32_data, dt).reshape(list(t.dims))
+    return np.zeros(list(t.dims), dt)
+
+
+def _attrs(node):
+    out = {}
+    for a in node.attribute:
+        if a.type == pb.AttributeProto.INT:
+            out[a.name] = int(a.i)
+        elif a.type == pb.AttributeProto.FLOAT:
+            out[a.name] = float(a.f)
+        elif a.type == pb.AttributeProto.STRING:
+            out[a.name] = a.s.decode()
+        elif a.type == pb.AttributeProto.INTS:
+            out[a.name] = list(a.ints)
+    return out
+
+
+def _pool(x, ks, strides, pads, mode):
+    n, c, h, w = x.shape
+    ph0, pw0, ph1, pw1 = pads
+    fill = -np.inf if mode == "max" else 0.0
+    xp = np.full((n, c, h + ph0 + ph1, w + pw0 + pw1), fill, x.dtype)
+    xp[:, :, ph0:ph0 + h, pw0:pw0 + w] = x
+    kh, kw = ks
+    sh, sw = strides
+    oh = (xp.shape[2] - kh) // sh + 1
+    ow = (xp.shape[3] - kw) // sw + 1
+    out = np.empty((n, c, oh, ow), x.dtype)
+    for i in range(oh):
+        for j in range(ow):
+            win = xp[:, :, i * sh:i * sh + kh, j * sw:j * sw + kw]
+            out[:, :, i, j] = win.max((2, 3)) if mode == "max" \
+                else win.mean((2, 3))
+    return out
+
+
+def _conv(x, w, b, attrs):
+    group = attrs.get("group", 1)
+    strides = attrs.get("strides", [1, 1])
+    dil = attrs.get("dilations", [1, 1])
+    pads = attrs.get("pads", [0, 0, 0, 0])
+    n, cin, h, wdt = x.shape
+    cout, cpg, kh, kw = w.shape
+    xp = np.zeros((n, cin, h + pads[0] + pads[2], wdt + pads[1] + pads[3]),
+                  x.dtype)
+    xp[:, :, pads[0]:pads[0] + h, pads[1]:pads[1] + wdt] = x
+    oh = (xp.shape[2] - ((kh - 1) * dil[0] + 1)) // strides[0] + 1
+    ow = (xp.shape[3] - ((kw - 1) * dil[1] + 1)) // strides[1] + 1
+    out = np.zeros((n, cout, oh, ow), np.float32)
+    opg = cout // group
+    for g in range(group):
+        xg = xp[:, g * cpg:(g + 1) * cpg]
+        wg = w[g * opg:(g + 1) * opg]
+        for i in range(oh):
+            for j in range(ow):
+                hi = i * strides[0]
+                wj = j * strides[1]
+                win = xg[:, :, hi:hi + (kh - 1) * dil[0] + 1:dil[0],
+                         wj:wj + (kw - 1) * dil[1] + 1:dil[1]]
+                out[:, g * opg:(g + 1) * opg, i, j] = np.einsum(
+                    "nchw,ochw->no", win, wg)
+    if b is not None:
+        out = out + b[None, :, None, None]
+    return out.astype(x.dtype)
+
+
+def run(model_bytes_or_path, inputs):
+    """Execute an exported model. inputs: list of np arrays (positional,
+    matching graph inputs). Returns list of outputs."""
+    import os
+    if isinstance(model_bytes_or_path, (str, os.PathLike)):
+        data = open(model_bytes_or_path, "rb").read()
+    else:
+        data = model_bytes_or_path
+    model = pb.ModelProto()
+    model.ParseFromString(data)
+    g = model.graph
+    env = {t.name: tensor_to_np(t) for t in g.initializer}
+    for vi, arr in zip(g.input, inputs):
+        env[vi.name] = np.asarray(arr)
+
+    for node in g.node:
+        a = _attrs(node)
+        x = [env[i] for i in node.input]
+        op = node.op_type
+        if op == "Identity":
+            y = x[0]
+        elif op == "Add":
+            y = x[0] + x[1]
+        elif op == "Sub":
+            y = x[0] - x[1]
+        elif op == "Mul":
+            y = x[0] * x[1]
+        elif op == "Div":
+            if np.issubdtype(x[0].dtype, np.integer) and \
+                    np.issubdtype(x[1].dtype, np.integer):
+                # ONNX integer Div truncates toward zero (C semantics)
+                y = np.trunc(x[0] / x[1]).astype(x[0].dtype)
+            else:
+                y = x[0] / x[1]
+        elif op == "Pow":
+            y = x[0] ** x[1]
+        elif op == "Neg":
+            y = -x[0]
+        elif op == "Max":
+            y = np.maximum(x[0], x[1])
+        elif op == "Min":
+            y = np.minimum(x[0], x[1])
+        elif op == "Exp":
+            y = np.exp(x[0])
+        elif op == "Log":
+            y = np.log(x[0])
+        elif op == "Tanh":
+            y = np.tanh(x[0])
+        elif op == "Sin":
+            y = np.sin(x[0])
+        elif op == "Cos":
+            y = np.cos(x[0])
+        elif op == "Sqrt":
+            y = np.sqrt(x[0])
+        elif op == "Reciprocal":
+            y = 1.0 / x[0]
+        elif op == "Abs":
+            y = np.abs(x[0])
+        elif op == "Sign":
+            y = np.sign(x[0])
+        elif op == "Floor":
+            y = np.floor(x[0])
+        elif op == "Ceil":
+            y = np.ceil(x[0])
+        elif op == "Sigmoid":
+            y = 1.0 / (1.0 + np.exp(-x[0]))
+        elif op == "Erf":
+            from math import erf
+            y = np.vectorize(erf)(x[0]).astype(x[0].dtype)
+        elif op == "Equal":
+            y = x[0] == x[1]
+        elif op == "Less":
+            y = x[0] < x[1]
+        elif op == "LessOrEqual":
+            y = x[0] <= x[1]
+        elif op == "Greater":
+            y = x[0] > x[1]
+        elif op == "GreaterOrEqual":
+            y = x[0] >= x[1]
+        elif op == "And":
+            y = np.logical_and(x[0], x[1])
+        elif op == "Or":
+            y = np.logical_or(x[0], x[1])
+        elif op == "Not":
+            y = np.logical_not(x[0])
+        elif op == "Where":
+            y = np.where(x[0], x[1], x[2])
+        elif op == "Einsum":
+            y = np.einsum(a["equation"], *x)
+        elif op == "Conv":
+            y = _conv(x[0], x[1], x[2] if len(x) > 2 else None, a)
+        elif op == "MaxPool":
+            y = _pool(x[0], a["kernel_shape"], a["strides"],
+                      a.get("pads", [0, 0, 0, 0]), "max")
+        elif op == "AveragePool":
+            y = _pool(x[0], a["kernel_shape"], a["strides"],
+                      a.get("pads", [0, 0, 0, 0]), "avg")
+        elif op == "ReduceSum":
+            y = x[0].sum(tuple(a["axes"]),
+                         keepdims=bool(a.get("keepdims", 1)))
+        elif op == "ReduceMax":
+            y = x[0].max(tuple(a["axes"]),
+                         keepdims=bool(a.get("keepdims", 1)))
+        elif op == "ReduceMin":
+            y = x[0].min(tuple(a["axes"]),
+                         keepdims=bool(a.get("keepdims", 1)))
+        elif op == "Reshape":
+            y = x[0].reshape([int(d) for d in x[1]])
+        elif op == "Expand":
+            y = np.broadcast_to(x[0], [int(d) for d in x[1]]).copy()
+        elif op == "Transpose":
+            y = np.transpose(x[0], a["perm"])
+        elif op == "Concat":
+            y = np.concatenate(x, axis=a["axis"])
+        elif op == "Slice":
+            starts, ends, axes, steps = (x[1], x[2], x[3], x[4])
+            sl = [slice(None)] * x[0].ndim
+            for s, e, ax, st in zip(starts, ends, axes, steps):
+                sl[int(ax)] = slice(int(s), int(e), int(st))
+            y = x[0][tuple(sl)]
+        elif op == "Cast":
+            y = x[0].astype(_NP_OF[a["to"]])
+        elif op == "Gather":
+            y = np.take(x[0], x[1].astype(np.int64), axis=a.get("axis", 0))
+        elif op == "ArgMax":
+            y = np.argmax(x[0], axis=a["axis"])
+            if not a.get("keepdims", 1):
+                pass
+            else:
+                y = np.expand_dims(y, a["axis"])
+        elif op == "Pad":
+            pads = x[1]
+            nd = x[0].ndim
+            widths = [(int(pads[i]), int(pads[i + nd])) for i in range(nd)]
+            cval = x[2] if len(x) > 2 else 0
+            y = np.pad(x[0], widths, constant_values=np.asarray(cval))
+        else:
+            raise NotImplementedError(f"numpy_runtime: op {op}")
+        env[node.output[0]] = y
+
+    return [env[vi.name] for vi in g.output]

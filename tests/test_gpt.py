@@ -125,6 +125,7 @@ class TestGraftEntry:
         out = jax.jit(fn)(params, ids)
         assert out.shape == (2, 64, 512)
 
+    @pytest.mark.nightly  # the driver runs this entry directly each round
     def test_dryrun_multichip(self):
         import os
         import sys

@@ -117,8 +117,10 @@ class TestRoiOps:
         bids = np.array([0, 0, 1])
         return x, boxes, boxes_num, bids
 
-    @pytest.mark.parametrize("ratio,aligned", [(2, True), (2, False),
-                                               (-1, True)])
+    @pytest.mark.parametrize("ratio,aligned", [
+        (2, True),
+        pytest.param(2, False, marks=pytest.mark.nightly),
+        (-1, True)])
     def test_roi_align(self, ratio, aligned):
         x, boxes, boxes_num, bids = self._data()
         got = V.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
@@ -164,6 +166,7 @@ class TestRoiOps:
         want = _roi_pool_oracle(x, boxes, bids, (4, 4), 0.5)
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.nightly  # thin wrappers; the functional ops are tested
     def test_layers(self):
         x, boxes, boxes_num, _ = self._data()
         t = (paddle.to_tensor(x), paddle.to_tensor(boxes),
@@ -254,6 +257,7 @@ class TestDeformConv:
         want = shifted.sum(1, keepdims=True).repeat(2, 1)
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.nightly
     def test_mask_and_layer(self):
         rng = np.random.default_rng(6)
         x = paddle.to_tensor(
