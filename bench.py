@@ -55,7 +55,11 @@ def _bench_resnet50(on_tpu):
         batch, warmup, iters = 8, 1, 2  # degraded-signal fallback, <3 min
 
     P.seed(0)
-    model = resnet50(num_classes=1000)
+    # NHWC (r3, VERDICT #2): profiling the r2 bench showed the forward
+    # dominated by per-channel BN statistics reductions — in NCHW those
+    # reduce across the lane dimension; channels-last keeps C on lanes
+    # and is the layout XLA prefers for MXU convs.
+    model = resnet50(num_classes=1000, data_format="NHWC")
     opt = P.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
                                parameters=model.parameters())
 
@@ -71,7 +75,7 @@ def _bench_resnet50(on_tpu):
 
     rng = np.random.default_rng(0)
     x = P.to_tensor(
-        rng.standard_normal((batch, 3, 224, 224)).astype(np.float32))
+        rng.standard_normal((batch, 224, 224, 3)).astype(np.float32))
     y = P.to_tensor(rng.integers(0, 1000, (batch,)), dtype="int64")
 
     for _ in range(warmup):

@@ -73,6 +73,7 @@ from paddle_tpu import (  # noqa: F401,E402
     nn,
     optimizer,
     profiler,
+    quantization,
     signal,
     static,
     sparse,

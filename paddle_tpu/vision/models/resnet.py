@@ -1,8 +1,11 @@
 """ResNet family. Reference: python/paddle/vision/models/resnet.py.
 
-The bench flagship for vision: NCHW API surface; XLA lays out the convs for
-the MXU. BasicBlock (18/34) + BottleneckBlock (50/101/152) + wide/resnext
-variants, matching the reference's constructors.
+The bench flagship for vision: NCHW API surface (reference default) with
+data_format="NHWC" supported end to end — on TPU, channels-last keeps the
+per-channel BN reductions on the lane dimension and is the layout XLA
+prefers for the MXU convs (the bench trains NHWC). BasicBlock (18/34) +
+BottleneckBlock (50/101/152) + wide/resnext variants, matching the
+reference's constructors (which also expose data_format).
 """
 from __future__ import annotations
 
@@ -13,15 +16,21 @@ class BasicBlock(nn.Layer):
     expansion = 1
 
     def __init__(self, inplanes, planes, stride=1, downsample=None, groups=1,
-                 base_width=64, dilation=1, norm_layer=None):
+                 base_width=64, dilation=1, norm_layer=None,
+                 data_format="NCHW"):
         super().__init__()
         norm_layer = norm_layer or nn.BatchNorm2D
+        df = dict(data_format=data_format)
+        # custom norm factories may not take data_format; only the
+        # non-default layout requires it
+        ndf = df if data_format != "NCHW" else {}
         self.conv1 = nn.Conv2D(inplanes, planes, 3, padding=1, stride=stride,
-                               bias_attr=False)
-        self.bn1 = norm_layer(planes)
+                               bias_attr=False, **df)
+        self.bn1 = norm_layer(planes, **ndf)
         self.relu = nn.ReLU()
-        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False)
-        self.bn2 = norm_layer(planes)
+        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False,
+                               **df)
+        self.bn2 = norm_layer(planes, **ndf)
         self.downsample = downsample
         self.stride = stride
 
@@ -38,19 +47,22 @@ class BottleneckBlock(nn.Layer):
     expansion = 4
 
     def __init__(self, inplanes, planes, stride=1, downsample=None, groups=1,
-                 base_width=64, dilation=1, norm_layer=None):
+                 base_width=64, dilation=1, norm_layer=None,
+                 data_format="NCHW"):
         super().__init__()
         norm_layer = norm_layer or nn.BatchNorm2D
+        df = dict(data_format=data_format)
+        ndf = df if data_format != "NCHW" else {}
         width = int(planes * (base_width / 64.0)) * groups
-        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False)
-        self.bn1 = norm_layer(width)
+        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False, **df)
+        self.bn1 = norm_layer(width, **ndf)
         self.conv2 = nn.Conv2D(width, width, 3, padding=dilation,
                                stride=stride, groups=groups,
-                               dilation=dilation, bias_attr=False)
-        self.bn2 = norm_layer(width)
+                               dilation=dilation, bias_attr=False, **df)
+        self.bn2 = norm_layer(width, **ndf)
         self.conv3 = nn.Conv2D(width, planes * self.expansion, 1,
-                               bias_attr=False)
-        self.bn3 = norm_layer(planes * self.expansion)
+                               bias_attr=False, **df)
+        self.bn3 = norm_layer(planes * self.expansion, **ndf)
         self.relu = nn.ReLU()
         self.downsample = downsample
         self.stride = stride
@@ -67,8 +79,11 @@ class BottleneckBlock(nn.Layer):
 
 class ResNet(nn.Layer):
     def __init__(self, block, depth=50, width=64, num_classes=1000,
-                 with_pool=True, groups=1):
+                 with_pool=True, groups=1, data_format="NCHW"):
         super().__init__()
+        self.data_format = data_format
+        df = dict(data_format=data_format)
+        ndf = df if data_format != "NCHW" else {}
         layer_cfg = {
             18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
             101: [3, 4, 23, 3], 152: [3, 8, 36, 3],
@@ -82,35 +97,39 @@ class ResNet(nn.Layer):
         self.inplanes = 64
         self.dilation = 1
         self.conv1 = nn.Conv2D(3, self.inplanes, 7, stride=2, padding=3,
-                               bias_attr=False)
-        self.bn1 = self._norm_layer(self.inplanes)
+                               bias_attr=False, **df)
+        self.bn1 = self._norm_layer(self.inplanes, **ndf)
         self.relu = nn.ReLU()
-        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1, **df)
         self.layer1 = self._make_layer(block, 64, layers[0])
         self.layer2 = self._make_layer(block, 128, layers[1], stride=2)
         self.layer3 = self._make_layer(block, 256, layers[2], stride=2)
         self.layer4 = self._make_layer(block, 512, layers[3], stride=2)
         if with_pool:
-            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1), **df)
         if num_classes > 0:
             self.fc = nn.Linear(512 * block.expansion, num_classes)
 
     def _make_layer(self, block, planes, blocks, stride=1, dilate=False):
         norm_layer = self._norm_layer
         downsample = None
+        df = dict(data_format=self.data_format)
+        ndf = df if self.data_format != "NCHW" else {}
         if stride != 1 or self.inplanes != planes * block.expansion:
             downsample = nn.Sequential(
                 nn.Conv2D(self.inplanes, planes * block.expansion, 1,
-                          stride=stride, bias_attr=False),
-                norm_layer(planes * block.expansion),
+                          stride=stride, bias_attr=False, **df),
+                norm_layer(planes * block.expansion, **ndf),
             )
         layers = [block(self.inplanes, planes, stride, downsample, self.groups,
-                        self.base_width, self.dilation, norm_layer)]
+                        self.base_width, self.dilation, norm_layer,
+                        data_format=self.data_format)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
             layers.append(block(self.inplanes, planes, groups=self.groups,
                                 base_width=self.base_width,
-                                norm_layer=norm_layer))
+                                norm_layer=norm_layer,
+                                data_format=self.data_format))
         return nn.Sequential(*layers)
 
     def forward(self, x):

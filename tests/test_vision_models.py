@@ -87,3 +87,18 @@ def test_no_classifier_head():
     model.eval()
     out = model(_x(size=32))
     assert tuple(out.shape) == (1, 512, 1, 1)
+
+
+def test_resnet_nhwc_matches_nchw():
+    """data_format="NHWC" (the TPU bench layout) computes the same
+    function as the NCHW default."""
+    paddle_tpu.seed(0)
+    m1 = models.resnet18(num_classes=4)
+    paddle_tpu.seed(0)
+    m2 = models.resnet18(num_classes=4, data_format="NHWC")
+    m1.eval()
+    m2.eval()
+    x = np.random.RandomState(0).randn(2, 3, 32, 32).astype(np.float32)
+    y1 = m1(paddle_tpu.to_tensor(x)).numpy()
+    y2 = m2(paddle_tpu.to_tensor(x.transpose(0, 2, 3, 1))).numpy()
+    np.testing.assert_allclose(y1, y2, atol=2e-4, rtol=1e-4)
