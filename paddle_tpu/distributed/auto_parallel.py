@@ -126,6 +126,7 @@ def shard_tensor(x, process_mesh=None, shard_spec=None, mesh=None,
     (process_mesh, shard_spec) form and the dtensor (mesh, placements)
     form; installs the ProcessMesh globally if none is active."""
     pm = process_mesh if process_mesh is not None else mesh
+    explicit = pm is not None
     if isinstance(pm, ProcessMesh):
         jmesh = pm.to_jax()
     elif isinstance(pm, Mesh):
@@ -139,7 +140,20 @@ def shard_tensor(x, process_mesh=None, shard_spec=None, mesh=None,
         return dmesh.shard_tensor(x)
     nd = len(x.shape)
     names = list(jmesh.axis_names) if jmesh is not None else []
-    return dmesh.shard_tensor(x, *_entries_from(entries, nd, names))
+    norm = _entries_from(entries, nd, names)
+    if explicit and jmesh is not None and jmesh is not dmesh.get_mesh():
+        # the user named a SPECIFIC mesh (possibly a device subset) that
+        # differs from the installed global one: place directly on it —
+        # routing through the global mesh would silently degrade any axis
+        # it doesn't know to replicated
+        spec = PartitionSpec(*norm)
+        val = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        out = jax.device_put(val, NamedSharding(jmesh, spec))
+        t = x if isinstance(x, Tensor) else Tensor(out)
+        t._value = out
+        t.__dict__["dist_spec"] = spec
+        return t
+    return dmesh.shard_tensor(x, *norm)
 
 
 def reshard(x, mesh=None, placements=None, process_mesh=None,
@@ -160,6 +174,9 @@ def reshard(x, mesh=None, placements=None, process_mesh=None,
     out = jax.device_put(val, NamedSharding(jmesh, spec))
     if isinstance(x, Tensor):
         x._value = out
+        # refresh the annotation shard_tensor left, or to_static's state
+        # lift would re-apply the PRE-reshard placement
+        x.__dict__["dist_spec"] = spec
         return x
     return Tensor(out)
 

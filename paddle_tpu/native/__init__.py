@@ -69,6 +69,12 @@ def _load():
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p)]
         lib.ptdata_loader_reset.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.ptdata_loader_destroy.argtypes = [ctypes.c_void_p]
+        lib.ptdata_augment_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+            ctypes.c_uint64, ctypes.c_int]
         _lib = lib
         return _lib
 
@@ -118,6 +124,57 @@ def gather_rows(src, indices, nthreads=None):
                       indices.ctypes.data_as(ctypes.c_void_p), len(indices),
                       out.ctypes.data_as(ctypes.c_void_p), nthreads)
     return out
+
+
+def augment_batch(images, out_size, pad=0, random_crop=False,
+                  random_flip=False, mean=0.0, std=1.0, to_chw=True,
+                  seed=0, nthreads=None):
+    """Fused native augmentation: zero-pad -> (random|center) crop ->
+    random hflip -> /255 -> normalize -> float32 CHW/HWC, threaded over
+    the batch with no GIL. images: uint8 [N, H, W, C]. Falls back to a
+    numpy implementation when the native library is unavailable."""
+    images = np.ascontiguousarray(images, dtype=np.uint8)
+    n, h, w, c = images.shape
+    oh, ow = (out_size, out_size) if isinstance(out_size, int) else out_size
+    mean = np.ascontiguousarray(mean, np.float32).reshape(-1)
+    std = np.ascontiguousarray(std, np.float32).reshape(-1)
+    if mean.size == 1:
+        mean = np.repeat(mean, c)
+    if std.size == 1:
+        std = np.repeat(std, c)
+    if mean.size != c or std.size != c:
+        raise ValueError(
+            f"mean/std must have {c} entries (or 1), got "
+            f"{mean.size}/{std.size}")
+    lib = _load()
+    if lib is not None:
+        shape = (n, c, oh, ow) if to_chw else (n, oh, ow, c)
+        out = np.empty(shape, np.float32)
+        nthreads = nthreads or min(8, os.cpu_count() or 1)
+        lib.ptdata_augment_batch(
+            images.ctypes.data_as(ctypes.c_void_p), n, h, w, c,
+            out.ctypes.data_as(ctypes.c_void_p), oh, ow, int(pad),
+            int(bool(random_crop)), int(bool(random_flip)),
+            mean.ctypes.data_as(ctypes.c_void_p),
+            std.ctypes.data_as(ctypes.c_void_p), int(bool(to_chw)),
+            ctypes.c_uint64(seed), nthreads)
+        return out
+    # numpy fallback: same semantics (incl. randomness), python-speed
+    rng = np.random.default_rng(seed)
+    padded = np.zeros((n, h + 2 * pad, w + 2 * pad, c), np.float32)
+    padded[:, pad:pad + h, pad:pad + w] = images
+    max_y = max(h + 2 * pad - oh, 0)
+    max_x = max(w + 2 * pad - ow, 0)
+    out = np.empty((n, oh, ow, c), np.float32)
+    for i in range(n):
+        oy = int(rng.integers(0, max_y + 1)) if random_crop else max_y // 2
+        ox = int(rng.integers(0, max_x + 1)) if random_crop else max_x // 2
+        crop = padded[i, oy:oy + oh, ox:ox + ow]
+        if random_flip and rng.integers(0, 2):
+            crop = crop[:, ::-1]
+        out[i] = crop
+    outv = (out / 255.0 - mean) / std
+    return outv.transpose(0, 3, 1, 2).copy() if to_chw else outv
 
 
 class NativeLoader:

@@ -258,4 +258,79 @@ void ptdata_loader_destroy(void* h) {
   delete L;
 }
 
+
+// ---------------------------------------------------------------------------
+// Fused image augmentation: zero-pad -> random crop -> random hflip ->
+// normalize (per-channel mean/std) -> float32 HWC or CHW, threaded over the
+// batch. Reference parity: the per-sample Python transform chain
+// (python/paddle/vision/transforms RandomCrop+RandomHorizontalFlip+
+// Normalize+ToTensor) that the reference runs inside C++-backed DataLoader
+// worker processes; here it is one GIL-free pass per batch.
+// ---------------------------------------------------------------------------
+static void augment_range(const uint8_t* src, int64_t h, int64_t w,
+                          int64_t c, float* dst, int64_t out_h,
+                          int64_t out_w, int pad, int random_crop,
+                          int random_flip, const float* mean,
+                          const float* stdev, int to_chw, uint64_t seed,
+                          int64_t lo, int64_t hi) {
+  const int64_t in_img = h * w * c;
+  const int64_t out_img = out_h * out_w * c;
+  for (int64_t i = lo; i < hi; ++i) {
+    uint64_t st = seed + 0x9e3779b97f4a7c15ULL * (uint64_t)(i + 1);
+    int64_t max_y = h + 2 * pad - out_h;
+    int64_t max_x = w + 2 * pad - out_w;
+    int64_t off_y = 0, off_x = 0;
+    if (random_crop && max_y >= 0 && max_x >= 0) {
+      off_y = (int64_t)(splitmix64(&st) % (uint64_t)(max_y + 1));
+      off_x = (int64_t)(splitmix64(&st) % (uint64_t)(max_x + 1));
+    } else {
+      off_y = max_y > 0 ? max_y / 2 : 0;   // center crop fallback
+      off_x = max_x > 0 ? max_x / 2 : 0;
+    }
+    int flip = random_flip && (splitmix64(&st) & 1);
+    const uint8_t* img = src + i * in_img;
+    float* out = dst + i * out_img;
+    for (int64_t y = 0; y < out_h; ++y) {
+      int64_t sy = y + off_y - pad;               // padded-space -> source
+      for (int64_t x = 0; x < out_w; ++x) {
+        int64_t ox = flip ? (out_w - 1 - x) : x;
+        int64_t sx = x + off_x - pad;
+        for (int64_t ch = 0; ch < c; ++ch) {
+          float v = 0.0f;                          // zero padding
+          if (sy >= 0 && sy < h && sx >= 0 && sx < w)
+            v = (float)img[(sy * w + sx) * c + ch];
+          v = (v / 255.0f - mean[ch]) / stdev[ch];
+          if (to_chw)
+            out[ch * out_h * out_w + y * out_w + ox] = v;
+          else
+            out[(y * out_w + ox) * c + ch] = v;
+        }
+      }
+    }
+  }
+}
+
+void ptdata_augment_batch(const uint8_t* src, int64_t n, int64_t h,
+                          int64_t w, int64_t c, float* dst, int64_t out_h,
+                          int64_t out_w, int pad, int random_crop,
+                          int random_flip, const float* mean,
+                          const float* stdev, int to_chw, uint64_t seed,
+                          int nthreads) {
+  if (nthreads <= 1 || n < nthreads * 2) {
+    augment_range(src, h, w, c, dst, out_h, out_w, pad, random_crop,
+                  random_flip, mean, stdev, to_chw, seed, 0, n);
+    return;
+  }
+  std::vector<std::thread> ts;
+  int64_t chunk = (n + nthreads - 1) / nthreads;
+  for (int t = 0; t < nthreads; ++t) {
+    int64_t lo = t * chunk, hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    ts.emplace_back(augment_range, src, h, w, c, dst, out_h, out_w, pad,
+                    random_crop, random_flip, mean, stdev, to_chw, seed,
+                    lo, hi);
+  }
+  for (auto& t : ts) t.join();
+}
+
 }  // extern "C"

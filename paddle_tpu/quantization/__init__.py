@@ -257,10 +257,11 @@ class _ObservedLayer(Layer):
         self.inner = inner
         self.act_obs = copy.deepcopy(cfg.activation_quantizer)
         self.wt_obs = copy.deepcopy(cfg.weight_quantizer)
+        # weights are frozen during PTQ calibration: one sample suffices
+        self.wt_obs.sample(inner.weight._value)
 
     def forward(self, x):
         self.act_obs.sample(x._value)
-        self.wt_obs.sample(self.inner.weight._value)
         return self.inner(x)
 
 

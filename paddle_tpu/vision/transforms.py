@@ -231,3 +231,48 @@ def center_crop(img, output_size):
 
 def crop(img, top, left, height, width):
     return _to_hwc(img)[top:top + height, left:left + width]
+
+
+class FusedImageAugment:
+    """Batch-level fused augmentation on the native C++ pipeline
+    (paddle_tpu/native ptdata_augment_batch): zero-pad -> random crop ->
+    random hflip -> /255 -> normalize -> float32 CHW/HWC in ONE GIL-free
+    threaded pass. The per-sample transform chain (RandomCrop +
+    RandomHorizontalFlip + Normalize + ToTensor) costs a Python call per
+    image per stage; this is the whole chain per BATCH. Training-style
+    randomness is deterministic per (seed, epoch, sample index).
+
+    Apply to uint8 [N, H, W, C] batches (e.g. as DataLoader batch-level
+    preprocessing before host->device transfer).
+    """
+
+    def __init__(self, size, pad=0, random_crop=True, random_flip=True,
+                 mean=0.0, std=1.0, data_format="CHW", seed=0):
+        self.size = size
+        self.pad = pad
+        self.random_crop = random_crop
+        self.random_flip = random_flip
+        self.mean = mean
+        self.std = std
+        self.to_chw = data_format.upper() == "CHW"
+        self.seed = seed
+        self._epoch = 0
+        self._batch = 0
+
+    def set_epoch(self, epoch):
+        self._epoch = int(epoch)
+        self._batch = 0
+
+    def __call__(self, batch):
+        from paddle_tpu import native
+        import numpy as _np
+        arr = _np.asarray(batch)
+        # fold (seed, epoch, batch counter) so every batch draws a fresh
+        # stream — without the counter each epoch would reuse the same
+        # batch_size augmentations for every batch
+        mix = (self.seed * 1000003 + self._epoch) * 2654435761             + self._batch
+        self._batch += 1
+        return native.augment_batch(
+            arr, self.size, pad=self.pad, random_crop=self.random_crop,
+            random_flip=self.random_flip, mean=self.mean, std=self.std,
+            to_chw=self.to_chw, seed=mix & 0xFFFFFFFFFFFF)

@@ -124,3 +124,48 @@ def test_native_loader_restarts_after_early_break():
 def test_shufflenet_act_none_constructible():
     from paddle_tpu.vision.models import ShuffleNetV2
     ShuffleNetV2(scale=0.25, act=None, num_classes=4)
+
+
+class TestNativeAugment:
+    def test_normalize_only_exact(self):
+        from paddle_tpu import native
+        if not native.available():
+            pytest.skip("native lib unavailable")
+        rng = np.random.default_rng(0)
+        imgs = rng.integers(0, 256, (4, 8, 8, 3)).astype(np.uint8)
+        mean, std = (0.4, 0.5, 0.6), (0.2, 0.25, 0.3)
+        out = native.augment_batch(imgs, (8, 8), mean=mean, std=std,
+                                   to_chw=True)
+        want = ((imgs / 255.0 - mean) / std).transpose(0, 3, 1, 2)
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+        assert out.dtype == np.float32
+
+    def test_center_crop_and_hwc(self):
+        from paddle_tpu import native
+        if not native.available():
+            pytest.skip("native lib unavailable")
+        imgs = np.arange(4 * 6 * 6 * 1, dtype=np.uint8).reshape(4, 6, 6, 1)
+        out = native.augment_batch(imgs, (4, 4), to_chw=False)
+        want = imgs[:, 1:5, 1:5].astype(np.float32) / 255.0
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+    def test_random_crop_flip_deterministic_and_valid(self):
+        from paddle_tpu import native
+        if not native.available():
+            pytest.skip("native lib unavailable")
+        rng = np.random.default_rng(1)
+        imgs = rng.integers(0, 256, (16, 10, 10, 3)).astype(np.uint8)
+        a = native.augment_batch(imgs, (8, 8), pad=2, random_crop=True,
+                                 random_flip=True, seed=7)
+        b = native.augment_batch(imgs, (8, 8), pad=2, random_crop=True,
+                                 random_flip=True, seed=7)
+        np.testing.assert_array_equal(a, b)          # same seed -> same
+        c = native.augment_batch(imgs, (8, 8), pad=2, random_crop=True,
+                                 random_flip=True, seed=8)
+        assert not np.array_equal(a, c)              # new seed -> differs
+        # every non-padding output pixel must appear in the source image
+        img_vals = np.unique(imgs[0].astype(np.float32) / 255.0)
+        out0 = a[0].transpose(1, 2, 0).reshape(-1)
+        nonpad = out0[np.abs(out0) > 1e-9][:64]
+        dist = np.abs(nonpad[:, None] - img_vals[None, :]).min(axis=1)
+        assert float(dist.max()) < 1e-6
