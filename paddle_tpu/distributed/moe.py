@@ -1,0 +1,309 @@
+"""Mixture-of-Experts with expert parallelism — TPU-native.
+
+Reference parity:
+  python/paddle/incubate/distributed/models/moe/moe_layer.py (MoELayer),
+  .../moe/gate/{naive_gate,gshard_gate,switch_gate}.py,
+  python/paddle/distributed/utils/moe_utils.py (global_scatter/global_gather).
+
+The reference is FastMoE-style: data-dependent scatter of tokens into
+per-expert buffers, NCCL all-to-all of ragged counts, per-expert Linear
+loops.  None of that maps to XLA: data-dependent shapes don't compile, and
+ragged buffers defeat the MXU.  The TPU-native design is the GShard/Switch
+formulation: every routing decision becomes a STATIC-shape one-hot
+``dispatch`` mask [tokens, experts, capacity] and a differentiable
+``combine`` tensor of gate weights; dispatch/combine are einsums (MXU
+work), tokens over capacity are dropped (the residual connection carries
+them), and expert parallelism is a sharding annotation on the expert axis
+of the [E, C, d] dispatched activations — XLA's partitioner inserts the
+same all-to-all the reference issues by hand through NCCL.
+"""
+from __future__ import annotations
+
+import math
+
+import paddle_tpu
+from paddle_tpu import nn
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.distributed.fleet.meta_parallel import _constrain
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+
+__all__ = [
+    "BaseGate", "NaiveGate", "GShardGate", "SwitchGate",
+    "MoELayer", "StackedExpertFFN", "dispatch_combine",
+]
+
+
+def _capacity(num_tokens, num_experts, top_k, capacity_factor):
+    """GShard per-expert capacity: each expert can take its fair share of
+    the top_k routed tokens, scaled by the capacity factor."""
+    return max(1, math.ceil(capacity_factor * num_tokens * top_k
+                            / num_experts))
+
+
+def dispatch_combine(probs, top_k, capacity, keep_last=None):
+    """Static-shape GShard routing tensors from router probabilities.
+
+    probs: [n, E] router probabilities (post-softmax, differentiable).
+    keep_last: optional [n] 0/1 mask gating each token's LAST (lowest-
+    priority) expert choice — the hook for GShard's stochastic
+    second-expert routing.
+    Returns (combine [n, E, C], dispatch [n, E, C]) where dispatch is the
+    0/1 routing mask (top_k choices, position-in-expert < capacity, GShard
+    priority: all top-1 picks claim capacity before any top-2 pick) and
+    combine carries the gate weights at the same positions.  Both are
+    differentiable in `probs` through the top-k gate values.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def fn(p, *rest):
+        n, e = p.shape
+        kl = rest[0] if rest else None
+        vals, idx = jax.lax.top_k(p, top_k)            # [n, K]
+        onehot = jax.nn.one_hot(idx, e, dtype=p.dtype)  # [n, K, E]
+        if kl is not None:
+            onehot = onehot.at[:, top_k - 1, :].multiply(
+                kl.astype(p.dtype)[:, None])
+        # rank of each token within its chosen expert; top-1 column fills
+        # before top-2 (GShard §3.2) so the primary route wins capacity
+        offset = jnp.zeros((e,), p.dtype)
+        keep_k, pos_k = [], []
+        for k in range(top_k):
+            mk = onehot[:, k, :]                        # [n, E]
+            pos = jnp.cumsum(mk, axis=0) - mk + offset  # [n, E]
+            offset = offset + mk.sum(axis=0)
+            keep_k.append(mk * (pos < capacity))
+            pos_k.append(pos)
+        keep = jnp.stack(keep_k, 1)                     # [n, K, E]
+        pos = jnp.stack(pos_k, 1)                       # [n, K, E]
+        slot = jax.nn.one_hot(
+            jnp.clip(pos, 0, capacity - 1).astype(jnp.int32), capacity,
+            dtype=p.dtype)                              # [n, K, E, C]
+        disp_k = keep[..., None] * slot                 # [n, K, E, C]
+        dispatch = disp_k.sum(axis=1)
+        combine = (vals[:, :, None, None] * disp_k).sum(axis=1)
+        return combine, dispatch
+
+    if keep_last is not None:
+        return apply(fn, probs, keep_last)
+    return apply(fn, probs)
+
+
+class BaseGate(nn.Layer):
+    """Reference-API base: gates stash their auxiliary (load-balancing)
+    loss; the training loop reads it via get_loss() and adds it to the
+    task loss (reference moe/gate/base_gate.py)."""
+
+    def __init__(self, num_expert, world_size=1):
+        super().__init__()
+        self.world_size = world_size
+        self.num_expert = num_expert
+        self.tot_expert = num_expert * world_size
+        self.loss = None
+
+    def set_loss(self, loss):
+        self.loss = loss
+
+    def get_loss(self, clear=True):
+        loss = self.loss
+        if clear:
+            self.loss = None
+        return loss
+
+
+class NaiveGate(BaseGate):
+    """Linear router + top-k softmax over the selected experts
+    (reference moe/gate/naive_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2):
+        super().__init__(num_expert, world_size)
+        self.top_k = topk
+        self.gate = nn.Linear(
+            d_model, self.tot_expert,
+            weight_attr=I.ParamAttr(initializer=I.Normal(0.0, 0.02)))
+
+    def scores(self, x):
+        """Full softmax router probabilities [n, E] (differentiable)."""
+        return F.softmax(self.gate(x), axis=-1)
+
+    def forward(self, x, return_all_scores=False):
+        logits = self.gate(x)
+        vals, idx = paddle_tpu.topk(logits, self.top_k, axis=-1)
+        vals = F.softmax(vals, axis=-1)
+        if return_all_scores:
+            return vals, idx, logits
+        return vals, idx
+
+
+class GShardGate(NaiveGate):
+    """Top-2 gate with the GShard load-balancing auxiliary loss
+    mean(c_e * m_e) * E^2 (reference moe/gate/gshard_gate.py) and optional
+    stochastic second-expert routing."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2,
+                 capacity=(1.2, 2.4), random_routing=True, group=None):
+        assert topk == 2, "topk should be 2 in gshard"
+        super().__init__(d_model, num_expert, world_size, topk=topk)
+        self.capacity_factor = capacity
+        self.random_routing = random_routing
+
+    def aux_loss(self, probs, top1_idx):
+        c_e = F.one_hot(top1_idx, self.tot_expert).mean(axis=0)
+        m_e = probs.mean(axis=0)
+        loss = (c_e * m_e).mean() * (self.tot_expert ** 2)
+        self.set_loss(loss)
+        return loss
+
+
+class SwitchGate(NaiveGate):
+    """Top-1 gate with multiplicative jitter noise in training and the
+    Switch-Transformer balance loss sum(f_e * p_e) * E
+    (reference moe/gate/switch_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=1,
+                 switch_eps=0.1, capacity=(1.2, 2.4), group=None):
+        assert topk == 1, "topk should be 1 in switch"
+        super().__init__(d_model, num_expert, world_size, topk=1)
+        self.switch_eps = switch_eps
+        self.capacity_factor = capacity
+
+    def scores(self, x):
+        logits = self.gate(x)
+        if self.training and self.switch_eps:
+            noise = paddle_tpu.rand(logits.shape, dtype="float32")
+            logits = logits * (
+                noise * (2 * self.switch_eps) + (1.0 - self.switch_eps))
+        return F.softmax(logits, axis=-1)
+
+    def aux_loss(self, probs, top1_idx):
+        f_e = F.one_hot(top1_idx, self.tot_expert).mean(axis=0)
+        p_e = probs.mean(axis=0)
+        loss = (f_e * p_e).sum() * self.tot_expert
+        self.set_loss(loss)
+        return loss
+
+
+class StackedExpertFFN(nn.Layer):
+    """All experts' FFN weights stacked on a leading expert axis so the
+    expert compute is ONE batched einsum over [E, C, d] — the MXU-friendly
+    replacement for the reference's Python loop over per-expert Linears.
+    Weights are annotated to shard over the `ep` mesh axis."""
+
+    def __init__(self, num_experts, d_model, d_hidden, ep_axis="ep",
+                 activation="gelu"):
+        super().__init__()
+        from paddle_tpu.distributed.mesh import shard_tensor
+        self.num_experts = num_experts
+        self.ep_axis = ep_axis
+        self.activation = activation
+        init = I.Normal(0.0, 0.02)
+        self.w1 = self.create_parameter([num_experts, d_model, d_hidden],
+                                        default_initializer=init)
+        self.b1 = self.create_parameter(
+            [num_experts, d_hidden], default_initializer=I.Constant(0.0))
+        self.w2 = self.create_parameter([num_experts, d_hidden, d_model],
+                                        default_initializer=init)
+        self.b2 = self.create_parameter(
+            [num_experts, d_model], default_initializer=I.Constant(0.0))
+        for w in (self.w1, self.b1, self.w2, self.b2):
+            shard_tensor(w, ep_axis)
+
+    def forward(self, x):
+        # x: [E, C, d] dispatched tokens, expert axis sharded over ep
+        h = paddle_tpu.einsum("ecd,edh->ech", x, self.w1) + self.b1.unsqueeze(1)
+        h = F.gelu(h, approximate=True) if self.activation == "gelu" \
+            else F.relu(h)
+        return paddle_tpu.einsum("ech,ehd->ecd", h, self.w2) \
+            + self.b2.unsqueeze(1)
+
+
+class MoELayer(nn.Layer):
+    """Mixture-of-experts layer (reference moe_layer.py MoELayer).
+
+    Args mirror the reference: `experts` is either a LayerList of
+    per-expert Layers ([C, d] -> [C, d]) or a StackedExpertFFN; `gate` a
+    dict config ({"type": "gshard"|"switch"|"naive", "top_k": k}) or a
+    BaseGate instance.  `moe_group`/`mp_group` become the `ep_axis` mesh
+    axis name — the reference's process groups are mesh axes here, and the
+    all-to-all the reference issues through NCCL (global_scatter /
+    global_gather) is inserted by the XLA partitioner from the sharding
+    constraint on the dispatched [E, C, d] activations.
+
+    Tokens routed beyond an expert's capacity contribute zero output (the
+    surrounding residual carries them) — identical semantics to the
+    reference's capacity-limited gates.
+    """
+
+    def __init__(self, d_model, experts, gate=None, moe_group=None,
+                 mp_group=None, ep_axis="ep", capacity_factor=(1.2, 2.4),
+                 recompute_interval=0, recompute_ctx=None):
+        super().__init__()
+        self.d_model = d_model
+        self.ep_axis = ep_axis if moe_group is None else moe_group
+        if isinstance(experts, StackedExpertFFN):
+            self.experts = experts
+            self.num_expert = experts.num_experts
+        else:
+            self.experts = nn.LayerList(list(experts))
+            self.num_expert = len(self.experts)
+
+        if gate is None or isinstance(gate, dict):
+            gate = dict(gate or {})
+            top_k = gate.get("top_k", 2)
+            kind = gate.get("type", "gshard")
+            if kind in (None, "naive"):
+                gate = NaiveGate(d_model, self.num_expert, topk=top_k)
+            elif kind == "gshard":
+                gate = GShardGate(d_model, self.num_expert, topk=top_k,
+                                  capacity=capacity_factor)
+            elif kind == "switch":
+                gate = SwitchGate(d_model, self.num_expert,
+                                  capacity=capacity_factor)
+            else:
+                raise ValueError(f"unknown gate type {kind!r}")
+        elif not isinstance(gate, BaseGate):
+            raise TypeError("gate must be a dict config or a BaseGate")
+        self.gate = gate
+        self.top_k = gate.top_k
+        self.capacity_factor = getattr(gate, "capacity_factor",
+                                       capacity_factor)
+
+    def _run_experts(self, xin):
+        if isinstance(self.experts, StackedExpertFFN):
+            return self.experts(xin)
+        outs = [self.experts[e](xin[e]) for e in range(self.num_expert)]
+        return paddle_tpu.stack(outs, axis=0)
+
+    def forward(self, x):
+        orig_shape = x.shape
+        n = 1
+        for s in orig_shape[:-1]:
+            n *= s
+        xf = x.reshape([n, self.d_model])
+
+        probs = self.gate.scores(xf)                       # [n, E]
+        _, top_idx = paddle_tpu.topk(probs, self.top_k, axis=-1)
+        if hasattr(self.gate, "aux_loss"):
+            self.gate.aux_loss(probs, top_idx[:, 0])
+
+        cap_rate = self.capacity_factor[0 if self.training else 1]
+        capacity = _capacity(n, self.num_expert, self.top_k, cap_rate)
+        # GShard stochastic second-expert routing (reference
+        # moe/utils.py _random_routing): keep the 2nd choice with
+        # probability min(1, 2 * its gate value)
+        keep_last = None
+        if (self.training and self.top_k == 2
+                and getattr(self.gate, "random_routing", False)):
+            vals2, _ = paddle_tpu.topk(probs, 2, axis=-1)
+            r = paddle_tpu.rand([n], dtype="float32")
+            keep_last = (vals2[:, 1] * 2.0 > r).astype("float32")
+        combine, dispatch = dispatch_combine(probs, self.top_k, capacity,
+                                             keep_last=keep_last)
+
+        xin = paddle_tpu.einsum("nec,nd->ecd", dispatch, xf)
+        xin = _constrain(xin, self.ep_axis, None, None)
+        out = self._run_experts(xin)                       # [E, C, d]
+        out = _constrain(out, self.ep_axis, None, None)
+        y = paddle_tpu.einsum("nec,ecd->nd", combine, out)
+        return y.reshape(orig_shape)

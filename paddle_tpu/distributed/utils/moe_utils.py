@@ -1,0 +1,39 @@
+"""Expert-parallel token exchange (reference:
+python/paddle/distributed/utils/moe_utils.py global_scatter/global_gather).
+
+The reference ops move RAGGED per-expert token counts through NCCL
+all-to-all; counts are runtime data.  On TPU the exchange must compile to
+a static XLA `all_to_all`, so the unit of exchange is the STATIC-capacity
+dispatch buffer [E, C, d] produced by GShard routing
+(paddle_tpu.distributed.moe.dispatch_combine): unused capacity slots
+travel as zeros instead of being compacted away.  These helpers are the
+explicit shard_map-path primitives; the MoELayer nn API instead lets the
+XLA partitioner insert the identical collective from a sharding
+constraint.
+
+Both functions must run INSIDE a shard_map body over the `ep` mesh axis.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["global_scatter", "global_gather"]
+
+
+def global_scatter(x, axis="ep"):
+    """[E, C, d] locally-routed tokens -> [E/ep, ep*C, d] per-expert rows.
+
+    Each device enters holding the tokens IT routed for all E global
+    experts; it leaves holding every device's tokens for its E/ep local
+    experts — the reference's global_scatter (send side of the MoE
+    all-to-all), as one XLA AllToAll over ICI.
+    """
+    return jax.lax.all_to_all(x, axis, split_axis=0, concat_axis=1,
+                              tiled=True)
+
+
+def global_gather(x, axis="ep"):
+    """Inverse of global_scatter: [E/ep, ep*C, d] expert outputs back to
+    [E, C, d] on the device that originally routed each token."""
+    return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=0,
+                              tiled=True)

@@ -1,0 +1,12 @@
+"""API mirror of paddle.incubate.distributed.models.moe (reference:
+python/paddle/incubate/distributed/models/moe/__init__.py)."""
+from paddle_tpu.distributed.moe import (  # noqa: F401
+    BaseGate,
+    GShardGate,
+    MoELayer,
+    NaiveGate,
+    StackedExpertFFN,
+    SwitchGate,
+    dispatch_combine,
+)
+from .gate import *  # noqa: F401,F403

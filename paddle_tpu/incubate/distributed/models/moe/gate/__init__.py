@@ -1,0 +1,6 @@
+from paddle_tpu.distributed.moe import (  # noqa: F401
+    BaseGate,
+    GShardGate,
+    NaiveGate,
+    SwitchGate,
+)

@@ -35,7 +35,9 @@ class GPTConfig:
                  num_heads=16, ffn_hidden_size=None, max_seq_len=2048,
                  dropout=0.1, attention_dropout=0.1, initializer_range=0.02,
                  layer_norm_epsilon=1e-5, use_recompute=False,
-                 tie_word_embeddings=True):
+                 tie_word_embeddings=True, moe_num_experts=0, moe_top_k=2,
+                 moe_every=2, moe_gate="gshard", moe_ep_axis="ep",
+                 moe_capacity_factor=(2.0, 2.0)):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -48,6 +50,15 @@ class GPTConfig:
         self.layer_norm_epsilon = layer_norm_epsilon
         self.use_recompute = use_recompute
         self.tie_word_embeddings = tie_word_embeddings
+        # MoE (GShard-style; reference incubate.distributed.models.moe):
+        # every `moe_every`-th decoder block swaps its dense FFN for
+        # `moe_num_experts` experts sharded over the `moe_ep_axis` mesh axis
+        self.moe_num_experts = moe_num_experts
+        self.moe_top_k = moe_top_k
+        self.moe_every = moe_every
+        self.moe_gate = moe_gate
+        self.moe_ep_axis = moe_ep_axis
+        self.moe_capacity_factor = moe_capacity_factor
 
 
 def gpt3_1p3b(**kw):
@@ -143,16 +154,33 @@ class GPTMLP(nn.Layer):
 
 
 class GPTDecoderLayer(nn.Layer):
-    """Pre-LN transformer decoder block."""
+    """Pre-LN transformer decoder block. With `moe_num_experts` set and
+    this block selected by `moe_every`, the dense FFN is replaced by a
+    GShard MoE whose experts shard over the `ep` mesh axis (reference:
+    GPT-MoE built on incubate.distributed.models.moe.MoELayer)."""
 
-    def __init__(self, config: GPTConfig):
+    def __init__(self, config: GPTConfig, layer_idx: int = 0):
         super().__init__()
         self.ln1 = nn.LayerNorm(config.hidden_size,
                                 epsilon=config.layer_norm_epsilon)
         self.attn = GPTAttention(config)
         self.ln2 = nn.LayerNorm(config.hidden_size,
                                 epsilon=config.layer_norm_epsilon)
-        self.mlp = GPTMLP(config)
+        use_moe = (config.moe_num_experts > 0
+                   and (layer_idx + 1) % config.moe_every == 0)
+        if use_moe:
+            from paddle_tpu.distributed.moe import (MoELayer,
+                                                    StackedExpertFFN)
+            self.mlp = MoELayer(
+                config.hidden_size,
+                StackedExpertFFN(config.moe_num_experts, config.hidden_size,
+                                 config.ffn_hidden_size,
+                                 ep_axis=config.moe_ep_axis),
+                gate={"type": config.moe_gate, "top_k": config.moe_top_k},
+                ep_axis=config.moe_ep_axis,
+                capacity_factor=config.moe_capacity_factor)
+        else:
+            self.mlp = GPTMLP(config)
         self.dropout = nn.Dropout(config.dropout)
 
     def forward(self, x):
@@ -167,7 +195,8 @@ class GPTModel(nn.Layer):
         self.config = config
         self.embeddings = GPTEmbeddings(config)
         self.layers = nn.LayerList(
-            [GPTDecoderLayer(config) for _ in range(config.num_layers)])
+            [GPTDecoderLayer(config, layer_idx=i)
+             for i in range(config.num_layers)])
         self.final_ln = nn.LayerNorm(config.hidden_size,
                                      epsilon=config.layer_norm_epsilon)
 
@@ -179,6 +208,18 @@ class GPTModel(nn.Layer):
             else:
                 h = layer(h)
         return self.final_ln(h)
+
+    def moe_aux_loss(self):
+        """Sum of the MoE gates' load-balancing losses from the last
+        forward (cleared on read); 0.0 when the model has no MoE blocks."""
+        total = None
+        for layer in self.layers:
+            gate = getattr(layer.mlp, "gate", None)
+            if gate is not None and hasattr(gate, "get_loss"):
+                loss = gate.get_loss()
+                if loss is not None:
+                    total = loss if total is None else total + loss
+        return total if total is not None else paddle_tpu.zeros([])
 
 
 class GPTForCausalLM(nn.Layer):
