@@ -59,6 +59,8 @@ from paddle_tpu import (  # noqa: F401,E402
     amp,
     audio,
     autograd,
+    callbacks,
+    device,
     distributed,
     distribution,
     fft,
@@ -71,6 +73,7 @@ from paddle_tpu import (  # noqa: F401,E402
     linalg,
     metric,
     nn,
+    onnx,
     optimizer,
     profiler,
     quantization,
@@ -82,6 +85,7 @@ from paddle_tpu import (  # noqa: F401,E402
     utils,
     vision,
 )
+from paddle_tpu.batch import batch  # noqa: F401,E402
 from paddle_tpu.hapi.model import Model  # noqa: F401,E402
 from paddle_tpu.jit.api import to_static  # noqa: F401,E402
 from paddle_tpu.nn.layer.layers import disable_static, enable_static  # noqa: F401,E402

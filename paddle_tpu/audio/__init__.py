@@ -1,2 +1,3 @@
 """paddle.audio parity namespace (reference: python/paddle/audio)."""
-from paddle_tpu.audio import features, functional  # noqa: F401
+from paddle_tpu.audio import backends, datasets, features, functional  # noqa: F401
+from paddle_tpu.audio.backends import info, load, save  # noqa: F401

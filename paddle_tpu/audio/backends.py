@@ -1,0 +1,92 @@
+"""paddle.audio.backends parity (reference:
+python/paddle/audio/backends/wave_backend.py): WAV load/save/info on the
+stdlib `wave` module — no soundfile dependency, fully offline."""
+from __future__ import annotations
+
+import wave as _wave
+
+import numpy as np
+
+__all__ = ["AudioInfo", "info", "load", "save",
+           "list_available_backends", "get_current_backend", "set_backend"]
+
+
+class AudioInfo:
+    """(reference backend.py AudioInfo)"""
+
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return "wave_backend"
+
+
+def set_backend(backend_name):
+    if backend_name != "wave_backend":
+        raise NotImplementedError(
+            "only the stdlib wave_backend exists in this build "
+            "(the reference's soundfile backend needs an external lib)")
+
+
+def info(filepath):
+    with _wave.open(str(filepath), "rb") as f:
+        return AudioInfo(f.getframerate(), f.getnframes(), f.getnchannels(),
+                         f.getsampwidth() * 8, "PCM_S")
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """-> (Tensor [channels, time] (or [time, channels]), sample_rate)."""
+    from paddle_tpu.core.tensor import Tensor
+    with _wave.open(str(filepath), "rb") as f:
+        sr = f.getframerate()
+        nch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(min(frame_offset, f.getnframes()))
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(max(n, 0))
+    if width == 2:
+        data = np.frombuffer(raw, dtype=np.int16)
+        scale = 32768.0
+    elif width == 1:  # unsigned 8-bit WAV
+        data = np.frombuffer(raw, dtype=np.uint8).astype(np.int16) - 128
+        scale = 128.0
+    elif width == 4:
+        data = np.frombuffer(raw, dtype=np.int32)
+        scale = 2147483648.0
+    else:
+        raise ValueError(f"unsupported WAV sample width {width}")
+    data = data.reshape(-1, nch)
+    if normalize:
+        data = (data.astype(np.float32) / scale)
+    if channels_first:
+        data = data.T
+    return Tensor(np.ascontiguousarray(data)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding="PCM_16", bits_per_sample=16):
+    from paddle_tpu.core.tensor import Tensor
+    arr = src.numpy() if isinstance(src, Tensor) else np.asarray(src)
+    if arr.ndim == 1:
+        arr = arr[None] if channels_first else arr[:, None]
+    if channels_first:
+        arr = arr.T  # -> [time, channels]
+    if arr.dtype != np.int16:
+        arr = np.clip(arr, -1.0, 1.0)
+        arr = (arr * 32767.0).astype(np.int16)
+    with _wave.open(str(filepath), "wb") as f:
+        f.setnchannels(arr.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(arr.tobytes())

@@ -11,6 +11,12 @@ from paddle_tpu.core.engine import (  # noqa: F401
     set_grad_enabled,
 )
 from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.autograd.functional import (  # noqa: F401
+    Hessian,
+    Jacobian,
+    jvp,
+    vjp,
+)
 
 
 def backward(tensors, grad_tensors=None, retain_graph=False):

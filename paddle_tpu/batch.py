@@ -1,0 +1,23 @@
+"""paddle.batch parity (reference: python/paddle/batch.py:18): wrap a
+sample reader into a mini-batch reader.  Legacy reader API kept for
+user-code compatibility; paddle_tpu.io.DataLoader is the native path."""
+from __future__ import annotations
+
+__all__ = ["batch"]
+
+
+def batch(reader, batch_size, drop_last=False):
+    def batch_reader():
+        b = []
+        for sample in reader():
+            b.append(sample)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    if batch_size <= 0:
+        raise ValueError("batch_size should be a positive integer, "
+                         f"but got {batch_size}")
+    return batch_reader

@@ -1,0 +1,13 @@
+"""paddle.callbacks parity (reference: python/paddle/callbacks.py is a
+re-export of the hapi callbacks)."""
+from paddle_tpu.hapi.callbacks import (  # noqa: F401
+    Callback,
+    EarlyStopping,
+    LRScheduler,
+    ModelCheckpoint,
+    ProgBarLogger,
+    VisualDL,
+)
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
+           "EarlyStopping", "VisualDL"]

@@ -1,0 +1,47 @@
+"""paddle.device.cuda parity surface. There is no CUDA on TPU; queries
+report zero devices instead of raising so device-agnostic user code
+(`if paddle.device.cuda.device_count(): ...`) keeps working."""
+from __future__ import annotations
+
+__all__ = ["device_count", "synchronize", "empty_cache",
+           "max_memory_allocated", "max_memory_reserved",
+           "memory_allocated", "memory_reserved", "Stream", "Event"]
+
+
+def device_count():
+    return 0
+
+
+def synchronize(device=None):
+    from paddle_tpu.device import synchronize as sync
+    return sync(device)
+
+
+def empty_cache():
+    return None
+
+
+def max_memory_allocated(device=None):
+    return 0
+
+
+def max_memory_reserved(device=None):
+    return 0
+
+
+def memory_allocated(device=None):
+    return 0
+
+
+def memory_reserved(device=None):
+    return 0
+
+
+class Stream:
+    def __init__(self, *a, **kw):
+        raise RuntimeError("CUDA streams do not exist on the TPU backend; "
+                           "XLA schedules compute/collective streams itself")
+
+
+class Event(Stream):
+    pass

@@ -1,8 +1,59 @@
 """paddle.incubate parity namespace (reference: python/paddle/incubate).
 
-Hosts the fused transformer ops/layers; the rest of the reference's
-incubate surface either graduated into core namespaces here (flash
-attention lives in ops/pallas + nn.functional.scaled_dot_product_attention)
-or is GPU-runtime-specific with no TPU analogue.
+Fused transformer ops/layers, MoE, LookAhead/ModelAverage, fused
+softmax-mask ops, graph sampling ops and segment reductions — the same
+public __all__ as the reference's incubate/__init__.py:42.  Pieces of the
+reference incubate surface that graduated into core namespaces here are
+re-exported from them (flash attention lives in ops/pallas +
+nn.functional.scaled_dot_product_attention).
 """
+from paddle_tpu.incubate import autograd  # noqa: F401
+from paddle_tpu.incubate import distributed  # noqa: F401
 from paddle_tpu.incubate import nn  # noqa: F401
+from paddle_tpu.incubate import operators  # noqa: F401
+from paddle_tpu.incubate.operators import (  # noqa: F401
+    graph_khop_sampler,
+    graph_reindex,
+    graph_sample_neighbors,
+    graph_send_recv,
+    softmax_mask_fuse,
+    softmax_mask_fuse_upper_triangle,
+)
+from paddle_tpu.incubate.optimizer import LookAhead, ModelAverage  # noqa: F401
+from paddle_tpu.geometric import (  # noqa: F401
+    segment_max,
+    segment_mean,
+    segment_min,
+    segment_sum,
+)
+
+
+def identity_loss(x, reduction="none"):
+    """Mark a loss for IPU-style pipelining in the reference
+    (incubate/__init__.py identity_loss); numerically it reduces or passes
+    through the input."""
+    import paddle_tpu
+    if reduction in (0, "sum"):
+        return x.sum()
+    if reduction in (1, "mean"):
+        return x.mean()
+    if reduction in (2, "none"):
+        return x
+    raise ValueError("reduction must be sum|mean|none")
+
+
+__all__ = [
+    "LookAhead",
+    "ModelAverage",
+    "softmax_mask_fuse_upper_triangle",
+    "softmax_mask_fuse",
+    "graph_send_recv",
+    "graph_khop_sampler",
+    "graph_sample_neighbors",
+    "graph_reindex",
+    "segment_sum",
+    "segment_mean",
+    "segment_max",
+    "segment_min",
+    "identity_loss",
+]

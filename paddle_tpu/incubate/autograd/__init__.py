@@ -1,0 +1,10 @@
+"""paddle.incubate.autograd parity (reference:
+python/paddle/incubate/autograd/__init__.py)."""
+from paddle_tpu.autograd.functional import (  # noqa: F401
+    Hessian,
+    Jacobian,
+    jvp,
+    vjp,
+)
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian"]

@@ -1,0 +1,99 @@
+"""paddle.incubate.operators parity (reference:
+python/paddle/incubate/operators/).
+
+The reference implements these as hand-written CUDA kernels; here each is
+a small jnp composition that XLA fuses into one kernel on TPU — the
+"fused" contract (no materialised intermediate) holds by construction.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply
+
+__all__ = [
+    "softmax_mask_fuse",
+    "softmax_mask_fuse_upper_triangle",
+    "graph_send_recv",
+    "graph_khop_sampler",
+    "graph_sample_neighbors",
+    "graph_reindex",
+]
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) fused (reference operators/softmax_mask_fuse.py;
+    mask broadcasts over heads, holds -10000 at masked positions)."""
+    return apply(lambda xv, mv: jax.nn.softmax(
+        xv.astype(jnp.float32) + mv.astype(jnp.float32),
+        axis=-1).astype(xv.dtype), x, mask)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """softmax with the causal (upper-triangle masked) pattern fused
+    (reference operators/softmax_mask_fuse_upper_triangle.py): scores at
+    column > row are masked out. x: [b, h, sq, sk]."""
+
+    def fn(xv):
+        sq, sk = xv.shape[-2], xv.shape[-1]
+        row = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(col <= row, xv.astype(jnp.float32), -1e9)
+        return jax.nn.softmax(s, axis=-1).astype(xv.dtype)
+
+    return apply(fn, x)
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """Deprecated alias of geometric.send_u_recv (reference
+    operators/graph_send_recv.py routes to the same kernel)."""
+    from paddle_tpu.geometric import send_u_recv
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling: iterate geometric.sample_neighbors
+    over `sample_sizes` hops and reindex the union subgraph (reference
+    operators/graph_khop_sampler.py)."""
+    import numpy as np
+
+    from paddle_tpu.geometric import reindex_graph, sample_neighbors
+
+    nodes = input_nodes
+    all_neighbors, all_counts = [], []
+    for size in sample_sizes:
+        neigh, counts = sample_neighbors(row, colptr, nodes,
+                                         sample_size=size)
+        all_neighbors.append(neigh)
+        all_counts.append(counts)
+        nodes = neigh
+    neighbors = paddle_concat(all_neighbors)
+    counts = paddle_concat(all_counts)
+    reindex_src, reindex_dst, out_nodes = reindex_graph(
+        input_nodes, neighbors, counts)
+    return reindex_src, reindex_dst, out_nodes, counts
+
+
+def paddle_concat(xs):
+    import paddle_tpu
+    return paddle_tpu.concat(xs, axis=0)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    from paddle_tpu.geometric import sample_neighbors
+    return sample_neighbors(row, colptr, input_nodes,
+                            sample_size=sample_size, eids=eids,
+                            return_eids=return_eids)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    from paddle_tpu.geometric import reindex_graph
+    return reindex_graph(x, neighbors, count, value_buffer, index_buffer)

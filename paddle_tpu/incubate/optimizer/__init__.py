@@ -1,0 +1,168 @@
+"""paddle.incubate.optimizer parity: LookAhead + ModelAverage.
+
+Reference: python/paddle/incubate/optimizer/lookahead.py and
+modelaverage.py.  Both are expressed as pure state-tensor updates with
+`jnp.where` for the data-dependent triggers, so a `to_static` train step
+traces them into the same single XLA program as the inner optimizer
+(the reference versions emit conditional blocks into the fluid program).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.engine import no_grad
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.framework.state import register_state_tensor
+from paddle_tpu.optimizer.optimizer import Optimizer
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+def _state(name, value):
+    t = Tensor(jnp.asarray(value), name=name)
+    t.persistable = True
+    register_state_tensor(t)
+    return t
+
+
+class LookAhead(Optimizer):
+    """slow_param <- slow_param + alpha * (fast_param - slow_param) every
+    k inner-optimizer steps, then fast_param <- slow_param
+    (reference lookahead.py:37)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        assert inner_optimizer is not None, "inner optimizer can not be None"
+        assert 0.0 <= alpha <= 1.0, "alpha should be in [0, 1]"
+        assert isinstance(k, int) and k > 0, "k should be a positive integer"
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._parameter_list = inner_optimizer._parameter_list
+        self._step_counter = _state("lookahead_step", jnp.zeros((), jnp.int32))
+        # slow weights snapshot the initial fast weights (created eagerly:
+        # lazy creation inside a to_static trace could not be re-initialised
+        # concretely). `+ 0` forces a DISTINCT buffer — aliasing the param's
+        # would make to_static donate the same buffer twice.
+        self._slow = {id(p): _state(f"{p.name}_slow",
+                                    p._value.astype(jnp.float32) + 0)
+                      for p in self._params()}
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    @no_grad()
+    def step(self):
+        self.inner_optimizer.step()
+        cnt = self._step_counter._value + 1
+        self._step_counter._set_value(cnt)
+        sync = (cnt % self.k) == 0
+        for p in self._params():
+            slow = self._slow[id(p)]
+            new_slow = jnp.where(
+                sync,
+                self.alpha * p._value.astype(jnp.float32)
+                + (1.0 - self.alpha) * slow._value,
+                slow._value)
+            slow._set_value(new_slow)
+            p._set_value(jnp.where(sync, new_slow.astype(p._value.dtype),
+                                   p._value))
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, self.inner_optimizer._params_grads()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_step"] = self._step_counter
+        for p in self._params():
+            sd[self._slow[id(p)].name] = self._slow[id(p)]
+        return sd
+
+
+class ModelAverage(Optimizer):
+    """Windowed average of parameter trajectories (reference
+    modelaverage.py): accumulate sums each step; inside `apply()` the
+    parameters are swapped for sum/(accumulation count); `restore()` puts
+    the live weights back.
+
+    Window roll (reference docstring :49): when
+    num_accumulates >= min_average_window and
+    num_accumulates >= min(max_average_window,
+    num_updates * average_window_rate), fold sum_1+sum_2 into sum_3 and
+    restart the accumulation window.
+    """
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        super().__init__(learning_rate=0.0, parameters=parameters)
+        self.avg_rate = float(average_window_rate)
+        self.min_window = int(min_average_window)
+        self.max_window = int(max_average_window)
+        self._num_updates = _state("ma_num_updates", jnp.zeros((), jnp.int32))
+        self._num_acc = _state("ma_num_acc", jnp.zeros((), jnp.int32))
+        self._old_num_acc = _state("ma_old_num_acc", jnp.zeros((), jnp.int32))
+        self._restore_vals = None
+        for p in self._params():  # eager accumulator creation
+            for s in ("sum_1", "sum_2", "sum_3"):
+                self._acc(s, p, init=0.0, dtype=jnp.float32)
+
+    @no_grad()
+    def step(self):
+        nu = self._num_updates._value + 1
+        na = self._num_acc._value + 1
+        window = jnp.minimum(
+            jnp.asarray(self.max_window, jnp.float32),
+            nu.astype(jnp.float32) * self.avg_rate)
+        roll = (na >= self.min_window) & (na.astype(jnp.float32) >= window)
+        for p in self._params():
+            s1 = self._acc("sum_1", p)
+            s2 = self._acc("sum_2", p)
+            s3 = self._acc("sum_3", p)
+            new_s1 = s1._value + p._value.astype(jnp.float32)
+            s3._set_value(jnp.where(roll, new_s1 + s2._value, s3._value))
+            s2._set_value(jnp.where(roll, jnp.zeros_like(s2._value),
+                                    s2._value))
+            s1._set_value(jnp.where(roll, jnp.zeros_like(new_s1), new_s1))
+        self._old_num_acc._set_value(
+            jnp.where(roll, na, self._old_num_acc._value))
+        self._num_acc._set_value(jnp.where(roll, jnp.zeros_like(na), na))
+        self._num_updates._set_value(nu)
+
+    def _averaged(self, p):
+        total = (self._acc("sum_1", p)._value + self._acc("sum_2", p)._value
+                 + self._acc("sum_3", p)._value)
+        count = (self._num_acc._value + self._old_num_acc._value).astype(
+            jnp.float32)
+        return jnp.where(count > 0, total / jnp.maximum(count, 1.0),
+                         p._value.astype(jnp.float32)).astype(p._value.dtype)
+
+    def apply(self, executor=None, need_restore=True):
+        """Context manager swapping in the averaged weights (eval-time)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            self._restore_vals = [(p, p._value) for p in self._params()]
+            for p in self._params():
+                p._set_value(self._averaged(p))
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+
+        return ctx()
+
+    def restore(self, executor=None):
+        if self._restore_vals is not None:
+            for p, v in self._restore_vals:
+                p._set_value(v)
+            self._restore_vals = None

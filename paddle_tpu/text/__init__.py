@@ -1,4 +1,4 @@
-"""paddle.text parity namespace.
+"""paddle.text parity namespace (viterbi_decode + datasets).
 
 Reference: python/paddle/text/viterbi_decode.py (viterbi_decode :24,
 ViterbiDecoder :100); numeric semantics follow the phi kernel
@@ -21,7 +21,9 @@ from paddle_tpu.core.dispatch import apply
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.nn.layer.layers import Layer
 
-__all__ = ["viterbi_decode", "ViterbiDecoder"]
+__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets"]
+
+from paddle_tpu.text import datasets  # noqa: F401,E402
 
 
 def _t(x):

@@ -1,0 +1,204 @@
+"""paddle.text.datasets parity (reference: python/paddle/text/datasets/).
+
+Zero-egress environment: the reference downloads corpora; here each
+dataset synthesizes deterministic procedural data with the reference's
+item shapes/dtypes, so user pipelines (tokenized docs + labels, n-gram
+tuples, rating tuples, regression rows) run unchanged.  Statistical
+structure is injected (class-conditional token distributions, user/item
+biases) so models measurably learn, mirroring vision/datasets.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.io import Dataset
+
+__all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "Conll05",
+           "WMT14", "WMT16"]
+
+
+class Imdb(Dataset):
+    """Sentiment-labelled token-id documents (reference imdb.py:30)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True):
+        assert mode in ("train", "test")
+        rng = np.random.default_rng(42 if mode == "train" else 43)
+        n = 512 if mode == "train" else 128
+        self.word_idx = {f"w{i}": i for i in range(cutoff)}
+        self.docs, self.labels = [], []
+        for i in range(n):
+            label = i % 2
+            length = int(rng.integers(16, 64))
+            # sentiment-dependent token bias makes the task learnable
+            base = rng.integers(0, cutoff // 2, length)
+            shift = (cutoff // 2) * label
+            doc = (base + shift * (rng.random(length) < 0.7)).astype(np.int64)
+            self.docs.append(doc % cutoff)
+            self.labels.append(label)
+
+    def __getitem__(self, idx):
+        return np.array(self.docs[idx]), np.array([self.labels[idx]])
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram tuples (reference imikolov.py)."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=True):
+        assert data_type in ("NGRAM", "SEQ")
+        rng = np.random.default_rng(7 if mode == "train" else 8)
+        vocab = 200
+        self.word_idx = {f"w{i}": i for i in range(vocab)}
+        n = 1024 if mode == "train" else 256
+        self.data = []
+        stream = rng.integers(0, vocab, n + window_size)
+        # Markov-ish structure: next token correlates with previous
+        for i in range(1, len(stream)):
+            stream[i] = (stream[i - 1] + stream[i]) % vocab
+        if data_type == "NGRAM":
+            for i in range(n):
+                self.data.append(tuple(stream[i:i + window_size]))
+        else:
+            for i in range(n // 8):
+                seq = stream[i * 8:(i + 1) * 8]
+                self.data.append((seq[:-1], seq[1:]))
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Movielens(Dataset):
+    """(user_id, gender, age, job, movie_id, category, title, rating)
+    tuples (reference movielens.py:232)."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        rng = np.random.default_rng(rand_seed)
+        n_users, n_movies = 100, 200
+        user_bias = rng.normal(0, 1, n_users)
+        movie_bias = rng.normal(0, 1, n_movies)
+        rows = []
+        for _ in range(2000):
+            u = int(rng.integers(0, n_users))
+            m = int(rng.integers(0, n_movies))
+            score = 3.0 + user_bias[u] + movie_bias[m] + rng.normal(0, 0.3)
+            rows.append((
+                np.array([u]), np.array([int(rng.integers(0, 2))]),
+                np.array([int(rng.integers(1, 7))]),
+                np.array([int(rng.integers(0, 21))]),
+                np.array([m]),
+                rng.integers(0, 18, 3).astype(np.int64),
+                rng.integers(0, 5000, 4).astype(np.int64),
+                np.array([float(np.clip(round(score), 1, 5))],
+                         np.float32),
+            ))
+        cut = int(len(rows) * (1 - test_ratio))
+        self.data = rows[:cut] if mode == "train" else rows[cut:]
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class UCIHousing(Dataset):
+    """13-feature housing regression rows (reference uci_housing.py)."""
+
+    N_FEAT = 13
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        rng = np.random.default_rng(13 if mode == "train" else 14)
+        n = 404 if mode == "train" else 102
+        x = rng.normal(0, 1, (n, self.N_FEAT))
+        w = rng.normal(0, 1, self.N_FEAT)
+        y = x @ w + rng.normal(0, 0.1, n)
+        self.data = np.concatenate([x, y[:, None]], axis=1)
+        self.dtype = "float32"
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return (np.array(row[:-1]).astype(self.dtype),
+                np.array(row[-1:]).astype(self.dtype))
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Conll05(Dataset):
+    """SRL tuples: (word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, pred,
+    mark, label) id sequences (reference conll05.py)."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, emb_file=None,
+                 mode="train", download=True):
+        rng = np.random.default_rng(5 if mode == "train" else 6)
+        self.word_dict = {f"w{i}": i for i in range(800)}
+        self.predicate_dict = {f"v{i}": i for i in range(60)}
+        self.label_dict = {f"l{i}": i for i in range(20)}
+        n = 256 if mode == "train" else 64
+        self.data = []
+        for _ in range(n):
+            length = int(rng.integers(5, 30))
+            words = rng.integers(0, 800, length).astype(np.int64)
+            ctx = [np.roll(words, s) for s in (2, 1, 0, -1, -2)]
+            pred = np.full(length, rng.integers(0, 60), np.int64)
+            mark = (rng.random(length) < 0.2).astype(np.int64)
+            label = rng.integers(0, 20, length).astype(np.int64)
+            self.data.append((words, *ctx, pred, mark, label))
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class WMT14(Dataset):
+    """(src_ids, trg_ids, trg_ids_next) translation triples
+    (reference wmt14.py)."""
+
+    DICT_SIZE = 1000
+    BOS, EOS, UNK = 0, 1, 2
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1,
+                 download=True):
+        self.dict_size = self.DICT_SIZE if dict_size < 0 else dict_size
+        rng = np.random.default_rng(140 if mode == "train" else 141)
+        n = 512 if mode == "train" else 128
+        self.data = []
+        for _ in range(n):
+            length = int(rng.integers(4, 20))
+            src = rng.integers(3, self.dict_size, length).astype(np.int64)
+            # target: deterministic per-token mapping + BOS/EOS framing
+            trg_core = (src * 7 + 11) % self.dict_size
+            trg = np.concatenate([[self.BOS], trg_core])
+            trg_next = np.concatenate([trg_core, [self.EOS]])
+            self.data.append((src, trg.astype(np.int64),
+                              trg_next.astype(np.int64)))
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+    def get_dict(self, lang="en", reverse=False):
+        d = {f"tok{i}": i for i in range(self.dict_size)}
+        return {v: k for k, v in d.items()} if reverse else d
+
+
+class WMT16(WMT14):
+    """Same triple layout, separate vocab handles (reference wmt16.py)."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", download=True):
+        super().__init__(mode=mode,
+                         dict_size=max(src_dict_size, trg_dict_size))

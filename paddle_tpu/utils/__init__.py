@@ -1,6 +1,8 @@
 """Misc utils. Reference: python/paddle/utils/__init__.py."""
 from __future__ import annotations
 
+from paddle_tpu.utils import dlpack  # noqa: F401
+
 
 def try_import(name):
     import importlib

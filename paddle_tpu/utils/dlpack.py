@@ -1,0 +1,29 @@
+"""paddle.utils.dlpack parity (reference: python/paddle/utils/dlpack.py):
+zero-copy tensor interchange with other frameworks via the DLPack
+protocol (torch, numpy, cupy...)."""
+from __future__ import annotations
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Tensor -> DLPack-protocol object (zero-copy where the backend
+    allows).  Returned object implements ``__dlpack__``/
+    ``__dlpack_device__`` — the modern protocol form every consumer
+    (torch.from_dlpack, np.from_dlpack, jax) accepts; the reference's
+    legacy PyCapsule form is produced by calling ``__dlpack__()`` on it."""
+    from paddle_tpu.core.tensor import Tensor
+    return x._value if isinstance(x, Tensor) else x
+
+
+def from_dlpack(dlpack):
+    """__dlpack__-bearing object (torch/numpy/jax array...) -> Tensor."""
+    import jax
+
+    from paddle_tpu.core.tensor import Tensor
+    if not hasattr(dlpack, "__dlpack__"):
+        raise TypeError(
+            "from_dlpack needs an object implementing the DLPack protocol "
+            "(__dlpack__/__dlpack_device__); legacy bare PyCapsules cannot "
+            "be re-imported — pass the producing array itself")
+    return Tensor(jax.dlpack.from_dlpack(dlpack))

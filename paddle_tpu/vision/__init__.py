@@ -1,1 +1,10 @@
 from paddle_tpu.vision import datasets, models, ops, transforms  # noqa: F401
+
+# reference layout parity: paddle.vision.transforms.functional is a
+# submodule; here the functional forms live in the same module.  The
+# attribute alias serves `from ...transforms import functional`; the
+# sys.modules entry serves `import ...transforms.functional as F`.
+import sys as _sys
+
+transforms.functional = transforms
+_sys.modules[__name__ + ".transforms.functional"] = transforms

@@ -185,24 +185,468 @@ class Transpose(BaseTransform):
 class BrightnessTransform(BaseTransform):
     def __init__(self, value, keys=None):
         super().__init__(keys)
+        if isinstance(value, (list, tuple)):
+            self.range = (float(value[0]), float(value[1]))
+        else:
+            self.range = (max(0, 1 - value), 1 + value)
         self.value = value
 
     def _apply_image(self, img):
         arr = _to_hwc(img).astype(np.float32)
-        factor = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        factor = np.random.uniform(*self.range)
         return np.clip(arr * factor, 0, 255).astype(np.uint8) \
             if arr.max() > 1.5 else np.clip(arr * factor, 0, 1)
 
 
-class ColorJitter(BaseTransform):
-    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0, keys=None):
+def _scale_clip(arr, out):
+    """Clip to the input's value range, preserving uint8-ness."""
+    if arr.dtype == np.uint8 or arr.max() > 1.5:
+        return np.clip(out, 0, 255).astype(
+            np.uint8 if arr.dtype == np.uint8 else arr.dtype)
+    return np.clip(out, 0, 1).astype(arr.dtype)
+
+
+def adjust_brightness(img, factor):
+    arr = _to_hwc(img)
+    return _scale_clip(arr, arr.astype(np.float32) * factor)
+
+
+def adjust_contrast(img, factor):
+    """Blend with the mean of the grayscale image (reference
+    functional adjust_contrast semantics)."""
+    arr = _to_hwc(img)
+    f = arr.astype(np.float32)
+    gray_mean = (f @ np.array([0.299, 0.587, 0.114], np.float32)).mean() \
+        if arr.shape[2] == 3 else f.mean()
+    return _scale_clip(arr, f * factor + gray_mean * (1.0 - factor))
+
+
+def adjust_saturation(img, factor):
+    arr = _to_hwc(img)
+    f = arr.astype(np.float32)
+    if arr.shape[2] != 3:
+        return arr
+    gray = (f @ np.array([0.299, 0.587, 0.114], np.float32))[..., None]
+    return _scale_clip(arr, f * factor + gray * (1.0 - factor))
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor (in [-0.5, 0.5] turns) through HSV space."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr = _to_hwc(img)
+    if arr.shape[2] != 3:
+        return arr
+    scale = 255.0 if (arr.dtype == np.uint8 or arr.max() > 1.5) else 1.0
+    f = arr.astype(np.float32) / scale
+    r, g, b = f[..., 0], f[..., 1], f[..., 2]
+    maxc = f.max(-1)
+    minc = f.min(-1)
+    v = maxc
+    d = maxc - minc
+    s = np.where(maxc > 0, d / np.maximum(maxc, 1e-12), 0.0)
+    dd = np.maximum(d, 1e-12)
+    h = np.where(maxc == r, ((g - b) / dd) % 6.0,
+                 np.where(maxc == g, (b - r) / dd + 2.0,
+                          (r - g) / dd + 4.0))
+    h = np.where(d == 0, 0.0, h) / 6.0
+    h = (h + hue_factor) % 1.0
+    i = np.floor(h * 6.0)
+    fr = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * fr)
+    t = v * (1.0 - s * (1.0 - fr))
+    i = i.astype(np.int32) % 6
+    r2 = np.choose(i, [v, q, p, p, t, v])
+    g2 = np.choose(i, [t, v, v, q, p, p])
+    b2 = np.choose(i, [p, p, t, v, v, q])
+    out = np.stack([r2, g2, b2], axis=-1) * scale
+    return _scale_clip(arr, out)
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = _to_hwc(img)
+    f = arr.astype(np.float32)
+    gray = f @ np.array([0.299, 0.587, 0.114], np.float32) \
+        if arr.shape[2] == 3 else f[..., 0]
+    out = np.repeat(gray[..., None], num_output_channels, axis=-1)
+    return _scale_clip(arr, out)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = _to_hwc(img)
+    if isinstance(padding, numbers.Number):
+        pl = pt = pr = pb = int(padding)
+    elif len(padding) == 2:
+        pl, pt = padding
+        pr, pb = padding
+    else:
+        pl, pt, pr, pb = padding
+    spec = [(pt, pb), (pl, pr), (0, 0)]
+    if padding_mode == "constant":
+        if isinstance(fill, (list, tuple)):  # per-channel fill (RGB)
+            chans = [np.pad(arr[..., c:c + 1], spec[:2] + [(0, 0)],
+                            mode="constant", constant_values=fill[c])
+                     for c in range(arr.shape[2])]
+            return np.concatenate(chans, axis=2)
+        return np.pad(arr, spec, mode="constant", constant_values=fill)
+    mode = {"edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    return np.pad(arr, spec, mode=mode)
+
+
+def _inverse_warp(img, inv_matrix, interpolation="nearest", fill=0,
+                  out_size=None):
+    """Warp by sampling input at inv_matrix @ output-coords (3x3
+    homography, pixel-center coordinates) — the shared core of rotate /
+    affine / perspective."""
+    arr = _to_hwc(img)
+    h, w = arr.shape[:2]
+    oh, ow = out_size or (h, w)
+    ys, xs = np.meshgrid(np.arange(oh, dtype=np.float32),
+                         np.arange(ow, dtype=np.float32), indexing="ij")
+    ones = np.ones_like(xs)
+    coords = np.stack([xs, ys, ones], 0).reshape(3, -1)
+    src = np.asarray(inv_matrix, np.float32) @ coords
+    sx = src[0] / np.maximum(np.abs(src[2]), 1e-12) * np.sign(src[2])
+    sy = src[1] / np.maximum(np.abs(src[2]), 1e-12) * np.sign(src[2])
+    f = arr.astype(np.float32)
+
+    def sample(ix, iy):
+        valid = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
+        ixc = np.clip(ix, 0, w - 1)
+        iyc = np.clip(iy, 0, h - 1)
+        out = f[iyc, ixc]
+        out[~valid] = fill
+        return out, valid
+
+    if interpolation == "bilinear":
+        x0 = np.floor(sx).astype(np.int64)
+        y0 = np.floor(sy).astype(np.int64)
+        wx = (sx - x0)[:, None]
+        wy = (sy - y0)[:, None]
+        v00, _ = sample(x0, y0)
+        v01, _ = sample(x0 + 1, y0)
+        v10, _ = sample(x0, y0 + 1)
+        v11, _ = sample(x0 + 1, y0 + 1)
+        out = (v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy)
+               + v10 * (1 - wx) * wy + v11 * wx * wy)
+        inside = (sx >= -0.5) & (sx <= w - 0.5) & (sy >= -0.5) & (sy <= h - 0.5)
+        out[~inside] = fill
+    else:
+        out, _ = sample(np.round(sx).astype(np.int64),
+                        np.round(sy).astype(np.int64))
+    return _scale_clip(arr, out.reshape(oh, ow, arr.shape[2]))
+
+
+def _affine_inv(angle_deg, translate, scale, shear_deg, center):
+    """Inverse of the torchvision/paddle affine convention: output =
+    T(center) T(translate) R(angle) Sh(shear) S(scale) T(-center) input."""
+    a = np.deg2rad(angle_deg)
+    sx, sy = np.deg2rad(shear_deg[0]), np.deg2rad(shear_deg[1])
+    cx, cy = center
+    tx, ty = translate
+    # forward 2x3 (torchvision _get_inverse_affine_matrix, inverted there;
+    # build forward then invert numerically for clarity)
+    rot = np.array([[np.cos(a - sy) / np.cos(sy),
+                     -np.cos(a - sy) * np.tan(sx) / np.cos(sy) - np.sin(a)],
+                    [np.sin(a - sy) / np.cos(sy),
+                     -np.sin(a - sy) * np.tan(sx) / np.cos(sy) + np.cos(a)]],
+                   np.float32) * scale
+    fwd = np.eye(3, dtype=np.float32)
+    fwd[:2, :2] = rot
+    pre = np.eye(3, dtype=np.float32)
+    pre[:2, 2] = (-cx, -cy)
+    post = np.eye(3, dtype=np.float32)
+    post[:2, 2] = (cx + tx, cy + ty)
+    return np.linalg.inv(post @ fwd @ pre).astype(np.float32)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    arr = _to_hwc(img)
+    h, w = arr.shape[:2]
+    c = center or ((w - 1) * 0.5, (h - 1) * 0.5)
+    out_size = None
+    if expand:
+        a = np.deg2rad(angle)
+        # tolerance before ceil: cos(90°) is ~6e-17, not 0, and
+        # ceil(8 + 2e-16) would grow the canvas to 9
+        ow = int(np.ceil(abs(w * np.cos(a)) + abs(h * np.sin(a)) - 1e-6))
+        oh = int(np.ceil(abs(h * np.cos(a)) + abs(w * np.sin(a)) - 1e-6))
+        out_size = (oh, ow)
+        inv = _affine_inv(angle, ((ow - w) / 2, (oh - h) / 2), 1.0,
+                          (0.0, 0.0), c)
+    else:
+        inv = _affine_inv(angle, (0, 0), 1.0, (0.0, 0.0), c)
+    return _inverse_warp(arr, inv, interpolation, fill, out_size)
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    arr = _to_hwc(img)
+    h, w = arr.shape[:2]
+    if isinstance(shear, numbers.Number):
+        shear = (shear, 0.0)
+    c = center or ((w - 1) * 0.5, (h - 1) * 0.5)
+    inv = _affine_inv(angle, tuple(translate), scale, tuple(shear), c)
+    return _inverse_warp(arr, inv, interpolation, fill)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Warp so `startpoints` (4 corner [x, y]) map to `endpoints`."""
+    arr = _to_hwc(img)
+    a = []
+    bvec = []
+    for (sx, sy), (ex, ey) in zip(endpoints, startpoints):
+        a.append([sx, sy, 1, 0, 0, 0, -ex * sx, -ex * sy])
+        a.append([0, 0, 0, sx, sy, 1, -ey * sx, -ey * sy])
+        bvec.extend([ex, ey])
+    coeffs = np.linalg.lstsq(np.asarray(a, np.float32),
+                             np.asarray(bvec, np.float32), rcond=None)[0]
+    inv = np.append(coeffs, 1.0).reshape(3, 3).astype(np.float32)
+    return _inverse_warp(arr, inv, interpolation, fill)
+
+
+def _looks_chw(arr):
+    return (arr.ndim == 3 and arr.shape[0] in (1, 3)
+            and arr.shape[2] not in (1, 3))
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Zero/fill a region (reference functional erase); works on Tensor,
+    HWC ndarray or CHW ndarray (layout detected for both)."""
+    if isinstance(img, Tensor):
+        arr = img.numpy().copy()
+        if _looks_chw(arr):
+            arr[:, i:i + h, j:j + w] = v
+        else:
+            arr[i:i + h, j:j + w] = v
+        return Tensor(arr)
+    arr = np.asarray(img) if inplace else np.array(img)
+    if _looks_chw(arr):
+        arr[:, i:i + h, j:j + w] = v        # CHW
+    else:
+        arr[i:i + h, j:j + w] = v           # HWC
+    return arr
+
+
+def _jitter_range(value, name, center=1.0, bound=None):
+    """paddle ColorJitter args are float-or-(min,max): a float v means
+    [max(0, center-v), center+v]; a pair is used as-is."""
+    if isinstance(value, (list, tuple)):
+        lo, hi = float(value[0]), float(value[1])
+    else:
+        if value < 0:
+            raise ValueError(f"{name} value should be non-negative")
+        if bound is not None and value > bound:
+            raise ValueError(f"{name} value should be in [0, {bound}]")
+        lo, hi = max(0.0, center - value), center + value
+        if center == 0.0:
+            lo = -value
+    return lo, hi
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
         super().__init__(keys)
-        self.brightness = brightness
+        self.range = _jitter_range(value, "contrast")
+        self.value = value
 
     def _apply_image(self, img):
+        if self.range == (1.0, 1.0):
+            return _to_hwc(img)
+        return adjust_contrast(img, np.random.uniform(*self.range))
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.range = _jitter_range(value, "saturation")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.range == (1.0, 1.0):
+            return _to_hwc(img)
+        return adjust_saturation(img, np.random.uniform(*self.range))
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.range = _jitter_range(value, "hue", center=0.0, bound=0.5)
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.range == (0.0, 0.0):
+            return _to_hwc(img)
+        return adjust_hue(img, np.random.uniform(*self.range))
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            if degrees < 0:
+                raise ValueError("degrees must be non-negative")
+            degrees = (-degrees, degrees)
+        self.degrees = tuple(degrees)
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return rotate(img, angle, self.interpolation, self.expand,
+                      self.center, self.fill)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = tuple(degrees)
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        arr = _to_hwc(img)
+        h, w = arr.shape[:2]
+        angle = np.random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * h
+        scale = np.random.uniform(*self.scale) if self.scale else 1.0
+        sx = sy = 0.0
+        if self.shear is not None:
+            sh = self.shear
+            if isinstance(sh, numbers.Number):
+                sh = (-sh, sh)
+            sx = np.random.uniform(sh[0], sh[1])
+            if len(sh) == 4:
+                sy = np.random.uniform(sh[2], sh[3])
+        return affine(arr, angle, (tx, ty), scale, (sx, sy),
+                      self.interpolation, self.fill, self.center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = _to_hwc(img)
+        if np.random.rand() >= self.prob:
+            return arr
+        h, w = arr.shape[:2]
+        d = self.distortion_scale
+        dx, dy = int(d * w / 2), int(d * h / 2)
+        start = [[0, 0], [w - 1, 0], [w - 1, h - 1], [0, h - 1]]
+        end = [[np.random.randint(0, dx + 1), np.random.randint(0, dy + 1)],
+               [w - 1 - np.random.randint(0, dx + 1),
+                np.random.randint(0, dy + 1)],
+               [w - 1 - np.random.randint(0, dx + 1),
+                h - 1 - np.random.randint(0, dy + 1)],
+               [np.random.randint(0, dx + 1),
+                h - 1 - np.random.randint(0, dy + 1)]]
+        return perspective(arr, start, end, self.interpolation, self.fill)
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr = img.numpy() if isinstance(img, Tensor) else np.asarray(img)
+        chw = (arr.ndim == 3 and arr.shape[0] in (1, 3)
+               and arr.shape[2] not in (1, 3))
+        h, w = (arr.shape[1], arr.shape[2]) if chw else arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh)
+                j = np.random.randint(0, w - ew)
+                v = self.value if isinstance(self.value, numbers.Number) \
+                    else np.asarray(self.value).reshape(
+                        (-1, 1, 1) if chw else (1, 1, -1))
+                return erase(img, i, j, eh, ew, v, self.inplace)
+        return img
+
+
+class ColorJitter(BaseTransform):
+    """Randomly-ordered brightness/contrast/saturation/hue jitter
+    (reference transforms.py ColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.brightness = brightness
+        self.contrast = contrast
+        self.saturation = saturation
+        self.hue = hue
+
+    def _apply_image(self, img):
+        ops = []
         if self.brightness:
-            return BrightnessTransform(self.brightness)._apply_image(img)
-        return _to_hwc(img)
+            ops.append(BrightnessTransform(self.brightness))
+        if self.contrast:
+            ops.append(ContrastTransform(self.contrast))
+        if self.saturation:
+            ops.append(SaturationTransform(self.saturation))
+        if self.hue:
+            ops.append(HueTransform(self.hue))
+        np.random.shuffle(ops)
+        out = _to_hwc(img)
+        for op in ops:
+            out = op._apply_image(out)
+        return out
 
 
 def to_tensor(pic, data_format="CHW"):

@@ -21,8 +21,12 @@ class TestAutoCast:
         model = nn.Linear(8, 1)
         opt = optimizer.SGD(learning_rate=0.1,
                             parameters=model.parameters())
-        x = paddle_tpu.to_tensor(rng.randn(32, 8).astype(np.float32))
-        y = paddle_tpu.to_tensor(rng.randn(32, 1).astype(np.float32))
+        x_np = rng.randn(32, 8).astype(np.float32)
+        # learnable linear target: the old N(0,1) target made the pass
+        # depend on the luck of the init (irreducible variance ~1.0)
+        y_np = (x_np @ rng.randn(8, 1) * 0.3 + 0.1).astype(np.float32)
+        x = paddle_tpu.to_tensor(x_np)
+        y = paddle_tpu.to_tensor(y_np)
         losses = []
         for _ in range(20):
             opt.clear_grad()

@@ -21,6 +21,16 @@ def _data(cfg, b=2, s=32):
     return ids, labels
 
 
+_single_cache = {}
+
+
+def _one_step_loss_single_cached():
+    """Single-device losses shared by two tests (one compile, not two)."""
+    if "v" not in _single_cache:
+        _single_cache["v"] = _one_step_loss()
+    return _single_cache["v"]
+
+
 def _one_step_loss(mesh_shape=None):
     """Build model + run one AdamW train step; returns (loss0, loss1)."""
     import jax
@@ -70,7 +80,7 @@ class TestGPT:
             assert np.isfinite(p.grad.numpy()).all(), name
 
     def test_to_static_step_trains(self):
-        l0, l1 = _one_step_loss()
+        l0, l1 = _one_step_loss_single_cached()
         assert l1 < l0
 
     def test_loss_mask(self):
@@ -84,6 +94,8 @@ class TestGPT:
         plain = crit(model(ids), labels)
         np.testing.assert_allclose(float(full), float(plain), rtol=1e-5)
 
+    @pytest.mark.nightly  # degradation path; axis filtering itself is
+    # covered cheaply by tests/test_distributed.py constraint tests
     def test_builds_and_steps_on_pure_dp_mesh(self):
         """tp/sp-annotated layers must degrade to replicated on a dp-only
         mesh (axis filtering in shard_tensor/_constrain)."""
@@ -106,7 +118,7 @@ class TestGPT:
 
     def test_hybrid_parallel_matches_single_device(self):
         """dp2×tp2×sp2 sharded train step == single-device step (same seed)."""
-        single = _one_step_loss()
+        single = _one_step_loss_single_cached()
         set_mesh(None)
         sharded = _one_step_loss(dict(dp=2, pp=1, tp=2, sp=2))
         np.testing.assert_allclose(single[0], sharded[0], rtol=2e-4)

@@ -41,6 +41,8 @@ def meshes():
     mesh_mod.set_mesh(old)
 
 
+@pytest.mark.nightly  # the 1f1b + interleave parity tests below cover
+# the hybrid-vs-single-device claim in the default gate run
 def test_hybrid_matches_single_device(meshes):
     cfg = _cfg()
     mesh8 = mesh_mod.init_mesh({"dp": 1, "pp": 2, "tp": 2, "sp": 2})

@@ -1,0 +1,190 @@
+"""incubate additions: LookAhead, ModelAverage, fused softmax-mask ops,
+graph-op aliases, identity_loss; autograd functional vjp/jvp/Jacobian/
+Hessian; dlpack round-trip; paddle.batch; device namespace.
+
+Reference: python/paddle/incubate/{optimizer,operators}/,
+python/paddle/incubate/autograd/functional.py,
+python/paddle/utils/dlpack.py, python/paddle/batch.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+
+
+class TestLookAhead:
+    def test_slow_weights_sync_every_k(self):
+        P.seed(0)
+        lin = P.nn.Linear(4, 4)
+        sgd = P.optimizer.SGD(learning_rate=0.1,
+                              parameters=lin.parameters())
+        la = P.incubate.LookAhead(sgd, alpha=0.5, k=2)
+        w0 = lin.weight.numpy().copy()
+        x = P.to_tensor(np.ones((2, 4), np.float32))
+
+        def one_step():
+            la.clear_grad()
+            (lin(x) ** 2).mean().backward()
+            la.step()
+
+        one_step()
+        w_fast_1 = lin.weight.numpy().copy()  # k=1: plain sgd step
+        slow = la._slow[id(lin.weight)]._value
+        np.testing.assert_allclose(np.asarray(slow), w0, rtol=1e-6)
+
+        one_step()  # k=2: sync — param == slow == interpolation
+        slow2 = np.asarray(la._slow[id(lin.weight)]._value)
+        np.testing.assert_allclose(lin.weight.numpy(), slow2, rtol=1e-6)
+        assert not np.allclose(slow2, w0)
+
+    def test_trains_under_to_static(self):
+        P.seed(0)
+        lin = P.nn.Linear(8, 1)
+        la = P.incubate.LookAhead(
+            P.optimizer.Adam(learning_rate=0.05,
+                             parameters=lin.parameters()), alpha=0.3, k=3)
+        rng = np.random.RandomState(0)
+        xs = P.to_tensor(rng.randn(32, 8).astype(np.float32))
+        ys = P.to_tensor((rng.randn(32, 1) * 0.1 + 1.0).astype(np.float32))
+
+        @P.jit.to_static
+        def step(x, y):
+            la.clear_grad()
+            loss = ((lin(x) - y) ** 2).mean()
+            loss.backward()
+            la.step()
+            return loss
+
+        l0 = float(step(xs, ys))
+        for _ in range(20):
+            l1 = float(step(xs, ys))
+        assert l1 < l0 * 0.5, (l0, l1)
+
+
+class TestModelAverage:
+    def test_average_applied_and_restored(self):
+        P.seed(0)
+        lin = P.nn.Linear(3, 3)
+        sgd = P.optimizer.SGD(learning_rate=0.5,
+                              parameters=lin.parameters())
+        ma = P.incubate.ModelAverage(
+            0.5, parameters=lin.parameters(),
+            min_average_window=2, max_average_window=8)
+        x = P.to_tensor(np.ones((2, 3), np.float32))
+        history = []
+        for _ in range(4):
+            sgd.clear_grad()
+            (lin(x) ** 2).mean().backward()
+            sgd.step()
+            ma.step()
+            history.append(lin.weight.numpy().copy())
+
+        live = lin.weight.numpy().copy()
+        with ma.apply():
+            avg = lin.weight.numpy().copy()
+        np.testing.assert_allclose(lin.weight.numpy(), live, rtol=1e-6)
+        assert not np.allclose(avg, live)
+        # averaged weights lie inside the visited range
+        hist = np.stack(history)
+        assert (avg >= hist.min(0) - 1e-5).all()
+        assert (avg <= hist.max(0) + 1e-5).all()
+
+
+class TestFusedSoftmaxMask:
+    def test_softmax_mask_fuse(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 4, 8, 8).astype(np.float32)
+        mask = np.where(rng.rand(2, 1, 8, 8) > 0.5, 0.0,
+                        -10000.0).astype(np.float32)
+        got = P.incubate.softmax_mask_fuse(
+            P.to_tensor(x), P.to_tensor(mask)).numpy()
+        s = x + mask
+        e = np.exp(s - s.max(-1, keepdims=True))
+        want = e / e.sum(-1, keepdims=True)
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_softmax_mask_fuse_upper_triangle(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(1, 2, 6, 6).astype(np.float32)
+        got = P.incubate.softmax_mask_fuse_upper_triangle(
+            P.to_tensor(x)).numpy()
+        # rows attend only to columns <= row
+        for r in range(6):
+            np.testing.assert_allclose(got[0, 0, r, r + 1:], 0.0, atol=1e-8)
+            np.testing.assert_allclose(got[0, 0, r].sum(), 1.0, rtol=1e-5)
+
+    def test_graph_send_recv_alias(self):
+        x = P.to_tensor(np.arange(6, dtype=np.float32).reshape(3, 2))
+        src = P.to_tensor(np.array([0, 1, 2]), dtype="int64")
+        dst = P.to_tensor(np.array([1, 2, 1]), dtype="int64")
+        out = P.incubate.graph_send_recv(x, src, dst, pool_type="sum")
+        want = np.zeros((3, 2), np.float32)
+        want[1] = x.numpy()[0] + x.numpy()[2]
+        want[2] = x.numpy()[1]
+        np.testing.assert_allclose(out.numpy(), want)
+
+    def test_identity_loss(self):
+        x = P.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        assert float(P.incubate.identity_loss(x, "sum")) == 6.0
+        assert float(P.incubate.identity_loss(x, "mean")) == 2.0
+        np.testing.assert_allclose(
+            P.incubate.identity_loss(x, "none").numpy(), x.numpy())
+
+
+class TestAutogradFunctional:
+    def test_vjp_with_cotangent(self):
+        x = P.to_tensor(np.array([1.0, 2.0], np.float32))
+        v = P.to_tensor(np.array([[1.0, 0.0], [0.0, 2.0]], np.float32))
+        out, g = P.autograd.vjp(lambda t: P.stack([t * t, t ** 3]), x)
+        np.testing.assert_allclose(out.numpy(),
+                                   [[1.0, 4.0], [1.0, 8.0]], rtol=1e-6)
+        # default cotangent of ones: d/dx sum(x^2 + x^3) = 2x + 3x^2
+        np.testing.assert_allclose(g.numpy(), [5.0, 16.0], rtol=1e-6)
+
+    def test_jvp_forward_mode(self):
+        x = P.to_tensor(np.array([3.0], np.float32))
+        v = P.to_tensor(np.array([2.0], np.float32))
+        _, tang = P.autograd.jvp(lambda t: t * t, x, v)
+        np.testing.assert_allclose(tang.numpy(), [12.0], rtol=1e-6)
+
+    def test_jacobian_and_hessian(self):
+        x = P.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        J = P.autograd.Jacobian(lambda t: t * t, x)
+        np.testing.assert_allclose(np.asarray(J[:].numpy()),
+                                   np.diag([2.0, 4.0, 6.0]), rtol=1e-6)
+        H = P.autograd.Hessian(lambda t: (t ** 3).sum(), x)
+        np.testing.assert_allclose(np.asarray(H[:].numpy()),
+                                   np.diag([6.0, 12.0, 18.0]), rtol=1e-5)
+
+    def test_incubate_alias(self):
+        assert P.incubate.autograd.vjp is P.autograd.vjp
+
+
+class TestInterop:
+    def test_dlpack_roundtrip(self):
+        x = P.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+        cap = P.utils.dlpack.to_dlpack(x)
+        y = P.utils.dlpack.from_dlpack(cap)
+        np.testing.assert_allclose(y.numpy(), x.numpy())
+
+    def test_dlpack_from_torch(self):
+        torch = pytest.importorskip("torch")
+        t = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+        y = P.utils.dlpack.from_dlpack(t)
+        np.testing.assert_allclose(y.numpy(), t.numpy())
+
+    def test_batch_reader(self):
+        def reader():
+            yield from range(7)
+
+        batches = list(P.batch(reader, 3)())
+        assert batches == [[0, 1, 2], [3, 4, 5], [6]]
+        batches = list(P.batch(reader, 3, drop_last=True)())
+        assert batches == [[0, 1, 2], [3, 4, 5]]
+
+    def test_device_namespace(self):
+        assert P.device.cuda.device_count() == 0
+        assert isinstance(P.device.get_device(), str)
+        P.device.synchronize()
+        types = P.device.get_all_device_type()
+        assert "cpu" in types

@@ -141,12 +141,17 @@ class TestDeepFM:
         # learnable target: label depends on one field's id parity
         y = paddle_tpu.to_tensor(
             (np.asarray(ids._value)[:, 0] % 2).astype(np.float32))
-        first = last = None
-        for _ in range(40):
+        @paddle_tpu.jit.to_static
+        def step(ids, dense, y):
             opt.clear_grad()
             loss = crit(model(ids, dense), y)
             loss.backward()
             opt.step()
+            return loss
+
+        first = last = None
+        for _ in range(40):
+            loss = step(ids, dense, y)
             first = first if first is not None else float(loss)
             last = float(loss)
         assert last < first * 0.5, (first, last)

@@ -121,6 +121,7 @@ def test_native_loader_restarts_after_early_break():
     loader.close()
 
 
+@pytest.mark.nightly  # construction-only regression; zoo forward covers it
 def test_shufflenet_act_none_constructible():
     from paddle_tpu.vision.models import ShuffleNetV2
     ShuffleNetV2(scale=0.25, act=None, num_classes=4)

@@ -37,6 +37,7 @@ def test_lenet_roundtrip(tmp_path):
     _roundtrip(model, [x], tmp_path)
 
 
+@pytest.mark.nightly  # conv/BN/residual ONNX ops stay covered by LeNet
 def test_resnet18_roundtrip(tmp_path):
     from paddle_tpu.vision.models import resnet18
     paddle.seed(2)

@@ -1,0 +1,112 @@
+"""text.datasets / audio.datasets / audio wave backend.
+
+Reference: python/paddle/text/datasets/, python/paddle/audio/datasets/,
+python/paddle/audio/backends/wave_backend.py.
+"""
+import numpy as np
+
+import paddle_tpu as P
+from paddle_tpu.text import datasets as tds
+
+
+class TestTextDatasets:
+    def test_imdb_shapes_and_learnability_signal(self):
+        ds = tds.Imdb(mode="train", cutoff=150)
+        doc, label = ds[0]
+        assert doc.dtype == np.int64 and doc.ndim == 1
+        assert label.shape == (1,)
+        assert doc.max() < 150
+        # class-conditional token distributions must differ (learnable)
+        pos = np.concatenate([ds[i][0] for i in range(len(ds))
+                              if ds[i][1][0] == 1])
+        neg = np.concatenate([ds[i][0] for i in range(len(ds))
+                              if ds[i][1][0] == 0])
+        assert abs(pos.mean() - neg.mean()) > 5
+
+    def test_imikolov_ngram_and_seq(self):
+        ds = tds.Imikolov(data_type="NGRAM", window_size=5)
+        assert len(ds[0]) == 5
+        ds2 = tds.Imikolov(data_type="SEQ")
+        src, trg = ds2[0]
+        assert src.shape == trg.shape
+
+    def test_uci_housing_regression_learns(self):
+        ds = tds.UCIHousing(mode="train")
+        x, y = ds[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        xs = np.stack([ds[i][0] for i in range(len(ds))])
+        ys = np.stack([ds[i][1] for i in range(len(ds))])[:, 0]
+        w, *_ = np.linalg.lstsq(xs, ys, rcond=None)
+        resid = ys - xs @ w
+        assert resid.var() < 0.05 * ys.var()  # linear structure present
+
+    def test_movielens_tuple_layout(self):
+        ds = tds.Movielens(mode="train")
+        item = ds[0]
+        assert len(item) == 8
+        assert 1.0 <= float(item[-1][0]) <= 5.0
+
+    def test_conll05_aligned_lengths(self):
+        ds = tds.Conll05()
+        item = ds[0]
+        assert len(item) == 9
+        lens = {len(part) for part in item}
+        assert len(lens) == 1
+
+    def test_wmt_translation_framing(self):
+        ds = tds.WMT14(mode="train")
+        src, trg, trg_next = ds[0]
+        assert trg[0] == ds.BOS
+        assert trg_next[-1] == ds.EOS
+        np.testing.assert_array_equal(trg[1:], trg_next[:-1])
+        d = ds.get_dict()
+        assert len(d) == ds.dict_size
+        tds.WMT16(mode="test")  # constructible
+
+
+class TestAudioBackend:
+    def test_wav_save_load_roundtrip(self, tmp_path):
+        sr = 8000
+        t = np.arange(sr // 4, dtype=np.float32) / sr
+        wav = (0.3 * np.sin(2 * np.pi * 440 * t)).astype(np.float32)[None]
+        path = tmp_path / "t.wav"
+        P.audio.save(str(path), P.to_tensor(wav), sr)
+        meta = P.audio.info(str(path))
+        assert meta.sample_rate == sr
+        assert meta.num_channels == 1
+        back, sr2 = P.audio.load(str(path))
+        assert sr2 == sr
+        np.testing.assert_allclose(back.numpy(), wav, atol=2e-4)
+
+    def test_load_frame_window(self, tmp_path):
+        sr = 8000
+        wav = np.linspace(-0.5, 0.5, sr, dtype=np.float32)[None]
+        path = tmp_path / "w.wav"
+        P.audio.save(str(path), P.to_tensor(wav), sr)
+        part, _ = P.audio.load(str(path), frame_offset=100, num_frames=50)
+        assert part.shape == [1, 50]
+        np.testing.assert_allclose(part.numpy()[0], wav[0, 100:150],
+                                   atol=2e-4)
+
+
+class TestAudioDatasets:
+    def test_tess_raw_and_melspectrogram(self):
+        ds = P.audio.datasets.TESS(mode="train", feat_type="raw")
+        wav, label = ds[0]
+        assert wav.dtype == np.float32 and wav.ndim == 1
+        assert 0 <= int(label) < 7
+        assert np.abs(wav).max() <= 0.5 + 1e-6
+        ds2 = P.audio.datasets.TESS(mode="dev", feat_type="melspectrogram",
+                                    n_fft=256, hop_length=128, n_mels=32)
+        feat, _ = ds2[1]
+        assert feat.ndim == 2 and feat.shape[0] == 32
+
+    def test_classes_are_spectrally_distinct(self):
+        ds = P.audio.datasets.ESC50(mode="test")
+        w0, l0 = ds[0]
+        w1, l1 = ds[1]
+        assert int(l0) != int(l1)
+        # different fundamentals -> dominant FFT bins differ
+        b0 = np.abs(np.fft.rfft(w0)).argmax()
+        b1 = np.abs(np.fft.rfft(w1)).argmax()
+        assert b0 != b1

@@ -1,0 +1,128 @@
+"""New vision transforms: color ops, grayscale, pad, rotate/affine/
+perspective warps, random erasing, full ColorJitter.
+
+Reference: python/paddle/vision/transforms/transforms.py + functional.py.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.vision import transforms as T
+
+
+def _img(h=8, w=10, c=3, seed=0):
+    return np.random.RandomState(seed).randint(
+        0, 256, (h, w, c)).astype(np.uint8)
+
+
+class TestColorOps:
+    def test_adjust_brightness(self):
+        img = _img()
+        out = T.adjust_brightness(img, 2.0)
+        assert out.dtype == np.uint8
+        np.testing.assert_array_equal(
+            out, np.clip(img.astype(np.float32) * 2, 0, 255).astype(np.uint8))
+
+    def test_adjust_contrast_identity(self):
+        img = _img()
+        np.testing.assert_array_equal(T.adjust_contrast(img, 1.0), img)
+
+    def test_adjust_contrast_zero_is_gray_mean(self):
+        img = _img()
+        out = T.adjust_contrast(img, 0.0).astype(np.float32)
+        assert out.std() < 1.0  # collapsed to a constant
+
+    def test_adjust_saturation_zero_is_grayscale(self):
+        img = _img()
+        out = T.adjust_saturation(img, 0.0)
+        np.testing.assert_allclose(out[..., 0], out[..., 1], atol=1)
+        np.testing.assert_allclose(out[..., 1], out[..., 2], atol=1)
+
+    def test_adjust_hue_identity_and_range(self):
+        img = _img()
+        np.testing.assert_allclose(T.adjust_hue(img, 0.0), img, atol=2)
+        with pytest.raises(ValueError):
+            T.adjust_hue(img, 0.6)
+        out = T.adjust_hue(img, 0.25)
+        assert out.shape == img.shape
+        # hue rotation preserves value (max channel) exactly in HSV
+        np.testing.assert_allclose(out.max(-1), img.max(-1), atol=2)
+
+    def test_grayscale(self):
+        img = _img()
+        g1 = T.Grayscale(1)(img)
+        assert g1.shape == (8, 10, 1)
+        g3 = T.Grayscale(3)(img)
+        np.testing.assert_array_equal(g3[..., 0], g3[..., 2])
+
+    def test_color_jitter_runs_all_ops(self):
+        np.random.seed(0)
+        img = _img()
+        out = T.ColorJitter(0.4, 0.4, 0.4, 0.2)(img)
+        assert out.shape == img.shape
+
+
+class TestPadWarp:
+    def test_pad_constant_and_modes(self):
+        img = _img(4, 4)
+        out = T.Pad(2, fill=7)(img)
+        assert out.shape == (8, 8, 3)
+        assert (out[:2] == 7).all()
+        out = T.Pad((1, 2), padding_mode="edge")(img)
+        assert out.shape == (4 + 4, 4 + 2, 3)
+        np.testing.assert_array_equal(out[0, 1], img[0, 0])
+
+    def test_rotate_90_exact(self):
+        img = _img(6, 6)
+        out = T.rotate(img, 90, interpolation="nearest")
+        # 90° CCW about the center (torchvision/paddle convention:
+        # positive angle is counter-clockwise): out == np.rot90 variant
+        np.testing.assert_array_equal(out, np.rot90(img, k=-1))
+
+    def test_rotate_expand_grows_canvas(self):
+        img = _img(4, 8)
+        out = T.rotate(img, 90, expand=True)
+        assert out.shape[:2] == (8, 4)
+
+    def test_random_rotation_zero_is_identity(self):
+        img = _img()
+        np.testing.assert_array_equal(T.RandomRotation(0.0)(img), img)
+
+    def test_affine_identity(self):
+        img = _img()
+        out = T.affine(img, 0.0, (0, 0), 1.0, (0.0, 0.0))
+        np.testing.assert_array_equal(out, img)
+
+    def test_affine_translate(self):
+        img = _img(6, 6)
+        out = T.affine(img, 0.0, (2, 0), 1.0, (0.0, 0.0), fill=0)
+        np.testing.assert_array_equal(out[:, 2:], img[:, :-2])
+        assert (out[:, :2] == 0).all()
+
+    def test_perspective_identity(self):
+        img = _img(6, 6)
+        pts = [[0, 0], [5, 0], [5, 5], [0, 5]]
+        out = T.perspective(img, pts, pts)
+        np.testing.assert_array_equal(out, img)
+
+    def test_random_perspective_prob_zero(self):
+        img = _img()
+        np.testing.assert_array_equal(
+            T.RandomPerspective(prob=0.0)(img), img)
+
+
+class TestErase:
+    def test_erase_region_hwc(self):
+        img = _img()
+        out = T.erase(img, 2, 3, 4, 5, 0)
+        assert (out[2:6, 3:8] == 0).all()
+        assert (out[:2] == img[:2]).all()
+
+    def test_random_erasing_always(self):
+        np.random.seed(0)
+        img = np.full((16, 16, 3), 200, np.uint8)
+        out = T.RandomErasing(prob=1.0, value=0)(img)
+        assert (out == 0).sum() > 0
+
+    def test_functional_alias(self):
+        import paddle_tpu
+        assert paddle_tpu.vision.transforms.functional.rotate is T.rotate

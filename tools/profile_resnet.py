@@ -111,8 +111,15 @@ def main():
     per_step_stream = (time.perf_counter() - t0) / args.iters
 
     dev = jax.devices()[0]
-    peak = 197e12 if "v5" in getattr(dev, "device_kind", "") else 197e12
-    flops_img = 3 * 4.1e9
+    import importlib.util as _u
+    _spec = _u.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py"))
+    _bench = _u.module_from_spec(_spec)
+    _spec.loader.exec_module(_bench)
+    peak = _bench._lookup(_bench._PEAK_TFLOPS,
+                          getattr(dev, "device_kind", ""), 197.0) * 1e12
+    flops_img = _bench._RESNET50_TRAIN_FLOPS  # FLOPs (2x MACs), like bench
     for name, t in [("synced", per_step_synced), ("stream", per_step_stream)]:
         img_s = args.batch / t
         print(f"{name}: {t*1e3:.1f} ms/step  {img_s:.0f} img/s  "
