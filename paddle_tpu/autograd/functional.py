@@ -90,12 +90,21 @@ class Jacobian:
         xs = _as_list(xs)
         fn = _purify(func, len(xs))
         vals = _values(xs)
+
+        def single_out(*a):
+            out = fn(*a)
+            if isinstance(out, tuple):
+                raise TypeError(
+                    "Jacobian expects func returning a single Tensor "
+                    "(reference functional.Jacobian contract); got a tuple")
+            return out
+
+        argnums = tuple(range(len(vals)))
         if is_batched:
-            jac = jax.vmap(jax.jacrev(
-                lambda *a: fn(*a)))(*vals)
+            jac = jax.vmap(jax.jacrev(single_out, argnums=argnums))(*vals)
         else:
-            jac = jax.jacrev(fn, argnums=tuple(range(len(vals))))(*vals)
-            jac = jac[0] if len(vals) == 1 else jac
+            jac = jax.jacrev(single_out, argnums=argnums)(*vals)
+        jac = jac[0] if len(vals) == 1 else jac
         self._jac = Tensor(jnp.asarray(jac)) if not isinstance(jac, tuple) \
             else tuple(Tensor(jnp.asarray(j)) for j in jac)
 
@@ -123,11 +132,17 @@ class Hessian:
             out = out[0] if isinstance(out, tuple) else out
             return jnp.reshape(out, ())
 
+        argnums = tuple(range(len(vals)))
         if is_batched:
-            hess = jax.vmap(jax.hessian(scalar_fn))(*vals)
+            hess = jax.vmap(jax.hessian(scalar_fn, argnums=argnums))(*vals)
         else:
-            hess = jax.hessian(scalar_fn)(*vals)
-        self._hess = Tensor(jnp.asarray(hess))
+            hess = jax.hessian(scalar_fn, argnums=argnums)(*vals)
+        if len(vals) == 1:
+            self._hess = Tensor(jnp.asarray(hess[0][0]))
+        else:
+            # full block structure: tuple-of-tuples of Tensors
+            self._hess = tuple(
+                tuple(Tensor(jnp.asarray(b)) for b in row) for row in hess)
 
     def __getitem__(self, idx):
         return self._hess[idx]

@@ -1,0 +1,279 @@
+"""Automatic SParsity (n:m structured pruning).
+
+Reference parity: python/paddle/incubate/asp/__init__.py exporting
+fluid/contrib/sparsity/{utils,asp}.py (get_mask_1d :186,
+get_mask_2d_best :433, create_mask :487, check_sparsity :556,
+prune_model / decorate in asp.py).
+
+The reference targets NVIDIA sparse tensor cores; TPUs have no 2:4
+hardware path, so here ASP is the hardware-agnostic part of the story:
+mask generation, pruning, and the optimizer decoration that keeps pruned
+weights at zero through training (masks re-applied after each step as a
+multiply the XLA compiler fuses into the update).
+"""
+from __future__ import annotations
+
+from enum import Enum
+from itertools import combinations, product
+
+import numpy as np
+
+__all__ = [
+    "MaskAlgo", "CheckMethod", "calculate_density", "get_mask_1d",
+    "get_mask_2d_greedy", "get_mask_2d_best", "create_mask",
+    "check_mask_1d", "check_mask_2d", "check_sparsity", "decorate",
+    "prune_model", "set_excluded_layers", "reset_excluded_layers",
+]
+
+
+class MaskAlgo(Enum):
+    MASK_1D = "get_mask_1d"
+    MASK_2D_GREEDY = "get_mask_2d_greedy"
+    MASK_2D_BEST = "get_mask_2d_best"
+
+
+class CheckMethod(Enum):
+    CHECK_1D = "check_mask_1d"
+    CHECK_2D = "check_mask_2d"
+
+    @staticmethod
+    def get_checking_method(mask_algo):
+        assert isinstance(mask_algo, MaskAlgo)
+        return CheckMethod.CHECK_1D if mask_algo == MaskAlgo.MASK_1D \
+            else CheckMethod.CHECK_2D
+
+
+def calculate_density(x):
+    """Fraction of non-zero entries (reference utils.py:93)."""
+    x = np.asarray(x)
+    return float(np.count_nonzero(x)) / x.size
+
+
+def _pad_to(mat, m):
+    h, w = mat.shape
+    ph = (m - h % m) % m
+    pw = (m - w % m) % m
+    if ph or pw:
+        mat = np.pad(mat, ((0, ph), (0, pw)))
+    return mat, h, w
+
+
+def get_mask_1d(mat, n, m):
+    """Keep the n largest-|.|(of every m consecutive values along rows)."""
+    mat = np.asarray(mat)
+    padded, h, w = _pad_to(mat, m)
+    blocks = np.abs(padded.reshape(padded.shape[0], -1, m))
+    order = np.argsort(-blocks, axis=-1)
+    mask = np.zeros_like(blocks)
+    np.put_along_axis(mask, order[..., :n], 1.0, axis=-1)
+    return mask.reshape(padded.shape)[:h, :w]
+
+
+def get_mask_2d_greedy(mat, n, m):
+    """m x m blocks with at most n survivors per row AND column, chosen
+    greedily by magnitude (reference utils.py get_mask_2d_greedy)."""
+    mat = np.asarray(mat)
+    padded, h, w = _pad_to(mat, m)
+    mask = np.zeros_like(padded)
+    for bi in range(0, padded.shape[0], m):
+        for bj in range(0, padded.shape[1], m):
+            block = np.abs(padded[bi:bi + m, bj:bj + m])
+            order = np.argsort(-block.ravel())
+            rows = np.zeros(m, np.int64)
+            cols = np.zeros(m, np.int64)
+            taken = np.zeros((m, m), bool)
+            for flat in order:
+                r, c = divmod(int(flat), m)
+                if rows[r] < n and cols[c] < n:
+                    taken[r, c] = True
+                    rows[r] += 1
+                    cols[c] += 1
+            # pure greedy can strand capacity (a deficient row's only
+            # open columns are ones it already uses); complete to
+            # exactly n per row AND col with one-swap augmenting moves
+            while (rows < n).any():
+                r = int(np.argmin(rows))
+                deficit = [c for c in range(m) if cols[c] < n]
+                free = [c for c in deficit if not taken[r, c]]
+                if free:
+                    c = max(free, key=lambda cc: block[r, cc])
+                    taken[r, c] = True
+                    rows[r] += 1
+                    cols[c] += 1
+                    continue
+                c = deficit[0]
+                for c2 in range(m):
+                    if cols[c2] >= n and not taken[r, c2]:
+                        donors = [rr for rr in range(m)
+                                  if taken[rr, c2] and not taken[rr, c]]
+                        if donors:
+                            # a donor always exists: col c2 has n users,
+                            # deficit col c has < n, so some c2-user is
+                            # free to move to c
+                            rr = max(donors, key=lambda x: block[x, c])
+                            taken[rr, c2] = False
+                            taken[rr, c] = True
+                            cols[c2] -= 1
+                            cols[c] += 1
+                            taken[r, c2] = True
+                            cols[c2] += 1
+                            rows[r] += 1
+                            break
+            mask[bi:bi + m, bj:bj + m] = taken
+    return mask[:h, :w]
+
+
+def _best_patterns(n, m):
+    """All m x m 0/1 patterns with exactly n per row and per column."""
+    key = (n, m)
+    if key not in _best_patterns._cache:
+        row_choices = list(combinations(range(m), n))
+        pats = []
+        # product, not permutations: rows may legally pick the SAME
+        # column set (e.g. the 2:4 block-diagonal pattern)
+        for rows in product(row_choices, repeat=m) if m <= 4 else ():
+            p = np.zeros((m, m))
+            for r, cols in enumerate(rows):
+                p[r, list(cols)] = 1.0
+            if (p.sum(0) == n).all():
+                pats.append(p)
+        _best_patterns._cache[key] = pats
+    return _best_patterns._cache[key]
+
+
+_best_patterns._cache = {}
+
+
+def get_mask_2d_best(mat, n, m):
+    """Exhaustive best n:m 2-D pattern per m x m block (m<=4; falls back
+    to greedy otherwise) — reference utils.py:433."""
+    pats = _best_patterns(n, m)
+    if not pats:
+        return get_mask_2d_greedy(mat, n, m)
+    mat = np.asarray(mat)
+    padded, h, w = _pad_to(mat, m)
+    mask = np.zeros_like(padded)
+    stack = np.stack(pats)  # [P, m, m]
+    for bi in range(0, padded.shape[0], m):
+        for bj in range(0, padded.shape[1], m):
+            block = np.abs(padded[bi:bi + m, bj:bj + m])
+            scores = (stack * block).sum(axis=(1, 2))
+            mask[bi:bi + m, bj:bj + m] = stack[int(scores.argmax())]
+    return mask[:h, :w]
+
+
+def create_mask(tensor, func_name=MaskAlgo.MASK_1D, n=2, m=4):
+    """n:m mask with the same shape as `tensor`; >2-D tensors are pruned
+    on their 2-D [prod(leading), last] view (reference utils.py:487)."""
+    arr = np.asarray(tensor, dtype=np.float32)
+    shape = arr.shape
+    mat = arr.reshape(-1, shape[-1]) if arr.ndim != 2 else arr
+    fn = globals()[func_name.value if isinstance(func_name, MaskAlgo)
+                   else str(func_name)]
+    return fn(mat, n, m).reshape(shape)
+
+
+def check_mask_1d(mat, n, m):
+    mat = np.asarray(mat)
+    padded, _, _ = _pad_to(mat, m)
+    blocks = padded.reshape(padded.shape[0], -1, m)
+    return bool((np.count_nonzero(blocks, axis=-1) <= n).all())
+
+
+def check_mask_2d(mat, n, m):
+    mat = np.asarray(mat)
+    padded, _, _ = _pad_to(mat, m)
+    for bi in range(0, padded.shape[0], m):
+        for bj in range(0, padded.shape[1], m):
+            b = padded[bi:bi + m, bj:bj + m]
+            if (np.count_nonzero(b, axis=0) > n).any() or \
+                    (np.count_nonzero(b, axis=1) > n).any():
+                return False
+    return True
+
+
+def check_sparsity(tensor, func_name=CheckMethod.CHECK_1D, n=2, m=4):
+    arr = np.asarray(tensor)
+    mat = arr.reshape(-1, arr.shape[-1]) if arr.ndim != 2 else arr
+    fn = globals()[func_name.value if isinstance(func_name, CheckMethod)
+                   else str(func_name)]
+    return fn(mat, n, m)
+
+
+# ---------------------------------------------------------------- ASP state
+class ASPHelper:
+    """Per-process mask registry (reference asp.py ASPHelper)."""
+
+    _masks = {}          # id(param) -> (param, mask ndarray)
+    _excluded = set()    # layer-name prefixes
+
+    @classmethod
+    def is_supported(cls, name, param):
+        if any(name.startswith(e) for e in cls._excluded):
+            return False
+        shape = tuple(param._value.shape)
+        if len(shape) < 2:
+            return False
+        return shape[-1] % 4 == 0
+
+    @classmethod
+    def prune(cls, model, n, m, mask_algo, with_mask):
+        import jax.numpy as jnp
+        pruned = {}
+        for name, p in model.named_parameters():
+            if not name.endswith("weight") or not cls.is_supported(name, p):
+                continue
+            mask = create_mask(np.asarray(p._value), mask_algo, n, m)
+            p._set_value(p._value * jnp.asarray(mask, p._value.dtype))
+            if with_mask:
+                cls._masks[id(p)] = (p, mask)
+            pruned[name] = mask
+        return pruned
+
+    @classmethod
+    def apply_masks(cls):
+        import jax.numpy as jnp
+        for p, mask in cls._masks.values():
+            p._set_value(p._value * jnp.asarray(mask, p._value.dtype))
+
+
+def set_excluded_layers(param_names, main_program=None):
+    ASPHelper._excluded.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    ASPHelper._excluded.clear()
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Prune every supported weight of `model` to n:m sparsity and (with
+    with_mask) register the masks so a decorated optimizer keeps them
+    (reference asp.py prune_model)."""
+    algo = {"mask_1d": MaskAlgo.MASK_1D,
+            "mask_2d_greedy": MaskAlgo.MASK_2D_GREEDY,
+            "mask_2d_best": MaskAlgo.MASK_2D_BEST}[mask_algo]
+    return ASPHelper.prune(model, n, m, algo, with_mask)
+
+
+class OptimizerWithSparsityGuarantee:
+    """Re-applies the registered masks after every step so pruned weights
+    stay exactly zero through training (reference asp.py decorate)."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def step(self):
+        self._optimizer.step()
+        ASPHelper.apply_masks()
+
+    def minimize(self, loss, *args, **kwargs):
+        out = self._optimizer.minimize(loss, *args, **kwargs)
+        ASPHelper.apply_masks()
+        return out
+
+
+def decorate(optimizer):
+    return OptimizerWithSparsityGuarantee(optimizer)

@@ -59,22 +59,24 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
     """Multi-hop neighbor sampling: iterate geometric.sample_neighbors
     over `sample_sizes` hops and reindex the union subgraph (reference
     operators/graph_khop_sampler.py)."""
-    import numpy as np
-
     from paddle_tpu.geometric import reindex_graph, sample_neighbors
 
+    # hop h samples around the previous hop's frontier; reindex pairs
+    # every seed (all hops concatenated) with its own neighbor count
+    seeds_per_hop, all_neighbors, all_counts = [], [], []
     nodes = input_nodes
-    all_neighbors, all_counts = [], []
     for size in sample_sizes:
         neigh, counts = sample_neighbors(row, colptr, nodes,
                                          sample_size=size)
+        seeds_per_hop.append(nodes)
         all_neighbors.append(neigh)
         all_counts.append(counts)
         nodes = neigh
+    seeds = paddle_concat(seeds_per_hop)
     neighbors = paddle_concat(all_neighbors)
     counts = paddle_concat(all_counts)
     reindex_src, reindex_dst, out_nodes = reindex_graph(
-        input_nodes, neighbors, counts)
+        seeds, neighbors, counts)
     return reindex_src, reindex_dst, out_nodes, counts
 
 

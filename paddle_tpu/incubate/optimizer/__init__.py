@@ -34,10 +34,15 @@ class LookAhead(Optimizer):
         assert inner_optimizer is not None, "inner optimizer can not be None"
         assert 0.0 <= alpha <= 1.0, "alpha should be in [0, 1]"
         assert isinstance(k, int) and k > 0, "k should be a positive integer"
+        # base init so every inherited Optimizer API (set_lr,
+        # _learning_rate, _acc, state_dict plumbing) has its attributes;
+        # like the reference (lookahead.py:133), LookAhead's own lr IS
+        # alpha — the task lr lives on the inner optimizer
+        super().__init__(learning_rate=alpha,
+                         parameters=inner_optimizer._parameter_list)
         self.inner_optimizer = inner_optimizer
         self.alpha = alpha
         self.k = k
-        self._parameter_list = inner_optimizer._parameter_list
         self._step_counter = _state("lookahead_step", jnp.zeros((), jnp.int32))
         # slow weights snapshot the initial fast weights (created eagerly:
         # lazy creation inside a to_static trace could not be re-initialised
@@ -46,9 +51,6 @@ class LookAhead(Optimizer):
         self._slow = {id(p): _state(f"{p.name}_slow",
                                     p._value.astype(jnp.float32) + 0)
                       for p in self._params()}
-
-    def get_lr(self):
-        return self.inner_optimizer.get_lr()
 
     @no_grad()
     def step(self):

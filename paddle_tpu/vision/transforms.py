@@ -185,17 +185,13 @@ class Transpose(BaseTransform):
 class BrightnessTransform(BaseTransform):
     def __init__(self, value, keys=None):
         super().__init__(keys)
-        if isinstance(value, (list, tuple)):
-            self.range = (float(value[0]), float(value[1]))
-        else:
-            self.range = (max(0, 1 - value), 1 + value)
+        self.range = _jitter_range(value, "brightness")
         self.value = value
 
     def _apply_image(self, img):
-        arr = _to_hwc(img).astype(np.float32)
-        factor = np.random.uniform(*self.range)
-        return np.clip(arr * factor, 0, 255).astype(np.uint8) \
-            if arr.max() > 1.5 else np.clip(arr * factor, 0, 1)
+        if self.range == (1.0, 1.0):
+            return _to_hwc(img)
+        return adjust_brightness(img, np.random.uniform(*self.range))
 
 
 def _scale_clip(arr, out):
@@ -600,8 +596,7 @@ class RandomErasing(BaseTransform):
         if np.random.rand() >= self.prob:
             return img
         arr = img.numpy() if isinstance(img, Tensor) else np.asarray(img)
-        chw = (arr.ndim == 3 and arr.shape[0] in (1, 3)
-               and arr.shape[2] not in (1, 3))
+        chw = _looks_chw(arr)
         h, w = (arr.shape[1], arr.shape[2]) if chw else arr.shape[:2]
         area = h * w
         for _ in range(10):

@@ -1,0 +1,87 @@
+"""Automatic SParsity (n:m pruning). Reference:
+python/paddle/incubate/asp/ + fluid/contrib/sparsity/{utils,asp}.py."""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu.incubate import asp
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    asp.ASPHelper._masks.clear()
+    asp.reset_excluded_layers()
+    yield
+    asp.ASPHelper._masks.clear()
+    asp.reset_excluded_layers()
+
+
+class TestMasks:
+    def test_mask_1d_keeps_two_largest_of_four(self):
+        mat = np.array([[0.1, -3.0, 2.0, 0.05, 5.0, 0.2, -0.3, 1.0]])
+        mask = asp.get_mask_1d(mat, 2, 4)
+        np.testing.assert_array_equal(
+            mask, [[0, 1, 1, 0, 1, 0, 0, 1]])
+        assert asp.check_mask_1d(mat * mask, 2, 4)
+
+    def test_mask_2d_greedy_row_and_col_budget(self):
+        rng = np.random.RandomState(0)
+        mat = rng.randn(8, 8)
+        mask = asp.get_mask_2d_greedy(mat, 2, 4)
+        assert asp.check_mask_2d(mask, 2, 4)
+        assert abs(asp.calculate_density(mask) - 0.5) < 1e-6
+
+    def test_mask_2d_best_at_least_as_good_as_greedy(self):
+        rng = np.random.RandomState(1)
+        mat = rng.randn(4, 4)
+        g = (np.abs(mat) * asp.get_mask_2d_greedy(mat, 2, 4)).sum()
+        b = (np.abs(mat) * asp.get_mask_2d_best(mat, 2, 4)).sum()
+        assert b >= g - 1e-9
+        assert asp.check_mask_2d(asp.get_mask_2d_best(mat, 2, 4), 2, 4)
+
+    def test_create_and_check_on_conv_shape(self):
+        rng = np.random.RandomState(2)
+        w = rng.randn(8, 3, 3, 4)  # last dim % 4 == 0
+        mask = asp.create_mask(w, asp.MaskAlgo.MASK_1D, 2, 4)
+        assert mask.shape == w.shape
+        assert asp.check_sparsity(w * mask, asp.CheckMethod.CHECK_1D, 2, 4)
+
+    def test_density(self):
+        x = np.zeros((4, 4))
+        x[0, 0] = 1
+        assert asp.calculate_density(x) == 1 / 16
+
+
+class TestPruneAndTrain:
+    def test_prune_model_halves_density_and_decorated_step_keeps_it(self):
+        P.seed(0)
+        model = P.nn.Sequential(
+            P.nn.Linear(16, 32), P.nn.ReLU(), P.nn.Linear(32, 4))
+        pruned = asp.prune_model(model, n=2, m=4)
+        assert len(pruned) == 2
+        for _, p in model.named_parameters():
+            if p._value.ndim == 2:
+                assert abs(asp.calculate_density(p.numpy()) - 0.5) < 1e-6
+
+        opt = asp.decorate(P.optimizer.SGD(
+            learning_rate=0.1, parameters=model.parameters()))
+        x = P.to_tensor(np.random.RandomState(0).randn(8, 16)
+                        .astype(np.float32))
+        for _ in range(3):
+            opt.clear_grad()
+            (model(x) ** 2).mean().backward()
+            opt.step()
+        for _, p in model.named_parameters():
+            if p._value.ndim == 2:
+                # pruned positions stayed exactly zero through training
+                assert abs(asp.calculate_density(p.numpy()) - 0.5) < 1e-6
+                assert asp.check_sparsity(p.numpy(), n=2, m=4)
+
+    def test_excluded_layers_respected(self):
+        P.seed(0)
+        model = P.nn.Sequential(P.nn.Linear(8, 8), P.nn.Linear(8, 8))
+        name0 = next(iter(dict(model.named_parameters())))
+        asp.set_excluded_layers([name0.rsplit(".", 1)[0]])
+        pruned = asp.prune_model(model)
+        assert all(not k.startswith(name0.rsplit(".", 1)[0])
+                   for k in pruned)
