@@ -66,6 +66,7 @@ from paddle_tpu import (  # noqa: F401,E402
     fft,
     framework,
     geometric,
+    hub,
     incubate,
     inference,
     io,
@@ -73,7 +74,6 @@ from paddle_tpu import (  # noqa: F401,E402
     linalg,
     metric,
     nn,
-    onnx,
     optimizer,
     profiler,
     quantization,
@@ -136,6 +136,160 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
     if print_detail:
         print(f"Total FLOPs: {total[0]}")
     return total[0]
+
+
+# ---- remaining reference top-level surface (python/paddle/__init__.py) ----
+from paddle_tpu.distributed.parallel import DataParallel  # noqa: E402,F401
+from paddle_tpu.nn.initializer import ParamAttr  # noqa: E402,F401
+
+
+def cast(x, dtype):
+    """paddle.cast(x, dtype) (the method form is Tensor.cast)."""
+    return x.cast(dtype)
+
+
+def reverse(x, axis, name=None):
+    """Legacy alias of flip (reference keeps both)."""
+    return flip(x, axis)
+
+
+def tolist(x):
+    return x.tolist()
+
+
+def index_add_(x, index, axis, value, name=None):
+    """In-place index_add (reference index_add_): same tape semantics as
+    the out-of-place op, result written back into x."""
+    out = index_add(x, index, axis, value)
+    x._set_value(out._value)
+    return x
+
+
+def frexp(x, name=None):
+    """(mantissa, exponent) with x = mantissa * 2**exponent,
+    0.5 <= |mantissa| < 1 (reference tensor/math.py frexp)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.dispatch import apply
+    def fn(v):
+        exp = jnp.where(v == 0, 0.0, jnp.floor(jnp.log2(jnp.abs(v))) + 1.0)
+        mant = v / jnp.exp2(exp)
+        return mant, exp.astype(v.dtype)
+    return apply(fn, x)
+
+
+class iinfo:
+    """Integer dtype limits (reference paddle.iinfo)."""
+
+    def __init__(self, dtype):
+        import numpy as _np
+        info = _np.iinfo(_dtype_mod.convert_dtype(dtype))
+        self.min = int(info.min)
+        self.max = int(info.max)
+        self.bits = int(info.bits)
+        self.dtype = str(info.dtype)
+
+
+class finfo:
+    """Float dtype limits (reference paddle.finfo)."""
+
+    def __init__(self, dtype):
+        import jax.numpy as jnp
+        import numpy as _np
+        name = str(dtype).split(".")[-1]
+        info = jnp.finfo(jnp.bfloat16 if name == "bfloat16"
+                         else _np.dtype(name))
+        self.min = float(info.min)
+        self.max = float(info.max)
+        self.eps = float(info.eps)
+        self.tiny = float(info.tiny)
+        self.smallest_normal = float(info.tiny)
+        self.bits = int(info.bits)
+        self.dtype = name
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Numpy-backed print options (Tensor repr renders through numpy)."""
+    import numpy as _np
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def check_shape(shape):
+    """Validate a shape argument the way reference creation ops do."""
+    if isinstance(shape, Tensor):
+        return
+    for s in shape:
+        if not isinstance(s, (int, Tensor)) or (
+                isinstance(s, int) and s < -1):
+            raise ValueError(f"invalid shape entry {s!r}")
+
+
+def disable_signal_handler():
+    """The reference unhooks its C++ crash handlers; there are none."""
+    return None
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """paddle.summary parity: delegate to hapi Model.summary; a sample
+    `input` substitutes for input_size."""
+    from paddle_tpu.hapi.model import Model
+    if input_size is None and input is not None:
+        input_size = tuple(input.shape)
+    return Model(net).summary(input_size=input_size,
+                              dtype=dtypes[0] if dtypes else None)
+
+
+class LazyGuard:
+    """Reference LazyGuard defers parameter materialization; init here is
+    host-side numpy (already cheap/lazy-friendly), so the guard is a
+    compatibility context manager."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class NPUPlace:
+    """Reference NPUPlace; no NPU exists on this backend."""
+
+    def __init__(self, device_id=0):
+        raise RuntimeError("NPU devices do not exist on the TPU backend; "
+                           "use paddle.set_device('tpu')")
+
+
+def get_cuda_rng_state():
+    """No CUDA RNG: the global PRNG key covers every device; returned
+    value round-trips through set_cuda_rng_state."""
+    from paddle_tpu.framework import state as _state
+    return [_state.get_rng_state()] if hasattr(_state, "get_rng_state") \
+        else []
+
+
+def set_cuda_rng_state(state_list):
+    from paddle_tpu.framework import state as _state
+    if state_list and hasattr(_state, "set_rng_state"):
+        _state.set_rng_state(state_list[0])
+
+
+# paddle.dtype is the dtype TYPE (paddle.dtype('float32') etc.); dtypes
+# here are numpy dtypes, so the type is np.dtype
+import numpy as _np_mod  # noqa: E402
+
+dtype = _np_mod.dtype
 
 
 def __getattr__(name):

@@ -6,17 +6,26 @@ reference's NCCL/gloo process groups; multi-host init is jax.distributed.
 from paddle_tpu.distributed import fleet  # noqa: F401
 from paddle_tpu.distributed.collective import (  # noqa: F401
     Group,
+    P2POp,
     ReduceOp,
     all_gather,
     all_gather_object,
     all_reduce,
     all_to_all_single,
     alltoall,
+    alltoall_single,
     barrier,
+    batch_isend_irecv,
     broadcast,
+    destroy_process_group,
     get_group,
     get_rank,
     get_world_size,
+    gloo_barrier,
+    gloo_init_parallel_env,
+    gloo_release,
+    irecv,
+    isend,
     new_group,
     ppermute,
     recv,
@@ -24,7 +33,14 @@ from paddle_tpu.distributed.collective import (  # noqa: F401
     reduce_scatter,
     scatter,
     send,
+    shift,
     wait,
+)
+from paddle_tpu.distributed import communication  # noqa: F401
+from paddle_tpu.distributed.entry_attr import (  # noqa: F401
+    CountFilterEntry,
+    ProbabilityEntry,
+    ShowClickEntry,
 )
 from paddle_tpu.distributed.mesh import (  # noqa: F401
     collective_axis,
@@ -98,3 +114,57 @@ def spawn(func, args=(), nprocs=-1, **kwargs):
     """Single-controller JAX doesn't fork per device; run inline (the mesh
     gives SPMD parallelism). Multi-host launch is via paddle_tpu.distributed.launch."""
     return func(*args)
+
+
+class ParallelMode:
+    """Reference distributed/parallel.py ParallelMode constants."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Model-parallel split op (reference distributed/collective.py
+    split): run a linear/embedding whose weight is partitioned
+    `num_partitions`-ways over the tensor-parallel mesh axis.
+
+    The reference constructs per-rank weight shards and inserts
+    c_concat/c_allreduce by hand; here the layer holds the full logical
+    weight with a PartitionSpec over 'tp' and XLA partitions the matmul
+    (fleet.meta_parallel Column/RowParallelLinear are the layer forms).
+    """
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        ColumnParallelLinear,
+        RowParallelLinear,
+        VocabParallelEmbedding,
+    )
+    mesh = get_mesh()
+    tp = mesh.shape.get("tp", 1) if mesh is not None else 1
+    if num_partitions > 1 and tp not in (1, num_partitions):
+        raise ValueError(
+            f"num_partitions={num_partitions} does not match the mesh's "
+            f"tp degree {tp}")
+    if operation == "linear":
+        # reference: axis=1 splits the OUT dim (column-parallel),
+        # axis=0 splits the IN dim (row-parallel); bias_attr=False
+        # disables the bias like the reference nn.Linear contract
+        has_bias = bias_attr is not False
+        if axis == 0:
+            layer = RowParallelLinear(size[0], size[1],
+                                      weight_attr=weight_attr,
+                                      has_bias=has_bias,
+                                      input_is_parallel=False)
+        else:
+            layer = ColumnParallelLinear(size[0], size[1],
+                                         weight_attr=weight_attr,
+                                         has_bias=has_bias,
+                                         gather_output=gather_out)
+        return layer(x)
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError("operation must be 'linear' or 'embedding'")

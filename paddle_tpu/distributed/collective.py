@@ -256,3 +256,115 @@ def wait(tensor, group=None, use_calc_stream=True):
     from paddle_tpu.core.tensor import sync_array
     sync_array(tensor._value)
     return tensor
+
+
+alltoall_single = all_to_all_single  # reference exports both spellings
+
+
+class shift:
+    """Static peer pattern for batch_isend_irecv: every rank r talks to
+    (r + offset) % world_size.  XLA's collective-permute takes one STATIC
+    global edge list, so per-rank dynamic peer ints (the reference's
+    NCCL contract) cannot lower from inside an SPMD region — uniform
+    shifts are the expressible (and, for pipelines/rings, the actually
+    used) pattern."""
+
+    def __init__(self, offset):
+        self.offset = int(offset)
+
+
+class P2POp:
+    """One point-to-point op for batch_isend_irecv (reference
+    distributed/communication/batch_isend_irecv.py).  op is
+    paddle.distributed.isend or .irecv; peer a `shift(k)` pattern (see
+    shift) on the bound mesh axis."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        if op not in (isend, irecv):
+            raise ValueError("op must be distributed.isend or "
+                             "distributed.irecv")
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def isend(tensor, dst=0, group=None):
+    """XLA has no one-sided send; use inside batch_isend_irecv, where a
+    matched send/recv set becomes ONE ppermute over the mesh axis."""
+    raise RuntimeError(
+        "isend/irecv cannot run standalone on XLA (no one-sided p2p). "
+        "Wrap them in P2POp(...) and run batch_isend_irecv([...]) — the "
+        "batch lowers to a single collective-permute over ICI.")
+
+
+def irecv(tensor, src=0, group=None):
+    isend(tensor, src, group)
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Execute matched isend/irecv pairs as ONE XLA collective-permute
+    (reference batch_isend_irecv issues grouped NCCL p2p).  Each isend's
+    (my_rank -> peer) edge must have the matching irecv posted on the
+    destination; here the full edge list is the ppermute perm and every
+    irecv tensor is assigned its permuted value.  Must run inside a
+    shard_map / collective-axis context so ranks are defined."""
+    from paddle_tpu.distributed import mesh as dmesh
+
+    axis = dmesh.current_collective_axis()
+    if axis is None:
+        g = p2p_op_list[0].group if p2p_op_list else None
+        axis = _axis_of(g)
+    if axis is None:
+        raise RuntimeError("batch_isend_irecv needs a mesh axis: run "
+                           "inside shard_map/collective_axis or pass a "
+                           "group bound to an axis")
+    sends = [p for p in p2p_op_list if p.op is isend]
+    recvs = [p for p in p2p_op_list if p.op is irecv]
+    if len(sends) != len(recvs):
+        raise ValueError(
+            f"batch_isend_irecv needs matched send/recv pairs, got "
+            f"{len(sends)} isend vs {len(recvs)} irecv — on XLA every "
+            f"permuted value must land in a posted recv buffer")
+    n = dmesh.axis_size(axis)
+    tasks = []
+    for s, r in zip(sends, recvs):
+        if not isinstance(s.peer, shift) or not isinstance(r.peer, shift):
+            raise TypeError(
+                "on XLA, P2POp peers must be distributed.shift(offset) "
+                "patterns (a collective-permute needs one static global "
+                "edge list; absolute per-rank peer ints cannot be read "
+                "inside the SPMD region)")
+        if (r.peer.offset + s.peer.offset) % n != 0:
+            raise ValueError(
+                f"mismatched pair: isend shift({s.peer.offset}) delivers "
+                f"to rank+{s.peer.offset}, so the matching irecv must be "
+                f"shift({-s.peer.offset}), got shift({r.peer.offset})")
+        perm = [(rr, (rr + s.peer.offset) % n) for rr in range(n)]
+        out = apply(lambda v, p=tuple(perm): jax.lax.ppermute(v, axis, p),
+                    s.tensor)
+        r.tensor._inplace_assign(out)
+        tasks.append(out)
+    return tasks
+
+
+def destroy_process_group(group=None):
+    """Drop the installed mesh/groups (reference destroys NCCL comms)."""
+    from paddle_tpu.distributed import mesh as dmesh
+    if group is None:
+        dmesh.set_mesh(None)
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """CPU-side gloo bootstrap: jax.distributed covers both CPU and TPU
+    meshes here, so this is init_parallel_env."""
+    from paddle_tpu import distributed as dist
+    dist.init_parallel_env()
+
+
+def gloo_barrier():
+    barrier()
+
+
+def gloo_release():
+    return None

@@ -1,0 +1,27 @@
+"""paddle.distributed.communication parity (reference:
+python/paddle/distributed/communication/): the collective API lives in
+paddle_tpu.distributed.collective; this namespace re-exports it plus the
+`stream` variants.  On XLA there is no separate comm stream to schedule
+onto — the compiler owns stream assignment — so stream.* == the sync
+forms."""
+from paddle_tpu.distributed.collective import (  # noqa: F401
+    Group,
+    P2POp,
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    all_to_all_single,
+    alltoall,
+    alltoall_single,
+    barrier,
+    batch_isend_irecv,
+    broadcast,
+    irecv,
+    isend,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+)
+from paddle_tpu.distributed.communication import stream  # noqa: F401

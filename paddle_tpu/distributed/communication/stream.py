@@ -1,0 +1,17 @@
+"""paddle.distributed.communication.stream parity.  XLA schedules its
+own compute/collective streams; the stream-targeted variants are the
+same collectives (reference stream/*.py route to the same kernels with a
+stream hint the TPU compiler derives itself)."""
+from paddle_tpu.distributed.collective import (  # noqa: F401
+    all_gather,
+    all_reduce,
+    all_to_all_single,
+    alltoall,
+    alltoall_single,
+    broadcast,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+)

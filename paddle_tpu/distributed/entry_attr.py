@@ -1,0 +1,59 @@
+"""Sparse-table entry policies (reference:
+python/paddle/distributed/entry_attr.py): admission/eviction config for
+parameter-server embedding tables (consumed by distributed.ps
+SparseTable configs)."""
+from __future__ import annotations
+
+__all__ = ["EntryAttr", "ProbabilityEntry", "CountFilterEntry",
+           "ShowClickEntry"]
+
+
+class EntryAttr:
+    def __init__(self):
+        self._name = None
+
+    def _to_attr(self):
+        raise NotImplementedError
+
+
+class ProbabilityEntry(EntryAttr):
+    """Admit a new feature id with fixed probability."""
+
+    def __init__(self, probability):
+        super().__init__()
+        if not 0 <= probability <= 1:
+            raise ValueError("probability must be in [0, 1]")
+        self._name = "probability_entry"
+        self._probability = probability
+
+    def _to_attr(self):
+        return f"{self._name}:{self._probability}"
+
+
+class CountFilterEntry(EntryAttr):
+    """Admit a feature id once it has been seen count_filter times."""
+
+    def __init__(self, count_filter):
+        super().__init__()
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        self._name = "count_filter_entry"
+        self._count_filter = count_filter
+
+    def _to_attr(self):
+        return f"{self._name}:{self._count_filter}"
+
+
+class ShowClickEntry(EntryAttr):
+    """Weight feature ids by show/click statistics columns."""
+
+    def __init__(self, show_name, click_name):
+        super().__init__()
+        if not isinstance(show_name, str) or not isinstance(click_name, str):
+            raise ValueError("show_name/click_name must be str")
+        self._name = "show_click_entry"
+        self._show_name = show_name
+        self._click_name = click_name
+
+    def _to_attr(self):
+        return f"{self._name}:{self._show_name}:{self._click_name}"

@@ -162,3 +162,169 @@ def worker_num():
 
 def worker_index():
     return jax.process_index()
+
+
+# public aliases matching reference fleet/__init__.py naming
+Fleet = _Fleet
+HybridCommunicateGroup = _HybridCommunicateGroup
+
+
+class CommunicateTopology:
+    """Axis-name <-> coordinate mapping over the hybrid mesh (reference
+    fleet/base/topology.py CommunicateTopology)."""
+
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding",
+                                           "model"), dims=None):
+        from paddle_tpu.distributed.mesh import get_mesh
+        mesh = get_mesh()
+        # 'sharding' stays 1 unless explicitly configured: it reuses the
+        # dp ranks (ZeRO over dp), so mapping it to the dp SIZE would
+        # double-count dp in world_size/rank arithmetic
+        name_map = {"data": "dp", "pipe": "pp", "model": "tp",
+                    "sep": "sp"}
+        self._names = list(hybrid_group_names)
+        if dims is not None:
+            self._dims = list(dims)
+        elif mesh is not None:
+            self._dims = [mesh.shape.get(name_map[n], 1)
+                          if n in name_map else 1 for n in self._names]
+        else:
+            self._dims = [1] * len(self._names)
+
+    def get_hybrid_group_names(self):
+        return self._names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        out = 1
+        for d in self._dims:
+            out *= d
+        return out
+
+    def get_rank(self, **coords):
+        rank = 0
+        for n, d in zip(self._names, self._dims):
+            rank = rank * d + coords.get(n, 0)
+        return rank
+
+    def get_coord(self, rank):
+        import collections
+        coords = []
+        for d in reversed(self._dims):
+            coords.append(rank % d)
+            rank //= d
+        C = collections.namedtuple("Coord", [n.replace("-", "_")
+                                             for n in self._names])
+        return C(*reversed(coords))
+
+
+class Role:
+    """reference fleet/base/role_maker.py Role constants."""
+
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class PaddleCloudRoleMaker:
+    """Collective role maker: every process is a worker; identity comes
+    from jax.distributed (reference role_maker.py PaddleCloudRoleMaker)."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+
+    def _worker_num(self):
+        return jax.process_count()
+
+    def _worker_index(self):
+        return jax.process_index()
+
+    def _is_first_worker(self):
+        return jax.process_index() == 0
+
+    def _role(self):
+        return Role.WORKER
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    def __init__(self, is_collective=True, init_gloo=False, **kwargs):
+        super().__init__(is_collective)
+        self._kwargs = kwargs
+
+
+class UtilBase:
+    """reference fleet/base/util_factory.py UtilBase: small cross-worker
+    helpers on top of the collective API."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+
+        import paddle_tpu as P
+        from paddle_tpu.distributed.collective import ReduceOp, all_reduce
+        op = {"sum": ReduceOp.SUM, "max": ReduceOp.MAX,
+              "min": ReduceOp.MIN}[mode]
+        t = P.to_tensor(np.asarray(input))
+        return np.asarray(all_reduce(t, op=op)._value)
+
+    def barrier(self, comm_world="worker"):
+        from paddle_tpu.distributed.collective import barrier
+        barrier()
+
+    def all_gather(self, input, comm_world="worker"):
+        import numpy as np
+
+        import paddle_tpu as P
+        from paddle_tpu.distributed.collective import all_gather
+        out = []
+        all_gather(out, P.to_tensor(np.asarray(input)))
+        return [np.asarray(t._value) for t in out]
+
+    def get_file_shard(self, files):
+        n = jax.process_count()
+        i = jax.process_index()
+        return files[i::n]
+
+    def print_on_rank(self, message, rank_id=0):
+        if jax.process_index() == rank_id:
+            print(message)
+
+
+util = UtilBase()
+_Fleet.util = util
+
+
+def get_logger(name="FLEET", level=None, fmt=None):
+    import logging
+    logger = logging.getLogger(name)
+    if level is not None:
+        logger.setLevel(level)
+    return logger
+
+
+def find_free_ports(num):
+    """num free localhost TCP ports (reference launch utils)."""
+    import socket
+    ports, socks = set(), []
+    while len(ports) < num:
+        s = socket.socket()
+        s.bind(("", 0))
+        socks.append(s)
+        ports.add(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def get_host_name_ip():
+    import socket
+    try:
+        host = socket.gethostname()
+        return host, socket.gethostbyname(host)
+    except OSError:
+        return None

@@ -319,6 +319,9 @@ class Poisson(Distribution):
 
 
 def kl_divergence(p, q):
+    rule = dispatch_kl(p, q)
+    if rule is not None:
+        return rule(p, q)
     if isinstance(p, Normal) and isinstance(q, Normal):
         return p.kl_divergence(q)
     if isinstance(p, Categorical) and isinstance(q, Categorical):
@@ -333,3 +336,57 @@ def kl_divergence(p, q):
         return Tensor(pp * (jnp.log(pp) - jnp.log(qq)) +
                       (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq)))
     raise NotImplementedError(f"kl({type(p).__name__}, {type(q).__name__})")
+
+
+from paddle_tpu.distribution.transform import (  # noqa: E402,F401
+    AbsTransform,
+    AffineTransform,
+    ChainTransform,
+    Constraint,
+    ExpTransform,
+    IndependentTransform,
+    Positive,
+    PowerTransform,
+    Range,
+    Real,
+    ReshapeTransform,
+    SigmoidTransform,
+    Simplex,
+    SoftmaxTransform,
+    StackTransform,
+    StickBreakingTransform,
+    TanhTransform,
+    Transform,
+    Variable,
+)
+from paddle_tpu.distribution.transformed_distribution import (  # noqa: E402,F401
+    ExponentialFamily,
+    Independent,
+    TransformedDistribution,
+    dispatch_kl,
+    register_kl,
+)
+
+
+class Stack:
+    """Distribution over stacked independent components (reference
+    variable.py Stack is the VARIABLE form; the distribution form stacks
+    per-slice distributions along `axis`)."""
+
+    def __init__(self, distributions, axis=0):
+        self._dists = list(distributions)
+        self._axis = axis
+
+    def sample(self, shape=()):
+        from paddle_tpu.tensor.manipulation import stack as tstack
+        return tstack([d.sample(shape) for d in self._dists],
+                      axis=self._axis)
+
+    def log_prob(self, value):
+        vv = _v(value)
+        slices = jnp.moveaxis(vv, self._axis, 0)
+        lps = [
+            _v(d.log_prob(Tensor(slices[i])))
+            for i, d in enumerate(self._dists)
+        ]
+        return Tensor(jnp.moveaxis(jnp.stack(lps, 0), 0, self._axis))

@@ -1,0 +1,511 @@
+"""Bijective transforms of random variables.
+
+Reference parity: python/paddle/distribution/transform.py (Transform :59,
+AbsTransform :342, AffineTransform :414, ChainTransform :496,
+ExpTransform :621, IndependentTransform :670, PowerTransform :765,
+ReshapeTransform :829, SigmoidTransform :953, SoftmaxTransform :996,
+StackTransform :1052, StickBreakingTransform :1172, TanhTransform :1238),
+constraint.py and variable.py.
+
+All math is jnp through the VJP-tape `apply`, so transforms compose with
+autograd and jit the same as any framework op.
+"""
+from __future__ import annotations
+
+import enum
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = [
+    "Type", "Transform", "AbsTransform", "AffineTransform",
+    "ChainTransform", "ExpTransform", "IndependentTransform",
+    "PowerTransform", "ReshapeTransform", "SigmoidTransform",
+    "SoftmaxTransform", "StackTransform", "StickBreakingTransform",
+    "TanhTransform", "Constraint", "Real", "Range", "Positive", "Simplex",
+    "Variable",
+]
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# --------------------------------------------------------- constraints
+class Constraint:
+    """Value-validity predicate (reference constraint.py:17)."""
+
+    def __call__(self, value):
+        raise NotImplementedError
+
+
+class Real(Constraint):
+    def __call__(self, value):
+        return apply(lambda v: v == v, value)
+
+
+class Range(Constraint):
+    def __init__(self, lower, upper):
+        self._lower, self._upper = lower, upper
+
+    def __call__(self, value):
+        return apply(lambda v: (self._lower <= v) & (v <= self._upper),
+                     value)
+
+
+class Positive(Constraint):
+    def __call__(self, value):
+        return apply(lambda v: v >= 0.0, value)
+
+
+class Simplex(Constraint):
+    def __call__(self, value):
+        return apply(lambda v: jnp.all(v >= 0, -1)
+                     & (jnp.abs(v.sum(-1) - 1) < 1e-6), value)
+
+
+real = Real()
+positive = Positive()
+simplex = Simplex()
+
+
+# ----------------------------------------------------------- variables
+class Variable:
+    """Random-variable domain metadata (reference variable.py:18)."""
+
+    def __init__(self, is_discrete=False, event_rank=0, constraint=None):
+        self._is_discrete = is_discrete
+        self._event_rank = event_rank
+        self._constraint = constraint or Real()
+
+    @property
+    def is_discrete(self):
+        return self._is_discrete
+
+    @property
+    def event_rank(self):
+        return self._event_rank
+
+    def constraint(self, value):
+        return self._constraint(value)
+
+
+class _RealVariable(Variable):
+    def __init__(self, is_discrete=False, event_rank=0):
+        super().__init__(is_discrete, event_rank, Real())
+
+
+class _PositiveVariable(Variable):
+    def __init__(self, is_discrete=False, event_rank=0):
+        super().__init__(is_discrete, event_rank, Positive())
+
+
+class _IndependentVariable(Variable):
+    def __init__(self, base, reinterpreted_batch_rank):
+        super().__init__(base.is_discrete,
+                         base.event_rank + reinterpreted_batch_rank,
+                         base._constraint)
+        self._base = base
+
+
+class _StackVariable(Variable):
+    def __init__(self, vars, axis=0):
+        super().__init__(any(v.is_discrete for v in vars),
+                         max(v.event_rank for v in vars))
+        self._vars = vars
+        self._axis = axis
+
+
+# ----------------------------------------------------------- transforms
+class Type(enum.Enum):
+    BIJECTION = "bijection"
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+    @classmethod
+    def is_injective(cls, t):
+        return t in (cls.BIJECTION, cls.INJECTION)
+
+
+class Transform:
+    _type = Type.BIJECTION
+
+    @classmethod
+    def _is_injective(cls):
+        return Type.is_injective(cls._type)
+
+    def __call__(self, input):
+        if isinstance(input, Transform):
+            return ChainTransform([self, input])
+        from paddle_tpu.distribution import Distribution
+        if isinstance(input, Distribution):
+            from paddle_tpu.distribution.transformed_distribution import (
+                TransformedDistribution)
+            return TransformedDistribution(input, [self])
+        return self.forward(input)
+
+    # public API
+    def forward(self, x):
+        return Tensor(self._forward(_v(x)))
+
+    def inverse(self, y):
+        return Tensor(self._inverse(_v(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(self._forward_log_det_jacobian(_v(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        yv = _v(y)
+        return Tensor(-self._forward_log_det_jacobian(self._inverse(yv)))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    @property
+    def _domain(self):
+        return _RealVariable()
+
+    @property
+    def _codomain(self):
+        return _RealVariable()
+
+    # subclass hooks
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AbsTransform(Transform):
+    """y = |x| — surjective, not injective (reference :342)."""
+
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # principal branch (the positive preimage)
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x (reference :414)."""
+
+    def __init__(self, loc, scale):
+        self._loc = _v(loc)
+        self._scale = _v(scale)
+
+    @property
+    def loc(self):
+        return Tensor(self._loc)
+
+    @property
+    def scale(self):
+        return Tensor(self._scale)
+
+    def _forward(self, x):
+        return self._loc + self._scale * x
+
+    def _inverse(self, y):
+        return (y - self._loc) / self._scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self._scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    """y = exp(x) (reference :621)."""
+
+    @property
+    def _codomain(self):
+        return _PositiveVariable()
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    """y = x ** power on the positive half-line (reference :765)."""
+
+    def __init__(self, power):
+        self._power = _v(power)
+
+    @property
+    def power(self):
+        return Tensor(self._power)
+
+    @property
+    def _domain(self):
+        return _PositiveVariable()
+
+    @property
+    def _codomain(self):
+        return _PositiveVariable()
+
+    def _forward(self, x):
+        return jnp.power(x, self._power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self._power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self._power * jnp.power(x, self._power - 1)))
+
+
+class SigmoidTransform(Transform):
+    """y = sigmoid(x) (reference :953)."""
+
+    @property
+    def _codomain(self):
+        return Variable(False, 0, Range(0.0, 1.0))
+
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    """y = tanh(x) (reference :1238)."""
+
+    @property
+    def _codomain(self):
+        return Variable(False, 0, Range(-1.0, 1.0))
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log|dy/dx| = log(1 - tanh^2 x) = 2(log2 - x - softplus(-2x))
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x): surjection onto the simplex (reference :996)."""
+
+    _type = Type.OTHER
+
+    @property
+    def _codomain(self):
+        return Variable(False, 1, Simplex())
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, -1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+
+class StickBreakingTransform(Transform):
+    """R^{K} -> interior of the (K+1)-simplex via stick-breaking
+    (reference :1172)."""
+
+    @property
+    def _domain(self):
+        return Variable(False, 1, Real())
+
+    @property
+    def _codomain(self):
+        return Variable(False, 1, Simplex())
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+    def _forward(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        zc = jnp.cumprod(1 - z, -1)
+        lead = jnp.concatenate(
+            [jnp.ones(x.shape[:-1] + (1,), x.dtype), zc], -1)
+        tail = jnp.concatenate(
+            [z, jnp.ones(x.shape[:-1] + (1,), x.dtype)], -1)
+        return lead * tail
+
+    def _inverse(self, y):
+        k = y.shape[-1] - 1
+        cum = jnp.cumsum(y[..., :-1], -1)
+        rest = 1 - jnp.concatenate(
+            [jnp.zeros(y.shape[:-1] + (1,), y.dtype), cum[..., :-1]], -1)
+        z = y[..., :-1] / rest
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=y.dtype))
+        return jnp.log(z) - jnp.log1p(-z) + offset
+
+    def _forward_log_det_jacobian(self, x):
+        # dy_k/dx_k = z_k (1 - z_k) rest_k (triangular Jacobian):
+        # log z = -softplus(-xs), log(1-z) = -softplus(xs)
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        xs = x - offset
+        z = jax.nn.sigmoid(xs)
+        rest = jnp.concatenate(
+            [jnp.ones(x.shape[:-1] + (1,), x.dtype),
+             jnp.cumprod(1 - z, -1)[..., :-1]], -1)
+        return (-jax.nn.softplus(-xs) - jax.nn.softplus(xs)
+                + jnp.log(rest)).sum(-1)
+
+
+class ReshapeTransform(Transform):
+    """Reshape the event block (reference :829)."""
+
+    def __init__(self, in_event_shape, out_event_shape):
+        if int(np.prod(in_event_shape)) != int(np.prod(out_event_shape)):
+            raise ValueError("in/out event shapes must have equal size")
+        self._in = tuple(in_event_shape)
+        self._out = tuple(out_event_shape)
+
+    @property
+    def in_event_shape(self):
+        return self._in
+
+    @property
+    def out_event_shape(self):
+        return self._out
+
+    def forward_shape(self, shape):
+        n = len(self._in)
+        if tuple(shape[len(shape) - n:]) != self._in:
+            raise ValueError(f"shape {shape} does not end with {self._in}")
+        return tuple(shape[:len(shape) - n]) + self._out
+
+    def inverse_shape(self, shape):
+        n = len(self._out)
+        return tuple(shape[:len(shape) - n]) + self._in
+
+    def _forward(self, x):
+        batch = x.shape[:x.ndim - len(self._in)]
+        return x.reshape(batch + self._out)
+
+    def _inverse(self, y):
+        batch = y.shape[:y.ndim - len(self._out)]
+        return y.reshape(batch + self._in)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = x.shape[:x.ndim - len(self._in)]
+        return jnp.zeros(batch, x.dtype)
+
+
+class IndependentTransform(Transform):
+    """Promote rightmost batch dims of `base` into the event: log-dets
+    sum over the reinterpreted dims (reference :670)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self._base = base
+        self._rank = int(reinterpreted_batch_rank)
+
+    @property
+    def _domain(self):
+        return _IndependentVariable(self._base._domain, self._rank)
+
+    @property
+    def _codomain(self):
+        return _IndependentVariable(self._base._codomain, self._rank)
+
+    def _forward(self, x):
+        return self._base._forward(x)
+
+    def _inverse(self, y):
+        return self._base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        ld = self._base._forward_log_det_jacobian(x)
+        return ld.sum(axis=tuple(range(ld.ndim - self._rank, ld.ndim)))
+
+
+class ChainTransform(Transform):
+    """Function composition: last-listed applies first to forward? No —
+    reference semantics: transforms apply in LIST ORDER on forward
+    (reference :496)."""
+
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    @classmethod
+    def _chain_injective(cls, transforms):
+        return all(t._is_injective() for t in transforms)
+
+    def _is_injective(self):
+        return self._chain_injective(self.transforms)
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return shape
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._forward_log_det_jacobian(x)
+            x = t._forward(x)
+        return total
+
+
+class StackTransform(Transform):
+    """Apply transforms[i] to slice i along `axis` (reference :1052)."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self._axis = axis
+
+    @property
+    def axis(self):
+        return self._axis
+
+    def _map(self, fn_name, v):
+        slices = jnp.moveaxis(v, self._axis, 0)
+        outs = [getattr(t, fn_name)(slices[i])
+                for i, t in enumerate(self.transforms)]
+        return jnp.moveaxis(jnp.stack(outs, 0), 0, self._axis)
+
+    def _forward(self, x):
+        return self._map("_forward", x)
+
+    def _inverse(self, y):
+        return self._map("_inverse", y)
+
+    def _forward_log_det_jacobian(self, x):
+        return self._map("_forward_log_det_jacobian", x)

@@ -1,0 +1,93 @@
+"""paddle.hub (reference: python/paddle/hapi/hub.py list :174, help :222,
+load :267): run entrypoints from a repo's hubconf.py.
+
+The `local` source is fully supported (import hubconf.py from a
+directory, check `dependencies`, call the entry).  `github`/`gitee`
+require network egress, which this build does not have — they raise
+with that explanation instead of pretending.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+MODULE_HUBCONF = "hubconf.py"
+VAR_DEPENDENCY = "dependencies"
+
+__all__ = ["list", "help", "load"]
+
+
+def _import_hubconf(repo_dir):
+    path = os.path.join(repo_dir, MODULE_HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no {MODULE_HUBCONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    module = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(module)
+    finally:
+        sys.path.remove(repo_dir)
+    _check_dependencies(module)
+    return module
+
+
+def _check_module_exists(name):
+    try:
+        __import__(name)
+        return True
+    except ImportError:
+        return False
+
+
+def _check_dependencies(m):
+    deps = getattr(m, VAR_DEPENDENCY, None)
+    if deps:
+        missing = [d for d in deps if not _check_module_exists(d)]
+        if missing:
+            raise RuntimeError("Missing dependencies: " + ", ".join(missing))
+
+
+def _resolve(repo_dir, source, force_reload):
+    if source not in ("github", "gitee", "local"):
+        raise ValueError(
+            f'Unknown source: "{source}". Allowed: "github" | "gitee" | '
+            f'"local".')
+    if source in ("github", "gitee"):
+        raise RuntimeError(
+            f"hub source '{source}' needs network egress, which this "
+            f"environment does not have; clone the repo yourself and use "
+            f"source='local' with its path")
+    return repo_dir
+
+
+def _load_entry_from_hubconf(m, name):
+    if not isinstance(name, str):
+        raise ValueError("Invalid input: model should be a str of "
+                         "function name")
+    func = getattr(m, name, None)
+    if func is None or not callable(func):
+        raise RuntimeError(f"Cannot find callable {name} in hubconf")
+    return func
+
+
+def list(repo_dir, source="github", force_reload=False):
+    """All public callable entrypoints of the repo's hubconf."""
+    repo_dir = _resolve(repo_dir, source, force_reload)
+    m = _import_hubconf(repo_dir)
+    return [f for f in dir(m)
+            if callable(getattr(m, f)) and not f.startswith("_")]
+
+
+def help(repo_dir, model, source="github", force_reload=False):
+    """Docstring of one entrypoint."""
+    repo_dir = _resolve(repo_dir, source, force_reload)
+    return _load_entry_from_hubconf(_import_hubconf(repo_dir), model).__doc__
+
+
+def load(repo_dir, model, source="github", force_reload=False, **kwargs):
+    """Call entrypoint `model` of the repo's hubconf with **kwargs."""
+    repo_dir = _resolve(repo_dir, source, force_reload)
+    return _load_entry_from_hubconf(_import_hubconf(repo_dir), model)(
+        **kwargs)

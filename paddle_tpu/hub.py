@@ -1,0 +1,5 @@
+"""paddle.hub parity (reference: python/paddle/hub.py re-exporting
+hapi/hub.py)."""
+from paddle_tpu.hapi.hub import help, list, load  # noqa: F401
+
+__all__ = ["list", "help", "load"]

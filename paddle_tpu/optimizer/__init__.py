@@ -1,5 +1,24 @@
 """Optimizers. Reference: python/paddle/optimizer/__init__.py."""
 from paddle_tpu.optimizer import lr  # noqa: F401
+# the reference also surfaces the schedulers at paddle.optimizer level
+from paddle_tpu.optimizer.lr import (  # noqa: F401
+    CosineAnnealingDecay,
+    CyclicLR,
+    ExponentialDecay,
+    InverseTimeDecay,
+    LambdaDecay,
+    LinearWarmup,
+    LRScheduler,
+    MultiplicativeDecay,
+    MultiStepDecay,
+    NaturalExpDecay,
+    NoamDecay,
+    OneCycleLR,
+    PiecewiseDecay,
+    PolynomialDecay,
+    ReduceOnPlateau,
+    StepDecay,
+)
 from paddle_tpu.optimizer.adam import Adam, Adamax, AdamW, Lamb  # noqa: F401
 from paddle_tpu.optimizer.optimizer import Optimizer  # noqa: F401
 from paddle_tpu.optimizer.rmsprop import Adadelta, Adagrad, RMSProp  # noqa: F401

@@ -246,6 +246,93 @@ tanh = _unary_on_values(jnp.tanh)
 sqrt = _unary_on_values(jnp.sqrt)
 abs = _unary_on_values(jnp.abs)
 neg = _unary_on_values(jnp.negative)
+# zero-preserving unaries (reference python/paddle/sparse/unary.py)
+asin = _unary_on_values(jnp.arcsin)
+asinh = _unary_on_values(jnp.arcsinh)
+atan = _unary_on_values(jnp.arctan)
+atanh = _unary_on_values(jnp.arctanh)
+sinh = _unary_on_values(jnp.sinh)
+tan = _unary_on_values(jnp.tan)
+square = _unary_on_values(jnp.square)
+expm1 = _unary_on_values(jnp.expm1)
+log1p = _unary_on_values(jnp.log1p)
+deg2rad = _unary_on_values(jnp.deg2rad)
+rad2deg = _unary_on_values(jnp.rad2deg)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    """Cast indices and/or values (reference sparse/unary.py cast)."""
+    if isinstance(x, SparseCooTensor):
+        idx = jnp.swapaxes(x._bcoo.indices, 0, 1)
+        if index_dtype is not None:
+            from paddle_tpu.core.dtype import convert_dtype
+            idx = idx.astype(convert_dtype(index_dtype))
+        vals = x._bcoo.data
+        if value_dtype is not None:
+            from paddle_tpu.core.dtype import convert_dtype
+            vals = vals.astype(convert_dtype(value_dtype))
+        return SparseCooTensor(idx, vals, x._bcoo.shape)
+    return x.cast(value_dtype) if value_dtype is not None else x
+
+
+def coalesce(x, name=None):
+    """Merge duplicate coordinates (reference sparse/unary.py coalesce)."""
+    if isinstance(x, SparseCooTensor):
+        summed = x._bcoo.sum_duplicates(nse=x._bcoo.nse)
+        return SparseCooTensor(jnp.swapaxes(summed.indices, 0, 1),
+                               summed.data, summed.shape)
+    return x
+
+
+def is_same_shape(x, y):
+    return tuple(x.shape) == tuple(y.shape)
+
+
+def reshape(x, shape, name=None):
+    """Reshape a sparse tensor by recomputing flat coordinates — O(nnz),
+    never densifies (reference sparse/unary.py reshape)."""
+    if not isinstance(x, SparseCooTensor):
+        from paddle_tpu.tensor.manipulation import reshape as dense_r
+        return dense_r(x, shape)
+    old_shape = x._bcoo.shape
+    n = int(np.prod(old_shape))
+    known = int(np.prod([s for s in shape if s != -1])) or 1
+    shape = tuple(n // known if s == -1 else int(s) for s in shape)
+    idx = x._bcoo.indices  # [nnz, ndim]
+    strides = np.cumprod((old_shape[1:] + (1,))[::-1])[::-1].copy()
+    flat = (idx * jnp.asarray(strides, idx.dtype)).sum(axis=1)
+    new_strides = np.cumprod((shape[1:] + (1,))[::-1])[::-1].copy()
+    new_idx = jnp.stack(
+        [(flat // int(st)) % int(dim)
+         for st, dim in zip(new_strides, shape)], axis=0)
+    return SparseCooTensor(new_idx, x._bcoo.data, shape)
+
+
+def divide(x, y, name=None):
+    """Elementwise divide; dense result (implicit zeros divide to 0/y)."""
+    xv = x.to_dense() if isinstance(x, SparseCooTensor) else x
+    yv = y.to_dense() if isinstance(y, SparseCooTensor) else y
+    return Tensor(unwrap(xv) / unwrap(yv))
+
+
+def mv(x, vec, name=None):
+    """Sparse matrix x dense vector (reference sparse/matmul.py mv):
+    O(nnz) gather-multiply-segment-sum on the BCOO triplet."""
+    if isinstance(x, SparseCooTensor):
+        idx = x._bcoo.indices
+        contrib = x._bcoo.data * unwrap(vec)[idx[:, 1]]
+        out = jnp.zeros((x._bcoo.shape[0],), x._bcoo.data.dtype
+                        ).at[idx[:, 0]].add(contrib)
+        return Tensor(out)
+    from paddle_tpu.tensor.math import matmul as dense_mm
+    return dense_mm(x, vec)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta * input + alpha * (x @ y) (reference sparse/matmul.py addmm)."""
+    prod = matmul(x, y)
+    iv = input.to_dense() if isinstance(input, SparseCooTensor) else input
+    return Tensor(beta * unwrap(iv) + alpha * unwrap(prod))
 def pow(x, factor, name=None):
     """Zero-preserving only for factor > 0 (0**f == 0); otherwise implicit
     zeros would become 1 (f == 0) or inf (f < 0), so fall back to dense."""

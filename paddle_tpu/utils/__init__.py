@@ -38,3 +38,110 @@ class deprecated:
 
     def __call__(self, fn):
         return fn
+
+
+# reference paddle.utils also surfaces these directly
+from paddle_tpu.utils.dlpack import from_dlpack, to_dlpack  # noqa: E402,F401
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    import paddle_tpu
+    return paddle_tpu.flops(net, input_size, custom_ops, print_detail)
+
+
+_flops_registry = {}
+
+
+def register_flops(op_type):
+    """Register a custom per-layer FLOPs counter (reference
+    utils/flops.py registry)."""
+    def deco(fn):
+        _flops_registry[op_type] = fn
+        return fn
+    return deco
+
+
+def generate(key=""):
+    """paddle.utils.unique_name.generate parity."""
+    return unique_name(key or "tmp")
+
+
+def require_version(min_version, max_version=None):
+    """Version gate (reference utils/__init__.py require_version) against
+    this framework's version string."""
+    import paddle_tpu
+
+    def parse(v):
+        parts = []
+        for p in str(v).split(".")[:3]:
+            digits = "".join(c for c in p if c.isdigit())
+            parts.append(int(digits) if digits else 0)
+        while len(parts) < 3:  # '0.1' means '0.1.x' — pad, don't shorten
+            parts.append(0)
+        return tuple(parts)
+
+    cur = parse(getattr(paddle_tpu, "__version__", "0.0.0"))
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {cur} < required minimum {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {cur} > required maximum {max_version}")
+
+
+class ProfilerOptions:
+    """Legacy fluid profiler options bag (reference utils/profiler.py)."""
+
+    def __init__(self, options=None):
+        self.options = dict(options or {})
+
+    def get(self, key, default=None):
+        return self.options.get(key, default)
+
+
+class Profiler:
+    """Legacy profiler facade routing to paddle_tpu.profiler.Profiler."""
+
+    def __init__(self, enabled=True, options=None):
+        from paddle_tpu.profiler import Profiler as _P
+        self._p = _P()
+        self._enabled = enabled
+
+    def __enter__(self):
+        if self._enabled:
+            self._p.start()
+        return self
+
+    def __exit__(self, *exc):
+        if self._enabled:
+            self._p.stop()
+        return False
+
+
+_legacy_profiler = [None]
+
+
+def get_profiler(options=None):
+    if _legacy_profiler[0] is None:
+        _legacy_profiler[0] = Profiler(options=options)
+    return _legacy_profiler[0]
+
+
+def start_profiler(state="All", tracer_option="Default"):
+    get_profiler()._p.start()
+
+
+def stop_profiler(sorted_key=None, profile_path=None):
+    p = _legacy_profiler[0]
+    if p is not None:
+        p._p.stop()
+        _legacy_profiler[0] = None
+
+
+def reset_profiler():
+    _legacy_profiler[0] = None
+
+
+def cuda_profiler(*a, **kw):
+    raise RuntimeError("cuda_profiler has no TPU analogue; use "
+                       "paddle_tpu.profiler (jax.profiler traces)")

@@ -1,4 +1,39 @@
 from paddle_tpu.vision import datasets, models, ops, transforms  # noqa: F401
+# the reference surfaces the detection ops at paddle.vision level too
+from paddle_tpu.vision.ops import (  # noqa: F401
+    DeformConv2D,
+    PSRoIPool,
+    RoIAlign,
+    RoIPool,
+    deform_conv2d,
+    nms,
+    psroi_pool,
+    roi_align,
+    roi_pool,
+    yolo_box,
+)
+
+
+def set_image_backend(backend):
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(f"unknown image backend {backend!r}")
+    _state["image_backend"] = backend
+
+
+def get_image_backend():
+    return _state["image_backend"]
+
+
+_state = {"image_backend": "pil"}
+
+
+def image_load(path, backend=None):
+    """Load an image file. PIL/cv2 are not in this build; PNG/PPM decode
+    through pure numpy would go here — currently raises with guidance."""
+    raise RuntimeError(
+        "no image decoding library (PIL/cv2) is bundled in this build; "
+        "decode to a numpy array yourself and feed it to the transforms "
+        "(they accept HWC ndarrays)")
 
 # reference layout parity: paddle.vision.transforms.functional is a
 # submodule; here the functional forms live in the same module.  The

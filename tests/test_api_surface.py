@@ -1,0 +1,183 @@
+"""Reference API-surface fills: top-level names, optimizer lr
+re-exports, utils, sparse ops, vision re-exports, distributed
+communication namespace + fleet public classes.
+
+Reference: python/paddle/__init__.py, distributed/communication/,
+fleet/base/{topology,role_maker,util_factory}.py, sparse/unary.py,
+sparse/matmul.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed import mesh as mesh_mod
+
+
+@pytest.fixture(autouse=True)
+def _reset_mesh():
+    yield
+    mesh_mod.set_mesh(None)
+
+
+class TestTopLevel:
+    def test_frexp_reconstructs(self):
+        x = P.to_tensor(np.array([0.0, 3.0, -5.5, 1e-3], np.float32))
+        m, e = P.frexp(x)
+        np.testing.assert_allclose(m.numpy() * 2.0 ** e.numpy(), x.numpy(),
+                                   rtol=1e-6)
+        nz = np.abs(m.numpy()[1:])
+        assert ((nz >= 0.5) & (nz < 1.0)).all()
+
+    def test_iinfo_finfo(self):
+        assert P.iinfo("int8").max == 127
+        assert P.finfo("float32").bits == 32
+        assert abs(P.finfo("bfloat16").eps - 2 ** -7) < 1e-12
+
+    def test_cast_reverse_tolist_index_add_(self):
+        x = P.to_tensor(np.array([1.5, -2.0], np.float32))
+        assert P.cast(x, "int32").numpy().dtype == np.int32
+        np.testing.assert_array_equal(P.reverse(x, [0]).numpy(),
+                                      [-2.0, 1.5])
+        assert P.tolist(x) == [1.5, -2.0]
+        y = P.zeros([3, 2])
+        P.index_add_(y, P.to_tensor(np.array([2]), dtype="int64"), 0,
+                     P.ones([1, 2]))
+        assert y.numpy()[2].sum() == 2.0
+
+    def test_misc_compat(self):
+        P.set_printoptions(precision=4)
+        P.check_shape([1, 2, 3])
+        P.disable_signal_handler()
+        with P.LazyGuard():
+            lin = P.nn.Linear(2, 2)
+        assert lin.weight.shape == [2, 2]
+        st = P.get_cuda_rng_state()
+        P.set_cuda_rng_state(st)
+        with pytest.raises(RuntimeError):
+            P.NPUPlace(0)
+        assert P.DataParallel is not None and P.ParamAttr is not None
+        assert P.dtype("float32") == np.float32
+
+
+class TestSparseOps:
+    def _coo(self):
+        idx = P.to_tensor(np.array([[0, 1], [1, 0]]), dtype="int64")
+        vals = P.to_tensor(np.array([2.0, -3.0], np.float32))
+        return P.sparse.sparse_coo_tensor(idx, vals, [2, 2])
+
+    def test_new_unaries_zero_preserving(self):
+        x = self._coo()
+        for name in ("asin", "atan", "sinh", "tan", "square", "expm1",
+                     "log1p", "deg2rad", "rad2deg", "asinh", "atanh"):
+            fn = getattr(P.sparse, name)
+            try:
+                out = fn(x)
+            except Exception:  # domain errors (atanh of -3) are fine
+                continue
+            d = out.to_dense().numpy()
+            assert d[0, 0] == 0.0 and d[1, 1] == 0.0, name
+
+    def test_reshape_mv_addmm_coalesce(self):
+        x = self._coo()
+        r = P.sparse.reshape(x, [4])
+        np.testing.assert_allclose(r.to_dense().numpy(),
+                                   x.to_dense().numpy().reshape(4))
+        v = P.sparse.mv(x, P.to_tensor(np.array([1.0, 2.0], np.float32)))
+        np.testing.assert_allclose(
+            v.numpy(), x.to_dense().numpy() @ [1.0, 2.0])
+        out = P.sparse.addmm(P.eye(2), x, P.eye(2), beta=0.5, alpha=2.0)
+        np.testing.assert_allclose(
+            out.numpy(), 0.5 * np.eye(2) + 2.0 * x.to_dense().numpy())
+        assert P.sparse.is_same_shape(x, x)
+        dup = P.sparse.sparse_coo_tensor(
+            P.to_tensor(np.array([[0, 0], [0, 0]]), dtype="int64"),
+            P.to_tensor(np.array([1.0, 2.0], np.float32)), [1, 1])
+        assert float(P.sparse.coalesce(dup).to_dense().numpy()[0, 0]) == 3.0
+
+
+class TestDistributedSurface:
+    def test_p2p_batch_maps_to_ppermute(self):
+        """isend/irecv pairs inside a collective-axis context execute as
+        one ppermute ring step."""
+        mesh = mesh_mod.init_mesh({"pp": 8})
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        import paddle_tpu.distributed as dist
+
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+        xs = jax.device_put(x, NamedSharding(mesh, PartitionSpec("pp")))
+
+        def body(v):
+            with mesh_mod.collective_axis("pp"):
+                src = P.Tensor(v)
+                dst = P.Tensor(v * 0)
+                ops = [dist.P2POp(dist.isend, src, dist.shift(1)),
+                       dist.P2POp(dist.irecv, dst, dist.shift(-1))]
+                dist.batch_isend_irecv(ops)
+                return dst._value
+
+        out = jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=PartitionSpec("pp"),
+            out_specs=PartitionSpec("pp")))(xs)
+        np.testing.assert_allclose(np.asarray(out)[:, 0], np.roll(x[:, 0], 1))
+
+    def test_isend_standalone_raises_with_guidance(self):
+        with pytest.raises(RuntimeError, match="batch_isend_irecv"):
+            P.distributed.isend(P.ones([2]), dst=1)
+
+    def test_split_linear_on_tp_mesh(self):
+        mesh_mod.init_mesh({"tp": 8})
+        P.seed(0)
+        # axis=0: row-parallel (in dim split); axis=1: column-parallel
+        out = P.distributed.split(P.ones([2, 8]), (8, 8), "linear", axis=0,
+                                  bias_attr=False)
+        assert tuple(out.shape) == (2, 8)
+        out = P.distributed.split(P.ones([2, 4]), (4, 8), "linear", axis=1)
+        assert tuple(out.shape) == (2, 8)
+        with pytest.raises(ValueError, match="num_partitions"):
+            P.distributed.split(P.ones([2, 4]), (4, 8), "linear", axis=1,
+                                num_partitions=4)
+
+    def test_fleet_public_surface(self):
+        assert fleet.Fleet is type(fleet.fleet)
+        topo = fleet.CommunicateTopology(dims=[2, 2, 1, 2])
+        assert topo.world_size() == 8
+        c = topo.get_coord(5)
+        assert topo.get_rank(**c._asdict()) == 5
+        rm = fleet.PaddleCloudRoleMaker(is_collective=True)
+        assert rm._worker_num() >= 1 and rm._role() == fleet.Role.WORKER
+        assert fleet.util.get_file_shard(["a", "b", "c"]) == ["a", "b", "c"]
+        assert len(fleet.find_free_ports(2)) == 2
+
+    def test_entries(self):
+        assert "0.5" in P.distributed.ProbabilityEntry(0.5)._to_attr()
+        assert "show" in P.distributed.ShowClickEntry("show", "clk")._to_attr()
+        with pytest.raises(ValueError):
+            P.distributed.CountFilterEntry(-1)
+
+
+class TestUtilsSurface:
+    def test_optimizer_lr_reexports(self):
+        sched = P.optimizer.CosineAnnealingDecay(0.1, T_max=10)
+        assert isinstance(sched, P.optimizer.LRScheduler)
+
+    def test_utils_generate_require_version(self):
+        a, b = P.utils.generate("foo"), P.utils.generate("foo")
+        assert a != b and a.startswith("foo")
+        P.utils.require_version("0.0.1")
+        with pytest.raises(Exception):
+            P.utils.require_version("999.0.0")
+
+    def test_utils_dlpack_reexport(self):
+        x = P.to_tensor(np.arange(4, dtype=np.float32))
+        y = P.utils.from_dlpack(P.utils.to_dlpack(x))
+        np.testing.assert_allclose(y.numpy(), x.numpy())
+
+    def test_legacy_profiler_facade(self):
+        with P.utils.Profiler(enabled=False):
+            pass
+        P.utils.start_profiler()
+        P.utils.stop_profiler()
+        P.utils.reset_profiler()

@@ -1,0 +1,202 @@
+"""Distribution transforms, TransformedDistribution, Independent,
+ExponentialFamily, register_kl.
+
+Reference: python/paddle/distribution/{transform,independent,
+transformed_distribution,exponential_family,kl}.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu import distribution as D
+
+
+def _x(*shape, seed=0, lo=-2.0, hi=2.0):
+    rng = np.random.RandomState(seed)
+    return P.to_tensor((rng.rand(*shape) * (hi - lo) + lo)
+                       .astype(np.float32))
+
+
+BIJECTIONS = [
+    (D.AffineTransform(P.to_tensor(1.5), P.to_tensor(-2.0)), (-2, 2)),
+    (D.ExpTransform(), (-2, 2)),
+    (D.SigmoidTransform(), (-3, 3)),
+    (D.TanhTransform(), (-2, 2)),
+    (D.PowerTransform(P.to_tensor(3.0)), (0.1, 2)),
+]
+
+
+class TestBijections:
+    @pytest.mark.parametrize("t,rng", BIJECTIONS,
+                             ids=lambda p: type(p).__name__
+                             if isinstance(p, D.Transform) else "")
+    def test_inverse_roundtrip(self, t, rng):
+        x = _x(4, 3, lo=rng[0], hi=rng[1])
+        y = t.forward(x)
+        back = t.inverse(y)
+        np.testing.assert_allclose(back.numpy(), x.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    @pytest.mark.parametrize("t,rng", BIJECTIONS,
+                             ids=lambda p: type(p).__name__
+                             if isinstance(p, D.Transform) else "")
+    def test_log_det_matches_autodiff(self, t, rng):
+        import jax
+        x = _x(5, lo=rng[0], hi=rng[1])
+        ld = t.forward_log_det_jacobian(x).numpy()
+        for i, xi in enumerate(x.numpy()):
+            g = jax.grad(lambda v: float(0) + t._forward(v))(
+                P.to_tensor(xi)._value)
+            np.testing.assert_allclose(ld[i], np.log(abs(np.asarray(g))),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_inverse_log_det_is_negated(self, ):
+        t = D.ExpTransform()
+        x = _x(6)
+        y = t.forward(x)
+        np.testing.assert_allclose(
+            t.inverse_log_det_jacobian(y).numpy(),
+            -t.forward_log_det_jacobian(x).numpy(), rtol=1e-5)
+
+
+class TestStructuredTransforms:
+    def test_abs_surjection(self):
+        t = D.AbsTransform()
+        assert not t._is_injective()
+        np.testing.assert_allclose(
+            t.forward(P.to_tensor(np.array([-2.0, 3.0]))).numpy(),
+            [2.0, 3.0])
+
+    def test_chain_composes_in_order(self):
+        t = D.ChainTransform([
+            D.AffineTransform(P.to_tensor(0.0), P.to_tensor(2.0)),
+            D.ExpTransform()])
+        x = _x(4)
+        np.testing.assert_allclose(t.forward(x).numpy(),
+                                   np.exp(2 * x.numpy()), rtol=1e-5)
+        np.testing.assert_allclose(t.inverse(t.forward(x)).numpy(),
+                                   x.numpy(), rtol=1e-4)
+        # chain log-det = sum of stage log-dets at the staged points
+        want = (np.log(2.0)
+                + 2 * x.numpy())
+        np.testing.assert_allclose(
+            t.forward_log_det_jacobian(x).numpy(), want, rtol=1e-5)
+
+    def test_softmax_and_stickbreaking_hit_simplex(self):
+        x = _x(3, 4)
+        y = D.SoftmaxTransform()(x)
+        np.testing.assert_allclose(y.numpy().sum(-1), 1.0, rtol=1e-5)
+        sb = D.StickBreakingTransform()
+        z = sb.forward(x)
+        assert z.shape[-1] == 5
+        np.testing.assert_allclose(z.numpy().sum(-1), 1.0, rtol=1e-5)
+        assert (z.numpy() > 0).all()
+        np.testing.assert_allclose(sb.inverse(z).numpy(), x.numpy(),
+                                   rtol=1e-3, atol=1e-4)
+        assert sb.forward_shape((3, 4)) == (3, 5)
+
+    def test_reshape_transform(self):
+        t = D.ReshapeTransform((4,), (2, 2))
+        x = _x(3, 4)
+        y = t.forward(x)
+        assert tuple(y.shape) == (3, 2, 2)
+        np.testing.assert_allclose(t.inverse(y).numpy(), x.numpy())
+        np.testing.assert_allclose(
+            t.forward_log_det_jacobian(x).numpy(), np.zeros(3))
+        assert t.forward_shape((5, 4)) == (5, 2, 2)
+
+    def test_independent_transform_sums_log_det(self):
+        base = D.ExpTransform()
+        t = D.IndependentTransform(base, 1)
+        x = _x(3, 4)
+        ld = t.forward_log_det_jacobian(x).numpy()
+        np.testing.assert_allclose(ld, x.numpy().sum(-1), rtol=1e-5)
+
+    def test_stack_transform(self):
+        t = D.StackTransform([D.ExpTransform(),
+                              D.AffineTransform(P.to_tensor(0.0),
+                                                P.to_tensor(3.0))], axis=0)
+        x = _x(2, 5)
+        y = t.forward(x).numpy()
+        np.testing.assert_allclose(y[0], np.exp(x.numpy()[0]), rtol=1e-5)
+        np.testing.assert_allclose(y[1], 3 * x.numpy()[1], rtol=1e-5)
+
+
+class TestTransformedDistribution:
+    def test_lognormal_via_exp_of_normal(self):
+        base = D.Normal(P.to_tensor(0.0), P.to_tensor(1.0))
+        d = D.TransformedDistribution(base, [D.ExpTransform()])
+        P.seed(0)
+        s = d.sample([2000])
+        assert (s.numpy() > 0).all()
+        v = np.array([0.5, 1.0, 2.0], np.float32)
+        got = d.log_prob(P.to_tensor(v)).numpy()
+        # closed-form lognormal pdf
+        want = -np.log(v) - 0.5 * np.log(2 * np.pi) - (np.log(v) ** 2) / 2
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_transform_call_on_distribution(self):
+        d = D.ExpTransform()(D.Normal(P.to_tensor(0.0), P.to_tensor(1.0)))
+        assert isinstance(d, D.TransformedDistribution)
+
+    def test_independent_sums_event_dims(self):
+        base = D.Normal(P.to_tensor(np.zeros((3, 4), np.float32)),
+                        P.to_tensor(np.ones((3, 4), np.float32)))
+        ind = D.Independent(base, 1)
+        v = _x(3, 4)
+        np.testing.assert_allclose(
+            ind.log_prob(v).numpy(),
+            base.log_prob(v).numpy().sum(-1), rtol=1e-6)
+        np.testing.assert_allclose(
+            ind.entropy().numpy(), base.entropy().numpy().sum(-1),
+            rtol=1e-6)
+
+
+class TestExponentialFamilyAndKL:
+    def test_normal_entropy_via_bregman(self):
+        class NormalEF(D.ExponentialFamily):
+            def __init__(self, loc, scale):
+                self.loc = np.float32(loc)
+                self.scale = np.float32(scale)
+
+            @property
+            def _natural_parameters(self):
+                import jax.numpy as jnp
+                return (jnp.asarray(self.loc / self.scale ** 2),
+                        jnp.asarray(-0.5 / self.scale ** 2))
+
+            def _log_normalizer(self, n1, n2):
+                import jax.numpy as jnp
+                return -n1 ** 2 / (4 * n2) - 0.5 * jnp.log(-2.0 * n2)
+
+            @property
+            def _mean_carrier_measure(self):
+                return -0.5 * np.log(2 * np.pi)
+
+        ent = NormalEF(1.3, 2.0).entropy()
+        want = 0.5 + 0.5 * np.log(2 * np.pi) + np.log(2.0)
+        np.testing.assert_allclose(float(ent), want, rtol=1e-5)
+
+    def test_register_kl_dispatch(self):
+        class MyDist(D.Distribution):
+            pass
+
+        @D.register_kl(MyDist, MyDist)
+        def _kl(p, q):
+            return P.to_tensor(np.float32(42.0))
+
+        assert float(D.kl_divergence(MyDist(), MyDist())) == 42.0
+        # built-in pairs still work
+        kl = D.kl_divergence(D.Normal(P.to_tensor(0.0), P.to_tensor(1.0)),
+                             D.Normal(P.to_tensor(1.0), P.to_tensor(1.0)))
+        np.testing.assert_allclose(float(kl), 0.5, rtol=1e-6)
+
+    def test_constraints_and_variables(self):
+        assert bool(D.Positive()(P.to_tensor(2.0)).numpy())
+        assert not bool(D.Positive()(P.to_tensor(-1.0)).numpy())
+        assert bool(D.Range(0, 1)(P.to_tensor(0.5)).numpy())
+        simplex_ok = D.Simplex()(P.to_tensor(
+            np.array([0.2, 0.3, 0.5], np.float32)))
+        assert bool(simplex_ok.numpy())
+        v = D.Variable(False, 1, D.Positive())
+        assert v.event_rank == 1 and not v.is_discrete

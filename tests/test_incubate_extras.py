@@ -188,3 +188,28 @@ class TestInterop:
         P.device.synchronize()
         types = P.device.get_all_device_type()
         assert "cpu" in types
+
+
+class TestHub:
+    def test_local_hubconf_list_help_load(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            'dependencies = ["numpy"]\n'
+            "def tiny_mlp(width=4):\n"
+            '    """Builds a tiny MLP."""\n'
+            "    import paddle_tpu as P\n"
+            "    return P.nn.Linear(width, width)\n")
+        entries = P.hub.list(str(tmp_path), source="local")
+        assert "tiny_mlp" in entries
+        assert "tiny MLP" in P.hub.help(str(tmp_path), "tiny_mlp",
+                                        source="local")
+        layer = P.hub.load(str(tmp_path), "tiny_mlp", source="local",
+                           width=6)
+        assert tuple(layer.weight.shape) == (6, 6)
+
+    def test_remote_sources_raise_clearly(self, tmp_path):
+        with pytest.raises(RuntimeError, match="egress"):
+            P.hub.list("owner/repo", source="github")
+        with pytest.raises(RuntimeError, match="Missing dependencies"):
+            (tmp_path / "hubconf.py").write_text(
+                'dependencies = ["not_a_real_pkg_xyz"]\n')
+            P.hub.list(str(tmp_path), source="local")

@@ -1,0 +1,114 @@
+"""API-coverage diff: reference `python/paddle` public surface vs
+paddle_tpu's importable surface.
+
+The reference package can't be imported (compiled C extensions), so its
+surface is scraped with `ast`: every module's `__all__` plus public
+top-level def/class names.  paddle_tpu IS importable, so presence is
+checked with getattr walks.  Output: per-namespace missing-name lists,
+worst first.  Heuristic by design — used to aim work, not as a gate.
+
+Usage: python tools/api_coverage.py [--limit N] [--namespace paddle.nn]
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import sys
+
+REF = "/root/reference/python/paddle"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# reference namespaces that are GPU/legacy plumbing with no TPU analogue
+SKIP = {
+    "fluid", "libs", "proto", "cost_model", "distributed.fleet.proto",
+    "utils.cpp_extension", "utils.gast", "incubate.xpu", "device.cuda",
+    "base", "_typing", "tests",
+}
+
+
+def ref_public_names(py_path):
+    try:
+        tree = ast.parse(open(py_path, encoding="utf-8").read())
+    except SyntaxError:
+        return set()
+    names = set()
+    explicit_all = None
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__":
+                    try:
+                        explicit_all = {e for e in ast.literal_eval(node.value)
+                                        if isinstance(e, str)}
+                    except Exception:
+                        pass
+        elif isinstance(node, (ast.FunctionDef, ast.ClassDef)):
+            if not node.name.startswith("_"):
+                names.add(node.name)
+    return explicit_all if explicit_all is not None else names
+
+
+def walk_reference():
+    """namespace ('' for top level) -> public names."""
+    out = {}
+    for root, dirs, files in os.walk(REF):
+        rel = os.path.relpath(root, REF)
+        ns = "" if rel == "." else rel.replace(os.sep, ".")
+        if any(ns == s or ns.startswith(s + ".") for s in SKIP):
+            dirs[:] = []
+            continue
+        for f in files:
+            if not f.endswith(".py"):
+                continue
+            mod_ns = ns if f == "__init__.py" else \
+                (f[:-3] if not ns else ns)  # non-init defs roll up to pkg
+            out.setdefault(mod_ns, set()).update(
+                ref_public_names(os.path.join(root, f)))
+    return out
+
+
+def has_attr_path(obj, name):
+    return getattr(obj, name, None) is not None
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--limit", type=int, default=25)
+    ap.add_argument("--namespace", default=None)
+    args = ap.parse_args()
+
+    import paddle_tpu
+
+    ref = walk_reference()
+    rows = []
+    for ns, names in sorted(ref.items()):
+        if args.namespace and not ("paddle." + ns).startswith(
+                args.namespace) and not (ns == "" and
+                                         args.namespace == "paddle"):
+            continue
+        target = paddle_tpu
+        ok = True
+        for part in (ns.split(".") if ns else []):
+            target = getattr(target, part, None)
+            if target is None:
+                ok = False
+                break
+        if not ok:
+            rows.append((ns or "<top>", len(names), sorted(names)[:12],
+                         "NAMESPACE MISSING"))
+            continue
+        missing = sorted(n for n in names if not has_attr_path(target, n))
+        if missing:
+            rows.append((ns or "<top>", len(missing), missing[:12], ""))
+    rows.sort(key=lambda r: -r[1])
+    total_missing = sum(r[1] for r in rows)
+    print(f"namespaces with gaps: {len(rows)}; total missing names: "
+          f"{total_missing}\n")
+    for ns, n, sample, note in rows[:args.limit]:
+        print(f"paddle.{ns:40s} {n:4d} missing {note}  e.g. "
+              f"{', '.join(sample[:8])}")
+
+
+if __name__ == "__main__":
+    main()
