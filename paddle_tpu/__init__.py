@@ -159,9 +159,10 @@ def tolist(x):
 
 def index_add_(x, index, axis, value, name=None):
     """In-place index_add (reference index_add_): same tape semantics as
-    the out-of-place op, result written back into x."""
+    the out-of-place op — _inplace_assign adopts the new autograd node so
+    gradients flow to `value` (a raw value rebind would drop them)."""
     out = index_add(x, index, axis, value)
-    x._set_value(out._value)
+    x._inplace_assign(out)
     return x
 
 

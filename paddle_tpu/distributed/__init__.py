@@ -37,6 +37,7 @@ from paddle_tpu.distributed.collective import (  # noqa: F401
     wait,
 )
 from paddle_tpu.distributed import communication  # noqa: F401
+from paddle_tpu.distributed import rpc  # noqa: F401
 from paddle_tpu.distributed.entry_attr import (  # noqa: F401
     CountFilterEntry,
     ProbabilityEntry,

@@ -1,0 +1,258 @@
+"""RPC framework (reference: python/paddle/distributed/rpc/rpc.py —
+init_rpc :73, rpc_sync :141, rpc_async :179, shutdown :270,
+get_worker_info :299).
+
+The reference rides brpc through the C++ core.  Here each worker runs a
+threaded `multiprocessing.connection.Listener` service; the master
+endpoint is a tiny in-process rendezvous server that exchanges
+(name, ip, port) triples, after which calls go worker<->worker directly.
+Callables are sent by qualified name (module:qualname) and re-resolved
+on the callee — the wire format carries DATA, never code objects, so a
+malicious peer can at most call functions already importable there.
+Thread-based futures back rpc_async.
+"""
+from __future__ import annotations
+
+import importlib
+import threading
+import time
+from collections import namedtuple
+from concurrent.futures import ThreadPoolExecutor
+from multiprocessing.connection import Client, Listener
+
+WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
+
+_DEFAULT_RPC_TIMEOUT = 30.0
+_AUTH = b"paddle_tpu_rpc"
+
+_state = {
+    "self": None,          # WorkerInfo
+    "workers": {},         # name -> WorkerInfo
+    "listener": None,
+    "serve_thread": None,
+    "pool": None,
+    "master": None,        # _Rendezvous if this rank hosts it
+    "shutdown": False,
+}
+
+
+def _fn_ref(fn):
+    mod = getattr(fn, "__module__", None)
+    qual = getattr(fn, "__qualname__", None)
+    if not mod or not qual or "<locals>" in qual or "<lambda>" in qual:
+        raise ValueError(
+            "rpc can only ship module-level functions (sent by qualified "
+            "name, resolved on the callee — closures/lambdas have no "
+            "importable name)")
+    return f"{mod}:{qual}"
+
+
+def _resolve(ref):
+    mod, qual = ref.split(":", 1)
+    obj = importlib.import_module(mod)
+    for part in qual.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+# ------------------------------------------------------------ rendezvous
+class _Rendezvous:
+    """Master-endpoint name exchange: collects world_size WorkerInfos,
+    then hands the full table to every caller."""
+
+    def __init__(self, host, port, world_size):
+        self._infos = {}
+        self._cv = threading.Condition()
+        self._world = world_size
+        self._listener = Listener((host, port), authkey=_AUTH)
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        handlers = []
+        for _ in range(self._world):
+            try:
+                conn = self._listener.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._handle, args=(conn,),
+                                 daemon=True)
+            t.start()
+            handlers.append(t)
+
+    def _handle(self, conn):
+        info = WorkerInfo(*conn.recv())
+        with self._cv:
+            self._infos[info.name] = info
+            self._cv.notify_all()
+            self._cv.wait_for(lambda: len(self._infos) >= self._world)
+        conn.send(sorted(self._infos.values(), key=lambda w: w.rank))
+        conn.close()
+
+    def close(self):
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+# ------------------------------------------------------------ worker side
+def _serve_loop(listener, pool):
+    while not _state["shutdown"]:
+        try:
+            conn = listener.accept()
+        except OSError:
+            return
+
+        def handle(c):
+            try:
+                msg = c.recv()
+                if msg[0] == "call":
+                    _, ref, args, kwargs = msg
+                    try:
+                        out = _resolve(ref)(*args, **(kwargs or {}))
+                        c.send(("ok", out))
+                    except Exception as e:  # ship the error, not a hang
+                        c.send(("err", f"{type(e).__name__}: {e}"))
+                elif msg[0] == "bye":
+                    c.send(("ok", None))
+            except EOFError:
+                pass
+            finally:
+                c.close()
+
+        pool.submit(handle, conn)
+
+
+def _my_ip(master_host):
+    """Address other workers can dial: loopback stays loopback for a
+    local master; otherwise the interface that routes to the master."""
+    import socket
+    if master_host in ("127.0.0.1", "localhost"):
+        return "127.0.0.1"
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect((master_host, 1))
+        return s.getsockname()[0]
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+    finally:
+        s.close()
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Join the RPC world: rank 0's process hosts the rendezvous at
+    master_endpoint; every worker starts its service and learns every
+    other worker's endpoint."""
+    host, port = (master_endpoint or "127.0.0.1:29500").split(":")
+    port = int(port)
+    rank = 0 if rank is None else rank
+    world_size = 1 if world_size is None else world_size
+
+    if rank == 0:
+        _state["master"] = _Rendezvous(host, port, world_size)
+
+    my_ip = _my_ip(host)
+    listener = Listener(("", 0), authkey=_AUTH)  # reachable from peers
+    my_port = listener.address[1]
+    _state["listener"] = listener
+    _state["pool"] = ThreadPoolExecutor(max_workers=8)
+    _state["serve_thread"] = threading.Thread(
+        target=_serve_loop, args=(listener, _state["pool"]), daemon=True)
+    _state["shutdown"] = False
+    _state["serve_thread"].start()
+
+    me = WorkerInfo(name, rank, my_ip, my_port)
+    _state["self"] = me
+    deadline = time.time() + _DEFAULT_RPC_TIMEOUT
+    while True:
+        try:
+            conn = Client((host, port), authkey=_AUTH)
+            break
+        except ConnectionError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.05)
+    conn.send(tuple(me))
+    infos = conn.recv()
+    conn.close()
+    _state["workers"] = {w.name: WorkerInfo(*w) for w in infos}
+    return me
+
+
+def _invoke(to, fn, args, kwargs, timeout):
+    w = _state["workers"].get(to)
+    if w is None:
+        raise RuntimeError(f"unknown rpc worker {to!r}; known: "
+                           f"{sorted(_state['workers'])}")
+    conn = Client((w.ip, w.port), authkey=_AUTH)
+    try:
+        conn.send(("call", _fn_ref(fn), tuple(args or ()),
+                   dict(kwargs or {})))
+        if timeout is not None and timeout > 0 and not conn.poll(timeout):
+            raise TimeoutError(
+                f"rpc to {to!r} timed out after {timeout}s")
+        status, payload = conn.recv()
+    finally:
+        conn.close()
+    if status == "err":
+        raise RuntimeError(f"rpc to {to!r} failed remotely: {payload}")
+    return payload
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
+    """Blocking remote call; returns the result."""
+    return _invoke(to, fn, args, kwargs, timeout)
+
+
+class _FutureWrapper:
+    """reference FutureWrapper surface (.wait) over a stdlib Future —
+    wrapping instead of monkey-patching Future keeps the stdlib class
+    untouched."""
+
+    def __init__(self, fut):
+        self._fut = fut
+
+    def wait(self, timeout=None):
+        return self._fut.result(timeout)
+
+    def result(self, timeout=None):
+        return self._fut.result(timeout)
+
+    def done(self):
+        return self._fut.done()
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
+    """Non-blocking remote call; returns a future with .wait()/.result()."""
+    return _FutureWrapper(
+        _state["pool"].submit(_invoke, to, fn, args, kwargs, timeout))
+
+
+def shutdown():
+    """Synchronize and tear the service down."""
+    _state["shutdown"] = True
+    if _state["listener"] is not None:
+        try:
+            _state["listener"].close()
+        except OSError:
+            pass
+    if _state["pool"] is not None:
+        _state["pool"].shutdown(wait=False)
+    if _state["master"] is not None:
+        _state["master"].close()
+    for k in ("self", "listener", "serve_thread", "pool", "master"):
+        _state[k] = None
+    _state["workers"] = {}
+
+
+def get_worker_info(name):
+    return _state["workers"][name]
+
+
+def get_all_worker_infos():
+    return sorted(_state["workers"].values(), key=lambda w: w.rank)
+
+
+def get_current_worker_info():
+    return _state["self"]

@@ -24,11 +24,17 @@ def _import_hubconf(repo_dir):
         raise FileNotFoundError(f"no {MODULE_HUBCONF} in {repo_dir}")
     spec = importlib.util.spec_from_file_location("hubconf", path)
     module = importlib.util.module_from_spec(spec)
-    sys.path.insert(0, repo_dir)
+    was_on_path = repo_dir in sys.path
+    if not was_on_path:
+        sys.path.insert(0, repo_dir)
     try:
         spec.loader.exec_module(module)
     finally:
-        sys.path.remove(repo_dir)
+        if not was_on_path:  # never delete a pre-existing user entry
+            try:
+                sys.path.remove(repo_dir)
+            except ValueError:
+                pass
     _check_dependencies(module)
     return module
 

@@ -1,6 +1,7 @@
 """paddle_tpu.nn — layers namespace. Reference: python/paddle/nn/__init__.py."""
 from paddle_tpu.nn import functional  # noqa: F401
 from paddle_tpu.nn import initializer  # noqa: F401
+from paddle_tpu.nn import quant  # noqa: F401
 from paddle_tpu.nn import utils  # noqa: F401
 from paddle_tpu.nn.clip import (  # noqa: F401
     ClipGradByGlobalNorm,

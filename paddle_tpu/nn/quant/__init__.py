@@ -1,0 +1,114 @@
+"""paddle.nn.quant parity (reference: python/paddle/nn/quant/quant_layers.py).
+
+The reference's FakeQuant* layers simulate int8 quantization during QAT
+with straight-through gradients; here they are thin Layer wrappers over
+paddle_tpu.quantization's STE fake_quant + observers, which the
+quantization module's ImperativePTQ/ImperativeQuantAware already insert.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer.layers import Layer
+from paddle_tpu.quantization import (
+    AbsmaxQuantizer,
+    PerChannelAbsmaxQuantizer,
+    fake_quant,
+)
+
+__all__ = [
+    "FakeQuantAbsMax", "FakeQuantChannelWiseAbsMax",
+    "FakeQuantMovingAverageAbsMax", "FakeQuantMAOutputScaleLayer",
+    "QuantStub", "quant_dequant",
+]
+
+
+def quant_dequant(x, scale, bits=8):
+    """Round-trip through the int grid with STE gradients."""
+    return fake_quant(x, scale, bits)
+
+
+class FakeQuantAbsMax(Layer):
+    """Per-tensor absmax fake quantization (reference quant_layers.py
+    FakeQuantAbsMax)."""
+
+    def __init__(self, name=None, quant_bits=8, dtype="float32",
+                 quant_on_weight=False):
+        super().__init__()
+        self.bits = quant_bits
+
+    def forward(self, x):
+        scale = float(np.abs(np.asarray(x._value)).max()) or 1.0
+        qmax = 2 ** (self.bits - 1) - 1
+        return fake_quant(x, scale / qmax, self.bits)
+
+
+class FakeQuantChannelWiseAbsMax(Layer):
+    """Per-output-channel absmax fake quantization."""
+
+    def __init__(self, name=None, channel_num=None, quant_bits=8,
+                 quant_axis=0, dtype="float32", quant_on_weight=True):
+        super().__init__()
+        self.bits = quant_bits
+        self.axis = quant_axis
+
+    def forward(self, x):
+        v = np.asarray(x._value)
+        axes = tuple(i for i in range(v.ndim) if i != self.axis)
+        amax = np.abs(v).max(axis=axes, keepdims=True)
+        amax = np.where(amax == 0, 1.0, amax)
+        qmax = 2 ** (self.bits - 1) - 1
+        shape = [1] * v.ndim
+        shape[self.axis] = -1
+        return fake_quant(x, (amax / qmax).reshape(shape), self.bits)
+
+
+class FakeQuantMovingAverageAbsMax(Layer):
+    """Activation fake quantization with an EMA absmax scale (reference
+    FakeQuantMovingAverageAbsMax): the running scale is a persistable
+    state tensor so QAT checkpoints carry it."""
+
+    def __init__(self, name=None, moving_rate=0.9, quant_bits=8,
+                 dtype="float32"):
+        super().__init__()
+        self.rate = moving_rate
+        self.bits = quant_bits
+        self.scale = self.create_parameter([1])
+        self.scale._set_value(jnp.ones((1,), jnp.float32))
+        self.scale.stop_gradient = True
+
+    def forward(self, x):
+        if self.training:
+            cur = float(np.abs(np.asarray(x._value)).max()) or 1e-7
+            new = self.rate * float(self.scale._value[0]) \
+                + (1 - self.rate) * cur
+            self.scale._set_value(jnp.asarray([new], jnp.float32))
+        qmax = 2 ** (self.bits - 1) - 1
+        return fake_quant(x, float(self.scale._value[0]) / qmax, self.bits)
+
+
+class FakeQuantMAOutputScaleLayer(Layer):
+    """Wrap a layer and fake-quantize its OUTPUT with a moving-average
+    scale (reference FakeQuantMAOutputScaleLayer)."""
+
+    def __init__(self, layer, moving_rate=0.9, name=None, dtype="float32"):
+        super().__init__()
+        self._layer = layer
+        self._fq = FakeQuantMovingAverageAbsMax(moving_rate=moving_rate)
+
+    def forward(self, *args, **kwargs):
+        return self._fq(self._layer(*args, **kwargs))
+
+
+class QuantStub(Layer):
+    """Input quant marker (reference nn/quant/stub.py): observes and
+    fake-quantizes the network input."""
+
+    def __init__(self, observer=None):
+        super().__init__()
+        self._fq = FakeQuantMovingAverageAbsMax()
+
+    def forward(self, x):
+        return self._fq(x)

@@ -117,11 +117,13 @@ class Profiler:
             os.makedirs(self._trace_dir, exist_ok=True)
             jax.profiler.start_trace(self._trace_dir)
             self._active = True
+            _profiler_mode[0] = True
 
     def _end_trace(self):
         if self._active:
             jax.profiler.stop_trace()
             self._active = False
+            _profiler_mode[0] = False
             # the reference contract: the callback fires only when a
             # recorded window's trace is ready
             if self.on_trace_ready is not None:
@@ -211,3 +213,79 @@ def stop_profiler(dir_name=None):
 def load_profiler_result(path):
     raise NotImplementedError(
         "open the trace directory with TensorBoard or Perfetto")
+
+
+class SortedKeys(enum.Enum):
+    """Report sort orders (reference profiler/profiler_statistic.py)."""
+
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView(enum.Enum):
+    """Summary table selectors (reference profiler/profiler.py:41)."""
+
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+_profiler_mode = [False]
+
+
+def in_profiler_mode():
+    return _profiler_mode[0]
+
+
+def wrap_optimizers():
+    """The reference monkey-patches optimizer.step for op annotation; our
+    Optimizer.step already runs under RecordEvent when a profiler is
+    active, so this is a no-op hook kept for API compatibility."""
+    return None
+
+
+class Benchmark:
+    """Throughput/latency helper (reference profiler/utils.py Benchmark):
+    wall-clock step timing with warmup discard."""
+
+    def __init__(self):
+        self._times = []
+        self._t0 = None
+
+    def begin(self):
+        import time
+        self._t0 = time.perf_counter()
+
+    def step(self, num_samples=None):
+        import time
+        if self._t0 is not None:
+            self._times.append((time.perf_counter() - self._t0,
+                                num_samples or 1))
+        self._t0 = time.perf_counter()
+
+    def end(self):
+        self._t0 = None
+
+    def report(self, warmup=1):
+        times = self._times[warmup:] or self._times
+        if not times:
+            return {}
+        total_t = sum(t for t, _ in times)
+        total_n = sum(n for _, n in times)
+        return {"steps": len(times), "avg_ms": 1e3 * total_t / len(times),
+                "ips": total_n / total_t if total_t else 0.0}
+
+
+benchmark = Benchmark

@@ -71,15 +71,464 @@ def data(name, shape, dtype="float32", lod_level=0):
 
 
 class Program:
-    """Placeholder for paddle.static.Program (not used in the TPU design)."""
+    """Light paddle.static.Program analogue.  There is no separate
+    ProgramDesc interpreter in the TPU design — a "program" is the pair
+    (traced callables, state tensors) — but the Program object carries
+    the reference's bookkeeping surface: random seed, a global block
+    holding created vars/params, and state_dict-style access so
+    save/load/program_guard-based user code runs.
+    """
 
     def __init__(self):
-        pass
+        self.random_seed = 0
+        self._vars = {}
+
+    def global_block(self):
+        return self
+
+    # block-ish surface
+    def var(self, name):
+        return self._vars[name]
+
+    def all_parameters(self):
+        from paddle_tpu.core.tensor import Parameter
+        return [v for v in self._vars.values()
+                if isinstance(v, Parameter)]
+
+    def list_vars(self):
+        return list(self._vars.values())
+
+    def state_dict(self, mode="all"):
+        return dict(self._vars)
+
+    def set_state_dict(self, state_dict):
+        for k, v in state_dict.items():
+            if k in self._vars:
+                self._vars[k]._set_value(
+                    v._value if hasattr(v, "_value") else v)
+
+    def clone(self, for_test=False):
+        p = Program()
+        p.random_seed = self.random_seed
+        p._vars = dict(self._vars)
+        return p
+
+
+_main_program = [Program()]
+_startup_program = [Program()]
 
 
 def default_main_program():
-    return Program()
+    return _main_program[0]
 
 
 def default_startup_program():
-    return Program()
+    return _startup_program[0]
+
+
+class program_guard:
+    """Scope new vars into the given program (reference static/__init__
+    program_guard)."""
+
+    def __init__(self, main_program, startup_program=None):
+        self._main = main_program
+        self._startup = startup_program
+
+    def __enter__(self):
+        self._prev = (_main_program[0], _startup_program[0])
+        _main_program[0] = self._main
+        if self._startup is not None:
+            _startup_program[0] = self._startup
+        return self
+
+    def __exit__(self, *exc):
+        _main_program[0], _startup_program[0] = self._prev
+        return False
+
+
+class Executor:
+    """paddle.static.Executor facade: `run(feed=..., fetch_list=...)`
+    calls the traced callables the TPU design compiles — fetch entries
+    may be Tensors (returned as numpy) or callables of the feed."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True):
+        feed = feed or {}
+        outs = []
+        for f in (fetch_list or []):
+            if callable(f):
+                out = f(**feed)
+            else:
+                out = f
+            if return_numpy and hasattr(out, "numpy"):
+                out = out.numpy()
+            outs.append(out)
+        return outs
+
+    def close(self):
+        return None
+
+
+class scope_guard:
+    def __init__(self, scope):
+        self.scope = scope
+
+    def __enter__(self):
+        return self.scope
+
+    def __exit__(self, *exc):
+        return False
+
+
+class _Scope:
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        return self._vars.setdefault(name, None)
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+
+_global_scope = _Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+def name_scope(prefix=None):
+    import contextlib
+
+    @contextlib.contextmanager
+    def ctx():
+        yield
+
+    return ctx()
+
+
+def device_guard(device=None):
+    import contextlib
+
+    @contextlib.contextmanager
+    def ctx():
+        yield
+
+    return ctx()
+
+
+def cpu_places(device_count=None):
+    import jax
+
+    from paddle_tpu.core.device import CPUPlace
+    n = device_count or max(1, len(jax.devices("cpu")))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    return []  # no CUDA devices on the TPU backend
+
+
+def npu_places(device_ids=None):
+    return []
+
+
+def xpu_places(device_ids=None):
+    return []
+
+
+def mlu_places(device_ids=None):
+    return []
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.framework.state import register_state_tensor
+    t = Tensor(jnp.full(tuple(shape), value, convert_dtype(dtype)),
+               name=name)
+    t.persistable = persistable
+    if persistable:
+        register_state_tensor(t)
+    default_main_program()._vars[t.name] = t
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.tensor import Parameter
+    # Parameter registers itself as a state tensor on construction
+    p = Parameter(jnp.zeros(tuple(shape), convert_dtype(dtype)), name=name)
+    init = default_initializer or (
+        attr.initializer if attr is not None and getattr(
+            attr, "initializer", None) else None)
+    if init is not None:
+        init(p)
+    default_main_program()._vars[p.name] = p
+    return p
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Symbolic-gradient analogue: tape/jax gradients of targets w.r.t.
+    inputs (reference static append_backward/gradients pair).
+    target_gradients weight each target BEFORE the scalar reduction —
+    the reference's cotangent contract."""
+    from paddle_tpu.autograd import grad
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if target_gradients is not None:
+        tg = target_gradients if isinstance(
+            target_gradients, (list, tuple)) else [target_gradients]
+        targets = [t if w is None else t * w
+                   for t, w in zip(targets, tg)]
+    total = targets[0].sum()
+    for t in targets[1:]:
+        total = total + t.sum()
+    return grad(total, inputs, allow_unused=True)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Eager/tape analogue of append_backward: computes grads and returns
+    (param, grad) pairs like the reference."""
+    loss.backward()
+    params = parameter_list or []
+    return [(p, p.grad) for p in params if getattr(p, "grad", None)
+            is not None]
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    from paddle_tpu.metric import accuracy as _acc
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    from paddle_tpu.metric import Auc
+    m = Auc(num_thresholds=min(num_thresholds, 4095))
+    m.update(input, label)
+    import numpy as _np
+
+    import paddle_tpu as P
+    return P.to_tensor(_np.asarray(m.accumulate(), _np.float32))
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-python op (reference static/nn/common.py py_func): runs func
+    on host values; the tape records it via pure_callback semantics —
+    eager path calls directly."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    result = func(*xs)
+    if out is not None and hasattr(out, "_set_value") and hasattr(
+            result, "_value"):
+        out._set_value(result._value)
+        return out
+    return result
+
+
+class WeightNormParamAttr:
+    """reference static/nn/common.py WeightNormParamAttr: ParamAttr that
+    applies weight normalization (dim) on the created parameter."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        from paddle_tpu.nn.initializer import ParamAttr
+        self.dim = dim
+        self.attr = ParamAttr(name=name, initializer=initializer,
+                              learning_rate=learning_rate,
+                              regularizer=regularizer, trainable=trainable)
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (reference static/__init__.py
+    ExponentialMovingAverage): update() folds current params into the
+    shadow values; apply()/restore() swap them in and out."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._shadow = {}
+        self._backup = None
+        self._params = None
+
+    def _ensure(self, params):
+        import jax.numpy as jnp
+        if self._params is None:
+            self._params = list(params)
+            for p in self._params:
+                self._shadow[id(p)] = p._value.astype(jnp.float32) + 0
+
+    def update(self, parameters=None):
+        import jax.numpy as jnp
+        if parameters is None and self._params is None:
+            raise ValueError(
+                "first update() needs the parameter list (the reference "
+                "discovers it from the static program; there is none here)")
+        self._ensure(parameters or self._params)
+        d = self._decay
+        for p in self._params:
+            sh = self._shadow[id(p)]
+            self._shadow[id(p)] = d * sh + (1 - d) * p._value.astype(
+                jnp.float32)
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            self._backup = [(p, p._value) for p in self._params or []]
+            for p in (self._params or []):
+                p._set_value(self._shadow[id(p)].astype(p._value.dtype))
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+
+        return ctx()
+
+    def restore(self, executor=None):
+        if self._backup:
+            for p, v in self._backup:
+                p._set_value(v)
+            self._backup = None
+
+
+class BuildStrategy:
+    """Compile-strategy bag; XLA owns fusion/layout decisions, knobs are
+    accepted for compatibility."""
+
+    def __init__(self):
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.enable_auto_fusion = False
+        self.memory_optimize = True
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+
+
+class CompiledProgram:
+    """The reference compiles a ProgramDesc; here to_static already
+    produces the compiled XLA executable, so this wraps and forwards."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+
+    def with_data_parallel(self, *a, **kw):
+        return self
+
+    def __getattr__(self, item):
+        return getattr(self._program, item)
+
+
+ParallelExecutor = CompiledProgram
+
+
+def serialize_program(feed_vars, fetch_vars, **kwargs):
+    # JSON, not pickle: loading a serialized program must never execute
+    # code (same policy as jit.serialization's PTPU container)
+    import json
+    return json.dumps({"feed": [getattr(v, "name", None)
+                                for v in feed_vars],
+                       "fetch": [getattr(v, "name", None)
+                                 for v in fetch_vars]}).encode()
+
+
+def deserialize_program(data):
+    import json
+    return json.loads(data.decode() if isinstance(data, bytes) else data)
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None, **kwargs):
+    import io
+
+    import numpy as _np
+    buf = io.BytesIO()
+    prog = default_main_program()
+    _np.savez(buf, **{k: _np.asarray(v._value)
+                      for k, v in prog._vars.items()})
+    return buf.getvalue()
+
+
+def deserialize_persistables(program, data, executor=None):
+    import io
+
+    import numpy as _np
+    loaded = _np.load(io.BytesIO(data))
+    for k in loaded.files:
+        if k in program._vars:
+            program._vars[k]._set_value(loaded[k])
+    return program
+
+
+def save(program, model_prefix):
+    import numpy as _np
+    _np.savez(model_prefix + ".pdparams",
+              **{k: _np.asarray(v._value)
+                 for k, v in program._vars.items()})
+
+
+def load(program, model_prefix, executor=None, var_list=None):
+    import numpy as _np
+    path = model_prefix + ".pdparams"
+    if not path.endswith(".npz"):
+        import os
+        path = path if os.path.exists(path) else path + ".npz"
+    loaded = _np.load(path)
+    for k in loaded.files:
+        if k in program._vars:
+            program._vars[k]._set_value(loaded[k])
+
+
+def load_program_state(model_prefix, var_list=None):
+    import numpy as _np
+    import os
+    path = model_prefix + ".pdparams"
+    path = path if os.path.exists(path) else path + ".npz"
+    loaded = _np.load(path)
+    return {k: loaded[k] for k in loaded.files}
+
+
+def set_program_state(program, state):
+    for k, v in state.items():
+        if k in program._vars:
+            program._vars[k]._set_value(v)
+
+
+def normalize_program(program, feed_vars, fetch_vars):
+    return program
+
+
+# reference static Variable — in the TPU design every variable IS a
+# Tensor; a direct alias keeps `isinstance(x, static.Variable)` true for
+# Tensors in ported code
+from paddle_tpu.core.tensor import Tensor as Variable  # noqa: E402
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Debug print (reference static/nn/control_flow.py Print): eager
+    prints immediately; under jit it becomes jax.debug.print."""
+    import jax
+
+    from paddle_tpu.core.dispatch import apply
+
+    def fn(v):
+        jax.debug.print((message or "") + " {}", v)
+        return v
+
+    return apply(fn, input)

@@ -76,17 +76,22 @@ def require_version(min_version, max_version=None):
         for p in str(v).split(".")[:3]:
             digits = "".join(c for c in p if c.isdigit())
             parts.append(int(digits) if digits else 0)
-        while len(parts) < 3:  # '0.1' means '0.1.x' — pad, don't shorten
-            parts.append(0)
         return tuple(parts)
 
+    def pad(t, n):
+        return t + (0,) * (n - len(t))
+
     cur = parse(getattr(paddle_tpu, "__version__", "0.0.0"))
-    if parse(min_version) > cur:
+    mn = parse(min_version)
+    if pad(mn, 3) > pad(cur, 3):
         raise Exception(
             f"installed version {cur} < required minimum {min_version}")
-    if max_version is not None and parse(max_version) < cur:
-        raise Exception(
-            f"installed version {cur} > required maximum {max_version}")
+    if max_version is not None:
+        mx = parse(max_version)
+        # 'max 2.1' admits every 2.1.x: compare at the max's precision
+        if mx < cur[:len(mx)]:
+            raise Exception(
+                f"installed version {cur} > required maximum {max_version}")
 
 
 class ProfilerOptions:

@@ -1,0 +1,241 @@
+"""paddle.static compat surface, distributed.rpc, nn.quant fake-quant
+layers, profiler statistics enums.
+
+Reference: python/paddle/static/__init__.py, distributed/rpc/rpc.py,
+nn/quant/quant_layers.py, profiler/profiler.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu import static as S
+
+
+def _double(x):
+    return x * 2
+
+
+def _add(a, b=0):
+    return a + b
+
+
+class TestStatic:
+    def test_program_guard_and_vars(self):
+        prog = S.Program()
+        with S.program_guard(prog):
+            v = S.create_global_var([2, 2], 3.0, "float32")
+            p = S.create_parameter([4], "float32")
+        assert v.name in prog._vars and p.name in prog._vars
+        assert (v.numpy() == 3.0).all()
+        clone = prog.clone(for_test=True)
+        assert set(clone._vars) == set(prog._vars)
+        assert len(prog.all_parameters()) >= 1
+
+    def test_executor_run(self):
+        ex = S.Executor()
+        outs = ex.run(feed={"x": P.ones([3])},
+                      fetch_list=[lambda x: x + 1])
+        np.testing.assert_allclose(outs[0], 2.0)
+
+    def test_gradients_and_append_backward(self):
+        x = P.to_tensor(np.array([2.0], np.float32))
+        x.stop_gradient = False
+        (g,) = S.gradients((x ** 3).sum(), [x])
+        np.testing.assert_allclose(g.numpy(), [12.0])
+
+        lin = P.nn.Linear(2, 1)
+        loss = (lin(P.ones([1, 2])) ** 2).mean()
+        pairs = S.append_backward(loss, parameter_list=lin.parameters())
+        assert pairs and all(g is not None for _, g in pairs)
+
+    def test_program_save_load_roundtrip(self, tmp_path):
+        prog = S.Program()
+        with S.program_guard(prog):
+            v = S.create_global_var([2], 7.0, "float32")
+        S.save(prog, str(tmp_path / "m"))
+        v._set_value(v._value * 0)
+        S.load(prog, str(tmp_path / "m"))
+        np.testing.assert_allclose(v.numpy(), 7.0)
+        state = S.load_program_state(str(tmp_path / "m"))
+        assert v.name in state
+
+    def test_serialize_program_is_not_executable(self):
+        data = S.serialize_program([S.data("x", [2])], [])
+        assert b"pickle" not in data
+        assert S.deserialize_program(data)["feed"] == ["x"]
+
+    def test_ema(self):
+        lin = P.nn.Linear(2, 2)
+        ema = S.ExponentialMovingAverage(0.5)
+        w0 = lin.weight.numpy().copy()
+        ema.update(lin.parameters())
+        lin.weight._set_value(lin.weight._value + 1.0)
+        ema.update()
+        live = lin.weight.numpy().copy()
+        with ema.apply():
+            inside = lin.weight.numpy().copy()
+        np.testing.assert_allclose(lin.weight.numpy(), live)
+        np.testing.assert_allclose(inside, 0.5 * w0 + 0.5 * (w0 + 1),
+                                   rtol=1e-6)
+
+    def test_places_and_misc(self):
+        assert S.cpu_places()
+        assert S.cuda_places() == []
+        with S.name_scope("blk"):
+            pass
+        with S.device_guard("cpu"):
+            pass
+        acc = S.accuracy(P.to_tensor(np.array([[0.9, 0.1], [0.2, 0.8]],
+                                              np.float32)),
+                         P.to_tensor(np.array([[0], [1]]), dtype="int64"))
+        np.testing.assert_allclose(float(acc), 1.0)
+
+
+class TestRPC:
+    def test_single_worker_sync_async_and_info(self):
+        from paddle_tpu.distributed import rpc
+        import socket
+        s_ = socket.socket(); s_.bind(("", 0)); port = s_.getsockname()[1]; s_.close()
+        me = rpc.init_rpc("w0", rank=0, world_size=1,
+                          master_endpoint=f"127.0.0.1:{port}")
+        try:
+            assert rpc.get_current_worker_info().name == "w0"
+            assert rpc.get_worker_info("w0").rank == 0
+            assert [w.name for w in rpc.get_all_worker_infos()] == ["w0"]
+            out = rpc.rpc_sync("w0", _double, args=(21,))
+            assert out == 42
+            fut = rpc.rpc_async("w0", _add, args=(40,), kwargs={"b": 2})
+            assert fut.result(10) == 42
+            with pytest.raises(RuntimeError, match="remotely"):
+                rpc.rpc_sync("w0", _resolve_error_helper, args=())
+        finally:
+            rpc.shutdown()
+
+    def test_lambda_rejected(self):
+        from paddle_tpu.distributed import rpc
+        import socket
+        s_ = socket.socket(); s_.bind(("", 0)); port = s_.getsockname()[1]; s_.close()
+        me = rpc.init_rpc("solo", rank=0, world_size=1,
+                          master_endpoint=f"127.0.0.1:{port}")
+        try:
+            with pytest.raises(ValueError, match="module-level"):
+                rpc.rpc_sync("solo", lambda: 1)
+        finally:
+            rpc.shutdown()
+
+    def test_two_workers_in_threads(self):
+        """Two RPC workers inside one process (threaded listeners):
+        cross-worker call routes through w1's service."""
+        import socket
+        import threading
+        import time
+
+        from paddle_tpu.distributed.rpc import rpc as R
+        s = socket.socket()
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        # worker 1: its own listener + registration (rank 0's init_rpc
+        # hosts the rendezvous)
+        from multiprocessing.connection import Client, Listener
+        w1_listener = Listener(("127.0.0.1", 0), authkey=R._AUTH)
+
+        def serve_w1():
+            conn = w1_listener.accept()
+            msg = conn.recv()
+            assert msg[0] == "call"
+            fn = R._resolve(msg[1])
+            conn.send(("ok", fn(*msg[2], **msg[3])))
+            conn.close()
+
+        threading.Thread(target=serve_w1, daemon=True).start()
+
+        w1 = R.WorkerInfo("w1", 1, "127.0.0.1", w1_listener.address[1])
+
+        def reg1():
+            deadline = time.time() + 15
+            while True:
+                try:
+                    c = Client(("127.0.0.1", port), authkey=R._AUTH)
+                    break
+                except ConnectionError:
+                    if time.time() > deadline:
+                        raise
+                    time.sleep(0.05)
+            c.send(tuple(w1))
+            c.recv()
+            c.close()
+
+        t1 = threading.Thread(target=reg1, daemon=True)
+        t1.start()
+        R.init_rpc("w0", rank=0, world_size=2,
+                   master_endpoint=f"127.0.0.1:{port}")
+        t1.join(15)
+        try:
+            assert {w.name for w in R.get_all_worker_infos()} == \
+                {"w0", "w1"}
+            assert R.rpc_sync("w1", _double, args=(5,)) == 10
+        finally:
+            R.shutdown()
+            w1_listener.close()
+
+
+def _resolve_error_helper():
+    raise ValueError("boom")
+
+
+class TestNNQuant:
+    def test_fake_quant_absmax_roundtrip(self):
+        fq = P.nn.quant.FakeQuantAbsMax(quant_bits=8)
+        x = P.to_tensor(np.linspace(-1, 1, 17).astype(np.float32))
+        y = fq(x)
+        assert np.abs(y.numpy() - x.numpy()).max() <= 1.0 / 127 + 1e-6
+
+    def test_channelwise_scales_differ(self):
+        cw = P.nn.quant.FakeQuantChannelWiseAbsMax(quant_axis=0)
+        w = np.stack([np.linspace(-1, 1, 8),
+                      np.linspace(-100, 100, 8)]).astype(np.float32)
+        y = cw(P.to_tensor(w)).numpy()
+        np.testing.assert_allclose(y, w, rtol=2e-2)
+
+    def test_moving_average_updates_in_train_only(self):
+        ma = P.nn.quant.FakeQuantMovingAverageAbsMax(moving_rate=0.5)
+        x = P.to_tensor(np.array([4.0], np.float32))
+        ma.train()
+        ma(x)
+        s1 = float(ma.scale._value[0])
+        ma.eval()
+        ma(P.to_tensor(np.array([100.0], np.float32)))
+        assert float(ma.scale._value[0]) == s1
+
+    def test_output_scale_wrapper_and_stub(self):
+        lin = P.nn.Linear(3, 3)
+        wrapped = P.nn.quant.FakeQuantMAOutputScaleLayer(lin)
+        out = wrapped(P.ones([2, 3]))
+        assert tuple(out.shape) == (2, 3)
+        stub = P.nn.quant.QuantStub()
+        assert tuple(stub(P.ones([2, 3])).shape) == (2, 3)
+
+    def test_ste_gradient_passthrough(self):
+        x = P.to_tensor(np.array([0.3, -0.7], np.float32))
+        x.stop_gradient = False
+        from paddle_tpu.quantization import fake_quant
+        y = fake_quant(x, 1.0 / 127, bits=8)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [1.0, 1.0])
+
+
+class TestProfilerStats:
+    def test_enums_and_mode_flag(self):
+        assert P.profiler.SortedKeys.CPUTotal.value == 0
+        assert P.profiler.SummaryView.MemoryView is not None
+        assert not P.profiler.in_profiler_mode()
+        P.profiler.wrap_optimizers()
+
+    def test_benchmark_report(self):
+        b = P.profiler.Benchmark()
+        b.begin()
+        for _ in range(3):
+            b.step(num_samples=4)
+        rep = b.report(warmup=1)
+        assert rep["steps"] == 2 and rep["ips"] > 0
