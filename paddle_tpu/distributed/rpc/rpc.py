@@ -30,7 +30,9 @@ _state = {
     "workers": {},         # name -> WorkerInfo
     "listener": None,
     "serve_thread": None,
-    "pool": None,
+    "pool": None,          # serves INCOMING requests
+    "client_pool": None,   # runs OUTBOUND rpc_async calls — separate so
+                           # self-calls/cycles can't starve the server side
     "master": None,        # _Rendezvous if this rank hosts it
     "shutdown": False,
 }
@@ -157,6 +159,7 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     my_port = listener.address[1]
     _state["listener"] = listener
     _state["pool"] = ThreadPoolExecutor(max_workers=8)
+    _state["client_pool"] = ThreadPoolExecutor(max_workers=8)
     _state["serve_thread"] = threading.Thread(
         target=_serve_loop, args=(listener, _state["pool"]), daemon=True)
     _state["shutdown"] = False
@@ -226,7 +229,8 @@ class _FutureWrapper:
 def rpc_async(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
     """Non-blocking remote call; returns a future with .wait()/.result()."""
     return _FutureWrapper(
-        _state["pool"].submit(_invoke, to, fn, args, kwargs, timeout))
+        _state["client_pool"].submit(_invoke, to, fn, args, kwargs,
+                                     timeout))
 
 
 def shutdown():
@@ -239,9 +243,12 @@ def shutdown():
             pass
     if _state["pool"] is not None:
         _state["pool"].shutdown(wait=False)
+    if _state["client_pool"] is not None:
+        _state["client_pool"].shutdown(wait=False)
     if _state["master"] is not None:
         _state["master"].close()
-    for k in ("self", "listener", "serve_thread", "pool", "master"):
+    for k in ("self", "listener", "serve_thread", "pool", "client_pool",
+              "master"):
         _state[k] = None
     _state["workers"] = {}
 

@@ -10,13 +10,8 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
-from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.nn.layer.layers import Layer
-from paddle_tpu.quantization import (
-    AbsmaxQuantizer,
-    PerChannelAbsmaxQuantizer,
-    fake_quant,
-)
+from paddle_tpu.quantization import fake_quant
 
 __all__ = [
     "FakeQuantAbsMax", "FakeQuantChannelWiseAbsMax",

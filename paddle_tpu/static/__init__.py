@@ -475,18 +475,16 @@ def deserialize_persistables(program, data, executor=None):
 
 def save(program, model_prefix):
     import numpy as _np
-    _np.savez(model_prefix + ".pdparams",
-              **{k: _np.asarray(v._value)
-                 for k, v in program._vars.items()})
+    # write through a file object so the on-disk name is EXACTLY
+    # `prefix.pdparams` (np.savez appends .npz to bare string names)
+    with open(model_prefix + ".pdparams", "wb") as f:
+        _np.savez(f, **{k: _np.asarray(v._value)
+                        for k, v in program._vars.items()})
 
 
 def load(program, model_prefix, executor=None, var_list=None):
     import numpy as _np
-    path = model_prefix + ".pdparams"
-    if not path.endswith(".npz"):
-        import os
-        path = path if os.path.exists(path) else path + ".npz"
-    loaded = _np.load(path)
+    loaded = _np.load(model_prefix + ".pdparams")
     for k in loaded.files:
         if k in program._vars:
             program._vars[k]._set_value(loaded[k])
@@ -494,10 +492,7 @@ def load(program, model_prefix, executor=None, var_list=None):
 
 def load_program_state(model_prefix, var_list=None):
     import numpy as _np
-    import os
-    path = model_prefix + ".pdparams"
-    path = path if os.path.exists(path) else path + ".npz"
-    loaded = _np.load(path)
+    loaded = _np.load(model_prefix + ".pdparams")
     return {k: loaded[k] for k in loaded.files}
 
 

@@ -5,12 +5,18 @@ from paddle_tpu.vision.ops import (  # noqa: F401
     PSRoIPool,
     RoIAlign,
     RoIPool,
+    box_coder,
     deform_conv2d,
+    distribute_fpn_proposals,
+    generate_proposals,
+    matrix_nms,
     nms,
+    prior_box,
     psroi_pool,
     roi_align,
     roi_pool,
     yolo_box,
+    yolo_loss,
 )
 
 

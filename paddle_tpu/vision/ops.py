@@ -590,3 +590,425 @@ class RoIAlign(Layer):
     def forward(self, x, boxes, boxes_num, aligned=True):
         return roi_align(x, boxes, boxes_num, self._output_size,
                          self._spatial_scale, aligned=aligned)
+
+
+# --------------------------------------------------------------- box_coder
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    """Encode/decode target boxes against priors
+    (reference vision/ops.py:649). Boxes are [xmin, ymin, xmax, ymax];
+    encode: offsets of target centers/sizes w.r.t. priors scaled by the
+    variances; decode inverts it. prior_box_var may be a Tensor
+    ([M, 4]), a 4-list, or None."""
+    pv = _val(prior_box)
+    tv = _val(target_box)
+    norm = 0.0 if box_normalized else 1.0
+
+    pw = pv[:, 2] - pv[:, 0] + norm
+    ph = pv[:, 3] - pv[:, 1] + norm
+    px = pv[:, 0] + pw * 0.5
+    py = pv[:, 1] + ph * 0.5
+
+    if prior_box_var is None:
+        var = jnp.ones((4,), pv.dtype)
+    elif isinstance(prior_box_var, (list, tuple)):
+        var = jnp.asarray(prior_box_var, pv.dtype)
+    else:
+        var = _val(prior_box_var)
+
+    if code_type == "encode_center_size":
+        # target [N, 4] against every prior -> [N, M, 4]
+        tw = tv[:, 2] - tv[:, 0] + norm
+        th = tv[:, 3] - tv[:, 1] + norm
+        tx = tv[:, 0] + tw * 0.5
+        ty = tv[:, 1] + th * 0.5
+        ox = (tx[:, None] - px[None, :]) / pw[None, :]
+        oy = (ty[:, None] - py[None, :]) / ph[None, :]
+        ow = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+        oh = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)
+        out = out / (var[None, None, :] if var.ndim == 1
+                     else var[None, :, :])
+        return Tensor(out)
+
+    if code_type == "decode_center_size":
+        # target [N, M, 4] offsets; priors broadcast along `axis`
+        exp = (lambda a: a[None, :, :]) if axis == 0 else \
+            (lambda a: a[:, None, :])
+        pwe = pw[None, :] if axis == 0 else pw[:, None]
+        phe = ph[None, :] if axis == 0 else ph[:, None]
+        pxe = px[None, :] if axis == 0 else px[:, None]
+        pye = py[None, :] if axis == 0 else py[:, None]
+        v = var[None, None, :] if var.ndim == 1 else exp(var)
+        t = tv * v
+        ox = pwe * t[:, :, 0] + pxe
+        oy = phe * t[:, :, 1] + pye
+        ow = jnp.exp(t[:, :, 2]) * pwe
+        oh = jnp.exp(t[:, :, 3]) * phe
+        out = jnp.stack([ox - ow * 0.5, oy - oh * 0.5,
+                         ox + ow * 0.5 - norm, oy + oh * 0.5 - norm], -1)
+        return Tensor(out)
+    raise ValueError("code_type must be encode_center_size or "
+                     "decode_center_size")
+
+
+# --------------------------------------------------------------- prior_box
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior boxes (reference vision/ops.py:477): each feature-map
+    cell emits boxes for every (min_size, aspect_ratio) pair (+ the
+    sqrt(min*max) box).  Returns (boxes [H, W, P, 4], variances same
+    shape).  Pure static shape math — computed host-side in numpy, the
+    same way the reference's CPU kernel does."""
+    fh, fw = int(input.shape[2]), int(input.shape[3])
+    ih, iw = int(image.shape[2]), int(image.shape[3])
+    min_sizes = [float(m) for m in (min_sizes if isinstance(
+        min_sizes, (list, tuple)) else [min_sizes])]
+    max_sizes = [float(m) for m in (max_sizes or [])]
+    if max_sizes:
+        assert len(max_sizes) == len(min_sizes)
+    ars = [1.0]
+    for ar in (aspect_ratios if isinstance(aspect_ratios, (list, tuple))
+               else [aspect_ratios]):
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+
+    boxes_per_cell = []
+    for k, ms in enumerate(min_sizes):
+        cell = []
+        # aspect-ratio boxes of min_size (ar==1 first)
+        for ar in ars:
+            cell.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        if max_sizes:
+            big = np.sqrt(ms * max_sizes[k])
+            if min_max_aspect_ratios_order:
+                cell.insert(1, (big, big))
+            else:
+                cell.append((big, big))
+        boxes_per_cell.extend(cell)
+
+    p = len(boxes_per_cell)
+    wh = np.asarray(boxes_per_cell, np.float32)  # [P, 2]
+    cx = (np.arange(fw, dtype=np.float32) + offset) * step_w
+    cy = (np.arange(fh, dtype=np.float32) + offset) * step_h
+    cxg, cyg = np.meshgrid(cx, cy)  # [H, W]
+    out = np.zeros((fh, fw, p, 4), np.float32)
+    out[..., 0] = (cxg[:, :, None] - wh[None, None, :, 0] / 2) / iw
+    out[..., 1] = (cyg[:, :, None] - wh[None, None, :, 1] / 2) / ih
+    out[..., 2] = (cxg[:, :, None] + wh[None, None, :, 0] / 2) / iw
+    out[..., 3] = (cyg[:, :, None] + wh[None, None, :, 1] / 2) / ih
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+# -------------------------------------------------------------- matrix_nms
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (reference vision/ops.py:2425): instead of greedy
+    suppression, every selected box's score decays by the max IoU with
+    any higher-scored box of its class (gaussian or linear decay) — one
+    IoU MATRIX per class, no sequential loop: the formulation SOLOv2
+    introduced because it vectorizes (ideal for the MXU).  Returns
+    ([No, 6] detections, index, rois_num) with host-materialized counts
+    like ops.nms."""
+    bv = np.asarray(jax.device_get(_val(bboxes)), np.float32)   # [N, M, 4]
+    sv = np.asarray(jax.device_get(_val(scores)), np.float32)   # [N, C, M]
+    n, c, m = sv.shape
+    outs, idxs, nums = [], [], []
+    for b in range(n):
+        dets, sel = [], []
+        for cls in range(c):
+            if cls == background_label:
+                continue
+            s = sv[b, cls]
+            keep = np.nonzero(s > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-s[keep], kind="stable")]
+            if nms_top_k > -1:
+                order = order[:nms_top_k]
+            boxes = bv[b, order]
+            ss = s[order]
+            # pairwise IoU on the sorted subset (one jnp matrix op)
+            iou = np.asarray(jax.device_get(_box_iou_matrix(
+                jnp.asarray(boxes))))
+            k = len(order)
+            tri = np.triu(iou, 1)                    # IoU with higher-ranked
+            max_iou = tri.max(axis=0) if k > 1 else np.zeros(k)
+            # decay_j = min_i f(iou_ij) / f(max_iou_i) over higher-ranked i
+            if use_gaussian:
+                f = lambda x: np.exp(-(x ** 2) / gaussian_sigma)
+            else:
+                f = lambda x: 1.0 - x
+            comp = max_iou[:, None] if k > 1 else np.zeros((k, 1))
+            decay = (f(tri) / f(comp))
+            decay = np.where(np.triu(np.ones((k, k), bool), 1), decay, 1.0)
+            decay = decay.min(axis=0)
+            new_scores = ss * decay
+            survived = np.nonzero(new_scores > post_threshold)[0]
+            for j in survived:
+                dets.append([float(cls), float(new_scores[j]), *boxes[j]])
+                sel.append(b * m + int(order[j]))
+        if dets:
+            order = np.argsort(-np.asarray(dets)[:, 1], kind="stable")
+            if keep_top_k > -1:
+                order = order[:keep_top_k]
+            outs.append(np.asarray(dets, np.float32)[order])
+            idxs.append(np.asarray(sel, np.int64)[order])
+            nums.append(len(order))
+        else:
+            nums.append(0)
+    out = np.concatenate(outs, 0) if outs else np.zeros((0, 6), np.float32)
+    index = (np.concatenate(idxs, 0) if idxs
+             else np.zeros((0,), np.int64))[:, None]
+    rois_num = np.asarray(nums, np.int32)
+    rets = [Tensor(jnp.asarray(out))]
+    if return_index:
+        rets.append(Tensor(jnp.asarray(index)))
+    if return_rois_num:
+        rets.append(Tensor(jnp.asarray(rois_num)))
+    return tuple(rets) if len(rets) > 1 else rets[0]
+
+
+# ------------------------------------------------- distribute_fpn_proposals
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign RoIs to FPN levels by scale (reference vision/ops.py:1288):
+    level = floor(refer_level + log2(sqrt(area) / refer_scale)), clipped
+    to [min_level, max_level].  Returns (multi_rois list, restore_ind,
+    rois_num_per_level list); with `rois_num` ([N] per-image counts) each
+    level's count tensor is per-image, so downstream per-level
+    roi_align(boxes_num=...) can still split by image."""
+    rv = np.asarray(jax.device_get(_val(fpn_rois)), np.float32)
+    off = 1.0 if pixel_offset else 0.0
+    w = rv[:, 2] - rv[:, 0] + off
+    h = rv[:, 3] - rv[:, 1] + off
+    scale = np.sqrt(np.maximum(w * h, 1e-12))
+    lvl = np.floor(refer_level + np.log2(scale / refer_scale + 1e-12))
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+
+    if rois_num is not None:
+        counts = np.asarray(jax.device_get(_val(rois_num)),
+                            np.int64).reshape(-1)
+        img_of = np.repeat(np.arange(counts.size), counts)
+    else:
+        counts = None
+        img_of = np.zeros(rv.shape[0], np.int64)
+
+    multi_rois, restore, nums = [], [], []
+    for level in range(min_level, max_level + 1):
+        pos = np.nonzero(lvl == level)[0]
+        multi_rois.append(Tensor(jnp.asarray(rv[pos])))
+        if counts is not None:
+            per_img = np.asarray(
+                [(img_of[pos] == i).sum() for i in range(counts.size)],
+                np.int32)
+        else:
+            per_img = np.asarray([pos.size], np.int32)
+        nums.append(Tensor(jnp.asarray(per_img)))
+        restore.append(pos)
+    order = np.concatenate(restore) if restore else np.zeros(0, np.int64)
+    restore_ind = np.empty_like(order)
+    restore_ind[order] = np.arange(order.size)
+    return multi_rois, Tensor(jnp.asarray(restore_ind[:, None]
+                                          .astype(np.int32))), nums
+
+
+# --------------------------------------------------------------- yolo_loss
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 loss (reference vision/ops.py:52): location SSE + obj/noobj
+    + class BCE per grid anchor.  Fully vectorized jnp (the reference is
+    a CUDA kernel); best-anchor assignment by IoU of wh shapes, ignore
+    mask from predicted-box IoU against every gt."""
+    xv = _val(x)
+    gb = _val(gt_box).astype(jnp.float32)     # [N, B, 4] cx cy w h (norm)
+    gl = _val(gt_label).astype(jnp.int32)     # [N, B]
+    nb, ch, hh, ww = xv.shape
+    s = len(anchor_mask)
+    assert ch == s * (5 + class_num), "channel/anchor mismatch"
+    pred = xv.reshape(nb, s, 5 + class_num, hh, ww)
+
+    an = np.asarray(anchors, np.float32).reshape(-1, 2)
+    masked = an[np.asarray(anchor_mask)]
+    in_w = ww * downsample_ratio
+    in_h = hh * downsample_ratio
+
+    tx = jax.nn.sigmoid(pred[:, :, 0]) * scale_x_y \
+        - (scale_x_y - 1) / 2                       # [N, S, H, W]
+    ty = jax.nn.sigmoid(pred[:, :, 1]) * scale_x_y \
+        - (scale_x_y - 1) / 2
+    tw = pred[:, :, 2]
+    th = pred[:, :, 3]
+    tobj = pred[:, :, 4]
+    tcls = pred[:, :, 5:]                            # [N, S, C, H, W]
+
+    gx = jnp.arange(ww, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(hh, dtype=jnp.float32)[None, None, :, None]
+    aw = jnp.asarray(masked[:, 0])[None, :, None, None]
+    ahh = jnp.asarray(masked[:, 1])[None, :, None, None]
+    px = (tx + gx) / ww
+    py = (ty + gy) / hh
+    pw = jnp.exp(jnp.clip(tw, -10, 10)) * aw / in_w
+    ph = jnp.exp(jnp.clip(th, -10, 10)) * ahh / in_h
+
+    # ignore mask: max IoU of each predicted box vs every gt of the image
+    def box_iou_cw(px, py, pw, ph, g):
+        # g: [B, 4]
+        x1 = px - pw / 2
+        y1 = py - ph / 2
+        x2 = px + pw / 2
+        y2 = py + ph / 2
+        gx1 = (g[:, 0] - g[:, 2] / 2)[:, None, None, None]
+        gy1 = (g[:, 1] - g[:, 3] / 2)[:, None, None, None]
+        gx2 = (g[:, 0] + g[:, 2] / 2)[:, None, None, None]
+        gy2 = (g[:, 1] + g[:, 3] / 2)[:, None, None, None]
+        iw = jnp.maximum(jnp.minimum(x2[None], gx2)
+                         - jnp.maximum(x1[None], gx1), 0)
+        ih = jnp.maximum(jnp.minimum(y2[None], gy2)
+                         - jnp.maximum(y1[None], gy1), 0)
+        inter = iw * ih
+        union = pw * ph + (g[:, 2] * g[:, 3])[:, None, None, None] - inter
+        return inter / jnp.maximum(union, 1e-10)
+
+    iou_all = jax.vmap(box_iou_cw)(px, py, pw, ph, gb)  # [N, B, S, H, W]
+    valid_gt = (gb[:, :, 2] > 0)[:, :, None, None, None]
+    best_iou = jnp.where(valid_gt, iou_all, 0.0).max(axis=1)
+    ignore = best_iou > ignore_thresh
+
+    # gt assignment: best anchor (over ALL anchors) by wh IoU; only
+    # anchors in anchor_mask contribute to this scale's loss
+    gw = gb[:, :, 2] * in_w
+    gh = gb[:, :, 3] * in_h
+    inter = jnp.minimum(gw[:, :, None], an[None, None, :, 0]) * \
+        jnp.minimum(gh[:, :, None], an[None, None, :, 1])
+    union = gw[:, :, None] * gh[:, :, None] \
+        + (an[:, 0] * an[:, 1])[None, None, :] - inter
+    best_anchor = jnp.argmax(inter / jnp.maximum(union, 1e-10), -1)
+
+    gi = jnp.clip((gb[:, :, 0] * ww).astype(jnp.int32), 0, ww - 1)
+    gj = jnp.clip((gb[:, :, 1] * hh).astype(jnp.int32), 0, hh - 1)
+
+    loss = jnp.zeros((nb,), jnp.float32)
+    mask_arr = np.asarray(anchor_mask)
+    smooth = 1.0 / max(class_num, 1) if use_label_smooth else 0.0
+    score = _val(gt_score).astype(jnp.float32) if gt_score is not None \
+        else jnp.ones(gl.shape, jnp.float32)
+
+    obj_target = jnp.zeros((nb, s, hh, ww), jnp.float32)
+    obj_weight = jnp.zeros((nb, s, hh, ww), jnp.float32)
+    for si, a_idx in enumerate(mask_arr):
+        sel = (best_anchor == a_idx) & (gb[:, :, 2] > 0)   # [N, B]
+        w_box = (2.0 - gb[:, :, 2] * gb[:, :, 3]) * sel * score
+        tgt_x = gb[:, :, 0] * ww - gi.astype(jnp.float32)
+        tgt_y = gb[:, :, 1] * hh - gj.astype(jnp.float32)
+        tgt_w = jnp.where(sel, jnp.log(jnp.maximum(
+            gw / an[a_idx, 0], 1e-9)), 0.0)
+        tgt_h = jnp.where(sel, jnp.log(jnp.maximum(
+            gh / an[a_idx, 1], 1e-9)), 0.0)
+        bidx = jnp.arange(nb)[:, None]
+        px_sel = tx[bidx, si, gj, gi]
+        py_sel = ty[bidx, si, gj, gi]
+        pw_sel = tw[bidx, si, gj, gi]
+        ph_sel = th[bidx, si, gj, gi]
+        loss = loss + (w_box * ((px_sel - tgt_x) ** 2
+                                + (py_sel - tgt_y) ** 2
+                                + (pw_sel - tgt_w) ** 2
+                                + (ph_sel - tgt_h) ** 2)).sum(-1)
+        cls_sel = tcls[bidx, si, :, gj, gi]     # [N, B, C]
+        onehot = jax.nn.one_hot(gl, class_num) * (1 - smooth) + \
+            smooth / max(class_num, 1)
+        bce = jnp.maximum(cls_sel, 0) - cls_sel * onehot + \
+            jnp.log1p(jnp.exp(-jnp.abs(cls_sel)))
+        loss = loss + (bce.sum(-1) * sel * score).sum(-1)
+        obj_target = obj_target.at[bidx, si, gj, gi].max(
+            sel.astype(jnp.float32))
+        obj_weight = obj_weight.at[bidx, si, gj, gi].max(
+            (sel * score).astype(jnp.float32))
+
+    noobj = (1.0 - obj_target) * (1.0 - ignore.astype(jnp.float32))
+    obj_bce = jnp.maximum(tobj, 0) - tobj * obj_target + \
+        jnp.log1p(jnp.exp(-jnp.abs(tobj)))
+    loss = loss + (obj_bce * (obj_target * jnp.maximum(obj_weight, 0.0)
+                              + noobj)).sum((1, 2, 3))
+    return Tensor(loss)
+
+
+# --------------------------------------------------------- generate_proposals
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation (reference vision/ops.py:2236): decode
+    anchor deltas, clip to the image, drop tiny boxes, greedy-NMS and
+    keep post_nms_top_n per image.  Returns (rpn_rois, rpn_roi_probs[,
+    rpn_rois_num]) like the reference."""
+    sv = np.asarray(jax.device_get(_val(scores)), np.float32)  # [N,A,H,W]
+    dv = np.asarray(jax.device_get(_val(bbox_deltas)), np.float32)
+    iv = np.asarray(jax.device_get(_val(img_size)), np.float32)
+    av = np.asarray(jax.device_get(_val(anchors)),
+                    np.float32).reshape(-1, 4)
+    vv = np.asarray(jax.device_get(_val(variances)),
+                    np.float32).reshape(-1, 4)
+    n, a, h, w = sv.shape
+    off = 1.0 if pixel_offset else 0.0
+
+    rois_all, probs_all, num_all = [], [], []
+    for b in range(n):
+        s = sv[b].transpose(1, 2, 0).reshape(-1)
+        d = dv[b].reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s, kind="stable")[:pre_nms_top_n]
+        s = s[order]
+        d = d[order]
+        anc = av[order % av.shape[0]] if av.shape[0] != s.size \
+            else av[order]
+        var = vv[order % vv.shape[0]] if vv.shape[0] != s.size \
+            else vv[order]
+        aw = anc[:, 2] - anc[:, 0] + off
+        ah = anc[:, 3] - anc[:, 1] + off
+        ax = anc[:, 0] + aw * 0.5
+        ay = anc[:, 1] + ah * 0.5
+        dx, dy, dw, dh = (d * var).T
+        cx = dx * aw + ax
+        cy = dy * ah + ay
+        bw = np.exp(np.clip(dw, -10, 10)) * aw
+        bh = np.exp(np.clip(dh, -10, 10)) * ah
+        boxes = np.stack([cx - bw / 2, cy - bh / 2,
+                          cx + bw / 2 - off, cy + bh / 2 - off], -1)
+        ih, iw = iv[b, 0], iv[b, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - off)
+        ok = ((boxes[:, 2] - boxes[:, 0] + off >= min_size)
+              & (boxes[:, 3] - boxes[:, 1] + off >= min_size))
+        boxes, s = boxes[ok], s[ok]
+        keep = np.asarray(jax.device_get(_nms_keep_mask(
+            jnp.asarray(boxes), nms_thresh)))
+        kept = np.nonzero(keep)[0][:post_nms_top_n]
+        rois_all.append(boxes[kept])
+        probs_all.append(s[kept])
+        num_all.append(len(kept))
+    rois = np.concatenate(rois_all, 0) if rois_all else \
+        np.zeros((0, 4), np.float32)
+    probs = (np.concatenate(probs_all, 0) if probs_all
+             else np.zeros((0,), np.float32))[:, None]
+    rpn_rois = Tensor(jnp.asarray(rois))
+    rpn_roi_probs = Tensor(jnp.asarray(probs))
+    nums = Tensor(jnp.asarray(np.asarray(num_all, np.int32)))
+    if return_rois_num:
+        return rpn_rois, rpn_roi_probs, nums
+    return rpn_rois, rpn_roi_probs

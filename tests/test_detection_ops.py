@@ -1,0 +1,193 @@
+"""Detection ops round 2: box_coder, prior_box, matrix_nms,
+distribute_fpn_proposals, yolo_loss, generate_proposals.
+
+Reference: python/paddle/vision/ops.py (box_coder :649, prior_box :477,
+matrix_nms :2425, distribute_fpn_proposals :1288, yolo_loss :52,
+generate_proposals :2236).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu.vision import ops
+
+
+class TestBoxCoder:
+    def test_encode_decode_roundtrip(self):
+        rng = np.random.RandomState(0)
+        priors = np.sort(rng.rand(5, 4).astype(np.float32), -1)
+        targets = np.sort(rng.rand(3, 4).astype(np.float32), -1)
+        var = [0.1, 0.1, 0.2, 0.2]
+        enc = ops.box_coder(P.to_tensor(priors), var, P.to_tensor(targets),
+                            code_type="encode_center_size").numpy()
+        assert enc.shape == (3, 5, 4)
+        dec = ops.box_coder(P.to_tensor(priors), var, P.to_tensor(enc),
+                            code_type="decode_center_size",
+                            axis=0).numpy()
+        # decoding its own encoding restores the target box (vs prior i)
+        for i in range(5):
+            np.testing.assert_allclose(dec[:, i], targets, rtol=1e-4,
+                                       atol=1e-5)
+
+    def test_encode_center_formula(self):
+        prior = np.array([[0.0, 0.0, 2.0, 2.0]], np.float32)  # c=(1,1) wh=2
+        target = np.array([[1.0, 1.0, 3.0, 3.0]], np.float32)  # c=(2,2)
+        enc = ops.box_coder(P.to_tensor(prior), None, P.to_tensor(target),
+                            code_type="encode_center_size").numpy()[0, 0]
+        np.testing.assert_allclose(enc, [0.5, 0.5, 0.0, 0.0], atol=1e-6)
+
+
+class TestPriorBox:
+    def test_shapes_and_count(self):
+        inp = P.zeros([1, 3, 6, 9])
+        img = P.zeros([1, 3, 18, 27])
+        box, var = ops.prior_box(inp, img, min_sizes=[2.0, 4.0],
+                                 aspect_ratios=[1.0, 2.0], flip=True,
+                                 clip=True)
+        # per min_size: ar 1 + (2, 1/2) = 3 boxes -> 6 total
+        assert tuple(box.shape) == (6, 9, 6, 4)
+        assert tuple(var.shape) == tuple(box.shape)
+        b = box.numpy()
+        assert (b >= 0).all() and (b <= 1).all()
+        # centers sit at (i + 0.5) * step normalized
+        np.testing.assert_allclose(
+            (b[0, 0, 0, 0] + b[0, 0, 0, 2]) / 2, 0.5 * (27 / 9) / 27,
+            rtol=1e-5)
+
+    def test_max_size_adds_box(self):
+        inp = P.zeros([1, 3, 2, 2])
+        img = P.zeros([1, 3, 8, 8])
+        box, _ = ops.prior_box(inp, img, min_sizes=[2.0], max_sizes=[4.0])
+        assert box.shape[2] == 2  # min + sqrt(min*max)
+
+
+class TestMatrixNMS:
+    def test_decays_overlapping_keeps_distinct(self):
+        boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                           [20, 20, 30, 30]]], np.float32)
+        scores = np.array([[[0.9, 0.85, 0.8]]], np.float32)  # 1 class
+        out, rois_num = ops.matrix_nms(
+            P.to_tensor(boxes), P.to_tensor(scores),
+            score_threshold=0.1, post_threshold=0.5, nms_top_k=10,
+            keep_top_k=10, background_label=-1)
+        o = out.numpy()
+        # overlapping second box decayed below post_threshold; the
+        # distinct box survives with its full score
+        assert int(rois_num.numpy()[0]) == 2
+        np.testing.assert_allclose(sorted(o[:, 1], reverse=True)[0], 0.9)
+
+    def test_gaussian_and_index(self):
+        boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11]]], np.float32)
+        scores = np.array([[[0.9, 0.8]]], np.float32)
+        out, idx, num = ops.matrix_nms(
+            P.to_tensor(boxes), P.to_tensor(scores), 0.1, 0.01, 10, 10,
+            use_gaussian=True, gaussian_sigma=2.0, background_label=-1,
+            return_index=True)
+        assert out.numpy().shape[1] == 6
+        assert idx.numpy().shape[1] == 1
+        assert int(num.numpy()[0]) == out.numpy().shape[0]
+
+
+class TestFPNDistribute:
+    def test_levels_by_scale(self):
+        rois = np.array([
+            [0, 0, 10, 10],      # small -> low level
+            [0, 0, 224, 224],    # refer scale -> refer level
+            [0, 0, 900, 900],    # big -> high level
+        ], np.float32)
+        multi, restore, nums = ops.distribute_fpn_proposals(
+            P.to_tensor(rois), min_level=2, max_level=5, refer_level=4,
+            refer_scale=224)
+        assert len(multi) == 4
+        sizes = [m.shape[0] for m in multi]
+        assert sizes == [1, 0, 1, 1]
+        # restore index reorders concatenated level outputs back
+        cat = np.concatenate([m.numpy() for m in multi if m.shape[0]])
+        ri = restore.numpy()[:, 0]
+        np.testing.assert_allclose(cat[np.argsort(np.argsort(ri))][ri],
+                                   cat[ri])
+        total = sum(int(nn.numpy()[0]) for nn in nums)
+        assert total == 3
+
+
+class TestYoloLoss:
+    def _setup(self, seed=0):
+        rng = np.random.RandomState(seed)
+        s, c, h, w = 3, 4, 4, 4
+        x = rng.randn(2, s * (5 + c), h, w).astype(np.float32) * 0.1
+        gt_box = np.zeros((2, 2, 4), np.float32)
+        gt_box[:, 0] = [0.5, 0.5, 0.3, 0.4]   # one real box per image
+        gt_label = np.zeros((2, 2), np.int64)
+        return x, gt_box, gt_label
+
+    def test_loss_finite_and_positive(self):
+        x, gb, gl = self._setup()
+        loss = ops.yolo_loss(
+            P.to_tensor(x), P.to_tensor(gb), P.to_tensor(gl, dtype="int64"),
+            anchors=[10, 13, 16, 30, 33, 23], anchor_mask=[0, 1, 2],
+            class_num=4, ignore_thresh=0.7, downsample_ratio=8)
+        lv = loss.numpy()
+        assert lv.shape == (2,)
+        assert np.isfinite(lv).all() and (lv > 0).all()
+
+    def test_better_prediction_lower_loss(self):
+        x, gb, gl = self._setup()
+        base = ops.yolo_loss(
+            P.to_tensor(x), P.to_tensor(gb), P.to_tensor(gl, dtype="int64"),
+            anchors=[10, 13, 16, 30, 33, 23], anchor_mask=[0, 1, 2],
+            class_num=4, ignore_thresh=0.7, downsample_ratio=8).numpy()
+        # crank objectness way down where there is no object: loss drops
+        x2 = x.copy().reshape(2, 3, 9, 4, 4)
+        x2[:, :, 4] = -8.0
+        x2 = x2.reshape(2, 27, 4, 4)
+        better = ops.yolo_loss(
+            P.to_tensor(x2), P.to_tensor(gb),
+            P.to_tensor(gl, dtype="int64"),
+            anchors=[10, 13, 16, 30, 33, 23], anchor_mask=[0, 1, 2],
+            class_num=4, ignore_thresh=0.7, downsample_ratio=8).numpy()
+        assert (better < base).all()
+
+    def test_grads_flow(self):
+        import jax
+        x, gb, gl = self._setup()
+
+        def f(xv):
+            return ops.yolo_loss(
+                P.Tensor(xv), P.to_tensor(gb),
+                P.to_tensor(gl, dtype="int64"),
+                anchors=[10, 13, 16, 30, 33, 23], anchor_mask=[0, 1, 2],
+                class_num=4, ignore_thresh=0.7,
+                downsample_ratio=8)._value.sum()
+
+        g = jax.grad(f)(P.to_tensor(x)._value)
+        assert np.isfinite(np.asarray(g)).all()
+        assert np.abs(np.asarray(g)).sum() > 0
+
+
+class TestGenerateProposals:
+    def test_decode_clip_nms(self):
+        rng = np.random.RandomState(0)
+        n, a, h, w = 1, 3, 4, 4
+        scores = rng.rand(n, a, h, w).astype(np.float32)
+        deltas = (rng.randn(n, a * 4, h, w) * 0.1).astype(np.float32)
+        # anchors per (h, w, a) location
+        anchors = np.zeros((h, w, a, 4), np.float32)
+        for i in range(h):
+            for j in range(w):
+                for k in range(a):
+                    cx, cy = j * 8 + 4, i * 8 + 4
+                    sz = 8 * (k + 1)
+                    anchors[i, j, k] = [cx - sz / 2, cy - sz / 2,
+                                        cx + sz / 2, cy + sz / 2]
+        variances = np.ones_like(anchors)
+        rois, probs, num = ops.generate_proposals(
+            P.to_tensor(scores), P.to_tensor(deltas),
+            P.to_tensor(np.array([[32.0, 32.0]], np.float32)),
+            P.to_tensor(anchors), P.to_tensor(variances),
+            pre_nms_top_n=50, post_nms_top_n=10, nms_thresh=0.7,
+            min_size=1.0, return_rois_num=True)
+        r = rois.numpy()
+        assert probs.numpy().shape == (r.shape[0], 1)
+        assert r.shape[0] == int(num.numpy()[0]) <= 10
+        assert (r >= 0).all() and (r <= 32).all()
+        assert (r[:, 2] >= r[:, 0]).all() and (r[:, 3] >= r[:, 1]).all()
