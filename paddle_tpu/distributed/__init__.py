@@ -43,6 +43,10 @@ from paddle_tpu.distributed.entry_attr import (  # noqa: F401
     ProbabilityEntry,
     ShowClickEntry,
 )
+from paddle_tpu.distributed.fleet.dataset import (  # noqa: F401
+    InMemoryDataset,
+    QueueDataset,
+)
 from paddle_tpu.distributed.mesh import (  # noqa: F401
     collective_axis,
     get_mesh,

@@ -20,6 +20,11 @@ from paddle_tpu.distributed.fleet.meta_parallel import (  # noqa: F401
     RowParallelLinear,
     VocabParallelEmbedding,
 )
+from paddle_tpu.distributed.fleet import utils  # noqa: F401
+from paddle_tpu.distributed.fleet.dataset import (  # noqa: F401
+    InMemoryDataset,
+    QueueDataset,
+)
 
 
 class DistributedStrategy:

@@ -1,0 +1,138 @@
+"""Fleet datasets (reference: python/paddle/distributed/fleet/dataset/
+dataset.py — InMemoryDataset, QueueDataset).
+
+The reference streams slot-formatted text through C++ DataFeed workers.
+Here the same API fronts a host-side loader: a filelist of text files
+(one sample per line, fields parsed by `parse_fn`, default
+whitespace-separated floats), batched for the training loop.
+InMemoryDataset materializes + shuffles in RAM; QueueDataset streams
+lazily.  Multi-worker file sharding follows the PS convention
+(round-robin by worker index).
+"""
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+__all__ = ["InMemoryDataset", "QueueDataset"]
+
+
+def _default_parse(line):
+    return np.asarray([float(x) for x in line.split()], np.float32)
+
+
+class _DatasetBase:
+    def __init__(self):
+        self._filelist = []
+        self._batch_size = 1
+        self._thread_num = 1
+        self._use_vars = []
+        self._parse_fn = _default_parse
+        self._shard_num = 1
+        self._shard_id = 0
+
+    def init(self, batch_size=1, thread_num=1, use_var=None, pipe_command=None,
+             input_type=0, fs_name="", fs_ugi="", parse_fn=None, **kwargs):
+        self._batch_size = batch_size
+        self._thread_num = thread_num
+        self._use_vars = list(use_var or [])
+        if parse_fn is not None:
+            self._parse_fn = parse_fn
+        return self
+
+    # reference names kept
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def set_batch_size(self, batch_size):
+        self._batch_size = batch_size
+
+    def set_thread(self, thread_num):
+        self._thread_num = thread_num
+
+    def set_use_var(self, use_vars):
+        self._use_vars = list(use_vars)
+
+    def set_parse_fn(self, fn):
+        self._parse_fn = fn
+
+    def _shard(self, num, idx):
+        """PS convention: worker idx reads files [idx::num]."""
+        self._shard_num = num
+        self._shard_id = idx
+
+    def _my_files(self):
+        return self._filelist[self._shard_id::self._shard_num]
+
+    def _read_files(self):
+        for path in self._my_files():
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        yield self._parse_fn(line)
+
+    @staticmethod
+    def _batched(it, batch_size, drop_last=False):
+        buf = []
+        for sample in it:
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield np.stack(buf)
+                buf = []
+        if buf and not drop_last:
+            yield np.stack(buf)
+
+
+class InMemoryDataset(_DatasetBase):
+    """Load the shard into host RAM, shuffle, iterate batches
+    (reference dataset.py InMemoryDataset)."""
+
+    def __init__(self):
+        super().__init__()
+        self._samples = None
+
+    def load_into_memory(self):
+        self._samples = list(self._read_files())
+
+    def preload_into_memory(self, thread_num=None):
+        self.load_into_memory()
+
+    def wait_preload_done(self):
+        if self._samples is None:
+            self.load_into_memory()
+
+    def local_shuffle(self, seed=None):
+        self._require_loaded()
+        random.Random(seed).shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, thread_num=None, seed=None):
+        # single-controller: the global set IS the local set
+        self.local_shuffle(seed)
+
+    def get_memory_data_size(self, fleet=None):
+        self._require_loaded()
+        return len(self._samples)
+
+    def get_shuffle_data_size(self, fleet=None):
+        return self.get_memory_data_size()
+
+    def release_memory(self):
+        self._samples = None
+
+    def _require_loaded(self):
+        if self._samples is None:
+            raise RuntimeError("call load_into_memory() first")
+
+    def __iter__(self):
+        self._require_loaded()
+        return self._batched(iter(self._samples), self._batch_size)
+
+
+class QueueDataset(_DatasetBase):
+    """Streaming dataset: files are read lazily on iteration, nothing is
+    materialized (reference dataset.py QueueDataset)."""
+
+    def __iter__(self):
+        return self._batched(self._read_files(), self._batch_size)

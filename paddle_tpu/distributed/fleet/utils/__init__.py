@@ -1,0 +1,28 @@
+"""paddle.distributed.fleet.utils parity (reference:
+python/paddle/distributed/fleet/utils/)."""
+from paddle_tpu.distributed.fleet.utils.fs import (  # noqa: F401
+    FS,
+    ExecuteError,
+    FSFileExistsError,
+    FSFileNotExistsError,
+    FSShellCmdAborted,
+    FSTimeOut,
+    HDFSClient,
+    LocalFS,
+)
+from paddle_tpu.distributed.recompute import recompute  # noqa: F401
+
+
+def get_log_level_code():
+    import logging
+    return logging.getLogger("FLEET").getEffectiveLevel()
+
+
+def get_log_level_name():
+    import logging
+    return logging.getLevelName(get_log_level_code())
+
+
+def set_log_level(level):
+    import logging
+    logging.getLogger("FLEET").setLevel(level)

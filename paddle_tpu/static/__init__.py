@@ -482,9 +482,17 @@ def save(program, model_prefix):
                         for k, v in program._vars.items()})
 
 
+def _params_path(model_prefix):
+    import os
+    path = model_prefix + ".pdparams"
+    if not os.path.exists(path) and os.path.exists(path + ".npz"):
+        return path + ".npz"  # back-compat: earlier saves via bare savez
+    return path
+
+
 def load(program, model_prefix, executor=None, var_list=None):
     import numpy as _np
-    loaded = _np.load(model_prefix + ".pdparams")
+    loaded = _np.load(_params_path(model_prefix))
     for k in loaded.files:
         if k in program._vars:
             program._vars[k]._set_value(loaded[k])
@@ -492,7 +500,7 @@ def load(program, model_prefix, executor=None, var_list=None):
 
 def load_program_state(model_prefix, var_list=None):
     import numpy as _np
-    loaded = _np.load(model_prefix + ".pdparams")
+    loaded = _np.load(_params_path(model_prefix))
     return {k: loaded[k] for k in loaded.files}
 
 

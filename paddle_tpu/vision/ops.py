@@ -31,7 +31,8 @@ from paddle_tpu.nn.layer.layers import Layer
 __all__ = [
     "yolo_box", "deform_conv2d", "DeformConv2D", "psroi_pool", "PSRoIPool",
     "roi_pool", "RoIPool", "roi_align", "RoIAlign", "nms",
-    "ConvNormActivation",
+    "ConvNormActivation", "box_coder", "prior_box", "matrix_nms",
+    "distribute_fpn_proposals", "yolo_loss", "generate_proposals",
 ]
 
 
@@ -726,6 +727,25 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
     bv = np.asarray(jax.device_get(_val(bboxes)), np.float32)   # [N, M, 4]
     sv = np.asarray(jax.device_get(_val(scores)), np.float32)   # [N, C, M]
     n, c, m = sv.shape
+    norm_off = 0.0 if normalized else 1.0
+
+    def np_iou(b):
+        # numpy IoU matrix (no device round-trip: this whole routine is
+        # host-side post-processing); +1 widths for pixel boxes like the
+        # reference's normalized=False convention
+        w = np.maximum(b[:, 2] - b[:, 0] + norm_off, 0)
+        h = np.maximum(b[:, 3] - b[:, 1] + norm_off, 0)
+        area = w * h
+        ix = np.maximum(
+            np.minimum(b[:, None, 2], b[None, :, 2])
+            - np.maximum(b[:, None, 0], b[None, :, 0]) + norm_off, 0)
+        iy = np.maximum(
+            np.minimum(b[:, None, 3], b[None, :, 3])
+            - np.maximum(b[:, None, 1], b[None, :, 1]) + norm_off, 0)
+        inter = ix * iy
+        return inter / np.maximum(area[:, None] + area[None, :] - inter,
+                                  1e-10)
+
     outs, idxs, nums = [], [], []
     for b in range(n):
         dets, sel = [], []
@@ -741,9 +761,7 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
                 order = order[:nms_top_k]
             boxes = bv[b, order]
             ss = s[order]
-            # pairwise IoU on the sorted subset (one jnp matrix op)
-            iou = np.asarray(jax.device_get(_box_iou_matrix(
-                jnp.asarray(boxes))))
+            iou = np_iou(boxes)
             k = len(order)
             tri = np.triu(iou, 1)                    # IoU with higher-ranked
             max_iou = tri.max(axis=0) if k > 1 else np.zeros(k)
@@ -996,9 +1014,23 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
         ok = ((boxes[:, 2] - boxes[:, 0] + off >= min_size)
               & (boxes[:, 3] - boxes[:, 1] + off >= min_size))
         boxes, s = boxes[ok], s[ok]
-        keep = np.asarray(jax.device_get(_nms_keep_mask(
-            jnp.asarray(boxes), nms_thresh)))
-        kept = np.nonzero(keep)[0][:post_nms_top_n]
+        if eta < 1.0:
+            # adaptive NMS (reference :2236): loosen the threshold each
+            # round while it stays meaningful, re-running on survivors
+            thresh = nms_thresh
+            cur = np.arange(boxes.shape[0])
+            while True:
+                keep = np.asarray(jax.device_get(_nms_keep_mask(
+                    jnp.asarray(boxes[cur]), thresh)))
+                cur = cur[np.nonzero(keep)[0]]
+                thresh *= eta
+                if thresh < 0.5 or cur.size <= post_nms_top_n:
+                    break
+            kept = cur[:post_nms_top_n]
+        else:
+            keep = np.asarray(jax.device_get(_nms_keep_mask(
+                jnp.asarray(boxes), nms_thresh)))
+            kept = np.nonzero(keep)[0][:post_nms_top_n]
         rois_all.append(boxes[kept])
         probs_all.append(s[kept])
         num_all.append(len(kept))

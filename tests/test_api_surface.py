@@ -181,3 +181,65 @@ class TestUtilsSurface:
         P.utils.start_profiler()
         P.utils.stop_profiler()
         P.utils.reset_profiler()
+
+
+class TestFleetUtilsAndDatasets:
+    def test_localfs_full_surface(self, tmp_path):
+        from paddle_tpu.distributed.fleet.utils import LocalFS
+        fs = LocalFS()
+        d = tmp_path / "a"
+        fs.mkdirs(str(d))
+        assert fs.is_dir(str(d)) and fs.is_exist(str(d))
+        f = d / "x.txt"
+        fs.touch(str(f))
+        assert fs.is_file(str(f))
+        (d / "sub").mkdir()
+        dirs, files = fs.ls_dir(str(d))
+        assert dirs == ["sub"] and files == ["x.txt"]
+        fs.mv(str(f), str(d / "y.txt"))
+        assert fs.is_exist(str(d / "y.txt"))
+        assert fs.list_dirs(str(d)) == ["sub"]
+        (d / "y.txt").write_text("hello")
+        assert fs.cat(str(d / "y.txt")) == "hello"
+        fs.delete(str(d))
+        assert not fs.is_exist(str(d))
+        assert not fs.need_upload_download()
+
+    def test_hdfs_raises_clearly_without_hadoop(self, tmp_path):
+        from paddle_tpu.distributed.fleet.utils import (ExecuteError,
+                                                        HDFSClient)
+        client = HDFSClient(str(tmp_path))  # no bin/hadoop here
+        with pytest.raises(ExecuteError, match="hadoop binary"):
+            client.mkdirs("/tmp/x")
+        assert client.need_upload_download()
+
+    def test_in_memory_dataset(self, tmp_path):
+        for i in range(2):
+            (tmp_path / f"f{i}.txt").write_text(
+                "\n".join(f"{j + 10 * i} 1" for j in range(5)))
+        ds = P.distributed.InMemoryDataset()
+        ds.init(batch_size=4)
+        ds.set_filelist([str(tmp_path / "f0.txt"),
+                         str(tmp_path / "f1.txt")])
+        ds.load_into_memory()
+        assert ds.get_memory_data_size() == 10
+        ds.local_shuffle(seed=0)
+        batches = list(ds)
+        assert sum(b.shape[0] for b in batches) == 10
+        assert batches[0].shape[1] == 2
+        ds.release_memory()
+        with pytest.raises(RuntimeError):
+            ds.get_memory_data_size()
+
+    def test_queue_dataset_streams_with_sharding(self, tmp_path):
+        files = []
+        for i in range(4):
+            p = tmp_path / f"q{i}.txt"
+            p.write_text(f"{i}\n")
+            files.append(str(p))
+        ds = P.distributed.QueueDataset()
+        ds.init(batch_size=1)
+        ds.set_filelist(files)
+        ds._shard(2, 1)  # worker 1 of 2 -> files 1, 3
+        vals = [float(b[0, 0]) for b in ds]
+        assert vals == [1.0, 3.0]

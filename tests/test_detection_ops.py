@@ -101,13 +101,40 @@ class TestFPNDistribute:
         assert len(multi) == 4
         sizes = [m.shape[0] for m in multi]
         assert sizes == [1, 0, 1, 1]
-        # restore index reorders concatenated level outputs back
+        # restore index maps each ORIGINAL roi to its row in the
+        # level-concatenated output: cat[restore_ind[i]] == rois[i]
         cat = np.concatenate([m.numpy() for m in multi if m.shape[0]])
         ri = restore.numpy()[:, 0]
-        np.testing.assert_allclose(cat[np.argsort(np.argsort(ri))][ri],
-                                   cat[ri])
+        np.testing.assert_allclose(cat[ri], rois)
         total = sum(int(nn.numpy()[0]) for nn in nums)
         assert total == 3
+
+    def test_restore_index_nontrivial_permutation(self):
+        # interleave scales so level order != input order
+        rois = np.array([
+            [0, 0, 900, 900],   # high level
+            [0, 0, 10, 10],     # low level
+            [0, 0, 800, 800],   # high level
+            [0, 0, 12, 12],     # low level
+        ], np.float32)
+        multi, restore, _ = ops.distribute_fpn_proposals(
+            P.to_tensor(rois), 2, 5, 4, 224)
+        cat = np.concatenate([m.numpy() for m in multi if m.shape[0]])
+        ri = restore.numpy()[:, 0]
+        assert not np.array_equal(ri, np.arange(4))  # actually permuted
+        np.testing.assert_allclose(cat[ri], rois)
+
+    def test_per_image_counts_with_rois_num(self):
+        rois = np.array([[0, 0, 10, 10], [0, 0, 900, 900],
+                         [0, 0, 11, 11]], np.float32)
+        multi, _, nums = ops.distribute_fpn_proposals(
+            P.to_tensor(rois), 2, 5, 4, 224,
+            rois_num=P.to_tensor(np.array([2, 1]), dtype="int64"))
+        # each level reports counts PER IMAGE ([2] each)
+        for nn in nums:
+            assert nn.numpy().shape == (2,)
+        low = nums[0].numpy()   # both small boxes: one from each image
+        np.testing.assert_array_equal(low, [1, 1])
 
 
 class TestYoloLoss:
