@@ -333,3 +333,17 @@ def get_host_name_ip():
         return host, socket.gethostbyname(host)
     except OSError:
         return None
+
+
+# reference layout parity: fleet.meta_parallel.sharding is a subpackage;
+# here meta_parallel is a module, so the sharding surface mounts as an
+# attribute + sys.modules entry (both import spellings work)
+import sys as _sys  # noqa: E402
+
+from paddle_tpu.distributed.fleet import meta_parallel as _mp  # noqa: E402
+from paddle_tpu.distributed.fleet import (  # noqa: E402
+    meta_parallel_sharding as _mps,
+)
+
+_mp.sharding = _mps
+_sys.modules[__name__ + ".meta_parallel.sharding"] = _mps

@@ -1,0 +1,62 @@
+"""paddle.distributed.fleet.meta_parallel.sharding parity surface
+(reference: fleet/meta_parallel/sharding/group_sharded_*.py).
+
+The reference implements ZeRO stages with hand-managed GradStorage
+buffers, broadcast hooks and a stage-aware scaler on NCCL.  Here the
+whole mechanism is `distributed/sharding.py`'s declarative form: stage
+levels are sharding annotations over the dp axis and XLA's partitioner
+emits the reduce-scatter/all-gather (see group_sharded_parallel).  The
+class names below front that implementation so reference-written
+training scripts construct the same objects.
+"""
+from __future__ import annotations
+
+from paddle_tpu.distributed.sharding import (  # noqa: F401
+    group_sharded_parallel,
+    save_group_sharded_model,
+)
+
+__all__ = ["GroupShardedOptimizerStage2", "GroupShardedStage2",
+           "GroupShardedStage3", "GroupShardedScaler",
+           "group_sharded_parallel", "save_group_sharded_model"]
+
+
+def GroupShardedOptimizerStage2(params, optim, group=None, offload=False,
+                                device="tpu", **kw):
+    """Stage-2 optimizer wrapper: optimizer states shard over the dp
+    mesh axis as they are (lazily) created — same mechanism
+    group_sharded_parallel installs, usable standalone."""
+    from paddle_tpu.distributed.mesh import axis_size
+    from paddle_tpu.distributed.sharding import _patch_acc
+
+    dp = axis_size("dp")
+    if dp > 1:
+        optim.__dict__["_shard_accumulators_axis"] = "dp"
+        _patch_acc(optim, dp)
+    return optim
+
+
+def GroupShardedStage2(model, optimizer=None, group=None, sync_buffers=False,
+                       buffer_max_size=2 ** 23, auto_refresh_trainable=True,
+                       device="tpu"):
+    if optimizer is not None:
+        model, _, _ = group_sharded_parallel(model, optimizer, level="os_g")
+        return model
+    return model
+
+
+def GroupShardedStage3(model, optimizer=None, group=None, sync_buffers=False,
+                       device="tpu", segment_size=2 ** 20,
+                       pertrain_sync_models=True, offload=False, **kw):
+    if optimizer is not None:
+        model, _, _ = group_sharded_parallel(model, optimizer, level="p_g_os")
+        return model
+    return model
+
+
+class GroupShardedScaler:
+    """Stage-aware GradScaler facade: bf16 training needs no loss
+    scaling on TPU, so this defers to the plain amp.GradScaler."""
+
+    def __new__(cls, scaler):
+        return scaler

@@ -535,3 +535,6 @@ def Print(input, first_n=-1, message=None, summarize=20,
         return v
 
     return apply(fn, input)
+
+
+from paddle_tpu.static import nn  # noqa: E402,F401

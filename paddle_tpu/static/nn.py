@@ -1,0 +1,630 @@
+"""paddle.static.nn parity (reference: python/paddle/static/nn/
+__init__.py:62 and static/nn/common.py / control_flow.py).
+
+The reference's static layer functions append ops + parameters to the
+active fluid Program.  Here each call builds the matching eager layer
+(parameters register as state tensors and land in the active Program's
+var table) and applies it immediately — the TPU design's "program" is
+the traced computation itself.  Layers are cached per call site name so
+repeated invocations inside a training loop reuse their parameters.
+
+Control flow (cond/case/switch_case/while_loop) runs through
+`lax.cond`/`lax.while_loop` under a trace and plain Python eagerly.
+Sequence ops operate on dense [batch, time, ...] tensors with an
+explicit length tensor — the dense analogue of fluid's LoD tensors
+(LoD does not exist in this framework).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = [
+    "fc", "batch_norm", "embedding", "bilinear_tensor_product", "case",
+    "cond", "conv2d", "conv2d_transpose", "conv3d", "conv3d_transpose",
+    "crf_decoding", "data_norm", "deform_conv2d", "group_norm",
+    "instance_norm", "layer_norm", "prelu", "py_func", "spectral_norm",
+    "switch_case", "while_loop", "sparse_embedding", "sequence_softmax",
+    "sequence_pool", "sequence_concat", "sequence_first_step",
+    "sequence_last_step", "sequence_reverse", "StaticRNN",
+]
+
+_layer_cache = {}
+
+
+def _call_site():
+    """(filename, lineno) of the user call two frames up — the identity
+    of an UNNAMED static.nn layer, so a layer invoked in a training loop
+    reuses its parameters while two different unnamed calls of the same
+    shape stay distinct."""
+    import sys
+    f = sys._getframe(3)
+    return (f.f_code.co_filename, f.f_lineno)
+
+
+def _cached(key, make):
+    key = key if key[1] is not None else (key[0], _call_site(), *key[2:])
+    if key not in _layer_cache:
+        _layer_cache[key] = make()
+    return _layer_cache[key]
+
+
+# ------------------------------------------------------------- layers
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    from paddle_tpu import nn
+    in_dim = 1
+    for s in x.shape[num_flatten_dims:]:
+        in_dim *= s
+    layer = _cached(("fc", name, in_dim, size), lambda: nn.Linear(
+        in_dim, size, weight_attr=weight_attr, bias_attr=bias_attr))
+    flat = x.reshape(list(x.shape[:num_flatten_dims]) + [in_dim])
+    out = layer(flat)
+    if activation is not None:
+        from paddle_tpu.nn import functional as F
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    from paddle_tpu import nn
+    layer = _cached(("emb", getattr(param_attr, "name", None), *size),
+                    lambda: nn.Embedding(size[0], size[1],
+                                         padding_idx=padding_idx,
+                                         sparse=is_sparse,
+                                         weight_attr=param_attr))
+    return layer(input)
+
+
+sparse_embedding = embedding
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=True,
+               use_global_stats=False, is_test=False):
+    from paddle_tpu import nn
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    layer = _cached(("bn", name, c), lambda: nn.BatchNorm2D(
+        c, momentum=momentum, epsilon=epsilon, weight_attr=param_attr,
+        bias_attr=bias_attr,
+        data_format=data_layout) if len(input.shape) == 4
+        else nn.BatchNorm1D(c, momentum=momentum, epsilon=epsilon))
+    # set mode EVERY call: a one-off is_test pass must not freeze the
+    # cached layer in eval for the rest of training
+    layer.eval() if is_test else layer.train()
+    out = layer(input)
+    if act:
+        from paddle_tpu.nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCHW"):
+    from paddle_tpu import nn
+    cin = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    layer = _cached(
+        ("conv2d", name, cin, num_filters, str(filter_size)),
+        lambda: nn.Conv2D(cin, num_filters, filter_size, stride=stride,
+                          padding=padding, dilation=dilation,
+                          groups=groups, weight_attr=param_attr,
+                          bias_attr=bias_attr, data_format=data_format))
+    out = layer(input)
+    if act:
+        from paddle_tpu.nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCHW"):
+    from paddle_tpu import nn
+    cin = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    if filter_size is None:
+        # reference derives the kernel from the requested output size:
+        # out = (in - 1) * stride + k - 2 * pad  =>  k = ...
+        if output_size is None:
+            raise ValueError("conv2d_transpose needs filter_size or "
+                             "output_size")
+        osz = output_size if isinstance(output_size, (list, tuple)) \
+            else (output_size, output_size)
+        st = stride if isinstance(stride, (list, tuple)) \
+            else (stride, stride)
+        pd = padding if isinstance(padding, (list, tuple)) \
+            else (padding, padding)
+        in_sp = input.shape[2:4] if data_format == "NCHW" \
+            else input.shape[1:3]
+        filter_size = tuple(
+            osz[i] - (in_sp[i] - 1) * st[i] + 2 * pd[i] for i in range(2))
+    layer = _cached(
+        ("convT2d", name, cin, num_filters, str(filter_size)),
+        lambda: nn.Conv2DTranspose(cin, num_filters, filter_size,
+                                   stride=stride, padding=padding,
+                                   dilation=dilation, groups=groups,
+                                   weight_attr=param_attr,
+                                   bias_attr=bias_attr,
+                                   data_format=data_format))
+    out = layer(input)
+    if act:
+        from paddle_tpu.nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCDHW"):
+    from paddle_tpu import nn
+    cin = input.shape[1] if data_format == "NCDHW" else input.shape[-1]
+    layer = _cached(
+        ("conv3d", name, cin, num_filters, str(filter_size)),
+        lambda: nn.Conv3D(cin, num_filters, filter_size, stride=stride,
+                          padding=padding, dilation=dilation,
+                          groups=groups, weight_attr=param_attr,
+                          bias_attr=bias_attr))
+    out = layer(input)
+    if act:
+        from paddle_tpu.nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCDHW"):
+    from paddle_tpu import nn
+    cin = input.shape[1] if data_format == "NCDHW" else input.shape[-1]
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError("conv3d_transpose needs filter_size or "
+                             "output_size")
+        osz = output_size if isinstance(output_size, (list, tuple)) \
+            else (output_size,) * 3
+        st = stride if isinstance(stride, (list, tuple)) \
+            else (stride,) * 3
+        pd = padding if isinstance(padding, (list, tuple)) \
+            else (padding,) * 3
+        in_sp = input.shape[2:5] if data_format == "NCDHW" \
+            else input.shape[1:4]
+        filter_size = tuple(
+            osz[i] - (in_sp[i] - 1) * st[i] + 2 * pd[i] for i in range(3))
+    layer = _cached(
+        ("convT3d", name, cin, num_filters, str(filter_size)),
+        lambda: nn.Conv3DTranspose(cin, num_filters, filter_size,
+                                   stride=stride, padding=padding,
+                                   dilation=dilation, groups=groups,
+                                   weight_attr=param_attr,
+                                   bias_attr=bias_attr))
+    out = layer(input)
+    if act:
+        from paddle_tpu.nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    from paddle_tpu import nn
+    norm_shape = list(input.shape[begin_norm_axis:])
+    layer = _cached(("ln", name, tuple(norm_shape)),
+                    lambda: nn.LayerNorm(norm_shape, epsilon=epsilon,
+                                         weight_attr=param_attr,
+                                         bias_attr=bias_attr))
+    out = layer(input)
+    if act:
+        from paddle_tpu.nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    from paddle_tpu import nn
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    layer = _cached(("gn", name, groups, c),
+                    lambda: nn.GroupNorm(groups, c, epsilon=epsilon))
+    out = layer(input)
+    if act:
+        from paddle_tpu.nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    from paddle_tpu import nn
+    c = input.shape[1]
+    layer = _cached(("in", name, c),
+                    lambda: nn.InstanceNorm2D(c, epsilon=epsilon))
+    return layer(input)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              enable_scale_and_shift=False, name=None, **kwargs):
+    """Batch-statistics normalization without learnable affine by
+    default (reference static/nn/common.py data_norm)."""
+    def fn(v):
+        mean = v.mean(axis=0, keepdims=True)
+        var = v.var(axis=0, keepdims=True)
+        return (v - mean) * jax.lax.rsqrt(var + epsilon)
+    out = apply(fn, input)
+    if act:
+        from paddle_tpu.nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    from paddle_tpu import nn
+    if mode == "all":
+        num = 1
+    elif mode == "channel":
+        num = x.shape[1] if data_format == "NCHW" else x.shape[-1]
+    else:
+        num = 1
+        for s in x.shape[1:]:
+            num *= s
+    layer = _cached(("prelu", name, mode, num),
+                    lambda: nn.PReLU(num_parameters=num,
+                                     weight_attr=param_attr,
+                                     data_format=data_format))
+    return layer(x)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    from paddle_tpu import nn
+    layer = _cached(("sn", name, tuple(weight.shape)),
+                    lambda: nn.SpectralNorm(weight.shape, dim=dim,
+                                            power_iters=power_iters,
+                                            eps=eps))
+    return layer(weight)
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None,
+                  name=None):
+    from paddle_tpu.vision.ops import DeformConv2D
+    cin = x.shape[1]
+    layer = _cached(("dcn", name, cin, num_filters, str(filter_size)),
+                    lambda: DeformConv2D(cin, num_filters, filter_size,
+                                         stride=stride, padding=padding,
+                                         dilation=dilation, groups=groups,
+                                         deformable_groups=deformable_groups))
+    return layer(x, offset, mask)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    from paddle_tpu import nn
+    layer = _cached(("bilinear", name, x.shape[-1], y.shape[-1], size),
+                    lambda: nn.Bilinear(x.shape[-1], y.shape[-1], size,
+                                        weight_attr=param_attr,
+                                        bias_attr=bias_attr))
+    out = layer(x, y)
+    if act:
+        from paddle_tpu.nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def crf_decoding(potentials, transitions=None, lengths=None, label=None,
+                 param_attr=None):
+    """Viterbi decode (reference crf_decoding routes to the CRF kernel;
+    the text namespace holds the TPU implementation).  With no explicit
+    `transitions`, a learnable [n, n] transition table is created (per
+    call site / param_attr name) like the reference's CRF weight; the
+    paddle convention keeps bos/eos as the last two of the n tags."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.text import viterbi_decode
+    num_tags = potentials.shape[-1]
+    if transitions is None:
+        from paddle_tpu import nn
+
+        class _Trans(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.weight = self.create_parameter(
+                    [num_tags, num_tags], attr=param_attr)
+
+        holder = _cached(("crf_trans",
+                          getattr(param_attr, "name", None), num_tags),
+                         _Trans)
+        transitions = holder.weight
+    if lengths is None:
+        batch, time = potentials.shape[0], potentials.shape[1]
+        lengths = Tensor(jnp.full((batch,), time, jnp.int32))
+    scores, path = viterbi_decode(potentials, transitions, lengths)
+    return path
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    from paddle_tpu.static import py_func as _py
+    return _py(func, x, out, backward_func, skip_vars_in_backward_input)
+
+
+# ------------------------------------------------------- control flow
+def _is_tracing(*tensors):
+    return any(isinstance(getattr(t, "_value", None), jax.core.Tracer)
+               for t in tensors if isinstance(t, Tensor))
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None,
+         return_names=None):
+    """Two-branch conditional (reference control_flow.py cond): under a
+    trace this lowers to lax.cond (both branches traced); eagerly it is
+    a Python if."""
+    if isinstance(pred, Tensor) and _is_tracing(pred):
+        if true_fn is None or false_fn is None:
+            raise ValueError(
+                "under a trace, cond needs BOTH branches (lax.cond "
+                "requires matching outputs; a None branch is only legal "
+                "eagerly, where it means 'return None')")
+
+        def wrap(fn):
+            def inner(_):
+                out = fn()
+                return out._value if isinstance(out, Tensor) else out
+            return inner
+        return Tensor(jax.lax.cond(pred._value.reshape(()),
+                                   wrap(true_fn), wrap(false_fn), 0))
+    taken = bool(pred.numpy()) if isinstance(pred, Tensor) else bool(pred)
+    branch = true_fn if taken else false_fn
+    return branch() if branch is not None else None
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First-match-wins multi-branch (reference control_flow.py case)."""
+    for pred, fn in pred_fn_pairs:
+        taken = bool(pred.numpy()) if isinstance(pred, Tensor) else \
+            bool(pred)
+        if taken:
+            return fn()
+    if default is not None:
+        return default()
+    return pred_fn_pairs[-1][1]()
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Index-dispatched branch (reference control_flow.py switch_case)."""
+    fns = dict(branch_fns) if not isinstance(branch_fns, dict) \
+        else branch_fns
+    idx = int(branch_index.numpy()) if isinstance(branch_index, Tensor) \
+        else int(branch_index)
+    if idx in fns:
+        return fns[idx]()
+    if default is not None:
+        return default()
+    return fns[max(fns)]()
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """While loop (reference control_flow.py while_loop): lax.while_loop
+    under a trace (body must keep shapes/dtypes stable), Python loop
+    eagerly."""
+    if _is_tracing(*loop_vars):
+        def c(vals):
+            out = cond(*[Tensor(v) for v in vals])
+            return out._value.reshape(()) if isinstance(out, Tensor) \
+                else out
+
+        def b(vals):
+            outs = body(*[Tensor(v) for v in vals])
+            outs = outs if isinstance(outs, (list, tuple)) else [outs]
+            return tuple(o._value if isinstance(o, Tensor) else o
+                         for o in outs)
+
+        final = jax.lax.while_loop(
+            c, b, tuple(v._value if isinstance(v, Tensor) else v
+                        for v in loop_vars))
+        return [Tensor(v) for v in final]
+    vals = list(loop_vars)
+    while True:
+        c = cond(*vals)  # evaluate ONCE per iteration
+        if not bool(c.numpy() if isinstance(c, Tensor) else c):
+            break
+        out = body(*vals)
+        vals = list(out) if isinstance(out, (list, tuple)) else [out]
+    return vals
+
+
+# ------------------------------------------------------- sequence ops
+# Dense [batch, time, ...] + explicit lengths replace fluid LoD tensors.
+def _length_mask(lengths, time, dtype=jnp.float32):
+    t = jnp.arange(time)
+    return (t[None, :] < lengths[:, None]).astype(dtype)
+
+
+def sequence_softmax(input, lengths=None, name=None):
+    def fn(v, *rest):
+        if rest:
+            mask = _length_mask(rest[0], v.shape[1], v.dtype)
+            v = jnp.where(mask[..., None] > 0 if v.ndim == 3
+                          else mask > 0, v, -1e30)
+        return jax.nn.softmax(v, axis=1)
+    if lengths is None:
+        return apply(fn, input)
+    return apply(fn, input, lengths)
+
+
+def sequence_pool(input, pool_type, lengths=None, pad_value=0.0):
+    def fn(v, *rest):
+        mask = None
+        if rest:
+            mask = _length_mask(rest[0], v.shape[1], v.dtype)
+            while mask.ndim < v.ndim:
+                mask = mask[..., None]
+        if pool_type.lower() == "sum":
+            return (v * mask).sum(1) if mask is not None else v.sum(1)
+        if pool_type.lower() in ("average", "mean"):
+            if mask is not None:
+                return (v * mask).sum(1) / jnp.maximum(mask.sum(1), 1)
+            return v.mean(1)
+        if pool_type.lower() == "max":
+            if mask is not None:
+                v = jnp.where(mask > 0, v, -jnp.inf)
+                out = v.max(1)
+                # zero-length rows have nothing to pool: reference fills
+                # them with pad_value instead of -inf
+                empty = mask.reshape(mask.shape[0], mask.shape[1], -1
+                                     ).sum(axis=(1, 2)) == 0
+                shape = (out.shape[0],) + (1,) * (out.ndim - 1)
+                return jnp.where(empty.reshape(shape), pad_value, out)
+            return v.max(1)
+        if pool_type.lower() == "sqrt":
+            if mask is not None:
+                return (v * mask).sum(1) / jnp.sqrt(
+                    jnp.maximum(mask.sum(1), 1))
+            return v.sum(1) / jnp.sqrt(v.shape[1])
+        if pool_type.lower() in ("first", "last"):
+            if pool_type.lower() == "first":
+                return v[:, 0]
+            if rest:
+                idx = jnp.maximum(rest[0].astype(jnp.int32) - 1, 0)
+                return jnp.take_along_axis(
+                    v, idx[:, None, None] if v.ndim == 3
+                    else idx[:, None], axis=1).squeeze(1)
+            return v[:, -1]
+        raise ValueError(f"unknown pool_type {pool_type}")
+    if lengths is None:
+        return apply(fn, input)
+    return apply(fn, input, lengths)
+
+
+def sequence_first_step(input, lengths=None):
+    return sequence_pool(input, "first", lengths)
+
+
+def sequence_last_step(input, lengths=None):
+    return sequence_pool(input, "last", lengths)
+
+
+def sequence_concat(input, name=None):
+    from paddle_tpu.tensor.manipulation import concat
+    return concat(list(input), axis=1)
+
+
+def sequence_reverse(x, lengths=None, name=None):
+    def fn(v, *rest):
+        if not rest:
+            return jnp.flip(v, axis=1)
+        t = v.shape[1]
+        lens = rest[0].astype(jnp.int32)
+        idx = jnp.arange(t)[None, :]
+        rev = jnp.where(idx < lens[:, None], lens[:, None] - 1 - idx, idx)
+        return jnp.take_along_axis(
+            v, rev[..., None] if v.ndim == 3 else rev, axis=1)
+    if lengths is None:
+        return apply(fn, x)
+    return apply(fn, x, lengths)
+
+
+class StaticRNN:
+    """Step-wise RNN builder (reference control_flow.py StaticRNN):
+    collect the step function through the with-block API, then run it
+    as one lax.scan over time."""
+
+    def __init__(self, name=None):
+        self._inputs = []       # [batch, time, ...] tensors, time-major in scan
+        self._memories = []     # (init Tensor)
+        self._mem_next = {}
+        self._outputs = []
+        self._in_block = False
+
+    def step(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            self._in_block = True
+            yield self
+            self._in_block = False
+
+        return ctx()
+
+    def step_input(self, x):
+        self._inputs.append(x)
+        marker = ("in", len(self._inputs) - 1)
+        return _RNNRef(self, marker)
+
+    def memory(self, init=None, shape=None, value=0.0, batch_ref=None):
+        if init is None:
+            batch = batch_ref.shape[0] if batch_ref is not None else 1
+            init = Tensor(jnp.full((batch, *shape), value, jnp.float32))
+        self._memories.append(init)
+        return _RNNRef(self, ("mem", len(self._memories) - 1))
+
+    def update_memory(self, mem_ref, new_ref):
+        self._mem_next[mem_ref._marker[1]] = new_ref
+
+    def step_output(self, out_ref):
+        self._outputs.append(out_ref)
+
+    def output(self, *out_refs):
+        for r in out_refs:
+            self.step_output(r)
+
+    def __call__(self):
+        ins = [jnp.swapaxes(t._value, 0, 1) for t in self._inputs]
+        mems = tuple(m._value for m in self._memories)
+
+        def scan_fn(carry, xs):
+            env = {("in", i): xs[i] for i in range(len(ins))}
+            env.update({("mem", i): carry[i]
+                        for i in range(len(carry))})
+            outs = [r._eval(env) for r in self._outputs]
+            new_carry = tuple(
+                self._mem_next[i]._eval(env) if i in self._mem_next
+                else carry[i] for i in range(len(carry)))
+            return new_carry, tuple(outs)
+
+        _, ys = jax.lax.scan(scan_fn, mems, tuple(ins))
+        outs = [Tensor(jnp.swapaxes(y, 0, 1)) for y in ys]
+        return outs if len(outs) != 1 else outs[0]
+
+
+class _RNNRef:
+    """Deferred expression node inside a StaticRNN step block: records
+    the op graph symbolically; evaluated per scan step."""
+
+    def __init__(self, rnn, marker, fn=None, args=()):
+        self._rnn = rnn
+        self._marker = marker
+        self._fn = fn
+        self._args = args
+
+    def _eval(self, env):
+        if self._fn is None:
+            return env[self._marker]
+        return self._fn(*[a._eval(env) if isinstance(a, _RNNRef)
+                          else (a._value if isinstance(a, Tensor) else a)
+                          for a in self._args])
+
+    def _lift(self, fn, *args):
+        return _RNNRef(self._rnn, ("expr", id(self)), fn,
+                       (self, *args))
+
+    def __add__(self, other):
+        return self._lift(lambda a, b: a + b, other)
+
+    def __mul__(self, other):
+        return self._lift(lambda a, b: a * b, other)
+
+    def matmul(self, w):
+        return self._lift(lambda a, b: a @ b, w)
+
+    def tanh(self):
+        return self._lift(jnp.tanh)

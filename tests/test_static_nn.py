@@ -1,0 +1,157 @@
+"""paddle.static.nn layer functions + control flow + sequence ops +
+StaticRNN.
+
+Reference: python/paddle/static/nn/__init__.py:62,
+static/nn/{common,control_flow}.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu.static import nn as snn
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    snn._layer_cache.clear()
+    yield
+    snn._layer_cache.clear()
+
+
+class TestLayers:
+    def test_fc_caches_params_across_calls(self):
+        P.seed(0)
+        x = P.to_tensor(np.random.RandomState(0).randn(4, 6)
+                        .astype(np.float32))
+        y1 = snn.fc(x, 3, name="shared")
+        y2 = snn.fc(x, 3, name="shared")
+        np.testing.assert_allclose(y1.numpy(), y2.numpy())
+        assert tuple(y1.shape) == (4, 3)
+        y3 = snn.fc(x, 3, name="other", activation="relu")
+        assert (y3.numpy() >= 0).all()
+
+    def test_embedding_and_batch_norm_conv(self):
+        P.seed(0)
+        ids = P.to_tensor(np.array([[1, 2], [3, 0]]), dtype="int64")
+        emb = snn.embedding(ids, (8, 5))
+        assert tuple(emb.shape) == (2, 2, 5)
+        img = P.to_tensor(np.random.RandomState(1).randn(2, 3, 8, 8)
+                          .astype(np.float32))
+        out = snn.conv2d(img, 4, 3, padding=1, act="relu")
+        assert tuple(out.shape) == (2, 4, 8, 8)
+        bn = snn.batch_norm(out)
+        assert tuple(bn.shape) == (2, 4, 8, 8)
+        ln = snn.layer_norm(img, begin_norm_axis=1)
+        assert tuple(ln.shape) == tuple(img.shape)
+        gn = snn.group_norm(img, groups=3)
+        assert tuple(gn.shape) == tuple(img.shape)
+
+    def test_data_norm_standardizes(self):
+        x = P.to_tensor((np.random.RandomState(0).randn(64, 4) * 3 + 5)
+                        .astype(np.float32))
+        out = snn.data_norm(x).numpy()
+        np.testing.assert_allclose(out.mean(0), 0.0, atol=1e-5)
+        np.testing.assert_allclose(out.std(0), 1.0, atol=1e-2)
+
+    def test_bilinear_and_prelu(self):
+        P.seed(0)
+        a = P.to_tensor(np.random.RandomState(0).randn(3, 4)
+                        .astype(np.float32))
+        b = P.to_tensor(np.random.RandomState(1).randn(3, 5)
+                        .astype(np.float32))
+        out = snn.bilinear_tensor_product(a, b, 6)
+        assert tuple(out.shape) == (3, 6)
+        x = P.to_tensor(np.array([[-1.0, 2.0]], np.float32))
+        y = snn.prelu(x, mode="all")
+        assert y.numpy()[0, 1] == 2.0
+
+
+class TestControlFlow:
+    def test_cond_eager_and_traced(self):
+        x = P.to_tensor(np.array(3.0, np.float32))
+        out = snn.cond(P.to_tensor(True),
+                       lambda: x * 2, lambda: x * 10)
+        assert float(out) == 6.0
+
+        @P.jit.to_static
+        def f(v):
+            return snn.cond(v.sum() > 0, lambda: v * 2, lambda: v * 10)
+
+        np.testing.assert_allclose(
+            f(P.to_tensor(np.array([1.0], np.float32))).numpy(), [2.0])
+        np.testing.assert_allclose(
+            f(P.to_tensor(np.array([-1.0], np.float32))).numpy(), [-10.0])
+
+    def test_case_and_switch_case(self):
+        x = P.to_tensor(np.array(1.0, np.float32))
+        out = snn.case([(P.to_tensor(False), lambda: x * 1),
+                        (P.to_tensor(True), lambda: x * 5)],
+                       default=lambda: x * 9)
+        assert float(out) == 5.0
+        out = snn.switch_case(P.to_tensor(2), {1: lambda: x * 1,
+                                               2: lambda: x * 7})
+        assert float(out) == 7.0
+
+    def test_while_loop_eager_and_traced(self):
+        i = P.to_tensor(np.array(0, np.int32))
+        (final,) = snn.while_loop(lambda i: i < 5, lambda i: i + 1, [i])
+        assert int(final) == 5
+
+        @P.jit.to_static
+        def f(start):
+            (out,) = snn.while_loop(lambda i: i < 10,
+                                    lambda i: i + 2, [start])
+            return out
+
+        assert int(f(P.to_tensor(np.array(0, np.int32)))) == 10
+
+
+class TestSequenceOps:
+    def test_pool_variants_with_lengths(self):
+        x = P.to_tensor(np.arange(12, dtype=np.float32).reshape(2, 3, 2))
+        lens = P.to_tensor(np.array([2, 3]), dtype="int64")
+        s = snn.sequence_pool(x, "sum", lens).numpy()
+        np.testing.assert_allclose(s[0], x.numpy()[0, :2].sum(0))
+        np.testing.assert_allclose(s[1], x.numpy()[1].sum(0))
+        m = snn.sequence_pool(x, "max", lens).numpy()
+        np.testing.assert_allclose(m[0], x.numpy()[0, :2].max(0))
+        first = snn.sequence_first_step(x).numpy()
+        np.testing.assert_allclose(first, x.numpy()[:, 0])
+        last = snn.sequence_last_step(x, lens).numpy()
+        np.testing.assert_allclose(last[0], x.numpy()[0, 1])
+        np.testing.assert_allclose(last[1], x.numpy()[1, 2])
+
+    def test_softmax_masks_padding(self):
+        x = P.to_tensor(np.zeros((1, 4), np.float32))
+        lens = P.to_tensor(np.array([2]), dtype="int64")
+        p = snn.sequence_softmax(x, lens).numpy()
+        np.testing.assert_allclose(p[0, :2], 0.5, rtol=1e-5)
+        np.testing.assert_allclose(p[0, 2:], 0.0, atol=1e-8)
+
+    def test_reverse_respects_lengths(self):
+        x = P.to_tensor(np.arange(8, dtype=np.float32).reshape(1, 4, 2))
+        lens = P.to_tensor(np.array([3]), dtype="int64")
+        r = snn.sequence_reverse(x, lens).numpy()
+        np.testing.assert_allclose(r[0, :3], x.numpy()[0, [2, 1, 0]])
+        np.testing.assert_allclose(r[0, 3], x.numpy()[0, 3])  # pad stays
+
+    def test_concat(self):
+        a = P.ones([2, 2, 3])
+        b = P.zeros([2, 1, 3])
+        out = snn.sequence_concat([a, b])
+        assert tuple(out.shape) == (2, 3, 3)
+
+
+class TestStaticRNN:
+    def test_cumulative_sum_rnn(self):
+        x = P.to_tensor(np.arange(6, dtype=np.float32).reshape(1, 3, 2))
+        rnn = snn.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            mem = rnn.memory(shape=(2,), batch_ref=x)
+            acc = mem + xt
+            rnn.update_memory(mem, acc)
+            rnn.step_output(acc)
+        out = rnn().numpy()
+        np.testing.assert_allclose(out[0],
+                                   np.cumsum(x.numpy()[0], axis=0))
