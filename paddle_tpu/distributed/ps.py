@@ -172,8 +172,17 @@ def ps_embedding(ids, table):
                 jnp.sum(ct).astype(jnp.float32))
 
     lookup.defvjp(fwd, bwd)
+    # the anchor persists across steps (cached on the table) while its
+    # .grad is re-written by every backward; under to_static a DISCOVERY
+    # trace can abort (state registered lazily -> retrace) after backward
+    # already wrote a tracer into anchor.grad — accumulating onto that
+    # leaked tracer in the next trace is an UnexpectedTracerError. The
+    # grad's value is never consumed (push() happens in the vjp), so
+    # clear it on every entry.
+    anchor = table.anchor
+    anchor.clear_grad()
     return apply(lookup, ids if isinstance(ids, Tensor)
-                 else Tensor(jnp.asarray(ids)), table.anchor)
+                 else Tensor(jnp.asarray(ids)), anchor)
 
 
 class PSEmbedding:

@@ -170,7 +170,7 @@ def conv3d(input, num_filters, filter_size, stride=1, padding=0,
         lambda: nn.Conv3D(cin, num_filters, filter_size, stride=stride,
                           padding=padding, dilation=dilation,
                           groups=groups, weight_attr=param_attr,
-                          bias_attr=bias_attr))
+                          bias_attr=bias_attr, data_format=data_format))
     out = layer(input)
     if act:
         from paddle_tpu.nn import functional as F
@@ -204,7 +204,8 @@ def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
                                    stride=stride, padding=padding,
                                    dilation=dilation, groups=groups,
                                    weight_attr=param_attr,
-                                   bias_attr=bias_attr))
+                                   bias_attr=bias_attr,
+                                   data_format=data_format))
     out = layer(input)
     if act:
         from paddle_tpu.nn import functional as F
@@ -233,7 +234,10 @@ def group_norm(input, groups, epsilon=1e-5, param_attr=None,
     from paddle_tpu import nn
     c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
     layer = _cached(("gn", name, groups, c),
-                    lambda: nn.GroupNorm(groups, c, epsilon=epsilon))
+                    lambda: nn.GroupNorm(groups, c, epsilon=epsilon,
+                                         weight_attr=param_attr,
+                                         bias_attr=bias_attr,
+                                         data_format=data_layout))
     out = layer(input)
     if act:
         from paddle_tpu.nn import functional as F
@@ -287,7 +291,7 @@ def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
     layer = _cached(("sn", name, tuple(weight.shape)),
                     lambda: nn.SpectralNorm(weight.shape, dim=dim,
                                             power_iters=power_iters,
-                                            eps=eps))
+                                            epsilon=eps))
     return layer(weight)
 
 
@@ -377,17 +381,29 @@ def cond(pred, true_fn=None, false_fn=None, name=None,
         def wrap(fn):
             def inner(_):
                 out = fn()
-                return out._value if isinstance(out, Tensor) else out
+                # Tensors are not jax pytree leaves: strip them in any
+                # (possibly nested) branch output structure
+                return jax.tree_util.tree_map(
+                    lambda o: o._value if isinstance(o, Tensor) else o,
+                    out, is_leaf=lambda o: isinstance(o, Tensor))
             return inner
-        return Tensor(jax.lax.cond(pred._value.reshape(()),
-                                   wrap(true_fn), wrap(false_fn), 0))
+
+        out = jax.lax.cond(pred._value.reshape(()),
+                           wrap(true_fn), wrap(false_fn), 0)
+        return jax.tree_util.tree_map(Tensor, out)
     taken = bool(pred.numpy()) if isinstance(pred, Tensor) else bool(pred)
     branch = true_fn if taken else false_fn
     return branch() if branch is not None else None
 
 
 def case(pred_fn_pairs, default=None, name=None):
-    """First-match-wins multi-branch (reference control_flow.py case)."""
+    """First-match-wins multi-branch (reference control_flow.py case).
+    Eager-only: nest `cond` for a traced multi-branch."""
+    if _is_tracing(*[p for p, _ in pred_fn_pairs
+                     if isinstance(p, Tensor)]):
+        raise NotImplementedError(
+            "static.nn.case needs concrete predicates; under to_static "
+            "compose nested static.nn.cond calls (lax.cond) instead")
     for pred, fn in pred_fn_pairs:
         taken = bool(pred.numpy()) if isinstance(pred, Tensor) else \
             bool(pred)
@@ -399,7 +415,12 @@ def case(pred_fn_pairs, default=None, name=None):
 
 
 def switch_case(branch_index, branch_fns, default=None, name=None):
-    """Index-dispatched branch (reference control_flow.py switch_case)."""
+    """Index-dispatched branch (reference control_flow.py switch_case).
+    Eager-only: use lax.switch-style nesting of `cond` under a trace."""
+    if isinstance(branch_index, Tensor) and _is_tracing(branch_index):
+        raise NotImplementedError(
+            "static.nn.switch_case needs a concrete index; under "
+            "to_static compose nested static.nn.cond calls instead")
     fns = dict(branch_fns) if not isinstance(branch_fns, dict) \
         else branch_fns
     idx = int(branch_index.numpy()) if isinstance(branch_index, Tensor) \

@@ -155,6 +155,7 @@ def test_hybrid_1f1b_matches_single_device(meshes):
                                    atol=2e-4, rtol=2e-3)
 
 
+@pytest.mark.nightly  # schedule parity tests cover 1f1b in the gate
 def test_hybrid_1f1b_train_step_decreases_loss(meshes):
     cfg = _cfg()
     mesh = mesh_mod.init_mesh({"dp": 1, "pp": 2, "tp": 2, "sp": 2})
@@ -208,6 +209,7 @@ def test_hybrid_interleaved_matches_single_device(meshes):
                                    atol=2e-4, rtol=2e-3)
 
 
+@pytest.mark.nightly  # schedule parity tests cover interleave in the gate
 def test_hybrid_interleaved_train_step(meshes):
     cfg = _cfg()
     mesh = mesh_mod.init_mesh({"dp": 1, "pp": 2, "tp": 2, "sp": 2})

@@ -88,7 +88,7 @@ class TestErnie:
 class TestViT:
     def test_forward_backward(self):
         P.seed(0)
-        cfg = vit_tiny()
+        cfg = vit_tiny(num_layers=1)
         model = VisionTransformer(cfg)
         x = P.to_tensor(np.random.default_rng(0).standard_normal(
             (2, 3, 32, 32)).astype(np.float32))

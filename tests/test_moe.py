@@ -113,9 +113,9 @@ class TestMoELayer:
     def test_gshard_aux_loss_formula(self):
         P.seed(0)
         d, E = 8, 4
-        layer = MoELayer(d, StackedExpertFFN(E, d, 16),
+        layer = MoELayer(d, StackedExpertFFN(E, d, 8),
                          gate={"type": "gshard", "top_k": 2})
-        x = np.random.RandomState(3).randn(6, 3, d).astype(np.float32)
+        x = np.random.RandomState(3).randn(4, 2, d).astype(np.float32)
         layer(P.to_tensor(x))
         loss = layer.gate.get_loss()
         assert loss is not None
@@ -161,7 +161,7 @@ class TestMoELayer:
                          gate={"type": "gshard", "top_k": 2},
                          capacity_factor=(64.0, 64.0))
         assert layer.gate.random_routing
-        x = P.to_tensor(np.random.RandomState(7).randn(16, 4, d)
+        x = P.to_tensor(np.random.RandomState(7).randn(8, 4, d)
                         .astype(np.float32))
         layer.train()
         a = layer(x).numpy()
@@ -218,9 +218,9 @@ class TestExpertParallel:
                 return loss
 
             rng = np.random.default_rng(0)
-            ids = P.to_tensor(rng.integers(0, cfg.vocab_size, (8, 32)),
+            ids = P.to_tensor(rng.integers(0, cfg.vocab_size, (4, 16)),
                               dtype="int64")
-            labels = P.to_tensor(rng.integers(0, cfg.vocab_size, (8, 32)),
+            labels = P.to_tensor(rng.integers(0, cfg.vocab_size, (4, 16)),
                                  dtype="int64")
             if mesh_shape is not None:
                 sh = NamedSharding(mesh, PartitionSpec("dp", None))

@@ -29,8 +29,9 @@ SINGLE_OUT = [
     pytest.param("mobilenet_v3_large", dict(num_classes=10, scale=0.5), 64,
                  marks=_N),
     pytest.param("squeezenet1_0", dict(num_classes=10), 64, marks=_N),
-    pytest.param("squeezenet1_1", dict(num_classes=10), 64, marks=_N),
-    pytest.param("shufflenet_v2_x0_25", dict(num_classes=10), 64),
+    pytest.param("squeezenet1_1", dict(num_classes=10), 64),
+    pytest.param("shufflenet_v2_x0_25", dict(num_classes=10), 64,
+                 marks=_N),
     pytest.param("shufflenet_v2_swish", dict(num_classes=10), 64,
                  marks=_N),
     pytest.param("densenet121", dict(num_classes=10), 64, marks=_N),
@@ -82,6 +83,7 @@ def test_mobilenet_v2_train_step_runs():
     assert np.isfinite(float(loss))
 
 
+@pytest.mark.nightly  # construction-variant check
 def test_no_classifier_head():
     model = models.resnet18(num_classes=0)
     model.eval()
