@@ -16,6 +16,10 @@ from paddle_tpu.quantization import fake_quant
 __all__ = [
     "FakeQuantAbsMax", "FakeQuantChannelWiseAbsMax",
     "FakeQuantMovingAverageAbsMax", "FakeQuantMAOutputScaleLayer",
+    "FakeQuantWeightLSQPlus", "FakeQuantActLSQPlus", "LsqFunc",
+    "LsqPlusActFunc", "MovingAverageAbsMaxScale", "MAOutputScaleLayer",
+    "QuantizedLinear", "QuantizedConv2D",
+    "QuantizedColumnParallelLinear", "QuantizedRowParallelLinear",
     "QuantStub", "quant_dequant",
 ]
 
@@ -63,13 +67,16 @@ class FakeQuantChannelWiseAbsMax(Layer):
 class FakeQuantMovingAverageAbsMax(Layer):
     """Activation fake quantization with an EMA absmax scale (reference
     FakeQuantMovingAverageAbsMax): the running scale is a persistable
-    state tensor so QAT checkpoints carry it."""
+    state tensor so QAT checkpoints carry it.  With observe_only the
+    layer tracks the scale but passes the value through unquantized
+    (the MovingAverageAbsMaxScale behavior)."""
 
     def __init__(self, name=None, moving_rate=0.9, quant_bits=8,
-                 dtype="float32"):
+                 dtype="float32", observe_only=False):
         super().__init__()
         self.rate = moving_rate
         self.bits = quant_bits
+        self.observe_only = observe_only
         self.scale = self.create_parameter([1])
         self.scale._set_value(jnp.ones((1,), jnp.float32))
         self.scale.stop_gradient = True
@@ -80,6 +87,8 @@ class FakeQuantMovingAverageAbsMax(Layer):
             new = self.rate * float(self.scale._value[0]) \
                 + (1 - self.rate) * cur
             self.scale._set_value(jnp.asarray([new], jnp.float32))
+        if self.observe_only:
+            return x
         qmax = 2 ** (self.bits - 1) - 1
         return fake_quant(x, float(self.scale._value[0]) / qmax, self.bits)
 
@@ -107,3 +116,183 @@ class QuantStub(Layer):
 
     def forward(self, x):
         return self._fq(x)
+
+
+# ------------------------------------------------------- LSQ(+) quantizers
+def _lsq(x, scale, qn, qp, grad_scale):
+    """Learned-Step-size Quantization op (Esser et al. 2020; reference
+    quant_layers.py LsqFunc): q = clip(round(x/s)) * s with the paper's
+    straight-through gradients — d/dx passes inside the clip range,
+    d/ds = g * (q/s - x/s rounded residual or the clip boundary)."""
+    import jax
+
+    @jax.custom_vjp
+    def op(v, s):
+        return jnp.clip(jnp.round(v / s), qn, qp) * s
+
+    def fwd(v, s):
+        return op(v, s), (v, s)
+
+    def bwd(res, ct):
+        v, s = res
+        r = v / s
+        inside = (r >= qn) & (r <= qp)
+        dv = jnp.where(inside, ct, 0.0)
+        q = jnp.clip(jnp.round(r), qn, qp)
+        ds_elem = jnp.where(inside, q - r, q)
+        full = ct * ds_elem * grad_scale
+        # reduce to the scale's shape (per-tensor OR per-channel): sum
+        # over every axis the scale broadcasts across
+        s_shape = jnp.shape(s)
+        lead = full.ndim - len(s_shape)
+        axes = tuple(range(lead)) + tuple(
+            lead + i for i, d in enumerate(s_shape)
+            if d == 1 and full.shape[lead + i] != 1)
+        ds = full.sum(axis=axes, keepdims=False)
+        if lead and ds.ndim != len(s_shape):
+            ds = ds.reshape(s_shape)
+        elif axes and ds.ndim != len(s_shape):
+            ds = ds.reshape(s_shape)
+        return dv, ds.reshape(s_shape)
+
+    op.defvjp(fwd, bwd)
+    return op(x, scale)
+
+
+def LsqFunc(x, scale, lsq_factor=1.0, bits=8, all_positive=False,
+            per_channel=False):
+    """Functional LSQ fake-quant (reference quant_layers.py LsqFunc)."""
+    from paddle_tpu.core.dispatch import apply
+    from paddle_tpu.core.tensor import Tensor as _T
+    qn = 0 if all_positive else -(2 ** (bits - 1))
+    qp = (2 ** bits - 1) if all_positive else (2 ** (bits - 1) - 1)
+    return apply(lambda v, s: _lsq(v, s, qn, qp, lsq_factor), x,
+                 scale if isinstance(scale, _T) else _T(jnp.asarray(scale)))
+
+
+LsqPlusActFunc = LsqFunc
+
+
+class FakeQuantWeightLSQPlus(Layer):
+    """Weight fake-quant with a LEARNED step size (reference
+    quant_layers.py FakeQuantWeightLSQPlus): scale initializes from the
+    weight statistics and trains with the model."""
+
+    def __init__(self, quant_bits=8, all_positive=False, channel_num=None,
+                 per_channel=False, batch_init=20, dtype="float32",
+                 quant_linear=False, reduce_type=None):
+        super().__init__()
+        self.bits = quant_bits
+        self.all_positive = all_positive
+        self.scale = self.create_parameter([1])
+        # init-state rides in state_dict (a plain python flag would make
+        # the first forward after set_state_dict clobber a restored
+        # trained scale with fresh weight statistics)
+        self.init_state = self.create_parameter([1])
+        self.init_state._set_value(jnp.zeros((1,), jnp.float32))
+        self.init_state.stop_gradient = True
+
+    def forward(self, w):
+        if float(self.init_state._value[0]) == 0.0:
+            qp = (2 ** self.bits - 1) if self.all_positive \
+                else (2 ** (self.bits - 1) - 1)
+            init = 2.0 * float(np.abs(np.asarray(w._value)).mean()) \
+                / np.sqrt(qp) or 1e-3
+            self.scale._set_value(jnp.asarray([init], jnp.float32))
+            self.init_state._set_value(jnp.ones((1,), jnp.float32))
+        qp_g = (2 ** self.bits - 1) if self.all_positive \
+            else (2 ** (self.bits - 1) - 1)
+        g = 1.0 / np.sqrt(np.prod(w.shape) * qp_g) if w.shape else 1.0
+        return LsqFunc(w, self.scale, lsq_factor=float(g), bits=self.bits,
+                       all_positive=self.all_positive)
+
+
+class FakeQuantActLSQPlus(FakeQuantWeightLSQPlus):
+    """Activation LSQ+ fake-quant (learned scale + optional learned
+    offset; offset omitted — symmetric activations on TPU)."""
+
+
+class MovingAverageAbsMaxScale(FakeQuantMovingAverageAbsMax):
+    """Observe-only: track the EMA absmax scale WITHOUT quantizing
+    (reference quant_layers.py MovingAverageAbsMaxScale)."""
+
+    def __init__(self, name=None, moving_rate=0.9, dtype="float32"):
+        super().__init__(name=name, moving_rate=moving_rate,
+                         observe_only=True)
+
+
+MAOutputScaleLayer = FakeQuantMAOutputScaleLayer
+
+
+class QuantizedLinear(Layer):
+    """QAT linear: fake-quantizes weight (channel-wise) and activation
+    (moving-average) around the float matmul (reference quant_layers.py
+    QuantizedLinear); convert via paddle_tpu.quantization for the real
+    int8 MXU kernel."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max", **kw):
+        super().__init__()
+        self._layer = layer
+        if weight_quantize_type == "abs_max":
+            self._wfq = FakeQuantAbsMax(quant_bits=weight_bits)
+        else:
+            self._wfq = FakeQuantChannelWiseAbsMax(quant_bits=weight_bits,
+                                                   quant_axis=1)
+        self._afq = FakeQuantMovingAverageAbsMax(moving_rate=moving_rate,
+                                                quant_bits=activation_bits)
+
+    def forward(self, x):
+        from paddle_tpu.nn import functional as F
+        w = self._wfq(self._layer.weight)
+        return F.linear(self._afq(x), w, self._layer.bias)
+
+
+class QuantizedConv2D(Layer):
+    """QAT conv2d with fake-quantized weight + activation (reference
+    quant_layers.py QuantizedConv2D)."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, **kw):
+        super().__init__()
+        self._layer = layer
+        self._wfq = FakeQuantChannelWiseAbsMax(quant_bits=weight_bits,
+                                               quant_axis=0)
+        self._afq = FakeQuantMovingAverageAbsMax(moving_rate=moving_rate,
+                                                quant_bits=activation_bits)
+
+    def forward(self, x):
+        from paddle_tpu.nn import functional as F
+        lay = self._layer
+        w = self._wfq(lay.weight)
+        return F.conv2d(self._afq(x), w, lay.bias,
+                        stride=lay._stride, padding=lay._padding,
+                        dilation=lay._dilation, groups=lay._groups,
+                        data_format=lay._data_format)
+
+
+class _QuantizedParallelLinear(QuantizedLinear):
+    """QAT wrapper over fleet Column/RowParallelLinear: the wrapped
+    layer's OWN forward runs (its _constrain sharding annotations,
+    gather_output / input_is_parallel semantics and the tp psum must
+    survive quantization) with the weight temporarily swapped for its
+    fake-quantized view."""
+
+    def forward(self, x):
+        lay = self._layer
+        w_float = lay.weight._value
+        wq = self._wfq(lay.weight)
+        try:
+            lay.weight._value = wq._value
+            return lay(self._afq(x))
+        finally:
+            lay.weight._value = w_float
+
+
+class QuantizedColumnParallelLinear(_QuantizedParallelLinear):
+    pass
+
+
+class QuantizedRowParallelLinear(_QuantizedParallelLinear):
+    pass

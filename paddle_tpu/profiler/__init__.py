@@ -289,3 +289,306 @@ class Benchmark:
 
 
 benchmark = Benchmark
+
+
+# ---------------------------------------------------- statistic helpers
+# (reference profiler/statistic_helper.py — interval algebra over
+# [(start, end)] event ranges, used by the summary tables)
+def merge_ranges(range_list1, range_list2, is_sorted=False):
+    """Union of two interval lists (overlaps coalesced)."""
+    ranges = list(range_list1 or []) + list(range_list2 or [])
+    return merge_self_ranges(ranges)
+
+
+def merge_self_ranges(src_ranges, is_sorted=False):
+    if not src_ranges:
+        return []
+    rs = sorted(src_ranges)
+    out = [list(rs[0])]
+    for s, e in rs[1:]:
+        if s <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], e)
+        else:
+            out.append([s, e])
+    return [(s, e) for s, e in out]
+
+
+def intersection_ranges(range_list1, range_list2, is_sorted=False):
+    a = merge_self_ranges(range_list1)
+    b = merge_self_ranges(range_list2)
+    out, i, j = [], 0, 0
+    while i < len(a) and j < len(b):
+        s = max(a[i][0], b[j][0])
+        e = min(a[i][1], b[j][1])
+        if s < e:
+            out.append((s, e))
+        if a[i][1] < b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return out
+
+
+def subtract_ranges(range_list1, range_list2, is_sorted=False):
+    a = merge_self_ranges(range_list1)
+    b = merge_self_ranges(range_list2)
+    out = []
+    j = 0
+    for s, e in a:
+        cur = s
+        while j < len(b) and b[j][1] <= cur:
+            j += 1
+        k = j
+        while k < len(b) and b[k][0] < e:
+            if b[k][0] > cur:
+                out.append((cur, b[k][0]))
+            cur = max(cur, b[k][1])
+            k += 1
+        if cur < e:
+            out.append((cur, e))
+    return out
+
+
+def sum_ranges(ranges):
+    return sum(e - s for s, e in (ranges or []))
+
+
+class Event:
+    """One timeline event (reference profiler_statistic Event shape)."""
+
+    def __init__(self, name, type=None, start_ns=0, end_ns=0):
+        self.name = name
+        self.type = type
+        self.start_ns = start_ns
+        self.end_ns = end_ns
+
+    @property
+    def duration_ns(self):
+        return self.end_ns - self.start_ns
+
+
+class HostStatisticNode:
+    """Tree node over host events: self/children time accounting."""
+
+    def __init__(self, hostnode):
+        self.hostnode = hostnode
+        self.children_node = []
+        self.runtime_node = []
+        self.cpu_time = 0
+        self.self_cpu_time = 0
+
+    def cal_statistic(self):
+        for child in self.children_node:
+            child.cal_statistic()
+        self.cpu_time = self.hostnode.end_ns - self.hostnode.start_ns
+        self.self_cpu_time = self.cpu_time - sum(
+            c.cpu_time for c in self.children_node)
+
+
+def traverse_tree(nodetrees):
+    """Flatten {root: children-tree} into per-thread node lists."""
+    out = {}
+    for thread_id, root in (nodetrees or {}).items():
+        stack = [root]
+        flat = []
+        while stack:
+            node = stack.pop()
+            flat.append(node)
+            stack.extend(getattr(node, "children_node", []))
+        out[thread_id] = flat
+    return out
+
+
+def get_device_nodes(hostnode):
+    """All device-side nodes launched under a host node."""
+    out = []
+    stack = [hostnode]
+    while stack:
+        node = stack.pop()
+        for rt in getattr(node, "runtime_node", []):
+            out.extend(getattr(rt, "device_node", []))
+        stack.extend(getattr(node, "children_node", []))
+    return out
+
+
+class TimeRangeSummary:
+    """Per-event-type busy-time over the capture window."""
+
+    def __init__(self):
+        self.CPUTimeRange = {}
+        self.GPUTimeRange = {}
+        self.call_times = {}
+
+    def add_range(self, kind, start_ns, end_ns, device=False):
+        table = self.GPUTimeRange if device else self.CPUTimeRange
+        table.setdefault(kind, []).append((start_ns, end_ns))
+        self.call_times[kind] = self.call_times.get(kind, 0) + 1
+
+    def get_cpu_range_sum(self, kind):
+        return sum_ranges(merge_self_ranges(self.CPUTimeRange.get(kind)))
+
+    def get_gpu_range_sum(self, kind):
+        return sum_ranges(merge_self_ranges(self.GPUTimeRange.get(kind)))
+
+
+class EventSummary:
+    """Per-name aggregate: count/total/avg/min/max."""
+
+    class Item:
+        def __init__(self, name):
+            self.name = name
+            self.call = 0
+            self.total_time = 0.0
+            self.max_time = float("-inf")
+            self.min_time = float("inf")
+
+        @property
+        def avg_time(self):
+            return self.total_time / self.call if self.call else 0.0
+
+        def add_item(self, duration):
+            self.call += 1
+            self.total_time += duration
+            self.max_time = max(self.max_time, duration)
+            self.min_time = min(self.min_time, duration)
+
+    def __init__(self):
+        self.items = {}
+
+    def add_item(self, name, duration):
+        self.items.setdefault(name, self.Item(name)).add_item(duration)
+
+
+class MemorySummary:
+    def __init__(self):
+        self.allocated_items = {}
+        self.reserved_items = {}
+        self.peak_allocation_values = {}
+        self.peak_reserved_values = {}
+
+
+class DistributedSummary:
+    def __init__(self):
+        self.cpu_communication_range = []
+        self.gpu_communication_range = []
+        self.communication_range = []
+        self.computation_range = []
+        self.overlap_range = []
+
+    def cal_overlap(self):
+        self.communication_range = merge_ranges(
+            self.cpu_communication_range, self.gpu_communication_range)
+        self.overlap_range = intersection_ranges(
+            self.communication_range, self.computation_range)
+
+
+class StatisticData:
+    """Bundle the summaries for report rendering (reference
+    profiler_statistic.StatisticData)."""
+
+    def __init__(self, node_trees=None, extra_info=None):
+        self.node_trees = node_trees or {}
+        self.extra_info = extra_info or {}
+        self.time_range_summary = TimeRangeSummary()
+        self.event_summary = EventSummary()
+        self.distributed_summary = DistributedSummary()
+        self.memory_summary = MemorySummary()
+
+
+class TimeAverager:
+    """Rolling step-time/ips averager (reference utils TimeAverager)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._total_time = 0.0
+        self._total_samples = 0
+        self._cnt = 0
+
+    def record(self, usetime, num_samples=None):
+        self._total_time += usetime
+        self._cnt += 1
+        if num_samples:
+            self._total_samples += num_samples
+
+    def get_average(self):
+        return self._total_time / self._cnt if self._cnt else 0.0
+
+    def get_ips_average(self):
+        return self._total_samples / self._total_time \
+            if self._total_time else 0.0
+
+
+class Hook:
+    def begin(self, benchmark=None):
+        pass
+
+    def end(self, benchmark=None):
+        pass
+
+    def before_reader(self, benchmark=None):
+        pass
+
+    def after_reader(self, benchmark=None):
+        pass
+
+    def after_step(self, benchmark=None):
+        pass
+
+
+class TimerHook(Hook):
+    """Benchmark hook timing reader/step segments."""
+
+    def __init__(self):
+        self.reader_avg = TimeAverager()
+        self.batch_avg = TimeAverager()
+        self._reader_t0 = None
+        self._step_t0 = None
+
+    def before_reader(self, benchmark=None):
+        self._reader_t0 = time.perf_counter()
+
+    def after_reader(self, benchmark=None):
+        if self._reader_t0 is not None:
+            self.reader_avg.record(time.perf_counter() - self._reader_t0)
+
+    def after_step(self, benchmark=None):
+        if self._step_t0 is not None:
+            self.batch_avg.record(time.perf_counter() - self._step_t0)
+        self._step_t0 = time.perf_counter()
+
+
+class Stack:
+    """Simple LIFO used by the statistic tree walkers."""
+
+    def __init__(self):
+        self._items = []
+
+    def push(self, item):
+        self._items.append(item)
+
+    def pop(self):
+        return self._items.pop()
+
+    def empty(self):
+        return not self._items
+
+    def top(self):
+        return self._items[-1]
+
+
+def wrap_tree(nodetrees):
+    """Wrap raw host nodes into HostStatisticNode trees and compute
+    self-times."""
+    out = {}
+    for tid, root in (nodetrees or {}).items():
+        def build(n):
+            w = HostStatisticNode(n)
+            for c in getattr(n, "children_node", []):
+                w.children_node.append(build(c))
+            return w
+        wrapped = build(root)
+        wrapped.cal_statistic()
+        out[tid] = wrapped
+    return out

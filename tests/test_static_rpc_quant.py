@@ -239,3 +239,108 @@ class TestProfilerStats:
             b.step(num_samples=4)
         rep = b.report(warmup=1)
         assert rep["steps"] == 2 and rep["ips"] > 0
+
+
+class TestLSQAndQuantizedLayers:
+    def test_lsq_roundtrip_and_scale_gradient(self):
+        import jax
+        from paddle_tpu.nn.quant import LsqFunc
+        x = P.to_tensor(np.linspace(-0.9, 0.9, 9).astype(np.float32))
+        x.stop_gradient = False
+        s = P.to_tensor(np.array([1.0 / 127], np.float32))
+        s.stop_gradient = False
+        y = LsqFunc(x, s)
+        assert np.abs(y.numpy() - x.numpy()).max() <= 1.0 / 127
+        y.sum().backward()
+        assert x.grad is not None and s.grad is not None
+        assert np.isfinite(float(s.grad.numpy()[0]))
+
+    def test_weight_lsq_plus_learns_scale(self):
+        from paddle_tpu.nn.quant import FakeQuantWeightLSQPlus
+        fq = FakeQuantWeightLSQPlus(quant_bits=8)
+        w = P.to_tensor(np.random.RandomState(0).randn(8, 8)
+                        .astype(np.float32))
+        out = fq(w)
+        assert float(fq.init_state._value[0]) == 1.0
+        assert float(fq.scale._value[0]) > 0
+        assert np.abs(out.numpy() - w.numpy()).max() < 0.2
+
+    def test_quantized_linear_conv_close_to_float(self):
+        P.seed(0)
+        from paddle_tpu.nn.quant import QuantizedConv2D, QuantizedLinear
+        lin = P.nn.Linear(8, 4)
+        qlin = QuantizedLinear(lin, moving_rate=0.1)
+        x = P.to_tensor(np.random.RandomState(1).randn(2, 8)
+                        .astype(np.float32))
+        qlin.train()
+        for _ in range(8):  # warm the act scale EMA
+            q = qlin(x)
+        rel = np.abs(q.numpy() - lin(x).numpy()).max() / (
+            np.abs(lin(x).numpy()).max() + 1e-6)
+        assert rel < 0.2, rel
+
+        conv = P.nn.Conv2D(3, 4, 3, padding=1)
+        qconv = QuantizedConv2D(conv, moving_rate=0.1)
+        img = P.to_tensor(np.random.RandomState(2).randn(1, 3, 6, 6)
+                          .astype(np.float32))
+        qconv.train()
+        for _ in range(3):
+            qc = qconv(img)
+        rel = np.abs(qc.numpy() - conv(img).numpy()).max() / (
+            np.abs(conv(img).numpy()).max() + 1e-6)
+        assert rel < 0.25, rel
+
+    def test_observe_only_scale(self):
+        from paddle_tpu.nn.quant import MovingAverageAbsMaxScale
+        obs = MovingAverageAbsMaxScale(moving_rate=0.5)
+        obs.train()
+        x = P.to_tensor(np.array([4.0], np.float32))
+        out = obs(x)
+        np.testing.assert_allclose(out.numpy(), x.numpy())  # identity
+        assert float(obs.scale._value[0]) != 1.0
+
+
+class TestProfilerStatistics:
+    def test_range_algebra(self):
+        pr = P.profiler
+        assert pr.merge_self_ranges([(5, 9), (1, 3), (2, 4)]) == \
+            [(1, 4), (5, 9)]
+        assert pr.merge_ranges([(0, 2)], [(1, 5)]) == [(0, 5)]
+        assert pr.intersection_ranges([(0, 10)], [(3, 5), (8, 12)]) == \
+            [(3, 5), (8, 10)]
+        assert pr.subtract_ranges([(0, 10)], [(3, 5)]) == [(0, 3), (5, 10)]
+        assert pr.sum_ranges([(0, 2), (5, 6)]) == 3
+
+    def test_summaries_and_averager(self):
+        pr = P.profiler
+        es = pr.EventSummary()
+        es.add_item("matmul", 2.0)
+        es.add_item("matmul", 4.0)
+        item = es.items["matmul"]
+        assert (item.call, item.avg_time, item.min_time, item.max_time) \
+            == (2, 3.0, 2.0, 4.0)
+        ds = pr.DistributedSummary()
+        ds.cpu_communication_range = [(0, 4)]
+        ds.computation_range = [(2, 6)]
+        ds.cal_overlap()
+        assert ds.overlap_range == [(2, 4)]
+        ta = pr.TimeAverager()
+        ta.record(0.1, 32)
+        ta.record(0.3, 32)
+        assert abs(ta.get_ips_average() - 64 / 0.4) < 1e-6
+        trs = pr.TimeRangeSummary()
+        trs.add_range("Kernel", 0, 5)
+        trs.add_range("Kernel", 3, 8)
+        assert trs.get_cpu_range_sum("Kernel") == 8
+        assert trs.call_times["Kernel"] == 2
+
+    def test_tree_wrapping(self):
+        pr = P.profiler
+        child = pr.Event("child", start_ns=1, end_ns=3)
+        child.children_node = []
+        root = pr.Event("root", start_ns=0, end_ns=10)
+        root.children_node = [child]
+        wrapped = pr.wrap_tree({0: root})[0]
+        assert wrapped.cpu_time == 10 and wrapped.self_cpu_time == 8
+        flat = pr.traverse_tree({0: wrapped})
+        assert len(flat[0]) == 2
