@@ -1,1 +1,16 @@
 from . import moe_utils  # noqa: F401
+from .launch_utils import (  # noqa: F401
+    Cluster,
+    Hdfs,
+    JobServer,
+    Pod,
+    Trainer,
+    TrainerProc,
+    add_arguments,
+    find_free_ports,
+    get_cluster,
+    get_cluster_from_args,
+    get_host_name_ip,
+    get_logger,
+    terminate_local_procs,
+)

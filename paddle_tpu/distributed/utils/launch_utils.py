@@ -1,0 +1,255 @@
+"""Launch topology dataclasses + process helpers (reference:
+python/paddle/distributed/utils/launch_utils.py — Hdfs :102,
+Cluster :131, JobServer :197, Trainer :211, Pod :242, get_cluster :305,
+terminate_local_procs :332, add_arguments :368, find_free_ports :386,
+TrainerProc :457).
+
+These model the multi-host job layout that paddle_tpu.distributed.launch
+drives; "gpus" become TPU-chip ordinals, everything else carries over.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import time
+
+__all__ = ["Hdfs", "Cluster", "JobServer", "Trainer", "Pod", "TrainerProc",
+           "get_cluster", "get_cluster_from_args", "terminate_local_procs",
+           "get_host_name_ip", "add_arguments", "find_free_ports",
+           "get_logger"]
+
+
+class Hdfs:
+    def __init__(self):
+        self.hdfs_ugi = None
+        self.hdfs_name = None
+        self.hdfs_path = None
+
+    def is_valid(self):
+        return bool(self.hdfs_ugi and self.hdfs_name and self.hdfs_path)
+
+    def __eq__(self, other):
+        return (self.hdfs_ugi == other.hdfs_ugi
+                and self.hdfs_name == other.hdfs_name
+                and self.hdfs_path == other.hdfs_path)
+
+    def __ne__(self, other):
+        return not self == other
+
+    def __str__(self):
+        return f"hdfs_ugi:{self.hdfs_ugi} hdfs_name:{self.hdfs_name} " \
+               f"hdfs_path:{self.hdfs_path}"
+
+
+class JobServer:
+    def __init__(self):
+        self.endpoint = None
+
+    def __str__(self):
+        return f"{self.endpoint}"
+
+    def __eq__(self, other):
+        return self.endpoint == other.endpoint
+
+    def __ne__(self, other):
+        return not self == other
+
+
+class Trainer:
+    def __init__(self):
+        self.gpus = []      # chip ordinals on this pod
+        self.endpoint = None
+        self.rank = None
+
+    def __str__(self):
+        return f"gpu:{self.gpus} endpoint:{self.endpoint} rank:{self.rank}"
+
+    def __eq__(self, other):
+        return (self.gpus == other.gpus and self.endpoint == other.endpoint
+                and self.rank == other.rank)
+
+    def __ne__(self, other):
+        return not self == other
+
+    def rank_str(self):
+        return str(self.rank)
+
+
+class Pod:
+    def __init__(self):
+        self.rank = None
+        self.id = None
+        self.addr = None
+        self.port = None
+        self.trainers = []
+        self.gpus = []
+
+    def __str__(self):
+        return (f"rank:{self.rank} id:{self.id} addr:{self.addr} "
+                f"port:{self.port} visible_gpu:{self.gpus} "
+                f"trainers:{[str(t) for t in self.trainers]}")
+
+    def __eq__(self, other):
+        if (self.rank != other.rank or self.id != other.id
+                or self.addr != other.addr or self.port != other.port
+                or len(self.trainers) != len(other.trainers)):
+            return False
+        return all(a == b for a, b in zip(self.trainers, other.trainers))
+
+    def __ne__(self, other):
+        return not self == other
+
+    def rank_str(self):
+        return str(self.rank)
+
+    def get_visible_gpus(self):
+        return ",".join(str(g) for g in self.gpus)
+
+
+class Cluster:
+    def __init__(self, hdfs=None):
+        self.job_server = None
+        self.pods = []
+        self.hdfs = hdfs
+        self.job_stage_flag = None
+
+    def __str__(self):
+        return (f"job_server:{self.job_server} "
+                f"pods:{[str(p) for p in self.pods]} "
+                f"job_stage_flag:{self.job_stage_flag} hdfs:{self.hdfs}")
+
+    def __eq__(self, other):
+        if len(self.pods) != len(other.pods):
+            return False
+        return all(a == b for a, b in zip(self.pods, other.pods))
+
+    def __ne__(self, other):
+        return not self == other
+
+    def update_pods(self, cluster):
+        self.pods = list(cluster.pods)
+
+    def trainers_nranks(self):
+        return len(self.trainers_endpoints())
+
+    def pods_nranks(self):
+        return len(self.pods)
+
+    def trainers_endpoints(self):
+        return [t.endpoint for p in self.pods for t in p.trainers]
+
+    def pods_endpoints(self):
+        return [f"{p.addr}:{p.port}" for p in self.pods]
+
+    def pod(self, rank):
+        for p in self.pods:
+            if p.rank == rank:
+                return p
+        return None
+
+
+class TrainerProc:
+    def __init__(self):
+        self.proc = None
+        self.log_fn = None
+        self.log_offset = None
+        self.rank = None
+        self.local_rank = None
+        self.cmd = None
+
+
+def get_cluster(node_ips, node_ip, trainer_endpoints, selected_gpus):
+    """Build the Cluster/Pod/Trainer topology (reference :305)."""
+    assert isinstance(trainer_endpoints, list)
+    cluster = Cluster(hdfs=None)
+    trainer_rank = 0
+    for node_rank, ip in enumerate(node_ips):
+        pod = Pod()
+        pod.rank = node_rank
+        pod.addr = ip
+        pod.id = node_rank
+        cur_eps = trainer_endpoints[node_rank]
+        for i in range(len(selected_gpus)):
+            trainer = Trainer()
+            trainer.gpus.append(selected_gpus[i])
+            trainer.endpoint = cur_eps[i]
+            trainer.rank = trainer_rank
+            trainer_rank += 1
+            pod.trainers.append(trainer)
+        cluster.pods.append(pod)
+    pod_rank = node_ips.index(node_ip)
+    return cluster, cluster.pods[pod_rank]
+
+
+def get_cluster_from_args(args, selected_gpus):
+    node_ips = [ip.strip() for ip in args.cluster_node_ips.split(",")]
+    node_ip = args.node_ip
+    started_port = getattr(args, "started_port", None)
+    # random free ports are only safe when every node can SEE the choice
+    # — i.e. single-node with no explicit port (reference semantics);
+    # multi-node must agree on started_port arithmetic
+    if len(node_ips) == 1 and started_port is None:
+        ports = sorted(find_free_ports(len(selected_gpus)))
+    else:
+        base = started_port if started_port is not None else 6170
+        ports = list(range(base, base + len(selected_gpus)))
+    eps = [[f"{ip}:{p}" for p in ports] for ip in node_ips]
+    return get_cluster(node_ips, node_ip, eps, selected_gpus)
+
+
+def terminate_local_procs(procs):
+    """SIGTERM then SIGKILL stragglers (reference :332)."""
+    for p in procs:
+        if p.proc is not None and p.proc.poll() is None:
+            p.proc.terminate()
+            if p.log_fn:
+                try:
+                    p.log_fn.close()
+                except OSError:
+                    pass
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if all(p.proc is None or p.proc.poll() is not None for p in procs):
+            return
+        time.sleep(0.2)
+    for p in procs:
+        if p.proc is not None and p.proc.poll() is None:
+            try:
+                os.kill(p.proc.pid, signal.SIGKILL)
+            except OSError:
+                pass
+
+
+def get_host_name_ip():
+    try:
+        host = socket.gethostname()
+        return host, socket.gethostbyname(host)
+    except OSError:
+        return None
+
+
+def add_arguments(argname, type, default, help, argparser, **kwargs):
+    """argparse helper preserving the reference's call shape."""
+    argparser.add_argument(
+        "--" + argname, default=default, type=type,
+        help=help + f" Default: %(default)s.", **kwargs)
+
+
+def find_free_ports(num):
+    ports, socks = set(), []
+    while len(ports) < num:
+        s = socket.socket()
+        s.bind(("", 0))
+        socks.append(s)
+        ports.add(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def get_logger(log_level=20, name="root"):
+    import logging
+    logger = logging.getLogger(name)
+    logger.setLevel(log_level)
+    return logger

@@ -141,19 +141,14 @@ def _lsq(x, scale, qn, qp, grad_scale):
         q = jnp.clip(jnp.round(r), qn, qp)
         ds_elem = jnp.where(inside, q - r, q)
         full = ct * ds_elem * grad_scale
-        # reduce to the scale's shape (per-tensor OR per-channel): sum
-        # over every axis the scale broadcasts across
+        # reduce to the scale's shape (per-tensor OR per-channel): with
+        # the scale right-aligned against the input (numpy broadcasting),
+        # sum exactly the axes the scale broadcasts across
         s_shape = jnp.shape(s)
-        lead = full.ndim - len(s_shape)
-        axes = tuple(range(lead)) + tuple(
-            lead + i for i, d in enumerate(s_shape)
-            if d == 1 and full.shape[lead + i] != 1)
-        ds = full.sum(axis=axes, keepdims=False)
-        if lead and ds.ndim != len(s_shape):
-            ds = ds.reshape(s_shape)
-        elif axes and ds.ndim != len(s_shape):
-            ds = ds.reshape(s_shape)
-        return dv, ds.reshape(s_shape)
+        aligned = (1,) * (full.ndim - len(s_shape)) + tuple(s_shape)
+        axes = tuple(i for i in range(full.ndim)
+                     if aligned[i] == 1 and full.shape[i] != 1)
+        return dv, full.sum(axis=axes).reshape(s_shape)
 
     op.defvjp(fwd, bwd)
     return op(x, scale)
@@ -184,7 +179,11 @@ class FakeQuantWeightLSQPlus(Layer):
         super().__init__()
         self.bits = quant_bits
         self.all_positive = all_positive
-        self.scale = self.create_parameter([1])
+        self.per_channel = per_channel
+        if per_channel and not channel_num:
+            raise ValueError("per_channel=True needs channel_num")
+        self.scale = self.create_parameter(
+            [channel_num] if per_channel else [1])
         # init-state rides in state_dict (a plain python flag would make
         # the first forward after set_state_dict clobber a restored
         # trained scale with fresh weight statistics)
@@ -196,15 +195,23 @@ class FakeQuantWeightLSQPlus(Layer):
         if float(self.init_state._value[0]) == 0.0:
             qp = (2 ** self.bits - 1) if self.all_positive \
                 else (2 ** (self.bits - 1) - 1)
-            init = 2.0 * float(np.abs(np.asarray(w._value)).mean()) \
-                / np.sqrt(qp) or 1e-3
-            self.scale._set_value(jnp.asarray([init], jnp.float32))
+            wv = np.asarray(w._value)
+            if self.per_channel:
+                # per-LAST-axis channel statistics (scale right-aligns)
+                axes = tuple(range(wv.ndim - 1))
+                init = 2.0 * np.abs(wv).mean(axis=axes) / np.sqrt(qp)
+                init = np.maximum(init, 1e-3).astype(np.float32)
+                self.scale._set_value(jnp.asarray(init))
+            else:
+                init = 2.0 * float(np.abs(wv).mean()) / np.sqrt(qp) or 1e-3
+                self.scale._set_value(jnp.asarray([init], jnp.float32))
             self.init_state._set_value(jnp.ones((1,), jnp.float32))
         qp_g = (2 ** self.bits - 1) if self.all_positive \
             else (2 ** (self.bits - 1) - 1)
         g = 1.0 / np.sqrt(np.prod(w.shape) * qp_g) if w.shape else 1.0
         return LsqFunc(w, self.scale, lsq_factor=float(g), bits=self.bits,
-                       all_positive=self.all_positive)
+                       all_positive=self.all_positive,
+                       per_channel=self.per_channel)
 
 
 class FakeQuantActLSQPlus(FakeQuantWeightLSQPlus):
@@ -240,8 +247,11 @@ class QuantizedLinear(Layer):
         else:
             self._wfq = FakeQuantChannelWiseAbsMax(quant_bits=weight_bits,
                                                    quant_axis=1)
-        self._afq = FakeQuantMovingAverageAbsMax(moving_rate=moving_rate,
-                                                quant_bits=activation_bits)
+        if activation_quantize_type == "abs_max":
+            self._afq = FakeQuantAbsMax(quant_bits=activation_bits)
+        else:
+            self._afq = FakeQuantMovingAverageAbsMax(
+                moving_rate=moving_rate, quant_bits=activation_bits)
 
     def forward(self, x):
         from paddle_tpu.nn import functional as F
@@ -254,13 +264,20 @@ class QuantizedConv2D(Layer):
     quant_layers.py QuantizedConv2D)."""
 
     def __init__(self, layer, weight_bits=8, activation_bits=8,
-                 moving_rate=0.9, **kw):
+                 moving_rate=0.9, weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max", **kw):
         super().__init__()
         self._layer = layer
-        self._wfq = FakeQuantChannelWiseAbsMax(quant_bits=weight_bits,
-                                               quant_axis=0)
-        self._afq = FakeQuantMovingAverageAbsMax(moving_rate=moving_rate,
-                                                quant_bits=activation_bits)
+        if weight_quantize_type == "abs_max":
+            self._wfq = FakeQuantAbsMax(quant_bits=weight_bits)
+        else:
+            self._wfq = FakeQuantChannelWiseAbsMax(quant_bits=weight_bits,
+                                                   quant_axis=0)
+        if activation_quantize_type == "abs_max":
+            self._afq = FakeQuantAbsMax(quant_bits=activation_bits)
+        else:
+            self._afq = FakeQuantMovingAverageAbsMax(
+                moving_rate=moving_rate, quant_bits=activation_bits)
 
     def forward(self, x):
         from paddle_tpu.nn import functional as F

@@ -243,3 +243,32 @@ class TestFleetUtilsAndDatasets:
         ds._shard(2, 1)  # worker 1 of 2 -> files 1, 3
         vals = [float(b[0, 0]) for b in ds]
         assert vals == [1.0, 3.0]
+
+
+class TestLaunchUtils:
+    def test_cluster_topology(self):
+        from paddle_tpu.distributed.utils import get_cluster
+        eps = [[f"10.0.0.{n}:{6170 + i}" for i in range(4)]
+               for n in range(2)]
+        cluster, pod = get_cluster(["10.0.0.0", "10.0.0.1"], "10.0.0.1",
+                                   eps, [0, 1, 2, 3])
+        assert cluster.trainers_nranks() == 8
+        assert cluster.pods_nranks() == 2
+        assert pod.rank == 1
+        assert pod.trainers[0].rank == 4
+        assert cluster.pod(0).get_visible_gpus() == ""
+        assert len(cluster.trainers_endpoints()) == 8
+        clone = get_cluster(["10.0.0.0", "10.0.0.1"], "10.0.0.0",
+                            eps, [0, 1, 2, 3])[0]
+        assert cluster == clone
+
+    def test_add_arguments_and_ports(self):
+        import argparse
+
+        from paddle_tpu.distributed.utils import (add_arguments,
+                                                  find_free_ports)
+        ap = argparse.ArgumentParser()
+        add_arguments("node_ip", str, "127.0.0.1", "ip", ap)
+        args = ap.parse_args([])
+        assert args.node_ip == "127.0.0.1"
+        assert len(find_free_ports(3)) == 3
