@@ -305,34 +305,17 @@ _Fleet.util = util
 
 
 def get_logger(name="FLEET", level=None, fmt=None):
-    import logging
-    logger = logging.getLogger(name)
-    if level is not None:
-        logger.setLevel(level)
-    return logger
+    from paddle_tpu.distributed.utils.launch_utils import (
+        get_logger as _gl,
+    )
+    return _gl(log_level=level, name=name)
 
 
-def find_free_ports(num):
-    """num free localhost TCP ports (reference launch utils)."""
-    import socket
-    ports, socks = set(), []
-    while len(ports) < num:
-        s = socket.socket()
-        s.bind(("", 0))
-        socks.append(s)
-        ports.add(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    return ports
-
-
-def get_host_name_ip():
-    import socket
-    try:
-        host = socket.gethostname()
-        return host, socket.gethostbyname(host)
-    except OSError:
-        return None
+# single canonical implementation lives in distributed.utils.launch_utils
+from paddle_tpu.distributed.utils.launch_utils import (  # noqa: E402,F401
+    find_free_ports,
+    get_host_name_ip,
+)
 
 
 # reference layout parity: fleet.meta_parallel.sharding is a subpackage;

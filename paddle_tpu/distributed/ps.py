@@ -67,6 +67,7 @@ class SparseTable:
         self.pull_count = 0
         self.push_count = 0
         self._anchor = None
+        self._native = None  # lazily probed (see _use_native)
 
     @property
     def anchor(self):
@@ -95,11 +96,26 @@ class SparseTable:
         return self._pull_impl(ids)
 
     def _pull_impl(self, ids):
+        if self._use_native():
+            from paddle_tpu import native
+            self.pull_count += 1
+            return native.pstable_pull(self._data, ids, self.row_offset)
         loc, ok = self._local(ids)
         rows = self._data[np.clip(loc, 0, self.local_rows - 1)]
         rows[~ok] = 0
         self.pull_count += 1
         return rows.reshape(ids.shape + (self.dim,))
+
+    def _use_native(self):
+        """Native C++ kernels (GIL-free, multithreaded pull) when the
+        toolchain is up AND the table layout matches (fp32 contiguous)."""
+        if self._native is None:
+            from paddle_tpu import native
+            self._native = bool(
+                native.pstable_available()
+                and self.dtype == np.float32
+                and self._data.flags["C_CONTIGUOUS"])
+        return self._native
 
     def prefetch(self, ids):
         """Start an async host-side gather for a future pull of exactly
@@ -121,10 +137,18 @@ class SparseTable:
         within the batch are summed, like the PS's sparse merge)."""
         ids = np.asarray(ids)
         loc, ok = self._local(ids)
+        if not ok.any():
+            return  # nothing lands in this shard: counters untouched
+        self.push_count += 1
+        if self._use_native():
+            from paddle_tpu import native
+            with self._lock:
+                native.pstable_push(
+                    self._data, getattr(self, "_acc", None), ids, grads,
+                    self.row_offset, self._lr, self._eps, self._opt)
+            return
         g = np.asarray(grads, np.float32).reshape(-1, self.dim)[ok]
         loc = loc[ok]
-        if loc.size == 0:
-            return
         uniq, inv = np.unique(loc, return_inverse=True)
         merged = np.zeros((uniq.size, self.dim), np.float32)
         np.add.at(merged, inv, g)
@@ -135,7 +159,6 @@ class SparseTable:
             else:
                 step = merged
             self._data[uniq] -= (self._lr * step).astype(self.dtype)
-        self.push_count += 1
 
     def rows(self, ids):
         """Debug/eval helper: current host values for global ids."""

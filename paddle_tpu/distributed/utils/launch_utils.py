@@ -248,8 +248,11 @@ def find_free_ports(num):
     return ports
 
 
-def get_logger(log_level=20, name="root"):
+def get_logger(log_level=None, name="FLEET"):
     import logging
     logger = logging.getLogger(name)
-    logger.setLevel(log_level)
+    # never touch the ROOT logger's level implicitly — setLevel only on
+    # an explicit request, and never for the root logger by default
+    if log_level is not None:
+        logger.setLevel(log_level)
     return logger

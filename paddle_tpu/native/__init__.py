@@ -27,11 +27,18 @@ _lock = threading.Lock()
 _build_err = None
 
 
-def _build():
-    subprocess.run(
-        ["g++", "-O3", "-std=c++17", "-fPIC", "-pthread", "-shared",
-         "-o", _SO, os.path.join(_DIR, "ptdata.cc")],
-        check=True, capture_output=True)
+def _build_and_load(src_name, so_path):
+    """Shared build-or-load: (re)compile when the .so is missing/stale,
+    then dlopen. Raises on toolchain/load failure (callers decide the
+    fallback policy)."""
+    src = os.path.join(_DIR, src_name)
+    if not os.path.exists(so_path) or (
+            os.path.getmtime(so_path) < os.path.getmtime(src)):
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-fPIC", "-pthread", "-shared",
+             "-o", so_path, src],
+            check=True, capture_output=True)
+    return ctypes.CDLL(so_path)
 
 
 def _load():
@@ -40,11 +47,7 @@ def _load():
         if _lib is not None or _build_err is not None:
             return _lib
         try:
-            if not os.path.exists(_SO) or (
-                    os.path.getmtime(_SO) <
-                    os.path.getmtime(os.path.join(_DIR, "ptdata.cc"))):
-                _build()
-            lib = ctypes.CDLL(_SO)
+            lib = _build_and_load("ptdata.cc", _SO)
         except Exception as e:  # no toolchain / load failure -> Python path
             _build_err = e
             return None
@@ -244,3 +247,67 @@ class NativeLoader:
             self.close()
         except Exception:
             pass
+
+
+# ------------------------------------------------------- PS sparse table
+_PSTABLE_SO = os.path.join(_DIR, "libpstable.so")
+_pstable_lib = None
+_pstable_err = None
+
+
+def _pstable():
+    """Load (building on first use) the native PS table kernels; None
+    when no toolchain is available (callers fall back to numpy)."""
+    global _pstable_lib, _pstable_err
+    with _lock:
+        if _pstable_lib is not None or _pstable_err is not None:
+            return _pstable_lib
+        try:
+            lib = _build_and_load("pstable.cc", _PSTABLE_SO)
+            lib.pstable_pull.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_int]
+            lib.pstable_push.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_float,
+                ctypes.c_float, ctypes.c_int]
+            _pstable_lib = lib
+        except Exception as e:
+            _pstable_err = e
+            return None
+        return _pstable_lib
+
+
+def pstable_available():
+    return _pstable() is not None
+
+
+def pstable_pull(data, ids, row_offset, n_threads=4):
+    """data [R, D] float32 (C-contiguous), ids int64 any shape ->
+    [*ids.shape, D] float32 (zeros for out-of-shard rows)."""
+    lib = _pstable()
+    ids = np.ascontiguousarray(ids, np.int64)
+    flat = ids.reshape(-1)
+    out = np.empty((flat.size, data.shape[1]), np.float32)
+    lib.pstable_pull(
+        data.ctypes.data_as(ctypes.c_void_p), data.shape[0], data.shape[1],
+        flat.ctypes.data_as(ctypes.c_void_p), flat.size, row_offset,
+        out.ctypes.data_as(ctypes.c_void_p), n_threads)
+    return out.reshape(ids.shape + (data.shape[1],))
+
+
+def pstable_push(data, acc, ids, grads, row_offset, lr, eps, optimizer):
+    """In-place merged sparse update; optimizer 'sgd'|'adagrad'."""
+    lib = _pstable()
+    ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64)
+    grads = np.ascontiguousarray(
+        np.asarray(grads, np.float32).reshape(ids.size, data.shape[1]))
+    lib.pstable_push(
+        data.ctypes.data_as(ctypes.c_void_p),
+        acc.ctypes.data_as(ctypes.c_void_p) if acc is not None else None,
+        data.shape[0], data.shape[1],
+        ids.ctypes.data_as(ctypes.c_void_p), ids.size, row_offset,
+        grads.ctypes.data_as(ctypes.c_void_p), lr, eps,
+        1 if optimizer == "adagrad" else 0)

@@ -1,0 +1,91 @@
+// Native parameter-server sparse-table kernels.
+//
+// Reference parity: the reference PS runs its table ops in C++ brpc
+// services (paddle/fluid/distributed/ps/table/memory_sparse_table.cc);
+// here the same hot paths — row gather (pull) and merged sparse
+// optimizer update (push) — run natively and GIL-free under
+// jax.pure_callback / io_callback, multithreaded for the pull.
+//
+// Build: g++ -O3 -std=c++17 -fPIC -pthread -shared -o libpstable.so pstable.cc
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <cmath>
+#include <numeric>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// rows[i] = data[ids[i] - row_offset] when in-shard else 0
+// data: [local_rows, dim] float32; ids: [n] int64; out: [n, dim] float32
+void pstable_pull(const float* data, int64_t local_rows, int64_t dim,
+                  const int64_t* ids, int64_t n, int64_t row_offset,
+                  float* out, int n_threads) {
+  auto work = [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      int64_t loc = ids[i] - row_offset;
+      float* dst = out + i * dim;
+      if (loc >= 0 && loc < local_rows) {
+        std::memcpy(dst, data + loc * dim, sizeof(float) * dim);
+      } else {
+        std::memset(dst, 0, sizeof(float) * dim);
+      }
+    }
+  };
+  int nt = n_threads > 0 ? n_threads : 1;
+  if (nt == 1 || n < 1024) {
+    work(0, n);
+    return;
+  }
+  std::vector<std::thread> threads;
+  int64_t chunk = (n + nt - 1) / nt;
+  for (int t = 0; t < nt; ++t) {
+    int64_t lo = t * chunk, hi = std::min(n, lo + chunk);
+    if (lo < hi) threads.emplace_back(work, lo, hi);
+  }
+  for (auto& th : threads) th.join();
+}
+
+// Merged sparse update: duplicate ids inside the batch are summed FIRST
+// (the PS sparse-merge semantics — matters for adagrad, where the
+// accumulator update uses the merged gradient squared), then one
+// optimizer step per unique row.
+//   optimizer: 0 = sgd, 1 = adagrad (acc required)
+void pstable_push(float* data, float* acc, int64_t local_rows, int64_t dim,
+                  const int64_t* ids, int64_t n, int64_t row_offset,
+                  const float* grads, float lr, float eps, int optimizer) {
+  // sort positions by local row id to group duplicates
+  std::vector<int64_t> order(n);
+  std::iota(order.begin(), order.end(), 0);
+  std::sort(order.begin(), order.end(), [&](int64_t a, int64_t b) {
+    return ids[a] < ids[b];
+  });
+  std::vector<float> merged(dim);
+  int64_t i = 0;
+  while (i < n) {
+    int64_t row = ids[order[i]];
+    int64_t loc = row - row_offset;
+    std::fill(merged.begin(), merged.end(), 0.0f);
+    int64_t j = i;
+    for (; j < n && ids[order[j]] == row; ++j) {
+      const float* g = grads + order[j] * dim;
+      for (int64_t d = 0; d < dim; ++d) merged[d] += g[d];
+    }
+    if (loc >= 0 && loc < local_rows) {
+      float* w = data + loc * dim;
+      if (optimizer == 1) {
+        float* a = acc + loc * dim;
+        for (int64_t d = 0; d < dim; ++d) {
+          a[d] += merged[d] * merged[d];
+          w[d] -= lr * merged[d] / std::sqrt(a[d] + eps);
+        }
+      } else {
+        for (int64_t d = 0; d < dim; ++d) w[d] -= lr * merged[d];
+      }
+    }
+    i = j;
+  }
+}
+
+}  // extern "C"

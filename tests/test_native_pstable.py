@@ -1,0 +1,74 @@
+"""Native C++ PS sparse-table kernels: parity with the numpy path and
+engagement through the PSEmbedding training flow.
+
+Reference: paddle/fluid/distributed/ps/table/memory_sparse_table.cc (the
+reference PS's C++ table ops); paddle_tpu/native/pstable.cc here.
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu import native
+from paddle_tpu.distributed.ps import SparseTable
+
+pytestmark = pytest.mark.skipif(not native.pstable_available(),
+                                reason="no C++ toolchain")
+
+
+def _pair(opt, seed=3):
+    tn = SparseTable(1000, 16, optimizer=opt, seed=seed,
+                     row_shard=(100, 500))
+    tp = SparseTable(1000, 16, optimizer=opt, seed=seed,
+                     row_shard=(100, 500))
+    tp._native = False
+    assert tn._use_native()
+    return tn, tp
+
+
+@pytest.mark.parametrize("opt", ["sgd", "adagrad"])
+def test_pull_push_parity_with_numpy_path(opt):
+    tn, tp = _pair(opt)
+    rng = np.random.default_rng(0)
+    for _ in range(5):
+        ids = rng.integers(0, 1000, (64,))
+        ids[:8] = ids[0]  # in-batch duplicates exercise the merge
+        g = rng.standard_normal((64, 16)).astype(np.float32)
+        np.testing.assert_allclose(tn.pull(ids), tp.pull(ids), atol=1e-6)
+        tn.push(ids, g)
+        tp.push(ids, g)
+    # fp32 merge-order noise only (C++ merges duplicates in sorted
+    # occurrence order, numpy via add.at)
+    np.testing.assert_allclose(tn._data, tp._data, rtol=1e-4, atol=1e-5)
+    if opt == "adagrad":
+        np.testing.assert_allclose(tn._acc, tp._acc, rtol=1e-4, atol=1e-5)
+
+
+def test_out_of_shard_rows_zero_and_untouched():
+    tn, _ = _pair("sgd")
+    before = tn._data.copy()
+    ids = np.array([0, 99, 600, 999])  # all outside [100, 600)
+    rows = tn.pull(ids)
+    np.testing.assert_allclose(rows, 0.0)
+    tn.push(ids, np.ones((4, 16), np.float32))
+    np.testing.assert_allclose(tn._data, before)  # nothing applied
+
+
+def test_multidim_ids_shape():
+    tn, _ = _pair("sgd")
+    ids = np.arange(100, 112).reshape(2, 3, 2)
+    rows = tn.pull(ids)
+    assert rows.shape == (2, 3, 2, 16)
+
+
+def test_ps_embedding_training_uses_native(monkeypatch):
+    import paddle_tpu as P
+    from paddle_tpu.distributed.ps import PSEmbedding
+    P.seed(0)
+    emb = PSEmbedding(256, 8, optimizer="adagrad", learning_rate=0.1)
+    assert emb.table._use_native()
+    ids = P.to_tensor(np.arange(16) % 7, dtype="int64")
+    before = emb.table.rows(np.arange(7)).copy()
+    out = emb(ids)
+    (out ** 2).mean().backward()
+    after = emb.table.rows(np.arange(7))
+    assert emb.table.push_count >= 1
+    assert not np.allclose(before, after)  # server-side update applied
