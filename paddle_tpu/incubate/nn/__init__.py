@@ -16,7 +16,9 @@ from paddle_tpu.nn import initializer as I
 from paddle_tpu.nn.layer.layers import Layer
 
 __all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
-           "FusedTransformerEncoderLayer"]
+           "FusedTransformerEncoderLayer",
+           "FusedBiasDropoutResidualLayerNorm", "FusedMultiTransformer",
+           "FusedTransformer"]
 
 
 class FusedMultiHeadAttention(Layer):
@@ -168,3 +170,123 @@ class FusedTransformerEncoderLayer(Layer):
                                          cache=cache)
         out = self.ffn(out)
         return out if cache is None else (out, cache)
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """out = LayerNorm(residual + dropout(x + bias)) in one fused pass
+    (reference incubate/nn/layer/fused_transformer.py
+    FusedBiasDropoutResidualLayerNorm)."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        assert embed_dim > 0
+        self._dropout_rate = dropout_rate
+        self._epsilon = epsilon
+        self.linear_bias = self.create_parameter(
+            shape=[embed_dim], attr=bias_attr,
+            default_initializer=I.Constant(0.0), is_bias=True)
+        self.ln_scale = self.create_parameter(
+            shape=[embed_dim], attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            shape=[embed_dim], default_initializer=I.Constant(0.0),
+            is_bias=True)
+
+    def forward(self, x, residual):
+        from paddle_tpu.incubate.nn.functional import (
+            fused_bias_dropout_residual_layer_norm,
+        )
+        return fused_bias_dropout_residual_layer_norm(
+            x, residual, self.linear_bias, self.ln_scale, self.ln_bias,
+            dropout_rate=self._dropout_rate, ln_epsilon=self._epsilon,
+            training=self.training)
+
+
+class FusedMultiTransformer(Layer):
+    """Fused stack of pre-LN decoder blocks (reference
+    incubate/nn/layer/fused_transformer.py FusedMultiTransformer — the
+    serving-path stack; per-layer weights live in lists and every block
+    runs the fused attention + feedforward kernels)."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 num_layers=-1, nranks=1, trans_qkvw=True, ring_id=-1,
+                 name=None, **unused):
+        super().__init__()
+        assert normalize_before, \
+            "FusedTransformerEncoderLayer only supports " \
+            "normalize_before=True here"
+        if num_layers <= 0:
+            # the reference's -1 means "infer depth from the per-layer
+            # weight lists"; this implementation owns its weights, so a
+            # silent 1-layer default would be a porting trap
+            raise ValueError(
+                "num_layers must be a positive int (the reference's "
+                "num_layers=-1 weight-list inference does not apply: "
+                "this class creates its own per-layer weights)")
+        from paddle_tpu.nn.layer.container import LayerList
+        self.layers = LayerList([
+            FusedTransformerEncoderLayer(
+                embed_dim, num_heads, dim_feedforward,
+                dropout_rate=dropout_rate, activation=activation,
+                normalize_before=True)
+            for _ in range(num_layers)])
+
+    def forward(self, src, attn_mask=None, caches=None, **kw):
+        out = src
+        if caches is None:
+            for layer in self.layers:
+                out = layer(out, src_mask=attn_mask)
+            return out
+        new_caches = []
+        for layer, cache in zip(self.layers, caches):
+            out, cache = layer(out, src_mask=attn_mask, cache=cache)
+            new_caches.append(cache)
+        return out, new_caches
+
+
+class FusedTransformer(Layer):
+    """Encoder-decoder built from the fused blocks (reference
+    fused_transformer.py FusedTransformer).  The decoder side reuses the
+    fused encoder blocks with causal masking — the fused kernels are the
+    same; cross-attention runs through the unfused functional path."""
+
+    def __init__(self, d_model=512, nhead=8, num_encoder_layers=6,
+                 num_decoder_layers=6, dim_feedforward=2048, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, custom_encoder=None,
+                 custom_decoder=None):
+        super().__init__()
+        from paddle_tpu import nn as _nn
+        from paddle_tpu.nn.layer.container import LayerList
+        # a provided custom_encoder is a single MODULE called once
+        # (reference API); the default is our fused per-layer stack
+        self._custom_encoder = custom_encoder is not None
+        if self._custom_encoder:
+            self.encoder = custom_encoder
+        else:
+            self.encoder = LayerList([
+                FusedTransformerEncoderLayer(
+                    d_model, nhead, dim_feedforward, dropout_rate=dropout,
+                    activation=activation,
+                    normalize_before=normalize_before)
+                for _ in range(num_encoder_layers)])
+        self.decoder = custom_decoder if custom_decoder is not None else \
+            _nn.TransformerDecoder(
+                _nn.TransformerDecoderLayer(
+                    d_model, nhead, dim_feedforward, dropout=dropout,
+                    activation=activation,
+                    normalize_before=normalize_before),
+                num_decoder_layers)
+
+    def forward(self, src, tgt, src_mask=None, tgt_mask=None,
+                memory_mask=None):
+        if self._custom_encoder:
+            memory = self.encoder(src, src_mask)
+        else:
+            memory = src
+            for layer in self.encoder:
+                memory = layer(memory, src_mask=src_mask)
+        return self.decoder(tgt, memory, tgt_mask=tgt_mask,
+                            memory_mask=memory_mask)

@@ -24,7 +24,7 @@ from paddle_tpu.framework.state import next_key
 from paddle_tpu.ops.pallas.norm import fused_layer_norm
 
 __all__ = ["fused_feedforward", "fused_multi_head_attention",
-           "fused_linear"]
+           "fused_linear", "fused_bias_dropout_residual_layer_norm"]
 
 
 def _v(x):
@@ -192,3 +192,41 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
                       _t(pre_ln_scale), _t(pre_ln_bias), _t(ln_scale),
                       _t(ln_bias), _t(qkv_bias), _t(linear_bias),
                       _t(cache_kv) if has_cache else None, _t(attn_mask))
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True, mode="upscale_in_train",
+        name=None):
+    """LayerNorm(residual + dropout(x + bias)) as ONE fused region
+    (reference incubate/nn/functional/fused_transformer.py
+    fused_bias_dropout_residual_layer_norm): the bias add, dropout and
+    residual add are elementwise epilogues XLA fuses into the layer-norm
+    reduction (the Pallas fused_layer_norm kernel on TPU)."""
+    use_dropout = training and dropout_rate > 0.0
+    key = next_key() if use_dropout else None
+
+    def fn(xv, rv, bv, sv, bbv, *rest):
+        h = xv if bv is None else xv + bv
+        if use_dropout:
+            keep = jax.random.bernoulli(
+                jax.random.wrap_key_data(rest[0]), 1.0 - dropout_rate,
+                h.shape)
+            if mode == "upscale_in_train":
+                h = jnp.where(keep, h / (1.0 - dropout_rate), 0.0)
+            else:
+                h = jnp.where(keep, h, 0.0)
+        elif mode == "downscale_in_infer" and dropout_rate > 0.0:
+            # eval-time scaling for the non-upscaled train mode, matching
+            # _dropout_val's convention
+            h = h * (1.0 - dropout_rate)
+        h = h + rv
+        d = h.shape[-1]
+        flat = h.reshape(-1, d)
+        out = fused_layer_norm(flat, sv, bbv, ln_epsilon)
+        return out.reshape(h.shape)
+
+    args = [_t(x), _t(residual), _t(bias), _t(ln_scale), _t(ln_bias)]
+    if use_dropout:
+        return apply(fn, *args, Tensor(jax.random.key_data(key)))
+    return apply(fn, *args)

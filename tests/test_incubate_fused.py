@@ -179,3 +179,62 @@ class TestFusedLayers:
         opt.step()
         assert all(p.grad is not None for p in layer.parameters()
                    if not p.stop_gradient)
+
+
+class TestFusedBiasDropoutResidualLN:
+    def test_matches_unfused_composition_eval(self):
+        from paddle_tpu.incubate.nn import FusedBiasDropoutResidualLayerNorm
+        paddle.seed(0)
+        layer = FusedBiasDropoutResidualLayerNorm(16, dropout_rate=0.0)
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.standard_normal((2, 5, 16))
+                             .astype(np.float32))
+        res = paddle.to_tensor(rng.standard_normal((2, 5, 16))
+                               .astype(np.float32))
+        layer.eval()
+        got = layer(x, res).numpy()
+        h = x.numpy() + layer.linear_bias.numpy() + res.numpy()
+        mu = h.mean(-1, keepdims=True)
+        var = h.var(-1, keepdims=True)
+        want = (h - mu) / np.sqrt(var + 1e-5) * layer.ln_scale.numpy() \
+            + layer.ln_bias.numpy()
+        np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+    def test_dropout_active_in_train(self):
+        from paddle_tpu.incubate.nn import FusedBiasDropoutResidualLayerNorm
+        paddle.seed(0)
+        layer = FusedBiasDropoutResidualLayerNorm(8, dropout_rate=0.5)
+        x = paddle.to_tensor(np.random.default_rng(1).standard_normal(
+            (1, 4, 8)).astype(np.float32))
+        res = paddle.zeros([1, 4, 8])
+        layer.train()
+        a = layer(x, res).numpy()
+        b = layer(x, res).numpy()
+        assert not np.allclose(a, b)
+
+
+class TestFusedStacks:
+    def test_multi_transformer_runs(self):
+        from paddle_tpu.incubate.nn import FusedMultiTransformer
+        paddle.seed(0)
+        stack = FusedMultiTransformer(16, 2, 32, dropout_rate=0.0,
+                                      num_layers=2)
+        x = paddle.to_tensor(np.random.default_rng(2).standard_normal(
+            (2, 6, 16)).astype(np.float32))
+        out = stack(x)
+        assert out.shape == [2, 6, 16]
+        assert np.isfinite(out.numpy()).all()
+
+    def test_fused_transformer_encoder_decoder(self):
+        from paddle_tpu.incubate.nn import FusedTransformer
+        paddle.seed(0)
+        model = FusedTransformer(d_model=16, nhead=2, num_encoder_layers=1,
+                                 num_decoder_layers=1, dim_feedforward=32,
+                                 dropout=0.0)
+        rng = np.random.default_rng(3)
+        src = paddle.to_tensor(rng.standard_normal((2, 5, 16))
+                               .astype(np.float32))
+        tgt = paddle.to_tensor(rng.standard_normal((2, 4, 16))
+                               .astype(np.float32))
+        out = model(src, tgt)
+        assert out.shape == [2, 4, 16]
