@@ -160,6 +160,14 @@ class SparseTable:
                 step = merged
             self._data[uniq] -= (self._lr * step).astype(self.dtype)
 
+    @property
+    def memory_bytes(self):
+        """Host-RAM footprint of this shard (table + optimizer state)."""
+        total = self._data.nbytes
+        if hasattr(self, "_acc"):
+            total += self._acc.nbytes
+        return total
+
     def rows(self, ids):
         """Debug/eval helper: current host values for global ids."""
         return self._pull_impl(np.asarray(ids))

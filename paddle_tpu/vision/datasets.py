@@ -23,7 +23,8 @@ class MNIST(Dataset):
         self.mode = mode
         self.transform = transform
         loaded = False
-        if image_path and label_path and os.path.exists(image_path):
+        if image_path and label_path and os.path.exists(image_path) \
+                and os.path.exists(label_path):
             with gzip.open(image_path, "rb") as f:
                 magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
                 self.images = np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
@@ -32,14 +33,22 @@ class MNIST(Dataset):
                 self.labels = np.frombuffer(f.read(), np.uint8).astype(np.int64)
             loaded = True
         if not loaded:
-            # deterministic synthetic digits: class-dependent patterns
+            # deterministic synthetic digits: class-dependent patterns.
+            # The class-defining base patterns are SHARED between splits
+            # (fixed seed) — only labels and per-sample noise differ — so
+            # a model trained on `train` generalizes to `test` the way a
+            # real dataset's splits do.
             n = 6000 if mode == "train" else 1000
+            base = np.random.default_rng(1234).normal(
+                0, 1, (10, 28, 28)).astype(np.float32)
             rng = np.random.default_rng(42 if mode == "train" else 7)
             self.labels = rng.integers(0, 10, n).astype(np.int64)
-            base = rng.normal(0, 1, (10, 28, 28)).astype(np.float32)
             noise = rng.normal(0, 0.3, (n, 28, 28)).astype(np.float32)
             img = base[self.labels] + noise
-            img = (img - img.min()) / (img.max() - img.min())
+            # FIXED normalization bounds (±4 sigma of base+noise), not
+            # per-split min/max: identical patterns must map to identical
+            # pixel values in every split
+            img = np.clip((img + 4.0) / 8.0, 0.0, 1.0)
             self.images = (img * 255).astype(np.uint8)
 
     def __getitem__(self, idx):
@@ -69,12 +78,14 @@ class Cifar10(Dataset):
         self.mode = mode
         self.transform = transform
         n = 5000 if mode == "train" else 1000
+        # class patterns shared across splits (see MNIST note)
+        base = np.random.default_rng(4321).normal(
+            0, 1, (self._classes, 32, 32, 3)).astype(np.float32)
         rng = np.random.default_rng(1 if mode == "train" else 2)
         self.labels = rng.integers(0, self._classes, n).astype(np.int64)
-        base = rng.normal(0, 1, (self._classes, 32, 32, 3)).astype(np.float32)
         noise = rng.normal(0, 0.4, (n, 32, 32, 3)).astype(np.float32)
         img = base[self.labels] + noise
-        img = (img - img.min()) / (img.max() - img.min())
+        img = np.clip((img + 4.0) / 8.0, 0.0, 1.0)  # fixed bounds
         self.data = (img * 255).astype(np.uint8)
 
     def __getitem__(self, idx):
