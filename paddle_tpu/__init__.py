@@ -244,12 +244,12 @@ def disable_signal_handler():
 
 def summary(net, input_size=None, dtypes=None, input=None):
     """paddle.summary parity: delegate to hapi Model.summary; a sample
-    `input` substitutes for input_size."""
+    `input` tensor is forwarded AS-IS so its dtype survives (integer ids
+    feed embedding networks correctly)."""
     from paddle_tpu.hapi.model import Model
-    if input_size is None and input is not None:
-        input_size = tuple(input.shape)
     return Model(net).summary(input_size=input_size,
-                              dtype=dtypes[0] if dtypes else None)
+                              dtype=dtypes[0] if dtypes else None,
+                              input=input)
 
 
 class LazyGuard:

@@ -25,6 +25,11 @@ from paddle_tpu.distributed.fleet.dataset import (  # noqa: F401
     InMemoryDataset,
     QueueDataset,
 )
+from paddle_tpu.distributed.fleet.data_generator import (  # noqa: F401
+    DataGenerator,
+    MultiSlotDataGenerator,
+    MultiSlotStringDataGenerator,
+)
 
 
 class DistributedStrategy:

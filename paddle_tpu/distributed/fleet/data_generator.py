@@ -1,0 +1,120 @@
+"""Fleet slot data generators (reference:
+python/paddle/distributed/fleet/data_generator/data_generator.py —
+DataGenerator :20, MultiSlotStringDataGenerator :240,
+MultiSlotDataGenerator :285).
+
+Users subclass and implement generate_sample(line); run_from_stdin /
+run_from_memory render the MultiSlotDataFeed text format
+(`slot_size v1 v2 ... slot_size ...` per sample) that
+fleet.InMemoryDataset/QueueDataset files carry.
+"""
+from __future__ import annotations
+
+import sys
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class DataGenerator:
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    def generate_sample(self, line):
+        """Override: return a generator of
+        [(slot_name, [value, ...]), ...] per produced sample."""
+        raise NotImplementedError(
+            "implement generate_sample(line) in your subclass")
+
+    def generate_batch(self, samples):
+        """Optional batch-level post-processing hook."""
+        def local_iter():
+            for sample in samples:
+                yield sample
+        return local_iter
+
+    def _gen_str(self, line):
+        raise NotImplementedError
+
+    def run_from_stdin(self):
+        for line in sys.stdin:
+            for user_parsed_line in self._iter_samples(line):
+                sys.stdout.write(self._gen_str(user_parsed_line))
+
+    def run_from_memory(self):
+        """Return the rendered lines instead of streaming stdout."""
+        out = []
+        for user_parsed_line in self._iter_samples(None):
+            out.append(self._gen_str(user_parsed_line))
+        return out
+
+    def _iter_samples(self, line):
+        gen = self.generate_sample(line)
+        if gen is None:
+            return
+        batch = []
+        for sample in gen():
+            if sample is None:
+                continue
+            batch.append(sample)
+            if len(batch) == self.batch_size_:
+                yield from self.generate_batch(batch)()
+                batch = []
+        if batch:
+            yield from self.generate_batch(batch)()
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """Values are already strings: render `len v1 v2 ...` per slot
+    (reference :240)."""
+
+    def _gen_str(self, line):
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "the output of process()/generate_sample must be a "
+                "list or tuple of (name, [str, ...]) pairs")
+        parts = []
+        for _, values in line:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Values are ints/floats; slot dtypes are checked for consistency
+    across samples like the reference's proto_info tracking
+    (reference :285)."""
+
+    def _gen_str(self, line):
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "the output of process()/generate_sample must be a "
+                "list or tuple of (name, [num, ...]) pairs")
+        if self._proto_info is None:
+            self._proto_info = []
+            for name, values in line:
+                kind = "float" if any(isinstance(v, float) for v in values) \
+                    else "uint64"
+                self._proto_info.append((name, kind))
+        elif len(line) != len(self._proto_info):
+            raise ValueError(
+                f"the complete field set of one sample changed: "
+                f"{len(line)} slots vs {len(self._proto_info)}")
+        parts = []
+        for i, (name, values) in enumerate(line):
+            expect_name, kind = self._proto_info[i]
+            if name != expect_name:
+                raise ValueError(
+                    f"slot {i} name changed: {name!r} vs {expect_name!r}")
+            if kind == "uint64" and any(
+                    isinstance(v, float) for v in values):
+                # widen like the reference: once floats appear the slot
+                # becomes a float slot
+                self._proto_info[i] = (name, "float")
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts) + "\n"

@@ -179,7 +179,75 @@ class Model:
     def parameters(self, *args, **kwargs):
         return self.network.parameters(*args, **kwargs)
 
-    def summary(self, input_size=None, dtype=None):
-        n_params = sum(int(np.prod(p.shape)) for p in self.network.parameters())
-        print(f"Total params: {n_params}")
-        return {"total_params": n_params}
+    def summary(self, input_size=None, dtype=None, input=None):
+        """Layer-by-layer table (reference hapi/model_summary.py): with
+        input_size (or a sample `input` tensor, whose dtype is honored —
+        integer inputs feed embedding networks correctly), a forward pass
+        records every sublayer's output shape via hooks; otherwise
+        parameter counts only."""
+        rows = []
+        total = trainable = 0
+        for name, p in self.network.named_parameters():
+            n = int(np.prod(p.shape))
+            total += n
+            if not p.stop_gradient:
+                trainable += n
+
+        shapes = {}
+        if input_size is not None or input is not None:
+            import paddle_tpu as P
+            hooks = []
+
+            def make_hook(lname):
+                def hook(layer, inp, out):
+                    o = out[0] if isinstance(out, (tuple, list)) else out
+                    if hasattr(o, "shape"):
+                        shapes[lname] = list(o.shape)
+                return hook
+
+            for lname, sub in self.network.named_sublayers():
+                hooks.append(sub.register_forward_post_hook(
+                    make_hook(lname)))
+            # snapshot PER-SUBLAYER modes: a blanket .train() at restore
+            # would silently unfreeze deliberately-eval'd sublayers
+            modes = [(sub, sub.training)
+                     for _, sub in self.network.named_sublayers(
+                         include_self=True)]
+            self.network.eval()
+            try:
+                if input is not None:
+                    x = input
+                else:
+                    shape = [1 if (s is None or s == -1) else int(s)
+                             for s in input_size]
+                    x = P.zeros(shape, dtype=dtype or "float32")
+                with P.no_grad():
+                    self.network(x)
+            finally:
+                for h in hooks:
+                    h.remove()
+                for sub, mode in modes:
+                    sub.training = mode
+
+        for lname, sub in self.network.named_sublayers():
+            own = sum(int(np.prod(p.shape))
+                      for p in sub.parameters(include_sublayers=False)) \
+                if hasattr(sub, "parameters") else 0
+            rows.append((lname, type(sub).__name__,
+                         shapes.get(lname, "-"), own))
+
+        name_w = max([len(r[0]) for r in rows] + [10])
+        header = (f"{'Layer':<{name_w}}  {'Type':<22} "
+                  f"{'Output Shape':<20} {'Params':>12}")
+        print("-" * len(header))
+        print(header)
+        print("=" * len(header))
+        for lname, tname, shape, own in rows:
+            print(f"{lname:<{name_w}}  {tname:<22} "
+                  f"{str(shape):<20} {own:>12,}")
+        print("=" * len(header))
+        print(f"Total params: {total:,}")
+        print(f"Trainable params: {trainable:,}")
+        print(f"Non-trainable params: {total - trainable:,}")
+        print("-" * len(header))
+        return {"total_params": total, "trainable_params": trainable}
