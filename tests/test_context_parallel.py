@@ -161,3 +161,13 @@ def test_split_gather_sequence_roundtrip(sp_mesh):
     assert not xs.sharding.is_fully_replicated
     xg = gather_sequence(xs, seq_axis=1)
     np.testing.assert_array_equal(np.asarray(xg), np.asarray(x))
+
+
+@pytest.mark.nightly  # long-context evidence on the CPU mesh: 2k tokens
+# sharded 8 ways through the ppermute ring must equal full attention
+def test_ring_attention_long_sequence_parity(sp_mesh):
+    q, k, v = _qkv(b=1, s=2048, h=2, d=32)
+    out = ring_attention_bshd(q, k, v, causal=True)
+    ref = ref_attention(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=5e-5, rtol=5e-5)
