@@ -44,3 +44,6 @@ from paddle_tpu.tensor.linalg import (  # noqa: F401
     transpose,
 )
 from paddle_tpu.tensor.stat import histogram  # noqa: F401
+
+
+from paddle_tpu.tensor.linalg import cond  # noqa: E402,F401

@@ -2,6 +2,10 @@
 from paddle_tpu.nn import functional  # noqa: F401
 from paddle_tpu.nn import initializer  # noqa: F401
 from paddle_tpu.nn import quant  # noqa: F401
+from paddle_tpu.nn.decode import (  # noqa: F401
+    BeamSearchDecoder,
+    dynamic_decode,
+)
 from paddle_tpu.nn import utils  # noqa: F401
 from paddle_tpu.nn.clip import (  # noqa: F401
     ClipGradByGlobalNorm,

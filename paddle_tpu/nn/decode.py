@@ -1,0 +1,172 @@
+"""Seq2seq decoding: BeamSearchDecoder + dynamic_decode + gather_tree.
+
+Reference parity: python/paddle/nn/decode.py re-exporting
+fluid/layers/rnn.py (BeamSearchDecoder :939, dynamic_decode further
+down) and nn/functional/extension.py gather_tree :253.
+
+TPU-native: the decode loop runs step-wise over cached-jit ops (each
+step is one compiled program; beam bookkeeping is jnp one-hots/gathers),
+ending with a gather_tree backtrace. Batch-first layout like the
+reference's dynamic_decode outputs.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode", "gather_tree"]
+
+
+def gather_tree(ids, parents):
+    """Backtrace beam-search results (reference
+    nn/functional/extension.py:253): ids/parents are
+    [max_time, batch, beam]; walk parents from the last step so row b,
+    beam k holds the FULL selected sequence."""
+
+    def fn(idv, pav):
+        t, b, k = idv.shape
+
+        def step(beams, ti):
+            # beams: [b, k] current beam index at time ti+1's viewpoint
+            cur_ids = jnp.take_along_axis(idv[ti], beams, axis=1)
+            prev = jnp.take_along_axis(pav[ti], beams, axis=1)
+            return prev.astype(beams.dtype), cur_ids
+
+        init = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32), (b, k))
+        _, rev = jax.lax.scan(step, init, jnp.arange(t - 1, -1, -1))
+        return jnp.flip(rev, axis=0)
+
+    return apply(fn, ids if isinstance(ids, Tensor) else Tensor(jnp.asarray(ids)),
+                 parents if isinstance(parents, Tensor)
+                 else Tensor(jnp.asarray(parents)))
+
+
+def _tile_beam(x, beam_size):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    tiled = jnp.repeat(v, beam_size, axis=0)
+    return Tensor(tiled)
+
+
+class BeamSearchDecoder:
+    """Beam-search wrapper over an RNN cell (reference fluid rnn.py:939).
+
+    embedding_fn maps ids -> cell inputs; output_fn maps cell outputs ->
+    vocab logits. States are any pytree of Tensors with batch on axis 0.
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[batch, ...] -> [batch*beam, ...] (reference helper)."""
+        return _tile_beam(x, beam_size)
+
+    # --- decode protocol -------------------------------------------------
+    def initialize(self, initial_cell_states):
+        k = self.beam_size
+        states = jax.tree_util.tree_map(
+            lambda t: _tile_beam(t, k), initial_cell_states,
+            is_leaf=lambda t: isinstance(t, Tensor))
+        leaves = jax.tree_util.tree_leaves(
+            states, is_leaf=lambda t: isinstance(t, Tensor))
+        bk = leaves[0].shape[0]
+        batch = bk // k
+        ids = jnp.full((bk,), self.start_token, jnp.int32)
+        # beam 0 starts live, beams 1.. start at -inf so step 1 expands
+        # from a single hypothesis per batch row
+        log_probs = jnp.tile(
+            jnp.asarray([0.0] + [-1e9] * (k - 1), jnp.float32), (batch,))
+        finished = jnp.zeros((bk,), bool)
+        return Tensor(ids), states, Tensor(log_probs), Tensor(finished)
+
+    def step(self, ids, states, log_probs, finished):
+        k = self.beam_size
+        inputs = self.embedding_fn(ids) if self.embedding_fn else ids
+        cell_out, new_states = self.cell(inputs, states)
+        logits = self.output_fn(cell_out) if self.output_fn else cell_out
+
+        def fn(lg, lp, fin):
+            bk, vocab = lg.shape
+            batch = bk // k
+            step_lp = jax.nn.log_softmax(lg.astype(jnp.float32), -1)
+            # finished beams only extend with end_token at zero cost
+            fin_mask = jnp.full((vocab,), -1e9).at[self.end_token].set(0.0)
+            step_lp = jnp.where(fin[:, None], fin_mask[None, :], step_lp)
+            total = lp[:, None] + step_lp                  # [bk, vocab]
+            total = total.reshape(batch, k * vocab)
+            top_lp, top_idx = jax.lax.top_k(total, k)      # [batch, k]
+            parent = (top_idx // vocab).astype(jnp.int32)  # beam within row
+            word = (top_idx % vocab).astype(jnp.int32)
+            gather = (jnp.arange(batch, dtype=jnp.int32)[:, None] * k
+                      + parent).reshape(-1)
+            new_fin = fin[gather] | (word.reshape(-1) == self.end_token)
+            return (word.reshape(-1), top_lp.reshape(-1), new_fin, gather,
+                    parent.reshape(-1))
+
+        word, lp, fin, gather, parent = apply(
+            fn, logits, log_probs, finished)
+        gathered_states = jax.tree_util.tree_map(
+            lambda t: apply(lambda sv, gv: sv[gv], t, gather),
+            new_states, is_leaf=lambda t: isinstance(t, Tensor))
+        return word, gathered_states, lp, fin, parent
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major=False,
+                   impute_finished=False, is_test=False, return_length=False,
+                   **kwargs):
+    """Run `decoder` until every beam emits end_token or max_step_num
+    (reference dynamic_decode). Returns (ids [batch, time, beam] int64,
+    final log_probs [batch, beam]) (+ sequence lengths with
+    return_length), with the gather_tree backtrace applied."""
+    assert max_step_num is not None and max_step_num > 0, \
+        "max_step_num is required (static bounds keep programs compiled)"
+    ids, states, log_probs, finished = decoder.initialize(inits)
+    step_ids, step_parents = [], []
+    for _ in range(max_step_num):
+        ids, states, log_probs, finished, parent = decoder.step(
+            ids, states, log_probs, finished)
+        step_ids.append(ids)
+        step_parents.append(parent)
+        if bool(np.asarray(jax.device_get(finished._value)).all()):
+            break
+
+    k = decoder.beam_size
+    bk = step_ids[0].shape[0]
+    batch = bk // k
+    t = len(step_ids)
+    ids_tbk = Tensor(jnp.stack([s._value for s in step_ids])
+                     .reshape(t, batch, k))
+    par_tbk = Tensor(jnp.stack([p._value for p in step_parents])
+                     .reshape(t, batch, k))
+    traced = gather_tree(ids_tbk, par_tbk)          # [t, batch, k]
+    out = apply(lambda v: jnp.transpose(v, (1, 0, 2)).astype(jnp.int64),
+                traced)
+    lp = Tensor(log_probs._value.reshape(batch, k))
+    lengths = None
+    if return_length:
+        # lengths come from the BATCH-MAJOR view (time axis 1); compute
+        # before any time-major re-transpose
+        lengths = apply(
+            lambda v: jnp.minimum(
+                jnp.argmax((v == decoder.end_token).astype(jnp.int32),
+                           axis=1) + 1,
+                v.shape[1]) * jnp.any(v == decoder.end_token, 1)
+            + v.shape[1] * (1 - jnp.any(v == decoder.end_token, 1)),
+            out)
+    if output_time_major:
+        out = apply(lambda v: jnp.transpose(v, (1, 0, 2)), out)
+    if return_length:
+        return out, lp, lengths
+    return out, lp

@@ -15,7 +15,7 @@ from paddle_tpu.nn.functional.extension import (  # noqa: F401
     sequence_mask,
     temporal_shift,
 )
-from paddle_tpu.nn.functional.input import embedding, one_hot  # noqa: F401
+from paddle_tpu.nn.functional.input import embedding, gather_tree, one_hot  # noqa: F401
 from paddle_tpu.nn.functional.loss import *  # noqa: F401,F403
 from paddle_tpu.nn.functional.norm import (  # noqa: F401
     batch_norm,

@@ -185,3 +185,10 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
             y = y + jax.lax.stop_gradient(oh - y)
         return y
     return apply(fn, x)
+
+
+def tanh_(x, name=None):
+    """In-place tanh (reference activation.py tanh_)."""
+    out = tanh(x)
+    x._inplace_assign(out)
+    return x

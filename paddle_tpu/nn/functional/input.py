@@ -22,3 +22,10 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
             out = jnp.where(mask, jnp.zeros((), out.dtype), out)
         return out
     return apply(fn, x, weight)
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (reference nn/functional/extension.py:253);
+    implementation in paddle_tpu.nn.decode."""
+    from paddle_tpu.nn.decode import gather_tree as _gt
+    return _gt(ids, parents)

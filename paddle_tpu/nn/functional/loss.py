@@ -3,8 +3,10 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from paddle_tpu.core.dispatch import apply
+from paddle_tpu.core.tensor import Tensor
 
 
 def _reduce(v, reduction):
@@ -352,3 +354,124 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002):
         reg = l2_reg * (jnp.mean(jnp.sum(a * a, 1)) + jnp.mean(jnp.sum(p * p, 1))) * 0.25
         return ce + reg
     return apply(fn, anchor, positive, labels)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """Multi-class margin (hinge) loss (reference nn/functional/loss.py
+    multi_margin_loss): mean over classes of
+    max(0, margin - x_label + x_j)^p for j != label."""
+
+    def fn(x, y, *rest):
+        n, c = x.shape
+        yi = y.reshape(-1).astype(jnp.int32)
+        x_label = jnp.take_along_axis(x, yi[:, None], axis=1)
+        m = jnp.maximum(0.0, margin - x_label + x)
+        if p != 1:
+            m = m ** p
+        if rest:
+            m = m * rest[0][None, yi].reshape(n, 1) if rest[0].ndim == 1 \
+                else m * rest[0]
+        mask = 1.0 - jax.nn.one_hot(yi, c, dtype=x.dtype)
+        loss = (m * mask).sum(axis=1) / c
+        return _reduce(loss, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply(fn, *args)
+
+
+_hsigmoid_path_cache = {}
+
+
+def _default_hsigmoid_paths(n_cls):
+    if n_cls not in _hsigmoid_path_cache:
+        depth = int(np.ceil(np.log2(max(n_cls, 2))))
+        tables, codes = [], []
+        for lab in range(n_cls):
+            node = lab + n_cls  # leaf position in the heap
+            tab, code = [], []
+            while node > 1:
+                code.append(node & 1)
+                node //= 2
+                tab.append(node - 1)  # non-leaf ids 0-based
+            tab = tab[::-1]
+            code = code[::-1]
+            pad = depth + 1 - len(tab)
+            tables.append(tab + [-1] * pad)
+            codes.append(code + [-1] * pad)
+        _hsigmoid_path_cache[n_cls] = (np.asarray(tables, np.int64),
+                                       np.asarray(codes, np.int64))
+    return _hsigmoid_path_cache[n_cls]
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (reference nn/functional/loss.py:926).
+
+    Default tree: the complete binary tree over num_classes leaves the
+    reference builds — node ids trace the path root->leaf of
+    (label + num_classes) in the implicit heap layout; code bits are the
+    left/right turns. Custom trees pass path_table/path_code
+    [N, path_len] (pad with -1).
+    """
+
+    if path_table is None or path_code is None:
+        # the default-tree paths depend only on (class id, num_classes):
+        # build the [num_classes, L] tables ONCE per num_classes and
+        # gather rows by label on device (no per-step host sync)
+        t_all, c_all = _default_hsigmoid_paths(num_classes)
+        def gather_paths(y, tbl):
+            yi = y.reshape(-1).astype(jnp.int32)
+            return tbl[yi]
+        path_table = apply(lambda y: gather_paths(y, jnp.asarray(t_all)),
+                           label)
+        path_code = apply(lambda y: gather_paths(y, jnp.asarray(c_all)),
+                          label)
+
+    def fn(x, tab, code, w, *rest):
+        valid = (tab >= 0)
+        tab_c = jnp.maximum(tab, 0)
+        # scores along the path: [N, L]
+        wsel = w[tab_c]                       # [N, L, D]
+        s = jnp.einsum("nd,nld->nl", x, wsel)
+        if rest:
+            s = s + rest[0][tab_c]
+        target = code.astype(jnp.float32)
+        # BCE-with-logits per path node, masked by validity
+        bce = jnp.maximum(s, 0) - s * target + jnp.log1p(
+            jnp.exp(-jnp.abs(s)))
+        return (bce * valid).sum(axis=1, keepdims=True)
+
+    args = [input, path_table, path_code, weight] + (
+        [bias] if bias is not None else [])
+    return apply(fn, *args)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace-style margin softmax (reference nn/functional/loss.py:1837):
+    the target logit's angle theta becomes
+    cos(margin1*theta + margin2) - margin3, everything scaled by `scale`.
+    The reference's model-parallel class sharding is the tp mesh axis
+    here (sharded logits work through sharding propagation)."""
+
+    def fn(lg, y):
+        n, c = lg.shape
+        yi = y.reshape(-1).astype(jnp.int32)
+        # stay strictly inside arccos' differentiable domain: cos==1.0
+        # gives d(arccos)/dx = -inf and one such sample poisons the step
+        cos = jnp.clip(lg, -1.0 + 1e-6, 1.0 - 1e-6)
+        theta = jnp.arccos(jnp.take_along_axis(cos, yi[:, None], 1))
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        onehot = jax.nn.one_hot(yi, c, dtype=lg.dtype)
+        adjusted = cos * (1 - onehot) + target * onehot
+        z = adjusted * scale
+        logp = jax.nn.log_softmax(z, -1)
+        loss = -jnp.take_along_axis(logp, yi[:, None], 1)
+        loss_out = _reduce(loss, reduction)
+        return (loss_out, jax.nn.softmax(z, -1)) if return_softmax \
+            else loss_out
+
+    return apply(fn, logits, label)

@@ -87,3 +87,13 @@ class Maxout(Layer):
 
     def forward(self, x):
         return F.maxout(x, self.groups, self.axis)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW inputs
+    (reference nn/layer/activation.py Softmax2D)."""
+
+    def forward(self, x):
+        if len(x.shape) not in (3, 4):
+            raise ValueError("Softmax2D expects 3-D or 4-D input")
+        return F.softmax(x, axis=-3)

@@ -292,3 +292,34 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
         u, s, vh = jnp.linalg.svd(vv, full_matrices=False)
         return u[..., :qq], s[..., :qq], jnp.swapaxes(vh, -1, -2)[..., :qq]
     return apply(fn, x)
+
+
+def cond(x, p=None, name=None):
+    """Condition number w.r.t. norm `p` (reference tensor/linalg.py:741):
+    p in {None, 2, -2} uses singular values; fro/nuc/1/-1/inf/-inf use
+    norm(x) * norm(inv(x))."""
+    def fn(v):
+        if p is None or p in (2, -2):
+            s = jnp.linalg.svd(v, compute_uv=False)
+            big = s[..., 0]
+            small = s[..., -1]
+            return big / small if (p is None or p == 2) else small / big
+        inv = jnp.linalg.inv(v)
+
+        def mat_norm(m):
+            if p == "fro":
+                return jnp.sqrt((m * m).sum((-2, -1)))
+            if p == "nuc":
+                return jnp.linalg.svd(m, compute_uv=False).sum(-1)
+            if p in (1, -1):
+                colsums = jnp.abs(m).sum(-2)
+                return colsums.max(-1) if p == 1 else colsums.min(-1)
+            if p in (float("inf"), -float("inf")):
+                rowsums = jnp.abs(m).sum(-1)
+                return rowsums.max(-1) if p == float("inf") \
+                    else rowsums.min(-1)
+            raise ValueError(f"unsupported norm order {p!r}")
+
+        return mat_norm(v) * mat_norm(inv)
+
+    return apply(fn, x)

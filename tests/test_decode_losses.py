@@ -1,0 +1,159 @@
+"""Beam-search decoding + round-3 loss additions + linalg.cond.
+
+Reference: python/paddle/nn/decode.py (BeamSearchDecoder/dynamic_decode
+via fluid/layers/rnn.py), nn/functional/extension.py gather_tree :253,
+nn/functional/loss.py (hsigmoid_loss :926, margin_cross_entropy :1837,
+multi_margin_loss :3834), tensor/linalg.py cond :741.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn.functional as F
+
+
+class _ToyCell:
+    """Stateless cell: passes ids through (output_fn makes the logits)."""
+
+    def __call__(self, ids, states):
+        return ids, states
+
+
+def _next_token_output_fn(vocab):
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.dispatch import apply
+
+    def output_fn(ids_tensor):
+        def fn(ids):
+            nxt = (ids.astype(jnp.int32) + 1) % vocab
+            return jax.nn.one_hot(nxt, vocab) * 5.0
+        return apply(fn, ids_tensor)
+
+    return output_fn
+
+
+class TestBeamSearch:
+    def test_greedy_chain_and_end_token_padding(self):
+        dec = P.nn.BeamSearchDecoder(
+            _ToyCell(), start_token=0, end_token=4, beam_size=2,
+            output_fn=_next_token_output_fn(5))
+        out, lp = P.nn.dynamic_decode(dec, inits={"h": P.zeros([3, 1])},
+                                      max_step_num=8)
+        seq = out.numpy()
+        assert seq.shape[0] == 3 and seq.shape[2] == 2
+        for b in range(3):  # best beam: deterministic 1,2,3,4 then pad
+            np.testing.assert_array_equal(seq[b, :4, 0], [1, 2, 3, 4])
+            assert (seq[b, 4:, 0] == 4).all()
+        assert lp.shape == [3, 2]
+        # best beam's log prob beats the runner-up
+        assert (lp.numpy()[:, 0] >= lp.numpy()[:, 1]).all()
+
+    def test_stops_early_when_all_beams_finish(self):
+        # vocab 2: every expansion hits the end token almost immediately
+        dec = P.nn.BeamSearchDecoder(
+            _ToyCell(), start_token=0, end_token=1, beam_size=2,
+            output_fn=_next_token_output_fn(2))
+        out, _ = P.nn.dynamic_decode(dec, inits={"h": P.zeros([1, 1])},
+                                     max_step_num=10)
+        assert out.shape[1] < 10  # early exit, not max_step_num
+
+    def test_states_follow_parent_beams(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.dispatch import apply
+
+        class CountingCell:
+            def __call__(self, ids, states):
+                new = apply(lambda s, i: s + i.astype(jnp.float32)[:, None],
+                            states["acc"], ids)
+                return ids, {"acc": new}
+
+        dec = P.nn.BeamSearchDecoder(
+            CountingCell(), start_token=0, end_token=4, beam_size=2,
+            output_fn=_next_token_output_fn(5))
+        ids, states, lp, fin = dec.initialize({"acc": P.zeros([1, 1])})
+        for _ in range(3):
+            ids, states, lp, fin, parent = dec.step(ids, states, lp, fin)
+        # beam 0 consumed 0+1+2: the accumulated state must equal the
+        # sum of ITS OWN path, proving gather-by-parent happened
+        assert float(states["acc"].numpy()[0, 0]) == 0 + 1 + 2
+
+    def test_gather_tree_backtrace(self):
+        from paddle_tpu.nn.decode import gather_tree
+        ids = np.array([[[2, 5]], [[6, 1]]], np.int32)
+        parents = np.array([[[0, 0]], [[1, 0]]], np.int32)
+        g = gather_tree(P.to_tensor(ids), P.to_tensor(parents)).numpy()
+        np.testing.assert_array_equal(g[:, 0, 0], [5, 6])
+        np.testing.assert_array_equal(g[:, 0, 1], [2, 1])
+        # also exposed as nn.functional.gather_tree
+        g2 = F.gather_tree(P.to_tensor(ids), P.to_tensor(parents)).numpy()
+        np.testing.assert_array_equal(g, g2)
+
+
+class TestNewLosses:
+    def test_multi_margin_formula(self):
+        x = P.to_tensor(np.array([[0.1, 0.9, 0.2], [0.8, 0.1, 0.1]],
+                                 np.float32))
+        y = P.to_tensor(np.array([1, 0]), dtype="int64")
+        got = float(F.multi_margin_loss(x, y))
+        want = np.mean([(max(0, 1 - 0.9 + 0.1) + max(0, 1 - 0.9 + 0.2)) / 3,
+                        (max(0, 1 - 0.8 + 0.1) * 2) / 3])
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+        layer = P.nn.MultiMarginLoss(reduction="sum")
+        assert float(layer(x, y)) > 0
+
+    def test_hsigmoid_trains_and_beats_chance(self):
+        P.seed(0)
+        n_cls, feat = 8, 16
+        hs = P.nn.HSigmoidLoss(feat, n_cls)
+        opt = P.optimizer.Adam(0.05, parameters=hs.parameters())
+        rng = np.random.RandomState(0)
+        centers = rng.randn(n_cls, feat).astype(np.float32) * 2
+        labels = rng.randint(0, n_cls, 64)
+        x = P.to_tensor((centers[labels]
+                         + rng.randn(64, feat) * 0.1).astype(np.float32))
+        y = P.to_tensor(labels.reshape(-1, 1), dtype="int64")
+        l0 = None
+        for _ in range(30):
+            opt.clear_grad()
+            loss = hs(x, y).mean()
+            loss.backward()
+            opt.step()
+            l0 = l0 or float(loss)
+        assert float(loss) < l0 * 0.5, (l0, float(loss))
+
+    def test_margin_cross_entropy_reduces_to_ce(self):
+        rng = np.random.RandomState(2)
+        lg = P.to_tensor((rng.rand(3, 5) * 0.5).astype(np.float32))
+        y = P.to_tensor(np.array([1, 0, 4]), dtype="int64")
+        mce = F.margin_cross_entropy(lg, y, margin1=1.0, margin2=0.0,
+                                     margin3=0.0, scale=1.0)
+        ce = F.cross_entropy(lg, y)
+        np.testing.assert_allclose(float(mce), float(ce), rtol=1e-4)
+        # margins increase the loss on the target class
+        harder = F.margin_cross_entropy(lg, y, margin2=0.5, scale=1.0)
+        assert float(harder) > float(mce)
+
+    def test_softmax2d_and_tanh_inplace(self):
+        out = P.nn.Softmax2D()(P.ones([2, 3, 4, 4]))
+        np.testing.assert_allclose(out.numpy().sum(1), 1.0, rtol=1e-6)
+        t = P.to_tensor(np.array([0.5], np.float32))
+        F.tanh_(t)
+        np.testing.assert_allclose(t.numpy(), np.tanh(0.5), rtol=1e-6)
+
+
+class TestLinalgCond:
+    def test_orders(self):
+        m = P.to_tensor(np.diag([4.0, 1.0]).astype(np.float32))
+        np.testing.assert_allclose(float(P.linalg.cond(m)), 4.0, rtol=1e-5)
+        np.testing.assert_allclose(float(P.linalg.cond(m, p=-2)), 0.25,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(float(P.linalg.cond(m, p=1)), 4.0,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(
+            float(P.linalg.cond(m, p="fro")),
+            np.sqrt(17) * np.sqrt(1 + 1 / 16), rtol=1e-5)
+        np.testing.assert_allclose(
+            float(P.linalg.cond(m, p=float("inf"))), 4.0, rtol=1e-5)
