@@ -252,3 +252,49 @@ def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
     header["mixed_precision"] = str(target)
     write_model_file(mixed_model_file, header, blob)
     save_params_npz(mixed_params_file, cast)
+
+
+class DataType:
+    """reference paddle/inference DataType enum."""
+
+    FLOAT32 = "float32"
+    FLOAT16 = "float16"
+    BFLOAT16 = "bfloat16"
+    INT64 = "int64"
+    INT32 = "int32"
+    INT8 = "int8"
+    UINT8 = "uint8"
+    BOOL = "bool"
+
+
+def get_num_bytes_of_data_type(dtype):
+    import numpy as np
+    return np.dtype("float16" if dtype == DataType.BFLOAT16
+                    else dtype).itemsize
+
+
+def get_version():
+    import paddle_tpu
+    return f"paddle_tpu inference {paddle_tpu.__version__}"
+
+
+def get_trt_compile_version():
+    return (0, 0, 0)  # no TensorRT on TPU
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+class PredictorPool:
+    """Pool of predictors over one config (reference PredictorPool):
+    on TPU each predictor shares the same AOT executable; the pool gives
+    per-thread handle isolation."""
+
+    def __init__(self, config, size=1):
+        self._predictors = [create_predictor(config) for _ in range(size)]
+
+    def retrive(self, idx):  # reference spells it 'retrive'
+        return self._predictors[idx]
+
+    retrieve = retrive

@@ -51,3 +51,30 @@ def load(path, **configs):
     if meta.get("stablehlo"):
         return load_program(path)
     return load_params_npz(path + ".pdiparams")
+
+
+# reference jit namespace extras (python/paddle/jit/__init__.py)
+from paddle_tpu.jit.serialization import TranslatedLayer  # noqa: E402,F401
+
+TracedLayer = TranslatedLayer  # legacy alias: trace-based save/load
+
+_code_level = [0]
+_verbosity = [0]
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Dy2Static debugging knob (reference jit/dy2static logging): there
+    is no source-to-source transform here — to_static traces Python
+    directly — so this records the level and, at >0, prints a note."""
+    _code_level[0] = level
+    if level and also_to_stdout:
+        print("paddle_tpu.jit: to_static traces Python directly; there "
+              "is no transformed code to dump (level recorded)")
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    _verbosity[0] = level
+
+
+def get_verbosity():
+    return _verbosity[0]

@@ -1,6 +1,7 @@
 """paddle.linalg namespace. Reference: python/paddle/linalg.py."""
 from paddle_tpu.tensor.linalg import (  # noqa: F401
     cholesky,
+    cond,
     cholesky_solve,
     corrcoef,
     cov,
@@ -45,5 +46,3 @@ from paddle_tpu.tensor.linalg import (  # noqa: F401
 )
 from paddle_tpu.tensor.stat import histogram  # noqa: F401
 
-
-from paddle_tpu.tensor.linalg import cond  # noqa: E402,F401

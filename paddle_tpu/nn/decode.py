@@ -130,6 +130,9 @@ def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major=Fal
     (reference dynamic_decode). Returns (ids [batch, time, beam] int64,
     final log_probs [batch, beam]) (+ sequence lengths with
     return_length), with the gather_tree backtrace applied."""
+    assert inits is not None, \
+        "inits is required: the initial cell states (any pytree of " \
+        "Tensors with batch on axis 0)"
     assert max_step_num is not None and max_step_num > 0, \
         "max_step_num is required (static bounds keep programs compiled)"
     ids, states, log_probs, finished = decoder.initialize(inits)

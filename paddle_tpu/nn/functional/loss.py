@@ -399,8 +399,12 @@ def _default_hsigmoid_paths(n_cls):
             pad = depth + 1 - len(tab)
             tables.append(tab + [-1] * pad)
             codes.append(code + [-1] * pad)
-        _hsigmoid_path_cache[n_cls] = (np.asarray(tables, np.int64),
-                                       np.asarray(codes, np.int64))
+        # cache DEVICE arrays: re-uploading [num_classes, depth+1]
+        # tables every step would defeat the cache at hsigmoid's
+        # intended (large-vocab) scale
+        _hsigmoid_path_cache[n_cls] = (
+            jnp.asarray(np.asarray(tables, np.int64)),
+            jnp.asarray(np.asarray(codes, np.int64)))
     return _hsigmoid_path_cache[n_cls]
 
 
@@ -421,13 +425,13 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
         # build the [num_classes, L] tables ONCE per num_classes and
         # gather rows by label on device (no per-step host sync)
         t_all, c_all = _default_hsigmoid_paths(num_classes)
+
         def gather_paths(y, tbl):
             yi = y.reshape(-1).astype(jnp.int32)
             return tbl[yi]
-        path_table = apply(lambda y: gather_paths(y, jnp.asarray(t_all)),
-                           label)
-        path_code = apply(lambda y: gather_paths(y, jnp.asarray(c_all)),
-                          label)
+
+        path_table = apply(lambda y: gather_paths(y, t_all), label)
+        path_code = apply(lambda y: gather_paths(y, c_all), label)
 
     def fn(x, tab, code, w, *rest):
         valid = (tab >= 0)
