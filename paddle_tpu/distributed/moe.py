@@ -54,40 +54,46 @@ def dispatch_combine(probs, top_k, capacity, keep_last=None):
     combine carries the gate weights at the same positions.  Both are
     differentiable in `probs` through the top-k gate values.
     """
-    import jax
-    import jax.numpy as jnp
-
     def fn(p, *rest):
-        n, e = p.shape
-        kl = rest[0] if rest else None
-        vals, idx = jax.lax.top_k(p, top_k)            # [n, K]
-        onehot = jax.nn.one_hot(idx, e, dtype=p.dtype)  # [n, K, E]
-        if kl is not None:
-            onehot = onehot.at[:, top_k - 1, :].multiply(
-                kl.astype(p.dtype)[:, None])
-        # rank of each token within its chosen expert; top-1 column fills
-        # before top-2 (GShard §3.2) so the primary route wins capacity
-        offset = jnp.zeros((e,), p.dtype)
-        keep_k, pos_k = [], []
-        for k in range(top_k):
-            mk = onehot[:, k, :]                        # [n, E]
-            pos = jnp.cumsum(mk, axis=0) - mk + offset  # [n, E]
-            offset = offset + mk.sum(axis=0)
-            keep_k.append(mk * (pos < capacity))
-            pos_k.append(pos)
-        keep = jnp.stack(keep_k, 1)                     # [n, K, E]
-        pos = jnp.stack(pos_k, 1)                       # [n, K, E]
-        slot = jax.nn.one_hot(
-            jnp.clip(pos, 0, capacity - 1).astype(jnp.int32), capacity,
-            dtype=p.dtype)                              # [n, K, E, C]
-        disp_k = keep[..., None] * slot                 # [n, K, E, C]
-        dispatch = disp_k.sum(axis=1)
-        combine = (vals[:, :, None, None] * disp_k).sum(axis=1)
-        return combine, dispatch
+        return gshard_dispatch_combine(p, top_k, capacity,
+                                       rest[0] if rest else None)
 
     if keep_last is not None:
         return apply(fn, probs, keep_last)
     return apply(fn, probs)
+
+
+def gshard_dispatch_combine(p, top_k, capacity, kl=None):
+    """Plain-jnp GShard routing core shared by the nn MoELayer and the
+    explicit hybrid (models/gpt_hybrid._moe_ffn). p: [n, E] probs."""
+    import jax
+    import jax.numpy as jnp
+
+    n, e = p.shape
+    vals, idx = jax.lax.top_k(p, top_k)            # [n, K]
+    onehot = jax.nn.one_hot(idx, e, dtype=p.dtype)  # [n, K, E]
+    if kl is not None:
+        onehot = onehot.at[:, top_k - 1, :].multiply(
+            kl.astype(p.dtype)[:, None])
+    # rank of each token within its chosen expert; top-1 column fills
+    # before top-2 (GShard §3.2) so the primary route wins capacity
+    offset = jnp.zeros((e,), p.dtype)
+    keep_k, pos_k = [], []
+    for k in range(top_k):
+        mk = onehot[:, k, :]                        # [n, E]
+        pos = jnp.cumsum(mk, axis=0) - mk + offset  # [n, E]
+        offset = offset + mk.sum(axis=0)
+        keep_k.append(mk * (pos < capacity))
+        pos_k.append(pos)
+    keep = jnp.stack(keep_k, 1)                     # [n, K, E]
+    pos = jnp.stack(pos_k, 1)                       # [n, K, E]
+    slot = jax.nn.one_hot(
+        jnp.clip(pos, 0, capacity - 1).astype(jnp.int32), capacity,
+        dtype=p.dtype)                              # [n, K, E, C]
+    disp_k = keep[..., None] * slot                 # [n, K, E, C]
+    dispatch = disp_k.sum(axis=1)
+    combine = (vals[:, :, None, None] * disp_k).sum(axis=1)
+    return combine, dispatch
 
 
 class BaseGate(nn.Layer):

@@ -1,5 +1,5 @@
-"""GPT with EXPLICIT 4-D hybrid parallelism — dp × pp × tp × sp in one SPMD
-program.
+"""GPT with EXPLICIT hybrid parallelism — up to 5 axes
+(dp × pp × tp × sp × ep) in one SPMD program.
 
 Reference parity: the reference's fleet hybrid-parallel GPT-3
 (python/paddle/distributed/fleet/meta_parallel/: tensor parallel mp_layers
@@ -17,6 +17,9 @@ contains the whole train step —
       split over tp so attention itself needs no tp communication
   sp: sequence dim sharded; exact causal attention via ring_attention
       (ppermute k/v ring with online-softmax merge) over the "sp" axis
+  ep: (cfg.moe_num_experts > 0) every FFN becomes a GShard expert bank
+      sharded over "ep": per-ep-rank grouped dispatch, one all_to_all
+      pair moves tokens to their experts and back (_moe_ffn)
 This composes paddle_tpu.distributed.pipeline's schedule with
 context_parallel.ring_attention — the same building blocks exposed to
 users — into the flagship configuration the driver dry-runs.
@@ -78,11 +81,25 @@ def init_hybrid_gpt_params(cfg, mesh, seed=0, virtual_chunks=1):
         "b_o": np.zeros((L, H), np.float32),
         "ln2_g": np.ones((L, H), np.float32),
         "ln2_b": np.zeros((L, H), np.float32),
-        "w1": norm(L, H, F),
-        "b1": np.zeros((L, F), np.float32),
-        "w2": norm(L, F, H),
-        "b2": np.zeros((L, H), np.float32),
     }
+    E = int(getattr(cfg, "moe_num_experts", 0) or 0)
+    if E > 0:
+        # MoE flagship variant: every layer's FFN becomes E experts
+        # sharded over the `ep` mesh axis (GShard dispatch in-block)
+        stages.update({
+            "gate_w": norm(L, H, E),
+            "moe_w1": norm(L, E, H, F),
+            "moe_b1": np.zeros((L, E, F), np.float32),
+            "moe_w2": norm(L, E, F, H),
+            "moe_b2": np.zeros((L, E, H), np.float32),
+        })
+    else:
+        stages.update({
+            "w1": norm(L, H, F),
+            "b1": np.zeros((L, F), np.float32),
+            "w2": norm(L, F, H),
+            "b2": np.zeros((L, H), np.float32),
+        })
     if virtual_chunks > 1:
         pp = dict(mesh.shape)["pp"]
         perm = interleave_layer_permutation(L, pp, virtual_chunks)
@@ -98,33 +115,47 @@ def init_hybrid_gpt_params(cfg, mesh, seed=0, virtual_chunks=1):
         "lnf_b": np.zeros((H,), np.float32),
         "stages": stages,
     }
-    specs = hybrid_param_specs()
+    specs = hybrid_param_specs(moe=E > 0)
     return jax.tree_util.tree_map(
         lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), params,
         specs)
 
 
-def hybrid_param_specs():
-    """PartitionSpecs: stage dim over pp; Megatron col/row layouts over tp."""
+def hybrid_param_specs(moe=False):
+    """PartitionSpecs: stage dim over pp; Megatron col/row layouts over
+    tp; with `moe`, expert weights shard their E dim over ep (the dense
+    FFN leaves disappear — every layer's FFN is the expert bank)."""
+    stages = {
+        "ln1_g": P("pp", None),
+        "ln1_b": P("pp", None),
+        "w_qkv": P("pp", None, "tp"),   # column-parallel
+        "b_qkv": P("pp", "tp"),
+        "w_o": P("pp", "tp", None),     # row-parallel
+        "b_o": P("pp", None),
+        "ln2_g": P("pp", None),
+        "ln2_b": P("pp", None),
+    }
+    if moe:
+        stages.update({
+            "gate_w": P("pp", None, None),      # router replicated
+            "moe_w1": P("pp", "ep", None, None),
+            "moe_b1": P("pp", "ep", None),
+            "moe_w2": P("pp", "ep", None, None),
+            "moe_b2": P("pp", "ep", None),
+        })
+    else:
+        stages.update({
+            "w1": P("pp", None, "tp"),      # column-parallel
+            "b1": P("pp", "tp"),
+            "w2": P("pp", "tp", None),      # row-parallel
+            "b2": P("pp", None),
+        })
     return {
         "wte": P("tp", None),        # vocab-parallel table + tied head:
         "wpe": P(None, None),        # no full-vocab logits ever materialize
         "lnf_g": P(None),            # (fleet/mp_ops.py)
         "lnf_b": P(None),
-        "stages": {
-            "ln1_g": P("pp", None),
-            "ln1_b": P("pp", None),
-            "w_qkv": P("pp", None, "tp"),   # column-parallel
-            "b_qkv": P("pp", "tp"),
-            "w_o": P("pp", "tp", None),     # row-parallel
-            "b_o": P("pp", None),
-            "ln2_g": P("pp", None),
-            "ln2_b": P("pp", None),
-            "w1": P("pp", None, "tp"),      # column-parallel
-            "b1": P("pp", "tp"),
-            "w2": P("pp", "tp", None),      # row-parallel
-            "b2": P("pp", None),
-        },
+        "stages": stages,
     }
 
 
@@ -139,7 +170,116 @@ def _layer_norm(x, g, b, eps=1e-5):
     return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
 
 
-def _decoder_block(p, h, num_heads_local, sp_size, explicit_tp_bwd=False):
+def _make_ep_boundaries(ep_size):
+    """Custom-VJP ep-region boundaries (the ep analogue of mp_ops'
+    copy_to/reduce_from tp pair): activations REPLICATED over ep carry
+    FULL per-rank cotangents in the explicit per-stage vjp, so the plain
+    transposes of dynamic_slice (scatter) and all_gather
+    (reduce-scatter) would double-count. The pair below implements the
+    convention explicitly — split's bwd all-gathers the slice cotangents
+    back to full; merge's bwd takes this rank's slice of the full
+    cotangent — and is a no-op identity-pair semantics-wise.
+    """
+
+    @jax.custom_vjp
+    def ep_split(x):
+        n = x.shape[0] // ep_size
+        r = lax.axis_index("ep")
+        return lax.dynamic_slice_in_dim(x, r * n, n, axis=0)
+
+    def split_fwd(x):
+        return ep_split(x), None
+
+    def split_bwd(_, d_slice):
+        return (lax.all_gather(d_slice, "ep", axis=0, tiled=True),)
+
+    ep_split.defvjp(split_fwd, split_bwd)
+
+    @jax.custom_vjp
+    def ep_merge(y_slice):
+        return lax.all_gather(y_slice, "ep", axis=0, tiled=True)
+
+    def merge_fwd(y_slice):
+        return ep_merge(y_slice), None
+
+    def merge_bwd(_, d_full):
+        n = d_full.shape[0] // ep_size
+        r = lax.axis_index("ep")
+        return (lax.dynamic_slice_in_dim(d_full, r * n, n, axis=0),)
+
+    ep_merge.defvjp(merge_fwd, merge_bwd)
+    return ep_split, ep_merge
+
+
+def _moe_ffn(p, x, top_k, capacity_factor, ep_size, explicit_bwd=False):
+    """GShard expert FFN on local shards inside shard_map.
+
+    x: [mb, s_loc, H] this device's tokens (its dp x sp group). Routing
+    is per-group (the GShard formulation); the E global experts' weights
+    shard E over `ep`, and the token exchange is ONE all_to_all pair
+    over the ep axis (distributed/utils/moe_utils.py) — the explicit
+    form of what the propagation path gets from a sharding constraint.
+    """
+    mb, s_loc, H = x.shape
+    n_full = mb * s_loc
+    flat = x.reshape(n_full, H)
+    E = p["gate_w"].shape[-1]
+    gate_w = p["gate_w"]
+    if ep_size > 1 and explicit_bwd:
+        # replicated router weight, per-GROUP tokens: its per-rank grad
+        # covers only this rank's group — psum over ep in the backward
+        # (the ep analogue of Megatron's copy_to_region boundary)
+        gate_w = copy_to_tp_region(gate_w, "ep")
+    if ep_size > 1:
+        # tokens are REPLICATED across ep (data shards over dp/sp only):
+        # each ep rank must dispatch a DISTINCT token group, or every
+        # token reaches the experts ep times (ep-times compute and
+        # ep-scaled expert grads). Slice this rank's group through the
+        # custom-vjp boundary; outputs merge back through its pair.
+        if n_full % ep_size:
+            raise ValueError("local token count must divide by ep degree")
+        n = n_full // ep_size
+        if explicit_bwd:
+            # per-stage jax.vjp (1F1B): replicated activations carry FULL
+            # per-rank cotangents, so the plain slice/all_gather
+            # transposes (scatter / reduce-scatter) would double-count —
+            # route through the custom-vjp boundary pair instead
+            ep_split, ep_merge = _make_ep_boundaries(ep_size)
+            flat = ep_split(flat)
+        else:
+            r = lax.axis_index("ep")
+            flat = lax.dynamic_slice_in_dim(flat, r * n, n, axis=0)
+    else:
+        n = n_full
+    from paddle_tpu.distributed.moe import (_capacity,
+                                            gshard_dispatch_combine)
+    probs = jax.nn.softmax(flat @ gate_w, axis=-1)             # [n, E]
+    capacity = _capacity(n, E, top_k, capacity_factor)
+    combine, dispatch = gshard_dispatch_combine(probs, top_k, capacity)
+
+    xin = jnp.einsum("nec,nd->ecd", dispatch, flat)            # [E, C, H]
+    if ep_size > 1:
+        xin = lax.all_to_all(xin, "ep", split_axis=0, concat_axis=1,
+                             tiled=True)        # [E/ep, ep*C, H]
+    h1 = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xin, p["moe_w1"])
+                     + p["moe_b1"][:, None, :], approximate=True)
+    out = jnp.einsum("ecf,efd->ecd", h1, p["moe_w2"]) \
+        + p["moe_b2"][:, None, :]
+    if ep_size > 1:
+        out = lax.all_to_all(out, "ep", split_axis=1, concat_axis=0,
+                             tiled=True)        # back to [E, C, H]
+    y = jnp.einsum("nec,ecd->nd", combine, out)
+    if ep_size > 1:
+        # reassemble the full replicated token set from the ep groups
+        if explicit_bwd:
+            y = ep_merge(y)
+        else:
+            y = lax.all_gather(y, "ep", axis=0, tiled=True)
+    return y.reshape(mb, s_loc, H)
+
+
+def _decoder_block(p, h, num_heads_local, sp_size, explicit_tp_bwd=False,
+                   moe_top_k=2, moe_capacity_factor=2.0, ep_size=1):
     """One decoder layer on local shards: tp-split heads/ffn, sp-ring attn.
     h: [mb, s_loc, H]. p leaves are single-layer (no leading layer dim).
 
@@ -174,10 +314,17 @@ def _decoder_block(p, h, num_heads_local, sp_size, explicit_tp_bwd=False):
     attn = o @ p["w_o"]                        # partial sums over tp shard
     attn = reduce(attn) + p["b_o"]             # row-parallel reduce
     h = h + attn
-    # --- mlp ---
+    # --- mlp / moe ---
     x = _layer_norm(h, p["ln2_g"], p["ln2_b"])
-    y = jax.nn.gelu(enter(x) @ p["w1"] + p["b1"], approximate=True)
-    y = reduce(y @ p["w2"]) + p["b2"]          # row-parallel reduce
+    if "gate_w" in p:
+        # MoE branch: no tp collectives (experts shard over ep; the
+        # router and dispatch replicate over tp)
+        y = _moe_ffn(p, x.astype(h.dtype), moe_top_k,
+                     moe_capacity_factor, ep_size,
+                     explicit_bwd=explicit_tp_bwd)
+    else:
+        y = jax.nn.gelu(enter(x) @ p["w1"] + p["b1"], approximate=True)
+        y = reduce(y @ p["w2"]) + p["b2"]      # row-parallel reduce
     return h + y
 
 
@@ -223,16 +370,28 @@ def _check_layout(cfg, virtual_chunks):
 
 def _hybrid_degrees(cfg, mesh):
     """Validate cfg divisibility against the mesh; returns
-    (tp, sp, pp, heads_local) — shared by both schedule factories."""
+    (tp, sp, pp, ep, heads_local) — shared by the schedule factories."""
     shape = dict(mesh.shape)
     tp, sp, pp = shape["tp"], shape["sp"], shape["pp"]
+    ep = shape.get("ep", 1)
     if cfg.num_heads % tp:
         raise ValueError("num_heads must divide by tp degree")
     if cfg.num_layers % pp:
         raise ValueError("num_layers must divide by pp degree")
     if cfg.vocab_size % tp:
         raise ValueError("vocab_size must divide by tp degree")
-    return tp, sp, pp, cfg.num_heads // tp
+    E = int(getattr(cfg, "moe_num_experts", 0) or 0)
+    if E and E % ep:
+        raise ValueError("moe_num_experts must divide by ep degree")
+    if ep > 1 and not E:
+        raise ValueError("mesh has ep > 1 but cfg.moe_num_experts is 0")
+    return tp, sp, pp, ep, cfg.num_heads // tp
+
+
+def _moe_knobs(cfg):
+    """(top_k, train capacity factor) resolved once for both factories."""
+    cf = getattr(cfg, "moe_capacity_factor", (2.0, 2.0)) or (2.0, 2.0)
+    return getattr(cfg, "moe_top_k", 2), cf[0]
 
 
 def _embed_fn(ids, num_microbatches, explicit_bwd):
@@ -266,15 +425,18 @@ def make_hybrid_loss_fn(cfg, mesh, num_microbatches=2, pipeline="gpipe",
     Both differentiate via outer AD; the explicit 1F1B schedule lives in
     make_hybrid_grad_fn.
     """
-    tp, sp, pp, heads_local = _hybrid_degrees(cfg, mesh)
+    tp, sp, pp, ep, heads_local = _hybrid_degrees(cfg, mesh)
     _check_layout(cfg, virtual_chunks if pipeline == "interleave" else 1)
     M = num_microbatches
+    moe = bool(getattr(cfg, "moe_num_experts", 0))
 
     def local_loss(params, ids, labels):
         b_loc, s_loc = ids.shape
         h = _embed_fn(ids, M, False)(params["wte"], params["wpe"])
-        block = functools.partial(_decoder_block,
-                                  num_heads_local=heads_local, sp_size=sp)
+        moe_top_k, moe_cf = _moe_knobs(cfg)
+        block = functools.partial(
+            _decoder_block, num_heads_local=heads_local, sp_size=sp,
+            moe_top_k=moe_top_k, moe_capacity_factor=moe_cf, ep_size=ep)
         if pipeline == "interleave":
             v = virtual_chunks
 
@@ -302,7 +464,7 @@ def make_hybrid_loss_fn(cfg, mesh, num_microbatches=2, pipeline="gpipe",
         count = lax.psum(jnp.asarray(nll.size, jnp.float32), ("dp", "sp"))
         return total / count
 
-    specs = hybrid_param_specs()
+    specs = hybrid_param_specs(moe=moe)
     data_spec = P("dp", "sp")
     return jax.shard_map(local_loss, mesh=mesh,
                          in_specs=(specs, data_spec, data_spec),
@@ -325,17 +487,20 @@ def make_hybrid_grad_fn(cfg, mesh, num_microbatches=2):
 
     Returns fn(params, ids, labels) -> (loss, grads) for the whole mesh.
     """
-    tp, sp, pp, heads_local = _hybrid_degrees(cfg, mesh)
+    tp, sp, pp, ep, heads_local = _hybrid_degrees(cfg, mesh)
     M = num_microbatches
+    moe = bool(getattr(cfg, "moe_num_experts", 0))
 
     def local_step(params, ids, labels):
         b_loc, s_loc = ids.shape
         embed = _embed_fn(ids, M, True)
         h_mb, embed_vjp = jax.vjp(embed, params["wte"], params["wpe"])
         labels_mb = labels.reshape(M, b_loc // M, s_loc)
-        block = functools.partial(_decoder_block,
-                                  num_heads_local=heads_local, sp_size=sp,
-                                  explicit_tp_bwd=True)
+        moe_top_k, moe_cf = _moe_knobs(cfg)
+        block = functools.partial(
+            _decoder_block, num_heads_local=heads_local, sp_size=sp,
+            explicit_tp_bwd=True,
+            moe_top_k=moe_top_k, moe_capacity_factor=moe_cf, ep_size=ep)
 
         def stage_fn(stage_params, x):
             def one(xc, pl):
@@ -374,7 +539,7 @@ def make_hybrid_grad_fn(cfg, mesh, num_microbatches=2):
             lambda g: lax.psum(g, ("dp", "sp")) * inv, grads)
         return total * inv, grads
 
-    specs = hybrid_param_specs()
+    specs = hybrid_param_specs(moe=moe)
     data_spec = P("dp", "sp")
     return jax.shard_map(local_step, mesh=mesh,
                          in_specs=(specs, data_spec, data_spec),
