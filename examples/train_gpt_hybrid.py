@@ -4,6 +4,9 @@
 Usage (8 virtual CPU devices; on a pod the same code uses real chips):
   XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
       python examples/train_gpt_hybrid.py --steps 5
+  # full 5-axis with MoE experts over ep:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python examples/train_gpt_hybrid.py --sp 1 --ep 2 --experts 4
 
 Covers: distributed.mesh, models.gpt_hybrid (shard_map + ppermute
 pipeline + Megatron tp psums + sp ring attention + vocab-parallel CE),
@@ -28,16 +31,22 @@ def main():
     ap.add_argument("--pp", type=int, default=2)
     ap.add_argument("--tp", type=int, default=2)
     ap.add_argument("--sp", type=int, default=2)
+    ap.add_argument("--ep", type=int, default=1)
+    ap.add_argument("--experts", type=int, default=0,
+                    help="MoE experts per layer (0 = dense FFN)")
     ap.add_argument("--schedule", default="1f1b",
                     choices=["gpipe", "1f1b", "interleave"])
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--microbatches", type=int, default=2)
     args = ap.parse_args()
 
-    mesh = init_mesh(dict(dp=args.dp, pp=args.pp, tp=args.tp, sp=args.sp))
+    mesh = init_mesh(dict(dp=args.dp, pp=args.pp, tp=args.tp, sp=args.sp,
+                          ep=args.ep))
     cfg = GPTConfig(vocab_size=256, hidden_size=64,
                     num_layers=2 * args.pp, num_heads=max(4, 2 * args.tp),
-                    max_seq_len=64 * args.sp, dropout=0.0)
+                    max_seq_len=64 * args.sp, dropout=0.0,
+                    moe_num_experts=args.experts, moe_top_k=2,
+                    moe_capacity_factor=(2.0, 2.0))
     params = init_hybrid_gpt_params(cfg, mesh, seed=0)
     step = make_hybrid_train_step(cfg, mesh, lr=1e-2,
                                   num_microbatches=args.microbatches,
@@ -56,7 +65,9 @@ def main():
 
     for i in range(args.steps):
         params, loss = step(params, ids, labels)
-        print(f"step {i} [{args.schedule}] loss {float(loss):.4f}")
+        kind = f"{args.experts} experts/ep{args.ep}" if args.experts \
+            else "dense"
+        print(f"step {i} [{args.schedule}, {kind}] loss {float(loss):.4f}")
 
 
 if __name__ == "__main__":

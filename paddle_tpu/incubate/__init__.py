@@ -8,6 +8,7 @@ re-exported from them (flash attention lives in ops/pallas +
 nn.functional.scaled_dot_product_attention).
 """
 from paddle_tpu.incubate import asp  # noqa: F401
+from paddle_tpu.incubate import autotune  # noqa: F401
 from paddle_tpu.incubate import autograd  # noqa: F401
 from paddle_tpu.incubate import distributed  # noqa: F401
 from paddle_tpu.incubate import nn  # noqa: F401

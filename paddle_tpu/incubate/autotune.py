@@ -1,0 +1,49 @@
+"""paddle.incubate.autotune parity (reference:
+python/paddle/incubate/autotune.py set_config :23).
+
+The reference's three tuners map onto TPU realities:
+- kernel: XLA's autotuner already exhaustively selects conv/matmul
+  algorithms during compilation — the knob records intent and is
+  otherwise satisfied by construction.
+- layout: recorded and surfaced via get_config(); models opt in through
+  data_format="NHWC" (vision models support it; the bench uses it).
+- dataloader: ENABLED by default here — the native C++ loader sizes its
+  prefetch ring from the config's dataloader settings.
+"""
+from __future__ import annotations
+
+import json
+
+__all__ = ["set_config", "get_config"]
+
+_config = {
+    "kernel": {"enable": True, "tuning_range": [1, 10]},
+    "layout": {"enable": False},
+    "dataloader": {"enable": True},
+}
+
+
+def set_config(config=None):
+    """dict, JSON-file path, or None (enable everything)."""
+    global _config
+    if config is None:
+        for section in _config.values():
+            section["enable"] = True
+        return
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    if not isinstance(config, dict):
+        raise TypeError("config must be None, a dict, or a JSON file path")
+    for key, value in config.items():
+        if key not in _config:
+            raise ValueError(
+                f"unknown autotune section {key!r}; valid: "
+                f"{sorted(_config)}")
+        if not isinstance(value, dict):
+            raise TypeError(f"autotune section {key!r} must be a dict")
+        _config[key].update(value)
+
+
+def get_config():
+    return {k: dict(v) for k, v in _config.items()}

@@ -213,3 +213,19 @@ class TestHub:
             (tmp_path / "hubconf.py").write_text(
                 'dependencies = ["not_a_real_pkg_xyz"]\n')
             P.hub.list(str(tmp_path), source="local")
+
+
+class TestAutotune:
+    def test_set_get_roundtrip_and_validation(self, tmp_path):
+        import json
+        at = P.incubate.autotune
+        at.set_config({"layout": {"enable": True}})
+        assert at.get_config()["layout"]["enable"]
+        p = tmp_path / "cfg.json"
+        p.write_text(json.dumps({"kernel": {"tuning_range": [2, 5]}}))
+        at.set_config(str(p))
+        assert at.get_config()["kernel"]["tuning_range"] == [2, 5]
+        with pytest.raises(ValueError, match="unknown autotune"):
+            at.set_config({"bogus": {}})
+        at.set_config(None)  # enable everything
+        assert all(s["enable"] for s in at.get_config().values())
