@@ -116,6 +116,8 @@ def _bench_resnet50(on_tpu):
     # ceiling (arithmetic intensity ~65 flop/byte < v5e ridge ~240).
     extra = {}
     try:
+        if not on_tpu:
+            raise RuntimeError("hbm roofline keys are TPU-only")
         import jax
         jitted, _, state_list = next(iter(train_step._compiled.values()))
         cost = jitted.lower([t._value for t in state_list],
