@@ -224,6 +224,13 @@ def test_hybrid_interleaved_train_step(meshes):
     assert losses[-1] < losses[0], losses
 
 
+def _moe_cfg(num_layers=4):
+    return GPTConfig(vocab_size=96, hidden_size=32, num_layers=num_layers,
+                     num_heads=4, max_seq_len=64, dropout=0.0,
+                     moe_num_experts=4, moe_top_k=2,
+                     moe_capacity_factor=(64.0, 64.0))
+
+
 def test_hybrid_moe_5axis_matches_single_device(meshes):
     """The FULL 5-axis composition (dp x pp x tp x sp x ep) in one
     shard_map program: GShard expert FFNs (grouped per-ep-rank dispatch,
@@ -232,13 +239,7 @@ def test_hybrid_moe_5axis_matches_single_device(meshes):
     loss AND all grads must match the same math on one device."""
     from paddle_tpu.models.gpt_hybrid import make_hybrid_grad_fn
 
-    def moe_cfg():
-        return GPTConfig(vocab_size=96, hidden_size=32, num_layers=4,
-                         num_heads=4, max_seq_len=64, dropout=0.0,
-                         moe_num_experts=4, moe_top_k=2,
-                         moe_capacity_factor=(64.0, 64.0))
-
-    cfg = moe_cfg()
+    cfg = _moe_cfg()
     mesh8 = mesh_mod.init_mesh({"dp": 1, "pp": 2, "tp": 2, "sp": 1,
                                 "ep": 2})
     params8 = init_hybrid_gpt_params(cfg, mesh8, seed=0)
@@ -249,7 +250,7 @@ def test_hybrid_moe_5axis_matches_single_device(meshes):
     l8f, g8f = jax.jit(make_hybrid_grad_fn(cfg, mesh8, 2))(
         params8, ids8, labels8)
 
-    cfg1 = moe_cfg()
+    cfg1 = _moe_cfg()
     mesh1 = mesh_mod.init_mesh(
         {"dp": 1, "pp": 1, "tp": 1, "sp": 1, "ep": 1},
         devices=jax.devices()[:1])
@@ -272,13 +273,7 @@ def test_hybrid_moe_with_dp_sp_groups(meshes):
     ('dp','sp') psum of ep-sharded expert grads and per-group routing
     must still reproduce single-device math (ample capacity keeps
     routing decisions token-independent)."""
-    def moe_cfg():
-        return GPTConfig(vocab_size=96, hidden_size=32, num_layers=2,
-                         num_heads=4, max_seq_len=64, dropout=0.0,
-                         moe_num_experts=4, moe_top_k=2,
-                         moe_capacity_factor=(64.0, 64.0))
-
-    cfg = moe_cfg()
+    cfg = _moe_cfg(num_layers=2)
     mesh8 = mesh_mod.init_mesh({"dp": 2, "pp": 1, "tp": 1, "sp": 2,
                                 "ep": 2})
     params8 = init_hybrid_gpt_params(cfg, mesh8, seed=0)
@@ -287,7 +282,7 @@ def test_hybrid_moe_with_dp_sp_groups(meshes):
     l8, g8 = jax.jit(jax.value_and_grad(
         make_hybrid_loss_fn(cfg, mesh8, 2)))(params8, ids8, labels8)
 
-    cfg1 = moe_cfg()
+    cfg1 = _moe_cfg(num_layers=2)
     mesh1 = mesh_mod.init_mesh(
         {"dp": 1, "pp": 1, "tp": 1, "sp": 1, "ep": 1},
         devices=jax.devices()[:1])
@@ -324,3 +319,36 @@ def test_hybrid_moe_trains_with_capacity_drops(meshes):
         losses.append(float(loss))
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0], losses
+
+
+@pytest.mark.nightly  # the 1f1b + gpipe MoE parities run in the gate;
+# this confirms the interleaved virtual-stage schedule composes with the
+# expert banks too (stage-tree reshape carries the [L, E, ...] leaves)
+def test_hybrid_moe_interleaved_matches_single_device(meshes):
+    cfg = _moe_cfg()
+    V = 2
+    mesh8 = mesh_mod.init_mesh({"dp": 1, "pp": 2, "tp": 2, "sp": 1,
+                                "ep": 2})
+    params8 = init_hybrid_gpt_params(cfg, mesh8, seed=0, virtual_chunks=V)
+    ids8, labels8 = _data(mesh8)
+    l8, g8 = jax.jit(jax.value_and_grad(make_hybrid_loss_fn(
+        cfg, mesh8, 2, pipeline="interleave", virtual_chunks=V)))(
+        params8, ids8, labels8)
+
+    cfg1 = _moe_cfg()
+    mesh1 = mesh_mod.init_mesh(
+        {"dp": 1, "pp": 1, "tp": 1, "sp": 1, "ep": 1},
+        devices=jax.devices()[:1])
+    params1 = init_hybrid_gpt_params(cfg1, mesh1, seed=0)
+    ids1, labels1 = _data(mesh1)
+    l1, g1 = jax.jit(jax.value_and_grad(make_hybrid_loss_fn(
+        cfg1, mesh1, 2)))(params1, ids1, labels1)
+    np.testing.assert_allclose(float(l8), float(l1), rtol=2e-5)
+    # grads too, mapped back through the interleave layer permutation
+    from paddle_tpu.distributed.pipeline import interleave_layer_permutation
+    perm = interleave_layer_permutation(cfg.num_layers, 2, V)
+    inv = np.argsort(perm)
+    for key, a in g8["stages"].items():
+        b = np.asarray(g1["stages"][key])
+        np.testing.assert_allclose(np.asarray(a)[inv], b,
+                                   atol=2e-4, rtol=2e-3)
