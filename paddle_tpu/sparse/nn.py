@@ -115,3 +115,78 @@ class BatchNorm(Layer):
         idx = jnp.swapaxes(x._bcoo.indices, 0, 1)
         return sparse.SparseCooTensor(idx, out_vals._value, x._bcoo.shape,
                                       x.stop_gradient)
+
+
+class LeakyReLU(Layer):
+    """Zero-preserving leaky ReLU on stored values (reference
+    sparse/nn/layer/activation.py LeakyReLU)."""
+
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        from paddle_tpu import sparse
+        slope = self.negative_slope
+        return sparse._unary_on_values(
+            lambda v: jnp.where(v >= 0, v, slope * v))(x)
+
+
+class ReLU6(Layer):
+    """min(max(0, v), 6) on stored values (reference ReLU6)."""
+
+    def forward(self, x):
+        from paddle_tpu import sparse
+        return sparse._unary_on_values(
+            lambda v: jnp.clip(v, 0.0, 6.0))(x)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica BatchNorm over sparse activations: on TPU the
+    statistics sync falls out of jit over the mesh (the same design as
+    dense nn.SyncBatchNorm), so this shares BatchNorm's implementation
+    (reference sparse/nn/layer/norm.py SyncBatchNorm)."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        return layer
+
+
+class MaxPool3D(Layer):
+    """Max pool over sparse NDHWC activations (reference
+    sparse/nn/layer/pooling.py MaxPool3D): like the reference's rulebook
+    kernel, the max runs over ACTIVE (stored) sites only — implicit
+    zeros do not participate, so all-negative active windows keep their
+    true (negative) max — and windows with no active site produce no
+    output entry."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        if data_format != "NDHWC":
+            raise ValueError(
+                "sparse MaxPool3D only supports data_format='NDHWC' "
+                "(the reference kernel has the same contract)")
+        self.kernel_size = kernel_size
+        self.stride = stride or kernel_size
+        self.padding = padding
+
+    def forward(self, x):
+        from paddle_tpu import sparse
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.nn.functional.pooling import max_pool3d
+
+        dense = x._value                         # [N, D, H, W, C]
+        idx = x._bcoo.indices                    # [nnz, 5]
+        mask = jnp.zeros(dense.shape, jnp.float32).at[
+            tuple(idx[:, i] for i in range(idx.shape[1]))].set(1.0)
+        neg_inf = jnp.asarray(-jnp.inf, dense.dtype)
+        masked = jnp.where(mask > 0, dense, neg_inf)
+        pooled = max_pool3d(Tensor(masked), self.kernel_size,
+                            stride=self.stride, padding=self.padding,
+                            data_format="NDHWC")._value
+        pooled_mask = max_pool3d(Tensor(mask), self.kernel_size,
+                                 stride=self.stride, padding=self.padding,
+                                 data_format="NDHWC")._value
+        out = jnp.where(pooled_mask > 0, pooled, 0.0)
+        return sparse.to_sparse_coo(Tensor(out), out.ndim)

@@ -198,3 +198,40 @@ class TestLazySparse:
             np.asarray(t.indices().numpy()), [[0, 0, 1], [0, 2, 1]])
         np.testing.assert_allclose(t.to_dense().numpy(),
                                    [[1, 0, 2], [0, 3, 0]])
+
+
+class TestSparseNNAdditions:
+    def test_leaky_relu6_zero_preserving(self):
+        import paddle_tpu as P
+        s = P.sparse
+        idx = P.to_tensor(np.array([[0, 0], [1, 2]]), dtype="int64")
+        vals = P.to_tensor(np.array([-2.0, 8.0], np.float32))
+        x = s.sparse_coo_tensor(idx, vals, [2, 4])
+        lr = s.nn.LeakyReLU(0.1)(x).to_dense().numpy()
+        np.testing.assert_allclose(lr[0, 1], -0.2, rtol=1e-6)
+        assert lr[1].sum() == 0.0  # implicit zeros stay zero
+        r6 = s.nn.ReLU6()(x).to_dense().numpy()
+        np.testing.assert_allclose(r6[0, 2], 6.0)
+
+    def test_maxpool3d_and_sync_bn(self):
+        import paddle_tpu as P
+        s = P.sparse
+        vol = np.zeros((1, 2, 2, 2, 1), np.float32)
+        vol[0, 0, 0, 0, 0] = 5.0
+        sp = s.to_sparse_coo(P.to_tensor(vol), 5)
+        out = s.nn.MaxPool3D(2)(sp)
+        assert float(out.to_dense().numpy().max()) == 5.0
+        bn = s.nn.SyncBatchNorm(4)
+        assert isinstance(bn, s.nn.BatchNorm)
+
+    def test_maxpool3d_active_sites_only(self):
+        import paddle_tpu as P
+        s = P.sparse
+        vol = np.zeros((1, 2, 2, 2, 1), np.float32)
+        vol[0, 0, 0, 0, 0] = -5.0  # only active value is negative
+        sp = s.to_sparse_coo(P.to_tensor(vol), 5)
+        out = s.nn.MaxPool3D(2)(sp).to_dense().numpy()
+        # reference rulebook semantics: implicit zeros do NOT win the max
+        np.testing.assert_allclose(out[0, 0, 0, 0, 0], -5.0)
+        with pytest.raises(ValueError, match="NDHWC"):
+            s.nn.MaxPool3D(2, data_format="NCDHW")
