@@ -1,6 +1,7 @@
 """paddle.sparse: COO/CSR construction, BCOO spmm, zero-preserving unary
 ops, sparse nn layers."""
 import numpy as np
+import pytest
 
 import paddle_tpu
 from paddle_tpu import sparse
