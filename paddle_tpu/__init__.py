@@ -75,8 +75,10 @@ from paddle_tpu import (  # noqa: F401,E402
     metric,
     nn,
     optimizer,
+    onnx,
     profiler,
     quantization,
+    regularizer,
     signal,
     static,
     sparse,

@@ -17,6 +17,9 @@ from paddle_tpu.autograd.functional import (  # noqa: F401
     jvp,
     vjp,
 )
+from paddle_tpu.autograd.saved_tensors_hooks import (  # noqa: F401
+    saved_tensors_hooks,
+)
 
 
 def backward(tensors, grad_tensors=None, retain_graph=False):
@@ -57,16 +60,42 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
 class PyLayerContext:
     def __init__(self):
         self._saved = ()
+        self._saved_hooks = None
+        self._packed_mask = ()
 
     def save_for_backward(self, *tensors):
-        self._saved = tensors
+        from paddle_tpu.autograd.saved_tensors_hooks import current_hooks
+        hooks = current_hooks()
+        if hooks is not None:
+            pack, _ = hooks
+            # pack only Tensors; non-tensor metadata passes through and
+            # must not be run through unpack at backward time
+            self._saved = tuple(pack(t) if isinstance(t, Tensor) else t
+                                for t in tensors)
+            self._packed_mask = tuple(isinstance(t, Tensor) for t in tensors)
+            self._saved_hooks = hooks
+        else:
+            self._saved = tensors
+
+    def _unpacked(self):
+        if self._saved_hooks is None:
+            return self._saved
+        _, unpack = self._saved_hooks
+        out = []
+        for p, was_packed in zip(self._saved, self._packed_mask):
+            if not was_packed:
+                out.append(p)
+                continue
+            u = unpack(p)
+            out.append(u if isinstance(u, Tensor) else Tensor(u))
+        return tuple(out)
 
     @property
     def saved_tensor(self):
-        return self._saved
+        return self._unpacked()
 
     def saved_tensors(self):
-        return self._saved
+        return self._unpacked()
 
 
 class PyLayer:

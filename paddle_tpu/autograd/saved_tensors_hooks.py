@@ -1,0 +1,64 @@
+"""saved_tensors_hooks — intercept what the autograd engine saves for backward.
+
+Reference: python/paddle/autograd/saved_tensors_hooks.py:20 (pack_hook runs
+when an op saves a tensor for its grad computation; unpack_hook runs when the
+backward pass consumes it — the hook point for activation offload /
+compression).
+
+TPU-native design: in this engine the "tensors saved for backward" are the
+residuals captured by the eager ``jax.vjp`` closure of each recorded op
+(core/dispatch.py:apply). While a hook pair is active, ``apply`` does NOT
+retain that closure: it packs the op's differentiable *input* values through
+``pack_hook`` (e.g. ``lambda t: t.numpy()`` moves them to host RAM) and the
+pullback re-runs ``jax.vjp`` from the unpacked inputs at backward time —
+op-granular rematerialization with user-controlled storage, which is exactly
+the offload/compression use case. ``PyLayer.save_for_backward`` /
+``ctx.saved_tensor`` route through the same hooks, matching the reference's
+PyLayer contract. (Under ``to_static`` the whole step is one XLA program;
+memory there is managed with ``recompute``/remat, not eager hooks.)
+"""
+from __future__ import annotations
+
+import threading
+
+
+class _HookState(threading.local):
+    def __init__(self):
+        self.stack = []
+
+
+_state = _HookState()
+
+
+def current_hooks():
+    """The innermost active (pack_hook, unpack_hook) pair, or None."""
+    return _state.stack[-1] if _state.stack else None
+
+
+class saved_tensors_hooks:
+    """Context manager registering a pack/unpack hook pair.
+
+    Example (offload eager activations to host RAM)::
+
+        def pack(t):
+            return t.numpy()            # device -> host copy
+
+        def unpack(packed):
+            return paddle_tpu.to_tensor(packed)
+
+        with paddle_tpu.autograd.saved_tensors_hooks(pack, unpack):
+            y = model(x)
+        y.backward()                     # unpack runs here
+    """
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        _state.stack.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        _state.stack.pop()
+        return False

@@ -58,11 +58,43 @@ def apply(fn, *args, **kwargs):
             vs[i] = v
         return run(vs)
 
-    out_val, pull = jax.vjp(closed, [vals[i] for i in diff_idx])
+    from paddle_tpu.autograd.saved_tensors_hooks import current_hooks
+    hooks = current_hooks()
+    if hooks is None:
+        out_val, pull = jax.vjp(closed, [vals[i] for i in diff_idx])
 
-    def pullback(cot):
-        (gs,) = pull(cot)
-        return gs
+        def pullback(cot):
+            (gs,) = pull(cot)
+            return gs
+    else:
+        # saved_tensors_hooks active: save packed(inputs) instead of the
+        # jax.vjp residual closure; recompute the vjp from the unpacked
+        # inputs at backward time (see autograd/saved_tensors_hooks.py)
+        pack_hook, unpack_hook = hooks
+        out_val = run(vals)
+        packed = [pack_hook(Tensor(vals[i], stop_gradient=True))
+                  for i in diff_idx]
+        # drop the closure's device references to the packed inputs so the
+        # packed form (e.g. a host copy) is the only thing the tape retains
+        held = list(vals)
+        for i in diff_idx:
+            held[i] = None
+
+        def closed_late(diff_vals):
+            vs = list(held)
+            for i, v in zip(diff_idx, diff_vals):
+                vs[i] = v
+            return run(vs)
+
+        def pullback(cot):
+            restored = []
+            for p in packed:
+                u = unpack_hook(p)
+                restored.append(u._value if isinstance(u, Tensor)
+                                else jnp.asarray(u))
+            _, pull = jax.vjp(closed_late, restored)
+            (gs,) = pull(cot)
+            return gs
 
     in_tensors = [leaves[i] for i in diff_idx]
     if isinstance(out_val, tuple):

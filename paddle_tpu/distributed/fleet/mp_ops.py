@@ -134,3 +134,54 @@ def vocab_parallel_cross_entropy(logits_local, labels, axis_name="tp",
         axis=-1)[..., 0]
     tgt = reduce(jnp.where(ok, tgt, 0.0))
     return jnp.log(denom) + m - tgt
+
+
+def parallel_margin_cross_entropy(logits_local, labels, margin1=1.0,
+                                  margin2=0.5, margin3=0.0, scale=64.0,
+                                  axis_name="tp", return_softmax=False,
+                                  explicit_bwd=False):
+    """ArcFace margin softmax over CLASS-SHARDED cosine logits.
+
+    Reference: python/paddle/nn/functional/loss.py margin_cross_entropy's
+    group-parallel path (c_margin_cross_entropy: each rank owns a class
+    shard; only the rank owning the target class applies the margin, then
+    the softmax runs as the usual two-allreduce sharded logsumexp).
+
+    logits_local: [N, C/tp] cosine similarities (this shard's classes).
+    labels:       [N] GLOBAL class ids.
+    Returns per-sample nll [N] (replicated over `axis_name`); with
+    return_softmax=True also the LOCAL softmax shard [N, C/tp].
+    """
+    if explicit_bwd:
+        def reduce(x):
+            return reduce_from_tp_region(x, axis_name)
+    else:
+        def reduce(x):
+            return lax.psum(x, axis_name)
+    idx = lax.axis_index(axis_name)
+    v_loc = logits_local.shape[-1]
+    local_lab = labels.reshape(-1).astype(jnp.int32) - idx * v_loc
+    ok = (local_lab >= 0) & (local_lab < v_loc)
+    # stay inside arccos' differentiable domain (cos==±1 -> d/dx = ∓inf)
+    cos = jnp.clip(logits_local, -1.0 + 1e-6, 1.0 - 1e-6)
+    tgt_cos = jnp.take_along_axis(
+        cos, jnp.clip(local_lab, 0, v_loc - 1)[:, None], axis=-1)[..., 0]
+    theta = jnp.arccos(tgt_cos)
+    adjusted_tgt = jnp.cos(margin1 * theta + margin2) - margin3
+    onehot_local = (jnp.arange(v_loc)[None, :] == local_lab[:, None]) & \
+        ok[:, None]
+    z = jnp.where(onehot_local, adjusted_tgt[:, None], cos) * scale
+    # sharded logsumexp CE inlined (same math as
+    # vocab_parallel_cross_entropy) so the softmax branch reuses m/denom
+    # instead of issuing a second all_gather + psum pair
+    m = lax.stop_gradient(jnp.max(
+        lax.all_gather(jnp.max(z, axis=-1), axis_name), axis=0))
+    denom = reduce(jnp.sum(jnp.exp(z - m[..., None]), axis=-1))
+    tgt = jnp.take_along_axis(
+        z, jnp.clip(local_lab, 0, v_loc - 1)[:, None], axis=-1)[..., 0]
+    tgt = reduce(jnp.where(ok, tgt, 0.0))
+    nll = jnp.log(denom) + m - tgt
+    if not return_softmax:
+        return nll
+    softmax_local = jnp.exp(z - m[..., None]) / denom[..., None]
+    return nll, softmax_local

@@ -1,4 +1,5 @@
 from . import moe_utils  # noqa: F401
+from .moe_utils import global_gather, global_scatter  # noqa: F401
 from .launch_utils import (  # noqa: F401
     Cluster,
     Hdfs,

@@ -15,7 +15,9 @@ from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.framework.state import register_state_tensor
 from paddle_tpu.optimizer.optimizer import Optimizer
 
-__all__ = ["LookAhead", "ModelAverage"]
+from paddle_tpu.incubate.optimizer import functional  # noqa: F401
+
+__all__ = ["LookAhead", "ModelAverage", "functional"]
 
 
 def _state(name, value):

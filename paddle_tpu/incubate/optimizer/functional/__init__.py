@@ -1,0 +1,9 @@
+"""paddle.incubate.optimizer.functional parity namespace.
+
+Reference: python/paddle/incubate/optimizer/functional/__init__.py
+(minimize_bfgs, minimize_lbfgs).
+"""
+from paddle_tpu.incubate.optimizer.functional.bfgs import minimize_bfgs  # noqa: F401
+from paddle_tpu.incubate.optimizer.functional.lbfgs import minimize_lbfgs  # noqa: F401
+
+__all__ = ["minimize_bfgs", "minimize_lbfgs"]

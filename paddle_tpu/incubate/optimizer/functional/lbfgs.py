@@ -1,0 +1,139 @@
+"""L-BFGS minimizer as one lax.while_loop program.
+
+Reference: python/paddle/incubate/optimizer/functional/lbfgs.py:27
+(minimize_lbfgs — limited-memory two-loop recursion, strong-Wolfe line
+search, same return tuple). TPU-native: the (s, y) history lives in two
+fixed-shape [m, n] device buffers addressed circularly, and the two-loop
+recursion is a pair of lax.fori_loop sweeps — everything, including the
+line search, compiles into a single XLA while loop.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.incubate.optimizer.functional.bfgs import (
+    _as_array,
+    _objective_as_fn,
+    _phi_maker,
+)
+from paddle_tpu.incubate.optimizer.functional.line_search import strong_wolfe
+
+
+def _two_loop(g, S, Y, rho, head, count, gamma, m):
+    """Direction -H g via the L-BFGS two-loop recursion.
+
+    S/Y: [m, n] circular buffers; head = next write slot; count = number
+    of valid pairs; gamma = y·s / y·y scaling of the seed H0.
+    """
+    q = g
+    alphas = jnp.zeros((m,), g.dtype)
+
+    def bwd(i, carry):
+        q, alphas = carry
+        # i = 0 is the NEWEST pair: slot (head - 1 - i) mod m
+        slot = jnp.mod(head - 1 - i, m)
+        valid = i < count
+        a = rho[slot] * jnp.dot(S[slot], q)
+        a = jnp.where(valid, a, 0.0)
+        q = q - a * Y[slot]
+        return q, alphas.at[slot].set(a)
+
+    q, alphas = lax.fori_loop(0, m, bwd, (q, alphas))
+    r = gamma * q
+
+    def fwd(i, r):
+        # oldest first: slot (head - count + i) mod m
+        slot = jnp.mod(head - count + i, m)
+        valid = i < count
+        b = rho[slot] * jnp.dot(Y[slot], r)
+        upd = (alphas[slot] - b) * S[slot]
+        return r + jnp.where(valid, upd, 0.0)
+
+    return lax.fori_loop(0, m, fwd, r)
+
+
+def minimize_lbfgs(objective_func, initial_position, history_size=100,
+                   max_iters=50, tolerance_grad=1e-8, tolerance_change=1e-8,
+                   initial_inverse_hessian_estimate=None,
+                   line_search_fn="strong_wolfe", max_line_search_iters=50,
+                   initial_step_length=1.0, dtype="float32", name=None):
+    if dtype not in ("float32", "float64"):
+        raise ValueError(f"dtype must be 'float32' or 'float64', got {dtype}")
+    if line_search_fn != "strong_wolfe":
+        raise NotImplementedError(
+            "only line_search_fn='strong_wolfe' is supported")
+    jdt = jnp.float32 if dtype == "float32" else jnp.float64
+
+    x0 = _as_array(initial_position, jdt)
+    n = x0.shape[0]
+    m = int(history_size)
+    f = _objective_as_fn(objective_func, jdt)
+    f_vg = jax.value_and_grad(f)
+
+    value0, g0 = f_vg(x0)
+    state = dict(
+        k=jnp.zeros((), jnp.int32),
+        done=jnp.zeros((), jnp.bool_),
+        is_converge=jnp.zeros((), jnp.bool_),
+        nfev=jnp.ones((), jnp.int32),
+        x=x0, value=value0, g=g0,
+        S=jnp.zeros((m, n), jdt), Y=jnp.zeros((m, n), jdt),
+        rho=jnp.zeros((m,), jdt),
+        head=jnp.zeros((), jnp.int32), count=jnp.zeros((), jnp.int32),
+        gamma=jnp.ones((), jdt),
+    )
+
+    def cond(s):
+        return (s["k"] < max_iters) & ~s["done"]
+
+    def body(s):
+        pk = -_two_loop(s["g"], s["S"], s["Y"], s["rho"], s["head"],
+                        s["count"], s["gamma"], m)
+        dphi0 = jnp.dot(s["g"], pk)
+        bad_dir = dphi0 >= 0
+        pk = jnp.where(bad_dir, -s["g"], pk)
+        dphi0 = jnp.where(bad_dir, -jnp.dot(s["g"], s["g"]), dphi0)
+
+        alpha, value2, g2, nfev = strong_wolfe(
+            _phi_maker(f_vg, s["x"], pk), s["g"],
+            alpha0=initial_step_length, phi0=s["value"], dphi0=dphi0,
+            max_iters=max_line_search_iters)
+        sk = alpha * pk
+        x2 = s["x"] + sk
+        yk = g2 - s["g"]
+        ys = jnp.dot(yk, sk)
+        store = ys > 1e-10
+        slot = s["head"]
+        S2 = jnp.where(store, s["S"].at[slot].set(sk), s["S"])
+        Y2 = jnp.where(store, s["Y"].at[slot].set(yk), s["Y"])
+        rho2 = jnp.where(store,
+                         s["rho"].at[slot].set(1.0 / jnp.where(store, ys, 1.0)),
+                         s["rho"])
+        head2 = jnp.where(store, jnp.mod(slot + 1, m), slot)
+        count2 = jnp.where(store, jnp.minimum(s["count"] + 1, m), s["count"])
+        gamma2 = jnp.where(store, ys / jnp.maximum(jnp.dot(yk, yk), 1e-30),
+                           s["gamma"])
+
+        g_inf = jnp.max(jnp.abs(g2))
+        converged = g_inf < tolerance_grad
+        stalled = (jnp.max(jnp.abs(sk)) < tolerance_change) | \
+            (jnp.abs(value2 - s["value"]) < tolerance_change)
+        return dict(
+            k=s["k"] + 1, done=converged | stalled,
+            is_converge=s["is_converge"] | converged,
+            nfev=s["nfev"] + nfev,
+            x=x2, value=value2, g=g2,
+            S=S2, Y=Y2, rho=rho2, head=head2, count=count2, gamma=gamma2,
+        )
+
+    state["is_converge"] = jnp.max(jnp.abs(g0)) < tolerance_grad
+    state["done"] = state["is_converge"]
+    out = lax.while_loop(cond, body, state)
+    return (Tensor(out["is_converge"].reshape(1)),
+            Tensor(out["nfev"].astype(jnp.int64).reshape(1)),
+            Tensor(out["x"]),
+            Tensor(out["value"].reshape(1)),
+            Tensor(out["g"]))

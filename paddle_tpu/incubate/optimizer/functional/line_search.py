@@ -1,0 +1,135 @@
+"""Strong-Wolfe line search as ONE lax.while_loop.
+
+Reference: python/paddle/incubate/optimizer/functional/line_search.py
+(strong_wolfe — Nocedal & Wright, Numerical Optimization 2e, Algorithms
+3.5 bracketing / 3.6 zoom).
+
+TPU-native: the reference builds the search out of nested static-graph
+while ops; here the bracket and zoom phases are a single
+``lax.while_loop`` state machine — each iteration evaluates phi at one
+trial step (bracket phase probes a growing alpha, zoom bisects), so the
+whole search compiles to one XLA loop with a single value_and_grad call
+in its body.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def strong_wolfe(phi_fn, g_example, alpha0=1.0, phi0=None, dphi0=None,
+                 c1=1e-4, c2=0.9, max_iters=50, alpha_max=1e3):
+    """Find alpha satisfying the strong Wolfe conditions.
+
+    phi_fn(alpha) -> (phi, dphi, g): line value, line derivative and the
+    full gradient at ``x + alpha * p`` (returned so the caller reuses it
+    for the quasi-Newton update without another gradient evaluation).
+
+    Returns (alpha_star, phi_star, g_star, n_func_evals).
+    """
+    dtype = jnp.asarray(phi0).dtype
+
+    state = dict(
+        i=jnp.zeros((), jnp.int32),
+        done=jnp.zeros((), jnp.bool_),
+        zoom=jnp.zeros((), jnp.bool_),
+        a_trial=jnp.asarray(alpha0, dtype),
+        a_prev=jnp.zeros((), dtype),
+        phi_prev=jnp.asarray(phi0, dtype),
+        dphi_prev=jnp.asarray(dphi0, dtype),
+        a_lo=jnp.zeros((), dtype),
+        phi_lo=jnp.asarray(phi0, dtype),
+        dphi_lo=jnp.asarray(dphi0, dtype),
+        a_hi=jnp.zeros((), dtype),
+        phi_hi=jnp.asarray(phi0, dtype),
+        a_star=jnp.zeros((), dtype),
+        phi_star=jnp.asarray(phi0, dtype),
+        g_star=jnp.asarray(g_example, dtype),
+        nfev=jnp.zeros((), jnp.int32),
+    )
+    phi0 = jnp.asarray(phi0, dtype)
+    dphi0 = jnp.asarray(dphi0, dtype)
+
+    def cond(s):
+        return (~s["done"]) & (s["i"] < max_iters)
+
+    def body(s):
+        a = jnp.where(s["zoom"], 0.5 * (s["a_lo"] + s["a_hi"]), s["a_trial"])
+        phi, dphi, g = phi_fn(a)
+        armijo_fail = phi > phi0 + c1 * a * dphi0
+        curv_ok = jnp.abs(dphi) <= -c2 * dphi0
+
+        # ---- bracket-phase transitions (Nocedal alg 3.5) ----
+        br_to_zoom1 = armijo_fail | ((s["i"] > 0) & (phi >= s["phi_prev"]))
+        br_accept = (~br_to_zoom1) & curv_ok
+        br_to_zoom2 = (~br_to_zoom1) & (~curv_ok) & (dphi >= 0)
+        br_continue = (~br_to_zoom1) & (~br_accept) & (~br_to_zoom2)
+
+        # ---- zoom-phase transitions (alg 3.6, bisection) ----
+        zo_shrink_hi = armijo_fail | (phi >= s["phi_lo"])
+        zo_accept = (~zo_shrink_hi) & curv_ok
+        zo_flip = (~zo_shrink_hi) & (~curv_ok) & \
+            (dphi * (s["a_hi"] - s["a_lo"]) >= 0)
+        # zoom interval collapsed -> bail out with the best point seen
+        zo_stall = s["zoom"] & (jnp.abs(s["a_hi"] - s["a_lo"])
+                                <= 1e-10 * jnp.maximum(1.0, jnp.abs(s["a_hi"])))
+
+        in_zoom = s["zoom"]
+        accept = jnp.where(in_zoom, zo_accept | zo_stall, br_accept)
+        enter_zoom = (~in_zoom) & (br_to_zoom1 | br_to_zoom2)
+
+        new = dict(s)
+        new["i"] = s["i"] + 1
+        new["nfev"] = s["nfev"] + 1
+        new["done"] = s["done"] | accept
+        new["zoom"] = in_zoom | enter_zoom
+        # entering zoom: zoom1 brackets (a_prev, a); zoom2 brackets (a, a_prev)
+        z1 = br_to_zoom1 & ~in_zoom
+        z2 = br_to_zoom2 & ~in_zoom
+        a_lo = jnp.where(z1, s["a_prev"], jnp.where(z2, a, s["a_lo"]))
+        phi_lo = jnp.where(z1, s["phi_prev"], jnp.where(z2, phi, s["phi_lo"]))
+        dphi_lo = jnp.where(z1, s["dphi_prev"],
+                            jnp.where(z2, dphi, s["dphi_lo"]))
+        a_hi = jnp.where(z1 | z2, jnp.where(z1, a, s["a_prev"]), s["a_hi"])
+        phi_hi = jnp.where(z1 | z2, jnp.where(z1, phi, s["phi_prev"]),
+                           s["phi_hi"])
+        # inside zoom: standard interval update
+        a_hi = jnp.where(in_zoom & zo_shrink_hi, a, a_hi)
+        phi_hi = jnp.where(in_zoom & zo_shrink_hi, phi, phi_hi)
+        a_hi = jnp.where(in_zoom & zo_flip, s["a_lo"], a_hi)
+        phi_hi = jnp.where(in_zoom & zo_flip, s["phi_lo"], phi_hi)
+        move_lo = in_zoom & (~zo_shrink_hi) & (~zo_accept)
+        a_lo = jnp.where(move_lo, a, a_lo)
+        phi_lo = jnp.where(move_lo, phi, phi_lo)
+        dphi_lo = jnp.where(move_lo, dphi, dphi_lo)
+        new.update(a_lo=a_lo, phi_lo=phi_lo, dphi_lo=dphi_lo,
+                   a_hi=a_hi, phi_hi=phi_hi)
+        # bracket phase bookkeeping
+        new["a_prev"] = jnp.where(br_continue & ~in_zoom, a, s["a_prev"])
+        new["phi_prev"] = jnp.where(br_continue & ~in_zoom, phi,
+                                    s["phi_prev"])
+        new["dphi_prev"] = jnp.where(br_continue & ~in_zoom, dphi,
+                                     s["dphi_prev"])
+        new["a_trial"] = jnp.where(br_continue & ~in_zoom,
+                                   jnp.minimum(2.0 * a, alpha_max),
+                                   s["a_trial"])
+        # record the accepted point (or best-so-far on stall)
+        took = accept & ~s["done"]
+        new["a_star"] = jnp.where(took, a, s["a_star"])
+        new["phi_star"] = jnp.where(took, phi, s["phi_star"])
+        new["g_star"] = jnp.where(took, g, s["g_star"])
+        return new
+
+    out = lax.while_loop(cond, body, state)
+    # if the search never accepted (max_iters hit), fall back to the last
+    # zoom midpoint / trial so the caller still makes progress
+    fell_back = ~out["done"]
+    a_fb = jnp.where(out["zoom"], 0.5 * (out["a_lo"] + out["a_hi"]),
+                     out["a_trial"])
+    phi_fb, g_fb = lax.cond(
+        fell_back,
+        lambda: (lambda r: (r[0], r[2]))(phi_fn(a_fb)),
+        lambda: (out["phi_star"], out["g_star"]))
+    alpha = jnp.where(fell_back, a_fb, out["a_star"])
+    return alpha, phi_fb, g_fb, out["nfev"] + jnp.where(fell_back, 1, 0)

@@ -232,5 +232,66 @@ def upsample(x, size=None, scale_factor=None, mode="nearest",
     return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
 
 
-def class_center_sample(label, num_classes, num_samples, group=None):
-    raise NotImplementedError("class_center_sample: planned (distributed margin losses)")
+def class_center_sample(label, num_classes, num_samples, group=None,
+                        rank=None, nranks=None, seed=None):
+    """PartialFC class-center sampling (reference nn/functional/common.py
+    class_center_sample; phi kernel
+    paddle/phi/kernels/cpu/class_center_sample_kernel.cc): keep every
+    positive class center (sorted ascending), top up with uniformly
+    sampled negative centers until ``max(num_samples, num_positives)``,
+    and remap labels to indices into the sampled list.
+
+    This is host-side label preparation (data-dependent output size), so
+    it runs in numpy — the TPU work is the subsequent margin loss over the
+    sampled centers, which stays static-shaped at ``num_samples``.
+
+    Model parallel (class-sharded fc over the tp axis): pass
+    ``rank``/``nranks`` (or a group object carrying them). ``num_classes``
+    is the LOCAL class count of every shard; labels are GLOBAL. Each
+    rank's sample is computed deterministically from the shared seed, so
+    the remapped labels index the CONCATENATED per-rank sampled space —
+    the layout vocab-sharded weights use. Returns this rank's
+    (remapped_label, sampled_local_class_center).
+    """
+    from paddle_tpu.core.tensor import Tensor
+
+    if num_samples > num_classes:
+        # same contract as the phi kernel's PADDLE_ENFORCE_LE — without it
+        # the negative-sampling loop below could never terminate
+        raise ValueError(
+            f"num_samples ({num_samples}) must be <= num_classes "
+            f"({num_classes})")
+    y = np.asarray(label.numpy() if isinstance(label, Tensor) else label)
+    y = y.reshape(-1).astype(np.int64)
+    if group is False:
+        nranks, rank = 1, 0
+    if nranks is None:
+        nranks = getattr(group, "nranks", 1) if group is not None else 1
+    if rank is None:
+        rank = getattr(group, "rank", 0) if group is not None else 0
+    if seed is None:
+        from paddle_tpu.framework.state import _rng
+        seed = _rng.seed_val
+
+    sampled_per_rank = []
+    remap_base = {}
+    base = 0
+    for r in range(nranks):
+        lo, hi = r * num_classes, (r + 1) * num_classes
+        pos = np.unique(y[(y >= lo) & (y < hi)]) - lo       # local ids, sorted
+        rng = np.random.default_rng(np.uint64(seed) + np.uint64(r) * 7919)
+        chosen = set(pos.tolist())
+        sampled = list(pos)
+        while len(chosen) < num_samples:
+            neg = int(rng.integers(0, num_classes))
+            if neg not in chosen:
+                chosen.add(neg)
+                sampled.append(neg)                          # negatives unordered
+        for local_idx, cls in enumerate(sampled):
+            remap_base[cls + lo] = base + local_idx
+        sampled_per_rank.append(np.asarray(sampled, dtype=np.int64))
+        base += len(sampled)
+
+    remapped = np.asarray([remap_base[int(v)] for v in y], dtype=np.int64)
+    return (Tensor(jnp.asarray(remapped)),
+            Tensor(jnp.asarray(sampled_per_rank[rank])))

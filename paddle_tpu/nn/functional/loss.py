@@ -458,8 +458,28 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
     """ArcFace-style margin softmax (reference nn/functional/loss.py:1837):
     the target logit's angle theta becomes
     cos(margin1*theta + margin2) - margin3, everything scaled by `scale`.
-    The reference's model-parallel class sharding is the tp mesh axis
-    here (sharded logits work through sharding propagation)."""
+
+    Model parallel: pass ``group`` as a mesh AXIS NAME (e.g. "tp") from
+    inside a shard_map whose logits are class-sharded — the loss then runs
+    the two-allreduce sharded logsumexp with the margin applied only by
+    the shard owning the target class (the reference's group-parallel
+    c_margin_cross_entropy), and no [N, C] global tensor forms. See
+    distributed/fleet/mp_ops.py:parallel_margin_cross_entropy."""
+    if isinstance(group, str):
+        from paddle_tpu.distributed.fleet.mp_ops import (
+            parallel_margin_cross_entropy,
+        )
+
+        def sharded(lg, y):
+            out = parallel_margin_cross_entropy(
+                lg, y, margin1=margin1, margin2=margin2, margin3=margin3,
+                scale=scale, axis_name=group, return_softmax=return_softmax)
+            if return_softmax:
+                nll, sm = out
+                return _reduce(nll[:, None], reduction), sm
+            return _reduce(out[:, None], reduction)
+
+        return apply(sharded, logits, label)
 
     def fn(lg, y):
         n, c = lg.shape

@@ -122,17 +122,42 @@ class SparseCsrTensor(SparseCooTensor):
     def __init__(self, crows, cols, values, shape, stop_gradient=True):
         crows_v = jnp.asarray(unwrap(crows))
         cols_v = jnp.asarray(unwrap(cols))
-        nnz = int(crows_v[-1])
-        if nnz != cols_v.shape[0]:
-            raise ValueError(
-                f"sparse_csr_tensor: crows[-1]={nnz} does not match "
-                f"len(cols)={cols_v.shape[0]}")
-        # expand crows -> per-entry row ids ON DEVICE (total length is the
-        # static nnz, so the repeat stays statically shaped)
-        rows = jnp.repeat(jnp.arange(crows_v.shape[0] - 1),
-                          jnp.diff(crows_v),
-                          total_repeat_length=cols_v.shape[0])
-        indices = jnp.stack([rows, cols_v])
+        shape = tuple(int(s) for s in shape)
+        if len(shape) == 2:
+            nnz = int(crows_v[-1])
+            if nnz != cols_v.shape[0]:
+                raise ValueError(
+                    f"sparse_csr_tensor: crows[-1]={nnz} does not match "
+                    f"len(cols)={cols_v.shape[0]}")
+            # expand crows -> per-entry row ids ON DEVICE (total length is
+            # the static nnz, so the repeat stays statically shaped)
+            rows = jnp.repeat(jnp.arange(crows_v.shape[0] - 1),
+                              jnp.diff(crows_v),
+                              total_repeat_length=cols_v.shape[0])
+            indices = jnp.stack([rows, cols_v])
+        elif len(shape) == 3:
+            # batched CSR (phi convention, e.g. the attention sparse_mask):
+            # crows is [batch*(rows+1)] of per-batch row pointers, cols is
+            # the per-batch column lists concatenated
+            nbatch, nrows = shape[0], shape[1]
+            cr = crows_v.reshape(nbatch, nrows + 1)
+            per_batch = np.asarray(cr[:, -1])
+            total = int(per_batch.sum())
+            if total != cols_v.shape[0]:
+                raise ValueError(
+                    f"sparse_csr_tensor: sum of per-batch nnz {total} does "
+                    f"not match len(cols)={cols_v.shape[0]}")
+            rows = jnp.concatenate([
+                jnp.repeat(jnp.arange(nrows), jnp.diff(cr[i]),
+                           total_repeat_length=int(per_batch[i]))
+                for i in range(nbatch)])
+            batch_ids = jnp.repeat(jnp.arange(nbatch),
+                                   jnp.asarray(per_batch),
+                                   total_repeat_length=total)
+            indices = jnp.stack([batch_ids, rows, cols_v])
+        else:
+            raise ValueError("sparse_csr_tensor supports 2-D or batched "
+                             f"3-D shapes, got {shape}")
         super().__init__(indices, values, shape, stop_gradient)
         self._crows = Tensor(crows_v)
         self._cols = Tensor(cols_v)

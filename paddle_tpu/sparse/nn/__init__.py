@@ -13,6 +13,8 @@ import numpy as np
 
 from paddle_tpu.nn.layer.layers import Layer
 
+from paddle_tpu.sparse.nn import functional  # noqa: F401
+
 
 class ReLU(Layer):
     def forward(self, x):

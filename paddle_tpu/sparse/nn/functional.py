@@ -1,0 +1,157 @@
+"""paddle.sparse.nn.functional — functional ops over sparse tensors.
+
+Reference: python/paddle/sparse/nn/functional/ (conv.py conv3d/subm_conv3d,
+pooling.py max_pool3d, activation.py relu/relu6/leaky_relu/softmax,
+transformer.py attention).
+
+TPU-native: activations are zero-preserving maps over BCOO stored values;
+convs/pooling run through the dense mirror (XLA windows); `attention`
+computes QK^T only at the CSR-stored positions via gathers + segment
+softmax — static shapes (nnz is fixed at trace time), so the whole thing
+jits and differentiates through jax.grad / the tape.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "relu",
+    "relu6",
+    "leaky_relu",
+    "softmax",
+    "conv3d",
+    "subm_conv3d",
+    "max_pool3d",
+    "attention",
+]
+
+
+def relu(x, name=None):
+    from paddle_tpu import sparse
+    return sparse.relu(x)
+
+
+def relu6(x, name=None):
+    from paddle_tpu import sparse
+    return sparse._unary_on_values(lambda v: jnp.clip(v, 0.0, 6.0))(x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    from paddle_tpu import sparse
+    return sparse._unary_on_values(
+        lambda v: jnp.where(v >= 0, v, negative_slope * v))(x)
+
+
+def softmax(x, axis=-1, name=None):
+    from paddle_tpu.sparse import nn as sparse_nn
+    return sparse_nn.Softmax(axis=axis)(x)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None):
+    """Sparse 3-D conv, NDHWC (reference sparse/nn/functional/conv.py:conv3d).
+
+    weight follows the dense Conv3D layout [out_c, in_c/groups, kD, kH, kW]
+    (the layer's parameterization); x is a SparseCooTensor.
+    """
+    from paddle_tpu import sparse
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.nn.functional.conv import conv3d as dense_conv3d
+    w = weight if isinstance(weight, Tensor) else Tensor(jnp.asarray(weight))
+    v = jnp.moveaxis(x._value, -1, 1)  # NDHWC -> NCDHW
+    out = dense_conv3d(Tensor(v), w, bias=bias, stride=stride,
+                       padding=padding, dilation=dilation, groups=groups)
+    out = Tensor(jnp.moveaxis(out._value, 1, -1))
+    return sparse.to_sparse_coo(out)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    """Submanifold sparse conv: outputs only at input active sites."""
+    from paddle_tpu import sparse
+    from paddle_tpu.core.tensor import Tensor
+    active = (x._value != 0).any(axis=-1, keepdims=True)
+    out = conv3d(x, weight, bias=bias, stride=stride, padding=padding,
+                 dilation=dilation, groups=groups)
+    masked = jnp.where(active, out._value, 0.0)
+    return sparse.to_sparse_coo(Tensor(masked))
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NDHWC", name=None):
+    from paddle_tpu.sparse import nn as sparse_nn
+    return sparse_nn.MaxPool3D(kernel_size, stride=stride, padding=padding,
+                               data_format=data_format)(x)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse attention: softmax(QK^T/sqrt(d)) * V computed ONLY at the
+    positions stored in ``sparse_mask`` (a SparseCsrTensor of dense shape
+    [batch*num_heads, seq, seq]).
+
+    Reference: python/paddle/sparse/nn/functional/transformer.py:attention
+    (phi kernel sparse_fused_attention). Mask conventions match the phi
+    kernel: entries where key_padding_mask[b, j] == 0 or
+    attn_mask[i, j] == 0 score -inf before the softmax.
+
+    TPU-native: one gather per stored entry for q-rows/k-cols, a fused
+    dot over head_dim, segment-softmax over each (bh, i) row, and a
+    segment-sum of p * V — all static-shaped (nnz fixed at trace time),
+    so it jits and the VJP falls out of jax.grad. Memory is O(nnz * d)
+    instead of O(seq^2 * d) — the same win the reference gets from CSR.
+    """
+    from paddle_tpu.core.dispatch import apply
+    from paddle_tpu.core.tensor import Tensor
+
+    def _arr(t):
+        return t._value if isinstance(t, Tensor) else jnp.asarray(t)
+
+    q, k, v = _arr(query), _arr(key), _arr(value)
+    b, h, s, d = q.shape
+    # mask entries as (bh, i, j) coordinates; CSR construction already
+    # produced batch-major 3-row BCOO indices, and the reference requires
+    # equal nnz per bh batch ("nnz of each batch must be the same"), so
+    # the per-batch reshape below is exact
+    idx = jnp.asarray(sparse_mask._bcoo.indices)          # [nnz_total, 3]
+    nnz_total = idx.shape[0]
+    if nnz_total % (b * h) != 0:
+        raise ValueError(
+            "sparse attention requires equal nnz per batch*head "
+            f"(got total nnz {nnz_total} over {b * h} batches)")
+    nnz = nnz_total // (b * h)
+    row_id = idx[:, 1].reshape(b * h, nnz)
+    cols = idx[:, 2].reshape(b * h, nnz)
+
+    bh = b * h
+    scale = 1.0 / np.sqrt(d)
+
+    kp = None if key_padding_mask is None else _arr(key_padding_mask)
+    am = None if attn_mask is None else _arr(attn_mask)
+
+    def per_batch(args):
+        qi, ki, vi, rows, js, bidx = args
+        scores = jnp.einsum("ed,ed->e", qi[rows], ki[js]) * scale
+        neg = jnp.asarray(-jnp.inf, scores.dtype)
+        if kp is not None:
+            scores = jnp.where(kp[bidx][js] == 0, neg, scores)
+        if am is not None:
+            scores = jnp.where(am[rows, js] == 0, neg, scores)
+        mx = jax.ops.segment_max(scores, rows, num_segments=s)
+        e = jnp.exp(scores - mx[rows])
+        denom = jax.ops.segment_sum(e, rows, num_segments=s)
+        p = e / jnp.maximum(denom[rows], 1e-30)
+        out = jax.ops.segment_sum(p[:, None] * vi[js], rows, num_segments=s)
+        return out
+
+    batch_of_bh = jnp.arange(bh) // h
+
+    def _fwd(qa, ka, va):
+        qf_, kf_, vf_ = (a.reshape(bh, s, d) for a in (qa, ka, va))
+        o = jax.vmap(lambda qi, ki, vi, rows, js, bidx: per_batch(
+            (qi, ki, vi, rows, js, bidx)))(qf_, kf_, vf_, row_id, cols, batch_of_bh)
+        return o.reshape(b, h, s, d).astype(qa.dtype)
+
+    return apply(_fwd, query, key, value)

@@ -21,9 +21,22 @@ from paddle_tpu.core.dispatch import apply
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.nn.layer.layers import Layer
 
-__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets"]
+__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets",
+           "Imdb", "Imikolov", "Movielens", "UCIHousing", "Conll05",
+           "WMT14", "WMT16"]
 
 from paddle_tpu.text import datasets  # noqa: F401,E402
+# dataset classes at the reference path (python/paddle/text/__init__.py
+# re-exports paddle.text.Imdb etc. directly)
+from paddle_tpu.text.datasets import (  # noqa: F401,E402
+    Conll05,
+    Imdb,
+    Imikolov,
+    Movielens,
+    UCIHousing,
+    WMT14,
+    WMT16,
+)
 
 
 def _t(x):

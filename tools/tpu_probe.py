@@ -1,0 +1,17 @@
+"""Standalone TPU health probe. Prints one JSON line and exits.
+
+Run detached; NEVER kill it — if the axon claim is wedged it will hang
+until the relay releases, and killing it can wedge the claim further.
+"""
+import json, sys, time
+t0 = time.time()
+try:
+    import jax, jax.numpy as jnp
+    devs = jax.devices()
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    y = (x @ x).block_until_ready()
+    out = {"ok": True, "platform": devs[0].platform, "n": len(devs),
+           "device": str(devs[0]), "t": round(time.time() - t0, 2)}
+except Exception as e:  # noqa: BLE001
+    out = {"ok": False, "error": f"{type(e).__name__}: {e}", "t": round(time.time() - t0, 2)}
+print(json.dumps(out), flush=True)
