@@ -12,7 +12,11 @@ retain that closure: it packs the op's differentiable *input* values through
 ``pack_hook`` (e.g. ``lambda t: t.numpy()`` moves them to host RAM) and the
 pullback re-runs ``jax.vjp`` from the unpacked inputs at backward time —
 op-granular rematerialization with user-controlled storage, which is exactly
-the offload/compression use case. ``PyLayer.save_for_backward`` /
+the offload/compression use case. The tape also holds those inputs WEAKLY
+(engine._InRef): once user code drops an offloaded activation, the packed
+form is the only copy the graph retains and the device buffer is freed —
+cotangent routing survives collection because node identity is recorded as
+(uid, version) snapshots, not live objects. ``PyLayer.save_for_backward`` /
 ``ctx.saved_tensor`` route through the same hooks, matching the reference's
 PyLayer contract. (Under ``to_static`` the whole step is one XLA program;
 memory there is managed with ``recompute``/remat, not eager hooks.)

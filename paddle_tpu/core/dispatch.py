@@ -71,6 +71,12 @@ def apply(fn, *args, **kwargs):
         # jax.vjp residual closure; recompute the vjp from the unpacked
         # inputs at backward time (see autograd/saved_tensors_hooks.py)
         pack_hook, unpack_hook = hooks
+        # stochastic ops draw from the global RNG inside fn; the
+        # backward-time recompute must replay the SAME keys (a fresh draw
+        # would differentiate a different dropout mask than the forward
+        # produced) — snapshot the stream and rewind around the vjp
+        from paddle_tpu.framework import state as _fstate
+        rng_before = _fstate.get_rng_state()
         out_val = run(vals)
         packed = [pack_hook(Tensor(vals[i], stop_gradient=True))
                   for i in diff_idx]
@@ -92,19 +98,31 @@ def apply(fn, *args, **kwargs):
                 u = unpack_hook(p)
                 restored.append(u._value if isinstance(u, Tensor)
                                 else jnp.asarray(u))
-            _, pull = jax.vjp(closed_late, restored)
+            cur = _fstate.get_rng_state()
+            _fstate.set_rng_state(rng_before)
+            try:
+                _, pull = jax.vjp(closed_late, restored)
+            finally:
+                _fstate.set_rng_state(cur)
             (gs,) = pull(cot)
             return gs
 
     in_tensors = [leaves[i] for i in diff_idx]
+    # weak input refs under saved_tensors_hooks: the packed form is then
+    # the ONLY thing the tape retains — dropping user refs to an
+    # offloaded activation genuinely frees its device buffer
+    weak = hooks is not None
     if isinstance(out_val, tuple):
         outs = tuple(Tensor(o, stop_gradient=False) for o in out_val)
-        node = engine.Node(in_tensors, outs, pullback, name=getattr(fn, "__name__", "op"))
+        node = engine.Node(in_tensors, outs, pullback,
+                           name=getattr(fn, "__name__", "op"),
+                           weak_inputs=weak)
         for o in outs:
             o._node = node
         return outs
     out = Tensor(out_val, stop_gradient=False)
-    node = engine.Node(in_tensors, (out,), pullback, name=getattr(fn, "__name__", "op"))
+    node = engine.Node(in_tensors, (out,), pullback,
+                       name=getattr(fn, "__name__", "op"), weak_inputs=weak)
     out._node = node
     return out
 

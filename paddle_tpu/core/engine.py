@@ -72,32 +72,69 @@ class enable_grad:
 _node_counter = [0]
 
 
+class _InRef:
+    """One input edge of a Node: identity + topology snapshot.
+
+    Holds the input Tensor STRONGLY by default (pre-existing tape
+    semantics: the graph keeps its leaves alive until backward). Under
+    `autograd.saved_tensors_hooks` the reference is WEAK — the packed
+    form the hook produced is then the only thing the tape retains, so
+    offloading an activation to host genuinely releases its device
+    buffer once user code drops it. Identity for cotangent routing is
+    (uid, version), not id(): uids are never reused, so a collected
+    tensor can't alias a later one.
+    """
+
+    __slots__ = ("uid", "version", "stop_gradient", "node", "_strong",
+                 "_weak")
+
+    def __init__(self, t, weak=False):
+        self.uid = t._uid
+        self.version = t._version
+        self.stop_gradient = t.stop_gradient
+        self.node = t._node
+        if weak:
+            self._strong = None
+            self._weak = weakref.ref(t)
+        else:
+            self._strong = t
+            self._weak = None
+
+    def tensor(self):
+        return self._strong if self._strong is not None else self._weak()
+
+
 class Node:
     """One recorded differentiable op."""
 
     __slots__ = (
         "idx",
-        "inputs",
-        "in_versions",
-        "out_refs",
+        "in_refs",
+        "out_uids",
         "out_versions",
         "out_avals",
         "pullback",
         "name",
     )
 
-    def __init__(self, inputs, out_tensors, pullback, name=""):
+    def __init__(self, inputs, out_tensors, pullback, name="",
+                 weak_inputs=False):
         _node_counter[0] += 1
         self.idx = _node_counter[0]
-        self.inputs = tuple(inputs)
-        self.in_versions = tuple(t._version for t in inputs)
-        self.out_refs = tuple(weakref.ref(t) for t in out_tensors)
+        self.in_refs = tuple(_InRef(t, weak_inputs) for t in inputs)
+        self.out_uids = tuple(t._uid for t in out_tensors)
         self.out_versions = tuple(t._version for t in out_tensors)
         self.out_avals = tuple(
             (tuple(t._value.shape), t._value.dtype) for t in out_tensors
         )
         self.pullback = pullback
         self.name = name
+
+    @property
+    def inputs(self):
+        """Live input tensors (compat accessor; None for collected
+        weak-held inputs)."""
+        return tuple(r.tensor() for r in self.in_refs)
 
 
 def _zero_cotangent(shape, dtype):
@@ -127,7 +164,8 @@ def backward(root, grad=None, retain_graph=False):
     elif isinstance(grad, Tensor):
         grad = grad._value
 
-    # Collect reachable nodes.
+    # Collect reachable nodes (via the recorded topology snapshot, so a
+    # weak-held input collected by the GC does not sever its upstream).
     seen = {}
     stack = [root._node]
     while stack:
@@ -135,12 +173,12 @@ def backward(root, grad=None, retain_graph=False):
         if node.idx in seen:
             continue
         seen[node.idx] = node
-        for t in node.inputs:
-            if t._node is not None and t._node.idx not in seen:
-                stack.append(t._node)
+        for r in node.in_refs:
+            if r.node is not None and r.node.idx not in seen:
+                stack.append(r.node)
     order = sorted(seen.values(), key=lambda n: n.idx, reverse=True)
 
-    cot = {(id(root), root._version): grad}
+    cot = {(root._uid, root._version): grad}
 
     for node in order:
         if node.pullback is None:
@@ -150,12 +188,11 @@ def backward(root, grad=None, retain_graph=False):
             )
         cots = []
         any_live = False
-        for ref, ver, (shape, dtype) in zip(
-            node.out_refs, node.out_versions, node.out_avals
+        for uid, ver, (shape, dtype) in zip(
+            node.out_uids, node.out_versions, node.out_avals
         ):
-            t = ref()
-            key = (id(t), ver) if t is not None else None
-            if key is not None and key in cot:
+            key = (uid, ver)
+            if key in cot:
                 cots.append(cot.pop(key))
                 any_live = True
             else:
@@ -163,15 +200,17 @@ def backward(root, grad=None, retain_graph=False):
         if not any_live:
             continue
         in_grads = node.pullback(tuple(cots) if len(cots) > 1 else cots[0])
-        for t, ver, g in zip(node.inputs, node.in_versions, in_grads):
+        for r, g in zip(node.in_refs, in_grads):
             if g is None or (hasattr(g, "dtype") and g.dtype == float0):
                 continue
-            if t.stop_gradient:
+            if r.stop_gradient:
                 continue
-            if t._node is None:
-                t._accumulate_grad(g)
+            if r.node is None:
+                t = r.tensor()
+                if t is not None:
+                    t._accumulate_grad(g)
             else:
-                key = (id(t), ver)
+                key = (r.uid, r.version)
                 if key in cot:
                     cot[key] = cot[key] + g
                 else:

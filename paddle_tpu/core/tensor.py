@@ -59,6 +59,7 @@ class Tensor:
         "persistable",
         "_node",
         "_version",
+        "_uid",
         "__weakref__",
         "__dict__",
     )
@@ -79,6 +80,7 @@ class Tensor:
         self.stop_gradient = bool(stop_gradient)
         self.grad = None
         Tensor._tensor_id[0] += 1
+        self._uid = Tensor._tensor_id[0]   # never reused (id() can be)
         self.name = name or f"tensor_{Tensor._tensor_id[0]}"
         self.persistable = False
         self._node = None
@@ -246,7 +248,7 @@ class Tensor:
         self._version += 1
         node = new_tensor._node
         if node is not None:
-            node.out_refs = (weakref.ref(self),)
+            node.out_uids = (self._uid,)
             node.out_versions = (self._version,)
             self._node = node
             self.stop_gradient = new_tensor.stop_gradient

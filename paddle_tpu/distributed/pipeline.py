@@ -338,6 +338,138 @@ def pipeline_interleaved_forward_fn(chunk_fn, axis_name="pp",
     return body
 
 
+def pipeline_1f1b_interleaved_body(chunk_fn, loss_fn, params_chunks,
+                                   loss_params, x, aux, axis_name="pp",
+                                   axis_size=None, num_chunks=1):
+    """Explicit interleaved 1F1B: virtual stages composed WITH the 1F1B
+    schedule (call INSIDE shard_map). Reference:
+    fleet/meta_parallel/pipeline_parallel.py:461
+    (PipelineParallelWithInterleave) — whose interleave IS 1F1B with
+    virtual stages: bubble/V AND the O(pp) activation-memory bound
+    together (r3's forward-only folded ring kept only the bubble win).
+
+    TPU-native timetable (one lax.scan, every pp rank): logical stage
+    l = c*pp + d lives on device d = l % pp as chunk c = l // pp, so
+    EVERY logical hop — seam crossings included — is the same +1 ring
+    ppermute, and the cotangent hop is the same -1 ring. Device d's
+    forward stream coordinate is s = t - d with
+    chunk c = (s % (pp*V)) // pp, microbatch m = (s//(pp*V))*pp + s%pp
+    (microbatches advance in groups of pp, Megatron's grouping); the
+    backward of logical stage l for m runs at
+    t_B = t_F(L-1, m) + (L-1-l), which works out to one forward chunk
+    AND one backward chunk per device per tick — the 1F1B invariant at
+    chunk granularity. Chunk inputs are saved in a ring of
+    min(M*V, 2*pp*V - 1) slots and the per-stage backward is a
+    recompute-vjp from the saved input, so activation memory is O(pp*V)
+    chunk inputs, independent of the microbatch count.
+
+    chunk_fn(chunk_params, x) -> y      (1/V of a stage's layers)
+    loss_fn(loss_params, y, aux) -> scalar microbatch loss (last stage)
+    params_chunks: pytree with leading [V, ...] chunk dim per leaf
+    (storage layout per interleave_layer_permutation).
+
+    Returns (loss_sum, chunk_param_grads [V-leading, local],
+    loss_param_grads, dx_mb) — same contract as pipeline_1f1b_body.
+    M must divide by pp.
+    """
+    v = num_chunks
+
+    def body(params_chunks, loss_params, x, aux):
+        pp = mesh_mod.resolve_axis_size(axis_name, axis_size)
+        d = lax.axis_index(axis_name)
+        L = pp * v
+        M = x.shape[0]
+        if M % pp:
+            raise ValueError(f"microbatches {M} must divide by pp {pp}")
+        S = M * v                            # forward stream length
+        R = min(S, 2 * L - 1)                # saved-input ring slots
+        T = S + 2 * (L - 1) - (v - 1) * pp   # == v*(M+pp) + pp - 2
+        period = pp * v
+        fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
+        bwd_perm = [(i, (i - 1) % pp) for i in range(pp)]
+        zero_y = jnp.zeros(x.shape[1:], x.dtype)
+        last_dev = d == pp - 1
+
+        def chunk_params_at(c):
+            return jax.tree_util.tree_map(
+                lambda p: lax.dynamic_index_in_dim(
+                    p, jnp.clip(c, 0, v - 1), 0, keepdims=False),
+                params_chunks)
+
+        def tick(c_state, t):
+            # ---------------- forward half ----------------
+            s = t - d
+            f_valid = (s >= 0) & (s < S)
+            sc = jnp.clip(s, 0, S - 1)
+            c_f = (sc % period) // pp
+            mb_f = (sc // period) * pp + (sc % pp)
+            inbound = lax.ppermute(c_state["fwd_out"], axis_name, fwd_perm)
+            inject = (d == 0) & (c_f == 0)
+            inp = jnp.where(inject, x[jnp.clip(mb_f, 0, M - 1)], inbound)
+            y = chunk_fn(chunk_params_at(c_f), inp)
+            slot_f = sc % R
+            saved = c_state["saved"].at[slot_f].set(
+                jnp.where(f_valid, inp, c_state["saved"][slot_f]))
+            # last logical stage closes its microbatch NOW (loss + dy)
+            loss_m, (d_lp, dy) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1))(loss_params, y,
+                                         aux[jnp.clip(mb_f, 0, M - 1)])
+            finishes = f_valid & last_dev & (c_f == v - 1)
+            # ---------------- backward half ----------------
+            # invert t_B = g*pp*v + k - c*pp + 2*pp*v - 2 - d  (w below)
+            w = t - (2 * L - 2) + d
+            k_b = jnp.mod(w, pp)
+            c_b = jnp.mod(v - (jnp.mod(w, period) - k_b) // pp, v)
+            g_b = (w - k_b + c_b * pp) // period
+            mb_b = g_b * pp + k_b
+            b_valid = (mb_b >= 0) & (mb_b < M)
+            s_b = g_b * period + c_b * pp + k_b   # its fwd stream coord
+            g_in = lax.ppermute(c_state["bwd_out"], axis_name, bwd_perm)
+            is_last_logical = last_dev & (c_b == v - 1)
+            g = jnp.where(is_last_logical, dy, g_in)
+            g = jnp.where(b_valid, g, 0.0)       # zero cotangent => zero
+            x_saved = saved[jnp.mod(jnp.clip(s_b, 0, S - 1), R)]
+            _, vjp = jax.vjp(chunk_fn, chunk_params_at(c_b), x_saved)
+            d_cparams, d_x = vjp(g)
+            cb_idx = jnp.clip(c_b, 0, v - 1)
+            new_state = {
+                "fwd_out": y,
+                "bwd_out": d_x,
+                "saved": saved,
+                "gparams": jax.tree_util.tree_map(
+                    lambda G, dp: G.at[cb_idx].add(dp),
+                    c_state["gparams"], d_cparams),
+                "gloss": jax.tree_util.tree_map(
+                    lambda a, b: a + jnp.where(finishes, b, 0.0),
+                    c_state["gloss"], d_lp),
+                "loss": c_state["loss"] + jnp.where(finishes, loss_m, 0.0),
+            }
+            emit_dx = (d == 0) & (c_b == 0) & b_valid
+            return new_state, jnp.where(emit_dx, d_x, 0.0)
+
+        init = {
+            "fwd_out": zero_y,
+            "bwd_out": zero_y,
+            "saved": jnp.zeros((R,) + x.shape[1:], x.dtype),
+            "gparams": jax.tree_util.tree_map(jnp.zeros_like,
+                                              params_chunks),
+            "gloss": jax.tree_util.tree_map(jnp.zeros_like, loss_params),
+            "loss": jnp.asarray(0.0, jnp.float32),
+        }
+        c_state, dxs = lax.scan(tick, init, jnp.arange(T))
+        # mb m's stage-0 backward tick: g*pp*v + k + 2*pp*v - 2 (d=0,c=0)
+        m_idx = jnp.arange(M)
+        t_dx = (m_idx // pp) * period + (m_idx % pp) + 2 * L - 2
+        dx_mb = lax.psum(
+            jnp.where(d == 0, dxs[t_dx], 0.0), axis_name)
+        loss_sum = lax.psum(c_state["loss"], axis_name)
+        gloss = jax.tree_util.tree_map(
+            lambda a: lax.psum(a, axis_name), c_state["gloss"])
+        return loss_sum, c_state["gparams"], gloss, dx_mb
+
+    return body(params_chunks, loss_params, x, aux)
+
+
 def microbatch(x, num_microbatches, batch_axis=0):
     """[B, ...] -> [M, B/M, ...] microbatch stream."""
     B = x.shape[batch_axis]

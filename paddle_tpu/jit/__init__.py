@@ -55,6 +55,7 @@ def load(path, **configs):
 
 # reference jit namespace extras (python/paddle/jit/__init__.py)
 from paddle_tpu.jit.serialization import TranslatedLayer  # noqa: E402,F401
+from paddle_tpu.jit import dy2static  # noqa: E402,F401
 
 TracedLayer = TranslatedLayer  # legacy alias: trace-based save/load
 

@@ -79,7 +79,14 @@ class StaticFunction:
 
     def __init__(self, function, input_spec=None, build_strategy=None,
                  backend=None, donate_state=True):
-        self._function = function
+        self._raw_function = function
+        # Dy2Static AST pass (jit/dy2static.py): tensor-dependent
+        # if/while/for in the traced function (and, via convert_call, in
+        # everything it calls) become select/lax.while_loop programs;
+        # Python-valued control flow keeps eager semantics. Best-effort:
+        # falls back to the untransformed function on any failure.
+        from paddle_tpu.jit.dy2static import convert_to_static
+        self._function = convert_to_static(function)
         self._input_spec = input_spec
         self._donate = donate_state
         self._compiled = {}
@@ -88,7 +95,7 @@ class StaticFunction:
 
     @property
     def dygraph_function(self):
-        return self._function
+        return self._raw_function
 
     def _make_pure(self, in_treedef, n_state, static_leaves):
         fn = self._function

@@ -43,9 +43,12 @@ from paddle_tpu.distributed.fleet.mp_ops import (copy_to_tp_region,
                                                  reduce_from_tp_region,
                                                  vocab_parallel_cross_entropy,
                                                  vocab_parallel_embedding)
-from paddle_tpu.distributed.pipeline import (interleave_layer_permutation,
-                                             pipeline_1f1b_body,
-                                             pipeline_interleaved_forward_fn)
+from paddle_tpu.distributed.pipeline import (
+    interleave_layer_permutation,
+    pipeline_1f1b_body,
+    pipeline_1f1b_interleaved_body,
+    pipeline_interleaved_forward_fn,
+)
 
 
 # ---------------------------------------------------------------------------
@@ -471,7 +474,7 @@ def make_hybrid_loss_fn(cfg, mesh, num_microbatches=2, pipeline="gpipe",
                          out_specs=P(), check_vma=False)
 
 
-def make_hybrid_grad_fn(cfg, mesh, num_microbatches=2):
+def make_hybrid_grad_fn(cfg, mesh, num_microbatches=2, virtual_chunks=1):
     """Explicit 1F1B loss+grad for the flagship (r3, VERDICT #3).
 
     Reference: fleet/meta_parallel/pipeline_parallel.py:117
@@ -485,9 +488,18 @@ def make_hybrid_grad_fn(cfg, mesh, num_microbatches=2):
     embed's VJP is applied to the dx_mb the pipeline returns, and the head
     grads ride the schedule's loss_params slot.
 
+    virtual_chunks > 1 (r4, VERDICT #5) switches to the INTERLEAVED 1F1B
+    schedule (pipeline_1f1b_interleaved_body): V virtual stages per
+    device composed WITH the explicit per-tick fwd/bwd — bubble/V and the
+    O(pp·V-chunk-input) activation bound together, which is the actual
+    semantics of the reference's PipelineParallelWithInterleave
+    (pipeline_parallel.py:461). Params must come from
+    init_hybrid_gpt_params(virtual_chunks=V).
+
     Returns fn(params, ids, labels) -> (loss, grads) for the whole mesh.
     """
     tp, sp, pp, ep, heads_local = _hybrid_degrees(cfg, mesh)
+    _check_layout(cfg, virtual_chunks)
     M = num_microbatches
     moe = bool(getattr(cfg, "moe_num_experts", 0))
 
@@ -519,9 +531,23 @@ def make_hybrid_grad_fn(cfg, mesh, num_microbatches=2):
 
         loss_params = {"lnf_g": params["lnf_g"], "lnf_b": params["lnf_b"],
                        "wte": params["wte"]}
-        loss_sum, g_stages, gloss, dx_mb = pipeline_1f1b_body(
-            stage_fn, loss_fn, params["stages"], loss_params,
-            h_mb, labels_mb, axis_name="pp", axis_size=pp)
+        if virtual_chunks > 1:
+            v = virtual_chunks
+            chunked = jax.tree_util.tree_map(
+                lambda p: p.reshape((v, p.shape[0] // v) + p.shape[1:]),
+                params["stages"])
+            loss_sum, g_chunks, gloss, dx_mb = \
+                pipeline_1f1b_interleaved_body(
+                    stage_fn, loss_fn, chunked, loss_params,
+                    h_mb, labels_mb, axis_name="pp", axis_size=pp,
+                    num_chunks=v)
+            g_stages = jax.tree_util.tree_map(
+                lambda g: g.reshape((g.shape[0] * g.shape[1],)
+                                    + g.shape[2:]), g_chunks)
+        else:
+            loss_sum, g_stages, gloss, dx_mb = pipeline_1f1b_body(
+                stage_fn, loss_fn, params["stages"], loss_params,
+                h_mb, labels_mb, axis_name="pp", axis_size=pp)
         d_wte_e, d_wpe = embed_vjp(dx_mb)
 
         total = lax.psum(loss_sum, ("dp", "sp"))
@@ -554,13 +580,18 @@ def make_hybrid_train_step(cfg, mesh, lr=1e-3, num_microbatches=2,
     shard_map transpose — or, on the 1F1B path, explicit dp/sp psums).
 
     schedule: "1f1b" (explicit interleaved fwd/bwd pipeline, the flagship
-    default), "gpipe" (scan+ppermute forward trunk, outer AD backward),
-    or "interleave" (virtual-stage folded ring, `virtual_chunks` chunks
-    per device, outer AD backward — init params with the matching
-    virtual_chunks layout).
+    default), "interleave" (virtual-stage 1F1B — V chunks per device
+    composed with the explicit per-tick fwd/bwd schedule, keeping BOTH
+    the bubble/V and the 1F1B activation-memory win; init params with the
+    matching virtual_chunks layout), or "gpipe" (scan+ppermute forward
+    trunk, outer AD backward). "interleave-fwd" keeps r3's forward-only
+    folded ring with outer AD, for comparison.
     """
-    if schedule == "1f1b":
-        grad_fn = make_hybrid_grad_fn(cfg, mesh, num_microbatches)
+    if schedule in ("1f1b", "interleave"):
+        grad_fn = make_hybrid_grad_fn(
+            cfg, mesh, num_microbatches,
+            virtual_chunks=virtual_chunks if schedule == "interleave"
+            else 1)
 
         @jax.jit
         def step(params, ids, labels):
@@ -568,10 +599,11 @@ def make_hybrid_train_step(cfg, mesh, lr=1e-3, num_microbatches=2,
             params = jax.tree_util.tree_map(lambda p, g: p - lr * g,
                                             params, grads)
             return params, loss
-    elif schedule in ("gpipe", "interleave"):
+    elif schedule in ("gpipe", "interleave-fwd"):
         loss_fn = make_hybrid_loss_fn(
             cfg, mesh, num_microbatches,
-            pipeline="interleave" if schedule == "interleave" else "gpipe",
+            pipeline="interleave" if schedule == "interleave-fwd"
+            else "gpipe",
             virtual_chunks=virtual_chunks)
 
         @jax.jit

@@ -273,6 +273,12 @@ def class_center_sample(label, num_classes, num_samples, group=None,
         from paddle_tpu.framework.state import _rng
         seed = _rng.seed_val
 
+    total_classes = nranks * num_classes
+    if y.size and (y.min() < 0 or y.max() >= total_classes):
+        raise ValueError(
+            f"class_center_sample: labels must lie in [0, "
+            f"{total_classes}) (nranks*num_classes); got range "
+            f"[{int(y.min())}, {int(y.max())}]")
     sampled_per_rank = []
     remap_base = {}
     base = 0

@@ -122,6 +122,18 @@ def attention(query, key, value, sparse_mask, key_padding_mask=None,
             "sparse attention requires equal nnz per batch*head "
             f"(got total nnz {nnz_total} over {b * h} batches)")
     nnz = nnz_total // (b * h)
+    # divisible-but-unequal per-batch counts would silently shift entries
+    # across batches in the reshape below; validate when concrete
+    try:
+        batch_ids = np.asarray(idx[:, 0])
+        counts = np.bincount(batch_ids, minlength=b * h)
+        if not (counts == nnz).all():
+            raise ValueError(
+                "sparse attention requires EQUAL nnz per batch*head "
+                f"(per-batch counts {counts.tolist()}); the reference has "
+                "the same contract ('nnz of each batch must be the same')")
+    except (TypeError, jax.errors.TracerArrayConversionError):
+        pass  # traced mask: shape contract already enforced above
     row_id = idx[:, 1].reshape(b * h, nnz)
     cols = idx[:, 2].reshape(b * h, nnz)
 
@@ -140,7 +152,10 @@ def attention(query, key, value, sparse_mask, key_padding_mask=None,
         if am is not None:
             scores = jnp.where(am[rows, js] == 0, neg, scores)
         mx = jax.ops.segment_max(scores, rows, num_segments=s)
-        e = jnp.exp(scores - mx[rows])
+        # a fully-masked row has mx = -inf; exp(-inf - -inf) would be NaN —
+        # zero the row instead (same as a softmax over an empty support)
+        safe_mx = jnp.where(jnp.isfinite(mx), mx, 0.0)
+        e = jnp.exp(scores - safe_mx[rows])
         denom = jax.ops.segment_sum(e, rows, num_segments=s)
         p = e / jnp.maximum(denom[rows], 1e-30)
         out = jax.ops.segment_sum(p[:, None] * vi[js], rows, num_segments=s)

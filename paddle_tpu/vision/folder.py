@@ -1,0 +1,140 @@
+"""Folder datasets: train on a local image directory.
+
+Reference: python/paddle/vision/datasets/folder.py (DatasetFolder :66,
+ImageFolder :314) — the "root/class_x/img.ext" directory convention.
+
+TPU-native notes: items come back as numpy HWC uint8 arrays (the layout
+the transforms pipeline and the C++ prefetch ring consume); decoding is
+host-side work that belongs on the data pipeline, never on the chip.
+Decoding uses PIL when present (it is in this image) and falls back to a
+clear error otherwise — zero-egress either way.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from paddle_tpu.io import Dataset
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp")
+
+__all__ = ["DatasetFolder", "ImageFolder", "default_loader",
+           "IMG_EXTENSIONS"]
+
+
+def default_loader(path):
+    """Load one image file as an HWC uint8 RGB numpy array."""
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError(
+            "image loading needs PIL (pillow); pass a custom `loader` to "
+            "DatasetFolder/ImageFolder to decode without it") from e
+    with Image.open(path) as img:
+        return np.asarray(img.convert("RGB"))
+
+
+def has_valid_extension(filename, extensions):
+    return filename.lower().endswith(tuple(extensions))
+
+
+def make_dataset(directory, class_to_idx, extensions=None,
+                 is_valid_file=None):
+    """Walk root/class_x/**, returning [(path, class_idx), ...] sorted."""
+    if (extensions is None) == (is_valid_file is None):
+        raise ValueError(
+            "exactly one of `extensions` and `is_valid_file` must be set")
+    if extensions is not None:
+        def is_valid_file(p):  # noqa: F811
+            return has_valid_extension(p, extensions)
+    samples = []
+    directory = os.path.expanduser(directory)
+    for cls in sorted(class_to_idx):
+        d = os.path.join(directory, cls)
+        if not os.path.isdir(d):
+            continue
+        for root, _, fnames in sorted(os.walk(d, followlinks=True)):
+            for fname in sorted(fnames):
+                path = os.path.join(root, fname)
+                if is_valid_file(path):
+                    samples.append((path, class_to_idx[cls]))
+    return samples
+
+
+class DatasetFolder(Dataset):
+    """root/class_a/x.ext layout -> (image, class_index) samples.
+
+    Attributes match the reference: `classes`, `class_to_idx`, `samples`,
+    `targets`.
+    """
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or default_loader
+        if extensions is None and is_valid_file is None:
+            extensions = IMG_EXTENSIONS
+        self.extensions = extensions
+        classes = [d.name for d in os.scandir(root) if d.is_dir()]
+        classes.sort()
+        if not classes:
+            raise RuntimeError(f"no class directories found under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = make_dataset(root, self.class_to_idx, extensions,
+                                    is_valid_file)
+        if not self.samples:
+            raise RuntimeError(
+                f"no valid files found under {root} (extensions="
+                f"{extensions})")
+        self.targets = [t for _, t in self.samples]
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, np.asarray(target, np.int64)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat (or nested) image directory -> [image] samples, no labels.
+
+    Reference: vision/datasets/folder.py:314 — items are single-element
+    lists, matching the reference's return convention.
+    """
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or default_loader
+        if extensions is None and is_valid_file is None:
+            extensions = IMG_EXTENSIONS
+        if is_valid_file is None:
+            def is_valid_file(p):
+                return has_valid_extension(p, extensions)
+        samples = []
+        for root_, _, fnames in sorted(os.walk(root, followlinks=True)):
+            for fname in sorted(fnames):
+                path = os.path.join(root_, fname)
+                if is_valid_file(path):
+                    samples.append(path)
+        if not samples:
+            raise RuntimeError(f"no valid files found under {root}")
+        self.samples = samples
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)

@@ -33,7 +33,50 @@ __all__ = [
     "roi_pool", "RoIPool", "roi_align", "RoIAlign", "nms",
     "ConvNormActivation", "box_coder", "prior_box", "matrix_nms",
     "distribute_fpn_proposals", "yolo_loss", "generate_proposals",
+    "read_file", "decode_jpeg",
 ]
+
+
+def read_file(filename, name=None):
+    """File bytes as a 1-D uint8 Tensor (reference vision/ops.py:1448).
+
+    Host-side IO: the bytes land in host memory; only decode_jpeg's
+    output (the pixel array) should ever move to the device.
+    """
+    import jax.numpy as _jnp
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return Tensor(_jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode JPEG bytes into a CHW uint8 image tensor (reference
+    vision/ops.py:1493; the phi kernel wraps nvjpeg — here decoding is
+    host-side PIL, which is where decode belongs on a TPU system).
+
+    mode: 'unchanged' (keep the file's channel count), 'gray', or 'rgb'.
+    """
+    import io as _io
+
+    import jax.numpy as _jnp
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError("decode_jpeg needs PIL (pillow)") from e
+    raw = bytes(np.asarray(_val(x), dtype=np.uint8).tobytes())
+    with Image.open(_io.BytesIO(raw)) as img:
+        if mode == "gray":
+            img = img.convert("L")
+        elif mode in ("rgb", "RGB"):
+            img = img.convert("RGB")
+        elif mode != "unchanged":
+            raise ValueError(f"decode_jpeg: unknown mode {mode!r}")
+        arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]                      # [1, H, W]
+    else:
+        arr = np.transpose(arr, (2, 0, 1))   # HWC -> CHW
+    return Tensor(_jnp.asarray(arr))
 
 
 def _val(x):

@@ -1,0 +1,242 @@
+"""Dy2Static control-flow conversion (round-4, VERDICT #2).
+
+Reference:
+python/paddle/fluid/dygraph/dygraph_to_static/convert_operators.py:108
+(convert_while_loop) and :329 (convert_ifelse) — tensor-dependent
+if/while/for compile under to_static; Python-valued conditions keep
+eager semantics. Our lowering: tensor-if = both-branches + where select
+(tape-differentiable), tensor-while = lax.while_loop (jit/dy2static.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as p
+import paddle_tpu.nn.functional as F
+from paddle_tpu.jit.dy2static import convert_to_static
+
+
+def _arr(*v):
+    return p.to_tensor(np.array(v, np.float32))
+
+
+class TestTensorIf:
+    def test_assignment_if_both_paths(self):
+        @p.jit.to_static
+        def f(x):
+            if x.sum() > 0:
+                y = x * 2.0
+            else:
+                y = x - 1.0
+            return y
+
+        assert np.allclose(f(_arr(1.0, 2.0)).numpy(), [2.0, 4.0])
+        assert np.allclose(f(_arr(-1.0, -2.0)).numpy(), [-2.0, -3.0])
+        # one compiled program serves both predicate values (select, not
+        # per-branch recompilation)
+        assert len(f._compiled) == 1
+
+    def test_return_style_if(self):
+        @p.jit.to_static
+        def f(x):
+            if x.mean() > 0:
+                return x * 10.0
+            else:
+                return x * -1.0
+
+        assert np.allclose(f(_arr(1.0, 2.0)).numpy(), [10.0, 20.0])
+        assert np.allclose(f(_arr(-1.0, -2.0)).numpy(), [1.0, 2.0])
+
+    def test_elif_chain(self):
+        @p.jit.to_static
+        def f(x):
+            s = x.sum()
+            if s > 10:
+                y = x * 0.0
+            elif s > 0:
+                y = x + 1.0
+            else:
+                y = x - 1.0
+            return y
+
+        assert np.allclose(f(_arr(20.0)).numpy(), [0.0])
+        assert np.allclose(f(_arr(3.0)).numpy(), [4.0])
+        assert np.allclose(f(_arr(-3.0)).numpy(), [-4.0])
+
+    def test_grad_flows_through_select(self):
+        w = p.to_tensor(np.array([2.0], np.float32))
+        w.stop_gradient = False
+
+        @p.jit.to_static
+        def step(x):
+            h = x * w
+            if h.sum() > 0:
+                y = h * 3.0
+            else:
+                y = h * 5.0
+            loss = y.sum()
+            loss.backward()
+            g = w.grad
+            w.grad = None
+            return loss, g
+
+        _, g = step(_arr(1.0, 2.0))
+        assert np.allclose(g.numpy(), 3.0 * 3.0)  # sum(x) * true-branch
+        _, g = step(_arr(-1.0, -2.0))
+        assert np.allclose(g.numpy(), 5.0 * -3.0)
+
+    def test_python_cond_keeps_eager_semantics(self):
+        def f(x, flag):
+            if flag:
+                return x + 1.0
+            return x - 1.0
+
+        ft = convert_to_static(f)
+        assert np.allclose(ft(_arr(1.0), True).numpy(), [2.0])
+        assert np.allclose(ft(_arr(1.0), False).numpy(), [0.0])
+
+    def test_boolop_on_tensors(self):
+        @p.jit.to_static
+        def f(x):
+            if (x.sum() > 0) and (x.max() < 10):
+                return x + 100.0
+            else:
+                return x
+
+        assert np.allclose(f(_arr(1.0, 2.0)).numpy(), [101.0, 102.0])
+        assert np.allclose(f(_arr(50.0)).numpy(), [50.0])
+
+    def test_single_branch_var_raises_under_trace(self):
+        @p.jit.to_static
+        def f(x):
+            if x.sum() > 0:
+                y = x * 2.0
+            return y  # noqa: F821 — y unbound on the false path
+
+        with pytest.raises(Exception, match="only one branch|assigned"):
+            f(_arr(1.0))
+
+
+class TestTensorWhile:
+    def test_while_counts_to_sum(self):
+        @p.jit.to_static
+        def f(x):
+            i = p.zeros([])
+            while i < x.sum():
+                i = i + 1.0
+            return i
+
+        assert np.allclose(f(_arr(2.5, 1.0)).numpy(), 4.0)
+        assert np.allclose(f(_arr(0.2)).numpy(), 1.0)
+
+    def test_for_over_range_tensor(self):
+        @p.jit.to_static
+        def f(n, x):
+            acc = x * 0.0
+            for _ in range(n):
+                acc = acc + x
+            return acc
+
+        n = p.to_tensor(np.int32(3))
+        assert np.allclose(f(n, _arr(1.0, 2.0)).numpy(), [3.0, 6.0])
+
+    def test_python_while_unchanged(self):
+        def f(x, n):
+            i = 0
+            while i < n:
+                x = x + 1.0
+                i += 1
+            return x
+
+        ft = convert_to_static(f)
+        assert np.allclose(ft(_arr(0.0), 4).numpy(), [4.0])
+
+    def test_newton_sqrt_decode_loop(self):
+        # while-loop with real math in the body (Newton iteration)
+        @p.jit.to_static
+        def f(a):
+            x = a * 0.5 + 1.0
+            err = p.to_tensor(np.float32(1e9))
+            while err > 1e-5:
+                nx = 0.5 * (x + a / x)
+                err = (nx - x).abs().max()
+                x = nx
+            return x
+
+        out = f(_arr(2.0, 9.0, 16.0))
+        assert np.allclose(out.numpy(), [np.sqrt(2.0), 3.0, 4.0], atol=1e-4)
+
+
+class TestConvertCall:
+    def test_layer_forward_converted_recursively(self):
+        class Gate(p.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = p.nn.Linear(2, 2)
+
+            def forward(self, x):
+                h = self.lin(x)
+                if h.sum() > 0:
+                    h = h * 2.0
+                else:
+                    h = h * 0.5
+                return h
+
+        net = Gate()
+
+        @p.jit.to_static
+        def step(x):
+            return net(x).sum()
+
+        x = _arr(1.0, 2.0)
+        assert np.allclose(float(net(x).sum().numpy()),
+                           float(step(x).numpy()), atol=1e-6)
+
+    def test_helper_function_converted(self):
+        def clip_step(x, lim):
+            if x.abs().max() > lim:
+                return x * 0.5
+            else:
+                return x
+
+        @p.jit.to_static
+        def step(x):
+            return clip_step(x, 1.0)
+
+        assert np.allclose(step(_arr(4.0)).numpy(), [2.0])
+        assert np.allclose(step(_arr(0.5)).numpy(), [0.5])
+
+    def test_training_model_with_control_flow(self):
+        # end-to-end: a model whose forward branches on a tensor trains
+        # under to_static and the loss decreases
+        class Net(p.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.l1 = p.nn.Linear(4, 16)
+                self.l2 = p.nn.Linear(16, 2)
+
+            def forward(self, x):
+                h = F.relu(self.l1(x))
+                if h.mean() > 0.5:
+                    h = h * 0.9
+                else:
+                    h = h * 1.1
+                return self.l2(h)
+
+        p.seed(0)
+        net = Net()
+        opt = p.optimizer.Adam(learning_rate=0.05,
+                               parameters=net.parameters())
+
+        @p.jit.to_static
+        def train_step(x, y):
+            loss = F.cross_entropy(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        rng = np.random.default_rng(0)
+        x = p.to_tensor(rng.standard_normal((32, 4)).astype(np.float32))
+        y = p.to_tensor((rng.standard_normal(32) > 0).astype(np.int64))
+        losses = [float(train_step(x, y).numpy()) for _ in range(30)]
+        assert losses[-1] < losses[0] * 0.5, losses[:3] + losses[-3:]

@@ -1,0 +1,116 @@
+"""Folder datasets + image file IO (r4, VERDICT #7).
+
+Reference: python/paddle/vision/datasets/folder.py:66 (DatasetFolder),
+:314 (ImageFolder); python/paddle/vision/ops.py:1448 (read_file),
+:1493 (decode_jpeg). Done-criterion: a LeNet-style model trains on a
+generated on-disk image folder through the public API.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as p
+import paddle_tpu.nn.functional as F
+
+PIL = pytest.importorskip("PIL")
+from PIL import Image  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def image_root(tmp_path_factory):
+    """root/class_{0,1}/img_*.{jpg,png} with class-dependent pixels."""
+    root = tmp_path_factory.mktemp("imgfolder")
+    rng = np.random.default_rng(0)
+    for cls in (0, 1):
+        d = root / f"class_{cls}"
+        d.mkdir()
+        for i in range(12):
+            # class 0: dark top half; class 1: dark bottom half (+noise)
+            img = rng.integers(100, 156, (28, 28, 3)).astype(np.uint8)
+            if cls == 0:
+                img[:14] //= 4
+            else:
+                img[14:] //= 4
+            ext = "jpg" if i % 2 == 0 else "png"
+            Image.fromarray(img).save(d / f"img_{i:02d}.{ext}")
+        (d / "notes.txt").write_text("not an image")
+    return str(root)
+
+
+class TestImageIO:
+    def test_read_file_decode_jpeg_roundtrip(self, tmp_path):
+        rng = np.random.default_rng(1)
+        img = rng.integers(0, 256, (40, 30, 3)).astype(np.uint8)
+        path = str(tmp_path / "x.jpg")
+        Image.fromarray(img).save(path, quality=95)
+        raw = p.vision.ops.read_file(path)
+        assert raw.dtype == p.uint8 and len(raw.shape) == 1
+        out = p.vision.ops.decode_jpeg(raw)
+        assert list(out.shape) == [3, 40, 30]
+        # JPEG is lossy; high quality keeps pixels close
+        ref = np.asarray(Image.open(path).convert("RGB"))
+        assert np.array_equal(out.numpy(), np.transpose(ref, (2, 0, 1)))
+        gray = p.vision.ops.decode_jpeg(raw, mode="gray")
+        assert list(gray.shape) == [1, 40, 30]
+
+    def test_decode_png_via_loader(self, tmp_path):
+        img = np.zeros((8, 8, 3), np.uint8)
+        path = str(tmp_path / "z.png")
+        Image.fromarray(img).save(path)
+        from paddle_tpu.vision.folder import default_loader
+        assert default_loader(path).shape == (8, 8, 3)
+
+
+class TestDatasetFolder:
+    def test_layout_discovery(self, image_root):
+        ds = p.vision.datasets.DatasetFolder(image_root)
+        assert ds.classes == ["class_0", "class_1"]
+        assert ds.class_to_idx == {"class_0": 0, "class_1": 1}
+        assert len(ds) == 24                      # txt files filtered out
+        assert sorted(set(ds.targets)) == [0, 1]
+        img, label = ds[0]
+        assert img.shape == (28, 28, 3) and img.dtype == np.uint8
+        assert label in (0, 1)
+
+    def test_image_folder_unlabeled(self, image_root):
+        ds = p.vision.datasets.ImageFolder(image_root)
+        assert len(ds) == 24
+        (img,) = ds[0]
+        assert img.shape == (28, 28, 3)
+
+    def test_custom_is_valid_file(self, image_root):
+        ds = p.vision.datasets.DatasetFolder(
+            image_root, is_valid_file=lambda pth: pth.endswith(".png"))
+        assert len(ds) == 12
+
+    def test_train_on_folder(self, image_root):
+        """LeNet-style train over DatasetFolder + DataLoader (the VERDICT
+        done-criterion: a user can train on their own image directory)."""
+        T = p.vision.transforms
+
+        tr = T.Compose([T.Grayscale(), T.ToTensor()])  # -> [1, 28, 28]
+        ds = p.vision.datasets.DatasetFolder(image_root, transform=tr)
+        loader = p.io.DataLoader(ds, batch_size=8, shuffle=True)
+
+        p.seed(0)
+        net = p.nn.Sequential(
+            p.nn.Conv2D(1, 4, 3, padding=1), p.nn.ReLU(),
+            p.nn.MaxPool2D(2), p.nn.Flatten(),
+            p.nn.Linear(4 * 14 * 14, 2))
+        opt = p.optimizer.Adam(learning_rate=0.01,
+                               parameters=net.parameters())
+
+        @p.jit.to_static
+        def step(x, y):
+            loss = F.cross_entropy(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        losses = []
+        for _ in range(6):
+            for x, y in loader:
+                losses.append(float(step(x, y).numpy()))
+        assert losses[-1] < losses[0], (losses[0], losses[-1])

@@ -171,6 +171,49 @@ class TestSavedTensorsHooks:
         assert np.allclose(g0[0], x.grad.numpy(), atol=1e-6)
         assert np.allclose(g0[1], w.grad.numpy(), atol=1e-6)
 
+    def test_offload_releases_intermediate(self):
+        """Under hooks the tape holds op inputs WEAKLY: once user code
+        drops an activation, only the packed (host) form remains and the
+        device buffer is free — the point of activation offload. Without
+        hooks the tape pins inputs (strong refs), as before."""
+        import gc
+        import weakref as wr
+
+        rng = np.random.default_rng(3)
+        x = p.to_tensor(rng.standard_normal((16, 16)).astype(np.float32)
+                        * 0.1)
+        x.stop_gradient = False
+        w = p.to_tensor(rng.standard_normal((16, 16)).astype(np.float32)
+                        * 0.1)
+        w.stop_gradient = False
+
+        with p.autograd.saved_tensors_hooks(
+                lambda t: t.numpy(), lambda pk: p.to_tensor(pk)):
+            h1 = p.matmul(x, w)
+            h2 = h1.tanh()
+            loss = h2.sum()
+        ref = wr.ref(h1)
+        del h1, h2
+        gc.collect()
+        assert ref() is None, "offloaded activation still pinned"
+        loss.backward()
+        g_hook = x.grad.numpy().copy()
+        x.grad = None
+        w.grad = None
+
+        # same graph without hooks: strong refs pin the intermediate,
+        # and grads agree
+        h1 = p.matmul(x, w)
+        h2 = h1.tanh()
+        loss2 = h2.sum()
+        ref2 = wr.ref(h1)
+        del h1, h2
+        gc.collect()
+        assert ref2() is not None
+        loss2.backward()
+        assert np.allclose(g_hook, x.grad.numpy(), atol=1e-6)
+        assert np.abs(g_hook).sum() > 0
+
     def test_pylayer_saved_tensor_packing(self):
         x = p.to_tensor(np.ones((3,), np.float32))
         x.stop_gradient = False

@@ -1,17 +1,25 @@
-"""Standalone TPU health probe. Prints one JSON line and exits.
+"""Standalone TPU health probe: delegates to bench.py's probe() so the
+device-init contract (one matmul, one JSON line, never kill a running
+probe — a killed claim-holding python wedges the tunnel for hours) lives
+in exactly one place.
 
-Run detached; NEVER kill it — if the axon claim is wedged it will hang
-until the relay releases, and killing it can wedge the claim further.
+Run detached; let it exit on its own.
 """
-import json, sys, time
-t0 = time.time()
-try:
-    import jax, jax.numpy as jnp
-    devs = jax.devices()
-    x = jnp.ones((256, 256), jnp.bfloat16)
-    y = (x @ x).block_until_ready()
-    out = {"ok": True, "platform": devs[0].platform, "n": len(devs),
-           "device": str(devs[0]), "t": round(time.time() - t0, 2)}
-except Exception as e:  # noqa: BLE001
-    out = {"ok": False, "error": f"{type(e).__name__}: {e}", "t": round(time.time() - t0, 2)}
-print(json.dumps(out), flush=True)
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if __name__ == "__main__":
+    import json
+    import time
+
+    t0 = time.time()
+    try:
+        from bench import probe
+        sys.exit(probe())
+    except SystemExit:
+        raise
+    except Exception as e:  # noqa: BLE001
+        print(json.dumps({"ok": False, "error": f"{type(e).__name__}: {e}",
+                          "t": round(time.time() - t0, 2)}), flush=True)
