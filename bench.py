@@ -228,13 +228,19 @@ def probe():
     import jax
     import jax.numpy as jnp
 
+    t0 = time.time()
     devices, on_tpu = _init_backend()
     x = jnp.ones((256, 256), jnp.bfloat16)
     (x @ x).block_until_ready()
+    # "ok" is the schema every recorded artifact uses (MULTICHIP_r*.json,
+    # .tpu_probe files); "probe_ok" kept as an alias
     print(json.dumps({
+        "ok": True,
         "probe_ok": True,
         "platform": devices[0].platform,
         "device_kind": getattr(devices[0], "device_kind", ""),
+        "n": len(devices),
+        "t": round(time.time() - t0, 2),
     }))
     return 0
 
@@ -354,7 +360,8 @@ def main():
 
     probe_res, probe_err = _await_json(
         _spawn("--probe", force_cpu=False), PROBE_BUDGET_S)
-    tpu_ok = bool(probe_res and probe_res.get("probe_ok")
+    tpu_ok = bool(probe_res
+                  and (probe_res.get("ok") or probe_res.get("probe_ok"))
                   and probe_res.get("platform") != "cpu")
 
     merged, errors = {}, []

@@ -11,6 +11,10 @@ from paddle_tpu.distributed.fleet.utils.fs import (  # noqa: F401
     LocalFS,
 )
 from paddle_tpu.distributed.recompute import recompute  # noqa: F401
+from paddle_tpu.distributed.fleet.utils.hybrid_parallel_inference import (  # noqa: F401,E501
+    DistributedInfer,
+    HybridParallelInferenceHelper,
+)
 
 
 def get_log_level_code():

@@ -300,15 +300,17 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights,
             q, k, v = (jnp.swapaxes(t_, 1, 2) for t_ in qkv)  # [b,n,s,d]
 
             kv_mask_extra = None
+            if pcaches[i] is not None and tstep is None:
+                # prefix keys come FIRST (independent of cache_kvs: a
+                # prefix-tuning forward without a decode cache still
+                # attends over the prefix); with a cache the concatenated
+                # stream is stored so decode offsets line up
+                k = jnp.concatenate([pcaches[i][0], k], axis=2)
+                v = jnp.concatenate([pcaches[i][1], v], axis=2)
             if caches[i] is not None:
                 cache = caches[i]
                 max_len = cache.shape[3]
                 if tstep is None:                       # prefill
-                    if pcaches[i] is not None:
-                        # prefix keys come FIRST; the cache stores the
-                        # concatenated stream so decode offsets line up
-                        k = jnp.concatenate([pcaches[i][0], k], axis=2)
-                        v = jnp.concatenate([pcaches[i][1], v], axis=2)
                     cache = cache.at[0, :, :, :k.shape[2]].set(k)
                     cache = cache.at[1, :, :, :v.shape[2]].set(v)
                 else:                                   # decode: s == 1

@@ -16,8 +16,12 @@ from paddle_tpu.framework.state import register_state_tensor
 from paddle_tpu.optimizer.optimizer import Optimizer
 
 from paddle_tpu.incubate.optimizer import functional  # noqa: F401
+from paddle_tpu.incubate.optimizer.distributed_fused_lamb import (  # noqa: F401,E501
+    DistributedFusedLamb,
+)
 
-__all__ = ["LookAhead", "ModelAverage", "functional"]
+__all__ = ["LookAhead", "ModelAverage", "functional",
+           "DistributedFusedLamb"]
 
 
 def _state(name, value):

@@ -1,7 +1,7 @@
 """Misc utils. Reference: python/paddle/utils/__init__.py."""
 from __future__ import annotations
 
-from paddle_tpu.utils import dlpack  # noqa: F401
+from paddle_tpu.utils import cpp_extension, custom_op, dlpack  # noqa: F401
 
 
 def try_import(name):

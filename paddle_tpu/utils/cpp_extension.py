@@ -1,0 +1,132 @@
+"""C++ extension loading — host-side native custom ops.
+
+Reference: python/paddle/utils/cpp_extension/cpp_extension.py (load /
+setup / CppExtension / CUDAExtension: compile user C++ into framework
+ops). TPU-native split: device compute belongs to Pallas/jnp custom ops
+(utils/custom_op.py); what legitimately stays native is HOST-side work —
+tokenizers, samplers, feature extraction, IO — and that is exactly what
+this module compiles. ``load`` builds the sources with g++ into a shared
+library (the same toolchain path as paddle_tpu/native/*.cc) and returns
+a ctypes handle; ``as_host_op`` lifts an exported C function operating
+on float32 buffers into a jit-safe framework op via
+``jax.pure_callback``, so compiled C++ runs inside a traced program at
+the host boundary.
+
+Expected C signature for ``as_host_op``::
+
+    extern "C" void my_op(const float* in, float* out, long n);
+
+CUDAExtension has no meaning on a TPU system and raises.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+
+__all__ = ["load", "CppExtension", "CUDAExtension", "setup", "as_host_op",
+           "get_build_directory"]
+
+_DEFAULT_BUILD_DIR = os.path.join(tempfile.gettempdir(),
+                                  "paddle_tpu_extensions")
+
+
+def get_build_directory():
+    os.makedirs(_DEFAULT_BUILD_DIR, exist_ok=True)
+    return _DEFAULT_BUILD_DIR
+
+
+class CppExtension:
+    def __init__(self, sources, *args, **kwargs):
+        self.sources = list(sources)
+        self.extra_compile_args = kwargs.get("extra_compile_args") or []
+        self.include_dirs = kwargs.get("include_dirs") or []
+
+
+def CUDAExtension(*args, **kwargs):
+    raise RuntimeError(
+        "CUDAExtension has no TPU analogue — write device kernels as "
+        "Pallas custom ops (paddle_tpu.utils.custom_op.register_custom_op)"
+        " and keep C++ for host-side work via CppExtension/load")
+
+
+def load(name, sources, extra_cxx_cflags=None, extra_include_paths=None,
+         build_directory=None, verbose=False, **kwargs):
+    """Compile C++ `sources` into <build_dir>/lib<name>.so and return the
+    ctypes.CDLL handle. Caching: recompiles only when a source is newer
+    than the built library."""
+    build_dir = build_directory or get_build_directory()
+    os.makedirs(build_dir, exist_ok=True)
+    out = os.path.join(build_dir, f"lib{name}.so")
+    srcs = [os.path.abspath(s) for s in sources]
+    fresh = os.path.exists(out) and all(
+        os.path.getmtime(out) >= os.path.getmtime(s) for s in srcs)
+    if not fresh:
+        cmd = (["g++", "-O2", "-fPIC", "-shared", "-std=c++17"]
+               + [f"-I{d}" for d in (extra_include_paths or [])]
+               + (extra_cxx_cflags or [])
+               + srcs + ["-o", out])
+        if verbose:
+            print(" ".join(cmd))
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"cpp_extension build failed:\n{proc.stderr[-4000:]}")
+    return ctypes.CDLL(out)
+
+
+def setup(name=None, ext_modules=None, **kwargs):
+    """setuptools-style entry: build every CppExtension immediately
+    (the reference generates a python wheel; here the shared library in
+    the build directory IS the artifact — import it with `load`)."""
+    exts = ext_modules or []
+    if not isinstance(exts, (list, tuple)):
+        exts = [exts]
+    libs = {}
+    for i, ext in enumerate(exts):
+        ext_name = name or f"ext_{i}"
+        libs[ext_name] = load(ext_name, ext.sources,
+                              extra_cxx_cflags=ext.extra_compile_args,
+                              extra_include_paths=ext.include_dirs)
+    return libs
+
+
+def as_host_op(lib, symbol, out_shape_fn=None):
+    """Lift `extern "C" void f(const float*, float*, long)` into a
+    framework op usable eagerly AND inside jit (via jax.pure_callback —
+    the op runs on host at a callback boundary; XLA overlaps transfers).
+
+    out_shape_fn(in_shape) -> out_shape; defaults to same-shape.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.dispatch import apply
+
+    cfun = getattr(lib, symbol)
+    cfun.restype = None
+    cfun.argtypes = [ctypes.POINTER(ctypes.c_float),
+                     ctypes.POINTER(ctypes.c_float), ctypes.c_long]
+
+    def host(x):
+        x = np.ascontiguousarray(np.asarray(x, np.float32))
+        shape = out_shape_fn(x.shape) if out_shape_fn else x.shape
+        out = np.empty(shape, np.float32)
+        cfun(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+             out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+             ctypes.c_long(x.size))
+        return out
+
+    def fn(xv):
+        shape = out_shape_fn(xv.shape) if out_shape_fn else xv.shape
+        return jax.pure_callback(
+            host, jax.ShapeDtypeStruct(tuple(shape), jnp.float32), xv)
+
+    def op(x):
+        return apply(fn, x)
+
+    op.__name__ = symbol
+    return op

@@ -1,0 +1,99 @@
+"""Public custom-op registration — the TPU-native cpp_extension story.
+
+Reference: python/paddle/utils/cpp_extension/cpp_extension.py — the
+reference's custom-op path compiles user C++/CUDA kernels and registers
+them as framework ops with gradients. On a TPU system the compute-path
+analogue is a Pallas (or plain jnp) kernel registered as a paddle_tpu op
+with a VJP; host-side C++ remains available through
+``paddle_tpu.utils.cpp_extension`` (ctypes + pure_callback).
+
+Usage::
+
+    import jax.numpy as jnp
+    from paddle_tpu.utils.custom_op import register_custom_op
+
+    def silu_fwd(x):                 # pure fn over jnp arrays —
+        return x * jax.nn.sigmoid(x) # or a pl.pallas_call kernel
+
+    def silu_bwd(saved, grads):
+        (x,) = saved
+        (g,) = grads
+        s = jax.nn.sigmoid(x)
+        return (g * (s + x * s * (1 - s)),)
+
+    my_silu = register_custom_op("my_silu", silu_fwd, backward=silu_bwd)
+    y = my_silu(tensor)              # eager: recorded on the tape
+    # ... and inside @to_static it traces into the XLA program.
+
+The op works in BOTH execution modes for free: eagerly each call runs
+through core/dispatch.apply (tape-recorded, ``backward()`` uses the
+custom VJP); under ``to_static`` the same function traces into the
+single-program XLA compile. ``backward=None`` falls back to jax's
+autodiff of the forward — register a backward only when autodiff can't
+differentiate the kernel (e.g. a Pallas call without a built-in VJP) or
+a custom gradient is wanted.
+"""
+from __future__ import annotations
+
+import jax
+
+from paddle_tpu.core.dispatch import apply
+
+__all__ = ["register_custom_op", "get_custom_op", "custom_ops"]
+
+custom_ops = {}
+
+
+def register_custom_op(name, forward, backward=None, nondiff_args=()):
+    """Register `forward` as a framework op; returns the Tensor-level
+    callable (also retrievable via get_custom_op(name)).
+
+    forward(*arrays) -> array | tuple of arrays — pure over jnp arrays
+        (jnp ops, lax, or pl.pallas_call kernels).
+    backward(saved_inputs, output_cotangents) -> input cotangent tuple,
+        one entry per differentiable forward argument (None entries are
+        allowed). When omitted, jax.vjp differentiates the forward.
+    nondiff_args: indices of non-array / configuration arguments (static
+        under jit, excluded from the VJP).
+    """
+    if name in custom_ops:
+        raise ValueError(f"custom op {name!r} already registered")
+
+    if backward is None:
+        kernel = forward
+    else:
+        core = jax.custom_vjp(forward, nondiff_argnums=tuple(nondiff_args))
+        nd = set(nondiff_args)
+
+        def fwd(*args):
+            out = forward(*args)
+            # residuals: differentiable args only (static args reach bwd
+            # as leading positionals via nondiff_argnums)
+            return out, tuple(a for i, a in enumerate(args) if i not in nd)
+
+        def bwd(*res_and_cot):
+            *static, saved, cot = res_and_cot
+            cots = cot if isinstance(cot, tuple) else (cot,)
+            grads = backward(saved, cots)
+            return tuple(grads)
+
+        core.defvjp(fwd, bwd)
+        kernel = core
+
+    def op(*tensors, **kwargs):
+        return apply(kernel, *tensors, **kwargs)
+
+    op.__name__ = name
+    op._forward = forward
+    op._backward = backward
+    custom_ops[name] = op
+    return op
+
+
+def get_custom_op(name):
+    try:
+        return custom_ops[name]
+    except KeyError:
+        raise KeyError(
+            f"no custom op {name!r}; registered: {sorted(custom_ops)}"
+        ) from None

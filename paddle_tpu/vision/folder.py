@@ -72,6 +72,7 @@ class DatasetFolder(Dataset):
 
     def __init__(self, root, loader=None, extensions=None, transform=None,
                  is_valid_file=None):
+        root = os.path.expanduser(root)
         self.root = root
         self.transform = transform
         self.loader = loader or default_loader
@@ -112,9 +113,14 @@ class ImageFolder(Dataset):
 
     def __init__(self, root, loader=None, extensions=None, transform=None,
                  is_valid_file=None):
+        root = os.path.expanduser(root)
         self.root = root
         self.transform = transform
         self.loader = loader or default_loader
+        if extensions is not None and is_valid_file is not None:
+            raise ValueError(
+                "exactly one of `extensions` and `is_valid_file` must be "
+                "set")  # same contract as DatasetFolder/make_dataset
         if extensions is None and is_valid_file is None:
             extensions = IMG_EXTENSIONS
         if is_valid_file is None:

@@ -40,13 +40,15 @@ __all__ = [
 def read_file(filename, name=None):
     """File bytes as a 1-D uint8 Tensor (reference vision/ops.py:1448).
 
-    Host-side IO: the bytes land in host memory; only decode_jpeg's
-    output (the pixel array) should ever move to the device.
+    Host-side IO: the bytes stay in host memory (a cpu-device array) —
+    only decode_jpeg's output (the pixel array) should ever move to the
+    accelerator, so the compressed file never does a device round-trip
+    on the data-loading path.
     """
-    import jax.numpy as _jnp
+    import jax
     with open(filename, "rb") as f:
         data = np.frombuffer(f.read(), dtype=np.uint8)
-    return Tensor(_jnp.asarray(data))
+    return Tensor(jax.device_put(data, jax.devices("cpu")[0]))
 
 
 def decode_jpeg(x, mode="unchanged", name=None):

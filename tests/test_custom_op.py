@@ -1,0 +1,109 @@
+"""Custom-op public API (r4, missing #8): register a Pallas/jnp kernel as
+a framework op with a VJP; compile host-side C++ via cpp_extension.
+
+Reference: python/paddle/utils/cpp_extension/cpp_extension.py.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as p
+from paddle_tpu.utils.custom_op import (custom_ops, get_custom_op,
+                                        register_custom_op)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    yield
+    for k in [k for k in custom_ops if k.startswith("t_")]:
+        del custom_ops[k]
+
+
+class TestRegisterCustomOp:
+    def test_forward_autodiff_backward(self):
+        op = register_custom_op("t_square", lambda x: x * x)
+        x = p.to_tensor(np.array([2.0, 3.0], np.float32))
+        x.stop_gradient = False
+        y = op(x)
+        np.testing.assert_allclose(y.numpy(), [4.0, 9.0])
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+        assert get_custom_op("t_square") is op
+
+    def test_custom_vjp(self):
+        # deliberately wrong-by-10x gradient proves the CUSTOM rule runs
+        def bwd(saved, cots):
+            (x,) = saved
+            (g,) = cots
+            return (10.0 * g * 2.0 * x,)
+
+        op = register_custom_op("t_square10", lambda x: x * x, backward=bwd)
+        x = p.to_tensor(np.array([3.0], np.float32))
+        x.stop_gradient = False
+        op(x).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [60.0])
+
+    def test_under_to_static(self):
+        def silu_bwd(saved, cots):
+            (x,) = saved
+            (g,) = cots
+            s = jax.nn.sigmoid(x)
+            return (g * (s + x * s * (1 - s)),)
+
+        op = register_custom_op(
+            "t_silu", lambda x: x * jax.nn.sigmoid(x), backward=silu_bwd)
+
+        w = p.to_tensor(np.array([0.5], np.float32))
+        w.stop_gradient = False
+
+        @p.jit.to_static
+        def step(x):
+            loss = op(x * w).sum()
+            loss.backward()
+            g = w.grad
+            w.grad = None
+            return loss, g
+
+        x = np.array([1.0, -2.0], np.float32)
+        loss, g = step(p.to_tensor(x))
+        # oracle via jax
+        want = jax.grad(
+            lambda wv: jnp.sum(jax.nn.silu(jnp.asarray(x) * wv)))(0.5)
+        np.testing.assert_allclose(g.numpy(), [np.asarray(want)],
+                                   rtol=1e-5)
+
+    def test_duplicate_name_rejected(self):
+        register_custom_op("t_dup", lambda x: x)
+        with pytest.raises(ValueError, match="already registered"):
+            register_custom_op("t_dup", lambda x: x)
+
+
+class TestCppExtension:
+    def test_compile_and_run_host_op(self, tmp_path):
+        src = tmp_path / "scale2.cc"
+        src.write_text(
+            'extern "C" void scale2(const float* in, float* out, long n)'
+            '{ for (long i = 0; i < n; ++i) out[i] = 2.0f * in[i]; }\n')
+        from paddle_tpu.utils import cpp_extension as cpp
+
+        lib = cpp.load("t_scale2", [str(src)],
+                       build_directory=str(tmp_path))
+        op = cpp.as_host_op(lib, "scale2")
+        x = p.to_tensor(np.arange(6, dtype=np.float32))
+        np.testing.assert_allclose(op(x).numpy(),
+                                   2.0 * np.arange(6, dtype=np.float32))
+
+        # works inside a traced program (pure_callback boundary)
+        @p.jit.to_static
+        def f(x):
+            return op(x) + 1.0
+
+        np.testing.assert_allclose(
+            f(x).numpy(), 2.0 * np.arange(6, dtype=np.float32) + 1.0)
+
+    def test_cuda_extension_raises(self):
+        from paddle_tpu.utils import cpp_extension as cpp
+        with pytest.raises(RuntimeError, match="Pallas"):
+            cpp.CUDAExtension([])

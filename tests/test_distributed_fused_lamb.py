@@ -1,0 +1,158 @@
+"""DistributedFusedLamb + mesh-aware inference helpers (r4, VERDICT #10).
+
+Reference: python/paddle/incubate/optimizer/distributed_fused_lamb.py:83,
+python/paddle/distributed/fleet/utils/hybrid_parallel_inference.py:23,
+python/paddle/distributed/fleet/utils/ps_util.py:23.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as p
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.incubate.optimizer import DistributedFusedLamb
+
+
+@pytest.fixture
+def meshes():
+    yield
+    mesh_mod.set_mesh(None)
+
+
+def _net():
+    p.seed(0)
+    return p.nn.Sequential(p.nn.Linear(8, 32), p.nn.ReLU(),
+                           p.nn.Linear(32, 2))
+
+
+def _data():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 8)).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.int64)
+    return p.to_tensor(x), p.to_tensor(y)
+
+
+class TestDistributedFusedLamb:
+    def test_converges_and_matches_lamb(self, meshes):
+        x, y = _data()
+
+        def train(opt_cls, **kw):
+            net = _net()
+            opt = opt_cls(learning_rate=0.05, parameters=net.parameters(),
+                          **kw)
+            losses = []
+            for _ in range(15):
+                loss = F.cross_entropy(net(x), y)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                losses.append(float(loss.numpy()))
+            return losses
+
+        dfl = train(DistributedFusedLamb)
+        ref = train(p.optimizer.Lamb)
+        assert dfl[-1] < dfl[0] * 0.7, dfl
+        # same math modulo fp32 master accumulation: closely tracking
+        assert abs(dfl[-1] - ref[-1]) < 0.15, (dfl[-1], ref[-1])
+
+    def test_global_norm_clip_and_inf_skip(self, meshes):
+        from paddle_tpu.nn import ClipGradByGlobalNorm
+        net = _net()
+        opt = DistributedFusedLamb(
+            learning_rate=0.1, parameters=net.parameters(),
+            grad_clip=ClipGradByGlobalNorm(0.1))
+        x, y = _data()
+        loss = F.cross_entropy(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        assert not bool(opt._found_inf.numpy()[0])
+
+        # poison one grad with inf: the update must be skipped entirely
+        before = [q.numpy().copy() for q in net.parameters()]
+        loss = F.cross_entropy(net(x), y)
+        loss.backward()
+        g0 = net.parameters()[0].grad
+        g0._set_value(jnp.full_like(g0._value, jnp.inf))
+        opt.step()
+        opt.clear_grad()
+        assert bool(opt._found_inf.numpy()[0])
+        for b, q in zip(before, net.parameters()):
+            np.testing.assert_array_equal(b, q.numpy())
+
+    def test_state_sharded_over_dp(self, meshes):
+        mesh = mesh_mod.init_mesh({"dp": 8})
+        net = _net()
+        opt = DistributedFusedLamb(learning_rate=0.05,
+                                   parameters=net.parameters())
+        x, y = _data()
+        loss = F.cross_entropy(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        # moments live flattened, padded to dp=8, sharded over dp
+        m = opt._flat_acc("moment1", net.parameters()[0])
+        assert m._value.size % 8 == 0
+        sh = m._value.sharding
+        assert getattr(sh, "spec", None) == P("dp"), sh
+        # one device holds 1/8 of the flat moment
+        shard = m._value.addressable_shards[0]
+        assert shard.data.size == m._value.size // 8
+
+    def test_gradient_accumulation(self, meshes):
+        net = _net()
+        opt = DistributedFusedLamb(learning_rate=0.05,
+                                   parameters=net.parameters(),
+                                   gradient_accumulation_steps=2)
+        x, y = _data()
+        w0 = net.parameters()[0].numpy().copy()
+        loss = F.cross_entropy(net(x), y)
+        loss.backward()
+        opt.step()                      # step 1 of 2: accumulate only
+        opt.clear_grad()
+        np.testing.assert_array_equal(w0, net.parameters()[0].numpy())
+        loss = F.cross_entropy(net(x), y)
+        loss.backward()
+        opt.step()                      # step 2 of 2: update fires
+        opt.clear_grad()
+        assert np.abs(w0 - net.parameters()[0].numpy()).max() > 0
+
+
+class TestHybridParallelInference:
+    def test_tp_sharded_serving_matches_single(self, meshes):
+        from paddle_tpu.distributed.fleet.utils import (
+            HybridParallelInferenceHelper,
+        )
+
+        net = _net()
+        x, _ = _data()
+        net.eval()
+        want = net(x).numpy()
+
+        mesh = mesh_mod.init_mesh({"mp": 8})
+        # Megatron pair: first linear column-parallel, second row-parallel
+        specs = {"0.weight": P(None, "mp"), "0.bias": P("mp"),
+                 "2.weight": P("mp", None)}
+        helper = HybridParallelInferenceHelper(net, mesh,
+                                               param_specs=specs)
+        (got,) = helper.run(x.numpy())
+        np.testing.assert_allclose(got, want, atol=1e-5)
+        # weights are genuinely sharded: one device holds 1/8 columns
+        w = dict(net.state_dict())["0.weight"]
+        assert w._value.addressable_shards[0].data.shape == (8, 4)
+
+    def test_distributed_infer_runs(self, meshes):
+        from paddle_tpu.distributed.fleet.utils import DistributedInfer
+
+        net = _net()
+        x, _ = _data()
+        net.eval()
+        want = net(x).numpy()
+        di = DistributedInfer(model=net)
+        di.init_distributed_infer_env()
+        (got,) = di.run(x)
+        np.testing.assert_allclose(got, want, atol=1e-6)
