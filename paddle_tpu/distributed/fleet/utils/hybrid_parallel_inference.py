@@ -55,9 +55,11 @@ class HybridParallelInferenceHelper:
         self.model = model
         self.mesh = mesh or ensure_mesh()
         self.param_specs = param_specs or {}
-        self._compiled = {}
         model.eval()
         self._shard_params()
+        # jax.jit specializes per input shape/dtype internally — one
+        # wrapper is the whole cache
+        self._fn = jax.jit(self._functional())
 
     def _spec_for(self, name, value):
         spec = None
@@ -85,14 +87,10 @@ class HybridParallelInferenceHelper:
     def run(self, *inputs):
         """One replicated-in, replicated-out forward over the mesh."""
         arrs = [jnp.asarray(np.asarray(x)) for x in inputs]
-        key = tuple((tuple(a.shape), str(a.dtype)) for a in arrs)
-        if key not in self._compiled:
-            self._compiled[key] = jax.jit(self._functional())
-        fn = self._compiled[key]
         # params re-read per call: a set_state_dict between runs must
         # serve the NEW weights (only the compiled fn is cached)
         params = {k: v._value for k, v in self.model.state_dict().items()}
-        outs = fn(params, *arrs)
+        outs = self._fn(params, *arrs)
         return [np.asarray(o) for o in outs]
 
     # reference-API no-ops: GSPMD already did the program split
@@ -132,9 +130,19 @@ class DistributedInfer:
         if dist.get_world_size() > 1:
             dist.barrier()
         if dirname and self.model is not None:
+            import os
+
             from paddle_tpu.framework.io import load
-            state = load(dirname)
-            self.model.set_state_dict(state)
+            path = dirname
+            if os.path.isdir(dirname):
+                cands = sorted(
+                    f for f in os.listdir(dirname)
+                    if f.endswith((".pdparams", ".pkl")))
+                if not cands:
+                    raise FileNotFoundError(
+                        f"no .pdparams/.pkl checkpoint in {dirname}")
+                path = os.path.join(dirname, cands[0])
+            self.model.set_state_dict(load(path))
         return None
 
     def run(self, *inputs):

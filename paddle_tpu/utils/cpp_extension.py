@@ -87,7 +87,10 @@ def setup(name=None, ext_modules=None, **kwargs):
         exts = [exts]
     libs = {}
     for i, ext in enumerate(exts):
-        ext_name = name or f"ext_{i}"
+        # `name` maps to the lib only when unambiguous; multiple
+        # extensions get indexed names so none overwrites another
+        ext_name = name if (name and len(exts) == 1) else \
+            f"{name or 'ext'}_{i}"
         libs[ext_name] = load(ext_name, ext.sources,
                               extra_cxx_cflags=ext.extra_compile_args,
                               extra_include_paths=ext.include_dirs)

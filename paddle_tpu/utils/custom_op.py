@@ -75,7 +75,11 @@ def register_custom_op(name, forward, backward=None, nondiff_args=()):
             *static, saved, cot = res_and_cot
             cots = cot if isinstance(cot, tuple) else (cot,)
             grads = backward(saved, cots)
-            return tuple(grads)
+            # None entries mean "no gradient": custom_vjp requires a
+            # cotangent matching the primal, so materialize zeros
+            return tuple(
+                jax.numpy.zeros_like(s) if g is None else g
+                for g, s in zip(grads, saved))
 
         core.defvjp(fwd, bwd)
         kernel = core
