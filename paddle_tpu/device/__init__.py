@@ -16,7 +16,41 @@ from paddle_tpu.core.device import (  # noqa: F401
     set_device,
 )
 
+from paddle_tpu.core.device import (  # noqa: F401
+    CPUPlace,
+    CUDAPinnedPlace,
+    CUDAPlace,
+    TPUPlace,
+    XPUPlace,
+)
+
 from . import cuda  # noqa: F401
+
+
+class IPUPlace:
+    def __init__(self, *a):
+        raise RuntimeError("IPU is not a TPU-system device; use TPUPlace")
+
+
+class MLUPlace:
+    def __init__(self, *a):
+        raise RuntimeError("MLU is not a TPU-system device; use TPUPlace")
+
+
+def get_cudnn_version():
+    return None
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_mlu():
+    return False
 
 __all__ = [
     "get_device", "set_device", "device_count", "synchronize",
@@ -25,6 +59,10 @@ __all__ = [
     "is_compiled_with_tpu", "get_all_device_type",
     "get_all_custom_device_type", "get_available_device",
     "get_available_custom_device",
+    "CPUPlace", "CUDAPlace", "CUDAPinnedPlace", "TPUPlace", "XPUPlace",
+    "IPUPlace", "MLUPlace", "get_cudnn_version",
+    "is_compiled_with_cinn", "is_compiled_with_ipu",
+    "is_compiled_with_mlu",
 ]
 
 

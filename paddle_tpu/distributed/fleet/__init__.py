@@ -335,3 +335,42 @@ from paddle_tpu.distributed.fleet import (  # noqa: E402
 
 _mp.sharding = _mps
 _sys.modules[__name__ + ".meta_parallel.sharding"] = _mps
+
+
+# ---- launch-plumbing surface (reference fleet/launch_utils.py) ----
+# the canonical classes live in distributed.utils.launch_utils; the
+# reference exposes them from the fleet namespace too
+from paddle_tpu.distributed.utils.launch_utils import (  # noqa: E402,F401
+    Cluster,
+    Hdfs,
+    JobServer,
+    Pod,
+    Trainer,
+    TrainerProc,
+    get_cluster,
+    get_logger as _llu_get_logger,
+    terminate_local_procs,
+)
+from paddle_tpu.distributed.fleet import base  # noqa: E402,F401
+
+
+class DistributeMode:
+    """fleetrun launch mode ids (reference launch_utils.py:38)."""
+
+    COLLECTIVE = 0
+    PS = 1
+    PS_HETER = 2
+
+
+class DeviceMode:
+    """Training device type ids (reference launch_utils.py:48); TPU is
+    the accelerator here — mapped onto the collective/XPU slot."""
+
+    UNKNOWN = -1
+    CPU = 0
+    GPU = 1
+    KUNLUN = 2
+    XPU = 2
+    ASCEND_NPU = 3
+    MLU = 4
+    TPU = 5

@@ -1,0 +1,144 @@
+"""paddle.distributed.fleet.base parity namespace.
+
+Reference: python/paddle/distributed/fleet/base/ (topology.py
+CommunicateTopology/HybridCommunicateGroup, role_maker.py,
+strategy_group.py DPGroup/MPGroup/PPGroup/ShardingGroup/
+OrthogonalStrategy, util_factory.py UtilBase).
+
+TPU-native: the topology/role classes are thin views over the installed
+jax.sharding.Mesh (one SPMD program, no per-rank processes to
+choreograph); strategy groups wrap distributed.new_group so collective
+calls can still be scoped the reference's way.
+"""
+from __future__ import annotations
+
+from paddle_tpu.distributed.fleet import (  # noqa: F401
+    CommunicateTopology,
+    HybridCommunicateGroup,
+    PaddleCloudRoleMaker,
+    UserDefinedRoleMaker,
+    UtilBase,
+)
+
+__all__ = [
+    "CommunicateTopology", "HybridCommunicateGroup",
+    "PaddleCloudRoleMaker", "UserDefinedRoleMaker", "UtilBase",
+    "StrategyGroupBase", "DPGroup", "MPGroup", "PPGroup",
+    "ShardingGroup", "OrthogonalStrategy",
+]
+
+
+class StrategyGroupBase:
+    """One parallelism axis's process groups (reference
+    fleet/base/strategy_group.py StrategyGroupBase): built from rank
+    lists; `group` is the group containing this rank (or the list when
+    several do)."""
+
+    def __init__(self, list_of_ranks):
+        import paddle_tpu.distributed as dist
+        self._list_of_ranks = list(list_of_ranks)
+        rank = dist.get_rank()
+        groups = [dist.new_group(rs) for rs in self._list_of_ranks]
+        mine = [g for g, rs in zip(groups, self._list_of_ranks)
+                if rank in rs]
+        self._group = mine[0] if len(mine) == 1 else (mine or groups)
+
+    @property
+    def group(self):
+        return self._group
+
+    @property
+    def world_size(self):
+        sizes = {len(rs) for rs in self._list_of_ranks}
+        return sizes.pop() if len(sizes) == 1 else \
+            [len(rs) for rs in self._list_of_ranks]
+
+
+class DPGroup(StrategyGroupBase):
+    pass
+
+
+class MPGroup(StrategyGroupBase):
+    pass
+
+
+class ShardingGroup(StrategyGroupBase):
+    pass
+
+
+class PPGroup(StrategyGroupBase):
+    """Pipeline groups additionally expose the p2p neighbor ranks the
+    reference's send/recv schedule uses; in the SPMD rendering these are
+    the ppermute peers."""
+
+    def __init__(self, list_of_ranks):
+        super().__init__(list_of_ranks)
+        import paddle_tpu.distributed as dist
+        rank = dist.get_rank()
+        self._rank_of_next_stage = None
+        self._rank_of_prev_stage = None
+        for rs in self._list_of_ranks:
+            if rank in rs:
+                i = rs.index(rank)
+                self._rank_of_next_stage = rs[(i + 1) % len(rs)]
+                self._rank_of_prev_stage = rs[(i - 1) % len(rs)]
+
+    @property
+    def rank_of_next_stage(self):
+        return self._rank_of_next_stage
+
+    @property
+    def rank_of_prev_stage(self):
+        return self._rank_of_prev_stage
+
+
+class OrthogonalStrategy:
+    """Compose orthogonal parallelism axes (reference strategy_group.py
+    OrthogonalStrategy): list of (name, degree, group_cls); rank lists
+    are the mesh-order cartesian slices, plus fused groups over unions
+    of axes."""
+
+    def __init__(self, list_of_strategy, fused_strategy_dict=None):
+        import itertools
+
+        import paddle_tpu.distributed as dist
+        self._strategies = {}
+        names = [s[0] for s in list_of_strategy]
+        degrees = [s[1] for s in list_of_strategy]
+        world = 1
+        for d in degrees:
+            world *= d
+        if dist.get_world_size() not in (1, world):
+            raise ValueError(
+                f"strategy degrees {degrees} produce world {world} != "
+                f"{dist.get_world_size()}")
+        self._degrees = dict(zip(names, degrees))
+        # rank layout: row-major over the strategy order
+        coords = list(itertools.product(*[range(d) for d in degrees]))
+        rank_of = {c: i for i, c in enumerate(coords)}
+        for ax, (nm, d, cls) in enumerate(list_of_strategy):
+            lists = {}
+            for c in coords:
+                key = c[:ax] + c[ax + 1:]
+                lists.setdefault(key, []).append(rank_of[c])
+            self._strategies[nm] = cls(sorted(lists.values()))
+        self._fused = {}
+        for fname, axes in (fused_strategy_dict or {}).items():
+            ax_ids = [names.index(a) for a in axes]
+            lists = {}
+            for c in coords:
+                key = tuple(v for i, v in enumerate(c) if i not in ax_ids)
+                lists.setdefault(key, []).append(rank_of[c])
+            self._fused[fname] = StrategyGroupBase(sorted(lists.values()))
+
+    def strategy_group(self, name):
+        return self._strategies[name]
+
+    def fused_strategy_group(self, name):
+        return self._fused[name]
+
+    def rank_in_strategy(self, name):
+        import paddle_tpu.distributed as dist
+        g = self._strategies[name].group
+        ranks = getattr(g, "ranks", None)
+        return ranks.index(dist.get_rank()) if ranks else 0

@@ -206,6 +206,14 @@ class ASPHelper:
 
     _masks = {}          # id(param) -> (param, mask ndarray)
     _excluded = set()    # layer-name prefixes
+    _extra_supported = {}  # add_supported_layer registrations
+
+    @classmethod
+    def _registration_for(cls, name):
+        for key, fn in cls._extra_supported.items():
+            if key in name.lower():
+                return key, fn
+        return None, None
 
     @classmethod
     def is_supported(cls, name, param):
@@ -214,6 +222,8 @@ class ASPHelper:
         shape = tuple(param._value.shape)
         if len(shape) < 2:
             return False
+        if cls._registration_for(name)[0] is not None:
+            return True  # add_supported_layer registration wins
         return shape[-1] % 4 == 0
 
     @classmethod
@@ -223,8 +233,17 @@ class ASPHelper:
         for name, p in model.named_parameters():
             if not name.endswith("weight") or not cls.is_supported(name, p):
                 continue
-            mask = create_mask(np.asarray(p._value), mask_algo, n, m)
-            p._set_value(p._value * jnp.asarray(mask, p._value.dtype))
+            _, custom = cls._registration_for(name)
+            if custom is not None:
+                # registered pruning_func(weight, m, n, algo_name, name)
+                # -> (pruned_weight, mask), the reference's contract
+                w, mask = custom(np.asarray(p._value), m, n,
+                                 getattr(mask_algo, "value", mask_algo),
+                                 name)
+                p._set_value(jnp.asarray(w, p._value.dtype))
+            else:
+                mask = create_mask(np.asarray(p._value), mask_algo, n, m)
+                p._set_value(p._value * jnp.asarray(mask, p._value.dtype))
             if with_mask:
                 cls._masks[id(p)] = (p, mask)
             pruned[name] = mask
@@ -235,6 +254,18 @@ class ASPHelper:
         import jax.numpy as jnp
         for p, mask in cls._masks.values():
             p._set_value(p._value * jnp.asarray(mask, p._value.dtype))
+
+
+def add_supported_layer(layer, pruning_func=None):
+    """Register an extra layer type (or parameter-name prefix) as
+    prunable (reference asp/supported_layer_list.py add_supported_layer).
+    With `pruning_func`, it is called as pruning_func(weight_ndarray, m,
+    n, mask_algo, param_name) -> (pruned_weight, mask) during
+    prune_model."""
+    name = layer if isinstance(layer, str) else \
+        getattr(layer, "__name__", str(layer)).lower()
+    ASPHelper._extra_supported[name] = pruning_func
+    return name
 
 
 def set_excluded_layers(param_names, main_program=None):

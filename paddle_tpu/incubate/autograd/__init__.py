@@ -1,5 +1,16 @@
 """paddle.incubate.autograd parity (reference:
-python/paddle/incubate/autograd/__init__.py)."""
+python/paddle/incubate/autograd/__init__.py: vjp, jvp, Jacobian,
+Hessian, enable_prim/disable_prim, forward_grad, grad).
+
+The reference's primitive machinery (Registry/REGISTER_JVP/orig2prim/
+prim2orig transform passes) hand-builds a primitive-level autodiff over
+ProgramDesc. JAX *is* that system here — every op already lowers to
+differentiable primitives — so enable_prim/disable_prim are honest
+flags (primitive mode is always on) and forward_grad/grad run jax's
+native forward/reverse transforms through the same functional surface.
+"""
+from __future__ import annotations
+
 from paddle_tpu.autograd.functional import (  # noqa: F401
     Hessian,
     Jacobian,
@@ -7,4 +18,58 @@ from paddle_tpu.autograd.functional import (  # noqa: F401
     vjp,
 )
 
-__all__ = ["vjp", "jvp", "Jacobian", "Hessian"]
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian", "enable_prim",
+           "disable_prim", "prim_enabled", "forward_grad", "grad"]
+
+_prim_flag = [True]
+
+
+def enable_prim():
+    _prim_flag[0] = True
+
+
+def disable_prim():
+    # accepted for API parity; ops always execute as jax primitives
+    _prim_flag[0] = False
+
+
+def prim_enabled():
+    return _prim_flag[0]
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """Forward-mode gradients (reference primapi.py forward_grad).
+
+    The reference form takes static-graph VARS and rewrites the program;
+    that form has no analogue over an already-executed eager graph (the
+    tape stores reverse pullbacks). The working contract here is the
+    functional one: pass the FUNCTION as `outputs` and its inputs/seed
+    tangents, and this is exactly one jax jvp —
+    ``forward_grad(fn, xs, v) == jvp(fn, xs, v)[1]``.
+    """
+    if callable(outputs):
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.tensor import Tensor
+
+        ins = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+        if grad_inputs is None:
+            tangents = tuple(Tensor(jnp.ones_like(t._value)) for t in ins)
+        else:
+            tangents = tuple(
+                [grad_inputs] if isinstance(grad_inputs, Tensor)
+                else list(grad_inputs))
+        _, tangent_out = jvp(outputs, tuple(ins), tangents)
+        return tangent_out
+    raise NotImplementedError(
+        "forward_grad over captured eager outputs is not representable "
+        "(the tape records reverse pullbacks); pass the function itself: "
+        "forward_grad(fn, inputs, seed_tangents)")
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    """Reverse-mode gradients (reference primapi.py grad): same
+    contract as paddle.grad, provided here at the incubate path."""
+    from paddle_tpu.autograd import grad as _eager_grad
+    return _eager_grad(outputs, inputs, grad_outputs,
+                       retain_graph=True, allow_unused=True)

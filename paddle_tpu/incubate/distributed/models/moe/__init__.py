@@ -10,3 +10,15 @@ from paddle_tpu.distributed.moe import (  # noqa: F401
     dispatch_combine,
 )
 from .gate import *  # noqa: F401,F403
+from paddle_tpu.incubate.distributed.models.moe.utils import (  # noqa: F401,E402
+    AllGather,
+    MoEGather,
+    MoEScatter,
+    Slice,
+    count_by_gate,
+    limit_by_capacity,
+    prepare_forward,
+)
+from paddle_tpu.incubate.distributed.models.moe.grad_clip import (  # noqa: F401,E402
+    ClipGradForMOEByGlobalNorm,
+)

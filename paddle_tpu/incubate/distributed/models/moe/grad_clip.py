@@ -1,0 +1,47 @@
+"""MoE-aware global-norm gradient clipping.
+
+Reference: python/paddle/incubate/distributed/models/moe/grad_clip.py
+(ClipGradForMOEByGlobalNorm): expert-parallel params exist once PER
+RANK, so their grad-norm contribution must be averaged over the moe
+group before entering the global norm, or the clip threshold shifts
+with the ep degree.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.nn.clip import ClipGradByGlobalNorm
+
+
+class ClipGradForMOEByGlobalNorm(ClipGradByGlobalNorm):
+    def __init__(self, clip_norm, is_expert_param_func=None,
+                 moe_group=None, group_name="default_moe_group"):
+        super().__init__(clip_norm)
+        self.is_expert = is_expert_param_func or (lambda p: False)
+        self.moe_group = moe_group
+        # world size of the moe group: expert contributions divide by it
+        self.moe_world = getattr(moe_group, "nranks", None) or 1
+
+    def __call__(self, params_grads):
+        sq_normal = 0.0
+        sq_expert = 0.0
+        for p, g in params_grads:
+            if g is None:
+                continue
+            s = jnp.sum(jnp.square(g._value.astype(jnp.float32)))
+            if self.is_expert(p):
+                sq_expert = sq_expert + s
+            else:
+                sq_normal = sq_normal + s
+        total = jnp.sqrt(sq_normal + sq_expert / float(self.moe_world))
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(total, 1e-12),
+                            1.0)
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+            else:
+                from paddle_tpu.core.tensor import Tensor
+                out.append((p, Tensor(g._value * scale.astype(
+                    g._value.dtype))))
+        return out

@@ -1,0 +1,142 @@
+"""MoE building-block utilities.
+
+Reference: python/paddle/incubate/distributed/models/moe/utils.py
+(count_by_gate, limit_by_capacity, prepare_forward) and moe_layer.py's
+MoEScatter/MoEGather/AllGather/Slice autograd functions — the pieces a
+hand-rolled expert-parallel layer composes.
+
+TPU-native: token permutation is argsort + gather (one XLA sort, MXU-
+friendly static shapes); the cross-rank exchange the reference does with
+NCCL alltoall is the `ep`-axis all_to_all in distributed/utils/moe_utils
+when called inside shard_map — these helpers do the LOCAL math and stay
+correct in both eager and traced use.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply, unwrap
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = [
+    "count_by_gate", "limit_by_capacity", "prepare_forward",
+    "MoEScatter", "MoEGather", "AllGather", "Slice",
+]
+
+
+def count_by_gate(gate_idx, num_expert, world_size=1, require_pos=True,
+                  group=None):
+    """Per-expert token counts + the expert-sorted position permutation.
+
+    gate_idx: [N] int expert id per token (top-1 routing granularity).
+    Returns (pos, local_expert_count, global_expert_count): `pos`
+    permutes tokens into expert order (stable), local counts are [E],
+    global counts are the all-gathered [world_size * E] (equal to local
+    tiled when no process group is active — single-program SPMD).
+    """
+    g = unwrap(gate_idx).reshape(-1).astype(jnp.int32)
+    E = int(num_expert)
+    w = max(world_size, 1)
+    # gate ids span the GLOBAL expert space [0, E*world): local counts
+    # are per global expert; global counts are the alltoall'd view (per
+    # reference utils.py — identical content in the single-program SPMD
+    # model, where the exchange is the ep-axis all_to_all inside
+    # shard_map)
+    local = jnp.bincount(g, length=E * w)
+    pos = jnp.argsort(g, stable=True) if require_pos else None
+    glob = local
+    mk = lambda v: Tensor(v)  # noqa: E731
+    return (None if pos is None else mk(pos)), mk(local), mk(glob)
+
+
+def limit_by_capacity(expert_count, capacity, world_size=1, group=None):
+    """Clip per-expert token counts at `capacity` (reference
+    limit_by_capacity — tokens beyond an expert's capacity are dropped
+    by the subsequent scatter)."""
+    c = unwrap(expert_count)
+    cap = unwrap(capacity)
+    return Tensor(jnp.minimum(c, cap))
+
+
+def prepare_forward(gate, num_expert, world_size=1, moe_group=None):
+    """The routing prologue (reference prepare_forward): counts, the
+    expert-order permutation, and the flat batch size the expert FFN
+    sees."""
+    pos, local, glob = count_by_gate(gate, num_expert, world_size,
+                                     group=moe_group)
+    if world_size > 1:
+        # tokens arriving at THIS rank's local experts: fold the global
+        # [world * E] counts over the rank dim
+        fwd_expert_count = Tensor(
+            unwrap(glob).reshape(world_size, -1).sum(0))
+    else:
+        fwd_expert_count = local
+    fwd_batch_size = int(jnp.sum(unwrap(fwd_expert_count)))
+    return pos, local, glob, fwd_expert_count, fwd_batch_size
+
+
+class _FnOp:
+    """Reference-API shim: these are autograd.Function classes there;
+    here the tape differentiates the jnp body, so `apply` is enough."""
+
+    @classmethod
+    def apply(cls, *args, **kw):
+        return cls.forward(*args, **kw)
+
+
+class MoEScatter(_FnOp):
+    """Permute tokens into expert order (rows beyond capacity drop)."""
+
+    @staticmethod
+    def forward(x, pos, local_expert_count=None, global_expert_count=None,
+                fwd_batch_size=None, world_size=1, group=None):
+        def fn(xv, pv):
+            return jnp.take(xv, pv.astype(jnp.int32), axis=0)
+
+        return apply(fn, x, pos)
+
+
+class MoEGather(_FnOp):
+    """Inverse of MoEScatter: expert-ordered rows back to token order."""
+
+    @staticmethod
+    def forward(x, pos, out_batch_size=None, world_size=1, group=None):
+        def fn(xv, pv):
+            n = out_batch_size or pv.shape[0]
+            return jnp.zeros((n,) + xv.shape[1:], xv.dtype).at[
+                pv.astype(jnp.int32)].set(xv[:pv.shape[0]])
+
+        return apply(fn, x, pos)
+
+
+class AllGather(_FnOp):
+    """Gather shards along dim 0 across the group (reference AllGather).
+    Inside shard_map this is lax.all_gather over the ep axis; eagerly in
+    the single-program model it is identity."""
+
+    @staticmethod
+    def forward(x, rank=0, world_size=1, group=None):
+        if world_size <= 1:
+            return x
+        axis = getattr(group, "axis", None) or "ep"
+        import jax
+
+        def fn(v):
+            return jax.lax.all_gather(v, axis, tiled=True)
+
+        return apply(fn, x)
+
+
+class Slice(_FnOp):
+    """This rank's dim-0 shard (reference Slice — inverse of AllGather)."""
+
+    @staticmethod
+    def forward(x, rank=0, world_size=1, group=None):
+        if world_size <= 1:
+            return x
+
+        def fn(v):
+            n = v.shape[0] // world_size
+            return v[rank * n:(rank + 1) * n]
+
+        return apply(fn, x)

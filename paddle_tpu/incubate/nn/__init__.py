@@ -15,7 +15,7 @@ from paddle_tpu.incubate.nn.functional import (fused_feedforward,
 from paddle_tpu.nn import initializer as I
 from paddle_tpu.nn.layer.layers import Layer
 
-__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward", "FusedLinear",
            "FusedTransformerEncoderLayer",
            "FusedBiasDropoutResidualLayerNorm", "FusedMultiTransformer",
            "FusedTransformer"]
@@ -290,3 +290,29 @@ class FusedTransformer(Layer):
                 memory = layer(memory, src_mask=src_mask)
         return self.decoder(tgt, memory, tgt_mask=tgt_mask,
                             memory_mask=memory_mask)
+
+
+class FusedLinear(Layer):
+    """Linear through the fused matmul+bias entry point (reference
+    incubate/nn/layer/fused_linear.py FusedLinear — cublasLt epilogue
+    fusion there; XLA fuses the bias add into the MXU matmul here)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        from paddle_tpu import nn as _nn
+        shape = ((out_features, in_features) if transpose_weight
+                 else (in_features, out_features))
+        self.weight = self.create_parameter(
+            shape=shape, attr=weight_attr,
+            default_initializer=_nn.initializer.XavierNormal())
+        self.bias = None if bias_attr is False else self.create_parameter(
+            shape=(out_features,), attr=bias_attr, is_bias=True)
+        self._transpose_weight = transpose_weight
+
+    def forward(self, x):
+        from paddle_tpu.incubate.nn.functional import fused_matmul_bias
+        return fused_matmul_bias(x, self.weight, self.bias,
+                                 transpose_y=self._transpose_weight)
+
+from paddle_tpu.incubate.nn import layer  # noqa: E402,F401

@@ -70,9 +70,11 @@ def _ln(v, scale, bias, eps):
 
 _ACTS = {
     "relu": jax.nn.relu,
-    # erf form: paddle's gelu default (nn/functional/activation.py gelu
-    # approximate=False); jax.nn.gelu's default is the tanh approximation
-    "gelu": lambda v: jax.nn.gelu(v, approximate=False),
+    # tanh approximation: the reference's FUSED kernels use GeluFunctor
+    # (paddle/phi/kernels/funcs/functors.h:129, explicitly the tanh
+    # form) even though plain F.gelu defaults to erf — jax.nn.gelu's
+    # default matches the fused convention
+    "gelu": jax.nn.gelu,
 }
 
 

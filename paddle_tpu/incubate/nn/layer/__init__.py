@@ -1,0 +1,13 @@
+"""paddle.incubate.nn.layer parity namespace (reference:
+python/paddle/incubate/nn/layer/) — the layer classes live in
+paddle_tpu.incubate.nn; this package re-exports them at the reference's
+submodule path."""
+from paddle_tpu.incubate.nn import (  # noqa: F401
+    FusedBiasDropoutResidualLayerNorm,
+    FusedFeedForward,
+    FusedLinear,
+    FusedMultiHeadAttention,
+    FusedMultiTransformer,
+    FusedTransformer,
+    FusedTransformerEncoderLayer,
+)

@@ -538,3 +538,34 @@ def Print(input, first_n=-1, message=None, summarize=20,
 
 
 from paddle_tpu.static import nn  # noqa: E402,F401
+from paddle_tpu.static import sparsity  # noqa: E402,F401
+
+
+def save_to_file(path, content):
+    """Raw-bytes file write (reference static/io.py:423)."""
+    if not isinstance(content, bytes):
+        raise ValueError("save_to_file expects bytes content")
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    """Raw-bytes file read (reference static/io.py:704)."""
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """fluid-era lr helper (reference fluid/layers/
+    learning_rate_scheduler.py:119): continuous form decays every step
+    (gamma chosen so lr(decay_steps) == learning_rate * decay_rate);
+    staircase holds lr constant within each decay_steps window."""
+    if staircase:
+        from paddle_tpu.optimizer.lr import LambdaDecay
+        return LambdaDecay(
+            learning_rate,
+            lr_lambda=lambda ep: decay_rate ** (ep // decay_steps))
+    from paddle_tpu.optimizer.lr import ExponentialDecay
+    return ExponentialDecay(learning_rate,
+                            gamma=decay_rate ** (1.0 / decay_steps))

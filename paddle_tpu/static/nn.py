@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from paddle_tpu.core.dispatch import apply
 from paddle_tpu.core.tensor import Tensor
@@ -30,6 +31,10 @@ __all__ = [
     "switch_case", "while_loop", "sparse_embedding", "sequence_softmax",
     "sequence_pool", "sequence_concat", "sequence_first_step",
     "sequence_last_step", "sequence_reverse", "StaticRNN",
+    "sequence_pad", "sequence_unpad", "sequence_reshape",
+    "sequence_slice", "sequence_expand", "sequence_expand_as",
+    "sequence_enumerate", "sequence_scatter", "sequence_conv",
+    "row_conv", "nce", "multi_box_head",
 ]
 
 _layer_cache = {}
@@ -78,9 +83,6 @@ def embedding(input, size, is_sparse=False, padding_idx=None,
                                          sparse=is_sparse,
                                          weight_attr=param_attr))
     return layer(input)
-
-
-sparse_embedding = embedding
 
 
 def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,
@@ -551,6 +553,335 @@ def sequence_reverse(x, lengths=None, name=None):
     if lengths is None:
         return apply(fn, x)
     return apply(fn, x, lengths)
+
+
+
+def _param(key, shape, attr=None, is_bias=False):
+    """Cached parameter holder for the functional static.nn ops (same
+    call-site identity rules as _cached)."""
+    import sys
+
+    from paddle_tpu import nn
+
+    if key[1] is None:
+        # resolve the USER call site here — _cached's own frame walk
+        # would land inside the static.nn op function (one extra frame
+        # through _param) and silently share weights across call sites
+        f = sys._getframe(2)
+        key = (key[0], ("site", f.f_code.co_filename, f.f_lineno),
+               *key[2:])
+
+    def make():
+        class _Holder(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.weight = self.create_parameter(
+                    list(shape), attr=attr, is_bias=is_bias)
+
+        return _Holder()
+
+    return _cached(key, make).weight
+
+
+def sequence_pad(x, pad_value, maxlen=None, lengths=None, name=None):
+    """Pad variable-length rows to a common length (reference
+    sequence_lod.py sequence_pad). Dense form: positions >= lengths[b]
+    fill with pad_value; returns (padded, lengths)."""
+    def fn(v, pv, *rest):
+        t = v.shape[1] if maxlen is None else maxlen
+        orig_t = v.shape[1]
+        out = v[:, :t] if orig_t >= t else jnp.pad(
+            v, [(0, 0), (0, t - orig_t)] + [(0, 0)] * (v.ndim - 2))
+        if rest:
+            mask = _length_mask(rest[0], t, jnp.bool_)
+        else:
+            # no lengths: only the maxlen extension is padding
+            mask = (jnp.arange(t) < orig_t)[None, :]
+        while mask.ndim < out.ndim:
+            mask = mask[..., None]
+        return jnp.where(mask, out, jnp.asarray(pv, out.dtype))
+
+    lens = lengths
+    if lens is None:
+        from paddle_tpu.tensor.creation import full
+        lens = full([x.shape[0]], x.shape[1], dtype="int64")
+    padded = apply(fn, x, pad_value, lens) if lengths is not None \
+        else apply(fn, x, pad_value)
+    return padded, lens
+
+
+def sequence_unpad(x, length, name=None):
+    """Trim padding to the max real length and zero the tail (reference
+    sequence_unpad; true ragged rows don't exist on TPU — static shapes —
+    so the result keeps the batch layout with an exact-length mask)."""
+    def fn(v, lens):
+        t = v.shape[1]
+        mask = _length_mask(lens, t, v.dtype)
+        while mask.ndim < v.ndim:
+            mask = mask[..., None]
+        return v * mask
+
+    return apply(fn, x, length)
+
+
+def sequence_reshape(input, new_dim, name=None):
+    """Refold the feature dim (reference sequence_reshape: total elements
+    per batch row preserved, time adjusts to match new_dim)."""
+    def fn(v):
+        b = v.shape[0]
+        return v.reshape(b, -1, new_dim)
+
+    return apply(fn, input)
+
+
+def sequence_slice(input, offset, length, name=None):
+    """Per-row [offset, offset+length) window (reference sequence_slice).
+    `length` must be a python int / equal per row (static shapes)."""
+    def fn(v, off):
+        off = off.reshape(-1).astype(jnp.int32)
+        ln = int(np.asarray(jax.device_get(length._value)).reshape(-1)[0]) \
+            if hasattr(length, "_value") else int(np.asarray(length).reshape(-1)[0])
+        idx = off[:, None] + jnp.arange(ln)[None, :]
+        idx = jnp.clip(idx, 0, v.shape[1] - 1)
+        return jnp.take_along_axis(
+            v, idx[..., None] if v.ndim == 3 else idx, axis=1)
+
+    return apply(fn, input, offset)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """Repeat x's rows per y's row-lengths (reference sequence_expand's
+    LoD broadcast). Dense form: x [B, ...] tiled to match y's batch."""
+    def fn(xv, yv):
+        if xv.shape[0] == yv.shape[0]:
+            return xv
+        rep = yv.shape[0] // xv.shape[0]
+        return jnp.repeat(xv, rep, axis=0)
+
+    return apply(fn, x, y)
+
+
+def sequence_expand_as(x, y, name=None):
+    return sequence_expand(x, y)
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    """Sliding windows of ids: [B, T] -> [B, T, win_size], positions past
+    the end fill with pad_value (reference sequence_enumerate)."""
+    def fn(v):
+        t = v.shape[1]
+        idx = jnp.arange(t)[:, None] + jnp.arange(win_size)[None, :]
+        valid = idx < t
+        gathered = v[:, jnp.clip(idx, 0, t - 1)]
+        return jnp.where(valid[None], gathered,
+                         jnp.asarray(pad_value, v.dtype))
+
+    return apply(fn, input)
+
+
+def sequence_scatter(input, index, updates, name=None):
+    """Scatter updates into flat positions (reference sequence_scatter's
+    dense rendering: index addresses dim-0 rows of a 2-D input)."""
+    def fn(v, idx, upd):
+        return v.at[idx.reshape(-1).astype(jnp.int32)].add(
+            upd.reshape((-1,) + v.shape[1:]))
+
+    return apply(fn, input, index, updates)
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, param_attr=None,
+                  bias_attr=None, act=None, name=None):
+    """Context-window conv over time (reference sequence_conv): each
+    step sees `filter_size` consecutive steps; implemented as one MXU
+    matmul over the unfolded windows."""
+    d = input.shape[-1]
+    weight = _param(("seqconv_w", getattr(param_attr, "name", None),
+                     filter_size * d, num_filters),
+                    (filter_size * d, num_filters), param_attr)
+    bias = _param(("seqconv_b", getattr(bias_attr, "name", None),
+                   num_filters), (num_filters,), bias_attr,
+                  is_bias=True) if bias_attr is not False else None
+
+    start = -((filter_size - 1) // 2) if padding_start is None \
+        else padding_start
+
+    def fn(v, w, *rest):
+        b, t, dd = v.shape
+        offs = start + jnp.arange(filter_size)
+        idx = jnp.arange(t)[:, None] + offs[None, :]
+        valid = (idx >= 0) & (idx < t)
+        g = v[:, jnp.clip(idx, 0, t - 1)]               # [b, t, fs, d]
+        g = jnp.where(valid[None, :, :, None], g, 0.0)
+        out = g.reshape(b, t, filter_size * dd) @ w
+        if rest:
+            out = out + rest[0]
+        if act == "relu":
+            out = jax.nn.relu(out)
+        elif act == "tanh":
+            out = jnp.tanh(out)
+        return out
+
+    if bias is not None:
+        return apply(fn, input, weight, bias)
+    return apply(fn, input, weight)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None,
+             name=None):
+    """Lookahead (row) convolution (reference common.py row_conv — the
+    DeepSpeech2 streaming op): out[t] = sum_{i=0..k} w[i] * x[t+i],
+    a depthwise causal-in-reverse window over time."""
+    d = input.shape[-1]
+    k = future_context_size + 1
+    weight = _param(("row_conv_w", getattr(param_attr, "name", None),
+                     k, d), (k, d), param_attr)
+
+    def fn(v, w):
+        b, t, dd = v.shape
+        idx = jnp.arange(t)[:, None] + jnp.arange(k)[None, :]
+        valid = idx < t
+        g = v[:, jnp.clip(idx, 0, t - 1)]               # [b, t, k, d]
+        g = jnp.where(valid[None, :, :, None], g, 0.0)
+        out = jnp.einsum("btkd,kd->btd", g, w)
+        if act == "relu":
+            out = jax.nn.relu(out)
+        return out
+
+    return apply(fn, input, weight)
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (reference common.py nce):
+    logistic discrimination of the true class against sampled noise
+    classes. Negatives are drawn host-side per call (uniform or
+    custom_dist); the compute is two gathers + a BCE — static shapes."""
+    d = input.shape[-1]
+    weight = _param(("nce_w", getattr(param_attr, "name", None),
+                     num_total_classes, d), (num_total_classes, d),
+                    param_attr)
+    bias = _param(("nce_b", getattr(bias_attr, "name", None),
+                   num_total_classes), (num_total_classes,), bias_attr,
+                  is_bias=True) if bias_attr is not False else None
+
+    rng = np.random.default_rng(seed or None)
+    if custom_dist is not None:
+        pdist = np.asarray(custom_dist, np.float64)
+        pdist = pdist / pdist.sum()
+        negs = rng.choice(num_total_classes, size=num_neg_samples,
+                          p=pdist)
+    else:
+        negs = rng.integers(0, num_total_classes, size=num_neg_samples)
+    negs = jnp.asarray(negs.astype(np.int64))
+
+    def fn(v, y, w, *rest):
+        b_ = rest[0] if rest else None
+        yi = y.reshape(-1).astype(jnp.int32)
+        w_pos = w[yi]                                    # [B, d]
+        s_pos = jnp.sum(v * w_pos, -1)
+        w_neg = w[negs]                                  # [K, d]
+        s_neg = v @ w_neg.T                              # [B, K]
+        if b_ is not None:
+            s_pos = s_pos + b_[yi]
+            s_neg = s_neg + b_[negs][None, :]
+        # BCE-with-logits: positives label 1, sampled noise label 0
+        def bce(s, t):
+            return jnp.maximum(s, 0) - s * t + jnp.log1p(
+                jnp.exp(-jnp.abs(s)))
+        loss = bce(s_pos, 1.0) + bce(s_neg, 0.0).sum(-1)
+        return loss[:, None]
+
+    if bias is not None:
+        return apply(fn, input, label, weight, bias)
+    return apply(fn, input, label, weight)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32"):
+    """PS-backed large-vocab embedding (reference common.py
+    sparse_embedding): rows live beyond HBM in the host-RAM SparseTable
+    (distributed/ps.py) and stream through jit-safe callbacks."""
+    from paddle_tpu.distributed.ps import SparseTable, ps_embedding
+
+    table = _cached(("sparse_emb", None, size[0], size[1]),
+                    lambda: SparseTable(size[0], size[1]))
+    return ps_embedding(input, table)
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, offset=0.5, variance=None,
+                   flip=True, clip=False, name=None,
+                   min_max_aspect_ratios_order=False, **kw):
+    """SSD detection head (reference vision/ops multi_box_head): per
+    feature map, a 3x3 conv predicts box offsets + class scores for the
+    prior boxes of vision.ops.prior_box; outputs concatenate across maps.
+    Returns (mbox_locs, mbox_confs, boxes, variances)."""
+    from paddle_tpu.nn.functional.conv import conv2d
+    from paddle_tpu.vision.ops import prior_box as _prior_box
+
+    variance = variance or [0.1, 0.1, 0.2, 0.2]
+    n_in = len(inputs)
+    if min_sizes is None:
+        # the reference derives per-level sizes from min/max ratio
+        min_ratio = 20 if min_ratio is None else min_ratio
+        max_ratio = 90 if max_ratio is None else max_ratio
+        img = base_size
+        step = int((max_ratio - min_ratio) / max(n_in - 2, 1))
+        min_sizes, max_sizes = [], []
+        for r in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(img * r / 100.0)
+            max_sizes.append(img * (r + step) / 100.0)
+        min_sizes = [img * 0.10] + min_sizes[:n_in - 1]
+        max_sizes = [img * 0.20] + max_sizes[:n_in - 1]
+
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    for i, feat in enumerate(inputs):
+        ms = min_sizes[i] if isinstance(min_sizes[i], (list, tuple)) \
+            else [min_sizes[i]]
+        mx = None
+        if max_sizes is not None and i < len(max_sizes):
+            mx = max_sizes[i] if isinstance(max_sizes[i], (list, tuple)) \
+                else [max_sizes[i]]
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[i],
+                                            (list, tuple)) \
+            else [aspect_ratios[i]]
+        step_i = steps[i] if steps else 0.0
+        step_wh = (step_i, step_i) if not isinstance(step_i, (list, tuple)) \
+            else tuple(step_i)
+        boxes, variances = _prior_box(
+            feat, image, min_sizes=ms, max_sizes=mx, aspect_ratios=ar,
+            variance=variance, flip=flip, clip=clip, steps=step_wh,
+            offset=offset,
+            min_max_aspect_ratios_order=min_max_aspect_ratios_order)
+        num_priors = boxes.shape[2] if len(boxes.shape) == 4 else \
+            boxes.shape[-2]
+        # priors per spatial location
+        h, w = feat.shape[2], feat.shape[3]
+        k = int(np.prod(boxes.shape[:-1]) // (h * w))
+        c_in = feat.shape[1]
+        wl = _param((f"mbox_loc_w_{i}", name and f"{name}_loc_{i}",
+                     k * 4, c_in), (k * 4, c_in, 3, 3), None)
+        wc = _param((f"mbox_conf_w_{i}", name and f"{name}_conf_{i}",
+                     k * num_classes, c_in),
+                    (k * num_classes, c_in, 3, 3), None)
+        loc = conv2d(feat, wl, padding=1)      # [b, k*4, h, w]
+        conf = conv2d(feat, wc, padding=1)     # [b, k*C, h, w]
+        b = feat.shape[0]
+        from paddle_tpu.tensor.manipulation import reshape, transpose
+        loc = reshape(transpose(loc, [0, 2, 3, 1]), [b, -1, 4])
+        conf = reshape(transpose(conf, [0, 2, 3, 1]),
+                       [b, -1, num_classes])
+        locs.append(loc)
+        confs.append(conf)
+        boxes_all.append(reshape(boxes, [-1, 4]))
+        vars_all.append(reshape(variances, [-1, 4]))
+    from paddle_tpu.tensor.manipulation import concat
+    return (concat(locs, axis=1), concat(confs, axis=1),
+            concat(boxes_all, axis=0), concat(vars_all, axis=0))
 
 
 class StaticRNN:

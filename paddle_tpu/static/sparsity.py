@@ -1,0 +1,15 @@
+"""paddle.static.sparsity parity namespace (reference:
+python/paddle/static/sparsity/__init__.py) — static-graph surface over
+the ASP n:m sparsity tooling in paddle_tpu.incubate.asp."""
+from paddle_tpu.incubate.asp import (  # noqa: F401
+    calculate_density,
+    decorate,
+    prune_model,
+    reset_excluded_layers,
+    set_excluded_layers,
+)
+
+
+def add_supported_layer(layer, pruning_func=None):
+    from paddle_tpu.incubate import asp
+    return asp.add_supported_layer(layer, pruning_func)

@@ -62,8 +62,9 @@ def _oracle(w, x, causal):
             np.sqrt(v.var(-1, keepdims=True) + 1e-5)
 
     def gelu(v):
-        from scipy.special import erf
-        return v * 0.5 * (1 + erf(v / np.sqrt(2)))
+        # tanh approximation — the reference's fused kernels' GeluFunctor
+        return 0.5 * v * (1 + np.tanh(
+            0.79788456 * v * (1 + 0.044715 * v * v)))
 
     b, s, e = x.shape
     h = x.copy()

@@ -1,0 +1,208 @@
+"""Round-4 static.nn completions + namespace fills.
+
+Reference: python/paddle/static/nn/ (sequence_lod.py, common.py nce /
+row_conv / multi_box_head / py_func / sparse_embedding),
+python/paddle/static/sparsity, python/paddle/incubate/distributed/
+models/moe/utils.py, fleet/base/strategy_group.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as p
+from paddle_tpu.static import nn as snn
+
+
+def _x(shape, seed=0, scale=1.0):
+    return p.to_tensor(np.random.default_rng(seed).standard_normal(
+        shape).astype(np.float32) * scale)
+
+
+class TestSequenceOps:
+    def test_pad_unpad(self):
+        x = _x((2, 5, 4))
+        lens = p.to_tensor(np.array([3, 5], np.int64))
+        padded, L = snn.sequence_pad(x, -7.0, lengths=lens)
+        assert np.allclose(padded.numpy()[0, 3:], -7.0)
+        assert np.allclose(padded.numpy()[1], x.numpy()[1])
+        up = snn.sequence_unpad(padded, lens)
+        assert np.allclose(up.numpy()[0, 3:], 0.0)
+
+    def test_pad_value_without_lengths(self):
+        x = _x((2, 3, 4))
+        padded, _ = snn.sequence_pad(x, -7.0, maxlen=5)
+        assert np.allclose(padded.numpy()[:, 3:], -7.0)
+        assert np.allclose(padded.numpy()[:, :3], x.numpy())
+
+    def test_distinct_call_sites_get_distinct_params(self):
+        x = _x((1, 4, 4), seed=9)
+        a = snn.sequence_conv(x, 6, filter_size=3)  # call site A
+        b = snn.sequence_conv(x, 6, filter_size=3)  # call site B
+        # different (unnamed) call sites must not share weights
+        assert not np.allclose(a.numpy(), b.numpy())
+
+    def test_reshape_slice_expand(self):
+        x = _x((2, 6, 4))
+        assert snn.sequence_reshape(x, 8).shape == [2, 3, 8]
+        sl = snn.sequence_slice(x, p.to_tensor(np.array([1, 2])),
+                                p.to_tensor(np.array([3])))
+        assert sl.shape == [2, 3, 4]
+        np.testing.assert_allclose(sl.numpy()[0], x.numpy()[0, 1:4])
+        ex = snn.sequence_expand(_x((2, 4)), _x((6, 4)))
+        assert ex.shape == [6, 4]
+        assert snn.sequence_expand_as(_x((3, 4)), _x((6, 4))).shape \
+            == [6, 4]
+
+    def test_enumerate(self):
+        ids = p.to_tensor(np.arange(8).reshape(2, 4))
+        en = snn.sequence_enumerate(ids, 3, pad_value=-1)
+        assert en.shape == [2, 4, 3]
+        np.testing.assert_array_equal(en.numpy()[0, 0], [0, 1, 2])
+        np.testing.assert_array_equal(en.numpy()[0, 3], [3, -1, -1])
+
+    def test_conv_and_row_conv_shapes_and_grads(self):
+        x = _x((2, 5, 4))
+        x.stop_gradient = False
+        out = snn.sequence_conv(x, 8, filter_size=3)
+        assert out.shape == [2, 5, 8]
+        out.sum().backward()
+        assert np.isfinite(x.grad.numpy()).all()
+        rc = snn.row_conv(x, 2)
+        assert rc.shape == [2, 5, 4]
+
+        # row conv is a lookahead window: out[0] depends on x[0..2] only.
+        # ONE call site (same cached weights) fed two inputs that agree
+        # on the first 3 steps must agree at step 0.
+        def run(inp):
+            return snn.row_conv(inp, 2).numpy()
+
+        x2 = _x((1, 5, 4), seed=3)
+        x3 = p.to_tensor(np.concatenate(
+            [x2.numpy()[:, :3], np.zeros((1, 2, 4), np.float32)], 1))
+        np.testing.assert_allclose(run(x2)[:, 0], run(x3)[:, 0],
+                                   atol=1e-6)
+
+    def test_nce_loss(self):
+        feat = _x((4, 8), seed=1)
+        y = p.to_tensor(np.array([[1], [2], [3], [1]], np.int64))
+        loss = snn.nce(feat, y, num_total_classes=50, num_neg_samples=10)
+        assert loss.shape == [4, 1]
+        assert np.isfinite(loss.numpy()).all()
+
+    def test_py_func_host_roundtrip(self):
+        out_t = p.zeros([2, 3])
+        got = snn.py_func(lambda a: a * 2 + 1,
+                          p.to_tensor(np.ones((2, 3), np.float32)), out_t)
+        np.testing.assert_allclose(got.numpy(), 3.0)
+
+    def test_sparse_embedding_ps(self):
+        emb = snn.sparse_embedding(
+            p.to_tensor(np.array([[0, 5, 9]], np.int64)), size=[64, 8])
+        assert emb.shape == [1, 3, 8]
+
+    def test_multi_box_head(self):
+        img = p.to_tensor(np.zeros((1, 3, 64, 64), np.float32))
+        f1 = _x((1, 8, 8, 8), seed=2)
+        f2 = _x((1, 8, 4, 4), seed=3)
+        locs, confs, boxes, vars_ = snn.multi_box_head(
+            [f1, f2], img, base_size=64, num_classes=3,
+            aspect_ratios=[[2.0], [2.0]])
+        assert locs.shape[2] == 4 and confs.shape[2] == 3
+        assert boxes.shape[0] == locs.shape[1]
+        assert vars_.shape == boxes.shape
+
+
+class TestNamespaceFills:
+    def test_static_sparsity(self):
+        import paddle_tpu.static.sparsity as sp
+        w = np.zeros((8, 8), np.float32)
+        w[:, ::2] = 1.0
+        assert abs(sp.calculate_density(w) - 0.5) < 1e-6
+        assert callable(sp.prune_model) and callable(sp.decorate)
+        sp.add_supported_layer("my_layer")
+        sp.set_excluded_layers(["foo"])
+        sp.reset_excluded_layers()
+
+    def test_static_file_io_and_lr(self, tmp_path):
+        path = str(tmp_path / "blob.bin")
+        p.static.save_to_file(path, b"abc123")
+        assert p.static.load_from_file(path) == b"abc123"
+        sched = p.static.exponential_decay(0.1, decay_steps=10,
+                                           decay_rate=0.5)
+        lr0 = sched()
+        for _ in range(10):
+            sched.step()
+        assert abs(sched() / lr0 - 0.5) < 1e-6
+        # staircase: constant within each window
+        st = p.static.exponential_decay(0.1, decay_steps=10,
+                                        decay_rate=0.5, staircase=True)
+        first = st()
+        for _ in range(5):
+            st.step()
+        assert st() == first
+        for _ in range(5):
+            st.step()
+        assert abs(st() / first - 0.5) < 1e-6
+
+    def test_device_fills(self):
+        assert p.device.get_cudnn_version() is None
+        assert p.device.is_compiled_with_cinn() is False
+        assert p.device.is_compiled_with_ipu() is False
+        with pytest.raises(RuntimeError):
+            p.device.IPUPlace()
+
+    def test_incubate_nn_layer_namespace(self):
+        from paddle_tpu.incubate.nn.layer import (FusedLinear,
+                                                  FusedMultiTransformer)
+        assert FusedMultiTransformer is not None
+        fl = FusedLinear(4, 8)
+        y = fl(p.to_tensor(np.ones((2, 4), np.float32)))
+        assert y.shape == [2, 8]
+
+    def test_moe_utils(self):
+        from paddle_tpu.incubate.distributed.models.moe import (
+            ClipGradForMOEByGlobalNorm, MoEGather, MoEScatter,
+            count_by_gate, limit_by_capacity, prepare_forward)
+
+        gate = p.to_tensor(np.array([2, 0, 1, 0, 2, 2], np.int64))
+        pos, local, glob = count_by_gate(gate, 3)
+        assert local.numpy().tolist() == [2, 1, 3]
+        x = p.to_tensor(np.arange(12, dtype=np.float32).reshape(6, 2))
+        xs = MoEScatter.apply(x, pos)
+        order = np.argsort(gate.numpy(), kind="stable")
+        np.testing.assert_allclose(xs.numpy(), x.numpy()[order])
+        back = MoEGather.apply(xs, pos, out_batch_size=6)
+        np.testing.assert_allclose(back.numpy(), x.numpy())
+        capped = limit_by_capacity(local, p.to_tensor(np.int64(2)))
+        assert capped.numpy().tolist() == [2, 1, 2]
+        _, _, _, fwd_count, fwd_bs = prepare_forward(gate, 3)
+        assert fwd_bs == 6
+        # world_size=2: gate ids span 2*E global experts; fwd counts
+        # fold the rank dim and the batch size equals the token count
+        gate2 = p.to_tensor(np.array([0, 1, 2, 3, 0, 1], np.int64))
+        _, local2, glob2 = count_by_gate(gate2, 2, world_size=2)
+        assert local2.numpy().tolist() == [2, 2, 1, 1]
+        assert glob2.shape == [4]
+        _, _, _, fc2, fb2 = prepare_forward(gate2, 2, world_size=2)
+        assert fc2.numpy().tolist() == [3, 3]
+        assert fb2 == 6
+
+        clip = ClipGradForMOEByGlobalNorm(
+            1.0, is_expert_param_func=lambda q: "expert" in q.name,
+            moe_group=type("G", (), {"nranks": 2})())
+        w = p.to_tensor(np.ones(4, np.float32)); w.name = "dense.w"
+        e = p.to_tensor(np.ones(4, np.float32)); e.name = "expert.w"
+        g1 = p.to_tensor(np.full(4, 3.0, np.float32))
+        g2 = p.to_tensor(np.full(4, 3.0, np.float32))
+        out = clip([(w, g1), (e, g2)])
+        # norm = sqrt(36 + 36/2) = sqrt(54); scale = 1/sqrt(54)
+        want = 3.0 / np.sqrt(54.0)
+        np.testing.assert_allclose(out[0][1].numpy(), want, rtol=1e-5)
+
+    def test_fleet_base_strategy_groups(self):
+        from paddle_tpu.distributed.fleet.base import (DPGroup, MPGroup,
+                                                       OrthogonalStrategy,
+                                                       PPGroup)
+        st = OrthogonalStrategy([("dp", 1, DPGroup), ("pp", 1, PPGroup)])
+        assert st.strategy_group("dp") is not None
+        pg = PPGroup([[0]])
+        assert pg.rank_of_next_stage == 0
