@@ -72,24 +72,19 @@ _RESNET50_TRAIN_FLOPS = 24.0e9
 
 
 # --------------------------------------------------------------- workers
-def _bench_resnet50(on_tpu):
+def _resnet_variant(on_tpu, remat, batch, warmup, iters):
     import numpy as np
 
     import paddle_tpu as P
     import paddle_tpu.nn.functional as F
     from paddle_tpu.vision.models import resnet50
 
-    if on_tpu:
-        batch, warmup, iters = 256, 5, 25  # ~125 ms/step: timing noise <1%
-    else:
-        batch, warmup, iters = 8, 1, 2  # degraded-signal fallback, <3 min
-
     P.seed(0)
     # NHWC (r3, VERDICT #2): profiling the r2 bench showed the forward
     # dominated by per-channel BN statistics reductions — in NCHW those
     # reduce across the lane dimension; channels-last keeps C on lanes
     # and is the layout XLA prefers for MXU convs.
-    model = resnet50(num_classes=1000, data_format="NHWC")
+    model = resnet50(num_classes=1000, data_format="NHWC", remat=remat)
     opt = P.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
                                parameters=model.parameters())
 
@@ -119,13 +114,37 @@ def _bench_resnet50(on_tpu):
     # through the optimizer), so syncing on it waits for the whole run
     loss.block_until_ready()
     dt = time.perf_counter() - t0
+    return dt, train_step, x, y
+
+
+def _bench_resnet50(on_tpu):
+    if on_tpu:
+        batch, warmup, iters = 256, 5, 25  # ~125 ms/step: timing noise <1%
+    else:
+        batch, warmup, iters = 8, 1, 2  # degraded-signal fallback, <3 min
+
+    dt, train_step, x, y = _resnet_variant(on_tpu, False, batch, warmup,
+                                           iters)
+    remat_used = False
+    if on_tpu and os.environ.get("PTPU_TRY_REMAT", "1") != "0":
+        # HBM-bound step + idle MXU: rematerializing the residual stages
+        # can net throughput — measure and keep the faster variant
+        try:
+            dt2, ts2, x2, y2 = _resnet_variant(
+                on_tpu, True, batch, 3, max(10, iters // 2))
+            dt2 = dt2 * iters / max(10, iters // 2)
+            if dt2 < dt:
+                dt, train_step, x, y = dt2, ts2, x2, y2
+                remat_used = True
+        except Exception:
+            pass
 
     # Where the time goes (r3 profile, tools/profile_resnet.py): the step
     # is HBM-bandwidth-bound, not compute- or host-bound. XLA cost
     # analysis of the compiled step gives flops + bytes; bytes/step over
     # the measured step time vs ~819 GB/s v5e HBM explains the MFU
     # ceiling (arithmetic intensity ~65 flop/byte < v5e ridge ~240).
-    extra = {}
+    extra = {"remat": remat_used}
     try:
         if not on_tpu:
             raise RuntimeError("hbm roofline keys are TPU-only")

@@ -6,6 +6,11 @@ The reference hosts CUDA stream/event control here; the TPU analogue of
 from __future__ import annotations
 
 from paddle_tpu.core.device import (  # noqa: F401
+    CPUPlace,
+    CUDAPinnedPlace,
+    CUDAPlace,
+    TPUPlace,
+    XPUPlace,
     get_device,
     device_count,
     is_compiled_with_cuda,
@@ -14,14 +19,6 @@ from paddle_tpu.core.device import (  # noqa: F401
     is_compiled_with_tpu,
     is_compiled_with_xpu,
     set_device,
-)
-
-from paddle_tpu.core.device import (  # noqa: F401
-    CPUPlace,
-    CUDAPinnedPlace,
-    CUDAPlace,
-    TPUPlace,
-    XPUPlace,
 )
 
 from . import cuda  # noqa: F401

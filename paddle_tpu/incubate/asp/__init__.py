@@ -262,8 +262,8 @@ def add_supported_layer(layer, pruning_func=None):
     With `pruning_func`, it is called as pruning_func(weight_ndarray, m,
     n, mask_algo, param_name) -> (pruned_weight, mask) during
     prune_model."""
-    name = layer if isinstance(layer, str) else \
-        getattr(layer, "__name__", str(layer)).lower()
+    name = (layer if isinstance(layer, str)
+            else getattr(layer, "__name__", str(layer))).lower()
     ASPHelper._extra_supported[name] = pruning_func
     return name
 

@@ -23,10 +23,13 @@ class ClipGradForMOEByGlobalNorm(ClipGradByGlobalNorm):
         self.moe_world = getattr(moe_group, "nranks", None) or 1
 
     def __call__(self, params_grads):
+        def clippable(p, g):
+            return g is not None and getattr(p, "need_clip", True)
+
         sq_normal = 0.0
         sq_expert = 0.0
         for p, g in params_grads:
-            if g is None:
+            if not clippable(p, g):
                 continue
             s = jnp.sum(jnp.square(g._value.astype(jnp.float32)))
             if self.is_expert(p):
@@ -38,7 +41,7 @@ class ClipGradForMOEByGlobalNorm(ClipGradByGlobalNorm):
                             1.0)
         out = []
         for p, g in params_grads:
-            if g is None:
+            if not clippable(p, g):
                 out.append((p, g))
             else:
                 from paddle_tpu.core.tensor import Tensor

@@ -13,6 +13,7 @@ correct in both eager and traced use.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.dispatch import apply, unwrap
@@ -51,8 +52,10 @@ def count_by_gate(gate_idx, num_expert, world_size=1, require_pos=True,
 
 def limit_by_capacity(expert_count, capacity, world_size=1, group=None):
     """Clip per-expert token counts at `capacity` (reference
-    limit_by_capacity — tokens beyond an expert's capacity are dropped
-    by the subsequent scatter)."""
+    limit_by_capacity). Capacity-DROPPING dispatch — building the
+    fixed-[E, C] expert batches where overflow tokens vanish — is
+    distributed.moe.dispatch_combine / gshard_dispatch_combine; these
+    utils only do the count bookkeeping."""
     c = unwrap(expert_count)
     cap = unwrap(capacity)
     return Tensor(jnp.minimum(c, cap))
@@ -71,7 +74,12 @@ def prepare_forward(gate, num_expert, world_size=1, moe_group=None):
             unwrap(glob).reshape(world_size, -1).sum(0))
     else:
         fwd_expert_count = local
-    fwd_batch_size = int(jnp.sum(unwrap(fwd_expert_count)))
+    total = jnp.sum(unwrap(fwd_expert_count))
+    try:
+        fwd_batch_size = int(total)     # eager: a python int
+    except jax.errors.TracerArrayConversionError:
+        fwd_batch_size = total          # traced: stays a tracer (shapes
+        #                                 must come from static capacity)
     return pos, local, glob, fwd_expert_count, fwd_batch_size
 
 
@@ -85,11 +93,23 @@ class _FnOp:
 
 
 class MoEScatter(_FnOp):
-    """Permute tokens into expert order (rows beyond capacity drop)."""
+    """Permute tokens into expert order (a pure gather: every routed
+    token keeps its row). Capacity-dropping dispatch into fixed [E, C]
+    expert batches is distributed.moe.dispatch_combine — mixing the two
+    silently would mis-size the expert FFN, so a mismatched
+    fwd_batch_size is a loud error."""
 
     @staticmethod
     def forward(x, pos, local_expert_count=None, global_expert_count=None,
                 fwd_batch_size=None, world_size=1, group=None):
+        n = int(unwrap(pos).shape[0])
+        if fwd_batch_size is not None and \
+                isinstance(fwd_batch_size, int) and fwd_batch_size != n:
+            raise ValueError(
+                f"MoEScatter permutes all {n} routed tokens; a clipped "
+                f"fwd_batch_size ({fwd_batch_size}) needs the capacity-"
+                "dropping dispatch (distributed.moe.dispatch_combine)")
+
         def fn(xv, pv):
             return jnp.take(xv, pv.astype(jnp.int32), axis=0)
 

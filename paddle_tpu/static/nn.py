@@ -655,6 +655,10 @@ def sequence_expand(x, y, ref_level=-1, name=None):
     def fn(xv, yv):
         if xv.shape[0] == yv.shape[0]:
             return xv
+        if yv.shape[0] % xv.shape[0]:
+            raise ValueError(
+                f"sequence_expand: target batch {yv.shape[0]} is not a "
+                f"multiple of source batch {xv.shape[0]}")
         rep = yv.shape[0] // xv.shape[0]
         return jnp.repeat(xv, rep, axis=0)
 
@@ -857,8 +861,6 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
             variance=variance, flip=flip, clip=clip, steps=step_wh,
             offset=offset,
             min_max_aspect_ratios_order=min_max_aspect_ratios_order)
-        num_priors = boxes.shape[2] if len(boxes.shape) == 4 else \
-            boxes.shape[-2]
         # priors per spatial location
         h, w = feat.shape[2], feat.shape[3]
         k = int(np.prod(boxes.shape[:-1]) // (h * w))

@@ -1,0 +1,48 @@
+"""ResNet remat mode: rematerialized residual stages must be a pure
+performance knob — loss and gradients identical to the plain model.
+(The bench races both variants on TPU; see bench.py _bench_resnet50.)
+"""
+import numpy as np
+
+import paddle_tpu as p
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision.models import resnet18
+
+
+def _run(remat):
+    p.seed(0)
+    m = resnet18(num_classes=10, remat=remat)
+    x = p.to_tensor(np.random.default_rng(0).standard_normal(
+        (2, 3, 32, 32)).astype(np.float32))
+    y = p.to_tensor(np.array([1, 3], np.int64))
+    loss = F.cross_entropy(m(x), y)
+    loss.backward()
+    return float(loss.numpy()), m.parameters()[0].grad.numpy().copy()
+
+
+def test_remat_matches_plain():
+    l0, g0 = _run(False)
+    l1, g1 = _run(True)
+    assert abs(l0 - l1) < 1e-6
+    np.testing.assert_allclose(g0, g1, atol=1e-5)
+
+
+def test_remat_under_to_static_trains():
+    p.seed(0)
+    m = resnet18(num_classes=10, remat=True)
+    opt = p.optimizer.Momentum(learning_rate=0.05,
+                               parameters=m.parameters())
+
+    @p.jit.to_static
+    def step(x, y):
+        loss = F.cross_entropy(m(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    rng = np.random.default_rng(0)
+    x = p.to_tensor(rng.standard_normal((4, 3, 32, 32)).astype(np.float32))
+    y = p.to_tensor(rng.integers(0, 10, 4))
+    losses = [float(step(x, y).numpy()) for _ in range(6)]
+    assert losses[-1] < losses[0], losses
