@@ -117,34 +117,13 @@ def _resnet_variant(on_tpu, remat, batch, warmup, iters):
     return dt, train_step, x, y
 
 
-def _bench_resnet50(on_tpu):
-    if on_tpu:
-        batch, warmup, iters = 256, 5, 25  # ~125 ms/step: timing noise <1%
-    else:
-        batch, warmup, iters = 8, 1, 2  # degraded-signal fallback, <3 min
-
-    dt, train_step, x, y = _resnet_variant(on_tpu, False, batch, warmup,
-                                           iters)
-    remat_used = False
-    if on_tpu and os.environ.get("PTPU_TRY_REMAT", "1") != "0":
-        # HBM-bound step + idle MXU: rematerializing the residual stages
-        # can net throughput — measure and keep the faster variant
-        try:
-            dt2, ts2, x2, y2 = _resnet_variant(
-                on_tpu, True, batch, 3, max(10, iters // 2))
-            dt2 = dt2 * iters / max(10, iters // 2)
-            if dt2 < dt:
-                dt, train_step, x, y = dt2, ts2, x2, y2
-                remat_used = True
-        except Exception:
-            pass
-
+def _resnet_extra(on_tpu, dt, iters, batch, train_step, x, y, remat):
     # Where the time goes (r3 profile, tools/profile_resnet.py): the step
     # is HBM-bandwidth-bound, not compute- or host-bound. XLA cost
     # analysis of the compiled step gives flops + bytes; bytes/step over
     # the measured step time vs ~819 GB/s v5e HBM explains the MFU
     # ceiling (arithmetic intensity ~65 flop/byte < v5e ridge ~240).
-    extra = {"remat": remat_used}
+    extra = {"remat": remat}
     try:
         if not on_tpu:
             raise RuntimeError("hbm roofline keys are TPU-only")
@@ -162,7 +141,7 @@ def _bench_resnet50(on_tpu):
         extra["xla_flops_per_img"] = round(cost["flops"] / batch / 1e9, 2)
     except Exception:
         pass
-    return batch * iters / dt, extra
+    return extra
 
 
 def _bench_bert(on_tpu):
@@ -264,9 +243,7 @@ def probe():
     return 0
 
 
-def worker_resnet():
-    devices, on_tpu = _init_backend()
-    img_s, extra = _bench_resnet50(on_tpu)
+def _resnet_line(devices, on_tpu, img_s, extra):
     kind = getattr(devices[0], "device_kind", "")
     out = {
         "metric": "resnet50_train_throughput",
@@ -280,7 +257,40 @@ def worker_resnet():
     if on_tpu:  # a CPU "MFU" against TPU peak would be meaningless
         peak = _lookup(_PEAK_TFLOPS, kind, 197.0)
         out["mfu"] = round(img_s * _RESNET50_TRAIN_FLOPS / (peak * 1e12), 4)
-    print(json.dumps(out))
+    return out
+
+
+def worker_resnet():
+    devices, on_tpu = _init_backend()
+    if on_tpu:
+        batch, warmup, iters = 256, 5, 25  # ~125 ms/step: timing noise <1%
+    else:
+        batch, warmup, iters = 8, 1, 2  # degraded-signal fallback, <3 min
+    t_start = time.monotonic()
+
+    dt, ts, x, y = _resnet_variant(on_tpu, False, batch, warmup, iters)
+    img_s = batch * iters / dt
+    extra = _resnet_extra(on_tpu, dt, iters, batch, ts, x, y, False)
+    # print the BASELINE immediately: if the remat attempt below wedges,
+    # the orchestrator salvages this line from the abandoned worker
+    print(json.dumps(_resnet_line(devices, on_tpu, img_s, extra)),
+          flush=True)
+
+    if on_tpu and os.environ.get("PTPU_TRY_REMAT", "1") != "0" and \
+            time.monotonic() - t_start < RESNET_TPU_S * 0.5:
+        # HBM-bound step + idle MXU: rematerializing the residual stages
+        # can net throughput — measure and keep the faster variant
+        try:
+            it2 = max(10, iters // 2)
+            dt2, ts2, x2, y2 = _resnet_variant(on_tpu, True, batch, 3, it2)
+            img_s2 = batch * it2 / dt2
+            if img_s2 > img_s:
+                extra2 = _resnet_extra(on_tpu, dt2, it2, batch, ts2, x2,
+                                       y2, True)
+                print(json.dumps(_resnet_line(devices, on_tpu, img_s2,
+                                              extra2)), flush=True)
+        except Exception:
+            pass
     return 0
 
 
@@ -304,33 +314,55 @@ def worker_bert():
 
 # --------------------------------------------------------------- orchestrator
 def _spawn(mode, force_cpu):
+    import tempfile
+
     env = dict(os.environ)
     if force_cpu:
         env["PTPU_FORCE_CPU"] = "1"
-    return subprocess.Popen(
+    # stdout goes to a FILE so an abandoned (deadlined) worker's already-
+    # printed partial results are still readable — a worker that measured
+    # the baseline but hung in a later phase salvages its number
+    outf = tempfile.NamedTemporaryFile(
+        mode="w+", suffix=f"_{mode.strip('-')}.out", delete=False)
+    proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), mode],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        env=env, stdout=outf, stderr=subprocess.DEVNULL,
         text=True, start_new_session=True)
+    proc._ptpu_outpath = outf.name
+    outf.close()
+    return proc
+
+
+def _read_last_json(path):
+    try:
+        with open(path) as f:
+            for line in reversed(f.read().strip().splitlines()):
+                try:
+                    return json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+    except OSError:
+        pass
+    return None
 
 
 def _await_json(proc, deadline_s):
     """Poll `proc` until it exits or the deadline passes. On deadline the
     process is ABANDONED (detached via start_new_session), NEVER killed —
-    killing a TPU-claim-holding python wedges the claim for hours."""
+    killing a TPU-claim-holding python wedges the claim for hours. Any
+    JSON the worker printed before the deadline is still used."""
     t0 = time.monotonic()
     while time.monotonic() - t0 < deadline_s:
         rc = proc.poll()
         if rc is not None:
-            out = proc.stdout.read() if proc.stdout else ""
-            if rc != 0:
-                return None, f"rc={rc}"
-            for line in reversed(out.strip().splitlines()):
-                try:
-                    return json.loads(line), None
-                except json.JSONDecodeError:
-                    continue
-            return None, "no JSON"
+            res = _read_last_json(proc._ptpu_outpath)
+            if res is not None:
+                return res, None
+            return None, (f"rc={rc}, no JSON" if rc != 0 else "no JSON")
         time.sleep(0.5)
+    res = _read_last_json(proc._ptpu_outpath)
+    if res is not None:
+        return res, None   # partial line salvaged from the abandoned run
     return None, f"abandoned after {deadline_s}s (left running, not killed)"
 
 

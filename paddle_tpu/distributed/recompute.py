@@ -36,6 +36,8 @@ def recompute(function, *args, **kwargs):
     arg_is_tensor = [isinstance(a, Tensor) for a in args]
     tensor_args = [a for a in args if isinstance(a, Tensor)]
 
+    meta = {"n_user": 1, "is_seq": False}
+
     @jax.checkpoint
     def inner(arg_vals, state_vals):
         saved = [(t._value, t._version, t._node, t.stop_gradient) for t in state]
@@ -54,8 +56,19 @@ def recompute(function, *args, **kwargs):
                     call_args.append(args[i])
             out = function(*call_args, **kwargs)
             if isinstance(out, (tuple, list)):
-                return tuple(o._value if isinstance(o, Tensor) else o for o in out)
-            return out._value if isinstance(out, Tensor) else out
+                meta["is_seq"] = True
+                outs = tuple(o._value if isinstance(o, Tensor) else o
+                             for o in out)
+            else:
+                meta["is_seq"] = False
+                outs = (out._value if isinstance(out, Tensor) else out,)
+            meta["n_user"] = len(outs)
+            # buffer updates (BN running stats …) must ESCAPE the
+            # checkpointed region: the finally below restores every
+            # state tensor, so thread the post-run buffer values out as
+            # extra outputs and reapply them outside
+            new_buf = tuple(t._value for t in buffers)
+            return outs + new_buf
         finally:
             for t, (v, ver, node, sg) in zip(state, saved):
                 t._value = v
@@ -68,7 +81,14 @@ def recompute(function, *args, **kwargs):
         svals = list(vals[len(tensor_args):])
         return inner(avals, svals)
 
-    return apply(fn, *tensor_args, *state)
+    result = apply(fn, *tensor_args, *state)
+    result = result if isinstance(result, tuple) else (result,)
+    user = result[:meta["n_user"]]
+    for t, new in zip(buffers, result[meta["n_user"]:]):
+        t._set_value(new._value)
+    if not meta["is_seq"]:
+        return user[0]
+    return tuple(user)
 
 
 def recompute_sequential(ctx, functions, *args, **kwargs):

@@ -77,7 +77,7 @@ def prepare_forward(gate, num_expert, world_size=1, moe_group=None):
     total = jnp.sum(unwrap(fwd_expert_count))
     try:
         fwd_batch_size = int(total)     # eager: a python int
-    except jax.errors.TracerArrayConversionError:
+    except jax.errors.ConcretizationTypeError:
         fwd_batch_size = total          # traced: stays a tracer (shapes
         #                                 must come from static capacity)
     return pos, local, glob, fwd_expert_count, fwd_batch_size

@@ -82,10 +82,10 @@ class ResNet(nn.Layer):
     pass (jax.checkpoint via distributed.recompute): the training step
     is HBM-bandwidth-bound on TPU (r3 roofline: 94 GB/step at 99% of
     v5e bandwidth with the MXU ~27% busy), so trading idle FLOPs for
-    skipped activation round-trips can raise throughput. BN running
-    stats inside a rematerialized stage do not advance (recompute
-    restores buffers) — train-mode batch statistics, losses and
-    gradients are unaffected."""
+    skipped activation round-trips can raise throughput. A pure
+    performance knob: losses, gradients AND BatchNorm running stats
+    match the plain model (recompute threads buffer updates out of the
+    checkpointed region)."""
 
     def __init__(self, block, depth=50, width=64, num_classes=1000,
                  with_pool=True, groups=1, data_format="NCHW",

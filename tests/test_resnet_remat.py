@@ -27,6 +27,28 @@ def test_remat_matches_plain():
     np.testing.assert_allclose(g0, g1, atol=1e-5)
 
 
+def test_remat_updates_bn_running_stats():
+    """recompute threads buffer updates out of the checkpointed region:
+    BN running stats must advance identically to the plain model, so
+    eval() after remat training behaves the same."""
+    def stats(remat):
+        p.seed(0)
+        m = resnet18(num_classes=10, remat=remat)
+        x = p.to_tensor(np.random.default_rng(0).standard_normal(
+            (2, 3, 32, 32)).astype(np.float32))
+        m(x)
+        return {k: v.numpy().copy() for k, v in m.state_dict().items()
+                if "_mean" in k or "_variance" in k}
+
+    s0, s1 = stats(False), stats(True)
+    moved = 0
+    for k in s0:
+        np.testing.assert_allclose(s0[k], s1[k], atol=1e-5, err_msg=k)
+        if np.abs(s1[k]).sum() > 0 and "_mean" in k:
+            moved += int(not np.allclose(s1[k], 0.0))
+    assert moved > 0  # stats genuinely advanced, not both stuck at init
+
+
 def test_remat_under_to_static_trains():
     p.seed(0)
     m = resnet18(num_classes=10, remat=True)
