@@ -33,9 +33,11 @@ def bind_all():
         "lerp", "atan2", "scale", "stanh", "nansum", "nanmean",
         "count_nonzero", "isfinite", "isinf", "isnan", "nan_to_num",
         "heaviside", "diff", "neg", "trace", "diagonal", "digamma", "lgamma",
-        "frac", "take", "conj", "angle", "rad2deg", "deg2rad", "add_",
+        "frac", "take", "conj", "angle", "rad2deg", "deg2rad", "gcd",
+        "lcm", "add_",
         "subtract_", "multiply_", "clip_", "scale_", "exp_", "sqrt_",
         "rsqrt_", "reciprocal_", "round_", "ceil_", "floor_", "tanh_",
+        "fill_", "zero_",
         # logic
         "equal", "not_equal", "greater_than", "greater_equal", "less_than",
         "less_equal", "equal_all", "allclose", "isclose", "logical_and",
@@ -49,7 +51,7 @@ def bind_all():
         "unique", "unique_consecutive", "masked_select", "masked_fill",
         "index_select", "index_sample", "index_add", "take_along_axis",
         "put_along_axis", "repeat_interleave", "split", "chunk", "unstack",
-        "as_complex", "as_real", "unbind",
+        "as_complex", "as_real", "unbind", "tensordot",
         # linalg
         "dot", "bmm", "mv", "t", "cross", "norm", "dist", "cholesky", "det",
         "slogdet", "svd", "qr", "eig", "eigvals", "pinv", "inverse", "solve",

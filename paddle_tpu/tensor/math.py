@@ -466,6 +466,17 @@ def _make_inplace(op):
     return inplace
 
 
+def fill_(x, value, name=None):
+    """In-place fill with a scalar (reference varbase patch fill_)."""
+    out = apply(lambda v: jnp.full_like(v, value), x)
+    return x._inplace_assign(out)
+
+
+def zero_(x, name=None):
+    """In-place zero fill (reference varbase patch zero_)."""
+    return fill_(x, 0.0)
+
+
 add_ = _make_inplace(add)
 subtract_ = _make_inplace(subtract)
 multiply_ = _make_inplace(multiply)

@@ -240,3 +240,92 @@ class TestConvertCall:
         y = p.to_tensor((rng.standard_normal(32) > 0).astype(np.int64))
         losses = [float(train_step(x, y).numpy()) for _ in range(30)]
         assert losses[-1] < losses[0] * 0.5, losses[:3] + losses[-3:]
+
+
+class TestEdgeCases:
+    """Composability battery: nested/mixed control flow, boolop chains,
+    aug/tuple assignment, dict outputs, eager python semantics."""
+
+    def test_nested_if_in_tensor_while(self):
+        @p.jit.to_static
+        def f(x):
+            i = p.zeros([])
+            acc = x * 0.0
+            while i < 3.0:
+                if x.sum() > 0:
+                    acc = acc + x
+                else:
+                    acc = acc - x
+                i = i + 1.0
+            return acc
+
+        assert np.allclose(f(_arr(1.0, 2.0)).numpy(), [3.0, 6.0])
+        assert np.allclose(f(_arr(-1.0)).numpy(), [3.0])
+
+    def test_if_inside_python_for(self):
+        @p.jit.to_static
+        def f(x):
+            acc = x * 0.0
+            for k in [1.0, 2.0, 3.0]:
+                if (x.sum() * k) > 4.0:
+                    acc = acc + k
+                else:
+                    acc = acc - k
+            return acc
+
+        assert np.allclose(f(_arr(1.0, 2.0)).numpy(), [4.0, 4.0])
+
+    def test_boolop_chain_and_or_not(self):
+        @p.jit.to_static
+        def f(x):
+            if (x.sum() > 0) and (x.max() < 10) and (x.min() > 0):
+                y = x + 1.0
+            elif (x.sum() > 0) or (x.min() > 100):
+                y = x * 2.0
+            else:
+                y = x
+            if not (y.sum() > 100):
+                y = y + 0.5
+            return y
+
+        assert np.allclose(f(_arr(1.0, 2.0)).numpy(), [2.5, 3.5])
+        assert np.allclose(f(_arr(50.0)).numpy(), [100.5])
+
+    def test_aug_and_tuple_assignment(self):
+        @p.jit.to_static
+        def f(x):
+            y = x * 1.0
+            if x.sum() > 0:
+                y += 10.0
+                a, b = x + 1.0, x + 2.0
+            else:
+                y -= 10.0
+                a, b = x - 1.0, x - 2.0
+            return y + a + b
+
+        assert np.allclose(f(_arr(1.0)).numpy(), [16.0])
+        assert np.allclose(f(_arr(-1.0)).numpy(), [-16.0])
+
+    def test_dict_branch_output(self):
+        @p.jit.to_static
+        def f(x):
+            if x.sum() > 0:
+                d = {"a": x + 1.0}
+            else:
+                d = {"a": x - 1.0}
+            return d["a"]
+
+        assert np.allclose(f(_arr(1.0, 2.0)).numpy(), [2.0, 3.0])
+        assert np.allclose(f(_arr(-5.0)).numpy(), [-6.0])
+
+    def test_eager_python_loop_semantics_preserved(self):
+        def f(x, n):
+            total = 0.0
+            for i in range(n):
+                if i % 2 == 0:
+                    total += i
+            return x * 0.0 + total
+
+        ft = convert_to_static(f)
+        assert np.allclose(ft(_arr(1.0), 5).numpy(), [6.0])
+        assert np.allclose(ft(_arr(1.0), 3).numpy(), [2.0])
