@@ -336,6 +336,11 @@ from paddle_tpu.distributed.fleet import (  # noqa: E402
 _mp.sharding = _mps
 _sys.modules[__name__ + ".meta_parallel.sharding"] = _mps
 
+from paddle_tpu.distributed.fleet import pp_utils as _ppu  # noqa: E402
+
+_mp.pp_utils = _ppu
+_sys.modules[__name__ + ".meta_parallel.pp_utils"] = _ppu
+
 
 # ---- launch-plumbing surface (reference fleet/launch_utils.py) ----
 # the canonical classes live in distributed.utils.launch_utils; the

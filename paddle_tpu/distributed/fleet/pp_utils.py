@@ -1,0 +1,82 @@
+"""paddle.distributed.fleet.meta_parallel.pp_utils parity.
+
+Reference: fleet/meta_parallel/pp_utils/p2p_communication.py
+(recv_forward/send_backward/… — the NCCL point-to-point calls the
+reference's pipeline schedule is built from) and pp_utils/utils.py.
+
+TPU-native: there is no one-sided send. In the SPMD rendering every
+matched send/recv PAIR is ONE `lax.ppermute` over the `pp` mesh axis —
+stage s's send_forward and stage s+1's recv_forward are the same
+collective. These helpers expose the reference's vocabulary for code
+being ported: each returns the tensor that ARRIVES at this stage (the
+value the reference's recv would produce), and the "send" names are
+aliases of the paired receive since the pair is one op. Call them
+inside `shard_map` over a mesh with a `pp` axis (the prebuilt schedules
+in distributed/pipeline.py are the fast path; these are the primitives).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from jax import lax
+
+from paddle_tpu.distributed import mesh as mesh_mod
+
+__all__ = [
+    "p2p_shift", "recv_forward", "recv_backward", "send_forward",
+    "send_backward", "send_forward_recv_backward",
+    "send_backward_recv_forward", "get_tensor_bytes", "is_float_tensor",
+]
+
+
+def p2p_shift(x, direction=+1, axis_name="pp", axis_size=None):
+    """One ring hop over `axis_name`: +1 moves values stage s -> s+1
+    (the forward-activation direction), -1 moves s -> s-1 (the
+    backward-cotangent direction)."""
+    n = mesh_mod.resolve_axis_size(axis_name, axis_size)
+    perm = [(i, (i + direction) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def recv_forward(tensor, axis_name="pp", axis_size=None):
+    """The activation arriving FROM the previous stage (the reference's
+    recv_forward); `tensor` is this stage's outgoing activation — the
+    send half of the same ppermute."""
+    return p2p_shift(tensor, +1, axis_name, axis_size)
+
+
+def recv_backward(tensor, axis_name="pp", axis_size=None):
+    """The cotangent arriving FROM the next stage."""
+    return p2p_shift(tensor, -1, axis_name, axis_size)
+
+
+# one collective per matched pair: the send names ARE the paired recv
+send_forward = recv_forward
+send_backward = recv_backward
+
+
+def send_forward_recv_backward(activation, cotangent, axis_name="pp",
+                               axis_size=None):
+    """1F1B steady-state exchange: push the activation one stage ahead
+    and pull the cotangent one stage back (two ppermutes, opposite
+    directions — XLA overlaps them)."""
+    return (p2p_shift(activation, +1, axis_name, axis_size),
+            p2p_shift(cotangent, -1, axis_name, axis_size))
+
+
+def send_backward_recv_forward(cotangent, activation, axis_name="pp",
+                               axis_size=None):
+    return (p2p_shift(cotangent, -1, axis_name, axis_size),
+            p2p_shift(activation, +1, axis_name, axis_size))
+
+
+def get_tensor_bytes(tensor):
+    """Byte size of a tensor (reference pp_utils/utils.py)."""
+    v = getattr(tensor, "_value", tensor)
+    return int(np.prod(v.shape)) * v.dtype.itemsize
+
+
+def is_float_tensor(tensor):
+    import jax.numpy as jnp
+    v = getattr(tensor, "_value", tensor)
+    return jnp.issubdtype(v.dtype, jnp.floating)

@@ -237,8 +237,11 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights,
     entries are STATIC [2, bsz, n_head, max_seq_len, head_dim] buffers —
     prefill (time_step=None) writes positions [0, s), decode
     (time_step=t) writes position t and attends over [0, t] with a
-    static-shape mask (no dynamic shapes ever reach XLA). Returns out,
-    or (out, updated_cache_kvs) when cache_kvs is given — updated
+    static-shape mask (no dynamic shapes ever reach XLA). time_step may
+    also be a [bsz] VECTOR for ragged decode: each sequence writes and
+    attends at its own length, so continuation batching serves mixed-
+    length requests without re-padding. Returns out, or
+    (out, updated_cache_kvs) when cache_kvs is given — updated
     functionally, not in place. ring_id is the reference's NCCL group
     id; tensor parallelism here comes from weight shardings (GSPMD), so
     it is accepted and ignored.
@@ -316,16 +319,43 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights,
                     cache = cache.at[0, :, :, :k.shape[2]].set(k)
                     cache = cache.at[1, :, :, :v.shape[2]].set(v)
                 else:                                   # decode: s == 1
-                    t0 = jnp.reshape(tstep, ()).astype(jnp.int32)
-                    cache = jax.lax.dynamic_update_slice(
-                        cache, jnp.stack([k, v], 0)[:, :, :, :1],
-                        (0, 0, 0, t0, 0))
+                    if s != 1:
+                        raise ValueError(
+                            f"decode (time_step given) expects one token "
+                            f"per sequence, got seq_len {s}")
+                    ts = jnp.reshape(tstep, (-1,)).astype(jnp.int32)
+                    if ts.shape[0] not in (1, bsz):
+                        raise ValueError(
+                            f"time_step must be scalar-like or [batch] "
+                            f"({bsz}), got shape {tuple(ts.shape)}")
+                    if ts.shape[0] == 1:
+                        # uniform decode: one position for the batch
+                        t0 = ts[0]
+                        cache = jax.lax.dynamic_update_slice(
+                            cache, jnp.stack([k, v], 0)[:, :, :, :1],
+                            (0, 0, 0, t0, 0))
+                        kv_mask_extra = jnp.where(
+                            jnp.arange(max_len)[None, None, None, :] <= t0,
+                            0.0, jnp.finfo(jnp.float32).min)
+                    else:
+                        # RAGGED decode (time_step of shape [bsz]): each
+                        # sequence writes/attends at its OWN length —
+                        # continuation batching without re-padding (the
+                        # ragged-attention serving pattern, static shapes)
+                        kv_new = jnp.stack([k, v], 0)  # [2, b, n, 1, d]
+
+                        def upd(cache_b, kv_b, t_b):
+                            return jax.lax.dynamic_update_slice(
+                                cache_b, kv_b, (0, 0, t_b, 0))
+
+                        cache = jax.vmap(upd, in_axes=(1, 1, 0),
+                                         out_axes=1)(cache, kv_new, ts)
+                        kv_mask_extra = jnp.where(
+                            jnp.arange(max_len)[None, None, None, :]
+                            <= ts[:, None, None, None],
+                            0.0, jnp.finfo(jnp.float32).min)
                     k = cache[0]
                     v = cache[1]
-                    pos = jnp.arange(max_len)
-                    kv_mask_extra = jnp.where(
-                        pos[None, None, None, :] <= t0, 0.0,
-                        jnp.finfo(jnp.float32).min)
                 new_caches.append(cache)
 
             s_qk = (q * scale) @ jnp.swapaxes(k, -1, -2)

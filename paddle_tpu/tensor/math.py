@@ -468,8 +468,8 @@ def _make_inplace(op):
 
 def fill_(x, value, name=None):
     """In-place fill with a scalar (reference varbase patch fill_)."""
-    out = apply(lambda v: jnp.full_like(v, value), x)
-    return x._inplace_assign(out)
+    from paddle_tpu.tensor.creation import full_like
+    return x._inplace_assign(full_like(x, value))
 
 
 def zero_(x, name=None):

@@ -138,3 +138,40 @@ def test_fused_matmul_bias(weights):
     out2 = IF.fused_matmul_bias(p.to_tensor(a.T), p.to_tensor(y),
                                 transpose_x=True, transpose_y=True)
     np.testing.assert_allclose(out2.numpy(), a @ y.T, atol=1e-6)
+
+
+def test_ragged_decode_per_sequence_positions(weights):
+    """time_step as a [bsz] vector: each sequence decodes at its OWN
+    length. Row b's decode output must equal the uniform-decode output
+    computed for that row's length alone — continuation batching without
+    re-padding."""
+    max_len = 12
+    lens = np.array([4, 6], np.int32)          # per-sequence real lengths
+
+    # build per-sequence caches by prefilling each row's prefix alone,
+    # then assemble the ragged batch cache
+    caches_batch = [np.zeros((2, B, N, max_len, HD), np.float32)
+                    for _ in range(L)]
+    xt = weights["rng"].standard_normal((B, 1, E)).astype(np.float32) * 0.3
+    per_row_out = []
+    for b in range(B):
+        xb = weights["x"][b:b + 1, :lens[b]]
+        mb = np.broadcast_to(_causal(lens[b]),
+                             (1, 1, lens[b], lens[b])).copy()
+        cb = [p.to_tensor(np.zeros((2, 1, N, max_len, HD), np.float32))
+              for _ in range(L)]
+        _, cb2 = _run(weights, xb, mb, cache_kvs=cb)
+        for i in range(L):
+            caches_batch[i][:, b] = cb2[i].numpy()[:, 0]
+        out_b, _ = _run(weights, xt[b:b + 1], cache_kvs=[
+            p.to_tensor(c.numpy()) for c in cb2],
+            time_step=p.to_tensor(np.array([lens[b]], np.int32)))
+        per_row_out.append(out_b.numpy()[0])
+
+    out_ragged, _ = _run(
+        weights, xt,
+        cache_kvs=[p.to_tensor(c) for c in caches_batch],
+        time_step=p.to_tensor(lens))
+    for b in range(B):
+        np.testing.assert_allclose(out_ragged.numpy()[b], per_row_out[b],
+                                   atol=2e-5, err_msg=f"row {b}")

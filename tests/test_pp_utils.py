@@ -1,0 +1,50 @@
+"""fleet.meta_parallel.pp_utils — the reference's p2p vocabulary as
+ppermute ring hops (reference fleet/meta_parallel/pp_utils/
+p2p_communication.py; one matched send/recv pair == one ppermute)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as p
+from paddle_tpu.distributed.fleet.meta_parallel import pp_utils as ppu
+
+
+def test_ring_hops_move_stage_values():
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("pp",))
+
+    def body(x):
+        return ppu.recv_forward(x), ppu.recv_backward(x)
+
+    f = jax.jit(shard_map(body, mesh=mesh, in_specs=P("pp"),
+                          out_specs=(P("pp"), P("pp")), check_vma=False))
+    x = jnp.arange(8.0)
+    fwd, bwd = f(x)
+    # +1 hop: stage s receives stage s-1's value
+    np.testing.assert_allclose(np.asarray(fwd), np.roll(np.arange(8.0), 1))
+    np.testing.assert_allclose(np.asarray(bwd), np.roll(np.arange(8.0), -1))
+
+
+def test_paired_exchange():
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("pp",))
+
+    def body(x):
+        a, c = ppu.send_forward_recv_backward(x, x * 10.0)
+        return a, c
+
+    f = jax.jit(shard_map(body, mesh=mesh, in_specs=P("pp"),
+                          out_specs=(P("pp"), P("pp")), check_vma=False))
+    a, c = f(jnp.arange(8.0))
+    np.testing.assert_allclose(np.asarray(a), np.roll(np.arange(8.0), 1))
+    np.testing.assert_allclose(np.asarray(c),
+                               np.roll(10.0 * np.arange(8.0), -1))
+
+
+def test_utils():
+    t = p.to_tensor(np.ones((3, 4), np.float32))
+    assert ppu.get_tensor_bytes(t) == 48
+    assert ppu.is_float_tensor(t)
+    assert not ppu.is_float_tensor(p.to_tensor(np.ones((2,), np.int32)))
