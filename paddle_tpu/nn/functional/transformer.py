@@ -57,16 +57,102 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     return apply(fn, query, key, value, attn_mask)
 
 
+_block_mask_cache = {}
+
+
+def _csr_shared_mask(offs_np, cols_np, ql, kl):
+    """The single [ql, kl] token mask all (b, h) share, or None. Built
+    ONCE per pattern (the per-block-size alignment checks below reuse
+    it)."""
+    import numpy as np
+    b, h = offs_np.shape[:2]
+    base = None
+    for bi in range(b):
+        for hi in range(h):
+            m = np.zeros((ql, kl), bool)
+            o, c = offs_np[bi, hi], cols_np[bi, hi]
+            for r in range(ql):
+                m[r, c[o[r]:o[r + 1]]] = True
+            if base is None:
+                base = m
+            elif not np.array_equal(base, m):
+                return None
+    return base
+
+
+def _mask_block_aligned(base, ql, kl, block):
+    """[nq, nk] block mask if `base` is exactly block-aligned, else None."""
+    import numpy as np
+    if ql % block or kl % block:
+        return None
+    blocks = base.reshape(ql // block, block, kl // block, block)
+    frac = blocks.mean(axis=(1, 3))
+    if not np.all((frac == 0.0) | (frac == 1.0)):
+        return None
+    return frac.astype(bool)
+
+
 def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
                      key_padding_mask=None, attn_mask=None, name=None):
     """Block-sparse attention. Reference: nn/functional/sparse_attention.py.
-    TPU note: implemented as dense attention with a sparsity mask built from
-    the CSR pattern (XLA handles masked softmax efficiently); a pallas
-    block-sparse kernel is the planned fast path."""
+
+    TPU note: when the CSR pattern is shared across (batch, head) and
+    exactly block-aligned (the practical patterns — sliding window,
+    global tokens, blocked causal), this routes to the Pallas
+    block-sparse flash kernel
+    (ops/pallas/block_sparse_attention.py): work and K/V DMA scale with
+    the ACTIVE block count, not seq². Other patterns fall back to dense
+    attention with the CSR mask (XLA fuses the masked softmax)."""
+    hit = None
+    if key_padding_mask is None and attn_mask is None:
+        import hashlib
+
+        import numpy as np
+        try:
+            # host-side pattern analysis only — a failure here (traced
+            # offsets, exotic inputs) falls back to dense; a failure in
+            # the KERNEL below must surface, not be swallowed
+            offs_np = np.asarray(
+                sparse_csr_offset.numpy()
+                if hasattr(sparse_csr_offset, "numpy")
+                else sparse_csr_offset)
+            cols_np = np.asarray(
+                sparse_csr_columns.numpy()
+                if hasattr(sparse_csr_columns, "numpy")
+                else sparse_csr_columns)
+            ql = query.shape[2]
+            kl = key.shape[2]
+            dig = hashlib.sha256()
+            dig.update(offs_np.tobytes())
+            dig.update(cols_np.tobytes())
+            key_ = (dig.hexdigest(), ql, kl)
+            if key_ in _block_mask_cache:
+                hit = _block_mask_cache[key_]
+            else:
+                hit = None
+                base = _csr_shared_mask(offs_np, cols_np, ql, kl)
+                if base is not None:
+                    for block in (512, 256, 128, 64):
+                        bm = _mask_block_aligned(base, ql, kl, block)
+                        if bm is not None:
+                            hit = (bm, block)
+                            break
+                _block_mask_cache[key_] = hit
+        except Exception:
+            hit = None
+    if hit is not None:
+        bm, block = hit
+        from paddle_tpu.ops.pallas.block_sparse_attention import (
+            block_sparse_attention,
+        )
+        return apply(
+            lambda q, k, v: block_sparse_attention(
+                q, k, v, bm, block_q=block, block_k=block),
+            query, key, value)
+
     def fn(q, k, v, offs, cols):
         b, h, ql, d = q.shape
         kl = k.shape[2]
-        mask = jnp.zeros((b, h, ql, kl), bool)
         # CSR rows -> dense mask (static pattern assumed)
         import numpy as np
         offs_np = np.asarray(offs)

@@ -1,0 +1,168 @@
+"""Block-sparse flash attention (ops/pallas/block_sparse_attention.py)
+and its integration as nn.functional.sparse_attention's fast path.
+
+Reference role: python/paddle/nn/functional/sparse_attention.py. Work
+scales with the ACTIVE block count (splash-style host tables feed the
+K/V index maps); backward walks the same tables. Interpret-mode here;
+tests_tpu/ holds the Mosaic-compiled forms.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as p
+import paddle_tpu.nn.functional as F
+from paddle_tpu.ops.pallas.block_sparse_attention import (
+    block_sparse_attention, make_global_plus_window_mask,
+    make_sliding_window_mask)
+
+B, H, S, D = 1, 2, 256, 64
+BQ = BK = 64
+
+
+def _qkv(seed=0):
+    rng = np.random.RandomState(seed)
+    return (jnp.asarray(rng.randn(B, H, S, D), jnp.float32),
+            jnp.asarray(rng.randn(B, H, S, D), jnp.float32),
+            jnp.asarray(rng.randn(B, H, S, D), jnp.float32))
+
+
+def _dense_ref(q, k, v, token_mask):
+    scores = np.einsum("bhid,bhjd->bhij", np.asarray(q),
+                       np.asarray(k)) / np.sqrt(D)
+    scores = np.where(token_mask, scores, -1e30)
+    e = np.exp(scores - scores.max(-1, keepdims=True))
+    return np.einsum("bhij,bhjd->bhid", e / e.sum(-1, keepdims=True),
+                     np.asarray(v))
+
+
+class TestKernel:
+    @pytest.mark.parametrize("pattern", ["window", "global_window"])
+    def test_forward_matches_dense_masked(self, pattern):
+        q, k, v = _qkv()
+        nq = S // BQ
+        if pattern == "window":
+            bm = make_sliding_window_mask(nq, nq, 2, causal=True)
+        else:
+            bm = make_global_plus_window_mask(nq, nq, 2, 1, causal=True)
+        out = block_sparse_attention(q, k, v, bm, block_q=BQ, block_k=BK)
+        big = np.kron(bm, np.ones((BQ, BK))).astype(bool)
+        ref = _dense_ref(q, k, v, big)
+        assert np.abs(np.asarray(out) - ref).max() < 5e-5
+
+    def test_grads_match_dense_masked(self):
+        q, k, v = _qkv(1)
+        nq = S // BQ
+        bm = make_sliding_window_mask(nq, nq, 2, causal=True)
+        big = jnp.asarray(np.kron(bm, np.ones((BQ, BK))).astype(bool))
+
+        def f(q, k, v):
+            return jnp.sum(block_sparse_attention(
+                q, k, v, bm, block_q=BQ, block_k=BK).astype(jnp.float32))
+
+        def g(q, k, v):
+            s = jnp.einsum("bhid,bhjd->bhij", q, k) / np.sqrt(D)
+            s = jnp.where(big, s, -1e30)
+            return jnp.sum(jnp.einsum("bhij,bhjd->bhid",
+                                      jax.nn.softmax(s, -1), v))
+
+        got = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(g, argnums=(0, 1, 2))(q, k, v)
+        for a, w in zip(got, want):
+            assert float(jnp.max(jnp.abs(a - w))) < 1e-4
+
+    def test_ragged_tail_seq_not_block_multiple(self):
+        """seq_k = 300 with block 256: the active last block's 212
+        zero-padded phantom keys must not enter the softmax denominator."""
+        b, h, s, d = 1, 1, 300, 64
+        bq = bk = 256
+        rng = np.random.RandomState(7)
+        q = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+        k = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+        v = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+        bm = np.ones((2, 2), bool)          # fully active blocks
+        out = block_sparse_attention(q, k, v, bm, block_q=bq, block_k=bk)
+        ref = _dense_ref(q, k, v, np.ones((b, h, s, s), bool))
+        assert np.abs(np.asarray(out) - ref).max() < 5e-5
+
+        def f(q, k, v):
+            return jnp.sum(block_sparse_attention(
+                q, k, v, bm, block_q=bq, block_k=bk).astype(jnp.float32))
+
+        def g(q, k, v):
+            sc = jnp.einsum("bhid,bhjd->bhij", q, k) / np.sqrt(d)
+            return jnp.sum(jnp.einsum("bhij,bhjd->bhid",
+                                      jax.nn.softmax(sc, -1), v))
+
+        got = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(g, argnums=(0, 1, 2))(q, k, v)
+        for a, w in zip(got, want):
+            assert float(jnp.max(jnp.abs(a - w))) < 1e-4
+
+    def test_mask_shape_validated(self):
+        q, k, v = _qkv()
+        with pytest.raises(ValueError, match="block_mask shape"):
+            block_sparse_attention(q, k, v, np.ones((2, 2), bool),
+                                   block_q=BQ, block_k=BK)
+
+
+class TestSparseAttentionFastPath:
+    def _csr_from_block_mask(self, bm, block):
+        """Token-level CSR (per b, h) for a block mask."""
+        nq, nk = bm.shape
+        ql = nq * block
+        offs = np.zeros((B, H, ql + 1), np.int32)
+        cols = []
+        for r in range(ql):
+            cs = np.nonzero(np.kron(bm[r // block],
+                                    np.ones(block, bool)))[0]
+            cols.append(cs)
+            offs[:, :, r + 1] = offs[:, :, r] + len(cs)
+        cols_flat = np.concatenate(cols).astype(np.int32)
+        cols_all = np.broadcast_to(cols_flat,
+                                   (B, H, len(cols_flat))).copy()
+        return offs, cols_all
+
+    def test_block_aligned_csr_routes_to_kernel(self):
+        from paddle_tpu.nn.functional.transformer import _block_mask_cache
+
+        q, k, v = _qkv(2)
+        nq = S // BK
+        bm = make_sliding_window_mask(nq, nq, 2, causal=True)
+        offs, cols = self._csr_from_block_mask(bm, BK)
+        _block_mask_cache.clear()
+        out = F.sparse_attention(
+            p.to_tensor(np.asarray(q)), p.to_tensor(np.asarray(k)),
+            p.to_tensor(np.asarray(v)), p.to_tensor(offs),
+            p.to_tensor(cols))
+        big = np.kron(bm, np.ones((BK, BK))).astype(bool)
+        ref = _dense_ref(q, k, v, big)
+        assert np.abs(out.numpy() - ref).max() < 5e-5
+        # THIS call's pattern was recognized as block-aligned
+        assert len(_block_mask_cache) == 1
+        (hit,) = _block_mask_cache.values()
+        assert hit is not None and hit[1] == BK
+
+    def test_ragged_csr_falls_back_dense(self):
+        q, k, v = _qkv(3)
+        rng = np.random.RandomState(0)
+        ql = S
+        offs = np.zeros((B, H, ql + 1), np.int32)
+        cols_rows = []
+        for r in range(ql):
+            cs = np.sort(rng.choice(ql, 5, replace=False)).astype(np.int32)
+            cols_rows.append(cs)
+            offs[:, :, r + 1] = offs[:, :, r] + 5
+        cols = np.broadcast_to(np.concatenate(cols_rows),
+                               (B, H, 5 * ql)).copy()
+        out = F.sparse_attention(
+            p.to_tensor(np.asarray(q)), p.to_tensor(np.asarray(k)),
+            p.to_tensor(np.asarray(v)), p.to_tensor(offs),
+            p.to_tensor(cols))
+        tok = np.zeros((B, H, ql, ql), bool)
+        for r in range(ql):
+            tok[:, :, r, cols_rows[r]] = True
+        ref = _dense_ref(q, k, v, tok)
+        assert np.abs(out.numpy() - ref).max() < 5e-5

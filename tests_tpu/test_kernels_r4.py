@@ -254,3 +254,61 @@ def test_fused_bias_dropout_residual_ln_compiled():
     want = (h - h.mean(-1, keepdims=True)) / \
         np.sqrt(h.var(-1, keepdims=True) + 1e-5)
     assert np.abs(out.numpy() - want).max() < 1e-3
+
+
+# --------------------------------------------- block-sparse attention
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_block_sparse_attention_compiled(dtype):
+    """Splash-style table-driven kernel through Mosaic: scalar-prefetch
+    index maps must lower and the active-block walk must match the
+    dense-masked oracle."""
+    from paddle_tpu.ops.pallas.block_sparse_attention import (
+        block_sparse_attention, make_sliding_window_mask)
+
+    b, h, s, d = 1, 2, 1024, 64
+    bq = bk = 256
+    rng = np.random.RandomState(10)
+    q = jnp.asarray(rng.randn(b, h, s, d), dtype)
+    k = jnp.asarray(rng.randn(b, h, s, d), dtype)
+    v = jnp.asarray(rng.randn(b, h, s, d), dtype)
+    nq = s // bq
+    bm = make_sliding_window_mask(nq, nq, 2, causal=True)
+    out = block_sparse_attention(q, k, v, bm, block_q=bq, block_k=bk,
+                                 interpret=False)
+    big = jnp.asarray(np.kron(bm, np.ones((bq, bk))).astype(bool))
+    sc = jnp.einsum("bhid,bhjd->bhij", q.astype(jnp.float32),
+                    k.astype(jnp.float32)) / np.sqrt(d)
+    sc = jnp.where(big, sc, -1e30)
+    ref = jnp.einsum("bhij,bhjd->bhid", jax.nn.softmax(sc, -1),
+                     v.astype(jnp.float32))
+    assert _rel_err(out, ref) < (3e-2 if dtype == jnp.bfloat16 else 6e-3)
+
+
+def test_block_sparse_attention_grads_compiled():
+    from paddle_tpu.ops.pallas.block_sparse_attention import (
+        block_sparse_attention, make_sliding_window_mask)
+
+    b, h, s, d = 1, 1, 512, 64
+    bq = bk = 128
+    rng = np.random.RandomState(11)
+    q = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+    bm = make_sliding_window_mask(s // bq, s // bq, 2, causal=True)
+    big = jnp.asarray(np.kron(bm, np.ones((bq, bk))).astype(bool))
+
+    def f(q, k, v):
+        return jnp.sum(block_sparse_attention(
+            q, k, v, bm, block_q=bq, block_k=bk,
+            interpret=False).astype(jnp.float32))
+
+    def g(q, k, v):
+        sc = jnp.einsum("bhid,bhjd->bhij", q, k) / np.sqrt(d)
+        sc = jnp.where(big, sc, -1e30)
+        return jnp.sum(jnp.einsum("bhij,bhjd->bhid",
+                                  jax.nn.softmax(sc, -1), v))
+
+    got = jax.jit(jax.grad(f, argnums=(0, 1, 2)))(q, k, v)
+    want = jax.grad(g, argnums=(0, 1, 2))(q, k, v)
+    for a, w in zip(got, want):
+        assert _rel_err(a, w) < 2e-2
