@@ -57,7 +57,16 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     return apply(fn, query, key, value, attn_mask)
 
 
-_block_mask_cache = {}
+_block_mask_cache = {}          # digest key -> (block_mask, block) | None
+_BLOCK_MASK_CACHE_CAP = 64
+_pattern_identity_memo = {}     # (id(offs), id(cols), ql, kl) -> digest key
+_PATTERN_MEMO_CAP = 256
+
+
+def _cache_put(cache, cap, key, value):
+    if len(cache) >= cap:
+        cache.pop(next(iter(cache)))   # FIFO eviction
+    cache[key] = value
 
 
 def _csr_shared_mask(offs_np, cols_np, ql, kl):
@@ -112,23 +121,57 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
             # host-side pattern analysis only — a failure here (traced
             # offsets, exotic inputs) falls back to dense; a failure in
             # the KERNEL below must surface, not be swallowed
-            offs_np = np.asarray(
-                sparse_csr_offset.numpy()
-                if hasattr(sparse_csr_offset, "numpy")
-                else sparse_csr_offset)
-            cols_np = np.asarray(
-                sparse_csr_columns.numpy()
-                if hasattr(sparse_csr_columns, "numpy")
-                else sparse_csr_columns)
             ql = query.shape[2]
             kl = key.shape[2]
-            dig = hashlib.sha256()
-            dig.update(offs_np.tobytes())
-            dig.update(cols_np.tobytes())
-            key_ = (dig.hexdigest(), ql, kl)
+            # serving loops pass the SAME offset/column objects each
+            # step: an identity memo skips the device->host copy + hash
+            # on the hot path
+            import weakref
+            ident = (id(sparse_csr_offset), id(sparse_csr_columns),
+                     ql, kl)
+            memo = _pattern_identity_memo.get(ident)
+            key_ = None
+            if memo is not None:
+                # id() can be reused after GC: the memo only counts if
+                # the weakrefs still point at live (hence same) objects
+                k, r1, r2 = memo
+                if r1() is sparse_csr_offset and \
+                        r2() is sparse_csr_columns:
+                    key_ = k
+            if key_ is None:
+                offs_np = np.asarray(
+                    sparse_csr_offset.numpy()
+                    if hasattr(sparse_csr_offset, "numpy")
+                    else sparse_csr_offset)
+                cols_np = np.asarray(
+                    sparse_csr_columns.numpy()
+                    if hasattr(sparse_csr_columns, "numpy")
+                    else sparse_csr_columns)
+                dig = hashlib.sha256()
+                dig.update(offs_np.tobytes())
+                dig.update(cols_np.tobytes())
+                key_ = (dig.hexdigest(), ql, kl)
+                try:
+                    _cache_put(
+                        _pattern_identity_memo, _PATTERN_MEMO_CAP, ident,
+                        (key_, weakref.ref(sparse_csr_offset),
+                         weakref.ref(sparse_csr_columns)))
+                except TypeError:
+                    pass  # plain ndarrays/lists may not be weakref-able
+            else:
+                offs_np = cols_np = None
             if key_ in _block_mask_cache:
                 hit = _block_mask_cache[key_]
             else:
+                if offs_np is None:
+                    offs_np = np.asarray(
+                        sparse_csr_offset.numpy()
+                        if hasattr(sparse_csr_offset, "numpy")
+                        else sparse_csr_offset)
+                    cols_np = np.asarray(
+                        sparse_csr_columns.numpy()
+                        if hasattr(sparse_csr_columns, "numpy")
+                        else sparse_csr_columns)
                 hit = None
                 base = _csr_shared_mask(offs_np, cols_np, ql, kl)
                 if base is not None:
@@ -137,7 +180,8 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
                         if bm is not None:
                             hit = (bm, block)
                             break
-                _block_mask_cache[key_] = hit
+                _cache_put(_block_mask_cache, _BLOCK_MASK_CACHE_CAP,
+                           key_, hit)
         except Exception:
             hit = None
     if hit is not None:
@@ -169,5 +213,10 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
             jnp.asarray(d, jnp.float32)).astype(q.dtype)
         logits = jnp.where(mask, logits, -1e30)
         p = jax.nn.softmax(logits, axis=-1)
+        # a row with NO stored entries attends nothing: zero output (the
+        # softmax over the all -1e30 row would fabricate a uniform
+        # average of V) — same convention as the block-sparse kernel and
+        # sparse.nn.functional.attention
+        p = jnp.where(mask.any(-1, keepdims=True), p, 0.0)
         return jnp.einsum("bhqk,bhkd->bhqd", p, v)
     return apply(fn, query, key, value, sparse_csr_offset, sparse_csr_columns)

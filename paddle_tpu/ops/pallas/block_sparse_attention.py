@@ -36,7 +36,8 @@ from paddle_tpu.ops.pallas.flash_attention import (_LOG2E, _LN2, _NEG_INF,
                                                   _LSE_LANES, _compiler_params,
                                                   _pad_to)
 
-__all__ = ["block_sparse_flash_attention", "make_sliding_window_mask",
+__all__ = ["block_sparse_attention", "block_sparse_flash_attention",
+           "prepare_block_mask", "make_sliding_window_mask",
            "make_global_plus_window_mask", "block_mask_tables"]
 
 

@@ -175,3 +175,36 @@ def test_ragged_decode_per_sequence_positions(weights):
     for b in range(B):
         np.testing.assert_allclose(out_ragged.numpy()[b], per_row_out[b],
                                    atol=2e-5, err_msg=f"row {b}")
+
+
+def test_tp_sharded_serving_stack(weights):
+    """The fused stack under tensor parallelism: qkv/ffn weights sharded
+    over an mp mesh via GSPMD (column/row layouts), output must match
+    the unsharded stack — the serving composition
+    HybridParallelInferenceHelper uses."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    want = _run(weights, weights["x"]).numpy()
+
+    # N=4 heads: shard over a 4-device mp mesh
+    mesh = Mesh(np.array(jax.devices()[:4]), ("mp",))
+
+    def shard(arr, spec):
+        return jax.device_put(jnp.asarray(arr), NamedSharding(mesh, spec))
+
+    # Megatron layouts: qkv column-parallel over heads, proj row-parallel,
+    # ffn1 column-, ffn2 row-parallel; norms replicated
+    w = {
+        **weights,
+        "qkvw": [shard(a, P(None, "mp", None, None))
+                 for a in weights["qkvw"]],
+        "qkvb": [shard(a, P(None, "mp", None)) for a in weights["qkvb"]],
+        "lw": [shard(a, P("mp", None)) for a in weights["lw"]],
+        "w1": [shard(a, P(None, "mp")) for a in weights["w1"]],
+        "b1": [shard(a, P("mp")) for a in weights["b1"]],
+        "w2": [shard(a, P("mp", None)) for a in weights["w2"]],
+    }
+    got = _run(w, weights["x"]).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
