@@ -69,6 +69,11 @@ def _cache_put(cache, cap, key, value):
     cache[key] = value
 
 
+def _to_np(x):
+    import numpy as np
+    return np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+
+
 def _csr_shared_mask(offs_np, cols_np, ql, kl):
     """The single [ql, kl] token mask all (b, h) share, or None. Built
     ONCE per pattern (the per-block-size alignment checks below reuse
@@ -129,24 +134,25 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
             import weakref
             ident = (id(sparse_csr_offset), id(sparse_csr_columns),
                      ql, kl)
+            def _ver(t):
+                return getattr(t, "_version", None)
+
             memo = _pattern_identity_memo.get(ident)
             key_ = None
             if memo is not None:
-                # id() can be reused after GC: the memo only counts if
-                # the weakrefs still point at live (hence same) objects
-                k, r1, r2 = memo
+                # id() can be reused after GC, and in-place mutation
+                # (set_value/__setitem__) keeps id but bumps _version:
+                # the memo only counts for the same LIVE objects at the
+                # same versions
+                k, r1, r2, v1, v2 = memo
                 if r1() is sparse_csr_offset and \
-                        r2() is sparse_csr_columns:
+                        r2() is sparse_csr_columns and \
+                        v1 == _ver(sparse_csr_offset) and \
+                        v2 == _ver(sparse_csr_columns):
                     key_ = k
             if key_ is None:
-                offs_np = np.asarray(
-                    sparse_csr_offset.numpy()
-                    if hasattr(sparse_csr_offset, "numpy")
-                    else sparse_csr_offset)
-                cols_np = np.asarray(
-                    sparse_csr_columns.numpy()
-                    if hasattr(sparse_csr_columns, "numpy")
-                    else sparse_csr_columns)
+                offs_np = _to_np(sparse_csr_offset)
+                cols_np = _to_np(sparse_csr_columns)
                 dig = hashlib.sha256()
                 dig.update(offs_np.tobytes())
                 dig.update(cols_np.tobytes())
@@ -155,7 +161,9 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
                     _cache_put(
                         _pattern_identity_memo, _PATTERN_MEMO_CAP, ident,
                         (key_, weakref.ref(sparse_csr_offset),
-                         weakref.ref(sparse_csr_columns)))
+                         weakref.ref(sparse_csr_columns),
+                         _ver(sparse_csr_offset),
+                         _ver(sparse_csr_columns)))
                 except TypeError:
                     pass  # plain ndarrays/lists may not be weakref-able
             else:
@@ -164,20 +172,16 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
                 hit = _block_mask_cache[key_]
             else:
                 if offs_np is None:
-                    offs_np = np.asarray(
-                        sparse_csr_offset.numpy()
-                        if hasattr(sparse_csr_offset, "numpy")
-                        else sparse_csr_offset)
-                    cols_np = np.asarray(
-                        sparse_csr_columns.numpy()
-                        if hasattr(sparse_csr_columns, "numpy")
-                        else sparse_csr_columns)
+                    offs_np = _to_np(sparse_csr_offset)
+                    cols_np = _to_np(sparse_csr_columns)
                 hit = None
                 base = _csr_shared_mask(offs_np, cols_np, ql, kl)
                 if base is not None:
                     for block in (512, 256, 128, 64):
                         bm = _mask_block_aligned(base, ql, kl, block)
-                        if bm is not None:
+                        if bm is not None and bm.any():
+                            # all-empty patterns stay on the dense path
+                            # (defined zero output, no kernel tables)
                             hit = (bm, block)
                             break
                 _cache_put(_block_mask_cache, _BLOCK_MASK_CACHE_CAP,
