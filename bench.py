@@ -144,7 +144,7 @@ def _resnet_extra(on_tpu, dt, iters, batch, train_step, x, y, remat):
     return extra
 
 
-def _bench_bert(on_tpu):
+def _bench_bert(on_tpu, batch_override=None):
     """Second metric: BERT-base masked-LM train step, tokens/sec (seq 512)."""
     import numpy as np
 
@@ -153,7 +153,7 @@ def _bench_bert(on_tpu):
     from paddle_tpu.models.bert import BertConfig, BertForPretraining
 
     if on_tpu:
-        batch, seq, warmup, iters = 16, 512, 2, 8
+        batch, seq, warmup, iters = batch_override or 16, 512, 2, 8
         cfg = BertConfig(dropout=0.0, attention_dropout=0.0)  # bert-base
     else:
         batch, seq, warmup, iters = 2, 128, 1, 2
@@ -294,21 +294,41 @@ def worker_resnet():
     return 0
 
 
-def worker_bert():
-    devices, on_tpu = _init_backend()
-    tok_s, extra = _bench_bert(on_tpu)
+def _bert_line(devices, on_tpu, tok_s, extra, batch):
     # per-phase platform tag: a CPU-fallback BERT number merged next to
     # TPU resnet numbers must stay distinguishable from the top-level
     # "platform" (which describes the headline metric)
     out = {"bert_base_tokens_s": round(tok_s, 2),
-           "bert_platform": devices[0].platform}
+           "bert_platform": devices[0].platform,
+           "bert_batch": batch}
     fpt = extra.pop("_flops_per_token", None)
     out.update(extra)
     if on_tpu and fpt:
         peak = _lookup(_PEAK_TFLOPS,
                        getattr(devices[0], "device_kind", ""), 197.0)
         out["bert_mfu"] = round(tok_s * fpt / (peak * 1e12), 4)
-    print(json.dumps(out))
+    return out
+
+
+def worker_bert():
+    devices, on_tpu = _init_backend()
+    t_start = time.monotonic()
+    tok_s, extra = _bench_bert(on_tpu)
+    # baseline prints immediately (salvageable if the variant wedges);
+    # the CPU fallback runs a reduced config (batch 2, seq 128)
+    print(json.dumps(_bert_line(devices, on_tpu, tok_s, extra,
+                                16 if on_tpu else 2)), flush=True)
+    if on_tpu and os.environ.get("PTPU_TRY_BERT32", "1") != "0" and \
+            time.monotonic() - t_start < BERT_TPU_S * 0.5:
+        # larger batch amortizes the non-attention matmuls better if the
+        # HBM holds it — measure and keep the faster variant
+        try:
+            tok_s2, extra2 = _bench_bert(on_tpu, batch_override=32)
+            if tok_s2 > tok_s:
+                print(json.dumps(_bert_line(devices, on_tpu, tok_s2,
+                                            extra2, 32)), flush=True)
+        except Exception:
+            pass
     return 0
 
 
