@@ -1,0 +1,27 @@
+#!/bin/bash
+# One-shot silicon capture: run the moment a TPU probe succeeds.
+# NEVER kill any of these processes (a killed TPU-claim holder wedges
+# the tunnel for hours) — every step has its own generous timeout-free
+# budget and exits on its own. Total healthy runtime ~15-20 min.
+#
+#   bash tools/run_on_silicon.sh
+#
+# Captures, in order of value:
+#   1. bench.py           -> headline JSON + BENCH_NOTES.md append
+#   2. tests_tpu/         -> 28 compiled-mode kernel tests
+#   3. tools/sweep_flash  -> block sweep + measured-VPU roofline
+set -u
+cd "$(dirname "$0")/.."
+STAMP=$(date -u +%Y%m%d_%H%M%S)
+LOG=silicon_capture_${STAMP}.log
+{
+  echo "=== silicon capture ${STAMP} ==="
+  echo "--- 1. bench.py ---"
+  python bench.py
+  echo "--- 2. tests_tpu ---"
+  python -m pytest tests_tpu/ -q --no-header -p no:cacheprovider
+  echo "--- 3. flash sweep ---"
+  python tools/sweep_flash.py
+  echo "=== capture complete ==="
+} 2>&1 | tee "$LOG"
+echo "log: $LOG (bench JSON + sweep also appended to BENCH_NOTES.md)"
