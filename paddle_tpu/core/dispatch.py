@@ -60,6 +60,13 @@ def apply(fn, *args, **kwargs):
 
     from paddle_tpu.autograd.saved_tensors_hooks import current_hooks
     hooks = current_hooks()
+    if hooks is not None and any(
+            isinstance(v, jax.core.Tracer) for v in vals):
+        # saved_tensors_hooks manage EAGER residency; under a trace
+        # (to_static / jit) the whole step is one XLA program whose
+        # memory is the compiler's / remat's job — and pack hooks that
+        # move to host (t.numpy()) cannot act on tracers anyway
+        hooks = None
     if hooks is None:
         out_val, pull = jax.vjp(closed, [vals[i] for i in diff_idx])
 

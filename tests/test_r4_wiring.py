@@ -303,3 +303,30 @@ class TestFunctionalMinimizers:
             rosen, x0, history_size=10, max_iters=200,
             tolerance_grad=1e-5, tolerance_change=0.0)
         assert np.allclose(res[2].numpy(), np.ones(10), atol=1e-2)
+
+    def test_hooks_yield_to_tracing(self):
+        """saved_tensors_hooks manage EAGER residency; a to_static step
+        inside the context must trace normally (pack cannot act on
+        tracers — memory under jit is remat's job)."""
+        import paddle_tpu.nn.functional as F
+
+        p.seed(0)
+        net = p.nn.Linear(4, 4)
+        opt = p.optimizer.SGD(learning_rate=0.1,
+                              parameters=net.parameters())
+
+        @p.jit.to_static
+        def step(x, y):
+            loss = F.mse_loss(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        x = p.to_tensor(np.ones((2, 4), np.float32))
+        y = p.to_tensor(np.zeros((2, 4), np.float32))
+        with p.autograd.saved_tensors_hooks(
+                lambda t: t.numpy(), lambda pk: p.to_tensor(pk)):
+            l1 = float(step(x, y).numpy())
+            l2 = float(step(x, y).numpy())
+        assert l2 < l1

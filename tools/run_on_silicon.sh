@@ -10,7 +10,7 @@
 #   1. bench.py           -> headline JSON + BENCH_NOTES.md append
 #   2. tests_tpu/         -> 28 compiled-mode kernel tests
 #   3. tools/sweep_flash  -> block sweep + measured-VPU roofline
-set -u
+set -u -o pipefail
 cd "$(dirname "$0")/.."
 STAMP=$(date -u +%Y%m%d_%H%M%S)
 LOG=silicon_capture_${STAMP}.log
