@@ -25,3 +25,9 @@ from paddle_tpu.distributed.collective import (  # noqa: F401
     send,
 )
 from paddle_tpu.distributed.communication import stream  # noqa: F401
+
+# int8-payload gradient sync (EQuARX-class; see PAPERS.md)
+from paddle_tpu.distributed.quantized_collective import (  # noqa: E402,F401
+    quantized_all_reduce_mean,
+    quantized_all_reduce_sum,
+)

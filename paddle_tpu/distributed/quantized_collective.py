@@ -1,0 +1,73 @@
+"""Quantized all-reduce — gradient sync at int8 wire width.
+
+Motivated by EQuARX (Efficient Quantized AllReduce in XLA,
+arXiv:2506.17615, see PAPERS.md): data-parallel gradient all-reduce is
+ICI-bandwidth-bound, and int8 payloads quadruple the effective link
+bandwidth at a bounded quantization error. The reference framework's
+analogue is fleet's fp16/bf16 gradient compression knobs
+(DistributedStrategy fp16_allreduce).
+
+TPU-native rendering (call INSIDE shard_map over the reduce axis):
+1. global per-tensor scale: pmax of the local absmax over the axis —
+   every rank quantizes against the SAME scale, so the integer sum is
+   exact (no per-rank rescaling error);
+2. stochastic rounding (engaged by passing a step-varying `key`, e.g.
+   folded from the training step's RNG) keeps the rounding error
+   unbiased and decorrelated over the trajectory; without a key the
+   rounding is deterministic round-to-nearest (a FIXED key would round
+   each value the same way every step — systematic error with none of
+   the benefit, so that is not a default);
+3. psum runs on int32 (int8 values sum without overflow for any
+   realistic axis size: 127 * n_ranks << 2^31);
+4. dequantize by scale / n is the mean.
+
+The wire format is what XLA's collective sees: an int32 tensor whose
+values fit in 9-ish bits — with EQuARX-class compiler support the
+transfer runs at the narrow width; without it, correctness and the
+API are unchanged (the compiler may still pack). `bits` trades error
+for headroom (8 default).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["quantized_all_reduce_mean", "quantized_all_reduce_sum"]
+
+
+def _quantize(x, scale, qmax, key):
+    xs = x.astype(jnp.float32) / jnp.maximum(scale, 1e-30) * qmax
+    if key is not None:
+        # stochastic rounding: floor + Bernoulli(frac) — unbiased
+        lo = jnp.floor(xs)
+        frac = xs - lo
+        xs = lo + jax.random.bernoulli(key, frac).astype(jnp.float32)
+    else:
+        xs = jnp.round(xs)
+    return jnp.clip(xs, -qmax, qmax).astype(jnp.int32)
+
+
+def quantized_all_reduce_sum(x, axis_name="dp", bits=8, key=None):
+    """Sum `x` over `axis_name` with an int-quantized payload.
+
+    x: local float array (any shape). Returns float32 of x's shape.
+    key: optional PRNG key enabling stochastic rounding — pass a
+    STEP-VARYING key (it is folded with the rank index here) so the
+    rounding error is unbiased over the trajectory.
+    """
+    qmax = float(2 ** (bits - 1) - 1)
+    # one global scale so every rank's integer grid aligns and the
+    # integer psum is EXACT given the quantized inputs
+    scale = lax.pmax(jnp.max(jnp.abs(x.astype(jnp.float32))), axis_name)
+    if key is not None:
+        key = jax.random.fold_in(key, lax.axis_index(axis_name))
+    q = _quantize(x, scale, qmax, key)
+    total = lax.psum(q, axis_name)
+    return total.astype(jnp.float32) * (scale / qmax)
+
+
+def quantized_all_reduce_mean(x, axis_name="dp", bits=8, key=None):
+    """Mean over `axis_name` (the dp gradient-sync op) at int wire width."""
+    n = lax.psum(jnp.ones((), jnp.float32), axis_name)
+    return quantized_all_reduce_sum(x, axis_name, bits, key) / n

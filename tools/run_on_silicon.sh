@@ -24,4 +24,6 @@ LOG=silicon_capture_${STAMP}.log
   python tools/sweep_flash.py
   echo "=== capture complete ==="
 } 2>&1 | tee "$LOG"
+rc=$?
 echo "log: $LOG (bench JSON + sweep also appended to BENCH_NOTES.md)"
+exit $rc
