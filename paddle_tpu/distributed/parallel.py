@@ -14,13 +14,49 @@ from paddle_tpu.core.engine import no_grad
 from paddle_tpu.nn.layer.layers import Layer
 
 
+def _int8_grad_sync(grad, group, ws):
+    """Quantized mean-allreduce of one grad tensor over the collective
+    layer: shared MAX-allreduced scale, int32 SUM, dequant/ws — the
+    eager-path form of quantized_collective.quantized_all_reduce_mean."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.distributed.collective import ReduceOp, all_reduce
+
+    # shares the quantization contract (qmax, clip, scale guard) with
+    # the shard_map-level collective — one definition, two transports
+    from paddle_tpu.distributed.quantized_collective import _quantize
+
+    qmax = 127.0
+    g = grad._value.astype(jnp.float32)
+    smax = Tensor(jnp.max(jnp.abs(g)))
+    all_reduce(smax, op=ReduceOp.MAX, group=group)
+    scale = smax._value
+    q = Tensor(_quantize(g, scale, qmax, None))
+    all_reduce(q, group=group)
+    grad._set_value(
+        (q._value.astype(jnp.float32) * (jnp.maximum(scale, 1e-30)
+                                         / qmax) / ws)
+        .astype(grad._value.dtype))
+    return grad
+
+
 class DataParallel(Layer):
+    """comm_dtype="int8" switches the eager gradient sync to the
+    quantized all-reduce (distributed/quantized_collective.py — one
+    global scale, exact integer summation, int32 wire payload; ~4x
+    effective ICI bandwidth with narrow-wire collective support)."""
+
     def __init__(self, layers, strategy=None, comm_buffer_size=25,
                  last_comm_buffer_size=1, find_unused_parameters=False,
-                 group=None):
+                 group=None, comm_dtype=None):
         super().__init__()
         self._layers = layers
         self.group = group
+        if comm_dtype not in (None, "int8"):
+            raise ValueError(
+                f"comm_dtype must be None or 'int8', got {comm_dtype!r}")
+        self._comm_dtype = comm_dtype
         self.add_sublayer("_layers_holder", layers)
 
     @property
@@ -39,12 +75,18 @@ class DataParallel(Layer):
     @no_grad()
     def apply_collective_grads(self):
         """Average gradients across data-parallel workers (eager path)."""
-        from paddle_tpu.distributed.collective import all_reduce, get_world_size
+        from paddle_tpu.distributed.collective import (ReduceOp,
+                                                       all_reduce,
+                                                       get_world_size)
         ws = get_world_size(self.group)
         if ws <= 1:
             return
         for p in self._inner.parameters():
-            if p.grad is not None:
+            if p.grad is None:
+                continue
+            if self._comm_dtype == "int8":
+                _int8_grad_sync(p.grad, self.group, ws)
+            else:
                 all_reduce(p.grad, group=self.group)
                 p.grad._set_value(p.grad._value / ws)
 

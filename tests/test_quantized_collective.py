@@ -81,3 +81,27 @@ def test_int32_wire_dtype():
     m = re.search(r'all_reduce.{0,600}?tensor<[0-9x]+xi32>', traced,
                   re.S)
     assert m, traced[:2000]
+
+
+def test_dataparallel_int8_sync_inside_shard_map():
+    """DataParallel(comm_dtype='int8')'s eager sync helper: quantized
+    mean over the dp axis matches the fp32 mean within the bound."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.distributed.parallel import _int8_grad_sync
+
+    rng = np.random.default_rng(4)
+    g = rng.standard_normal((8, 16)).astype(np.float32) * 0.01
+
+    def body(v):
+        t = Tensor(v)
+        with dist.collective_axis("dp"):
+            _int8_grad_sync(t, dist.new_group(axis="dp"), 8)
+        return t._value
+
+    out = shard_map(body, mesh=_mesh(), in_specs=P("dp"),
+                    out_specs=P("dp"), check_vma=False)(jnp.asarray(g))
+    want = g.mean(0)
+    got = np.asarray(out)[0]
+    bound = np.abs(g).max() / 127.0 * 1.01
+    assert np.abs(got - want).max() <= bound

@@ -10,20 +10,21 @@
 #   1. bench.py           -> headline JSON + BENCH_NOTES.md append
 #   2. tests_tpu/         -> 28 compiled-mode kernel tests
 #   3. tools/sweep_flash  -> block sweep + measured-VPU roofline
-set -u -o pipefail
+#
+# Exit code: 0 only if every step succeeded (steps still all run).
+set -u
 cd "$(dirname "$0")/.."
 STAMP=$(date -u +%Y%m%d_%H%M%S)
 LOG=silicon_capture_${STAMP}.log
-{
-  echo "=== silicon capture ${STAMP} ==="
-  echo "--- 1. bench.py ---"
-  python bench.py
-  echo "--- 2. tests_tpu ---"
-  python -m pytest tests_tpu/ -q --no-header -p no:cacheprovider
-  echo "--- 3. flash sweep ---"
-  python tools/sweep_flash.py
-  echo "=== capture complete ==="
-} 2>&1 | tee "$LOG"
-rc=$?
+exec > >(tee "$LOG") 2>&1
+rc=0
+echo "=== silicon capture ${STAMP} ==="
+echo "--- 1. bench.py ---"
+python bench.py || rc=1
+echo "--- 2. tests_tpu ---"
+python -m pytest tests_tpu/ -q --no-header -p no:cacheprovider || rc=1
+echo "--- 3. flash sweep ---"
+python tools/sweep_flash.py || rc=1
+echo "=== capture complete (rc=$rc) ==="
 echo "log: $LOG (bench JSON + sweep also appended to BENCH_NOTES.md)"
 exit $rc
