@@ -1,0 +1,156 @@
+"""Paged KV-cache attention — pool-shared decode memory.
+
+Motivated by Ragged Paged Attention (TPU inference kernel,
+arXiv:2604.15464, see PAPERS.md) / vLLM's PagedAttention: instead of a
+dense per-sequence [max_len] KV buffer, KV lives in a SHARED pool of
+fixed-size pages and each sequence owns a small block table of page
+ids. Memory scales with TOKENS IN FLIGHT, not batch * max_len, and
+sequences grow by appending pages — no re-padding, no fragmentation.
+
+TPU-native rendering (pure XLA, static shapes — the Pallas kernel form
+of the paper is a later specialization; the semantics and the memory
+model are here):
+
+- pools:        k/v  [num_pages, n_head, page_size, head_dim]
+- block table:  [batch, max_pages_per_seq] int32 page ids
+- seq lens:     [batch] int32
+
+Decode writes each sequence's new token into page
+``table[b, len_b // page]`` at offset ``len_b % page`` (one scatter),
+then attends over the sequence's gathered pages with a length mask.
+Everything jits; the tape differentiates through the gathers if ever
+needed (serving is no_grad).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import apply, unwrap
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["PagedKVCache", "paged_attention_decode"]
+
+
+class PagedKVCache:
+    """Shared-pool KV cache with per-sequence block tables.
+
+    num_pages * page_size is the total token capacity shared by ALL
+    sequences — size it to tokens-in-flight, not batch * max_len.
+    """
+
+    def __init__(self, num_pages, page_size, num_heads, head_dim,
+                 batch, max_pages_per_seq, dtype=jnp.float32):
+        self.page_size = int(page_size)
+        self.k_pages = Tensor(jnp.zeros(
+            (num_pages, num_heads, page_size, head_dim), dtype))
+        self.v_pages = Tensor(jnp.zeros(
+            (num_pages, num_heads, page_size, head_dim), dtype))
+        self.block_tables = Tensor(jnp.zeros(
+            (batch, max_pages_per_seq), jnp.int32))
+        self.seq_lens = Tensor(jnp.zeros((batch,), jnp.int32))
+        # page 0 is the reserved GARBAGE page: released rows' block
+        # tables point at it, so a batch-wide append from a finished row
+        # scatters into page 0 and can never corrupt a live sequence
+        self._free = list(range(num_pages - 1, 0, -1))
+        self._owned = [[] for _ in range(batch)]
+        self.max_pages_per_seq = int(max_pages_per_seq)
+
+    # ---- host-side page allocator (the serving loop's bookkeeping) ----
+    def ensure_capacity(self, b, new_len):
+        """Allocate pages so sequence `b` can hold `new_len` tokens."""
+        need = -(-int(new_len) // self.page_size)
+        if len(self._owned[b]) >= need:
+            return                      # common case: no transfer at all
+        if need > self.max_pages_per_seq:
+            raise ValueError(
+                f"sequence {b} needs {need} pages but max_pages_per_seq "
+                f"is {self.max_pages_per_seq}")
+        if need - len(self._owned[b]) > len(self._free):
+            raise RuntimeError("paged KV cache: out of pages")
+        tbl = np.array(unwrap(self.block_tables))  # writable host copy
+        while len(self._owned[b]) < need:
+            pg = self._free.pop()
+            slot = len(self._owned[b])
+            self._owned[b].append(pg)
+            tbl[b, slot] = pg
+        self.block_tables._set_value(jnp.asarray(tbl))
+
+    def release(self, b):
+        """Finished sequence: its pages return to the pool; its block
+        table resets to the garbage page so further batch-wide appends
+        from this row are harmlessly absorbed."""
+        self._free.extend(reversed(self._owned[b]))
+        self._owned[b] = []
+        tbl = np.array(unwrap(self.block_tables))
+        tbl[b, :] = 0
+        self.block_tables._set_value(jnp.asarray(tbl))
+        lens = np.asarray(unwrap(self.seq_lens)).copy()
+        lens[b] = 0
+        self.seq_lens._set_value(jnp.asarray(lens))
+
+    def append_and_attend(self, q, k_new, v_new, scale=None):
+        """One decode step for every sequence: write each row's new
+        token at its own position, return attention over its pages.
+
+        q/k_new/v_new: [batch, n_head, 1, head_dim].
+        """
+        out, kp, vp, lens = apply(
+            lambda qv, kv, vv, kpg, vpg, tbl, ln: _paged_step(
+                qv, kv, vv, kpg, vpg, tbl, ln, self.page_size, scale),
+            q, k_new, v_new, self.k_pages, self.v_pages,
+            self.block_tables, self.seq_lens)
+        self.k_pages._set_value(kp._value)
+        self.v_pages._set_value(vp._value)
+        self.seq_lens._set_value(lens._value)
+        return out
+
+
+def _attend_pages(q, k_pages, v_pages, tables, lens, page_size, scale):
+    """Shared attention core: [b, h, 1, d] queries over each row's
+    gathered pages, masked at `lens` — used by both the stateful step
+    and the functional read-only decode."""
+    b, h, one, d = q.shape
+    sc = scale if scale is not None else 1.0 / float(d) ** 0.5
+    k_seq = k_pages[tables]                               # [b, P, h, p, d]
+    v_seq = v_pages[tables]
+    P = tables.shape[1]
+    k_seq = jnp.moveaxis(k_seq, 2, 1).reshape(b, h, P * page_size, d)
+    v_seq = jnp.moveaxis(v_seq, 2, 1).reshape(b, h, P * page_size, d)
+    pos = jnp.arange(P * page_size)
+    mask = pos[None, None, None, :] < lens[:, None, None, None]
+    s = (q * sc) @ jnp.swapaxes(k_seq, -1, -2)            # [b, h, 1, Pp]
+    s = jnp.where(mask, s.astype(jnp.float32),
+                  jnp.finfo(jnp.float32).min)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return p @ v_seq                                      # [b, h, 1, d]
+
+
+def _paged_step(q, k_new, v_new, k_pages, v_pages, tables, lens,
+                page_size, scale):
+    lens = lens.astype(jnp.int32)
+    page_idx = lens // page_size
+    offs = lens % page_size
+    page_ids = jnp.take_along_axis(tables, page_idx[:, None],
+                                   axis=1)[:, 0]          # [b]
+    # scatter each row's token into its page/offset
+    kt = jnp.swapaxes(k_new, 1, 2)[:, 0]                  # [b, h, d]
+    vt = jnp.swapaxes(v_new, 1, 2)[:, 0]
+    k_pages = k_pages.at[page_ids, :, offs].set(kt)
+    v_pages = v_pages.at[page_ids, :, offs].set(vt)
+    new_lens = lens + 1
+    out = _attend_pages(q, k_pages, v_pages, tables, new_lens,
+                        page_size, scale)
+    return out, k_pages, v_pages, new_lens
+
+
+def paged_attention_decode(q, k_pages, v_pages, block_tables, seq_lens,
+                           page_size, scale=None):
+    """Functional read-only form: attention of [b, h, 1, d] queries over
+    already-written pages (positions < seq_lens)."""
+    return apply(
+        lambda qv, kpg, vpg, tbl, ln: _attend_pages(
+            qv, kpg, vpg, tbl, ln, page_size, scale),
+        q, k_pages, v_pages, block_tables, seq_lens)

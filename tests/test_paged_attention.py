@@ -1,0 +1,111 @@
+"""Paged KV-cache attention (incubate/nn/paged_attention.py — pool-
+shared decode memory; see PAPERS.md Ragged Paged Attention)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as p
+from paddle_tpu.incubate.nn.paged_attention import (PagedKVCache,
+                                                    paged_attention_decode)
+
+B, H, D = 3, 2, 8
+PAGE = 4
+
+
+def _dense_attn(q, ks, vs):
+    """Oracle over each row's real keys."""
+    out = np.zeros_like(q)
+    for b in range(q.shape[0]):
+        k = ks[b]  # [h, t, d]
+        s = np.einsum("hod,htd->hot", q[b], k) / np.sqrt(D)
+        e = np.exp(s - s.max(-1, keepdims=True))
+        pm = e / e.sum(-1, keepdims=True)
+        out[b] = np.einsum("hot,htd->hod", pm, vs[b])
+    return out
+
+
+def test_ragged_decode_with_release_and_reuse():
+    """Continuation batching proper: rows finish at different lengths,
+    release their pages, and RESTART as new sequences — lengths diverge
+    (genuinely ragged) and freed pages are recycled across rows; every
+    live row must still match the dense oracle each step."""
+    rng = np.random.default_rng(0)
+    cache = PagedKVCache(num_pages=10, page_size=PAGE, num_heads=H,
+                         head_dim=D, batch=B, max_pages_per_seq=3)
+    lens = [0, 0, 0]
+    hist_k = [[] for _ in range(B)]
+    hist_v = [[] for _ in range(B)]
+    limits = [5, 9, 2]  # row restarts after reaching its limit
+    seen_ragged = False
+    for t in range(12):
+        q = rng.standard_normal((B, H, 1, D)).astype(np.float32)
+        kn = rng.standard_normal((B, H, 1, D)).astype(np.float32)
+        vn = rng.standard_normal((B, H, 1, D)).astype(np.float32)
+        for b in range(B):
+            if lens[b] >= limits[b]:       # finished: release + restart
+                cache.release(b)
+                lens[b] = 0
+                hist_k[b] = []
+                hist_v[b] = []
+            cache.ensure_capacity(b, lens[b] + 1)
+        out = cache.append_and_attend(p.to_tensor(q), p.to_tensor(kn),
+                                      p.to_tensor(vn))
+        for b in range(B):
+            hist_k[b].append(kn[b, :, 0])
+            hist_v[b].append(vn[b, :, 0])
+            lens[b] += 1
+        if len(set(lens)) == B:
+            seen_ragged = True
+        ks = [np.stack(hist_k[b], axis=1) for b in range(B)]
+        vs = [np.stack(hist_v[b], axis=1) for b in range(B)]
+        want = _dense_attn(q, ks, vs)
+        np.testing.assert_allclose(out.numpy(), want, atol=1e-5,
+                                   err_msg=f"step {t} lens={lens}")
+    assert seen_ragged  # the schedule genuinely diverged row lengths
+
+
+def test_pool_sharing_and_release():
+    # 5 pages = 1 reserved garbage page + 4 allocatable
+    cache = PagedKVCache(num_pages=5, page_size=PAGE, num_heads=H,
+                         head_dim=D, batch=2, max_pages_per_seq=3)
+    # row 0 takes 2 pages (8 tokens), row 1 takes 2: pool exhausted
+    cache.ensure_capacity(0, 8)
+    cache.ensure_capacity(1, 8)
+    with pytest.raises(RuntimeError, match="out of pages"):
+        cache.ensure_capacity(0, 12)
+    with pytest.raises(ValueError, match="max_pages_per_seq"):
+        cache.ensure_capacity(0, 100)
+    # releasing row 0 returns its pages for reuse
+    cache.release(0)
+    cache.ensure_capacity(1, 8)   # no-op, already sized
+    cache.ensure_capacity(0, 4)   # reallocates from freed pages
+    assert np.asarray(cache.block_tables.numpy())[0, 0] != 0
+
+
+def test_functional_read_only_decode():
+    rng = np.random.default_rng(1)
+    cache = PagedKVCache(num_pages=6, page_size=PAGE, num_heads=H,
+                         head_dim=D, batch=B, max_pages_per_seq=2)
+    # write 3 tokens per row through the stateful API
+    hist_k = [[] for _ in range(B)]
+    hist_v = [[] for _ in range(B)]
+    for t in range(3):
+        q = rng.standard_normal((B, H, 1, D)).astype(np.float32)
+        kn = rng.standard_normal((B, H, 1, D)).astype(np.float32)
+        vn = rng.standard_normal((B, H, 1, D)).astype(np.float32)
+        for b in range(B):
+            cache.ensure_capacity(b, t + 1)
+        cache.append_and_attend(p.to_tensor(q), p.to_tensor(kn),
+                                p.to_tensor(vn))
+        for b in range(B):
+            hist_k[b].append(kn[b, :, 0])
+            hist_v[b].append(vn[b, :, 0])
+    q = rng.standard_normal((B, H, 1, D)).astype(np.float32)
+    out = paged_attention_decode(
+        p.to_tensor(q), cache.k_pages, cache.v_pages, cache.block_tables,
+        cache.seq_lens, PAGE)
+    ks = [np.stack(hist_k[b], axis=1) for b in range(B)]
+    vs = [np.stack(hist_v[b], axis=1) for b in range(B)]
+    np.testing.assert_allclose(out.numpy(), _dense_attn(q, ks, vs),
+                               atol=1e-5)
