@@ -120,6 +120,16 @@ def apply(fn, *args, **kwargs):
     # offloaded activation genuinely frees its device buffer
     weak = hooks is not None
     if isinstance(out_val, tuple):
+        # the engine hands a SINGLE-output node its cotangent as a bare
+        # leaf, but `closed` returned a tuple here — normalize so a
+        # 1-element tuple output (e.g. recompute's outs+buffers packing)
+        # round-trips through the vjp with matching structure
+        inner_pullback = pullback
+
+        def pullback(cot):  # noqa: F811
+            return inner_pullback(
+                cot if isinstance(cot, tuple) else (cot,))
+
         outs = tuple(Tensor(o, stop_gradient=False) for o in out_val)
         node = engine.Node(in_tensors, outs, pullback,
                            name=getattr(fn, "__name__", "op"),

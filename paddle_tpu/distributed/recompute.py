@@ -31,6 +31,14 @@ def recompute(function, *args, **kwargs):
     layer = _owner_layer(function)
     params = list(layer.parameters()) if layer is not None else []
     buffers = list(layer.buffers()) if layer is not None else []
+    # the global RNG key threads through like a buffer: stochastic ops
+    # (dropout) inside the checkpointed region draw sub-trace keys, and
+    # (a) the advanced key must ESCAPE as a checkpoint output (a bare
+    # mutation would leak a sub-trace tracer into the ambient state),
+    # (b) the backward rematerialization re-enters with the SAME key, so
+    # the recomputed dropout mask matches the forward's exactly
+    from paddle_tpu.framework.state import _key_tensor
+    buffers = buffers + [_key_tensor()]
     state = params + buffers
     n_args = len(args)
     arg_is_tensor = [isinstance(a, Tensor) for a in args]
