@@ -115,7 +115,14 @@ class StaticFunction:
                     leaves.append(Tensor(next(ti)) if s is _ARRAY else s)
                 args, kwargs = _tree.tree_unflatten(in_treedef, leaves)
                 out = fn(*args, **kwargs)
+                from paddle_tpu.jit.dy2static import UNDEF as _UNDEF
                 out_leaves, out_treedef = _tree.tree_flatten(out, is_leaf=_is_tensor)
+                if any(o is _UNDEF for o in out_leaves):
+                    raise ValueError(
+                        "to_static: the function returned a variable "
+                        "bound in only one branch of a tensor-valued "
+                        "`if` (unrepresentable under a trace) — bind it "
+                        "on every path")
                 out_vals = [o._value if isinstance(o, Tensor) else o
                             for o in out_leaves]
                 out_static = [_ARRAY if isinstance(o, (Tensor, jax.Array))

@@ -104,6 +104,10 @@ class _Undefined:
     __truediv__ = __rtruediv__ = __matmul__ = __rmatmul__ = _raise
     __lt__ = __le__ = __gt__ = __ge__ = _raise
     __neg__ = __abs__ = __float__ = __int__ = __index__ = _raise
+    # equality / formatting / hashing are reads too: `status == 'done'`
+    # or f"{status}" on a poisoned variable must raise, not silently
+    # take the wrong path (repr stays printable for debugging)
+    __eq__ = __ne__ = __str__ = __format__ = __hash__ = _raise
 
 
 UNDEF = _Undefined()
@@ -147,10 +151,11 @@ def _select_var(pred, t, f):
     if t is f:
         return t
     if t is UNDEF or f is UNDEF:
-        raise ValueError(
-            "dy2static: a variable is assigned in only one branch of a "
-            "tensor-valued `if`; under a trace both branches must bind it "
-            "(the select-based lowering needs a value from each side)")
+        # bound on only one side: a maybe-bound value is not
+        # representable under a trace, so the merge POISONS the name —
+        # dead temporaries (loop targets etc.) pass through silently,
+        # and any actual READ raises the sentinel's NameError
+        return UNDEF
     if isinstance(t, Tensor) or isinstance(f, Tensor):
         # through dispatch.apply so the select is a TAPE op — gradients
         # flow into both branches' subgraphs (d/dt where(p,t,f) masks the
@@ -549,13 +554,15 @@ def _contains(stmts, kinds, stop_at_loops=False):
         visit_Lambda = visit_FunctionDef
         visit_ClassDef = visit_FunctionDef
 
-        def visit_While(self, node):
+        def _visit_loop(self, node, header_fields):
             if stop_at_loops:
-                # its test/body own their break/continue
-                self.visit(node.test)
+                # the nested loop's body owns its break/continue; only
+                # its header expressions and orelse are OUR scope
+                for f in header_fields:
+                    self.visit(getattr(node, f))
                 for s in node.orelse:
                     self.visit(s)
-                if any(kind in (ast.Return,) for kind in kinds):
+                if any(kind is ast.Return for kind in kinds):
                     for s in node.body:  # returns still escape nested loops
                         for n in ast.walk(s):
                             if isinstance(n, ast.Return):
@@ -563,7 +570,11 @@ def _contains(stmts, kinds, stop_at_loops=False):
             else:
                 self.generic_visit(node)
 
-        visit_For = visit_While
+        def visit_While(self, node):
+            self._visit_loop(node, ("test",))
+
+        def visit_For(self, node):
+            self._visit_loop(node, ("target", "iter"))
 
         def generic_visit(self, node):
             if isinstance(node, kinds):
@@ -843,23 +854,35 @@ class _Transformer(ast.NodeTransformer):
             self.generic_visit(node)
             return node
         i = self._next()
-        r = f"{_GEN_PREFIX}r_{i}"
+        # generated VARIABLES use a non-helper prefix so the while
+        # transformer treats them as ordinary locals (the counter must
+        # be a loop CARRY; helper-def names stay excluded via
+        # _GEN_PREFIX). The counter is hidden — the loop body may freely
+        # clobber the user target (Python's `for` iterator state is
+        # independent of the target binding; nested fors reusing one
+        # target name were miscounting when the target WAS the state)
+        ctr = f"_d2s_v_i_{i}"
+        stop = f"_d2s_v_stop_{i}"
+        step = f"_d2s_v_step_{i}"
         tgt = node.target.id
         setup = ast.Assign(
-            targets=[ast.Tuple(elts=[_nm(tgt, ast.Store()),
-                                     _nm(f"{r}_stop", ast.Store()),
-                                     _nm(f"{r}_step", ast.Store())],
+            targets=[ast.Tuple(elts=[_nm(ctr, ast.Store()),
+                                     _nm(stop, ast.Store()),
+                                     _nm(step, ast.Store())],
                                ctx=ast.Store())],
             value=ast.Call(func=_ptd2s_attr("make_range"),
                            args=list(it.args), keywords=[]))
         test = ast.Call(func=_ptd2s_attr("range_cond"),
-                        args=[_nm(tgt), _nm(f"{r}_stop"),
-                              _nm(f"{r}_step")], keywords=[])
-        inc = ast.Assign(targets=[_nm(tgt, ast.Store())],
-                         value=ast.BinOp(left=_nm(tgt), op=ast.Add(),
-                                         right=_nm(f"{r}_step")))
-        loop = ast.While(test=test, body=node.body + [inc], orelse=[])
-        self.bound.update({tgt, f"{r}_stop", f"{r}_step"})
+                        args=[_nm(ctr), _nm(stop), _nm(step)],
+                        keywords=[])
+        bind_tgt = ast.Assign(targets=[_nm(tgt, ast.Store())],
+                              value=_nm(ctr))
+        inc = ast.Assign(targets=[_nm(ctr, ast.Store())],
+                         value=ast.BinOp(left=_nm(ctr), op=ast.Add(),
+                                         right=_nm(step)))
+        loop = ast.While(test=test, body=[bind_tgt] + node.body + [inc],
+                         orelse=[])
+        self.bound.update({tgt, ctr, stop, step})
         self.changed = True
         out = self.visit_While(loop)
         return [setup] + (out if isinstance(out, list) else [out])
