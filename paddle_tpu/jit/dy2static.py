@@ -39,6 +39,13 @@ unavailable, or any construct outside the supported subset
 (`break`/`continue`/early-`return` inside a converted branch), is left
 as plain Python — correct eagerly, and a tensor-valued condition there
 still raises the usual concretization error pointing here.
+
+Known dark corner: a variable bound in only ONE branch of a tensor-`if`
+merges to a poison sentinel — every ordinary read (arithmetic,
+comparison by value, bool, str/format, hash, call, index) raises
+NameError, but Python's `is` operator cannot be intercepted, so
+`maybe_bound is None` silently evaluates False instead of raising.
+Bind the variable on every path when its identity is tested.
 """
 from __future__ import annotations
 

@@ -67,12 +67,18 @@ def _make_program(seed):
 def test_generated_program_eager_vs_compiled(seed):
     prog, src = _make_program(seed)
     rng = np.random.default_rng(seed + 1000)
-    for trial in range(3):
+    compiled = p.jit.to_static(prog)             # one conversion+compile;
+    for trial in range(3):                       # trials hit the cache
         x = rng.standard_normal(4).astype(np.float32)
         want = prog(p.to_tensor(x)).numpy()      # eager: python control flow
-        compiled = p.jit.to_static(prog)
         got = compiled(p.to_tensor(x)).numpy()   # converted + compiled
         assert np.isfinite(want).all(), f"program diverged:\n{src}"
         np.testing.assert_allclose(
             got, want, rtol=1e-5, atol=1e-5,
             err_msg=f"seed {seed} trial {trial}\n{src}")
+
+
+@pytest.mark.nightly  # broader sweep of the same property
+@pytest.mark.parametrize("seed", list(range(16, 32)))
+def test_generated_program_eager_vs_compiled_nightly(seed):
+    test_generated_program_eager_vs_compiled(seed)

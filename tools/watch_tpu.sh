@@ -1,0 +1,28 @@
+#!/bin/bash
+# Autonomous TPU watcher: probe -> on success run the one-shot capture.
+# Leave running detached; it never kills anything (wedge rule), probes
+# SEQUENTIALLY (one python at a time), and exits after a successful
+# capture or --max-cycles attempts.
+#
+#   nohup bash tools/watch_tpu.sh [max_cycles] > watch_tpu.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+MAX=${1:-24}
+for ((i = 1; i <= MAX; i++)); do
+  echo "[watch_tpu] cycle $i/$MAX $(date -u +%H:%M:%S)"
+  python tools/tpu_probe.py > .tpu_probe_r4.json 2> .tpu_probe_r4.err
+  if grep -q '"ok": true' .tpu_probe_r4.json 2>/dev/null; then
+    echo "[watch_tpu] TPU ALIVE — running silicon capture"
+    bash tools/run_on_silicon.sh
+    rc=$?
+    if [ "$rc" -eq 0 ]; then
+      echo "[watch_tpu] capture complete; exiting"
+      exit 0
+    fi
+    echo "[watch_tpu] capture rc=$rc (transient wedge?); keep watching"
+  fi
+  echo "[watch_tpu] probe: $(head -c 120 .tpu_probe_r4.json)"
+  sleep 60   # probes self-throttle (~25 min each on a dead backend)
+done
+echo "[watch_tpu] gave up after $MAX cycles"
+exit 1
