@@ -403,6 +403,11 @@ def _embed_fn(ids, num_microbatches, explicit_bwd):
     positions via the sp shard index, reshaped into the [M, mb, s_loc, H]
     microbatch stream the pipeline consumes."""
     b_loc, s_loc = ids.shape
+    if b_loc % num_microbatches:
+        raise ValueError(
+            f"per-dp-shard batch {b_loc} must divide by num_microbatches "
+            f"{num_microbatches} (a zero-sized microbatch otherwise "
+            "surfaces as an opaque reshape error)")
     pos = lax.axis_index("sp") * s_loc + jnp.arange(s_loc)
 
     def embed(wte, wpe):

@@ -14,7 +14,8 @@ import paddle_tpu as p
 from paddle_tpu.jit.dy2static import convert_to_static
 
 _OPS = ["y = y * 1.5 + 0.1", "y = y - 0.3", "y = (y * y) * 0.1",
-        "y = y / 2.0 + x", "y = y + x * 0.5"]
+        "y = y / 2.0 + x", "y = y + x * 0.5", "y = _helper(y)",
+        "y = y + _helper(x)"]
 _CONDS = ["y.sum() > {t}", "y.mean() > {t}", "y.max() < {t}",
           "(y.sum() > {t}) and (y.max() < 50.0)",
           "(y.min() > {t}) or (y.sum() > 0)"]
@@ -49,7 +50,13 @@ def _gen_block(rng, depth, lines, indent):
 
 def _make_program(seed):
     rng = np.random.default_rng(seed)
-    lines = ["def prog(x):", "    y = x * 1.0"]
+    lines = ["def _helper(v):",
+             "    if v.mean() > 0.2:",
+             "        return v * 0.9",
+             "    else:",
+             "        return v * 1.1",
+             "",
+             "def prog(x):", "    y = x * 1.0"]
     _gen_block(rng, 2, lines, 1)
     lines.append("    return y")
     src = "\n".join(lines) + "\n"
