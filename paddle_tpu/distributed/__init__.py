@@ -82,9 +82,22 @@ def init_parallel_env(coordinator_address=None, num_processes=None,
     """Reference: python/paddle/distributed/parallel.py init_parallel_env
     (NCCL bootstrap). TPU-native: jax.distributed.initialize for multi-host
     (DCN coordination), then install the global mesh over all devices."""
+    import os
+
     import jax
     if _parallel_env_initialized[0]:
         return
+    # no-arg call inside a launched worker: pick up the bootstrap env the
+    # launcher (launch.py / utils.start_local_trainers) exported
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("PADDLE_MASTER")
+    if num_processes is None:
+        v = os.environ.get("PADDLE_TRAINERS_NUM",
+                           os.environ.get("PADDLE_NNODES"))
+        num_processes = int(v) if v else None
+    if process_id is None:
+        v = os.environ.get("PADDLE_TRAINER_ID")
+        process_id = int(v) if v else None
     if coordinator_address is not None or num_processes not in (None, 1):
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,

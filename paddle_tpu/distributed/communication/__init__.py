@@ -8,6 +8,8 @@ from paddle_tpu.distributed.collective import (  # noqa: F401
     Group,
     P2POp,
     ReduceOp,
+    destroy_process_group,
+    get_group,
     all_gather,
     all_reduce,
     all_to_all_single,
@@ -31,3 +33,10 @@ from paddle_tpu.distributed.quantized_collective import (  # noqa: E402,F401
     quantized_all_reduce_mean,
     quantized_all_reduce_sum,
 )
+
+
+def is_initialized():
+    """Reference: distributed/communication/group.py:132 (lazy import —
+    the flag lives on the distributed package root)."""
+    import paddle_tpu.distributed as dist
+    return dist.is_initialized()

@@ -26,6 +26,10 @@ __all__ = [
     "p2p_shift", "recv_forward", "recv_backward", "send_forward",
     "send_backward", "send_forward_recv_backward",
     "send_backward_recv_forward", "get_tensor_bytes", "is_float_tensor",
+    "SendRecvMeta", "initialize_p2p_groups", "allgather_partial",
+    "send_partial", "recv_partial", "send_forward_recv_forward",
+    "send_backward_recv_backward",
+    "send_forward_backward_recv_forward_backward",
 ]
 
 
@@ -68,6 +72,89 @@ def send_backward_recv_forward(cotangent, activation, axis_name="pp",
                                axis_size=None):
     return (p2p_shift(cotangent, -1, axis_name, axis_size),
             p2p_shift(activation, +1, axis_name, axis_size))
+
+
+def send_forward_recv_forward(tensor, axis_name="pp", axis_size=None):
+    """Interleave steady state: relay — the activation moves one stage
+    ahead while this stage receives the previous stage's (one ppermute:
+    both halves of the reference pair are the same collective)."""
+    return p2p_shift(tensor, +1, axis_name, axis_size)
+
+
+def send_backward_recv_backward(tensor, axis_name="pp", axis_size=None):
+    return p2p_shift(tensor, -1, axis_name, axis_size)
+
+
+def send_forward_backward_recv_forward_backward(
+        activation, cotangent, axis_name="pp", axis_size=None):
+    """Both relays of the interleaved steady state in one call
+    (reference p2p_communication.py's fused four-way op)."""
+    return (p2p_shift(activation, +1, axis_name, axis_size),
+            p2p_shift(cotangent, -1, axis_name, axis_size))
+
+
+class SendRecvMeta:
+    """Shape/dtype metadata the reference exchanges before dynamic-shape
+    p2p (p2p_communication.py SendRecvMeta). XLA p2p is static-shape, so
+    the meta is captured at trace time and never hits the wire."""
+
+    def __init__(self):
+        self.send_shape_message = None
+        self.send_dtype_message = None
+        self.recv_shape_message = None
+        self.recv_dtype_message = None
+
+    def set_send_message(self, tensor):
+        v = getattr(tensor, "_value", tensor)
+        self.send_shape_message = tuple(v.shape)
+        self.send_dtype_message = str(v.dtype)
+
+    def recv_meta(self, tensor):
+        v = getattr(tensor, "_value", tensor)
+        self.recv_shape_message = tuple(v.shape)
+        self.recv_dtype_message = str(v.dtype)
+
+
+def initialize_p2p_groups(hcg=None, *a, **kw):
+    """NCCL p2p group setup in the reference; the mesh owns comms here —
+    validate a pp axis exists and return it."""
+    m = mesh_mod.get_mesh()
+    if m is not None and "pp" not in m.axis_names:
+        raise ValueError(f"mesh {m.axis_names} has no 'pp' axis")
+    return m
+
+
+def _mp_slice(tensor, axis_name="mp"):
+    """This rank's 1/mp slice of a flattened tensor (pad-free only when
+    divisible — reference send_partial has the same restriction)."""
+    import jax
+    import jax.numpy as jnp
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    flat = jnp.ravel(tensor)
+    if flat.shape[0] % n:
+        raise ValueError(f"numel {flat.shape[0]} not divisible by {n}")
+    k = flat.shape[0] // n
+    return jax.lax.dynamic_slice(flat, (idx * k,), (k,))
+
+
+def send_partial(tensor, direction=+1, axis_name="pp", mp_axis="mp",
+                 axis_size=None):
+    """Reference send_partial: ship only this mp-rank's 1/mp slice over
+    the pp hop (cuts wire bytes mp-fold); pair with allgather_partial."""
+    return p2p_shift(_mp_slice(tensor, mp_axis), direction, axis_name,
+                     axis_size)
+
+
+recv_partial = send_partial  # one collective per matched pair
+
+
+def allgather_partial(part, mp_axis="mp", shape=None):
+    """Reassemble a send_partial slice: all_gather over the mp axis,
+    then restore the original shape."""
+    import jax.numpy as jnp
+    full = lax.all_gather(part, mp_axis, tiled=True)
+    return full if shape is None else jnp.reshape(full, shape)
 
 
 def get_tensor_bytes(tensor):

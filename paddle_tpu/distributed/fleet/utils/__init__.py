@@ -15,6 +15,11 @@ from paddle_tpu.distributed.fleet.utils.hybrid_parallel_inference import (  # no
     DistributedInfer,
     HybridParallelInferenceHelper,
 )
+from paddle_tpu.distributed.fleet.utils.internal_storage import (  # noqa: F401
+    GradStorage,
+    InternalStorage,
+    ParamStorage,
+)
 
 
 def get_log_level_code():
@@ -30,3 +35,11 @@ def get_log_level_name():
 def set_log_level(level):
     import logging
     logging.getLogger("FLEET").setLevel(level)
+
+
+def layer_to_str(base, *args, **kwargs):
+    """Reference: fleet/utils/log_util.py:63 — repr helper used by the
+    hybrid-parallel layer descriptors."""
+    parts = [str(a) for a in args]
+    parts += [f"{k}={v}" for k, v in kwargs.items()]
+    return f"{base}({', '.join(parts)})"

@@ -1,0 +1,174 @@
+"""Flat fused storages (reference: fleet/utils/internal_storage.py:33
+InternalStorage / :94 ParamStorage / :214 GradStorage, and their
+meta_parallel/sharding/group_sharded_storage.py twins).
+
+The reference packs many small parameters/gradients into one contiguous
+torch buffer and re-points each tensor at a *view*, so NCCL moves one
+large message instead of many small ones.  XLA arrays are immutable —
+aliasing views is impossible — so here the storage keeps an explicit
+offset map and provides pack/unpack both ways: `sync_buffer()` gathers
+the current param/grad values into the flat buffer, `sync_views()`
+scatters the flat buffer back onto the tensors.  One fused
+`all_reduce(storage.buffer)` then has exactly the reference's wire
+behavior (single large message over the dp axis).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["InternalStorage", "ParamStorage", "GradStorage"]
+
+
+def _numel(shape):
+    return int(np.prod(shape)) if len(shape) else 1
+
+
+class InternalStorage:
+    """One flat device buffer of `size` elements of `dtype`."""
+
+    def __init__(self, size, dtype, device=None, convert_cpu=False):
+        self._size = int(size)
+        self._dtype = dtype
+        self._device = device or "tpu"
+        self.buffer = jnp.zeros((self._size,), dtype=dtype)
+        self._fill = 0
+        # tensor -> (offset, numel, shape); insertion-ordered
+        self._slots = []
+
+    @property
+    def size(self):
+        return self._size
+
+    def to(self, device, dtype=None, keep_alignment=True):
+        if dtype is not None and dtype != self._dtype:
+            self.buffer = self.buffer.astype(dtype)
+            self._dtype = dtype
+        self._device = device
+        return self
+
+    # -- packing ----------------------------------------------------------
+    def _reserve(self, tensor, align=0):
+        n = _numel(tensor.shape)
+        if self._fill + n + align > self._size:
+            raise ValueError(
+                f"storage full: need {n + align} at {self._fill} of "
+                f"{self._size}")
+        off = self._fill
+        self._fill += n + align
+        self._slots.append((tensor, off, n, tuple(tensor.shape)))
+        return off
+
+    def _write(self, off, n, value):
+        self.buffer = self.buffer.at[off:off + n].set(
+            jnp.ravel(value).astype(self._dtype))
+
+    def _pack(self, value_of):
+        """Rebuild the whole buffer in ONE concatenate (O(N)) — a
+        per-slot .at[].set would copy the full immutable buffer once per
+        param (O(P*N) on the per-step gradient path).  Alignment gaps and
+        the unreserved tail are zero-filled."""
+        parts, pos = [], 0
+        for t, off, n, _ in self._slots:
+            if off > pos:
+                parts.append(jnp.zeros((off - pos,), self._dtype))
+            v = value_of(t)
+            parts.append(jnp.zeros((n,), self._dtype) if v is None
+                         else jnp.ravel(v).astype(self._dtype))
+            pos = off + n
+        if pos < self._size:
+            parts.append(jnp.zeros((self._size - pos,), self._dtype))
+        if parts:
+            self.buffer = jnp.concatenate(parts)
+
+    def sync_views(self):
+        """Scatter the flat buffer back onto every registered tensor."""
+        for t, off, n, shape in self._slots:
+            t._set_value(self.buffer[off:off + n].reshape(shape)
+                         .astype(t._value.dtype))
+
+
+class ParamStorage(InternalStorage):
+    """Packs trainable parameters into the flat buffer (reference
+    internal_storage.py:94; add_rank_params keeps paddle's signature)."""
+
+    def __init__(self, size, dtype, device=None):
+        super().__init__(size, dtype, device)
+        self.param2align = {}
+
+    def add_rank_params(self, trainable_params, param2align=None,
+                        convert_gpu=False):
+        param2align = param2align or {}
+        for p in trainable_params:
+            align = int(param2align.get(getattr(p, "name", ""), 0))
+            self._reserve(p, align)
+            self.param2align[getattr(p, "name", str(id(p)))] = align
+        self.sync_buffer()
+
+    def sync_buffer(self):
+        """Gather current parameter values into the flat buffer (the
+        reference's views make this implicit; explicit under XLA)."""
+        self._pack(lambda p: p._value)
+
+
+class GradStorage(InternalStorage):
+    """Accumulates many parameters' grads into one flat buffer so the
+    dp-axis sync is a single fused message (reference
+    internal_storage.py:214; check-in bookkeeping preserved)."""
+
+    def __init__(self, size, dtype, device=None, destination=None,
+                 parm2align=None, convert_cpu=False):
+        super().__init__(size, dtype, device)
+        self._max_size = self._size
+        self._release = False
+        self.params_checked_in = 0
+        self.destination = destination
+        self._parm2align = parm2align or {}
+
+    def reset_checked_in(self):
+        self.params_checked_in = 0
+
+    @property
+    def all_checked_in(self):
+        return len(self._slots) == self.params_checked_in
+
+    def can_add_grad_view(self, param, align=0):
+        return (self._fill + _numel(param.shape) + align <= self._size
+                and not any(t is param for t, *_ in self._slots))
+
+    def add_grad(self, param, align=0):
+        self._reserve(param, align)
+
+    def sync_buffer(self):
+        """Gather every registered param's .grad into the flat buffer;
+        missing grads contribute zeros."""
+        def grad_of(p):
+            g = getattr(p, "grad", None)
+            if g is None:
+                return None
+            return g._value if hasattr(g, "_value") else g
+        self._pack(grad_of)
+        self.params_checked_in = len(self._slots)
+
+    def sync_grads(self):
+        """Scatter the (e.g. all-reduced) flat buffer back into .grad."""
+        from paddle_tpu.core.tensor import Tensor
+        for p, off, n, shape in self._slots:
+            val = self.buffer[off:off + n].reshape(shape)
+            if p.grad is not None:
+                p.grad._set_value(val.astype(p.grad._value.dtype))
+            else:
+                p.grad = Tensor(val.astype(p._value.dtype),
+                                stop_gradient=True,
+                                name=getattr(p, "name", "param") + "@GRAD")
+
+    def manumal_relase(self):  # sic — reference spells it this way
+        if not self._release:
+            self.buffer = jnp.zeros((0,), dtype=self._dtype)
+            self._release = True
+
+    def rebuild(self):
+        if self._release:
+            self.buffer = jnp.zeros((self._size,), dtype=self._dtype)
+            self.sync_buffer()
+            self._release = False

@@ -11,7 +11,11 @@ from .launch_utils import (  # noqa: F401
     find_free_ports,
     get_cluster,
     get_cluster_from_args,
+    get_gpus,
     get_host_name_ip,
     get_logger,
+    pull_worker_log,
+    start_local_trainers,
     terminate_local_procs,
+    watch_local_trainers,
 )

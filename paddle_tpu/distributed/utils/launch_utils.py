@@ -256,3 +256,119 @@ def get_logger(log_level=None, name="FLEET"):
     if log_level is not None:
         logger.setLevel(log_level)
     return logger
+
+
+def get_gpus(selected_gpus):
+    """Reference launch_utils.py:66 parses selected_gpus against
+    CUDA_VISIBLE_DEVICES; the TPU analogue resolves device indices
+    against TPU_VISIBLE_CHIPS (or the full local device list)."""
+    visible = os.environ.get("TPU_VISIBLE_CHIPS")
+    if visible is None:
+        visible = os.environ.get("CUDA_VISIBLE_DEVICES")
+    # "" is an explicit ZERO-device set, distinct from unset (None)
+    vis = None if visible is None else \
+        [int(x) for x in visible.split(",") if x.strip() != ""]
+    if selected_gpus is None:
+        # relative (local) indices in BOTH branches — same index space
+        # as the selected_gpus path below (reference returns
+        # range(device_count) here)
+        if vis is not None:
+            return list(range(len(vis)))
+        import jax
+        return list(range(jax.local_device_count()))
+    want = [int(x) for x in str(selected_gpus).split(",")]
+    if vis is None:
+        return want
+    for w in want:
+        if w not in vis:
+            raise ValueError(
+                f"selected device {w} not in visible set {vis}")
+    # reference remaps to position within the visible list
+    return [vis.index(w) for w in want]
+
+
+def start_local_trainers(cluster, pod, training_script,
+                         training_script_args, log_dir=None):
+    """Spawn one worker process per local trainer with the jax.distributed
+    bootstrap env (reference :467 sets the NCCL/gloo endpoints; here the
+    coordinator/rank/world-size variables distributed.init_parallel_env
+    reads)."""
+    import subprocess
+    import sys
+    base_env = dict(os.environ)
+    base_env.pop("http_proxy", None)
+    base_env.pop("https_proxy", None)
+    coordinator = cluster.pods_endpoints()[0]
+    world = len(cluster.trainers_endpoints())
+    procs = []
+    for idx, t in enumerate(pod.trainers):
+        env = dict(base_env)
+        env.update({
+            # read by distributed.init_parallel_env()'s no-arg fallback
+            # and launch.py's _from_env — this is the live bootstrap path
+            "PADDLE_MASTER": coordinator,
+            "PADDLE_NNODES": str(world),
+            "PADDLE_TRAINER_ID": str(t.rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_CURRENT_ENDPOINT": t.endpoint,
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(
+                cluster.trainers_endpoints()),
+            # honored by jax.distributed.initialize() autodetect
+            "JAX_COORDINATOR_ADDRESS": coordinator,
+        })
+        cmd = [sys.executable, "-u", training_script] + list(
+            training_script_args or [])
+        fn = None
+        if log_dir is not None:
+            os.makedirs(log_dir, exist_ok=True)
+            fn = open(os.path.join(log_dir, f"workerlog.{idx}"), "a")
+            proc = subprocess.Popen(cmd, env=env, stdout=fn, stderr=fn)
+        else:
+            proc = subprocess.Popen(cmd, env=env)
+        tp = TrainerProc()
+        tp.proc = proc
+        tp.rank = t.rank
+        tp.local_rank = idx
+        tp.log_fn = fn
+        tp.log_offset = fn.tell() if fn else None
+        tp.cmd = cmd
+        procs.append(tp)
+    return procs
+
+
+def pull_worker_log(tp):
+    """Stream new lines from a trainer's log file (reference :510)."""
+    import sys
+    if not tp.log_fn:
+        return
+    # errors="replace": a worker emitting non-UTF-8 bytes (progress bars,
+    # locale output) must not crash the watch loop with UnicodeDecodeError
+    with open(tp.log_fn.name, "r", errors="replace") as fin:
+        fin.seek(tp.log_offset or 0, 0)
+        for line in fin:
+            try:
+                sys.stdout.write(line)
+            except UnicodeEncodeError:
+                sys.stdout.write(f"<unwritable line; see {tp.log_fn.name}>\n")
+        tp.log_offset = fin.tell()
+
+
+def watch_local_trainers(procs, nranks):
+    """Poll trainers: stream rank-0's log, kill the job on any nonzero
+    exit, return whether any are still alive (reference :526)."""
+    error, error_rank, alive = False, [], False
+    for p in procs:
+        if p.log_fn and p.local_rank == 0:
+            pull_worker_log(p)
+        ret = p.proc.poll()
+        if ret is None:
+            alive = True
+        elif ret != 0:
+            error = True
+            error_rank.append(p.rank)
+    if error:
+        terminate_local_procs(procs)
+        raise RuntimeError(
+            f"local trainer ranks {error_rank} exited nonzero; job "
+            "terminated")
+    return alive

@@ -12,6 +12,11 @@ import jax.numpy as jnp
 
 from paddle_tpu.core.dispatch import apply
 
+from paddle_tpu.incubate.operators.resnet_unit import (  # noqa: F401
+    ResNetUnit,
+    resnet_unit,
+)
+
 __all__ = [
     "softmax_mask_fuse",
     "softmax_mask_fuse_upper_triangle",
@@ -19,6 +24,8 @@ __all__ = [
     "graph_khop_sampler",
     "graph_sample_neighbors",
     "graph_reindex",
+    "resnet_unit",
+    "ResNetUnit",
 ]
 
 

@@ -5,5 +5,11 @@ Reference: python/paddle/incubate/optimizer/functional/__init__.py
 """
 from paddle_tpu.incubate.optimizer.functional.bfgs import minimize_bfgs  # noqa: F401
 from paddle_tpu.incubate.optimizer.functional.lbfgs import minimize_lbfgs  # noqa: F401
+from paddle_tpu.incubate.optimizer.functional.line_search import (  # noqa: F401
+    check_initial_inverse_hessian_estimate,
+    check_input_type,
+    cubic_interpolation_,
+    strong_wolfe,
+)
 
 __all__ = ["minimize_bfgs", "minimize_lbfgs"]

@@ -18,6 +18,46 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def cubic_interpolation_(x1, f1, g1, x2, f2, g2):
+    """Minimizer of the cubic through (x1,f1,g1),(x2,f2,g2) (reference
+    line_search.py cubic_interpolation_, Nocedal eq. 3.59), safeguarded
+    to the bracket; falls back to bisection when the cubic has no real
+    minimizer in the interval."""
+    d1 = g1 + g2 - 3 * (f1 - f2) / (x1 - x2)
+    rad = d1 * d1 - g1 * g2
+    ok = rad >= 0
+    d2 = jnp.sign(x2 - x1) * jnp.sqrt(jnp.maximum(rad, 0.0))
+    denom = g2 - g1 + 2 * d2
+    xmin = x2 - (x2 - x1) * (g2 + d2 - d1) / denom
+    lo = jnp.minimum(x1, x2)
+    hi = jnp.maximum(x1, x2)
+    bisect = 0.5 * (lo + hi)
+    good = ok & jnp.isfinite(xmin) & (jnp.abs(denom) > 1e-32)
+    return jnp.clip(jnp.where(good, xmin, bisect), lo, hi)
+
+
+def check_input_type(input, name, op_name):
+    """Reference utils.py check_input_type: tensors only."""
+    import paddle_tpu
+    if not isinstance(input, (paddle_tpu.Tensor, jnp.ndarray)):
+        raise ValueError(f"The input {name} of {op_name} must be a "
+                         f"Tensor, got {type(input)}")
+
+
+def check_initial_inverse_hessian_estimate(H0):
+    """Reference bfgs utils: H0 must be symmetric positive definite."""
+    import numpy as np
+    H = np.asarray(getattr(H0, "_value", H0))
+    if not np.allclose(H, H.T, atol=1e-5):
+        raise ValueError("initial_inverse_hessian_estimate must be "
+                         "symmetric")
+    try:
+        np.linalg.cholesky(H)
+    except np.linalg.LinAlgError:
+        raise ValueError("initial_inverse_hessian_estimate must be "
+                         "positive definite") from None
+
+
 def strong_wolfe(phi_fn, g_example, alpha0=1.0, phi0=None, dphi0=None,
                  c1=1e-4, c2=0.9, max_iters=50, alpha_max=1e3):
     """Find alpha satisfying the strong Wolfe conditions.
@@ -43,6 +83,7 @@ def strong_wolfe(phi_fn, g_example, alpha0=1.0, phi0=None, dphi0=None,
         dphi_lo=jnp.asarray(dphi0, dtype),
         a_hi=jnp.zeros((), dtype),
         phi_hi=jnp.asarray(phi0, dtype),
+        dphi_hi=jnp.asarray(dphi0, dtype),
         a_star=jnp.zeros((), dtype),
         phi_star=jnp.asarray(phi0, dtype),
         g_star=jnp.asarray(g_example, dtype),
@@ -55,7 +96,16 @@ def strong_wolfe(phi_fn, g_example, alpha0=1.0, phi0=None, dphi0=None,
         return (~s["done"]) & (s["i"] < max_iters)
 
     def body(s):
-        a = jnp.where(s["zoom"], 0.5 * (s["a_lo"] + s["a_hi"]), s["a_trial"])
+        # zoom trial: cubic interpolation over the bracket (reference
+        # alg), safeguarded away from the endpoints — degenerate cubics
+        # fall back to bisection inside cubic_interpolation_
+        a_cubic = cubic_interpolation_(s["a_lo"], s["phi_lo"], s["dphi_lo"],
+                                       s["a_hi"], s["phi_hi"], s["dphi_hi"])
+        lo = jnp.minimum(s["a_lo"], s["a_hi"])
+        hi = jnp.maximum(s["a_lo"], s["a_hi"])
+        margin = 0.1 * (hi - lo)
+        a_zoom = jnp.clip(a_cubic, lo + margin, hi - margin)
+        a = jnp.where(s["zoom"], a_zoom, s["a_trial"])
         phi, dphi, g = phi_fn(a)
         armijo_fail = phi > phi0 + c1 * a * dphi0
         curv_ok = jnp.abs(dphi) <= -c2 * dphi0
@@ -94,17 +144,21 @@ def strong_wolfe(phi_fn, g_example, alpha0=1.0, phi0=None, dphi0=None,
         a_hi = jnp.where(z1 | z2, jnp.where(z1, a, s["a_prev"]), s["a_hi"])
         phi_hi = jnp.where(z1 | z2, jnp.where(z1, phi, s["phi_prev"]),
                            s["phi_hi"])
+        dphi_hi = jnp.where(z1 | z2, jnp.where(z1, dphi, s["dphi_prev"]),
+                            s["dphi_hi"])
         # inside zoom: standard interval update
         a_hi = jnp.where(in_zoom & zo_shrink_hi, a, a_hi)
         phi_hi = jnp.where(in_zoom & zo_shrink_hi, phi, phi_hi)
+        dphi_hi = jnp.where(in_zoom & zo_shrink_hi, dphi, dphi_hi)
         a_hi = jnp.where(in_zoom & zo_flip, s["a_lo"], a_hi)
         phi_hi = jnp.where(in_zoom & zo_flip, s["phi_lo"], phi_hi)
+        dphi_hi = jnp.where(in_zoom & zo_flip, s["dphi_lo"], dphi_hi)
         move_lo = in_zoom & (~zo_shrink_hi) & (~zo_accept)
         a_lo = jnp.where(move_lo, a, a_lo)
         phi_lo = jnp.where(move_lo, phi, phi_lo)
         dphi_lo = jnp.where(move_lo, dphi, dphi_lo)
         new.update(a_lo=a_lo, phi_lo=phi_lo, dphi_lo=dphi_lo,
-                   a_hi=a_hi, phi_hi=phi_hi)
+                   a_hi=a_hi, phi_hi=phi_hi, dphi_hi=dphi_hi)
         # bracket phase bookkeeping
         new["a_prev"] = jnp.where(br_continue & ~in_zoom, a, s["a_prev"])
         new["phi_prev"] = jnp.where(br_continue & ~in_zoom, phi,

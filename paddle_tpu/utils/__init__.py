@@ -62,8 +62,9 @@ def register_flops(op_type):
 
 
 def generate(key=""):
-    """paddle.utils.unique_name.generate parity."""
-    return unique_name(key or "tmp")
+    """paddle.utils.unique_name.generate parity: scoped by guard/switch
+    (see the _NameScope machinery below)."""
+    return _name_scope[0].generate(key or "tmp")
 
 
 def require_version(min_version, max_version=None):
@@ -150,3 +151,65 @@ def reset_profiler():
 def cuda_profiler(*a, **kw):
     raise RuntimeError("cuda_profiler has no TPU analogue; use "
                        "paddle_tpu.profiler (jax.profiler traces)")
+
+
+# ---- unique_name scoping (reference utils/unique_name.py: generate /
+# guard / switch over a per-scope counter map) --------------------------
+class _NameScope:
+    def __init__(self):
+        self.counters = {}
+
+    def generate(self, key):
+        n = self.counters.get(key, 0)
+        self.counters[key] = n + 1
+        return f"{key}_{n}"
+
+
+_name_scope = [_NameScope()]
+
+
+def switch(new_generator=None):
+    """Swap the active unique-name scope, returning the previous one."""
+    old = _name_scope[0]
+    _name_scope[0] = new_generator if new_generator is not None \
+        else _NameScope()
+    return old
+
+
+class guard:
+    """Context manager: names generated inside restart from a fresh (or
+    given) scope, restoring the outer scope on exit."""
+
+    def __init__(self, new_generator=None):
+        self._new = new_generator
+        self._old = None
+
+    def __enter__(self):
+        self._old = switch(self._new)
+        return self
+
+    def __exit__(self, *exc):
+        switch(self._old)
+        return False
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    """Reference utils/download.py:79: resolve a pretrained-weights URL to
+    a local cache path.  This environment has zero egress, so only the
+    cache-hit path works: the file must already be under WEIGHTS_HOME
+    (~/.cache/paddle_tpu/hapi/weights or $WEIGHTS_HOME)."""
+    import os
+    home = os.environ.get(
+        "WEIGHTS_HOME",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                     "hapi", "weights"))
+    fname = os.path.basename(str(url).split("?")[0])
+    path = os.path.join(home, fname)
+    if os.path.exists(path):
+        return path
+    raise RuntimeError(
+        f"weights for {url!r} not found at {path}; this build has no "
+        "network egress — place the file there (or set WEIGHTS_HOME)")
+
+
+from paddle_tpu.utils import profiler  # noqa: E402,F401

@@ -1,0 +1,15 @@
+"""Legacy `paddle.utils.profiler` module surface (reference:
+python/paddle/utils/profiler.py) — routes to the modern
+paddle_tpu.profiler jax-trace profiler via the facades in utils."""
+from paddle_tpu.utils import (  # noqa: F401
+    Profiler,
+    ProfilerOptions,
+    cuda_profiler,
+    get_profiler,
+    reset_profiler,
+    start_profiler,
+    stop_profiler,
+)
+
+__all__ = ["ProfilerOptions", "Profiler", "get_profiler", "start_profiler",
+           "stop_profiler", "reset_profiler", "cuda_profiler"]

@@ -1,0 +1,293 @@
+"""Round-4 long-tail API fills: partial p2p, flat fused storages,
+ResNetUnit, unique_name scoping, communication/group helpers, launcher
+worker utilities, cubic line-search interpolation."""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as p
+from paddle_tpu.distributed.fleet.meta_parallel import pp_utils as ppu
+
+
+class TestPartialP2P:
+    def test_send_partial_allgather_roundtrip(self):
+        """send_partial ships 1/mp of the tensor over the pp hop;
+        allgather_partial reassembles it — together they equal a plain
+        recv_forward (reference p2p_communication.py send_partial)."""
+        mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("pp", "mp"))
+
+        def body(x):
+            part = ppu.send_partial(x, +1, "pp", "mp")
+            full = ppu.allgather_partial(part, "mp", shape=x.shape)
+            return full, ppu.recv_forward(x, "pp")
+
+        f = jax.jit(shard_map(body, mesh=mesh, in_specs=P("pp"),
+                              out_specs=(P("pp"), P("pp")),
+                              check_vma=False))
+        x = jnp.arange(16.0).reshape(2, 8)
+        got, want = f(x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+    def test_interleave_relays(self):
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("pp",))
+
+        def body(x):
+            return ppu.send_forward_backward_recv_forward_backward(
+                x, x * 10.0)
+
+        f = jax.jit(shard_map(body, mesh=mesh, in_specs=P("pp"),
+                              out_specs=(P("pp"), P("pp")),
+                              check_vma=False))
+        a, c = f(jnp.arange(8.0))
+        np.testing.assert_allclose(np.asarray(a),
+                                   np.roll(np.arange(8.0), 1))
+        np.testing.assert_allclose(np.asarray(c),
+                                   np.roll(10.0 * np.arange(8.0), -1))
+
+    def test_send_recv_meta_and_init(self):
+        m = ppu.SendRecvMeta()
+        t = p.ones([2, 3], dtype="float32")
+        m.set_send_message(t)
+        assert m.send_shape_message == (2, 3)
+        assert "float32" in m.send_dtype_message
+        ppu.initialize_p2p_groups()  # mesh may be None off-distributed
+
+
+class TestInternalStorage:
+    def test_param_storage_pack_unpack(self):
+        from paddle_tpu.distributed.fleet.utils import ParamStorage
+        p.seed(0)
+        net = p.nn.Linear(4, 3)
+        params = net.parameters()
+        total = sum(int(np.prod(q.shape)) for q in params)
+        st = ParamStorage(total, dtype=jnp.float32)
+        st.add_rank_params(params)
+        # buffer holds the concatenated current values
+        want = np.concatenate([np.ravel(q.numpy()) for q in params])
+        np.testing.assert_allclose(np.asarray(st.buffer), want, rtol=1e-6)
+        # mutate the buffer, scatter back onto the tensors
+        st.buffer = st.buffer * 2.0
+        st.sync_views()
+        np.testing.assert_allclose(
+            np.ravel(params[0].numpy()), 2.0 * want[:12], rtol=1e-6)
+
+    def test_grad_storage_fused_sync(self):
+        from paddle_tpu.distributed.fleet.utils import GradStorage
+        p.seed(0)
+        net = p.nn.Linear(4, 3)
+        x = p.to_tensor(np.ones((2, 4), np.float32))
+        net(x).sum().backward()
+        params = net.parameters()
+        total = sum(int(np.prod(q.shape)) for q in params)
+        st = GradStorage(total, dtype=jnp.float32)
+        for q in params:
+            assert st.can_add_grad_view(q)
+            st.add_grad(q)
+        assert not st.can_add_grad_view(params[0])  # already registered
+        st.sync_buffer()
+        assert st.all_checked_in
+        want = np.concatenate([np.ravel(q.grad.numpy()) for q in params])
+        np.testing.assert_allclose(np.asarray(st.buffer), want, rtol=1e-6)
+        # simulate a fused mean all-reduce then scatter back
+        st.buffer = st.buffer / 8.0
+        st.sync_grads()
+        np.testing.assert_allclose(
+            np.ravel(params[0].grad.numpy()), want[:12] / 8.0, rtol=1e-6)
+        st.manumal_relase()
+        assert st.buffer.shape == (0,)
+        st.rebuild()
+        assert st.buffer.shape == (total,)
+
+    def test_grad_storage_scatters_to_gradless_params(self):
+        """sync_grads must create .grad when a param has none (e.g. the
+        fused buffer IS the accumulator) — the optimizer reads .grad."""
+        from paddle_tpu.distributed.fleet.utils import GradStorage
+        p.seed(0)
+        net = p.nn.Linear(3, 2)
+        params = net.parameters()
+        total = sum(int(np.prod(q.shape)) for q in params)
+        st = GradStorage(total, dtype=jnp.float32)
+        for q in params:
+            st.add_grad(q)
+        assert all(q.grad is None for q in params)
+        st.buffer = jnp.ones((total,), jnp.float32)
+        st.sync_grads()
+        for q in params:
+            assert q.grad is not None
+            np.testing.assert_allclose(q.grad.numpy(),
+                                       np.ones(q.shape, np.float32))
+
+    def test_grad_storage_respects_alignment_gaps(self):
+        from paddle_tpu.distributed.fleet.utils import GradStorage
+        p.seed(0)
+        net = p.nn.Linear(3, 2)
+        w, b = net.parameters()
+        net(p.to_tensor(np.ones((1, 3), np.float32))).sum().backward()
+        st = GradStorage(6 + 4 + 2 + 3, dtype=jnp.float32)
+        st.add_grad(w, align=4)  # 6 elems + 4 pad
+        st.add_grad(b)
+        st.sync_buffer()
+        buf = np.asarray(st.buffer)
+        np.testing.assert_allclose(buf[:6], np.ravel(w.grad.numpy()))
+        np.testing.assert_allclose(buf[6:10], 0.0)  # the alignment gap
+        np.testing.assert_allclose(buf[10:12], np.ravel(b.grad.numpy()))
+        np.testing.assert_allclose(buf[12:], 0.0)   # unreserved tail
+
+
+class TestResNetUnit:
+    def test_eval_oracle_and_shapes(self):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.incubate.operators import ResNetUnit
+        p.seed(0)
+        u = ResNetUnit(num_channels_x=16, num_filters=16, filter_size=3,
+                       data_format="NHWC", fuse_add=True, is_test=True)
+        y = p.randn([2, 8, 8, 16])
+        out = u(y, y)
+        ref = F.relu(F.batch_norm(
+            F.conv2d(y, u.filter_x, stride=1, padding=1,
+                     data_format="NHWC"),
+            u.mean_x, u.var_x, weight=u.scale_x, bias=u.bias_x,
+            training=False, data_format="NHWC") + y)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_shortcut_train_grads(self):
+        from paddle_tpu.incubate.operators import ResNetUnit
+        p.seed(1)
+        u = ResNetUnit(num_channels_x=8, num_filters=16, filter_size=3,
+                       stride=2, data_format="NHWC", has_shortcut=True,
+                       num_channels_z=8, stride_z=2)
+        x = p.randn([2, 16, 16, 8])
+        z = p.randn([2, 16, 16, 8])
+        out = u(x, z)
+        assert out.shape == [2, 8, 8, 16]
+        assert float((out.numpy() >= 0).mean()) == 1.0  # relu epilogue
+        out.sum().backward()
+        assert u.filter_x.grad is not None
+        assert u.filter_z.grad is not None
+        # moving stats updated by the training-mode BN
+        assert not np.allclose(u.mean_x.numpy(), 0.0)
+
+
+class TestUniqueNameScoping:
+    def test_guard_and_switch(self):
+        import paddle_tpu.utils as U
+        with U.guard():
+            assert U.generate("fc") == "fc_0"
+            assert U.generate("fc") == "fc_1"
+            with U.guard():
+                assert U.generate("fc") == "fc_0"
+            assert U.generate("fc") == "fc_2"
+        old = U.switch()
+        assert U.generate("fc") == "fc_0"
+        U.switch(old)
+
+
+class TestGroupHelpers:
+    def test_communication_reexports(self):
+        from paddle_tpu.distributed import communication as comm
+        g = comm.get_group(0)
+        assert g is not None
+        assert isinstance(comm.is_initialized(), bool)
+        comm.destroy_process_group()  # idempotent no-op on default group
+
+    def test_weights_path_zero_egress(self, tmp_path):
+        import paddle_tpu.utils as U
+        os.environ["WEIGHTS_HOME"] = str(tmp_path)
+        try:
+            (tmp_path / "model.pdparams").write_bytes(b"x")
+            got = U.get_weights_path_from_url(
+                "https://example.com/model.pdparams?x=1")
+            assert got == str(tmp_path / "model.pdparams")
+            with pytest.raises(RuntimeError, match="egress"):
+                U.get_weights_path_from_url("https://example.com/nope.bin")
+        finally:
+            del os.environ["WEIGHTS_HOME"]
+
+
+class TestLauncherWorkers:
+    def test_get_gpus_visible_remap(self, monkeypatch):
+        from paddle_tpu.distributed.utils import get_gpus
+        monkeypatch.setenv("TPU_VISIBLE_CHIPS", "4,5,6,7")
+        assert get_gpus("5,7") == [1, 3]
+        # None returns relative indices too — one index space
+        assert get_gpus(None) == [0, 1, 2, 3]
+        with pytest.raises(ValueError):
+            get_gpus("0")
+
+    def test_start_watch_trainers(self, tmp_path):
+        from paddle_tpu.distributed.utils import (
+            get_cluster, start_local_trainers, watch_local_trainers)
+        script = tmp_path / "worker.py"
+        script.write_text(
+            "import os\n"
+            "print('rank', os.environ['PADDLE_TRAINER_ID'],"
+            " 'of', os.environ['PADDLE_TRAINERS_NUM'])\n")
+        cluster, pod = get_cluster(
+            ["127.0.0.1"], "127.0.0.1",
+            [["127.0.0.1:6170", "127.0.0.1:6171"]], [0, 1])
+        procs = start_local_trainers(cluster, pod, str(script), [],
+                                     log_dir=str(tmp_path / "logs"))
+        try:
+            import time
+            deadline = time.time() + 30
+            while watch_local_trainers(procs, 2) and time.time() < deadline:
+                time.sleep(0.1)
+        finally:
+            from paddle_tpu.distributed.utils import terminate_local_procs
+            terminate_local_procs(procs)
+        log0 = (tmp_path / "logs" / "workerlog.0").read_text()
+        assert "rank 0 of 2" in log0
+
+
+class TestCubicLineSearch:
+    def test_cubic_minimizer_quadratic(self):
+        from paddle_tpu.incubate.optimizer.functional import (
+            cubic_interpolation_)
+        # f(x) = (x-0.3)^2 on [0, 1]: cubic fit IS the quadratic
+        f = lambda x: (x - 0.3) ** 2
+        g = lambda x: 2 * (x - 0.3)
+        got = cubic_interpolation_(jnp.float32(0.0), jnp.float32(f(0.0)),
+                                   jnp.float32(g(0.0)), jnp.float32(1.0),
+                                   jnp.float32(f(1.0)), jnp.float32(g(1.0)))
+        assert abs(float(got) - 0.3) < 1e-5
+
+    def test_degenerate_falls_back_to_bisection(self):
+        from paddle_tpu.incubate.optimizer.functional import (
+            cubic_interpolation_)
+        # identical points -> NaN guts -> bisection midpoint
+        got = cubic_interpolation_(jnp.float32(0.0), jnp.float32(1.0),
+                                   jnp.float32(-1.0), jnp.float32(2.0),
+                                   jnp.float32(1.0), jnp.float32(-1.0))
+        assert 0.0 <= float(got) <= 2.0 and np.isfinite(float(got))
+
+    def test_checks(self):
+        from paddle_tpu.incubate.optimizer.functional import (
+            check_initial_inverse_hessian_estimate, check_input_type)
+        check_initial_inverse_hessian_estimate(np.eye(4))
+        with pytest.raises(ValueError, match="symmetric"):
+            check_initial_inverse_hessian_estimate(
+                np.array([[1.0, 2.0], [0.0, 1.0]]))
+        with pytest.raises(ValueError, match="positive definite"):
+            check_initial_inverse_hessian_estimate(
+                np.array([[1.0, 0.0], [0.0, -1.0]]))
+        check_input_type(p.ones([2]), "x", "op")
+        with pytest.raises(ValueError):
+            check_input_type([1, 2], "x", "op")
+
+    def test_bfgs_still_converges_rosenbrock(self):
+        from paddle_tpu.incubate.optimizer.functional import minimize_bfgs
+
+        def rosen(x):
+            return ((1 - x[:-1]) ** 2 + 100.0 *
+                    (x[1:] - x[:-1] ** 2) ** 2).sum()
+
+        x0 = p.to_tensor(np.zeros(6, np.float32))
+        res = minimize_bfgs(rosen, x0, max_iters=200, tolerance_grad=1e-6)
+        assert np.allclose(res[2].numpy(), np.ones(6), atol=1e-2)
