@@ -1,0 +1,119 @@
+"""Roofline profile of the bench's BERT-base train step on real TPU.
+
+Answers "where does the other ~70% of MFU go" with data rather than
+guesswork: XLA cost analysis of the compiled step gives flops and HBM
+bytes; bytes/step over the measured step time vs the ~819 GB/s v5e HBM
+tells whether the step is bandwidth-bound (like ResNet) or occupancy-
+bound; the dot-shape census from the compiled HLO shows how much of the
+time sits in GEMMs too narrow to fill the 128x128 MXU.
+
+Usage: python tools/profile_bert.py [--batch N] [--iters N]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+HBM_GBPS = 819.0   # v5e
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--iters", type=int, default=8)
+    args = ap.parse_args()
+
+    import jax
+
+    import paddle_tpu as P
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.models.bert import BertConfig, BertForPretraining
+
+    P.seed(0)
+    cfg = BertConfig(dropout=0.0, attention_dropout=0.0)
+    model = BertForPretraining(cfg)
+    opt = P.optimizer.AdamW(learning_rate=1e-4,
+                            parameters=model.parameters())
+
+    @P.jit.to_static
+    def train_step(ids, labels):
+        opt.clear_grad()
+        with P.amp.auto_cast(level="O1", dtype="bfloat16"):
+            pred, _ = model(ids)
+        loss = F.cross_entropy(
+            pred.reshape([-1, cfg.vocab_size]), labels.reshape([-1]))
+        loss.backward()
+        opt.step()
+        return loss
+
+    rng = np.random.default_rng(0)
+    ids = P.to_tensor(rng.integers(0, cfg.vocab_size,
+                                   (args.batch, args.seq)), dtype="int64")
+    labels = P.to_tensor(rng.integers(0, cfg.vocab_size,
+                                      (args.batch, args.seq)),
+                         dtype="int64")
+    loss = train_step(ids, labels)
+    loss.block_until_ready()
+
+    flops = bytes_acc = None
+    try:
+        jitted, _, state_list = next(iter(train_step._compiled.values()))
+        compiled = jitted.lower([t._value for t in state_list],
+                                [ids._value, labels._value]).compile()
+        cost = compiled.cost_analysis()
+        cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+        flops = float(cost.get("flops", 0.0))
+        bytes_acc = float(cost.get("bytes accessed", 0.0))
+        print(f"xla flops/step: {flops:.3e}  bytes/step: {bytes_acc:.3e}")
+        # dot-shape census: which GEMM shapes carry the flops
+        hlo = compiled.as_text()
+        shapes = {}
+        for m in re.finditer(
+                r"= (bf16|f32)\[([0-9,]+)\][^=]*? dot\(", hlo):
+            key = f"{m.group(1)}[{m.group(2)}]"
+            shapes[key] = shapes.get(key, 0) + 1
+        top = sorted(shapes.items(), key=lambda kv: -kv[1])[:12]
+        print("dot output shapes (count):")
+        for k, c in top:
+            print(f"  {c:4d}x {k}")
+        print("fusions:", hlo.count(" fusion("),
+              " custom-calls:", hlo.count("custom-call("),
+              " copies:", hlo.count(" copy("))
+    except Exception as e:  # noqa: BLE001
+        print("cost/HLO analysis failed:", e)
+
+    # free-running step time (bench's mode: serial dependence via state)
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        loss = train_step(ids, labels)
+    loss.block_until_ready()
+    dt = (time.perf_counter() - t0) / args.iters
+
+    tok_s = args.batch * args.seq / dt
+    print(f"step {dt*1e3:.1f} ms  {tok_s:.0f} tokens/s")
+    if flops:
+        mfu = flops / dt / 197e12
+        print(f"mfu (xla flops): {mfu:.3f}")
+    if bytes_acc:
+        bw = bytes_acc / dt / 1e9
+        util = bw / HBM_GBPS
+        print(f"hbm: {bytes_acc/1e9:.2f} GB/step -> {bw:.0f} GB/s "
+              f"({util:.1%} of {HBM_GBPS:.0f})")
+        if flops and bytes_acc:
+            ai = flops / bytes_acc
+            print(f"arithmetic intensity {ai:.0f} flop/byte "
+                  f"(v5e ridge ~{197e12/HBM_GBPS/1e9:.0f}) -> "
+                  f"{'COMPUTE' if ai > 197e12/(HBM_GBPS*1e9) else 'MEMORY'}"
+                  "-bound in the roofline sense")
+
+
+if __name__ == "__main__":
+    main()
