@@ -82,11 +82,16 @@ from paddle_tpu import (  # noqa: F401,E402
     signal,
     static,
     sparse,
+    sysconfig,
     tensor,
     text,
     utils,
     vision,
 )
+# the function shadows its module at the package root, as in the
+# reference (paddle/__init__.py imports and calls it at import time —
+# we only call when scipy is actually bundled)
+from paddle_tpu.check_import_scipy import check_import_scipy  # noqa: F401,E402,E501
 from paddle_tpu.batch import batch  # noqa: F401,E402
 from paddle_tpu.hapi.model import Model  # noqa: F401,E402
 from paddle_tpu.jit.api import to_static  # noqa: F401,E402

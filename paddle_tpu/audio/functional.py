@@ -20,7 +20,7 @@ from paddle_tpu.core.tensor import Tensor
 
 __all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
            "compute_fbank_matrix", "power_to_db", "create_dct",
-           "get_window"]
+           "get_window", "WindowFunctionRegister", "window_function_register"]
 
 
 def _jnp(x):
@@ -192,6 +192,36 @@ def get_window(window, win_length, fftbins=True, dtype="float64"):
         beta = args[0] if args else 14.0
         w = np.i0(beta * np.sqrt(1 - ((n - (M - 1) / 2)
                                       / ((M - 1) / 2)) ** 2)) / np.i0(beta)
+    elif name in window_function_register._functions_dict:
+        w = np.asarray(window_function_register.get(name)(M, *args),
+                       dtype=np.float64)
     else:
         raise ValueError(f"unsupported window: {window!r}")
     return Tensor(jnp.asarray(_truncate(w, trunc)).astype(dtype))
+
+
+class WindowFunctionRegister:
+    """Custom-window registry (reference audio/functional/window.py:22):
+    @window_function_register.register() adds a window factory that
+    get_window resolves by function name."""
+
+    def __init__(self):
+        self._functions_dict = {}
+
+    def register(self, func=None):
+        def add_subfunction(f):
+            self._functions_dict[f.__name__] = f
+            return f
+        if func is not None:
+            return add_subfunction(func)
+        return add_subfunction
+
+    def get(self, name):
+        if name not in self._functions_dict:
+            raise ValueError(
+                f"no window registered under {name!r}; known: "
+                f"{sorted(self._functions_dict)}")
+        return self._functions_dict[name]
+
+
+window_function_register = WindowFunctionRegister()

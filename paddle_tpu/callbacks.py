@@ -6,8 +6,11 @@ from paddle_tpu.hapi.callbacks import (  # noqa: F401
     LRScheduler,
     ModelCheckpoint,
     ProgBarLogger,
+    ReduceLROnPlateau,
     VisualDL,
+    WandbCallback,
 )
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
-           "EarlyStopping", "VisualDL"]
+           "EarlyStopping", "VisualDL", "ReduceLROnPlateau",
+           "WandbCallback"]

@@ -1,0 +1,16 @@
+"""paddle.check_import_scipy parity (reference:
+python/paddle/check_import_scipy.py): import scipy with a clearer error
+on Windows DLL failures."""
+
+__all__ = ["check_import_scipy"]
+
+
+def check_import_scipy(os_name):
+    try:
+        import scipy  # noqa: F401
+    except ImportError as e:
+        if os_name == "nt" and "DLL load failed" in str(e):
+            raise ImportError(
+                "scipy DLL load failed on Windows; install the VC++ "
+                "redistributable and reinstall scipy") from e
+        raise

@@ -58,6 +58,7 @@ from paddle_tpu.distributed.mesh import (  # noqa: F401
 from paddle_tpu.distributed.parallel import DataParallel  # noqa: F401
 from paddle_tpu.distributed.recompute import recompute  # noqa: F401
 from paddle_tpu.distributed import elastic, launch  # noqa: F401
+from paddle_tpu.distributed.elastic import Command  # noqa: F401
 from paddle_tpu.distributed.pipeline import (  # noqa: F401
     microbatch,
     pipeline_forward,

@@ -201,3 +201,45 @@ def notify_progress():
     if _active_manager is not None:
         _step_counter[0] += 1
         _active_manager.beat(_step_counter[0])
+
+
+class Command:
+    """Elastic scale control (reference distributed/elastic.py:19): the
+    reference stores the target world size np in etcd. Zero external
+    services here — the KV is a local JSON file shared by node-local
+    processes (cross-host coordination is jax.distributed's job)."""
+
+    def __init__(self, server=None, name="default"):
+        import json
+        import os
+        import tempfile
+        self._json = json
+        self.path = os.path.join(tempfile.gettempdir(),
+                                 f"ptpu_elastic_{name}.json")
+
+    def _read(self):
+        import os
+        if not os.path.exists(self.path):
+            return {}
+        try:
+            with open(self.path) as fh:
+                return self._json.load(fh)
+        except Exception:
+            return {}
+
+    def set_np(self, np):
+        state = self._read()
+        state["np"] = int(np)
+        with open(self.path, "w") as fh:
+            self._json.dump(state, fh)
+
+    def scale_np(self, np):
+        if self._read().get("np") is not None:
+            self.set_np(np)
+            return True
+        return False
+
+    def clean(self):
+        import os
+        if os.path.exists(self.path):
+            os.remove(self.path)

@@ -21,6 +21,11 @@ from paddle_tpu.distributed.fleet.meta_parallel import (  # noqa: F401
     VocabParallelEmbedding,
 )
 from paddle_tpu.distributed.fleet import utils  # noqa: F401
+from paddle_tpu.distributed.fleet import elastic  # noqa: F401
+from paddle_tpu.distributed.fleet import layers  # noqa: F401
+from paddle_tpu.distributed.fleet import meta_optimizers  # noqa: F401
+from paddle_tpu.distributed.fleet import mp_ops  # noqa: F401
+from paddle_tpu.distributed.fleet import pp_utils  # noqa: F401
 from paddle_tpu.distributed.fleet.dataset import (  # noqa: F401
     InMemoryDataset,
     QueueDataset,

@@ -1,0 +1,129 @@
+"""paddle.distributed.fleet.elastic parity (reference:
+fleet/elastic/__init__.py enable_elastic/launch_elastic +
+elastic/manager.py ElasticLevel/ElasticStatus/LauncherInterface +
+elastic/collective.py CollectiveLauncher).
+
+The reference coordinates restarts through etcd; here the elastic
+machinery is distributed/elastic.py's watchdog/heartbeat manager (no
+external KV store — jax.distributed owns membership), and these names
+front it at the reference's import path.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import time
+
+from paddle_tpu.distributed.elastic import (  # noqa: F401
+    ElasticManager,
+    HeartbeatServer,
+    Watchdog,
+)
+
+__all__ = ["ElasticLevel", "ElasticStatus", "LauncherInterface",
+           "CollectiveLauncher", "ElasticManager", "enable_elastic",
+           "launch_elastic"]
+
+
+class ElasticLevel:
+    FAULT_TOLERANCE = 1
+    ELASTIC = 2
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class LauncherInterface:
+    """Process-group launcher base (reference elastic/manager.py:55)."""
+
+    def __init__(self, args):
+        self.args = args
+        self.procs = []
+
+    def _terminate_procs(self):
+        for p in self.procs:
+            proc = getattr(p, "proc", p)
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if all(getattr(p, "proc", p) is None or
+                   getattr(p, "proc", p).poll() is not None
+                   for p in self.procs):
+                return True
+            time.sleep(0.2)
+        for p in self.procs:
+            proc = getattr(p, "proc", p)
+            if proc is not None and proc.poll() is None and os.name != "nt":
+                proc.send_signal(signal.SIGKILL)
+        return False
+
+    def launch(self):
+        raise NotImplementedError
+
+    def stop(self):
+        raise NotImplementedError
+
+    def watch(self):
+        raise NotImplementedError
+
+
+class CollectiveLauncher(LauncherInterface):
+    """Launch + watch the local trainer group (reference
+    elastic/collective.py:28), backed by utils.start_local_trainers."""
+
+    def __init__(self, args):
+        super().__init__(args)
+        self.tmp_dir = getattr(args, "log_dir", None)
+
+    def launch(self):
+        from paddle_tpu.distributed.utils import (
+            get_cluster_from_args, get_gpus, start_local_trainers)
+        args = self.args
+        devices = get_gpus(getattr(args, "gpus", None))
+        cluster, pod = get_cluster_from_args(args, devices)
+        self.procs = start_local_trainers(
+            cluster, pod, args.training_script,
+            getattr(args, "training_script_args", []),
+            log_dir=self.tmp_dir)
+        return self.procs
+
+    def watch(self):
+        from paddle_tpu.distributed.utils import watch_local_trainers
+        try:
+            alive = watch_local_trainers(self.procs, len(self.procs))
+        except RuntimeError:
+            return ElasticStatus.ERROR
+        return ElasticStatus.HOLD if alive else ElasticStatus.COMPLETED
+
+    def stop(self):
+        self._terminate_procs()
+
+
+def enable_elastic(args, distribute_mode=None):
+    """Elastic runs are opted into via PADDLE_ELASTIC_TIMEOUT (the
+    reference keys off its etcd server setting)."""
+    return bool(os.environ.get("PADDLE_ELASTIC_TIMEOUT"))
+
+
+def launch_elastic(args, distribute_mode=None):
+    """Launch under the elastic manager: start trainers, watch, restart
+    on failure up to PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL retries."""
+    retries = int(os.environ.get("PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", 1))
+    launcher = CollectiveLauncher(args)
+    for attempt in range(max(retries, 1)):
+        launcher.launch()
+        while True:
+            status = launcher.watch()
+            if status == ElasticStatus.COMPLETED:
+                return ElasticStatus.COMPLETED
+            if status == ElasticStatus.ERROR:
+                launcher.stop()
+                break
+            time.sleep(1.0)
+    return ElasticStatus.ERROR

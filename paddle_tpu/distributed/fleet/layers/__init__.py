@@ -1,0 +1,2 @@
+"""paddle.distributed.fleet.layers parity namespace."""
+from paddle_tpu.distributed.fleet.layers import mpu  # noqa: F401

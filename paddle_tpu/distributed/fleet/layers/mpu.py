@@ -1,0 +1,60 @@
+"""paddle.distributed.fleet.layers.mpu parity (reference:
+fleet/layers/mpu/ — the model-parallel layer/op vocabulary).
+
+The layers live in fleet.meta_parallel (full logical weights with tp
+PartitionSpecs; XLA places the collectives) and are re-exported here at
+the reference's path. `split` is the reference's one-call model-parallel
+constructor (mp_ops.py:678): build the matching tp-sharded layer for an
+embedding/linear operation.
+"""
+from __future__ import annotations
+
+from paddle_tpu.distributed.fleet.meta_parallel import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from paddle_tpu.distributed.fleet.mp_ops import (  # noqa: F401
+    copy_to_tp_region,
+    reduce_from_tp_region,
+    vocab_parallel_cross_entropy,
+    vocab_parallel_embedding,
+)
+
+__all__ = ["ColumnParallelLinear", "RowParallelLinear",
+           "VocabParallelEmbedding", "ParallelCrossEntropy", "split"]
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Build + apply the tp-sharded layer for `operation` in one call
+    (reference mp_ops.py:678). operation='embedding' -> vocab-parallel
+    embedding; 'linear' with axis=0 -> row-parallel, axis=1 ->
+    column-parallel. The mesh's tp axis plays num_partitions' role — XLA
+    shards the weight; num_partitions is validated against it."""
+    from paddle_tpu.distributed.mesh import axis_size
+
+    tp = axis_size("tp")
+    if num_partitions not in (1, tp):
+        raise ValueError(
+            f"num_partitions={num_partitions} but the mesh tp axis has "
+            f"{tp} devices — size the mesh, not the call")
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr)
+        return layer(x)
+    if operation != "linear":
+        raise ValueError(f"unsupported operation {operation!r}")
+    if axis == 0:
+        layer = RowParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                  has_bias=bias_attr is not False,
+                                  input_is_parallel=False)
+    elif axis == 1:
+        layer = ColumnParallelLinear(size[0], size[1],
+                                     weight_attr=weight_attr,
+                                     has_bias=bias_attr is not False,
+                                     gather_output=gather_out)
+    else:
+        raise ValueError("axis must be 0 (row) or 1 (column)")
+    return layer(x)

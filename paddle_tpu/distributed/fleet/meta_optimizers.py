@@ -1,0 +1,66 @@
+"""paddle.distributed.fleet.meta_optimizers parity surface.
+
+The reference's meta-optimizers rewrite the static Program (insert
+c_allreduce, shard states, recompute segments). Under XLA the same
+outcomes are sharding annotations + jit: the classes here are honest
+fronts that apply the equivalent configuration so reference-written
+fleet strategies construct.
+"""
+from __future__ import annotations
+
+__all__ = ["ParameterServerOptimizer", "RawProgramOptimizer",
+           "dygraph_optimizer"]
+
+
+class RawProgramOptimizer:
+    """Reference meta_optimizers/raw_program_optimizer.py: run the user
+    program with dp all-reduce only — here that's DataParallel's role."""
+
+    def __init__(self, optimizer=None):
+        self.inner_opt = optimizer
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self.inner_opt.minimize(loss, startup_program,
+                                       parameter_list, no_grad_set)
+
+
+class ParameterServerOptimizer:
+    """Reference meta_optimizers/parameter_server_optimizer.py: route
+    sparse tables to the PS (distributed/ps.py owns them here)."""
+
+    def __init__(self, optimizer=None):
+        self.inner_opt = optimizer
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self.inner_opt.minimize(loss, startup_program,
+                                       parameter_list, no_grad_set)
+
+
+class dygraph_optimizer:
+    """Submodule-style namespace (reference
+    meta_optimizers/dygraph_optimizer/): sharded dygraph optimizers."""
+
+    @staticmethod
+    def DygraphShardingOptimizer(hcg=None, user_defined_strategy=None,
+                                 params=None, inner_optimizer_class=None,
+                                 **inner_kw):
+        """Stage-1 sharding: optimizer states shard over dp (reference
+        dygraph_sharding_optimizer.py) — the existing stage-2 wrapper
+        subsumes it (states are the stage-1 subset of stage-2)."""
+        from paddle_tpu.distributed.fleet.meta_parallel_sharding import (
+            GroupShardedOptimizerStage2)
+        opt = (inner_optimizer_class(parameters=params, **inner_kw)
+               if inner_optimizer_class is not None else params)
+        return GroupShardedOptimizerStage2(params, opt)
+
+    @staticmethod
+    def ShardingOptimizerStage2(params=None, optim=None, group=None,
+                                offload=False, **kw):
+        from paddle_tpu.distributed.fleet.meta_parallel_sharding import (
+            GroupShardedOptimizerStage2)
+        return GroupShardedOptimizerStage2(params, optim, group=group,
+                                           offload=offload, **kw)
+
+

@@ -60,3 +60,47 @@ class GroupShardedScaler:
 
     def __new__(cls, scaler):
         return scaler
+
+
+# flat fused storages shared with fleet.utils (reference keeps twin
+# copies in meta_parallel/sharding/group_sharded_storage.py)
+from paddle_tpu.distributed.fleet.utils.internal_storage import (  # noqa: E402,F401,E501
+    GradStorage,
+    InternalStorage,
+    ParamStorage,
+)
+
+ShardingScaler = GroupShardedScaler   # pre-2.3 alias
+
+
+class GroupShardedClipGrad:
+    """Global-norm clip aware of dp-sharded grads (reference
+    group_sharded_utils.py GroupShardedClipGrad): when optimizer states
+    shard over dp, each rank holds the full grads here (XLA shards the
+    update itself), so the clip reduces to the stock global-norm clip."""
+
+    def __init__(self, clip, device=None, group=None):
+        self._clip = clip
+
+    def __call__(self, params_grads):
+        return self._clip(params_grads)
+
+    def __getattr__(self, item):
+        return getattr(self._clip, item)
+
+
+ShardingClipGrad = GroupShardedClipGrad   # pre-2.3 alias
+
+
+def ForwardPreHooks(layer, order_tracer, trainable_params, *a, **kw):
+    """Stage-3 gather hook point (reference group_sharded_stage3.py):
+    XLA's partitioner all-gathers p_g_os-sharded params at use sites, so
+    the hook records traversal order only."""
+    order_tracer.setdefault("order", []).append(getattr(layer, "name",
+                                                        repr(layer)))
+
+
+def ForwardPostHooks(layer, *a, **kw):
+    """Stage-3 release hook point: rematerialization/partitioning frees
+    gathered params after use under XLA; nothing to release by hand."""
+    return None

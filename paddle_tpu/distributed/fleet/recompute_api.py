@@ -1,0 +1,11 @@
+"""Segmented/hybrid recompute entry points (reference:
+fleet/recompute/recompute.py:512 recompute_sequential,
+recompute_hybrid.py:234 recompute_hybrid) — implemented next to the
+core recompute so parameter lifting is shared."""
+from paddle_tpu.distributed.recompute import (  # noqa: F401
+    recompute,
+    recompute_hybrid,
+    recompute_sequential,
+)
+
+__all__ = ["recompute_sequential", "recompute_hybrid"]

@@ -1,20 +1,22 @@
-"""Multi-host launcher. Reference: python/paddle/distributed/launch (the
-`python -m paddle.distributed.launch --nnodes ... train.py` CLI that spawns
-per-GPU worker processes and wires NCCL env).
+"""Multi-host launcher package (reference: python/paddle/distributed/
+launch/ — the `python -m paddle.distributed.launch` CLI with its
+context/job/controllers/plugins/utils architecture).
 
 TPU-native design: one process per HOST (JAX single-controller-per-host
 SPMD), not one per chip; coordination over DCN via jax.distributed
-(coordinator address + process id), after which jax.devices() spans every
-chip in the pod slice and the global Mesh covers them. So `launch` just
-initializes the coordination service from CLI/env and execs the training
-script in-process — no worker fan-out needed on a TPU host.
+(coordinator address + process id), after which jax.devices() spans
+every chip in the pod slice and the global Mesh covers them. The
+controller architecture is preserved for scripts that drive it — the
+CollectiveController builds the node-local pod and spawns worker
+processes with the bootstrap env; `launch()` is the in-process fast
+path a TPU host normally takes.
 
 Usage:
   python -m paddle_tpu.distributed.launch \
-      --master 10.0.0.1:8476 --nnodes 4 --rank $NODE_RANK train.py [args...]
+      --master 10.0.0.1:8476 --nnodes 4 --rank $NODE_RANK train.py ...
 
-Env fallbacks: PADDLE_MASTER, PADDLE_NNODES, PADDLE_TRAINER_ID (reference
-names), or the standard JAX TPU metadata autodetection when none is given.
+Env fallbacks: PADDLE_MASTER, PADDLE_NNODES, PADDLE_TRAINER_ID
+(reference names), or JAX TPU metadata autodetection when none given.
 """
 from __future__ import annotations
 
@@ -22,6 +24,14 @@ import argparse
 import os
 import runpy
 import sys
+
+from paddle_tpu.distributed.launch import (  # noqa: F401
+    context,
+    controllers,
+    job,
+    plugins,
+    utils,
+)
 
 
 def _from_env(args):

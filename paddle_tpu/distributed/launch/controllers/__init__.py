@@ -1,0 +1,371 @@
+"""Launch controllers (reference: distributed/launch/controllers/ —
+controller.py ControllerBase/Controller/ControleMode, collective.py
+CollectiveController/CollectiveElasticController, ps.py PSController,
+master.py Master/HTTPMaster/ETCDMaster, watcher.py Watcher).
+
+A controller builds this node's Pod (one Container per worker) with the
+bootstrap env and deploys/watches it. Node discovery runs through the
+HTTP KV master (utils.KVServer — no etcd dependency; ETCDMaster gates
+on etcd3's presence honestly).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+from paddle_tpu.distributed.launch.context import Context, Status
+from paddle_tpu.distributed.launch.job import Container, Job, Pod
+
+__all__ = ["init", "ControleMode", "ControllerBase", "Controller",
+           "CollectiveController", "CollectiveElasticController",
+           "PSController", "IPUController", "Master", "HTTPMaster",
+           "ETCDMaster", "Watcher"]
+
+
+class ControleMode:   # sic — reference spelling (controller.py:27)
+    COLLECTIVE = "collective"
+    PS = "ps"
+    IPU = "ipu"
+    RPC = "rpc"
+
+
+class Master:
+    """Node-discovery store base (reference master.py:27)."""
+
+    MAIN = "main"
+    STANDBY = "standby"
+    PATICIPANT = "participant"   # sic
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.server = None
+        self.initialized = False
+        self.endpoint = None
+
+    def stop(self):
+        if self.server is not None:
+            self.server.stop()
+            self.server = None
+
+    def set_status(self, status):
+        pass
+
+    def get_status(self):
+        return None
+
+    @classmethod
+    def factory(cls, ctx):
+        if (ctx.args.master or "").startswith("etcd://"):
+            return ETCDMaster(ctx)
+        return HTTPMaster(ctx)
+
+
+class HTTPMaster(Master):
+    """KVServer-backed barrier/sync (reference master.py:65): rank 0
+    hosts the store; every node writes its endpoint under the job
+    prefix and polls until nnodes are present."""
+
+    def lazy_init(self):
+        if self.initialized:
+            return
+        self.role = Master.PATICIPANT
+        if self.ctx.args.master:
+            self.endpoint = self.ctx.args.master
+            ip, port = self.endpoint.split(":")
+            if ip in ("127.0.0.1", self.ctx.node.ip):
+                from paddle_tpu.distributed.launch.utils import KVServer
+                try:
+                    self.server = KVServer(int(port))
+                    self.server.start()
+                    self.role = Master.MAIN
+                except OSError:
+                    pass  # another process on this host owns it
+        else:
+            from paddle_tpu.distributed.launch.utils import KVServer
+            port = self.ctx.node.get_free_port()
+            self.endpoint = f"{self.ctx.node.ip}:{port}"
+            self.server = KVServer(port)
+            self.server.start()
+            self.role = Master.MAIN
+        from paddle_tpu.distributed.launch.utils import KVClient
+        self.client = KVClient(self.endpoint)
+        self.initialized = True
+
+    def sync_peers(self, prefix, key, value, size, rank=-1):
+        """Register value under prefix and wait for all `size` peers;
+        returns (sorted peer values, this rank)."""
+        if size < 2:
+            return [value], 0
+        self.lazy_init()
+        self.client.wait_server_ready()
+        self.client.put(f"{prefix}/{key}", value)
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            peers = self.client.get_prefix(prefix)
+            if len(peers) >= size:
+                values = [v for _, v in sorted(peers.items())]
+                me = values.index(value) if rank < 0 else rank
+                return values, me
+            time.sleep(0.5)
+        raise TimeoutError(f"sync_peers: {len(peers)}/{size} after 300s")
+
+
+class ETCDMaster(Master):
+    """etcd-backed master (reference master.py:177); requires etcd3,
+    which this build does not bundle — constructing without it fails
+    with the dependency named."""
+
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        try:
+            import etcd3  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "ETCDMaster needs the etcd3 package; use an http:// "
+                "master (HTTPMaster) in this environment") from e
+
+
+class ControllerBase:
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.master = Master.factory(ctx)
+        self.job = Job(jid=ctx.args.job_id,
+                       mode=ctx.args.run_mode,
+                       nnodes=ctx.args.nnodes or "1")
+        self.pod = Pod()
+        self.join_server = None
+
+    def deploy_pod(self):
+        self.ctx.status.run()
+        self.pod.deploy()
+
+    def run(self):
+        self.build_job()
+        self.build_pod()
+        self.deploy_pod()
+        self.watch()
+
+    def watch(self):
+        while True:
+            status = self.pod.status()
+            if status in (Status.COMPLETED, Status.FAILED):
+                if status == Status.FAILED:
+                    self.pod.stop()
+                    self.ctx.status.fail()
+                    return False
+                self.ctx.status.complete()
+                return True
+            time.sleep(1)
+
+    def stop(self, sigint=15):
+        self.master.stop()
+        self.pod.stop(sigint)
+
+    def finalize(self):
+        self.pod.join()
+        self.master.stop()
+        sys.exit(self.pod.exit_code)
+
+    def signal_handler(self, sigint, frame):
+        self.stop(sigint)
+        sys.exit(sigint)
+
+
+class Controller(ControllerBase):
+    """Adds entrypoint/env plumbing (reference controller.py:161)."""
+
+    def build_job(self):
+        self.ctx.logger.info(f"Job {self.job.id}: mode={self.job.mode} "
+                             f"replicas={self.job.replicas}")
+
+    def entrypoint(self, ctx=None):
+        ctx = ctx or self.ctx
+        entry = [sys.executable, "-u", ctx.args.training_script]
+        entry += list(ctx.args.training_script_args or [])
+        return entry
+
+    def new_container(self, entrypoint=None, envs=None, out=None,
+                      err=None):
+        c = Container(entrypoint=entrypoint or self.entrypoint(),
+                      env=self.ctx.get_envs())
+        c.update_env(envs or {})
+        c.outfile = out
+        c.errfile = err
+        return c
+
+    def add_container(self, container=None, entrypoint=None, envs=None,
+                      log_file=None, is_init=False):
+        if container is None:
+            log_path = (os.path.join(self.ctx.args.log_dir, log_file)
+                        if self.ctx.args.log_dir and log_file else None)
+            container = self.new_container(entrypoint=entrypoint,
+                                           envs=envs, out=log_path,
+                                           err=log_path)
+        if is_init:
+            self.pod.add_init_container(container)
+        else:
+            self.pod.add_container(container)
+
+    def pod_replicas(self):
+        if self.ctx.args.nproc_per_node:
+            return int(self.ctx.args.nproc_per_node)
+        # one process per HOST on TPU (single-controller SPMD)
+        return 1
+
+
+class CollectiveController(Controller):
+    """Build the node's pod for a collective job (reference
+    collective.py:21): discover peers through the master, then spawn
+    workers with the PADDLE_*/JAX bootstrap env."""
+
+    @classmethod
+    def enable(cls, ctx):
+        return True
+
+    def build_pod(self):
+        replicas = self.pod_replicas()
+        data = json.dumps({
+            "name": self.pod.name,
+            "rank": self.ctx.args.rank if self.ctx.args.rank is not None
+            else -1,
+            "replicas": replicas,
+            "dtype": self.ctx.node.device.dtype,
+            "candidate": f"{self.ctx.node.ip}:"
+                         f"{self.ctx.node.get_free_port()}",
+        })
+        nnodes = self.job.replicas
+        peer_list, _ = self.master.sync_peers(
+            f"/{self.job.id}/info", self.pod.name, data, nnodes)
+        peers = [json.loads(p) for p in peer_list]
+        # sync_peers orders by pod NAME (random); when users pinned
+        # explicit --rank values the coordinator (global rank 0) must be
+        # the rank-0 NODE, so re-order by the reported ranks — name
+        # order only when no rank was pinned anywhere
+        if all(pr["rank"] >= 0 for pr in peers):
+            peers.sort(key=lambda pr: pr["rank"])
+        rank = next(i for i, pr in enumerate(peers)
+                    if pr["name"] == self.pod.name)
+        self.pod.rank = rank
+        global_size = sum(pr["replicas"] for pr in peers)
+        rank_offset = sum(pr["replicas"] for pr in peers[:rank])
+        coordinator = peers[0]["candidate"]
+        endpoints = [p["candidate"] for p in peers]
+        for i in range(replicas):
+            e = {
+                "PADDLE_MASTER": coordinator,
+                "PADDLE_NNODES": str(global_size),
+                "PADDLE_TRAINER_ID": str(rank_offset + i),
+                "PADDLE_TRAINERS_NUM": str(global_size),
+                "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+                "PADDLE_LOCAL_RANK": str(i),
+                "JAX_COORDINATOR_ADDRESS": coordinator,
+            }
+            self.add_container(envs=e, log_file=f"workerlog.{i}")
+        return True
+
+
+class CollectiveElasticController(CollectiveController):
+    """Elastic collective (reference collective.py:184): watch + rebuild
+    on failure while the job's nnodes range allows it."""
+
+    @classmethod
+    def enable(cls, ctx):
+        return bool(ctx.args.master)
+
+    def run(self):
+        self.build_job()
+        attempts = max(1, self.job.replicas_max - self.job.replicas_min
+                       + 1)
+        for _ in range(attempts):
+            self.pod.reset()
+            self.build_pod()
+            self.deploy_pod()
+            if self.watch():
+                return
+            self.ctx.logger.warning("pod failed; elastic restart")
+        self.ctx.status.fail()
+
+
+class PSController(Controller):
+    """PS-mode pod: server containers then trainer containers
+    (reference ps.py:21); the PS tables themselves live in
+    distributed/ps.py."""
+
+    @classmethod
+    def enable(cls, ctx):
+        return ctx.args.run_mode == ControleMode.PS
+
+    def build_pod(self):
+        servers = int(os.environ.get("PADDLE_PSERVER_NUM", 1))
+        trainers = self.pod_replicas()
+        for i in range(servers):
+            self.add_container(
+                envs={"PADDLE_ROLE": "PSERVER",
+                      "PADDLE_PSERVER_ID": str(i)},
+                log_file=f"serverlog.{i}")
+        for i in range(trainers):
+            self.add_container(
+                envs={"PADDLE_ROLE": "TRAINER",
+                      "PADDLE_TRAINER_ID": str(i)},
+                log_file=f"workerlog.{i}")
+        return True
+
+
+class IPUController(CollectiveController):
+    """IPU hardware is out of scope for a TPU-native runtime."""
+
+    @classmethod
+    def enable(cls, ctx):
+        return False
+
+    def build_pod(self):
+        raise RuntimeError("IPU is not supported on the TPU runtime")
+
+
+class Watcher:
+    """Resource watcher (reference watcher.py:22): samples device info
+    into the log dir (when set) and keeps a BOUNDED in-memory window —
+    a multi-day job must not grow the controller without limit."""
+
+    MAX_SAMPLES = 720   # ~1h at the 5s cadence
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.stopped = False
+        self.samples = []
+        self._log_path = (os.path.join(ctx.args.log_dir, "devicelog")
+                          if ctx.args.log_dir else None)
+        import threading
+        self.proc = threading.Thread(target=self.watch, daemon=True)
+        self.proc.start()
+
+    def watch(self):
+        from paddle_tpu.distributed.launch.utils import get_gpu_info
+        while not self.stopped:
+            info = get_gpu_info()
+            self.samples.append(info)
+            if len(self.samples) > self.MAX_SAMPLES:
+                del self.samples[:len(self.samples) - self.MAX_SAMPLES]
+            if self._log_path:
+                try:
+                    with open(self._log_path, "a") as fh:
+                        fh.write(json.dumps(
+                            [i.dict() for i in info]) + "\n")
+                except OSError:
+                    pass
+            time.sleep(5)
+
+    def stop(self):
+        self.stopped = True
+
+
+def init(ctx):
+    """Pick the controller for the context (reference
+    controllers/__init__.py:33)."""
+    for cls in (PSController, CollectiveElasticController,
+                CollectiveController):
+        if cls.enable(ctx):
+            return cls(ctx)
+    raise RuntimeError("no controller enabled for this context")

@@ -1,0 +1,230 @@
+"""Job model (reference: distributed/launch/job/ — job.py Job/JobMode,
+pod.py Pod/PodSepc, container.py Container, status.py Status): a Job is
+N Pods (one per node), each Pod runs Containers (worker processes)."""
+from __future__ import annotations
+
+import os
+import uuid
+
+from paddle_tpu.distributed.launch.context import Status  # noqa: F401
+
+__all__ = ["Job", "JobMode", "Pod", "PodSepc", "Container", "Status"]
+
+
+class JobMode:
+    COLLECTIVE = "collective"
+    PS = "ps"
+    HETER = "heter"
+
+
+class Job:
+    def __init__(self, jid="default", mode=JobMode.COLLECTIVE, nnodes="1"):
+        self.mode = mode
+        self.id = jid
+        self.replicas = 0
+        # "N" or "N:M" elastic range (reference job.py)
+        nnodes = str(nnodes)
+        if ":" in nnodes:
+            lo, hi = nnodes.split(":")
+            self.replicas_min, self.replicas_max = int(lo), int(hi)
+        else:
+            self.replicas_min = self.replicas_max = int(nnodes or 1)
+        self.replicas = self.replicas_min
+
+    @property
+    def elastic(self):
+        return self.replicas_min < self.replicas_max
+
+
+class Container:
+    """One worker process + its env/log plumbing (reference
+    container.py:23), backed by utils.ProcessContext."""
+
+    def __init__(self, entrypoint="", rank=-1, env=None):
+        self._entrypoint = entrypoint
+        self._rank = rank
+        self._env = dict(env or {})
+        self._proc = None
+        self._out = None
+        self._err = None
+        self._log_handler = None
+
+    @property
+    def entrypoint(self):
+        return self._entrypoint
+
+    @entrypoint.setter
+    def entrypoint(self, ep):
+        self._entrypoint = ep
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @rank.setter
+    def rank(self, r):
+        self._rank = r
+
+    @property
+    def outfile(self):
+        return self._out
+
+    @outfile.setter
+    def outfile(self, out):
+        self._out = out
+
+    @property
+    def errfile(self):
+        return self._err
+
+    @errfile.setter
+    def errfile(self, err):
+        self._err = err
+
+    def update_env(self, env=None, **kwargs):
+        self._env.update({k: v for k, v in (env or {}).items()
+                          if isinstance(v, str)})
+        self._env.update({k: v for k, v in kwargs.items()
+                          if isinstance(v, str)})
+
+    @property
+    def env(self):
+        return self._env
+
+    def start(self):
+        from paddle_tpu.distributed.launch.utils import ProcessContext
+        if self._proc and self._proc.alive():
+            return True
+        self._proc = ProcessContext(self._entrypoint, env=self._env,
+                                    out=self._out, err=self._err)
+        self._proc.start()
+        return True
+
+    def terminate(self, force=False):
+        if self._proc:
+            return self._proc.terminate(force)
+
+    def wait(self, timeout=None):
+        if self._proc:
+            return self._proc.wait(timeout)
+
+    @property
+    def exit_code(self):
+        return self._proc.exit_code() if self._proc else None
+
+    def status(self):
+        if self._proc is None:
+            return Status.UNINIT
+        if self._proc.alive():
+            return Status.RUNNING
+        if self._proc.exit_code() == 0:
+            return Status.COMPLETED
+        return Status.FAILED
+
+    def __str__(self):
+        return (f"Container rank {self._rank} status {self.status()} "
+                f"cmd {self._entrypoint}")
+
+
+class PodSepc:   # sic — the reference spells it this way (pod.py:23)
+    def __init__(self):
+        self._name = "".join(str(uuid.uuid4()).split("-")[:1])
+        self._containers = []
+        self._init_containers = []
+        self._resource = None
+        self._status = None
+        self._rank = -1
+        self._replicas = 0
+
+
+class Pod(PodSepc):
+    """This node's worker group (reference pod.py:43)."""
+
+    def __init__(self):
+        super().__init__()
+        self._status = Status()
+
+    def __str__(self):
+        return (f"Pod: {self.name}, replicas {self.replicas}, "
+                f"status {self.status()}")
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def replicas(self):
+        return self._replicas
+
+    @replicas.setter
+    def replicas(self, r):
+        self._replicas = r
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @rank.setter
+    def rank(self, r):
+        self._rank = r
+
+    @property
+    def containers(self):
+        return self._containers
+
+    def add_container(self, c):
+        c.rank = len(self._containers)
+        self._containers.append(c)
+
+    @property
+    def init_containers(self):
+        return self._init_containers
+
+    def add_init_container(self, c):
+        c.rank = len(self._init_containers)
+        self._init_containers.append(c)
+
+    def deploy(self):
+        for i in self._init_containers:
+            i.start()
+            i.wait()
+        for c in self._containers:
+            c.start()
+        self._status.run()
+
+    def stop(self, sigint=15, timeout=None):
+        for c in self._containers:
+            c.terminate(force=(sigint == 9))
+        if timeout:
+            self.join(timeout)
+
+    def join(self, timeout=None):
+        for c in self._containers:
+            c.wait(timeout)
+
+    def status(self):
+        statuses = [c.status() for c in self._containers]
+        if not statuses:
+            return Status.UNINIT
+        if any(s == Status.FAILED for s in statuses):
+            return Status.FAILED
+        if all(s == Status.COMPLETED for s in statuses):
+            return Status.COMPLETED
+        if any(s == Status.RUNNING for s in statuses):
+            return Status.RUNNING
+        return Status.READY
+
+    def failed_container(self):
+        return [c for c in self._containers
+                if c.status() == Status.FAILED]
+
+    @property
+    def exit_code(self):
+        for c in self._containers:
+            if c.exit_code not in (0, None):
+                return c.exit_code
+        return 0
+
+    def reset(self):
+        self._containers = []
+        self._init_containers = []

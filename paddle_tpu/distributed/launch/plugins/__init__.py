@@ -1,0 +1,87 @@
+"""Launch plugins (reference: distributed/launch/plugins/__init__.py
+log/process_args/collective_compatible + test.py's smoke-train trio)."""
+from __future__ import annotations
+
+__all__ = []
+
+
+def log(ctx):
+    ctx.print()
+
+
+def process_args(ctx):
+    argdev = ctx.args.devices
+    if argdev:
+        for d in argdev.split(","):
+            if d not in ctx.node.device.labels:
+                ctx.logger.error(
+                    f"device {d} not in node inventory "
+                    f"{ctx.node.device.labels}")
+
+
+def collective_compatible(ctx):
+    """Honor legacy PADDLE_TRAINER_ENDPOINTS env (reference behavior):
+    derive master + nnodes from the endpoint list."""
+    if "PADDLE_TRAINER_ENDPOINTS" in ctx.envs:
+        eps = ctx.envs["PADDLE_TRAINER_ENDPOINTS"].split(",")
+        hosts = {h.split(":")[0] for h in eps}
+        ctx.args.master = eps[0] if ":" in eps[0] else f"{eps[0]}:6768"
+        ctx.args.nnodes = str(len(hosts))
+
+
+enabled_plugins = [collective_compatible, process_args, log]
+
+
+# ---- test.py trio (reference plugins/test.py): a ready-made smoke
+# train for validating a fresh multi-host setup ------------------------
+from paddle_tpu.io import Dataset  # noqa: E402
+
+
+class RandomDataset(Dataset):
+    def __init__(self, num_samples):
+        self.num_samples = num_samples
+
+    def __getitem__(self, idx):
+        import numpy as np
+        rng = np.random.RandomState(idx)
+        image = rng.random(size=(3, 224, 224)).astype("float32")
+        label = rng.randint(0, 100, (1,)).astype("int64")
+        return image, label
+
+    def __len__(self):
+        return self.num_samples
+
+
+def optimizer_setting(parameter_list=None):
+    import paddle_tpu as paddle
+    return paddle.optimizer.Momentum(
+        learning_rate=0.01, momentum=0.9, parameters=parameter_list)
+
+
+def train_resnet(epoch=1, batch_size=8, batch_num=2):
+    """Tiny distributed ResNet run (reference plugins/test.py:56)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.vision.models import resnet18
+
+    fleet.init(is_collective=True)
+    model = resnet18(num_classes=100)
+    opt = optimizer_setting(model.parameters())
+    opt = fleet.distributed_optimizer(opt)
+    model = fleet.distributed_model(model)
+    loader = DataLoader(RandomDataset(batch_num * batch_size),
+                        batch_size=batch_size, shuffle=True,
+                        drop_last=True)
+    losses = []
+    for _ in range(epoch):
+        model.train()
+        for img, label in loader:
+            out = model(img)
+            loss = paddle.nn.functional.cross_entropy(out,
+                                                      label.reshape([-1]))
+            loss.backward()
+            opt.step()
+            model.clear_gradients()
+            losses.append(float(loss.numpy()))
+    return losses

@@ -99,8 +99,58 @@ def recompute(function, *args, **kwargs):
     return tuple(user)
 
 
+class _SegmentChain:
+    """Callable chunk of a Sequential whose parameters recompute() can
+    lift: registers every member Layer so _owner_layer finds them all."""
+
+    def __init__(self, fns):
+        from paddle_tpu.nn.layer.layers import Layer
+        self._holder = Layer()
+        self._fns = list(fns)
+        for i, f in enumerate(self._fns):
+            if isinstance(f, Layer):
+                self._holder.add_sublayer(str(i), f)
+        # recompute() lifts params via function.__self__
+        self.__self__ = self._holder
+
+    def __call__(self, *args, **kwargs):
+        # first member takes the user's full signature; the rest chain
+        # on its (single) output like the reference's do_run
+        x = self._fns[0](*args, **kwargs)
+        for f in self._fns[1:]:
+            x = f(x)
+        return x
+
+
 def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Chunk a Sequential into ctx['segments'] recompute regions
+    (reference fleet/recompute/recompute.py:512). Each chunk is wrapped
+    so ALL member layers' parameters lift into the checkpointed region
+    — a bare closure would silently drop their gradients."""
+    ctx = dict(ctx or {})
+    segments = max(int(ctx.get("segments", 1)), 1)
+    from paddle_tpu.nn.layer.container import Sequential
+    if isinstance(functions, Sequential):
+        functions = [m for _, m in functions.named_children()]
+    functions = list(functions)
+    seg = max(len(functions) // segments, 1)
     out = args
-    for fn in functions:
-        out = recompute(fn, *(out if isinstance(out, tuple) else (out,)), **kwargs)
+    pos = 0
+    while pos < len(functions):
+        end = min(pos + seg, len(functions))
+        if len(functions) - end < seg:
+            end = len(functions)
+        chain = _SegmentChain(functions[pos:end])
+        out = recompute(chain, *(out if isinstance(out, tuple)
+                                 else (out,)), **kwargs)
+        pos = end
     return out
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """Hybrid-parallel recompute (reference recompute_hybrid.py:234):
+    the ctx's mp_group/offload/partition keys configure hand-partitioned
+    activation storage there; under XLA rematerialized values keep their
+    producers' shardings, so this reduces to recompute."""
+    kwargs.pop("preserve_rng_state", None)
+    return recompute(function, *args, **kwargs)

@@ -21,6 +21,18 @@ from paddle_tpu.framework.state import (  # noqa: F401
 )
 
 
+def iinfo(dtype):
+    """Integer dtype limits (reference framework/__init__.py iinfo)."""
+    import paddle_tpu
+    return paddle_tpu.iinfo(dtype)
+
+
+def finfo(dtype):
+    """Float dtype limits (reference framework/__init__.py finfo)."""
+    import paddle_tpu
+    return paddle_tpu.finfo(dtype)
+
+
 def in_dynamic_mode():
     from paddle_tpu.jit.api import _in_to_static_trace
     return not _in_to_static_trace()

@@ -212,3 +212,4 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
         e = np.concatenate(out_e) if out_e else np.zeros(0, rw.dtype)
         res = res + (Tensor(jnp.asarray(e)),)
     return res
+from paddle_tpu.geometric import message_passing  # noqa: E402,F401

@@ -1,0 +1,53 @@
+"""paddle.geometric.message_passing utils parity (reference:
+geometric/message_passing/utils.py:22,36,61)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["convert_out_size_to_list", "get_out_size_tensor_inputs",
+           "reshape_lhs_rhs"]
+
+
+def convert_out_size_to_list(out_size):
+    """Normalize out_size (None | int | 0-d tensor) to a 1-list."""
+    if out_size is None:
+        return [0]
+    if isinstance(out_size, (int, np.integer)):
+        return [int(out_size)]
+    return [int(np.asarray(out_size.numpy()
+                           if hasattr(out_size, "numpy")
+                           else out_size).reshape(-1)[0])]
+
+
+def get_out_size_tensor_inputs(inputs, attrs, out_size, op_type):
+    """Static-graph form: record out_size into attrs/inputs. Shapes are
+    static under XLA, so a tensor out_size is materialized at trace
+    time."""
+    if out_size is None:
+        attrs["out_size"] = [0]
+    elif isinstance(out_size, (int, np.integer)):
+        attrs["out_size"] = [int(out_size)]
+    else:
+        inputs["Out_size"] = out_size
+    return inputs, attrs
+
+
+def reshape_lhs_rhs(x, y):
+    """Pad the lower-rank operand with middle singleton dims so
+    elementwise message ops broadcast like the reference."""
+    import paddle_tpu as P
+    if len(x.shape) == 1:
+        x = P.reshape(x, [-1, 1])
+    if len(y.shape) == 1:
+        y = P.reshape(y, [-1, 1])
+    if len(x.shape) != len(y.shape):
+        max_nd = max(len(x.shape), len(y.shape))
+        if len(x.shape) < max_nd:
+            shape = [x.shape[0]] + [1] * (max_nd - len(x.shape)) + \
+                list(x.shape[1:])
+            x = P.reshape(x, shape)
+        else:
+            shape = [y.shape[0]] + [1] * (max_nd - len(y.shape)) + \
+                list(y.shape[1:])
+            y = P.reshape(y, shape)
+    return x, y

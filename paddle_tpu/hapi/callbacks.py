@@ -112,3 +112,95 @@ class VisualDL(Callback):
 
     def on_train_batch_end(self, step, logs=None):
         self.records.append((step, logs))
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce the optimizer lr by `factor` after `patience` evals without
+    improvement on `monitor` (reference hapi/callbacks.py:1169)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0.0):
+        self.monitor = monitor
+        self.factor = float(factor)
+        self.patience = patience
+        self.verbose = verbose
+        if mode == "auto":
+            mode = "max" if "acc" in (monitor or "") else "min"
+        self.mode = mode
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.best = None
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def _better(self, cur):
+        if self.best is None:
+            return True
+        if self.mode == "min":
+            return cur < self.best - self.min_delta
+        return cur > self.best + self.min_delta
+
+    def on_eval_end(self, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        cur = float(np.asarray(cur).reshape(-1)[0])
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self._better(cur):
+            self.best = cur
+            self.wait = 0
+            return
+        if self.cooldown_counter > 0:
+            return
+        self.wait += 1
+        if self.wait < self.patience:
+            return
+        opt = getattr(self.model, "_optimizer", None)
+        if opt is None:
+            return
+        lr = opt.get_lr()
+        new_lr = max(lr * self.factor, self.min_lr)
+        if new_lr < lr:
+            opt.set_lr(new_lr)
+            if self.verbose:
+                print(f"ReduceLROnPlateau: lr {lr:.3e} -> {new_lr:.3e}")
+        self.cooldown_counter = self.cooldown
+        self.wait = 0
+
+
+class WandbCallback(Callback):
+    """Weights & Biases logger (reference hapi/callbacks.py WandbCallback).
+    Requires the `wandb` package, which this zero-egress build does not
+    bundle — construction degrades to a local record unless wandb is
+    importable."""
+
+    def __init__(self, project=None, name=None, dir=None, mode=None,
+                 job_type=None, **kwargs):
+        try:
+            import wandb
+            self.wandb = wandb
+            self.run = wandb.init(project=project, name=name, dir=dir,
+                                  mode=mode, job_type=job_type, **kwargs)
+        except ImportError:
+            self.wandb = None
+            self.run = None
+            self.records = []
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.run is not None:
+            self.run.log(dict(logs or {}), step=step)
+        else:
+            self.records.append(("train", step, dict(logs or {})))
+
+    def on_eval_end(self, logs=None):
+        if self.run is not None:
+            self.run.log({f"eval/{k}": v for k, v in (logs or {}).items()})
+        else:
+            self.records.append(("eval", None, dict(logs or {})))
+
+    def on_train_end(self, logs=None):
+        if self.run is not None:
+            self.run.finish()

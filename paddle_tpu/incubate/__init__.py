@@ -59,3 +59,10 @@ __all__ = [
     "segment_min",
     "identity_loss",
 ]
+
+
+def set_config(config=None):
+    """paddle.incubate.set_config — the autotune configuration entry
+    (reference incubate/__init__.py re-exports autotune.set_config)."""
+    from paddle_tpu.incubate.autotune import set_config as _set
+    return _set(config)

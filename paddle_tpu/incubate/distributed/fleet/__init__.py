@@ -1,0 +1,8 @@
+"""paddle.incubate.distributed.fleet parity (reference re-exports the
+fleet recompute entries)."""
+from paddle_tpu.distributed.fleet.recompute_api import (  # noqa: F401
+    recompute_hybrid,
+    recompute_sequential,
+)
+
+__all__ = ["recompute_sequential", "recompute_hybrid"]

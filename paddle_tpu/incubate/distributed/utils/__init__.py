@@ -1,0 +1,2 @@
+"""paddle.incubate.distributed.utils parity namespace."""
+from paddle_tpu.incubate.distributed.utils import io  # noqa: F401

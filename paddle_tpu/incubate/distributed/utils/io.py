@@ -1,0 +1,91 @@
+"""Distributed save/load helpers (reference:
+incubate/distributed/utils/io/dist_save.py:30 save, dist_load.py:24
+load/:94 load_with_place, save_for_auto.py:34 save_for_auto_inference).
+
+The reference gathers sharded (mp/pp) state to rank 0 before writing;
+here state tensors are jax global arrays whose addressable shards
+gather through the array API, so save/load defer to framework.io with a
+gather step for sharded values.
+"""
+from __future__ import annotations
+
+__all__ = ["save", "load", "load_with_place", "save_for_auto_inference"]
+
+
+def _gather_full(value):
+    """Materialize a (possibly sharded) jax array fully addressable."""
+    import jax
+    v = getattr(value, "_value", value)
+    if isinstance(v, jax.Array) and not v.is_fully_addressable:
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec
+        mesh = v.sharding.mesh if hasattr(v.sharding, "mesh") else None
+        if mesh is not None:
+            rep = NamedSharding(mesh, PartitionSpec())
+            return jax.device_put(v, rep)
+    return v
+
+
+def save(state_dict, path, gather_to=0, state_type="params", **configs):
+    """Gather sharded entries, then paddle save (single artifact)."""
+    import numpy as np
+
+    import paddle_tpu
+    full = {k: np.asarray(_gather_full(v)) for k, v in state_dict.items()}
+    process_index = 0
+    try:
+        import jax
+        process_index = jax.process_index()
+    except Exception:
+        pass
+    if process_index == int(gather_to):
+        paddle_tpu.save(full, path, **configs)
+
+
+def load(path, place=None, **configs):
+    import paddle_tpu
+    return paddle_tpu.load(path, **configs)
+
+
+def load_with_place(path, place=None, **configs):
+    """Load then commit every tensor to `place` (reference
+    dist_load.py:94). Accepts a paddle place (CPUPlace/TPUPlace) or a
+    jax device."""
+    import paddle_tpu
+    obj = paddle_tpu.load(path, **configs)
+    if place is None or not hasattr(obj, "items"):
+        return obj
+    import jax
+
+    import paddle_tpu as P
+    platform = getattr(place, "_platform", None) or \
+        ("cpu" if type(place).__name__ == "CPUPlace" else "tpu")
+    try:
+        dev = jax.devices(platform)[0]
+    except RuntimeError:
+        dev = jax.devices()[0]
+    out = {}
+    for k, v in obj.items():
+        t = P.to_tensor(v)
+        t._set_value(jax.device_put(t._value, dev))
+        out[k] = t
+    return out
+
+
+def save_for_auto_inference(path_prefix, dist_model, cvt2cpu=False):
+    """Persist a distributed model for single-process inference
+    (reference save_for_auto.py:34): gather every parameter full and
+    write one params artifact + a meta file."""
+    import numpy as np
+
+    import paddle_tpu
+    sd = dist_model.state_dict() if hasattr(dist_model, "state_dict") \
+        else dict(dist_model)
+    full = {k: np.asarray(_gather_full(v)) for k, v in sd.items()}
+    paddle_tpu.save(full, path_prefix + "_dist0.pdparams")
+    import json
+    import os
+    meta = {"keys": sorted(full), "format": "gathered-full"}
+    with open(path_prefix + ".meta.json", "w") as fh:
+        json.dump(meta, fh)
+    return path_prefix + "_dist0.pdparams"

@@ -106,3 +106,17 @@ def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
                   flag_buffer_hashtable=False, name=None):
     from paddle_tpu.geometric import reindex_graph
     return reindex_graph(x, neighbors, count, value_buffer, index_buffer)
+
+
+def convert_out_size_to_list(out_size):
+    """Reference incubate/operators/graph_*.py helper — shared with
+    geometric.message_passing."""
+    from paddle_tpu.geometric.message_passing import (
+        convert_out_size_to_list as impl)
+    return impl(out_size)
+
+
+def get_out_size_tensor_inputs(inputs, attrs, out_size, op_type):
+    from paddle_tpu.geometric.message_passing import (
+        get_out_size_tensor_inputs as impl)
+    return impl(inputs, attrs, out_size, op_type)

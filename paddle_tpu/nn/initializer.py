@@ -234,3 +234,44 @@ class ParamAttr:
         self.regularizer = regularizer
         self.trainable = trainable
         self.need_clip = need_clip
+
+
+class Bilinear(Initializer):
+    """Bilinear-interpolation kernel init for transposed-conv upsampling
+    (reference fluid/initializer.py:1034 BilinearInitializer): every
+    channel of a (C, 1|Cin, K, K) filter gets the same (K, K) separable
+    triangle kernel, so a stride-f Conv2DTranspose performs bilinear
+    x f upsampling."""
+
+    def _generate(self, shape, dtype):
+        if len(shape) != 4:
+            raise ValueError("Bilinear expects a 4-D conv filter shape")
+        k = shape[3]
+        f = int(np.ceil(k / 2.0))
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        ax = np.arange(k)
+        tri = (1 - np.abs(ax / f - c))
+        kern = np.outer(tri, tri)
+        out = np.zeros(shape, np.float64)
+        out[...] = kern  # broadcast over the leading channel dims
+        return jnp.asarray(out).astype(dtype)
+
+
+# global default initializers (reference fluid/initializer.py:1346
+# set_global_initializer): consulted by Layer.create_parameter when no
+# per-param initializer was given
+_global_weight_init = [None]
+_global_bias_init = [None]
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    for v, nm in ((weight_init, "weight_init"), (bias_init, "bias_init")):
+        if v is not None and not isinstance(v, Initializer):
+            raise TypeError(f"{nm} must be an Initializer or None, "
+                            f"got {type(v)}")
+    _global_weight_init[0] = weight_init
+    _global_bias_init[0] = bias_init
+
+
+def _global_default(is_bias):
+    return _global_bias_init[0] if is_bias else _global_weight_init[0]

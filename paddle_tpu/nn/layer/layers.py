@@ -106,6 +106,12 @@ class Layer:
         pa = attr if isinstance(attr, I.ParamAttr) else None
         if pa is not None and pa.initializer is not None:
             init = pa.initializer
+        if pa is None or pa.initializer is None:
+            # set_global_initializer: overrides the layer's built-in
+            # default but never an explicit ParamAttr initializer
+            g = I._global_default(is_bias)
+            if g is not None:
+                init = g
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierUniform()
         shape = tuple(int(s) for s in shape)

@@ -313,3 +313,63 @@ class QuantizedColumnParallelLinear(_QuantizedParallelLinear):
 
 class QuantizedRowParallelLinear(_QuantizedParallelLinear):
     pass
+
+
+class QuantizedConv2DTranspose(Layer):
+    """QAT transposed conv: fake-quantized weight + activation around the
+    float conv2d_transpose (reference quant_layers.py:614)."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max", **kw):
+        super().__init__()
+        self._layer = layer
+        if weight_quantize_type == "abs_max":
+            self._wfq = FakeQuantAbsMax(quant_bits=weight_bits)
+        else:
+            # transposed filters are [Cin, Cout/g, kh, kw]: channel axis 1
+            self._wfq = FakeQuantChannelWiseAbsMax(quant_bits=weight_bits,
+                                                   quant_axis=1)
+        if activation_quantize_type == "abs_max":
+            self._afq = FakeQuantAbsMax(quant_bits=activation_bits)
+        else:
+            self._afq = FakeQuantMovingAverageAbsMax(
+                moving_rate=moving_rate, quant_bits=activation_bits)
+
+    def forward(self, x, output_size=None):
+        from paddle_tpu.nn import functional as F
+        lay = self._layer
+        w = self._wfq(lay.weight)
+        return F.conv2d_transpose(
+            self._afq(x), w, lay.bias, stride=lay._stride,
+            padding=lay._padding, output_padding=lay._output_padding,
+            dilation=lay._dilation, groups=lay._groups,
+            data_format=lay._data_format, output_size=output_size)
+
+
+import jax as _jax
+
+
+@_jax.custom_vjp
+def _ste_round(v):
+    import jax.numpy as jnp
+    return jnp.round(v)
+
+
+def _ste_round_fwd(v):
+    return _ste_round(v), None
+
+
+def _ste_round_bwd(_, ct):
+    return (ct,)
+
+
+_ste_round.defvjp(_ste_round_fwd, _ste_round_bwd)
+
+
+def round(x):
+    """Straight-through round (reference nn/quant/functional_layers.py):
+    rounds in the forward, identity gradient in the backward — usable
+    inside QAT graphs."""
+    from paddle_tpu.core.dispatch import apply
+    return apply(_ste_round, x)

@@ -592,3 +592,10 @@ def wrap_tree(nodetrees):
         wrapped.cal_statistic()
         out[tid] = wrapped
     return out
+
+
+def get_profiler(*a, **kw):
+    """Legacy entry (reference profiler/profiler.py get_profiler): routes
+    to the utils facade over this module's Profiler."""
+    from paddle_tpu.utils import get_profiler as _legacy
+    return _legacy(*a, **kw)

@@ -1,0 +1,18 @@
+"""paddle.sysconfig parity (reference: python/paddle/sysconfig.py):
+paths for building native extensions against the installed package."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    """Directory holding the package's C headers (native/ ships the
+    ctypes-backed runtime sources here)."""
+    return os.path.join(_ROOT, "include")
+
+
+def get_lib():
+    """Directory holding the package's compiled native libraries."""
+    return os.path.join(_ROOT, "native")

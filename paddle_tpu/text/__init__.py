@@ -23,13 +23,14 @@ from paddle_tpu.nn.layer.layers import Layer
 
 __all__ = ["viterbi_decode", "ViterbiDecoder", "datasets",
            "Imdb", "Imikolov", "Movielens", "UCIHousing", "Conll05",
-           "WMT14", "WMT16"]
+           "Conll05st", "WMT14", "WMT16"]
 
 from paddle_tpu.text import datasets  # noqa: F401,E402
 # dataset classes at the reference path (python/paddle/text/__init__.py
 # re-exports paddle.text.Imdb etc. directly)
 from paddle_tpu.text.datasets import (  # noqa: F401,E402
     Conll05,
+    Conll05st,
     Imdb,
     Imikolov,
     Movielens,

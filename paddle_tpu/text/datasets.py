@@ -14,6 +14,7 @@ import numpy as np
 from paddle_tpu.io import Dataset
 
 __all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "Conll05",
+           "Conll05st",
            "WMT14", "WMT16"]
 
 
@@ -202,3 +203,7 @@ class WMT16(WMT14):
                  trg_dict_size=-1, lang="en", download=True):
         super().__init__(mode=mode,
                          dict_size=max(src_dict_size, trg_dict_size))
+
+
+# the reference exports this dataset as Conll05st (text/datasets/conll05.py)
+Conll05st = Conll05

@@ -1,0 +1,325 @@
+"""Second round-4 wiring sweep: launch package (context/job/controllers/
+kv), fleet mounts (layers.mpu, elastic, meta_optimizers), segmented
+recompute, global initializer, quant fills, datasets, misc namespaces."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as p
+
+
+class TestLaunchPackage:
+    def test_context_node_device(self):
+        from paddle_tpu.distributed.launch.context import (
+            Context, Device, DeviceType)
+        ctx = Context(enable_plugin=False,
+                      argv=["--nnodes", "1", "s.py"])
+        assert ctx.node.device.count >= 1
+        assert ctx.node.device.dtype in (DeviceType.CPU, DeviceType.TPU)
+        d = Device(DeviceType.TPU, 4, labels=["0", "1", "2", "3"])
+        assert d.get_selected_devices("1,3") == ["1", "3"]
+        assert d.get_selected_device_key() == "TPU_VISIBLE_CHIPS"
+
+    def test_kv_server_client_roundtrip(self):
+        from paddle_tpu.distributed.launch.utils import KVClient, KVServer
+        from paddle_tpu.distributed.utils import find_free_ports
+        port = sorted(find_free_ports(1))[0]
+        s = KVServer(port)
+        s.start()
+        try:
+            c = KVClient(f"127.0.0.1:{port}")
+            assert c.wait_server_ready(10)
+            assert c.put("/j/n0", "a") and c.put("/j/n1", "b")
+            assert c.get("/j/n0") == "a"
+            assert sorted(c.get_prefix("/j").values()) == ["a", "b"]
+            c.delete("/j/n0")
+            assert list(c.get_prefix("/j").values()) == ["b"]
+        finally:
+            s.stop()
+
+    def test_pod_deploys_real_subprocess(self, tmp_path):
+        from paddle_tpu.distributed.launch.job import Container, Pod
+        pod = Pod()
+        c = Container(entrypoint=[sys.executable, "-c",
+                                  "print('hi worker')"],
+                      env=dict(os.environ))
+        c.outfile = str(tmp_path / "w0.log")
+        pod.add_container(c)
+        pod.deploy()
+        pod.join(timeout=60)
+        assert pod.status() == "completed"
+        assert pod.exit_code == 0
+        assert "hi worker" in (tmp_path / "w0.log").read_text()
+
+    def test_collective_controller_single_node_env(self):
+        from paddle_tpu.distributed.launch import controllers
+        from paddle_tpu.distributed.launch.context import Context
+        ctx = Context(enable_plugin=False,
+                      argv=["--nnodes", "1", "--job_id", "t", "s.py"])
+        ctrl = controllers.init(ctx)
+        ctrl.build_job()
+        ctrl.build_pod()
+        env = ctrl.pod.containers[0].env
+        assert env["PADDLE_TRAINERS_NUM"] == "1"
+        assert env["PADDLE_TRAINER_ID"] == "0"
+        assert "PADDLE_MASTER" in env
+
+    def test_two_node_sync_orders_by_pinned_rank(self):
+        """Explicit --rank values must decide the coordinator (global
+        rank 0), not the random pod-name sort order of the KV store."""
+        import threading
+
+        from paddle_tpu.distributed.launch import controllers
+        from paddle_tpu.distributed.launch.context import Context
+        from paddle_tpu.distributed.utils import find_free_ports
+        port = sorted(find_free_ports(1))[0]
+        master = f"127.0.0.1:{port}"
+        ctrls, errs = [None, None], []
+
+        def node(i, rank):
+            try:
+                ctx = Context(enable_plugin=False, argv=[
+                    "--nnodes", "2", "--rank", str(rank),
+                    "--master", master, "--job_id", "ranked", "s.py"])
+                c = controllers.CollectiveController(ctx)
+                c.build_job()
+                c.build_pod()
+                ctrls[i] = c
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        # start node with rank 1 FIRST so name-order != rank-order bugs
+        # have every chance to misassign the coordinator
+        t1 = threading.Thread(target=node, args=(0, 1))
+        t2 = threading.Thread(target=node, args=(1, 0))
+        t1.start()
+        t2.start()
+        t1.join(60)
+        t2.join(60)
+        try:
+            assert not errs, errs
+            by_rank = {c.pod.rank: c for c in ctrls}
+            assert set(by_rank) == {0, 1}
+            env0 = by_rank[0].pod.containers[0].env
+            env1 = by_rank[1].pod.containers[0].env
+            # both agree on the coordinator, and it is rank 0's candidate
+            assert env0["PADDLE_MASTER"] == env1["PADDLE_MASTER"]
+            assert env0["PADDLE_TRAINER_ID"] == "0"
+            assert env1["PADDLE_TRAINER_ID"] == "1"
+            eps = env0["PADDLE_TRAINER_ENDPOINTS"].split(",")
+            assert env0["PADDLE_MASTER"] == eps[0]
+        finally:
+            for c in ctrls:
+                if c is not None:
+                    c.master.stop()
+
+    def test_failed_container_reported(self):
+        from paddle_tpu.distributed.launch.job import Container, Pod
+        pod = Pod()
+        c = Container(entrypoint=[sys.executable, "-c", "raise SystemExit(3)"],
+                      env=dict(os.environ))
+        pod.add_container(c)
+        pod.deploy()
+        pod.join(timeout=60)
+        assert pod.status() == "failed"
+        assert pod.exit_code == 3
+        assert pod.failed_container() == [c]
+
+
+class TestFleetMounts:
+    def test_layers_mpu_names(self):
+        from paddle_tpu.distributed.fleet.layers import mpu
+        for n in ("ColumnParallelLinear", "RowParallelLinear",
+                  "VocabParallelEmbedding", "ParallelCrossEntropy",
+                  "split"):
+            assert hasattr(mpu, n), n
+
+    def test_mpu_split_validates_partitions(self):
+        from paddle_tpu.distributed.fleet.layers.mpu import split
+        with pytest.raises(ValueError, match="num_partitions"):
+            split(p.ones([2, 4]), (4, 8), "linear", num_partitions=16)
+
+    def test_elastic_names_and_command(self, tmp_path):
+        from paddle_tpu.distributed.fleet import elastic as fe
+        assert fe.ElasticLevel.ELASTIC == 2
+        assert fe.ElasticStatus.RESTART == "restart"
+        from paddle_tpu.distributed import Command
+        cmd = Command(name="testjob")
+        try:
+            assert not cmd.scale_np(4)   # nothing stored yet
+            cmd.set_np(8)
+            assert cmd.scale_np(4)
+        finally:
+            cmd.clean()
+
+    def test_meta_optimizers(self):
+        from paddle_tpu.distributed.fleet import meta_optimizers as mo
+        assert mo.RawProgramOptimizer is not None
+        assert mo.ParameterServerOptimizer is not None
+        assert hasattr(mo.dygraph_optimizer, "ShardingOptimizerStage2")
+
+    def test_sharding_namespace_names(self):
+        from paddle_tpu.distributed.fleet import meta_parallel_sharding as s
+        for n in ("GradStorage", "InternalStorage", "ParamStorage",
+                  "ShardingScaler", "GroupShardedClipGrad",
+                  "ShardingClipGrad", "ForwardPreHooks",
+                  "ForwardPostHooks"):
+            assert hasattr(s, n), n
+
+
+class TestSegmentedRecompute:
+    def test_param_grads_flow_through_segments(self):
+        from paddle_tpu.incubate.distributed.fleet import (
+            recompute_hybrid, recompute_sequential)
+        p.seed(0)
+        net = p.nn.Sequential(p.nn.Linear(4, 8), p.nn.ReLU(),
+                              p.nn.Linear(8, 8), p.nn.ReLU(),
+                              p.nn.Linear(8, 4))
+        x = p.randn([2, 4])
+        out = recompute_sequential({"segments": 2}, net, x)
+        np.testing.assert_allclose(out.numpy(), net(x).numpy(), rtol=1e-6)
+        out.sum().backward()
+        assert all(q.grad is not None for q in net.parameters())
+        out2 = recompute_hybrid({"mp_group": None}, net, x)
+        np.testing.assert_allclose(out2.numpy(), net(x).numpy(),
+                                   rtol=1e-6)
+
+
+class TestGlobalInitializer:
+    def test_set_global_initializer(self):
+        import paddle_tpu.nn.initializer as I
+        I.set_global_initializer(I.Constant(0.25), I.Constant(0.5))
+        try:
+            lin = p.nn.Linear(3, 2)
+            np.testing.assert_allclose(lin.weight.numpy(), 0.25)
+            np.testing.assert_allclose(lin.bias.numpy(), 0.5)
+            # explicit ParamAttr initializer wins over the global
+            lin2 = p.nn.Linear(
+                3, 2, weight_attr=p.ParamAttr(
+                    initializer=I.Constant(7.0)))
+            np.testing.assert_allclose(lin2.weight.numpy(), 7.0)
+        finally:
+            I.set_global_initializer(None)
+        lin3 = p.nn.Linear(3, 2)
+        assert not np.allclose(lin3.weight.numpy(), 0.25)
+
+    def test_bilinear_kernel(self):
+        import paddle_tpu.nn.initializer as I
+        w = np.asarray(I.Bilinear()._generate((2, 1, 4, 4), "float32"))
+        # separable triangle kernel, rows sum symmetric
+        np.testing.assert_allclose(w[0, 0], w[1, 0])
+        np.testing.assert_allclose(w[0, 0, 0],
+                                   [0.0625, 0.1875, 0.1875, 0.0625])
+
+
+class TestQuantFills:
+    def test_quantized_conv2d_transpose(self):
+        from paddle_tpu.nn.quant import QuantizedConv2DTranspose
+        p.seed(0)
+        conv = p.nn.Conv2DTranspose(4, 6, 3)
+        q = QuantizedConv2DTranspose(conv)
+        x = p.uniform([2, 4, 8, 8], min=-1.0, max=1.0)
+        y, yq = conv(x), q(x)
+        assert y.shape == yq.shape
+        assert float(np.abs(y.numpy() - yq.numpy()).mean()) < 0.05
+
+    def test_ste_round(self):
+        from paddle_tpu.nn.quant import round as qround
+        x = p.to_tensor(np.array([0.4, 1.6, -2.3], np.float32),
+                        stop_gradient=False)
+        r = qround(x)
+        np.testing.assert_allclose(r.numpy(), [0.0, 2.0, -2.0])
+        r.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), 1.0)
+
+
+class TestDatasets:
+    def test_voc2012_split_coherence(self):
+        from paddle_tpu.vision.datasets import VOC2012
+        tr, va = VOC2012(mode="train"), VOC2012(mode="val")
+        img, m = tr[0]
+        assert img.shape == (3, 64, 64) and m.shape == (64, 64)
+        assert img.dtype == np.float32 and m.dtype == np.int64
+        classes = set(np.unique(m))
+        assert classes.issubset(set(range(21)) | {255})
+        assert 255 in classes  # border ignore
+        assert len(tr) == 128 and len(va) == 32
+
+    def test_conll05st_alias(self):
+        from paddle_tpu.text import datasets as td
+        assert td.Conll05st is td.Conll05
+
+
+class TestMiscNamespaces:
+    def test_small_fills(self):
+        assert os.path.isdir(os.path.dirname(p.sysconfig.get_lib()))
+        assert p.framework.iinfo("int8").max == 127
+        assert p.framework.finfo("float32").eps > 0
+        assert p.profiler.get_profiler() is not None
+        from paddle_tpu.check_import_scipy import check_import_scipy
+        check_import_scipy(os.name)
+        from paddle_tpu.incubate import set_config
+        set_config(None)
+        import paddle_tpu.jit as jit
+        assert jit.Function is jit.StaticFunction
+        assert "lambda" in repr(jit.FunctionInfo(lambda: 0))
+
+    def test_multiprocessing_reductions(self):
+        import pickle
+
+        from paddle_tpu.incubate.multiprocessing import init_reductions
+        init_reductions()
+        t = p.to_tensor(np.arange(6.0, dtype=np.float32).reshape(2, 3))
+        t2 = pickle.loads(pickle.dumps(t))
+        np.testing.assert_allclose(t.numpy(), t2.numpy())
+
+    def test_passes_registry(self):
+        from paddle_tpu.incubate.passes import fuse_resnet_unit, ir
+        assert "fuse_resnet_unit" in ir._registry
+        assert fuse_resnet_unit("prog") == "prog"
+
+    def test_message_passing_utils(self):
+        from paddle_tpu.geometric.message_passing import (
+            convert_out_size_to_list, reshape_lhs_rhs)
+        assert convert_out_size_to_list(None) == [0]
+        assert convert_out_size_to_list(5) == [5]
+        assert convert_out_size_to_list(p.to_tensor([9])) == [9]
+        x, y = reshape_lhs_rhs(p.ones([3]), p.ones([3, 2, 2]))
+        assert x.shape == [3, 1, 1] and y.shape == [3, 2, 2]
+
+    def test_custom_window_register(self):
+        from paddle_tpu.audio.functional import (
+            get_window, window_function_register)
+
+        @window_function_register.register()
+        def _test_flat(M):
+            return np.full(M, 0.25)
+
+        w = get_window("_test_flat", 6)
+        np.testing.assert_allclose(w.numpy(), 0.25)
+
+    def test_reduce_lr_on_plateau(self):
+        from paddle_tpu.callbacks import ReduceLROnPlateau
+        net = p.nn.Linear(2, 2)
+        opt = p.optimizer.SGD(learning_rate=0.1,
+                              parameters=net.parameters())
+
+        class FakeModel:
+            _optimizer = opt
+
+        cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=2,
+                               verbose=0)
+        cb.model = FakeModel()
+        cb.on_eval_end({"loss": 1.0})
+        cb.on_eval_end({"loss": 1.0})   # wait 1
+        cb.on_eval_end({"loss": 1.0})   # wait 2 -> reduce
+        assert abs(opt.get_lr() - 0.05) < 1e-9
+
+    def test_wandb_callback_degrades_locally(self):
+        from paddle_tpu.callbacks import WandbCallback
+        cb = WandbCallback(project="x")
+        cb.on_train_batch_end(0, {"loss": 1.0})
+        cb.on_eval_end({"acc": 0.5})
+        assert cb.run is None and len(cb.records) == 2
