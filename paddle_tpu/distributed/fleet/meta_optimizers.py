@@ -51,8 +51,15 @@ class dygraph_optimizer:
         subsumes it (states are the stage-1 subset of stage-2)."""
         from paddle_tpu.distributed.fleet.meta_parallel_sharding import (
             GroupShardedOptimizerStage2)
-        opt = (inner_optimizer_class(parameters=params, **inner_kw)
-               if inner_optimizer_class is not None else params)
+        from paddle_tpu.optimizer.optimizer import Optimizer
+        if inner_optimizer_class is None:
+            raise ValueError(
+                "DygraphShardingOptimizer needs inner_optimizer_class "
+                "(e.g. paddle_tpu.optimizer.AdamW) — there is no inner "
+                "optimizer to shard otherwise")
+        opt = (inner_optimizer_class
+               if isinstance(inner_optimizer_class, Optimizer)
+               else inner_optimizer_class(parameters=params, **inner_kw))
         return GroupShardedOptimizerStage2(params, opt)
 
     @staticmethod

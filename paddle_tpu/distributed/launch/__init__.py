@@ -20,7 +20,6 @@ Env fallbacks: PADDLE_MASTER, PADDLE_NNODES, PADDLE_TRAINER_ID
 """
 from __future__ import annotations
 
-import argparse
 import os
 import runpy
 import sys
@@ -75,19 +74,22 @@ def launch(master=None, nnodes=None, rank=None, watchdog_timeout=None):
 
 
 def main(argv=None):
-    p = argparse.ArgumentParser(prog="paddle_tpu.distributed.launch")
-    p.add_argument("--master", default=None,
-                   help="coordinator host:port (rank 0)")
-    p.add_argument("--nnodes", type=int, default=None)
-    p.add_argument("--rank", type=int, default=None, help="this node's rank")
-    p.add_argument("--watchdog-timeout", type=float, default=None)
-    p.add_argument("script")
-    p.add_argument("script_args", nargs=argparse.REMAINDER)
-    args = _from_env(p.parse_args(argv))
+    # the ONE CLI lives in context.parse_args (shared with Context)
+    from paddle_tpu.distributed.launch.context import parse_args
+    args, unknown = parse_args(argv)
+    if unknown:
+        raise SystemExit(f"unknown launch arguments: {unknown}")
+    if args.training_script is None:
+        raise SystemExit("missing training script")
+    # "N" or elastic "N:M" — the in-process fast path uses the minimum
+    if args.nnodes is not None and ":" in str(args.nnodes):
+        args.nnodes = str(args.nnodes).split(":")[0]
+    args.nnodes = int(args.nnodes) if args.nnodes else None
+    args = _from_env(args)
 
     launch(args.master, args.nnodes, args.rank, args.watchdog_timeout)
-    sys.argv = [args.script] + list(args.script_args)
-    runpy.run_path(args.script, run_name="__main__")
+    sys.argv = [args.training_script] + list(args.training_script_args)
+    runpy.run_path(args.training_script, run_name="__main__")
 
 
 if __name__ == "__main__":

@@ -140,19 +140,27 @@ class Resource:
 
 
 def fetch_envs():
-    """PADDLE_*/launch-relevant env snapshot (reference context
-    fetch_envs strips everything else)."""
-    keep_prefix = ("PADDLE_", "JAX_", "TPU_", "CUDA_", "POD_", "FLAGS_")
-    return {k: v for k, v in os.environ.items()
-            if k.startswith(keep_prefix)}
+    """Full environment snapshot minus proxies (reference context copies
+    os.environ; workers NEED PATH/HOME/PYTHONPATH/LD_LIBRARY_PATH — a
+    prefix-filtered env would strand every spawned trainer)."""
+    env = dict(os.environ)
+    env.pop("http_proxy", None)
+    env.pop("https_proxy", None)
+    return env
 
 
 def parse_args(argv=None):
+    """THE launch CLI — one parser shared by `python -m ...launch`
+    (launch/__init__.py main) and Context, so the flag surface cannot
+    drift between the two."""
     p = argparse.ArgumentParser(prog="paddle_tpu.distributed.launch",
                                 allow_abbrev=False)
-    p.add_argument("--master", default=None)
-    p.add_argument("--nnodes", type=str, default=None)
-    p.add_argument("--rank", type=int, default=None)
+    p.add_argument("--master", default=None,
+                   help="coordinator host:port (rank 0)")
+    p.add_argument("--nnodes", type=str, default=None,
+                   help="node count N, or elastic range N:M")
+    p.add_argument("--rank", type=int, default=None,
+                   help="this node's rank")
     p.add_argument("--nproc_per_node", type=int, default=None)
     p.add_argument("--log_dir", default=None)
     p.add_argument("--log_level", default="INFO")
@@ -161,6 +169,7 @@ def parse_args(argv=None):
     p.add_argument("--devices", "--gpus", default=None)
     p.add_argument("--ips", default=None)
     p.add_argument("--legacy", action="store_true")
+    p.add_argument("--watchdog-timeout", type=float, default=None)
     p.add_argument("training_script", nargs="?", default=None)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_known_args(argv)

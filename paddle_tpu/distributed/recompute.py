@@ -46,7 +46,15 @@ def recompute(function, *args, **kwargs):
 
     meta = {"n_user": 1, "is_seq": False}
 
-    @jax.checkpoint
+    # VJP-only rematerialization (NOT jax.checkpoint): the eager tape
+    # pre-lowers every op's custom_vjp into raw fwd/bwd calls, so by the
+    # time jax.checkpoint would linearize this region via JVP the flash
+    # attention pallas_call appears raw — and pallas has no usable JVP
+    # rule (AssertionError in _pallas_call_jvp_rule; found the first
+    # time recompute wrapped flash ON TPU). A custom_vjp whose backward
+    # re-executes the forward needs no JVP anywhere: fwd saves ONLY the
+    # inputs, bwd re-runs the region (that re-trace IS the remat) and
+    # pulls the cotangent through it.
     def inner(arg_vals, state_vals):
         saved = [(t._value, t._version, t._node, t.stop_gradient) for t in state]
         try:
@@ -84,10 +92,30 @@ def recompute(function, *args, **kwargs):
                 t._node = node
                 t.stop_gradient = sg
 
+    @jax.custom_vjp
+    def ckpt(arg_vals, state_vals):
+        return inner(arg_vals, state_vals)
+
+    def ckpt_fwd(arg_vals, state_vals):
+        # residuals = the region's INPUTS only — the jax.checkpoint
+        # memory contract
+        return inner(arg_vals, state_vals), (arg_vals, state_vals)
+
+    def ckpt_bwd(res, ct):
+        arg_vals, state_vals = res
+        # barrier: without it XLA CSEs the re-run against the forward's
+        # values and silently un-remats the region
+        arg_vals, state_vals = jax.lax.optimization_barrier(
+            (arg_vals, state_vals))
+        _, pull = jax.vjp(inner, arg_vals, state_vals)
+        return pull(ct)
+
+    ckpt.defvjp(ckpt_fwd, ckpt_bwd)
+
     def fn(*vals):
         avals = list(vals[:len(tensor_args)])
         svals = list(vals[len(tensor_args):])
-        return inner(avals, svals)
+        return ckpt(avals, svals)
 
     result = apply(fn, *tensor_args, *state)
     result = result if isinstance(result, tuple) else (result,)
@@ -108,8 +136,11 @@ class _SegmentChain:
         self._holder = Layer()
         self._fns = list(fns)
         for i, f in enumerate(self._fns):
-            if isinstance(f, Layer):
-                self._holder.add_sublayer(str(i), f)
+            # a member may be a Layer OR a bound method of one — lift
+            # the owner either way, else its params silently lose grads
+            owner = _owner_layer(f)
+            if owner is not None:
+                self._holder.add_sublayer(str(i), owner)
         # recompute() lifts params via function.__self__
         self.__self__ = self._holder
 

@@ -179,15 +179,19 @@ class WandbCallback(Callback):
 
     def __init__(self, project=None, name=None, dir=None, mode=None,
                  job_type=None, **kwargs):
+        self.wandb = None
+        self.run = None
+        self.records = []
         try:
             import wandb
             self.wandb = wandb
             self.run = wandb.init(project=project, name=name, dir=dir,
                                   mode=mode, job_type=job_type, **kwargs)
-        except ImportError:
+        except Exception:  # noqa: BLE001 — auth/network errors degrade
+            # too: zero-egress deployments must keep training with the
+            # local record, not crash at callback construction
             self.wandb = None
             self.run = None
-            self.records = []
 
     def on_train_batch_end(self, step, logs=None):
         if self.run is not None:

@@ -247,6 +247,22 @@ class GPTForCausalLM(nn.Layer):
             logits = paddle_tpu.matmul(h, self.lm_head_weight)
         return _constrain(logits, "dp", "sp", "tp")
 
+    def loss_with_fused_head(self, input_ids, labels, position_ids=None,
+                             chunk_size=8192):
+        """Single-chip memory path: head matmul + CE fused and chunked so
+        the [b, s, vocab] logits never materialize (the tp analogue is
+        ParallelCrossEntropy; see F.fused_linear_cross_entropy). A 350M
+        model at batch 8/seq 2048 OOMs v5e HBM through the logits alone
+        on the plain path; this one fits."""
+        import paddle_tpu.nn.functional as F
+        h = self.gpt(input_ids, position_ids)
+        if self.config.tie_word_embeddings:
+            w = self.gpt.embeddings.word_embeddings.weight.t()
+        else:
+            w = self.lm_head_weight
+        return F.fused_linear_cross_entropy(h, w, labels,
+                                            chunk_size=chunk_size)
+
 
 class GPTPretrainingCriterion(nn.Layer):
     """Masked LM loss (reference: PaddleNLP GPTPretrainingCriterion —

@@ -1,6 +1,7 @@
 """Loss functionals. Reference: python/paddle/nn/functional/loss.py."""
 from __future__ import annotations
 
+import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -499,3 +500,101 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
             else loss_out
 
     return apply(fn, logits, label)
+
+
+# ---- fused LM-head + cross entropy (chunked, logits never materialize)
+def _flce_chunk_stats(xs, w, ys):
+    """Per-chunk pieces: logsumexp over the vocab + the label logit."""
+    logits = jax.lax.dot_general(
+        xs, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)          # [c, V] f32
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    lab = jnp.take_along_axis(
+        logits, ys[:, None].astype(jnp.int32), axis=1)[:, 0]
+    return lse, lab
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _flce(x, w, y, valid, chunk):
+    """valid: float [n] mask (padding rows = 0); loss = masked mean."""
+    n = x.shape[0]
+    xs = x.reshape(n // chunk, chunk, x.shape[1])
+    ys = y.reshape(n // chunk, chunk)
+
+    def body(_, c):
+        lse, lab = _flce_chunk_stats(c[0], w, c[1])
+        return None, lse - lab
+
+    _, losses = jax.lax.scan(body, None, (xs, ys))
+    return jnp.sum(losses.reshape(-1) * valid) / jnp.sum(valid)
+
+
+def _flce_fwd(x, w, y, valid, chunk):
+    # residuals: only the INPUTS — the whole point is that no [n, V]
+    # tensor survives the forward
+    return _flce(x, w, y, valid, chunk), (x, w, y, valid)
+
+
+def _flce_bwd(chunk, res, ct):
+    x, w, y, valid = res
+    n = x.shape[0]
+    xs = x.reshape(n // chunk, chunk, x.shape[1])
+    ys = y.reshape(n // chunk, chunk)
+    per_tok = (ct / jnp.sum(valid)) * valid          # [n]
+    scales = per_tok.reshape(n // chunk, chunk)
+
+    def body(dw, c):
+        xc, yc, sc = c
+        logits = jax.lax.dot_general(
+            xc, w, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        p = jax.nn.softmax(logits, axis=-1)
+        onehot = jax.nn.one_hot(yc.astype(jnp.int32), w.shape[1],
+                                dtype=p.dtype)
+        dlogits = (p - onehot) * sc[:, None]         # [c, V]
+        dxc = jax.lax.dot_general(
+            dlogits, w, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(x.dtype)
+        dw = dw + jax.lax.dot_general(
+            xc, dlogits, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dw, dxc
+
+    dw, dx = jax.lax.scan(body, jnp.zeros(w.shape, jnp.float32),
+                          (xs, ys, scales))
+    return (dx.reshape(x.shape), dw.astype(w.dtype), None, None)
+
+
+_flce.defvjp(_flce_fwd, _flce_bwd)
+
+
+def fused_linear_cross_entropy(hidden, weight, label, chunk_size=8192,
+                               name=None):
+    """LM-head matmul + softmax cross entropy WITHOUT materializing the
+    [tokens, vocab] logits: tokens stream through lax.scan in
+    `chunk_size` slices and the backward rematerializes each chunk's
+    softmax (custom VJP saves only the inputs).
+
+    This is the single-chip counterpart of the tp vocab-parallel
+    ParallelCrossEntropy (reference fleet ParallelCrossEntropy /
+    incubate fused_linear role): the reference avoids the full-vocab
+    tensor by sharding it over mp ranks; on one chip we avoid it by
+    chunking time. hidden: [..., H] (flattened to tokens), weight:
+    [H, vocab], label: int ids matching hidden's leading dims.
+    Returns the mean loss.
+    """
+    def fn(h, w, y):
+        hf = h.reshape(-1, h.shape[-1])
+        yf = y.reshape(-1)
+        n = hf.shape[0]
+        c = min(chunk_size, n)
+        pad = (-n) % c   # pad to a chunk multiple; a divisor fallback
+        # would degrade to chunk=1 for prime n (thousands of [1, V] steps)
+        valid = jnp.ones((n,), jnp.float32)
+        if pad:
+            hf = jnp.pad(hf, ((0, pad), (0, 0)))
+            yf = jnp.pad(yf, (0, pad))
+            valid = jnp.pad(valid, (0, pad))
+        return _flce(hf, w, yf, valid, c)
+
+    return apply(fn, hidden, weight, label)

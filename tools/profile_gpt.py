@@ -1,0 +1,121 @@
+"""Flagship GPT train step on real TPU: single-chip throughput + MFU.
+
+Complements bench.py's ResNet/BERT headlines with the GPT family the
+BASELINE.json Fleet configs center on. Default config is a ~350M-param
+GPT (hidden 1024, 24 layers) at seq 2048 with recompute — the largest
+that fits v5e HBM (16 GB) comfortably with AdamW fp32 states.
+
+Run ON TPU (never kill it mid-run):
+  python tools/profile_gpt.py [--hidden 1024] [--layers 24]
+      [--batch 4] [--seq 2048] [--iters 6]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hidden", type=int, default=1024)
+    ap.add_argument("--layers", type=int, default=24)
+    ap.add_argument("--heads", type=int, default=16)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--iters", type=int, default=6)
+    ap.add_argument("--no-recompute", action="store_true")
+    ap.add_argument("--fused-head", action="store_true",
+                    help="chunked fused LM-head+CE: no [b,s,V] logits")
+    args = ap.parse_args()
+
+    import jax
+
+    import paddle_tpu as P
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.models.gpt import (GPTConfig, GPTForCausalLM,
+                                       GPTPretrainingCriterion)
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} {getattr(dev, 'device_kind', '')}",
+          flush=True)
+
+    P.seed(0)
+    cfg = GPTConfig(vocab_size=50304, hidden_size=args.hidden,
+                    num_layers=args.layers, num_heads=args.heads,
+                    max_seq_len=args.seq, dropout=0.0,
+                    attention_dropout=0.0,
+                    use_recompute=not args.no_recompute)
+    model = GPTForCausalLM(cfg)
+    crit = GPTPretrainingCriterion()
+    opt = P.optimizer.AdamW(learning_rate=1e-4,
+                            parameters=model.parameters())
+    n_params = sum(int(np.prod(q.shape)) for q in model.parameters())
+    print(f"params: {n_params/1e6:.1f}M", flush=True)
+
+    @P.jit.to_static
+    def train_step(ids, labels):
+        opt.clear_grad()
+        with P.amp.auto_cast(level="O1", dtype="bfloat16"):
+            if args.fused_head:
+                loss = model.loss_with_fused_head(ids, labels)
+            else:
+                logits = model(ids)
+                loss = crit(logits, labels)
+        loss.backward()
+        opt.step()
+        return loss
+
+    rng = np.random.default_rng(0)
+    ids = P.to_tensor(rng.integers(0, cfg.vocab_size,
+                                   (args.batch, args.seq)), dtype="int64")
+    labels = P.to_tensor(rng.integers(0, cfg.vocab_size,
+                                      (args.batch, args.seq)),
+                         dtype="int64")
+
+    t0 = time.time()
+    loss = train_step(ids, labels)
+    loss.block_until_ready()
+    print(f"compile+first step {time.time()-t0:.1f}s "
+          f"loss={float(loss.numpy()):.3f}", flush=True)
+
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        loss = train_step(ids, labels)
+    loss.block_until_ready()   # steps chain through optimizer state
+    dt = (time.perf_counter() - t0) / args.iters
+
+    tokens = args.batch * args.seq
+    tok_s = tokens / dt
+    # PaLM-style accounting: 6N matmul flops/token (fwd+bwd) plus causal
+    # attention 6*L*h*s flops/token (dense would be 12*L*h*s; causal
+    # halves it). Recompute re-runs the fwd, so HARDWARE flops are ~33%
+    # higher — this reports MODEL mfu (useful work), like the bench.
+    flops_per_token = 6.0 * n_params + \
+        6.0 * args.layers * args.hidden * args.seq
+    mfu = tok_s * flops_per_token / 197e12
+    out = {"metric": "gpt_train_tokens_s", "value": round(tok_s, 1),
+           "unit": "tokens/sec/chip", "platform": dev.platform,
+           "params_m": round(n_params / 1e6, 1),
+           "batch": args.batch, "seq": args.seq,
+           "ms_per_step": round(dt * 1e3, 1),
+           "recompute": cfg.use_recompute,
+           "flops_per_token_g": round(flops_per_token / 1e9, 2),
+           "mfu": round(mfu, 4)}
+    print(json.dumps(out), flush=True)
+    notes = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_NOTES.md")
+    stamp = time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime())
+    with open(notes, "a") as fh:
+        fh.write(f"\n- tools/profile_gpt.py {stamp}: `{json.dumps(out)}`\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
