@@ -1,0 +1,73 @@
+"""Training-path compositions compiled on real TPU.
+
+The r4 lesson behind this file: recompute()+flash crashed the first
+time it ran on silicon because jax.checkpoint JVP-linearized a raw
+pallas_call (CPU tests route attention away from pallas, so the gate
+could not see it). These tests pin the compositions that only exist on
+TPU: remat-wrapped flash blocks and the fused chunked LM-head CE inside
+a full to_static train step.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+import paddle_tpu.nn.functional as F
+
+
+class _Block(P.nn.Layer):
+    def __init__(self, h, heads):
+        super().__init__()
+        self.ln = P.nn.LayerNorm(h)
+        self.qkv = P.nn.Linear(h, 3 * h)
+        self.out = P.nn.Linear(h, h)
+        self.heads = heads
+
+    def forward(self, x):
+        b, s, h = x.shape
+        qkv = self.qkv(self.ln(x)).reshape(
+            [b, s, 3, self.heads, h // self.heads])
+        q, k, v = (qkv[:, :, i] for i in range(3))
+        a = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        return x + self.out(a.reshape([b, s, h]))
+
+
+def test_recompute_flash_block_trains_on_tpu():
+    """remat around a flash-attention block, compiled + executed."""
+    from paddle_tpu.distributed.recompute import recompute
+    P.seed(0)
+    blk = _Block(256, 4)
+    opt = P.optimizer.SGD(learning_rate=0.1,
+                          parameters=blk.parameters())
+
+    @P.jit.to_static
+    def step(x):
+        opt.clear_grad()
+        with P.amp.auto_cast(level="O1", dtype="bfloat16"):
+            h = recompute(blk, x)
+        loss = (h.astype("float32") ** 2).mean()
+        loss.backward()
+        opt.step()
+        return loss
+
+    x = P.to_tensor(np.random.RandomState(0)
+                    .randn(2, 512, 256).astype(np.float32))
+    losses = [float(step(x).numpy()) for _ in range(3)]
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()
+
+
+def test_fused_linear_ce_compiled_matches_oracle():
+    P.seed(0)
+    rng = np.random.RandomState(0)
+    hid = P.to_tensor(rng.randn(384, 128).astype(np.float32))
+    hid.stop_gradient = False
+    w = P.to_tensor((rng.randn(128, 1024) * 0.05).astype(np.float32))
+    w.stop_gradient = False
+    y = P.to_tensor(rng.randint(0, 1024, 384), dtype="int64")
+    loss = F.fused_linear_cross_entropy(hid, w, y, chunk_size=128)
+    ref = F.cross_entropy(P.matmul(P.to_tensor(hid.numpy()),
+                                   P.to_tensor(w.numpy())), y)
+    np.testing.assert_allclose(float(loss.numpy()), float(ref.numpy()),
+                               rtol=5e-3)
+    loss.backward()
+    assert np.isfinite(w.grad.numpy()).all()
