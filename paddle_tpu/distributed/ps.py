@@ -228,3 +228,18 @@ class PSEmbedding:
 
     def __call__(self, ids):
         return ps_embedding(ids, self.table)
+
+
+# table/coordinator vocabulary at the reference paddle.distributed.ps path
+from paddle_tpu.distributed.ps_tables import (  # noqa: E402,F401
+    BarrierTable,
+    ClientSelector,
+    ClientSelectorBase,
+    Coordinator,
+    DenseTable,
+    FLClient,
+    FLClientBase,
+    GlobalStepTable,
+    Table,
+    TensorTable,
+)

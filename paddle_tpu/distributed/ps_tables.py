@@ -1,0 +1,158 @@
+"""PS table/coordinator vocabulary (reference: distributed/ps/
+the_one_ps.py Table:620 / BarrierTable:634 / DenseTable:836 /
+TensorTable / GlobalStepTable, and ps/coordinator.py ClientSelector /
+Coordinator / FLClient*).
+
+The live parameter-server machinery here is distributed/ps.py's
+host-RAM SparseTable (jit-safe callbacks + the native C++ pstable
+kernels). These classes carry the reference's table-descriptor
+vocabulary for code that constructs PS topologies explicitly; dense
+parameters need no table at all (they live on-device, sharded by XLA),
+so DenseTable fronts a plain host buffer and BarrierTable wraps the
+collective barrier. The FL (federated-learning) client/coordinator
+surface is declared but gated: this runtime has no cross-silo
+transport, and pretending otherwise would train silently wrong.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Table", "BarrierTable", "DenseTable", "TensorTable",
+           "GlobalStepTable", "ClientSelectorBase", "ClientSelector",
+           "Coordinator", "FLClientBase", "FLClient"]
+
+
+class Table:
+    """Table descriptor base (reference the_one_ps.py:620)."""
+
+    def __init__(self):
+        self.id = -1
+        self.table_class = None
+        self.shard_num = 256
+        self.type = None
+        self.tensor = None
+
+    def _set(self, table_proto=None):
+        return None
+
+
+class BarrierTable(Table):
+    """Trainer barrier as a table op (reference the_one_ps.py:634);
+    here the mesh's collective barrier IS the implementation."""
+
+    def __init__(self, idx=0, trainer_num=1):
+        super().__init__()
+        self.id = idx
+        self.table_class = "BarrierTable"
+        self.trainer_num = trainer_num
+
+    def barrier(self):
+        from paddle_tpu.distributed.collective import barrier
+        return barrier()
+
+
+class DenseTable(Table):
+    """Dense parameter block on the server (reference
+    the_one_ps.py:836). Dense params live on-device under XLA sharding;
+    this front keeps a host mirror for reference-style pull/push."""
+
+    def __init__(self, idx=0, shape=None, dtype="float32"):
+        super().__init__()
+        self.id = idx
+        self.table_class = "MemoryDenseTable"
+        self._buf = np.zeros(shape or (0,), dtype)
+
+    def pull(self):
+        return self._buf.copy()
+
+    def push(self, grad, lr=1.0):
+        self._buf -= lr * np.asarray(grad, self._buf.dtype)
+        return self._buf
+
+
+class TensorTable(Table):
+    def __init__(self, idx=0, tensor=None):
+        super().__init__()
+        self.id = idx
+        self.table_class = "TensorTable"
+        self.tensor = tensor
+
+
+class GlobalStepTable(TensorTable):
+    def __init__(self, idx=0):
+        super().__init__(idx)
+        self.table_class = "GlobalStepTable"
+        self._step = 0
+
+    def increment(self, n=1):
+        self._step += n
+        return self._step
+
+
+class ClientSelectorBase:
+    """FL client sampling base (reference coordinator.py:49)."""
+
+    def __init__(self, clients_info=None):
+        self.clients_info = dict(clients_info or {})
+
+    def select(self):
+        raise NotImplementedError
+
+
+class ClientSelector(ClientSelectorBase):
+    """Random fraction selector (reference coordinator.py:80)."""
+
+    def __init__(self, clients_info=None, fraction=1.0, seed=0):
+        super().__init__(clients_info)
+        self.fraction = fraction
+        self._rng = np.random.default_rng(seed)
+
+    def select(self):
+        ids = sorted(self.clients_info)
+        k = max(1, int(round(len(ids) * self.fraction))) if ids else 0
+        return list(self._rng.choice(ids, size=k, replace=False)) \
+            if k else []
+
+
+def _no_fl_transport(*a, **kw):
+    raise RuntimeError(
+        "federated-learning coordination needs a cross-silo RPC "
+        "transport, which this TPU runtime does not ship; "
+        "the in-datacenter PS path is distributed/ps.py")
+
+
+class FLClientBase:
+    """Declared FL client surface (reference coordinator.py FLClientBase)
+    — constructing is allowed (for topology code), communicating is an
+    explicit capability error."""
+
+    def __init__(self):
+        self.strategy = None
+
+    connect = _no_fl_transport
+    push_fl_client_info_sync = _no_fl_transport
+    pull_fl_strategy = _no_fl_transport
+
+
+class FLClient(FLClientBase):
+    pass
+
+
+class Coordinator:
+    """FL round coordinator (reference coordinator.py:356): selection
+    works (it is pure policy); transport is gated like FLClient."""
+
+    def __init__(self, ps_hosts=None):
+        self.ps_hosts = ps_hosts
+        self.selector = None
+
+    def start_coordinator(self, self_endpoint=None, trainer_endpoints=None):
+        self.selector = ClientSelector(
+            {i: {"endpoint": e}
+             for i, e in enumerate(trainer_endpoints or [])})
+        return self.selector
+
+    def make_fl_strategy(self):
+        if self.selector is None:
+            raise RuntimeError("start_coordinator first")
+        return {cid: "JOIN" for cid in self.selector.select()}

@@ -323,3 +323,31 @@ class TestMiscNamespaces:
         cb.on_train_batch_end(0, {"loss": 1.0})
         cb.on_eval_end({"acc": 0.5})
         assert cb.run is None and len(cb.records) == 2
+
+
+class TestPSTables:
+    def test_dense_table_pull_push(self):
+        from paddle_tpu.distributed.ps import DenseTable
+        t = DenseTable(shape=(4,))
+        t.push(np.ones(4), lr=0.5)
+        np.testing.assert_allclose(t.pull(), -0.5)
+
+    def test_coordinator_selection_policy(self):
+        from paddle_tpu.distributed.ps import ClientSelector, Coordinator
+        c = Coordinator()
+        c.start_coordinator(trainer_endpoints=["a:1", "b:2", "c:3", "d:4"])
+        strategy = c.make_fl_strategy()
+        assert strategy and all(v == "JOIN" for v in strategy.values())
+        half = ClientSelector({i: {} for i in range(10)}, fraction=0.5,
+                              seed=1)
+        assert len(half.select()) == 5
+
+    def test_fl_transport_gated(self):
+        from paddle_tpu.distributed.ps import FLClient
+        with pytest.raises(RuntimeError, match="transport"):
+            FLClient().connect()
+
+    def test_global_step_table(self):
+        from paddle_tpu.distributed.ps import GlobalStepTable
+        g = GlobalStepTable()
+        assert g.increment() == 1 and g.increment(4) == 5
