@@ -37,11 +37,17 @@ def _gen_block(rng, depth, lines, indent):
         lines.append(pad + "else:")
         _gen_block(rng, depth - 1, lines, indent + 1)
     elif kind == 3 and depth > 0:        # bounded tensor while
-        lines.append(pad + "n = p.zeros([])")
-        lines.append(pad + f"while (n < {int(rng.integers(1, 4))}.0)"
+        # one counter PER NESTING DEPTH: a nested while that reset the
+        # shared `n` undid the outer loop's progress and produced a
+        # genuinely non-terminating program (found at seed 50 — eager
+        # and compiled both spin, so it is a generator bug, not a
+        # converter bug)
+        n = f"n{indent}"
+        lines.append(pad + f"{n} = p.zeros([])")
+        lines.append(pad + f"while ({n} < {int(rng.integers(1, 4))}.0)"
                            f" and (y.abs().max() < 100.0):")
         _gen_block(rng, depth - 1, lines, indent + 1)
-        lines.append(pad + "    n = n + 1.0")
+        lines.append(pad + f"    {n} = {n} + 1.0")
     elif kind == 1:                      # python for
         lines.append(pad + f"for _k in range({int(rng.integers(1, 3))}):")
         _gen_block(rng, max(depth - 1, 0), lines, indent + 1)
