@@ -569,3 +569,52 @@ def exponential_decay(learning_rate, decay_steps, decay_rate,
     from paddle_tpu.optimizer.lr import ExponentialDecay
     return ExponentialDecay(learning_rate,
                             gamma=decay_rate ** (1.0 / decay_steps))
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """CTR metric accumulators (reference fluid/contrib/layers/
+    metric_op.py:28): returns six running-stat tensors
+    (local_sqrerr, local_abserr, local_prob, local_q, local_pos_num,
+    local_ins_num) that accumulate across calls; finalize as
+    MAE = local_abserr/local_ins_num, RMSE = sqrt(local_sqrerr/
+    local_ins_num), predicted_ctr = local_prob/local_ins_num,
+    q = local_q/local_ins_num. In a distributed job all-reduce the six
+    accumulators first (they are plain state tensors, so
+    distributed.all_reduce applies directly)."""
+    import jax.numpy as jnp
+
+    import paddle_tpu
+    from paddle_tpu.core.engine import no_grad
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.framework.state import register_state_tensor
+
+    pred = input if isinstance(input, Tensor) else paddle_tpu.to_tensor(input)
+    lab = label if isinstance(label, Tensor) else paddle_tpu.to_tensor(label)
+    w = (ins_tag_weight if ins_tag_weight is not None
+         else paddle_tpu.ones([1], dtype="float32"))
+
+    state = []
+    for name in ("local_sqrerr", "local_abserr", "local_prob", "local_q",
+                 "local_pos_num", "local_ins_num"):
+        t = Tensor(jnp.zeros((1,), jnp.float32), name=name)
+        t.persistable = True
+        register_state_tensor(t)
+        state.append(t)
+    sqrerr, abserr, prob, q, pos_num, ins_num = state
+
+    pv = pred._value.astype(jnp.float32).reshape(-1)
+    lv = lab._value.astype(jnp.float32).reshape(-1)
+    wv = w._value.astype(jnp.float32).reshape(-1)[0] \
+        if hasattr(w, "_value") else jnp.float32(1.0)
+    err = pv - lv
+    with no_grad():
+        sqrerr._set_value(sqrerr._value + jnp.sum(err * err)[None] * wv)
+        abserr._set_value(abserr._value + jnp.sum(jnp.abs(err))[None] * wv)
+        prob._set_value(prob._value + jnp.sum(pv)[None] * wv)
+        # q-value: sum of pred/(1-pred) odds, the reference's calibration
+        q._set_value(q._value + jnp.sum(
+            pv / jnp.clip(1.0 - pv, 1e-6, None))[None] * wv)
+        pos_num._set_value(pos_num._value + jnp.sum(lv)[None] * wv)
+        ins_num._set_value(ins_num._value + jnp.float32(
+            lv.shape[0])[None] * wv)
+    return sqrerr, abserr, prob, q, pos_num, ins_num

@@ -351,3 +351,18 @@ class TestPSTables:
         from paddle_tpu.distributed.ps import GlobalStepTable
         g = GlobalStepTable()
         assert g.increment() == 1 and g.increment(4) == 5
+
+
+class TestCtrMetricBundle:
+    def test_accumulates_ctr_stats(self):
+        pred = p.to_tensor(np.array([[0.8], [0.3], [0.6]], np.float32))
+        lab = p.to_tensor(np.array([[1.0], [0.0], [1.0]], np.float32))
+        sq, ab, pr, q, pos, n = p.static.ctr_metric_bundle(pred, lab)
+        n_v = float(n.numpy()[0])
+        assert n_v == 3.0
+        mae = float(ab.numpy()[0]) / n_v
+        rmse = float(np.sqrt(sq.numpy()[0] / n_v))
+        np.testing.assert_allclose(mae, (0.2 + 0.3 + 0.4) / 3, rtol=1e-5)
+        np.testing.assert_allclose(
+            rmse, np.sqrt((0.04 + 0.09 + 0.16) / 3), rtol=1e-5)
+        np.testing.assert_allclose(float(pos.numpy()[0]), 2.0)
