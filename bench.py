@@ -128,7 +128,7 @@ def _resnet_extra(on_tpu, dt, iters, batch, train_step, x, y, remat):
         if not on_tpu:
             raise RuntimeError("hbm roofline keys are TPU-only")
         import jax
-        jitted, _, state_list = next(iter(train_step._compiled.values()))
+        entry = next(iter(train_step._compiled.values())); jitted, state_list = entry.jitted, entry.state_list
         cost = jitted.lower([t._value for t in state_list],
                             [x._value, y._value]).compile().cost_analysis()
         cost = cost[0] if isinstance(cost, (list, tuple)) else cost
@@ -195,7 +195,7 @@ def _bench_bert(on_tpu, batch_override=None):
 
     extra = {}
     try:
-        jitted, _, state_list = next(iter(train_step._compiled.values()))
+        entry = next(iter(train_step._compiled.values())); jitted, state_list = entry.jitted, entry.state_list
         cost = jitted.lower(
             [t._value for t in state_list],
             [ids._value, labels._value]).compile().cost_analysis()

@@ -28,6 +28,17 @@ _tree = jax.tree_util
 _trace_state = threading.local()
 
 
+class _CompiledEntry(__import__("typing").NamedTuple):
+    """One compiled signature of a StaticFunction. Field access, not
+    positional unpacking, is the supported way to consume this (the
+    3->4-tuple growth broke five positional unpackers at once)."""
+
+    jitted: object
+    out_info: object
+    state_list: list
+    grad_idx: tuple
+
+
 def _in_to_static_trace():
     return getattr(_trace_state, "active", False)
 
@@ -129,8 +140,20 @@ class StaticFunction:
                               or hasattr(o, "aval") else o for o in out_leaves]
                 new_state = [t._value for t in state_list]
                 self._out_info = (out_treedef, out_static)
+                # grads that survive to the end of the step (backward ran
+                # and nothing cleared them) materialize back onto
+                # param.grad — paddle semantics; a user reading .grad
+                # after a jitted step must not silently see None
+                grad_idx, grad_vals = [], []
+                for i, t in enumerate(state_list):
+                    g = t.grad
+                    if g is not None and isinstance(
+                            g._value, (jax.core.Tracer, jax.Array)):
+                        grad_idx.append(i)
+                        grad_vals.append(g._value)
+                self._grad_idx = tuple(grad_idx)
                 arrays = [v for v, s in zip(out_vals, out_static) if s is _ARRAY]
-                return arrays, new_state
+                return arrays, new_state, grad_vals
             finally:
                 _trace_state.active = False
                 snap.restore()
@@ -171,23 +194,32 @@ class StaticFunction:
                 jitted.lower(state_vals, tensor_vals)
                 if fstate.registry_version() != reg_ver:
                     continue
-                self._compiled[key] = (jitted, self._out_info, state_list)
+                self._compiled[key] = _CompiledEntry(
+                    jitted, self._out_info, state_list, self._grad_idx)
                 entry = self._compiled[key]
-            jitted, out_info, cached_state_list = entry
-            out_arrays, new_state = jitted(state_vals, tensor_vals)
-            self._apply(entry, out_arrays, new_state)
+            jitted = entry.jitted
+            out_arrays, new_state, grad_vals = jitted(state_vals,
+                                                      tensor_vals)
+            self._apply(entry, out_arrays, new_state, grad_vals)
             return self._rewrap(entry, out_arrays)
         raise RuntimeError("to_static: state registry kept changing during trace")
 
-    def _apply(self, entry, out_arrays, new_state):
-        _, _, state_list = entry
+    def _apply(self, entry, out_arrays, new_state, grad_vals):
+        state_list, grad_idx = entry.state_list, entry.grad_idx
         for t, v in zip(state_list, new_state):
             t._value = v
             t._version += 1
             t._node = None
+        for i, gv in zip(grad_idx, grad_vals):
+            t = state_list[i]
+            if t.grad is None:
+                t.grad = Tensor(gv, stop_gradient=True,
+                                name=t.name + "@GRAD")
+            else:
+                t.grad._value = gv
 
     def _rewrap(self, entry, out_arrays):
-        _, (out_treedef, out_static), _ = entry
+        out_treedef, out_static = entry.out_info
         it = iter(out_arrays)
         leaves = [Tensor(next(it)) if s is _ARRAY else s for s in out_static]
         return _tree.tree_unflatten(out_treedef, leaves)

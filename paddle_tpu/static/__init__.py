@@ -571,16 +571,17 @@ def exponential_decay(learning_rate, decay_steps, decay_rate,
                             gamma=decay_rate ** (1.0 / decay_steps))
 
 
-def ctr_metric_bundle(input, label, ins_tag_weight=None):
+def ctr_metric_bundle(input, label, ins_tag_weight=None, name="default"):
     """CTR metric accumulators (reference fluid/contrib/layers/
     metric_op.py:28): returns six running-stat tensors
     (local_sqrerr, local_abserr, local_prob, local_q, local_pos_num,
-    local_ins_num) that accumulate across calls; finalize as
-    MAE = local_abserr/local_ins_num, RMSE = sqrt(local_sqrerr/
-    local_ins_num), predicted_ctr = local_prob/local_ins_num,
-    q = local_q/local_ins_num. In a distributed job all-reduce the six
-    accumulators first (they are plain state tensors, so
-    distributed.all_reduce applies directly)."""
+    local_ins_num) that ACCUMULATE across calls — one persistent bundle
+    per `name`, like the reference's per-graph global variables.
+    Finalize as MAE = local_abserr/local_ins_num, RMSE =
+    sqrt(local_sqrerr/local_ins_num), predicted_ctr =
+    local_prob/local_ins_num, q = local_q/local_ins_num. In a
+    distributed job all-reduce the six accumulators first (plain state
+    tensors — distributed.all_reduce applies directly)."""
     import jax.numpy as jnp
 
     import paddle_tpu
@@ -590,22 +591,32 @@ def ctr_metric_bundle(input, label, ins_tag_weight=None):
 
     pred = input if isinstance(input, Tensor) else paddle_tpu.to_tensor(input)
     lab = label if isinstance(label, Tensor) else paddle_tpu.to_tensor(label)
-    w = (ins_tag_weight if ins_tag_weight is not None
-         else paddle_tpu.ones([1], dtype="float32"))
 
-    state = []
-    for name in ("local_sqrerr", "local_abserr", "local_prob", "local_q",
-                 "local_pos_num", "local_ins_num"):
-        t = Tensor(jnp.zeros((1,), jnp.float32), name=name)
-        t.persistable = True
-        register_state_tensor(t)
-        state.append(t)
-    sqrerr, abserr, prob, q, pos_num, ins_num = state
+    bundle = _ctr_bundles.get(name)
+    if bundle is None:
+        bundle = []
+        for stat in ("local_sqrerr", "local_abserr", "local_prob",
+                     "local_q", "local_pos_num", "local_ins_num"):
+            t = Tensor(jnp.zeros((1,), jnp.float32),
+                       name=f"ctr_{name}_{stat}")
+            t.persistable = True
+            # created lazily, possibly inside a to_static trace: the
+            # snapshot machinery re-inits mid-trace-created state from
+            # this spec, then the retrace lifts it properly
+            t._reinit = lambda: jnp.zeros((1,), jnp.float32)
+            register_state_tensor(t)
+            bundle.append(t)
+        _ctr_bundles[name] = bundle
+    sqrerr, abserr, prob, q, pos_num, ins_num = bundle
 
     pv = pred._value.astype(jnp.float32).reshape(-1)
     lv = lab._value.astype(jnp.float32).reshape(-1)
-    wv = w._value.astype(jnp.float32).reshape(-1)[0] \
-        if hasattr(w, "_value") else jnp.float32(1.0)
+    if ins_tag_weight is None:
+        wv = jnp.float32(1.0)
+    else:
+        w = ins_tag_weight if isinstance(ins_tag_weight, Tensor) \
+            else paddle_tpu.to_tensor(ins_tag_weight)
+        wv = w._value.astype(jnp.float32).reshape(-1)[0]
     err = pv - lv
     with no_grad():
         sqrerr._set_value(sqrerr._value + jnp.sum(err * err)[None] * wv)
@@ -618,3 +629,6 @@ def ctr_metric_bundle(input, label, ins_tag_weight=None):
         ins_num._set_value(ins_num._value + jnp.float32(
             lv.shape[0])[None] * wv)
     return sqrerr, abserr, prob, q, pos_num, ins_num
+
+
+_ctr_bundles = {}

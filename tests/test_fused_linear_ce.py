@@ -82,7 +82,7 @@ class TestFusedLinearCE:
             return loss
 
         step(hid, w, y)
-        jitted, _, state_list = next(iter(step._compiled.values()))
+        entry = next(iter(step._compiled.values())); jitted, state_list = entry.jitted, entry.state_list
         txt = jitted.lower([t._value for t in state_list],
                            [hid._value, w._value, y._value]).as_text()
         assert f"{n}x{v}" not in txt      # full logits

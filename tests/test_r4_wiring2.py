@@ -366,3 +366,46 @@ class TestCtrMetricBundle:
         np.testing.assert_allclose(
             rmse, np.sqrt((0.04 + 0.09 + 0.16) / 3), rtol=1e-5)
         np.testing.assert_allclose(float(pos.numpy()[0]), 2.0)
+
+
+class TestJitGradMaterialization:
+    def test_grads_visible_after_jitted_backward(self):
+        """backward() inside to_static must populate param.grad after
+        the call — users inspect/clip grads without an optimizer step."""
+        p.seed(0)
+        net = p.nn.Linear(4, 4)
+
+        @p.jit.to_static
+        def step(x):
+            loss = (net(x) ** 2).sum()
+            loss.backward()
+            return loss
+
+        x = p.randn([2, 4])
+        step(x)
+        assert net.weight.grad is not None
+        g_jit = net.weight.grad.numpy().copy()
+        net.clear_gradients()
+        (net(x) ** 2).sum().backward()
+        np.testing.assert_allclose(g_jit, net.weight.grad.numpy(),
+                                   rtol=1e-5)
+
+    def test_training_step_unaffected(self):
+        p.seed(0)
+        net = p.nn.Linear(4, 4)
+        opt = p.optimizer.SGD(learning_rate=0.1,
+                              parameters=net.parameters())
+
+        @p.jit.to_static
+        def step(x):
+            opt.clear_grad()
+            loss = (net(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            return loss
+
+        x = p.randn([2, 4])
+        losses = [float(step(x).numpy()) for _ in range(4)]
+        assert losses[-1] < losses[0]
+        # grads survive the step (cleared at NEXT call start)
+        assert net.weight.grad is not None

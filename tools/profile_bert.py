@@ -65,7 +65,7 @@ def main():
 
     flops = bytes_acc = None
     try:
-        jitted, _, state_list = next(iter(train_step._compiled.values()))
+        entry = next(iter(train_step._compiled.values())); jitted, state_list = entry.jitted, entry.state_list
         compiled = jitted.lower([t._value for t in state_list],
                                 [ids._value, labels._value]).compile()
         cost = compiled.cost_analysis()
