@@ -54,7 +54,7 @@ def _gen_block(rng, depth, lines, indent):
     # kind == 0: plain arithmetic only
 
 
-def _make_program(seed):
+def _make_program(seed, depth=2):
     rng = np.random.default_rng(seed)
     lines = ["def _helper(v):",
              "    if v.mean() > 0.2:",
@@ -63,7 +63,7 @@ def _make_program(seed):
              "        return v * 1.1",
              "",
              "def prog(x):", "    y = x * 1.0"]
-    _gen_block(rng, 2, lines, 1)
+    _gen_block(rng, depth, lines, 1)
     lines.append("    return y")
     src = "\n".join(lines) + "\n"
     ns = {"p": p}
@@ -95,3 +95,19 @@ def test_generated_program_eager_vs_compiled(seed):
 @pytest.mark.parametrize("seed", list(range(16, 32)))
 def test_generated_program_eager_vs_compiled_nightly(seed):
     test_generated_program_eager_vs_compiled(seed)
+
+
+@pytest.mark.nightly  # depth-3 nesting: while-in-if-in-while class shapes
+@pytest.mark.parametrize("seed", list(range(300, 308)))
+def test_generated_program_depth3_nightly(seed):
+    prog, src = _make_program(seed, depth=3)
+    rng = np.random.default_rng(seed + 1000)
+    compiled = p.jit.to_static(prog)
+    for trial in range(2):
+        x = rng.standard_normal(4).astype(np.float32)
+        want = prog(p.to_tensor(x)).numpy()
+        got = compiled(p.to_tensor(x)).numpy()
+        assert np.isfinite(want).all(), f"program diverged:\n{src}"
+        np.testing.assert_allclose(
+            got, want, rtol=1e-5, atol=1e-5,
+            err_msg=f"seed {seed} trial {trial}\n{src}")
