@@ -409,3 +409,33 @@ class TestJitGradMaterialization:
         assert losses[-1] < losses[0]
         # grads survive the step (cleared at NEXT call start)
         assert net.weight.grad is not None
+
+
+class TestHapiCallbackIntegration:
+    def test_reduce_lr_on_plateau_through_fit(self):
+        """ReduceLROnPlateau wired through Model.fit's eval loop must
+        actually move the optimizer lr when the metric plateaus."""
+        from paddle_tpu.callbacks import ReduceLROnPlateau
+        from paddle_tpu.hapi.model import Model
+        from paddle_tpu.io import Dataset
+
+        class Zeros(Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                x = np.zeros(4, np.float32)
+                return x, np.zeros(1, np.float32)
+
+        p.seed(0)
+        net = p.nn.Linear(4, 1)
+        model = Model(net)
+        opt = p.optimizer.SGD(learning_rate=0.1,
+                              parameters=net.parameters())
+        model.prepare(optimizer=opt, loss=p.nn.MSELoss())
+        cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+                               verbose=0, min_delta=1e-12)
+        # all-zero data: loss identical every eval -> plateau
+        model.fit(Zeros(), eval_data=Zeros(), batch_size=4, epochs=4,
+                  verbose=0, callbacks=[cb])
+        assert opt.get_lr() < 0.1
