@@ -39,22 +39,41 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     """paddle.grad — compute grads of outputs wrt inputs without touching .grad."""
     outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
     inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
-    saved = [(i.grad, i.__dict__.pop("_grad_hooks", None)) for i in inputs]
-    for i in inputs:
-        i.grad = None
+    # grads flow into a SINK, never into .grad — paddle.grad must leave
+    # every leaf's .grad untouched (a later loss.backward() would
+    # otherwise silently accumulate on top of stale values). Requested
+    # INTERMEDIATES are captured at the moment their cotangent
+    # completes in the walk (wanted_uids).
     retain = True if retain_graph is None else retain_graph
-    backward(outputs, grad_outputs, retain_graph=retain)
+    sink = {}
+    wanted = {i._uid for i in inputs}
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    for t, g in zip(outputs, grad_outputs):
+        _engine_backward(t, g,
+                         retain_graph=True if create_graph else retain,
+                         differentiable=create_graph, grad_sink=sink,
+                         wanted_uids=wanted)
     grads = []
-    for i, (old, hooks) in zip(inputs, saved):
-        g = i.grad
+    for i in inputs:
+        g = sink.get(i._uid)
+        if g is not None and not isinstance(g, Tensor):
+            g = Tensor(g, stop_gradient=True)
         if g is None and not allow_unused:
             from paddle_tpu.tensor.creation import zeros_like
             g = zeros_like(i)
         grads.append(g)
-        i.grad = old
-        if hooks is not None:
-            i.__dict__["_grad_hooks"] = hooks
     return grads
+
+
+class _CallableTuple(tuple):
+    """Tuple that can also be CALLED to return itself — bridges paddle's
+    ctx.saved_tensor() method spelling and property-style unpacking."""
+
+    def __call__(self):
+        return tuple(self)
 
 
 class PyLayerContext:
@@ -92,7 +111,10 @@ class PyLayerContext:
 
     @property
     def saved_tensor(self):
-        return self._unpacked()
+        # reference API: ctx.saved_tensor() is a METHOD; some earlier
+        # code here unpacked it as a property. _CallableTuple supports
+        # both spellings.
+        return _CallableTuple(self._unpacked())
 
     def saved_tensors(self):
         return self._unpacked()

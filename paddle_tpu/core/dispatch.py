@@ -68,6 +68,8 @@ def apply(fn, *args, **kwargs):
         # move to host (t.numpy()) cannot act on tracers anyway
         hooks = None
     if hooks is None:
+        from paddle_tpu.framework import state as _fstate
+        rng_before = _fstate.get_rng_state()
         out_val, pull = jax.vjp(closed, [vals[i] for i in diff_idx])
 
         def pullback(cot):
@@ -133,13 +135,17 @@ def apply(fn, *args, **kwargs):
         outs = tuple(Tensor(o, stop_gradient=False) for o in out_val)
         node = engine.Node(in_tensors, outs, pullback,
                            name=getattr(fn, "__name__", "op"),
-                           weak_inputs=weak)
+                           weak_inputs=weak,
+                           fwd=None if hooks is not None else closed,
+                           fwd_rng=None if hooks is not None else rng_before)
         for o in outs:
             o._node = node
         return outs
     out = Tensor(out_val, stop_gradient=False)
     node = engine.Node(in_tensors, (out,), pullback,
-                       name=getattr(fn, "__name__", "op"), weak_inputs=weak)
+                       name=getattr(fn, "__name__", "op"), weak_inputs=weak,
+                       fwd=None if hooks is not None else closed,
+                       fwd_rng=None if hooks is not None else rng_before)
     out._node = node
     return out
 

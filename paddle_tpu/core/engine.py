@@ -114,11 +114,13 @@ class Node:
         "out_versions",
         "out_avals",
         "pullback",
+        "fwd",
+        "fwd_rng",
         "name",
     )
 
     def __init__(self, inputs, out_tensors, pullback, name="",
-                 weak_inputs=False):
+                 weak_inputs=False, fwd=None, fwd_rng=None):
         _node_counter[0] += 1
         self.idx = _node_counter[0]
         self.in_refs = tuple(_InRef(t, weak_inputs) for t in inputs)
@@ -129,6 +131,13 @@ class Node:
         )
         self.pullback = pullback
         self.name = name
+        # forward closure over the diff inputs (diff_vals -> outputs):
+        # create_graph re-derives the vjp from it so second-order grads
+        # see the primal dependence (pullback's residuals are opaque).
+        # fwd_rng: the global RNG state the forward ran under — the
+        # re-run must replay the SAME stochastic draws (dropout mask)
+        self.fwd = fwd
+        self.fwd_rng = fwd_rng
 
     @property
     def inputs(self):
@@ -143,15 +152,27 @@ def _zero_cotangent(shape, dtype):
     return np.zeros(shape, dtype=float0)
 
 
-def backward(root, grad=None, retain_graph=False):
-    """Run reverse-mode accumulation from `root` tensor into leaf `.grad`s."""
+def backward(root, grad=None, retain_graph=False, differentiable=False,
+             grad_sink=None, wanted_uids=None):
+    """Run reverse-mode accumulation from `root` tensor into leaf `.grad`s.
+
+    differentiable=True (paddle's create_graph): cotangents flow as
+    TAPE-RECORDED tensors — each node's pullback is dispatched through
+    apply(), so the produced gradients carry their own graph and can be
+    differentiated again (gradient penalty / double backward)."""
     from paddle_tpu.core.tensor import Tensor
 
     if root._node is None:
         if not root.stop_gradient:
             # leaf with requires-grad: grad of itself
             g = grad if grad is not None else jnp.ones_like(root._value)
-            root._accumulate_grad(g)
+            if grad_sink is not None:
+                from paddle_tpu.core.tensor import Tensor as _T
+                g = g if isinstance(g, _T) else _T(g, stop_gradient=True)
+                grad_sink[root._uid] = (grad_sink[root._uid] + g
+                                        if root._uid in grad_sink else g)
+            else:
+                root._accumulate_grad(g)
         return
 
     if grad is None:
@@ -162,7 +183,10 @@ def backward(root, grad=None, retain_graph=False):
             )
         grad = jnp.ones_like(root._value)
     elif isinstance(grad, Tensor):
-        grad = grad._value
+        grad = grad if differentiable else grad._value
+    if differentiable:
+        return _backward_differentiable(root, grad, retain_graph,
+                                        grad_sink, wanted_uids)
 
     # Collect reachable nodes (via the recorded topology snapshot, so a
     # weak-held input collected by the GC does not sever its upstream).
@@ -193,7 +217,15 @@ def backward(root, grad=None, retain_graph=False):
         ):
             key = (uid, ver)
             if key in cot:
-                cots.append(cot.pop(key))
+                c = cot.pop(key)
+                # a requested INTERMEDIATE's cotangent is complete
+                # exactly when its producing node pops it (consumers
+                # all ran first in the reverse-topo walk)
+                if grad_sink is not None and wanted_uids \
+                        and uid in wanted_uids:
+                    grad_sink[uid] = (grad_sink[uid] + c
+                                      if uid in grad_sink else c)
+                cots.append(c)
                 any_live = True
             else:
                 cots.append(_zero_cotangent(shape, dtype))
@@ -206,9 +238,13 @@ def backward(root, grad=None, retain_graph=False):
             if r.stop_gradient:
                 continue
             if r.node is None:
-                t = r.tensor()
-                if t is not None:
-                    t._accumulate_grad(g)
+                if grad_sink is not None:
+                    grad_sink[r.uid] = (grad_sink[r.uid] + g
+                                        if r.uid in grad_sink else g)
+                else:
+                    t = r.tensor()
+                    if t is not None:
+                        t._accumulate_grad(g)
             else:
                 key = (r.uid, r.version)
                 if key in cot:
@@ -217,3 +253,152 @@ def backward(root, grad=None, retain_graph=False):
                     cot[key] = g
         if not retain_graph:
             node.pullback = None
+            node.fwd = None
+            node.fwd_rng = None
+
+
+def _backward_differentiable(root, grad, retain_graph, grad_sink=None,
+                             wanted_uids=None):
+    """create_graph walk: same traversal as backward(), but cotangents
+    are Tensors and every pullback runs through the dispatcher, so the
+    computed gradients are themselves tape-recorded (differentiable).
+    The source graph is implicitly retained (pullbacks are not freed) —
+    paddle's create_graph=True implies retain_graph=True likewise."""
+    from paddle_tpu.core.dispatch import apply
+    from paddle_tpu.core.tensor import Tensor
+
+    if not isinstance(grad, Tensor):
+        grad = Tensor(grad, stop_gradient=True)
+
+    seen = {}
+    stack = [root._node]
+    while stack:
+        node = stack.pop()
+        if node.idx in seen:
+            continue
+        seen[node.idx] = node
+        for r in node.in_refs:
+            if r.node is not None and r.node.idx not in seen:
+                stack.append(r.node)
+    order = sorted(seen.values(), key=lambda n: n.idx, reverse=True)
+
+    cot = {(root._uid, root._version): grad}
+
+    for node in order:
+        if node.pullback is None:
+            raise RuntimeError(
+                "Trying to backward through the graph a second time "
+                "(set retain_graph=True on the first backward).")
+        cots = []
+        tensor_pos = []
+        any_live = False
+        for uid, ver, (shape, dtype) in zip(
+                node.out_uids, node.out_versions, node.out_avals):
+            key = (uid, ver)
+            if key in cot:
+                c = cot.pop(key)
+                if grad_sink is not None and wanted_uids \
+                        and uid in wanted_uids:
+                    grad_sink[uid] = (grad_sink[uid] + c
+                                      if uid in grad_sink else c)
+                cots.append(c)
+                tensor_pos.append(len(cots) - 1)
+                any_live = True
+            else:
+                z = _zero_cotangent(shape, dtype)
+                if hasattr(z, "dtype") and z.dtype == float0:
+                    cots.append(z)          # stays a closure constant
+                else:
+                    cots.append(Tensor(z, stop_gradient=True))
+                    tensor_pos.append(len(cots) - 1)
+        if not any_live:
+            continue
+
+        # Re-derive the node's vjp from its stored forward closure with
+        # the PRIMAL inputs as live dispatcher arguments — second-order
+        # grads must see the primal dependence, which the pullback's
+        # baked residuals hide. Falls back to a value-correct but
+        # non-differentiable pullback call when the closure is absent
+        # (saved_tensors_hooks path) or a primal was mutated/collected.
+        primals = [r.tensor() for r in node.in_refs]
+        fwd_ok = (node.fwd is not None
+                  and all(t is not None and t._version == r.version
+                          for t, r in zip(primals, node.in_refs)))
+        mask = []
+        n_ct = len(tensor_pos)
+
+        if fwd_ok:
+            def run_vjp(*ts, _node=node, _cots=cots, _pos=tensor_pos,
+                        _mask=mask, _nct=n_ct):
+                cs, pvs = ts[:_nct], ts[_nct:]
+                full = list(_cots)
+                for i, c in zip(_pos, cs):
+                    full[i] = c
+                c = tuple(full) if len(full) > 1 else full[0]
+                # replay the forward's RNG stream: stochastic ops must
+                # re-draw the SAME mask, and the re-run must not advance
+                # the ambient stream as a side effect
+                from paddle_tpu.framework import state as _fstate
+                cur = _fstate.get_rng_state()
+                if _node.fwd_rng is not None:
+                    _fstate.set_rng_state(_node.fwd_rng)
+                try:
+                    _, pull = jax.vjp(_node.fwd, list(pvs))
+                finally:
+                    _fstate.set_rng_state(cur)
+                (gs,) = pull(c)
+                _mask.clear()
+                _mask.extend(
+                    not (o is None or (hasattr(o, "dtype")
+                                       and o.dtype == float0))
+                    for o in gs)
+                kept = tuple(o for o, m in zip(gs, _mask) if m)
+                return kept if len(kept) != 1 else kept[0]
+
+            res = apply(run_vjp, *[cots[i] for i in tensor_pos], *primals)
+        else:
+            import warnings
+            warnings.warn(
+                f"create_graph: op '{node.name}' has no differentiable "
+                "forward closure (PyLayer / saved_tensors_hooks, or an "
+                "input was mutated since the forward) — its gradient "
+                "VALUES are correct but second-order terms through it "
+                "are dropped", RuntimeWarning, stacklevel=2)
+
+            def run_pb(*cs, _pb=node.pullback, _cots=cots,
+                       _pos=tensor_pos, _mask=mask):
+                full = list(_cots)
+                for i, c in zip(_pos, cs):
+                    full[i] = c
+                c = tuple(full) if len(full) > 1 else full[0]
+                outs = _pb(c)
+                _mask.clear()
+                _mask.extend(
+                    not (o is None or (hasattr(o, "dtype")
+                                       and o.dtype == float0))
+                    for o in outs)
+                kept = tuple(o for o, m in zip(outs, _mask) if m)
+                return kept if len(kept) != 1 else kept[0]
+
+            res = apply(run_pb, *[cots[i] for i in tensor_pos])
+        res = res if isinstance(res, tuple) else (res,)
+        it = iter(res)
+        in_grads = [next(it) if m else None for m in mask]
+
+        for r, g in zip(node.in_refs, in_grads):
+            if g is None or r.stop_gradient:
+                continue
+            if r.node is None:
+                if grad_sink is not None:
+                    grad_sink[r.uid] = (grad_sink[r.uid] + g
+                                        if r.uid in grad_sink else g)
+                else:
+                    t = r.tensor()
+                    if t is not None:
+                        if t.grad is None:
+                            t.grad = g
+                        else:
+                            t.grad = t.grad + g
+            else:
+                key = (r.uid, r.version)
+                cot[key] = cot[key] + g if key in cot else g

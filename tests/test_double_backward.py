@@ -1,0 +1,127 @@
+"""create_graph (double backward): grads returned by paddle.grad must
+themselves carry the tape, with values matching jax.grad-of-grad."""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as p
+
+
+class TestCreateGraph:
+    def test_polynomial_orders(self):
+        x = p.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+        y = x * x * x
+        g1 = p.grad([y], [x], create_graph=True)[0]
+        np.testing.assert_allclose(g1.numpy(), [12.0], rtol=1e-6)
+        g2 = p.grad([g1], [x], create_graph=True)[0]
+        np.testing.assert_allclose(g2.numpy(), [12.0], rtol=1e-6)
+        g3 = p.grad([g2], [x])[0]
+        np.testing.assert_allclose(g3.numpy(), [6.0], rtol=1e-6)
+
+    def test_gradient_penalty_matches_jax_oracle(self):
+        """WGAN-GP pattern: d/dW of ||d out/d x|| must equal jax's
+        nested-grad computation on the same function."""
+        p.seed(0)
+        lin = p.nn.Linear(3, 1)
+        W = lin.weight.numpy().copy()
+        b = lin.bias.numpy().copy()
+        x_np = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+
+        x = p.to_tensor(x_np)
+        x.stop_gradient = False
+        out = (p.tanh(lin(x))).sum()
+        gx = p.grad([out], [x], create_graph=True)[0]
+        gp = (gx ** 2).sum()
+        gp.backward()
+        got_dw = lin.weight.grad.numpy()
+
+        def penalty(Wj):
+            def f(xv):
+                return jnp.sum(jnp.tanh(xv @ Wj + b))
+            gxj = jax.grad(f)(jnp.asarray(x_np))
+            return jnp.sum(gxj ** 2)
+
+        want_dw = np.asarray(jax.grad(penalty)(jnp.asarray(W)))
+        np.testing.assert_allclose(got_dw, want_dw, rtol=1e-4, atol=1e-6)
+
+    def test_second_order_through_backward_accumulation(self):
+        """create_graph grads accumulate into .grad with graph when
+        backward() is used on a function of them."""
+        x = p.to_tensor(np.array([1.0, 2.0], np.float32),
+                        stop_gradient=False)
+        y = (x ** 2).sum()
+        (gx,) = p.grad([y], [x], create_graph=True)
+        # d/dx sum(gx^2) = d/dx sum(4x^2) = 8x
+        (gg,) = p.grad([(gx ** 2).sum()], [x])
+        np.testing.assert_allclose(gg.numpy(), [8.0, 16.0], rtol=1e-6)
+
+    def test_first_order_values_unchanged(self):
+        p.seed(0)
+        net = p.nn.Linear(4, 2)
+        x = p.randn([3, 4])
+        loss = (net(x) ** 2).mean()
+        (gw_cg,) = p.grad([loss], [net.weight], create_graph=True)
+        loss2 = (net(x) ** 2).mean()
+        (gw,) = p.grad([loss2], [net.weight])
+        np.testing.assert_allclose(gw_cg.numpy(), gw.numpy(), rtol=1e-5)
+
+
+class TestCreateGraphHardening:
+    def test_dropout_mask_replayed_in_create_graph(self):
+        """The differentiable re-run must replay the forward's RNG: the
+        gradient's mask has to MATCH the forward dropout mask."""
+        import paddle_tpu.nn.functional as F
+        p.seed(42)
+        x = p.to_tensor(np.ones((1000,), np.float32),
+                        stop_gradient=False)
+        y = F.dropout(x, p=0.5, training=True)
+        (g,) = p.grad([y.sum()], [x], create_graph=True)
+        agree = float(((y.numpy() != 0) == (g.numpy() != 0)).mean())
+        assert agree == 1.0
+
+    def test_grad_wrt_intermediate(self):
+        a = p.to_tensor(np.array([3.0], np.float32),
+                        stop_gradient=False)
+        b = a * a
+        c = b * b
+        (gb,) = p.grad([c.sum()], [b])
+        np.testing.assert_allclose(gb.numpy(), [18.0], rtol=1e-6)
+        # ...and wrt both intermediate and leaf in one call
+        ga, gb2 = p.grad([(b * b).sum()], [a, b])
+        np.testing.assert_allclose(ga.numpy(), [108.0], rtol=1e-6)
+        np.testing.assert_allclose(gb2.numpy(), [18.0], rtol=1e-6)
+
+    def test_grad_leaves_dot_grad_untouched(self):
+        p.seed(0)
+        net = p.nn.Linear(2, 2)
+        x = p.randn([1, 2])
+        (gw,) = p.grad([(net(x) ** 2).sum()], [net.weight])
+        assert net.weight.grad is None
+        # a subsequent backward starts clean
+        (net(x) ** 2).sum().backward()
+        np.testing.assert_allclose(net.weight.grad.numpy(), gw.numpy(),
+                                   rtol=1e-5)
+
+    def test_pylayer_fallback_warns_not_silently_wrong(self):
+        import warnings
+
+        class Square(p.autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, t):
+                ctx.save_for_backward(t)
+                return t * t
+
+            @staticmethod
+            def backward(ctx, gy):
+                (t,) = ctx.saved_tensor()
+                return gy * 2.0 * t
+
+        x = p.to_tensor(np.array([3.0], np.float32),
+                        stop_gradient=False)
+        y = Square.apply(x)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            (g1,) = p.grad([y.sum()], [x], create_graph=True)
+            assert any("second-order" in str(m.message) for m in w)
+        np.testing.assert_allclose(g1.numpy(), [6.0], rtol=1e-6)
