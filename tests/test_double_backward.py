@@ -125,3 +125,35 @@ class TestCreateGraphHardening:
             (g1,) = p.grad([y.sum()], [x], create_graph=True)
             assert any("second-order" in str(m.message) for m in w)
         np.testing.assert_allclose(g1.numpy(), [6.0], rtol=1e-6)
+
+    def test_gradient_penalty_under_to_static(self):
+        """The full WGAN-GP step — create_graph inside a jitted
+        function — must compile to one XLA program and train."""
+        p.seed(0)
+        critic = p.nn.Sequential(p.nn.Linear(8, 16), p.nn.Tanh(),
+                                 p.nn.Linear(16, 1))
+        opt = p.optimizer.Adam(learning_rate=1e-3,
+                               parameters=critic.parameters())
+
+        @p.jit.to_static
+        def step(real, fake, mix):
+            opt.clear_grad()
+            mix.stop_gradient = False
+            loss = critic(fake).mean() - critic(real).mean()
+            gx = p.grad([critic(mix).sum()], [mix],
+                        create_graph=True)[0]
+            gp = ((gx.norm(p=2, axis=1) - 1.0) ** 2).mean()
+            loss = loss + 10.0 * gp
+            loss.backward()
+            opt.step()
+            return loss
+
+        rng = np.random.RandomState(0)
+
+        def mk(s):
+            return p.to_tensor(rng.randn(8, 8).astype(np.float32) + s)
+
+        losses = [float(step(mk(1.0), mk(-1.0), mk(0.0)).numpy())
+                  for _ in range(4)]
+        assert all(np.isfinite(losses))
+        assert len(step._compiled) == 1   # one program, cached
