@@ -60,6 +60,7 @@ from paddle_tpu import (  # noqa: F401,E402
     audio,
     autograd,
     callbacks,
+    cost_model,
     device,
     distributed,
     distribution,

@@ -1,0 +1,35 @@
+"""paddle.audio.backends (reference: python/paddle/audio/backends/):
+selection API over pluggable load/save/info backends. The top-level
+functions dispatch through the CURRENT backend so
+`set_backend('soundfile')` (when that package exists) retargets
+paddle.audio.load/save/info exactly as in the reference."""
+from __future__ import annotations
+
+from paddle_tpu.audio.backends import wave_backend  # noqa: F401
+from paddle_tpu.audio.backends.backend import AudioInfo  # noqa: F401
+from paddle_tpu.audio.backends.init_backend import (  # noqa: F401
+    _backend_module,
+    get_current_backend,
+    list_available_backends,
+    set_backend,
+)
+
+__all__ = ["AudioInfo", "info", "load", "save", "get_current_backend",
+           "list_available_backends", "set_backend"]
+
+
+def info(filepath):
+    return _backend_module().info(filepath)
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    return _backend_module().load(filepath, frame_offset, num_frames,
+                                  normalize, channels_first)
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding="PCM_16", bits_per_sample=16):
+    return _backend_module().save(filepath, src, sample_rate,
+                                  channels_first, encoding,
+                                  bits_per_sample)

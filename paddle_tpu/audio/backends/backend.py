@@ -1,0 +1,19 @@
+"""Audio backend interface (reference:
+python/paddle/audio/backends/backend.py). A backend is any module with
+`info(filepath)`, `load(filepath, frame_offset, num_frames, normalize,
+channels_first)` and `save(filepath, src, sample_rate, ...)`."""
+from __future__ import annotations
+
+__all__ = ["AudioInfo"]
+
+
+class AudioInfo:
+    """(reference backend.py AudioInfo)"""
+
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding

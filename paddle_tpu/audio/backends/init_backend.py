@@ -1,0 +1,41 @@
+"""Backend selection (reference:
+python/paddle/audio/backends/init_backend.py). A registry of backend
+modules; `set_backend` swaps which module serves
+paddle.audio.{load,save,info}. The stdlib wave backend is always
+available; the soundfile backend registers when the package imports."""
+from __future__ import annotations
+
+from paddle_tpu.audio.backends import soundfile_backend, wave_backend
+
+__all__ = ["get_current_backend", "list_available_backends", "set_backend"]
+
+_BACKENDS = {"wave_backend": wave_backend}
+if soundfile_backend.AVAILABLE:
+    _BACKENDS["soundfile"] = soundfile_backend
+
+_current = ["wave_backend"]
+
+
+def list_available_backends():
+    return sorted(_BACKENDS)
+
+
+def get_current_backend():
+    return _current[0]
+
+
+def set_backend(backend_name):
+    if backend_name not in _BACKENDS:
+        raise NotImplementedError(
+            f"unknown audio backend {backend_name!r}; available: "
+            f"{list_available_backends()} (the soundfile backend "
+            f"registers only when the `soundfile` package is installed)")
+    _current[0] = backend_name
+
+
+def _backend_module():
+    return _BACKENDS[_current[0]]
+
+
+def _init_set_audio_backend():
+    _current[0] = "wave_backend"

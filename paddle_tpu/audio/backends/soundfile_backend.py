@@ -1,0 +1,62 @@
+"""Soundfile audio backend (reference:
+python/paddle/audio/backends/soundfile_backend.py): delegates to the
+`soundfile` package when it is installed. This zero-egress image does
+not bundle it, so `AVAILABLE` gates registration — the module stays
+importable either way and the selection API reports availability
+honestly."""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.audio.backends.backend import AudioInfo
+
+try:
+    import soundfile as _sf
+    AVAILABLE = True
+except ImportError:
+    _sf = None
+    AVAILABLE = False
+
+__all__ = ["AVAILABLE", "info", "load", "save"]
+
+
+def _require():
+    if _sf is None:
+        raise ImportError(
+            "the soundfile backend needs the `soundfile` package "
+            "(pip install soundfile); use set_backend('wave_backend')")
+
+
+def info(filepath):
+    _require()
+    i = _sf.info(str(filepath))
+    bits = {"PCM_S8": 8, "PCM_U8": 8, "PCM_16": 16, "PCM_24": 24,
+            "PCM_32": 32, "FLOAT": 32, "DOUBLE": 64}.get(i.subtype, 16)
+    return AudioInfo(i.samplerate, i.frames, i.channels, bits, i.subtype)
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    _require()
+    from paddle_tpu.core.tensor import Tensor
+    stop = None if num_frames < 0 else frame_offset + num_frames
+    data, sr = _sf.read(str(filepath), start=frame_offset, stop=stop,
+                        dtype="float32" if normalize else "int16",
+                        always_2d=True)
+    if channels_first:
+        data = data.T
+    return Tensor(np.ascontiguousarray(data)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding="PCM_16", bits_per_sample=16):
+    _require()
+    from paddle_tpu.core.tensor import Tensor
+    arr = src.numpy() if isinstance(src, Tensor) else np.asarray(src)
+    if arr.ndim == 1:
+        arr = arr[None] if channels_first else arr[:, None]
+    if channels_first:
+        arr = arr.T
+    subtype = {8: "PCM_S8", 16: "PCM_16", 24: "PCM_24",
+               32: "PCM_32"}.get(bits_per_sample, "PCM_16")
+    _sf.write(str(filepath), arr, int(sample_rate), subtype=subtype)

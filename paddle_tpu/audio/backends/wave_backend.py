@@ -1,4 +1,4 @@
-"""paddle.audio.backends parity (reference:
+"""Stdlib-wave audio backend (reference:
 python/paddle/audio/backends/wave_backend.py): WAV load/save/info on the
 stdlib `wave` module — no soundfile dependency, fully offline."""
 from __future__ import annotations
@@ -7,35 +7,9 @@ import wave as _wave
 
 import numpy as np
 
-__all__ = ["AudioInfo", "info", "load", "save",
-           "list_available_backends", "get_current_backend", "set_backend"]
+from paddle_tpu.audio.backends.backend import AudioInfo
 
-
-class AudioInfo:
-    """(reference backend.py AudioInfo)"""
-
-    def __init__(self, sample_rate, num_samples, num_channels,
-                 bits_per_sample, encoding):
-        self.sample_rate = sample_rate
-        self.num_samples = num_samples
-        self.num_channels = num_channels
-        self.bits_per_sample = bits_per_sample
-        self.encoding = encoding
-
-
-def list_available_backends():
-    return ["wave_backend"]
-
-
-def get_current_backend():
-    return "wave_backend"
-
-
-def set_backend(backend_name):
-    if backend_name != "wave_backend":
-        raise NotImplementedError(
-            "only the stdlib wave_backend exists in this build "
-            "(the reference's soundfile backend needs an external lib)")
+__all__ = ["info", "load", "save"]
 
 
 def info(filepath):

@@ -29,8 +29,10 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
         grad_tensors = [None] * len(tensors)
     elif isinstance(grad_tensors, Tensor):
         grad_tensors = [grad_tensors]
-    for t, g in zip(tensors, grad_tensors):
-        _engine_backward(t, g, retain_graph=retain_graph)
+    for idx, (t, g) in enumerate(zip(tensors, grad_tensors)):
+        # keep shared nodes alive for the remaining outputs of THIS call
+        _engine_backward(
+            t, g, retain_graph=retain_graph or idx < len(tensors) - 1)
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
@@ -51,9 +53,14 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         grad_outputs = [None] * len(outputs)
     elif isinstance(grad_outputs, Tensor):
         grad_outputs = [grad_outputs]
-    for t, g in zip(outputs, grad_outputs):
+    for idx, (t, g) in enumerate(zip(outputs, grad_outputs)):
+        # the walk runs once per output; earlier passes must keep the
+        # graph alive for later outputs that share nodes with them, even
+        # under explicit retain_graph=False (reference paddle seeds all
+        # outputs into a single engine pass)
+        keep = retain or idx < len(outputs) - 1
         _engine_backward(t, g,
-                         retain_graph=True if create_graph else retain,
+                         retain_graph=True if create_graph else keep,
                          differentiable=create_graph, grad_sink=sink,
                          wanted_uids=wanted)
     grads = []

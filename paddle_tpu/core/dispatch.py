@@ -137,7 +137,8 @@ def apply(fn, *args, **kwargs):
                            name=getattr(fn, "__name__", "op"),
                            weak_inputs=weak,
                            fwd=None if hooks is not None else closed,
-                           fwd_rng=None if hooks is not None else rng_before)
+                           fwd_rng=None if hooks is not None else rng_before,
+                           out_is_tuple=True)
         for o in outs:
             o._node = node
         return outs

@@ -116,11 +116,13 @@ class Node:
         "pullback",
         "fwd",
         "fwd_rng",
+        "out_is_tuple",
         "name",
     )
 
     def __init__(self, inputs, out_tensors, pullback, name="",
-                 weak_inputs=False, fwd=None, fwd_rng=None):
+                 weak_inputs=False, fwd=None, fwd_rng=None,
+                 out_is_tuple=False):
         _node_counter[0] += 1
         self.idx = _node_counter[0]
         self.in_refs = tuple(_InRef(t, weak_inputs) for t in inputs)
@@ -138,6 +140,11 @@ class Node:
         # re-run must replay the SAME stochastic draws (dropout mask)
         self.fwd = fwd
         self.fwd_rng = fwd_rng
+        # whether the forward's raw return was a tuple: a fresh
+        # jax.vjp(fwd) pullback then expects a TUPLE cotangent even for
+        # one output (the stored pullback normalizes this; the
+        # create_graph re-derivation must too)
+        self.out_is_tuple = out_is_tuple
 
     @property
     def inputs(self):
@@ -334,7 +341,13 @@ def _backward_differentiable(root, grad, retain_graph, grad_sink=None,
                 full = list(_cots)
                 for i, c in zip(_pos, cs):
                     full[i] = c
-                c = tuple(full) if len(full) > 1 else full[0]
+                # a freshly derived jax.vjp pullback wants the EXACT
+                # output structure: a 1-element tuple forward (e.g.
+                # split(x, 1)) needs a 1-tuple cotangent, not a bare leaf
+                # (the stored pullback normalizes this; this path must
+                # use the recorded structure instead of len())
+                c = (tuple(full) if (_node.out_is_tuple or len(full) > 1)
+                     else full[0])
                 # replay the forward's RNG stream: stochastic ops must
                 # re-draw the SAME mask, and the re-run must not advance
                 # the ambient stream as a side effect

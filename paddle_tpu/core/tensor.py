@@ -223,6 +223,8 @@ class Tensor:
             self.grad._value = self.grad._value + g
 
     def clear_grad(self):
+        from paddle_tpu.jit.api import note_grad_cleared
+        note_grad_cleared(self._uid)
         self.grad = None
 
     clear_gradient = clear_grad

@@ -1,0 +1,115 @@
+"""paddle.cost_model (reference: python/paddle/cost_model/cost_model.py).
+
+The reference profiles a static Program through the C++ core.CostModel
+and ships a GPU op-benchmark JSON. TPU-native: the compiled XLA
+executable already carries its own cost model — `profile_measure`
+lowers the jitted step, reads XLA's flops / bytes-accessed analysis,
+and (optionally) wall-measures a few runs; `get_static_op_time`
+serves measured per-op data from a benchmark table captured on this
+chip (populated lazily; empty table degrades to analysis-only).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+__all__ = ["CostModel"]
+
+
+class CostModel:
+    def __init__(self):
+        self._static_cost_data = None
+
+    # ---- reference demo-parity helper ----
+    def build_program(self):
+        """Tiny linear+mean training step (the reference builds the same
+        demo program via static.Program). Returns (fn, example_args)
+        consumable by profile_measure."""
+        import numpy as np
+
+        import paddle_tpu as P
+        import paddle_tpu.nn.functional as F
+
+        P.seed(0)
+        lin = P.nn.Linear(1, 10)
+        opt = P.optimizer.SGD(learning_rate=0.01,
+                              parameters=lin.parameters())
+
+        @P.jit.to_static
+        def step(x):
+            opt.clear_grad()
+            loss = lin(x).mean()
+            loss.backward()
+            opt.step()
+            return loss
+
+        x = P.to_tensor(np.random.default_rng(0)
+                        .random((10, 1)).astype(np.float32))
+        return step, (x,)
+
+    def profile_measure(self, fn, *args, device=None,
+                        fetch_cost_list=("time",), iters=3):
+        """Compile `fn(*args)` (a StaticFunction or any callable of
+        Tensors) and return {"time_ms", "flops", "bytes_accessed",
+        "arithmetic_intensity"} from the XLA cost analysis + a short
+        wall measurement."""
+        out = {}
+        fn(*args)  # ensure compiled (and warm)
+        entry = None
+        compiled = getattr(fn, "_compiled", None)
+        if compiled:
+            entry = next(iter(compiled.values()))
+        if entry is not None:
+            jitted, state_list = entry.jitted, entry.state_list
+            cost = jitted.lower(
+                [t._value for t in state_list],
+                [a._value for a in args]).compile().cost_analysis()
+            cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+            out["flops"] = float(cost.get("flops", 0.0))
+            out["bytes_accessed"] = float(cost.get("bytes accessed", 0.0))
+            if out.get("bytes_accessed"):
+                out["arithmetic_intensity"] = round(
+                    out["flops"] / out["bytes_accessed"], 2)
+        if "time" in fetch_cost_list:
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                r = fn(*args)
+            blocker = getattr(r, "block_until_ready", None)
+            if blocker is not None:
+                blocker()
+            out["time_ms"] = round(
+                (time.perf_counter() - t0) / iters * 1e3, 3)
+        return out
+
+    # ---- static benchmark table (reference static_op_benchmark.json) ----
+    _TABLE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "static_op_benchmark.json")
+
+    def static_cost_data(self):
+        if self._static_cost_data is None:
+            try:
+                with open(self._TABLE_PATH) as f:
+                    self._static_cost_data = json.load(f)
+            except OSError:
+                self._static_cost_data = []
+        return self._static_cost_data
+
+    def get_static_op_time(self, op_name, forward=True, dtype="float32"):
+        if op_name is None:
+            raise ValueError(
+                "op_name should not be empty when you want to get "
+                "static op time")
+        if self._static_cost_data is None:
+            self.static_cost_data()
+        op_cost = {}
+        for op_data in self._static_cost_data:
+            if op_data.get("op") == op_name and \
+                    dtype in op_data.get("config", ""):
+                key = "paddle_gpu_time" if forward else \
+                    "paddle_gpu_time_backward"
+                # measured-on-this-chip tables use "tpu_time*" keys
+                tkey = "tpu_time" if forward else "tpu_time_backward"
+                op_cost["op_time"] = op_data.get(tkey, op_data.get(key))
+                op_cost["config"] = op_data.get("config")
+        return op_cost

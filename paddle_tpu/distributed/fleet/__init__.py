@@ -57,6 +57,8 @@ class DistributedStrategy:
         self.gradient_merge = False
         self.gradient_merge_configs = {}
         self.lamb = False
+        self.lars = False
+        self.lars_configs = {}
         self.dgc = False
         self.find_unused_parameters = False
         self.without_graph_optimization = True
@@ -147,6 +149,27 @@ class _Fleet:
         return model
 
     def distributed_optimizer(self, optimizer, strategy=None):
+        strategy = strategy or getattr(self, "_strategy", None)
+        if strategy is None:
+            return optimizer
+        if getattr(strategy, "lars", False):
+            from paddle_tpu.distributed.fleet.meta_optimizers import (
+                LarsOptimizer)
+            cfg = dict(strategy.lars_configs or {})
+            optimizer = LarsOptimizer(
+                optimizer,
+                lars_coeff=cfg.get("lars_coeff", 0.001),
+                lars_weight_decay=cfg.get("lars_weight_decay", 0.0005),
+                epsilon=cfg.get("epsilon", 0.0),
+                exclude_from_weight_decay=cfg.get(
+                    "exclude_from_weight_decay", None))
+        if getattr(strategy, "gradient_merge", False):
+            from paddle_tpu.distributed.fleet.meta_optimizers import (
+                GradientMergeOptimizer)
+            cfg = dict(strategy.gradient_merge_configs or {})
+            optimizer = GradientMergeOptimizer(
+                optimizer, k_steps=cfg.get("k_steps", 1),
+                avg=cfg.get("avg", True))
         return optimizer
 
     @property

@@ -8,8 +8,51 @@ fleet strategies construct.
 """
 from __future__ import annotations
 
-__all__ = ["ParameterServerOptimizer", "RawProgramOptimizer",
+__all__ = ["GradientMergeOptimizer", "LarsOptimizer",
+           "ParameterServerOptimizer", "RawProgramOptimizer",
            "dygraph_optimizer"]
+
+
+class GradientMergeOptimizer:
+    """Reference meta_optimizers/gradient_merge_optimizer.py. Real here:
+    wraps the inner optimizer in the trace-free k-step accumulator
+    (optimizer/gradient_merge.py where-commit form)."""
+
+    def __new__(cls, optimizer=None, k_steps=1, avg=True):
+        from paddle_tpu.optimizer.gradient_merge import (
+            GradientMergeOptimizer as _GM)
+        return _GM(optimizer, k_steps=k_steps, avg=avg)
+
+
+class LarsOptimizer:
+    """Reference meta_optimizers/lars_optimizer.py: swap the inner
+    Momentum for LarsMomentum with the strategy's lars configs."""
+
+    def __new__(cls, optimizer=None, lars_coeff=0.001,
+                lars_weight_decay=0.0005, epsilon=0.0,
+                exclude_from_weight_decay=None):
+        from paddle_tpu.optimizer.sgd import LarsMomentum, Momentum
+        if not isinstance(optimizer, Momentum):
+            # reference lars_optimizer.py _can_apply: LARS only applies
+            # to Momentum — other inner optimizers pass through
+            # UNCHANGED (scripts with strategy.lars + AdamW train
+            # without LARS on reference paddle; don't crash them here)
+            import warnings
+            warnings.warn(
+                "strategy.lars ignored: LarsOptimizer applies to "
+                "Momentum (got "
+                f"{type(optimizer).__name__})", UserWarning, stacklevel=2)
+            return optimizer
+        return LarsMomentum(
+            learning_rate=optimizer._lr_scheduler
+            if optimizer._lr_scheduler is not None
+            else float(optimizer._lr_tensor._value),
+            momentum=optimizer._momentum,
+            lars_coeff=lars_coeff, lars_weight_decay=lars_weight_decay,
+            epsilon=epsilon,
+            exclude_from_weight_decay=exclude_from_weight_decay,
+            parameters=optimizer._parameter_list,
+            grad_clip=optimizer._grad_clip)
 
 
 class RawProgramOptimizer:

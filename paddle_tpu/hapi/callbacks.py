@@ -184,12 +184,24 @@ class WandbCallback(Callback):
         self.records = []
         try:
             import wandb
+        except ImportError:
+            # the expected case in this zero-egress build: degrade
+            # silently to the local record
+            return
+        try:
             self.wandb = wandb
             self.run = wandb.init(project=project, name=name, dir=dir,
                                   mode=mode, job_type=job_type, **kwargs)
-        except Exception:  # noqa: BLE001 — auth/network errors degrade
-            # too: zero-egress deployments must keep training with the
-            # local record, not crash at callback construction
+        except Exception as e:  # noqa: BLE001 — auth/network/config
+            # errors degrade too (training must not crash at callback
+            # construction), but UNLIKE a missing package this is a real
+            # failure the user believes is working — say so
+            import warnings
+            warnings.warn(
+                f"WandbCallback: wandb.init failed "
+                f"({type(e).__name__}: {e}); degrading to local records "
+                f"— runs are NOT being logged to W&B", RuntimeWarning,
+                stacklevel=2)
             self.wandb = None
             self.run = None
 

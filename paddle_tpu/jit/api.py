@@ -37,10 +37,23 @@ class _CompiledEntry(__import__("typing").NamedTuple):
     out_info: object
     state_list: list
     grad_idx: tuple
+    # uids whose grads the traced fn CLEARED (clear_grad) during the
+    # step: their materialized grads overwrite param.grad; all others
+    # accumulate onto whatever .grad held before the call — matching
+    # what the same fn does in eager mode (the traced program always
+    # starts grads at None, so its grad outputs are per-step deltas)
+    grad_cleared: frozenset = frozenset()
 
 
 def _in_to_static_trace():
     return getattr(_trace_state, "active", False)
+
+
+def note_grad_cleared(uid):
+    """Called by Tensor.clear_grad: records, during a to_static trace,
+    that the step clears this tensor's grad (see _CompiledEntry)."""
+    if getattr(_trace_state, "active", False):
+        getattr(_trace_state, "cleared_uids", set()).add(uid)
 
 
 def _is_tensor(x):
@@ -115,6 +128,7 @@ class StaticFunction:
             state_list = self._trace_state_list
             snap = _StateSnapshot(state_list)
             _trace_state.active = True
+            _trace_state.cleared_uids = set()
             try:
                 for t, v in zip(state_list, state_vals):
                     t._value = v
@@ -152,6 +166,7 @@ class StaticFunction:
                         grad_idx.append(i)
                         grad_vals.append(g._value)
                 self._grad_idx = tuple(grad_idx)
+                self._grad_cleared = frozenset(_trace_state.cleared_uids)
                 arrays = [v for v, s in zip(out_vals, out_static) if s is _ARRAY]
                 return arrays, new_state, grad_vals
             finally:
@@ -176,6 +191,18 @@ class StaticFunction:
         for attempt in range(3):
             state_list = _ordered_state()
             state_vals = [t._value for t in state_list]
+            if self._donate:
+                # two state tensors can end up holding the SAME jax.Array
+                # (e.g. set_state_dict from another live Layer's
+                # state_dict) — donating one buffer twice is an XLA
+                # execute error, so break accidental aliasing here
+                seen = set()
+                for i, v in enumerate(state_vals):
+                    if id(v) in seen:
+                        state_vals[i] = jnp.array(v, copy=True)
+                        state_list[i]._value = state_vals[i]
+                    else:
+                        seen.add(id(v))
             reg_ver = fstate.registry_version()
             key = (
                 in_treedef,
@@ -195,7 +222,8 @@ class StaticFunction:
                 if fstate.registry_version() != reg_ver:
                     continue
                 self._compiled[key] = _CompiledEntry(
-                    jitted, self._out_info, state_list, self._grad_idx)
+                    jitted, self._out_info, state_list, self._grad_idx,
+                    self._grad_cleared)
                 entry = self._compiled[key]
             jitted = entry.jitted
             out_arrays, new_state, grad_vals = jitted(state_vals,
@@ -215,8 +243,15 @@ class StaticFunction:
             if t.grad is None:
                 t.grad = Tensor(gv, stop_gradient=True,
                                 name=t.name + "@GRAD")
-            else:
+            elif t._uid in entry.grad_cleared:
+                # the step clears before backward — fresh grads replace
                 t.grad._value = gv
+            else:
+                # the step did NOT clear: eager semantics accumulate the
+                # per-step grad onto the pre-call .grad (the compiled
+                # program always starts its grads at None, so gv is this
+                # step's delta, never a running total)
+                t.grad._value = t.grad._value + gv
 
     def _rewrap(self, entry, out_arrays):
         out_treedef, out_static = entry.out_info

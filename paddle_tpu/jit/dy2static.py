@@ -34,11 +34,30 @@ TPU-native redesign (what changes vs the reference):
   reference's `convert_call`), so control flow inside a model's forward
   converts even when only the train step carries `@to_static`.
 
+- `break` / `continue` under tensor conditions convert via the
+  reference's guard-flag form (break_continue_transformer.py:1): a
+  `break` becomes a loop-carried bool flag set under the (converted)
+  condition, statements after the set point are guard-wrapped in
+  `if not flag:`, and the loop test gains `not flag and ...`; a
+  for-range with break lowers to the explicit while form so the test
+  can carry the flag. `continue` uses a per-iteration flag reset at the
+  top of the body. Under python-valued conditions the flags stay python
+  bools and the loop exits eagerly at the next test, preserving eager
+  semantics.
+- Early `return` (return_transformer.py:1 / early_return_transformer):
+  instead of the reference's return-flag, guard-clause returns are
+  NORMALIZED — the statements after `if c: return v` are pushed into
+  its `else`, recursively, producing the both-branches-return form that
+  `convert_ifelse_ret` merges with one select. `return` inside a
+  tensor-converted LOOP stays unsupported (a lax.while_loop carry
+  cannot hold a value first bound mid-loop); such loops are left as
+  plain Python and a tensor condition there raises loudly.
+
 Conversion is best-effort and safe: any function whose source is
-unavailable, or any construct outside the supported subset
-(`break`/`continue`/early-`return` inside a converted branch), is left
-as plain Python — correct eagerly, and a tensor-valued condition there
-still raises the usual concretization error pointing here.
+unavailable, or any construct outside the supported subset (e.g.
+`return` inside a converted loop, `break` in a non-range `for`), is
+left as plain Python — correct eagerly, and a tensor-valued condition
+there still raises the usual concretization error pointing here.
 
 Known dark corner: a variable bound in only ONE branch of a tensor-`if`
 merges to a poison sentinel — every ordinary read (arithmetic,
@@ -229,13 +248,17 @@ def convert_while(cond_fn, body_fn, get_args, set_args, maybe_temp=None):
     the UNDEF sentinel (Python keeps the last iteration's value; reading
     it after a TENSOR-converted loop raises, loudly).
     """
-    c0 = cond_fn()
-    if not _is_traced(c0):
-        c = c0
-        while _truth(c):
-            body_fn()
-            c = cond_fn()
-        return get_args()
+    # the condition can BECOME traced mid-loop (a desugared break flag
+    # flips to a where-merged tensor on the first tensor-valued
+    # iteration) — re-dispatch every iteration; prior eager iterations
+    # are simply trace-time-unrolled prefix steps
+    while True:
+        c = cond_fn()
+        if _is_traced(c):
+            break
+        if not _truth(c):
+            return get_args()
+        body_fn()
 
     init = get_args()
     n = len(init)
@@ -273,13 +296,17 @@ def convert_while(cond_fn, body_fn, get_args, set_args, maybe_temp=None):
     return get_args()
 
 
+def _as_bool(v):
+    """bool-coerce a possibly-python operand for a traced logical op."""
+    return jnp.asarray(_unwrap(v)).astype(bool)
+
+
 def convert_logical_and(*fns):
     v = fns[0]()
     for f in fns[1:]:
         if _is_traced(v):
             w = f()  # no short circuit under a trace: both evaluate
-            v = Tensor(jnp.logical_and(
-                _unwrap(v).astype(bool), _unwrap(w).astype(bool)))
+            v = Tensor(jnp.logical_and(_as_bool(v), _as_bool(w)))
         else:
             if not _truth(v):
                 return v
@@ -292,8 +319,7 @@ def convert_logical_or(*fns):
     for f in fns[1:]:
         if _is_traced(v):
             w = f()
-            v = Tensor(jnp.logical_or(
-                _unwrap(v).astype(bool), _unwrap(w).astype(bool)))
+            v = Tensor(jnp.logical_or(_as_bool(v), _as_bool(w)))
         else:
             if _truth(v):
                 return v
@@ -437,8 +463,17 @@ def _do_transform(fn):
         raise TypeError("not a plain def")
     fdef.decorator_list = []
 
+    # pre-passes: normalize guard-clause early returns into the
+    # both-branches-return form, then desugar break/continue into
+    # guard flags (see the module docstring)
+    ret_changed = [False]
+    fdef.body = _normalize_returns(fdef.body, [], ret_changed)
+    desugar = _ExitDesugar()
+    fdef.body = desugar.block(fdef.body)
+
     bound = _function_bound_names(fdef)
     tr = _Transformer(bound)
+    tr.changed = ret_changed[0] or desugar.changed
     # visit the BODY, not fdef itself — the transformer's
     # visit_FunctionDef is a no-descend guard for nested scopes
     new_body = []
@@ -650,6 +685,261 @@ def _empty_args():
                          defaults=[])
 
 
+# ---------------- pre-passes: early return + break/continue desugaring
+def _shallow_has_return(stmts):
+    """Return present at this control level (descends ifs/try, NOT
+    loops or nested defs — a loop owns its returns and stays plain)."""
+    for s in stmts:
+        for n in _walk_no_loops(s):
+            if isinstance(n, ast.Return):
+                return True
+    return False
+
+
+def _walk_no_loops(node):
+    """ast.walk that does not descend into loops or nested scopes."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.While, ast.For, ast.FunctionDef,
+                          ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _terminates(stmts):
+    """Every execution path through `stmts` ends in `return`."""
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, ast.Return):
+        return True
+    if isinstance(last, ast.If):
+        return (_terminates(last.body) and bool(last.orelse)
+                and _terminates(last.orelse))
+    return False
+
+
+def _normalize_returns(stmts, tail, changed):
+    """Equivalent of `stmts` followed by `tail`, with guard-clause early
+    returns rewritten so both branches of the `if` end in `return`
+    (reference return/early_return transformers; here the select-form
+    `convert_ifelse_ret` then merges the two return values). Only the
+    duplication-free cases transform: the returning branch must return
+    on ALL its paths, so the trailing statements move into the OTHER
+    branch exactly once."""
+    out = []
+    for k, s in enumerate(stmts):
+        if isinstance(s, ast.If):
+            rest = stmts[k + 1:]
+            b_ret = _terminates(s.body)
+            o_ret = bool(s.orelse) and _terminates(s.orelse)
+            if (_shallow_has_return(s.body)
+                    or _shallow_has_return(s.orelse)) and (b_ret or o_ret):
+                if b_ret and o_ret:
+                    # both branches return on every path: the tail is
+                    # unreachable and drops
+                    new = ast.If(
+                        test=s.test,
+                        body=_normalize_returns(s.body, [], changed),
+                        orelse=_normalize_returns(s.orelse, [], changed))
+                elif b_ret:
+                    changed[0] = True
+                    new = ast.If(
+                        test=s.test,
+                        body=_normalize_returns(s.body, [], changed),
+                        orelse=_normalize_returns(
+                            s.orelse + rest, tail, changed))
+                else:
+                    changed[0] = True
+                    new = ast.If(
+                        test=s.test,
+                        body=_normalize_returns(
+                            s.body + rest, tail, changed),
+                        orelse=_normalize_returns(s.orelse, [], changed))
+                ast.copy_location(new, s)
+                out.append(new)
+                return out
+        out.append(s)
+    out.extend(tail)
+    return out
+
+
+class _ExitDesugar:
+    """break/continue -> guard flags (reference
+    break_continue_transformer.py): the flags become ordinary locals
+    (`_d2s_v_*`, loop carries under a tensor-converted while), the
+    statements after a flag-set are wrapped in `if not flag:`, and the
+    loop condition gains `not brk and ...`. For-range loops with a
+    break lower to the explicit counter-while form here (same lowering
+    visit_For performs) so the test can carry the flag."""
+
+    def __init__(self):
+        self.n = 0
+        self.changed = False
+
+    def block(self, stmts):
+        """Desugar every loop in a statement list (recursing into ifs
+        and nested defs are skipped — they desugar on their own)."""
+        out = []
+        for s in stmts:
+            out.extend(self.stmt(s))
+        return out
+
+    def stmt(self, s):
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Lambda)):
+            return [s]
+        if isinstance(s, (ast.While, ast.For)):
+            return self.loop(s)
+        if isinstance(s, ast.If):
+            new = ast.If(test=s.test, body=self.block(s.body) or [ast.Pass()],
+                         orelse=self.block(s.orelse))
+            return [ast.copy_location(new, s)]
+        if isinstance(s, ast.Try):
+            new = ast.Try(
+                body=self.block(s.body),
+                handlers=[ast.ExceptHandler(type=h.type, name=h.name,
+                                            body=self.block(h.body))
+                          for h in s.handlers],
+                orelse=self.block(s.orelse),
+                finalbody=self.block(s.finalbody))
+            return [ast.copy_location(new, s)]
+        if isinstance(s, ast.With):
+            new = ast.With(items=s.items, body=self.block(s.body))
+            return [ast.copy_location(new, s)]
+        return [s]
+
+    def loop(self, node):
+        has_exit = _contains(node.body, (ast.Break, ast.Continue),
+                             stop_at_loops=True)
+        has_ret = _contains(node.body, (ast.Return,))
+        if not has_exit or has_ret or node.orelse:
+            # no exits to desugar — or a return makes the loop
+            # unconvertible anyway (left plain; visit_While/For bail)
+            body = self.block(node.body)
+            new = type(node)(**{**{f: getattr(node, f)
+                                   for f in node._fields}, "body": body})
+            return [ast.copy_location(new, node)]
+
+        self.n += 1
+        self.changed = True
+        i = self.n
+        brk = f"_d2s_v_brk_{i}"
+        cont = f"_d2s_v_cont_{i}"
+        used_cont = _contains(node.body, (ast.Continue,), stop_at_loops=True)
+        used_brk = _contains(node.body, (ast.Break,), stop_at_loops=True)
+
+        body, _ = self._rewrite(self.block(node.body), brk, cont,
+                                used_brk, used_cont)
+        pre = []
+        if used_cont:
+            # per-iteration flag: reset at body top, never carried
+            body = [_assign(cont, False)] + body
+        if used_brk:
+            pre.append(_assign(brk, False))
+
+        if isinstance(node, ast.While):
+            test = node.test
+            if used_brk:
+                test = ast.BoolOp(op=ast.And(), values=[
+                    ast.UnaryOp(op=ast.Not(), operand=_nm(brk)), test])
+            new = ast.While(test=test, body=body, orelse=[])
+            return pre + [ast.copy_location(new, node)]
+
+        # For: only `for <name> in range(...)` desugars (same subset
+        # visit_For converts); anything else keeps its raw break and
+        # stays plain Python
+        it = node.iter
+        is_range = (isinstance(it, ast.Call)
+                    and isinstance(it.func, ast.Name)
+                    and it.func.id == "range" and not it.keywords
+                    and 1 <= len(it.args) <= 3
+                    and isinstance(node.target, ast.Name))
+        if not is_range:
+            # keep the raw break (plain-Python loop) but still desugar
+            # any loops nested deeper
+            new = ast.For(target=node.target, iter=node.iter,
+                          body=self.block(node.body), orelse=[])
+            return [ast.copy_location(new, node)]
+        # `vd` namespace: visit_For independently numbers its own
+        # `_d2s_v_i_*` counters — a shared prefix collided (the inner
+        # desugared loop's make_range overwrote the outer counter)
+        ctr, stop, step = (f"_d2s_vd_{k}_{i}" for k in ("i", "stop", "step"))
+        setup = ast.Assign(
+            targets=[ast.Tuple(elts=[_nm(ctr, ast.Store()),
+                                     _nm(stop, ast.Store()),
+                                     _nm(step, ast.Store())],
+                               ctx=ast.Store())],
+            value=ast.Call(func=_ptd2s_attr("make_range"),
+                           args=list(it.args), keywords=[]))
+        test = ast.Call(func=_ptd2s_attr("range_cond"),
+                        args=[_nm(ctr), _nm(stop), _nm(step)], keywords=[])
+        if used_brk:
+            test = ast.BoolOp(op=ast.And(), values=[
+                ast.UnaryOp(op=ast.Not(), operand=_nm(brk)), test])
+        bind = _assign_name(node.target.id, _nm(ctr))
+        # the counter increment is LOOP MACHINERY: it sits outside the
+        # continue guard (python's `for` advances the iterator on
+        # continue) and runs even on the break iteration (the flag, not
+        # the counter, ends the loop)
+        inc = ast.Assign(targets=[_nm(ctr, ast.Store())],
+                         value=ast.BinOp(left=_nm(ctr), op=ast.Add(),
+                                         right=_nm(step)))
+        new = ast.While(test=test, body=[bind] + body + [inc], orelse=[])
+        return pre + [ast.copy_location(setup, node),
+                      ast.copy_location(new, node)]
+
+    def _rewrite(self, stmts, brk, cont, used_brk, used_cont):
+        """Replace break/continue at THIS loop level with flag sets and
+        guard-wrap the statements that follow a possible set. Returns
+        (stmts, may_set_flag)."""
+        out = []
+        for k, s in enumerate(stmts):
+            if isinstance(s, ast.Break):
+                out.append(ast.copy_location(_assign(brk, True), s))
+                return out, True            # rest of the list is dead
+            if isinstance(s, ast.Continue):
+                out.append(ast.copy_location(_assign(cont, True), s))
+                return out, True
+            if isinstance(s, ast.If):
+                b, bf = self._rewrite(s.body, brk, cont,
+                                      used_brk, used_cont)
+                o, of = self._rewrite(s.orelse, brk, cont,
+                                      used_brk, used_cont)
+                s = ast.copy_location(
+                    ast.If(test=s.test, body=b or [ast.Pass()], orelse=o),
+                    s)
+                if bf or of:
+                    out.append(s)
+                    rest, _ = self._rewrite(stmts[k + 1:], brk, cont,
+                                            used_brk, used_cont)
+                    if rest:
+                        flags = ([_nm(brk)] if used_brk else []) + \
+                                ([_nm(cont)] if used_cont else [])
+                        test = flags[0] if len(flags) == 1 else \
+                            ast.BoolOp(op=ast.Or(), values=flags)
+                        guard = ast.If(
+                            test=ast.UnaryOp(op=ast.Not(), operand=test),
+                            body=rest, orelse=[])
+                        out.append(ast.copy_location(guard, s))
+                    return out, True
+                out.append(s)
+                continue
+            out.append(s)
+        return out, False
+
+
+def _assign(name, const):
+    return ast.Assign(targets=[_nm(name, ast.Store())],
+                      value=ast.Constant(value=const))
+
+
+def _assign_name(name, value):
+    return ast.Assign(targets=[_nm(name, ast.Store())], value=value)
+
+
 def _nm(n, ctx=None):
     return ast.Name(id=n, ctx=ctx or ast.Load())
 
@@ -759,11 +1049,18 @@ class _Transformer(ast.NodeTransformer):
             i = self._next()
             tname, fname = f"{_GEN_PREFIX}t_{i}", f"{_GEN_PREFIX}f_{i}"
             stmts = []
+            guard_names = set()
             for name, branch in ((tname, body), (fname, orelse)):
                 assigned = _collect_bound(branch)
                 nl = sorted(assigned & self.bound)
+                # a branch-local name may have NO enclosing binding
+                # (e.g. bound only inside this branch after return
+                # normalization pushed it here): the UNDEF guard
+                # creates one so `nonlocal` is legal
+                guard_names.update(nl)
                 b = ([ast.Nonlocal(names=nl)] if nl else []) + branch
                 stmts.append(_def(name, b))
+            stmts = [_undef_guard(n) for n in sorted(guard_names)] + stmts
             self.changed = True
             ret = ast.Return(value=ast.Call(
                 func=_ptd2s_attr("convert_ifelse_ret"),

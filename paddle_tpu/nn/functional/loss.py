@@ -526,7 +526,9 @@ def _flce(x, w, y, valid, chunk):
         return None, lse - lab
 
     _, losses = jax.lax.scan(body, None, (xs, ys))
-    return jnp.sum(losses.reshape(-1) * valid) / jnp.sum(valid)
+    # max(1): an all-ignored batch must yield loss 0, not nan
+    return (jnp.sum(losses.reshape(-1) * valid)
+            / jnp.maximum(jnp.sum(valid), 1.0))
 
 
 def _flce_fwd(x, w, y, valid, chunk):
@@ -540,7 +542,7 @@ def _flce_bwd(chunk, res, ct):
     n = x.shape[0]
     xs = x.reshape(n // chunk, chunk, x.shape[1])
     ys = y.reshape(n // chunk, chunk)
-    per_tok = (ct / jnp.sum(valid)) * valid          # [n]
+    per_tok = (ct / jnp.maximum(jnp.sum(valid), 1.0)) * valid    # [n]
     scales = per_tok.reshape(n // chunk, chunk)
 
     def body(dw, c):
@@ -569,7 +571,7 @@ _flce.defvjp(_flce_fwd, _flce_bwd)
 
 
 def fused_linear_cross_entropy(hidden, weight, label, chunk_size=8192,
-                               name=None):
+                               ignore_index=-100, name=None):
     """LM-head matmul + softmax cross entropy WITHOUT materializing the
     [tokens, vocab] logits: tokens stream through lax.scan in
     `chunk_size` slices and the backward rematerializes each chunk's
@@ -581,7 +583,9 @@ def fused_linear_cross_entropy(hidden, weight, label, chunk_size=8192,
     tensor by sharding it over mp ranks; on one chip we avoid it by
     chunking time. hidden: [..., H] (flattened to tokens), weight:
     [H, vocab], label: int ids matching hidden's leading dims.
-    Returns the mean loss.
+    Labels equal to `ignore_index` (padding tokens, reference
+    softmax_with_cross_entropy convention) are excluded from the mean
+    and clamped before the vocab gather. Returns the mean loss.
     """
     def fn(h, w, y):
         hf = h.reshape(-1, h.shape[-1])
@@ -590,7 +594,8 @@ def fused_linear_cross_entropy(hidden, weight, label, chunk_size=8192,
         c = min(chunk_size, n)
         pad = (-n) % c   # pad to a chunk multiple; a divisor fallback
         # would degrade to chunk=1 for prime n (thousands of [1, V] steps)
-        valid = jnp.ones((n,), jnp.float32)
+        valid = (yf != ignore_index).astype(jnp.float32)
+        yf = jnp.where(yf == ignore_index, 0, yf)
         if pad:
             hf = jnp.pad(hf, ((0, pad), (0, 0)))
             yf = jnp.pad(yf, (0, pad))

@@ -22,4 +22,12 @@ from paddle_tpu.optimizer.lr import (  # noqa: F401
 from paddle_tpu.optimizer.adam import Adam, Adamax, AdamW, Lamb  # noqa: F401
 from paddle_tpu.optimizer.optimizer import Optimizer  # noqa: F401
 from paddle_tpu.optimizer.rmsprop import Adadelta, Adagrad, RMSProp  # noqa: F401
-from paddle_tpu.optimizer.sgd import SGD, Momentum  # noqa: F401
+from paddle_tpu.optimizer.gradient_merge import (  # noqa: F401
+    GradientMergeOptimizer,
+)
+from paddle_tpu.optimizer.sgd import (  # noqa: F401
+    SGD,
+    LarsMomentum,
+    LarsMomentumOptimizer,
+    Momentum,
+)

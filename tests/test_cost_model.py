@@ -1,0 +1,22 @@
+"""paddle.cost_model surface (r5; reference python/paddle/cost_model/)."""
+import paddle_tpu as P
+
+
+def test_cost_model_profile_measure():
+    cm = P.cost_model.CostModel()
+    step, args = cm.build_program()
+    out = cm.profile_measure(step, *args)
+    assert out["flops"] > 0
+    assert out["bytes_accessed"] > 0
+    assert out["time_ms"] > 0
+
+
+def test_static_op_time_empty_table_degrades():
+    cm = P.cost_model.CostModel()
+    assert cm.static_cost_data() == []
+    assert cm.get_static_op_time("matmul") == {}
+    try:
+        cm.get_static_op_time(None)
+        raise AssertionError("expected ValueError")
+    except ValueError:
+        pass

@@ -1,0 +1,129 @@
+"""Process-based DataLoader workers (r5, VERDICT #6).
+
+Reference: python/paddle/fluid/dataloader/worker.py (_worker_loop) +
+dataloader_iter.py (_DataLoaderIterMultiProcess): num_workers>0 runs
+__getitem__ + transforms in real worker processes; batches return via
+shared memory. Threads remain for iterable/tensor-producing datasets
+(the AUTO heuristic) and the C++ ring still owns array-backed datasets.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu.io import DataLoader, Dataset
+
+
+class _NpDataset(Dataset):
+    def __init__(self, n=32):
+        self.n = n
+        self.data = np.random.default_rng(0).standard_normal(
+            (n, 8, 8)).astype(np.float32)
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return self.data[i] * 2.0, np.int64(i % 4)
+
+
+class _PidDataset(Dataset):
+    def __len__(self):
+        return 16
+
+    def __getitem__(self, i):
+        return np.full((2,), os.getpid(), np.int64)
+
+
+class _TensorDatasetLike(Dataset):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        return P.to_tensor(np.ones((3,), np.float32) * i)
+
+
+class _BoomDataset(Dataset):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        if i == 5:
+            raise ValueError("boom at 5")
+        return np.ones((2,), np.float32)
+
+
+def test_process_workers_parity_and_order():
+    ds = _NpDataset()
+    serial = list(DataLoader(ds, batch_size=4, num_workers=0))
+    procs = list(DataLoader(ds, batch_size=4, num_workers=3,
+                            use_process_workers=True))
+    assert len(serial) == len(procs)
+    for (x0, y0), (xp, yp) in zip(serial, procs):
+        np.testing.assert_allclose(x0.numpy(), xp.numpy(), rtol=1e-6)
+        np.testing.assert_array_equal(y0.numpy(), yp.numpy())
+
+
+def test_workers_are_real_processes():
+    dl = DataLoader(_PidDataset(), batch_size=4, num_workers=2,
+                    use_process_workers=True)
+    pids = set()
+    for (b,) in [(b,) for b in dl]:
+        pids.update(np.asarray(b.numpy()).ravel().tolist())
+    assert os.getpid() not in pids          # work happened off-process
+    assert len(pids) >= 1
+
+
+def test_auto_heuristic_routes_tensor_datasets_to_threads():
+    dl = DataLoader(_TensorDatasetLike(), batch_size=2, num_workers=2)
+    assert dl._process_mode() is False      # jax content -> threads
+    dl2 = DataLoader(_NpDataset(), batch_size=2, num_workers=2)
+    assert dl2._process_mode() is True      # numpy content -> processes
+    out = list(dl)                          # thread path still works
+    assert len(out) == 4
+
+
+def test_worker_error_propagates():
+    dl = DataLoader(_BoomDataset(), batch_size=4, num_workers=2,
+                    use_process_workers=True)
+    with pytest.raises(RuntimeError, match="boom at 5"):
+        list(dl)
+
+
+def test_shared_memory_off_path():
+    ds = _NpDataset(n=8)
+    a = list(DataLoader(ds, batch_size=4, num_workers=2,
+                        use_process_workers=True, use_shared_memory=False))
+    b = list(DataLoader(ds, batch_size=4, num_workers=0))
+    for (x0, _), (x1, _) in zip(b, a):
+        np.testing.assert_allclose(x0.numpy(), x1.numpy(), rtol=1e-6)
+
+
+class _SlowDataset(Dataset):
+    def __len__(self):
+        return 16
+
+    def __getitem__(self, i):
+        # pure-python busy loop: GIL-bound in a thread, parallel in a
+        # process
+        acc = 0.0
+        for k in range(400_000):
+            acc += (k % 7) * 1e-9
+        return np.float32(acc) + np.ones((4,), np.float32)
+
+
+@pytest.mark.nightly
+@pytest.mark.skipif((os.cpu_count() or 1) < 2,
+                    reason="wall-clock worker scaling needs >1 core")
+def test_process_workers_scale_on_multicore():
+    import time
+    ds = _SlowDataset()
+    t0 = time.perf_counter()
+    list(DataLoader(ds, batch_size=2, num_workers=0))
+    serial = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    list(DataLoader(ds, batch_size=2, num_workers=4,
+                    use_process_workers=True))
+    par = time.perf_counter() - t0
+    assert serial / par > 2.0, f"only {serial / par:.2f}x from 4 workers"

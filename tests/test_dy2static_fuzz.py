@@ -4,8 +4,10 @@ tensors) and compiled (converted select/while_loop under to_static).
 
 Programs are generated deterministically (seeded) from a small grammar:
 arithmetic on a carried tensor, tensor-`if` (possibly elif/else,
-possibly nested), tensor-bounded `while` with a decreasing guard, and
-python `for` loops — the constructs the converter owns.
+possibly nested), tensor-bounded `while` with a decreasing guard,
+python `for` loops, tensor-conditional `break`/`continue` inside
+loops, and guard-clause early `return`s — the constructs the converter
+owns (r5 grew the exit statements alongside the desugar pre-passes).
 """
 import numpy as np
 import pytest
@@ -21,36 +23,44 @@ _CONDS = ["y.sum() > {t}", "y.mean() > {t}", "y.max() < {t}",
           "(y.min() > {t}) or (y.sum() > 0)"]
 
 
-def _gen_block(rng, depth, lines, indent):
+def _gen_block(rng, depth, lines, indent, in_loop=False):
     pad = "    " * indent
     for _ in range(rng.integers(1, 3)):
         lines.append(pad + _OPS[rng.integers(0, len(_OPS))])
+    if in_loop and rng.integers(0, 3) == 0:
+        # tensor-conditional loop exit: the r5 desugar turns these into
+        # guard flags; eager python takes the real break/continue
+        t = float(rng.uniform(-2, 2))
+        exit_kw = "break" if rng.integers(0, 2) else "continue"
+        lines.append(pad + f"if y.sum() > {t}:")
+        lines.append(pad + f"    {exit_kw}")
     kind = rng.integers(0, 4 if depth > 0 else 2)
     if kind == 2 and depth > 0:          # tensor if / elif / else
         t = float(rng.uniform(-2, 2))
         lines.append(pad + "if " + _CONDS[rng.integers(
             0, len(_CONDS))].format(t=t) + ":")
-        _gen_block(rng, depth - 1, lines, indent + 1)
+        _gen_block(rng, depth - 1, lines, indent + 1, in_loop)
         if rng.integers(0, 2):
             lines.append(pad + f"elif y.sum() > {t - 1.0}:")
-            _gen_block(rng, depth - 1, lines, indent + 1)
+            _gen_block(rng, depth - 1, lines, indent + 1, in_loop)
         lines.append(pad + "else:")
-        _gen_block(rng, depth - 1, lines, indent + 1)
+        _gen_block(rng, depth - 1, lines, indent + 1, in_loop)
     elif kind == 3 and depth > 0:        # bounded tensor while
         # one counter PER NESTING DEPTH: a nested while that reset the
         # shared `n` undid the outer loop's progress and produced a
         # genuinely non-terminating program (found at seed 50 — eager
         # and compiled both spin, so it is a generator bug, not a
-        # converter bug)
+        # converter bug). The counter increments FIRST so a generated
+        # `continue` cannot skip it (termination stays guaranteed).
         n = f"n{indent}"
         lines.append(pad + f"{n} = p.zeros([])")
         lines.append(pad + f"while ({n} < {int(rng.integers(1, 4))}.0)"
                            f" and (y.abs().max() < 100.0):")
-        _gen_block(rng, depth - 1, lines, indent + 1)
         lines.append(pad + f"    {n} = {n} + 1.0")
+        _gen_block(rng, depth - 1, lines, indent + 1, in_loop=True)
     elif kind == 1:                      # python for
-        lines.append(pad + f"for _k in range({int(rng.integers(1, 3))}):")
-        _gen_block(rng, max(depth - 1, 0), lines, indent + 1)
+        lines.append(pad + f"for _k in range({int(rng.integers(2, 4))}):")
+        _gen_block(rng, max(depth - 1, 0), lines, indent + 1, in_loop=True)
     # kind == 0: plain arithmetic only
 
 
@@ -63,6 +73,11 @@ def _make_program(seed, depth=2):
              "        return v * 1.1",
              "",
              "def prog(x):", "    y = x * 1.0"]
+    if rng.integers(0, 3) == 0:
+        # guard-clause early return (r5 return normalization): the rest
+        # of the program body becomes the implicit else
+        lines.append(f"    if y.sum() > {float(rng.uniform(-1, 1))}:")
+        lines.append("        return y * 2.0 + 0.25")
     _gen_block(rng, depth, lines, 1)
     lines.append("    return y")
     src = "\n".join(lines) + "\n"

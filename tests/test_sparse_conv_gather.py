@@ -1,0 +1,111 @@
+"""True sparse compute for SubmConv3D (r5, VERDICT #5).
+
+Reference: python/paddle/sparse/nn/layer/conv.py + phi sparse
+gather-gemm-scatter kernels (the rulebook). Here the rulebook is a
+sorted-coordinate join (argsort + searchsorted per kernel offset) and
+the gemm is ONE dense [nnz, K³·Cin] x [K³·Cin, Cout] MXU dot — work
+scales with nnz, not volume. The dense mirror stays as the oracle.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as P
+from paddle_tpu import sparse
+import paddle_tpu.sparse.nn as spnn
+
+
+def _random_sparse(rng, shape, nnz_sites):
+    N, D, H, W, C = shape
+    dense = np.zeros(shape, np.float32)
+    sites = rng.choice(N * D * H * W, size=nnz_sites, replace=False)
+    n, z, y, x = np.unravel_index(sites, (N, D, H, W))
+    dense[n, z, y, x] = rng.standard_normal((nnz_sites, C))
+    return dense
+
+
+def test_gather_matches_dense_oracle():
+    rng = np.random.default_rng(0)
+    dense = _random_sparse(rng, (2, 6, 7, 5, 3), 40)
+    xt = sparse.to_sparse_coo(P.to_tensor(dense), sparse_dim=4)
+    P.seed(0)
+    conv = spnn.SubmConv3D(3, 4, kernel_size=3, padding=1)
+    assert xt._bcoo.indices.shape[-1] == 4  # fast path engages
+    out_g = conv(xt)
+    out_d = conv.forward_dense(xt)
+    np.testing.assert_allclose(np.asarray(out_g._value),
+                               np.asarray(out_d._value),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_gather_dilation_and_even_kernel():
+    rng = np.random.default_rng(1)
+    dense = _random_sparse(rng, (1, 8, 8, 8, 2), 30)
+    xt = sparse.to_sparse_coo(P.to_tensor(dense), sparse_dim=4)
+    conv = spnn.SubmConv3D(2, 3, kernel_size=3, padding=2, dilation=2)
+    np.testing.assert_allclose(np.asarray(conv(xt)._value),
+                               np.asarray(conv.forward_dense(xt)._value),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_gather_weight_grads_match_masked_dense():
+    rng = np.random.default_rng(2)
+    shape = (2, 6, 7, 5, 3)
+    dense = _random_sparse(rng, shape, 40)
+    xt = sparse.to_sparse_coo(P.to_tensor(dense), sparse_dim=4)
+    P.seed(0)
+    conv = spnn.SubmConv3D(3, 4, kernel_size=3, padding=1)
+    conv(xt).values().sum().backward()
+    ge = conv.weight.grad.numpy().copy()
+    conv.clear_gradients()
+    # oracle: dense conv masked to the active set, summed
+    N, D, H, W, C = shape
+    active = (dense != 0).any(-1)
+    mask = np.broadcast_to(active[:, None],
+                           (N, 4, D, H, W)).astype(np.float32)
+    out = conv._conv(P.to_tensor(np.moveaxis(dense, -1, 1)))
+    (out * P.to_tensor(mask)).sum().backward()
+    np.testing.assert_allclose(ge, conv.weight.grad.numpy(),
+                               rtol=1e-3, atol=1e-5)
+
+
+def test_sparse_layer_chain_grads_flow():
+    """values() of the gather output stays on the tape: two stacked
+    sparse layers backprop into the FIRST layer's weight."""
+    rng = np.random.default_rng(3)
+    dense = _random_sparse(rng, (1, 6, 6, 6, 3), 25)
+    xt = sparse.to_sparse_coo(P.to_tensor(dense), sparse_dim=4)
+    P.seed(0)
+    c1 = spnn.SubmConv3D(3, 5, kernel_size=3, padding=1)
+    c2 = spnn.SubmConv3D(5, 2, kernel_size=3, padding=1)
+    c2(c1(xt)).values().sum().backward()
+    assert c1.weight.grad is not None
+    assert np.abs(c1.weight.grad.numpy()).sum() > 0
+
+
+def test_compute_scales_with_nnz_not_volume():
+    """XLA cost analysis of the compiled gather step: at fixed volume,
+    50x the active sites must cost >10x the flops (the dense mirror
+    would be occupancy-independent)."""
+    rng = np.random.default_rng(4)
+    Dv = Hv = Wv = 16
+    flops = {}
+    for occ in (0.01, 0.5):
+        k = int(Dv * Hv * Wv * occ)
+        dense = _random_sparse(rng, (1, Dv, Hv, Wv, 8), k)
+        xt = sparse.to_sparse_coo(P.to_tensor(dense), sparse_dim=4)
+        conv = spnn.SubmConv3D(8, 8, kernel_size=3, padding=1)
+        idx = jnp.asarray(xt._bcoo.indices)
+        vals = jnp.asarray(xt._bcoo.data)
+
+        def run(vals, w):
+            x2 = sparse.SparseCooTensor(jnp.swapaxes(idx, 0, 1), vals,
+                                        (1, Dv, Hv, Wv, 8))
+            return conv(x2).values()._value
+
+        cost = (jax.jit(run).lower(vals, conv.weight._value)
+                .compile().cost_analysis())
+        cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+        flops[occ] = cost["flops"]
+    ratio = flops[0.5] / max(flops[0.01], 1.0)
+    assert ratio > 10.0, f"flops ratio {ratio:.1f} — not nnz-scaling"

@@ -8,6 +8,12 @@ that fits v5e HBM (16 GB) comfortably with AdamW fp32 states.
 Run ON TPU (never kill it mid-run):
   python tools/profile_gpt.py [--hidden 1024] [--layers 24]
       [--batch 4] [--seq 2048] [--iters 6]
+
+GPT-3 1.3B (BASELINE configs[3], hidden 2048 / 24 layers / seq 2048) on
+ONE 16 GB v5e needs the fit levers the pod-mesh reference gets from
+sharding stage2/3: bf16 params + bf16 Adam moments + remat + the
+chunked fused LM-head CE (no [b,s,V] logits) + donation:
+  python tools/profile_gpt.py --preset 1p3b [--batch 8]
 """
 from __future__ import annotations
 
@@ -33,7 +39,19 @@ def main():
     ap.add_argument("--no-recompute", action="store_true")
     ap.add_argument("--fused-head", action="store_true",
                     help="chunked fused LM-head+CE: no [b,s,V] logits")
+    ap.add_argument("--param-dtype", default=None,
+                    help="cast model params (e.g. bfloat16)")
+    ap.add_argument("--moment-dtype", default=None,
+                    help="Adam moment storage dtype (e.g. bfloat16)")
+    ap.add_argument("--preset", default=None, choices=[None, "1p3b"],
+                    help="1p3b = GPT-3 1.3B single-chip fit recipe")
     args = ap.parse_args()
+    if args.preset == "1p3b":
+        args.hidden, args.layers, args.heads = 2048, 24, 16
+        args.seq = 2048
+        args.fused_head = True
+        args.param_dtype = args.param_dtype or "bfloat16"
+        args.moment_dtype = args.moment_dtype or "bfloat16"
 
     import jax
 
@@ -53,9 +71,12 @@ def main():
                     attention_dropout=0.0,
                     use_recompute=not args.no_recompute)
     model = GPTForCausalLM(cfg)
+    if args.param_dtype:
+        model.to(dtype=args.param_dtype)
     crit = GPTPretrainingCriterion()
     opt = P.optimizer.AdamW(learning_rate=1e-4,
-                            parameters=model.parameters())
+                            parameters=model.parameters(),
+                            moment_dtype=args.moment_dtype)
     n_params = sum(int(np.prod(q.shape)) for q in model.parameters())
     print(f"params: {n_params/1e6:.1f}M", flush=True)
 
@@ -106,6 +127,9 @@ def main():
            "batch": args.batch, "seq": args.seq,
            "ms_per_step": round(dt * 1e3, 1),
            "recompute": cfg.use_recompute,
+           "fused_head": bool(args.fused_head),
+           "param_dtype": args.param_dtype or "float32",
+           "moment_dtype": args.moment_dtype or "float32",
            "flops_per_token_g": round(flops_per_token / 1e9, 2),
            "mfu": round(mfu, 4)}
     print(json.dumps(out), flush=True)

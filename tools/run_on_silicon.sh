@@ -23,7 +23,17 @@ echo "--- 1. bench.py ---"
 python bench.py || rc=1
 echo "--- 2. tests_tpu ---"
 python -m pytest tests_tpu/ -q --no-header -p no:cacheprovider || rc=1
-echo "--- 3. flash sweep ---"
+echo "--- 3. gpt 355M fused-head batch sweep (r4's lost datapoint) ---"
+python tools/profile_gpt.py --batch 16 --fused-head --iters 6 || rc=1
+echo "--- 4. gpt-3 1.3B single-chip fit (VERDICT r4 #2) ---"
+# CPU-smoked shape (tiny) before any silicon compile — wedge rule.
+# batch 4 first (smaller program), then 8; separate processes so an
+# OOM in one cannot take the other's datapoint.
+python tools/profile_gpt.py --preset 1p3b --batch 4 --iters 5 || rc=1
+python tools/profile_gpt.py --preset 1p3b --batch 8 --iters 5 || rc=1
+echo "--- 5. bert occupancy profile ---"
+python tools/profile_bert.py || rc=1
+echo "--- 6. flash sweep ---"
 python tools/sweep_flash.py || rc=1
 echo "=== capture complete (rc=$rc) ==="
 echo "log: $LOG (bench JSON + sweep also appended to BENCH_NOTES.md)"
