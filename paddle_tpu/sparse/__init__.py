@@ -36,6 +36,13 @@ class SparseCooTensor(Tensor):
         self._bcoo = jsparse.BCOO((vv, jnp.swapaxes(iv, 0, 1)),
                                   shape=tuple(int(s) for s in shape))
         self._dense_cache = None
+        # static-shape padding convention: producers whose true nnz is
+        # data-dependent (e.g. strided sparse conv under jit) carry a
+        # bool [nnz] row mask here; None = every stored row is live.
+        # Padded rows hold value 0 at a duplicated live coordinate, so
+        # coalescing consumers (to_dense, conv joins) need no mask —
+        # row-wise consumers (BatchNorm, Softmax) must honor it.
+        self._live_mask = None
         # Tensor.__init__ would require a dense value; init only the
         # non-storage fields so nothing materializes at construction
         self._init_meta(stop_gradient)
@@ -257,8 +264,10 @@ def subtract(x, y, name=None):
 def multiply(x, y, name=None):
     """Elementwise; sparse * scalar keeps sparsity."""
     if isinstance(x, SparseCooTensor) and np.isscalar(y):
-        return SparseCooTensor(jnp.swapaxes(x._bcoo.indices, 0, 1),
-                               x._bcoo.data * y, x._bcoo.shape)
+        out = SparseCooTensor(jnp.swapaxes(x._bcoo.indices, 0, 1),
+                              x._bcoo.data * y, x._bcoo.shape)
+        out._live_mask = x._live_mask
+        return out
     from paddle_tpu.tensor.math import multiply as dense_mul
     xv = x.to_dense() if isinstance(x, SparseCooTensor) else x
     yv = y.to_dense() if isinstance(y, SparseCooTensor) else y
@@ -266,11 +275,19 @@ def multiply(x, y, name=None):
 
 
 def _unary_on_values(fn_vals):
-    """Zero-preserving unary ops act on stored values only."""
+    """Zero-preserving unary ops act on stored values only (padded rows
+    hold 0 and zero-preserving ops keep them 0; the live mask
+    propagates). Values route through the tape so a sparse layer chain
+    (conv -> relu -> conv) backprops end to end."""
     def op(x, name=None):
         if isinstance(x, SparseCooTensor):
-            return SparseCooTensor(jnp.swapaxes(x._bcoo.indices, 0, 1),
-                                   fn_vals(x._bcoo.data), x._bcoo.shape)
+            tv = apply(fn_vals, x.values())
+            out = SparseCooTensor(jnp.swapaxes(x._bcoo.indices, 0, 1),
+                                  tv._value, x._bcoo.shape,
+                                  x.stop_gradient)
+            out._values = tv
+            out._live_mask = x._live_mask
+            return out
         return apply(fn_vals, x)
     return op
 
@@ -306,7 +323,9 @@ def cast(x, index_dtype=None, value_dtype=None, name=None):
         if value_dtype is not None:
             from paddle_tpu.core.dtype import convert_dtype
             vals = vals.astype(convert_dtype(value_dtype))
-        return SparseCooTensor(idx, vals, x._bcoo.shape)
+        out = SparseCooTensor(idx, vals, x._bcoo.shape)
+        out._live_mask = x._live_mask
+        return out
     return x.cast(value_dtype) if value_dtype is not None else x
 
 
@@ -340,7 +359,9 @@ def reshape(x, shape, name=None):
     new_idx = jnp.stack(
         [(flat // int(st)) % int(dim)
          for st, dim in zip(new_strides, shape)], axis=0)
-    return SparseCooTensor(new_idx, x._bcoo.data, shape)
+    out = SparseCooTensor(new_idx, x._bcoo.data, shape)
+    out._live_mask = x._live_mask   # rows keep their order
+    return out
 
 
 def divide(x, y, name=None):
@@ -381,7 +402,9 @@ def transpose(x, perm, name=None):
     if isinstance(x, SparseCooTensor):
         idx = x._bcoo.indices[:, jnp.asarray(perm)]
         shape = tuple(x._bcoo.shape[p] for p in perm)
-        return SparseCooTensor(jnp.swapaxes(idx, 0, 1), x._bcoo.data, shape)
+        out = SparseCooTensor(jnp.swapaxes(idx, 0, 1), x._bcoo.data, shape)
+        out._live_mask = x._live_mask   # rows keep their order
+        return out
     from paddle_tpu.tensor.manipulation import transpose as dense_t
     return dense_t(x, perm)
 

@@ -34,28 +34,118 @@ class Softmax(Layer):
         if not isinstance(x, sparse.SparseCooTensor):
             import paddle_tpu.nn.functional as F
             return F.softmax(x, axis=self.axis)
-        if self.axis not in (-1, x._value.ndim - 1):
+        if self.axis not in (-1, x.ndim - 1):    # .ndim never densifies
             raise ValueError("sparse softmax supports only the last axis")
         # softmax over the STORED entries of each row (CSR nnz semantics:
-        # explicitly-stored zeros participate; implicit zeros do not)
+        # explicitly-stored zeros participate; implicit zeros do not).
+        # Rows masked dead by a cap-padding producer are ABSENT: they
+        # neither shift the max nor join the denominator, and emit 0.
+        from paddle_tpu.core.dispatch import apply
         bcoo = x._bcoo
-        vals = bcoo.data
         idx = bcoo.indices  # (nnz, ndim)
         shape = bcoo.shape
+        mask = x._live_mask
         # linearize all leading dims into one segment id per row
         row = jnp.zeros(idx.shape[0], dtype=jnp.int32)
         for d in range(len(shape) - 1):
             row = row * shape[d] + idx[:, d].astype(jnp.int32)
         nrows = int(np.prod(shape[:-1])) or 1
-        mx = jax.ops.segment_max(vals, row, num_segments=nrows)
-        e = jnp.exp(vals - mx[row])
-        denom = jax.ops.segment_sum(e, row, num_segments=nrows)
-        out = e / denom[row]
-        return sparse.SparseCooTensor(jnp.swapaxes(idx, 0, 1), out, shape)
+        if mask is not None:
+            row = jnp.where(mask, row, nrows)       # dead -> spill row
+            nseg = nrows + 1
+        else:
+            nseg = nrows
+
+        def fn(vals):
+            if vals.ndim == 2:
+                # site-layout COO (dense trailing channel): axis=-1 is
+                # the DENSE dim — softmax is per-row over channels
+                out = jax.nn.softmax(vals, axis=-1)
+                if mask is not None:
+                    out = jnp.where(mask[:, None], out, 0)
+                return out
+            mx = jax.ops.segment_max(vals, row, num_segments=nseg)
+            e = jnp.exp(vals - mx[row])
+            denom = jax.ops.segment_sum(e, row, num_segments=nseg)
+            out = e / denom[row]
+            return jnp.where(mask, out, 0) if mask is not None else out
+
+        tv = apply(fn, x.values())   # on the tape: chains backprop
+        res = sparse.SparseCooTensor(jnp.swapaxes(idx, 0, 1), tv._value,
+                                     shape, x.stop_gradient)
+        res._values = tv
+        res._live_mask = mask
+        return res
+
+
+def _flat_sites(idx, D, H, W):
+    n, z, y, x = (idx[:, i] for i in range(4))
+    return ((n * D + z) * H + y) * W + x
+
+
+def _prep_join(idx, vals, D, H, W, sent, mask=None):
+    """Sort + COALESCE the input sites: returns (cflat, cvals, rep).
+    cflat is ascending unique flat site ids padded with `sent`, cvals
+    the per-site SUMMED features at matching positions, and rep a bool
+    over the ORIGINAL rows marking each live site's first occurrence.
+    Coalescing makes the join exact for inputs carrying duplicate
+    coordinates or explicit zeros (e.g. the cap-padded output of a
+    strided sparse conv); rows masked dead by `mask` are excluded
+    entirely (their flats sort to `sent`)."""
+    flat = _flat_sites(idx, D, H, W)
+    if mask is not None:
+        flat = jnp.where(mask, flat, sent)
+    order = jnp.argsort(flat)
+    sf = flat[order]
+    sv = vals[order]
+    first = jnp.concatenate([jnp.ones((1,), bool), sf[1:] != sf[:-1]])
+    seg = jnp.cumsum(first) - 1
+    n = flat.shape[0]
+    cvals = jax.ops.segment_sum(sv, seg, num_segments=n)
+    pos_live = (jnp.arange(n) < seg[-1] + 1)
+    cflat = jnp.where(pos_live, jax.ops.segment_max(sf, seg,
+                                                    num_segments=n), sent)
+    # dead rows grouped under `sent` must not elect a representative
+    rep = jnp.zeros(n, bool).at[order].set(first & (sf < sent))
+    return cflat, cvals, rep
+
+
+def _join_gather(cflat, cvals, qflat, valid):
+    """Features of the input site at each query flat id (0 when the
+    site is inactive or the query invalid)."""
+    pos = jnp.clip(jnp.searchsorted(cflat, qflat), 0, cflat.shape[0] - 1)
+    found = (cflat[pos] == qflat) & valid
+    return jnp.where(found[:, None], cvals[pos], 0)
+
+
+def _empty_site_coo(sparse_mod, shape, dtype, stop_gradient):
+    """Zero-nnz site-layout COO (empty sparse input short-circuit)."""
+    idx = jnp.zeros((4, 0), jnp.int32)
+    vals = jnp.zeros((0, shape[-1]), dtype)
+    return sparse_mod.SparseCooTensor(idx, vals, shape, stop_gradient)
+
+
+def _pad3(p):
+    if isinstance(p, int):
+        return (p, p, p)
+    if isinstance(p, (list, tuple)) and len(p) == 3 and \
+            all(isinstance(v, int) for v in p):
+        return tuple(p)
+    return None
 
 
 class Conv3D(Layer):
-    """Sparse 3-D conv (NDHWC, like the reference's sparse Conv3D)."""
+    """Sparse 3-D conv (NDHWC, like the reference's sparse Conv3D).
+
+    r5: strided/non-submanifold sparse compute — output active sites are
+    the union of every kernel tap's image (the reference rulebook's
+    out-index set), built as a unique() over the nnz·K³ candidate ids
+    with a mathematically safe static cap (min(nnz·K³, out volume) ≥
+    the true count, so no site is ever silently dropped); features
+    gather through the same sorted-join as SubmConv3D into ONE
+    [cap, K³·Cin] × [K³·Cin, Cout] MXU dot. Cap-padded rows carry
+    (site-0, value-0) entries — summed away by any consumer that
+    coalesces (to_dense, the next sparse conv's join)."""
 
     def __init__(self, in_channels, out_channels, kernel_size, stride=1,
                  padding=0, dilation=1, groups=1, padding_mode="zeros",
@@ -79,10 +169,123 @@ class Conv3D(Layer):
 
     def forward(self, x):
         from paddle_tpu import sparse
+        pad = _pad3(self._conv._padding)
+        if (isinstance(x, sparse.SparseCooTensor)
+                and x._bcoo.indices.shape[-1] == 4
+                and x._bcoo.data.ndim == 2
+                and self._conv._groups == 1 and pad is not None):
+            return self._forward_gather_strided(x, pad)
+        return self.forward_dense(x)
+
+    def forward_dense(self, x):
+        from paddle_tpu import sparse
         from paddle_tpu.core.tensor import Tensor
         out = self._conv(self._dense_ncdhw(x))
         out = Tensor(jnp.moveaxis(out._value, 1, -1))  # -> NDHWC
         return sparse.to_sparse_coo(out)
+
+    def _forward_gather_strided(self, x, pad):
+        from paddle_tpu import sparse
+        from paddle_tpu.core.dispatch import apply
+
+        bcoo = x._bcoo
+        N, D, H, W, _ = bcoo.shape
+        kd, kh, kw = self._conv._kernel_size
+        sd, sh, sw = self._conv._stride
+        dd, dh, dw = self._conv._dilation
+        pd, ph, pw = pad
+        Do = (D + 2 * pd - dd * (kd - 1) - 1) // sd + 1
+        Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        if max(N * D * H * W, N * Do * Ho * Wo) >= 2 ** 31:
+            raise ValueError(
+                "sparse Conv3D gather path: volume exceeds int32 site "
+                "indexing; tile the volume")
+        Cout = self.weight.shape[0]
+        idx = jnp.asarray(bcoo.indices, jnp.int32)
+        nnz = idx.shape[0]
+        offs = [(dz, dy, dx) for dz in range(kd)
+                for dy in range(kh) for dx in range(kw)]
+        in_sent = N * D * H * W
+        out_sent = N * Do * Ho * Wo
+        cap = min(nnz * len(offs), out_sent)
+        if nnz == 0 or cap == 0:
+            return _empty_site_coo(sparse, (N, Do, Ho, Wo, Cout),
+                                   bcoo.data.dtype, x.stop_gradient)
+        in_mask = x._live_mask
+
+        def fn(vals, w, b):
+            n, z, y, xx = (idx[:, i] for i in range(4))
+            cflat, cvals, _ = _prep_join(idx, vals, D, H, W, in_sent,
+                                         in_mask)
+            # candidate output sites: every tap's image of every LIVE
+            # input site (valid when it lands on the stride grid, in
+            # range)
+            cands = []
+            for dz, dy, dx in offs:
+                oz_n = z + pd - dz * dd
+                oy_n = y + ph - dy * dh
+                ox_n = xx + pw - dx * dw
+                v = ((oz_n >= 0) & (oz_n % sd == 0) &
+                     (oy_n >= 0) & (oy_n % sh == 0) &
+                     (ox_n >= 0) & (ox_n % sw == 0))
+                if in_mask is not None:
+                    v &= in_mask
+                oz, oy, ox = oz_n // sd, oy_n // sh, ox_n // sw
+                v &= (oz < Do) & (oy < Ho) & (ox < Wo)
+                cand = ((n * Do + oz) * Ho + oy) * Wo + ox
+                cands.append(jnp.where(v, cand, out_sent))
+            uniq = jnp.unique(jnp.concatenate(cands), size=cap,
+                              fill_value=out_sent)
+            live = uniq < out_sent
+            # decode out sites
+            on = uniq // (Do * Ho * Wo)
+            rem = uniq % (Do * Ho * Wo)
+            ozu = rem // (Ho * Wo)
+            oyu = (rem // Wo) % Ho
+            oxu = rem % Wo
+            cols = []
+            for dz, dy, dx in offs:
+                iz = ozu * sd - pd + dz * dd
+                iy = oyu * sh - ph + dy * dh
+                ix = oxu * sw - pw + dx * dw
+                v = (live & (iz >= 0) & (iz < D) & (iy >= 0) & (iy < H) &
+                     (ix >= 0) & (ix < W))
+                qflat = ((on * D + jnp.clip(iz, 0, D - 1)) * H +
+                         jnp.clip(iy, 0, H - 1)) * W + jnp.clip(ix, 0, W - 1)
+                cols.append(_join_gather(cflat, cvals, qflat, v))
+            g = jnp.concatenate(cols, axis=-1)
+            wmat = jnp.transpose(w, (2, 3, 4, 1, 0)).reshape(
+                g.shape[-1], Cout)
+            out = jax.lax.dot_general(
+                g, wmat, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(vals.dtype)
+            if b is not None:
+                out = out + b.astype(out.dtype)
+            # cap-padded rows duplicate the FIRST live site's coords
+            # with value 0: they coalesce away downstream instead of
+            # inventing a fake active site (uniq sorts live ids first,
+            # so row 0 is live whenever ANY site is; if nothing is
+            # live — no tap hit the stride grid — fall back to coord 0,
+            # harmless since every value is 0 and the mask is all-dead)
+            out = jnp.where(live[:, None], out, 0)
+            oidx = jnp.stack(
+                [jnp.where(live, c, jnp.where(live[0], c[0], 0))
+                 for c in (on, ozu, oyu, oxu)], 0)
+            return out, oidx, live
+
+        if self.bias is not None:
+            out_vals, oidx, live = apply(fn, x.values(), self.weight,
+                                         self.bias)
+        else:
+            out_vals, oidx, live = apply(lambda v, w: fn(v, w, None),
+                                         x.values(), self.weight)
+        out = sparse.SparseCooTensor(oidx._value, out_vals._value,
+                                     (N, Do, Ho, Wo, Cout),
+                                     x.stop_gradient)
+        out._values = out_vals
+        out._live_mask = live._value
+        return out
 
 
 class SubmConv3D(Conv3D):
@@ -140,17 +343,25 @@ class SubmConv3D(Conv3D):
                 f"2^31)")
         Cout = self.weight.shape[0]
         idx = jnp.asarray(bcoo.indices, jnp.int32)       # [nnz, 4]
+        if idx.shape[0] == 0:
+            return _empty_site_coo(sparse, (N, D, H, W, Cout),
+                                   bcoo.data.dtype, x.stop_gradient)
         kd, kh, kw = self._conv._kernel_size
         dil = self._conv._dilation
         offs = [((dz - kd // 2) * dil[0], (dy - kh // 2) * dil[1],
                  (dx - kw // 2) * dil[2])
                 for dz in range(kd) for dy in range(kh) for dx in range(kw)]
 
+        in_mask = x._live_mask
+
         def fn(vals, w, b):
             n, z, y, xx = (idx[:, i] for i in range(4))
-            flat = ((n * D + z) * H + y) * W + xx
-            order = jnp.argsort(flat)
-            sflat = flat[order]
+            # rep: duplicate-coordinate rows (a coalescing producer
+            # upstream, e.g. a strided sparse conv's cap padding) — only
+            # each site's FIRST live row carries the response, the rest
+            # emit 0, so densifying the output sums to the exact value
+            cflat, cvals, rep = _prep_join(idx, vals, D, H, W,
+                                           N * D * H * W, in_mask)
             cols = []
             for dz, dy, dx in offs:
                 zq, yq, xq = z + dz, y + dy, xx + dx
@@ -158,11 +369,7 @@ class SubmConv3D(Conv3D):
                          (xq >= 0) & (xq < W))
                 qflat = ((n * D + jnp.clip(zq, 0, D - 1)) * H +
                          jnp.clip(yq, 0, H - 1)) * W + jnp.clip(xq, 0, W - 1)
-                pos = jnp.clip(jnp.searchsorted(sflat, qflat),
-                               0, sflat.shape[0] - 1)
-                found = (sflat[pos] == qflat) & valid
-                src = order[pos]
-                cols.append(jnp.where(found[:, None], vals[src], 0))
+                cols.append(_join_gather(cflat, cvals, qflat, valid))
             g = jnp.concatenate(cols, axis=-1)           # [nnz, K3*Cin]
             # weight [Cout, Cin, kd, kh, kw] -> [K3*Cin, Cout] matching
             # the offs-major, Cin-minor gather layout
@@ -171,7 +378,9 @@ class SubmConv3D(Conv3D):
             out = jax.lax.dot_general(
                 g, wmat, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32).astype(vals.dtype)
-            return out + b.astype(out.dtype) if b is not None else out
+            if b is not None:
+                out = out + b.astype(out.dtype)
+            return jnp.where(rep[:, None], out, 0)
 
         if self.bias is not None:
             out_vals = apply(fn, x.values(), self.weight, self.bias)
@@ -186,6 +395,7 @@ class SubmConv3D(Conv3D):
         # arrays): grads flow sparse-layer-to-sparse-layer through the
         # stored values, exactly like the reference's sparse autograd
         out._values = out_vals
+        out._live_mask = x._live_mask   # subm keeps the input's rows
         return out
 
 
@@ -202,12 +412,59 @@ class BatchNorm(Layer):
 
     def forward(self, x):
         from paddle_tpu import sparse
-        from paddle_tpu.core.tensor import Tensor
         vals = x.values()                       # [nnz, C]
-        out_vals = self._bn(vals)
+        mask = getattr(x, "_live_mask", None)
+        if mask is None:
+            out_vals = self._bn(vals)
+        else:
+            out_vals = self._masked_bn(vals, mask)
         idx = jnp.swapaxes(x._bcoo.indices, 0, 1)
-        return sparse.SparseCooTensor(idx, out_vals._value, x._bcoo.shape,
-                                      x.stop_gradient)
+        out = sparse.SparseCooTensor(idx, out_vals._value, x._bcoo.shape,
+                                     x.stop_gradient)
+        out._values = out_vals
+        out._live_mask = mask
+        return out
+
+    def _masked_bn(self, vals, mask):
+        """BatchNorm over LIVE rows only (cap-padded rows from a strided
+        sparse conv must neither dilute the statistics nor become
+        nonzero beta values summed onto a real site)."""
+        from paddle_tpu.core.dispatch import apply
+        from paddle_tpu.core.engine import no_grad
+        bn = self._bn
+        eps, mom = bn._epsilon, bn._momentum
+        training = self.training
+
+        def fn(v, w, b, rm, rv):
+            m = mask.astype(v.dtype)[:, None]
+            alive = jnp.sum(m) > 0
+            cnt = jnp.maximum(jnp.sum(m), 1.0)
+            if training:
+                mean = jnp.sum(v * m, 0) / cnt
+                var = jnp.sum(((v - mean) ** 2) * m, 0) / cnt
+                # an all-dead batch has NO data: fall back to the
+                # running stats so the buffer blend below is a no-op
+                # instead of decaying toward fabricated mean=0/var=0
+                mean = jnp.where(alive, mean, rm)
+                var = jnp.where(alive, var, rv)
+            else:
+                mean, var = rm, rv
+            out = (v - mean) / jnp.sqrt(var + eps)
+            if w is not None:
+                out = out * w
+            if b is not None:
+                out = out + b
+            return jnp.where(mask[:, None], out, 0), mean, var
+
+        out, mean, var = apply(fn, vals, bn.weight, bn.bias,
+                               bn._mean, bn._variance)
+        if training:
+            with no_grad():
+                bn._mean._set_value(mom * bn._mean._value +
+                                    (1 - mom) * mean._value)
+                bn._variance._set_value(mom * bn._variance._value +
+                                        (1 - mom) * var._value)
+        return out
 
 
 class LeakyReLU(Layer):

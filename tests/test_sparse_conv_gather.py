@@ -109,3 +109,107 @@ def test_compute_scales_with_nnz_not_volume():
         flops[occ] = cost["flops"]
     ratio = flops[0.5] / max(flops[0.01], 1.0)
     assert ratio > 10.0, f"flops ratio {ratio:.1f} — not nnz-scaling"
+
+
+def test_strided_conv3d_matches_dense_at_stored_sites():
+    """Non-submanifold sparse Conv3D (r5): output sites = union of tap
+    images (safe static cap), values match the dense conv at every
+    stored site, for strided/dilated/anisotropic configs."""
+    rng = np.random.default_rng(5)
+    shape = (2, 9, 8, 7, 3)
+    dense = _random_sparse(rng, shape, 50)
+    xt = sparse.to_sparse_coo(P.to_tensor(dense), sparse_dim=4)
+    for stride, pad, dil in [(2, 1, 1), (1, 0, 1), (2, 2, 2),
+                             ((2, 1, 2), 1, 1)]:
+        P.seed(0)
+        conv = spnn.Conv3D(3, 5, kernel_size=3, stride=stride,
+                           padding=pad, dilation=dil)
+        out_s = conv(xt)
+        od = conv._conv(
+            P.to_tensor(np.moveaxis(dense, -1, 1)))._value
+        od = np.moveaxis(np.asarray(od), 1, -1)
+        ds = np.asarray(out_s._value)
+        assert ds.shape == od.shape
+        idx = np.asarray(out_s._bcoo.indices)
+        live = np.zeros(od.shape[:4], bool)
+        for r in range(idx.shape[0]):
+            live[tuple(idx[r])] = True
+        np.testing.assert_allclose(ds[live], od[live],
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_strided_then_subm_chain():
+    """Cap-padded strided output feeds SubmConv3D exactly (coalescing
+    join + representative-row dedup), with grads into the first conv."""
+    rng = np.random.default_rng(6)
+    dense = _random_sparse(rng, (2, 9, 8, 7, 3), 50)
+    xt = sparse.to_sparse_coo(P.to_tensor(dense), sparse_dim=4)
+    P.seed(0)
+    c1 = spnn.Conv3D(3, 5, kernel_size=3, stride=2, padding=1)
+    c2 = spnn.SubmConv3D(5, 2, kernel_size=3, padding=1)
+    out = c1(xt)
+    out2 = c2(out)
+    oracle = c2.forward_dense(
+        sparse.to_sparse_coo(P.to_tensor(np.asarray(out._value))))
+    np.testing.assert_allclose(np.asarray(out2._value),
+                               np.asarray(oracle._value),
+                               rtol=1e-4, atol=1e-5)
+    out2.values().sum().backward()
+    assert np.abs(c1.weight.grad.numpy()).sum() > 0
+
+
+def test_conv_bn_relu_subm_stack_with_live_mask():
+    """The canonical sparse CNN stack over a cap-padded strided output:
+    BatchNorm/Softmax honor the live mask (padded rows neither dilute
+    statistics nor leak beta values), ReLU propagates it, and grads
+    flow end to end through the taped values."""
+    rng = np.random.default_rng(7)
+    dense = _random_sparse(rng, (2, 9, 8, 7, 3), 50)
+    xt = sparse.to_sparse_coo(P.to_tensor(dense), sparse_dim=4)
+    P.seed(0)
+    c1 = spnn.Conv3D(3, 5, kernel_size=3, stride=2, padding=1)
+    bn = spnn.BatchNorm(5)
+    c2 = spnn.SubmConv3D(5, 2, kernel_size=3, padding=1)
+    h = c1(xt)
+    assert h._live_mask is not None
+    h4 = c2(spnn.ReLU()(bn(h)))
+
+    # oracle: dense mirrors with the stored-site mask carried through
+    hd = np.asarray(h._value)
+    live = (np.abs(hd) > 0).any(-1)
+    vl = hd[live]
+    mean, var = vl.mean(0), vl.var(0)
+    bn_d = (hd - mean) / np.sqrt(var + bn._bn._epsilon)
+    bn_d = bn_d * np.asarray(bn._bn.weight.numpy()) + \
+        np.asarray(bn._bn.bias.numpy())
+    relu_d = np.maximum(np.where(live[..., None], bn_d, 0), 0)
+    out_d = c2._conv(P.to_tensor(np.moveaxis(relu_d, -1, 1)))._value
+    out_d = np.where(live[..., None],
+                     np.moveaxis(np.asarray(out_d), 1, -1), 0)
+    np.testing.assert_allclose(np.asarray(h4._value), out_d,
+                               rtol=1e-3, atol=1e-4)
+
+    h4.values().sum().backward()
+    assert np.abs(c1.weight.grad.numpy()).sum() > 0
+    assert np.abs(bn._bn.weight.grad.numpy()).sum() > 0
+
+
+def test_empty_and_degenerate_inputs():
+    empty = sparse.to_sparse_coo(
+        P.to_tensor(np.zeros((1, 4, 4, 4, 3), np.float32)), sparse_dim=4)
+    assert spnn.SubmConv3D(3, 2, kernel_size=3,
+                           padding=1)(empty).nnz() == 0
+    assert spnn.Conv3D(3, 2, kernel_size=3, stride=2,
+                       padding=1)(empty).nnz() == 0
+    # kernel 1 / stride 2 with odd-only coords: no tap lands on the
+    # output grid — all-dead mask, in-range coords, zero values
+    dd = np.zeros((1, 6, 6, 6, 2), np.float32)
+    dd[0, 1, 1, 1] = 1.0
+    dd[0, 3, 3, 3] = 2.0
+    dd[0, 5, 5, 1] = 3.0
+    xt = sparse.to_sparse_coo(P.to_tensor(dd), sparse_dim=4)
+    out = spnn.Conv3D(2, 2, kernel_size=1, stride=2, padding=0,
+                      bias_attr=False)(xt)
+    assert np.asarray(out._value).sum() == 0
+    assert not np.asarray(out._live_mask).any()
+    assert np.asarray(out._bcoo.indices).max() == 0  # in-range coords
