@@ -45,25 +45,26 @@ class Softmax(Layer):
         idx = bcoo.indices  # (nnz, ndim)
         shape = bcoo.shape
         mask = x._live_mask
-        # linearize all leading dims into one segment id per row
-        row = jnp.zeros(idx.shape[0], dtype=jnp.int32)
-        for d in range(len(shape) - 1):
-            row = row * shape[d] + idx[:, d].astype(jnp.int32)
-        nrows = int(np.prod(shape[:-1])) or 1
-        if mask is not None:
-            row = jnp.where(mask, row, nrows)       # dead -> spill row
-            nseg = nrows + 1
-        else:
-            nseg = nrows
 
         def fn(vals):
             if vals.ndim == 2:
                 # site-layout COO (dense trailing channel): axis=-1 is
-                # the DENSE dim — softmax is per-row over channels
+                # the DENSE dim — softmax is per-row over channels (no
+                # segment ids needed on this path)
                 out = jax.nn.softmax(vals, axis=-1)
                 if mask is not None:
                     out = jnp.where(mask[:, None], out, 0)
                 return out
+            # scalar COO: linearize leading dims into a segment per row
+            row = jnp.zeros(idx.shape[0], dtype=jnp.int32)
+            for d in range(len(shape) - 1):
+                row = row * shape[d] + idx[:, d].astype(jnp.int32)
+            nrows = int(np.prod(shape[:-1])) or 1
+            if mask is not None:
+                row = jnp.where(mask, row, nrows)   # dead -> spill row
+                nseg = nrows + 1
+            else:
+                nseg = nrows
             mx = jax.ops.segment_max(vals, row, num_segments=nseg)
             e = jnp.exp(vals - mx[row])
             denom = jax.ops.segment_sum(e, row, num_segments=nseg)
@@ -436,25 +437,34 @@ class BatchNorm(Layer):
         training = self.training
 
         def fn(v, w, b, rm, rv):
-            m = mask.astype(v.dtype)[:, None]
+            # fp32 statistics + unbiased running-var update, matching
+            # the dense path (nn/functional/norm.py batch_norm) so the
+            # SAME layer behaves identically masked and unmasked
+            vf = v.astype(jnp.float32)
+            m = mask.astype(jnp.float32)[:, None]
             alive = jnp.sum(m) > 0
             cnt = jnp.maximum(jnp.sum(m), 1.0)
             if training:
-                mean = jnp.sum(v * m, 0) / cnt
-                var = jnp.sum(((v - mean) ** 2) * m, 0) / cnt
+                mean = jnp.sum(vf * m, 0) / cnt
+                var = jnp.sum(((vf - mean) ** 2) * m, 0) / cnt
+                unbias = cnt / jnp.maximum(cnt - 1.0, 1.0)
                 # an all-dead batch has NO data: fall back to the
                 # running stats so the buffer blend below is a no-op
                 # instead of decaying toward fabricated mean=0/var=0
+                run_mean = jnp.where(alive, mean, rm)
+                run_var = jnp.where(alive, var * unbias, rv)
                 mean = jnp.where(alive, mean, rm)
                 var = jnp.where(alive, var, rv)
             else:
                 mean, var = rm, rv
-            out = (v - mean) / jnp.sqrt(var + eps)
+                run_mean, run_var = rm, rv
+            out = (vf - mean) / jnp.sqrt(var + eps)
             if w is not None:
                 out = out * w
             if b is not None:
                 out = out + b
-            return jnp.where(mask[:, None], out, 0), mean, var
+            out = out.astype(v.dtype)
+            return jnp.where(mask[:, None], out, 0), run_mean, run_var
 
         out, mean, var = apply(fn, vals, bn.weight, bn.bias,
                                bn._mean, bn._variance)
