@@ -45,6 +45,9 @@ def main():
                     help="Adam moment storage dtype (e.g. bfloat16)")
     ap.add_argument("--preset", default=None, choices=[None, "1p3b"],
                     help="1p3b = GPT-3 1.3B single-chip fit recipe")
+    ap.add_argument("--ce-chunk", type=int, default=8192,
+                    help="fused LM-head CE chunk size (memory/occupancy "
+                         "tradeoff: smaller = less transient HBM)")
     args = ap.parse_args()
     if args.preset == "1p3b":
         args.hidden, args.layers, args.heads = 2048, 24, 16
@@ -85,7 +88,8 @@ def main():
         opt.clear_grad()
         with P.amp.auto_cast(level="O1", dtype="bfloat16"):
             if args.fused_head:
-                loss = model.loss_with_fused_head(ids, labels)
+                loss = model.loss_with_fused_head(
+                    ids, labels, chunk_size=args.ce_chunk)
             else:
                 logits = model(ids)
                 loss = crit(logits, labels)
@@ -130,6 +134,7 @@ def main():
            "fused_head": bool(args.fused_head),
            "param_dtype": args.param_dtype or "float32",
            "moment_dtype": args.moment_dtype or "float32",
+           "ce_chunk": args.ce_chunk if args.fused_head else None,
            "flops_per_token_g": round(flops_per_token / 1e9, 2),
            "mfu": round(mfu, 4)}
     print(json.dumps(out), flush=True)
