@@ -1,0 +1,124 @@
+"""ViT-B/16 train step on real TPU: throughput + MFU.
+
+Completes the BASELINE configs[1] lane ("PaddleClas ResNet-50 / ViT-B
+(to_static whole-graph -> XLA)") — bench.py owns the ResNet half; this
+is the ViT half. bf16 autocast, to_static whole-graph compile,
+cost-analysis-backed MFU.
+
+Run ON TPU (never kill it mid-run):
+  python tools/profile_vit.py [--batch 128] [--iters 8]
+Tiny CPU smoke:
+  python tools/profile_vit.py --tiny --iters 1
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_PEAK_TFLOPS = {"TPU v4": 275.0, "TPU v5 lite": 197.0, "TPU v5e": 197.0,
+                "TPU v5p": 459.0, "TPU v6 lite": 918.0, "TPU v6e": 918.0}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--iters", type=int, default=8)
+    ap.add_argument("--tiny", action="store_true",
+                    help="tiny config smoke (CPU)")
+    args = ap.parse_args()
+
+    import jax
+
+    import paddle_tpu as P
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.models.vit import (VisionTransformer, ViTConfig,
+                                      vit_b_16)
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} {getattr(dev, 'device_kind', '')}",
+          flush=True)
+
+    P.seed(0)
+    if args.tiny:
+        cfg = ViTConfig(image_size=32, patch_size=8, hidden_size=64,
+                        num_layers=2, num_heads=4, num_classes=10,
+                        dropout=0.0, attention_dropout=0.0)
+        args.batch = min(args.batch, 4)
+    else:
+        cfg = vit_b_16(dropout=0.0, attention_dropout=0.0)
+    model = VisionTransformer(cfg)
+    opt = P.optimizer.AdamW(learning_rate=1e-4,
+                            parameters=model.parameters())
+    n_params = sum(int(np.prod(q.shape)) for q in model.parameters())
+    print(f"params: {n_params/1e6:.1f}M", flush=True)
+
+    @P.jit.to_static
+    def train_step(x, y):
+        opt.clear_grad()
+        with P.amp.auto_cast(level="O1", dtype="bfloat16"):
+            logits = model(x)
+        loss = F.cross_entropy(logits, y)
+        loss.backward()
+        opt.step()
+        return loss
+
+    rng = np.random.default_rng(0)
+    x = P.to_tensor(rng.standard_normal(
+        (args.batch, cfg.in_channels, cfg.image_size,
+         cfg.image_size)).astype(np.float32))
+    y = P.to_tensor(rng.integers(0, cfg.num_classes, (args.batch,)),
+                    dtype="int64")
+
+    t0 = time.time()
+    loss = train_step(x, y)
+    loss.block_until_ready()
+    print(f"compile+first step {time.time()-t0:.1f}s "
+          f"loss={float(loss.numpy()):.3f}", flush=True)
+
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        loss = train_step(x, y)
+    loss.block_until_ready()       # steps chain through optimizer state
+    dt = (time.perf_counter() - t0) / args.iters
+    img_s = args.batch / dt
+
+    extra = {}
+    try:
+        entry = next(iter(train_step._compiled.values()))
+        cost = entry.jitted.lower(
+            [t._value for t in entry.state_list],
+            [x._value, y._value]).compile().cost_analysis()
+        cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+        fpi = cost["flops"] / args.batch
+        extra["xla_flops_per_img_g"] = round(fpi / 1e9, 2)
+        if dev.platform != "cpu":
+            peak = next((v for k, v in _PEAK_TFLOPS.items()
+                         if k in getattr(dev, "device_kind", "")), 197.0)
+            extra["mfu"] = round(img_s * fpi / (peak * 1e12), 4)
+    except Exception:
+        pass
+
+    out = {"metric": "vit_b16_train_throughput", "value": round(img_s, 2),
+           "unit": "images/sec/chip", "platform": dev.platform,
+           "params_m": round(n_params / 1e6, 1), "batch": args.batch,
+           "ms_per_step": round(dt * 1e3, 1), **extra}
+    print(json.dumps(out), flush=True)
+    if dev.platform != "cpu":
+        notes = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "BENCH_NOTES.md")
+        stamp = time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime())
+        with open(notes, "a") as fh:
+            fh.write(f"\n- tools/profile_vit.py {stamp}: "
+                     f"`{json.dumps(out)}`\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

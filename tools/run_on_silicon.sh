@@ -33,6 +33,8 @@ python tools/profile_gpt.py --preset 1p3b --batch 4 --iters 5 || rc=1
 python tools/profile_gpt.py --preset 1p3b --batch 8 --iters 5 || rc=1
 echo "--- 5. bert occupancy profile ---"
 python tools/profile_bert.py || rc=1
+echo "--- 5b. vit-b16 lane (BASELINE configs[1] second half) ---"
+python tools/profile_vit.py --batch 128 --iters 8 || rc=1
 echo "--- 6. flash sweep ---"
 python tools/sweep_flash.py || rc=1
 echo "=== capture complete (rc=$rc) ==="
