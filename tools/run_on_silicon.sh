@@ -31,8 +31,9 @@ echo "--- 4. gpt-3 1.3B single-chip fit (VERDICT r4 #2) ---"
 # OOM in one cannot take the other's datapoint.
 python tools/profile_gpt.py --preset 1p3b --batch 4 --iters 5 || rc=1
 python tools/profile_gpt.py --preset 1p3b --batch 8 --iters 5 || rc=1
-echo "--- 5. bert occupancy profile ---"
-python tools/profile_bert.py || rc=1
+echo "--- 5. bert occupancy profile (unfused vs incubate-fused A/B) ---"
+python tools/profile_bert.py --batch 48 || rc=1
+python tools/profile_bert.py --batch 48 --fused || rc=1
 echo "--- 5b. vit-b16 lane (BASELINE configs[1] second half) ---"
 python tools/profile_vit.py --batch 128 --iters 8 || rc=1
 echo "--- 6. flash sweep ---"
