@@ -100,9 +100,11 @@ def test_generated_program_eager_vs_compiled(seed):
         x = rng.standard_normal(4).astype(np.float32)
         want = prog(p.to_tensor(x)).numpy()      # eager: python control flow
         got = compiled(p.to_tensor(x)).numpy()   # converted + compiled
-        assert np.isfinite(want).all(), f"program diverged:\n{src}"
+        # a generated squaring chain can legitimately overflow — the
+        # property is eager == compiled INCLUDING divergence (inf must
+        # match inf, elementwise)
         np.testing.assert_allclose(
-            got, want, rtol=1e-5, atol=1e-5,
+            got, want, rtol=1e-5, atol=1e-5, equal_nan=True,
             err_msg=f"seed {seed} trial {trial}\n{src}")
 
 
@@ -122,7 +124,6 @@ def test_generated_program_depth3_nightly(seed):
         x = rng.standard_normal(4).astype(np.float32)
         want = prog(p.to_tensor(x)).numpy()
         got = compiled(p.to_tensor(x)).numpy()
-        assert np.isfinite(want).all(), f"program diverged:\n{src}"
         np.testing.assert_allclose(
-            got, want, rtol=1e-5, atol=1e-5,
+            got, want, rtol=1e-5, atol=1e-5, equal_nan=True,
             err_msg=f"seed {seed} trial {trial}\n{src}")
