@@ -361,10 +361,21 @@ class Watcher:
         self.stopped = True
 
 
+class RpcController(CollectiveController):
+    """Reference controllers/rpc.py: launch workers for the
+    paddle.distributed.rpc programming model. The pod build is the
+    collective one (workers get the master endpoint env, which is
+    exactly what distributed/rpc.py's TCP rendezvous consumes)."""
+
+    @classmethod
+    def enable(cls, ctx):
+        return getattr(ctx.args, "run_mode", None) == "rpc"
+
+
 def init(ctx):
     """Pick the controller for the context (reference
     controllers/__init__.py:33)."""
-    for cls in (PSController, CollectiveElasticController,
+    for cls in (PSController, RpcController, CollectiveElasticController,
                 CollectiveController):
         if cls.enable(ctx):
             return cls(ctx)

@@ -234,3 +234,45 @@ class ProcessContext:
             if fh:
                 fh.close()
         return self._proc.poll() is not None
+
+
+# ---- reference launch/utils/nvsmi.py surface (no nvidia in a TPU
+# deployment: honest empty results, never a crash) ----
+def has_nvidia_smi():
+    import shutil
+    return shutil.which("nvidia-smi") is not None
+
+
+def _smi_rows(fields):
+    """Shell out to nvidia-smi when present; [] otherwise (every TPU
+    host) — consistent with has_nvidia_smi."""
+    if not has_nvidia_smi():
+        return []
+    import subprocess
+    try:
+        out = subprocess.run(
+            ["nvidia-smi", f"--query-gpu={','.join(fields)}",
+             "--format=csv,noheader,nounits"],
+            capture_output=True, text=True, timeout=10).stdout
+    except Exception:
+        return []
+    rows = []
+    for line in out.strip().splitlines():
+        vals = [v.strip() for v in line.split(",")]
+        rows.append(dict(zip(fields, vals)))
+    return rows
+
+
+def query_smi(query=None, query_type="gpu", index=None, dtype=None):
+    """Reference nvsmi.query_smi: list of per-GPU info dicts."""
+    return _smi_rows(query or ["index", "uuid", "name",
+                               "memory.total", "memory.used"])
+
+
+def get_gpu_util(index=None):
+    return _smi_rows(["index", "utilization.gpu", "memory.total",
+                      "memory.used"])
+
+
+def get_gpu_info(index=None):
+    return _smi_rows(["index", "uuid", "driver_version", "name"])

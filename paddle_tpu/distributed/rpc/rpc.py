@@ -145,7 +145,21 @@ def _my_ip(master_host):
 def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     """Join the RPC world: rank 0's process hosts the rendezvous at
     master_endpoint; every worker starts its service and learns every
-    other worker's endpoint."""
+    other worker's endpoint.
+
+    Launcher contract (reference rpc/internal.py + launch rpc mode):
+    unset arguments fall back to the PADDLE_MASTER_ENDPOINT /
+    PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM environment the launch
+    controllers export, so `paddle.distributed.launch --run_mode rpc`
+    workers need only call init_rpc(name)."""
+    import os
+    if master_endpoint is None:
+        master_endpoint = os.environ.get(
+            "PADDLE_MASTER_ENDPOINT", os.environ.get("PADDLE_MASTER"))
+    if rank is None and os.environ.get("PADDLE_TRAINER_ID"):
+        rank = int(os.environ["PADDLE_TRAINER_ID"])
+    if world_size is None and os.environ.get("PADDLE_TRAINERS_NUM"):
+        world_size = int(os.environ["PADDLE_TRAINERS_NUM"])
     host, port = (master_endpoint or "127.0.0.1:29500").split(":")
     port = int(port)
     rank = 0 if rank is None else rank

@@ -7,6 +7,7 @@ reference incubate surface that graduated into core namespaces here are
 re-exported from them (flash attention lives in ops/pallas +
 nn.functional.scaled_dot_product_attention).
 """
+from paddle_tpu.incubate import multiprocessing  # noqa: F401
 from paddle_tpu.incubate import asp  # noqa: F401
 from paddle_tpu.incubate import autotune  # noqa: F401
 from paddle_tpu.incubate import autograd  # noqa: F401

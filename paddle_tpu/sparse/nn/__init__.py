@@ -119,6 +119,66 @@ def _join_gather(cflat, cvals, qflat, valid):
     return jnp.where(found[:, None], cvals[pos], 0)
 
 
+def _candidate_out_sites(idx, in_mask, offs, dims, strides, pads,
+                         out_dims, cap, out_sent):
+    """Output-site discovery shared by the strided conv and pooling:
+    every kernel tap's image of every LIVE input site, deduped by
+    unique() under the safe static cap. Returns (live, on, oz, oy, ox)
+    — decoded out coordinates with `live` marking real (non-padding)
+    rows."""
+    D, H, W = dims
+    sd, sh, sw = strides
+    pd, ph, pw = pads
+    Do, Ho, Wo = out_dims
+    n, z, y, xx = (idx[:, i] for i in range(4))
+    cands = []
+    for dz, dy, dx in offs:
+        oz_n = z + pd - dz
+        oy_n = y + ph - dy
+        ox_n = xx + pw - dx
+        v = ((oz_n >= 0) & (oz_n % sd == 0) &
+             (oy_n >= 0) & (oy_n % sh == 0) &
+             (ox_n >= 0) & (ox_n % sw == 0))
+        if in_mask is not None:
+            v &= in_mask
+        oz, oy, ox = oz_n // sd, oy_n // sh, ox_n // sw
+        v &= (oz < Do) & (oy < Ho) & (ox < Wo)
+        cand = ((n * Do + oz) * Ho + oy) * Wo + ox
+        cands.append(jnp.where(v, cand, out_sent))
+    uniq = jnp.unique(jnp.concatenate(cands), size=cap,
+                      fill_value=out_sent)
+    live = uniq < out_sent
+    on = uniq // (Do * Ho * Wo)
+    rem = uniq % (Do * Ho * Wo)
+    return (live, on, rem // (Ho * Wo), (rem // Wo) % Ho, rem % Wo)
+
+
+def _tap_query(site, off, dims, strides, pads, live):
+    """Input-site query flat id + validity for one output site set and
+    one kernel tap."""
+    on, oz, oy, ox = site
+    dz, dy, dx = off
+    D, H, W = dims
+    sd, sh, sw = strides
+    pd, ph, pw = pads
+    iz = oz * sd - pd + dz
+    iy = oy * sh - ph + dy
+    ix = ox * sw - pw + dx
+    v = (live & (iz >= 0) & (iz < D) & (iy >= 0) & (iy < H) &
+         (ix >= 0) & (ix < W))
+    qflat = ((on * D + jnp.clip(iz, 0, D - 1)) * H +
+             jnp.clip(iy, 0, H - 1)) * W + jnp.clip(ix, 0, W - 1)
+    return qflat, v
+
+
+def _pad_oidx(live, site):
+    """Out-index array with cap-padded rows duplicating the FIRST live
+    site's coords (coalesces away downstream; falls back to coord 0
+    when nothing is live — every value is 0 and the mask all-dead)."""
+    return jnp.stack([jnp.where(live, c, jnp.where(live[0], c[0], 0))
+                      for c in site], 0)
+
+
 def _empty_site_coo(sparse_mod, shape, dtype, stop_gradient):
     """Zero-nnz site-layout COO (empty sparse input short-circuit)."""
     idx = jnp.zeros((4, 0), jnp.int32)
@@ -198,62 +258,40 @@ class Conv3D(Layer):
         Do = (D + 2 * pd - dd * (kd - 1) - 1) // sd + 1
         Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
         Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        Cout = self.weight.shape[0]
+        if min(Do, Ho, Wo) <= 0:
+            # kernel larger than the padded input: no output sites
+            return _empty_site_coo(
+                sparse, (N, max(Do, 0), max(Ho, 0), max(Wo, 0), Cout),
+                bcoo.data.dtype, x.stop_gradient)
         if max(N * D * H * W, N * Do * Ho * Wo) >= 2 ** 31:
             raise ValueError(
                 "sparse Conv3D gather path: volume exceeds int32 site "
                 "indexing; tile the volume")
-        Cout = self.weight.shape[0]
         idx = jnp.asarray(bcoo.indices, jnp.int32)
         nnz = idx.shape[0]
-        offs = [(dz, dy, dx) for dz in range(kd)
+        # dilation-scaled tap offsets; order matches the wmat reshape
+        offs = [(dz * dd, dy * dh, dx * dw) for dz in range(kd)
                 for dy in range(kh) for dx in range(kw)]
         in_sent = N * D * H * W
         out_sent = N * Do * Ho * Wo
         cap = min(nnz * len(offs), out_sent)
-        if nnz == 0 or cap == 0:
+        if nnz == 0 or cap <= 0:
             return _empty_site_coo(sparse, (N, Do, Ho, Wo, Cout),
                                    bcoo.data.dtype, x.stop_gradient)
         in_mask = x._live_mask
+        dims, strides, pads = (D, H, W), (sd, sh, sw), (pd, ph, pw)
 
         def fn(vals, w, b):
-            n, z, y, xx = (idx[:, i] for i in range(4))
             cflat, cvals, _ = _prep_join(idx, vals, D, H, W, in_sent,
                                          in_mask)
-            # candidate output sites: every tap's image of every LIVE
-            # input site (valid when it lands on the stride grid, in
-            # range)
-            cands = []
-            for dz, dy, dx in offs:
-                oz_n = z + pd - dz * dd
-                oy_n = y + ph - dy * dh
-                ox_n = xx + pw - dx * dw
-                v = ((oz_n >= 0) & (oz_n % sd == 0) &
-                     (oy_n >= 0) & (oy_n % sh == 0) &
-                     (ox_n >= 0) & (ox_n % sw == 0))
-                if in_mask is not None:
-                    v &= in_mask
-                oz, oy, ox = oz_n // sd, oy_n // sh, ox_n // sw
-                v &= (oz < Do) & (oy < Ho) & (ox < Wo)
-                cand = ((n * Do + oz) * Ho + oy) * Wo + ox
-                cands.append(jnp.where(v, cand, out_sent))
-            uniq = jnp.unique(jnp.concatenate(cands), size=cap,
-                              fill_value=out_sent)
-            live = uniq < out_sent
-            # decode out sites
-            on = uniq // (Do * Ho * Wo)
-            rem = uniq % (Do * Ho * Wo)
-            ozu = rem // (Ho * Wo)
-            oyu = (rem // Wo) % Ho
-            oxu = rem % Wo
+            site = _candidate_out_sites(idx, in_mask, offs, dims, strides,
+                                        pads, (Do, Ho, Wo), cap, out_sent)
+            live, *coords = site
             cols = []
-            for dz, dy, dx in offs:
-                iz = ozu * sd - pd + dz * dd
-                iy = oyu * sh - ph + dy * dh
-                ix = oxu * sw - pw + dx * dw
-                v = (live & (iz >= 0) & (iz < D) & (iy >= 0) & (iy < H) &
-                     (ix >= 0) & (ix < W))
-                qflat = ((on * D + jnp.clip(iz, 0, D - 1)) * H +
-                         jnp.clip(iy, 0, H - 1)) * W + jnp.clip(ix, 0, W - 1)
+            for off in offs:
+                qflat, v = _tap_query(coords, off, dims, strides, pads,
+                                      live)
                 cols.append(_join_gather(cflat, cvals, qflat, v))
             g = jnp.concatenate(cols, axis=-1)
             wmat = jnp.transpose(w, (2, 3, 4, 1, 0)).reshape(
@@ -263,17 +301,8 @@ class Conv3D(Layer):
                 preferred_element_type=jnp.float32).astype(vals.dtype)
             if b is not None:
                 out = out + b.astype(out.dtype)
-            # cap-padded rows duplicate the FIRST live site's coords
-            # with value 0: they coalesce away downstream instead of
-            # inventing a fake active site (uniq sorts live ids first,
-            # so row 0 is live whenever ANY site is; if nothing is
-            # live — no tap hit the stride grid — fall back to coord 0,
-            # harmless since every value is 0 and the mask is all-dead)
             out = jnp.where(live[:, None], out, 0)
-            oidx = jnp.stack(
-                [jnp.where(live, c, jnp.where(live[0], c[0], 0))
-                 for c in (on, ozu, oyu, oxu)], 0)
-            return out, oidx, live
+            return out, _pad_oidx(live, coords), live
 
         if self.bias is not None:
             out_vals, oidx, live = apply(fn, x.values(), self.weight,
@@ -531,7 +560,81 @@ class MaxPool3D(Layer):
         self.stride = stride or kernel_size
         self.padding = padding
 
+    def _triple(self, v):
+        return (v, v, v) if isinstance(v, int) else tuple(v)
+
     def forward(self, x):
+        from paddle_tpu import sparse
+        if (isinstance(x, sparse.SparseCooTensor)
+                and x._bcoo.indices.shape[-1] == 4
+                and x._bcoo.data.ndim == 2):
+            return self._forward_gather(x)
+        return self._forward_dense(x)
+
+    def _forward_gather(self, x):
+        """r5 nnz path: same candidate-site/sorted-join machinery as the
+        strided conv, combined by max over taps — O(nnz·K³), no dense
+        volume. Windows with no active site produce dead (masked) rows."""
+        from paddle_tpu import sparse
+        from paddle_tpu.core.dispatch import apply
+
+        bcoo = x._bcoo
+        N, D, H, W, C = bcoo.shape
+        kd, kh, kw = self._triple(self.kernel_size)
+        sd, sh, sw = self._triple(self.stride)
+        pd, ph, pw = self._triple(self.padding)
+        Do = (D + 2 * pd - kd) // sd + 1
+        Ho = (H + 2 * ph - kh) // sh + 1
+        Wo = (W + 2 * pw - kw) // sw + 1
+        if min(Do, Ho, Wo) <= 0:
+            return _empty_site_coo(
+                sparse, (N, max(Do, 0), max(Ho, 0), max(Wo, 0), C),
+                bcoo.data.dtype, x.stop_gradient)
+        if max(N * D * H * W, N * Do * Ho * Wo) >= 2 ** 31:
+            raise ValueError("sparse MaxPool3D: volume exceeds int32 "
+                             "site indexing; tile the volume")
+        idx = jnp.asarray(bcoo.indices, jnp.int32)
+        nnz = idx.shape[0]
+        offs = [(dz, dy, dx) for dz in range(kd)
+                for dy in range(kh) for dx in range(kw)]
+        in_sent = N * D * H * W
+        out_sent = N * Do * Ho * Wo
+        cap = min(nnz * len(offs), out_sent)
+        if nnz == 0 or cap <= 0:
+            return _empty_site_coo(sparse, (N, Do, Ho, Wo, C),
+                                   bcoo.data.dtype, x.stop_gradient)
+        in_mask = x._live_mask
+        dims, strides, pads = (D, H, W), (sd, sh, sw), (pd, ph, pw)
+
+        def fn(vals):
+            cflat, cvals, _ = _prep_join(idx, vals, D, H, W, in_sent,
+                                         in_mask)
+            site = _candidate_out_sites(idx, in_mask, offs, dims, strides,
+                                        pads, (Do, Ho, Wo), cap, out_sent)
+            live, *coords = site
+            neg = jnp.asarray(-jnp.inf, jnp.float32)
+            best = jnp.full((cap, C), neg)
+            for off in offs:
+                qflat, v = _tap_query(coords, off, dims, strides, pads,
+                                      live)
+                pos = jnp.clip(jnp.searchsorted(cflat, qflat),
+                               0, cflat.shape[0] - 1)
+                found = (cflat[pos] == qflat) & v
+                tap = jnp.where(found[:, None],
+                                cvals[pos].astype(jnp.float32), neg)
+                best = jnp.maximum(best, tap)
+            # every live out site has >=1 active tap by construction
+            out = jnp.where(live[:, None], best, 0).astype(vals.dtype)
+            return out, _pad_oidx(live, coords), live
+
+        out_vals, oidx, live = apply(fn, x.values())
+        out = sparse.SparseCooTensor(oidx._value, out_vals._value,
+                                     (N, Do, Ho, Wo, C), x.stop_gradient)
+        out._values = out_vals
+        out._live_mask = live._value
+        return out
+
+    def _forward_dense(self, x):
         from paddle_tpu import sparse
         from paddle_tpu.core.tensor import Tensor
         from paddle_tpu.nn.functional.pooling import max_pool3d

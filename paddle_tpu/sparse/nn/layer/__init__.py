@@ -1,0 +1,15 @@
+"""paddle.sparse.nn.layer submodule path parity (reference:
+python/paddle/sparse/nn/layer/{activation,norm,conv,pooling}.py) — the
+classes live in paddle_tpu.sparse.nn; this package mirrors the
+reference's import paths."""
+from paddle_tpu.sparse.nn import (  # noqa: F401
+    BatchNorm,
+    Conv3D,
+    LeakyReLU,
+    MaxPool3D,
+    ReLU,
+    ReLU6,
+    Softmax,
+    SubmConv3D,
+    SyncBatchNorm,
+)

@@ -1,4 +1,5 @@
 from paddle_tpu.vision import datasets, models, ops, transforms  # noqa: F401
+from paddle_tpu.vision.ops import decode_jpeg, read_file  # noqa: F401
 # the reference surfaces the detection ops at paddle.vision level too
 from paddle_tpu.vision.ops import (  # noqa: F401
     DeformConv2D,

@@ -213,3 +213,33 @@ def test_empty_and_degenerate_inputs():
     assert np.asarray(out._value).sum() == 0
     assert not np.asarray(out._live_mask).any()
     assert np.asarray(out._bcoo.indices).max() == 0  # in-range coords
+
+
+def test_maxpool3d_gather_matches_dense():
+    """r5 nnz MaxPool3D (reference sparse/nn/layer/pooling.py): max over
+    ACTIVE sites per window via the candidate/join machinery — parity
+    with the dense-mirror oracle across kernel/stride/padding configs,
+    and grads flow through a sparse conv feeding it."""
+    rng = np.random.default_rng(8)
+    dense = _random_sparse(rng, (2, 8, 8, 8, 3), 60)
+    xt = sparse.to_sparse_coo(P.to_tensor(dense), sparse_dim=4)
+    for k, s, pad in [(2, 2, 0), (3, 2, 1), (3, 1, 1)]:
+        pool = spnn.MaxPool3D(kernel_size=k, stride=s, padding=pad)
+        out_g = pool(xt)
+        out_d = pool._forward_dense(
+            sparse.to_sparse_coo(P.to_tensor(dense)))
+        np.testing.assert_allclose(np.asarray(out_g._value),
+                                   np.asarray(out_d._value),
+                                   rtol=1e-5, atol=1e-6)
+    P.seed(0)
+    c1 = spnn.SubmConv3D(3, 3, kernel_size=3, padding=1)
+    pool = spnn.MaxPool3D(kernel_size=2, stride=2)
+    pool(c1(xt)).values().sum().backward()
+    assert np.abs(c1.weight.grad.numpy()).sum() > 0
+
+
+def test_sparse_nn_layer_submodule_path():
+    from paddle_tpu.sparse.nn.layer import (BatchNorm, MaxPool3D,
+                                            SubmConv3D, SyncBatchNorm)
+    assert MaxPool3D is spnn.MaxPool3D
+    assert SyncBatchNorm is spnn.SyncBatchNorm
