@@ -61,6 +61,7 @@ from paddle_tpu import (  # noqa: F401,E402
     autograd,
     callbacks,
     cost_model,
+    dataset,
     device,
     distributed,
     distribution,
@@ -79,6 +80,7 @@ from paddle_tpu import (  # noqa: F401,E402
     onnx,
     profiler,
     quantization,
+    reader,
     regularizer,
     signal,
     static,

@@ -1,0 +1,19 @@
+"""paddle.dataset — the fluid-era reader-factory surface (reference:
+python/paddle/dataset/). Each submodule exposes train()/test() readers
+(zero-arg callables yielding samples) over the same offline-synthesized
+datasets the class-style paddle.io datasets use; `paddle.reader`
+decorators compose them. Kept for migrating legacy pipelines."""
+from paddle_tpu.dataset import (  # noqa: F401
+    cifar,
+    common,
+    conll05,
+    flowers,
+    imdb,
+    imikolov,
+    mnist,
+    movielens,
+    uci_housing,
+    voc2012,
+    wmt14,
+    wmt16,
+)

@@ -1,0 +1,27 @@
+"""paddle.dataset.cifar (reference: python/paddle/dataset/cifar.py):
+reader factories over the offline paddle_tpu datasets (shared iteration
+logic: paddle_tpu.dataset.common.make_reader)."""
+from __future__ import annotations
+
+from paddle_tpu.dataset.common import make_reader as _mk
+
+
+def train10(**kw):
+    from paddle_tpu.vision.datasets import Cifar10
+    return _mk(Cifar10, "train", **kw)
+
+
+def test10(**kw):
+    from paddle_tpu.vision.datasets import Cifar10
+    return _mk(Cifar10, "test", **kw)
+
+
+def train100(**kw):
+    from paddle_tpu.vision.datasets import Cifar100
+    return _mk(Cifar100, "train", **kw)
+
+
+def test100(**kw):
+    from paddle_tpu.vision.datasets import Cifar100
+    return _mk(Cifar100, "test", **kw)
+

@@ -1,0 +1,47 @@
+"""Reference dataset/common.py: download cache helpers. Zero-egress
+build: DATA_HOME exists for path compatibility; download() of a file
+already on disk passes through, anything else raises (no network)."""
+from __future__ import annotations
+
+import os
+
+DATA_HOME = os.path.expanduser("~/.cache/paddle/dataset")
+
+
+def md5file(fname):
+    import hashlib
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url, module_name, md5sum, save_name=None):
+    os.makedirs(os.path.join(DATA_HOME, module_name), exist_ok=True)
+    path = os.path.join(DATA_HOME, module_name,
+                        save_name or url.split("/")[-1])
+    if os.path.exists(path):
+        if md5sum and md5file(path) != md5sum:
+            raise RuntimeError(
+                f"paddle.dataset.common.download: {path} exists but its "
+                f"md5 does not match {md5sum} (corrupt or truncated "
+                f"pre-placed file)")
+        return path
+    raise RuntimeError(
+        f"paddle.dataset.common.download: zero-egress build cannot fetch "
+        f"{url}; place the file at {path} or use the paddle_tpu offline "
+        f"datasets (paddle.vision.datasets / paddle.text)")
+
+
+def make_reader(dataset_cls, mode, **kw):
+    """Shared reader factory: instantiate the paddle_tpu dataset class
+    and yield its samples as tuples (the one copy of the iteration/
+    normalization logic every paddle.dataset submodule delegates to)."""
+    def impl():
+        ds = dataset_cls(mode=mode, **kw)
+        for i in range(len(ds)):
+            item = ds[i]
+            yield tuple(item) if isinstance(item, (list, tuple)) else item
+
+    return impl

@@ -1,0 +1,12 @@
+"""paddle.dataset.conll05 (reference: python/paddle/dataset/conll05.py):
+reader factories over the offline paddle_tpu datasets (shared iteration
+logic: paddle_tpu.dataset.common.make_reader)."""
+from __future__ import annotations
+
+from paddle_tpu.dataset.common import make_reader as _mk
+
+
+def test(**kw):
+    from paddle_tpu.text.datasets import Conll05
+    return _mk(Conll05, "test", **kw)
+

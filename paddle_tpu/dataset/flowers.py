@@ -1,0 +1,22 @@
+"""paddle.dataset.flowers (reference: python/paddle/dataset/flowers.py):
+reader factories over the offline paddle_tpu datasets (shared iteration
+logic: paddle_tpu.dataset.common.make_reader)."""
+from __future__ import annotations
+
+from paddle_tpu.dataset.common import make_reader as _mk
+
+
+def train(**kw):
+    from paddle_tpu.vision.datasets import Flowers
+    return _mk(Flowers, "train", **kw)
+
+
+def test(**kw):
+    from paddle_tpu.vision.datasets import Flowers
+    return _mk(Flowers, "test", **kw)
+
+
+def valid(**kw):
+    from paddle_tpu.vision.datasets import Flowers
+    return _mk(Flowers, "test", **kw)
+

@@ -1,0 +1,17 @@
+"""paddle.dataset.imikolov (reference: python/paddle/dataset/imikolov.py):
+reader factories over the offline paddle_tpu datasets (shared iteration
+logic: paddle_tpu.dataset.common.make_reader)."""
+from __future__ import annotations
+
+from paddle_tpu.dataset.common import make_reader as _mk
+
+
+def train(**kw):
+    from paddle_tpu.text.datasets import Imikolov
+    return _mk(Imikolov, "train", **kw)
+
+
+def test(**kw):
+    from paddle_tpu.text.datasets import Imikolov
+    return _mk(Imikolov, "test", **kw)
+

@@ -1,0 +1,17 @@
+"""paddle.dataset.mnist (reference: python/paddle/dataset/mnist.py):
+reader factories over the offline paddle_tpu datasets (shared iteration
+logic: paddle_tpu.dataset.common.make_reader)."""
+from __future__ import annotations
+
+from paddle_tpu.dataset.common import make_reader as _mk
+
+
+def train(**kw):
+    from paddle_tpu.vision.datasets import MNIST
+    return _mk(MNIST, "train", **kw)
+
+
+def test(**kw):
+    from paddle_tpu.vision.datasets import MNIST
+    return _mk(MNIST, "test", **kw)
+

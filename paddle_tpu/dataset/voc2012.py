@@ -1,0 +1,22 @@
+"""paddle.dataset.voc2012 (reference: python/paddle/dataset/voc2012.py):
+reader factories over the offline paddle_tpu datasets (shared iteration
+logic: paddle_tpu.dataset.common.make_reader)."""
+from __future__ import annotations
+
+from paddle_tpu.dataset.common import make_reader as _mk
+
+
+def train(**kw):
+    from paddle_tpu.vision.datasets import VOC2012
+    return _mk(VOC2012, "train", **kw)
+
+
+def test(**kw):
+    from paddle_tpu.vision.datasets import VOC2012
+    return _mk(VOC2012, "test", **kw)
+
+
+def val(**kw):
+    from paddle_tpu.vision.datasets import VOC2012
+    return _mk(VOC2012, "test", **kw)
+

@@ -1,0 +1,17 @@
+"""paddle.dataset.wmt14 (reference: python/paddle/dataset/wmt14.py):
+reader factories over the offline paddle_tpu datasets (shared iteration
+logic: paddle_tpu.dataset.common.make_reader)."""
+from __future__ import annotations
+
+from paddle_tpu.dataset.common import make_reader as _mk
+
+
+def train(**kw):
+    from paddle_tpu.text.datasets import WMT14
+    return _mk(WMT14, "train", **kw)
+
+
+def test(**kw):
+    from paddle_tpu.text.datasets import WMT14
+    return _mk(WMT14, "test", **kw)
+

@@ -1,0 +1,248 @@
+"""paddle.reader — legacy reader decorators (reference:
+python/paddle/reader/decorator.py). A *reader* is a zero-arg callable
+returning an iterable of samples; decorators compose them. Kept for
+migrating fluid-era input pipelines — new code uses paddle.io.
+DataLoader (which these can feed through an IterableDataset).
+"""
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import random as _random
+import threading
+
+__all__ = ["cache", "map_readers", "shuffle", "chain", "compose",
+           "buffered", "firstn", "xmap_readers", "multiprocess_reader"]
+
+
+def cache(reader):
+    """Cache the first COMPLETED pass in memory; later passes replay it
+    (reference decorator.py:47). A partially-consumed first pass (e.g.
+    under firstn, or an early epoch break) leaves the cache unarmed
+    instead of committing a truncated/duplicated prefix."""
+    all_data = []
+    filled = [False]
+
+    def _impl():
+        if filled[0]:
+            yield from all_data
+            return
+        data = []
+        for item in reader():
+            data.append(item)
+            yield item
+        # commit only on full consumption
+        all_data[:] = data
+        filled[0] = True
+
+    return _impl
+
+
+def map_readers(func, *readers):
+    """Zip several readers and map `func` over the tuples
+    (reference decorator.py:87)."""
+    def _impl():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return _impl
+
+
+def shuffle(reader, buf_size):
+    """Buffered shuffle (reference decorator.py:129)."""
+    def _impl():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return _impl
+
+
+def chain(*readers):
+    """Concatenate readers (reference decorator.py:178)."""
+    def _impl():
+        for r in readers:
+            yield from r()
+
+    return _impl
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into flattened tuples (reference decorator.py:243).
+    check_alignment=True (default) raises when lengths differ."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def _make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    _HOLE = object()
+
+    def _impl():
+        rs = [r() for r in readers]
+        for vals in itertools.zip_longest(*rs, fillvalue=_HOLE):
+            holes = sum(v is _HOLE for v in vals)
+            if holes and check_alignment:
+                # zip_longest sees the ragged round regardless of which
+                # reader is longer (plain zip would eat the extra item)
+                raise RuntimeError(
+                    "compose: readers have different lengths "
+                    "(check_alignment=True)")
+            yield sum((_make_tuple(v) for v in vals if v is not _HOLE),
+                      ())
+
+    return _impl
+
+
+def buffered(reader, size):
+    """Background-thread prefetch buffer (reference decorator.py:301).
+    Source errors re-raise in the CONSUMER (a mid-stream failure must
+    not masquerade as a clean shorter stream)."""
+    end = object()
+    err = object()
+
+    def _impl():
+        q = _queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for item in reader():
+                    q.put(item)
+            except BaseException as e:  # noqa: BLE001 — forwarded
+                q.put((err, e))
+                return
+            q.put(end)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is end:
+                return
+            if isinstance(item, tuple) and len(item) == 2 \
+                    and item[0] is err:
+                raise item[1]
+            yield item
+
+    return _impl
+
+
+def firstn(reader, n):
+    """First n samples (reference decorator.py:363)."""
+    def _impl():
+        return itertools.islice(reader(), n)
+
+    return _impl
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over a reader with worker THREADS (reference
+    decorator.py:408 uses threads too; the name is historical). With
+    order=True results keep input order. A mapper or source exception
+    re-raises in the consumer instead of hanging the pipeline."""
+    end = object()
+
+    def _impl():
+        in_q = _queue.Queue(buffer_size)
+        out_q = _queue.Queue(buffer_size)
+        results = {}
+        lock = threading.Condition()
+        done_workers = [0]
+        failure = [None]
+
+        def fail(e):
+            with lock:
+                if failure[0] is None:
+                    failure[0] = e
+                lock.notify_all()
+
+        def feed():
+            try:
+                for i, item in enumerate(reader()):
+                    in_q.put((i, item))
+            except BaseException as e:  # noqa: BLE001 — forwarded
+                fail(e)
+            finally:
+                for _ in range(process_num):
+                    in_q.put(end)
+
+        def work():
+            try:
+                while True:
+                    task = in_q.get()
+                    if task is end:
+                        return
+                    i, item = task
+                    mapped = mapper(item)
+                    if order:
+                        with lock:
+                            results[i] = mapped
+                            lock.notify_all()
+                    else:
+                        out_q.put(mapped)
+            except BaseException as e:  # noqa: BLE001 — forwarded
+                fail(e)
+            finally:
+                with lock:
+                    done_workers[0] += 1
+                    lock.notify_all()
+
+        threads = [threading.Thread(target=feed, daemon=True)] + \
+            [threading.Thread(target=work, daemon=True)
+             for _ in range(process_num)]
+        for t in threads:
+            t.start()
+        if order:
+            i = 0
+            while True:
+                with lock:
+                    while i not in results:
+                        if failure[0] is not None:
+                            raise failure[0]
+                        if done_workers[0] == process_num and \
+                                i not in results:
+                            return
+                        lock.wait(0.05)
+                    item = results.pop(i)
+                yield item
+                i += 1
+        else:
+            while True:
+                if failure[0] is not None:
+                    raise failure[0]
+                try:
+                    yield out_q.get(timeout=0.05)
+                except _queue.Empty:
+                    if failure[0] is not None:
+                        raise failure[0]
+                    if done_workers[0] == process_num and out_q.empty():
+                        return
+
+    return _impl
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Reference decorator.py:504 interleaves readers from worker
+    processes; here the readers run in threads (samples may be jax/host
+    arrays that must not cross a fork) and interleave round-robin."""
+    _END = object()
+
+    def _impl():
+        its = [r() for r in readers]
+        while its:
+            nxt = []
+            for it in its:
+                item = next(it, _END)    # None is a legitimate sample
+                if item is not _END:
+                    yield item
+                    nxt.append(it)
+            its = nxt
+
+    return _impl

@@ -1,0 +1,104 @@
+"""paddle.reader decorators + paddle.dataset legacy reader factories
+(r5; reference python/paddle/reader/decorator.py and
+python/paddle/dataset/)."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def test_reader_decorators():
+    r = paddle.reader.firstn(lambda: iter(range(100)), 5)
+    assert list(r()) == [0, 1, 2, 3, 4]
+    assert list(paddle.reader.chain(lambda: iter([1, 2]),
+                                    lambda: iter([3]))()) == [1, 2, 3]
+    m = paddle.reader.map_readers(lambda a, b: a + b,
+                                  lambda: iter([1, 2]),
+                                  lambda: iter([10, 20]))
+    assert list(m()) == [11, 22]
+    assert list(paddle.reader.buffered(
+        lambda: iter(range(10)), 3)()) == list(range(10))
+    assert sorted(paddle.reader.shuffle(
+        lambda: iter(range(20)), 8)()) == list(range(20))
+    c = paddle.reader.cache(lambda: iter(range(4)))
+    assert list(c()) == list(range(4))
+    assert list(c()) == list(range(4))      # replayed pass
+
+
+def test_reader_xmap_ordered():
+    r = paddle.reader.xmap_readers(lambda x: x * 2,
+                                   lambda: iter(range(8)), 3, 4,
+                                   order=True)
+    assert list(r()) == [0, 2, 4, 6, 8, 10, 12, 14]
+
+
+def test_reader_xmap_unordered_complete():
+    r = paddle.reader.xmap_readers(lambda x: x + 1,
+                                   lambda: iter(range(12)), 2, 4)
+    assert sorted(r()) == list(range(1, 13))
+
+
+def test_reader_compose_alignment():
+    r = paddle.reader.compose(lambda: iter([1, 2]),
+                              lambda: iter([(3, 4), (5, 6)]))
+    assert list(r()) == [(1, 3, 4), (2, 5, 6)]
+    bad = paddle.reader.compose(lambda: iter([1]),
+                                lambda: iter([2, 3]))
+    try:
+        list(bad())
+        raise AssertionError("expected alignment error")
+    except RuntimeError:
+        pass
+
+
+def test_dataset_reader_factories():
+    img, label = next(iter(paddle.dataset.mnist.train()()))
+    assert np.asarray(img).shape[-2:] == (28, 28)
+    x, y = next(iter(paddle.dataset.uci_housing.train()()))
+    assert np.asarray(x).ndim == 1
+    n = sum(1 for _ in paddle.reader.firstn(
+        paddle.dataset.imdb.train(), 10)())
+    assert n == 10
+
+
+def test_reader_error_and_edge_semantics():
+    """Review-hardened semantics: partial cache passes don't corrupt,
+    source/mapper errors propagate (no hang, no silent truncation),
+    alignment detection is order-independent, None samples survive."""
+    from itertools import islice
+    import pytest
+
+    c = paddle.reader.cache(lambda: iter(range(4)))
+    list(islice(c(), 2))                    # abandoned first pass
+    assert list(c()) == [0, 1, 2, 3]
+    assert list(c()) == [0, 1, 2, 3]
+
+    with pytest.raises(RuntimeError):
+        list(paddle.reader.compose(lambda: iter([1, 2, 3]),
+                                   lambda: iter([10, 20]))())
+
+    def boom():
+        yield 1
+        raise ValueError("io error")
+    with pytest.raises(ValueError):
+        list(paddle.reader.buffered(lambda: boom(), 2)())
+
+    def bad(x):
+        return 1 / (x - 3)
+    with pytest.raises(ZeroDivisionError):
+        list(paddle.reader.xmap_readers(bad, lambda: iter(range(6)),
+                                        2, 4, order=True)())
+    with pytest.raises(ZeroDivisionError):
+        list(paddle.reader.xmap_readers(bad, lambda: iter(range(6)),
+                                        2, 4)())
+
+    assert list(paddle.reader.multiprocess_reader(
+        [lambda: iter([1, None, 2])])()) == [1, None, 2]
+
+
+def test_cifar100_yields_100_classes():
+    labels = set()
+    for i, (_, lab) in enumerate(paddle.dataset.cifar.train100()()):
+        labels.add(int(np.asarray(lab)))
+        if i > 400:
+            break
+    assert max(labels) > 9
