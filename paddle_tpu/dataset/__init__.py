@@ -8,6 +8,7 @@ from paddle_tpu.dataset import (  # noqa: F401
     common,
     conll05,
     flowers,
+    image,
     imdb,
     imikolov,
     mnist,

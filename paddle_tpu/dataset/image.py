@@ -1,0 +1,90 @@
+"""paddle.dataset.image (reference: python/paddle/dataset/image.py):
+numpy/PIL image helpers for the fluid-era pipelines (the reference uses
+cv2; PIL is what this image bundles — same semantics, HWC uint8 in,
+float CHW out of simple_transform)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["load_image", "load_image_bytes", "resize_short", "to_chw",
+           "center_crop", "random_crop", "left_right_flip",
+           "simple_transform", "load_and_transform"]
+
+
+def _pil():
+    from PIL import Image
+    return Image
+
+
+def load_image_bytes(bytes_, is_color=True):
+    import io
+    img = _pil().open(io.BytesIO(bytes_))
+    img = img.convert("RGB" if is_color else "L")
+    return np.asarray(img)
+
+
+def load_image(file, is_color=True):
+    img = _pil().open(file)
+    img = img.convert("RGB" if is_color else "L")
+    return np.asarray(img)
+
+
+def resize_short(im, size):
+    """Scale so the SHORT edge equals `size` (aspect preserved)."""
+    h, w = im.shape[:2]
+    if h < w:
+        nh, nw = size, int(round(w * size / h))
+    else:
+        nh, nw = int(round(h * size / w)), size
+    img = _pil().fromarray(im)
+    return np.asarray(img.resize((nw, nh), _pil().BILINEAR))
+
+
+def to_chw(im, order=(2, 0, 1)):
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h0 = max((h - size) // 2, 0)
+    w0 = max((w - size) // 2, 0)
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def random_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h0 = np.random.randint(0, max(h - size, 0) + 1)
+    w0 = np.random.randint(0, max(w - size, 0) + 1)
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def left_right_flip(im, is_color=True):
+    return im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None):
+    """resize_short + (random crop + flip | center crop) + CHW float
+    (reference image.py:329)."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, is_color)
+        if np.random.randint(2) == 0:
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size, is_color)
+    if im.ndim == 3:
+        im = to_chw(im)
+    im = im.astype(np.float32)
+    if mean is not None:
+        mean = np.asarray(mean, np.float32)
+        if mean.ndim == 1 and im.ndim == 3:
+            mean = mean[:, None, None]
+        im -= mean
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    return simple_transform(load_image(filename, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)

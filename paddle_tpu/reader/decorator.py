@@ -1,0 +1,14 @@
+"""paddle.reader.decorator submodule path (reference keeps the
+decorators importable both as paddle.reader.* and
+paddle.reader.decorator.*)."""
+from paddle_tpu.reader import (  # noqa: F401
+    buffered,
+    cache,
+    chain,
+    compose,
+    firstn,
+    map_readers,
+    multiprocess_reader,
+    shuffle,
+    xmap_readers,
+)

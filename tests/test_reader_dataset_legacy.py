@@ -102,3 +102,16 @@ def test_cifar100_yields_100_classes():
         if i > 400:
             break
     assert max(labels) > 9
+
+
+def test_dataset_image_utils():
+    im = (np.random.default_rng(0).random((40, 60, 3)) * 255
+          ).astype(np.uint8)
+    r = paddle.dataset.image.resize_short(im, 32)
+    assert min(r.shape[:2]) == 32
+    assert paddle.dataset.image.center_crop(r, 32).shape[:2] == (32, 32)
+    t = paddle.dataset.image.simple_transform(
+        im, 36, 32, is_train=True, mean=[127.5, 127.5, 127.5])
+    assert t.shape == (3, 32, 32) and t.dtype == np.float32
+    from paddle_tpu.reader.decorator import firstn  # submodule path
+    assert list(firstn(lambda: iter(range(9)), 3)()) == [0, 1, 2]
