@@ -1,0 +1,72 @@
+"""Sparse 3-D CNN on synthetic point-cloud voxels (r5).
+
+The canonical sparse stack — strided sparse Conv3D (true nnz compute:
+candidate-site discovery + sorted-coalescing join + one MXU GEMM),
+mask-aware BatchNorm, ReLU, SubmConv3D, sparse MaxPool3D — trained
+end to end on a two-class "which octant is denser" task. Compute
+scales with active sites, not volume (reference:
+python/paddle/sparse/nn/layer/conv.py rulebook kernels).
+
+Run: python examples/train_sparse_cnn.py
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.sparse.nn as spnn
+from paddle_tpu import sparse
+
+VOL = (1, 16, 16, 16, 3)      # [N, D, H, W, C], ~2% occupancy
+
+
+def make_sample(rng, label):
+    """Scatter 80 active sites; class 1 biases them into the +z half."""
+    dense = np.zeros(VOL, np.float32)
+    n_sites = 80
+    z = rng.integers(8, 16, n_sites) if label else rng.integers(0, 16,
+                                                                n_sites)
+    y, x = rng.integers(0, 16, (2, n_sites))
+    dense[0, z, y, x] = rng.standard_normal((n_sites, 3)) + 0.5
+    return sparse.to_sparse_coo(paddle.to_tensor(dense), sparse_dim=4)
+
+
+def main():
+    paddle.seed(0)
+    rng = np.random.default_rng(0)
+    conv1 = spnn.Conv3D(3, 16, kernel_size=3, stride=2, padding=1)
+    bn1 = spnn.BatchNorm(16)
+    conv2 = spnn.SubmConv3D(16, 16, kernel_size=3, padding=1)
+    pool = spnn.MaxPool3D(kernel_size=2, stride=2)
+    head = paddle.nn.Linear(16, 2)
+    params = (conv1.parameters() + bn1.parameters() + conv2.parameters()
+              + head.parameters())
+    opt = paddle.optimizer.Adam(learning_rate=5e-3, parameters=params)
+    relu = spnn.ReLU()
+
+    losses, correct = [], 0
+    for step in range(40):
+        label = step % 2
+        x = make_sample(rng, label)
+        opt.clear_grad()
+        h = pool(conv2(relu(bn1(conv1(x)))))
+        # masked global mean over ACTIVE sites only
+        vals, mask = h.values(), paddle.to_tensor(
+            np.asarray(h._live_mask, np.float32))
+        pooled = (vals * mask.unsqueeze(-1)).sum(axis=0) / mask.sum()
+        logits = head(pooled)
+        loss = paddle.nn.functional.cross_entropy(
+            logits.unsqueeze(0),
+            paddle.to_tensor(np.array([label]), dtype="int64"))
+        loss.backward()
+        opt.step()
+        losses.append(float(loss))
+        if step >= 30:
+            correct += int(np.argmax(logits.numpy()) == label)
+    print(f"loss {np.mean(losses[:8]):.3f} -> {np.mean(losses[-8:]):.3f}"
+          f"; last-10 accuracy {correct}/10")
+    assert np.mean(losses[-8:]) < np.mean(losses[:8])
+    print("OK: sparse Conv-BN-ReLU-SubmConv-MaxPool stack trained "
+          "(work scales with ~2% active sites, not the volume)")
+
+
+if __name__ == "__main__":
+    main()
