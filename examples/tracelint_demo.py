@@ -1,0 +1,79 @@
+"""tracelint demo — a DELIBERATELY trace-unsafe `@to_static` step.
+
+This example exists to be caught: `python tools/tracelint.py examples/`
+must flag the hazards below with rule codes and file:line.  Running the
+module shows the same diagnostics surfacing the two other ways —
+`to_static(check=True)` warnings ahead of trace, and the NAMED runtime
+error (`analysis.rules.TraceHazardError`, same wording as the CLI) when
+a tensor condition actually hits an unconvertible loop.
+
+The hazards, on purpose:
+  - TL101: `loss.numpy()` host sync inside the traced step
+  - TL104: `print` of a tensor inside the traced step
+  - TL106: appending a tensor to a module-level list at trace time
+  - TL001: `return` inside a `while` — the loop stays plain Python, and
+    a tensor-valued condition there raises the named diagnostic
+"""
+import warnings
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+net = paddle.nn.Linear(8, 4)
+opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+
+history = []  # mutated from inside the traced step: TL106
+
+
+@paddle.jit.to_static
+def broken_train_step(x, y):
+    loss = F.cross_entropy(net(x), y)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    print("loss is", loss)            # TL104: prints a tracer, once
+    history.append(loss)              # TL106: trace-time side effect
+    return float(loss.numpy())        # TL101: host sync under the trace
+
+
+def clip_until(m):
+    # TL001: `return` inside the loop keeps it plain Python — fine
+    # eagerly, a named TraceHazardError when `m` is traced
+    while m > 4.0:
+        if m < 8.0:
+            return m
+        m = m * 0.5
+    return m
+
+
+def main():
+    from paddle_tpu import analysis
+
+    print("== AST findings for this file ==")
+    findings = analysis.lint_paths([__file__])
+    for f in findings:
+        print(" ", f.format())
+
+    print("\n== the same hazards via to_static(check=True) ==")
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        paddle.jit.to_static(broken_train_step.dygraph_function, check=True)
+    for w in caught:
+        print(" ", str(w.message).splitlines()[0])
+
+    print("\n== named runtime diagnostic (TL001) ==")
+
+    @paddle.jit.to_static
+    def traced_clip(x):
+        return clip_until(x.mean() * 100.0)
+
+    try:
+        traced_clip(paddle.to_tensor(np.ones((4, 4), np.float32)))
+    except analysis.TraceHazardError as e:
+        print(" ", str(e).splitlines()[0])
+
+
+if __name__ == "__main__":
+    main()

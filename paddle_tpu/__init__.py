@@ -305,8 +305,10 @@ dtype = _np_mod.dtype
 
 def __getattr__(name):
     # paddle_tpu.onnx loads lazily: its protoc-generated binding needs
-    # google.protobuf, which only ONNX exporters should have to carry
-    if name == "onnx":
+    # google.protobuf, which only ONNX exporters should have to carry.
+    # paddle_tpu.analysis (tracelint) loads lazily too: it is pure
+    # stdlib and the CLI imports it without this package __init__.
+    if name in ("onnx", "analysis"):
         import importlib
-        return importlib.import_module("paddle_tpu.onnx")
+        return importlib.import_module(f"paddle_tpu.{name}")
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

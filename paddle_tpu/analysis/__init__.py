@@ -1,0 +1,106 @@
+"""paddle_tpu.analysis — tracelint: trace-safety & TPU-compilability lint.
+
+Two passes over user code headed for the whole-program XLA path:
+
+- **AST pass** (pure stdlib, no trace): walks every function reachable
+  from a `@to_static` entry and reports, with file:line and a TLxxx
+  code, hazards the converter can otherwise only raise on at trace
+  time — constructs outside the conversion subset (TL0xx), host syncs
+  and trace-time side effects (TL1xx), recompile-storm hazards (TL3xx).
+- **jaxpr pass** (post-trace): lints the emitted program — f64
+  promotions, large baked constants, collectives vs the mesh (TL4xx).
+
+Surfaces: `tools/tracelint.py` (CLI, baseline-aware `--check` mode) and
+`paddle_tpu.jit.to_static(check=True)` (warnings at wrap/compile time).
+Per-line suppression: `# tracelint: disable=TL101`; whole file:
+`# tracelint: skip-file`.
+"""
+from __future__ import annotations
+
+import inspect
+import textwrap
+import warnings
+
+from paddle_tpu.analysis.rules import (  # noqa: F401
+    RULES, TraceHazardError, message_for,
+)
+from paddle_tpu.analysis.visitor import (  # noqa: F401
+    Finding, iter_py_files, lint_source, rel_path,
+)
+from paddle_tpu.analysis.subset_rules import check_recompile, check_subset
+from paddle_tpu.analysis.purity_rules import check_purity
+from paddle_tpu.analysis import report  # noqa: F401
+
+__all__ = [
+    "RULES", "TraceHazardError", "Finding", "TracelintWarning",
+    "lint_paths", "lint_file", "lint_callable", "check_jaxpr",
+    "message_for", "report",
+]
+
+AST_RULE_SETS = (check_subset, check_purity, check_recompile)
+
+
+class TracelintWarning(UserWarning):
+    """Emitted by to_static(check=True) for each tracelint finding."""
+
+
+def lint_file(path, base=None, rule_sets=AST_RULE_SETS):
+    try:
+        with open(path, encoding="utf-8", errors="replace") as fh:
+            source = fh.read()
+    except OSError:
+        return []
+    return lint_source(path, source, rule_sets, base=base)
+
+
+def lint_paths(paths, base=None, rule_sets=AST_RULE_SETS):
+    """AST-lint every .py file under `paths`; returns [Finding]."""
+    findings = []
+    for p in iter_py_files(paths):
+        findings.extend(lint_file(p, base=base, rule_sets=rule_sets))
+    return findings
+
+
+def lint_callable(fn, rule_sets=AST_RULE_SETS):
+    """AST-lint one function (a to_static target) and its module-local
+    reach. Used by `to_static(check=True)`; returns [] when source is
+    unavailable (builtins, REPL, exec'd code)."""
+    fn = inspect.unwrap(fn)
+    if inspect.ismethod(fn):
+        fn = fn.__func__
+    try:
+        path = inspect.getsourcefile(fn)
+        source = inspect.getsource(inspect.getmodule(fn))
+    except (OSError, TypeError):
+        # no module source (REPL) — fall back to the function body alone
+        try:
+            path = "<%s>" % getattr(fn, "__qualname__", "fn")
+            source = textwrap.dedent(inspect.getsource(fn))
+        except (OSError, TypeError):
+            return []
+    firstline = fn.__code__.co_firstlineno
+
+    def select_roots(index):
+        cands = [fi for fi in index.functions if fi.node.name == fn.__name__]
+        if not cands:
+            return []
+        root = min(cands, key=lambda fi: abs(fi.node.lineno - firstline))
+        # the wrapped function IS a to_static entry even when wrapped in
+        # call form (to_static(fn, check=True)) — entry-only rules
+        # (TL301 mutable static args) must see it as one
+        root.is_entry = True
+        return [root]
+
+    return lint_source(path, source, rule_sets, select_roots=select_roots)
+
+
+def check_jaxpr(closed_jaxpr, where="<traced function>", **kw):
+    """Post-trace jaxpr lint (TL4xx). Lazy import: jax only loads here."""
+    from paddle_tpu.analysis.jaxpr_rules import check_jaxpr as _impl
+    return _impl(closed_jaxpr, where=where, **kw)
+
+
+def warn_findings(findings, stacklevel=3):
+    for f in findings:
+        warnings.warn(f"tracelint: {f.format()}", TracelintWarning,
+                      stacklevel=stacklevel)

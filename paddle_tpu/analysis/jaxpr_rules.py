@@ -1,0 +1,143 @@
+"""tracelint TL4xx: post-trace lint of the emitted jaxpr.
+
+The AST pass (subset/purity rules) runs before any trace; this pass runs
+AFTER `to_static` traces the step function and inspects the actual
+program XLA will compile: unintended f32->f64 widenings (TL401), large
+host constants baked into the executable (TL402), and collectives
+issued against no / the wrong mesh axis (TL403/TL404).  Wired in via
+`to_static(check=True)` and importable directly for tools.
+
+Dtype-promotion policy comes from `core/dispatch.py`
+(`default_float_dtype` / `wide_dtype_allowed_ops`), so ops that widen
+deliberately can register themselves once and stay unflagged everywhere.
+"""
+from __future__ import annotations
+
+from paddle_tpu.analysis.rules import message_for
+from paddle_tpu.analysis.visitor import Finding
+
+# primitive name -> param key holding the axis name(s)
+COLLECTIVE_PRIMS = {
+    "psum": "axes", "pmin": "axes", "pmax": "axes",
+    "all_gather": "axis_name", "all_to_all": "axis_name",
+    "ppermute": "axis_name", "reduce_scatter": "axis_name",
+    "axis_index": "axis_name", "pbroadcast": "axes",
+}
+
+WIDE_DTYPES = ("float64", "complex128")
+
+LARGE_CONST_BYTES = 1 << 20  # 1 MiB
+
+
+def _iter_eqns(jaxpr):
+    """All eqns of a (Closed)Jaxpr, recursing into sub-jaxprs
+    (pjit/while/cond/scan bodies)."""
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in inner.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in _sub_jaxprs(v):
+                yield from _iter_eqns(sub)
+
+
+def _sub_jaxprs(v):
+    if hasattr(v, "eqns") or hasattr(v, "jaxpr"):
+        return [v]
+    if isinstance(v, (list, tuple)):
+        out = []
+        for x in v:
+            out.extend(_sub_jaxprs(x))
+        return out
+    return []
+
+
+def _axis_names(eqn):
+    key = COLLECTIVE_PRIMS.get(eqn.primitive.name)
+    if key is None:
+        return None
+    v = eqn.params.get(key)
+    if isinstance(v, (list, tuple)):
+        return tuple(a for a in v if isinstance(a, str))
+    return (v,) if isinstance(v, str) else ()
+
+
+def check_jaxpr(closed_jaxpr, where="<traced function>",
+                large_const_bytes=LARGE_CONST_BYTES):
+    """Lint one ClosedJaxpr; returns [Finding] (path = `where`)."""
+    from paddle_tpu.core import dispatch
+    from paddle_tpu.distributed import mesh as dmesh
+
+    findings = []
+
+    def emit(code, detail):
+        findings.append(Finding(path=where, line=0, col=0, code=code,
+                                message=message_for(code, detail=detail)))
+
+    # ---- TL401: widenings past the default float ----
+    # Report only INTRODUCTION points (wide output, no wide input) so a
+    # single upcast yields one finding at its origin, not one per
+    # downstream primitive the f64 flows through.  An allowlisted
+    # introducer silences its whole chain.
+    default_float = dispatch.default_float_dtype()
+    allowed = dispatch.wide_dtype_allowed_ops()
+    if default_float == "float32":
+        def _wide(v):
+            return str(getattr(getattr(v, "aval", None), "dtype", "")) \
+                in WIDE_DTYPES
+
+        intro_any, intro_flagged = {}, {}
+        for eqn in _iter_eqns(closed_jaxpr):
+            out_dt = next(
+                (str(ov.aval.dtype) for ov in eqn.outvars if _wide(ov)),
+                None)
+            if out_dt is None or any(_wide(iv) for iv in eqn.invars):
+                continue
+            intro_any.setdefault(eqn.primitive.name, out_dt)
+            if eqn.primitive.name not in allowed:
+                intro_flagged.setdefault(eqn.primitive.name, out_dt)
+        for prim, dt in sorted(intro_flagged.items()):
+            emit("TL401", f"{dt} (first introduced by `{prim}`)")
+        if not intro_any:
+            # wide values can also ENTER the program (traced input or
+            # baked constant) without any introducing eqn
+            inner = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+            entering = [str(v.aval.dtype) for v in inner.invars if _wide(v)]
+            entering += [str(getattr(c, "dtype", ""))
+                         for c in getattr(closed_jaxpr, "consts", []) or []
+                         if str(getattr(c, "dtype", "")) in WIDE_DTYPES]
+            if entering:
+                emit("TL401",
+                     f"{entering[0]} (entering as a traced input or "
+                     f"constant)")
+
+    # ---- TL402: large constants baked into the program ----
+    for const in getattr(closed_jaxpr, "consts", []) or []:
+        nbytes = getattr(const, "nbytes", 0)
+        if nbytes and nbytes >= large_const_bytes:
+            shape = tuple(getattr(const, "shape", ()))
+            dt = str(getattr(const, "dtype", "?"))
+            emit("TL402",
+                 f"{nbytes / (1 << 20):.1f} MiB ({dt}{list(shape)})")
+
+    # ---- TL403/TL404: collectives vs the mesh ----
+    mesh = dmesh.get_mesh()
+    mesh_axes = tuple(mesh.axis_names) if mesh is not None else ()
+    seen = set()
+    for eqn in _iter_eqns(closed_jaxpr):
+        names = _axis_names(eqn)
+        if not names:
+            continue  # not a collective, or positional (unnamed) axes
+        key = (eqn.primitive.name, names)
+        if key in seen:
+            continue
+        seen.add(key)
+        if mesh is None:
+            emit("TL403", f"{eqn.primitive.name}(axis={list(names)})")
+        else:
+            bad = [n for n in names if isinstance(n, str)
+                   and n not in mesh_axes]
+            if bad:
+                emit("TL404",
+                     f"{eqn.primitive.name}(axis={bad}) vs mesh axes "
+                     f"{list(mesh_axes)}")
+    return findings

@@ -1,0 +1,112 @@
+"""tracelint TL1xx: host syncs and trace-time side effects.
+
+Everything reached from a `@to_static` entry runs under one jax trace:
+host syncs (`.numpy()`, `float(t)`) either raise a concretization error
+or silently freeze a value at trace time, and side effects (`print`,
+appending to an outer list, host randomness) run ONCE while tracing
+instead of once per compiled step.  Tensor-likeness is the heuristic
+`visitor.TensorEnv` dataflow — over-approximate by design; the baseline
+absorbs reviewed-and-accepted findings.
+"""
+from __future__ import annotations
+
+import ast
+
+from paddle_tpu.analysis.rules import message_for
+from paddle_tpu.analysis.visitor import (
+    Finding, TensorEnv, _dotted, walk_same_scope,
+)
+
+HOST_SYNC_METHODS = {"numpy", "item", "tolist"}
+CONCRETIZERS = {"float", "int", "bool"}
+NP_HOST_FUNCS = {"array", "asarray", "asanyarray", "ascontiguousarray"}
+# dotted call prefixes evaluated on the HOST at trace time
+UNTRACED_SOURCES = (
+    "np.random.", "numpy.random.", "random.", "time.time", "time.monotonic",
+    "time.perf_counter", "datetime.",
+)
+
+
+def _finding(index, node, code, detail=""):
+    return Finding(path=index.path, line=node.lineno,
+                   col=getattr(node, "col_offset", 0), code=code,
+                   message=message_for(code, detail=detail))
+
+
+def _local_stores(fdef):
+    names = set()
+    for n in ast.walk(fdef):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, (ast.Store, ast.Del)):
+            names.add(n.id)
+        elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            names.add(n.name)
+    a = fdef.args
+    for arg in (a.posonlyargs + a.args + a.kwonlyargs +
+                ([a.vararg] if a.vararg else []) +
+                ([a.kwarg] if a.kwarg else [])):
+        names.add(arg.arg)
+    return names
+
+
+def check_purity(index, reached):
+    out = []
+    for fi in reached:
+        fdef = fi.node
+        env = TensorEnv(fdef, is_entry=True)
+        local = _local_stores(fdef)
+        globals_decl = set()
+        for n in walk_same_scope(fdef):
+            if isinstance(n, ast.Global):
+                globals_decl.update(n.names)
+
+        for n in walk_same_scope(fdef):
+            if not isinstance(n, ast.Call):
+                if isinstance(n, ast.Assign):
+                    # store to a declared-global name with a tensorish RHS
+                    for t in n.targets:
+                        if isinstance(t, ast.Name) and t.id in globals_decl \
+                                and env.is_tensorish(n.value):
+                            out.append(_finding(
+                                index, n, "TL106",
+                                detail=f"global `{t.id}`"))
+                continue
+            f = n.func
+            # ---- TL101: t.numpy() / t.item() / t.tolist() ----
+            if isinstance(f, ast.Attribute) and f.attr in HOST_SYNC_METHODS \
+                    and env.is_tensorish(f.value):
+                out.append(_finding(index, n, "TL101", detail=f.attr))
+                continue
+            # ---- TL102: float(t) / int(t) / bool(t) ----
+            if isinstance(f, ast.Name) and f.id in CONCRETIZERS and n.args \
+                    and env.is_tensorish(n.args[0]):
+                out.append(_finding(index, n, "TL102", detail=f.id))
+                continue
+            dotted = _dotted(f)
+            # ---- TL103: np.array(t) & friends ----
+            root, _, tail = dotted.partition(".")
+            if root in ("np", "numpy") and tail in NP_HOST_FUNCS and \
+                    n.args and env.is_tensorish(n.args[0]):
+                out.append(_finding(index, n, "TL103", detail=tail))
+                continue
+            # ---- TL104: print(tensor) ----
+            if isinstance(f, ast.Name) and f.id == "print" and any(
+                    env.is_tensorish(a) for a in n.args):
+                out.append(_finding(index, n, "TL104"))
+                continue
+            # ---- TL105: host randomness / clocks ----
+            if dotted and any(dotted == u.rstrip(".") or
+                              dotted.startswith(u) for u in UNTRACED_SOURCES):
+                out.append(_finding(index, n, "TL105", detail=dotted))
+                continue
+            # ---- TL106: mutating an outer list/set/dict with tensors ----
+            if isinstance(f, ast.Attribute) and \
+                    f.attr in ("append", "extend", "add", "insert",
+                               "update", "setdefault") and \
+                    isinstance(f.value, ast.Name) and \
+                    f.value.id not in local and \
+                    any(env.is_tensorish(a) for a in n.args):
+                out.append(_finding(
+                    index, n, "TL106",
+                    detail=f"outer `{f.value.id}.{f.attr}(...)`"))
+    return out

@@ -1,0 +1,78 @@
+"""tracelint reporting: text / JSON output and the repo baseline.
+
+The baseline lets the analyzer self-host over a codebase with known,
+reviewed findings: each finding is fingerprinted by
+(path, code, hash-of-stripped-source-line) — line NUMBERS move on every
+edit, line TEXT rarely does — and the baseline stores a count per
+fingerprint.  `--check` mode reports only findings whose fingerprint
+count EXCEEDS the baseline, so new hazards fail the gate while the
+accepted backlog stays quiet.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+from collections import Counter
+
+BASELINE_VERSION = 1
+
+
+def fingerprint(finding):
+    h = hashlib.sha1(
+        finding.source_line.strip().encode("utf-8", "replace")).hexdigest()[:12]
+    return f"{finding.path}::{finding.code}::{h}"
+
+
+def to_json(findings, extra=None):
+    doc = {"version": BASELINE_VERSION,
+           "count": len(findings),
+           "findings": [f.to_dict() for f in findings]}
+    if extra:
+        doc.update(extra)
+    return doc
+
+
+def format_text(findings, show_source=True):
+    lines = []
+    for f in findings:
+        lines.append(f.format())
+        if show_source and f.source_line:
+            lines.append(f"    {f.source_line}")
+    return "\n".join(lines)
+
+
+def write_baseline(findings, path):
+    counts = Counter(fingerprint(f) for f in findings)
+    doc = {"version": BASELINE_VERSION,
+           "fingerprints": dict(sorted(counts.items()))}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return doc
+
+
+def load_baseline(path):
+    try:
+        with open(path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError):
+        return {}
+    return dict(doc.get("fingerprints", {}))
+
+
+def diff_vs_baseline(findings, baseline):
+    """Findings above the baselined count per fingerprint (the NEW ones)."""
+    budget = Counter(baseline)
+    new = []
+    for f in findings:
+        fp = fingerprint(f)
+        if budget[fp] > 0:
+            budget[fp] -= 1
+        else:
+            new.append(f)
+    return new
+
+
+def summarize(findings):
+    by_code = Counter(f.code for f in findings)
+    return ", ".join(f"{c}×{n}" for c, n in sorted(by_code.items())) or "none"

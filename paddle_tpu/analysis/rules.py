@@ -1,0 +1,183 @@
+"""tracelint rule registry — the single source of truth for diagnostics.
+
+Every hazard the `to_static` pipeline can hit has a code here (TL0xx
+conversion-subset, TL1xx host-sync/purity, TL3xx recompile hazards,
+TL4xx post-trace jaxpr findings).  The CLI (`tools/tracelint.py`), the
+opt-in `to_static(check=True)` hook, and the *runtime* diagnostics in
+`jit/dy2static.py` all pull their message text from this table, so a
+user sees the same wording whether the problem is caught ahead of trace
+or at trace time.
+
+This module is pure stdlib (no jax import) so the AST pass stays cheap
+and importable anywhere — including from `jit/dy2static.py` without an
+import cycle.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Rule:
+    code: str
+    name: str
+    message: str       # one-line diagnostic (str.format over kwargs)
+    rationale: str     # why this is a hazard under the whole-program trace
+    fixit: str         # what the user should do instead
+
+
+class TraceHazardError(RuntimeError):
+    """Named runtime diagnostic for a construct outside the `to_static`
+    conversion subset hit with a tensor-valued condition.
+
+    Raised by `jit/dy2static.py` guards instead of letting the generic
+    jax concretization error surface; carries the rule code so the CLI
+    and the runtime agree on wording.
+    """
+
+    def __init__(self, code, filename, lineno, detail=""):
+        self.code = code
+        self.filename = filename
+        self.lineno = lineno
+        rule = RULES[code]
+        msg = (f"{code} at {filename}:{lineno}: "
+               f"{rule.message.format(detail=detail)}\n"
+               f"  why: {rule.rationale}\n"
+               f"  fix: {rule.fixit}\n"
+               f"  (run `python tools/tracelint.py <your file>` to find "
+               f"these before tracing)")
+        super().__init__(msg)
+
+
+_R = Rule
+
+RULES = {r.code: r for r in [
+    # ---- TL0xx: constructs outside the dy2static conversion subset ----
+    _R("TL001", "return-in-converted-loop",
+       "`return` inside a loop{detail} — the loop stays plain Python and "
+       "a tensor-valued condition there fails at trace time",
+       "a lax.while_loop carry cannot hold a value first bound mid-loop, "
+       "so dy2static leaves loops containing `return` unconverted; under "
+       "a trace the loop condition then hits bool() on a tracer",
+       "hoist the result into a variable, `break` out (range-for/while), "
+       "and `return` after the loop — or keep the condition "
+       "Python-valued"),
+    _R("TL002", "break-in-nonrange-for",
+       "`break`/`continue` in a non-range `for` loop{detail} — outside "
+       "the conversion subset, the loop stays plain Python",
+       "only `for <name> in range(...)` lowers to the counter-while form "
+       "that can carry the break/continue guard flags",
+       "iterate `for i in range(len(xs))` and index, or restructure "
+       "without break/continue"),
+    _R("TL003", "loop-else-clause",
+       "loop `else:` clause{detail} — outside the conversion subset, the "
+       "loop stays plain Python",
+       "the converted while/for forms have no place for the else block "
+       "(it would need a 'did not break' flag across the carry)",
+       "move the else body after the loop, guarded on the exit flag you "
+       "manage yourself"),
+    _R("TL004", "generator-under-trace",
+       "`yield` in a function reachable from a `@to_static` entry",
+       "generators cannot be traced into one XLA program; convert_call "
+       "skips them, so tensor control flow inside stays eager",
+       "materialize the sequence into a list before the traced region"),
+
+    # ---- TL1xx: host syncs & trace-time side effects ----
+    _R("TL101", "host-sync-numpy",
+       "`.{detail}()` on a tensor inside traced code — host sync / "
+       "concretization error under the trace",
+       "the whole-program trace has no concrete values; .numpy()/.item()/"
+       ".tolist() force a device->host transfer that cannot happen inside "
+       "one XLA program",
+       "keep the value as a tensor; move host-side reads (logging, "
+       "thresholds) outside the @to_static function"),
+    _R("TL102", "tensor-concretize",
+       "`{detail}()` of a tensor value — concretizes under the trace",
+       "float()/int()/bool() need a concrete scalar; under the trace they "
+       "raise a ConcretizationTypeError (or silently bake a trace-time "
+       "constant via __index__)",
+       "use tensor arithmetic (the converter handles tensor `if`/`while` "
+       "conditions), or compute the scalar before entering traced code"),
+    _R("TL103", "tensor-to-numpy-array",
+       "np.{detail}() over a tensor value — host transfer under the trace",
+       "numpy constructors force concretization; inside the trace this "
+       "either errors or silently freezes the value at trace time",
+       "use paddle_tpu / jnp ops end to end inside the traced function"),
+    _R("TL104", "print-of-tensor",
+       "`print` of a tensor value inside traced code — prints a tracer "
+       "once at trace time, not per step",
+       "side effects run only while tracing; the compiled program never "
+       "prints, and what does print is `Traced<...>`, not the value",
+       "return the value and print it outside, or drop the print"),
+    _R("TL105", "untraced-randomness",
+       "`{detail}` inside traced code — evaluated once at trace time and "
+       "baked into the program as a constant",
+       "host randomness / clocks are not traced: every compiled step "
+       "replays the same trace-time value, which is almost never intended",
+       "use paddle_tpu's traced RNG ops (paddle.rand/randn, nn dropout) "
+       "or pass the value in as an argument"),
+    _R("TL106", "trace-time-mutation",
+       "mutation of {detail} inside traced code — happens once at trace "
+       "time, not per step",
+       "appending tensors to module-level / closure lists (or writing "
+       "globals) under the trace stores tracers and runs only during "
+       "tracing; the compiled step never re-executes it",
+       "return values out of the traced function and accumulate outside"),
+
+    # ---- TL3xx: recompile-storm hazards ----
+    _R("TL301", "unhashable-static-arg",
+       "mutable default argument {detail} on a `@to_static` entry — "
+       "unhashable static leaf, falls back to repr() caching",
+       "non-tensor args key the compile cache; a list/dict/set default is "
+       "repr()-keyed, so equal-but-not-identical values silently miss the "
+       "cache and recompile",
+       "use a tuple / frozen value, or make the argument a tensor"),
+    _R("TL302", "to-static-in-loop",
+       "`to_static(...)` constructed inside a loop — every iteration "
+       "builds a fresh compile cache",
+       "each StaticFunction owns its cache; wrapping per iteration means "
+       "nothing is ever reused and every step pays a full XLA compile",
+       "hoist the to_static wrapping out of the loop and reuse it"),
+
+    # ---- TL4xx: post-trace jaxpr findings ----
+    _R("TL401", "f64-promotion",
+       "program contains {detail} values — unintended widening past the "
+       "default float32",
+       "f64/c128 on TPU runs on the slow path (or is silently demoted); "
+       "a stray Python float or np.float64 scalar upcasting an op is the "
+       "usual cause",
+       "cast inputs explicitly or keep scalars as Python floats under "
+       "jax's default x64-disabled config"),
+    _R("TL402", "large-baked-constant",
+       "constant of {detail} baked into the compiled program",
+       "closure-captured arrays are embedded in the executable — they "
+       "bloat compile time and HBM, and a changed value silently "
+       "recompiles",
+       "pass the array as an argument (it becomes a donated/traced "
+       "input) instead of closing over it"),
+    _R("TL403", "collective-outside-mesh",
+       "collective `{detail}` issued with no device mesh initialized",
+       "psum/all_gather and friends need a mesh axis to reduce over; "
+       "outside `init_mesh`/shard_map they are at best identities and at "
+       "worst trace errors on real multi-chip runs",
+       "call paddle.distributed.init_mesh(...) (or run under shard_map) "
+       "before tracing collectives"),
+    _R("TL404", "axis-name-mismatch",
+       "collective `{detail}` — axis name not bound by the current mesh",
+       "an axis name that doesn't match the mesh's axis_names raises at "
+       "dispatch on multi-chip and silently no-ops in single-process "
+       "fallbacks",
+       "use one of the mesh's declared axis names (see init_mesh "
+       "axis_names=...)"),
+]}
+
+
+def message_for(code, detail=""):
+    """Formatted one-line message for `code` (shared CLI/runtime text)."""
+    return RULES[code].message.format(detail=detail)
+
+
+# Codes whose AST rules only make sense on functions REACHED from a
+# @to_static entry (everything, today — kept explicit for the CLI docs).
+AST_CODES = tuple(c for c in RULES if c < "TL400")
+JAXPR_CODES = tuple(c for c in RULES if c >= "TL400")

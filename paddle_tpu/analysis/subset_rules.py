@@ -1,0 +1,123 @@
+"""tracelint TL0xx / TL3xx: conversion-subset and recompile hazards.
+
+These rules re-state, ahead of trace, exactly the bail conditions
+`jit/dy2static.py` applies during transform: a loop containing `return`,
+`break`/`continue` in a non-range `for`, or a loop `else:` clause is
+left as plain Python — correct eagerly, but a tensor-valued condition
+there surfaces as a trace-time error.  The runtime guards in dy2static
+raise the same codes (via `rules.TraceHazardError`); this pass finds
+them before the expensive trace.
+"""
+from __future__ import annotations
+
+import ast
+
+from paddle_tpu.analysis.rules import message_for
+from paddle_tpu.analysis.visitor import (
+    Finding, is_to_static_decorator, walk_same_scope as _walk_same_scope,
+)
+
+
+def _finding(index, node, code, detail=""):
+    return Finding(path=index.path, line=node.lineno,
+                   col=getattr(node, "col_offset", 0), code=code,
+                   message=message_for(code, detail=detail))
+
+
+def _is_range_for(node):
+    it = node.iter
+    return (isinstance(node, ast.For) and isinstance(it, ast.Call)
+            and isinstance(it.func, ast.Name) and it.func.id == "range"
+            and not it.keywords and 1 <= len(it.args) <= 3
+            and isinstance(node.target, ast.Name))
+
+
+def _loop_level_exits(loop):
+    """break/continue/return belonging to THIS loop (not nested loops;
+    returns DO escape nested loops)."""
+    brk, ret = [], []
+    stack = [(s, True) for s in loop.body]
+    while stack:
+        n, own = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Lambda)):
+            continue
+        if isinstance(n, (ast.Break, ast.Continue)):
+            if own:
+                brk.append(n)
+            continue
+        if isinstance(n, ast.Return):
+            ret.append(n)
+            continue
+        nested = isinstance(n, (ast.For, ast.While))
+        for c in ast.iter_child_nodes(n):
+            stack.append((c, own and not nested))
+    return brk, ret
+
+
+def check_subset(index, reached):
+    """TL001/TL002/TL003/TL004 over every function reached from an entry."""
+    out = []
+    for fi in reached:
+        fdef = fi.node
+        if isinstance(fdef, ast.AsyncFunctionDef):
+            out.append(_finding(index, fdef, "TL004"))
+            continue
+        for n in _walk_same_scope(fdef):
+            if isinstance(n, (ast.Yield, ast.YieldFrom)):
+                out.append(_finding(index, fdef, "TL004"))
+                break
+        for n in _walk_same_scope(fdef):
+            if not isinstance(n, (ast.For, ast.While)):
+                continue
+            brk, ret = _loop_level_exits(n)
+            if n.orelse:
+                out.append(_finding(index, n, "TL003"))
+            if ret:
+                out.append(_finding(
+                    index, ret[0], "TL001",
+                    detail=f" (loop at line {n.lineno})"))
+            if brk and isinstance(n, ast.For) and not _is_range_for(n):
+                out.append(_finding(
+                    index, brk[0], "TL002",
+                    detail=f" (loop at line {n.lineno})"))
+    return out
+
+
+def check_recompile(index, reached):
+    """TL301 (mutable default on an entry), TL302 (to_static in a loop)."""
+    out = []
+    for fi in reached:
+        if not fi.is_entry:
+            continue
+        a = fi.node.args
+        for d in (a.defaults or []) + [d for d in (a.kw_defaults or [])
+                                       if d is not None]:
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                kind = type(d).__name__.lower()
+                out.append(_finding(index, d, "TL301",
+                                    detail=f"(a {kind} literal)"))
+    # to_static applied under a loop (call form, or a decorated def whose
+    # definition re-executes per iteration).  Whole-file mode scans every
+    # function (recompile storms live in glue code, not entries); a
+    # PARTIAL lint (one explicit root, to_static(check=True)) narrows to
+    # module-level code plus the root's reach so unrelated functions
+    # don't warn on every wrap.
+    reached_ids = {id(fi.node) for fi in reached}
+
+    def scan(node, in_loop, active):
+        for c in ast.iter_child_nodes(node):
+            if isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if active and in_loop and any(is_to_static_decorator(d)
+                                              for d in c.decorator_list):
+                    out.append(_finding(index, c, "TL302"))
+                scan(c, False,  # a new scope resets loop context
+                     not index.partial or id(c) in reached_ids)
+                continue
+            if active and in_loop and isinstance(c, ast.Call) and \
+                    is_to_static_decorator(c.func):
+                out.append(_finding(index, c, "TL302"))
+            scan(c, in_loop or isinstance(c, (ast.For, ast.While)), active)
+
+    scan(index.tree, False, True)
+    return out

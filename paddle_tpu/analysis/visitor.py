@@ -1,0 +1,342 @@
+"""tracelint visitor framework: findings, suppressions, reachability.
+
+The AST pass mirrors what the runtime will do: `@to_static` wraps an
+entry function, and `convert_call` recursively converts every function
+and `Layer.forward` the entry reaches.  Statically we approximate that
+reach *within one module*: entries are (a) functions carrying a
+`to_static` decorator (any dotted spelling) and (b) `forward` methods of
+classes defined in the module (convert_call transforms those when a
+layer is called from traced code).  From each entry we close over
+module-local calls — `f(...)` resolving to a module/enclosing-scope
+`def`, and `self.m(...)` resolving to a method of the enclosing class.
+
+Pure stdlib — no jax / paddle_tpu imports — so the CLI can lint a tree
+in milliseconds without touching the framework.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Finding:
+    path: str          # repo-relative, posix separators
+    line: int
+    col: int
+    code: str
+    message: str
+    source_line: str = ""
+
+    def format(self):
+        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
+
+    def to_dict(self):
+        return {"path": self.path, "line": self.line, "col": self.col,
+                "code": self.code, "message": self.message,
+                "source_line": self.source_line}
+
+
+_DISABLE_RE = re.compile(r"#\s*tracelint:\s*disable=([A-Za-z0-9,\s]+)")
+_SKIP_FILE_RE = re.compile(r"^\s*#\s*tracelint:\s*skip-file\s*$")
+
+
+def parse_suppressions(source):
+    """lineno -> set of suppressed codes ('ALL' suppresses everything).
+    Returns (mapping, skip_file)."""
+    sup = {}
+    skip = False
+    for i, raw in enumerate(source.splitlines(), start=1):
+        if _SKIP_FILE_RE.match(raw):
+            skip = True
+        m = _DISABLE_RE.search(raw)
+        if m:
+            codes = {c.strip().upper() for c in m.group(1).split(",")
+                     if c.strip()}
+            sup[i] = codes
+    return sup, skip
+
+
+def _dotted(node):
+    """Best-effort dotted name of an expression ('a.b.c' or '')."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def is_to_static_decorator(dec):
+    """Matches @to_static, @paddle.jit.to_static, @jit.to_static, and the
+    call forms to_static(...)."""
+    if isinstance(dec, ast.Call):
+        dec = dec.func
+    name = _dotted(dec)
+    return name.split(".")[-1] == "to_static"
+
+
+@dataclass
+class FunctionInfo:
+    node: object                      # ast.FunctionDef
+    qualname: str
+    cls: object = None                # enclosing ast.ClassDef (methods)
+    is_entry: bool = False
+
+
+class ModuleIndex:
+    """One parsed file: functions, classes, entry points, call graph."""
+
+    def __init__(self, path, source, tree):
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self.lines = source.splitlines()
+        self.functions = []            # [FunctionInfo] in source order
+        self.by_scope = {}             # id(scope node) -> {name: FunctionInfo}
+        self.methods = {}              # id(ClassDef) -> {name: FunctionInfo}
+        self.partial = False           # True when linting one explicit root
+        self._index()
+
+    def _index(self):
+        def walk(body, scope_key, cls, prefix):
+            local = self.by_scope.setdefault(scope_key, {})
+            for node in body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qn = f"{prefix}{node.name}"
+                    fi = FunctionInfo(node=node, qualname=qn, cls=cls)
+                    fi.is_entry = any(is_to_static_decorator(d)
+                                      for d in node.decorator_list)
+                    if cls is not None and node.name == "forward":
+                        fi.is_entry = True
+                    self.functions.append(fi)
+                    local[node.name] = fi
+                    if cls is not None:
+                        self.methods.setdefault(id(cls), {})[node.name] = fi
+                    walk(node.body, id(node), None, qn + ".")
+                elif isinstance(node, ast.ClassDef):
+                    walk(node.body, id(node), node, f"{prefix}{node.name}.")
+                elif isinstance(node, (ast.If, ast.Try, ast.With,
+                                       ast.For, ast.While)):
+                    # defs nested under plain statements stay in the same
+                    # lexical scope for name resolution
+                    walk(_stmt_children(node), scope_key, cls, prefix)
+        walk(self.tree.body, id(self.tree), None, "")
+
+    def entries(self):
+        return [f for f in self.functions if f.is_entry]
+
+    def reachable(self, roots=None):
+        """Closure of module-local calls from `roots` (default: entries)."""
+        roots = self.entries() if roots is None else roots
+        seen, order = set(), []
+        stack = list(roots)
+        while stack:
+            fi = stack.pop()
+            if id(fi.node) in seen:
+                continue
+            seen.add(id(fi.node))
+            order.append(fi)
+            for callee in self._callees(fi):
+                if id(callee.node) not in seen:
+                    stack.append(callee)
+        return order
+
+    def _callees(self, fi):
+        out = []
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name):
+                target = self._resolve_name(f.id, fi)
+                if target is not None:
+                    out.append(target)
+            elif (isinstance(f, ast.Attribute)
+                  and isinstance(f.value, ast.Name)
+                  and f.value.id == "self" and fi.cls is not None):
+                m = self.methods.get(id(fi.cls), {}).get(f.attr)
+                if m is not None:
+                    out.append(m)
+        return out
+
+    def _resolve_name(self, name, fi):
+        # enclosing function scope first, then module scope
+        for scope_key in (id(fi.node), id(self.tree)):
+            hit = self.by_scope.get(scope_key, {}).get(name)
+            if hit is not None and hit is not fi:
+                return hit
+        return None
+
+
+def _stmt_children(node):
+    out = []
+    for fname in ("body", "orelse", "finalbody"):
+        out.extend(getattr(node, fname, []) or [])
+    for h in getattr(node, "handlers", []) or []:
+        out.extend(h.body)
+    return out
+
+
+def walk_same_scope(node):
+    """ast.walk that does not descend into nested function/class scopes
+    (their bodies are linted via their own FunctionInfo when reached)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for c in ast.iter_child_nodes(n):
+            if isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+                continue
+            stack.append(c)
+
+
+# --------------------------------------------------------- tensor-likeness
+# Attributes whose access on a tensor yields a NON-tensor (python) value.
+NONTENSOR_ATTRS = {
+    "shape", "dtype", "ndim", "name", "size", "numpy", "item", "tolist",
+    "place", "stop_gradient",
+}
+
+
+class TensorEnv:
+    """Heuristic intra-function tensor-likeness: parameters of an entry
+    (minus `self`) are tensors; tensor-ness propagates through
+    assignments, arithmetic, subscripts, method chains and calls that
+    take a tensor argument.  Over-approximate on purpose — findings are
+    hazards, and the baseline absorbs accepted ones."""
+
+    def __init__(self, fdef, is_entry):
+        self.names = set()
+        if is_entry:
+            a = fdef.args
+            for arg in (a.posonlyargs + a.args + a.kwonlyargs):
+                if arg.arg != "self":
+                    self.names.add(arg.arg)
+        # forward pass over assignments, in source order
+        for node in ast.walk(fdef):
+            if isinstance(node, ast.Assign) and self.is_tensorish(node.value):
+                for t in node.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            self.names.add(n.id)
+            elif isinstance(node, ast.AugAssign) and \
+                    self.is_tensorish(node.value):
+                if isinstance(node.target, ast.Name):
+                    self.names.add(node.target.id)
+
+    def is_tensorish(self, node):
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        if isinstance(node, ast.Attribute):
+            if node.attr in NONTENSOR_ATTRS:
+                return False
+            return self.is_tensorish(node.value)
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                if f.attr in NONTENSOR_ATTRS:
+                    return False
+                # h.mean(), self.conv(x), F.relu(x) ...
+                if self.is_tensorish(f.value):
+                    return True
+            return any(self.is_tensorish(a) for a in node.args) or \
+                any(self.is_tensorish(k.value) for k in node.keywords)
+        if isinstance(node, ast.BinOp):
+            return self.is_tensorish(node.left) or \
+                self.is_tensorish(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_tensorish(node.operand)
+        if isinstance(node, ast.Compare):
+            return self.is_tensorish(node.left) or \
+                any(self.is_tensorish(c) for c in node.comparators)
+        if isinstance(node, ast.Subscript):
+            return self.is_tensorish(node.value)
+        if isinstance(node, ast.IfExp):
+            return self.is_tensorish(node.body) or \
+                self.is_tensorish(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.is_tensorish(e) for e in node.elts)
+        return False
+
+
+# ------------------------------------------------------------- file drive
+def iter_py_files(paths):
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            yield p
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def rel_path(path, base=None):
+    base = base or os.getcwd()
+    try:
+        rel = os.path.relpath(os.path.abspath(path), base)
+    except ValueError:
+        rel = path
+    if rel.startswith(".."):
+        rel = path
+    return rel.replace(os.sep, "/")
+
+
+_parse_cache = {}  # (path, hash(source)) -> ast.Module
+
+
+def _parse_cached(path, source):
+    """Parse with a small memo: to_static(check=True) lints one module
+    once per wrapped function — the parse (the dominant cost) is shared.
+    The tree is never mutated by the lint, so sharing is safe."""
+    key = (path, hash(source))
+    tree = _parse_cache.get(key)
+    if tree is None:
+        tree = ast.parse(source)
+        if len(_parse_cache) >= 64:
+            _parse_cache.clear()
+        _parse_cache[key] = tree
+    return tree
+
+
+def lint_source(path, source, rule_sets, base=None, select_roots=None):
+    """Run `rule_sets` (callables: (index, reached) -> [Finding]) over one
+    file's source. Returns suppression-filtered findings.
+    `select_roots(index)` overrides the default entry set (used by the
+    `to_static(check=True)` hook to lint one function's reach)."""
+    try:
+        tree = _parse_cached(path, source)
+    except SyntaxError as e:
+        return [Finding(path=rel_path(path, base), line=e.lineno or 1,
+                        col=e.offset or 0, code="TL000",
+                        message=f"syntax error: {e.msg}")]
+    sup, skip = parse_suppressions(source)
+    if skip:
+        return []
+    index = ModuleIndex(rel_path(path, base), source, tree)
+    # partial: linting one explicit root (to_static(check=True)) rather
+    # than the whole file — module-wide rules narrow their scope then
+    index.partial = select_roots is not None
+    roots = select_roots(index) if select_roots is not None else None
+    reached = index.reachable(roots)
+    findings = []
+    for rs in rule_sets:
+        findings.extend(rs(index, reached))
+    out = []
+    for f in findings:
+        codes = sup.get(f.line, ())
+        if "ALL" in codes or f.code in codes:
+            continue
+        if 1 <= f.line <= len(index.lines):
+            f.source_line = index.lines[f.line - 1].strip()
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return out

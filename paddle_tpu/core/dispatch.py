@@ -151,6 +151,32 @@ def apply(fn, *args, **kwargs):
     return out
 
 
+# ------------------------------------------------------------------------
+# dtype-promotion metadata — queried by the tracelint jaxpr pass
+# (paddle_tpu/analysis/jaxpr_rules.py, rule TL401).  Ops that widen past
+# the default float ON PURPOSE (wide accumulations, float64 losses in
+# eval-only paths) register their primitive/op name once here and stay
+# unflagged everywhere the linter runs.
+_WIDE_DTYPE_ALLOWED_OPS: set = set()
+
+
+def allow_wide_dtype(op_name):
+    """Mark `op_name` (a jaxpr primitive or op fn name) as intentionally
+    producing float64/complex128; tracelint TL401 skips it."""
+    _WIDE_DTYPE_ALLOWED_OPS.add(op_name)
+    return op_name
+
+
+def wide_dtype_allowed_ops():
+    return frozenset(_WIDE_DTYPE_ALLOWED_OPS)
+
+
+def default_float_dtype():
+    """The framework-wide default float: float64 only when the user
+    enabled jax x64 — then TL401 widening findings are suppressed."""
+    return "float64" if jax.config.jax_enable_x64 else "float32"
+
+
 def unwrap(x):
     """Tensor -> jax array (pass through others, recursively on lists/tuples)."""
     if isinstance(x, Tensor):

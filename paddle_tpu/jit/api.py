@@ -102,8 +102,15 @@ class StaticFunction:
     """Callable wrapper produced by @to_static."""
 
     def __init__(self, function, input_spec=None, build_strategy=None,
-                 backend=None, donate_state=True):
+                 backend=None, donate_state=True, check=False):
         self._raw_function = function
+        # opt-in tracelint (analysis/): AST pass now, jaxpr pass at the
+        # first compile of each signature — findings surface as
+        # TracelintWarning instead of opaque trace-time errors
+        self._check = bool(check)
+        if self._check:
+            from paddle_tpu import analysis
+            analysis.warn_findings(analysis.lint_callable(function))
         # Dy2Static AST pass (jit/dy2static.py): tensor-dependent
         # if/while/for in the traced function (and, via convert_call, in
         # everything it calls) become select/lax.while_loop programs;
@@ -218,7 +225,16 @@ class StaticFunction:
                 # Discovery trace (no execution, nothing donated): lazily
                 # created state (optimizer accumulators, RNG key) registers
                 # during the trace; if that happened, retrace with it lifted.
-                jitted.lower(state_vals, tensor_vals)
+                if self._check:
+                    # trace() exposes the jaxpr for the post-trace lint
+                    # (TL4xx) at no extra cost vs the discovery lower()
+                    traced = jitted.trace(state_vals, tensor_vals)
+                    from paddle_tpu import analysis
+                    analysis.warn_findings(analysis.check_jaxpr(
+                        traced.jaxpr,
+                        where=f"<to_static {self.__name__}>"))
+                else:
+                    jitted.lower(state_vals, tensor_vals)
                 if fstate.registry_version() != reg_ver:
                     continue
                 self._compiled[key] = _CompiledEntry(
@@ -282,21 +298,26 @@ def _hashable(x):
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, **kwargs):
+              backend=None, check=False, **kwargs):
     """Decorator/wrapper: compile a dygraph function or Layer to one XLA program.
 
     Usage matches paddle.jit.to_static: bare decorator, decorator with
     input_spec, or `net = to_static(net)` on a Layer.
+
+    ``check=True`` opts into tracelint (paddle_tpu.analysis): an AST
+    pass over the function and its module-local reach at wrap time, and
+    a jaxpr pass after each first-compile — hazards are reported as
+    ``TracelintWarning`` with TLxxx codes and file:line.
     """
     from paddle_tpu.nn.layer.layers import Layer
 
     def wrap(fn):
         if isinstance(fn, Layer):
-            static = StaticFunction(fn.forward, input_spec)
+            static = StaticFunction(fn.forward, input_spec, check=check)
             fn.forward = static
             fn._static_forward = static
             return fn
-        return StaticFunction(fn, input_spec)
+        return StaticFunction(fn, input_spec, check=check)
 
     if function is not None:
         return wrap(function)

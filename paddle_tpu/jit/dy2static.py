@@ -90,6 +90,7 @@ __all__ = [
     "convert_logical_or",
     "convert_logical_not",
     "convert_to_static",
+    "guard_unconvertible",
     "UNDEF",
 ]
 
@@ -296,6 +297,22 @@ def convert_while(cond_fn, body_fn, get_args, set_args, maybe_temp=None):
     return get_args()
 
 
+def guard_unconvertible(value, code, filename, lineno):
+    """Runtime guard planted on loops LEFT PLAIN by the transformer
+    (return inside the body, `break` in a non-range `for`, loop `else:`).
+
+    Eagerly it is a transparent pass-through.  Under a trace it raises
+    the NAMED tracelint diagnostic (rule code + source line, wording
+    shared with `tools/tracelint.py` via `analysis/rules.py`) instead of
+    letting the loop condition die in an opaque jax concretization
+    error deep inside the tracer.
+    """
+    if _is_traced(value):
+        from paddle_tpu.analysis.rules import TraceHazardError
+        raise TraceHazardError(code, filename, lineno)
+    return value
+
+
 def _as_bool(v):
     """bool-coerce a possibly-python operand for a traced logical op."""
     return jnp.asarray(_unwrap(v)).astype(bool)
@@ -472,7 +489,8 @@ def _do_transform(fn):
     fdef.body = desugar.block(fdef.body)
 
     bound = _function_bound_names(fdef)
-    tr = _Transformer(bound)
+    tr = _Transformer(bound, src_info=(fn.__code__.co_filename,
+                                       fn.__code__.co_firstlineno))
     tr.changed = ret_changed[0] or desugar.changed
     # visit the BODY, not fdef itself — the transformer's
     # visit_FunctionDef is a no-descend guard for nested scopes
@@ -971,14 +989,28 @@ def _def(name, body, params=()):
 
 
 class _Transformer(ast.NodeTransformer):
-    def __init__(self, fn_bound_names):
+    def __init__(self, fn_bound_names, src_info=("<unknown>", 1)):
         self.bound = set(fn_bound_names)
         self.changed = False
         self.n = 0
+        self.src_file, self.src_base = src_info
 
     def _next(self):
         self.n += 1
         return self.n
+
+    def _guard(self, expr, code, node):
+        """Wrap a loop-header expression of a loop LEFT PLAIN so a traced
+        value raises the named tracelint diagnostic (rule `code`) with
+        the ORIGINAL file:line instead of a concretization error."""
+        self.changed = True
+        lineno = self.src_base + getattr(node, "lineno", 1) - 1
+        return ast.Call(
+            func=_ptd2s_attr("guard_unconvertible"),
+            args=[expr, ast.Constant(value=code),
+                  ast.Constant(value=self.src_file),
+                  ast.Constant(value=lineno)],
+            keywords=[])
 
     # -- do not descend into nested scopes --
     def visit_FunctionDef(self, node):
@@ -1100,10 +1132,16 @@ class _Transformer(ast.NodeTransformer):
     def visit_While(self, node):
         self.generic_visit(node)
         if node.orelse:
+            node.test = self._guard(node.test, "TL003", node)
             return node
-        if _contains(node.body, (ast.Return,)) or \
-                _contains(node.body, (ast.Break, ast.Continue),
-                          stop_at_loops=True):
+        if _contains(node.body, (ast.Return,)):
+            node.test = self._guard(node.test, "TL001", node)
+            return node
+        if _contains(node.body, (ast.Break, ast.Continue),
+                     stop_at_loops=True):
+            # break/continue the desugarer could not lift (e.g. mixed
+            # with a return elsewhere) — same unconvertible bucket
+            node.test = self._guard(node.test, "TL001", node)
             return node
         modified = sorted(_collect_bound(node.body))
         i = self._next()
@@ -1156,6 +1194,11 @@ class _Transformer(ast.NodeTransformer):
                 _contains(node.body, (ast.Break, ast.Continue),
                           stop_at_loops=True):
             self.generic_visit(node)
+            # range(tensor) on a plain-Python loop concretizes via
+            # __index__ — guard each range operand so a traced bound
+            # raises the named TL001 diagnostic instead
+            it = node.iter
+            it.args = [self._guard(a, "TL001", node) for a in it.args]
             return node
         i = self._next()
         # generated VARIABLES use a non-helper prefix so the while

@@ -1,0 +1,607 @@
+"""tracelint (paddle_tpu/analysis): one positive + one clean-negative
+case per rule code, the runtime named diagnostic, the to_static(check=)
+hook, and the self-lint gate over paddle_tpu/ + examples/.
+
+The AST-pass tests are pure stdlib (no trace); the jaxpr-pass tests
+build tiny jaxprs with jax.make_jaxpr; the gate test shells out to the
+CLI exactly as CI does.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import analysis
+from paddle_tpu.analysis import AST_RULE_SETS, lint_source
+from paddle_tpu.analysis import report
+
+pytestmark = pytest.mark.tracelint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def codes_of(src):
+    findings = lint_source("demo.py", textwrap.dedent(src), AST_RULE_SETS)
+    return [f.code for f in findings]
+
+
+# --------------------------------------------------------------- TL0xx
+def test_tl001_return_in_loop():
+    src = """
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def f(x):
+        while x > 0:
+            if x < 2:
+                return x
+            x = x - 1
+        return x
+    """
+    assert "TL001" in codes_of(src)
+
+
+def test_tl001_clean_loop():
+    src = """
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def f(x):
+        while x > 0:
+            x = x - 1
+        return x
+    """
+    assert codes_of(src) == []
+
+
+def test_tl002_break_in_nonrange_for():
+    src = """
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def f(xs):
+        for x in xs:
+            if x.sum() > 0:
+                break
+        return xs
+    """
+    assert "TL002" in codes_of(src)
+
+
+def test_tl002_clean_range_for_break():
+    src = """
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def f(x):
+        for i in range(10):
+            if i > 3:
+                break
+            x = x + i
+        return x
+    """
+    assert "TL002" not in codes_of(src)
+
+
+def test_tl003_loop_else():
+    src = """
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def f(x):
+        while x > 0:
+            x = x - 1
+        else:
+            x = x + 1
+        return x
+    """
+    assert "TL003" in codes_of(src)
+
+
+def test_tl003_clean():
+    src = """
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def f(x):
+        while x > 0:
+            x = x - 1
+        x = x + 1
+        return x
+    """
+    assert "TL003" not in codes_of(src)
+
+
+def test_tl004_generator_reached():
+    src = """
+    from paddle_tpu.jit import to_static
+
+    def gen(x):
+        yield x
+
+    @to_static
+    def f(x):
+        return list(gen(x))
+    """
+    assert "TL004" in codes_of(src)
+
+
+def test_tl004_clean():
+    src = """
+    from paddle_tpu.jit import to_static
+
+    def helper(x):
+        return x * 2
+
+    @to_static
+    def f(x):
+        return helper(x)
+    """
+    assert "TL004" not in codes_of(src)
+
+
+# --------------------------------------------------------------- TL1xx
+def test_tl101_numpy_call():
+    src = """
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def f(x):
+        return x.numpy()
+    """
+    assert "TL101" in codes_of(src)
+
+
+def test_tl101_clean_sum():
+    src = """
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def f(x):
+        return x.sum()
+    """
+    assert "TL101" not in codes_of(src)
+
+
+def test_tl102_float_of_tensor():
+    src = """
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def f(x):
+        return float(x.mean())
+    """
+    assert "TL102" in codes_of(src)
+
+
+def test_tl102_clean_float_of_shape():
+    src = """
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def f(x):
+        return float(x.shape[0])
+    """
+    assert "TL102" not in codes_of(src)
+
+
+def test_tl103_np_asarray_of_tensor():
+    src = """
+    import numpy as np
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def f(x):
+        return np.asarray(x)
+    """
+    assert "TL103" in codes_of(src)
+
+
+def test_tl103_clean_np_of_literal():
+    src = """
+    import numpy as np
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def f(x):
+        scale = np.asarray([1.0, 2.0])
+        return x
+    """
+    assert "TL103" not in codes_of(src)
+
+
+def test_tl104_print_of_tensor():
+    src = """
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def f(x):
+        print(x)
+        return x
+    """
+    assert "TL104" in codes_of(src)
+
+
+def test_tl104_clean_print_of_str():
+    src = """
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def f(x):
+        print("step done")
+        return x
+    """
+    assert "TL104" not in codes_of(src)
+
+
+def test_tl105_np_random():
+    src = """
+    import numpy as np
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def f(x):
+        noise = np.random.rand(4)
+        return x + noise
+    """
+    assert "TL105" in codes_of(src)
+
+
+def test_tl105_clean():
+    src = """
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def f(x):
+        return x * 2
+    """
+    assert "TL105" not in codes_of(src)
+
+
+def test_tl106_outer_append():
+    src = """
+    from paddle_tpu.jit import to_static
+
+    history = []
+
+    @to_static
+    def f(x):
+        history.append(x)
+        return x
+    """
+    assert "TL106" in codes_of(src)
+
+
+def test_tl106_clean_local_append():
+    src = """
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def f(x):
+        acc = []
+        acc.append(x)
+        return acc
+    """
+    assert "TL106" not in codes_of(src)
+
+
+# --------------------------------------------------------------- TL3xx
+def test_tl301_mutable_default():
+    src = """
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def f(x, cfg=[]):
+        return x
+    """
+    assert "TL301" in codes_of(src)
+
+
+def test_tl301_clean_tuple_default():
+    src = """
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def f(x, cfg=()):
+        return x
+    """
+    assert "TL301" not in codes_of(src)
+
+
+def test_tl302_to_static_in_loop():
+    src = """
+    from paddle_tpu.jit import to_static
+
+    def run(fns, x):
+        outs = []
+        for fn in fns:
+            outs.append(to_static(fn)(x))
+        return outs
+    """
+    assert "TL302" in codes_of(src)
+
+
+def test_tl302_clean_hoisted():
+    src = """
+    from paddle_tpu.jit import to_static
+
+    def run(fn, xs):
+        step = to_static(fn)
+        outs = []
+        for x in xs:
+            outs.append(step(x))
+        return outs
+    """
+    assert "TL302" not in codes_of(src)
+
+
+# --------------------------------------------------- suppression/baseline
+def test_suppression_comment():
+    src = """
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def f(x):
+        print(x)  # tracelint: disable=TL104
+        return x
+    """
+    assert codes_of(src) == []
+
+
+def test_baseline_roundtrip(tmp_path):
+    src = """
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def f(x):
+        print(x)
+        return x
+    """
+    findings = lint_source("demo.py", textwrap.dedent(src), AST_RULE_SETS)
+    assert findings
+    bl = tmp_path / "baseline.json"
+    report.write_baseline(findings, str(bl))
+    baseline = report.load_baseline(str(bl))
+    assert report.diff_vs_baseline(findings, baseline) == []
+    # a NEW finding (different source text) is not absorbed
+    src2 = src.replace("print(x)", "print(x * 3)")
+    findings2 = lint_source("demo.py", textwrap.dedent(src2), AST_RULE_SETS)
+    assert report.diff_vs_baseline(findings2, baseline) == findings2
+
+
+# --------------------------------------------------------------- TL4xx
+def test_tl401_f64_promotion():
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_enable_x64", True)
+    try:
+        jaxpr = jax.make_jaxpr(
+            lambda x: x.astype("float64") * 2.0)(jnp.ones(3, jnp.float32))
+    finally:
+        jax.config.update("jax_enable_x64", False)
+    codes = [f.code for f in analysis.check_jaxpr(jaxpr)]
+    assert "TL401" in codes
+
+
+def test_tl401_clean_f32_and_allowlist():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core import dispatch
+
+    jaxpr = jax.make_jaxpr(lambda x: x * 2.0)(jnp.ones(3, jnp.float32))
+    assert [f.code for f in analysis.check_jaxpr(jaxpr)] == []
+
+    # an allowlisted primitive is not flagged
+    jax.config.update("jax_enable_x64", True)
+    try:
+        wide = jax.make_jaxpr(
+            lambda x: x.astype("float64"))(jnp.ones(3, jnp.float32))
+    finally:
+        jax.config.update("jax_enable_x64", False)
+    assert any(f.code == "TL401" for f in analysis.check_jaxpr(wide))
+    dispatch.allow_wide_dtype("convert_element_type")
+    try:
+        assert not any(f.code == "TL401"
+                       for f in analysis.check_jaxpr(wide))
+    finally:
+        dispatch._WIDE_DTYPE_ALLOWED_OPS.discard("convert_element_type")
+
+
+def test_tl402_large_baked_constant():
+    import jax
+    import jax.numpy as jnp
+
+    big = jnp.ones((512, 1024), jnp.float32)  # 2 MiB
+    jaxpr = jax.make_jaxpr(lambda x: x + big)(jnp.ones((1,), jnp.float32))
+    codes = [f.code for f in analysis.check_jaxpr(jaxpr)]
+    assert "TL402" in codes
+
+
+def test_tl402_clean_small_constant():
+    import jax
+    import jax.numpy as jnp
+
+    small = jnp.ones((4,), jnp.float32)
+    jaxpr = jax.make_jaxpr(lambda x: x + small)(jnp.ones((4,), jnp.float32))
+    assert "TL402" not in [f.code for f in analysis.check_jaxpr(jaxpr)]
+
+
+def _psum_jaxpr(axis):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.make_jaxpr(
+        lambda x: jax.lax.psum(x, axis),
+        axis_env=[(axis, 2)])(jnp.ones(3, jnp.float32))
+
+
+def test_tl403_collective_without_mesh(monkeypatch):
+    from paddle_tpu.distributed import mesh as dmesh
+
+    monkeypatch.setattr(dmesh, "get_mesh", lambda: None)
+    codes = [f.code for f in analysis.check_jaxpr(_psum_jaxpr("mp"))]
+    assert "TL403" in codes
+
+
+def test_tl404_axis_name_mismatch(monkeypatch):
+    import types
+
+    from paddle_tpu.distributed import mesh as dmesh
+
+    fake = types.SimpleNamespace(axis_names=("dp",))
+    monkeypatch.setattr(dmesh, "get_mesh", lambda: fake)
+    codes = [f.code for f in analysis.check_jaxpr(_psum_jaxpr("mp"))]
+    assert "TL404" in codes
+
+
+def test_tl403_tl404_clean_with_matching_mesh(monkeypatch):
+    import types
+
+    from paddle_tpu.distributed import mesh as dmesh
+
+    fake = types.SimpleNamespace(axis_names=("mp", "dp"))
+    monkeypatch.setattr(dmesh, "get_mesh", lambda: fake)
+    codes = [f.code for f in analysis.check_jaxpr(_psum_jaxpr("mp"))]
+    assert "TL403" not in codes and "TL404" not in codes
+
+
+# ------------------------------------------------- runtime named diagnostic
+def _clip_with_return(m):
+    while m > 4.0:
+        if m < 8.0:
+            return m
+        m = m * 0.5
+    return m
+
+
+def test_runtime_named_diagnostic_tl001():
+    @paddle.jit.to_static
+    def traced(x):
+        return _clip_with_return(x.mean() * 100.0)
+
+    with pytest.raises(analysis.TraceHazardError) as ei:
+        traced(paddle.to_tensor(np.ones((4, 4), np.float32)))
+    assert ei.value.code == "TL001"
+    assert "TL001" in str(ei.value)
+    assert os.path.basename(__file__) in str(ei.value.filename)
+
+
+def test_runtime_guard_is_transparent_eagerly():
+    # same helper, Python-valued condition: runs fine, correct result
+    assert float(_clip_with_return(16.0)) in (4.0, 5.0, 6.0, 7.0, 8.0)
+
+
+# ------------------------------------------------------ to_static(check=)
+def _checked_step(x):
+    print(x)          # TL104
+    return x.numpy()  # TL101
+
+
+def _mutable_default_step(x, cfg=[]):  # noqa: B006 — deliberate TL301
+    return x
+
+
+def _unrelated_loop_wrapper(fns, x):
+    outs = []
+    for fn in fns:
+        outs.append(paddle.jit.to_static(fn)(x))  # TL302, not _checked_step's
+    return outs
+
+
+def test_lint_callable_marks_root_as_entry_tl301():
+    codes = [f.code for f in analysis.lint_callable(_mutable_default_step)]
+    assert "TL301" in codes
+
+
+def test_lint_callable_scoped_to_root_reach():
+    # TL302 lives in _unrelated_loop_wrapper; linting _checked_step's
+    # reach must not report it
+    codes = [f.code for f in analysis.lint_callable(_checked_step)]
+    assert "TL302" not in codes
+    # whole-file lint still sees it
+    codes = [f.code for f in analysis.lint_paths([__file__])]
+    assert "TL302" in codes
+
+
+def test_to_static_check_warns():
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        paddle.jit.to_static(_checked_step, check=True)
+    msgs = [str(w.message) for w in caught
+            if isinstance(w.message, analysis.TracelintWarning)]
+    assert any("TL101" in m for m in msgs)
+    assert any("TL104" in m for m in msgs)
+
+
+def test_to_static_check_jaxpr_pass_runs_clean():
+    net = paddle.nn.Linear(4, 2)
+
+    @paddle.jit.to_static
+    def fwd(x):
+        return net(x).sum()
+
+    fwd._check = True  # opt in the compile-time jaxpr pass
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        out = fwd(paddle.to_tensor(np.ones((2, 4), np.float32)))
+    assert np.isfinite(float(out.numpy()))
+    assert not [w for w in caught
+                if isinstance(w.message, analysis.TracelintWarning)]
+
+
+# ------------------------------------------------------------- self-lint
+def test_self_lint_gate():
+    """The CI gate: paddle_tpu/ and examples/ clean modulo the baseline."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tracelint.py"),
+         "--check", "paddle_tpu", "examples"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_demo_example_is_flagged_without_baseline():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tracelint.py"),
+         os.path.join("examples", "tracelint_demo.py")],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert "TL101" in proc.stdout
+    assert "examples/tracelint_demo.py:" in proc.stdout
+
+
+# ------------------------------------------------------- api_coverage CLI
+def test_api_coverage_regression_diff():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import api_coverage
+    finally:
+        sys.path.pop(0)
+    doc = {"namespaces": {"nn": {"missing_count": 5},
+                          "io": {"missing_count": 2}}}
+    base = {"namespaces": {"nn": {"missing_count": 5},
+                           "io": {"missing_count": 3}}}
+    assert api_coverage.diff_regressions(doc, base) == []
+    worse = {"namespaces": {"nn": {"missing_count": 6},
+                            "io": {"missing_count": 2}}}
+    regs = api_coverage.diff_regressions(worse, base)
+    assert regs == [("nn", 5, 6)]
+
+
+def test_api_coverage_json_schema():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import api_coverage
+    finally:
+        sys.path.pop(0)
+    doc = api_coverage.to_json_doc(
+        [("nn", 2, ["Foo", "Bar"], ""), ("<top>", 1, ["baz"], "")])
+    assert doc["total_missing"] == 3
+    assert doc["namespaces"]["nn"]["missing"] == ["Foo", "Bar"]
+    json.dumps(doc)  # machine-readable

@@ -8,11 +8,18 @@ checked with getattr walks.  Output: per-namespace missing-name lists,
 worst first.  Heuristic by design — used to aim work, not as a gate.
 
 Usage: python tools/api_coverage.py [--limit N] [--namespace paddle.nn]
+           [--json FILE|-] [--baseline FILE] [--write-baseline FILE]
+
+`--json` emits the machine-readable report alongside the text one so CI
+can diff coverage; `--baseline` compares against a previously-written
+JSON report and exits nonzero when any namespace regressed (more
+missing names than before).
 """
 from __future__ import annotations
 
 import argparse
 import ast
+import json
 import os
 import sys
 
@@ -72,21 +79,13 @@ def has_attr_path(obj, name):
     return getattr(obj, name, None) is not None
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--limit", type=int, default=25)
-    ap.add_argument("--namespace", default=None)
-    args = ap.parse_args()
-
+def collect():
+    """[(namespace, missing_count, missing_names, note)] sorted worst-first."""
     import paddle_tpu
 
     ref = walk_reference()
     rows = []
     for ns, names in sorted(ref.items()):
-        if args.namespace and not ("paddle." + ns).startswith(
-                args.namespace) and not (ns == "" and
-                                         args.namespace == "paddle"):
-            continue
         target = paddle_tpu
         ok = True
         for part in (ns.split(".") if ns else []):
@@ -95,13 +94,57 @@ def main():
                 ok = False
                 break
         if not ok:
-            rows.append((ns or "<top>", len(names), sorted(names)[:12],
+            rows.append((ns or "<top>", len(names), sorted(names),
                          "NAMESPACE MISSING"))
             continue
         missing = sorted(n for n in names if not has_attr_path(target, n))
         if missing:
-            rows.append((ns or "<top>", len(missing), missing[:12], ""))
-    rows.sort(key=lambda r: -r[1])
+            rows.append((ns or "<top>", len(missing), missing, ""))
+    rows.sort(key=lambda r: (-r[1], r[0]))
+    return rows
+
+
+def to_json_doc(rows):
+    return {
+        "version": 1,
+        "total_missing": sum(r[1] for r in rows),
+        "namespaces": {
+            ns: {"missing_count": n, "missing": names, "note": note}
+            for ns, n, names, note in rows
+        },
+    }
+
+
+def diff_regressions(doc, baseline):
+    """Namespaces whose missing_count grew vs `baseline` (same schema)."""
+    base_ns = baseline.get("namespaces", {})
+    regs = []
+    for ns, info in doc["namespaces"].items():
+        before = base_ns.get(ns, {}).get("missing_count", 0)
+        if info["missing_count"] > before:
+            regs.append((ns, before, info["missing_count"]))
+    return sorted(regs, key=lambda r: -(r[2] - r[1]))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--limit", type=int, default=25)
+    ap.add_argument("--namespace", default=None)
+    ap.add_argument("--json", metavar="FILE", default=None,
+                    help="write the machine-readable report ('-' = stdout)")
+    ap.add_argument("--baseline", metavar="FILE", default=None,
+                    help="previous --json report; exit 1 on any namespace "
+                         "regression")
+    ap.add_argument("--write-baseline", metavar="FILE", default=None,
+                    help="write the current report as the new baseline")
+    args = ap.parse_args()
+
+    all_rows = collect()
+    rows = all_rows
+    if args.namespace:
+        rows = [r for r in all_rows
+                if ("paddle." + ("" if r[0] == "<top>" else r[0]))
+                .startswith(args.namespace)]
     total_missing = sum(r[1] for r in rows)
     print(f"namespaces with gaps: {len(rows)}; total missing names: "
           f"{total_missing}\n")
@@ -109,6 +152,38 @@ def main():
         print(f"paddle.{ns:40s} {n:4d} missing {note}  e.g. "
               f"{', '.join(sample[:8])}")
 
+    # JSON / baseline / regression always cover the FULL surface —
+    # --namespace only narrows the text display, so a baseline written
+    # alongside a namespace filter cannot be silently truncated
+    doc = to_json_doc(all_rows)
+    for path in (args.json, args.write_baseline):
+        if not path:
+            continue
+        if path == "-":
+            json.dump(doc, sys.stdout, indent=1, sort_keys=True)
+            print()
+        else:
+            with open(path, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh, indent=1, sort_keys=True)
+                fh.write("\n")
+
+    if args.baseline:
+        try:
+            with open(args.baseline, encoding="utf-8") as fh:
+                baseline = json.load(fh)
+        except (OSError, ValueError) as e:
+            print(f"api_coverage: cannot read baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2
+        regs = diff_regressions(doc, baseline)
+        if regs:
+            print("\nCOVERAGE REGRESSIONS (missing-name count grew):")
+            for ns, before, now in regs:
+                print(f"  paddle.{ns}: {before} -> {now}")
+            return 1
+        print("\nno coverage regressions vs baseline")
+    return 0
+
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())
