@@ -45,6 +45,7 @@ PROBE_BUDGET_S = 60
 RESNET_TPU_S = 240
 BERT_TPU_S = 180
 ERNIE_TPU_S = 180
+SERVING_TPU_S = 150
 CPU_TIMEOUT_S = 150
 CAPTURE_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), ".bench_capture_tpu.json")
@@ -269,6 +270,81 @@ def _bench_ernie(on_tpu, batch_override=None):
 
     return _time_mlm(train_step, (ids, task_ids, labels), warmup, iters,
                      batch, seq, "ernie")
+
+
+def _bench_serving(on_tpu):
+    """Serving lane: continuous-batched generation through
+    paddle_tpu.serving.LLMEngine (paged KV cache, bucketed prefill, one
+    compiled decode step).  Reports decode tokens/s, time-to-first-token,
+    and p50/p99 inter-token latency from the engine's own metrics — the
+    same snapshot a production process exports via profiler
+    metrics_report()."""
+    import numpy as np
+
+    import paddle_tpu as P
+    from paddle_tpu import serving
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    if on_tpu:
+        mcfg = GPTConfig(vocab_size=32000, hidden_size=1024, num_layers=8,
+                         num_heads=16, max_seq_len=1024, dropout=0.0,
+                         attention_dropout=0.0)
+        ecfg = serving.EngineConfig(max_num_seqs=16, page_size=16,
+                                    max_model_len=512,
+                                    prefill_buckets=(64, 128, 256, 512))
+        n_req, max_new = 32, 64
+    else:
+        mcfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                         num_heads=4, max_seq_len=128, dropout=0.0,
+                         attention_dropout=0.0)
+        ecfg = serving.EngineConfig(max_num_seqs=4, page_size=8,
+                                    max_model_len=64,
+                                    prefill_buckets=(16, 32))
+        n_req, max_new = 8, 12
+
+    P.seed(0)
+    model = GPTForCausalLM(mcfg)
+    engine = serving.LLMEngine(model, ecfg)
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(
+        1, mcfg.vocab_size,
+        int(rng.integers(4, ecfg.prefill_buckets[-1] // 2))))
+        for _ in range(n_req)]
+    sps = [serving.SamplingParams(max_new_tokens=max_new, temperature=0.8,
+                                  top_p=0.95, seed=i)
+           for i in range(n_req)]
+    t0 = time.perf_counter()
+    results = engine.generate(prompts, sps)
+    wall = time.perf_counter() - t0
+    snap = engine.metrics.snapshot()
+    generated = sum(len(r.output_token_ids) for r in results)
+    out = {
+        "serving_tokens_s": round(generated / wall, 2),
+        "serving_requests": n_req,
+        "serving_batch": ecfg.max_num_seqs,
+        "serving_ttft_ms_p50": snap["ttft_ms"]["p50"],
+        "serving_ttft_ms_p99": snap["ttft_ms"]["p99"],
+        "serving_itl_ms_p50": snap["inter_token_ms"]["p50"],
+        "serving_itl_ms_p99": snap["inter_token_ms"]["p99"],
+        "serving_evictions": snap["requests"]["evicted"],
+        "serving_compiles": snap["compiles"]["count"],
+        "serving_compile_bound": snap["compiles"]["bound"],
+    }
+    engine.shutdown()
+    return out
+
+
+def worker_serving():
+    devices, on_tpu = _init_backend()
+    try:
+        out = _bench_serving(on_tpu)
+    except Exception:
+        if not on_tpu:
+            raise
+        return 1  # orchestrator falls back to the honest CPU run
+    out["serving_platform"] = devices[0].platform
+    print(json.dumps(out), flush=True)
+    return 0
 
 
 def _init_backend():
@@ -548,6 +624,8 @@ def main():
         return worker_bert()
     if "--worker-ernie" in sys.argv:
         return worker_ernie()
+    if "--worker-serving" in sys.argv:
+        return worker_serving()
     if "--probe" in sys.argv:
         return probe()
 
@@ -610,13 +688,25 @@ def main():
     if tpu_ok and resnet_on_tpu and bert_on_tpu and not bert_exited:
         errors.append("ernie tpu: skipped (abandoned bert worker may "
                       "still hold the claim)")
-    ernie_on_tpu, _ = _run_phase(
+    ernie_on_tpu, ernie_exited = _run_phase(
         "--worker-ernie",
         tpu_ok and resnet_on_tpu and bert_on_tpu and bert_exited,
         ERNIE_TPU_S, merged, errors, run_cpu)
     ernie_good = (ernie_on_tpu and merged.get("ernie_platform") == "tpu"
                   and "ernie_tokens_s" in merged)
     if resnet_on_tpu and bert_good and ernie_good:
+        _append_notes(dict(merged), truncate_to=partial_pos)
+        _save_capture(merged)
+
+    # serving lane (continuous-batching LLMEngine): TPU when the chain of
+    # prior workers exited cleanly, else honest CPU numbers
+    serving_on_tpu, _ = _run_phase(
+        "--worker-serving",
+        tpu_ok and resnet_on_tpu and bert_on_tpu and ernie_on_tpu
+        and ernie_exited,
+        SERVING_TPU_S, merged, errors, run_cpu)
+    if (resnet_on_tpu and bert_good and ernie_good and serving_on_tpu
+            and merged.get("serving_platform") != "cpu"):
         _append_notes(dict(merged), truncate_to=partial_pos)
         _save_capture(merged)
 

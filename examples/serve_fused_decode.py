@@ -1,137 +1,76 @@
-"""Serving-path walkthrough: fused transformer stack, KV-cache decode
-with CONTINUATION BATCHING (ragged per-sequence positions), and
-tensor-parallel weight sharding over a mesh.
+"""Serving-path walkthrough, rebuilt on `paddle_tpu.serving.LLMEngine`.
+
+The old version of this example hand-rolled the serving loop: manual
+prefill masks, a python decode loop appending at per-row lengths, argmax
+on host.  All of that is now the engine's job — this file shows the same
+mixed-length continuation-batched decode driven through the real
+subsystem: paged KV cache, bucketed prefill (bounded compiles), one
+compiled decode step, per-request sampling.
 
 Usage:
-  python examples/serve_fused_decode.py                      # 1 device
-  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \\
-      python examples/serve_fused_decode.py --mp 4           # mp mesh
+  JAX_PLATFORMS=cpu python examples/serve_fused_decode.py
+  python examples/serve_fused_decode.py --steps 24 --temperature 0.8
 
-Covers: incubate.nn.functional.fused_multi_transformer (the N-layer
-serving stack as ONE op; static KV caches; prefill + ragged decode),
-GSPMD weight sharding (Megatron column/row layouts — the same specs
-HybridParallelInferenceHelper applies to Layers).
+See examples/serve_continuous_batching.py for requests ARRIVING while
+the batch decodes (admission at step boundaries + streaming callbacks).
 """
 import argparse
 
 import numpy as np
 
 import paddle_tpu as paddle
-import paddle_tpu.incubate.nn.functional as IF
+from paddle_tpu import serving
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
 
-B, S_MAX, E, N_HEAD, HD, L = 4, 64, 128, 8, 16, 4
-FFN = 4 * E
 VOCAB = 97
-
-
-def make_weights(rng):
-    def mk(shape, s=0.06):
-        return rng.standard_normal(shape).astype(np.float32) * s
-
-    return dict(
-        emb=mk((VOCAB, E), 0.1),
-        ln_s=[np.ones(E, np.float32)] * L,
-        ln_b=[np.zeros(E, np.float32)] * L,
-        qkvw=[mk((3, N_HEAD, HD, E)) for _ in range(L)],
-        qkvb=[mk((3, N_HEAD, HD)) for _ in range(L)],
-        lw=[mk((N_HEAD * HD, E)) for _ in range(L)],
-        lb=[mk((E,)) for _ in range(L)],
-        fln_s=[np.ones(E, np.float32)] * L,
-        fln_b=[np.zeros(E, np.float32)] * L,
-        w1=[mk((E, FFN)) for _ in range(L)],
-        b1=[mk((FFN,)) for _ in range(L)],
-        w2=[mk((FFN, E)) for _ in range(L)],
-        b2=[mk((E,)) for _ in range(L)],
-        head=mk((E, VOCAB), 0.1),
-    )
-
-
-def shard_weights(w, mp):
-    """Megatron layouts over an mp mesh — GSPMD inserts the collectives."""
-    if mp <= 1:
-        return w, None
-    import jax
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-    mesh = Mesh(np.array(jax.devices()[:mp]), ("mp",))
-
-    def put(a, spec):
-        return jax.device_put(np.asarray(a), NamedSharding(mesh, spec))
-
-    w = dict(w)
-    w["qkvw"] = [put(a, P(None, "mp", None, None)) for a in w["qkvw"]]
-    w["qkvb"] = [put(a, P(None, "mp", None)) for a in w["qkvb"]]
-    w["lw"] = [put(a, P("mp", None)) for a in w["lw"]]
-    w["w1"] = [put(a, P(None, "mp")) for a in w["w1"]]
-    w["b1"] = [put(a, P("mp")) for a in w["b1"]]
-    w["w2"] = [put(a, P("mp", None)) for a in w["w2"]]
-    return w, mesh
-
-
-def stack(w, x, caches=None, time_step=None, mask=None):
-    return IF.fused_multi_transformer(
-        x, w["ln_s"], w["ln_b"], w["qkvw"], w["qkvb"], w["lw"], w["lb"],
-        w["fln_s"], w["fln_b"], w["w1"], w["b1"], w["w2"], w["b2"],
-        attn_mask=mask, cache_kvs=caches, time_step=time_step)
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--mp", type=int, default=1)
-    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--steps", type=int, default=12,
+                    help="tokens to generate per request")
+    ap.add_argument("--temperature", type=float, default=0.0,
+                    help="0 = greedy (the old example's argmax)")
     args = ap.parse_args()
 
-    rng = np.random.default_rng(0)
-    w, mesh = shard_weights(make_weights(rng), args.mp)
-    print(f"fused stack: {L} layers, {N_HEAD} heads, mp={args.mp}")
+    paddle.seed(0)
+    model = GPTForCausalLM(GPTConfig(
+        vocab_size=VOCAB, hidden_size=128, num_layers=4, num_heads=8,
+        max_seq_len=128, dropout=0.0, attention_dropout=0.0))
+
+    cfg = serving.EngineConfig(max_num_seqs=4, page_size=8,
+                               max_model_len=64,
+                               prefill_buckets=(16, 32))
+    engine = serving.LLMEngine(model, cfg)
+    print(f"engine: {cfg.max_num_seqs} slots, page={cfg.page_size}, "
+          f"buckets={cfg.prefill_buckets}, "
+          f"compile bound={cfg.compile_bound}")
 
     # mixed-length prompts: continuation batching from the first step
-    prompt_lens = np.array([3, 7, 5, 9], np.int32)
-    ids = rng.integers(1, VOCAB, (B, S_MAX))
-    for b in range(B):
-        ids[b, prompt_lens[b]:] = 0
+    rng = np.random.default_rng(0)
+    prompt_lens = [3, 7, 5, 9]
+    prompts = [list(rng.integers(1, VOCAB, n)) for n in prompt_lens]
+    sps = [serving.SamplingParams(max_new_tokens=args.steps,
+                                  temperature=args.temperature, seed=i)
+           for i in range(len(prompts))]
 
-    emb = w["emb"]
-    caches = [paddle.to_tensor(
-        np.zeros((2, B, N_HEAD, S_MAX, HD), np.float32))
-        for _ in range(L)]
-
-    # ---- prefill: run the longest prompt length once; per-row causal +
-    # padding mask keeps short rows clean ----
-    s0 = int(prompt_lens.max())
-    x = paddle.to_tensor(emb[ids[:, :s0]])
-    causal = np.tril(np.ones((s0, s0), np.float32))
-    pad = (np.arange(s0)[None, :] < prompt_lens[:, None]).astype(np.float32)
-    mask = np.where(causal[None, None] * pad[:, None, None, :] > 0,
-                    0.0, -1e9).astype(np.float32)
-    h, caches = stack(w, x, caches=caches, mask=paddle.to_tensor(mask))
-    print(f"prefill: {s0} steps, caches primed at per-row lengths "
-          f"{prompt_lens.tolist()}")
-
-    # last REAL token's hidden state per row seeds generation
-    h_np = h.numpy()
-    last = h_np[np.arange(B), prompt_lens - 1]
-    tok = np.argmax(last @ np.asarray(w["head"]), axis=-1)
-
-    # ---- ragged decode: every row appends at ITS OWN length ----
-    lens = prompt_lens.copy()
-    outputs = [[] for _ in range(B)]
-    for step in range(args.steps):
-        x_t = paddle.to_tensor(emb[tok][:, None, :])
-        h, caches = stack(w, x_t, caches=caches,
-                          time_step=paddle.to_tensor(lens))
-        logits = h.numpy()[:, 0] @ np.asarray(w["head"])
-        tok = np.argmax(logits, axis=-1)
-        for b in range(B):
-            outputs[b].append(int(tok[b]))
-        lens = lens + 1
+    results = engine.generate(prompts, sps)
+    print(f"prefill: {len(prompts)} requests bucketed over "
+          f"{sorted(set(engine.scheduler.bucket_for_len(n) for n in prompt_lens))}")
     print("ragged decode:", args.steps, "steps")
-    for b in range(B):
-        print(f"  row {b} (prompt {prompt_lens[b]:2d} tokens) -> "
-              f"{outputs[b][:8]}…")
-    assert all(len(o) == args.steps for o in outputs)
-    print("OK: mixed-length batch served with one static-shape program "
-          "per phase (no re-padding between steps)")
+    for i, r in enumerate(results):
+        print(f"  row {i} (prompt {prompt_lens[i]:2d} tokens) -> "
+              f"{r.output_token_ids[:8]}…")
+
+    snap = engine.metrics.snapshot()
+    assert all(len(r.output_token_ids) == args.steps for r in results)
+    assert snap["compiles"]["count"] <= snap["compiles"]["bound"]
+    print(f"OK: mixed-length batch served with "
+          f"{snap['compiles']['count']} compiled programs "
+          f"(bound {snap['compiles']['bound']}); "
+          f"{snap['tokens']['per_s']} tok/s, "
+          f"ttft p50 {snap['ttft_ms']['p50']} ms")
+    engine.shutdown()
 
 
 if __name__ == "__main__":

@@ -308,7 +308,9 @@ def __getattr__(name):
     # google.protobuf, which only ONNX exporters should have to carry.
     # paddle_tpu.analysis (tracelint) loads lazily too: it is pure
     # stdlib and the CLI imports it without this package __init__.
-    if name in ("onnx", "analysis"):
+    # paddle_tpu.serving lazily as well: the engine compiles nothing at
+    # import time, but serving is an opt-in subsystem like onnx export.
+    if name in ("onnx", "analysis", "serving"):
         import importlib
         return importlib.import_module(f"paddle_tpu.{name}")
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

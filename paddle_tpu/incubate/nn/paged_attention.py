@@ -20,6 +20,18 @@ Decode writes each sequence's new token into page
 then attends over the sequence's gathered pages with a length mask.
 Everything jits; the tape differentiates through the gathers if ever
 needed (serving is no_grad).
+
+Three layers of API, outermost first:
+
+- :class:`PagedKVCache` — stateful single-layer cache (Tensor pools +
+  embedded allocator), the standalone/demo surface.
+- :class:`PageAllocator` — the HOST-side page bookkeeping alone
+  (free list, per-slot ownership, leak guards). `paddle_tpu.serving`'s
+  engine uses one allocator across all transformer layers while the
+  device pools live as per-layer jnp arrays inside its compiled steps.
+- pure jnp step functions (:func:`paged_decode_step`,
+  :func:`paged_prefill_append`, :func:`paged_attend`) — trace-safe
+  building blocks usable inside any jit/to_static program.
 """
 from __future__ import annotations
 
@@ -31,7 +43,108 @@ import jax.numpy as jnp
 from paddle_tpu.core.dispatch import apply, unwrap
 from paddle_tpu.core.tensor import Tensor
 
-__all__ = ["PagedKVCache", "paged_attention_decode"]
+__all__ = [
+    "PageAllocator",
+    "PagedKVCache",
+    "paged_attend",
+    "paged_attention_decode",
+    "paged_decode_step",
+    "paged_prefill_append",
+]
+
+
+class PageAllocator:
+    """Host-side page bookkeeping for a shared pool.
+
+    Page 0 is the reserved GARBAGE page: released slots' block tables
+    point at it, so a batch-wide append from an inactive row scatters
+    into page 0 and can never corrupt a live sequence.  The allocator
+    therefore hands out pages ``1 .. num_pages-1``.
+
+    Invariant (the "no leak" contract): every page is either in the
+    free list or owned by exactly one slot.  ``release`` is idempotent
+    and guards against double-frees — an eviction mid-decode must
+    restore the free list exactly.
+    """
+
+    def __init__(self, num_pages, batch, max_pages_per_seq):
+        if num_pages < 2:
+            raise ValueError("need >= 2 pages (page 0 is reserved)")
+        self.num_pages = int(num_pages)
+        self.batch = int(batch)
+        self.max_pages_per_seq = int(max_pages_per_seq)
+        self._free = list(range(num_pages - 1, 0, -1))
+        self._free_set = set(self._free)
+        self._owned = [[] for _ in range(batch)]
+
+    @property
+    def num_free_pages(self):
+        return len(self._free)
+
+    def owned_pages(self, b):
+        return list(self._owned[b])
+
+    def pages_needed(self, n_tokens, page_size):
+        return -(-int(n_tokens) // int(page_size))
+
+    def can_allocate(self, b, need):
+        """Can slot `b` grow to `need` pages right now?"""
+        have = len(self._owned[b])
+        if need <= have:
+            return True
+        if need > self.max_pages_per_seq:
+            return False
+        return need - have <= len(self._free)
+
+    def allocate(self, b, need):
+        """Grow slot `b` to `need` pages; returns [(slot_idx, page_id)]
+        newly assigned entries for the caller's block-table update."""
+        have = len(self._owned[b])
+        if need <= have:
+            return []
+        if need > self.max_pages_per_seq:
+            raise ValueError(
+                f"sequence {b} needs {need} pages but max_pages_per_seq "
+                f"is {self.max_pages_per_seq}")
+        if need - have > len(self._free):
+            raise RuntimeError("paged KV cache: out of pages")
+        assigned = []
+        while len(self._owned[b]) < need:
+            pg = self._free.pop()
+            self._free_set.discard(pg)
+            assigned.append((len(self._owned[b]), pg))
+            self._owned[b].append(pg)
+        return assigned
+
+    def release(self, b):
+        """Return slot `b`'s pages to the pool; returns the freed page
+        ids.  Idempotent; raises on a double-free (a page already in the
+        free list means the bookkeeping leaked somewhere)."""
+        pages = self._owned[b]
+        if not pages:
+            return []
+        dupes = [p for p in pages if p in self._free_set]
+        if dupes:
+            raise RuntimeError(
+                f"paged KV cache: double-free of page(s) {dupes} "
+                f"releasing slot {b}")
+        self._free.extend(reversed(pages))
+        self._free_set.update(pages)
+        self._owned[b] = []
+        return pages
+
+    def check_invariant(self):
+        """All pages accounted for exactly once (free or owned)."""
+        owned = [p for o in self._owned for p in o]
+        if len(set(owned)) != len(owned):
+            raise RuntimeError("paged KV cache: page owned twice")
+        if set(owned) & self._free_set:
+            raise RuntimeError("paged KV cache: page both owned and free")
+        if len(owned) + len(self._free) != self.num_pages - 1:
+            raise RuntimeError(
+                f"paged KV cache: leak — {len(owned)} owned + "
+                f"{len(self._free)} free != {self.num_pages - 1}")
+        return True
 
 
 class PagedKVCache:
@@ -51,39 +164,43 @@ class PagedKVCache:
         self.block_tables = Tensor(jnp.zeros(
             (batch, max_pages_per_seq), jnp.int32))
         self.seq_lens = Tensor(jnp.zeros((batch,), jnp.int32))
-        # page 0 is the reserved GARBAGE page: released rows' block
-        # tables point at it, so a batch-wide append from a finished row
-        # scatters into page 0 and can never corrupt a live sequence
-        self._free = list(range(num_pages - 1, 0, -1))
-        self._owned = [[] for _ in range(batch)]
+        self._alloc = PageAllocator(num_pages, batch, max_pages_per_seq)
         self.max_pages_per_seq = int(max_pages_per_seq)
+
+    @property
+    def num_free_pages(self):
+        return self._alloc.num_free_pages
+
+    def owned_pages(self, b):
+        return self._alloc.owned_pages(b)
 
     # ---- host-side page allocator (the serving loop's bookkeeping) ----
     def ensure_capacity(self, b, new_len):
-        """Allocate pages so sequence `b` can hold `new_len` tokens."""
-        need = -(-int(new_len) // self.page_size)
-        if len(self._owned[b]) >= need:
-            return                      # common case: no transfer at all
-        if need > self.max_pages_per_seq:
-            raise ValueError(
-                f"sequence {b} needs {need} pages but max_pages_per_seq "
-                f"is {self.max_pages_per_seq}")
-        if need - len(self._owned[b]) > len(self._free):
-            raise RuntimeError("paged KV cache: out of pages")
-        tbl = np.array(unwrap(self.block_tables))  # writable host copy
-        while len(self._owned[b]) < need:
-            pg = self._free.pop()
-            slot = len(self._owned[b])
-            self._owned[b].append(pg)
-            tbl[b, slot] = pg
-        self.block_tables._set_value(jnp.asarray(tbl))
+        """Allocate pages so sequence `b` can hold `new_len` tokens.
+
+        A slot growing from zero owned pages is a FRESH sequence: its
+        device seq_len is reset to 0 so a reused slot can never write
+        its first token at a stale offset (the mid-decode-eviction bug:
+        released rows used to keep advancing batch-wide)."""
+        need = self._alloc.pages_needed(new_len, self.page_size)
+        fresh = not self._alloc.owned_pages(b) and need > 0
+        assigned = self._alloc.allocate(b, need)
+        if assigned:
+            tbl = np.array(unwrap(self.block_tables))  # writable host copy
+            for slot, pg in assigned:
+                tbl[b, slot] = pg
+            self.block_tables._set_value(jnp.asarray(tbl))
+        if fresh:
+            lens = np.asarray(unwrap(self.seq_lens)).copy()
+            lens[b] = 0
+            self.seq_lens._set_value(jnp.asarray(lens))
 
     def release(self, b):
-        """Finished sequence: its pages return to the pool; its block
-        table resets to the garbage page so further batch-wide appends
-        from this row are harmlessly absorbed."""
-        self._free.extend(reversed(self._owned[b]))
-        self._owned[b] = []
+        """Finished/evicted sequence: its pages return to the pool; its
+        block table resets to the garbage page so further batch-wide
+        appends from this row are harmlessly absorbed.  Idempotent, and
+        double-frees raise instead of silently growing the pool."""
+        self._alloc.release(b)
         tbl = np.array(unwrap(self.block_tables))
         tbl[b, :] = 0
         self.block_tables._set_value(jnp.asarray(tbl))
@@ -91,27 +208,68 @@ class PagedKVCache:
         lens[b] = 0
         self.seq_lens._set_value(jnp.asarray(lens))
 
-    def append_and_attend(self, q, k_new, v_new, scale=None):
+    def check_invariant(self):
+        return self._alloc.check_invariant()
+
+    def _active_mask(self):
+        """Rows that own pages are live; released rows must not advance
+        their device seq_lens (they'd corrupt the slot on reuse)."""
+        return np.array([bool(self._alloc.owned_pages(b))
+                         for b in range(len(self._alloc._owned))])
+
+    def append_and_attend(self, q, k_new, v_new, scale=None, active=None):
         """One decode step for every sequence: write each row's new
         token at its own position, return attention over its pages.
 
-        q/k_new/v_new: [batch, n_head, 1, head_dim].
+        q/k_new/v_new: [batch, n_head, 1, head_dim].  `active` ([batch]
+        bool, default: rows owning pages) masks which rows' seq_lens
+        advance — inactive rows scatter into the garbage page and stay
+        put, so an evicted slot is bit-exactly fresh when reused.
         """
+        if active is None:
+            active = self._active_mask()
+        active = jnp.asarray(np.asarray(active), jnp.bool_)
         out, kp, vp, lens = apply(
-            lambda qv, kv, vv, kpg, vpg, tbl, ln: _paged_step(
-                qv, kv, vv, kpg, vpg, tbl, ln, self.page_size, scale),
+            lambda qv, kv, vv, kpg, vpg, tbl, ln, act: _paged_step(
+                qv, kv, vv, kpg, vpg, tbl, ln, act, self.page_size, scale),
             q, k_new, v_new, self.k_pages, self.v_pages,
-            self.block_tables, self.seq_lens)
+            self.block_tables, self.seq_lens, active)
         self.k_pages._set_value(kp._value)
         self.v_pages._set_value(vp._value)
         self.seq_lens._set_value(lens._value)
         return out
 
+    def append_prefill(self, k_new, v_new, lens):
+        """Batched multi-sequence prompt write: scatter each row's first
+        ``lens[b]`` tokens into its pages (token t of row b lands in
+        page ``table[b, t // page]`` at offset ``t % page``).  Callers
+        must have ``ensure_capacity(b, lens[b])``-ed every row first.
 
-def _attend_pages(q, k_pages, v_pages, tables, lens, page_size, scale):
+        k_new/v_new: [batch, n_head, S, head_dim]; lens: [batch] int.
+        Positions >= lens[b] (padding) are directed to the garbage page.
+
+        Rows NOT being prefilled must pass ``lens[b] == 0``: they
+        scatter nothing and their existing device seq_len is preserved
+        (lens are MERGED, not overwritten), so a partial-batch prefill
+        cannot reset or corrupt rows that are mid-decode.
+        """
+        lens = jnp.asarray(np.asarray(lens), jnp.int32)
+        kp, vp = apply(
+            lambda kv, vv, kpg, vpg, tbl, ln: paged_prefill_append(
+                kv, vv, kpg, vpg, tbl, ln, self.page_size),
+            k_new, v_new, self.k_pages, self.v_pages,
+            self.block_tables, lens)
+        self.k_pages._set_value(kp._value)
+        self.v_pages._set_value(vp._value)
+        merged = jnp.where(lens > 0, lens,
+                           unwrap(self.seq_lens).astype(jnp.int32))
+        self.seq_lens._set_value(merged)
+
+
+def paged_attend(q, k_pages, v_pages, tables, lens, page_size, scale=None):
     """Shared attention core: [b, h, 1, d] queries over each row's
-    gathered pages, masked at `lens` — used by both the stateful step
-    and the functional read-only decode."""
+    gathered pages, masked at `lens` — used by the stateful step, the
+    functional read-only decode, and serving's compiled decode step."""
     b, h, one, d = q.shape
     sc = scale if scale is not None else 1.0 / float(d) ** 0.5
     k_seq = k_pages[tables]                               # [b, P, h, p, d]
@@ -128,8 +286,17 @@ def _attend_pages(q, k_pages, v_pages, tables, lens, page_size, scale):
     return p @ v_seq                                      # [b, h, 1, d]
 
 
-def _paged_step(q, k_new, v_new, k_pages, v_pages, tables, lens,
-                page_size, scale):
+_attend_pages = paged_attend  # back-compat alias (pre-serving name)
+
+
+def paged_decode_step(q, k_new, v_new, k_pages, v_pages, tables, lens,
+                      page_size, scale=None):
+    """Pure decode step WITHOUT length bookkeeping: write each row's new
+    token at position ``lens[b]``, attend over ``lens[b]+1`` tokens.
+    Returns (out, k_pages, v_pages); the caller owns the lens update —
+    a multi-layer engine calls this once per layer with the SAME lens
+    and advances lens once per step.
+    """
     lens = lens.astype(jnp.int32)
     page_idx = lens // page_size
     offs = lens % page_size
@@ -140,10 +307,44 @@ def _paged_step(q, k_new, v_new, k_pages, v_pages, tables, lens,
     vt = jnp.swapaxes(v_new, 1, 2)[:, 0]
     k_pages = k_pages.at[page_ids, :, offs].set(kt)
     v_pages = v_pages.at[page_ids, :, offs].set(vt)
-    new_lens = lens + 1
-    out = _attend_pages(q, k_pages, v_pages, tables, new_lens,
-                        page_size, scale)
+    out = paged_attend(q, k_pages, v_pages, tables, lens + 1,
+                       page_size, scale)
+    return out, k_pages, v_pages
+
+
+def _paged_step(q, k_new, v_new, k_pages, v_pages, tables, lens, active,
+                page_size, scale):
+    out, k_pages, v_pages = paged_decode_step(
+        q, k_new, v_new, k_pages, v_pages, tables, lens, page_size, scale)
+    new_lens = lens.astype(jnp.int32) + active.astype(jnp.int32)
     return out, k_pages, v_pages, new_lens
+
+
+def paged_prefill_append(k_new, v_new, k_pages, v_pages, tables, lens,
+                         page_size):
+    """Batched multi-sequence prompt scatter (pure): token t of row b
+    lands in page ``tables[b, t // page_size]`` at offset
+    ``t % page_size``; positions >= lens[b] go to the garbage page 0.
+
+    k_new/v_new: [b, h, S, d].  Returns (k_pages, v_pages).
+    """
+    b, h, S, d = k_new.shape
+    t = jnp.arange(S, dtype=jnp.int32)
+    page_idx = t // page_size                              # [S]
+    offs = t % page_size                                   # [S]
+    # clamp in case S spans more pages than the table width — the
+    # valid-mask below routes those to garbage anyway
+    page_idx = jnp.minimum(page_idx, tables.shape[1] - 1)
+    page_ids = tables[:, page_idx]                         # [b, S]
+    valid = t[None, :] < lens[:, None].astype(jnp.int32)
+    page_ids = jnp.where(valid, page_ids, 0)
+    flat_pages = page_ids.reshape(-1)                      # [b*S]
+    flat_offs = jnp.tile(offs, b)
+    kt = jnp.swapaxes(k_new, 1, 2).reshape(b * S, h, d)    # [b*S, h, d]
+    vt = jnp.swapaxes(v_new, 1, 2).reshape(b * S, h, d)
+    k_pages = k_pages.at[flat_pages, :, flat_offs].set(kt)
+    v_pages = v_pages.at[flat_pages, :, flat_offs].set(vt)
+    return k_pages, v_pages
 
 
 def paged_attention_decode(q, k_pages, v_pages, block_tables, seq_lens,
@@ -151,6 +352,6 @@ def paged_attention_decode(q, k_pages, v_pages, block_tables, seq_lens,
     """Functional read-only form: attention of [b, h, 1, d] queries over
     already-written pages (positions < seq_lens)."""
     return apply(
-        lambda qv, kpg, vpg, tbl, ln: _attend_pages(
+        lambda qv, kpg, vpg, tbl, ln: paged_attend(
             qv, kpg, vpg, tbl, ln, page_size, scale),
         q, k_pages, v_pages, block_tables, seq_lens)

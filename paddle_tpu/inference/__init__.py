@@ -195,6 +195,35 @@ class Predictor:
             return [self._inputs[n] for n in names]
         return [self._inputs[k] for k in sorted(self._inputs)]
 
+    def serve(self, serving_config=None, **config_kw):
+        """Serving adapter: lift the attached decoder Layer into a
+        `paddle_tpu.serving.LLMEngine` (continuous batching, paged KV
+        cache, bounded-recompile shape bucketing).
+
+        The layer must follow the cache-aware forward contract
+        (``model(input_ids, position_ids=..., kv_ctx=...)``; see
+        `paddle_tpu.serving.LLMEngine` and `models/gpt.py`).  Config via
+        a ready `serving.EngineConfig` or keyword args for one::
+
+            config = inference.Config()
+            config.set_layer(GPTForCausalLM(gpt3_tiny()))
+            engine = inference.create_predictor(config).serve(
+                max_num_seqs=8, max_model_len=256)
+            engine.generate(prompts, sampling_params)
+        """
+        if self._model is None:
+            raise RuntimeError(
+                "Predictor.serve() needs a live Layer — use "
+                "Config.set_layer(model); serialized StableHLO programs "
+                "cannot take the kv_ctx serving hook")
+        from paddle_tpu.serving import EngineConfig, LLMEngine
+        if serving_config is None:
+            serving_config = EngineConfig(**config_kw)
+        elif config_kw:
+            raise ValueError("pass either serving_config or kwargs, "
+                             "not both")
+        return LLMEngine(self._model, serving_config)
+
     def run(self, inputs=None):
         arrs = self._gather_inputs(inputs)
         if self._translated is not None:

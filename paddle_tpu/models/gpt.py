@@ -124,16 +124,22 @@ class GPTAttention(nn.Layer):
             input_is_parallel=True)
         self.attn_dropout_p = config.attention_dropout
 
-    def forward(self, hidden):
+    def forward(self, hidden, kv_ctx=None):
         b, s = hidden.shape[0], hidden.shape[1]
         qkv = self.qkv_proj(hidden)
         qkv = qkv.reshape([b, s, self.num_heads, 3 * self.head_dim])
         qkv = _constrain(qkv, "dp", "sp", "tp", None)
         q, k, v = qkv.split(3, axis=-1)
-        out = F.scaled_dot_product_attention(
-            q, k, v, is_causal=True,
-            dropout_p=self.attn_dropout_p if self.training else 0.0,
-            training=self.training)
+        if kv_ctx is not None:
+            # serving hook: the context owns KV residency (paged pools)
+            # and attention over the cached history — see
+            # paddle_tpu.serving.engine.PagedKVContext
+            out = kv_ctx.attend(q, k, v)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True,
+                dropout_p=self.attn_dropout_p if self.training else 0.0,
+                training=self.training)
         out = out.reshape([b, s, self.num_heads * self.head_dim])
         return self.out_proj(out)
 
@@ -183,8 +189,8 @@ class GPTDecoderLayer(nn.Layer):
             self.mlp = GPTMLP(config)
         self.dropout = nn.Dropout(config.dropout)
 
-    def forward(self, x):
-        x = x + self.dropout(self.attn(self.ln1(x)))
+    def forward(self, x, kv_ctx=None):
+        x = x + self.dropout(self.attn(self.ln1(x), kv_ctx=kv_ctx))
         x = x + self.dropout(self.mlp(self.ln2(x)))
         return _constrain(x, "dp", "sp", None)
 
@@ -200,11 +206,20 @@ class GPTModel(nn.Layer):
         self.final_ln = nn.LayerNorm(config.hidden_size,
                                      epsilon=config.layer_norm_epsilon)
 
-    def forward(self, input_ids, position_ids=None):
+    def forward(self, input_ids, position_ids=None, kv_ctx=None):
         h = self.embeddings(input_ids, position_ids)
+        if kv_ctx is not None and self.config.use_recompute and \
+                self.training:
+            # silently skipping the cache hook would leave the paged
+            # pools unwritten and decode over garbage — fail loudly
+            raise RuntimeError(
+                "kv_ctx serving forward requires eval mode (recompute "
+                "is active): call model.eval() before serving")
         for layer in self.layers:
             if self.config.use_recompute and self.training:
                 h = recompute(layer, h)
+            elif kv_ctx is not None:
+                h = layer(h, kv_ctx=kv_ctx)
             else:
                 h = layer(h)
         return self.final_ln(h)
@@ -238,8 +253,8 @@ class GPTForCausalLM(nn.Layer):
             from paddle_tpu.distributed.mesh import shard_tensor
             shard_tensor(self.lm_head_weight, None, "tp")
 
-    def forward(self, input_ids, position_ids=None):
-        h = self.gpt(input_ids, position_ids)
+    def forward(self, input_ids, position_ids=None, kv_ctx=None):
+        h = self.gpt(input_ids, position_ids, kv_ctx=kv_ctx)
         if self.config.tie_word_embeddings:
             w = self.gpt.embeddings.word_embeddings.weight
             logits = paddle_tpu.matmul(h, w, transpose_y=True)

@@ -599,3 +599,38 @@ def get_profiler(*a, **kw):
     to the utils facade over this module's Profiler."""
     from paddle_tpu.utils import get_profiler as _legacy
     return _legacy(*a, **kw)
+
+
+# --------------------------------------------------------------------------
+# Metrics-source registry: long-running subsystems (paddle_tpu.serving's
+# LLMEngine, dataloader pools, ...) register a zero-arg snapshot callable;
+# `metrics_report()` collects every registered snapshot into one dict so a
+# profiler pass over a serving process sees queue depth, tokens/s, TTFT,
+# page utilization, and the compile counter alongside the device traces.
+_metrics_sources = {}
+
+
+def register_metrics_source(name, snapshot_fn):
+    """Register `snapshot_fn` (zero-arg -> dict) under `name`.
+    Re-registering a name replaces the previous source."""
+    if not callable(snapshot_fn):
+        raise TypeError("snapshot_fn must be callable")
+    _metrics_sources[name] = snapshot_fn
+    return name
+
+
+def unregister_metrics_source(name):
+    _metrics_sources.pop(name, None)
+
+
+def metrics_report():
+    """{source_name: snapshot_dict} for every registered source; a
+    source that raises reports {"error": ...} instead of killing the
+    whole report."""
+    out = {}
+    for name, fn in list(_metrics_sources.items()):
+        try:
+            out[name] = fn()
+        except Exception as e:  # noqa: BLE001 — observability must not throw
+            out[name] = {"error": f"{type(e).__name__}: {e}"}
+    return out

@@ -1,0 +1,50 @@
+"""paddle_tpu.serving — continuous-batching LLM serving engine.
+
+TPU-native serving (Ragged Paged Attention + the Gemma-on-TPU serving
+recipe, PAPERS.md): a paged KV cache shared by every in-flight request,
+continuous batching at decode-step boundaries, prompt-length bucketing
+to a CLOSED set of compiled shapes (the engine's whole lifetime compiles
+``len(buckets) + 3`` XLA programs, asserted at runtime), and traced
+per-request sampling whose draws depend only on (seed, token position) —
+so continuous batching, sequential decode, and preemption replay all
+produce identical tokens.
+
+Quickstart::
+
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt3_tiny
+    from paddle_tpu import serving
+
+    engine = serving.LLMEngine(GPTForCausalLM(gpt3_tiny()),
+                               serving.EngineConfig(max_num_seqs=8,
+                                                    max_model_len=128))
+    results = engine.generate(
+        [[12, 7, 9], [4, 4, 8, 1]],
+        serving.SamplingParams(max_new_tokens=16, temperature=0.8,
+                               top_p=0.95, seed=1))
+
+See docs/serving.md for the architecture and the request lifecycle.
+"""
+from paddle_tpu.serving.engine import (EngineConfig, LLMEngine,
+                                       PagedKVContext)
+from paddle_tpu.serving.metrics import EngineMetrics, Histogram
+from paddle_tpu.serving.request import (GenerationResult, Request,
+                                        RequestState, SamplingParams)
+from paddle_tpu.serving.sampler import sample_tokens
+from paddle_tpu.serving.scheduler import (Scheduler, bucket_for,
+                                          default_buckets)
+
+__all__ = [
+    "EngineConfig",
+    "EngineMetrics",
+    "GenerationResult",
+    "Histogram",
+    "LLMEngine",
+    "PagedKVContext",
+    "Request",
+    "RequestState",
+    "SamplingParams",
+    "Scheduler",
+    "bucket_for",
+    "default_buckets",
+    "sample_tokens",
+]

@@ -1,0 +1,638 @@
+"""LLMEngine — continuous-batching serving over a paged KV cache.
+
+Execution model (the Gemma-on-TPU serving recipe, PAPERS.md): a SMALL,
+FIXED set of compiled programs serves every request mix —
+
+- one PREFILL program per prompt-length bucket: ``[1, bucket]`` token
+  ids in, dense causal attention, KV scattered into the shared paged
+  pools, last-real-token logits out;
+- ONE DECODE program at the full slot width ``[B, 1]``: every live
+  sequence appends its token at its own length and attends over its own
+  pages (ragged continuation batching — no re-padding, ever);
+- one SAMPLER program per width (prefill=1, decode=B) with every knob
+  (temperature/top-k/top-p/seed) as a traced operand.
+
+Compile count is therefore bounded by ``len(buckets) + 3`` for the life
+of the engine; `EngineMetrics.note_compile` hard-fails past the bound
+(the recompile storm tracelint TL3xx polices, turned into a runtime
+assertion).
+
+Continuous batching: new requests join the running decode batch at step
+boundaries (admission → bucketed prefill → slot in the decode batch),
+finished sequences free their pages immediately, and when the pool runs
+dry the latest-arrived running request is deterministically preempted
+(recompute-style: replayed later by prefilling prompt + generated
+tokens; positional sampling seeds make the replay token-identical —
+bit-exact on CPU; on TPU a replayed position is computed by the prefill
+program instead of the decode program, so a near-tie in bf16 logits
+could in principle resolve differently across an eviction).
+
+Everything host-side here is orchestration over device arrays; the only
+jax entry points are the compiled step programs, so the engine runs
+bit-deterministically on the CPU mesh (``JAX_PLATFORMS=cpu``) and
+unchanged on TPU.
+"""
+from __future__ import annotations
+
+import weakref
+from collections import OrderedDict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.autograd import no_grad
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.incubate.nn.paged_attention import (PageAllocator,
+                                                    paged_decode_step,
+                                                    paged_prefill_append)
+from paddle_tpu.serving.metrics import EngineMetrics
+from paddle_tpu.serving.request import (GenerationResult, Request,
+                                        RequestState, SamplingParams)
+from paddle_tpu.serving.sampler import sample_tokens
+from paddle_tpu.serving.scheduler import Scheduler, default_buckets
+
+__all__ = ["EngineConfig", "LLMEngine", "PagedKVContext"]
+
+
+class EngineConfig:
+    """Sizing and shape-bucketing knobs for :class:`LLMEngine`.
+
+    - `max_num_seqs`: decode batch width B (slots).
+    - `page_size` / `num_pages`: shared-pool geometry.  The default pool
+      holds every slot at `max_model_len` (no preemption pressure);
+      size it DOWN to oversubscribe memory and exercise preemption.
+    - `prefill_buckets`: the closed set of padded prompt shapes; the
+      engine never compiles any other prefill width.
+    - `eos_token_id`: default stop token for requests that don't set one.
+    """
+
+    def __init__(self, max_num_seqs=8, page_size=16, max_model_len=256,
+                 num_pages=None, prefill_buckets=None,
+                 growth_reserve_pages=1, eos_token_id=None,
+                 dtype=jnp.float32, finished_retention=1024):
+        if max_num_seqs < 1:
+            raise ValueError("max_num_seqs must be >= 1")
+        self.max_num_seqs = int(max_num_seqs)
+        self.page_size = int(page_size)
+        self.max_model_len = int(max_model_len)
+        self.max_pages_per_seq = -(-self.max_model_len // self.page_size)
+        if num_pages is None:
+            num_pages = self.max_num_seqs * self.max_pages_per_seq + 1
+        self.num_pages = int(num_pages)
+        if prefill_buckets is None:
+            prefill_buckets = default_buckets(self.max_model_len)
+        buckets = tuple(sorted(int(b) for b in prefill_buckets))
+        if not buckets or buckets[-1] > self.max_model_len:
+            raise ValueError(
+                f"prefill_buckets {buckets} must be non-empty and "
+                f"<= max_model_len {self.max_model_len}")
+        self.prefill_buckets = buckets
+        self.growth_reserve_pages = int(growth_reserve_pages)
+        self.eos_token_id = eos_token_id
+        self.dtype = dtype
+        # finished Request objects kept for post-hoc inspection via
+        # `engine.finished_requests`; oldest are dropped past this cap
+        # so a long-running step() loop cannot grow without bound
+        self.finished_retention = int(finished_retention)
+
+    @property
+    def compile_bound(self):
+        """Declared ceiling on XLA compiles for the engine's lifetime:
+        one prefill per bucket + one decode + two sampler widths."""
+        return len(self.prefill_buckets) + 3
+
+
+class PagedKVContext:
+    """The cache-aware attention hook handed to ``model(..., kv_ctx=)``.
+
+    Lives only INSIDE a traced step function: it carries the traced
+    per-layer pool arrays and a layer cursor; each attention layer calls
+    :meth:`attend` exactly once per forward.
+
+    - mode "prefill": dense causal attention over the (padded) prompt —
+      the padded tail only pollutes its own discarded rows — plus a
+      batched scatter of the real tokens' K/V into the pages.
+    - mode "decode": one-token append + attention over the row's pages
+      at its own length (ragged).
+    """
+
+    def __init__(self, k_pools, v_pools, tables, lens, page_size, mode):
+        self.k_pools = list(k_pools)
+        self.v_pools = list(v_pools)
+        self.tables = tables
+        self.lens = lens
+        self.page_size = page_size
+        self.mode = mode
+        self._layer = 0
+
+    def attend(self, q, k, v):
+        """q/k/v: Tensor [b, s, n_head, head_dim] -> Tensor same shape
+        (attention output); writes this layer's K/V into its pools."""
+        li = self._layer
+        self._layer += 1
+        if li >= len(self.k_pools):
+            raise RuntimeError(
+                f"model has more attention layers ({li + 1}+) than the "
+                f"engine allocated pools for ({len(self.k_pools)})")
+
+        def fn(qv, kv, vv):
+            qT = jnp.swapaxes(qv, 1, 2)            # [b, h, s, d]
+            kT = jnp.swapaxes(kv, 1, 2)
+            vT = jnp.swapaxes(vv, 1, 2)
+            if self.mode == "prefill":
+                out = _dense_causal_attention(qT, kT, vT)
+                kp, vp = paged_prefill_append(
+                    kT, vT, self.k_pools[li], self.v_pools[li],
+                    self.tables, self.lens, self.page_size)
+            else:
+                out, kp, vp = paged_decode_step(
+                    qT, kT, vT, self.k_pools[li], self.v_pools[li],
+                    self.tables, self.lens, self.page_size)
+            self.k_pools[li] = kp
+            self.v_pools[li] = vp
+            return jnp.swapaxes(out, 1, 2)         # [b, s, h, d]
+
+        return apply(fn, q, k, v)
+
+
+def _dense_causal_attention(q, k, v):
+    """[b, h, s, d] causal attention (fp32 softmax, deterministic)."""
+    d = q.shape[-1]
+    s = q.shape[2]
+    scores = (q / jnp.sqrt(jnp.float32(d)).astype(q.dtype)) @ \
+        jnp.swapaxes(k, -1, -2)                    # [b, h, s, s]
+    causal = jnp.tril(jnp.ones((s, s), jnp.bool_))
+    scores = jnp.where(causal[None, None], scores.astype(jnp.float32),
+                       jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return probs @ v
+
+
+class LLMEngine:
+    """Continuous-batching engine over any kv_ctx-aware decoder model.
+
+    The model contract (`models/gpt.py` is the reference attach point):
+
+    - ``model.config`` exposes ``num_layers``, ``num_heads``,
+      ``hidden_size`` (head_dim = hidden_size // num_heads);
+    - ``model(input_ids, position_ids=..., kv_ctx=...)`` returns
+      ``[b, s, vocab]`` logits, with every attention layer delegating to
+      ``kv_ctx.attend(q, k, v)`` when a context is passed.
+
+    Public surface: :meth:`add_request`, :meth:`step`, :meth:`generate`,
+    :attr:`metrics`, :meth:`shutdown`.
+    """
+
+    def __init__(self, model, config=None, metrics_name=None):
+        self.config = config or EngineConfig()
+        cfg = self.config
+        self._model = model
+        model.eval()
+        mc = model.config
+        self._num_layers = int(mc.num_layers)
+        self._num_heads = int(mc.num_heads)
+        self._head_dim = int(mc.hidden_size) // int(mc.num_heads)
+        if cfg.max_model_len > int(getattr(mc, "max_seq_len",
+                                           cfg.max_model_len)):
+            raise ValueError(
+                f"max_model_len {cfg.max_model_len} exceeds the model's "
+                f"max_seq_len {mc.max_seq_len}")
+
+        self._params = {k: t._value for k, t in model.state_dict().items()}
+
+        B, P = cfg.max_num_seqs, cfg.max_pages_per_seq
+        pool_shape = (cfg.num_pages, self._num_heads, cfg.page_size,
+                      self._head_dim)
+        self._k_pools = [jnp.zeros(pool_shape, cfg.dtype)
+                         for _ in range(self._num_layers)]
+        self._v_pools = [jnp.zeros(pool_shape, cfg.dtype)
+                         for _ in range(self._num_layers)]
+        self._tables = np.zeros((B, P), np.int32)      # host-canonical
+        self._lens = np.zeros((B,), np.int32)          # host-canonical
+        self._alloc = PageAllocator(cfg.num_pages, B, P)
+        self._slots = [None] * B                       # Request | None
+
+        self.scheduler = Scheduler(cfg.prefill_buckets, cfg.page_size,
+                                   cfg.growth_reserve_pages)
+        self.metrics = EngineMetrics()
+        self.metrics.compile_bound = cfg.compile_bound
+        self.metrics.pages_total = cfg.num_pages - 1   # page 0 reserved
+
+        self._compiled = {}
+        self._requests = {}          # live (queued or running) only
+        # finished requests move here (bounded by finished_retention);
+        # generate() drains its own, step()-loop users may inspect/pop
+        self.finished_requests = OrderedDict()
+        self._next_id = 0
+
+        self._metrics_name = (metrics_name
+                              or f"serving.engine{id(self) & 0xffff:04x}")
+        from paddle_tpu import profiler
+        # weak registration: a dropped engine (no shutdown()) must stay
+        # collectable and self-evict from the registry on the next report
+        mref = weakref.ref(self.metrics)
+        name = self._metrics_name
+
+        def _snapshot():
+            m = mref()
+            if m is None:
+                profiler.unregister_metrics_source(name)
+                return {"error": "engine collected"}
+            return m.snapshot()
+
+        profiler.register_metrics_source(name, _snapshot)
+
+    # ------------------------------------------------------------ API
+    def _resolve_params(self, sampling_params):
+        """Fill in the engine-level eos default."""
+        sp = sampling_params or SamplingParams(
+            eos_token_id=self.config.eos_token_id)
+        if sp.eos_token_id is None and self.config.eos_token_id is not None:
+            sp = SamplingParams(
+                max_new_tokens=sp.max_new_tokens,
+                temperature=sp.temperature, top_k=sp.top_k,
+                top_p=sp.top_p, seed=sp.seed,
+                eos_token_id=self.config.eos_token_id)
+        return sp
+
+    def _validate_request(self, prompt, sp):
+        """Raise ValueError unless (prompt, sp) is servable end to end —
+        called BEFORE anything is enqueued, so a bad request can never
+        strand earlier ones in the queue."""
+        if not prompt:
+            raise ValueError("prompt must contain at least one token")
+        total_max = len(prompt) + sp.max_new_tokens
+        if total_max > self.config.max_model_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({sp.max_new_tokens}) = {total_max} exceeds "
+                f"max_model_len {self.config.max_model_len}")
+        # the WORST-CASE replay length must be bucketable, not just the
+        # bare prompt: an eviction after g generated tokens (g can reach
+        # max_new_tokens - 1) replays prompt + g through prefill
+        self.scheduler.bucket_for_len(len(prompt) + sp.max_new_tokens - 1)
+        # the request must be SERVABLE alone on an empty pool: its final
+        # length's pages, and — the admission gate's view — its worst
+        # replay length plus the scheduler's growth reserve (otherwise
+        # add_request accepts work that deadlocks the queue forever)
+        need_total = max(
+            self._alloc.pages_needed(total_max, self.config.page_size),
+            self.scheduler.pages_for_prompt(total_max - 1))
+        if need_total > self.config.num_pages - 1:
+            raise ValueError(
+                f"request needs up to {need_total} pages (incl. the "
+                f"admission growth reserve) but the pool only has "
+                f"{self.config.num_pages - 1}")
+
+    def add_request(self, prompt_token_ids, sampling_params=None,
+                    stream=None):
+        """Queue one request; returns its request id.  Admission happens
+        at the next :meth:`step` boundary."""
+        sp = self._resolve_params(sampling_params)
+        prompt = [int(t) for t in prompt_token_ids]
+        self._validate_request(prompt, sp)
+        rid = f"req-{self._next_id}"
+        req = Request(rid, prompt, sp, arrival_index=self._next_id,
+                      stream=stream)
+        self._next_id += 1
+        req.arrive_t = self.metrics.clock()
+        self._requests[rid] = req
+        self.scheduler.enqueue(req)
+        self.metrics.requests_received += 1
+        return rid
+
+    def has_unfinished(self):
+        return (self.scheduler.has_waiting()
+                or any(r is not None for r in self._slots))
+
+    def step(self):
+        """One engine iteration: admit + prefill new requests at the
+        step boundary, then one continuous-batched decode step.  Returns
+        ``[(request_id, token_id, finished), ...]`` for tokens produced
+        this step; a preemption surfaces as ``(request_id, None, False)``
+        (the request re-enters the queue and will be replayed)."""
+        events = []
+        admitted = self._admit(events)
+        running = [r for r in self._slots if r is not None]
+        if running:
+            self._decode_step(events)
+        elif not admitted and self.scheduler.has_waiting():
+            head = self.scheduler.peek()
+            raise RuntimeError(
+                f"scheduler deadlock: nothing running and request "
+                f"{head.request_id} (prompt {len(head.replay_token_ids)} "
+                f"tokens) cannot be admitted — the page pool "
+                f"({self._alloc.num_free_pages} free) is too small")
+        self._refresh_gauges()
+        return events
+
+    def generate(self, prompts, sampling_params=None):
+        """Sync facade: serve `prompts` (list of token-id lists) to
+        completion; returns :class:`GenerationResult` per prompt in
+        input order."""
+        if prompts and isinstance(prompts[0], int):
+            raise TypeError("generate expects a LIST of prompts "
+                            "(each a list of token ids)")
+        if isinstance(sampling_params, (list, tuple)):
+            if len(sampling_params) != len(prompts):
+                raise ValueError("one SamplingParams per prompt required")
+            sps = list(sampling_params)
+        else:
+            sps = [sampling_params] * len(prompts)
+        # all-or-nothing: validate the whole batch BEFORE enqueueing so
+        # a bad prompt can't strand its predecessors in the queue
+        pairs = [([int(t) for t in p], self._resolve_params(sp))
+                 for p, sp in zip(prompts, sps)]
+        for prompt, sp in pairs:
+            self._validate_request(prompt, sp)
+        rids = [self.add_request(p, sp) for p, sp in pairs]
+        reqs = [self._requests[r] for r in rids]   # hold refs: _finish
+        while self.has_unfinished():               # moves them out of
+            self.step()                            # the live table
+        for r in rids:
+            self.finished_requests.pop(r, None)
+        return [GenerationResult(req) for req in reqs]
+
+    def shutdown(self):
+        """Unregister from the profiler metrics registry."""
+        from paddle_tpu import profiler
+        profiler.unregister_metrics_source(self._metrics_name)
+
+    # ----------------------------------------------------- admission
+    def _free_slot_count(self):
+        return sum(1 for r in self._slots if r is None)
+
+    def _admit(self, events):
+        admitted = 0
+        while True:
+            req = self.scheduler.pop_admissible(
+                self._free_slot_count(), self._alloc.num_free_pages)
+            if req is None:
+                break
+            self._prefill(req, events)
+            admitted += 1
+        return admitted
+
+    def _prefill(self, req, events):
+        cfg = self.config
+        t0 = self.metrics.clock()
+        req.transition(RequestState.PREFILL)
+        tokens = req.replay_token_ids
+        L = len(tokens)
+        bucket = self.scheduler.bucket_for_len(L)
+        slot = self._slots.index(None)
+        self._slots[slot] = req
+        req.slot = slot
+
+        need = self._alloc.pages_needed(L, cfg.page_size)
+        for pos, page in self._alloc.allocate(slot, need):
+            self._tables[slot, pos] = page
+
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :L] = tokens
+        pos_ids = np.arange(bucket, dtype=np.int32)[None, :]
+        length = np.array([L], np.int32)
+
+        fn = self._get_prefill(bucket)
+        last_logits, self._k_pools, self._v_pools = fn(
+            self._params, self._k_pools, self._v_pools,
+            jnp.asarray(self._tables[slot:slot + 1]), jnp.asarray(ids),
+            jnp.asarray(pos_ids), jnp.asarray(length))
+        self._lens[slot] = L
+
+        tok = self._sample(last_logits, [req], width=1)[0]
+        now = self.metrics.clock()
+        self.metrics.prefill_steps += 1
+        self.metrics.prefill_step_s.observe(now - t0)
+        self.metrics.prompt_tokens += L
+        if req.num_evictions == 0:
+            self.metrics.requests_admitted += 1
+            self.metrics.ttft.observe(now - req.arrive_t)
+        req.append_token(tok, now=now)
+        self.metrics.generated_tokens += 1
+        self._post_token(req, events, now)
+        if not req.is_finished:
+            req.transition(RequestState.DECODE)
+
+    # -------------------------------------------------------- decode
+    def _decode_step(self, events):
+        cfg = self.config
+        t0 = self.metrics.clock()
+        # capacity pass: every live row must fit one more token; the
+        # pool running dry preempts the latest-arrived running request
+        for slot in range(cfg.max_num_seqs):
+            req = self._slots[slot]
+            if req is None:
+                continue
+            need = self._alloc.pages_needed(
+                int(self._lens[slot]) + 1, cfg.page_size)
+            while not self._alloc.can_allocate(slot, need):
+                victim = self.scheduler.select_victim(
+                    [r for r in self._slots if r is not None])
+                if victim is None:
+                    raise RuntimeError(
+                        "paged pool exhausted with nothing left to "
+                        "preempt")
+                self._evict(victim, events)
+                if victim is req:
+                    break
+            if self._slots[slot] is None:
+                continue                       # row preempted itself
+            for pos, page in self._alloc.allocate(slot, need):
+                self._tables[slot, pos] = page
+
+        live = [(s, r) for s, r in enumerate(self._slots) if r is not None]
+        if not live:
+            return
+        tokens = np.zeros((cfg.max_num_seqs, 1), np.int32)
+        for s, r in live:
+            tokens[s, 0] = r.output_token_ids[-1]
+
+        fn = self._get_decode()
+        logits, self._k_pools, self._v_pools = fn(
+            self._params, self._k_pools, self._v_pools,
+            jnp.asarray(self._tables), jnp.asarray(self._lens),
+            jnp.asarray(tokens))
+
+        reqs = [self._slots[s] for s in range(cfg.max_num_seqs)]
+        toks = self._sample(logits, reqs, width=cfg.max_num_seqs)
+        for s, r in live:
+            self._lens[s] += 1
+        now = self.metrics.clock()
+        self.metrics.decode_steps += 1
+        self.metrics.decode_step_s.observe(now - t0)
+        for s, r in live:
+            if r.last_token_t is not None:
+                self.metrics.inter_token.observe(now - r.last_token_t)
+            r.append_token(toks[s], now=now)
+            self.metrics.generated_tokens += 1
+            self._post_token(r, events, now)
+
+    # ------------------------------------------------------ sampling
+    def _sample(self, logits, reqs, width):
+        """reqs: per-row Request or None (padding rows).  Position is
+        the ABSOLUTE index of the token being sampled = the row's cache
+        length AFTER its input token was appended — which is exactly
+        `total_len` host-side."""
+        seeds = np.zeros((width,), np.int32)
+        pos = np.zeros((width,), np.int32)
+        temps = np.zeros((width,), np.float32)
+        top_ks = np.zeros((width,), np.int32)
+        top_ps = np.ones((width,), np.float32)
+        for i, r in enumerate(reqs):
+            if r is None:
+                continue
+            sp = r.sampling_params
+            seeds[i] = sp.seed
+            pos[i] = r.total_len
+            temps[i] = sp.temperature
+            top_ks[i] = sp.top_k
+            top_ps[i] = sp.top_p
+        fn = self._get_sampler(width)
+        out = fn(jnp.asarray(logits), jnp.asarray(seeds),
+                 jnp.asarray(pos), jnp.asarray(temps),
+                 jnp.asarray(top_ks), jnp.asarray(top_ps))
+        return [int(t) for t in np.asarray(out)]
+
+    # ------------------------------------------------- finish / evict
+    def _post_token(self, req, events, now):
+        reason = req.should_stop()
+        if reason is not None:
+            self._finish(req, reason, now)
+        req.deliver(finished=req.is_finished)
+        events.append((req.request_id, req.output_token_ids[-1],
+                       req.is_finished))
+
+    def _finish(self, req, reason, now):
+        req.finish_reason = reason
+        req.transition(RequestState.FINISHED)
+        self._release_slot(req)
+        req.finish_t = now
+        self.metrics.requests_finished += 1
+        self.metrics.e2e_latency.observe(now - req.arrive_t)
+        # move out of the live table so a perpetual serving loop cannot
+        # accumulate one Request (+ stream closure) per request served
+        self._requests.pop(req.request_id, None)
+        self.finished_requests[req.request_id] = req
+        while len(self.finished_requests) > self.config.finished_retention:
+            self.finished_requests.popitem(last=False)
+
+    def _evict(self, req, events):
+        """Deterministic preemption: free everything, requeue at the
+        queue front; the replay prefill later reconstructs the cache
+        from prompt + generated tokens (token-identical, see sampler)."""
+        req.transition(RequestState.EVICTED)
+        self._release_slot(req)
+        req.num_evictions += 1
+        self.metrics.requests_evicted += 1
+        self.scheduler.requeue_front(req)
+        events.append((req.request_id, None, False))
+
+    def _release_slot(self, req):
+        slot = req.slot
+        self._alloc.release(slot)
+        self._tables[slot, :] = 0
+        self._lens[slot] = 0
+        self._slots[slot] = None
+        req.slot = None
+
+    def _refresh_gauges(self):
+        m = self.metrics
+        m.queue_depth = self.scheduler.queue_depth
+        m.running = sum(1 for r in self._slots if r is not None)
+        m.pages_in_use = (self.config.num_pages - 1
+                          - self._alloc.num_free_pages)
+
+    # ------------------------------------------------- compiled steps
+    def _run_model(self, params, ids, pos_ids, ctx):
+        """Traced: rebind params, run the cache-aware forward."""
+        sd = self._model.state_dict()
+        saved = [(t, t._value) for t in sd.values()]
+        try:
+            for k, t in sd.items():
+                t._value = params[k]
+            with no_grad():
+                out = self._model(Tensor(ids), position_ids=Tensor(pos_ids),
+                                  kv_ctx=ctx)
+            return out._value
+        finally:
+            for t, v in saved:
+                t._value = v
+
+    def _get_prefill(self, bucket):
+        key = ("prefill", bucket)
+        if key in self._compiled:
+            return self._compiled[key]
+        cfg = self.config
+
+        def prefill(params, k_pools, v_pools, row_table, ids, pos_ids,
+                    length):
+            ctx = PagedKVContext(k_pools, v_pools, row_table, length,
+                                 cfg.page_size, "prefill")
+            logits = self._run_model(params, ids, pos_ids, ctx)
+            # logits [1, bucket, V] -> the last REAL token's row
+            last = jnp.take_along_axis(
+                logits, (length - 1)[:, None, None].astype(jnp.int32),
+                axis=1)[:, 0]
+            return (last.astype(jnp.float32), ctx.k_pools, ctx.v_pools)
+
+        return self._compile(key, prefill, (
+            self._params, self._k_pools, self._v_pools,
+            jnp.zeros((1, cfg.max_pages_per_seq), jnp.int32),
+            jnp.zeros((1, bucket), jnp.int32),
+            jnp.zeros((1, bucket), jnp.int32),
+            jnp.zeros((1,), jnp.int32)), donate=(1, 2))
+
+    def _get_decode(self):
+        key = ("decode",)
+        if key in self._compiled:
+            return self._compiled[key]
+        cfg = self.config
+
+        def decode(params, k_pools, v_pools, tables, lens, tokens):
+            ctx = PagedKVContext(k_pools, v_pools, tables, lens,
+                                 cfg.page_size, "decode")
+            logits = self._run_model(params, tokens, lens[:, None], ctx)
+            return (logits[:, 0].astype(jnp.float32),
+                    ctx.k_pools, ctx.v_pools)
+
+        return self._compile(key, decode, (
+            self._params, self._k_pools, self._v_pools,
+            jnp.zeros((cfg.max_num_seqs, cfg.max_pages_per_seq),
+                      jnp.int32),
+            jnp.zeros((cfg.max_num_seqs,), jnp.int32),
+            jnp.zeros((cfg.max_num_seqs, 1), jnp.int32)), donate=(1, 2))
+
+    def _get_sampler(self, width):
+        key = ("sample", width)
+        if key in self._compiled:
+            return self._compiled[key]
+        V = int(self._model.config.vocab_size)
+        return self._compile(key, sample_tokens, (
+            jnp.zeros((width, V), jnp.float32),
+            jnp.zeros((width,), jnp.int32),
+            jnp.zeros((width,), jnp.int32),
+            jnp.zeros((width,), jnp.float32),
+            jnp.zeros((width,), jnp.int32),
+            jnp.ones((width,), jnp.float32)))
+
+    def _compile(self, key, fn, example_args, donate=()):
+        """AOT compile + count: every program the engine will ever run
+        passes through here, so `metrics.compile_count` is exact.
+
+        `donate` names arg positions (the KV pools) XLA may alias
+        in-place — without it every decode step materializes a second
+        copy of the whole cache.  CPU's backend can't donate these and
+        would warn on every call, so donation is accelerator-only."""
+        shapes = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), example_args)
+        if jax.default_backend() == "cpu":
+            donate = ()
+        compiled = jax.jit(fn, donate_argnums=donate).lower(
+            *shapes).compile()
+        self.metrics.note_compile()
+        self._compiled[key] = compiled
+        return compiled

@@ -1,0 +1,130 @@
+"""Serving observability: counters, gauges, and latency histograms,
+exposed as one plain-dict snapshot.
+
+The snapshot is the integration surface: `LLMEngine` registers its
+`snapshot` with `paddle_tpu.profiler.register_metrics_source`, so a
+profiler report over a serving process includes queue depth, tokens/s,
+TTFT, inter-token latency percentiles, page utilization, and — the
+recompile-storm tripwire — the compile counter next to its declared
+bound.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+
+__all__ = ["Histogram", "EngineMetrics"]
+
+
+class Histogram:
+    """Bounded-memory latency histogram: keeps the most recent `cap`
+    observations (seconds) and summarizes on demand.  `observe` is in
+    the per-token hot path, so eviction must be O(1) (deque maxlen)."""
+
+    def __init__(self, cap=4096):
+        self.cap = int(cap)
+        self._vals = deque(maxlen=self.cap)
+        self.count = 0
+
+    def observe(self, v):
+        self.count += 1
+        self._vals.append(float(v))
+
+    def _percentile(self, q):
+        vs = sorted(self._vals)
+        if not vs:
+            return None
+        idx = min(len(vs) - 1, max(0, int(round(q * (len(vs) - 1)))))
+        return vs[idx]
+
+    def summary(self, scale=1000.0):
+        """{count, mean, p50, p99} — scaled (default: seconds -> ms)."""
+        if not self._vals:
+            return {"count": self.count, "mean": None, "p50": None,
+                    "p99": None}
+        mean = sum(self._vals) / len(self._vals)
+        return {
+            "count": self.count,
+            "mean": round(mean * scale, 4),
+            "p50": round(self._percentile(0.50) * scale, 4),
+            "p99": round(self._percentile(0.99) * scale, 4),
+        }
+
+
+class EngineMetrics:
+    """All engine counters in one place; `snapshot()` is the contract."""
+
+    def __init__(self, clock=time.perf_counter):
+        self.clock = clock
+        self.started_t = clock()
+        # counters
+        self.requests_received = 0
+        self.requests_admitted = 0
+        self.requests_finished = 0
+        self.requests_evicted = 0
+        self.prefill_steps = 0
+        self.decode_steps = 0
+        self.prompt_tokens = 0
+        self.generated_tokens = 0
+        self.compile_count = 0
+        self.compile_bound = 0
+        # gauges (engine pushes current values)
+        self.queue_depth = 0
+        self.running = 0
+        self.pages_in_use = 0
+        self.pages_total = 0
+        # histograms (seconds)
+        self.ttft = Histogram()
+        self.inter_token = Histogram()
+        self.e2e_latency = Histogram()
+        self.prefill_step_s = Histogram()
+        self.decode_step_s = Histogram()
+
+    def note_compile(self):
+        self.compile_count += 1
+        if self.compile_bound and self.compile_count > self.compile_bound:
+            raise RuntimeError(
+                f"recompile storm: {self.compile_count} compiles exceeds "
+                f"the declared bound {self.compile_bound} — a shape "
+                f"escaped the bucket set")
+
+    def snapshot(self):
+        """Plain-dict view of everything (stable keys; see
+        docs/serving.md 'Metrics reference')."""
+        elapsed = max(self.clock() - self.started_t, 1e-9)
+        return {
+            "uptime_s": round(elapsed, 3),
+            "requests": {
+                "received": self.requests_received,
+                "admitted": self.requests_admitted,
+                "finished": self.requests_finished,
+                "evicted": self.requests_evicted,
+            },
+            "queue_depth": self.queue_depth,
+            "running": self.running,
+            "steps": {
+                "prefill": self.prefill_steps,
+                "decode": self.decode_steps,
+            },
+            "tokens": {
+                "prompt": self.prompt_tokens,
+                "generated": self.generated_tokens,
+                "per_s": round(self.generated_tokens / elapsed, 2),
+            },
+            "pages": {
+                "in_use": self.pages_in_use,
+                "total": self.pages_total,
+                "utilization": round(
+                    self.pages_in_use / self.pages_total, 4)
+                if self.pages_total else 0.0,
+            },
+            "compiles": {
+                "count": self.compile_count,
+                "bound": self.compile_bound,
+            },
+            "ttft_ms": self.ttft.summary(),
+            "inter_token_ms": self.inter_token.summary(),
+            "e2e_latency_ms": self.e2e_latency.summary(),
+            "prefill_step_ms": self.prefill_step_s.summary(),
+            "decode_step_ms": self.decode_step_s.summary(),
+        }

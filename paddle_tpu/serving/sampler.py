@@ -1,0 +1,66 @@
+"""Traced token sampling: greedy / temperature / top-k / top-p.
+
+One pure function over jnp arrays, vmapped across the batch, jitted by
+the engine at exactly two shapes (prefill width 1, decode width B) — it
+never recompiles per request because every knob (temperature, top_k,
+top_p, seed) is a TRACED operand, not a static argument.
+
+Determinism contract: the key for a draw is
+``fold_in(fold_in(PRNGKey(seed), position))`` where `position` is the
+ABSOLUTE index of the token being sampled.  Batch composition, slot
+assignment, and eviction/replay history cannot change a request's
+tokens.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["sample_tokens"]
+
+_NEG_INF = jnp.finfo(jnp.float32).min
+
+
+def _sample_row(logits, seed, position, temperature, top_k, top_p):
+    """One row: logits [V] f32 -> token id (int32)."""
+    V = logits.shape[0]
+    logits = logits.astype(jnp.float32)
+
+    # temperature; <=0 means greedy (selected at the end)
+    scaled = logits / jnp.maximum(temperature, 1e-6)
+
+    # top-k: mask everything below the k-th largest logit (k<=0: off)
+    k = jnp.where(top_k <= 0, V, jnp.clip(top_k, 1, V))
+    desc = jnp.sort(scaled)[::-1]
+    kth = desc[jnp.maximum(k - 1, 0)]
+    scaled = jnp.where(scaled < kth, _NEG_INF, scaled)
+
+    # top-p (nucleus) over the top-k-filtered distribution: keep the
+    # smallest prefix of descending-prob tokens whose mass reaches p
+    probs = jax.nn.softmax(scaled)
+    sp = jnp.sort(probs)[::-1]
+    cum = jnp.cumsum(sp)
+    keep_sorted = (cum - sp) < top_p        # mass BEFORE this token < p
+    keep_sorted = keep_sorted.at[0].set(True)  # never drop the argmax
+    pmin = jnp.min(jnp.where(keep_sorted, sp, jnp.inf))
+    log_probs = jnp.where(probs >= pmin, jnp.log(probs), _NEG_INF)
+
+    # Gumbel-max draw from the filtered distribution
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), position)
+    gumbel = jax.random.gumbel(key, (V,), jnp.float32)
+    sampled = jnp.argmax(log_probs + gumbel)
+
+    greedy = jnp.argmax(logits)
+    return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)
+
+
+def sample_tokens(logits, seeds, positions, temperatures, top_ks, top_ps):
+    """Batched sampling (pure, trace-safe).
+
+    logits [B, V] f32; seeds/positions/top_ks [B] int32;
+    temperatures/top_ps [B] f32 -> token ids [B] int32.
+    """
+    return jax.vmap(_sample_row)(
+        logits, seeds.astype(jnp.int32), positions.astype(jnp.int32),
+        temperatures.astype(jnp.float32), top_ks.astype(jnp.int32),
+        top_ps.astype(jnp.float32))

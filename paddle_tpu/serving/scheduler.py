@@ -1,0 +1,123 @@
+"""Admission scheduling: FCFS queue, page-budget policy, and the
+prompt-length bucketing that bounds XLA recompiles.
+
+Bucketing contract (the TL3xx recompile-storm hazard, made a feature):
+every prefill runs at one of ``len(buckets)`` padded shapes, decode runs
+at exactly one shape, and sampling adds two (prefill-width and
+decode-width).  The engine therefore compiles AT MOST
+``len(buckets) + 3`` programs over its whole lifetime — countable,
+declared up front (`compile_bound`), and asserted in CI.
+
+Admission is strict FCFS with head-of-line blocking: if the oldest
+waiting request does not fit (no free slot, or the page budget can't
+cover its bucketed prompt plus one growth page), nothing behind it is
+admitted either.  Skipping ahead would starve long prompts forever on a
+busy pool; head-of-line blocking keeps latency ordering predictable.
+
+Preemption is deterministic: when decode needs a page and the pool is
+dry, the LATEST-arrived running request is evicted (LIFO victim — the
+request that has consumed the least scheduler goodwill), its pages are
+freed, and it re-enters the waiting queue at the front.
+"""
+from __future__ import annotations
+
+from collections import deque
+
+from paddle_tpu.serving.request import RequestState
+
+__all__ = ["bucket_for", "default_buckets", "Scheduler"]
+
+
+def default_buckets(max_model_len, smallest=16):
+    """Powers-of-two padded prompt lengths up to max_model_len."""
+    buckets = []
+    b = smallest
+    while b < max_model_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_model_len)
+    return tuple(buckets)
+
+
+def bucket_for(length, buckets):
+    """Smallest bucket >= length; raises when the prompt can't fit."""
+    for b in buckets:
+        if length <= b:
+            return b
+    raise ValueError(
+        f"prompt length {length} exceeds the largest bucket "
+        f"{buckets[-1]} — raise max_model_len / add a bucket")
+
+
+class Scheduler:
+    """FCFS admission with a page-budget gate.
+
+    The scheduler owns the WAITING queue only; running-state ownership
+    (slots, allocator) stays with the engine, which passes the relevant
+    views in.  Keeping the policy pure over those views makes it
+    unit-testable without compiling anything.
+    """
+
+    def __init__(self, buckets, page_size, growth_reserve_pages=1):
+        self.buckets = tuple(sorted(buckets))
+        self.page_size = int(page_size)
+        # pages kept back per admission so one decode step can always
+        # grow the newly admitted sequence without instant preemption
+        self.growth_reserve_pages = int(growth_reserve_pages)
+        self._waiting = deque()
+
+    # ---- queue ----
+    def enqueue(self, request):
+        self._waiting.append(request)
+
+    def requeue_front(self, request):
+        """Evicted requests keep their FCFS priority."""
+        self._waiting.appendleft(request)
+
+    @property
+    def queue_depth(self):
+        return len(self._waiting)
+
+    def has_waiting(self):
+        return bool(self._waiting)
+
+    def peek(self):
+        return self._waiting[0] if self._waiting else None
+
+    # ---- policy ----
+    def pages_for_prompt(self, prompt_len):
+        """Pages an admission must secure: the FULL bucketed shape is
+        never written (padding is routed to the garbage page), so only
+        the real prompt length counts, plus the growth reserve."""
+        return (-(-prompt_len // self.page_size)
+                + self.growth_reserve_pages)
+
+    def admissible(self, request, free_slots, free_pages):
+        """Can `request` be admitted right now?"""
+        if free_slots <= 0:
+            return False
+        need = self.pages_for_prompt(len(request.replay_token_ids))
+        return need <= free_pages
+
+    def pop_admissible(self, free_slots, free_pages):
+        """Pop the queue head if it fits (strict FCFS: a non-fitting
+        head blocks everything behind it). Returns None when nothing is
+        admissible."""
+        if not self._waiting:
+            return None
+        head = self._waiting[0]
+        if not self.admissible(head, free_slots, free_pages):
+            return None
+        return self._waiting.popleft()
+
+    def select_victim(self, running):
+        """Deterministic preemption: evict the latest-arrived DECODE
+        request. Returns None when there is nothing to evict."""
+        candidates = [r for r in running
+                      if r.state == RequestState.DECODE]
+        if not candidates:
+            return None
+        return max(candidates, key=lambda r: r.arrival_index)
+
+    def bucket_for_len(self, length):
+        return bucket_for(length, self.buckets)

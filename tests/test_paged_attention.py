@@ -109,3 +109,101 @@ def test_functional_read_only_decode():
     vs = [np.stack(hist_v[b], axis=1) for b in range(B)]
     np.testing.assert_allclose(out.numpy(), _dense_attn(q, ks, vs),
                                atol=1e-5)
+
+
+def test_free_list_restored_after_100_interleaved_sequences():
+    """Satellite regression: 100 sequences allocated/released interleaved
+    across batch slots (including mid-decode evictions while other rows
+    keep decoding) must fully restore the free list — no leaked pages,
+    no duplicates, and the every-page-accounted-for invariant holds at
+    every step."""
+    rng = np.random.default_rng(7)
+    NB, NP = 4, 17  # 16 allocatable pages
+    cache = PagedKVCache(num_pages=NP, page_size=PAGE, num_heads=H,
+                         head_dim=D, batch=NB, max_pages_per_seq=3)
+    q = rng.standard_normal((NB, H, 1, D)).astype(np.float32)
+    lens = [0] * NB
+    started = 0
+    while started < 100:
+        b = int(rng.integers(0, NB))
+        if lens[b]:                      # evict mid-decode
+            cache.release(b)
+            cache.release(b)             # idempotent double-release
+            lens[b] = 0
+        want = int(rng.integers(1, 3 * PAGE + 1))
+        cache.ensure_capacity(b, want)
+        lens[b] = want
+        started += 1
+        # other rows keep decoding while this slot churns
+        cache.append_and_attend(p.to_tensor(q), p.to_tensor(q),
+                                p.to_tensor(q))
+        for r in range(NB):
+            if lens[r]:
+                lens[r] = min(lens[r] + 1, 3 * PAGE)
+                cache.ensure_capacity(r, lens[r])
+        cache.check_invariant()
+    for b in range(NB):
+        cache.release(b)
+    cache.check_invariant()
+    assert cache.num_free_pages == NP - 1
+    free = cache._alloc._free
+    assert sorted(free) == list(range(1, NP))  # every page, exactly once
+
+
+def test_released_row_does_not_advance_or_corrupt_reused_slot():
+    """The mid-decode-eviction bug: a released row's device seq_len used
+    to keep advancing with every batch-wide append, so a REUSED slot
+    wrote its first token at a stale offset. Released rows must stay at
+    len 0 and a fresh sequence in the slot must match the dense oracle."""
+    rng = np.random.default_rng(3)
+    cache = PagedKVCache(num_pages=9, page_size=PAGE, num_heads=H,
+                         head_dim=D, batch=2, max_pages_per_seq=2)
+    mk = lambda: rng.standard_normal((2, H, 1, D)).astype(np.float32)
+    for t in range(3):
+        cache.ensure_capacity(0, t + 1)
+        cache.ensure_capacity(1, t + 1)
+        cache.append_and_attend(p.to_tensor(mk()), p.to_tensor(mk()),
+                                p.to_tensor(mk()))
+    cache.release(0)
+    for t in range(3, 6):                # row 0 idle, row 1 decoding
+        cache.ensure_capacity(1, t + 1)
+        cache.append_and_attend(p.to_tensor(mk()), p.to_tensor(mk()),
+                                p.to_tensor(mk()))
+    assert int(cache.seq_lens.numpy()[0]) == 0   # did not advance
+    # slot 0 reused: first append must land at offset 0 and attend over
+    # exactly one token
+    cache.ensure_capacity(0, 1)
+    q, kn, vn = mk(), mk(), mk()
+    out = cache.append_and_attend(p.to_tensor(q), p.to_tensor(kn),
+                                  p.to_tensor(vn))
+    assert int(cache.seq_lens.numpy()[0]) == 1
+    want = _dense_attn(q[0:1], [kn[0]], [vn[0]])  # one token of history
+    np.testing.assert_allclose(out.numpy()[0:1], want, atol=1e-5)
+
+
+def test_append_prefill_matches_token_by_token():
+    """Batched multi-sequence prompt write: append_prefill over ragged
+    prompt lengths must leave the pools identical to appending the same
+    tokens one decode step at a time."""
+    rng = np.random.default_rng(5)
+    plens = np.array([5, 2, 7], np.int32)
+    S = int(plens.max())
+    k_new = rng.standard_normal((B, H, S, D)).astype(np.float32)
+    v_new = rng.standard_normal((B, H, S, D)).astype(np.float32)
+
+    fast = PagedKVCache(num_pages=10, page_size=PAGE, num_heads=H,
+                        head_dim=D, batch=B, max_pages_per_seq=3)
+    for b in range(B):
+        fast.ensure_capacity(b, int(plens[b]))
+    fast.append_prefill(p.to_tensor(k_new), p.to_tensor(v_new), plens)
+
+    # oracle: read-only decode over the prefilled pages vs dense attn
+    q = rng.standard_normal((B, H, 1, D)).astype(np.float32)
+    out = paged_attention_decode(
+        p.to_tensor(q), fast.k_pages, fast.v_pages, fast.block_tables,
+        fast.seq_lens, PAGE)
+    ks = [k_new[b, :, :plens[b]] for b in range(B)]
+    vs = [v_new[b, :, :plens[b]] for b in range(B)]
+    np.testing.assert_allclose(out.numpy(), _dense_attn(q, ks, vs),
+                               atol=1e-5)
+    np.testing.assert_array_equal(fast.seq_lens.numpy(), plens)

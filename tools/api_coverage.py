@@ -79,6 +79,37 @@ def has_attr_path(obj, name):
     return getattr(obj, name, None) is not None
 
 
+# paddle_tpu-NATIVE namespaces with no reference-paddle analogue: their
+# declared public surface (__all__) is the contract; a name that stops
+# resolving is a regression exactly like a reference-parity gap.
+NATIVE_NAMESPACES = ("serving", "analysis")
+
+
+def collect_native():
+    """[(namespace, missing_count, missing_names, note)] for the
+    paddle_tpu-native subsystems (checked against their own __all__)."""
+    import importlib
+    rows = []
+    for ns in NATIVE_NAMESPACES:
+        try:
+            mod = importlib.import_module(f"paddle_tpu.{ns}")
+        except Exception as e:  # noqa: BLE001 — report, don't crash the tool
+            # count high enough that a whole-namespace import break
+            # always regresses vs any baseline with partial gaps
+            rows.append((f"<native>.{ns}", 999, [],
+                         f"IMPORT FAILED: {type(e).__name__}"))
+            continue
+        declared = sorted(getattr(mod, "__all__", []))
+        missing = sorted(n for n in declared
+                         if getattr(mod, n, None) is None)
+        # always emit the row (missing_count 0 when healthy): the
+        # baseline then RECORDS the namespace, so a later import break
+        # or dropped name regresses against an explicit 0
+        rows.append((f"<native>.{ns}", len(missing), missing,
+                     "" if missing else f"OK ({len(declared)} names)"))
+    return rows
+
+
 def collect():
     """[(namespace, missing_count, missing_names, note)] sorted worst-first."""
     import paddle_tpu
@@ -100,6 +131,7 @@ def collect():
         missing = sorted(n for n in names if not has_attr_path(target, n))
         if missing:
             rows.append((ns or "<top>", len(missing), missing, ""))
+    rows.extend(collect_native())
     rows.sort(key=lambda r: (-r[1], r[0]))
     return rows
 
@@ -140,7 +172,7 @@ def main():
     args = ap.parse_args()
 
     all_rows = collect()
-    rows = all_rows
+    rows = [r for r in all_rows if r[1] > 0]   # text shows gaps only
     if args.namespace:
         rows = [r for r in all_rows
                 if ("paddle." + ("" if r[0] == "<top>" else r[0]))
