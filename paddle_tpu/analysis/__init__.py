@@ -29,12 +29,18 @@ from paddle_tpu.analysis.visitor import (  # noqa: F401
 )
 from paddle_tpu.analysis.subset_rules import check_recompile, check_subset
 from paddle_tpu.analysis.purity_rules import check_purity
+from paddle_tpu.analysis.shard_rules import (  # noqa: F401
+    AuditConfig, InputInfo, MeshInfo, input_infos_from_state,
+)
+from paddle_tpu.analysis.cost_audit import CostReport  # noqa: F401
 from paddle_tpu.analysis import report  # noqa: F401
 
 __all__ = [
     "RULES", "TraceHazardError", "Finding", "TracelintWarning",
-    "lint_paths", "lint_file", "lint_callable", "check_jaxpr",
-    "message_for", "report",
+    "ShardlintWarning", "lint_paths", "lint_file", "lint_callable",
+    "check_jaxpr", "audit_jaxpr", "message_for", "report",
+    "AuditConfig", "MeshInfo", "InputInfo", "CostReport",
+    "input_infos_from_state",
 ]
 
 AST_RULE_SETS = (check_subset, check_purity, check_recompile)
@@ -42,6 +48,11 @@ AST_RULE_SETS = (check_subset, check_purity, check_recompile)
 
 class TracelintWarning(UserWarning):
     """Emitted by to_static(check=True) for each tracelint finding."""
+
+
+class ShardlintWarning(TracelintWarning):
+    """Emitted by to_static(audit=True) for each shardlint finding.
+    Subclasses TracelintWarning so one warning filter governs both."""
 
 
 def lint_file(path, base=None, rule_sets=AST_RULE_SETS):
@@ -100,7 +111,41 @@ def check_jaxpr(closed_jaxpr, where="<traced function>", **kw):
     return _impl(closed_jaxpr, where=where, **kw)
 
 
-def warn_findings(findings, stacklevel=3):
+def audit_jaxpr(closed_jaxpr, where="<traced program>", inputs=None,
+                mesh=None, config=None, suppress=True):
+    """shardlint: the full SL-rule audit of one traced program.
+
+    Runs the sharding pass (SL1xx), the collective-safety pass (SL2xx)
+    and the memory/layout cost pass (SL3xx) over `closed_jaxpr`;
+    returns ``(findings, CostReport)``.
+
+    - `inputs`: [InputInfo] aligned with the jaxpr invars (use
+      :func:`input_infos_from_state` for a to_static state list, or
+      :meth:`StaticFunction.traced_program` which returns both).
+    - `mesh`: a MeshInfo / jax Mesh / None (falls back to the installed
+      global mesh).  Pass ``MeshInfo.of(axes={"dp": 8})`` to audit a
+      CPU-traced program against a hypothetical production topology.
+    - `suppress`: apply per-line `# tracelint: disable=SLxxx` comments
+      at each finding's resolved source site.
+    """
+    from paddle_tpu.analysis import cost_audit, shard_rules
+    config = config or shard_rules.AuditConfig()
+    mesh = mesh if isinstance(mesh, shard_rules.MeshInfo) \
+        else shard_rules.MeshInfo.of(mesh)
+    findings = shard_rules.check_sharding(
+        closed_jaxpr, inputs=inputs, mesh=mesh, config=config, where=where)
+    findings += shard_rules.check_collectives(
+        closed_jaxpr, mesh=mesh, config=config, where=where)
+    mem_findings, rep = cost_audit.audit_memory(
+        closed_jaxpr, where=where, inputs=inputs, config=config)
+    findings += mem_findings
+    if suppress:
+        findings = shard_rules.apply_suppressions(findings)
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return findings, rep
+
+
+def warn_findings(findings, stacklevel=3, category=None, prefix="tracelint"):
     for f in findings:
-        warnings.warn(f"tracelint: {f.format()}", TracelintWarning,
-                      stacklevel=stacklevel)
+        warnings.warn(f"{prefix}: {f.format()}",
+                      category or TracelintWarning, stacklevel=stacklevel)

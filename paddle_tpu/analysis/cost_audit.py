@@ -1,0 +1,244 @@
+"""shardlint SL3xx: memory & layout cost audit of a traced jaxpr.
+
+Three estimates the TPU will otherwise only reveal at runtime:
+
+- **peak HBM** — a linear-scan liveness walk over the program: inputs
+  and consts are resident, each eqn allocates its outputs and frees
+  operands past their last use; the maximum resident set is the
+  estimate, and the arrays live at that moment are the "top
+  contributors".  Sub-jaxprs (scan/while/cond bodies) contribute their
+  own internal peak beyond the operands already counted.  This is an
+  ESTIMATE — XLA fuses, rematerializes and buffer-shares — but it
+  ranks programs and catches order-of-magnitude blowups before any
+  compile (SL301 when a budget is declared).
+- **MXU padding waste** — every dot/conv operand is padded to the TPU
+  tile: (sublane x 128-lane) blocks, 8x128 for f32, 16x128 for bf16,
+  32x128 for int8.  A dim just past a tile boundary pays for the whole
+  next tile; SL302 flags operands whose padded footprint wastes more
+  than the threshold, and the program-wide waste fraction feeds the
+  bench report lane.
+- **f32-storage / bf16-compute** — an input whose only first touch is a
+  convert_element_type f32->bf16 could be stored half-size (SL303).
+
+Module-level imports are stdlib-only; jax types arrive via the jaxpr.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from paddle_tpu.analysis.jaxpr_rules import _iter_eqns, _sub_jaxprs
+from paddle_tpu.analysis.shard_rules import (AuditConfig, _aval_sig,
+                                             _fmt_bytes, _mk_finding,
+                                             _nbytes_of)
+
+__all__ = ["CostReport", "audit_memory", "tile_padded_elems"]
+
+_MIB = 1 << 20
+
+# primitives that execute on the MXU (systolic array) and therefore pay
+# tile padding on their operands
+MXU_PRIMS = ("dot_general", "conv_general_dilated")
+
+
+@dataclass
+class CostReport:
+    """Per-program cost summary (bench lane + CLI report schema)."""
+
+    where: str
+    n_eqns: int = 0
+    peak_hbm_bytes: int = 0
+    top: list = field(default_factory=list)   # [(bytes, label)]
+    mxu_bytes: int = 0
+    mxu_padded_bytes: int = 0
+    n_mxu_ops: int = 0
+
+    @property
+    def padding_waste(self):
+        """Fraction of MXU operand tile footprint that is padding."""
+        if not self.mxu_padded_bytes:
+            return 0.0
+        return 1.0 - self.mxu_bytes / self.mxu_padded_bytes
+
+    def to_dict(self):
+        return {
+            "where": self.where,
+            "n_eqns": self.n_eqns,
+            "peak_hbm_bytes": self.peak_hbm_bytes,
+            "peak_hbm_mb": round(self.peak_hbm_bytes / _MIB, 3),
+            "padding_waste_pct": round(100.0 * self.padding_waste, 2),
+            "n_mxu_ops": self.n_mxu_ops,
+            "top_contributors": [
+                {"bytes": b, "label": lbl} for b, lbl in self.top],
+        }
+
+
+def tile_padded_elems(shape, itemsize):
+    """Element count of `shape` once padded to the MXU tile for the
+    dtype: last dim -> multiple of 128 lanes, second-minor -> multiple
+    of the sublane count (32 // itemsize, min 8)."""
+    if not shape:
+        return 1
+    dims = [max(1, int(d)) for d in shape]
+    sublane = max(8, 32 // max(1, int(itemsize)))
+    dims[-1] = -(-dims[-1] // 128) * 128
+    if len(dims) >= 2:
+        dims[-2] = -(-dims[-2] // sublane) * sublane
+    n = 1
+    for d in dims:
+        n *= d
+    return n
+
+
+def _peak_scan(jaxpr, input_bytes, labels, top_n):
+    """Liveness walk of one (open) jaxpr.
+
+    `input_bytes`: {var: nbytes} for values resident at entry (invars,
+    constvars).  Returns (peak_bytes, [(bytes, label)] at the peak)."""
+    last_use = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            if not hasattr(v, "val"):
+                last_use[v] = i
+    for v in jaxpr.outvars:
+        if not hasattr(v, "val"):
+            last_use[v] = len(jaxpr.eqns)
+
+    live = dict(input_bytes)
+    current = sum(live.values())
+    peak, snapshot = current, sorted(
+        ((b, labels.get(v, "input")) for v, b in live.items()),
+        reverse=True)[:top_n]
+
+    for i, eqn in enumerate(jaxpr.eqns):
+        for ov in eqn.outvars:
+            b = _nbytes_of(ov)
+            live[ov] = b
+            labels[ov] = f"{eqn.primitive.name} {_aval_sig(ov)}"
+            current += b
+        inner_extra = 0
+        for v in eqn.params.values():
+            for sub in _sub_jaxprs(v):
+                inner = getattr(sub, "jaxpr", sub)
+                consts = sum(_nbytes_of(c)
+                             for c in getattr(sub, "consts", []) or [])
+                sub_inputs = {iv: _nbytes_of(iv) for iv in inner.invars}
+                sub_labels = {iv: f"{eqn.primitive.name}-body input "
+                                  f"{_aval_sig(iv)}" for iv in inner.invars}
+                sub_peak, _ = _peak_scan(inner, sub_inputs, sub_labels,
+                                         top_n)
+                # the body's inputs alias operands already counted live;
+                # only the EXTRA allocation inside the body stacks on top
+                inner_extra += max(
+                    0, sub_peak - sum(sub_inputs.values())) + consts
+        candidate = current + inner_extra
+        if candidate > peak:
+            peak = candidate
+            snapshot = sorted(((b, labels.get(v, "?"))
+                               for v, b in live.items()),
+                              reverse=True)[:top_n]
+        for v in list(eqn.invars) + list(eqn.outvars):
+            if hasattr(v, "val"):
+                continue
+            if last_use.get(v, i) <= i and v in live:
+                current -= live.pop(v)
+    return peak, snapshot
+
+
+def audit_memory(closed_jaxpr, where="<traced program>", inputs=None,
+                 config=None):
+    """Run the SL3xx pass; returns ([Finding], CostReport)."""
+    config = config or AuditConfig()
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    findings = []
+
+    # ---- peak HBM (liveness estimate) ----
+    input_bytes, labels = {}, {}
+    names = list(inputs or ())
+    for i, iv in enumerate(jaxpr.invars):
+        input_bytes[iv] = _nbytes_of(iv)
+        nm = names[i].name if i < len(names) else f"arg{i}"
+        labels[iv] = f"input `{nm}` {_aval_sig(iv)}"
+    const_bytes = 0
+    for cv, c in zip(jaxpr.constvars,
+                     getattr(closed_jaxpr, "consts", []) or []):
+        b = int(getattr(c, "nbytes", 0) or 0)
+        input_bytes[cv] = b
+        labels[cv] = f"const {_aval_sig(cv)}"
+        const_bytes += b
+    peak, top = _peak_scan(jaxpr, input_bytes, labels,
+                           config.top_contributors)
+
+    rep = CostReport(where=where,
+                     n_eqns=sum(1 for _ in _iter_eqns(closed_jaxpr)),
+                     peak_hbm_bytes=peak, top=top)
+
+    if config.hbm_budget_bytes and peak > config.hbm_budget_bytes:
+        heads = "; ".join(f"{lbl}={_fmt_bytes(b)}" for b, lbl in top[:3])
+        findings.append(_mk_finding(
+            "SL301",
+            f"{_fmt_bytes(peak)} > budget "
+            f"{_fmt_bytes(config.hbm_budget_bytes)} (top: {heads})",
+            where, sig=f"peak {where}"))
+
+    # ---- MXU tile padding (SL302) ----
+    seen = set()
+    for eqn in _iter_eqns(closed_jaxpr):
+        if eqn.primitive.name not in MXU_PRIMS:
+            continue
+        for opv in eqn.invars[:2]:
+            aval = getattr(opv, "aval", None)
+            dt = getattr(aval, "dtype", None)
+            shape = tuple(getattr(aval, "shape", ()) or ())
+            if dt is None or not shape:
+                continue
+            itemsize = int(getattr(dt, "itemsize", 4) or 4)
+            size = 1
+            for d in shape:
+                size *= int(d)
+            padded = tile_padded_elems(shape, itemsize)
+            rep.mxu_bytes += size * itemsize
+            rep.mxu_padded_bytes += padded * itemsize
+            rep.n_mxu_ops += 1
+            waste = 1.0 - size / padded if padded else 0.0
+            key = (eqn.primitive.name, shape, str(dt))
+            if waste >= config.padding_waste_threshold and \
+                    size * itemsize >= config.mxu_min_bytes and \
+                    key not in seen:
+                seen.add(key)
+                sub = max(8, 32 // itemsize)
+                findings.append(_mk_finding(
+                    "SL302",
+                    f"{_aval_sig(opv)} of `{eqn.primitive.name}` pads to "
+                    f"({sub},128) tiles: {waste * 100:.1f}% waste "
+                    f"({_fmt_bytes(padded * itemsize - size * itemsize)})",
+                    where, eqn=eqn,
+                    sig=f"pad {eqn.primitive.name} {_aval_sig(opv)}"))
+
+    # ---- f32 storage for bf16 compute (SL303) ----
+    # flag an f32 input ONLY when every top-level consumer is a
+    # convert_element_type to bf16 — a param also read in f32 (optimizer
+    # master-weight math, f32 layernorm) legitimately stays f32
+    program_inputs = {iv: i for i, iv in enumerate(jaxpr.invars)}
+    consumers = {}
+    for eqn in jaxpr.eqns:
+        for v in eqn.invars:
+            if not hasattr(v, "val") and v in program_inputs:
+                consumers.setdefault(v, []).append(eqn)
+    for v, eqns in consumers.items():
+        aval = getattr(v, "aval", None)
+        if str(getattr(aval, "dtype", "")) != "float32":
+            continue
+        if _nbytes_of(v) < config.f32_param_min_bytes:
+            continue
+        casts = [e for e in eqns
+                 if e.primitive.name == "convert_element_type"
+                 and str(e.params.get("new_dtype", "")) == "bfloat16"]
+        if casts and len(casts) == len(eqns):
+            nm_i = program_inputs[v]
+            nm = names[nm_i].name if nm_i < len(names) else f"arg{nm_i}"
+            findings.append(_mk_finding(
+                "SL303",
+                f"`{nm}` {_aval_sig(v)} ({_fmt_bytes(_nbytes_of(v))}; "
+                f"bf16 storage would halve it)",
+                where, eqn=casts[0], sig=f"f32->bf16 {nm}"))
+    return findings, rep

@@ -169,6 +169,79 @@ RULES = {r.code: r for r in [
        "fallbacks",
        "use one of the mesh's declared axis names (see init_mesh "
        "axis_names=...)"),
+
+    # ---- SL1xx: sharding (shardlint, analysis/shard_rules.py) ----
+    _R("SL101", "large-replicated-array",
+       "large program input {detail} is fully replicated on every device "
+       "of the mesh",
+       "a replicated array costs its full size in HBM on EVERY chip; past "
+       "a few MiB that is usually an unannotated weight the mesh was "
+       "supposed to shard",
+       "annotate it with shard_tensor(t, ...) / a PartitionSpec over a "
+       "mesh axis, or accept it into the shardlint baseline if the "
+       "replication is intentional"),
+    _R("SL102", "unsharded-optimizer-state",
+       "optimizer state {detail} is replicated under a data-parallel mesh",
+       "optimizer accumulators are pure per-parameter state — replicating "
+       "them across dp ranks wastes HBM that ZeRO stage 1/2 reclaims for "
+       "free (grads already reduce-scatter)",
+       "wrap with distributed.sharding.group_sharded_parallel (stage "
+       "'os'/'os_g'), or shard the accumulator like its parameter"),
+    _R("SL103", "resharding-thrash",
+       "value resharded {detail} — an A->B->A constraint chain",
+       "each conflicting sharding constraint materializes a resharding "
+       "collective; bouncing a value between two layouts pays the "
+       "transfer twice for no net layout change",
+       "pick one layout for the value's lifetime, or move the consumer "
+       "needing the other layout next to the first constraint"),
+
+    # ---- SL2xx: collective safety ----
+    _R("SL201", "collective-order-mismatch",
+       "cond branches issue different collective sequences ({detail})",
+       "under SPMD a collective is a rendezvous: if shards can disagree "
+       "on the branch (or the branches order their collectives "
+       "differently) some chips wait forever — a silent multi-chip "
+       "deadlock",
+       "hoist the collectives out of the cond, or make every branch "
+       "issue the SAME collectives in the SAME order"),
+    _R("SL202", "all-gather-over-budget",
+       "all_gather materializes {detail} — past the per-chip HBM budget",
+       "all_gather multiplies the operand by the axis size on EVERY "
+       "chip; a gather that exceeds the HBM budget OOMs at runtime even "
+       "though each shard individually fits",
+       "keep the value sharded (reduce_scatter + local compute), or "
+       "gather in chunks"),
+    _R("SL203", "loop-invariant-collective",
+       "collective `{detail}` inside a scan body has loop-invariant "
+       "operands",
+       "XLA does not hoist collectives out of loops: a psum/all_gather "
+       "of values that never change inside the scan pays the full "
+       "network latency every iteration",
+       "compute the collective once before the scan and pass the result "
+       "in as a carry/const"),
+
+    # ---- SL3xx: memory & layout cost ----
+    _R("SL301", "peak-hbm-over-budget",
+       "estimated peak HBM {detail}",
+       "the liveness estimate over the traced program exceeds the "
+       "declared per-chip budget — the step will OOM (or silently spill) "
+       "on real silicon",
+       "shard or rematerialize the top contributors (see the cost "
+       "report), shrink the batch, or raise the documented budget"),
+    _R("SL302", "mxu-padding-waste",
+       "operand {detail} — padded to the MXU tile, wasting compute/HBM",
+       "TPU tiles are (sublane, 128-lane) blocks — 8x128 f32, 16x128 "
+       "bf16; a dim just past a tile boundary pays for the whole next "
+       "tile in both memory and MXU cycles",
+       "round the dim to a multiple of 128 (lane) / the dtype sublane "
+       "count, e.g. pad vocab or hidden sizes at model-config time"),
+    _R("SL303", "f32-param-bf16-compute",
+       "f32 input {detail} is only consumed through a bf16 cast",
+       "storing a parameter in f32 when every use first converts it to "
+       "bf16 doubles its HBM residency and the cast bandwidth every "
+       "step",
+       "store the parameter in bf16 (keep an f32 master copy only where "
+       "the optimizer needs it)"),
 ]}
 
 
@@ -178,6 +251,10 @@ def message_for(code, detail=""):
 
 
 # Codes whose AST rules only make sense on functions REACHED from a
-# @to_static entry (everything, today — kept explicit for the CLI docs).
-AST_CODES = tuple(c for c in RULES if c < "TL400")
-JAXPR_CODES = tuple(c for c in RULES if c >= "TL400")
+# @to_static entry (everything AST-side, today — kept explicit for the
+# CLI docs).  SLxxx codes are all post-trace (jaxpr-level): the
+# shardlint passes in shard_rules.py / cost_audit.py.
+AST_CODES = tuple(c for c in RULES if c.startswith("TL") and c < "TL400")
+JAXPR_CODES = tuple(c for c in RULES
+                    if c.startswith("SL") or c >= "TL400")
+SHARDLINT_CODES = tuple(c for c in RULES if c.startswith("SL"))

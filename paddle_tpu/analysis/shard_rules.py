@@ -1,0 +1,508 @@
+"""shardlint SL1xx/SL2xx: sharding & collective-safety audit of jaxprs.
+
+tracelint's TL4xx pass stops at "does this collective have a mesh";
+shardlint goes the rest of the way: an abstract-interpretation walk over
+the traced program that knows shapes, dtypes and shardings per eqn and
+asks the questions that decide whether the program SCALES —
+
+- SL1xx sharding: large arrays left fully replicated on a multi-device
+  mesh (SL101), optimizer state unsharded under data parallelism
+  (SL102), and A->B->A resharding-constraint thrash (SL103);
+- SL2xx collective safety: cond branches whose collective sequences
+  diverge and can deadlock SPMD shards (SL201), all_gathers that
+  materialize past the per-chip HBM budget (SL202), and loop-invariant
+  collectives trapped inside scan bodies (SL203).
+
+The SL3xx memory/layout pass lives in :mod:`cost_audit`; the shared
+driver is :func:`paddle_tpu.analysis.audit_jaxpr`.
+
+Sharding facts come from two places: the `dist_spec` annotations on the
+lifted state tensors (:func:`input_infos_from_state` — available even
+when tracing on a single CPU device, which is the whole point of a
+STATIC auditor) and `sharding_constraint` eqns when the program was
+traced under a real mesh.  The mesh itself can be hypothetical: pass
+``MeshInfo.of(axes={"dp": 8, "tp": 4})`` to audit a CPU-traced program
+against the production topology before any TPU time is spent.
+
+Findings resolve back to a source line through each eqn's jax
+source_info, so the ordinary ``# tracelint: disable=SL201`` per-line
+suppressions apply (see :func:`apply_suppressions`).
+
+Module-level imports are stdlib-only (jax loads lazily inside the
+checks) so `tools/` CLIs can import the package light.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+from paddle_tpu.analysis.jaxpr_rules import (COLLECTIVE_PRIMS, _axis_names,
+                                             _iter_eqns, _sub_jaxprs)
+from paddle_tpu.analysis.rules import message_for
+from paddle_tpu.analysis.visitor import Finding, parse_suppressions, rel_path
+
+__all__ = [
+    "AuditConfig", "MeshInfo", "InputInfo", "input_infos_from_state",
+    "check_sharding", "check_collectives", "apply_suppressions",
+]
+
+_MIB = 1 << 20
+
+# finding paths (and therefore baseline fingerprints) anchor to the REPO
+# root, not the CWD — `shardlint --check` must agree with the checked-in
+# baseline no matter where it is invoked from
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+@dataclass(frozen=True)
+class AuditConfig:
+    """Thresholds for the SL rule families (one knob set shared by the
+    CLI, the to_static(audit=True) hook, and the serving self-audit)."""
+
+    # SL101: smallest replicated array worth flagging
+    large_replicated_bytes: int = 16 * _MIB
+    # SL102: smallest optimizer-state tensor worth flagging
+    opt_state_min_bytes: int = 64 << 10
+    # SL202: per-chip budget an all_gather result may not exceed
+    allgather_budget_bytes: int = 1 << 30
+    # SL301: peak-HBM budget (None = report the estimate, never flag)
+    hbm_budget_bytes: int = None
+    # SL302: minimum waste fraction + operand size to flag
+    padding_waste_threshold: float = 0.15
+    mxu_min_bytes: int = 16 << 10
+    # SL303: smallest f32 input worth flagging
+    f32_param_min_bytes: int = 64 << 10
+    # cost report: how many peak contributors to name
+    top_contributors: int = 5
+
+
+@dataclass(frozen=True)
+class MeshInfo:
+    """The (possibly hypothetical) device mesh an audit runs against."""
+
+    axis_sizes: tuple  # ((axis_name, size), ...)
+
+    @classmethod
+    def of(cls, mesh=None, axes=None):
+        """From an explicit ``axes`` dict, a jax Mesh (or anything with
+        ``axis_names`` + a ``shape`` mapping), or the installed global
+        mesh.  Returns None when no mesh is known anywhere."""
+        if axes:
+            return cls(tuple((str(a), int(n)) for a, n in axes.items()))
+        if mesh is None:
+            from paddle_tpu.distributed import mesh as dmesh
+            mesh = dmesh.get_mesh()
+        if mesh is None:
+            return None
+        shape = dict(getattr(mesh, "shape", None) or {})
+        names = tuple(getattr(mesh, "axis_names", ()) or ())
+        return cls(tuple((str(a), int(shape.get(a, 1))) for a in names))
+
+    @property
+    def axis_names(self):
+        return tuple(a for a, _ in self.axis_sizes)
+
+    def size(self, name, default=1):
+        return dict(self.axis_sizes).get(name, default)
+
+    @property
+    def n_devices(self):
+        n = 1
+        for _, s in self.axis_sizes:
+            n *= s
+        return n
+
+    def describe(self):
+        return "x".join(f"{a}={s}" for a, s in self.axis_sizes) or "<empty>"
+
+
+# Accumulator names from optimizer/: a state tensor named
+# `{param}_{acc_name}` (see Optimizer._acc) is optimizer state.  Exact
+# SUFFIX match against the known accumulator names — a substring match
+# would misclassify a param that merely contains "moment" in its name.
+OPT_STATE_SUFFIXES = tuple(
+    "_" + n for n in (
+        "moment", "moment1", "moment2", "momentum", "velocity",
+        "inf_norm", "mean_square", "mean_grad", "avg_squared_grad",
+        "avg_squared_update", "acc_grad", "gm_acc", "gm_count", "master",
+        "beta1_pow", "beta2_pow", "sum_1", "sum_2", "sum_3", "dfl_step",
+    ))
+
+
+@dataclass
+class InputInfo:
+    """What the auditor knows about one program input (jaxpr invar)."""
+
+    name: str
+    kind: str = "input"      # param | opt_state | input | other
+    spec: tuple = None       # PartitionSpec entries (None = replicated)
+    shape: tuple = ()
+    dtype: str = ""
+    nbytes: int = 0
+
+    def sharded_over(self, mesh):
+        """Mesh axes (size > 1) this input is actually partitioned on."""
+        if not self.spec or mesh is None:
+            return ()
+        axes = []
+        for entry in self.spec:
+            entry = entry if isinstance(entry, (list, tuple)) else (entry,)
+            axes.extend(a for a in entry
+                        if isinstance(a, str) and mesh.size(a) > 1)
+        return tuple(axes)
+
+
+def input_infos_from_state(state_tensors):
+    """InputInfos for to_static's lifted state list, in lift order.
+
+    kind comes from paddle_tpu naming: optimizer accumulators are named
+    `{param}_{marker}` (OPT_STATE_MARKERS); everything else persistable
+    counts as a parameter/buffer.  Sharding comes from the `dist_spec`
+    annotation (mesh-independent, set by shard_tensor)."""
+    from paddle_tpu.distributed.mesh import get_dist_spec
+    infos = []
+    for t in state_tensors:
+        name = getattr(t, "name", "") or ""
+        kind = "opt_state" if name.endswith(OPT_STATE_SUFFIXES) else "param"
+        spec = get_dist_spec(t)
+        v = getattr(t, "_value", None)
+        shape = tuple(getattr(v, "shape", ()) or ())
+        dtype = str(getattr(v, "dtype", ""))
+        nbytes = int(getattr(v, "nbytes", 0) or 0)
+        infos.append(InputInfo(name=name, kind=kind,
+                               spec=tuple(spec) if spec is not None else None,
+                               shape=shape, dtype=dtype, nbytes=nbytes))
+    return infos
+
+
+# ----------------------------------------------------------- finding plumbing
+def _eqn_site(eqn):
+    """(abs_path, line) of the first USER frame that emitted this eqn,
+    or (None, 0) — jax's source_info survives tracing, so a jaxpr
+    finding can point at real code (and per-line suppressions apply)."""
+    try:
+        from jax._src import source_info_util as siu
+        fr = siu.user_frame(eqn.source_info)
+        if fr is not None and fr.file_name and os.path.exists(fr.file_name):
+            return fr.file_name, int(fr.start_line or 0)
+    except Exception:
+        pass
+    return None, 0
+
+
+def _mk_finding(code, detail, where, eqn=None, sig=""):
+    path, line = _eqn_site(eqn) if eqn is not None else (None, 0)
+    return Finding(
+        path=rel_path(path, base=_REPO_ROOT) if path else where,
+        line=line, col=0,
+        code=code, message=message_for(code, detail=detail),
+        # for non-file findings the stable signature doubles as the
+        # baseline fingerprint text (report.fingerprint hashes it)
+        source_line=sig)
+
+
+def _fmt_bytes(n):
+    if n >= _MIB:
+        return f"{n / _MIB:.1f} MiB"
+    return f"{n / 1024:.1f} KiB"
+
+
+def _aval_sig(v):
+    aval = getattr(v, "aval", None)
+    dt = getattr(aval, "dtype", None)
+    shape = tuple(getattr(aval, "shape", ()) or ())
+    return f"{getattr(dt, 'name', dt)}{list(shape)}"
+
+
+# ----------------------------------------------------------------- SL1xx
+def check_sharding(closed_jaxpr, inputs=None, mesh=None, config=None,
+                   where="<traced program>"):
+    """SL101/SL102 over the program inputs + SL103 over constraint eqns."""
+    config = config or AuditConfig()
+    mesh = mesh if isinstance(mesh, MeshInfo) else MeshInfo.of(mesh)
+    findings = []
+
+    if mesh is not None and mesh.n_devices > 1:
+        for info in inputs or ():
+            if info.sharded_over(mesh):
+                continue
+            if info.kind == "opt_state" and \
+                    info.nbytes >= config.opt_state_min_bytes:
+                findings.append(_mk_finding(
+                    "SL102",
+                    f"`{info.name}` ({_fmt_bytes(info.nbytes)}, "
+                    f"{info.dtype}{list(info.shape)}) on mesh "
+                    f"{mesh.describe()}",
+                    where, sig=f"opt_state {info.name}"))
+            elif info.kind == "param" and \
+                    info.nbytes >= config.large_replicated_bytes:
+                findings.append(_mk_finding(
+                    "SL101",
+                    f"`{info.name}` ({_fmt_bytes(info.nbytes)}, "
+                    f"{info.dtype}{list(info.shape)}) on mesh "
+                    f"{mesh.describe()}",
+                    where, sig=f"param {info.name}"))
+
+    _thrash_walk(getattr(closed_jaxpr, "jaxpr", closed_jaxpr),
+                 findings, where)
+    return findings
+
+
+def _norm_spec(sharding):
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return None
+    out = []
+    for e in tuple(spec):
+        out.append(tuple(e) if isinstance(e, (list, tuple)) else e)
+    return tuple(out)
+
+
+def _thrash_walk(jaxpr, findings, where):
+    """SL103: follow sharding_constraint chains through dataflow and
+    flag A->B->A bounces (one finding per bounce site)."""
+    hist = {}  # var -> tuple of constraint specs on its lineage
+    for eqn in jaxpr.eqns:
+        inherited = ()
+        for v in eqn.invars:
+            if hasattr(v, "val"):     # Literal
+                continue
+            h = hist.get(v)
+            if h:
+                inherited = h
+                break
+        if eqn.primitive.name == "sharding_constraint":
+            spec = _norm_spec(eqn.params.get("sharding"))
+            if spec is not None:
+                if inherited and inherited[-1] != spec and spec in inherited:
+                    findings.append(_mk_finding(
+                        "SL103",
+                        f"{inherited[-1]} -> {spec} "
+                        f"(earlier already {spec})",
+                        where, eqn=eqn,
+                        sig=f"thrash {inherited[-1]}->{spec}"))
+                inherited = inherited + (spec,)
+        if inherited:
+            for ov in eqn.outvars:
+                hist[ov] = inherited
+        for v in eqn.params.values():
+            for sub in _sub_jaxprs(v):
+                _thrash_walk(getattr(sub, "jaxpr", sub), findings, where)
+
+
+# ----------------------------------------------------------------- SL2xx
+def check_collectives(closed_jaxpr, mesh=None, config=None,
+                      where="<traced program>"):
+    """SL201 (branch-divergent collectives), SL202 (all_gather size),
+    SL203 (loop-invariant collectives in scan bodies)."""
+    config = config or AuditConfig()
+    findings = []
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+
+    # SL202: the gathered aval already has the post-gather shape
+    seen = set()
+    for eqn in _iter_eqns(closed_jaxpr):
+        if eqn.primitive.name not in ("all_gather", "all_to_all"):
+            continue
+        out = eqn.outvars[0]
+        nbytes = _nbytes_of(out)
+        key = (eqn.primitive.name, _aval_sig(out))
+        if nbytes >= config.allgather_budget_bytes and key not in seen:
+            seen.add(key)
+            findings.append(_mk_finding(
+                "SL202",
+                f"{_aval_sig(out)} = {_fmt_bytes(nbytes)} per chip "
+                f"(budget {_fmt_bytes(config.allgather_budget_bytes)})",
+                where, eqn=eqn, sig=f"all_gather {_aval_sig(out)}"))
+
+    _branch_walk(jaxpr, findings, where)
+    _scan_walk(jaxpr, findings, where)
+    return findings
+
+
+# COLLECTIVE_PRIMS entries that perform NO cross-chip communication
+# (axis_index reads the local coordinate; pbroadcast is a type-level
+# rebinding): they cannot deadlock and cost nothing per scan iteration,
+# so SL201/SL203 must not treat them as rendezvous points.
+NON_RENDEZVOUS_PRIMS = ("axis_index", "pbroadcast")
+
+
+def _rendezvous_axes(eqn):
+    if eqn.primitive.name in NON_RENDEZVOUS_PRIMS:
+        return None
+    return _axis_names(eqn)
+
+
+def _collective_signature(jaxpr_like):
+    """STRUCTURED (primitive, axes) sequence of the collectives a
+    (sub)jaxpr issues — the rendezvous schedule SPMD shards must agree
+    on.  Control flow is kept structural rather than flattened: a
+    nested cond whose branches all agree contributes that common
+    schedule once (every runtime path issues it exactly once); a
+    divergent nested cond becomes an opaque token (it gets its own
+    SL201 from the recursive walk); a loop wraps its body's schedule in
+    a (loop, ...) token, since its collectives repeat per iteration and
+    must not compare equal to a single straight-line issue."""
+    sig = []
+    jx = getattr(jaxpr_like, "jaxpr", jaxpr_like)
+    for eqn in jx.eqns:
+        prim = eqn.primitive.name
+        if prim == "cond":
+            subs = [_collective_signature(b)
+                    for b in eqn.params.get("branches", ())]
+            if subs and all(s == subs[0] for s in subs):
+                sig.extend(subs[0])
+            elif any(subs):
+                sig.append(("cond!", tuple(subs)))
+        elif prim in ("scan", "while"):
+            inner = []
+            for v in eqn.params.values():
+                for sub in _sub_jaxprs(v):
+                    inner.extend(_collective_signature(sub))
+            if inner:
+                sig.append((prim, tuple(inner)))
+        else:
+            names = _rendezvous_axes(eqn)
+            if names:
+                sig.append((prim, names))
+            for v in eqn.params.values():
+                for sub in _sub_jaxprs(v):
+                    sig.extend(_collective_signature(sub))
+    return tuple(sig)
+
+
+def _fmt_sig(sig):
+    return "[" + ", ".join(
+        f"{p}@{list(a)}" if a and isinstance(a[0], str) else f"{p}{{...}}"
+        for p, a in sig) + "]"
+
+
+def _branch_walk(jaxpr, findings, where):
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "cond":
+            branches = eqn.params.get("branches", ())
+            sigs = [_collective_signature(b) for b in branches]
+            if len(set(sigs)) > 1:
+                desc = " vs ".join(_fmt_sig(s) for s in sigs)
+                findings.append(_mk_finding(
+                    "SL201", desc, where, eqn=eqn,
+                    sig=f"cond {desc}"))
+        for v in eqn.params.values():
+            for sub in _sub_jaxprs(v):
+                _branch_walk(getattr(sub, "jaxpr", sub), findings, where)
+
+
+def _scan_walk(jaxpr, findings, where):
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        if prim == "scan":
+            body = eqn.params.get("jaxpr")
+            if body is not None:
+                _flag_invariant_collectives(
+                    getattr(body, "jaxpr", body),
+                    int(eqn.params.get("num_consts", 0)),
+                    findings, where, loop=prim)
+        elif prim == "while":
+            body = eqn.params.get("body_jaxpr")
+            if body is not None:
+                _flag_invariant_collectives(
+                    getattr(body, "jaxpr", body),
+                    int(eqn.params.get("body_nconsts", 0)),
+                    findings, where, loop=prim)
+        for v in eqn.params.values():
+            for sub in _sub_jaxprs(v):
+                _scan_walk(getattr(sub, "jaxpr", sub), findings, where)
+
+
+def _flag_invariant_collectives(body, num_consts, findings, where,
+                                loop="scan"):
+    """SL203: inside one loop body, a collective whose operands depend
+    only on the body's consts (loop-invariant) re-runs every iteration
+    for the same answer.  Sub-jaxprs fed ONLY invariant operands are
+    entirely invariant, so a collective anywhere inside them flags too;
+    sub-jaxprs touching variant operands are skipped conservatively
+    (inner loops get their own pass from _scan_walk)."""
+    variant = set(body.invars[num_consts:])   # carry + xs change per iter
+    for eqn in body.eqns:
+        ins_variant = any(v in variant for v in eqn.invars
+                          if not hasattr(v, "val"))
+        subs = [s for v in eqn.params.values() for s in _sub_jaxprs(v)]
+
+        def _flag(e, names):
+            findings.append(_mk_finding(
+                "SL203",
+                f"{e.primitive.name}(axis={list(names)})",
+                where, eqn=e,
+                sig=f"{loop} {e.primitive.name}@{list(names)}"))
+
+        if not ins_variant:
+            names = _rendezvous_axes(eqn)
+            if names:
+                _flag(eqn, names)
+            # nested loops are excluded: _scan_walk gives their bodies
+            # their own invariance pass (flagging here would duplicate)
+            if eqn.primitive.name not in ("scan", "while"):
+                for sub in subs:
+                    for inner in _iter_eqns(sub):
+                        inner_names = _rendezvous_axes(inner)
+                        if inner_names:
+                            _flag(inner, inner_names)
+        else:
+            variant.update(eqn.outvars)
+
+
+def _nbytes_of(v):
+    aval = getattr(v, "aval", None)
+    size = getattr(aval, "size", None)
+    dt = getattr(aval, "dtype", None)
+    if size is None or dt is None:
+        return 0
+    return int(size) * int(getattr(dt, "itemsize", 0) or 0)
+
+
+# ----------------------------------------------------------- suppressions
+_src_cache = {}
+
+
+def _file_suppressions(path):
+    """(lineno -> codes, skip_file) for `path`, cached per file."""
+    hit = _src_cache.get(path)
+    if hit is not None:
+        return hit
+    try:
+        with open(path, encoding="utf-8", errors="replace") as fh:
+            source = fh.read()
+    except OSError:
+        source = ""
+    sup, skip = parse_suppressions(source)
+    if len(_src_cache) > 256:
+        _src_cache.clear()
+    _src_cache[path] = (sup, skip)
+    return _src_cache[path]
+
+
+def apply_suppressions(findings):
+    """Drop findings whose resolved source line carries a
+    `# tracelint: disable=<code>` (or `# shardlint:`, SL-scoped)
+    comment, exactly like the AST pass.  Findings without a real file
+    site pass through untouched — their baseline fingerprints hash the
+    stable `sig` every _mk_finding sets as source_line."""
+    out = []
+    for f in findings:
+        path = None
+        for cand in (f.path, os.path.join(_REPO_ROOT, f.path)):
+            if os.path.exists(cand):
+                path = cand
+                break
+        if path is None or f.line <= 0:
+            out.append(f)
+            continue
+        sup, skip = _file_suppressions(path)
+        if skip:
+            continue
+        codes = sup.get(f.line, ())
+        if "ALL" in codes or "ALL:SL" in codes or f.code in codes:
+            continue
+        out.append(f)
+    return out

@@ -39,23 +39,35 @@ class Finding:
                 "source_line": self.source_line}
 
 
-_DISABLE_RE = re.compile(r"#\s*tracelint:\s*disable=([A-Za-z0-9,\s]+)")
+# one suppression syntax for BOTH analyzers: `# tracelint: disable=...`
+# silences TLxxx and SLxxx codes alike (shardlint findings resolve back
+# to a source line via the eqn's jax source_info).  `# shardlint:` is an
+# accepted alias but scoped to the SL family only — its `ALL` becomes
+# the marker 'ALL:SL' and non-SL codes are dropped, so a shardlint-
+# spelled comment can never waive a trace-safety (TL) finding.
+# skip-file stays tracelint-spelled only, for the same reason.
+_DISABLE_RE = re.compile(
+    r"#\s*(tracelint|shardlint):\s*disable=([A-Za-z0-9,\s]+)")
 _SKIP_FILE_RE = re.compile(r"^\s*#\s*tracelint:\s*skip-file\s*$")
 
 
 def parse_suppressions(source):
-    """lineno -> set of suppressed codes ('ALL' suppresses everything).
-    Returns (mapping, skip_file)."""
+    """lineno -> set of suppressed codes ('ALL' suppresses everything;
+    'ALL:SL' suppresses every SL code). Returns (mapping, skip_file)."""
     sup = {}
     skip = False
     for i, raw in enumerate(source.splitlines(), start=1):
         if _SKIP_FILE_RE.match(raw):
             skip = True
-        m = _DISABLE_RE.search(raw)
-        if m:
-            codes = {c.strip().upper() for c in m.group(1).split(",")
+        # finditer: a line may carry BOTH spellings, and each merges
+        for m in _DISABLE_RE.finditer(raw):
+            codes = {c.strip().upper() for c in m.group(2).split(",")
                      if c.strip()}
-            sup[i] = codes
+            if m.group(1) == "shardlint":
+                codes = {"ALL:SL" if c == "ALL" else c
+                         for c in codes if c == "ALL"
+                         or c.startswith("SL")}
+            sup[i] = sup.get(i, set()) | codes
     return sup, skip
 
 

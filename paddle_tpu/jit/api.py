@@ -49,6 +49,20 @@ def _in_to_static_trace():
     return getattr(_trace_state, "active", False)
 
 
+def _audit_input_infos(state_list, tensor_vals):
+    """InputInfos for one traced signature's jaxpr invars: the lifted
+    state tensors then the user tensor args.  ONE builder for both the
+    audit=True hook and traced_program, so the same defect fingerprints
+    identically no matter which path found it."""
+    from paddle_tpu import analysis
+    infos = analysis.input_infos_from_state(state_list)
+    for i, v in enumerate(tensor_vals):
+        infos.append(analysis.InputInfo(
+            name=f"arg{i}", kind="input", shape=tuple(v.shape),
+            dtype=str(v.dtype), nbytes=int(getattr(v, "nbytes", 0) or 0)))
+    return infos
+
+
 def note_grad_cleared(uid):
     """Called by Tensor.clear_grad: records, during a to_static trace,
     that the step clears this tensor's grad (see _CompiledEntry)."""
@@ -102,12 +116,18 @@ class StaticFunction:
     """Callable wrapper produced by @to_static."""
 
     def __init__(self, function, input_spec=None, build_strategy=None,
-                 backend=None, donate_state=True, check=False):
+                 backend=None, donate_state=True, check=False, audit=False):
         self._raw_function = function
         # opt-in tracelint (analysis/): AST pass now, jaxpr pass at the
         # first compile of each signature — findings surface as
         # TracelintWarning instead of opaque trace-time errors
         self._check = bool(check)
+        # opt-in shardlint (analysis/shard_rules + cost_audit): the full
+        # SL-rule sharding/collective/memory audit of each signature's
+        # traced jaxpr at first compile — findings surface as
+        # ShardlintWarning; the latest CostReport lands on .last_audit
+        self._audit = bool(audit)
+        self.last_audit = None
         if self._check:
             from paddle_tpu import analysis
             analysis.warn_findings(analysis.lint_callable(function))
@@ -181,10 +201,14 @@ class StaticFunction:
                 snap.restore()
         return pure
 
-    def __call__(self, *args, **kwargs):
-        leaves, in_treedef = _tree.tree_flatten((args, kwargs), is_leaf=_is_tensor)
-        tensor_vals = []
-        static_leaves = []
+    @staticmethod
+    def _flatten_inputs(args, kwargs):
+        """One flatten rule for every path that traces this function
+        (__call__ and traced_program): tensor-like leaves become traced
+        array inputs, everything else is a static (cache-keying) leaf."""
+        leaves, in_treedef = _tree.tree_flatten((args, kwargs),
+                                                is_leaf=_is_tensor)
+        tensor_vals, static_leaves = [], []
         for l in leaves:
             if isinstance(l, Tensor):
                 tensor_vals.append(l._value)
@@ -194,6 +218,11 @@ class StaticFunction:
                 static_leaves.append(_ARRAY)
             else:
                 static_leaves.append(l)
+        return in_treedef, tensor_vals, static_leaves
+
+    def __call__(self, *args, **kwargs):
+        in_treedef, tensor_vals, static_leaves = self._flatten_inputs(
+            args, kwargs)
 
         for attempt in range(3):
             state_list = _ordered_state()
@@ -225,14 +254,23 @@ class StaticFunction:
                 # Discovery trace (no execution, nothing donated): lazily
                 # created state (optimizer accumulators, RNG key) registers
                 # during the trace; if that happened, retrace with it lifted.
-                if self._check:
+                if self._check or self._audit:
                     # trace() exposes the jaxpr for the post-trace lint
-                    # (TL4xx) at no extra cost vs the discovery lower()
+                    # (TL4xx) / shardlint audit at no extra cost vs the
+                    # discovery lower()
                     traced = jitted.trace(state_vals, tensor_vals)
                     from paddle_tpu import analysis
-                    analysis.warn_findings(analysis.check_jaxpr(
-                        traced.jaxpr,
-                        where=f"<to_static {self.__name__}>"))
+                    where = f"<to_static {self.__name__}>"
+                    if self._check:
+                        analysis.warn_findings(
+                            analysis.check_jaxpr(traced.jaxpr, where=where))
+                    if self._audit:
+                        infos = _audit_input_infos(state_list, tensor_vals)
+                        findings, self.last_audit = analysis.audit_jaxpr(
+                            traced.jaxpr, where=where, inputs=infos)
+                        analysis.warn_findings(
+                            findings, category=analysis.ShardlintWarning,
+                            prefix="shardlint")
                 else:
                     jitted.lower(state_vals, tensor_vals)
                 if fstate.registry_version() != reg_ver:
@@ -275,6 +313,35 @@ class StaticFunction:
         leaves = [Tensor(next(it)) if s is _ARRAY else s for s in out_static]
         return _tree.tree_unflatten(out_treedef, leaves)
 
+    def traced_program(self, *args, **kwargs):
+        """Trace (never compile or run) this signature; returns
+        ``(closed_jaxpr, input_infos)`` where `input_infos` is one
+        :class:`analysis.InputInfo` per jaxpr invar — the lifted state
+        tensors (with their names, kinds and dist_spec shardings) then
+        the user tensor args.  This is the entry point shardlint's CLI
+        and bench lane use to audit a program without paying a compile.
+        """
+        in_treedef, tensor_vals, static_leaves = self._flatten_inputs(
+            args, kwargs)
+        # same discovery-retrace loop as __call__ (lazily created state
+        # registers during the first trace), minus donation/compilation
+        for attempt in range(3):
+            state_list = _ordered_state()
+            state_vals = [t._value for t in state_list]
+            reg_ver = fstate.registry_version()
+            self._trace_state_list = state_list
+            pure = self._make_pure(in_treedef, len(state_vals),
+                                   static_leaves)
+            traced = jax.jit(pure).trace(state_vals, tensor_vals)
+            if fstate.registry_version() != reg_ver:
+                # lazily created state (optimizer accumulators, the RNG
+                # key) registered during the trace: retrace with it
+                # lifted so the audit sees it as a named input
+                continue
+            return traced.jaxpr, _audit_input_infos(state_list, tensor_vals)
+        raise RuntimeError(
+            "to_static: state registry kept changing during trace")
+
     def concrete_program(self, *args, **kwargs):
         raise NotImplementedError
 
@@ -298,7 +365,7 @@ def _hashable(x):
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, check=False, **kwargs):
+              backend=None, check=False, audit=False, **kwargs):
     """Decorator/wrapper: compile a dygraph function or Layer to one XLA program.
 
     Usage matches paddle.jit.to_static: bare decorator, decorator with
@@ -308,16 +375,23 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     pass over the function and its module-local reach at wrap time, and
     a jaxpr pass after each first-compile — hazards are reported as
     ``TracelintWarning`` with TLxxx codes and file:line.
+
+    ``audit=True`` opts into shardlint: the SL-rule sharding /
+    collective-safety / memory-layout audit of each signature's traced
+    jaxpr at first compile.  Findings surface as ``ShardlintWarning``
+    and the latest :class:`analysis.CostReport` (estimated peak HBM,
+    MXU padding waste) is kept on ``fn.last_audit``.
     """
     from paddle_tpu.nn.layer.layers import Layer
 
     def wrap(fn):
         if isinstance(fn, Layer):
-            static = StaticFunction(fn.forward, input_spec, check=check)
+            static = StaticFunction(fn.forward, input_spec, check=check,
+                                    audit=audit)
             fn.forward = static
             fn._static_forward = static
             return fn
-        return StaticFunction(fn, input_spec, check=check)
+        return StaticFunction(fn, input_spec, check=check, audit=audit)
 
     if function is not None:
         return wrap(function)

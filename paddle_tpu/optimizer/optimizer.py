@@ -68,6 +68,16 @@ class Optimizer:
             # rebuild a concrete initial value (see jit.api._StateSnapshot)
             t.__dict__["_reinit"] = lambda: jnp.full(shp, init, dt)
             register_state_tensor(t)
+            # a same-shaped accumulator of a sharded parameter inherits
+            # the parameter's PartitionSpec: moments of a tp-sharded
+            # weight living replicated on every chip is pure HBM waste
+            # (shardlint SL102) — the update math is elementwise over
+            # the param, so the param's layout is always legal for it
+            from paddle_tpu.distributed.mesh import (get_dist_spec,
+                                                     shard_tensor)
+            spec = get_dist_spec(p)
+            if spec is not None and shp == tuple(jnp.shape(p._value)):
+                shard_tensor(t, *spec)
             self._accumulators[key] = t
         return self._accumulators[key]
 

@@ -562,10 +562,10 @@ class LLMEngine:
             for t, v in saved:
                 t._value = v
 
-    def _get_prefill(self, bucket):
-        key = ("prefill", bucket)
-        if key in self._compiled:
-            return self._compiled[key]
+    def _prefill_program(self, bucket):
+        """(fn, example_args, donate) for one prefill bucket — shared by
+        the compile path and the shardlint self-audit (which traces the
+        SAME program, never a lookalike)."""
         cfg = self.config
 
         def prefill(params, k_pools, v_pools, row_table, ids, pos_ids,
@@ -579,17 +579,14 @@ class LLMEngine:
                 axis=1)[:, 0]
             return (last.astype(jnp.float32), ctx.k_pools, ctx.v_pools)
 
-        return self._compile(key, prefill, (
+        return prefill, (
             self._params, self._k_pools, self._v_pools,
             jnp.zeros((1, cfg.max_pages_per_seq), jnp.int32),
             jnp.zeros((1, bucket), jnp.int32),
             jnp.zeros((1, bucket), jnp.int32),
-            jnp.zeros((1,), jnp.int32)), donate=(1, 2))
+            jnp.zeros((1,), jnp.int32)), (1, 2)
 
-    def _get_decode(self):
-        key = ("decode",)
-        if key in self._compiled:
-            return self._compiled[key]
+    def _decode_program(self):
         cfg = self.config
 
         def decode(params, k_pools, v_pools, tables, lens, tokens):
@@ -599,25 +596,106 @@ class LLMEngine:
             return (logits[:, 0].astype(jnp.float32),
                     ctx.k_pools, ctx.v_pools)
 
-        return self._compile(key, decode, (
+        return decode, (
             self._params, self._k_pools, self._v_pools,
             jnp.zeros((cfg.max_num_seqs, cfg.max_pages_per_seq),
                       jnp.int32),
             jnp.zeros((cfg.max_num_seqs,), jnp.int32),
-            jnp.zeros((cfg.max_num_seqs, 1), jnp.int32)), donate=(1, 2))
+            jnp.zeros((cfg.max_num_seqs, 1), jnp.int32)), (1, 2)
 
-    def _get_sampler(self, width):
-        key = ("sample", width)
-        if key in self._compiled:
-            return self._compiled[key]
+    def _sampler_program(self, width):
         V = int(self._model.config.vocab_size)
-        return self._compile(key, sample_tokens, (
+        return sample_tokens, (
             jnp.zeros((width, V), jnp.float32),
             jnp.zeros((width,), jnp.int32),
             jnp.zeros((width,), jnp.int32),
             jnp.zeros((width,), jnp.float32),
             jnp.zeros((width,), jnp.int32),
-            jnp.ones((width,), jnp.float32)))
+            jnp.ones((width,), jnp.float32)), ()
+
+    def _get_prefill(self, bucket):
+        key = ("prefill", bucket)
+        if key in self._compiled:
+            return self._compiled[key]
+        fn, example, donate = self._prefill_program(bucket)
+        return self._compile(key, fn, example, donate=donate)
+
+    def _get_decode(self):
+        key = ("decode",)
+        if key in self._compiled:
+            return self._compiled[key]
+        fn, example, donate = self._decode_program()
+        return self._compile(key, fn, example, donate=donate)
+
+    def _get_sampler(self, width):
+        key = ("sample", width)
+        if key in self._compiled:
+            return self._compiled[key]
+        fn, example, donate = self._sampler_program(width)
+        return self._compile(key, fn, example, donate=donate)
+
+    # ---------------------------------------------------- self-audit
+    @property
+    def params_bytes(self):
+        return sum(int(v.nbytes) for v in self._params.values())
+
+    @property
+    def kv_pool_bytes(self):
+        """Total bytes of the paged K+V pools across all layers (the
+        page budget, in bytes)."""
+        return sum(int(p.nbytes) for p in self._k_pools) + \
+            sum(int(p.nbytes) for p in self._v_pools)
+
+    @property
+    def hbm_budget_bytes(self):
+        """Documented per-program peak-HBM budget: weights + both the
+        input and output aliases of the KV pools (XLA donates them, but
+        the static estimate sees both live) + a fixed activations
+        margin.  The decode/prefill programs must stay inside this —
+        asserted by the shardlint self-audit gate in CI."""
+        return self.params_bytes + 2 * self.kv_pool_bytes + (64 << 20)
+
+    def audit_programs(self):
+        """{name: ClosedJaxpr} for every program the engine will ever
+        compile, traced (not compiled) from the same builders."""
+        import jax
+        progs = {}
+        for b in self.config.prefill_buckets:
+            fn, example, _ = self._prefill_program(b)
+            progs[f"prefill_{b}"] = jax.jit(fn).trace(*example).jaxpr
+        fn, example, _ = self._decode_program()
+        progs["decode"] = jax.jit(fn).trace(*example).jaxpr
+        for width in (1, self.config.max_num_seqs):
+            fn, example, _ = self._sampler_program(width)
+            progs[f"sample_{width}"] = jax.jit(fn).trace(*example).jaxpr
+        return progs
+
+    def audit(self, config=None):
+        """shardlint self-audit: run the SL-rule audit over every engine
+        program against the documented compile + page budgets.  Returns
+        a plain dict (JSON-able) — the CI gate asserts every program's
+        ``within_budget`` and that the compile bound holds."""
+        from paddle_tpu import analysis
+        cfg = config or analysis.AuditConfig(
+            hbm_budget_bytes=self.hbm_budget_bytes)
+        out = {
+            "compile_bound": self.config.compile_bound,
+            "compiles_used": len(self._compiled),
+            "pages_total": self.config.num_pages - 1,
+            "params_mb": round(self.params_bytes / (1 << 20), 3),
+            "kv_pool_mb": round(self.kv_pool_bytes / (1 << 20), 3),
+            "hbm_budget_mb": round(self.hbm_budget_bytes / (1 << 20), 3),
+            "programs": {},
+        }
+        for name, jaxpr in self.audit_programs().items():
+            findings, rep = analysis.audit_jaxpr(
+                jaxpr, where=f"<serving {name}>", config=cfg)
+            d = rep.to_dict()
+            d["findings"] = [f.format() for f in findings]
+            d["within_budget"] = not any(f.code == "SL301"
+                                         for f in findings)
+            out["programs"][name] = d
+        return out
 
     def _compile(self, key, fn, example_args, donate=()):
         """AOT compile + count: every program the engine will ever run

@@ -1,0 +1,34 @@
+"""The unified static gate: tools/lint_all.py chains tracelint --check,
+shardlint --check and api_coverage --baseline into ONE exit code, and
+this `lint`-marked test is how tier-1 enforces all three baselines.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT_ALL = os.path.join(REPO, "tools", "lint_all.py")
+
+
+def test_lint_all_gate_clean():
+    proc = subprocess.run([sys.executable, LINT_ALL], cwd=REPO,
+                          capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = proc.stdout
+    assert "tracelint: ok" in out
+    assert "shardlint: ok" in out
+    assert "coverage: ok" in out
+    assert "all gates clean" in out
+
+
+def test_lint_all_skip_flag():
+    proc = subprocess.run(
+        [sys.executable, LINT_ALL, "--skip", "tracelint", "shardlint",
+         "coverage"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0
+    assert proc.stdout.count("SKIPPED") == 3

@@ -1,0 +1,551 @@
+"""shardlint (paddle_tpu/analysis shard_rules + cost_audit): rule unit
+tests per SL family (one flagged + one clean case each), the
+deadlock-ordering repro pair (flagged vs suppressed-clean through a real
+source file), a padding-waste fixture with a hand-computed waste %, the
+to_static(audit=True) hook, the serving engine's self-audit gate against
+its documented compile/page budgets, the bench report lane, and the CLI
+baseline gate run exactly as CI runs it.
+
+Everything traces tiny jaxprs on CPU — nothing compiles.
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import analysis
+from paddle_tpu.analysis import AuditConfig, InputInfo, MeshInfo
+
+pytestmark = pytest.mark.shardlint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MESH = MeshInfo.of(axes={"dp": 8, "tp": 4})
+CFG = AuditConfig(large_replicated_bytes=1 << 20,
+                  opt_state_min_bytes=16 << 10,
+                  allgather_budget_bytes=128 << 20,
+                  padding_waste_threshold=0.10,
+                  mxu_min_bytes=1 << 10,
+                  f32_param_min_bytes=1 << 10)
+
+
+def codes_of(jaxpr, inputs=None, mesh=MESH, config=CFG):
+    findings, _ = analysis.audit_jaxpr(jaxpr, where="<test>", inputs=inputs,
+                                       mesh=mesh, config=config)
+    return [f.code for f in findings]
+
+
+# --------------------------------------------------------------- SL101
+def _big_param_inputs(sharded):
+    return [InputInfo(name="w", kind="param",
+                      spec=(("dp",), None) if sharded else None,
+                      shape=(600, 1000), dtype="float32",
+                      nbytes=600 * 1000 * 4)]
+
+
+def test_sl101_large_replicated_param():
+    jaxpr = jax.make_jaxpr(lambda w: w * 2)(
+        jnp.ones((600, 1000), jnp.float32))
+    assert "SL101" in codes_of(jaxpr, inputs=_big_param_inputs(False))
+
+
+def test_sl101_clean_when_sharded_or_single_device():
+    jaxpr = jax.make_jaxpr(lambda w: w * 2)(
+        jnp.ones((600, 1000), jnp.float32))
+    assert "SL101" not in codes_of(jaxpr, inputs=_big_param_inputs(True))
+    # one-device mesh: replication is the only option — never flagged
+    one = MeshInfo.of(axes={"dp": 1})
+    assert "SL101" not in codes_of(jaxpr, inputs=_big_param_inputs(False),
+                                   mesh=one)
+
+
+# --------------------------------------------------------------- SL102
+def _opt_inputs(sharded):
+    return [InputInfo(name="fc_w_moment1", kind="opt_state",
+                      spec=(("dp",), None) if sharded else None,
+                      shape=(512, 64), dtype="float32",
+                      nbytes=512 * 64 * 4)]
+
+
+def test_sl102_unsharded_optimizer_state():
+    jaxpr = jax.make_jaxpr(lambda m: m * 0.9)(
+        jnp.ones((512, 64), jnp.float32))
+    assert "SL102" in codes_of(jaxpr, inputs=_opt_inputs(False))
+
+
+def test_sl102_clean_when_sharded():
+    jaxpr = jax.make_jaxpr(lambda m: m * 0.9)(
+        jnp.ones((512, 64), jnp.float32))
+    assert "SL102" not in codes_of(jaxpr, inputs=_opt_inputs(True))
+
+
+def test_sl102_fix_accumulators_inherit_param_spec():
+    """The finding this PR fixed: Optimizer._acc now propagates a
+    sharded parameter's PartitionSpec onto its same-shaped moments, so
+    a tp-sharded weight's optimizer state is tp-sharded too."""
+    from paddle_tpu.distributed.mesh import get_dist_spec, shard_tensor
+
+    lin = paddle.nn.Linear(8, 8)
+    shard_tensor(lin.weight, None, "tp")
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=lin.parameters())
+    m = opt._acc("moment1", lin.weight)
+    assert tuple(get_dist_spec(m)) == tuple(get_dist_spec(lin.weight))
+    # scalar accumulators (beta pows) do NOT inherit a 2-D spec
+    b1p = opt._acc("beta1_pow", lin.weight, init=1.0, shape=())
+    assert get_dist_spec(b1p) is None
+
+
+def test_input_infos_classify_optimizer_state():
+    lin = paddle.nn.Linear(4, 4)
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=lin.parameters())
+    m = opt._acc("moment1", lin.weight)
+    infos = analysis.input_infos_from_state([lin.weight, m])
+    assert infos[0].kind == "param"
+    assert infos[1].kind == "opt_state"
+    assert infos[1].nbytes == 4 * 4 * 4
+
+
+# --------------------------------------------------------------- SL103
+def _constrained(spec_chain):
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
+
+    def f(x):
+        for spec in spec_chain:
+            x = jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P(*spec)))
+            x = x * 2
+        return x
+
+    return jax.make_jaxpr(f)(jnp.ones((8, 8), jnp.float32))
+
+
+def test_sl103_resharding_thrash():
+    jaxpr = _constrained([("dp", None), (None, "dp"), ("dp", None)])
+    assert "SL103" in codes_of(jaxpr)
+
+
+def test_sl103_clean_consistent_constraints():
+    assert "SL103" not in codes_of(_constrained([("dp", None),
+                                                 ("dp", None)]))
+    # A -> B with no bounce back is a legitimate layout change
+    assert "SL103" not in codes_of(_constrained([("dp", None),
+                                                 (None, "dp")]))
+
+
+# --------------------------------------------------------------- SL201
+def _cond_jaxpr(true_has_psum, false_has_psum):
+    t = (lambda v: jax.lax.psum(v, "dp")) if true_has_psum \
+        else (lambda v: v * 1.0)
+    f = (lambda v: jax.lax.psum(v, "dp")) if false_has_psum \
+        else (lambda v: v * 1.0)
+    return jax.make_jaxpr(
+        lambda x, p: jax.lax.cond(p, t, f, x),
+        axis_env=[("dp", 8)])(jnp.ones((4,), jnp.float32), True)
+
+
+def test_sl201_deadlock_ordering_flagged():
+    assert "SL201" in codes_of(_cond_jaxpr(True, False))
+
+
+def test_sl201_clean_when_branches_agree():
+    assert "SL201" not in codes_of(_cond_jaxpr(True, True))
+    assert "SL201" not in codes_of(_cond_jaxpr(False, False))
+
+
+def test_sl201_nested_cond_not_double_counted():
+    """A branch wrapping the same single psum in an agreeing nested
+    cond issues it exactly once per path — no deadlock, no finding."""
+    def inner(v):
+        return jax.lax.cond(v.sum() > 0,
+                            lambda u: jax.lax.psum(u, "dp"),
+                            lambda u: jax.lax.psum(u * 2, "dp"), v)
+
+    jaxpr = jax.make_jaxpr(
+        lambda x, p: jax.lax.cond(
+            p, lambda v: jax.lax.psum(v, "dp"), inner, x),
+        axis_env=[("dp", 8)])(jnp.ones((4,), jnp.float32), True)
+    assert "SL201" not in codes_of(jaxpr)
+
+
+def test_sl201_scan_repeated_collective_vs_single_is_flagged():
+    """One branch issues psum once, the other issues it per scan
+    iteration: a real rendezvous-count mismatch, not signature-equal."""
+    def looped(v):
+        out, _ = jax.lax.scan(
+            lambda c, _: (jax.lax.psum(c, "dp"), c), v, jnp.zeros((3,)))
+        return out
+
+    jaxpr = jax.make_jaxpr(
+        lambda x, p: jax.lax.cond(
+            p, lambda v: jax.lax.psum(v, "dp"), looped, x),
+        axis_env=[("dp", 8)])(jnp.ones((4,), jnp.float32), True)
+    assert "SL201" in codes_of(jaxpr)
+
+
+def test_sl201_axis_index_is_not_a_rendezvous():
+    """axis_index reads the local mesh coordinate — no communication,
+    so branches differing only in it must not flag."""
+    jaxpr = jax.make_jaxpr(
+        lambda x, p: jax.lax.cond(
+            p, lambda v: v + jax.lax.axis_index("dp"), lambda v: v, x),
+        axis_env=[("dp", 8)])(jnp.ones((4,), jnp.int32), True)
+    assert "SL201" not in codes_of(jaxpr)
+
+
+_FIXTURE_SRC = '''\
+import jax
+
+
+def risky(x, p):
+    return jax.lax.cond(p, lambda v: jax.lax.psum(v, "dp"),
+                        lambda v: v * 1.0, x)
+
+
+def accepted(x, p):
+    return jax.lax.cond(p, lambda v: jax.lax.psum(v, "dp"),  # tracelint: disable=SL201
+                        lambda v: v * 1.0, x)
+'''
+
+
+def test_sl201_repro_pair_flagged_vs_suppressed(tmp_path):
+    """The deadlock-ordering repro pair: the same divergent-branch cond
+    is FLAGGED from one function and suppressed-clean from its twin via
+    the ordinary `# tracelint: disable=SL201` comment on the source
+    line shardlint resolves the eqn back to."""
+    path = tmp_path / "deadlock_fixture.py"
+    path.write_text(_FIXTURE_SRC)
+    spec = importlib.util.spec_from_file_location("deadlock_fixture",
+                                                  str(path))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    x = jnp.ones((4,), jnp.float32)
+    flagged = jax.make_jaxpr(mod.risky, axis_env=[("dp", 8)])(x, True)
+    clean = jax.make_jaxpr(mod.accepted, axis_env=[("dp", 8)])(x, True)
+    assert "SL201" in codes_of(flagged)
+    assert "SL201" not in codes_of(clean)
+    # the flagged finding points INTO the fixture file
+    findings, _ = analysis.audit_jaxpr(flagged, where="<pair>", mesh=MESH,
+                                       config=CFG)
+    f = next(f for f in findings if f.code == "SL201")
+    assert "deadlock_fixture.py" in f.path and f.line > 0
+
+
+def test_shardlint_alias_is_scoped_to_sl_codes():
+    """`# shardlint: disable=ALL` may waive SL findings but never a
+    TLxxx trace-safety finding on the same line."""
+    import textwrap
+
+    from paddle_tpu.analysis import AST_RULE_SETS, lint_source
+    src = textwrap.dedent("""
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def f(x):
+        print(x)  # shardlint: disable=ALL
+        return x
+    """)
+    codes = [f.code for f in lint_source("demo.py", src, AST_RULE_SETS)]
+    assert "TL104" in codes    # the shardlint spelling must not waive it
+    src2 = src.replace("shardlint: disable=ALL", "tracelint: disable=ALL")
+    assert lint_source("demo.py", src2, AST_RULE_SETS) == []
+
+
+# --------------------------------------------------------------- SL202
+def test_sl202_all_gather_over_budget():
+    jaxpr = jax.make_jaxpr(lambda x: jax.lax.all_gather(x, "dp"),
+                           axis_env=[("dp", 64)])(
+        jnp.ones((1024, 1024), jnp.float32))   # gathers to 256 MiB
+    assert "SL202" in codes_of(jaxpr)
+
+
+def test_sl202_clean_small_gather():
+    jaxpr = jax.make_jaxpr(lambda x: jax.lax.all_gather(x, "dp"),
+                           axis_env=[("dp", 8)])(
+        jnp.ones((64, 64), jnp.float32))
+    assert "SL202" not in codes_of(jaxpr)
+
+
+# --------------------------------------------------------------- SL203
+def test_sl203_loop_invariant_collective_in_scan():
+    def body(c, x):
+        w = jnp.ones((4,))
+        return c + jax.lax.psum(w, "dp"), x
+
+    jaxpr = jax.make_jaxpr(
+        lambda x: jax.lax.scan(body, x, jnp.zeros((3, 4)))[0],
+        axis_env=[("dp", 8)])(jnp.ones((4,), jnp.float32))
+    assert "SL203" in codes_of(jaxpr)
+
+
+def test_sl203_while_loop_body():
+    def cond(c):
+        return c[0].sum() < 100
+
+    def body(c):
+        x, w = c
+        return x + jax.lax.psum(w, "dp"), w   # w never changes: hoist
+
+    jaxpr = jax.make_jaxpr(
+        lambda x, w: jax.lax.while_loop(cond, body, (x, w)),
+        axis_env=[("dp", 8)])(jnp.ones((4,), jnp.float32),
+                              jnp.ones((4,), jnp.float32))
+    assert "SL203" in codes_of(jaxpr)
+
+
+def test_sl203_collective_under_nested_cond_in_scan():
+    def body(c, x):
+        w = jnp.ones((4,))
+        bump = jax.lax.cond(jnp.array(True),
+                            lambda u: jax.lax.psum(u, "dp"),
+                            lambda u: jax.lax.psum(u * 2, "dp"), w)
+        return c + bump, x
+
+    jaxpr = jax.make_jaxpr(
+        lambda x: jax.lax.scan(body, x, jnp.zeros((3, 4)))[0],
+        axis_env=[("dp", 8)])(jnp.ones((4,), jnp.float32))
+    assert "SL203" in codes_of(jaxpr)
+
+
+def test_sl203_clean_variant_collective():
+    def body(c, x):
+        return jax.lax.psum(c, "dp") + x, x   # carry-dependent: must run
+
+    jaxpr = jax.make_jaxpr(
+        lambda x: jax.lax.scan(body, x, jnp.zeros((3, 4)))[0],
+        axis_env=[("dp", 8)])(jnp.ones((4,), jnp.float32))
+    assert "SL203" not in codes_of(jaxpr)
+
+
+# --------------------------------------------------------------- SL301
+def test_sl301_peak_hbm_budget():
+    jaxpr = jax.make_jaxpr(lambda x: (x @ x.T) @ x)(
+        jnp.ones((512, 512), jnp.float32))
+    tight = AuditConfig(hbm_budget_bytes=1 << 20)      # 1 MiB: must trip
+    roomy = AuditConfig(hbm_budget_bytes=1 << 30)
+    assert "SL301" in codes_of(jaxpr, mesh=None, config=tight)
+    assert "SL301" not in codes_of(jaxpr, mesh=None, config=roomy)
+
+
+def test_peak_estimate_counts_inputs_and_outputs():
+    x = jnp.ones((256, 256), jnp.float32)              # 256 KiB
+    _, rep = analysis.audit_jaxpr(
+        jax.make_jaxpr(lambda a: a @ a)(x), where="<peak>", mesh=None)
+    # input + output live together at the matmul: >= 512 KiB
+    assert rep.peak_hbm_bytes >= 2 * x.nbytes
+    assert rep.top and rep.top[0][0] >= x.nbytes
+
+
+# --------------------------------------------------------------- SL302
+def test_sl302_padding_waste_known_fixture():
+    """[64,100] @ [100,128] f32: the lhs pads 100 -> 128 lanes
+    (21.875% waste), the rhs pads 100 -> 104 sublanes (~3.85%), so the
+    program-wide MXU waste is 1 - 19200/21504 = 10.714%."""
+    jaxpr = jax.make_jaxpr(lambda a, b: a @ b)(
+        jnp.ones((64, 100), jnp.float32), jnp.ones((100, 128), jnp.float32))
+    findings, rep = analysis.audit_jaxpr(jaxpr, where="<pad>", mesh=None,
+                                         config=CFG)
+    assert "SL302" in [f.code for f in findings]
+    assert rep.padding_waste == pytest.approx(1 - 19200 / 21504, abs=1e-6)
+    f = next(f for f in findings if f.code == "SL302")
+    assert "21.9% waste" in f.message
+
+
+def test_sl302_clean_aligned_dims():
+    jaxpr = jax.make_jaxpr(lambda a, b: a @ b)(
+        jnp.ones((64, 128), jnp.float32), jnp.ones((128, 256), jnp.float32))
+    findings, rep = analysis.audit_jaxpr(jaxpr, where="<pad>", mesh=None,
+                                         config=CFG)
+    assert "SL302" not in [f.code for f in findings]
+    assert rep.padding_waste == 0.0
+
+
+def test_tile_padding_math():
+    from paddle_tpu.analysis.cost_audit import tile_padded_elems
+    assert tile_padded_elems((64, 100), 4) == 64 * 128     # f32: (8,128)
+    assert tile_padded_elems((10, 128), 2) == 16 * 128     # bf16: (16,128)
+    assert tile_padded_elems((100,), 4) == 128             # rank-1: lanes
+    assert tile_padded_elems((8, 128), 4) == 8 * 128       # aligned
+
+
+# --------------------------------------------------------------- SL303
+def test_sl303_f32_param_only_used_as_bf16():
+    jaxpr = jax.make_jaxpr(
+        lambda w, x: jnp.dot(x, w.astype(jnp.bfloat16)))(
+        jnp.ones((128, 128), jnp.float32), jnp.ones((8, 128), jnp.bfloat16))
+    assert "SL303" in codes_of(jaxpr, mesh=None)
+
+
+def test_sl303_clean_when_also_read_in_f32():
+    jaxpr = jax.make_jaxpr(
+        lambda w, x: jnp.dot(x, w.astype(jnp.bfloat16)).astype(
+            jnp.float32).sum() + w.sum())(
+        jnp.ones((128, 128), jnp.float32), jnp.ones((8, 128), jnp.bfloat16))
+    assert "SL303" not in codes_of(jaxpr, mesh=None)
+
+
+# ------------------------------------------- acceptance: seeded fixture
+def _seeded_fixture_jaxpr():
+    """Replicated large param + misordered collectives + misaligned
+    matmul dim, in one program (the ISSUE acceptance fixture)."""
+    def f(w, x, p):
+        y = jnp.dot(x, w)                                  # misaligned
+        return jax.lax.cond(p, lambda v: jax.lax.psum(v, "dp"),
+                            lambda v: v * 1.0, y)          # misordered
+
+    return jax.make_jaxpr(f, axis_env=[("dp", 8)])(
+        jnp.ones((300, 1000), jnp.float32),
+        jnp.ones((64, 300), jnp.float32), True)
+
+
+def test_seeded_fixture_yields_three_distinct_findings():
+    inputs = [InputInfo(name="w", kind="param", shape=(300, 1000),
+                        dtype="float32", nbytes=300 * 1000 * 4),
+              InputInfo(name="x", kind="input"),
+              InputInfo(name="p", kind="input")]
+    codes = set(codes_of(_seeded_fixture_jaxpr(), inputs=inputs))
+    assert {"SL101", "SL201", "SL302"} <= codes
+
+
+def test_seeded_fixture_ids_are_stable():
+    from paddle_tpu.analysis import report
+    inputs = [InputInfo(name="w", kind="param", shape=(300, 1000),
+                        dtype="float32", nbytes=300 * 1000 * 4)]
+
+    def fingerprints():
+        findings, _ = analysis.audit_jaxpr(
+            _seeded_fixture_jaxpr(), where="<seeded>", inputs=inputs,
+            mesh=MESH, config=CFG)
+        return sorted(report.fingerprint(f) for f in findings)
+
+    first, second = fingerprints(), fingerprints()
+    assert first and first == second
+
+
+# ------------------------------------------------- to_static(audit=True)
+def test_to_static_audit_warns_and_reports(monkeypatch):
+    import types
+
+    from paddle_tpu.distributed import mesh as dmesh
+
+    fake = types.SimpleNamespace(axis_names=("dp", "tp"),
+                                 shape={"dp": 8, "tp": 4})
+    monkeypatch.setattr(dmesh, "get_mesh", lambda: fake)
+
+    lin = paddle.nn.Linear(100, 64)   # misaligned in-dim: SL302 food
+
+    @paddle.jit.to_static(audit=True)
+    def fwd(x):
+        return lin(x).sum()
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        out = fwd(paddle.to_tensor(np.ones((64, 100), np.float32)))
+    assert np.isfinite(float(out.numpy()))
+    msgs = [str(w.message) for w in caught
+            if isinstance(w.message, analysis.ShardlintWarning)]
+    assert any("SL302" in m for m in msgs)
+    assert fwd.last_audit is not None
+    assert fwd.last_audit.peak_hbm_bytes > 0
+
+
+def test_traced_program_exposes_named_inputs():
+    lin = paddle.nn.Linear(8, 8)
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=lin.parameters())
+
+    @paddle.jit.to_static
+    def step(x):
+        opt.clear_grad()
+        loss = lin(x).sum()
+        loss.backward()
+        opt.step()
+        return loss
+
+    jaxpr, infos = step.traced_program(
+        paddle.to_tensor(np.ones((4, 8), np.float32)))
+    assert len(infos) == len(jaxpr.jaxpr.invars)
+    kinds = {i.kind for i in infos}
+    assert "param" in kinds and "opt_state" in kinds and "input" in kinds
+    # tracing never compiled anything
+    assert step._compiled == {}
+
+
+# ------------------------------------------------- serving self-audit
+@pytest.mark.serving
+def test_serving_self_audit_gate():
+    """The serving engine's decode (and every other) program must stay
+    within its DOCUMENTED budgets: peak HBM inside
+    `engine.hbm_budget_bytes` (weights + 2x paged KV pools + margin)
+    and lifetime compiles inside `EngineConfig.compile_bound`."""
+    from paddle_tpu import serving
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    mcfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                     num_heads=4, max_seq_len=128, dropout=0.0,
+                     attention_dropout=0.0)
+    engine = serving.LLMEngine(
+        GPTForCausalLM(mcfg),
+        serving.EngineConfig(max_num_seqs=4, page_size=8,
+                             max_model_len=64, prefill_buckets=(16, 32)))
+    audit = engine.audit()
+    assert audit["compiles_used"] <= audit["compile_bound"]
+    assert set(audit["programs"]) >= {"prefill_16", "prefill_32",
+                                      "decode", "sample_1", "sample_4"}
+    for name, prog in audit["programs"].items():
+        assert prog["within_budget"], (name, prog)
+    # the decode program's estimate is also sane in absolute terms:
+    # at least the KV pools it reads, below the documented budget
+    dec = audit["programs"]["decode"]
+    assert dec["peak_hbm_bytes"] >= engine.kv_pool_bytes
+    assert dec["peak_hbm_bytes"] <= engine.hbm_budget_bytes
+    engine.shutdown()
+
+
+# --------------------------------------------------- bench report lane
+def test_bench_report_lane_keys():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import shardlint
+    finally:
+        sys.path.pop(0)
+    out = shardlint.bench_report(targets=("serving",))
+    assert "shardlint_serving_decode_peak_hbm_mb" in out
+    assert "shardlint_serving_decode_padding_waste_pct" in out
+    assert "shardlint_findings" in out and "shardlint_elapsed_s" in out
+    json.dumps(out)   # one JSON line, bench contract
+
+
+# --------------------------------------------------------- CLI gate
+def test_cli_check_gate_clean():
+    """CI shape: `python tools/shardlint.py --check` exits 0 against the
+    checked-in baseline."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "shardlint.py"),
+         "--check"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_rules_catalogue():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "shardlint.py"),
+         "--rules"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0
+    for code in ("SL101", "SL102", "SL103", "SL201", "SL202", "SL203",
+                 "SL301", "SL302", "SL303"):
+        assert code in proc.stdout

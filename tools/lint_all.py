@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""lint_all — the one-exit-code static gate CI runs.
+
+Chains every baseline-gated analyzer in the repo:
+
+  1. tracelint  --check paddle_tpu examples   (AST trace-safety, TLxxx)
+  2. shardlint  --check                       (sharding/memory audit, SLxxx)
+  3. api_coverage --baseline                  (public-surface regressions)
+
+Each gate compares against its checked-in baseline and fails only on
+REGRESSIONS, so `python tools/lint_all.py` exits 0 on a healthy tree and
+nonzero the moment any gate slips.  The `lint`-marked pytest test
+(tests/test_lint_all.py) shells out to this script, which is how tier-1
+enforces all three gates at once.
+
+Usage: python tools/lint_all.py [--skip tracelint shardlint coverage]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+
+GATES = {
+    "tracelint": [sys.executable, os.path.join(TOOLS, "tracelint.py"),
+                  "--check", "paddle_tpu", "examples"],
+    "shardlint": [sys.executable, os.path.join(TOOLS, "shardlint.py"),
+                  "--check"],
+    "coverage": [sys.executable, os.path.join(TOOLS, "api_coverage.py"),
+                 "--baseline",
+                 os.path.join(TOOLS, "api_coverage_baseline.json")],
+}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="lint_all", description=__doc__)
+    ap.add_argument("--skip", nargs="*", default=(),
+                    choices=sorted(GATES), help="gates to skip")
+    args = ap.parse_args(argv)
+
+    failures = []
+    for name, cmd in GATES.items():
+        if name in args.skip:
+            print(f"-- {name}: SKIPPED")
+            continue
+        t0 = time.time()
+        try:
+            # a wedged backend init must FAIL the gate, not hang CI
+            proc = subprocess.run(cmd, cwd=REPO, capture_output=True,
+                                  text=True, timeout=300)
+        except subprocess.TimeoutExpired:
+            print(f"-- {name}: FAIL (timed out after 300s)")
+            failures.append(name)
+            continue
+        status = "ok" if proc.returncode == 0 else f"FAIL rc={proc.returncode}"
+        print(f"-- {name}: {status} in {time.time() - t0:.1f}s")
+        if proc.returncode != 0:
+            failures.append(name)
+            sys.stdout.write(proc.stdout)
+            sys.stderr.write(proc.stderr)
+    if failures:
+        print(f"lint_all: FAILED ({', '.join(failures)})")
+        return 1
+    print("lint_all: all gates clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

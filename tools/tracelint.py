@@ -14,9 +14,12 @@ Exit codes: 0 clean, 1 findings (plain) / NEW findings vs baseline
 (--check), 2 usage error.
 
 Per-line suppression: `# tracelint: disable=TL101` — whole file:
-`# tracelint: skip-file`.  The checked-in baseline
-(tools/tracelint_baseline.json) holds reviewed findings; `--check`
-reports only regressions beyond it.
+`# tracelint: skip-file`.  The same comments silence shardlint's SLxxx
+jaxpr findings at their resolved source lines (see tools/shardlint.py).
+The checked-in baseline (tools/tracelint_baseline.json) holds reviewed
+findings; `--check` reports only regressions beyond it.  The `--json`
+report uses the same schema as shardlint's (analysis/report.to_json,
+with a "tool" discriminator key).
 """
 from __future__ import annotations
 
@@ -70,7 +73,11 @@ def main(argv=None):
     from paddle_tpu.analysis.rules import RULES
 
     if args.rules:
+        # TL codes only: the SLxxx family shares the registry but is
+        # checked by tools/shardlint.py (which has its own --rules)
         for r in RULES.values():
+            if not r.code.startswith("TL"):
+                continue
             print(f"{r.code}  {r.name}")
             print(f"    {r.message.format(detail='')}")
             print(f"    why: {r.rationale}")
@@ -104,7 +111,8 @@ def main(argv=None):
           f"[{report.summarize(shown)}] in {elapsed:.2f}s")
 
     if args.json:
-        doc = report.to_json(shown, extra={"elapsed_s": round(elapsed, 3)})
+        doc = report.to_json(shown, extra={"tool": "tracelint",
+                                           "elapsed_s": round(elapsed, 3)})
         if args.json == "-":
             json.dump(doc, sys.stdout, indent=1)
             print()
