@@ -310,7 +310,7 @@ def __getattr__(name):
     # stdlib and the CLI imports it without this package __init__.
     # paddle_tpu.serving lazily as well: the engine compiles nothing at
     # import time, but serving is an opt-in subsystem like onnx export.
-    if name in ("onnx", "analysis", "serving"):
+    if name in ("onnx", "analysis", "serving", "observability"):
         import importlib
         return importlib.import_module(f"paddle_tpu.{name}")
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

@@ -15,13 +15,17 @@ program per step instead of per-op kernel dispatch.
 """
 from __future__ import annotations
 
+import inspect
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.framework import state as fstate
+from paddle_tpu.observability import recompile as _obs_recompile
+from paddle_tpu.observability import span as _span
 
 _tree = jax.tree_util
 
@@ -143,6 +147,8 @@ class StaticFunction:
         self._compiled = {}
         self._last_state = None
         self.__name__ = getattr(function, "__name__", "static_fn")
+        self._span_name = f"jit.{self.__name__}"
+        self._param_names = None    # resolved lazily on first cache miss
 
     @property
     def dygraph_function(self):
@@ -220,7 +226,44 @@ class StaticFunction:
                 static_leaves.append(l)
         return in_treedef, tensor_vals, static_leaves
 
+    def _leaf_names(self, args, kwargs):
+        """One human-readable name per flattened leaf of (args, kwargs),
+        aligned with :meth:`_flatten_inputs` leaf order — so a recompile
+        event can say WHICH argument's shape/dtype/static value changed
+        (``ids``, ``arg1['mask']``, ...) instead of a leaf index."""
+        if self._param_names is None:
+            try:
+                self._param_names = [
+                    p.name for p in inspect.signature(
+                        self._raw_function).parameters.values()
+                    if p.kind in (p.POSITIONAL_ONLY,
+                                  p.POSITIONAL_OR_KEYWORD)]
+            except (TypeError, ValueError):
+                self._param_names = []
+        try:
+            flat, _ = _tree.tree_flatten_with_path((args, kwargs),
+                                                   is_leaf=_is_tensor)
+        except Exception:  # noqa: BLE001 — naming is best-effort
+            return None
+        names = []
+        for path, _leaf in flat:
+            if len(path) >= 2 and getattr(path[0], "idx", None) == 0:
+                i = getattr(path[1], "idx", None)
+                base = (self._param_names[i]
+                        if i is not None and i < len(self._param_names)
+                        else f"arg{i}")
+            elif len(path) >= 2:
+                base = str(getattr(path[1], "key", path[1]))
+            else:
+                base = "args"
+            names.append(base + "".join(str(p) for p in path[2:]))
+        return names
+
     def __call__(self, *args, **kwargs):
+        with _span(self._span_name):
+            return self._call(args, kwargs)
+
+    def _call(self, args, kwargs):
         in_treedef, tensor_vals, static_leaves = self._flatten_inputs(
             args, kwargs)
 
@@ -247,7 +290,10 @@ class StaticFunction:
                 reg_ver,
             )
             entry = self._compiled.get(key)
+            event = None
             if entry is None:
+                prior_keys = list(self._compiled)
+                t_trace0 = time.perf_counter()
                 self._trace_state_list = state_list
                 pure = self._make_pure(in_treedef, len(state_vals), static_leaves)
                 jitted = jax.jit(pure, donate_argnums=(0,) if self._donate else ())
@@ -279,9 +325,25 @@ class StaticFunction:
                     jitted, self._out_info, state_list, self._grad_idx,
                     self._grad_cleared)
                 entry = self._compiled[key]
+                # recompile attribution: diff this cache key against the
+                # nearest cached signature so the event can say WHY the
+                # miss happened (which arg's shape/dtype/static leaf, or
+                # the state registry, changed)
+                event = _obs_recompile.note_jit_compile(
+                    self.__name__, key, prior_keys,
+                    self._leaf_names(args, kwargs), _ARRAY,
+                    trace_ms=round(
+                        (time.perf_counter() - t_trace0) * 1e3, 3))
             jitted = entry.jitted
+            t_run0 = time.perf_counter()
             out_arrays, new_state, grad_vals = jitted(state_vals,
                                                       tensor_vals)
+            if event is not None:
+                # first execution of a fresh entry: XLA compiles here
+                # (the lower() above only traced), so this wall time is
+                # compile-dominated
+                event.compile_ms = round(
+                    (time.perf_counter() - t_run0) * 1e3, 3)
             self._apply(entry, out_arrays, new_state, grad_vals)
             return self._rewrap(entry, out_arrays)
         raise RuntimeError("to_static: state registry kept changing during trace")

@@ -458,6 +458,18 @@ def transform_func(fn):
     if key in _local.in_progress:
         return fn
     _local.in_progress.add(key)
+    # one span + counter per first-use conversion: AST transforms are a
+    # one-time trace-path cost, but a hot loop that defeats the
+    # _ptd2s_variant cache shows up here immediately.  Telemetry is
+    # entered/exited OUTSIDE the fail-cache try: a telemetry error must
+    # not discard a successful transform or fail-cache the function.
+    _span_cm = None
+    try:
+        from paddle_tpu.observability import span as _obs_span
+        _span_cm = _obs_span("dy2static.transform", fn=fn.__qualname__)
+        _span_cm.__enter__()
+    except Exception:
+        _span_cm = None
     try:
         new = _do_transform(fn)
     except Exception:
@@ -465,6 +477,18 @@ def transform_func(fn):
         return fn
     finally:
         _local.in_progress.discard(key)
+        if _span_cm is not None:
+            try:
+                _span_cm.__exit__(None, None, None)
+            except Exception:
+                pass
+    try:
+        from paddle_tpu.observability import metrics as _obs_metrics
+        _obs_metrics.registry().counter(
+            "dy2static_transforms_total",
+            help="functions AST-converted by dy2static").inc()
+    except Exception:
+        pass
     try:
         fn._ptd2s_variant = new
     except (AttributeError, TypeError):

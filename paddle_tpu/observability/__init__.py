@@ -1,0 +1,74 @@
+"""paddle_tpu.observability — unified runtime telemetry.
+
+One observability layer the way ``paddle_tpu.analysis`` is one static-
+analysis layer, replacing three disconnected metric silos (serving
+engine counters, the profiler's metrics-source registry, ad-hoc bench
+lanes) with four pieces:
+
+- :mod:`spans` — nested trace spans (``with span("train_step"): ...``)
+  that always record into a bounded ring buffer and additionally emit
+  ``jax.profiler.TraceAnnotation`` while a capture is active;
+- :mod:`metrics` — ONE process-wide registry of Counter / Gauge /
+  Histogram; ``profiler.register_metrics_source`` / ``metrics_report``
+  and ``serving.metrics`` are compatibility shims over it;
+- :mod:`recompile` — the compile-event log: every
+  ``StaticFunction`` cache miss and every serving AOT compile records
+  WHY it compiled (which argument's shape / dtype / static leaf
+  changed) plus wall-clock trace+compile time;
+- :mod:`export` — JSONL, Prometheus text exposition, and Chrome-trace
+  exporters; rendered by the ``tools/obs_report.py`` CLI.
+
+Quickstart::
+
+    from paddle_tpu import observability as obs
+
+    with obs.span("train_step", step=i):
+        loss = train_step(x, y)
+
+    obs.recompile_log().events()       # why did anything recompile?
+    obs.registry().snapshot()          # every counter/gauge/histogram
+    obs.export.dump_jsonl("obs.jsonl")  # -> tools/obs_report.py obs.jsonl
+
+See docs/observability.md for the architecture.
+"""
+from paddle_tpu.observability import export
+from paddle_tpu.observability.metrics import (Counter, Gauge, Histogram,
+                                              MetricsRegistry, registry)
+from paddle_tpu.observability.recompile import (RecompileEvent,
+                                                RecompileLog,
+                                                note_aot_compile,
+                                                note_jit_compile,
+                                                recompile_log)
+from paddle_tpu.observability.spans import (SpanRecord, SpanRecorder,
+                                            enabled, recorder,
+                                            set_enabled, span)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "RecompileEvent",
+    "RecompileLog",
+    "SpanRecord",
+    "SpanRecorder",
+    "enabled",
+    "export",
+    "note_aot_compile",
+    "note_jit_compile",
+    "recompile_log",
+    "recorder",
+    "registry",
+    "set_enabled",
+    "span",
+]
+
+# built-in metrics sources: the span aggregates and the recompile log
+# surface in every profiler.metrics_report() without extra wiring
+registry().register_source(
+    "spans", lambda: {"dropped": recorder().dropped,
+                      "buffered": len(recorder().spans()),
+                      "by_name": recorder().aggregates()},
+    builtin=True)
+registry().register_source(
+    "recompile", lambda: recompile_log().snapshot(), builtin=True)

@@ -1,0 +1,170 @@
+"""Exporters: JSONL dump, Prometheus text exposition, Chrome trace.
+
+Three render targets for the same in-process state (span ring buffer,
+metrics registry, recompile log):
+
+- :func:`dump_jsonl` / :func:`load_jsonl` — one self-describing line
+  per record (``{"kind": "span" | "recompile" | "metric" | "meta"}``),
+  the interchange format ``tools/obs_report.py`` reads;
+- :func:`prometheus_text` — the text exposition format (counters,
+  gauges, and reservoir histograms as Prometheus `summary` quantiles)
+  a scrape endpoint or node textfile collector can serve as-is;
+- :func:`chrome_trace` / :func:`write_chrome_trace` — the span buffer
+  as Chrome ``traceEvents`` JSON, loadable in Perfetto / chrome://tracing.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+from paddle_tpu.observability import metrics as _metrics
+from paddle_tpu.observability import recompile as _recompile
+from paddle_tpu.observability import spans as _spans
+
+__all__ = [
+    "dump_jsonl", "load_jsonl", "prometheus_text", "chrome_trace",
+    "write_chrome_trace",
+]
+
+
+# ------------------------------------------------------------------ JSONL
+def dump_jsonl(path, spans=None, recompiles=None, registry=None):
+    """Write spans + recompile events + metrics as JSON-lines; returns
+    `path`.  Defaults to the process-wide recorder/log/registry."""
+    spans = _spans.recorder().spans() if spans is None else spans
+    recompiles = (_recompile.recompile_log().events()
+                  if recompiles is None else recompiles)
+    registry = _metrics.registry() if registry is None else registry
+    # default=str: span attrs / event attrs are arbitrary user kwargs
+    # (ndarrays, dtypes, ...) — one odd attr must not abort the dump
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(json.dumps({
+            "kind": "meta", "version": 1,
+            "capture_utc": time.strftime("%Y-%m-%d %H:%M:%S UTC",
+                                         time.gmtime()),
+        }) + "\n")
+        for s in spans:
+            fh.write(json.dumps({"kind": "span", **s.to_dict()},
+                                default=str) + "\n")
+        for e in recompiles:
+            # the event dict has its own "kind" (jit | serving-aot), so
+            # it nests under "event" instead of colliding with the
+            # record discriminator
+            fh.write(json.dumps({"kind": "recompile",
+                                 "event": e.to_dict()},
+                                default=str) + "\n")
+        for m in registry.collect():
+            rec = {"kind": "metric", "name": m.name, "type": m.kind,
+                   "labels": m.labels}
+            rec["value"] = (m.summary() if m.kind == "histogram"
+                            else m.value)
+            fh.write(json.dumps(rec, default=str) + "\n")
+    return path
+
+
+def load_jsonl(path):
+    """Parse a :func:`dump_jsonl` file back into plain dict lists:
+    ``{"meta": dict|None, "spans": [...], "recompiles": [...],
+    "metrics": [...]}``."""
+    out = {"meta": None, "spans": [], "recompiles": [], "metrics": []}
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            kind = rec.pop("kind", None)
+            if kind == "meta":
+                out["meta"] = rec
+            elif kind == "span":
+                out["spans"].append(rec)
+            elif kind == "recompile":
+                # loaded entries match live RecompileEvent.to_dict()
+                # shape (their "kind" is jit | serving-aot)
+                out["recompiles"].append(rec.get("event", rec))
+            elif kind == "metric":
+                out["metrics"].append(rec)
+    return out
+
+
+# ------------------------------------------------------------- Prometheus
+def _escape_label(v):
+    return (str(v).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
+def _fmt_labels(labels, extra=None):
+    items = sorted((labels or {}).items())
+    if extra:
+        items = items + list(extra)
+    if not items:
+        return ""
+    return "{" + ",".join(f'{k}="{_escape_label(v)}"' for k, v in items) \
+        + "}"
+
+
+def _fmt_value(v):
+    if v is None:
+        return "NaN"
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def prometheus_text(registry=None):
+    """The registry in Prometheus text exposition format.
+
+    Counters keep their registered name (callers choose `_total`
+    suffixes), histograms render as `summary` quantiles over the
+    bounded reservoir plus exact `_sum` / `_count`."""
+    registry = _metrics.registry() if registry is None else registry
+    lines = []
+    seen_header = set()
+    for m in registry.collect():
+        if m.name not in seen_header:
+            seen_header.add(m.name)
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            kind = "summary" if m.kind == "histogram" else m.kind
+            lines.append(f"# TYPE {m.name} {kind}")
+        if m.kind == "histogram":
+            qs = (0.5, 0.9, 0.99)
+            for q, v in zip(qs, m.quantiles(qs)):
+                lines.append(
+                    f"{m.name}{_fmt_labels(m.labels, [('quantile', q)])} "
+                    f"{_fmt_value(v)}")
+            lines.append(f"{m.name}_sum{_fmt_labels(m.labels)} "
+                         f"{_fmt_value(m.sum)}")
+            lines.append(f"{m.name}_count{_fmt_labels(m.labels)} "
+                         f"{_fmt_value(m.count)}")
+        else:
+            lines.append(f"{m.name}{_fmt_labels(m.labels)} "
+                         f"{_fmt_value(m.value)}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+# ----------------------------------------------------------- Chrome trace
+def chrome_trace(spans=None):
+    """Span buffer as a Chrome/Perfetto ``traceEvents`` document."""
+    spans = _spans.recorder().spans() if spans is None else spans
+    tids = {}
+    events = []
+    for s in spans:
+        d = s.to_dict() if isinstance(s, _spans.SpanRecord) else dict(s)
+        tid = tids.setdefault(d["thread_id"], len(tids))
+        ev = {
+            "name": d["name"], "ph": "X", "pid": 0, "tid": tid,
+            "ts": d["start_ns"] / 1e3,      # us
+            "dur": d["dur_ns"] / 1e3,
+        }
+        if d.get("attrs"):
+            ev["args"] = d["attrs"]
+        events.append(ev)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(path, spans=None):
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(chrome_trace(spans), fh, default=str)
+    return path

@@ -119,16 +119,21 @@ class Optimizer:
     @no_grad()
     def step(self):
         from paddle_tpu.distributed import elastic
+        from paddle_tpu.observability import span
         elastic.notify_progress()   # launcher-installed watchdog heartbeat
-        pg = self._params_grads()
-        if self._grad_clip is not None:
-            pg = self._grad_clip(pg)
-        for p, g in pg:
-            lr_mult = getattr(p, "optimize_attr", {}).get("learning_rate", 1.0) \
-                if hasattr(p, "optimize_attr") else 1.0
-            gv = self._apply_decay(p, g._value.astype(jnp.float32)
-                                   if g._value.dtype != p._value.dtype else g._value)
-            self._update_param(p, gv, lr_mult)
+        # under to_static this span fires at TRACE time (the update math
+        # is fused into the step program); in eager mode it times every
+        # parameter update pass
+        with span("optimizer.step", cls=type(self).__name__):
+            pg = self._params_grads()
+            if self._grad_clip is not None:
+                pg = self._grad_clip(pg)
+            for p, g in pg:
+                lr_mult = getattr(p, "optimize_attr", {}).get("learning_rate", 1.0) \
+                    if hasattr(p, "optimize_attr") else 1.0
+                gv = self._apply_decay(p, g._value.astype(jnp.float32)
+                                       if g._value.dtype != p._value.dtype else g._value)
+                self._update_param(p, gv, lr_mult)
 
     def _update_param(self, p, g, lr_mult):
         raise NotImplementedError

@@ -30,7 +30,19 @@ class ProfilerState(enum.Enum):
 
 
 def make_scheduler(*, closed, ready, record, repeat=0, skip_first=0):
-    """Step-state scheduler, same shape as the reference's make_scheduler."""
+    """Step-state scheduler, same shape as the reference's make_scheduler.
+
+    Contract (pinned by tests/test_observability.py): ``skip_first`` is
+    consumed ONCE, before the first cycle; with ``repeat=0`` the
+    closed/ready/record window then re-enters forever on a plain
+    ``total``-step modulus (no re-skip at wraparound), and with
+    ``repeat=n`` the scheduler stays CLOSED after n full cycles."""
+    if closed < 0 or ready < 0 or repeat < 0 or skip_first < 0:
+        raise ValueError("make_scheduler: negative phase lengths")
+    if record < 1:
+        raise ValueError("make_scheduler: record must be >= 1 (a window "
+                         "that never records would never fire "
+                         "on_trace_ready)")
     total = closed + ready + record
 
     def schedule(step):
@@ -53,12 +65,51 @@ def make_scheduler(*, closed, ready, record, repeat=0, skip_first=0):
 
 def export_chrome_tracing(dir_name, worker_name=None):
     """on_trace_ready callback: jax writes TensorBoard/Perfetto traces into
-    dir_name (the reference writes Chrome json; same consumer workflow)."""
+    dir_name (the reference writes Chrome json; same consumer workflow).
+
+    The handler itself writes a small capture manifest
+    (``ptpu_trace_manifest.json``: trace dir, the recorded step window,
+    capture UTC) next to the trace so a later report pass can tell WHICH
+    steps a trace directory covers; the handler returns the manifest
+    path (``handler.last_manifest_path`` keeps the most recent one)."""
     def handler(prof):
-        pass  # jax already wrote the trace into handler._ptpu_trace_dir
+        # jax already wrote the trace into handler._ptpu_trace_dir; add
+        # the manifest that names the capture window
+        import json
+        os.makedirs(handler._ptpu_trace_dir, exist_ok=True)
+        window = {
+            "step_window": [getattr(prof, "_window_start_step", 0),
+                            getattr(prof, "step_num", 0)],
+            "capture_utc": time.strftime("%Y-%m-%d %H:%M:%S UTC",
+                                         time.gmtime()),
+        }
+        path = os.path.join(handler._ptpu_trace_dir,
+                            "ptpu_trace_manifest.json")
+        # a repeating scheduler fires once per recorded window while
+        # every capture accumulates in the same dir — keep the full
+        # window history ("windows"), top-level keys = most recent
+        windows = []
+        if os.path.exists(path):
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    windows = json.load(fh).get("windows", [])
+            except (OSError, ValueError):
+                windows = []
+        windows.append(window)
+        manifest = {
+            "trace_dir": os.path.abspath(handler._ptpu_trace_dir),
+            "worker_name": worker_name,
+            "windows": windows,
+            **window,
+        }
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(manifest, fh, indent=1)
+        handler.last_manifest_path = path
+        return path
     # _begin_trace reads this; on_trace_ready itself only fires when a
     # recorded window's trace is ready (reference contract)
     handler._ptpu_trace_dir = dir_name
+    handler.last_manifest_path = None
     return handler
 
 
@@ -82,8 +133,15 @@ class Profiler:
         self.on_trace_ready = on_trace_ready
         if isinstance(scheduler, (tuple, list)):
             start, end = scheduler
-            self.scheduler = make_scheduler(
-                closed=max(start, 0), ready=0, record=end - start, repeat=1)
+            if end - start < 1:
+                # an empty (start, end) window has always meant "never
+                # record"; keep that silent no-op rather than tripping
+                # make_scheduler's record >= 1 validation
+                self.scheduler = lambda step: ProfilerState.CLOSED
+            else:
+                self.scheduler = make_scheduler(
+                    closed=max(start, 0), ready=0, record=end - start,
+                    repeat=1)
         elif callable(scheduler):
             self.scheduler = scheduler
         else:
@@ -117,6 +175,8 @@ class Profiler:
             os.makedirs(self._trace_dir, exist_ok=True)
             jax.profiler.start_trace(self._trace_dir)
             self._active = True
+            # first batch the open window covers (manifest step_window)
+            self._window_start_step = self.step_num
             _profiler_mode[0] = True
 
     def _end_trace(self):
@@ -602,35 +662,31 @@ def get_profiler(*a, **kw):
 
 
 # --------------------------------------------------------------------------
-# Metrics-source registry: long-running subsystems (paddle_tpu.serving's
+# Metrics-source registry: COMPATIBILITY SHIMS over the one process-wide
+# paddle_tpu.observability registry.  Long-running subsystems (the serving
 # LLMEngine, dataloader pools, ...) register a zero-arg snapshot callable;
-# `metrics_report()` collects every registered snapshot into one dict so a
-# profiler pass over a serving process sees queue depth, tokens/s, TTFT,
-# page utilization, and the compile counter alongside the device traces.
-_metrics_sources = {}
-
-
+# `metrics_report()` collects every registered snapshot — plus every
+# observability Counter/Gauge/Histogram and the recompile log — into one
+# dict, so a profiler pass over a serving process sees queue depth,
+# tokens/s, TTFT, page utilization, compile counts AND recompile
+# attribution alongside the device traces.  The imports are lazy so this
+# module stays importable before the observability package loads.
 def register_metrics_source(name, snapshot_fn):
     """Register `snapshot_fn` (zero-arg -> dict) under `name`.
     Re-registering a name replaces the previous source."""
-    if not callable(snapshot_fn):
-        raise TypeError("snapshot_fn must be callable")
-    _metrics_sources[name] = snapshot_fn
-    return name
+    from paddle_tpu.observability import metrics as _obs_metrics
+    return _obs_metrics.registry().register_source(name, snapshot_fn)
 
 
 def unregister_metrics_source(name):
-    _metrics_sources.pop(name, None)
+    from paddle_tpu.observability import metrics as _obs_metrics
+    _obs_metrics.registry().unregister_source(name)
 
 
 def metrics_report():
-    """{source_name: snapshot_dict} for every registered source; a
-    source that raises reports {"error": ...} instead of killing the
-    whole report."""
-    out = {}
-    for name, fn in list(_metrics_sources.items()):
-        try:
-            out[name] = fn()
-        except Exception as e:  # noqa: BLE001 — observability must not throw
-            out[name] = {"error": f"{type(e).__name__}: {e}"}
-    return out
+    """{source_name: snapshot_dict} for every registered source, plus
+    the observability registry's own instruments under the
+    ``"observability"`` key; a source that raises reports
+    {"error": ...} instead of killing the whole report."""
+    import paddle_tpu.observability as _obs  # registers builtin sources
+    return _obs.registry().report()

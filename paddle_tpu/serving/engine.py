@@ -34,6 +34,7 @@ unchanged on TPU.
 """
 from __future__ import annotations
 
+import time
 import weakref
 from collections import OrderedDict
 
@@ -43,6 +44,7 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.autograd import no_grad
+from paddle_tpu.observability import note_aot_compile, span
 from paddle_tpu.core.dispatch import apply
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.incubate.nn.paged_attention import (PageAllocator,
@@ -217,7 +219,17 @@ class LLMEngine:
 
         self.scheduler = Scheduler(cfg.prefill_buckets, cfg.page_size,
                                    cfg.growth_reserve_pages)
-        self.metrics = EngineMetrics()
+        from paddle_tpu.observability.metrics import next_instance_label
+        # a monotonic default label, never id()-derived: a reused id
+        # after GC would silently merge this engine's registry metrics
+        # into a dead engine's accumulated totals
+        self._metrics_name = (metrics_name
+                              or next_instance_label("serving.engine"))
+        # the engine's histograms/compile counter live in the shared
+        # observability registry under this engine's label — the
+        # snapshot-source registration below is the coarse view of the
+        # SAME instruments, so the two can never diverge
+        self.metrics = EngineMetrics(name=self._metrics_name)
         self.metrics.compile_bound = cfg.compile_bound
         self.metrics.pages_total = cfg.num_pages - 1   # page 0 reserved
 
@@ -228,8 +240,6 @@ class LLMEngine:
         self.finished_requests = OrderedDict()
         self._next_id = 0
 
-        self._metrics_name = (metrics_name
-                              or f"serving.engine{id(self) & 0xffff:04x}")
         from paddle_tpu import profiler
         # weak registration: a dropped engine (no shutdown()) must stay
         # collectable and self-evict from the registry on the next report
@@ -239,10 +249,16 @@ class LLMEngine:
         def _snapshot():
             m = mref()
             if m is None:
-                profiler.unregister_metrics_source(name)
+                # instruments are released by the EngineMetrics GC
+                # finalizer; here only the source entry is evicted —
+                # and only if it is still OURS (a newer engine may have
+                # re-registered the same name)
+                from paddle_tpu.observability.metrics import registry
+                registry().unregister_source(name, expected=_snapshot)
                 return {"error": "engine collected"}
             return m.snapshot()
 
+        self._snapshot_fn = _snapshot
         profiler.register_metrics_source(name, _snapshot)
 
     # ------------------------------------------------------------ API
@@ -315,7 +331,8 @@ class LLMEngine:
         this step; a preemption surfaces as ``(request_id, None, False)``
         (the request re-enters the queue and will be replayed)."""
         events = []
-        admitted = self._admit(events)
+        with span("serving.admit"):
+            admitted = self._admit(events)
         running = [r for r in self._slots if r is not None]
         if running:
             self._decode_step(events)
@@ -357,9 +374,13 @@ class LLMEngine:
         return [GenerationResult(req) for req in reqs]
 
     def shutdown(self):
-        """Unregister from the profiler metrics registry."""
-        from paddle_tpu import profiler
-        profiler.unregister_metrics_source(self._metrics_name)
+        """Unregister from the profiler metrics registry and release
+        this engine's claim on its registry-owned instruments (shared
+        instruments survive until the last same-named engine goes)."""
+        from paddle_tpu.observability.metrics import registry
+        registry().unregister_source(self._metrics_name,
+                                     expected=self._snapshot_fn)
+        self.metrics.release()
 
     # ----------------------------------------------------- admission
     def _free_slot_count(self):
@@ -383,6 +404,11 @@ class LLMEngine:
         tokens = req.replay_token_ids
         L = len(tokens)
         bucket = self.scheduler.bucket_for_len(L)
+        with span("serving.prefill", request=req.request_id,
+                  bucket=bucket, tokens=L):
+            self._prefill_inner(req, events, cfg, t0, tokens, L, bucket)
+
+    def _prefill_inner(self, req, events, cfg, t0, tokens, L, bucket):
         slot = self._slots.index(None)
         self._slots[slot] = req
         req.slot = slot
@@ -419,6 +445,10 @@ class LLMEngine:
 
     # -------------------------------------------------------- decode
     def _decode_step(self, events):
+        with span("serving.decode"):
+            self._decode_step_inner(events)
+
+    def _decode_step_inner(self, events):
         cfg = self.config
         t0 = self.metrics.clock()
         # capacity pass: every live row must fit one more token; the
@@ -524,12 +554,14 @@ class LLMEngine:
         """Deterministic preemption: free everything, requeue at the
         queue front; the replay prefill later reconstructs the cache
         from prompt + generated tokens (token-identical, see sampler)."""
-        req.transition(RequestState.EVICTED)
-        self._release_slot(req)
-        req.num_evictions += 1
-        self.metrics.requests_evicted += 1
-        self.scheduler.requeue_front(req)
-        events.append((req.request_id, None, False))
+        with span("serving.preempt", request=req.request_id,
+                  generated=len(req.output_token_ids)):
+            req.transition(RequestState.EVICTED)
+            self._release_slot(req)
+            req.num_evictions += 1
+            self.metrics.requests_evicted += 1
+            self.scheduler.requeue_front(req)
+            events.append((req.request_id, None, False))
 
     def _release_slot(self, req):
         slot = req.slot
@@ -709,8 +741,22 @@ class LLMEngine:
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), example_args)
         if jax.default_backend() == "cpu":
             donate = ()
-        compiled = jax.jit(fn, donate_argnums=donate).lower(
-            *shapes).compile()
+        t0 = time.perf_counter()
+        with span("serving.compile", program=str(key)):
+            compiled = jax.jit(fn, donate_argnums=donate).lower(
+                *shapes).compile()
+        # the serving compile choke point reports into the same
+        # recompile log as StaticFunction cache misses: one timeline
+        # answers "what compiled, when, and against what bound" — record
+        # BEFORE the storm check so an over-bound compile is the best-
+        # documented event in the log, not a missing one; cache LAST so
+        # a storm RuntimeError leaves no over-bound program behind that
+        # a catch-and-retry caller could silently keep serving from
+        note_aot_compile(
+            "/".join(str(p) for p in key),
+            compile_ms=round((time.perf_counter() - t0) * 1e3, 3),
+            cache_size=len(self._compiled) + 1,
+            bound=self.config.compile_bound, engine=self._metrics_name)
         self.metrics.note_compile()
         self._compiled[key] = compiled
         return compiled

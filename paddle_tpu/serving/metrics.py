@@ -1,62 +1,80 @@
 """Serving observability: counters, gauges, and latency histograms,
 exposed as one plain-dict snapshot.
 
-The snapshot is the integration surface: `LLMEngine` registers its
-`snapshot` with `paddle_tpu.profiler.register_metrics_source`, so a
-profiler report over a serving process includes queue depth, tokens/s,
-TTFT, inter-token latency percentiles, page utilization, and — the
-recompile-storm tripwire — the compile counter next to its declared
-bound.
+Backing store: the process-wide :mod:`paddle_tpu.observability`
+registry.  ``Histogram`` here IS ``observability.metrics.Histogram``
+(compatibility alias), every latency histogram is registered under an
+engine-labeled Prometheus name (``serving_ttft_seconds{engine=...}``),
+and ``note_compile`` bumps the registry's ``serving_compile_total``
+counter — so `profiler.metrics_report()` and the Prometheus exporter
+both see engine compile counts / TTFT / ITL directly, not through a
+diverging side-registry.  The `snapshot()` dict remains the stable
+coarse integration surface (`LLMEngine` registers it as a metrics
+source; see docs/serving.md 'Metrics reference').
 """
 from __future__ import annotations
 
+import threading
 import time
-from collections import deque
+import weakref
+
+from paddle_tpu.observability.metrics import (Histogram, _label_key,
+                                              next_instance_label,
+                                              registry)
 
 __all__ = ["Histogram", "EngineMetrics"]
 
+# Live-instance count per label set.  Two engines created with the same
+# explicit `metrics_name` SHARE registry instruments (same (name,
+# labels) key — Prometheus semantics), so the instruments may only be
+# dropped when the LAST owner releases; otherwise one engine's
+# shutdown() would silently delete a live engine's histograms from the
+# registry while its snapshot() kept reporting them — exactly the
+# snapshot-vs-Prometheus divergence this layer exists to rule out.
+_live_labels = {}
+_live_lock = threading.Lock()
 
-class Histogram:
-    """Bounded-memory latency histogram: keeps the most recent `cap`
-    observations (seconds) and summarizes on demand.  `observe` is in
-    the per-token hot path, so eviction must be O(1) (deque maxlen)."""
 
-    def __init__(self, cap=4096):
-        self.cap = int(cap)
-        self._vals = deque(maxlen=self.cap)
-        self.count = 0
+def _acquire_labels(labels):
+    key = _label_key(labels)
+    with _live_lock:
+        _live_labels[key] = _live_labels.get(key, 0) + 1
 
-    def observe(self, v):
-        self.count += 1
-        self._vals.append(float(v))
 
-    def _percentile(self, q):
-        vs = sorted(self._vals)
-        if not vs:
-            return None
-        idx = min(len(vs) - 1, max(0, int(round(q * (len(vs) - 1)))))
-        return vs[idx]
-
-    def summary(self, scale=1000.0):
-        """{count, mean, p50, p99} — scaled (default: seconds -> ms)."""
-        if not self._vals:
-            return {"count": self.count, "mean": None, "p50": None,
-                    "p99": None}
-        mean = sum(self._vals) / len(self._vals)
-        return {
-            "count": self.count,
-            "mean": round(mean * scale, 4),
-            "p50": round(self._percentile(0.50) * scale, 4),
-            "p99": round(self._percentile(0.99) * scale, 4),
-        }
+def _release_labels(labels):
+    key = _label_key(labels)
+    # Drop while still holding _live_lock: deciding n==0 and then
+    # dropping outside the lock would let a same-named engine created
+    # in the gap lose its freshly re-created instruments.
+    with _live_lock:
+        n = _live_labels.get(key, 0) - 1
+        if n > 0:
+            _live_labels[key] = n
+            return
+        _live_labels.pop(key, None)
+        if n == 0:
+            registry().drop_labeled(labels)
 
 
 class EngineMetrics:
-    """All engine counters in one place; `snapshot()` is the contract."""
+    """All engine counters in one place; `snapshot()` is the contract.
 
-    def __init__(self, clock=time.perf_counter):
+    `name` labels this instance's registry instruments; an unnamed
+    instance (tests, ad-hoc use) gets a unique generated label so two
+    engines never share a histogram by accident."""
+
+    def __init__(self, clock=time.perf_counter, name=None):
         self.clock = clock
         self.started_t = clock()
+        reg = registry()
+        self.labels = {"engine": name or next_instance_label("engine")}
+        labels = self.labels
+        _acquire_labels(labels)
+        self._released = False
+        # GC safety net: an instance dropped without release() must
+        # still decrement the live count, or the labels leak forever
+        self._finalizer = weakref.finalize(
+            self, _release_labels, dict(labels))
         # counters
         self.requests_received = 0
         self.requests_admitted = 0
@@ -68,20 +86,45 @@ class EngineMetrics:
         self.generated_tokens = 0
         self.compile_count = 0
         self.compile_bound = 0
+        self._compile_counter = reg.counter(
+            "serving_compile_total", labels=labels,
+            help="XLA programs compiled by the serving engine")
         # gauges (engine pushes current values)
         self.queue_depth = 0
         self.running = 0
         self.pages_in_use = 0
         self.pages_total = 0
-        # histograms (seconds)
-        self.ttft = Histogram()
-        self.inter_token = Histogram()
-        self.e2e_latency = Histogram()
-        self.prefill_step_s = Histogram()
-        self.decode_step_s = Histogram()
+        # histograms (seconds) — registry-owned, engine-labeled
+        self.ttft = reg.histogram(
+            "serving_ttft_seconds", labels=labels,
+            help="time to first token")
+        self.inter_token = reg.histogram(
+            "serving_inter_token_seconds", labels=labels,
+            help="inter-token latency")
+        self.e2e_latency = reg.histogram(
+            "serving_e2e_latency_seconds", labels=labels,
+            help="request end-to-end latency")
+        self.prefill_step_s = reg.histogram(
+            "serving_prefill_step_seconds", labels=labels,
+            help="prefill step wall time")
+        self.decode_step_s = reg.histogram(
+            "serving_decode_step_seconds", labels=labels,
+            help="decode step wall time")
+
+    def release(self):
+        """Release this instance's claim on its registry instruments —
+        a finite-lifetime engine must not grow the registry forever.
+        The instruments are dropped only when the last same-labeled
+        instance releases (idempotent)."""
+        if self._released:
+            return
+        self._released = True
+        self._finalizer.detach()
+        _release_labels(self.labels)
 
     def note_compile(self):
         self.compile_count += 1
+        self._compile_counter.inc()
         if self.compile_bound and self.compile_count > self.compile_bound:
             raise RuntimeError(
                 f"recompile storm: {self.compile_count} compiles exceeds "
