@@ -48,6 +48,7 @@ ERNIE_TPU_S = 180
 SERVING_TPU_S = 150
 SHARDLINT_S = 150
 OBS_S = 150
+RESIL_S = 150
 CPU_TIMEOUT_S = 150
 CAPTURE_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), ".bench_capture_tpu.json")
@@ -444,6 +445,81 @@ def worker_obs():
     return 0
 
 
+def worker_resilience():
+    """Resilience lane: crash-safe checkpoint write/restore cost plus
+    the recovery-step overhead of a torn-write fallback, over a
+    synthetic ~16 MB train state.  Pure CPU — checkpointing is
+    host-side work (pickle + fsync + atomic rename), so its cost is
+    platform-independent and the lane never touches the TPU claim.
+
+    Reports (merged into every BENCH line):
+      resilience_ckpt_write_ms        — median durable save() wall ms
+      resilience_ckpt_restore_ms      — median load() (digest verify +
+                                        unpickle) wall ms
+      resilience_recovery_overhead_ms — EXTRA cost of a restore that
+                                        must detect a torn newest
+                                        checkpoint and fall back to
+                                        last-good (the chaos-path price
+                                        on top of a clean restore)
+      resilience_ckpt_mb              — payload size the times refer to
+    """
+    import shutil
+    import statistics
+    import tempfile
+
+    import numpy as np
+
+    _init_backend()   # honors PTPU_FORCE_CPU (always set for this lane)
+
+    from paddle_tpu import resilience as R
+
+    rng = np.random.default_rng(0)
+    state = {"step": 0, "model": {
+        f"w{i}": rng.standard_normal((1024, 2048)).astype(np.float32)
+        for i in range(2)}}
+    data_mb = sum(a.nbytes for a in state["model"].values()) / 1e6
+
+    tdir = tempfile.mkdtemp(prefix="ptpu_resil_bench_")
+    try:
+        ck = R.Checkpointer(tdir, keep=3)
+        writes = []
+        for step in range(5):
+            state["step"] = step
+            t0 = time.perf_counter()
+            ck.save(step, state)
+            writes.append((time.perf_counter() - t0) * 1e3)
+
+        restores = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            got = ck.load()
+            restores.append((time.perf_counter() - t0) * 1e3)
+        assert got is not None and got[0] == 4, "clean restore failed"
+        clean_ms = statistics.median(restores)
+
+        # tear the NEXT payload write, then time the fallback restore —
+        # the same skip-and-recover path the chaos suite proves correct
+        plan = R.FaultPlan([R.FaultSpec("io.save", "torn_write", at=0)],
+                           name="bench-torn")
+        with R.FaultInjector(plan):
+            ck.save(5, state)
+        t0 = time.perf_counter()
+        step, _ = ck.load()
+        recovery_ms = (time.perf_counter() - t0) * 1e3
+        assert step == 4, f"fallback restored step {step}, wanted 4"
+    finally:
+        shutil.rmtree(tdir, ignore_errors=True)
+
+    print(json.dumps({
+        "resilience_ckpt_mb": round(data_mb, 2),
+        "resilience_ckpt_write_ms": round(statistics.median(writes), 2),
+        "resilience_ckpt_restore_ms": round(clean_ms, 2),
+        "resilience_recovery_overhead_ms": round(
+            max(0.0, recovery_ms - clean_ms), 2),
+    }), flush=True)
+    return 0
+
+
 def worker_shardlint():
     """Static-analysis lane: shardlint's cost audit of the flagship
     programs (GPT hybrid train step + serving prefill/decode).  Pure
@@ -748,16 +824,20 @@ def main():
         return worker_shardlint()
     if "--worker-obs" in sys.argv:
         return worker_obs()
+    if "--worker-resilience" in sys.argv:
+        return worker_resilience()
     if "--probe" in sys.argv:
         return probe()
 
     merged, errors = {}, []
-    # shardlint + observability lanes: pure-CPU work that never touches
-    # the TPU claim, so they run CONCURRENTLY with the probe and their
-    # numbers (peak-HBM/padding-waste, span overhead/recompile count)
+    # shardlint + observability + resilience lanes: pure-CPU work that
+    # never touches the TPU claim, so they run CONCURRENTLY with the
+    # probe and their numbers (peak-HBM/padding-waste, span overhead/
+    # recompile count, checkpoint write/restore + recovery overhead)
     # ride along on every report — live, cached, or degraded
     sl_proc = _spawn("--worker-shardlint", force_cpu=True)
     obs_proc = _spawn("--worker-obs", force_cpu=True)
+    resil_proc = _spawn("--worker-resilience", force_cpu=True)
 
     probe_res, probe_err, _ = _await_json(
         _spawn("--probe", force_cpu=False), PROBE_BUDGET_S)
@@ -778,6 +858,14 @@ def main():
         # same rationale as shardlint_error: a telemetry-lane failure
         # must not mark a live measurement run as degraded
         merged["obs_error"] = str(obs_err)
+
+    resil_res, resil_err, _ = _await_json(resil_proc, RESIL_S)
+    if resil_res is not None:
+        merged.update(resil_res)
+    else:
+        # same rationale again: checkpoint-cost telemetry failing must
+        # not mark a live measurement run as degraded
+        merged["resilience_error"] = str(resil_err)
     tpu_ok = bool(probe_res
                   and (probe_res.get("ok") or probe_res.get("probe_ok"))
                   and probe_res.get("platform") != "cpu")
@@ -808,6 +896,14 @@ def main():
                            if k.startswith("obs_")})
         else:
             cached["obs_error"] = str(obs_err)
+        # and the resilience lane: host-side checkpoint costs, same deal
+        for k in [k for k in cached if k.startswith("resilience_")]:
+            cached.pop(k)
+        if "resilience_ckpt_write_ms" in merged:
+            cached.update({k: v for k, v in merged.items()
+                           if k.startswith("resilience_")})
+        else:
+            cached["resilience_error"] = str(resil_err)
         cached["live"] = False
         cached["note"] = (
             f"{reason} — reporting most recent full on-silicon capture "

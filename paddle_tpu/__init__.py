@@ -10,6 +10,14 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+# version-drift shims FIRST: library modules and user code reference
+# `jax.shard_map` / `jax.lax.axis_size`, which older JAX installs only
+# ship under other spellings — importing paddle_tpu makes the
+# environment whole
+from paddle_tpu.core import jax_compat as _jax_compat
+
+_jax_compat.install()
+
 from paddle_tpu.core.tensor import Parameter, Tensor  # noqa: F401
 from paddle_tpu.core import dtype as _dtype_mod
 from paddle_tpu.core.dtype import (  # noqa: F401
@@ -310,7 +318,8 @@ def __getattr__(name):
     # stdlib and the CLI imports it without this package __init__.
     # paddle_tpu.serving lazily as well: the engine compiles nothing at
     # import time, but serving is an opt-in subsystem like onnx export.
-    if name in ("onnx", "analysis", "serving", "observability"):
+    if name in ("onnx", "analysis", "serving", "observability",
+                "resilience"):
         import importlib
         return importlib.import_module(f"paddle_tpu.{name}")
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

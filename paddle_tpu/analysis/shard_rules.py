@@ -406,24 +406,35 @@ def _scan_walk(jaxpr, findings, where):
         elif prim == "while":
             body = eqn.params.get("body_jaxpr")
             if body is not None:
+                bj = getattr(body, "jaxpr", body)
+                n = int(eqn.params.get("body_nconsts", 0))
+                # while puts EVERYTHING in the carry (no consts/xs
+                # split like scan), so a purely const-based invariance
+                # pass sees nothing: also treat fixed-point carry slots
+                # — written back unchanged every iteration — as
+                # invariant
+                fixed = {iv for iv, ov in zip(bj.invars[n:], bj.outvars)
+                         if ov is iv}
                 _flag_invariant_collectives(
-                    getattr(body, "jaxpr", body),
-                    int(eqn.params.get("body_nconsts", 0)),
-                    findings, where, loop=prim)
+                    bj, n, findings, where, loop=prim,
+                    invariant_carry=fixed)
         for v in eqn.params.values():
             for sub in _sub_jaxprs(v):
                 _scan_walk(getattr(sub, "jaxpr", sub), findings, where)
 
 
 def _flag_invariant_collectives(body, num_consts, findings, where,
-                                loop="scan"):
+                                loop="scan", invariant_carry=()):
     """SL203: inside one loop body, a collective whose operands depend
     only on the body's consts (loop-invariant) re-runs every iteration
     for the same answer.  Sub-jaxprs fed ONLY invariant operands are
     entirely invariant, so a collective anywhere inside them flags too;
     sub-jaxprs touching variant operands are skipped conservatively
-    (inner loops get their own pass from _scan_walk)."""
+    (inner loops get their own pass from _scan_walk).
+    `invariant_carry`: carry invars proven invariant by the caller
+    (while fixed-point slots)."""
     variant = set(body.invars[num_consts:])   # carry + xs change per iter
+    variant -= set(invariant_carry)
     for eqn in body.eqns:
         ins_variant = any(v in variant for v in eqn.invars
                           if not hasattr(v, "val"))

@@ -1,0 +1,68 @@
+"""Version-drift shims over the installed JAX.
+
+The repo targets the modern ``jax.shard_map`` entry point (kwargs
+``mesh``/``in_specs``/``out_specs``/``check_vma``); older installs only
+ship ``jax.experimental.shard_map.shard_map`` with the ``check_rep``
+spelling.  :func:`ensure_shard_map` installs a translating alias onto
+the ``jax`` module so every call site — library, tests, and user code
+doing ``from jax import shard_map`` — runs against either version
+instead of dying with an AttributeError at import or call time.
+
+Called from ``paddle_tpu/__init__`` (and tests/conftest.py, which must
+shim before test modules import), so simply importing paddle_tpu makes
+the environment whole.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["ensure_axis_size", "ensure_shard_map", "install"]
+
+
+def ensure_shard_map():
+    """Return ``jax.shard_map``, installing a compat alias if the
+    installed JAX predates the public entry point."""
+    # plain getattr would trip jax's deprecation __getattr__ machinery
+    # on some versions; the module dict is the honest check
+    existing = jax.__dict__.get("shard_map")
+    if existing is not None:
+        return existing
+    try:
+        from jax.experimental.shard_map import shard_map as _exp
+    except ImportError:      # neither spelling: leave jax untouched
+        return None
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=None, **kw):
+        if check_vma is not None and "check_rep" not in kw:
+            kw["check_rep"] = check_vma    # modern name -> old spelling
+        return _exp(f, mesh=mesh, in_specs=in_specs,
+                    out_specs=out_specs, **kw)
+
+    shard_map.__doc__ = _exp.__doc__
+    jax.shard_map = shard_map
+    return shard_map
+
+
+def ensure_axis_size():
+    """Return ``jax.lax.axis_size``, installing a compat alias on
+    installs that predate it (where ``jax.core.axis_frame(name)``
+    returns the bound axis size directly)."""
+    existing = jax.lax.__dict__.get("axis_size")
+    if existing is not None:
+        return existing
+    import jax.core as _core
+
+    def axis_size(axis_name):
+        size = _core.axis_frame(axis_name)
+        # modern axis_frame returns a frame object; the old one the size
+        return getattr(size, "size", size)
+
+    jax.lax.axis_size = axis_size
+    return axis_size
+
+
+def install():
+    """Install every shim; importing paddle_tpu calls this."""
+    ensure_shard_map()
+    ensure_axis_size()

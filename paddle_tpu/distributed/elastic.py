@@ -164,11 +164,21 @@ class HeartbeatServer:
 
 class ElasticManager:
     """Reference: fleet elastic manager — here a thin supervisor combining
-    the step watchdog with host heartbeats."""
+    the step watchdog with host heartbeats, and (optionally) a
+    resilience PreemptionHandler so drains and heartbeats compose: the
+    handler's drain calls :func:`notify_progress` around its final
+    checkpoint write, which beats THIS manager's watchdog — a slow
+    final save is progress, not a stall."""
 
-    def __init__(self, timeout=300.0, abort_on_stall=True):
+    def __init__(self, timeout=300.0, abort_on_stall=True,
+                 preemption=None):
         self.watchdog = Watchdog(timeout=timeout, abort=abort_on_stall)
         self.heartbeats = HeartbeatServer()
+        self.preemption = preemption
+        if preemption is not None:
+            from paddle_tpu.resilience import preemption as _pre
+            _pre.install(preemption)
+            preemption.install_signal_handlers()
 
     def beat(self, step=None):
         self.watchdog.beat(step)
@@ -176,6 +186,13 @@ class ElasticManager:
     def stop(self):
         self.watchdog.stop()
         self.heartbeats.stop()
+        if self.preemption is not None:
+            self.preemption.uninstall_signal_handlers()
+            # and the process-global registration (symmetric with
+            # __init__): a stopped manager's handler must not swallow
+            # later request_preemption() calls — no loop polls it
+            from paddle_tpu.resilience import preemption as _pre
+            _pre.uninstall(self.preemption)
 
 
 # ---- global progress hook ------------------------------------------------

@@ -1,7 +1,13 @@
 """paddle.save / paddle.load. Reference: python/paddle/framework/io.py.
 
 Pickle-compatible state_dict persistence; Orbax-based async/multi-host
-checkpointing lives in paddle_tpu.utils.checkpoint.
+checkpointing lives in paddle_tpu.utils.checkpoint, and crash-safe
+manifested checkpointing (digests, retention, auto-resume) in
+paddle_tpu.resilience.checkpoint — both write through
+:func:`write_atomic` below, the repo's ONE durable-write choke point
+(write to a temp file in the same directory, flush+fsync, then
+``os.replace``), which is also the ``io.save`` fault-injection hook
+site for the chaos suite.
 """
 from __future__ import annotations
 
@@ -23,12 +29,56 @@ def _to_saveable(obj):
     return obj
 
 
-def save(obj, path, protocol=4, **configs):
+def write_atomic(path, data, fsync=True, site="io.save"):
+    """Durably write to `path`: temp file in the target directory,
+    optional fsync, then an atomic ``os.replace`` — a reader never
+    observes a half-written file; a crash mid-write leaves the previous
+    file intact.  `data` is either bytes or a ``callable(file)`` that
+    STREAMS the payload (so a multi-GB save never needs a full
+    in-memory byte copy).
+
+    Fault-injection site ``io.save`` (kind ``torn_write``): payload
+    ``keep_fraction`` truncates the written payload BEFORE the rename
+    (simulating a torn buffer that still got renamed — only a content
+    digest catches it); ``abort_rename`` writes the temp file but skips
+    the rename (simulating a crash between write and rename — atomicity
+    itself is what recovers this one).
+    """
+    from paddle_tpu.resilience import faultinject
+    spec = faultinject.fire(
+        site, path=path,
+        size=len(data) if isinstance(data, (bytes, bytearray)) else None)
+    torn = spec is not None and spec.kind == "torn_write"
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        if callable(data):
+            data(f)
+        else:
+            f.write(data)
+        if torn:
+            f.flush()
+            keep = float(spec.payload.get("keep_fraction", 0.5))
+            f.truncate(max(0, int(f.tell() * keep)))
+        if fsync:
+            f.flush()
+            os.fsync(f.fileno())
+    if torn and spec.payload.get("abort_rename"):
+        return  # the temp file is the debris a real crash would leave
+    os.replace(tmp, path)
+
+
+def save(obj, path, protocol=4, atomic=True, **configs):
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+    if atomic:
+        # streamed through the temp file: atomic-by-default costs no
+        # extra peak host memory over the historical direct pickle.dump
+        write_atomic(path, lambda f: pickle.dump(_to_saveable(obj), f,
+                                                 protocol=protocol))
+    else:
+        with open(path, "wb") as f:
+            pickle.dump(_to_saveable(obj), f, protocol=protocol)
 
 
 def load(path, **configs):

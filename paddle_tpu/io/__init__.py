@@ -516,12 +516,15 @@ class DataLoader:
             _warnings.simplefilter("ignore", RuntimeWarning)
             for p in procs:
                 p.start()
+        # bound BEFORE the try: the finally block below reads `results`,
+        # and an exception while dispatching the first batches must
+        # surface as itself, not as a masking NameError
+        results = {}
         try:
             sent = 0
             for i, b in enumerate(batches[:cap]):
                 idx_q.put((i, list(b)))
                 sent += 1
-            results = {}
             for i in range(len(batches)):
                 while i not in results:
                     try:

@@ -625,6 +625,79 @@ def _collect_bound(stmts):
     return out
 
 
+def _exit_in_finally(stmts):
+    """Does a break/continue belonging to THIS loop level sit inside a
+    ``try``'s ``finally`` block?  Such loops cannot flag-lower: a real
+    exit in ``finally`` runs during exception unwind (and swallows the
+    in-flight exception); the flag form cannot reproduce either, so the
+    loop stays plain Python."""
+    found = [False]
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+        visit_Lambda = visit_FunctionDef
+        visit_ClassDef = visit_FunctionDef
+
+        def visit_While(self, node):
+            pass            # a nested loop owns its exits
+
+        visit_For = visit_While
+
+        def visit_Try(self, node):
+            if _contains(node.finalbody, (ast.Break, ast.Continue),
+                         stop_at_loops=True):
+                found[0] = True
+            self.generic_visit(node)
+
+    for s in stmts:
+        V().visit(s)
+    return found[0]
+
+
+def _exit_in_unhandled(stmts):
+    """Is a this-loop-level ``break``/``continue`` nested under a
+    statement type :meth:`_ExitDesugar._rewrite` does not descend
+    (e.g. ``match``)?  Such loops must stay plain Python: lowering them
+    would leave the raw exit inside the counter-while form, where a
+    ``continue`` skips the counter increment — an infinite trace-time
+    hang.  Deny-by-default: only the containers _rewrite provably
+    handles (If / With / Try) are walked; anything else containing an
+    exit keeps the loop unconverted."""
+    for s in stmts:
+        if isinstance(s, (ast.Break, ast.Continue)):
+            continue                     # this level: _rewrite handles it
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue                     # different exit owner
+        if isinstance(s, (ast.While, ast.For, ast.AsyncFor)):
+            # the nested loop's BODY owns its exits, but its `else:`
+            # clause runs in OUR scope — and _rewrite never descends
+            # nested loops, so any exit there is unhandled
+            if _contains(s.orelse, (ast.Break, ast.Continue),
+                         stop_at_loops=True):
+                return True
+            continue
+        if isinstance(s, ast.If):
+            if _exit_in_unhandled(s.body) or _exit_in_unhandled(s.orelse):
+                return True
+        elif isinstance(s, ast.With):
+            if _exit_in_unhandled(s.body):
+                return True
+        elif isinstance(s, ast.Try):
+            # finalbody exits already keep the loop plain (_exit_in_finally)
+            if _exit_in_unhandled(s.body) or _exit_in_unhandled(s.orelse):
+                return True
+            for h in s.handlers:
+                if _exit_in_unhandled(h.body):
+                    return True
+        elif _contains([s], (ast.Break, ast.Continue), stop_at_loops=True):
+            return True
+    return False
+
+
 def _contains(stmts, kinds, stop_at_loops=False):
     """Does any statement (same function scope) contain a node of `kinds`?
     With stop_at_loops, break/continue inside NESTED loops don't count."""
@@ -857,9 +930,17 @@ class _ExitDesugar:
         has_exit = _contains(node.body, (ast.Break, ast.Continue),
                              stop_at_loops=True)
         has_ret = _contains(node.body, (ast.Return,))
-        if not has_exit or has_ret or node.orelse:
+        if not has_exit or has_ret or node.orelse or \
+                _exit_in_finally(node.body) or \
+                _exit_in_unhandled(node.body):
             # no exits to desugar — or a return makes the loop
-            # unconvertible anyway (left plain; visit_While/For bail)
+            # unconvertible anyway (left plain; visit_While/For bail).
+            # break/continue inside a `finally` stays plain too: a real
+            # exit there runs DURING exception unwind (and may swallow
+            # the exception); the flag form cannot reproduce that.
+            # Same for exits under statement types _rewrite does not
+            # descend (match, ...): lowering would leave the raw exit in
+            # the counter-while form — the trace-time-hang class
             body = self.block(node.body)
             new = type(node)(**{**{f: getattr(node, f)
                                    for f in node._fields}, "body": body})
@@ -933,9 +1014,30 @@ class _ExitDesugar:
         return pre + [ast.copy_location(setup, node),
                       ast.copy_location(new, node)]
 
+    def _flag_guard(self, body, used_brk, used_cont, brk, cont, loc):
+        """`if not (brk or cont): <body>` — the wrapper for statements
+        that must not run once an exit flag may have been set."""
+        flags = ([_nm(brk)] if used_brk else []) + \
+                ([_nm(cont)] if used_cont else [])
+        test = flags[0] if len(flags) == 1 else \
+            ast.BoolOp(op=ast.Or(), values=flags)
+        guard = ast.If(test=ast.UnaryOp(op=ast.Not(), operand=test),
+                       body=body, orelse=[])
+        return ast.copy_location(guard, loc)
+
+    def _guard_rest(self, out, rest_stmts, brk, cont, used_brk,
+                    used_cont, loc):
+        rest, _ = self._rewrite(rest_stmts, brk, cont, used_brk,
+                                used_cont)
+        if rest:
+            out.append(self._flag_guard(rest, used_brk, used_cont,
+                                        brk, cont, loc))
+
     def _rewrite(self, stmts, brk, cont, used_brk, used_cont):
         """Replace break/continue at THIS loop level with flag sets and
-        guard-wrap the statements that follow a possible set. Returns
+        guard-wrap the statements that follow a possible set — descending
+        into If, With, and Try (body/handlers/orelse; `finally` never
+        holds exits here, loop() keeps those loops plain). Returns
         (stmts, may_set_flag)."""
         out = []
         for k, s in enumerate(stmts):
@@ -955,17 +1057,52 @@ class _ExitDesugar:
                     s)
                 if bf or of:
                     out.append(s)
-                    rest, _ = self._rewrite(stmts[k + 1:], brk, cont,
-                                            used_brk, used_cont)
-                    if rest:
-                        flags = ([_nm(brk)] if used_brk else []) + \
-                                ([_nm(cont)] if used_cont else [])
-                        test = flags[0] if len(flags) == 1 else \
-                            ast.BoolOp(op=ast.Or(), values=flags)
-                        guard = ast.If(
-                            test=ast.UnaryOp(op=ast.Not(), operand=test),
-                            body=rest, orelse=[])
-                        out.append(ast.copy_location(guard, s))
+                    self._guard_rest(out, stmts[k + 1:], brk, cont,
+                                     used_brk, used_cont, s)
+                    return out, True
+                out.append(s)
+                continue
+            if isinstance(s, ast.With):
+                # an exit inside `with` leaves the block normally (the
+                # __exit__ still runs at block end), so the flag-set +
+                # guarded-tail form is exact
+                b, bf = self._rewrite(s.body, brk, cont,
+                                      used_brk, used_cont)
+                s = ast.copy_location(
+                    ast.With(items=s.items, body=b or [ast.Pass()]), s)
+                if bf:
+                    out.append(s)
+                    self._guard_rest(out, stmts[k + 1:], brk, cont,
+                                     used_brk, used_cont, s)
+                    return out, True
+                out.append(s)
+                continue
+            if isinstance(s, ast.Try):
+                b, bf = self._rewrite(s.body, brk, cont,
+                                      used_brk, used_cont)
+                handlers, hf = [], False
+                for h in s.handlers:
+                    hb, f = self._rewrite(h.body, brk, cont,
+                                          used_brk, used_cont)
+                    hf = hf or f
+                    handlers.append(ast.ExceptHandler(
+                        type=h.type, name=h.name,
+                        body=hb or [ast.Pass()]))
+                o, of = self._rewrite(s.orelse, brk, cont,
+                                      used_brk, used_cont)
+                if bf and o:
+                    # a real exit in the try body skips `else`; after
+                    # flag-lowering the Try completes "normally", so the
+                    # else must be explicitly flag-guarded
+                    o = [self._flag_guard(o, used_brk, used_cont,
+                                          brk, cont, s)]
+                s = ast.copy_location(
+                    ast.Try(body=b or [ast.Pass()], handlers=handlers,
+                            orelse=o, finalbody=s.finalbody), s)
+                if bf or hf or of:
+                    out.append(s)
+                    self._guard_rest(out, stmts[k + 1:], brk, cont,
+                                     used_brk, used_cont, s)
                     return out, True
                 out.append(s)
                 continue

@@ -35,9 +35,15 @@ class GradientMergeOptimizer:
 
     @no_grad()
     def step(self):
+        from paddle_tpu.distributed import elastic
+        # every microbatch step IS training progress: the accumulate
+        # path below never reaches Optimizer.step (it calls the update
+        # internals directly), so without this beat the elastic
+        # watchdog sees k-1 of every k steps as a stall
+        elastic.notify_progress()
         inner = self._inner
         if self._k <= 1:
-            inner.step()
+            inner.step()            # delegate beats again — harmless
             return
         counter = inner._acc("gm_count", inner._lr_tensor,
                              shape=(), dtype=jnp.int32)

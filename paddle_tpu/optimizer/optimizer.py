@@ -120,7 +120,12 @@ class Optimizer:
     def step(self):
         from paddle_tpu.distributed import elastic
         from paddle_tpu.observability import span
+        from paddle_tpu.resilience import faultinject
         elastic.notify_progress()   # launcher-installed watchdog heartbeat
+        # chaos hook: `exception` faults here exercise retry/elastic
+        # recovery, `preempt` faults the drain path.  Under to_static
+        # this fires at TRACE time only — chaos loops run eager.
+        faultinject.fire("optimizer.step")
         # under to_static this span fires at TRACE time (the update math
         # is fused into the step program); in eager mode it times every
         # parameter update pass

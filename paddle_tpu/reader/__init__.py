@@ -156,6 +156,7 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         lock = threading.Condition()
         done_workers = [0]
         failure = [None]
+        next_idx = [0]     # ordered mode: the index the consumer needs
 
         def fail(e):
             with lock:
@@ -183,6 +184,18 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                     mapped = mapper(item)
                     if order:
                         with lock:
+                            # bounded like the unordered path: an
+                            # out-of-order completion waits while the
+                            # buffer is full — EXCEPT the one index the
+                            # consumer is blocked on (admitting it is
+                            # what unblocks the pipeline; refusing it
+                            # would deadlock at results == buffer_size)
+                            while (len(results) >= buffer_size
+                                   and i != next_idx[0]
+                                   and failure[0] is None):
+                                lock.wait(0.05)
+                            if failure[0] is not None:
+                                return
                             results[i] = mapped
                             lock.notify_all()
                     else:
@@ -211,7 +224,9 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                             return
                         lock.wait(0.05)
                     item = results.pop(i)
-                yield item
+                    next_idx[0] = i + 1
+                    lock.notify_all()   # wake workers blocked on a
+                yield item              # full results buffer
                 i += 1
         else:
             while True:

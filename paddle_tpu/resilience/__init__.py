@@ -1,0 +1,66 @@
+"""paddle_tpu.resilience — fault-tolerant training & serving, plus the
+deterministic fault-injection harness that proves it.
+
+Four pieces (docs/resilience.md has the architecture):
+
+- :mod:`checkpoint` — crash-safe checkpointing: atomic write-then-
+  rename payloads, a digest-bearing manifest with retention, corruption
+  detection with automatic fallback to the last good checkpoint,
+  optional async host-side writes, and :func:`auto_resume` for training
+  loops;
+- :mod:`retry` + :mod:`preemption` — a :func:`retry` decorator with
+  exponential backoff, deterministic jitter and per-exception-class
+  policies, and a :class:`PreemptionHandler` that drains and
+  checkpoints at the step boundary after a preemption signal, beating
+  the ``distributed.elastic`` watchdog through the drain;
+- :mod:`health` — the HEALTHY → DEGRADED → DRAINING state machine the
+  serving engine drives from live page-pool occupancy;
+- :mod:`faultinject` — seeded, deterministic fault plans executed
+  through hook points in ``framework/io.py``, ``optimizer/`` and
+  ``serving/engine.py``, with every injected fault and recovery
+  recorded through ``paddle_tpu.observability``.
+
+Quickstart::
+
+    from paddle_tpu import resilience as R
+
+    ckpt = R.Checkpointer("run/ckpt", keep=3, async_save=True)
+    with R.PreemptionHandler(checkpointer=ckpt) as pre:
+        start, _ = R.auto_resume(ckpt, model, opt)
+        for step in range(start, steps):
+            train_step(batch(step))
+            if step % 10 == 9:
+                ckpt.save_train_state(step, model, opt)
+            if pre.check(step, lambda: {"step": step,
+                                        "model": model.state_dict(),
+                                        "optimizer": opt.state_dict()}):
+                break
+"""
+from paddle_tpu.resilience import faultinject
+from paddle_tpu.resilience.checkpoint import (CheckpointCorruption,
+                                              Checkpointer, auto_resume)
+from paddle_tpu.resilience.faultinject import (FaultInjector, FaultPlan,
+                                               FaultSpec, WorkerFault)
+from paddle_tpu.resilience.health import HealthMonitor, HealthState
+from paddle_tpu.resilience.preemption import (PreemptionHandler,
+                                              request_preemption)
+from paddle_tpu.resilience.retry import (RetryExhausted, RetryPolicy,
+                                         retry)
+
+__all__ = [
+    "CheckpointCorruption",
+    "Checkpointer",
+    "FaultInjector",
+    "FaultPlan",
+    "FaultSpec",
+    "HealthMonitor",
+    "HealthState",
+    "PreemptionHandler",
+    "RetryExhausted",
+    "RetryPolicy",
+    "WorkerFault",
+    "auto_resume",
+    "faultinject",
+    "request_preemption",
+    "retry",
+]

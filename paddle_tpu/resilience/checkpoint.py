@@ -1,0 +1,300 @@
+"""Crash-safe manifested checkpointing.
+
+Layout of a checkpoint directory::
+
+    run/
+      MANIFEST.json            # atomic, rewritten on every save
+      ckpt-00000010.pkl        # atomic write-then-rename payloads
+      ckpt-00000020.pkl
+
+The manifest is the source of truth: every entry records step, file,
+byte size, and a sha256 content digest.  ``load()`` verifies the digest
+before unpickling; a torn or corrupted checkpoint is skipped with a
+recorded recovery event and the next-older GOOD checkpoint restores
+instead — so the failure mode of a torn write is "resume a few steps
+earlier", never "run dead".
+
+Write path durability: payloads and the manifest both go through
+``framework.io.write_atomic`` (temp file + fsync + ``os.replace``), and
+the manifest is updated only AFTER its payload is durably in place —
+the manifest can under-promise (a payload with no entry: harmless
+debris) but never over-promise (an entry whose payload is missing or
+half-written and undetectable).
+
+``async_save=True`` moves serialization's WRITE half off the training
+thread: the state is snapshotted (pickled) synchronously at ``save()``
+time — so later in-place mutation of the live tensors cannot tear the
+checkpoint — and the disk write + manifest update happen on a single
+background writer thread (one thread: writes stay ordered).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import queue
+import threading
+import time
+
+from paddle_tpu.framework import io as fio
+
+__all__ = ["CheckpointCorruption", "Checkpointer", "auto_resume"]
+
+_MANIFEST = "MANIFEST.json"
+_FORMAT = 1
+
+
+class CheckpointCorruption(RuntimeError):
+    """Raised by ``load(strict=True)`` when every manifest entry fails
+    its digest check (the default ``strict=False`` returns None so
+    callers can cold-start)."""
+
+
+def _digest(data):
+    return hashlib.sha256(data).hexdigest()
+
+
+class Checkpointer:
+    """Step-indexed crash-safe checkpoint manager.
+
+    - ``save(step, state)``: atomic payload write + digest + manifest
+      update + retention pruning (keep the ``keep`` newest).
+    - ``load(step=None)``: newest (or exact) GOOD checkpoint as
+      ``(step, state)``; digest-verified with automatic fallback to the
+      last good entry on corruption.
+    - ``save_train_state`` / :func:`auto_resume`: the training-loop
+      convenience pair.
+
+    The observability spans (``resilience.checkpoint.save/load``) and
+    the ``resilience_checkpoint_*`` counters make checkpoint health
+    visible in the same telemetry stream as everything else.
+    """
+
+    def __init__(self, directory, keep=3, async_save=False):
+        if keep < 1:
+            raise ValueError("keep must be >= 1")
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.keep = int(keep)
+        self.async_save = bool(async_save)
+        self._lock = threading.Lock()
+        self._q = None
+        self._writer = None
+        self._writer_error = [None]
+        if self.async_save:
+            self._q = queue.Queue()
+            self._writer = threading.Thread(
+                target=self._writer_loop, daemon=True,
+                name="paddle_tpu-ckpt-writer")
+            self._writer.start()
+
+    # ------------------------------------------------------------ save
+    def _file_for(self, step):
+        return f"ckpt-{int(step):08d}.pkl"
+
+    def save(self, step, state):
+        """Checkpoint `state` (any picklable pytree; Tensors are
+        converted to host arrays) at `step`.  Returns the payload path
+        (the write may still be in flight under ``async_save``)."""
+        from paddle_tpu import observability as obs
+        t0 = time.perf_counter()
+        data = pickle.dumps(fio._to_saveable(state), protocol=4)
+        entry = {
+            "step": int(step),
+            "file": self._file_for(step),
+            "bytes": len(data),
+            "sha256": _digest(data),
+            "time_utc": time.strftime("%Y-%m-%d %H:%M:%S UTC",
+                                      time.gmtime()),
+        }
+        if self.async_save:
+            self._raise_writer_error()
+            self._q.put((data, entry))
+        else:
+            self._commit(data, entry)
+        obs.registry().counter(
+            "resilience_checkpoint_writes_total",
+            help="checkpoint save() calls").inc()
+        with obs.span("resilience.checkpoint.save", step=int(step),
+                      bytes=len(data), async_save=self.async_save,
+                      serialize_ms=round(
+                          (time.perf_counter() - t0) * 1e3, 3)):
+            pass
+        return os.path.join(self.directory, entry["file"])
+
+    def _commit(self, data, entry):
+        """Durably write payload THEN manifest (ordering is the crash-
+        safety invariant); retention-pruned entries are dropped from
+        the SAME manifest write (one fsync'd rewrite per save, and
+        ``io.manifest`` fault occurrences advance once per save) and
+        their payloads deleted after — the manifest never references a
+        deleted payload.  The lock serializes the manifest
+        read-modify-write when sync-mode save() runs from more than one
+        thread (a concurrent entry must never be silently dropped)."""
+        with self._lock:
+            fio.write_atomic(os.path.join(self.directory, entry["file"]),
+                             data)
+            manifest = self._read_manifest()
+            ckpts = [c for c in manifest.get("checkpoints", ())
+                     if c["step"] != entry["step"]]
+            ckpts.append(entry)
+            ckpts.sort(key=lambda c: c["step"])
+            drop, ckpts = ckpts[:-self.keep], ckpts[-self.keep:]
+            self._write_manifest({"format": _FORMAT,
+                                  "checkpoints": ckpts})
+            for c in drop:
+                try:
+                    os.remove(os.path.join(self.directory, c["file"]))
+                except OSError:
+                    pass
+
+    def _writer_loop(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            if isinstance(item, threading.Event):   # wait() flush marker
+                item.set()
+                continue
+            data, entry = item
+            try:
+                self._commit(data, entry)
+            except BaseException as e:  # surfaced on next save()/wait()
+                self._writer_error[0] = e
+
+    def _raise_writer_error(self):
+        err = self._writer_error[0]
+        if err is not None:
+            self._writer_error[0] = None
+            raise RuntimeError(
+                "async checkpoint writer failed") from err
+
+    def wait(self):
+        """Block until queued async writes are durably committed (call
+        before process exit / in the preemption drain).  A flush marker
+        through the single ordered writer thread is the barrier."""
+        if self._q is not None:
+            done = threading.Event()
+            self._q.put(done)
+            done.wait()
+            self._raise_writer_error()
+
+    def close(self):
+        if self._writer is not None:
+            self.wait()
+            self._q.put(None)
+            self._writer.join(timeout=5)
+            self._writer = None
+
+    # ------------------------------------------------------------ load
+    def _read_manifest(self):
+        path = os.path.join(self.directory, _MANIFEST)
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return {"format": _FORMAT, "checkpoints": []}
+
+    def _write_manifest(self, manifest):
+        # distinct fault site: occurrence-indexed plans can tear the
+        # Nth PAYLOAD without counting interleaved manifest rewrites
+        fio.write_atomic(os.path.join(self.directory, _MANIFEST),
+                         json.dumps(manifest, indent=1).encode(),
+                         site="io.manifest")
+
+    def steps(self):
+        """Manifest-recorded steps, ascending (unverified)."""
+        return [c["step"] for c in self._read_manifest()["checkpoints"]]
+
+    def latest_step(self):
+        s = self.steps()
+        return s[-1] if s else None
+
+    def verify(self, entry):
+        """Does `entry`'s payload exist with the manifested digest?"""
+        path = os.path.join(self.directory, entry["file"])
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return None
+        if len(data) != entry["bytes"] or _digest(data) != entry["sha256"]:
+            return None
+        return data
+
+    def load(self, step=None, strict=False):
+        """Restore the newest GOOD checkpoint (or exactly `step`).
+
+        Returns ``(step, state)``; ``None`` when nothing restorable
+        exists and ``strict=False``.  Corrupt entries (torn write, bit
+        rot, missing payload) are skipped with a recorded recovery —
+        the fallback-to-last-good behavior the chaos suite proves.
+        """
+        from paddle_tpu import observability as obs
+        if self.async_save:
+            self.wait()
+        entries = self._read_manifest()["checkpoints"]
+        if step is not None:
+            entries = [c for c in entries if c["step"] == int(step)]
+        skipped = 0
+        for entry in reversed(entries):
+            data = self.verify(entry)
+            if data is None:
+                skipped += 1
+                obs.registry().counter(
+                    "resilience_checkpoint_corrupt_total",
+                    help="checkpoints that failed digest verification"
+                ).inc()
+                continue
+            if skipped:
+                from paddle_tpu.resilience.faultinject import note_recovery
+                note_recovery("io.save", "torn_write",
+                              fallback_step=entry["step"],
+                              skipped=skipped)
+            with obs.span("resilience.checkpoint.load",
+                          step=entry["step"], skipped=skipped):
+                return entry["step"], pickle.loads(data)
+        if strict and entries:
+            raise CheckpointCorruption(
+                f"all {len(entries)} manifest entries under "
+                f"{self.directory} failed digest verification")
+        return None
+
+    # ------------------------------------------- training conveniences
+    def save_train_state(self, step, model=None, optimizer=None,
+                         extra=None):
+        state = {"step": int(step)}
+        if model is not None:
+            state["model"] = model.state_dict()
+        if optimizer is not None:
+            state["optimizer"] = optimizer.state_dict()
+        if extra is not None:
+            state["extra"] = extra
+        return self.save(step, state)
+
+
+def auto_resume(checkpointer, model=None, optimizer=None):
+    """Resume a training loop from the newest good checkpoint.
+
+    Restores model/optimizer state in place and returns
+    ``(start_step, extra)`` — ``start_step`` is the step AFTER the
+    checkpointed one (0 on cold start), ``extra`` whatever
+    ``save_train_state(extra=...)`` recorded (or None)::
+
+        ckpt = Checkpointer("run/ckpt", keep=3)
+        start, _ = auto_resume(ckpt, model, opt)
+        for step in range(start, total_steps):
+            ...
+            if step % 10 == 9:
+                ckpt.save_train_state(step, model, opt)
+    """
+    got = checkpointer.load()
+    if got is None:
+        return 0, None
+    step, state = got
+    if model is not None and "model" in state:
+        model.set_state_dict(state["model"])
+    if optimizer is not None and "optimizer" in state:
+        optimizer.set_state_dict(state["optimizer"])
+    return int(state.get("step", step)) + 1, state.get("extra")

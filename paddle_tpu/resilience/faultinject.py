@@ -1,0 +1,277 @@
+"""Deterministic fault injection — seeded fault plans over named hook
+sites.
+
+The chaos contract (docs/resilience.md): a fault plan is DATA (JSON-able
+list of :class:`FaultSpec`), execution is DETERMINISTIC (faults match on
+the per-site occurrence counter, never wall clock or a free-running
+RNG), and every injected fault and every recovery is recorded through
+``paddle_tpu.observability`` — so a chaos run leaves the same audit
+trail a production incident would.
+
+Hook sites instrumented in this repo:
+
+=====================  ====================================================
+site                   where / supported kinds
+=====================  ====================================================
+``io.save``            ``framework/io.py`` atomic writer — ``torn_write``
+                       (truncate payload / abort the rename),
+                       ``exception``, ``slow``
+``io.manifest``        checkpoint MANIFEST.json rewrites (same writer,
+                       separate occurrence counter)
+``optimizer.step``     ``Optimizer.step`` (eager) — ``exception``,
+                       ``preempt``, ``slow``
+``serving.decode``     ``LLMEngine`` decode step — ``exception`` (the
+                       engine evicts-and-requeues the offending request),
+                       ``slow``
+``serving.pool``       ``LLMEngine`` decode capacity pass —
+                       ``pool_exhaust`` (forces one preemption round
+                       through the REAL victim-selection path)
+=====================  ====================================================
+
+Usage::
+
+    plan = FaultPlan([
+        FaultSpec("io.save", "torn_write", at=2),     # 3rd save is torn
+        FaultSpec("optimizer.step", "preempt", at=5),
+    ], seed=0)
+    with FaultInjector(plan):
+        train()
+
+Call sites use :func:`fire`: near-free when no plan is installed (one
+global ``is None`` check), and generic kinds (``exception`` / ``slow`` /
+``preempt``) are executed by :func:`fire` itself so a hook point is one
+line.  Site-specific kinds (``torn_write``, ``pool_exhaust``) are
+returned to the caller to interpret.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = [
+    "FaultSpec", "FaultPlan", "FaultInjector", "WorkerFault",
+    "fire", "active_plan", "note_recovery",
+]
+
+KINDS = ("torn_write", "exception", "preempt", "pool_exhaust", "slow")
+
+
+class WorkerFault(RuntimeError):
+    """The exception an ``exception``-kind fault raises.  Carries the
+    site and any targeting payload (e.g. ``request_id`` for serving
+    faults) so recovery code can identify the offender."""
+
+    def __init__(self, site, spec, **ctx):
+        self.site = site
+        self.spec = spec
+        self.ctx = dict(ctx)
+        self.request_id = (spec.payload or {}).get("request_id")
+        super().__init__(
+            f"injected fault at {site!r} (kind={spec.kind}, "
+            f"occurrence={spec.at})")
+
+
+class FaultSpec:
+    """One fault: WHERE (site), WHAT (kind), WHEN (occurrence index).
+
+    - ``at``: 0-based occurrence index at the site; the fault fires on
+      occurrences ``[at, at + times)``.  Matching on the occurrence
+      counter (not wall time) is what makes replays deterministic.
+    - ``payload``: kind-specific knobs — ``torn_write``:
+      ``{"keep_fraction": 0.5}`` or ``{"abort_rename": True}``;
+      ``slow``: ``{"sleep_s": 0.05}``; serving ``exception``:
+      ``{"request_id": "req-3"}``.
+    """
+
+    def __init__(self, site, kind, at=0, times=1, payload=None):
+        if kind not in KINDS:
+            raise ValueError(f"unknown fault kind {kind!r}; one of {KINDS}")
+        if at < 0 or times < 1:
+            raise ValueError("at must be >= 0 and times >= 1")
+        self.site = str(site)
+        self.kind = str(kind)
+        self.at = int(at)
+        self.times = int(times)
+        self.payload = dict(payload) if payload else {}
+
+    def matches(self, occurrence):
+        return self.at <= occurrence < self.at + self.times
+
+    def to_dict(self):
+        return {"site": self.site, "kind": self.kind, "at": self.at,
+                "times": self.times, "payload": dict(self.payload)}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(d["site"], d["kind"], d.get("at", 0),
+                   d.get("times", 1), d.get("payload"))
+
+    def __repr__(self):
+        return (f"FaultSpec({self.site!r}, {self.kind!r}, at={self.at}, "
+                f"times={self.times})")
+
+
+class FaultPlan:
+    """An ordered, seeded collection of faults (the chaos-suite input).
+
+    The seed parameterizes nothing today beyond being recorded with
+    every injection event — it exists so a future probabilistic fault
+    kind has a deterministic anchor, and so two chaos runs can be
+    distinguished in the observability log.
+    """
+
+    def __init__(self, faults=(), seed=0, name="fault-plan"):
+        self.faults = [f if isinstance(f, FaultSpec)
+                       else FaultSpec.from_dict(f) for f in faults]
+        self.seed = int(seed)
+        self.name = str(name)
+
+    def faults_for(self, site):
+        return [f for f in self.faults if f.site == site]
+
+    def to_dict(self):
+        return {"name": self.name, "seed": self.seed,
+                "faults": [f.to_dict() for f in self.faults]}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(d.get("faults", ()), d.get("seed", 0),
+                   d.get("name", "fault-plan"))
+
+    def __repr__(self):
+        return (f"FaultPlan({self.name!r}, seed={self.seed}, "
+                f"{len(self.faults)} faults)")
+
+
+class FaultInjector:
+    """Installs a :class:`FaultPlan` for the duration of a ``with``
+    block.  Tracks per-site occurrence counters and a log of every
+    injection (``injector.injected``) for post-hoc assertions."""
+
+    def __init__(self, plan):
+        self.plan = plan
+        self._counts = {}
+        self._lock = threading.Lock()
+        self.injected = []          # [(site, FaultSpec, occurrence)]
+
+    # ---- plan execution ----
+    def poll(self, site, **ctx):
+        """Advance the site's occurrence counter; return the matching
+        FaultSpec (recorded) or None."""
+        with self._lock:
+            occ = self._counts.get(site, 0)
+            self._counts[site] = occ + 1
+        for spec in self.plan.faults_for(site):
+            if spec.matches(occ):
+                self.injected.append((site, spec, occ))
+                _record_injection(self.plan, site, spec, occ, ctx)
+                return spec
+        return None
+
+    def occurrences(self, site):
+        with self._lock:
+            return self._counts.get(site, 0)
+
+    # ---- installation ----
+    def __enter__(self):
+        install(self)
+        return self
+
+    def __exit__(self, *exc):
+        uninstall(self)
+        return False
+
+
+_active = None
+_active_lock = threading.Lock()
+
+
+def install(injector):
+    global _active
+    with _active_lock:
+        if _active is not None:
+            raise RuntimeError("a FaultInjector is already installed "
+                               "(nesting fault plans is not supported)")
+        _active = injector
+    return injector
+
+
+def uninstall(injector=None):
+    global _active
+    with _active_lock:
+        if injector is not None and _active is not injector:
+            return
+        _active = None
+
+
+def active_plan():
+    inj = _active
+    return inj.plan if inj is not None else None
+
+
+def fire(site, **ctx):
+    """The one-line hook call sites use.
+
+    Returns None (the overwhelmingly common case: no plan installed, or
+    no fault due at this occurrence).  Generic kinds execute here:
+
+    - ``exception`` → raises :class:`WorkerFault`;
+    - ``slow``      → sleeps ``payload["sleep_s"]`` (default 0.01);
+    - ``preempt``   → requests preemption on the installed
+      :class:`~paddle_tpu.resilience.preemption.PreemptionHandler`.
+
+    Site-specific kinds (``torn_write``, ``pool_exhaust``) return the
+    spec for the caller to interpret.
+    """
+    inj = _active
+    if inj is None:
+        return None
+    spec = inj.poll(site, **ctx)
+    if spec is None:
+        return None
+    if spec.kind == "exception":
+        raise WorkerFault(site, spec, **ctx)
+    if spec.kind == "slow":
+        time.sleep(float(spec.payload.get("sleep_s", 0.01)))
+        return spec
+    if spec.kind == "preempt":
+        from paddle_tpu.resilience import preemption
+        preemption.request_preemption(reason=f"injected at {site}")
+        return spec
+    return spec
+
+
+# ---- observability wiring ------------------------------------------------
+def _record_injection(plan, site, spec, occurrence, ctx):
+    try:
+        from paddle_tpu import observability as obs
+        with obs.span("resilience.fault", site=site, kind=spec.kind,
+                      occurrence=occurrence, plan=plan.name,
+                      seed=plan.seed):
+            pass
+        obs.registry().counter(
+            "resilience_faults_injected_total",
+            labels={"site": site, "kind": spec.kind},
+            help="faults injected by the chaos harness").inc()
+    except Exception:
+        # fault injection must never be broken by telemetry teardown
+        # ordering (e.g. interpreter shutdown)
+        pass
+
+
+def note_recovery(site, kind, **attrs):
+    """Record a successful recovery from a (possibly injected) fault —
+    checkpoint fallback-to-last-good, decode evict-and-requeue, a retry
+    that eventually succeeded.  Same span/metric channel as injections
+    so the chaos report pairs every fault with its recovery."""
+    try:
+        from paddle_tpu import observability as obs
+        with obs.span("resilience.recovery", site=site, kind=kind,
+                      **attrs):
+            pass
+        obs.registry().counter(
+            "resilience_recoveries_total",
+            labels={"site": site, "kind": kind},
+            help="successful recoveries from faults").inc()
+    except Exception:
+        pass

@@ -1,0 +1,185 @@
+"""Preemption: drain, checkpoint, exit — instead of dying mid-step.
+
+TPU hosts get evicted (spot/preemptible VMs, maintenance events) with a
+signal and a short grace window.  The handler turns that into a
+cooperative protocol:
+
+1. the signal (default SIGTERM) only sets a flag — signal context does
+   no real work;
+2. the training loop polls :meth:`PreemptionHandler.check` once per
+   step; at the first step boundary after the signal it drains: flush
+   async checkpoint writes, save a final checkpoint, and (optionally)
+   exit with a distinct code the launcher maps to "restart me";
+3. the drain beats the elastic watchdog (``distributed.elastic``)
+   before and after the checkpoint write, so a slow final save is not
+   misdiagnosed as a stall and killed halfway through — this is the
+   heartbeats-and-restarts composition contract.
+
+The fault-injection kind ``preempt`` calls :func:`request_preemption`
+on the installed handler, so chaos plans exercise exactly the
+production path minus the actual signal delivery.
+"""
+from __future__ import annotations
+
+import os
+import signal as _signal
+import sys
+import threading
+import time
+
+__all__ = ["PreemptionHandler", "request_preemption", "install",
+           "get_handler", "uninstall"]
+
+_active_handler = None
+_lock = threading.Lock()
+
+
+def install(handler):
+    """Make `handler` the process-wide preemption target (what the
+    ``preempt`` fault kind and external callers hit)."""
+    global _active_handler
+    with _lock:
+        _active_handler = handler
+    return handler
+
+
+def get_handler():
+    return _active_handler
+
+
+def uninstall(handler=None):
+    """Clear the process-wide handler (only if it is `handler`, when
+    given) — the counterpart :class:`PreemptionHandler.__exit__` and
+    ``ElasticManager.stop`` use so a stopped loop's handler cannot
+    swallow later preemption requests."""
+    global _active_handler
+    with _lock:
+        if handler is not None and _active_handler is not handler:
+            return
+        _active_handler = None
+
+
+def request_preemption(reason="external"):
+    """Flag the installed handler (no-op without one, so fault plans
+    with ``preempt`` faults are harmless in loops that opted out)."""
+    h = _active_handler
+    if h is not None:
+        h.request(reason)
+    return h is not None
+
+
+class PreemptionHandler:
+    """Cooperative drain-and-checkpoint on preemption.
+
+    Usage::
+
+        ckpt = Checkpointer("run/ckpt", async_save=True)
+        with PreemptionHandler(checkpointer=ckpt) as pre:
+            start, _ = auto_resume(ckpt, model, opt)
+            for step in range(start, steps):
+                train_step(...)
+                if pre.check(step, lambda: {"step": step,
+                                            "model": model.state_dict(),
+                                            "optimizer": opt.state_dict()}):
+                    break                    # drained + checkpointed
+
+    `exit_code` non-None additionally ``os._exit``\\ s after the drain
+    (the launcher restarts the job; 44 is distinct from the watchdog's
+    43).  Tests and library code leave it None and observe the bool.
+    """
+
+    def __init__(self, checkpointer=None, signals=None,
+                 exit_code=None, auto_install=True):
+        self.checkpointer = checkpointer
+        self.exit_code = exit_code
+        self._flag = threading.Event()
+        self.reason = None
+        self.drained = False
+        self.drain_step = None
+        self._prev = {}
+        self._signals = tuple(signals) if signals is not None \
+            else (_signal.SIGTERM,)
+        if auto_install:
+            install(self)
+
+    # ---- signal / request plumbing ----
+    def install_signal_handlers(self):
+        """Bind the OS signals (main thread only — callers running in
+        worker threads use :func:`request_preemption` instead)."""
+        for sig in self._signals:
+            self._prev[sig] = _signal.signal(sig, self._on_signal)
+        return self
+
+    def uninstall_signal_handlers(self):
+        for sig, prev in self._prev.items():
+            try:
+                _signal.signal(sig, prev)
+            except (ValueError, OSError):
+                pass
+        self._prev.clear()
+
+    def _on_signal(self, signum, frame):
+        self.request(f"signal:{_signal.Signals(signum).name}")
+
+    def request(self, reason="external"):
+        if not self._flag.is_set():
+            self.reason = reason
+            self._flag.set()
+            print(f"[paddle_tpu.resilience] preemption requested "
+                  f"({reason}); will drain at the next step boundary",
+                  file=sys.stderr, flush=True)
+
+    @property
+    def preempted(self):
+        return self._flag.is_set()
+
+    # ---- the step-boundary poll ----
+    def check(self, step, state_fn=None):
+        """Call once per training step.  Returns False on the hot path;
+        on a pending preemption it drains (checkpoint via `state_fn` or
+        the checkpointer's queued writes), records telemetry, optionally
+        exits, and returns True — the loop should break."""
+        if not self._flag.is_set():
+            return False
+        self.drain(step, state_fn)
+        if self.exit_code is not None:
+            os._exit(self.exit_code)
+        return True
+
+    def drain(self, step, state_fn=None):
+        from paddle_tpu import observability as obs
+        from paddle_tpu.distributed import elastic
+        t0 = time.perf_counter()
+        # heartbeat AROUND the save: the final checkpoint of a big model
+        # can exceed the watchdog window; a drain is progress, not a stall
+        elastic.notify_progress()
+        if self.checkpointer is not None and state_fn is not None:
+            self.checkpointer.save(step, state_fn())
+        if self.checkpointer is not None:
+            self.checkpointer.wait()
+        elastic.notify_progress()
+        self.drained = True
+        self.drain_step = step
+        obs.registry().counter(
+            "resilience_preemptions_total",
+            help="preemption drains completed").inc()
+        with obs.span("resilience.preempt.drain", step=step,
+                      reason=self.reason or "",
+                      drain_ms=round((time.perf_counter() - t0) * 1e3,
+                                     3)):
+            pass
+
+    def reset(self):
+        """Re-arm after a handled preemption (tests, multi-run loops)."""
+        self._flag.clear()
+        self.reason = None
+        self.drained = False
+        self.drain_step = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.uninstall_signal_handlers()
+        uninstall(self)
+        return False

@@ -30,10 +30,11 @@ from paddle_tpu.serving.metrics import EngineMetrics, Histogram
 from paddle_tpu.serving.request import (GenerationResult, Request,
                                         RequestState, SamplingParams)
 from paddle_tpu.serving.sampler import sample_tokens
-from paddle_tpu.serving.scheduler import (Scheduler, bucket_for,
-                                          default_buckets)
+from paddle_tpu.serving.scheduler import (AdmissionRejected, Scheduler,
+                                          bucket_for, default_buckets)
 
 __all__ = [
+    "AdmissionRejected",
     "EngineConfig",
     "EngineMetrics",
     "GenerationResult",

@@ -50,13 +50,18 @@ from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.incubate.nn.paged_attention import (PageAllocator,
                                                     paged_decode_step,
                                                     paged_prefill_append)
+from paddle_tpu.resilience.faultinject import fire as _fire
+from paddle_tpu.resilience.faultinject import note_recovery
+from paddle_tpu.resilience.health import HealthMonitor
 from paddle_tpu.serving.metrics import EngineMetrics
 from paddle_tpu.serving.request import (GenerationResult, Request,
                                         RequestState, SamplingParams)
 from paddle_tpu.serving.sampler import sample_tokens
-from paddle_tpu.serving.scheduler import Scheduler, default_buckets
+from paddle_tpu.serving.scheduler import (AdmissionRejected, Scheduler,
+                                          default_buckets)
 
-__all__ = ["EngineConfig", "LLMEngine", "PagedKVContext"]
+__all__ = ["AdmissionRejected", "EngineConfig", "LLMEngine",
+           "PagedKVContext"]
 
 
 class EngineConfig:
@@ -69,12 +74,24 @@ class EngineConfig:
     - `prefill_buckets`: the closed set of padded prompt shapes; the
       engine never compiles any other prefill width.
     - `eos_token_id`: default stop token for requests that don't set one.
+    - `max_queue_depth`: bounded admission — `add_request` past this
+      waiting-queue depth raises :class:`AdmissionRejected` (explicit
+      backpressure) instead of queueing unboundedly.
+    - `crash_safe_decode`: a decode-step exception evicts-and-requeues
+      the offending request (replayed token-identically) instead of
+      killing the engine.
+    - `health_*`: thresholds for the HEALTHY→DEGRADED→DRAINING state
+      machine driven by live page-pool occupancy; DRAINING rejects new
+      admissions until pressure falls.
     """
 
     def __init__(self, max_num_seqs=8, page_size=16, max_model_len=256,
                  num_pages=None, prefill_buckets=None,
                  growth_reserve_pages=1, eos_token_id=None,
-                 dtype=jnp.float32, finished_retention=1024):
+                 dtype=jnp.float32, finished_retention=1024,
+                 max_queue_depth=None, crash_safe_decode=True,
+                 health_degraded_at=0.85, health_drain_at=0.97,
+                 health_recover_at=0.70):
         if max_num_seqs < 1:
             raise ValueError("max_num_seqs must be >= 1")
         self.max_num_seqs = int(max_num_seqs)
@@ -99,6 +116,12 @@ class EngineConfig:
         # `engine.finished_requests`; oldest are dropped past this cap
         # so a long-running step() loop cannot grow without bound
         self.finished_retention = int(finished_retention)
+        self.max_queue_depth = (int(max_queue_depth)
+                                if max_queue_depth is not None else None)
+        self.crash_safe_decode = bool(crash_safe_decode)
+        self.health_degraded_at = float(health_degraded_at)
+        self.health_drain_at = float(health_drain_at)
+        self.health_recover_at = float(health_recover_at)
 
     @property
     def compile_bound(self):
@@ -218,7 +241,8 @@ class LLMEngine:
         self._slots = [None] * B                       # Request | None
 
         self.scheduler = Scheduler(cfg.prefill_buckets, cfg.page_size,
-                                   cfg.growth_reserve_pages)
+                                   cfg.growth_reserve_pages,
+                                   max_queue_depth=cfg.max_queue_depth)
         from paddle_tpu.observability.metrics import next_instance_label
         # a monotonic default label, never id()-derived: a reused id
         # after GC would silently merge this engine's registry metrics
@@ -232,6 +256,14 @@ class LLMEngine:
         self.metrics = EngineMetrics(name=self._metrics_name)
         self.metrics.compile_bound = cfg.compile_bound
         self.metrics.pages_total = cfg.num_pages - 1   # page 0 reserved
+        # health state machine over live page-pool occupancy; the gauge
+        # is EngineMetrics-owned so its registry lifecycle matches
+        self.health = HealthMonitor(
+            degraded_at=cfg.health_degraded_at,
+            drain_at=cfg.health_drain_at,
+            recover_at=cfg.health_recover_at,
+            gauge=self.metrics.health_state)
+        self._decode_fault_streak = 0
 
         self._compiled = {}
         self._requests = {}          # live (queued or running) only
@@ -306,17 +338,31 @@ class LLMEngine:
     def add_request(self, prompt_token_ids, sampling_params=None,
                     stream=None):
         """Queue one request; returns its request id.  Admission happens
-        at the next :meth:`step` boundary."""
+        at the next :meth:`step` boundary.  Raises
+        :class:`AdmissionRejected` under backpressure (waiting queue at
+        `max_queue_depth`, or health DRAINING)."""
         sp = self._resolve_params(sampling_params)
         prompt = [int(t) for t in prompt_token_ids]
         self._validate_request(prompt, sp)
+        if not self.health.admitting:
+            self.metrics.requests_rejected += 1
+            raise AdmissionRejected(
+                "draining",
+                f"engine {self._metrics_name} page-pool pressure "
+                f"{self.health.last_pressure:.2f}")
         rid = f"req-{self._next_id}"
         req = Request(rid, prompt, sp, arrival_index=self._next_id,
                       stream=stream)
-        self._next_id += 1
         req.arrive_t = self.metrics.clock()
+        if sp.deadline_s is not None:
+            req.deadline_t = req.arrive_t + sp.deadline_s
+        try:
+            self.scheduler.enqueue(req)
+        except AdmissionRejected:
+            self.metrics.requests_rejected += 1
+            raise
+        self._next_id += 1
         self._requests[rid] = req
-        self.scheduler.enqueue(req)
         self.metrics.requests_received += 1
         return rid
 
@@ -331,12 +377,15 @@ class LLMEngine:
         this step; a preemption surfaces as ``(request_id, None, False)``
         (the request re-enters the queue and will be replayed)."""
         events = []
+        self._expire_deadlines(events)
         with span("serving.admit"):
             admitted = self._admit(events)
         running = [r for r in self._slots if r is not None]
         if running:
             self._decode_step(events)
-        elif not admitted and self.scheduler.has_waiting():
+        elif not admitted and self.scheduler.has_waiting() \
+                and self.health.admitting:
+            # (DRAINING holds the queue on purpose — not a deadlock)
             head = self.scheduler.peek()
             raise RuntimeError(
                 f"scheduler deadlock: nothing running and request "
@@ -365,7 +414,18 @@ class LLMEngine:
                  for p, sp in zip(prompts, sps)]
         for prompt, sp in pairs:
             self._validate_request(prompt, sp)
-        rids = [self.add_request(p, sp) for p, sp in pairs]
+        rids = []
+        try:
+            for p, sp in pairs:
+                rids.append(self.add_request(p, sp))
+        except AdmissionRejected:
+            # all-or-nothing under backpressure too: withdraw the
+            # partial batch (no step() has run, so the withdrawn
+            # requests own no slots or pages) instead of stranding it
+            # in the bounded queue with no rids returned
+            for r in rids:
+                self.scheduler.withdraw(self._requests.pop(r))
+            raise
         reqs = [self._requests[r] for r in rids]   # hold refs: _finish
         while self.has_unfinished():               # moves them out of
             self.step()                            # the live table
@@ -381,6 +441,27 @@ class LLMEngine:
         registry().unregister_source(self._metrics_name,
                                      expected=self._snapshot_fn)
         self.metrics.release()
+
+    # ----------------------------------------------------- deadlines
+    def _expire_deadlines(self, events):
+        """Step-boundary deadline sweep: queued requests past their TTL
+        finish with reason "deadline"; running ones release their slot
+        and pages first.  Deterministic — driven by `metrics.clock`
+        and queue/slot order only."""
+        now = self.metrics.clock()
+        expired = self.scheduler.pop_expired(now)
+        for slot in range(self.config.max_num_seqs):
+            r = self._slots[slot]
+            if r is not None and r.past_deadline(now):
+                expired.append(r)
+        for req in expired:
+            with span("serving.deadline", request=req.request_id,
+                      state=req.state.value,
+                      overrun_s=round(now - req.deadline_t, 4)):
+                self.metrics.requests_expired += 1
+                self._finish(req, "deadline", now)
+                req.deliver(finished=True)
+                events.append((req.request_id, None, True))
 
     # ----------------------------------------------------- admission
     def _free_slot_count(self):
@@ -451,6 +532,19 @@ class LLMEngine:
     def _decode_step_inner(self, events):
         cfg = self.config
         t0 = self.metrics.clock()
+        # chaos hook: injected pool exhaustion drives ONE deterministic
+        # preemption round through the REAL victim-selection path (the
+        # same code a genuinely dry pool exercises below)
+        spec = _fire("serving.pool", step=self.metrics.decode_steps)
+        if spec is not None and spec.kind == "pool_exhaust":
+            for _ in range(int(spec.payload.get("victims", 1))):
+                victim = self.scheduler.select_victim(
+                    [r for r in self._slots if r is not None])
+                if victim is None:
+                    break
+                self._evict(victim, events)
+                note_recovery("serving.pool", "pool_exhaust",
+                              victim=victim.request_id)
         # capacity pass: every live row must fit one more token; the
         # pool running dry preempts the latest-arrived running request
         for slot in range(cfg.max_num_seqs):
@@ -482,10 +576,20 @@ class LLMEngine:
             tokens[s, 0] = r.output_token_ids[-1]
 
         fn = self._get_decode()
-        logits, self._k_pools, self._v_pools = fn(
-            self._params, self._k_pools, self._v_pools,
-            jnp.asarray(self._tables), jnp.asarray(self._lens),
-            jnp.asarray(tokens))
+        try:
+            # chaos hook: `exception` faults here simulate a crashed
+            # decode (payload `request_id` names the offender)
+            _fire("serving.decode", step=self.metrics.decode_steps)
+            logits, self._k_pools, self._v_pools = fn(
+                self._params, self._k_pools, self._v_pools,
+                jnp.asarray(self._tables), jnp.asarray(self._lens),
+                jnp.asarray(tokens))
+        except Exception as e:
+            if not cfg.crash_safe_decode:
+                raise
+            self._recover_decode_fault(e, events)
+            return
+        self._decode_fault_streak = 0
 
         reqs = [self._slots[s] for s in range(cfg.max_num_seqs)]
         toks = self._sample(logits, reqs, width=cfg.max_num_seqs)
@@ -500,6 +604,36 @@ class LLMEngine:
             r.append_token(toks[s], now=now)
             self.metrics.generated_tokens += 1
             self._post_token(r, events, now)
+
+    def _recover_decode_fault(self, exc, events):
+        """Crash-safe decode: a failed decode program left no state
+        behind (pools/lens update only on success, page grows are
+        idempotent), so the engine evicts-and-requeues the OFFENDING
+        request and keeps serving.  The offender is the exception's
+        `request_id` when it names one (injected faults, request-
+        poisoned inputs), else the latest-arrived live request — the
+        same deterministic victim order preemption uses.  Requeued, not
+        killed: the replay prefill regenerates its tokens exactly, so
+        recovery is token-identical for every surviving request.
+
+        A full batch of consecutive faults (streak > max_num_seqs)
+        means the fault is NOT request-local (wedged device, poisoned
+        weights) — rethrow rather than spin forever."""
+        live = [r for r in self._slots if r is not None]
+        self._decode_fault_streak += 1
+        if not live or self._decode_fault_streak > self.config.max_num_seqs:
+            raise exc
+        rid = getattr(exc, "request_id", None)
+        offender = next((r for r in live if r.request_id == rid), None)
+        if offender is None:
+            offender = max(live, key=lambda r: r.arrival_index)
+        with span("serving.decode_fault", request=offender.request_id,
+                  exc=type(exc).__name__, streak=self._decode_fault_streak):
+            self._evict(offender, events)
+        self.metrics.decode_fault_recoveries += 1
+        note_recovery("serving.decode", "exception",
+                      request=offender.request_id,
+                      exc=type(exc).__name__)
 
     # ------------------------------------------------------ sampling
     def _sample(self, logits, reqs, width):
@@ -539,7 +673,8 @@ class LLMEngine:
     def _finish(self, req, reason, now):
         req.finish_reason = reason
         req.transition(RequestState.FINISHED)
-        self._release_slot(req)
+        if req.slot is not None:     # queued deadline expiry has none
+            self._release_slot(req)
         req.finish_t = now
         self.metrics.requests_finished += 1
         self.metrics.e2e_latency.observe(now - req.arrive_t)
@@ -577,6 +712,9 @@ class LLMEngine:
         m.running = sum(1 for r in self._slots if r is not None)
         m.pages_in_use = (self.config.num_pages - 1
                           - self._alloc.num_free_pages)
+        state = self.health.update(
+            m.pages_in_use / m.pages_total if m.pages_total else 0.0)
+        m.health = state.name.lower()
 
     # ------------------------------------------------- compiled steps
     def _run_model(self, params, ids, pos_ids, ctx):

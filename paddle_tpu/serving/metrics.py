@@ -80,6 +80,9 @@ class EngineMetrics:
         self.requests_admitted = 0
         self.requests_finished = 0
         self.requests_evicted = 0
+        self.requests_rejected = 0   # backpressure (queue_full/draining)
+        self.requests_expired = 0    # deadline enforcement
+        self.decode_fault_recoveries = 0
         self.prefill_steps = 0
         self.decode_steps = 0
         self.prompt_tokens = 0
@@ -94,6 +97,10 @@ class EngineMetrics:
         self.running = 0
         self.pages_in_use = 0
         self.pages_total = 0
+        self.health = "healthy"      # engine-pushed health-state name
+        self.health_state = reg.gauge(
+            "serving_health_state", labels=labels,
+            help="engine health: 0 healthy / 1 degraded / 2 draining")
         # histograms (seconds) — registry-owned, engine-labeled
         self.ttft = reg.histogram(
             "serving_ttft_seconds", labels=labels,
@@ -142,9 +149,13 @@ class EngineMetrics:
                 "admitted": self.requests_admitted,
                 "finished": self.requests_finished,
                 "evicted": self.requests_evicted,
+                "rejected": self.requests_rejected,
+                "expired": self.requests_expired,
             },
             "queue_depth": self.queue_depth,
             "running": self.running,
+            "health": self.health,
+            "decode_fault_recoveries": self.decode_fault_recoveries,
             "steps": {
                 "prefill": self.prefill_steps,
                 "decode": self.decode_steps,

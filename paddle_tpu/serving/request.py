@@ -25,12 +25,14 @@ class RequestState(enum.Enum):
     EVICTED = "evicted"
 
 
-# legal transitions; anything else is an engine bug
+# legal transitions; anything else is an engine bug.  WAITING/EVICTED
+# may go straight to FINISHED: deadline expiry finishes a queued
+# request without it ever (re-)reaching a slot.
 _TRANSITIONS = {
-    RequestState.WAITING: {RequestState.PREFILL},
+    RequestState.WAITING: {RequestState.PREFILL, RequestState.FINISHED},
     RequestState.PREFILL: {RequestState.DECODE, RequestState.FINISHED},
     RequestState.DECODE: {RequestState.FINISHED, RequestState.EVICTED},
-    RequestState.EVICTED: {RequestState.PREFILL},
+    RequestState.EVICTED: {RequestState.PREFILL, RequestState.FINISHED},
     RequestState.FINISHED: set(),
 }
 
@@ -43,28 +45,38 @@ class SamplingParams:
     determine each draw, so generation is batch-composition independent
     (continuous batching, sequential decode, and preemption replay all
     produce identical tokens).
+
+    `deadline_s` is a per-request TTL measured from arrival: a request
+    still queued (or still decoding) past its deadline is finished with
+    ``finish_reason="deadline"`` at the next step boundary — enforced
+    deadline semantics rather than unbounded queueing.
     """
 
     def __init__(self, max_new_tokens=16, temperature=0.0, top_k=0,
-                 top_p=1.0, seed=0, eos_token_id=None):
+                 top_p=1.0, seed=0, eos_token_id=None, deadline_s=None):
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         if temperature < 0.0:
             raise ValueError("temperature must be >= 0")
         if not 0.0 < top_p <= 1.0:
             raise ValueError("top_p must be in (0, 1]")
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError("deadline_s must be positive (or None)")
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self.top_p = float(top_p)
         self.seed = int(seed)
         self.eos_token_id = eos_token_id
+        self.deadline_s = float(deadline_s) if deadline_s is not None \
+            else None
 
     def __repr__(self):
         return (f"SamplingParams(max_new_tokens={self.max_new_tokens}, "
                 f"temperature={self.temperature}, top_k={self.top_k}, "
                 f"top_p={self.top_p}, seed={self.seed}, "
-                f"eos_token_id={self.eos_token_id})")
+                f"eos_token_id={self.eos_token_id}, "
+                f"deadline_s={self.deadline_s})")
 
 
 class Request:
@@ -87,10 +99,12 @@ class Request:
         self.state = RequestState.WAITING
         self.output_token_ids = []
         self._streamed = 0          # tokens already delivered to `stream`
+        self._stream_done = False   # final last=True signal sent
         self.slot = None            # decode batch slot while running
         self.num_evictions = 0
-        self.finish_reason = None   # "stop" | "length"
+        self.finish_reason = None   # "stop" | "length" | "deadline"
         # metrics timestamps (host clocks; filled by the engine)
+        self.deadline_t = None      # arrive_t + deadline_s, or None
         self.arrive_t = None
         self.first_token_t = None
         self.finish_t = None
@@ -130,7 +144,11 @@ class Request:
         return True
 
     def deliver(self, finished):
-        """Stream not-yet-delivered tokens to the callback."""
+        """Stream not-yet-delivered tokens to the callback.  A finish
+        with nothing left to stream (deadline expiry of a queued
+        request, tokens already drained) still fires one final
+        ``(request, None, True)`` completion signal — a stream consumer
+        must never wait forever for its ``last=True``."""
         if self.stream is None:
             self._streamed = len(self.output_token_ids)
             return
@@ -139,7 +157,15 @@ class Request:
             t = toks[self._streamed]
             self._streamed += 1
             last = finished and self._streamed == len(toks)
+            if last:
+                self._stream_done = True
             self.stream(self, t, last)
+        if finished and not self._stream_done:
+            self._stream_done = True
+            self.stream(self, None, True)
+
+    def past_deadline(self, now):
+        return self.deadline_t is not None and now >= self.deadline_t
 
     def should_stop(self):
         """Returns the finish reason if the request is done, else None."""

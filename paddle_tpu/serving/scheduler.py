@@ -25,7 +25,20 @@ from collections import deque
 
 from paddle_tpu.serving.request import RequestState
 
-__all__ = ["bucket_for", "default_buckets", "Scheduler"]
+__all__ = ["AdmissionRejected", "bucket_for", "default_buckets",
+           "Scheduler"]
+
+
+class AdmissionRejected(RuntimeError):
+    """Explicit backpressure: the engine refuses NEW work (bounded
+    admission queue full, or the health state machine is DRAINING)
+    instead of queueing unboundedly.  Callers retry elsewhere / later —
+    `reason` is machine-readable ("queue_full" | "draining")."""
+
+    def __init__(self, reason, detail=""):
+        self.reason = reason
+        super().__init__(f"admission rejected ({reason})"
+                         + (f": {detail}" if detail else ""))
 
 
 def default_buckets(max_model_len, smallest=16):
@@ -58,21 +71,51 @@ class Scheduler:
     unit-testable without compiling anything.
     """
 
-    def __init__(self, buckets, page_size, growth_reserve_pages=1):
+    def __init__(self, buckets, page_size, growth_reserve_pages=1,
+                 max_queue_depth=None):
         self.buckets = tuple(sorted(buckets))
         self.page_size = int(page_size)
         # pages kept back per admission so one decode step can always
         # grow the newly admitted sequence without instant preemption
         self.growth_reserve_pages = int(growth_reserve_pages)
+        # bounded admission: NEW enqueues past this depth raise
+        # AdmissionRejected (None = unbounded, the historical behavior)
+        self.max_queue_depth = (int(max_queue_depth)
+                                if max_queue_depth is not None else None)
         self._waiting = deque()
 
     # ---- queue ----
     def enqueue(self, request):
+        if self.max_queue_depth is not None and \
+                len(self._waiting) >= self.max_queue_depth:
+            raise AdmissionRejected(
+                "queue_full",
+                f"waiting queue at max_queue_depth={self.max_queue_depth}")
         self._waiting.append(request)
 
     def requeue_front(self, request):
-        """Evicted requests keep their FCFS priority."""
+        """Evicted requests keep their FCFS priority.  Exempt from the
+        queue bound: the request was already admitted once, and
+        dropping it here would turn a preemption into a data loss."""
         self._waiting.appendleft(request)
+
+    def withdraw(self, request):
+        """Remove a still-WAITING request from the queue (generate()
+        unwinding a partially-enqueued batch under backpressure).
+        Missing is fine — the request may already have been rejected."""
+        try:
+            self._waiting.remove(request)
+        except ValueError:
+            pass
+
+    def pop_expired(self, now):
+        """Remove and return every waiting request whose deadline has
+        passed (deterministic: queue order preserved for survivors)."""
+        expired = [r for r in self._waiting if r.past_deadline(now)]
+        if expired:
+            self._waiting = deque(r for r in self._waiting
+                                  if not r.past_deadline(now))
+        return expired
 
     @property
     def queue_depth(self):

@@ -127,3 +127,26 @@ def test_process_workers_scale_on_multicore():
                     use_process_workers=True))
     par = time.perf_counter() - t0
     assert serial / par > 2.0, f"only {serial / par:.2f}x from 4 workers"
+
+
+class _BadBatchSampler:
+    """Yields a non-iterable batch: dispatching it raises TypeError
+    INSIDE the worker-dispatch try block (regression: the finally block
+    used to read a not-yet-bound `results` and mask the real error with
+    a NameError)."""
+    batch_size = 2
+
+    def __iter__(self):
+        yield [0, 1]
+        yield 5            # not a batch
+        yield [2, 3]
+
+    def __len__(self):
+        return 3
+
+
+def test_dispatch_failure_surfaces_real_error_not_nameerror():
+    dl = DataLoader(_NpDataset(n=8), batch_sampler=_BadBatchSampler(),
+                    num_workers=2, use_process_workers=True)
+    with pytest.raises(TypeError):
+        list(dl)

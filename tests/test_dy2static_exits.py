@@ -186,3 +186,175 @@ def test_verdict_combined_shape():
 
     _check(fn, P.to_tensor([1.0, 0.5]))
     _check(fn, P.to_tensor([0.1, 0.1]))
+
+
+# ---- exits nested in with / try (r6 regression: ADVICE high) ----
+# The desugarer used to lower `for i in range(...)` with a continue
+# inside a with/try to the counter-while form while leaving the raw
+# `continue` in place — which skipped the counter increment: a
+# confirmed infinite hang at trace time.  The repros run under a
+# watchdog so a regression fails fast instead of hanging the suite.
+import contextlib
+import threading
+
+
+def _check_with_timeout(fn, *args, timeout=60.0):
+    done = []
+    err = []
+
+    def run():
+        try:
+            _check(fn, *args)
+            done.append(True)
+        except BaseException as e:  # noqa: BLE001 — reported below
+            err.append(e)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout)
+    assert not t.is_alive(), (
+        f"{fn.__name__}: conversion hung (>{timeout}s) — the "
+        f"break/continue-in-with/try desugar regressed")
+    if err:
+        raise err[0]
+    assert done
+
+
+def _for_continue_in_with(x):
+    s = P.to_tensor(0.0)
+    for _ in range(6):
+        with contextlib.nullcontext():
+            t = s + x
+            if t > 3.0:
+                continue
+            s = t
+    return s
+
+
+def test_for_continue_in_with_converts():
+    _check_with_timeout(_for_continue_in_with, P.to_tensor(1.0))
+
+
+def _for_break_in_with(x):
+    s = x * 0.0
+    for _ in range(8):
+        with contextlib.nullcontext():
+            s = s + x
+            if s.sum() > 4.0:
+                break
+    return s
+
+
+def test_for_break_in_with_converts():
+    _check_with_timeout(_for_break_in_with, P.to_tensor([1.0, 1.0]))
+
+
+def _for_continue_in_try(x):
+    s = P.to_tensor(0.0)
+    for _ in range(6):
+        try:
+            t = s + x
+            if t > 3.0:
+                continue
+            s = t
+        except ValueError:
+            pass
+    return s
+
+
+def test_for_continue_in_try_converts():
+    _check_with_timeout(_for_continue_in_try, P.to_tensor(1.0))
+
+
+def _while_break_in_try_with_else(x):
+    s = P.to_tensor(0.0)
+    i = P.to_tensor(0.0)
+    while i < 10.0:
+        try:
+            s = s + x
+            if s > 3.0:
+                break
+        except ValueError:
+            pass
+        else:
+            s = s + 0.0       # must be SKIPPED on the break iteration
+        i = i + 1.0
+    return s
+
+
+def test_while_break_in_try_else_semantics():
+    _check_with_timeout(_while_break_in_try_with_else, P.to_tensor(1.5))
+    _check_with_timeout(_while_break_in_try_with_else, P.to_tensor(0.2))
+
+
+def _for_break_in_finally(x):
+    # an exit inside `finally` cannot flag-lower (it runs during
+    # unwind); the loop must stay plain Python and still be correct
+    s = 0.0
+    for _ in range(6):
+        try:
+            s = s + 1.0
+        finally:
+            if s > 3.0:
+                break
+    return P.to_tensor(s) * x
+
+
+def test_break_in_finally_stays_plain_and_correct():
+    _check_with_timeout(_for_break_in_finally, P.to_tensor(2.0))
+
+
+# ---- exits under statement types _rewrite does not descend ----
+def _for_continue_in_match(x):
+    s = x * 0.0
+    for i in range(6):
+        match i:
+            case 2:
+                continue
+            case _:
+                s = s + x
+    return s
+
+
+def test_for_continue_in_match_stays_plain_no_hang():
+    """A continue nested in `match` must keep the loop plain Python
+    (match is not a container the flag-lowering descends): lowering it
+    would leave the raw continue in the counter-while form — the same
+    trace-time infinite hang as the With/Try class above."""
+    _check_with_timeout(_for_continue_in_match, P.to_tensor(1.0))
+
+
+def _for_break_in_match(x):
+    s = x * 0.0
+    for i in range(8):
+        s = s + x
+        match i:
+            case 3:
+                break
+            case _:
+                pass
+    return s
+
+
+def test_for_break_in_match_stays_plain_no_hang():
+    _check_with_timeout(_for_break_in_match, P.to_tensor([1.0, 1.0]))
+
+
+def _outer_continue_in_nested_else(x):
+    s = x * 0.0
+    for i in range(6):
+        for _j in range(1):
+            pass
+        else:
+            if i == 2:
+                continue        # belongs to the OUTER loop
+        s = s + x
+    return s
+
+
+def test_outer_exit_in_nested_loop_else_stays_plain_no_hang():
+    """A nested loop's `else:` clause runs in the OUTER loop's scope,
+    and the flag-lowering never descends nested loops — an outer-level
+    continue there must keep the outer loop plain Python instead of
+    surviving raw into the counter-while form (infinite trace hang)."""
+    _check_with_timeout(_outer_continue_in_nested_else, P.to_tensor(1.0))

@@ -91,7 +91,14 @@ def _make_program(seed, depth=2):
     return ns["prog"], src
 
 
-@pytest.mark.parametrize("seed", list(range(16)))
+# seed 2 generates a nesting pattern whose XLA:CPU compile alone takes
+# ~5 minutes — that one case IS the exhaustive-compile class pytest.ini
+# reserves for the nightly sweep, so it carries the marker (the other
+# seeds stay in the default <5-minute gate)
+@pytest.mark.parametrize(
+    "seed",
+    [pytest.param(s, marks=pytest.mark.nightly) if s == 2 else s
+     for s in range(16)])
 def test_generated_program_eager_vs_compiled(seed):
     prog, src = _make_program(seed)
     rng = np.random.default_rng(seed + 1000)

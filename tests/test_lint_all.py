@@ -1,6 +1,10 @@
-"""The unified static gate: tools/lint_all.py chains tracelint --check,
-shardlint --check and api_coverage --baseline into ONE exit code, and
-this `lint`-marked test is how tier-1 enforces all three baselines.
+"""The unified gate: tools/lint_all.py chains tracelint --check,
+shardlint --check, api_coverage --baseline and the chaos suite
+(pytest -m chaos) into ONE exit code.  This `lint`-marked test is how
+tier-1 enforces the three static baselines; the chaos gate is skipped
+here because tier-1 runs the chaos tests directly (they live in
+tests/test_resilience.py under the `chaos` marker) — standalone
+`python tools/lint_all.py` runs all four.
 """
 import os
 import subprocess
@@ -15,20 +19,27 @@ LINT_ALL = os.path.join(REPO, "tools", "lint_all.py")
 
 
 def test_lint_all_gate_clean():
-    proc = subprocess.run([sys.executable, LINT_ALL], cwd=REPO,
-                          capture_output=True, text=True, timeout=420)
+    # --skip chaos: tier-1 already runs the chaos suite directly
+    # (tests/test_resilience.py carries the marker), so re-running it
+    # nested here would double its cost inside the tier-1 budget for no
+    # added coverage.  Standalone `python tools/lint_all.py` (the CI
+    # entry point) still runs all four gates.
+    proc = subprocess.run([sys.executable, LINT_ALL, "--skip", "chaos"],
+                          cwd=REPO, capture_output=True, text=True,
+                          timeout=420)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     out = proc.stdout
     assert "tracelint: ok" in out
     assert "shardlint: ok" in out
     assert "coverage: ok" in out
+    assert "chaos: SKIPPED" in out
     assert "all gates clean" in out
 
 
 def test_lint_all_skip_flag():
     proc = subprocess.run(
         [sys.executable, LINT_ALL, "--skip", "tracelint", "shardlint",
-         "coverage"],
+         "coverage", "chaos"],
         cwd=REPO, capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0
-    assert proc.stdout.count("SKIPPED") == 3
+    assert proc.stdout.count("SKIPPED") == 4

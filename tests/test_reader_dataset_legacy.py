@@ -115,3 +115,41 @@ def test_dataset_image_utils():
     assert t.shape == (3, 32, 32) and t.dtype == np.float32
     from paddle_tpu.reader.decorator import firstn  # submodule path
     assert list(firstn(lambda: iter(range(9)), 3)()) == [0, 1, 2]
+
+
+def test_reader_xmap_ordered_bounded_memory():
+    """order=True must keep bounded buffering like the unordered path
+    (regression: out-of-order completions used to accumulate in an
+    unbounded dict while the consumer waited on the next index).  The
+    bound is buffer_size buffered results plus at most one mapped item
+    in each worker's hands."""
+    import threading
+    import time
+
+    buffer_size, workers, n = 2, 3, 60
+    produced = [0]
+    consumed = [0]
+    peak = [0]
+    lk = threading.Lock()
+
+    def mapper(x):
+        time.sleep(0.0005 * (x % 3))        # force out-of-order finishes
+        with lk:
+            produced[0] += 1
+            peak[0] = max(peak[0], produced[0] - consumed[0])
+        return x * 2
+
+    r = paddle.reader.xmap_readers(mapper, lambda: iter(range(n)),
+                                   workers, buffer_size, order=True)
+    out = []
+    for v in r():
+        with lk:
+            consumed[0] += 1
+        time.sleep(0.001)                   # slow consumer
+        out.append(v)
+    assert out == [2 * i for i in range(n)]
+    # buffer_size in `results` + one in-flight item per worker (+1 for
+    # the handoff instant)
+    assert peak[0] <= buffer_size + workers + 1, (
+        f"ordered xmap buffered {peak[0]} mapped items "
+        f"(bound {buffer_size + workers + 1})")

@@ -1,0 +1,685 @@
+"""paddle_tpu.resilience — crash-safe checkpointing, retry/backoff,
+preemption drains, the engine health state machine, and the chaos suite.
+
+The `chaos`-marked tests are the acceptance proofs (also run by the
+tools/lint_all.py chaos gate): a training run with an injected torn
+checkpoint + preemption auto-resumes onto the fault-free loss
+trajectory, and a serving run with injected pool exhaustion + a
+mid-decode fault recovers token-identically under the compile bound.
+"""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu import resilience as R
+from paddle_tpu import serving
+from paddle_tpu.resilience.retry import compute_backoff
+
+pytestmark = pytest.mark.resilience
+
+
+# --------------------------------------------------------- checkpointing
+class TestCheckpointer:
+    def test_atomic_roundtrip_and_manifest(self, tmp_path):
+        ck = R.Checkpointer(str(tmp_path), keep=3)
+        ck.save(1, {"w": np.arange(4.0), "step": 1})
+        step, state = ck.load()
+        assert step == 1
+        np.testing.assert_array_equal(state["w"], np.arange(4.0))
+        man = ck._read_manifest()
+        assert man["checkpoints"][0]["sha256"]
+        assert man["checkpoints"][0]["bytes"] > 0
+        # no temp-file debris after a clean save
+        assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+
+    def test_retention_prunes_payloads(self, tmp_path):
+        ck = R.Checkpointer(str(tmp_path), keep=2)
+        for s in range(5):
+            ck.save(s, {"s": s})
+        assert ck.steps() == [3, 4]
+        pkls = [f for f in os.listdir(tmp_path) if f.endswith(".pkl")]
+        assert sorted(pkls) == ["ckpt-00000003.pkl", "ckpt-00000004.pkl"]
+
+    def test_torn_write_falls_back_to_last_good(self, tmp_path):
+        ck = R.Checkpointer(str(tmp_path), keep=3)
+        ck.save(1, {"v": 1.0})
+        ck.save(2, {"v": 2.0})
+        plan = R.FaultPlan([R.FaultSpec("io.save", "torn_write", at=0)])
+        with R.FaultInjector(plan) as inj:
+            ck.save(3, {"v": 3.0})          # payload torn, digest recorded
+        assert len(inj.injected) == 1
+        step, state = ck.load()              # detects, falls back
+        assert (step, state["v"]) == (2, 2.0)
+        # exact-step load of the torn checkpoint yields nothing
+        assert ck.load(step=3) is None
+        with pytest.raises(R.CheckpointCorruption):
+            ck.load(step=3, strict=True)
+
+    def test_aborted_rename_keeps_previous_checkpoint(self, tmp_path):
+        ck = R.Checkpointer(str(tmp_path), keep=3)
+        ck.save(1, {"v": 1.0})
+        plan = R.FaultPlan([R.FaultSpec(
+            "io.save", "torn_write", at=0,
+            payload={"abort_rename": True})])
+        with R.FaultInjector(plan):
+            ck.save(2, {"v": 2.0})          # crash between write & rename
+        step, state = ck.load()
+        assert (step, state["v"]) == (1, 1.0)
+
+    def test_garbage_manifest_is_cold_start(self, tmp_path):
+        ck = R.Checkpointer(str(tmp_path))
+        with open(os.path.join(str(tmp_path), "MANIFEST.json"), "w") as f:
+            f.write("{not json")
+        assert ck.load() is None
+
+    def test_async_save_is_durable_after_wait(self, tmp_path):
+        ck = R.Checkpointer(str(tmp_path), keep=2, async_save=True)
+        for s in range(3):
+            ck.save(s, {"s": np.full(8, float(s))})
+        ck.wait()
+        step, state = ck.load()
+        assert step == 2 and state["s"][0] == 2.0
+        ck.close()
+
+    def test_async_snapshot_immune_to_later_mutation(self, tmp_path):
+        ck = R.Checkpointer(str(tmp_path), async_save=True)
+        arr = np.zeros(4)
+        ck.save(1, {"w": arr})
+        arr[:] = 99.0                        # mutate AFTER save()
+        ck.wait()
+        _, state = ck.load()
+        np.testing.assert_array_equal(state["w"], np.zeros(4))
+        ck.close()
+
+    def test_auto_resume_restores_model_and_optimizer(self, tmp_path):
+        model = P.nn.Linear(4, 2)
+        opt = P.optimizer.SGD(learning_rate=0.1,
+                              parameters=model.parameters())
+        ck = R.Checkpointer(str(tmp_path))
+        w0 = np.asarray(model.weight.numpy()).copy()
+        ck.save_train_state(7, model, opt, extra={"note": "hi"})
+        # clobber, then resume
+        model.weight.set_value(P.to_tensor(np.zeros_like(w0)))
+        start, extra = R.auto_resume(ck, model, opt)
+        assert start == 8
+        assert extra == {"note": "hi"}
+        np.testing.assert_allclose(model.weight.numpy(), w0)
+
+    def test_cold_start_resume(self, tmp_path):
+        ck = R.Checkpointer(str(tmp_path))
+        assert R.auto_resume(ck) == (0, None)
+
+
+# ------------------------------------------------------------------ retry
+class TestRetry:
+    def test_succeeds_after_transient_failures(self):
+        calls = [0]
+
+        @R.retry(max_attempts=5, backoff=0.0, jitter=0.0)
+        def flaky():
+            calls[0] += 1
+            if calls[0] < 3:
+                raise OSError("transient")
+            return "ok"
+
+        assert flaky() == "ok"
+        assert calls[0] == 3
+
+    def test_exhaustion_raises_with_cause(self):
+        @R.retry(max_attempts=3, backoff=0.0, jitter=0.0)
+        def dead():
+            raise ValueError("always")
+
+        with pytest.raises(R.RetryExhausted) as ei:
+            dead()
+        assert ei.value.attempts == 3
+        assert isinstance(ei.value.__cause__, ValueError)
+
+    def test_non_retryable_raises_immediately(self):
+        calls = [0]
+
+        @R.retry(max_attempts=5, backoff=0.0, retry_on=(OSError,))
+        def typed():
+            calls[0] += 1
+            raise KeyError("not retryable")
+
+        with pytest.raises(KeyError):
+            typed()
+        assert calls[0] == 1
+
+    def test_per_exception_policy_overrides_default(self):
+        calls = [0]
+        # KeyError is NOT in retry_on, but gets a dedicated policy
+        @R.retry(max_attempts=2, backoff=0.0, retry_on=(OSError,),
+                 policies={KeyError: R.RetryPolicy(max_attempts=4,
+                                                   backoff=0.0)})
+        def keyed():
+            calls[0] += 1
+            raise KeyError("flaky")
+
+        with pytest.raises(R.RetryExhausted) as ei:
+            keyed()
+        assert ei.value.attempts == 4        # dedicated policy, not 2
+
+    def test_backoff_is_deterministic_and_capped(self):
+        pol = R.RetryPolicy(max_attempts=10, backoff=1.0, multiplier=2.0,
+                            max_backoff=5.0, jitter=0.5)
+        import random
+        a = [compute_backoff(pol, k, random.Random(0)) for k in range(6)]
+        b = [compute_backoff(pol, k, random.Random(0)) for k in range(6)]
+        assert a == b                        # seeded => replayable
+        assert all(d <= 5.0 for d in a)      # cap holds WITH jitter
+        nojit = R.RetryPolicy(backoff=1.0, multiplier=2.0,
+                              max_backoff=5.0, jitter=0.0)
+        assert [compute_backoff(nojit, k, random.Random(0))
+                for k in range(4)] == [1.0, 2.0, 4.0, 5.0]
+
+    def test_delay_sequence_replays_across_runs(self):
+        seen = []
+
+        def run():
+            delays = []
+            calls = [0]
+
+            @R.retry(max_attempts=4, backoff=0.01, jitter=0.9, seed=7,
+                     sleep=lambda s: delays.append(s))
+            def flaky():
+                calls[0] += 1
+                if calls[0] < 4:
+                    raise OSError("x")
+
+            flaky()
+            seen.append(delays)
+
+        run()
+        run()
+        assert seen[0] == seen[1] and len(seen[0]) == 3
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            R.RetryPolicy(max_attempts=0)
+        with pytest.raises(ValueError):
+            R.RetryPolicy(jitter=1.5)
+
+
+# ------------------------------------------------------------- preemption
+class TestPreemption:
+    def test_drain_checkpoints_and_flags(self, tmp_path):
+        ck = R.Checkpointer(str(tmp_path), async_save=True)
+        with R.PreemptionHandler(checkpointer=ck) as pre:
+            assert not pre.check(0)
+            assert R.request_preemption("unit-test")
+            done = pre.check(3, lambda: {"step": 3, "v": 1.0})
+            assert done and pre.drained and pre.drain_step == 3
+            step, state = ck.load()
+            assert step == 3 and state["v"] == 1.0
+            pre.reset()
+            assert not pre.preempted
+        ck.close()
+        # handler uninstalled on context exit
+        assert not R.request_preemption("after-exit")
+
+    def test_fault_kind_preempt_hits_installed_handler(self, tmp_path):
+        with R.PreemptionHandler() as pre:
+            plan = R.FaultPlan([R.FaultSpec("optimizer.step", "preempt",
+                                            at=1)])
+            model = P.nn.Linear(2, 1)
+            opt = P.optimizer.SGD(learning_rate=0.01,
+                                  parameters=model.parameters())
+            stopped_at = None
+            with R.FaultInjector(plan):
+                for step in range(4):
+                    x = P.to_tensor(np.ones((2, 2), np.float32))
+                    loss = (model(x) ** 2).mean()
+                    loss.backward()
+                    opt.step()
+                    opt.clear_grad()
+                    if pre.check(step):
+                        stopped_at = step
+                        break
+            assert stopped_at == 1
+            assert "optimizer.step" in pre.reason
+
+    def test_elastic_manager_stop_uninstalls_handler(self):
+        from paddle_tpu.distributed.elastic import ElasticManager
+        pre = R.PreemptionHandler(auto_install=False)
+        em = ElasticManager(timeout=300.0, abort_on_stall=False,
+                            preemption=pre)
+        assert R.request_preemption("while-running")
+        pre.reset()
+        em.stop()
+        # a stopped manager's handler must not swallow later requests —
+        # no loop polls it anymore
+        assert not R.request_preemption("after-stop")
+        assert not pre.preempted
+
+
+# ----------------------------------------------------------------- health
+class TestHealth:
+    def test_threshold_validation(self):
+        with pytest.raises(ValueError):
+            R.HealthMonitor(degraded_at=0.5, drain_at=0.4)
+        with pytest.raises(ValueError):
+            R.HealthMonitor(recover_at=0.9, degraded_at=0.8)
+
+    def test_hysteretic_transition_sequence(self):
+        h = R.HealthMonitor(degraded_at=0.85, drain_at=0.97,
+                            recover_at=0.70)
+        names = [h.update(p).name for p in
+                 (0.5, 0.86, 0.9, 0.98, 0.9, 0.84, 0.75, 0.69)]
+        assert names == ["HEALTHY", "DEGRADED", "DEGRADED", "DRAINING",
+                         "DRAINING", "DEGRADED", "DEGRADED", "HEALTHY"]
+        assert [(a.name, b.name) for a, b, _ in h.transitions] == [
+            ("HEALTHY", "DEGRADED"), ("DEGRADED", "DRAINING"),
+            ("DRAINING", "DEGRADED"), ("DEGRADED", "HEALTHY")]
+
+    def test_only_draining_blocks_admission(self):
+        h = R.HealthMonitor()
+        assert h.admitting
+        h.update(0.9)
+        assert h.admitting                   # DEGRADED still admits
+        h.update(0.99)
+        assert not h.admitting               # DRAINING rejects
+
+
+# ------------------------------------------------------------ fault plans
+class TestFaultPlans:
+    def test_schema_round_trip(self):
+        plan = R.FaultPlan([R.FaultSpec("io.save", "torn_write", at=2,
+                                        times=3,
+                                        payload={"keep_fraction": 0.25})],
+                           seed=11, name="p")
+        again = R.FaultPlan.from_dict(plan.to_dict())
+        assert again.to_dict() == plan.to_dict()
+        assert again.seed == 11
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            R.FaultSpec("io.save", "meteor")
+
+    def test_occurrence_windows(self):
+        spec = R.FaultSpec("s", "slow", at=1, times=2,
+                           payload={"sleep_s": 0.0})
+        with R.FaultInjector(R.FaultPlan([spec])) as inj:
+            from paddle_tpu.resilience.faultinject import fire
+            hits = [fire("s") is not None for _ in range(5)]
+        assert hits == [False, True, True, False, False]
+        assert inj.occurrences("s") == 5
+
+    def test_nested_injectors_rejected(self):
+        with R.FaultInjector(R.FaultPlan([])):
+            with pytest.raises(RuntimeError, match="already installed"):
+                R.FaultInjector(R.FaultPlan([])).__enter__()
+
+    def test_injections_recorded_in_observability(self):
+        from paddle_tpu import observability as obs
+        plan = R.FaultPlan([R.FaultSpec("unit.site", "slow", at=0,
+                                        payload={"sleep_s": 0.0})])
+        with R.FaultInjector(plan):
+            from paddle_tpu.resilience.faultinject import fire
+            fire("unit.site")
+        snap = obs.registry().snapshot()
+        key = "resilience_faults_injected_total{kind=slow,site=unit.site}"
+        assert snap.get(key, 0) >= 1
+
+
+# ------------------------------------------------- serving backpressure
+def _tiny_engine(model, **kw):
+    d = dict(max_num_seqs=2, page_size=4, max_model_len=32,
+             prefill_buckets=(8, 16))
+    d.update(kw)
+    return serving.LLMEngine(model, serving.EngineConfig(**d))
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt3_tiny
+    P.seed(0)
+    return GPTForCausalLM(gpt3_tiny())
+
+
+class TestServingBackpressure:
+    def test_bounded_queue_rejects_with_reason(self, tiny_model):
+        eng = _tiny_engine(tiny_model, max_queue_depth=2)
+        sp = serving.SamplingParams(max_new_tokens=2)
+        eng.add_request([1, 2], sp)
+        eng.add_request([3, 4], sp)
+        with pytest.raises(serving.AdmissionRejected) as ei:
+            eng.add_request([5, 6], sp)
+        assert ei.value.reason == "queue_full"
+        assert eng.metrics.requests_rejected == 1
+        eng.shutdown()
+
+    def test_generate_unwinds_partial_batch_on_rejection(self,
+                                                         tiny_model):
+        """generate()'s all-or-nothing contract holds under
+        backpressure too: a mid-batch AdmissionRejected withdraws the
+        already-enqueued prompts instead of stranding them in the
+        bounded queue, and the engine stays fully usable."""
+        eng = _tiny_engine(tiny_model, max_queue_depth=2)
+        sp = serving.SamplingParams(max_new_tokens=2)
+        with pytest.raises(serving.AdmissionRejected):
+            eng.generate([[1, 2], [3, 4], [5, 6]], sp)
+        assert eng.scheduler.queue_depth == 0
+        assert not eng.has_unfinished()
+        out = eng.generate([[1, 2], [3, 4]], sp)   # fits: works fine
+        assert len(out) == 2
+        eng.shutdown()
+
+    def test_draining_engine_rejects_admissions(self, tiny_model):
+        eng = _tiny_engine(tiny_model)
+        eng.health.update(0.99)              # force DRAINING
+        with pytest.raises(serving.AdmissionRejected) as ei:
+            eng.add_request([1, 2],
+                            serving.SamplingParams(max_new_tokens=4))
+        assert ei.value.reason == "draining"
+        assert eng.metrics.snapshot()["requests"]["rejected"] == 1
+        eng.shutdown()
+
+    def test_deadline_params_validated(self):
+        with pytest.raises(ValueError):
+            serving.SamplingParams(deadline_s=0.0)
+        assert serving.SamplingParams(deadline_s=2.5).deadline_s == 2.5
+
+
+class TestDeadlineEnforcement:
+    def _run(self, model, advance_at, jump):
+        """One deterministic run with a fake clock; returns the full
+        event stream and {rid: finish_reason}."""
+        eng = _tiny_engine(model, max_num_seqs=1,
+                           prefill_buckets=(8, 16, 32))
+        t = [0.0]
+        eng.metrics.clock = lambda: t[0]
+        r0 = eng.add_request([1, 2, 3],
+                             serving.SamplingParams(max_new_tokens=12,
+                                                    deadline_s=5.0))
+        r1 = eng.add_request([4, 5],
+                             serving.SamplingParams(max_new_tokens=2))
+        events, steps = [], 0
+        while eng.has_unfinished():
+            steps += 1
+            if steps == advance_at:
+                t[0] += jump
+            events.extend(eng.step())
+        reasons = {rid: eng.finished_requests[rid].finish_reason
+                   for rid in (r0, r1)}
+        eng.shutdown()
+        return events, reasons
+
+    def test_deadline_eviction_is_deterministic(self, tiny_model):
+        a = self._run(tiny_model, advance_at=3, jump=10.0)
+        b = self._run(tiny_model, advance_at=3, jump=10.0)
+        assert a == b
+        events, reasons = a
+        assert reasons["req-0"] == "deadline"
+        assert reasons["req-1"] == "length"
+        assert ("req-0", None, True) in events
+        # r1 was queued behind the doomed r0 and still fully served
+        assert sum(1 for e in events
+                   if e[0] == "req-1" and e[1] is not None) == 2
+
+    def test_queued_deadline_expiry_signals_stream(self, tiny_model):
+        """A deadline-expired request that never produced a token must
+        still fire its stream callback once with last=True — a stream
+        consumer can't be left waiting forever."""
+        t = [0.0]
+        eng = _tiny_engine(tiny_model)
+        eng.metrics.clock = lambda: t[0]
+        got = []
+        eng.add_request([1, 2],
+                        serving.SamplingParams(max_new_tokens=2,
+                                               deadline_s=1.0),
+                        stream=lambda r, tok, fin: got.append((tok, fin)))
+        t[0] = 5.0
+        eng.step()
+        assert got == [(None, True)]
+        eng.shutdown()
+
+    def test_expired_in_queue_never_occupies_a_slot(self, tiny_model):
+        eng = _tiny_engine(tiny_model, max_num_seqs=1,
+                           prefill_buckets=(8, 16, 32))
+        t = [0.0]
+        eng.metrics.clock = lambda: t[0]
+        rid = eng.add_request([1, 2],
+                              serving.SamplingParams(max_new_tokens=2,
+                                                     deadline_s=1.0))
+        t[0] = 5.0                           # expires before first step
+        ev = eng.step()
+        assert ev == [(rid, None, True)]
+        req = eng.finished_requests[rid]
+        assert req.finish_reason == "deadline"
+        assert req.output_token_ids == []
+        assert eng.metrics.requests_expired == 1
+        eng.shutdown()
+
+
+# ============================================================ CHAOS SUITE
+def _train_once(steps, ckpt_dir=None, save_every=None, plan=None,
+                stop_and_resume=True):
+    """Deterministic eager training loop (data keyed by step).  Returns
+    (losses_by_step, final_weight).  With a plan installed, runs the
+    faulted protocol: drain on preemption, then "restart" with fresh
+    objects and auto_resume."""
+    def data(step):
+        rng = np.random.default_rng(1000 + step)
+        x = rng.standard_normal((4, 3)).astype(np.float32)
+        y = rng.standard_normal((4, 1)).astype(np.float32)
+        return P.to_tensor(x), P.to_tensor(y)
+
+    def make():
+        P.seed(42)
+        model = P.nn.Linear(3, 1)
+        opt = P.optimizer.SGD(learning_rate=0.05,
+                              parameters=model.parameters())
+        return model, opt
+
+    def run_span(model, opt, ck, pre, start, losses):
+        for step in range(start, steps):
+            x, y = data(step)
+            loss = ((model(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses[step] = float(loss.numpy())
+            if ck is not None and save_every and step % save_every == \
+                    save_every - 1:
+                ck.save_train_state(step, model, opt)
+            if pre is not None and pre.check(step):
+                return step                  # drained; "process exits"
+        return None
+
+    losses = {}
+    model, opt = make()
+    ck = R.Checkpointer(ckpt_dir, keep=3) if ckpt_dir else None
+    if plan is None:
+        run_span(model, opt, ck, None, 0, losses)
+        return losses, np.asarray(model.weight.numpy()).copy()
+
+    with R.PreemptionHandler(checkpointer=ck) as pre:
+        with R.FaultInjector(plan):
+            stopped = run_span(model, opt, ck, pre, 0, losses)
+    assert stopped is not None, "plan was expected to preempt the run"
+    assert pre.drained
+    if not stop_and_resume:
+        return losses, np.asarray(model.weight.numpy()).copy()
+    # ---- restart: fresh process state, resume from last GOOD ckpt ----
+    model, opt = make()
+    start, _ = R.auto_resume(ck, model, opt)
+    resumed = dict(losses)
+    run_span(model, opt, ck, None, start, resumed)
+    return resumed, np.asarray(model.weight.numpy()).copy()
+
+
+@pytest.mark.chaos
+class TestChaosTraining:
+    STEPS = 12
+
+    def test_torn_checkpoint_plus_preemption_resumes_exactly(
+            self, tmp_path):
+        """The acceptance proof: periodic checkpoints at steps 2/5/8,
+        the step-5 payload TORN, preemption at step 6 (before the next
+        good save).  auto_resume must detect the torn step-5
+        checkpoint, fall back to step 2, recompute 3.. and land on the
+        fault-free loss trajectory and final weights EXACTLY."""
+        base_losses, base_w = _train_once(self.STEPS)
+
+        plan = R.FaultPlan([
+            R.FaultSpec("io.save", "torn_write", at=1),      # step-5 save
+            R.FaultSpec("optimizer.step", "preempt", at=6),  # step 6
+        ], seed=0, name="torn+preempt")
+        got_losses, got_w = _train_once(
+            self.STEPS, ckpt_dir=str(tmp_path / "run"), save_every=3,
+            plan=plan)
+
+        assert set(got_losses) == set(base_losses)
+        for step in sorted(base_losses):
+            assert got_losses[step] == base_losses[step], (
+                f"loss diverged at step {step} after resume")
+        np.testing.assert_array_equal(got_w, base_w)
+
+    def test_drain_checkpoint_resumes_from_preemption_step(
+            self, tmp_path):
+        """When the drain itself checkpoints (state_fn wired), resume
+        starts right after the preemption step — no recompute beyond
+        the drained step, same trajectory."""
+        base_losses, base_w = _train_once(self.STEPS)
+
+        def data_free_losses():
+            return {}
+
+        P.seed(42)
+        model = P.nn.Linear(3, 1)
+        opt = P.optimizer.SGD(learning_rate=0.05,
+                              parameters=model.parameters())
+        ck = R.Checkpointer(str(tmp_path / "run2"), keep=3)
+        losses = {}
+        plan = R.FaultPlan([R.FaultSpec("optimizer.step", "preempt",
+                                        at=4)])
+
+        def data(step):
+            rng = np.random.default_rng(1000 + step)
+            return (P.to_tensor(rng.standard_normal((4, 3))
+                                .astype(np.float32)),
+                    P.to_tensor(rng.standard_normal((4, 1))
+                                .astype(np.float32)))
+
+        with R.PreemptionHandler(checkpointer=ck) as pre:
+            with R.FaultInjector(plan):
+                for step in range(self.STEPS):
+                    x, y = data(step)
+                    loss = ((model(x) - y) ** 2).mean()
+                    loss.backward()
+                    opt.step()
+                    opt.clear_grad()
+                    losses[step] = float(loss.numpy())
+                    if pre.check(step, lambda: {
+                            "step": step, "model": model.state_dict(),
+                            "optimizer": opt.state_dict()}):
+                        break
+        assert pre.drain_step == 4
+        P.seed(42)
+        model2 = P.nn.Linear(3, 1)
+        opt2 = P.optimizer.SGD(learning_rate=0.05,
+                               parameters=model2.parameters())
+        start, _ = R.auto_resume(ck, model2, opt2)
+        assert start == 5                    # exactly after the drain
+        for step in range(start, self.STEPS):
+            x, y = data(step)
+            loss = ((model2(x) - y) ** 2).mean()
+            loss.backward()
+            opt2.step()
+            opt2.clear_grad()
+            losses[step] = float(loss.numpy())
+        for step in sorted(base_losses):
+            assert losses[step] == base_losses[step]
+        np.testing.assert_array_equal(
+            np.asarray(model2.weight.numpy()), base_w)
+
+
+@pytest.mark.chaos
+class TestChaosServing:
+    PROMPTS = [[1, 2, 3], [4, 5, 6, 7], [8, 9], [10, 11, 12]]
+
+    def _generate(self, model, plan=None, **cfg):
+        eng = _tiny_engine(model, **cfg)
+        sp = serving.SamplingParams(max_new_tokens=4, temperature=0.7,
+                                    seed=3)
+        if plan is None:
+            out = eng.generate(self.PROMPTS, sp)
+        else:
+            with R.FaultInjector(plan):
+                out = eng.generate(self.PROMPTS, sp)
+        toks = [r.output_token_ids for r in out]
+        return toks, eng
+
+    def test_pool_exhaustion_and_decode_fault_token_identical(
+            self, tiny_model):
+        """Injected KV-pool exhaustion + a mid-decode crash: the engine
+        must recover (evict-and-requeue through the REAL paths) with
+        token-identical output for every request, and lifetime compiles
+        must stay within the declared bound — verified via the
+        observability recompile log."""
+        from paddle_tpu import observability as obs
+        base, eng0 = self._generate(tiny_model)
+        eng0.shutdown()
+
+        plan = R.FaultPlan([
+            R.FaultSpec("serving.pool", "pool_exhaust", at=1),
+            R.FaultSpec("serving.decode", "exception", at=4),
+        ], seed=0, name="serving-chaos")
+        chaos, eng = self._generate(tiny_model, plan=plan)
+
+        assert chaos == base, "chaos run lost token identity"
+        m = eng.metrics
+        assert m.requests_evicted >= 1       # pool exhaustion recovered
+        assert m.decode_fault_recoveries >= 1
+        # compile-bound proof from the recompile log (not just the
+        # engine's own counter): every aot event for THIS engine
+        events = [e for e in obs.recompile_log().events()
+                  if e.attrs.get("engine") == eng._metrics_name]
+        assert 0 < len(events) <= eng.config.compile_bound
+        assert all(e.attrs.get("compile_bound") == eng.config.compile_bound
+                   for e in events)
+        eng.shutdown()
+
+    def test_decode_fault_targeting_named_request(self, tiny_model):
+        """An exception naming a specific request evicts THAT request,
+        not the default latest-arrival victim."""
+        plan = R.FaultPlan([R.FaultSpec(
+            "serving.decode", "exception", at=2,
+            payload={"request_id": "req-0"})])
+        base, e0 = self._generate(tiny_model)
+        e0.shutdown()
+        chaos, eng = self._generate(tiny_model, plan=plan)
+        assert chaos == base
+        # req-0 was evicted+replayed: its eviction count proves targeting
+        evicted = [r for r in eng.finished_requests.values()
+                   if r.request_id == "req-0"]
+        assert not evicted                   # generate() drained its own
+        assert eng.metrics.decode_fault_recoveries == 1
+        eng.shutdown()
+
+    def test_unrecoverable_decode_fault_still_raises(self, tiny_model):
+        """A fault on EVERY decode step exhausts the streak bound and
+        re-raises instead of spinning forever."""
+        plan = R.FaultPlan([R.FaultSpec("serving.decode", "exception",
+                                        at=0, times=10_000)])
+        eng = _tiny_engine(tiny_model)
+        sp = serving.SamplingParams(max_new_tokens=4)
+        with R.FaultInjector(plan):
+            with pytest.raises(R.WorkerFault):
+                eng.generate(self.PROMPTS[:2], sp)
+        eng.shutdown()
+
+    def test_crash_safe_decode_opt_out(self, tiny_model):
+        plan = R.FaultPlan([R.FaultSpec("serving.decode", "exception",
+                                        at=0)])
+        eng = _tiny_engine(tiny_model, crash_safe_decode=False)
+        with R.FaultInjector(plan):
+            with pytest.raises(R.WorkerFault):
+                eng.generate(self.PROMPTS[:1],
+                             serving.SamplingParams(max_new_tokens=4))
+        eng.shutdown()

@@ -1,19 +1,27 @@
 #!/usr/bin/env python
-"""lint_all — the one-exit-code static gate CI runs.
+"""lint_all — the one-exit-code gate CI runs.
 
-Chains every baseline-gated analyzer in the repo:
+Chains every baseline-gated analyzer in the repo, plus the chaos suite:
 
   1. tracelint  --check paddle_tpu examples   (AST trace-safety, TLxxx)
   2. shardlint  --check                       (sharding/memory audit, SLxxx)
   3. api_coverage --baseline                  (public-surface regressions)
+  4. pytest -m chaos                          (deterministic fault-injection
+                                               acceptance proofs)
 
-Each gate compares against its checked-in baseline and fails only on
-REGRESSIONS, so `python tools/lint_all.py` exits 0 on a healthy tree and
-nonzero the moment any gate slips.  The `lint`-marked pytest test
+The static gates compare against their checked-in baselines and fail
+only on REGRESSIONS; the chaos gate re-proves the resilience contracts
+(torn-checkpoint + preemption training resume matches the fault-free
+trajectory; serving pool-exhaustion + mid-decode-fault recovery stays
+token-identical under the compile bound — docs/resilience.md).  So
+`python tools/lint_all.py` exits 0 on a healthy tree and nonzero the
+moment any gate slips.  The `lint`-marked pytest test
 (tests/test_lint_all.py) shells out to this script, which is how tier-1
-enforces all three gates at once.
+enforces every gate at once.  The chaos gate deselects itself there via
+`-m "chaos"` targeting only tests/test_resilience.py — chaos tests
+carry no `lint` marker, so the recursion terminates.
 
-Usage: python tools/lint_all.py [--skip tracelint shardlint coverage]
+Usage: python tools/lint_all.py [--skip tracelint shardlint coverage chaos]
 """
 from __future__ import annotations
 
@@ -34,6 +42,12 @@ GATES = {
     "coverage": [sys.executable, os.path.join(TOOLS, "api_coverage.py"),
                  "--baseline",
                  os.path.join(TOOLS, "api_coverage_baseline.json")],
+    # scoped to the one chaos file: `-m chaos` over the whole tree would
+    # pay full collection, and -p no:cacheprovider keeps gate runs from
+    # racing tier-1's .pytest_cache
+    "chaos": [sys.executable, "-m", "pytest", "-q", "-m", "chaos",
+              "-p", "no:cacheprovider",
+              os.path.join(REPO, "tests", "test_resilience.py")],
 }
 
 
