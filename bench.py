@@ -47,6 +47,7 @@ BERT_TPU_S = 180
 ERNIE_TPU_S = 180
 SERVING_TPU_S = 150
 SHARDLINT_S = 150
+RACELINT_S = 90
 OBS_S = 150
 RESIL_S = 150
 CPU_TIMEOUT_S = 150
@@ -541,6 +542,25 @@ def worker_shardlint():
     return 0
 
 
+def worker_racelint():
+    """Static-analysis lane #2: racelint's host-concurrency audit of
+    the whole package (finding count + per-rule breakdown).  Pure
+    stdlib AST — no jax import at all — so every BENCH run records
+    the concurrency-hazard picture next to the shardlint cost audit."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    tools_dir = os.path.join(repo, "tools")
+    sys.path.insert(0, tools_dir)
+    try:
+        from _bootstrap import light_paddle_tpu
+        light_paddle_tpu(repo)
+        from paddle_tpu.analysis import race_rules
+        out = race_rules.bench_report()
+    finally:
+        sys.path.remove(tools_dir)
+    print(json.dumps(out), flush=True)
+    return 0
+
+
 def _init_backend():
     import jax
 
@@ -822,6 +842,8 @@ def main():
         return worker_serving()
     if "--worker-shardlint" in sys.argv:
         return worker_shardlint()
+    if "--worker-racelint" in sys.argv:
+        return worker_racelint()
     if "--worker-obs" in sys.argv:
         return worker_obs()
     if "--worker-resilience" in sys.argv:
@@ -836,6 +858,7 @@ def main():
     # recompile count, checkpoint write/restore + recovery overhead)
     # ride along on every report — live, cached, or degraded
     sl_proc = _spawn("--worker-shardlint", force_cpu=True)
+    rl_proc = _spawn("--worker-racelint", force_cpu=True)
     obs_proc = _spawn("--worker-obs", force_cpu=True)
     resil_proc = _spawn("--worker-resilience", force_cpu=True)
 
@@ -850,6 +873,13 @@ def main():
         # "Degraded run" boilerplate, and a static-analysis failure must
         # not mark an otherwise fully-live measurement run as degraded
         merged["shardlint_error"] = str(sl_err)
+
+    rl_res, rl_err, _ = _await_json(rl_proc, RACELINT_S)
+    if rl_res is not None:
+        merged.update(rl_res)
+    else:
+        # same rationale as shardlint_error
+        merged["racelint_error"] = str(rl_err)
 
     obs_res, obs_err, _ = _await_json(obs_proc, OBS_S)
     if obs_res is not None:
@@ -872,38 +902,29 @@ def main():
 
     cached = _load_capture()
 
+    def _adopt_lane(prefix, ok_key, err):
+        # platform-independent lanes (static analysis, telemetry,
+        # host-side checkpoint costs): report THIS run's numbers in a
+        # cached report, never the capture's stale ones — and when the
+        # lane itself failed, record the failure rather than passing
+        # stale numbers off as fresh
+        for k in [k for k in cached if k.startswith(prefix)]:
+            cached.pop(k)
+        if ok_key in merged:
+            cached.update({k: v for k, v in merged.items()
+                           if k.startswith(prefix)})
+        else:
+            cached[prefix + "error"] = str(err)
+
     def _report_cached(reason):
         # The relay is down/wedged RIGHT NOW, but we hold a full driver-
         # format on-silicon capture. Report it, clearly labeled: the
         # platform really was the TPU; only the freshness is degraded.
-        # The shardlint lane is platform-independent: report THIS run's
-        # numbers — and when the lane itself failed, drop the capture's
-        # stale ones rather than passing them off as fresh.
-        for k in [k for k in cached if k.startswith("shardlint_")]:
-            cached.pop(k)
-        if "shardlint_findings" in merged:
-            cached.update({k: v for k, v in merged.items()
-                           if k.startswith("shardlint_")})
-        else:
-            cached["shardlint_error"] = str(sl_err)
-        # the observability lane is platform-independent too: report
-        # THIS run's numbers, never the capture's stale ones (including
-        # a stale obs_error from a previously failed lane)
-        for k in [k for k in cached if k.startswith("obs_")]:
-            cached.pop(k)
-        if "obs_span_overhead_pct" in merged:
-            cached.update({k: v for k, v in merged.items()
-                           if k.startswith("obs_")})
-        else:
-            cached["obs_error"] = str(obs_err)
-        # and the resilience lane: host-side checkpoint costs, same deal
-        for k in [k for k in cached if k.startswith("resilience_")]:
-            cached.pop(k)
-        if "resilience_ckpt_write_ms" in merged:
-            cached.update({k: v for k, v in merged.items()
-                           if k.startswith("resilience_")})
-        else:
-            cached["resilience_error"] = str(resil_err)
+        _adopt_lane("shardlint_", "shardlint_findings", sl_err)
+        _adopt_lane("racelint_", "racelint_finding_count", rl_err)
+        _adopt_lane("obs_", "obs_span_overhead_pct", obs_err)
+        _adopt_lane("resilience_", "resilience_ckpt_write_ms",
+                    resil_err)
         cached["live"] = False
         cached["note"] = (
             f"{reason} — reporting most recent full on-silicon capture "

@@ -14,6 +14,13 @@ Surfaces: `tools/tracelint.py` (CLI, baseline-aware `--check` mode) and
 `paddle_tpu.jit.to_static(check=True)` (warnings at wrap/compile time).
 Per-line suppression: `# tracelint: disable=TL101`; whole file:
 `# tracelint: skip-file`.
+
+Siblings sharing the rule registry, the Finding/baseline machinery
+(`analysis/common.py`) and the suppression syntax: **shardlint**
+(`shard_rules.py`/`cost_audit.py`, SLxxx over traced jaxprs — see
+`tools/shardlint.py`) and **racelint** (`lock_model.py`/`race_rules.py`,
+RLxxx host-runtime concurrency audit, plus the runtime lock-order
+sanitizer in `lock_tracer.py` — see `tools/racelint.py`).
 """
 from __future__ import annotations
 

@@ -1,0 +1,92 @@
+"""Shared CLI plumbing for the baseline-gated analyzers.
+
+tracelint, shardlint and racelint all ship the same surface: a finding
+list, a checked-in fingerprint baseline, ``--check`` (fail only on NEW
+findings), ``--write-baseline``, and a ``--json`` report carrying a
+``"tool"`` discriminator over the shared ``analysis/report.to_json``
+schema.  Before this module each CLI re-implemented that flow; the
+third analyzer would have been the third copy.  The helpers here are
+the one implementation — byte-identical output to what the two
+original CLIs printed, which tests/test_racelint.py pins.
+
+Pure stdlib (report.py is too): the CLIs must stay importable without
+jax so the AST passes can gate CI in milliseconds.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+from paddle_tpu.analysis import report
+
+
+def add_baseline_args(ap, default_baseline):
+    """The flag set every baseline-gated analyzer CLI shares."""
+    ap.add_argument("--check", action="store_true",
+                    help="compare against the baseline; fail only on NEW "
+                         "findings")
+    ap.add_argument("--baseline", default=default_baseline,
+                    help=f"baseline file (default {default_baseline})")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write the current findings as the new baseline")
+    ap.add_argument("--json", metavar="FILE", default=None,
+                    help="also write findings as JSON ('-' for stdout)")
+    return ap
+
+
+def print_rules(rules, codes=None):
+    """The ``--rules`` catalogue listing (one format for every tool)."""
+    for r in rules.values():
+        if codes is not None and r.code not in codes:
+            continue
+        print(f"{r.code}  {r.name}")
+        print(f"    {r.message.format(detail='')}")
+        print(f"    why: {r.rationale}")
+        print(f"    fix: {r.fixit}")
+    return 0
+
+
+def run_baseline_flow(findings, args, tool, repo, elapsed,
+                      show_source=True, json_extra=None):
+    """The write-baseline / check-diff / report / json tail every
+    analyzer CLI ends with.  Returns the process exit code: 0 clean,
+    1 findings (plain mode) or NEW findings beyond the baseline
+    (``--check``).
+
+    - `args` must carry the :func:`add_baseline_args` flags.
+    - `json_extra` is merged into the JSON doc AFTER the shared
+      ``{"tool", "elapsed_s"}`` keys (shardlint appends its per-program
+      cost reports there).
+    """
+    if args.write_baseline:
+        report.write_baseline(findings, args.baseline)
+        print(f"wrote baseline: {len(findings)} finding(s) -> "
+              f"{os.path.relpath(args.baseline, repo)}")
+        return 0
+
+    shown = findings
+    note = ""
+    if args.check:
+        baseline = report.load_baseline(args.baseline)
+        shown = report.diff_vs_baseline(findings, baseline)
+        note = (f" ({len(findings)} total, "
+                f"{len(findings) - len(shown)} baselined)")
+
+    if shown:
+        print(report.format_text(shown, show_source=show_source))
+    print(f"{tool}: {len(shown)} finding(s){note} "
+          f"[{report.summarize(shown)}] in {elapsed:.2f}s")
+
+    if args.json:
+        extra = {"tool": tool, "elapsed_s": round(elapsed, 3)}
+        extra.update(json_extra or {})
+        doc = report.to_json(shown, extra=extra)
+        if args.json == "-":
+            json.dump(doc, sys.stdout, indent=1)
+            print()
+        else:
+            with open(args.json, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh, indent=1)
+                fh.write("\n")
+    return 1 if shown else 0

@@ -1,0 +1,996 @@
+"""racelint's world model: thread roots, lock identities, lock sets.
+
+The host runtime is concurrent the way most Python runtimes are: a
+main thread driving the public API, a handful of daemon worker loops
+(`threading.Thread(target=...)`), executor pools, signal handlers, and
+fork-process DataLoader workers.  Statically we recover that structure
+per module and stitch it package-wide:
+
+- **Lock identities.**  ``self._lock = threading.Lock()`` inside class
+  ``C`` of module ``M`` names the lock ``M.C._lock``; a module-level
+  ``_lock = threading.Lock()`` names ``M._lock``; a lock bound to a
+  function local names ``M.<func>.<name>`` (per-call, but its ordering
+  constraints are still real).  Condition/Semaphore count as locks;
+  Queue/Event/deque and friends are classified *thread-safe* so their
+  use never demands a guard.
+- **Thread roots.**  Functions reaching the runtime from somewhere
+  other than the main call stack: ``threading.Thread(target=f)``,
+  ``pool.submit(f)``, ``signal.signal(sig, h)``, multiprocessing
+  ``Process(target=f)`` (discovered, but fork workers do not share the
+  parent heap so they opt out of shared-state rules), and handler
+  objects registered process-wide via ``install(self)``.  Every public
+  method of a class owning a root is additionally a *main-thread* root
+  — the public API is exactly what the main thread calls.
+- **Per-statement lock sets.**  A structural walk of each function
+  tracks the set of locks held at every statement (``with lock:``
+  blocks and paired ``acquire()``/``release()`` calls), tagging every
+  ``self.X`` / module-global access, every blocking call, and every
+  nested acquisition (the acquired-while-holding edge set RL102 runs
+  cycle detection over).
+
+Pure stdlib — no jax import; the CLI models the whole package in a few
+seconds.  :mod:`race_rules` turns this model into RLxxx findings.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from paddle_tpu.analysis.visitor import (ModuleIndex, _dotted,
+                                         walk_same_scope)
+
+# constructor (last dotted segment) -> is it a lock-like / safe type?
+LOCK_TYPES = {"Lock", "RLock", "Condition", "Semaphore",
+              "BoundedSemaphore"}
+# types whose cross-thread use is safe by design: no lock needed, and
+# no RL101 finding for sharing them
+SAFE_TYPES = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue",
+              "JoinableQueue", "Event", "Barrier", "local"}
+
+# method names that MUTATE the object they are called on (used to
+# classify `self.X.append(...)` as a write to X)
+_MUTATORS = {
+    "append", "appendleft", "extend", "insert", "add", "update", "pop",
+    "popleft", "popitem", "remove", "discard", "clear", "setdefault",
+    "sort", "reverse", "put", "put_nowait",
+}
+
+MAIN = "<main>"
+
+
+@dataclass
+class LockInfo:
+    lock_id: str
+    kind: str                   # Lock / RLock / Condition / ...
+    path: str
+    line: int
+
+
+@dataclass
+class ThreadRoot:
+    root_id: str                # "thread:M.C._writer_loop", "signal:..."
+    kind: str                   # thread | executor | signal | process
+                                # | installed
+    target: object              # FunctionInfo or None
+    path: str = ""
+    line: int = 0
+    daemon: bool = False
+    joined: bool = False        # a .join() on the thread was found
+    shares_memory: bool = True  # fork Process roots: False
+
+
+@dataclass
+class Access:
+    attr: str                   # "M.C.X" or "M.X"
+    kind: str                   # read | write
+    locks: frozenset
+    line: int
+    col: int
+    in_init: bool = False
+    func: object = None         # FunctionInfo
+
+
+@dataclass
+class BlockingCall:
+    desc: str
+    locks: frozenset
+    line: int
+    col: int
+
+
+@dataclass
+class Edge:
+    held: str
+    acquired: str
+    line: int
+
+    def key(self):
+        return (self.held, self.acquired)
+
+
+@dataclass
+class FuncModel:
+    fi: object                          # visitor.FunctionInfo
+    owner: str                          # "M.C" or "M"
+    accesses: list = field(default_factory=list)
+    blocking: list = field(default_factory=list)    # held-set nonempty
+    blocking_any: list = field(default_factory=list)  # regardless of held
+    edges: list = field(default_factory=list)           # [Edge]
+    direct_acquires: set = field(default_factory=set)
+    # calls to same-class/module functions made while holding locks:
+    # [(callee FuncModel-key, frozenset(held), line)]
+    held_calls: list = field(default_factory=list)
+    # every resolvable same-scope call: [(callee qualname, line)]
+    calls: list = field(default_factory=list)
+    all_blocking: list = field(default_factory=list)    # transitive
+    acquire_sites: list = field(default_factory=list)   # [(lock_id, line)]
+    contexts: set = field(default_factory=set)          # filled by closure
+    all_acquires: set = field(default_factory=set)      # transitive
+    is_root_target: bool = False
+
+
+@dataclass
+class TOCTOU:
+    attr: str
+    locks: frozenset
+    line: int
+    col: int
+    func: object = None
+
+
+class ClassModel:
+    """Everything racelint knows about one class."""
+
+    def __init__(self, module, name, node, path):
+        self.module = module
+        self.name = name
+        self.node = node
+        self.path = path
+        self.qual = f"{module}.{name}"
+        self.locks = {}             # attr name -> LockInfo
+        self.safe_attrs = set()     # Queue/Event/... typed attributes
+        self.roots = []             # [ThreadRoot] whose target is a method
+        self.funcs = {}             # qualname -> FuncModel
+        self.toctou = []            # [TOCTOU]
+        self.executors = []         # [(attr_or_name, line, has_shutdown)]
+        self.thread_creations = []  # [(line, daemon, joined, target_qn)]
+
+
+class ModuleModel:
+    """One parsed module: its classes, module-level locks/globals/roots."""
+
+    def __init__(self, path, modname, source, tree):
+        self.path = path
+        self.modname = modname
+        self.source = source
+        self.index = ModuleIndex(path, source, tree)
+        self.classes = {}           # class name -> ClassModel
+        self.locks = {}             # module-level: name -> LockInfo
+        self.safe_globals = set()
+        self.shared_globals = set() # names written via `global` / subscript
+        self.roots = []             # module-level-target roots
+        self.funcs = {}             # qualname -> FuncModel (module-level fns)
+        self.toctou = []
+        self.executors = []
+        self.thread_creations = []
+
+    # ---- name plumbing -------------------------------------------------
+    def owner_class(self, fi):
+        """ClassModel a function belongs to (methods AND their nested
+        closures, via the qualname prefix), or None."""
+        head = fi.qualname.split(".")[0]
+        return self.classes.get(head)
+
+    def func_model(self, fi):
+        cm = self.owner_class(fi)
+        table = cm.funcs if cm is not None else self.funcs
+        fm = table.get(fi.qualname)
+        if fm is None:
+            owner = cm.qual if cm is not None else self.modname
+            fm = FuncModel(fi=fi, owner=owner)
+            table[fi.qualname] = fm
+        return fm
+
+    def all_funcs(self):
+        for fm in self.funcs.values():
+            yield fm
+        for cm in self.classes.values():
+            for fm in cm.funcs.values():
+                yield fm
+
+
+def _ctor_kind(node):
+    """'Lock' / 'Queue' / ... when `node` is a call to a known
+    lock/safe-type constructor, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = _dotted(node.func).split(".")[-1]
+    if name in LOCK_TYPES or name in SAFE_TYPES:
+        return name
+    if name == "deque":
+        return "deque"
+    return None
+
+
+def _is_self_attr(node):
+    return (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self")
+
+
+class _FuncWalker:
+    """Walks ONE function body in statement order, tracking the set of
+    held locks, and records accesses / blocking calls / lock-order
+    edges into the FuncModel."""
+
+    def __init__(self, mm, fm):
+        self.mm = mm
+        self.fm = fm
+        self.cm = mm.owner_class(fm.fi)
+        self.local_locks = {}       # local name -> lock_id
+        self.in_init = fm.fi.qualname.endswith("__init__") \
+            and "." in fm.fi.qualname
+
+    # ---- lock identity resolution ----
+    def resolve_lock(self, node):
+        """lock_id for an expression naming a known lock, else None."""
+        if _is_self_attr(node) and self.cm is not None:
+            info = self.cm.locks.get(node.attr)
+            return info.lock_id if info is not None else None
+        if isinstance(node, ast.Name):
+            if node.id in self.local_locks:
+                return self.local_locks[node.id]
+            info = self.mm.locks.get(node.id)
+            return info.lock_id if info is not None else None
+        return None
+
+    # ---- shared-attr resolution ----
+    def resolve_attr(self, node):
+        """Qualified shared-state id for `self.X` / shared global X."""
+        if _is_self_attr(node) and self.cm is not None:
+            name = node.attr
+            if name in self.cm.locks or name in self.cm.safe_attrs:
+                return None
+            return f"{self.cm.qual}.{name}"
+        if isinstance(node, ast.Name):
+            if node.id in self.mm.shared_globals \
+                    and node.id not in self.mm.locks \
+                    and node.id not in self.mm.safe_globals:
+                return f"{self.mm.modname}.{node.id}"
+        return None
+
+    # ---- the walk ----
+    def walk(self):
+        node = self.fm.fi.node
+        # record local lock assignments up-front (closures defined
+        # BEFORE the assignment still see the name at call time); own
+        # scope only — nested functions inherit via the builder
+        for n in walk_same_scope(node):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name):
+                kind = _ctor_kind(n.value)
+                if kind in LOCK_TYPES:
+                    qn = self.fm.fi.qualname
+                    self.local_locks[n.targets[0].id] = \
+                        f"{self.mm.modname}.{qn}.{n.targets[0].id}"
+        self._stmts(node.body, frozenset())
+
+    def _stmts(self, stmts, held):
+        """Process a statement list with `held` locks; returns the held
+        set at the end (acquire()/release() pairs mutate it)."""
+        held = set(held)
+        for stmt in stmts:
+            held = self._stmt(stmt, held)
+        return frozenset(held)
+
+    def _stmt(self, stmt, held):
+        held = set(held)
+        if isinstance(stmt, ast.With):
+            inner = set(held)
+            for item in stmt.items:
+                lid = self.resolve_lock(item.context_expr)
+                self._expr(item.context_expr, frozenset(inner))
+                if lid is not None:
+                    self._acquire(lid, frozenset(inner), stmt.lineno)
+                    inner.add(lid)
+            self._stmts(stmt.body, frozenset(inner))
+            return held
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return held     # nested defs walk via their own FuncModel
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+            f = call.func
+            if isinstance(f, ast.Attribute):
+                lid = self.resolve_lock(f.value)
+                if lid is not None and f.attr == "acquire":
+                    self._acquire(lid, frozenset(held), stmt.lineno)
+                    held.add(lid)
+                    self._expr_children(call, frozenset(held))
+                    return held
+                if lid is not None and f.attr == "release":
+                    held.discard(lid)
+                    return held
+            self._expr(stmt.value, frozenset(held))
+            return held
+        if isinstance(stmt, ast.If):
+            self._maybe_toctou(stmt, frozenset(held))
+            self._expr(stmt.test, frozenset(held))
+            self._stmts(stmt.body, frozenset(held))
+            self._stmts(stmt.orelse, frozenset(held))
+            return held
+        if isinstance(stmt, (ast.For, ast.While)):
+            for f_ in ("test", "iter"):
+                e = getattr(stmt, f_, None)
+                if e is not None:
+                    self._expr(e, frozenset(held))
+            if isinstance(stmt, ast.For):
+                self._expr(stmt.target, frozenset(held), store=True)
+            self._stmts(stmt.body, frozenset(held))
+            self._stmts(stmt.orelse, frozenset(held))
+            return held
+        if isinstance(stmt, ast.Try):
+            h = self._stmts(stmt.body, frozenset(held))
+            for hd in stmt.handlers:
+                self._stmts(hd.body, frozenset(held))
+            self._stmts(stmt.orelse, h)
+            # finally runs with the body's exit set in the common case
+            end = self._stmts(stmt.finalbody, h)
+            return set(end) if stmt.finalbody else set(h)
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            self._assign(stmt, frozenset(held))
+            return held
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            if getattr(stmt, "value", None) is not None:
+                self._expr(stmt.value, frozenset(held))
+            if getattr(stmt, "exc", None) is not None:
+                self._expr(stmt.exc, frozenset(held))
+            return held
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                self._expr(t, frozenset(held), store=True)
+            return held
+        # generic: visit child expressions, nested statements, and
+        # structural containers that are neither (match_case,
+        # ExceptHandler-likes on future grammars) — their statement
+        # bodies still run with the current held set
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._expr(child, frozenset(held))
+            elif isinstance(child, ast.stmt):
+                self._stmt(child, set(held))
+            else:
+                body = getattr(child, "body", None)
+                if isinstance(body, list):
+                    self._stmts(body, frozenset(held))
+                guard = getattr(child, "guard", None)
+                if isinstance(guard, ast.expr):
+                    self._expr(guard, frozenset(held))
+        return held
+
+    def _acquire(self, lid, held, line):
+        self.fm.direct_acquires.add(lid)
+        self.fm.acquire_sites.append((lid, line))
+        for h in held:
+            if h != lid:
+                self.fm.edges.append(Edge(h, lid, line))
+
+    # ---- assignments & expressions ----
+    def _assign(self, stmt, held):
+        targets = stmt.targets if isinstance(stmt, ast.Assign) \
+            else [stmt.target]
+        value = getattr(stmt, "value", None)
+        if value is not None:
+            self._expr(value, held)
+        for t in targets:
+            self._expr(t, held, store=True)
+        if isinstance(stmt, ast.AugAssign):
+            # x += 1 also READS x; record the read explicitly (the
+            # store=True pass above recorded the write)
+            self._record_access(stmt.target, "read", held)
+
+    def _record_access(self, node, kind, held):
+        # peel subscripts and attribute chains down to the shared base:
+        # self.X[k] = v and self.X.field = v both touch X
+        target = node
+        while True:
+            if isinstance(target, ast.Subscript):
+                target = target.value
+            elif isinstance(target, ast.Attribute) \
+                    and not _is_self_attr(target):
+                target = target.value
+            else:
+                break
+        attr = self.resolve_attr(target)
+        if attr is not None:
+            self.fm.accesses.append(Access(
+                attr=attr, kind=kind, locks=frozenset(held),
+                line=node.lineno, col=node.col_offset,
+                in_init=self.in_init, func=self.fm.fi))
+
+    def _expr(self, node, held, store=False):
+        if node is None:
+            return
+        if isinstance(node, (ast.Attribute, ast.Name)):
+            self._record_access(node, "write" if store else "read", held)
+            if isinstance(node, ast.Attribute):
+                self._expr(node.value, held)
+            return
+        if isinstance(node, ast.Subscript):
+            self._record_access(node, "write" if store else "read", held)
+            self._expr(node.value, held)
+            self._expr(node.slice, held)
+            return
+        if isinstance(node, (ast.Tuple, ast.List)) and store:
+            for e in node.elts:
+                self._expr(e, held, store=True)
+            return
+        if isinstance(node, ast.Call):
+            self._call(node, held)
+            return
+        if isinstance(node, (ast.Lambda, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child, held)
+
+    def _expr_children(self, call, held):
+        for a in call.args:
+            self._expr(a, held)
+        for k in call.keywords:
+            self._expr(k.value, held)
+
+    def _call(self, node, held):
+        f = node.func
+        dotted = _dotted(f)
+        last = dotted.split(".")[-1] if dotted else ""
+        # mutator method on a shared container: self.X.append(v)
+        if isinstance(f, ast.Attribute):
+            if last in _MUTATORS:
+                self._record_access(f.value, "write", held)
+            elif last in ("get",) and (node.args or node.keywords):
+                # dict-style read access self.X.get(k)
+                self._record_access(f.value, "read", held)
+            else:
+                self._expr(f.value, held)
+        # held-lock bookkeeping for inline acquire()/release() used in
+        # expression position (rare)
+        if isinstance(f, ast.Attribute) and last in ("acquire",):
+            lid = self.resolve_lock(f.value)
+            if lid is not None:
+                self._acquire(lid, held, node.lineno)
+        # blocking-call audit: record the site regardless of the held
+        # set — a callee's blocking site matters when a CALLER holds a
+        # lock across the call (race_rules surfaces those too)
+        desc = self._blocking_desc(node, dotted, last, held)
+        if desc is not None:
+            bc = BlockingCall(desc=desc, locks=frozenset(held),
+                              line=node.lineno, col=node.col_offset)
+            self.fm.blocking_any.append(bc)
+            if held:
+                self.fm.blocking.append(bc)
+        # calls into same-module code (context flow, transitive lock
+        # acquisition, and — while holding — the interprocedural half
+        # of the RL102 edge set)
+        callee = self._resolve_callee(f)
+        if callee is not None:
+            self.fm.calls.append((callee.qualname, node.lineno))
+            if held:
+                self.fm.held_calls.append(
+                    (callee.qualname, frozenset(held), node.lineno))
+        elif _is_self_attr(f) and self.cm is not None \
+                and last not in _MUTATORS and last != "get":
+            # a STORED callable (self.on_transition(...)): arbitrary
+            # user code — a convoy/deadlock hazard under a lock (the
+            # callback may well try to take the same lock)
+            bc = BlockingCall(
+                desc=f"user callback self.{f.attr}()",
+                locks=frozenset(held), line=node.lineno,
+                col=node.col_offset)
+            self.fm.blocking_any.append(bc)
+            if held:
+                self.fm.blocking.append(bc)
+        self._expr_children(node, held)
+
+    def _resolve_callee(self, f):
+        fi = self.fm.fi
+        if isinstance(f, ast.Name):
+            return self.mm.index._resolve_name(f.id, fi)
+        if _is_self_attr(f) and self.cm is not None:
+            return self.mm.index.methods.get(
+                id(self.cm.node), {}).get(f.attr)
+        return None
+
+    def _blocking_desc(self, node, dotted, last, held):
+        """A human-readable description when `node` is a blocking call
+        (made while holding `held`), else None."""
+        nargs = len(node.args)
+        kwnames = {k.arg for k in node.keywords}
+        if last == "join" and isinstance(node.func, ast.Attribute):
+            # thread/process join takes 0 args or timeout=; str.join and
+            # os.path.join always take the iterable positionally
+            if nargs == 0 and "sep" not in kwnames \
+                    and "path" not in dotted:
+                return "join()"
+        if last == "sleep":
+            return f"{dotted or 'sleep'}()"
+        if last == "get" and nargs == 0 \
+                and not ({"timeout", "block"} & kwnames):
+            return "un-timed queue get()"
+        if last == "wait" and nargs == 0 and "timeout" not in kwnames:
+            lid = self.resolve_lock(node.func.value) \
+                if isinstance(node.func, ast.Attribute) else None
+            if lid is not None:
+                # cv.wait() releases the condition it is called on —
+                # only a problem if OTHER locks are held across it
+                return ("un-timed wait()"
+                        if held - {lid} else None)
+            return "un-timed wait()"
+        if dotted.startswith("subprocess.") or last in (
+                "check_call", "check_output", "communicate"):
+            return f"{dotted}()"
+        if last == "open" and dotted in ("open", "io.open"):
+            return "file open()"
+        if last in ("accept", "recv", "recv_bytes", "connect"):
+            return f"socket/pipe {last}()"
+        if last in ("write_atomic",):
+            return f"{dotted}() [fsync'd file write]"
+        if last == "print" or dotted == "print":
+            return "print()"
+        return None
+
+    # ---- RL201: check-then-act ----
+    def _maybe_toctou(self, stmt, held):
+        """`if <reads shared attr A>: <mutates A>` — record the site;
+        race_rules decides whether the held set actually guards A."""
+        test_attrs = set()
+        for n in ast.walk(stmt.test):
+            if isinstance(n, (ast.Attribute, ast.Name)):
+                a = self.resolve_attr(n)
+                if a is not None:
+                    test_attrs.add(a)
+        if not test_attrs:
+            return
+        body_writes = set()
+        for s in stmt.body:
+            for n in ast.walk(s):
+                target = None
+                if isinstance(n, ast.Assign):
+                    for t in n.targets:
+                        target = t
+                        self._collect_write(target, body_writes)
+                elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+                    self._collect_write(n.target, body_writes)
+                elif isinstance(n, ast.Delete):
+                    for t in n.targets:
+                        self._collect_write(t, body_writes)
+                elif isinstance(n, ast.Call) and \
+                        isinstance(n.func, ast.Attribute) and \
+                        n.func.attr in _MUTATORS:
+                    self._collect_write(n.func.value, body_writes)
+        hit = test_attrs & body_writes
+        if not hit:
+            return
+        sink = self.cm.toctou if self.cm is not None else self.mm.toctou
+        for attr in sorted(hit):
+            sink.append(TOCTOU(attr=attr, locks=held, line=stmt.lineno,
+                               col=stmt.col_offset, func=self.fm.fi))
+
+    def _collect_write(self, target, out):
+        while isinstance(target, ast.Subscript):
+            target = target.value
+        a = self.resolve_attr(target)
+        if a is not None:
+            out.add(a)
+
+
+# --------------------------------------------------------------- builder
+class ModuleBuilder:
+    """Extracts the ModuleModel from one parsed file."""
+
+    def __init__(self, path, modname, source, tree):
+        self.mm = ModuleModel(path, modname, source, tree)
+
+    def build(self):
+        mm = self.mm
+        for node in ast.walk(mm.index.tree):
+            if isinstance(node, ast.ClassDef):
+                mm.classes[node.name] = ClassModel(
+                    mm.modname, node.name, node, mm.path)
+        self._collect_module_level()
+        self._scan_functions()
+        # index order lists enclosing functions before their closures,
+        # so a nested walker can inherit the parent's local-lock table
+        # (a Condition bound in the driver, waited on in the workers)
+        walkers = {}
+        for fi in mm.index.functions:
+            w = _FuncWalker(mm, mm.func_model(fi))
+            parent = walkers.get(fi.qualname.rsplit(".", 1)[0]) \
+                if "." in fi.qualname else None
+            if parent is not None:
+                w.local_locks.update(parent.local_locks)
+            walkers[fi.qualname] = w
+            w.walk()
+        return mm
+
+    # ---- module-level state ----
+    def _collect_module_level(self):
+        mm = self.mm
+        # module-level locks / safe containers / shared globals
+        for stmt in mm.index.tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                name = stmt.targets[0].id
+                kind = _ctor_kind(stmt.value)
+                if kind in LOCK_TYPES:
+                    mm.locks[name] = LockInfo(
+                        f"{mm.modname}.{name}", kind, mm.path,
+                        stmt.lineno)
+                elif kind is not None:
+                    mm.safe_globals.add(name)
+                elif isinstance(stmt.value, (ast.Dict, ast.List)):
+                    # module-level mutable container: shared iff some
+                    # function subscript-writes it (_scan_functions)
+                    mm.shared_globals.add(name)
+
+    def _global_write(self, target, out):
+        while isinstance(target, ast.Subscript):
+            target = target.value
+            if isinstance(target, ast.Name):
+                out.add(target.id)
+                return
+
+    # ---- the one per-function structural scan ----
+    def _scan_functions(self):
+        """ONE same-scope walk per function collecting everything the
+        model needs besides lock sets: `global`-declared and
+        subscript-mutated globals, `self.X = <ctor>` lock/safe-type
+        classifications, thread/executor/signal roots, and the
+        assignment-target map `_creation_joined` consults.  (Lock-set
+        tracking needs statement ORDER, so it stays a separate
+        structured walk in _FuncWalker.)"""
+        mm = self.mm
+        joined_attrs, declared = self._module_wide_facts()
+        mutated = set()
+        for fi in mm.index.functions:
+            cm = mm.owner_class(fi)
+            assign_of = {}      # id(value node) -> first assign target
+            with_items = set()  # id(expr) used as a `with` context item
+            for node in walk_same_scope(fi.node):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        with_items.add(id(item.context_expr))
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        self._global_write(t, mutated)
+                    if len(node.targets) == 1:
+                        assign_of[id(node.value)] = node.targets[0]
+                        t0 = node.targets[0]
+                        if _is_self_attr(t0) and cm is not None:
+                            kind = _ctor_kind(node.value)
+                            if kind in LOCK_TYPES:
+                                cm.locks[t0.attr] = LockInfo(
+                                    f"{cm.qual}.{t0.attr}", kind,
+                                    mm.path, node.lineno)
+                            elif kind is not None:
+                                cm.safe_attrs.add(t0.attr)
+                    continue
+                if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    self._global_write(node.target, mutated)
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in _MUTATORS and \
+                        isinstance(node.func.value, ast.Name):
+                    mutated.add(node.func.value.id)
+                self._maybe_root(fi, cm, node, joined_attrs, assign_of,
+                                 with_items)
+        mm.shared_globals = (mm.shared_globals & mutated) | {
+            n for n in declared if n not in mm.locks
+            and n not in mm.safe_globals}
+
+    def _maybe_root(self, fi, cm, node, joined_attrs, assign_of,
+                    with_items):
+        mm = self.mm
+        dotted = _dotted(node.func)
+        last = dotted.split(".")[-1] if dotted else ""
+        if last in ("Thread", "Process"):
+            kw = {k.arg: k.value for k in node.keywords
+                  if k.arg is not None}
+            self._thread_root(fi, cm, node, kw, joined_attrs,
+                              assign_of, shares_memory=last == "Thread")
+        elif last == "submit" and node.args:
+            tgt = self._resolve_target(node.args[0], fi, cm)
+            if tgt is not None:
+                self._add_root("executor", tgt, fi, node, daemon=True)
+        elif last == "signal" and len(node.args) == 2:
+            tgt = self._resolve_target(node.args[1], fi, cm)
+            if tgt is not None:
+                self._add_root("signal", tgt, fi, node, daemon=True)
+        elif last in ("ThreadPoolExecutor", "ProcessPoolExecutor"):
+            # `with ThreadPoolExecutor(...) as ex:` shuts down on exit
+            shut = id(node) in with_items or self._has_shutdown(cm)
+            sink = cm.executors if cm is not None else mm.executors
+            sink.append((fi.qualname, node.lineno, shut))
+        elif last == "install" and len(node.args) == 1 and \
+                isinstance(node.args[0], ast.Name) and \
+                node.args[0].id == "self" and cm is not None:
+            # a handler object registered process-wide: its public
+            # methods run on whatever thread delivers the event
+            cm.roots.append(ThreadRoot(
+                root_id=f"installed:{cm.qual}",
+                kind="installed", target=None, path=mm.path,
+                line=node.lineno, daemon=True, joined=True))
+
+    def _thread_root(self, fi, cm, node, kw, joined_attrs, assign_of,
+                     shares_memory):
+        mm = self.mm
+        tgt = None
+        if "target" in kw:
+            tgt = self._resolve_target(kw["target"], fi, cm)
+        daemon = False
+        d = kw.get("daemon")
+        if isinstance(d, ast.Constant):
+            daemon = bool(d.value)
+        joined = self._creation_joined(node, joined_attrs, assign_of)
+        kind = "thread" if shares_memory else "process"
+        sink = cm.thread_creations if cm is not None \
+            else mm.thread_creations
+        sink.append((node.lineno, daemon, joined,
+                     tgt.qualname if tgt is not None else None))
+        if tgt is not None:
+            self._add_root(kind, tgt, fi, node, daemon=daemon,
+                           joined=joined, shares_memory=shares_memory)
+
+    def _add_root(self, kind, tgt, fi, node, daemon=False, joined=False,
+                  shares_memory=True):
+        mm = self.mm
+        tgt_cm = mm.owner_class(tgt)
+        root = ThreadRoot(
+            root_id=f"{kind}:{mm.modname}.{tgt.qualname}", kind=kind,
+            target=tgt, path=mm.path, line=node.lineno, daemon=daemon,
+            joined=joined, shares_memory=shares_memory)
+        (tgt_cm.roots if tgt_cm is not None else mm.roots).append(root)
+
+    def _resolve_target(self, expr, fi, cm):
+        if isinstance(expr, ast.Name):
+            return self.mm.index._resolve_name(expr.id, fi)
+        if _is_self_attr(expr) and cm is not None:
+            return self.mm.index.methods.get(
+                id(cm.node), {}).get(expr.attr)
+        return None
+
+    def _module_wide_facts(self):
+        """One full-tree walk for the facts that are module-wide by
+        nature: names a no-arg `.join()` is called on (str/os.path
+        joins take args), and `global X` declarations."""
+        cached = getattr(self, "_facts", None)
+        if cached is not None:
+            return cached
+        joined, declared = set(), set()
+        for node in ast.walk(self.mm.index.tree):
+            if isinstance(node, ast.Global):
+                declared.update(node.names)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "join" and not node.args:
+                v = node.func.value
+                if _is_self_attr(v):
+                    joined.add(f"self.{v.attr}")
+                elif isinstance(v, ast.Name):
+                    joined.add(v.id)
+        self._facts = (joined, declared)
+        return self._facts
+
+    def _creation_joined(self, node, joined_attrs, assign_of):
+        """Was the thread created at `node` ever joined?  True when the
+        creation is assigned to a name/attr that is joined somewhere,
+        or when the enclosing function loops `for t in threads:
+        t.join()` style (any bare-name join)."""
+        parent_assign = assign_of.get(id(node))
+        if parent_assign is not None:
+            if _is_self_attr(parent_assign) and \
+                    f"self.{parent_assign.attr}" in joined_attrs:
+                return True
+            if isinstance(parent_assign, ast.Name) and \
+                    parent_assign.id in joined_attrs:
+                return True
+        # threads collected into a list that is iterated and joined
+        return any(not n.startswith("self.") for n in joined_attrs)
+
+    def _has_shutdown(self, cm):
+        """Does the class (or the module) ever call `.shutdown()`?
+        Cached per scope: one executor-heavy class must not re-walk
+        its body per creation site."""
+        cache = getattr(self, "_shutdown_cache", None)
+        if cache is None:
+            cache = self._shutdown_cache = {}
+        key = id(cm.node) if cm is not None else 0
+        if key in cache:
+            return cache[key]
+        scope = cm.node if cm is not None else self.mm.index.tree
+        found = any(
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "shutdown"
+            for node in ast.walk(scope))
+        cache[key] = found
+        return found
+
+
+# -------------------------------------------------------- package model
+class PackageModel:
+    """All modules + the package-wide lock-order graph and root table."""
+
+    def __init__(self):
+        self.modules = {}           # modname -> ModuleModel
+
+    def add(self, mm):
+        self.modules[mm.modname] = mm
+
+    def finalize(self):
+        """Transitive acquire sets, root-context propagation, and the
+        global edge list.  Call once after every module is added."""
+        for mm in self.modules.values():
+            self._close_acquires(mm)
+            self._propagate_contexts(mm)
+
+    # ---- transitive lock acquisition + interprocedural edges ----
+    def _close_acquires(self, mm):
+        funcs = {fm.fi.qualname: fm for fm in mm.all_funcs()}
+        # fixpoint over same-module calls (bounded: lock set is small)
+        for fm in funcs.values():
+            fm.all_acquires = set(fm.direct_acquires)
+            fm.all_blocking = list(fm.blocking_any)
+        changed = True
+        guard = 0
+        while changed and guard < 20:
+            changed = False
+            guard += 1
+            for fm in funcs.values():
+                for qn, _line in fm.calls:
+                    callee = funcs.get(qn)
+                    if callee is None:
+                        continue
+                    before = len(fm.all_acquires)
+                    fm.all_acquires |= callee.all_acquires
+                    changed |= len(fm.all_acquires) != before
+                    for bc in callee.all_blocking:
+                        if bc not in fm.all_blocking:
+                            fm.all_blocking.append(bc)
+                            changed = True
+        # a call made while holding H reaches every lock the callee
+        # (transitively) acquires (RL102 edges) and every blocking site
+        # inside it (RL103)
+        for fm in funcs.values():
+            for qn, held, line in fm.held_calls:
+                callee = funcs.get(qn)
+                if callee is None:
+                    continue
+                for lid in sorted(callee.all_acquires):
+                    for h in held:
+                        if h != lid:
+                            fm.edges.append(Edge(h, lid, line))
+                for bc in callee.all_blocking:
+                    hit = BlockingCall(
+                        desc=f"{bc.desc} [via {qn.split('.')[-1]}()]",
+                        locks=held, line=bc.line, col=bc.col)
+                    fm.blocking.append(hit)
+
+    # ---- root contexts ----
+    def _propagate_contexts(self, mm):
+        for cm in mm.classes.values():
+            self._class_contexts(mm, cm)
+        # module-level functions: roots vs main
+        root_targets = {r.target.qualname: r for r in mm.roots
+                        if r.target is not None}
+        for fm in mm.funcs.values():
+            qn = fm.fi.qualname
+            if qn in root_targets:
+                fm.contexts.add(root_targets[qn].root_id)
+                fm.is_root_target = True
+            elif "." not in qn:
+                fm.contexts.add(MAIN)
+        self._flow_contexts(mm, mm.funcs)
+
+    def _class_contexts(self, mm, cm):
+        root_targets = {}
+        installed = None
+        for r in cm.roots:
+            if r.target is not None:
+                root_targets.setdefault(r.target.qualname, []).append(r)
+            elif r.kind == "installed":
+                installed = r
+        for fm in cm.funcs.values():
+            qn = fm.fi.qualname
+            name = qn.split(".")[-1]
+            if qn in root_targets:
+                for r in root_targets[qn]:
+                    fm.contexts.add(r.root_id)
+                fm.is_root_target = True
+            # nested closures inherit from their enclosing function in
+            # the flow pass; direct methods default to the main thread
+            elif "." in qn and qn.count(".") == 1:
+                fm.contexts.add(MAIN)
+                if installed is not None and not name.startswith("_") \
+                        and name != "__init__":
+                    fm.contexts.add(installed.root_id)
+        self._flow_contexts(mm, cm.funcs)
+
+    def _flow_contexts(self, mm, funcs):
+        """Callees (and nested closures) run in their callers'
+        contexts."""
+        changed = True
+        guard = 0
+        while changed and guard < 20:
+            changed = False
+            guard += 1
+            for fm in funcs.values():
+                # nested closure: runs in the enclosing fn's contexts —
+                # unless it is itself a thread-root target, in which
+                # case it runs ONLY where its thread does
+                if "." in fm.fi.qualname and not fm.is_root_target:
+                    parent = funcs.get(
+                        fm.fi.qualname.rsplit(".", 1)[0])
+                    if parent is not None:
+                        before = len(fm.contexts)
+                        fm.contexts |= parent.contexts
+                        changed |= len(fm.contexts) != before
+                # fm.calls already resolves self.m() from closures too
+                # (owner class recovered via the qualname prefix)
+                for qn, _line in fm.calls:
+                    cfm = funcs.get(qn)
+                    if cfm is None or cfm.is_root_target:
+                        continue
+                    before = len(cfm.contexts)
+                    cfm.contexts |= fm.contexts
+                    changed |= len(cfm.contexts) != before
+
+    # ---- the global lock-order graph ----
+    def lock_graph(self):
+        """{(held, acquired): [(path, line), ...]} over every module."""
+        graph = {}
+        for mm in self.modules.values():
+            for fm in mm.all_funcs():
+                for e in fm.edges:
+                    graph.setdefault(e.key(), []).append(
+                        (mm.path, e.line))
+        return graph
+
+    def lock_sites(self):
+        """{lock_id: (path, line)} creation sites, package-wide."""
+        out = {}
+        for mm in self.modules.values():
+            for info in mm.locks.values():
+                out[info.lock_id] = (info.path, info.line)
+            for cm in mm.classes.values():
+                for info in cm.locks.values():
+                    out[info.lock_id] = (info.path, info.line)
+        return out
+
+
+def find_cycles(graph_keys):
+    """Cycles in the directed graph given as an iterable of (a, b)
+    edges.  Returns a sorted list of cycles, each a tuple of nodes in
+    a canonical rotation (smallest node first)."""
+    adj = {}
+    for a, b in graph_keys:
+        adj.setdefault(a, set()).add(b)
+    cycles = set()
+
+    def dfs(start, node, path, on_path):
+        for nxt in sorted(adj.get(node, ())):
+            if nxt == start:
+                cyc = tuple(path)
+                k = cyc.index(min(cyc))
+                cycles.add(cyc[k:] + cyc[:k])
+            elif nxt not in on_path and nxt > start:
+                # only explore nodes > start: each cycle is found from
+                # its smallest node exactly once
+                dfs(start, nxt, path + [nxt], on_path | {nxt})
+
+    for start in sorted(adj):
+        dfs(start, start, [start], {start})
+    return sorted(cycles)

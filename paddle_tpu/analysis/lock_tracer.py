@@ -1,0 +1,289 @@
+"""Runtime lock-order sanitizer — the dynamic half of racelint RL102.
+
+The static pass over-approximates within a module; what it cannot see
+is the ACTUAL cross-module acquisition order a live run produces (a
+span recorded inside a checkpoint commit takes the recorder lock while
+the checkpoint lock is held — an edge no single module shows).  The
+tracer closes that gap:
+
+- :class:`LockOrderTracer` monkey-patches ``threading.Lock`` /
+  ``threading.RLock`` for its ``with`` scope.  Only locks allocated
+  from code inside the traced root (default: the paddle_tpu package)
+  are wrapped — stdlib internals (queue, condition-backing locks
+  created by threading.py itself) keep the native primitive, so
+  nothing outside the repo changes behavior.
+- Each wrapped lock is identified by its ALLOCATION SITE (file:line) —
+  the same `self._lock = threading.Lock()` line the static model keys
+  its lock ids on, which is what makes the static/dynamic cross-check
+  possible.
+- Every acquisition while other traced locks are held records a
+  directed edge (held-site -> acquired-site) per thread.  RLock
+  re-entry does not re-edge.
+
+After (or during) a run:
+
+- :meth:`violations` — lock pairs observed in BOTH orders: a real
+  inversion the next unlucky schedule turns into a deadlock.
+- :meth:`check_static` — dynamic edges that OPPOSE a static RL102
+  edge (static says A before B, the run did B before A), plus
+  combined-graph cycles: the run proved an order the static model's
+  acyclicity argument relied on excluding.
+
+The chaos suite runs with a tracer active (tests/conftest.py arms it
+for every ``chaos``-marked test) and asserts zero violations — the
+fault-injection suite doubles as a concurrency stress run.
+
+Coverage boundary: only locks ALLOCATED while some tracer has the
+factories patched are proxied.  Module-import-time singletons
+(``SpanRecorder._lock``, ``MetricsRegistry._lock``, locks inside
+``threading.Condition``/``Event``/``queue.Queue``) stay native and
+invisible to the dynamic graph — their ordering discipline is covered
+by the static RL102 model and the "observability is innermost" rule
+in docs/internals.md, not by this tracer.  Per-run objects (engines,
+checkpointers, injectors, per-instrument metrics created during the
+run) are the traced population.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+__all__ = ["LockOrderTracer", "active_tracer"]
+
+_active = None
+
+
+def active_tracer():
+    return _active
+
+
+class _TracedLock:
+    """Proxy over a real Lock/RLock: forwards everything, reports
+    acquisition/release to whichever tracer is CURRENTLY active (not
+    the one live at allocation) — proxies outlive a tracer's `with`
+    scope, and a lock allocated during one traced run must still feed
+    the next run's graph instead of a deactivated tracer's.
+
+    Reentrancy (RLock) is handled by per-thread depth counting — only
+    the 0->1 acquisition edges into the order graph."""
+
+    __slots__ = ("_lock", "site", "_depth")
+
+    def __init__(self, lock, site):
+        self._lock = lock
+        self.site = site
+        self._depth = {}            # thread id -> reentry depth
+
+    def acquire(self, blocking=True, timeout=-1):
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            tid = threading.get_ident()
+            d = self._depth.get(tid, 0)
+            self._depth[tid] = d + 1
+            if d == 0:
+                tracer = _active
+                if tracer is not None:
+                    tracer._note_acquire(self, tid)
+        return got
+
+    def release(self):
+        self._lock.release()
+        tid = threading.get_ident()
+        if tid not in self._depth:
+            # cross-thread handoff (legal for a plain Lock): the
+            # acquiring thread's bookkeeping must be undone, not the
+            # releasing thread's — otherwise the owner's held stack
+            # keeps a phantom entry that fabricates edges forever
+            self._depth.clear()
+            tracer = _active
+            if tracer is not None:
+                tracer._note_release(self, tid=None)
+            return
+        d = self._depth[tid] - 1
+        if d <= 0:
+            self._depth.pop(tid, None)
+            tracer = _active
+            if tracer is not None:
+                tracer._note_release(self, tid)
+        else:
+            self._depth[tid] = d
+
+    def locked(self):
+        return self._lock.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    # Condition(lock=...) support
+    def _is_owned(self):
+        owned = getattr(self._lock, "_is_owned", None)
+        if owned is not None:
+            return owned()
+        if self._lock.acquire(False):
+            self._lock.release()
+            return False
+        return True
+
+    def __repr__(self):
+        return f"<TracedLock {self.site[0]}:{self.site[1]}>"
+
+
+class LockOrderTracer:
+    """Context manager recording the actual lock-acquisition graph.
+
+    `roots`: absolute directory prefixes; only locks ALLOCATED from a
+    file under one of them are traced (default: the paddle_tpu package
+    directory).  `base`: repo root used to relativize sites so dynamic
+    ids match the static model's repo-relative paths.
+    """
+
+    def __init__(self, roots=None, base=None):
+        here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        # separator-terminated prefixes: /x/pkg must not match a
+        # sibling /x/pkg_ext tree
+        self.roots = tuple(
+            os.path.abspath(r).rstrip(os.sep) + os.sep
+            for r in (roots or (here,)))
+        self.base = os.path.abspath(base or os.path.dirname(here))
+        self._meta = threading.Lock()   # guards edges/locks/stack tables
+        self._held = {}                 # thread id -> [locks], by _meta
+        self.edges = {}                 # (site_a, site_b) -> count
+        self.sites = {}                 # site -> kind
+        self._orig = None
+
+    # ---- patching ----
+    def __enter__(self):
+        global _active
+        if _active is not None:
+            raise RuntimeError("a LockOrderTracer is already active "
+                               "(nesting tracers is not supported)")
+        self._orig = (threading.Lock, threading.RLock)
+        orig_lock, orig_rlock = self._orig
+
+        def traced_factory(orig, kind):
+            tracer = self
+
+            def factory():
+                site = tracer._alloc_site()
+                lock = orig()
+                if site is None:
+                    return lock
+                with tracer._meta:
+                    tracer.sites[site] = kind
+                return _TracedLock(lock, site)
+            return factory
+
+        threading.Lock = traced_factory(orig_lock, "Lock")
+        threading.RLock = traced_factory(orig_rlock, "RLock")
+        _active = self
+        return self
+
+    def __exit__(self, *exc):
+        global _active
+        threading.Lock, threading.RLock = self._orig
+        _active = None
+        return False
+
+    def _alloc_site(self):
+        """(repo-relative path, line) of the allocation, when it is
+        inside a traced root; else None (lock stays native)."""
+        f = sys._getframe(2)
+        fname = f.f_code.co_filename
+        if not fname.startswith(self.roots):
+            return None
+        rel = os.path.relpath(fname, self.base).replace(os.sep, "/")
+        return (rel, f.f_lineno)
+
+    # ---- acquisition bookkeeping ----
+    def _note_acquire(self, lock, tid):
+        with self._meta:
+            st = self._held.setdefault(tid, [])
+            for held in st:
+                if held.site != lock.site:
+                    key = (held.site, lock.site)
+                    self.edges[key] = self.edges.get(key, 0) + 1
+            st.append(lock)
+
+    def _note_release(self, lock, tid):
+        """Drop `lock` from the holder's stack.  `tid=None` means a
+        cross-thread handoff release: whichever thread holds it loses
+        it.  Releases can also be out of LIFO order (hand-over-hand),
+        so removal is by identity, not by popping."""
+        with self._meta:
+            stacks = [self._held.get(tid, [])] if tid is not None \
+                else list(self._held.values())
+            for st in stacks:
+                for i in range(len(st) - 1, -1, -1):
+                    if st[i] is lock:
+                        del st[i]
+                        return
+
+    # ---- verdicts ----
+    def _violations_locked(self):
+        # caller holds self._meta (non-reentrant: snapshot() must not
+        # call the public wrapper while holding it)
+        return sorted((a, b) for a, b in self.edges
+                      if (b, a) in self.edges and a < b)
+
+    def violations(self):
+        """Lock-site pairs observed in BOTH orders during the run —
+        sorted [(site_a, site_b)] with site_a < site_b."""
+        with self._meta:
+            return self._violations_locked()
+
+    def check_static(self, static_edges, lock_sites):
+        """Cross-check the run against the static RL102 model.
+
+        - `static_edges`: {(held_id, acquired_id): sites} from
+          :func:`race_rules.static_lock_order`.
+        - `lock_sites`: {lock_id: (path, line)} mapping static ids to
+          allocation sites.
+
+        Returns {"conflicts": [...], "combined_cycles": [...]} —
+        `conflicts` are dynamic edges whose REVERSE the static model
+        requires; `combined_cycles` are cycles that appear only when
+        the observed edges are merged into the static graph.  Both
+        empty == the run agrees with the model.
+        """
+        from paddle_tpu.analysis.lock_model import find_cycles
+        site_to_id = {site: lid for lid, site in lock_sites.items()}
+        static_by_site = set()
+        for (a, b) in static_edges:
+            sa, sb = lock_sites.get(a), lock_sites.get(b)
+            if sa is not None and sb is not None:
+                static_by_site.add((sa, sb))
+        with self._meta:
+            dynamic = set(self.edges)
+        conflicts = sorted(
+            (a, b) for (a, b) in dynamic
+            if (b, a) in static_by_site and (a, b) not in static_by_site)
+        static_cycles = set(find_cycles(static_by_site))
+        combined_cycles = [
+            c for c in find_cycles(static_by_site | dynamic)
+            if c not in static_cycles]
+
+        def _name(site):
+            return site_to_id.get(site, f"{site[0]}:{site[1]}")
+
+        return {
+            "conflicts": [(_name(a), _name(b)) for a, b in conflicts],
+            "combined_cycles": [tuple(_name(s) for s in c)
+                                for c in combined_cycles],
+        }
+
+    def snapshot(self):
+        """Plain-dict view (counts only) for reports/tests."""
+        with self._meta:
+            return {
+                "locks_traced": len(self.sites),
+                "edges": {f"{a[0]}:{a[1]} -> {b[0]}:{b[1]}": n
+                          for (a, b), n in sorted(self.edges.items())},
+                "violations": [f"{a[0]}:{a[1]} <-> {b[0]}:{b[1]}"
+                               for a, b in self._violations_locked()],
+            }

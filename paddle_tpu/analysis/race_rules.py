@@ -1,0 +1,338 @@
+"""racelint rules: the RLxxx family over :mod:`lock_model`.
+
+Whole-package pass (cross-module lock-order graph, per-class shared
+state).  Findings resolve to real file:line sites and honor the same
+`# tracelint: disable=RLxxx` / `# racelint: disable=...` suppression
+comments the other analyzers use.  Like tracelint, the pass
+over-approximates on purpose: a finding is a *hazard*, and the
+checked-in baseline absorbs the reviewed backlog so `--check` fails
+only on regressions.
+
+Rule summary (catalogue text lives in :mod:`rules`):
+
+- **RL101** attribute shared across ≥2 thread roots with inconsistent
+  (or empty) lock sets.
+- **RL102** lock-order inversion cycles in the package-wide
+  acquired-while-holding graph.
+- **RL103** blocking calls (join, un-timed ``queue.get``, sleep,
+  file/subprocess IO) while holding a lock.
+- **RL104** signal handlers that do more than set a flag.
+- **RL105** thread/executor lifecycle leaks.
+- **RL201** check-then-act TOCTOU on a shared container outside its
+  guarding lock.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from paddle_tpu.analysis import lock_model
+from paddle_tpu.analysis.lock_model import PackageModel
+from paddle_tpu.analysis.rules import message_for
+from paddle_tpu.analysis.visitor import (Finding, _dotted, iter_py_files,
+                                         parse_suppressions, rel_path)
+
+# attribute-name suffixes whose unlocked sharing is overwhelmingly
+# benign telemetry (monotonic counters read for reporting only) —
+# demoting them keeps RL101 focused; a counter that must be exact
+# should be an observability Counter (which locks) anyway
+_COUNTERISH = ("_count", "_total", "_seq", "_steps", "count")
+
+
+def modname_for(path, base=None):
+    rel = rel_path(path, base)
+    mod = rel[:-3] if rel.endswith(".py") else rel
+    mod = mod.replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+def build_package_model(paths, base=None):
+    """Parse every .py under `paths` into one PackageModel.  Returns
+    (model, {path: (suppressions, skip_file)}, [parse-error Finding])."""
+    pm = PackageModel()
+    sups = {}
+    errors = []
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8", errors="replace") as fh:
+                source = fh.read()
+        except OSError:
+            continue
+        rel = rel_path(path, base)
+        try:
+            tree = ast.parse(source)
+        except SyntaxError as e:
+            errors.append(Finding(
+                path=rel, line=e.lineno or 1, col=e.offset or 0,
+                code="RL000", message=f"syntax error: {e.msg}"))
+            continue
+        sup, skip = parse_suppressions(source)
+        sups[rel] = (sup, skip, source.splitlines())
+        mm = lock_model.ModuleBuilder(
+            path=rel, modname=modname_for(path, base), source=source,
+            tree=tree).build()
+        pm.add(mm)
+    pm.finalize()
+    return pm, sups, errors
+
+
+def _finding(path, line, col, code, detail):
+    return Finding(path=path, line=line, col=col, code=code,
+                   message=message_for(code, detail=detail))
+
+
+def _short(attr_or_lock):
+    """Trailing two segments — enough to identify `Class.attr` in a
+    message without the full module path."""
+    return ".".join(attr_or_lock.split(".")[-2:])
+
+
+# ------------------------------------------------------------- RL101
+def _check_shared_state(pm):
+    findings = []
+    for mm in pm.modules.values():
+        # class attributes group per class, but MODULE GLOBALS must
+        # aggregate across every function in the module — a global
+        # written by a class method and read by a module function is
+        # still one shared object (scope-splitting it would make that
+        # race undetectable)
+        by_attr = {}
+        for fm in mm.all_funcs():
+            ctxs = {c for c in fm.contexts
+                    if not c.startswith("process:")}
+            if not ctxs:
+                continue
+            for acc in fm.accesses:
+                by_attr.setdefault(acc.attr, []).append((acc, ctxs))
+        for attr, accs in sorted(by_attr.items()):
+            f = _rl101_for_attr(mm, attr, accs)
+            if f is not None:
+                findings.append(f)
+    return findings
+
+
+def _rl101_for_attr(mm, attr, accs):
+    live = [(a, ctxs) for a, ctxs in accs if not a.in_init]
+    if not live:
+        return None
+    contexts = set().union(*(ctxs for _a, ctxs in live))
+    if len(contexts) < 2:
+        return None
+    writes = [a for a, _ in live if a.kind == "write"]
+    if not writes:
+        return None    # init-published, read-only after: happens-before
+    # single-writer-context attributes written only by one root and
+    # merely read elsewhere still race (torn/stale reads), but the
+    # high-signal case is multi-context writes or write+read overlap
+    lock_sets = [a.locks for a, _ in live]
+    common = frozenset.intersection(*lock_sets)
+    if common:
+        return None    # one lock consistently guards every access
+    name = attr.split(".")[-1]
+    if name.endswith(_COUNTERISH):
+        return None
+    w = min(writes, key=lambda a: a.line)
+    guarded = sum(1 for s in lock_sets if s)
+    detail = (f"`{_short(attr)}` ({len(live)} access sites, "
+              f"{guarded} locked, across "
+              f"{len(contexts)} thread roots)")
+    return _finding(mm.path, w.line, w.col, "RL101", detail)
+
+
+# ------------------------------------------------------------- RL102
+def _check_lock_order(pm):
+    findings = []
+    graph = pm.lock_graph()
+    cycles = lock_model.find_cycles(graph.keys())
+    for cyc in cycles:
+        # report at the first edge's first site, naming the whole cycle
+        edges = list(zip(cyc, cyc[1:] + cyc[:1]))
+        path, line = sorted(graph[edges[0]])[0]
+        order = " -> ".join(_short(n) for n in cyc + (cyc[0],))
+        sites = "; ".join(
+            f"{_short(a)}->{_short(b)} at "
+            f"{sorted(graph[(a, b)])[0][0]}:{sorted(graph[(a, b)])[0][1]}"
+            for a, b in edges)
+        findings.append(_finding(
+            path, line, 0, "RL102", f"{order} ({sites})"))
+    return findings
+
+
+# ------------------------------------------------------------- RL103
+def _check_blocking(pm):
+    findings = []
+    for mm in pm.modules.values():
+        seen = set()        # one finding per blocking SITE
+        for fm in mm.all_funcs():
+            for b in fm.blocking:
+                if (b.line, b.col) in seen:
+                    continue
+                seen.add((b.line, b.col))
+                locks = ", ".join(_short(x) for x in sorted(b.locks))
+                findings.append(_finding(
+                    mm.path, b.line, b.col, "RL103",
+                    f"{b.desc} (holding {locks})"))
+    return findings
+
+
+# ------------------------------------------------------------- RL104
+_IO_NAMES = {"print", "open", "write", "flush", "dump", "dumps"}
+
+
+def _check_signal_handlers(pm):
+    findings = []
+    for mm in pm.modules.values():
+        handlers = []
+        for cm in mm.classes.values():
+            for fm in cm.funcs.values():
+                if any(c.startswith("signal:") for c in fm.contexts):
+                    handlers.append((mm, fm))
+        for fm in mm.funcs.values():
+            if any(c.startswith("signal:") for c in fm.contexts):
+                handlers.append((mm, fm))
+        for mm2, fm in handlers:
+            findings.extend(_rl104_for_handler(mm2, fm))
+    return findings
+
+
+def _rl104_for_handler(mm, fm):
+    out = []
+    qn = fm.fi.qualname
+    # lock acquisition anywhere in the handler's (transitive) reach
+    for lid, line in fm.acquire_sites:
+        out.append(_finding(
+            mm.path, line, 0, "RL104",
+            f"`{qn}` acquires {_short(lid)}"))
+    if fm.all_acquires - fm.direct_acquires:
+        locks = ", ".join(sorted(_short(x) for x in
+                                 fm.all_acquires - fm.direct_acquires))
+        out.append(_finding(
+            mm.path, fm.fi.node.lineno, fm.fi.node.col_offset, "RL104",
+            f"`{qn}` reaches lock acquisition ({locks}) via calls"))
+    # IO / allocation in the handler body itself
+    for node in ast.walk(fm.fi.node):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func)
+        last = dotted.split(".")[-1] if dotted else ""
+        if last in _IO_NAMES:
+            out.append(_finding(
+                mm.path, node.lineno, node.col_offset, "RL104",
+                f"`{qn}` performs IO ({last})"))
+    return out
+
+
+# ------------------------------------------------------------- RL105
+def _check_lifecycle(pm):
+    findings = []
+    for mm in pm.modules.values():
+        creation_scopes = [(cm, cm.thread_creations, cm.executors)
+                           for cm in mm.classes.values()]
+        creation_scopes.append((None, mm.thread_creations, mm.executors))
+        for _cm, threads, executors in creation_scopes:
+            for line, daemon, joined, target in threads:
+                if daemon or joined:
+                    continue
+                tgt = f" (target {target.split('.')[-1]})" if target \
+                    else ""
+                findings.append(_finding(
+                    mm.path, line, 0, "RL105",
+                    f"non-daemon thread{tgt} is never joined — blocks "
+                    f"interpreter exit"))
+            for qn, line, has_shutdown in executors:
+                if has_shutdown:
+                    continue
+                findings.append(_finding(
+                    mm.path, line, 0, "RL105",
+                    f"executor created in `{qn}` is never shut down"))
+    return findings
+
+
+# ------------------------------------------------------------- RL201
+def _check_toctou(pm):
+    findings = []
+    for mm in pm.modules.values():
+        scopes = [(cm.toctou, cm.funcs) for cm in mm.classes.values()]
+        scopes.append((mm.toctou, mm.funcs))
+        for toctous, funcs in scopes:
+            # which locks guard each attr elsewhere in the scope?
+            guards = {}
+            for fm in funcs.values():
+                for acc in fm.accesses:
+                    if acc.locks:
+                        guards.setdefault(acc.attr, set()).update(
+                            acc.locks)
+            for t in toctous:
+                attr_guards = guards.get(t.attr, set())
+                if not attr_guards:
+                    continue    # no lock discipline at all -> RL101's job
+                if t.locks & attr_guards:
+                    continue    # the guarding lock IS held here
+                locks = ", ".join(sorted(_short(x)
+                                         for x in attr_guards))
+                findings.append(_finding(
+                    mm.path, t.line, t.col, "RL201",
+                    f"`{_short(t.attr)}` (guarded by {locks} "
+                    f"elsewhere)"))
+    return findings
+
+
+# -------------------------------------------------------------- driver
+ALL_CHECKS = (_check_shared_state, _check_lock_order, _check_blocking,
+              _check_signal_handlers, _check_lifecycle, _check_toctou)
+
+
+def lint_package(paths, base=None):
+    """The racelint entry: AST-model every file under `paths`, run the
+    RL rules package-wide, apply suppressions.  Returns [Finding]."""
+    pm, sups, findings = build_package_model(paths, base=base)
+    for check in ALL_CHECKS:
+        findings.extend(check(pm))
+    out = []
+    for f in findings:
+        entry = sups.get(f.path)
+        if entry is not None:
+            sup, skip, lines = entry
+            if skip:
+                continue
+            codes = sup.get(f.line, ())
+            if "ALL" in codes or "ALL:RL" in codes or f.code in codes:
+                continue
+            if 1 <= f.line <= len(lines):
+                f.source_line = lines[f.line - 1].strip()
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return out
+
+
+def static_lock_order(paths, base=None):
+    """(edges, lock_sites) for the lock-order sanitizer's cross-check:
+    edges is {(held_id, acquired_id): [(path, line), ...]},
+    lock_sites {lock_id: (path, line)} — creation sites are how the
+    runtime tracer maps live locks back to static identities."""
+    pm, _sups, _errs = build_package_model(paths, base=base)
+    return pm.lock_graph(), pm.lock_sites()
+
+
+def bench_report(paths=None, base=None):
+    """The bench.py lane: finding count + per-rule breakdown, so every
+    BENCH report records the concurrency-audit picture alongside the
+    shardlint cost numbers."""
+    import time
+    t0 = time.time()
+    if paths is None:
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        paths = [os.path.join(repo, "paddle_tpu")]
+        base = repo
+    findings = lint_package(paths, base=base)
+    breakdown = {}
+    for f in findings:
+        breakdown[f.code] = breakdown.get(f.code, 0) + 1
+    return {
+        "racelint_finding_count": len(findings),
+        "racelint_rule_breakdown": dict(sorted(breakdown.items())),
+        "racelint_elapsed_s": round(time.time() - t0, 2),
+    }

@@ -242,6 +242,62 @@ RULES = {r.code: r for r in [
        "step",
        "store the parameter in bf16 (keep an f32 master copy only where "
        "the optimizer needs it)"),
+    # ---- RL1xx: host-runtime concurrency (racelint, race_rules.py) ----
+    _R("RL101", "unguarded-shared-attribute",
+       "{detail} is accessed from multiple thread roots with no "
+       "consistent lock",
+       "attributes reached from two thread roots with empty (or "
+       "disjoint) lock sets are classic data races: lost updates, torn "
+       "reads, and ordering bugs that only fire under load — exactly "
+       "the class of bug the GIL hides until a preemption lands between "
+       "a read and its write-back",
+       "guard every access with ONE lock (document it next to the "
+       "attribute), make the attribute a thread-safe type "
+       "(Queue/Event), or confine it to a single thread"),
+    _R("RL102", "lock-order-inversion",
+       "lock-order cycle: {detail}",
+       "two threads taking the same locks in opposite orders deadlock "
+       "the moment their windows overlap; the acquired-while-holding "
+       "graph must stay acyclic for the whole package, not per module",
+       "pick one global order (docs/internals.md 'Threading model & "
+       "lock hierarchy') and re-nest the offending acquisition — or "
+       "drop to a single lock"),
+    _R("RL103", "blocking-call-under-lock",
+       "blocking {detail} while holding a lock",
+       "a lock held across join/IO/un-timed queue waits turns every "
+       "other acquirer into a convoy behind the slow operation — and "
+       "into a deadlock if the blocking operation itself needs the "
+       "lock (a callback, a signal handler, a joined thread)",
+       "move the blocking call outside the critical section: snapshot "
+       "state under the lock, release, then block"),
+    _R("RL104", "unsafe-signal-handler",
+       "signal handler does more than set a flag: {detail}",
+       "Python signal handlers run between bytecodes of WHATEVER the "
+       "main thread was doing: acquiring a lock the interrupted code "
+       "holds (buffered IO locks included — print!) deadlocks, and "
+       "allocation/IO there is reentrancy-unsafe by construction",
+       "set a flag (threading.Event) in the handler and do the real "
+       "work at a polled step boundary — the drain pattern "
+       "resilience.preemption documents"),
+    _R("RL105", "thread-lifecycle-leak",
+       "{detail}",
+       "a non-daemon thread nobody joins blocks interpreter exit; an "
+       "executor nobody shuts down leaks its workers; a loop with no "
+       "stop path cannot be drained on preemption — all three turn "
+       "clean shutdowns into hangs",
+       "join (or make daemon) every thread, `shutdown()` every "
+       "executor, and give every loop a stop Event the owner sets"),
+
+    # ---- RL2xx: atomicity ----
+    _R("RL201", "check-then-act-toctou",
+       "check-then-act on {detail} outside its guarding lock",
+       "`if key in shared: shared[key]...` is two operations; another "
+       "thread can invalidate the check before the act (the serving "
+       "metrics `_release_labels` bug this repo already shipped once) — "
+       "the attribute has a lock, but this site doesn't hold it",
+       "take the attribute's lock around the WHOLE check+act sequence, "
+       "or use an atomic primitive (dict.setdefault, dict.pop(k, "
+       "None))"),
 ]}
 
 
@@ -253,8 +309,11 @@ def message_for(code, detail=""):
 # Codes whose AST rules only make sense on functions REACHED from a
 # @to_static entry (everything AST-side, today — kept explicit for the
 # CLI docs).  SLxxx codes are all post-trace (jaxpr-level): the
-# shardlint passes in shard_rules.py / cost_audit.py.
+# shardlint passes in shard_rules.py / cost_audit.py.  RLxxx codes are
+# the host-runtime concurrency family (racelint, race_rules.py).
 AST_CODES = tuple(c for c in RULES if c.startswith("TL") and c < "TL400")
 JAXPR_CODES = tuple(c for c in RULES
-                    if c.startswith("SL") or c >= "TL400")
+                    if c.startswith("SL") or (c.startswith("TL")
+                                              and c >= "TL400"))
 SHARDLINT_CODES = tuple(c for c in RULES if c.startswith("SL"))
+RACELINT_CODES = tuple(c for c in RULES if c.startswith("RL"))

@@ -39,34 +39,40 @@ class Finding:
                 "source_line": self.source_line}
 
 
-# one suppression syntax for BOTH analyzers: `# tracelint: disable=...`
-# silences TLxxx and SLxxx codes alike (shardlint findings resolve back
-# to a source line via the eqn's jax source_info).  `# shardlint:` is an
-# accepted alias but scoped to the SL family only — its `ALL` becomes
-# the marker 'ALL:SL' and non-SL codes are dropped, so a shardlint-
-# spelled comment can never waive a trace-safety (TL) finding.
-# skip-file stays tracelint-spelled only, for the same reason.
+# one suppression syntax for EVERY analyzer: `# tracelint: disable=...`
+# silences TLxxx, SLxxx and RLxxx codes alike (shardlint findings
+# resolve back to a source line via the eqn's jax source_info; racelint
+# findings are AST sites already).  `# shardlint:` / `# racelint:` are
+# accepted aliases but scoped to their own family only — their `ALL`
+# becomes the marker 'ALL:SL' / 'ALL:RL' and foreign codes are dropped,
+# so a shardlint-spelled comment can never waive a trace-safety (TL)
+# finding and vice versa.  skip-file stays tracelint-spelled only, for
+# the same reason.
 _DISABLE_RE = re.compile(
-    r"#\s*(tracelint|shardlint):\s*disable=([A-Za-z0-9,\s]+)")
+    r"#\s*(tracelint|shardlint|racelint):\s*disable=([A-Za-z0-9,\s]+)")
 _SKIP_FILE_RE = re.compile(r"^\s*#\s*tracelint:\s*skip-file\s*$")
+
+_FAMILY = {"shardlint": "SL", "racelint": "RL"}
 
 
 def parse_suppressions(source):
     """lineno -> set of suppressed codes ('ALL' suppresses everything;
-    'ALL:SL' suppresses every SL code). Returns (mapping, skip_file)."""
+    'ALL:SL'/'ALL:RL' suppresses one family). Returns (mapping,
+    skip_file)."""
     sup = {}
     skip = False
     for i, raw in enumerate(source.splitlines(), start=1):
         if _SKIP_FILE_RE.match(raw):
             skip = True
-        # finditer: a line may carry BOTH spellings, and each merges
+        # finditer: a line may carry several spellings, and each merges
         for m in _DISABLE_RE.finditer(raw):
             codes = {c.strip().upper() for c in m.group(2).split(",")
                      if c.strip()}
-            if m.group(1) == "shardlint":
-                codes = {"ALL:SL" if c == "ALL" else c
+            fam = _FAMILY.get(m.group(1))
+            if fam is not None:
+                codes = {f"ALL:{fam}" if c == "ALL" else c
                          for c in codes if c == "ALL"
-                         or c.startswith("SL")}
+                         or c.startswith(fam)}
             sup[i] = sup.get(i, set()) | codes
     return sup, skip
 

@@ -96,19 +96,30 @@ class SparseTable:
         return self._pull_impl(ids)
 
     def _pull_impl(self, ids):
+        # the row gather shares self._lock with push(): a prefetch
+        # thread reading while the training thread applies an optimizer
+        # step must see either the pre- or post-step rows, never a torn
+        # mix (pull_count rides along so concurrent pulls don't lose
+        # increments)
         if self._use_native():
             from paddle_tpu import native
-            self.pull_count += 1
-            return native.pstable_pull(self._data, ids, self.row_offset)
+            with self._lock:
+                self.pull_count += 1
+                return native.pstable_pull(self._data, ids,
+                                           self.row_offset)
         loc, ok = self._local(ids)
-        rows = self._data[np.clip(loc, 0, self.local_rows - 1)]
+        with self._lock:
+            self.pull_count += 1
+            rows = self._data[np.clip(loc, 0, self.local_rows - 1)]
         rows[~ok] = 0
-        self.pull_count += 1
         return rows.reshape(ids.shape + (self.dim,))
 
     def _use_native(self):
-        """Native C++ kernels (GIL-free, multithreaded pull) when the
-        toolchain is up AND the table layout matches (fp32 contiguous)."""
+        """Native C++ kernels when the toolchain is up AND the table
+        layout matches (fp32 contiguous).  One gather is internally
+        multithreaded in C++; distinct pull/push CALLS serialize on
+        the table lock (pull-vs-push atomicity: a reader must never
+        see a half-applied optimizer step)."""
         if self._native is None:
             from paddle_tpu import native
             self._native = bool(

@@ -158,15 +158,25 @@ class FaultInjector:
     def poll(self, site, **ctx):
         """Advance the site's occurrence counter; return the matching
         FaultSpec (recorded) or None."""
+        hit = None
+        # counter advance, spec match AND log append in ONE critical
+        # section: hook sites fire from any thread (serving decode,
+        # checkpoint writer), and the injection log is read for
+        # post-hoc ordering assertions — entries must land in
+        # occurrence order
         with self._lock:
             occ = self._counts.get(site, 0)
             self._counts[site] = occ + 1
-        for spec in self.plan.faults_for(site):
-            if spec.matches(occ):
-                self.injected.append((site, spec, occ))
-                _record_injection(self.plan, site, spec, occ, ctx)
-                return spec
-        return None
+            for spec in self.plan.faults_for(site):
+                if spec.matches(occ):
+                    self.injected.append((site, spec, occ))
+                    hit = spec
+                    break
+        if hit is not None:
+            # telemetry outside the lock: spans/counters take their
+            # own locks and must stay innermost
+            _record_injection(self.plan, site, hit, occ, ctx)
+        return hit
 
     def occurrences(self, site):
         with self._lock:

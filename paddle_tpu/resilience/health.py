@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import enum
 import threading
+from collections import deque
 
 __all__ = ["HealthState", "HealthMonitor"]
 
@@ -59,6 +60,8 @@ class HealthMonitor:
         self._state = HealthState.HEALTHY
         self.transitions = []          # [(from, to, pressure)]
         self.last_pressure = 0.0
+        self._pending = deque()        # transitions awaiting emission
+        self._emitting = False         # one drainer at a time
         if self._gauge is not None:
             self._gauge.set(int(self._state))
 
@@ -72,7 +75,19 @@ class HealthMonitor:
         return self._state != HealthState.DRAINING
 
     def update(self, pressure):
-        """Feed the current pressure; returns the (possibly new) state."""
+        """Feed the current pressure; returns the (possibly new) state.
+
+        The transition decision and the `transitions` append happen
+        under the lock; gauge/span recording and the `on_transition`
+        callback run only AFTER it is released.  The callback is
+        arbitrary user code: under the non-reentrant lock, a callback
+        that feeds pressure back through ``update()`` (a drain hook
+        reacting to DRAINING) deadlocks the monitor, and a slow one
+        convoys every other updater.  Emission goes through a FIFO
+        queue drained by one thread at a time, so gauge values and
+        callback invocations arrive in TRANSITION order even when two
+        updates race — the gauge can never be left stale showing a
+        state older than the monitor's."""
         pressure = float(pressure)
         with self._lock:
             old = self._state
@@ -81,8 +96,29 @@ class HealthMonitor:
             if new is not old:
                 self._state = new
                 self.transitions.append((old, new, pressure))
-                self._record(old, new, pressure)
-            return new
+                self._pending.append((old, new, pressure))
+        if new is not old:
+            self._drain_events()
+        return new
+
+    def _drain_events(self):
+        """Emit queued transitions in order.  Exactly one thread
+        drains at a time; a thread arriving while another is emitting
+        (including a reentrant update() from inside on_transition)
+        leaves its event queued — the active drainer's loop picks it
+        up, preserving FIFO delivery without holding any lock across
+        user code."""
+        while True:
+            with self._lock:
+                if self._emitting or not self._pending:
+                    return
+                self._emitting = True
+                evt = self._pending.popleft()
+            try:
+                self._record(*evt)
+            finally:
+                with self._lock:
+                    self._emitting = False
 
     def _next_state(self, state, p):
         if state == HealthState.HEALTHY:

@@ -96,6 +96,8 @@ class PreemptionHandler:
         self.reason = None
         self.drained = False
         self.drain_step = None
+        self._notice_pending = False
+        self._notice_lock = threading.Lock()
         self._prev = {}
         self._signals = tuple(signals) if signals is not None \
             else (_signal.SIGTERM,)
@@ -119,15 +121,38 @@ class PreemptionHandler:
         self._prev.clear()
 
     def _on_signal(self, signum, frame):
-        self.request(f"signal:{_signal.Signals(signum).name}")
+        # Signal context does NOTHING but set the flag.  A Python
+        # signal handler runs between bytecodes of whatever the main
+        # thread was doing — buffered-stderr writes there can deadlock
+        # on the io lock the interrupted code may hold, so the operator
+        # notice is deferred to the next check() poll.
+        if not self._flag.is_set():
+            self.reason = f"signal:{_signal.Signals(signum).name}"
+            self._notice_pending = True
+            self._flag.set()
 
     def request(self, reason="external"):
         if not self._flag.is_set():
+            # same publish order as _on_signal: reason and the pending
+            # notice must be visible BEFORE the flag — a concurrently
+            # polling check() may drain (and exit) the moment the flag
+            # is up, and must find the notice to flush
             self.reason = reason
+            self._notice_pending = True
             self._flag.set()
+            self._flush_notice()
+
+    def _flush_notice(self):
+        """Emit the queued operator notice exactly once (called from
+        ordinary thread context only, never from the signal handler —
+        which is why the handler sets the flag lock-free while this
+        side test-and-clears under a lock)."""
+        with self._notice_lock:
+            pending, self._notice_pending = self._notice_pending, False
+        if pending:
             print(f"[paddle_tpu.resilience] preemption requested "
-                  f"({reason}); will drain at the next step boundary",
-                  file=sys.stderr, flush=True)
+                  f"({self.reason}); will drain at the next step "
+                  f"boundary", file=sys.stderr, flush=True)
 
     @property
     def preempted(self):
@@ -141,6 +166,7 @@ class PreemptionHandler:
         exits, and returns True — the loop should break."""
         if not self._flag.is_set():
             return False
+        self._flush_notice()    # notice deferred from signal context
         self.drain(step, state_fn)
         if self.exit_code is not None:
             os._exit(self.exit_code)
@@ -175,6 +201,7 @@ class PreemptionHandler:
         self.reason = None
         self.drained = False
         self.drain_step = None
+        self._notice_pending = False
 
     def __enter__(self):
         return self

@@ -1,10 +1,11 @@
 """The unified gate: tools/lint_all.py chains tracelint --check,
-shardlint --check, api_coverage --baseline and the chaos suite
-(pytest -m chaos) into ONE exit code.  This `lint`-marked test is how
-tier-1 enforces the three static baselines; the chaos gate is skipped
-here because tier-1 runs the chaos tests directly (they live in
+shardlint --check, racelint --check, api_coverage --baseline and the
+chaos suite (pytest -m chaos, run under the racelint lock-order
+tracer) into ONE exit code.  This `lint`-marked test is how tier-1
+enforces the four static baselines; the chaos gate is skipped here
+because tier-1 runs the chaos tests directly (they live in
 tests/test_resilience.py under the `chaos` marker) — standalone
-`python tools/lint_all.py` runs all four.
+`python tools/lint_all.py` runs all five.
 """
 import os
 import subprocess
@@ -31,6 +32,7 @@ def test_lint_all_gate_clean():
     out = proc.stdout
     assert "tracelint: ok" in out
     assert "shardlint: ok" in out
+    assert "racelint: ok" in out
     assert "coverage: ok" in out
     assert "chaos: SKIPPED" in out
     assert "all gates clean" in out
@@ -39,7 +41,7 @@ def test_lint_all_gate_clean():
 def test_lint_all_skip_flag():
     proc = subprocess.run(
         [sys.executable, LINT_ALL, "--skip", "tracelint", "shardlint",
-         "coverage", "chaos"],
+         "racelint", "coverage", "chaos"],
         cwd=REPO, capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0
-    assert proc.stdout.count("SKIPPED") == 4
+    assert proc.stdout.count("SKIPPED") == 5

@@ -1,0 +1,780 @@
+"""racelint: the host-runtime concurrency auditor + lock-order tracer.
+
+Covers, per the shipped contract (docs/racelint.md):
+
+- one flagged/clean fixture pair per RL rule (RL101/102/103/104/105/201);
+- suppression comments (`# racelint: disable=...` scoped to RL,
+  `# tracelint: disable=...` universal, `# shardlint:` NOT honored);
+- the shared baseline flow (analysis/common.py) driving `--check`;
+- the runtime lock-order sanitizer: inversion detection, agreement
+  with the static RL102 model (both directions: a clean run stays
+  clean, a hidden reverse acquisition conflicts);
+- the self-audit gate: `tools/racelint.py --check paddle_tpu` green
+  against the checked-in baseline;
+- regression tests for the concurrency bugs the self-audit surfaced
+  and this PR fixed (HealthMonitor callback-under-lock deadlock,
+  PreemptionHandler signal-context IO, SparseTable torn pulls).
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import signal as _signal
+import subprocess
+import sys
+import textwrap
+import threading
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.racelint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HERE = os.path.dirname(os.path.abspath(__file__))
+RACELINT = os.path.join(REPO, "tools", "racelint.py")
+
+from paddle_tpu.analysis import race_rules  # noqa: E402
+from paddle_tpu.analysis.lock_tracer import LockOrderTracer  # noqa: E402
+
+
+def lint_src(tmp_path, src, name="mod.py"):
+    (tmp_path / name).write_text(textwrap.dedent(src))
+    return race_rules.lint_package([str(tmp_path)], base=str(tmp_path))
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+# ---------------------------------------------------------------- RL101
+RL101_FLAGGED = """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self.items = {}
+            self._t = threading.Thread(target=self._run, daemon=True)
+            self._t.start()
+
+        def _run(self):
+            self.items["k"] = 1
+
+        def read(self):
+            return dict(self.items)
+"""
+
+RL101_CLEAN = """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self.items = {}
+            self._lock = threading.Lock()
+            self._t = threading.Thread(target=self._run, daemon=True)
+            self._t.start()
+
+        def _run(self):
+            with self._lock:
+                self.items["k"] = 1
+
+        def read(self):
+            with self._lock:
+                return dict(self.items)
+"""
+
+
+class TestRL101:
+    def test_flagged(self, tmp_path):
+        fs = lint_src(tmp_path, RL101_FLAGGED)
+        assert "RL101" in codes(fs)
+        (hit,) = [f for f in fs if f.code == "RL101"]
+        assert "items" in hit.message
+        assert hit.line > 0 and hit.path.endswith("mod.py")
+
+    def test_clean(self, tmp_path):
+        fs = lint_src(tmp_path, RL101_CLEAN)
+        assert "RL101" not in codes(fs)
+
+    def test_init_only_publish_is_clean(self, tmp_path):
+        # written in __init__ only (happens-before thread start), read
+        # from the worker: no finding
+        fs = lint_src(tmp_path, """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self.cfg = {"a": 1}
+                    threading.Thread(target=self._run,
+                                     daemon=True).start()
+
+                def _run(self):
+                    return self.cfg["a"]
+        """)
+        assert "RL101" not in codes(fs)
+
+    def test_queue_typed_attr_is_clean(self, tmp_path):
+        fs = lint_src(tmp_path, """
+            import queue
+            import threading
+
+            class W:
+                def __init__(self):
+                    self.q = queue.Queue()
+                    threading.Thread(target=self._run,
+                                     daemon=True).start()
+
+                def _run(self):
+                    self.q.put(1)
+
+                def read(self):
+                    return self.q.get_nowait()
+        """)
+        assert "RL101" not in codes(fs)
+
+
+# ---------------------------------------------------------------- RL102
+RL102_FLAGGED = """
+    import threading
+
+    a = threading.Lock()
+    b = threading.Lock()
+
+    def one():
+        with a:
+            with b:
+                pass
+
+    def two():
+        with b:
+            with a:
+                pass
+"""
+
+RL102_CLEAN = """
+    import threading
+
+    a = threading.Lock()
+    b = threading.Lock()
+
+    def one():
+        with a:
+            with b:
+                pass
+
+    def two():
+        with a:
+            with b:
+                pass
+"""
+
+
+class TestRL102:
+    def test_flagged(self, tmp_path):
+        fs = lint_src(tmp_path, RL102_FLAGGED)
+        hits = [f for f in fs if f.code == "RL102"]
+        assert len(hits) == 1            # one cycle, reported once
+        assert "mod.a" in hits[0].message and "mod.b" in hits[0].message
+
+    def test_clean(self, tmp_path):
+        fs = lint_src(tmp_path, RL102_CLEAN)
+        assert "RL102" not in codes(fs)
+
+    def test_interprocedural_cycle(self, tmp_path):
+        # inversion only visible through a call made while holding
+        fs = lint_src(tmp_path, """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def _inner(self):
+                    with self._a:
+                        pass
+
+                def forward(self):
+                    with self._b:
+                        self._inner()
+
+                def backward(self):
+                    with self._a:
+                        with self._b:
+                            pass
+        """)
+        assert "RL102" in codes(fs)
+
+
+# ---------------------------------------------------------------- RL103
+RL103_FLAGGED = """
+    import threading
+    import time
+
+    _lock = threading.Lock()
+
+    def slow():
+        with _lock:
+            time.sleep(1.0)
+"""
+
+RL103_CLEAN = """
+    import threading
+    import time
+
+    _lock = threading.Lock()
+
+    def slow():
+        with _lock:
+            x = 1
+        time.sleep(1.0)
+        return x
+"""
+
+
+class TestRL103:
+    def test_flagged(self, tmp_path):
+        fs = lint_src(tmp_path, RL103_FLAGGED)
+        hits = [f for f in fs if f.code == "RL103"]
+        assert hits and "sleep" in hits[0].message
+        assert "mod._lock" in hits[0].message
+
+    def test_clean(self, tmp_path):
+        fs = lint_src(tmp_path, RL103_CLEAN)
+        assert "RL103" not in codes(fs)
+
+    def test_untimed_queue_get_under_lock(self, tmp_path):
+        fs = lint_src(tmp_path, """
+            import queue
+            import threading
+
+            _lock = threading.Lock()
+            _q = queue.Queue()
+
+            def bad():
+                with _lock:
+                    return _q.get()
+
+            def fine():
+                with _lock:
+                    return _q.get(timeout=0.1)
+        """)
+        hits = [f for f in fs if f.code == "RL103"]
+        assert len(hits) == 1 and "get" in hits[0].message
+
+    def test_match_case_body_under_lock(self, tmp_path):
+        # match-case bodies are structural containers, not statements:
+        # the walker must still see the sleep under the lock
+        fs = lint_src(tmp_path, """
+            import threading
+            import time
+
+            _lock = threading.Lock()
+
+            def dispatch(cmd):
+                with _lock:
+                    match cmd:
+                        case "slow":
+                            time.sleep(1.0)
+                        case _:
+                            pass
+        """)
+        hits = [f for f in fs if f.code == "RL103"]
+        assert hits and "sleep" in hits[0].message
+
+    def test_callback_under_lock_via_callee(self, tmp_path):
+        # the HealthMonitor bug shape: update() holds the lock and
+        # calls _record(), which invokes a STORED callback
+        fs = lint_src(tmp_path, """
+            import threading
+
+            class Mon:
+                def __init__(self, on_change=None):
+                    self._lock = threading.Lock()
+                    self.on_change = on_change
+
+                def _record(self, v):
+                    self.on_change(v)
+
+                def update(self, v):
+                    with self._lock:
+                        self._record(v)
+        """)
+        hits = [f for f in fs if f.code == "RL103"]
+        assert hits and "on_change" in hits[0].message
+
+
+# ---------------------------------------------------------------- RL104
+RL104_FLAGGED = """
+    import signal
+    import threading
+
+    _lock = threading.Lock()
+
+    def handler(signum, frame):
+        with _lock:
+            print("preempted!")
+
+    def install():
+        signal.signal(signal.SIGTERM, handler)
+"""
+
+RL104_CLEAN = """
+    import signal
+    import threading
+
+    flag = threading.Event()
+
+    def handler(signum, frame):
+        flag.set()
+
+    def install():
+        signal.signal(signal.SIGTERM, handler)
+"""
+
+
+class TestRL104:
+    def test_flagged(self, tmp_path):
+        fs = lint_src(tmp_path, RL104_FLAGGED)
+        hits = [f for f in fs if f.code == "RL104"]
+        # both the lock acquisition and the IO are reported
+        assert any("acquires" in h.message for h in hits)
+        assert any("IO" in h.message for h in hits)
+
+    def test_clean(self, tmp_path):
+        fs = lint_src(tmp_path, RL104_CLEAN)
+        assert "RL104" not in codes(fs)
+
+
+# ---------------------------------------------------------------- RL105
+RL105_FLAGGED = """
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    class S:
+        def __init__(self):
+            self.pool = ThreadPoolExecutor(2)
+
+    def work():
+        pass
+
+    def spawn():
+        t = threading.Thread(target=work)
+        t.start()
+        return t
+"""
+
+RL105_CLEAN = """
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    class S:
+        def __init__(self):
+            self.pool = ThreadPoolExecutor(2)
+
+        def close(self):
+            self.pool.shutdown()
+
+    def work():
+        pass
+
+    def spawn():
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        return t
+"""
+
+
+class TestRL105:
+    def test_flagged(self, tmp_path):
+        fs = lint_src(tmp_path, RL105_FLAGGED)
+        hits = [f for f in fs if f.code == "RL105"]
+        assert any("never joined" in h.message for h in hits)
+        assert any("never shut down" in h.message for h in hits)
+
+    def test_clean(self, tmp_path):
+        fs = lint_src(tmp_path, RL105_CLEAN)
+        assert "RL105" not in codes(fs)
+
+    def test_with_managed_executor_is_clean(self, tmp_path):
+        # `with ThreadPoolExecutor(...)` shuts down on scope exit
+        fs = lint_src(tmp_path, """
+            from concurrent.futures import ThreadPoolExecutor
+
+            def fanout(fn, items):
+                with ThreadPoolExecutor(max_workers=2) as ex:
+                    return list(ex.map(fn, items))
+        """)
+        assert "RL105" not in codes(fs)
+
+    def test_joined_thread_is_clean(self, tmp_path):
+        fs = lint_src(tmp_path, """
+            import threading
+
+            def work():
+                pass
+
+            def spawn():
+                t = threading.Thread(target=work)
+                t.start()
+                t.join()
+        """)
+        assert "RL105" not in codes(fs)
+
+
+# ---------------------------------------------------------------- RL201
+RL201_FLAGGED = """
+    import threading
+
+    class Cache:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._m = {}
+            threading.Thread(target=self._evict, daemon=True).start()
+
+        def put(self, k, v):
+            with self._lock:
+                self._m[k] = v
+
+        def _evict(self):
+            if "k" in self._m:
+                del self._m["k"]
+"""
+
+RL201_CLEAN = """
+    import threading
+
+    class Cache:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._m = {}
+            threading.Thread(target=self._evict, daemon=True).start()
+
+        def put(self, k, v):
+            with self._lock:
+                self._m[k] = v
+
+        def _evict(self):
+            with self._lock:
+                if "k" in self._m:
+                    del self._m["k"]
+"""
+
+
+class TestRL201:
+    def test_flagged(self, tmp_path):
+        fs = lint_src(tmp_path, RL201_FLAGGED)
+        hits = [f for f in fs if f.code == "RL201"]
+        assert hits and "_m" in hits[0].message
+        assert "_lock" in hits[0].message   # names the guarding lock
+
+    def test_clean(self, tmp_path):
+        fs = lint_src(tmp_path, RL201_CLEAN)
+        assert "RL201" not in codes(fs)
+
+
+# ---------------------------------------------------------- suppression
+class TestSuppression:
+    def test_racelint_and_tracelint_spellings(self, tmp_path):
+        flagged = textwrap.dedent(RL103_FLAGGED)
+        for comment in ("# racelint: disable=RL103",
+                        "# tracelint: disable=RL103",
+                        "# racelint: disable=ALL"):
+            src = flagged.replace("time.sleep(1.0)",
+                                  f"time.sleep(1.0)  {comment}")
+            (tmp_path / "mod.py").write_text(src)
+            fs = race_rules.lint_package([str(tmp_path)],
+                                         base=str(tmp_path))
+            assert "RL103" not in codes(fs), comment
+
+    def test_shardlint_spelling_cannot_waive_rl(self, tmp_path):
+        src = textwrap.dedent(RL103_FLAGGED).replace(
+            "time.sleep(1.0)",
+            "time.sleep(1.0)  # shardlint: disable=RL103")
+        (tmp_path / "mod.py").write_text(src)
+        fs = race_rules.lint_package([str(tmp_path)],
+                                     base=str(tmp_path))
+        assert "RL103" in codes(fs)
+
+    def test_skip_file(self, tmp_path):
+        src = "# tracelint: skip-file\n" + textwrap.dedent(RL103_FLAGGED)
+        (tmp_path / "mod.py").write_text(src)
+        fs = race_rules.lint_package([str(tmp_path)],
+                                     base=str(tmp_path))
+        assert fs == []
+
+
+# ------------------------------------------------- baseline / CLI gate
+class TestBaselineFlow:
+    def test_check_only_fails_on_new_findings(self, tmp_path):
+        """The shared common.py flow: baseline absorbs the backlog,
+        --check goes red only on a regression."""
+        mod = tmp_path / "m.py"
+        mod.write_text(textwrap.dedent(RL103_FLAGGED))
+        baseline = tmp_path / "baseline.json"
+        env = dict(os.environ, PYTHONPATH=REPO)
+
+        def run(*args):
+            return subprocess.run(
+                [sys.executable, RACELINT, *args, str(tmp_path)],
+                capture_output=True, text=True, timeout=120, env=env)
+
+        assert run("--write-baseline",
+                   "--baseline", str(baseline)).returncode == 0
+        proc = run("--check", "--baseline", str(baseline))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "1 baselined" in proc.stdout
+        # regression: a NEW blocking site beyond the baselined count
+        mod.write_text(textwrap.dedent(RL103_FLAGGED) + textwrap.dedent("""
+            def slow2():
+                with _lock:
+                    time.sleep(2.0)
+        """))
+        proc = run("--check", "--baseline", str(baseline))
+        assert proc.returncode == 1
+        assert "RL103" in proc.stdout
+
+    def test_self_audit_gate(self):
+        """tools/racelint.py --check over the whole package must be
+        green against the checked-in baseline."""
+        proc = subprocess.run(
+            [sys.executable, RACELINT, "--check", "paddle_tpu"],
+            cwd=REPO, capture_output=True, text=True, timeout=180)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "racelint: 0 finding(s)" in proc.stdout
+
+    def test_rules_catalogue(self):
+        proc = subprocess.run(
+            [sys.executable, RACELINT, "--rules"], cwd=REPO,
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0
+        for code in ("RL101", "RL102", "RL103", "RL104", "RL105",
+                     "RL201"):
+            assert code in proc.stdout
+
+
+# ------------------------------------------------------ lock tracer
+def _load_tmp_module(tmp_path, src, name):
+    p = tmp_path / f"{name}.py"
+    p.write_text(textwrap.dedent(src))
+    spec = importlib.util.spec_from_file_location(name, p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+TRACED_SRC = """
+    import threading
+
+    a = threading.Lock()
+    b = threading.Lock()
+
+    def ordered():
+        with a:
+            with b:
+                pass
+
+    def reversed_hidden():
+        # opaque to the static pass: the locks travel through locals
+        first, second = b, a
+        with first:
+            with second:
+                pass
+"""
+
+
+class TestLockTracer:
+    def test_records_edges_and_violations(self, tmp_path):
+        with LockOrderTracer(roots=(str(tmp_path),),
+                             base=str(tmp_path)) as tr:
+            mod = _load_tmp_module(tmp_path, TRACED_SRC, "tr1")
+            mod.ordered()
+        assert tr.snapshot()["locks_traced"] == 2
+        assert len(tr.edges) == 1
+        assert tr.violations() == []
+        # now the reverse order too -> a real inversion
+        with LockOrderTracer(roots=(str(tmp_path),),
+                             base=str(tmp_path)) as tr2:
+            mod2 = _load_tmp_module(tmp_path, TRACED_SRC, "tr2")
+            mod2.ordered()
+            mod2.reversed_hidden()
+        assert len(tr2.violations()) == 1
+
+    def test_rlock_reentry_does_not_edge(self, tmp_path):
+        with LockOrderTracer(roots=(str(tmp_path),),
+                             base=str(tmp_path)) as tr:
+            mod = _load_tmp_module(tmp_path, """
+                import threading
+
+                r = threading.RLock()
+
+                def reenter():
+                    with r:
+                        with r:
+                            pass
+            """, "tr3")
+            mod.reenter()
+        assert tr.edges == {}
+
+    def test_agreement_with_static_model(self, tmp_path):
+        """The chaos-gate contract: dynamic edges from a CLEAN run are
+        consistent with the static RL102 model; a hidden reverse
+        acquisition is reported as a conflict."""
+        p = tmp_path / "trmod.py"
+        p.write_text(textwrap.dedent(TRACED_SRC))
+        static_edges, lock_sites = race_rules.static_lock_order(
+            [str(tmp_path)], base=str(tmp_path))
+        # the static model sees ONLY the ordered() edge (a before b)
+        assert len(static_edges) == 1
+        with LockOrderTracer(roots=(str(tmp_path),),
+                             base=str(tmp_path)) as tr:
+            spec = importlib.util.spec_from_file_location("trmod", p)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            mod.ordered()
+        verdict = tr.check_static(static_edges, lock_sites)
+        assert verdict["conflicts"] == []
+        assert verdict["combined_cycles"] == []
+        # a second run that takes the locks in the hidden reverse order
+        with LockOrderTracer(roots=(str(tmp_path),),
+                             base=str(tmp_path)) as tr2:
+            spec = importlib.util.spec_from_file_location("trmod2", p)
+            mod2 = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod2)
+            mod2.reversed_hidden()
+        verdict2 = tr2.check_static(static_edges, lock_sites)
+        assert verdict2["conflicts"], "reverse order must conflict"
+
+    def test_repo_runtime_stays_inversion_free(self, tmp_path):
+        """A representative slice of the concurrent runtime (async
+        checkpointing under fault injection + the health monitor +
+        engine metrics release) runs under the tracer with zero
+        order violations and no conflict against the static model."""
+        from paddle_tpu import resilience as R
+        from paddle_tpu.resilience.health import HealthMonitor
+
+        with LockOrderTracer() as tr:
+            ck = R.Checkpointer(str(tmp_path / "run"), keep=2,
+                                async_save=True)
+            plan = R.FaultPlan([R.FaultSpec("io.save", "torn_write",
+                                            at=1)])
+            with R.FaultInjector(plan):
+                for step in (1, 2, 3):
+                    ck.save(step, {"w": np.ones(8) * step})
+                ck.wait()
+            got = ck.load()
+            ck.close()
+            assert got is not None
+            mon = HealthMonitor()
+            for p_ in (0.5, 0.9, 0.99, 0.5, 0.1):
+                mon.update(p_)
+        assert tr.violations() == []
+        static_edges, lock_sites = race_rules.static_lock_order(
+            [os.path.join(REPO, "paddle_tpu")], base=REPO)
+        verdict = tr.check_static(static_edges, lock_sites)
+        assert verdict["conflicts"] == []
+
+
+# ------------------------------------- regression: the fixed findings
+class TestFixedRaces:
+    def test_health_monitor_reentrant_callback_does_not_deadlock(self):
+        """Pre-fix, HealthMonitor.update() invoked on_transition while
+        holding its non-reentrant lock: a callback that feeds pressure
+        back through update() (a drain hook reacting to DRAINING)
+        deadlocked the monitor.  Must complete now."""
+        from paddle_tpu.resilience.health import (HealthMonitor,
+                                                  HealthState)
+        gauge_sets = []
+
+        class FakeGauge:
+            def set(self, v):
+                gauge_sets.append(int(v))
+
+        mon = HealthMonitor(degraded_at=0.5, drain_at=0.9,
+                            recover_at=0.2, gauge=FakeGauge())
+        reentered = []
+
+        def cb(old, new, pressure):
+            if new == HealthState.DRAINING:
+                reentered.append(mon.update(0.1))
+
+        mon.on_transition = cb
+        done = []
+
+        def drive():
+            mon.update(0.95)        # HEALTHY -> DRAINING, fires cb
+            done.append(True)
+
+        t = threading.Thread(target=drive, daemon=True)
+        t.start()
+        t.join(timeout=10)
+        assert done, ("HealthMonitor.update() deadlocked when its "
+                      "on_transition callback re-entered the monitor")
+        assert reentered == [HealthState.DEGRADED]
+        assert mon.state == HealthState.DEGRADED
+        assert [(o.name, n.name) for o, n, _ in mon.transitions] == \
+            [("HEALTHY", "DRAINING"), ("DRAINING", "DEGRADED")]
+        # emission is FIFO through the drain queue: the gauge ends on
+        # the monitor's real state, never a stale earlier one
+        assert gauge_sets == [0, 2, 1]
+
+    def test_signal_handler_defers_io_to_poll(self, capfd):
+        """Pre-fix, the SIGTERM handler printed to (buffered) stderr
+        INSIDE signal context — reentrancy-unsafe (racelint RL104).
+        Now the handler only sets the flag; the operator notice is
+        emitted at the next check() poll."""
+        from paddle_tpu import resilience as R
+        h = R.PreemptionHandler(auto_install=False)
+        h.install_signal_handlers()
+        try:
+            _signal.raise_signal(_signal.SIGTERM)
+            assert h.preempted          # handler ran (main thread)
+            assert h.reason == "signal:SIGTERM"
+            out = capfd.readouterr()
+            assert "preemption requested" not in out.err, \
+                "signal context performed IO"
+            assert h.check(step=3) is True
+            err = capfd.readouterr().err
+            assert "preemption requested (signal:SIGTERM)" in err
+        finally:
+            h.uninstall_signal_handlers()
+
+    def test_direct_request_still_prints_immediately(self, capfd):
+        from paddle_tpu import resilience as R
+        h = R.PreemptionHandler(auto_install=False)
+        h.request("external")
+        assert "preemption requested (external)" in capfd.readouterr().err
+
+    def test_pstable_pull_is_never_torn_by_concurrent_push(self):
+        """Pre-fix, SparseTable._pull_impl read self._data with no
+        lock while push() applied the optimizer step under it: a
+        prefetch-thread pull could see half-applied updates.  Every
+        pulled snapshot must now be a CONSISTENT version: v0 - k*lr
+        for one integer k across all rows."""
+        from paddle_tpu.distributed.ps import SparseTable
+        table = SparseTable(32, 4, optimizer="sgd", learning_rate=1.0,
+                            init_std=0.0, seed=0)
+        ids = np.arange(32)
+        grads = np.ones((32, 4), np.float32)
+        stop = threading.Event()
+        bad = []
+
+        def puller():
+            while not stop.is_set():
+                rows = table.pull(ids)
+                ks = np.unique(-rows)   # v0 == 0, lr == 1: rows = -k
+                if len(ks) != 1 or ks[0] != round(float(ks[0])):
+                    bad.append(rows.copy())
+                    return
+
+        threads = [threading.Thread(target=puller, daemon=True)
+                   for _ in range(3)]
+        for t in threads:
+            t.start()
+        for _ in range(50):
+            table.push(ids, grads)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not bad, f"torn pull observed: {bad[0]}"
+        assert (table.pull(ids) == -50.0).all()

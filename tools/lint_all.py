@@ -5,9 +5,15 @@ Chains every baseline-gated analyzer in the repo, plus the chaos suite:
 
   1. tracelint  --check paddle_tpu examples   (AST trace-safety, TLxxx)
   2. shardlint  --check                       (sharding/memory audit, SLxxx)
-  3. api_coverage --baseline                  (public-surface regressions)
-  4. pytest -m chaos                          (deterministic fault-injection
-                                               acceptance proofs)
+  3. racelint   --check paddle_tpu            (host concurrency audit, RLxxx)
+  4. api_coverage --baseline                  (public-surface regressions)
+  5. pytest -m chaos                          (deterministic fault-injection
+                                               acceptance proofs, run under
+                                               the racelint lock-order
+                                               tracer — tests/conftest.py
+                                               arms it for chaos-marked
+                                               tests and fails on any
+                                               dynamic order violation)
 
 The static gates compare against their checked-in baselines and fail
 only on REGRESSIONS; the chaos gate re-proves the resilience contracts
@@ -21,7 +27,8 @@ enforces every gate at once.  The chaos gate deselects itself there via
 `-m "chaos"` targeting only tests/test_resilience.py — chaos tests
 carry no `lint` marker, so the recursion terminates.
 
-Usage: python tools/lint_all.py [--skip tracelint shardlint coverage chaos]
+Usage: python tools/lint_all.py
+       [--skip tracelint shardlint racelint coverage chaos]
 """
 from __future__ import annotations
 
@@ -39,6 +46,8 @@ GATES = {
                   "--check", "paddle_tpu", "examples"],
     "shardlint": [sys.executable, os.path.join(TOOLS, "shardlint.py"),
                   "--check"],
+    "racelint": [sys.executable, os.path.join(TOOLS, "racelint.py"),
+                 "--check", "paddle_tpu"],
     "coverage": [sys.executable, os.path.join(TOOLS, "api_coverage.py"),
                  "--baseline",
                  os.path.join(TOOLS, "api_coverage_baseline.json")],

@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""racelint CLI — host-runtime concurrency audit for paddle_tpu.
+
+Whole-package AST pass (no jax import, no trace): discovers thread
+roots (threading.Thread targets, executor submissions, signal handlers,
+multiprocessing workers, installed preemption handlers, and the public
+API as the main-thread root), infers per-function lock sets, and
+reports the RLxxx family — unguarded shared attributes (RL101),
+lock-order inversion cycles (RL102), blocking calls under a lock
+(RL103), unsafe signal handlers (RL104), thread/executor lifecycle
+leaks (RL105), and check-then-act TOCTOU (RL201).
+
+Usage:
+  python tools/racelint.py paddle_tpu             # report everything
+  python tools/racelint.py --check paddle_tpu     # vs baseline, CI gate
+  python tools/racelint.py --write-baseline paddle_tpu
+  python tools/racelint.py --json - paddle_tpu
+  python tools/racelint.py --rules                # RL rule catalogue
+
+Exit codes: 0 clean, 1 findings (plain) / NEW findings vs baseline
+(--check), 2 usage error.
+
+Suppression: the same `# tracelint: disable=RL101` per-line comments
+the other analyzers honor (`# racelint: disable=...` is an accepted
+alias, scoped to RL codes).  The checked-in baseline
+(tools/racelint_baseline.json) holds reviewed findings; `--check`
+reports only regressions beyond it.  The `--json` report uses the
+shared analyzer schema (analysis/report.to_json, "tool": "racelint").
+
+The dynamic half — the lock-order sanitizer that records the ACTUAL
+acquisition graph during the chaos suite and cross-checks it against
+the static RL102 model — lives in paddle_tpu/analysis/lock_tracer.py
+and is enabled by the chaos-marked tests (see docs/racelint.md).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(1, os.path.join(REPO, "tools"))
+
+DEFAULT_BASELINE = os.path.join(REPO, "tools", "racelint_baseline.json")
+
+
+def main(argv=None):
+    from _bootstrap import light_paddle_tpu
+    light_paddle_tpu(REPO)
+    from paddle_tpu.analysis import common, race_rules
+    from paddle_tpu.analysis.rules import RACELINT_CODES, RULES
+
+    ap = argparse.ArgumentParser(
+        prog="racelint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*", help="files/directories to lint")
+    common.add_baseline_args(ap, DEFAULT_BASELINE)
+    ap.add_argument("--rules", action="store_true",
+                    help="print the RL rule catalogue and exit")
+    ap.add_argument("--no-source", action="store_true",
+                    help="omit source lines from the text report")
+    args = ap.parse_args(argv)
+
+    if args.rules:
+        return common.print_rules(RULES, codes=set(RACELINT_CODES))
+    if not args.paths:
+        ap.print_usage()
+        return 2
+
+    t0 = time.time()
+    findings = race_rules.lint_package(args.paths, base=REPO)
+    elapsed = time.time() - t0
+
+    return common.run_baseline_flow(
+        findings, args, tool="racelint", repo=REPO, elapsed=elapsed,
+        show_source=not args.no_source)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

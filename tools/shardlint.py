@@ -32,7 +32,6 @@ with `tools/tracelint.py --json` (analysis/report.to_json).
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import sys
 import time
@@ -171,82 +170,38 @@ def bench_report(targets=("gpt_hybrid_train", "serving")):
 
 # ----------------------------------------------------------------- CLI
 def main(argv=None):
+    from paddle_tpu.analysis import common
+    from paddle_tpu.analysis.rules import RULES, SHARDLINT_CODES
+
     ap = argparse.ArgumentParser(
         prog="shardlint", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("--targets", nargs="*", default=None,
                     help=f"audit targets (default: all — "
                          f"{', '.join(sorted(TARGETS))})")
-    ap.add_argument("--check", action="store_true",
-                    help="compare against the baseline; fail only on NEW "
-                         "findings")
-    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
-                    help=f"baseline file (default {DEFAULT_BASELINE})")
-    ap.add_argument("--write-baseline", action="store_true",
-                    help="write the current findings as the new baseline")
-    ap.add_argument("--json", metavar="FILE", default=None,
-                    help="also write findings + cost reports as JSON "
-                         "('-' for stdout)")
+    common.add_baseline_args(ap, DEFAULT_BASELINE)
     ap.add_argument("--rules", action="store_true",
                     help="print the SL rule catalogue and exit")
     args = ap.parse_args(argv)
 
-    from paddle_tpu.analysis import report
-    from paddle_tpu.analysis.rules import RULES, SHARDLINT_CODES
-
     if args.rules:
-        for code in SHARDLINT_CODES:
-            r = RULES[code]
-            print(f"{r.code}  {r.name}")
-            print(f"    {r.message.format(detail='')}")
-            print(f"    why: {r.rationale}")
-            print(f"    fix: {r.fixit}")
-        return 0
+        return common.print_rules(RULES, codes=set(SHARDLINT_CODES))
 
     t0 = time.time()
     results = run_targets(args.targets)
     elapsed = time.time() - t0
     findings = [f for _, fs, _ in results for f in fs]
 
-    if args.write_baseline:
-        report.write_baseline(findings, args.baseline)
-        print(f"wrote baseline: {len(findings)} finding(s) -> "
-              f"{os.path.relpath(args.baseline, REPO)}")
-        return 0
-
-    shown = findings
-    note = ""
-    if args.check:
-        baseline = report.load_baseline(args.baseline)
-        shown = report.diff_vs_baseline(findings, baseline)
-        note = (f" ({len(findings)} total, "
-                f"{len(findings) - len(shown)} baselined)")
-
-    for name, fs, rep in results:
-        d = rep.to_dict()
-        print(f"== {name}: peak HBM {d['peak_hbm_mb']} MiB (est), "
-              f"padding waste {d['padding_waste_pct']}%, "
-              f"{len(fs)} finding(s)")
-    if shown:
-        print(report.format_text(shown, show_source=True))
-    print(f"shardlint: {len(shown)} finding(s){note} "
-          f"[{report.summarize(shown)}] in {elapsed:.2f}s")
-
-    if args.json:
-        doc = report.to_json(shown, extra={
-            "tool": "shardlint",
-            "elapsed_s": round(elapsed, 3),
-            "programs": {name: rep.to_dict()
-                         for name, _, rep in results},
-        })
-        if args.json == "-":
-            json.dump(doc, sys.stdout, indent=1)
-            print()
-        else:
-            with open(args.json, "w", encoding="utf-8") as fh:
-                json.dump(doc, fh, indent=1)
-                fh.write("\n")
-    return 1 if shown else 0
+    if not args.write_baseline:
+        for name, fs, rep in results:
+            d = rep.to_dict()
+            print(f"== {name}: peak HBM {d['peak_hbm_mb']} MiB (est), "
+                  f"padding waste {d['padding_waste_pct']}%, "
+                  f"{len(fs)} finding(s)")
+    return common.run_baseline_flow(
+        findings, args, tool="shardlint", repo=REPO, elapsed=elapsed,
+        json_extra={"programs": {name: rep.to_dict()
+                                 for name, _, rep in results}})
 
 
 if __name__ == "__main__":

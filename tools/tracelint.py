@@ -24,65 +24,40 @@ with a "tool" discriminator key).
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+sys.path.insert(1, os.path.join(REPO, "tools"))
 
 DEFAULT_BASELINE = os.path.join(REPO, "tools", "tracelint_baseline.json")
 
 
-def _light_package():
-    """Make `paddle_tpu.analysis` importable WITHOUT executing the real
-    paddle_tpu/__init__.py (which imports jax): the AST pass is pure
-    stdlib and the CLI must stay fast enough to gate CI on CPU.  No-op
-    when paddle_tpu is already imported (e.g. under pytest)."""
-    import types
-    if "paddle_tpu" not in sys.modules:
-        pkg = types.ModuleType("paddle_tpu")
-        pkg.__path__ = [os.path.join(REPO, "paddle_tpu")]
-        sys.modules["paddle_tpu"] = pkg
-
-
 def main(argv=None):
+    # stdlib-only import path: the AST pass must not drag in jax
+    from _bootstrap import light_paddle_tpu
+    light_paddle_tpu(REPO)
+    from paddle_tpu.analysis import common, lint_paths
+    from paddle_tpu.analysis.rules import RULES
+
     ap = argparse.ArgumentParser(
         prog="tracelint", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("paths", nargs="*", help="files/directories to lint")
-    ap.add_argument("--check", action="store_true",
-                    help="compare against the baseline; fail only on NEW "
-                         "findings")
-    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
-                    help=f"baseline file (default {DEFAULT_BASELINE})")
-    ap.add_argument("--write-baseline", action="store_true",
-                    help="write the current findings as the new baseline")
-    ap.add_argument("--json", metavar="FILE", default=None,
-                    help="also write findings as JSON ('-' for stdout)")
+    common.add_baseline_args(ap, DEFAULT_BASELINE)
     ap.add_argument("--rules", action="store_true",
                     help="print the rule catalogue and exit")
     ap.add_argument("--no-source", action="store_true",
                     help="omit source lines from the text report")
     args = ap.parse_args(argv)
 
-    # stdlib-only import path: the AST pass must not drag in jax
-    _light_package()
-    from paddle_tpu.analysis import lint_paths, report
-    from paddle_tpu.analysis.rules import RULES
-
     if args.rules:
         # TL codes only: the SLxxx family shares the registry but is
         # checked by tools/shardlint.py (which has its own --rules)
-        for r in RULES.values():
-            if not r.code.startswith("TL"):
-                continue
-            print(f"{r.code}  {r.name}")
-            print(f"    {r.message.format(detail='')}")
-            print(f"    why: {r.rationale}")
-            print(f"    fix: {r.fixit}")
-        return 0
+        return common.print_rules(
+            RULES, codes={c for c in RULES if c.startswith("TL")})
     if not args.paths:
         ap.print_usage()
         return 2
@@ -91,36 +66,9 @@ def main(argv=None):
     findings = lint_paths(args.paths, base=REPO)
     elapsed = time.time() - t0
 
-    if args.write_baseline:
-        report.write_baseline(findings, args.baseline)
-        print(f"wrote baseline: {len(findings)} finding(s) -> "
-              f"{os.path.relpath(args.baseline, REPO)}")
-        return 0
-
-    shown = findings
-    note = ""
-    if args.check:
-        baseline = report.load_baseline(args.baseline)
-        shown = report.diff_vs_baseline(findings, baseline)
-        note = (f" ({len(findings)} total, "
-                f"{len(findings) - len(shown)} baselined)")
-
-    if shown:
-        print(report.format_text(shown, show_source=not args.no_source))
-    print(f"tracelint: {len(shown)} finding(s){note} "
-          f"[{report.summarize(shown)}] in {elapsed:.2f}s")
-
-    if args.json:
-        doc = report.to_json(shown, extra={"tool": "tracelint",
-                                           "elapsed_s": round(elapsed, 3)})
-        if args.json == "-":
-            json.dump(doc, sys.stdout, indent=1)
-            print()
-        else:
-            with open(args.json, "w", encoding="utf-8") as fh:
-                json.dump(doc, fh, indent=1)
-                fh.write("\n")
-    return 1 if shown else 0
+    return common.run_baseline_flow(
+        findings, args, tool="tracelint", repo=REPO, elapsed=elapsed,
+        show_source=not args.no_source)
 
 
 if __name__ == "__main__":
