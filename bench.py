@@ -50,6 +50,7 @@ SHARDLINT_S = 150
 RACELINT_S = 90
 OBS_S = 150
 RESIL_S = 150
+PROFILE_S = 150
 CPU_TIMEOUT_S = 150
 CAPTURE_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), ".bench_capture_tpu.json")
@@ -359,7 +360,9 @@ def worker_obs():
 
     Reports (merged into every BENCH line):
       obs_span_overhead_pct   — wall-time cost of leaving spans on,
-                                asserted < 2% (the production contract)
+                                asserted < 2% (the production contract),
+                                measured WITH the Prometheus scrape
+                                endpoint live (the production shape)
       obs_recompile_count     — compile events seen by the log (the
                                 forced retrace makes this >= 2)
       obs_recompile_attrib    — which argument the last event blamed
@@ -411,21 +414,30 @@ def worker_obs():
         loss.block_until_ready()
         return time.perf_counter() - t0
 
-    time_loop(5)                            # warm the timing path
-    overhead = None
-    for attempt in range(3):
-        offs, ons = [], []
-        for _ in range(3):
-            obs.set_enabled(False)
-            offs.append(time_loop(20))
-            obs.set_enabled(True)
-            ons.append(time_loop(20))
-        pct = max(0.0, (statistics.median(ons) - statistics.median(offs))
-                  / statistics.median(offs) * 100.0)
-        overhead = pct if overhead is None else min(overhead, pct)
-        if overhead < 2.0:
-            break
-    obs.set_enabled(True)
+    # the <2% contract is measured in the production shape: roofline
+    # profiler imported, live Prometheus scrape endpoint running on its
+    # daemon thread (an idle endpoint must be free; a scrape-thread
+    # regression shows up here, not in prod)
+    scrape = obs.export.serve_prometheus(port=0)
+    try:
+        time_loop(5)                        # warm the timing path
+        overhead = None
+        for attempt in range(3):
+            offs, ons = [], []
+            for _ in range(3):
+                obs.set_enabled(False)
+                offs.append(time_loop(20))
+                obs.set_enabled(True)
+                ons.append(time_loop(20))
+            pct = max(0.0,
+                      (statistics.median(ons) - statistics.median(offs))
+                      / statistics.median(offs) * 100.0)
+            overhead = pct if overhead is None else min(overhead, pct)
+            if overhead < 2.0:
+                break
+        obs.set_enabled(True)
+    finally:
+        scrape.shutdown()
 
     events = obs.recompile_log().events()
     jit_events = [e for e in events if e.kind == "jit" and e.changes]
@@ -536,6 +548,28 @@ def worker_shardlint():
         out = shardlint.bench_report()
     finally:
         # remove by value: importing tools/shardlint.py prepends its own
+        # REPO entry, so pop(0) would evict the wrong path
+        sys.path.remove(tools_dir)
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+def worker_profile():
+    """Roofline-profiler lane: deterministic cost-model numbers for the
+    gpt hybrid train step (observability.profile — the same numbers
+    tools/perfgate.py gates on).  Pure CPU trace — never touches the
+    TPU claim — so every BENCH run records bytes/flops per step, the
+    heaviest layer, and the memory-bound fraction next to the measured
+    wall-time lanes."""
+    _init_backend()   # honors PTPU_FORCE_CPU (always set for this lane)
+    tools_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools")
+    sys.path.insert(0, tools_dir)
+    try:
+        import perfgate
+        out = perfgate.bench_report()
+    finally:
+        # remove by value: importing tools/perfgate.py prepends its own
         # REPO entry, so pop(0) would evict the wrong path
         sys.path.remove(tools_dir)
     print(json.dumps(out), flush=True)
@@ -846,6 +880,8 @@ def main():
         return worker_racelint()
     if "--worker-obs" in sys.argv:
         return worker_obs()
+    if "--worker-profile" in sys.argv:
+        return worker_profile()
     if "--worker-resilience" in sys.argv:
         return worker_resilience()
     if "--probe" in sys.argv:
@@ -861,6 +897,7 @@ def main():
     rl_proc = _spawn("--worker-racelint", force_cpu=True)
     obs_proc = _spawn("--worker-obs", force_cpu=True)
     resil_proc = _spawn("--worker-resilience", force_cpu=True)
+    prof_proc = _spawn("--worker-profile", force_cpu=True)
 
     probe_res, probe_err, _ = _await_json(
         _spawn("--probe", force_cpu=False), PROBE_BUDGET_S)
@@ -896,6 +933,14 @@ def main():
         # same rationale again: checkpoint-cost telemetry failing must
         # not mark a live measurement run as degraded
         merged["resilience_error"] = str(resil_err)
+
+    prof_res, prof_err, _ = _await_json(prof_proc, PROFILE_S)
+    if prof_res is not None:
+        merged.update(prof_res)
+    else:
+        # same rationale: a cost-model lane failure degrades only this
+        # lane's keys, never the measurement run's status
+        merged["profile_error"] = str(prof_err)
     tpu_ok = bool(probe_res
                   and (probe_res.get("ok") or probe_res.get("probe_ok"))
                   and probe_res.get("platform") != "cpu")
@@ -925,6 +970,7 @@ def main():
         _adopt_lane("obs_", "obs_span_overhead_pct", obs_err)
         _adopt_lane("resilience_", "resilience_ckpt_write_ms",
                     resil_err)
+        _adopt_lane("profile_", "profile_bytes_per_step", prof_err)
         cached["live"] = False
         cached["note"] = (
             f"{reason} — reporting most recent full on-silicon capture "

@@ -16,6 +16,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from paddle_tpu.observability import profile as _obsprofile
+
 float0 = jax.dtypes.float0
 
 
@@ -118,6 +120,7 @@ class Node:
         "fwd_rng",
         "out_is_tuple",
         "name",
+        "scope",
     )
 
     def __init__(self, inputs, out_tensors, pullback, name="",
@@ -133,6 +136,11 @@ class Node:
         )
         self.pullback = pullback
         self.name = name
+        # the layer-scope path active when the op ran forward: backward
+        # replays this node's pullback under it, so backward eqns that
+        # lose their jax name stack (fresh pull-time traces) still
+        # attribute to the owning layer in roofline reports
+        self.scope = _obsprofile.current_scope()
         # forward closure over the diff inputs (diff_vals -> outputs):
         # create_graph re-derives the vjp from it so second-order grads
         # see the primal dependence (pullback's residuals are opaque).
@@ -238,7 +246,9 @@ def backward(root, grad=None, retain_graph=False, differentiable=False,
                 cots.append(_zero_cotangent(shape, dtype))
         if not any_live:
             continue
-        in_grads = node.pullback(tuple(cots) if len(cots) > 1 else cots[0])
+        with _obsprofile.backward_scope(node.scope):
+            in_grads = node.pullback(
+                tuple(cots) if len(cots) > 1 else cots[0])
         for r, g in zip(node.in_refs, in_grads):
             if g is None or (hasattr(g, "dtype") and g.dtype == float0):
                 continue
@@ -368,7 +378,9 @@ def _backward_differentiable(root, grad, retain_graph, grad_sink=None,
                 kept = tuple(o for o, m in zip(gs, _mask) if m)
                 return kept if len(kept) != 1 else kept[0]
 
-            res = apply(run_vjp, *[cots[i] for i in tensor_pos], *primals)
+            with _obsprofile.backward_scope(node.scope):
+                res = apply(run_vjp, *[cots[i] for i in tensor_pos],
+                            *primals)
         else:
             import warnings
             warnings.warn(
@@ -393,7 +405,8 @@ def _backward_differentiable(root, grad, retain_graph, grad_sink=None,
                 kept = tuple(o for o, m in zip(outs, _mask) if m)
                 return kept if len(kept) != 1 else kept[0]
 
-            res = apply(run_pb, *[cots[i] for i in tensor_pos])
+            with _obsprofile.backward_scope(node.scope):
+                res = apply(run_pb, *[cots[i] for i in tensor_pos])
         res = res if isinstance(res, tuple) else (res,)
         it = iter(res)
         in_grads = [next(it) if m else None for m in mask]

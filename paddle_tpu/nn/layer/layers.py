@@ -16,6 +16,7 @@ from paddle_tpu.core.dtype import convert_dtype, get_default_dtype
 from paddle_tpu.core.tensor import Parameter, Tensor
 from paddle_tpu.framework.state import register_state_tensor
 from paddle_tpu.nn import initializer as I
+from paddle_tpu.observability.profile import layer_scope as _layer_scope
 
 
 class HookRemoveHelper:
@@ -60,6 +61,12 @@ class Layer:
                 if d is not None:
                     d.pop(name, None)
             layers[name] = value
+            # the child's attribute name under THIS parent: the unique
+            # component its profiler scope path is built from.  First
+            # registration wins — a shared instance mounted under two
+            # parents keeps ONE stable component (call-site paths still
+            # differ through the ambient scope stack)
+            value.__dict__.setdefault("_local_name", name)
             self.__dict__.pop(name, None)
         else:
             if params is not None and name in params:
@@ -133,6 +140,8 @@ class Layer:
 
     def add_sublayer(self, name, sublayer):
         self._sub_layers[str(name)] = sublayer
+        if isinstance(sublayer, Layer):
+            sublayer.__dict__.setdefault("_local_name", str(name))
         return sublayer
 
     def register_buffer(self, name, tensor, persistable=True):
@@ -306,12 +315,30 @@ class Layer:
             result = hook(self, inputs)
             if result is not None:
                 inputs = result if isinstance(result, tuple) else (result,)
-        outputs = self.forward(*inputs, **kwargs)
+        # jax.named_scope threading: under a to_static/jit trace every
+        # eqn this forward emits carries the layer-tree path on its name
+        # stack (and jax keeps it through jvp/transpose, so the layer's
+        # BACKWARD eqns attribute to the same scope) — the attribution
+        # key observability.profile's roofline reports aggregate by
+        with _layer_scope(self._scope_name()):
+            outputs = self.forward(*inputs, **kwargs)
         for hook in list(self._forward_post_hooks.values()):
             result = hook(self, inputs, outputs)
             if result is not None:
                 outputs = result
         return outputs
+
+    def _scope_name(self):
+        """This layer's component on the profiler scope path: its
+        attribute name under the parent (unique among siblings); a bare
+        container index gets the class prefix (``gptdecoderlayer_0``);
+        an unregistered root falls back to ``_name_scope``."""
+        local = self.__dict__.get("_local_name")
+        if local is None:
+            return self._name_scope
+        if local.isdigit():
+            return f"{self._name_scope}_{local}"
+        return local
 
     def full_name(self):
         return self._name_scope

@@ -15,8 +15,14 @@ lanes) with four pieces:
   ``StaticFunction`` cache miss and every serving AOT compile records
   WHY it compiled (which argument's shape / dtype / static leaf
   changed) plus wall-clock trace+compile time;
-- :mod:`export` — JSONL, Prometheus text exposition, and Chrome-trace
-  exporters; rendered by the ``tools/obs_report.py`` CLI.
+- :mod:`export` — JSONL, Prometheus text exposition (plus a live
+  scrape endpoint, :func:`export.serve_prometheus`), and Chrome-trace
+  exporters; rendered by the ``tools/obs_report.py`` CLI;
+- :mod:`profile` — the whole-program roofline profiler: deterministic
+  per-op flops/bytes attributed back to model layers through
+  ``jax.named_scope`` threading, classified compute- vs memory-bound
+  against chip specs, reconciled with span wall-times and XLA
+  ``cost_analysis()`` totals; regression-gated by ``tools/perfgate.py``.
 
 Quickstart::
 
@@ -32,8 +38,14 @@ Quickstart::
 See docs/observability.md for the architecture.
 """
 from paddle_tpu.observability import export
+from paddle_tpu.observability import profile
 from paddle_tpu.observability.metrics import (Counter, Gauge, Histogram,
                                               MetricsRegistry, registry)
+from paddle_tpu.observability.profile import (ChipSpec, LayerCost,
+                                              RooflineReport,
+                                              profile_engine,
+                                              profile_static_function,
+                                              profile_traced, reconcile)
 from paddle_tpu.observability.recompile import (RecompileEvent,
                                                 RecompileLog,
                                                 note_aot_compile,
@@ -44,18 +56,26 @@ from paddle_tpu.observability.spans import (SpanRecord, SpanRecorder,
                                             set_enabled, span)
 
 __all__ = [
+    "ChipSpec",
     "Counter",
     "Gauge",
     "Histogram",
+    "LayerCost",
     "MetricsRegistry",
     "RecompileEvent",
     "RecompileLog",
+    "RooflineReport",
     "SpanRecord",
     "SpanRecorder",
     "enabled",
     "export",
     "note_aot_compile",
     "note_jit_compile",
+    "profile",
+    "profile_engine",
+    "profile_static_function",
+    "profile_traced",
+    "reconcile",
     "recompile_log",
     "recorder",
     "registry",

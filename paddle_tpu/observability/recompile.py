@@ -39,13 +39,19 @@ class RecompileEvent:
     dicts — `kind` one of shape/dtype/static/structure/state/traced —
     empty for a first compile or a planned AOT compile."""
 
-    __slots__ = ("seq", "wall_time", "fn", "kind", "cause", "changes",
-                 "trace_ms", "compile_ms", "cache_size", "attrs")
+    __slots__ = ("seq", "wall_time", "t_ns", "fn", "kind", "cause",
+                 "changes", "trace_ms", "compile_ms", "cache_size",
+                 "attrs")
 
     def __init__(self, seq, fn, kind, cause, changes, trace_ms=None,
                  compile_ms=None, cache_size=None, attrs=None):
         self.seq = seq
         self.wall_time = time.time()
+        # monotonic twin of wall_time on the SAME clock the span ring
+        # buffer uses — so the Chrome-trace exporter can place compile
+        # events on the span timeline (an instant marker at the step
+        # where the retrace happened)
+        self.t_ns = time.perf_counter_ns()
         self.fn = fn
         self.kind = kind                # "jit" | "serving-aot"
         self.cause = cause
@@ -62,6 +68,7 @@ class RecompileEvent:
         return {
             "seq": self.seq,
             "wall_time": round(self.wall_time, 3),
+            "t_ns": self.t_ns,
             "fn": self.fn,
             "kind": self.kind,
             "cause": self.cause,

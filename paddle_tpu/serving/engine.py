@@ -715,6 +715,7 @@ class LLMEngine:
         state = self.health.update(
             m.pages_in_use / m.pages_total if m.pages_total else 0.0)
         m.health = state.name.lower()
+        m.sync_gauges()    # queue-depth / page-occupancy scrape gauges
 
     # ------------------------------------------------- compiled steps
     def _run_model(self, params, ids, pos_ids, ctx):

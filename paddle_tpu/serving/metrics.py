@@ -101,6 +101,15 @@ class EngineMetrics:
         self.health_state = reg.gauge(
             "serving_health_state", labels=labels,
             help="engine health: 0 healthy / 1 degraded / 2 draining")
+        # live admission signals for the multi-engine router's scrape
+        # path (observability.export.serve_prometheus): refreshed from
+        # the plain attrs above by sync_gauges() at every engine step
+        self.queue_depth_gauge = reg.gauge(
+            "serving_queue_depth", labels=labels,
+            help="requests waiting for admission")
+        self.page_occupancy_gauge = reg.gauge(
+            "serving_page_occupancy", labels=labels,
+            help="KV page-pool occupancy fraction (0..1)")
         # histograms (seconds) — registry-owned, engine-labeled
         self.ttft = reg.histogram(
             "serving_ttft_seconds", labels=labels,
@@ -128,6 +137,15 @@ class EngineMetrics:
         self._released = True
         self._finalizer.detach()
         _release_labels(self.labels)
+
+    def sync_gauges(self):
+        """Mirror the engine-pushed plain attrs into their registry
+        gauges, so the Prometheus scrape and snapshot() can't diverge
+        (same invariant the histograms get by being registry-owned)."""
+        self.queue_depth_gauge.set(self.queue_depth)
+        self.page_occupancy_gauge.set(
+            self.pages_in_use / self.pages_total if self.pages_total
+            else 0.0)
 
     def note_compile(self):
         self.compile_count += 1

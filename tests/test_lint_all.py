@@ -1,11 +1,11 @@
 """The unified gate: tools/lint_all.py chains tracelint --check,
-shardlint --check, racelint --check, api_coverage --baseline and the
-chaos suite (pytest -m chaos, run under the racelint lock-order
-tracer) into ONE exit code.  This `lint`-marked test is how tier-1
-enforces the four static baselines; the chaos gate is skipped here
-because tier-1 runs the chaos tests directly (they live in
-tests/test_resilience.py under the `chaos` marker) — standalone
-`python tools/lint_all.py` runs all five.
+shardlint --check, racelint --check, perfgate --check, api_coverage
+--baseline and the chaos suite (pytest -m chaos, run under the
+racelint lock-order tracer) into ONE exit code.  This `lint`-marked
+test is how tier-1 enforces the five static baselines; the chaos gate
+is skipped here because tier-1 runs the chaos tests directly (they
+live in tests/test_resilience.py under the `chaos` marker) —
+standalone `python tools/lint_all.py` runs all six.
 """
 import os
 import subprocess
@@ -24,7 +24,7 @@ def test_lint_all_gate_clean():
     # (tests/test_resilience.py carries the marker), so re-running it
     # nested here would double its cost inside the tier-1 budget for no
     # added coverage.  Standalone `python tools/lint_all.py` (the CI
-    # entry point) still runs all four gates.
+    # entry point) still runs all six gates.
     proc = subprocess.run([sys.executable, LINT_ALL, "--skip", "chaos"],
                           cwd=REPO, capture_output=True, text=True,
                           timeout=420)
@@ -33,6 +33,7 @@ def test_lint_all_gate_clean():
     assert "tracelint: ok" in out
     assert "shardlint: ok" in out
     assert "racelint: ok" in out
+    assert "perfgate: ok" in out
     assert "coverage: ok" in out
     assert "chaos: SKIPPED" in out
     assert "all gates clean" in out
@@ -41,7 +42,7 @@ def test_lint_all_gate_clean():
 def test_lint_all_skip_flag():
     proc = subprocess.run(
         [sys.executable, LINT_ALL, "--skip", "tracelint", "shardlint",
-         "racelint", "coverage", "chaos"],
+         "racelint", "perfgate", "coverage", "chaos"],
         cwd=REPO, capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0
-    assert proc.stdout.count("SKIPPED") == 5
+    assert proc.stdout.count("SKIPPED") == 6

@@ -6,8 +6,13 @@ Chains every baseline-gated analyzer in the repo, plus the chaos suite:
   1. tracelint  --check paddle_tpu examples   (AST trace-safety, TLxxx)
   2. shardlint  --check                       (sharding/memory audit, SLxxx)
   3. racelint   --check paddle_tpu            (host concurrency audit, RLxxx)
-  4. api_coverage --baseline                  (public-surface regressions)
-  5. pytest -m chaos                          (deterministic fault-injection
+  4. perfgate   --check                       (deterministic cost-model
+                                               perf budgets: bytes/flops
+                                               per step, padding waste,
+                                               compile bounds vs
+                                               tools/perf_baseline.json)
+  5. api_coverage --baseline                  (public-surface regressions)
+  6. pytest -m chaos                          (deterministic fault-injection
                                                acceptance proofs, run under
                                                the racelint lock-order
                                                tracer — tests/conftest.py
@@ -28,7 +33,7 @@ enforces every gate at once.  The chaos gate deselects itself there via
 carry no `lint` marker, so the recursion terminates.
 
 Usage: python tools/lint_all.py
-       [--skip tracelint shardlint racelint coverage chaos]
+       [--skip tracelint shardlint racelint perfgate coverage chaos]
 """
 from __future__ import annotations
 
@@ -48,6 +53,8 @@ GATES = {
                   "--check"],
     "racelint": [sys.executable, os.path.join(TOOLS, "racelint.py"),
                  "--check", "paddle_tpu"],
+    "perfgate": [sys.executable, os.path.join(TOOLS, "perfgate.py"),
+                 "--check"],
     "coverage": [sys.executable, os.path.join(TOOLS, "api_coverage.py"),
                  "--baseline",
                  os.path.join(TOOLS, "api_coverage_baseline.json")],

@@ -11,12 +11,21 @@ captures one live with ``--demo``) and renders:
   nesting depth, with durations;
 - the METRICS snapshot: every Counter/Gauge/Histogram in the registry.
 
+With ``--roofline`` it instead renders RooflineReport records
+(observability.profile): the per-layer bytes/flops attribution table
+sorted by bytes, with compute- vs memory-bound classification — from a
+JSONL dump's ``roofline`` records, or captured live from the gpt
+hybrid train target with ``--demo`` (traces, runs two steps for the
+measured span time, and reconciles predicted vs measured).
+
 Usage:
   python tools/obs_report.py obs.jsonl           # render a dump
   python tools/obs_report.py --demo              # gpt-hybrid forced-
                                                  # retrace demo, live
   python tools/obs_report.py obs.jsonl --json -  # machine-readable
   python tools/obs_report.py --demo --prom       # Prometheus text
+  python tools/obs_report.py --demo --roofline   # live roofline table
+  python tools/obs_report.py obs.jsonl --roofline  # from dump records
 
 The demo compiles the tiny-config GPT hybrid train step, perturbs ONE
 input's shape to force a retrace, and shows the resulting recompile
@@ -75,6 +84,56 @@ def run_demo():
     ids_wide = P.to_tensor(rng.integers(0, cfg.vocab_size, (2, 48)),
                            dtype="int64")
     train_step(ids_wide)                          # forced retrace
+
+
+def live_roofline():
+    """Roofline-profile the gpt hybrid train target live: trace for the
+    cost model, run two real steps so the span layer has a measured
+    wall time, reconcile the two in one report."""
+    import perfgate  # sibling tools/ module (sys.path[0] is tools/)
+
+    from paddle_tpu.observability import profile
+
+    train_step, ids, labels = perfgate.build_gpt_train_step()
+    train_step(ids, labels)                 # compile + step 1
+    train_step(ids, labels)                 # warm step 2
+    jaxpr, _ = train_step.traced_program(ids, labels)
+    report = profile.profile_traced(jaxpr, where="<gpt_hybrid_train>")
+    return profile.reconcile(report, "jit.train_step")
+
+
+def render_rooflines(reports):
+    for d in reports:
+        chip = d.get("chip", {})
+        print(f"== roofline {d.get('where', '?')} — chip "
+              f"{chip.get('name', '?')} ({chip.get('peak_tflops', '?')} "
+              f"TF/s, {chip.get('hbm_gbs', '?')} GB/s, ridge "
+              f"{chip.get('ridge_flop_per_byte', '?')} flop/B) " + "=" * 8)
+        total_b = d.get("total_bytes") or 1
+        print(f"  {'layer':<52s} {'KiB':>10s} {'MFLOP':>9s} "
+              f"{'flop/B':>7s} {'bound':>8s} {'%bytes':>7s}")
+        for row in d.get("layers", []):
+            print(f"  {row['name'][:52]:<52s} "
+                  f"{row['bytes'] / 1024:>10.1f} "
+                  f"{row['flops'] / 1e6:>9.3f} "
+                  f"{row.get('intensity', 0):>7.2f} "
+                  f"{row.get('bound', '?'):>8s} "
+                  f"{100.0 * row['bytes'] / total_b:>6.1f}%")
+        line = (f"  total {d['total_bytes'] / 1024:.1f} KiB, "
+                f"{d['total_flops'] / 1e6:.3f} MFLOP; attributed "
+                f"{d.get('attributed_bytes_pct', '?')}% bytes / "
+                f"{d.get('attributed_flops_pct', '?')}% flops; "
+                f"memory-bound fraction {d.get('bound_fraction', '?')}; "
+                f"predicted {d.get('predicted_ms', 0):.4f} ms")
+        if d.get("measured_ms") is not None:
+            line += (f"; measured {d['measured_ms']} ms "
+                     f"({d.get('measured_source', '')}) — on a CPU host "
+                     f"the ratio is diagnostic only")
+        print(line)
+        if d.get("xla"):
+            print(f"  xla cost_analysis: flops {d['xla']['flops']:.4g}, "
+                  f"bytes accessed {d['xla']['bytes_accessed']:.4g}")
+        print()
 
 
 def live_doc():
@@ -155,7 +214,35 @@ def main(argv=None):
                     help="also write the report as JSON ('-' = stdout)")
     ap.add_argument("--prom", action="store_true",
                     help="print the Prometheus text exposition instead")
+    ap.add_argument("--roofline", action="store_true",
+                    help="render roofline reports (per-layer bytes/flops "
+                         "attribution) instead: from the dump's roofline "
+                         "records, or live from the gpt target with --demo")
     args = ap.parse_args(argv)
+
+    if args.roofline:
+        if args.demo:
+            reports = [live_roofline().to_dict()]
+        elif args.dump:
+            from paddle_tpu.observability import export
+            reports = export.load_jsonl(args.dump).get("rooflines", [])
+            if not reports:
+                print(f"obs_report: no roofline records in {args.dump} "
+                      f"(dump_jsonl(..., rooflines=[report]) writes them)",
+                      file=sys.stderr)
+                return 1
+        else:
+            ap.error("--roofline needs a JSONL dump path or --demo")
+        render_rooflines(reports)
+        if args.json:
+            payload = json.dumps({"rooflines": reports}, indent=1,
+                                 sort_keys=True)
+            if args.json == "-":
+                print(payload)
+            else:
+                with open(args.json, "w", encoding="utf-8") as fh:
+                    fh.write(payload + "\n")
+        return 0
 
     if args.demo:
         run_demo()

@@ -1,0 +1,307 @@
+#!/usr/bin/env python
+"""perfgate — machine-checked perf budgets from DETERMINISTIC cost models.
+
+BENCH wall-times depend on the host, the chip, and the claim being up —
+a CI gate can't block on them.  What IS stable run-to-run is the cost
+model: the roofline profiler's analytic bytes/flops per traced step
+(observability.profile), the shardlint liveness/padding estimates
+(analysis.cost_audit), and the serving engine's declared lifetime
+compile bound.  perfgate traces the flagship programs on CPU (no
+compile, no TPU claim), extracts those numbers, and compares them
+against the checked-in baseline (tools/perf_baseline.json) — so every
+future bytes/step optimization (ROADMAP item 5: bf16 activations,
+fused optimizer, Pallas LN) lands against a machine-checked budget
+instead of a hand-read bench log, and an accidental +20% bytes/step
+regression fails CI the day it lands.
+
+Every metric is lower-is-better.  `--check` fails on any metric above
+baseline * (1 + tolerance); improvements beyond tolerance are reported
+with a hint to re-baseline (ratcheting the budget down is a reviewed
+diff, like every other baseline in tools/).
+
+Usage:
+  python tools/perfgate.py                 # report current numbers
+  python tools/perfgate.py --check         # vs baseline, CI gate
+  python tools/perfgate.py --write-baseline
+  python tools/perfgate.py --json -        # machine-readable report
+  python tools/perfgate.py --targets gpt_hybrid_train
+
+Exit codes: 0 clean, 1 regressions (--check), 2 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# the gate is trace-only (shape-level): the CPU backend is always the
+# right one — a wedged TPU claim must never hang CI
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+DEFAULT_BASELINE = os.path.join(REPO, "tools", "perf_baseline.json")
+DEFAULT_TOLERANCE = 0.05
+
+
+# ------------------------------------------------------------- targets
+def build_gpt_train_step():
+    """The flagship hybrid-parallel train step — the SHARED builder
+    other tools profile the same program from (tools/obs_report.py
+    --roofline --demo, tests/test_profile.py), with the loss under an
+    explicit profile scope so its softmax/gather traffic is attributed
+    rather than bucketed <unattributed>."""
+    import numpy as np
+
+    import paddle_tpu as P
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt3_tiny
+    from paddle_tpu.observability import profile
+
+    P.seed(0)
+    cfg = gpt3_tiny()
+    model = GPTForCausalLM(cfg)
+    opt = P.optimizer.AdamW(learning_rate=1e-4,
+                            parameters=model.parameters())
+
+    @P.jit.to_static
+    def train_step(ids, labels):
+        opt.clear_grad()
+        logits = model(ids)
+        with profile.scope("loss"):
+            loss = F.cross_entropy(logits.reshape([-1, cfg.vocab_size]),
+                                   labels.reshape([-1]))
+        loss.backward()
+        opt.step()
+        return loss
+
+    rng = np.random.default_rng(0)
+    ids = P.to_tensor(rng.integers(0, cfg.vocab_size, (2, 32)),
+                      dtype="int64")
+    labels = P.to_tensor(rng.integers(0, cfg.vocab_size, (2, 32)),
+                         dtype="int64")
+    return train_step, ids, labels
+
+
+def gpt_roofline_report():
+    """(RooflineReport, CostReport) for the gpt hybrid train step —
+    shared by the gate metrics and the bench.py --worker-profile lane."""
+    from paddle_tpu.analysis.cost_audit import audit_memory
+    from paddle_tpu.observability import profile
+
+    train_step, ids, labels = build_gpt_train_step()
+    jaxpr, infos = train_step.traced_program(ids, labels)
+    report = profile.profile_traced(jaxpr, where="<gpt_hybrid_train>")
+    _findings, cost = audit_memory(jaxpr, where="<gpt_hybrid_train>",
+                                   inputs=infos)
+    return report, cost
+
+
+def target_gpt_hybrid_train():
+    report, cost = gpt_roofline_report()
+    return {
+        "bytes_per_step": report.total_bytes,
+        "flops_per_step": report.total_flops,
+        "unattributed_bytes_pct": round(
+            100.0 * (1.0 - report.frac_attributed_bytes), 2),
+        "unattributed_flops_pct": round(
+            100.0 * (1.0 - report.frac_attributed_flops), 2),
+        "padding_waste_pct": round(100.0 * cost.padding_waste, 2),
+        "peak_hbm_mb": round(cost.peak_hbm_bytes / (1 << 20), 3),
+    }
+
+
+def target_serving():
+    """The serving engine's whole program set: total/decode traffic from
+    the roofline cost model plus the engine's declared lifetime compile
+    bound — the number the bounded-compile contract lives or dies by."""
+    import paddle_tpu as P
+    from paddle_tpu import serving
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.observability import profile
+
+    P.seed(0)
+    mcfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                     num_heads=4, max_seq_len=128, dropout=0.0,
+                     attention_dropout=0.0)
+    engine = serving.LLMEngine(
+        GPTForCausalLM(mcfg),
+        serving.EngineConfig(max_num_seqs=4, page_size=8, max_model_len=64,
+                             prefill_buckets=(16, 32)))
+    try:
+        reports = profile.profile_engine(engine)
+        decode = reports.get("decode")
+        return {
+            "compile_bound": engine.config.compile_bound,
+            "decode_bytes_per_step": decode.total_bytes if decode else 0,
+            "programs_total_bytes": sum(r.total_bytes
+                                        for r in reports.values()),
+        }
+    finally:
+        engine.shutdown()
+
+
+TARGETS = {
+    "gpt_hybrid_train": target_gpt_hybrid_train,
+    "serving": target_serving,
+}
+
+
+def run_targets(names=None):
+    out = {}
+    for name in (names or sorted(TARGETS)):
+        if name not in TARGETS:
+            raise SystemExit(f"perfgate: unknown target {name!r} "
+                             f"(have: {', '.join(sorted(TARGETS))})")
+        out[name] = TARGETS[name]()
+    return out
+
+
+def bench_report():
+    """The bench.py --worker-profile lane: roofline headline numbers
+    merged into every BENCH report next to the measured wall-time
+    lanes."""
+    t0 = time.time()
+    report, cost = gpt_roofline_report()
+    return {
+        "profile_bytes_per_step": report.total_bytes,
+        "profile_flops_per_step": report.total_flops,
+        "profile_top_layer": report.top_layer,
+        "profile_bound_fraction": round(report.bound_fraction, 4),
+        "profile_attributed_bytes_pct": round(
+            100.0 * report.frac_attributed_bytes, 2),
+        "profile_padding_waste_pct": round(100.0 * cost.padding_waste, 2),
+        "profile_elapsed_s": round(time.time() - t0, 2),
+    }
+
+
+# --------------------------------------------------------------- gate
+def compare(current, baseline, tolerance):
+    """(regressions, improvements, notes) — every metric lower-is-
+    better; a metric present in the baseline but missing from the
+    current run is gate erosion and counts as a regression."""
+    regressions, improvements, notes = [], [], []
+    base_targets = baseline.get("targets", {})
+    for tname, base_metrics in sorted(base_targets.items()):
+        cur_metrics = current.get(tname)
+        if cur_metrics is None:
+            regressions.append((tname, "<target>", None, None,
+                                "target missing from current run"))
+            continue
+        for m, base in sorted(base_metrics.items()):
+            cur = cur_metrics.get(m)
+            where = f"{tname}.{m}"
+            if cur is None:
+                regressions.append((tname, m, base, None,
+                                    "metric missing (gate erosion)"))
+            elif base == 0:
+                if cur > 0:
+                    regressions.append((tname, m, base, cur,
+                                        "grew from a zero baseline"))
+            elif cur > base * (1.0 + tolerance):
+                regressions.append(
+                    (tname, m, base, cur,
+                     f"+{100.0 * (cur / base - 1.0):.1f}% over baseline "
+                     f"(tolerance {100.0 * tolerance:.0f}%)"))
+            elif cur < base * (1.0 - tolerance):
+                improvements.append(
+                    (tname, m, base, cur,
+                     f"-{100.0 * (1.0 - cur / base):.1f}% under baseline"))
+        for m in sorted(set(cur_metrics) - set(base_metrics)):
+            notes.append(f"{tname}.{m}: new metric (not gated yet — "
+                         f"--write-baseline to start gating it)")
+    for tname in sorted(set(current) - set(base_targets)):
+        notes.append(f"{tname}: new target (not gated yet)")
+    return regressions, improvements, notes
+
+
+# ----------------------------------------------------------------- CLI
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="perfgate", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--targets", nargs="*", default=None,
+                    help=f"targets to run (default: all — "
+                         f"{', '.join(sorted(TARGETS))})")
+    ap.add_argument("--check", action="store_true",
+                    help="compare against the baseline; exit 1 on any "
+                         "regression beyond tolerance")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write the current numbers as the new baseline")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline path (default tools/perf_baseline.json)")
+    ap.add_argument("--tolerance", type=float, default=None,
+                    help="relative slack before a metric regresses "
+                         f"(default: baseline's, else {DEFAULT_TOLERANCE})")
+    ap.add_argument("--json", metavar="FILE", default=None,
+                    help="also write the report as JSON ('-' = stdout)")
+    args = ap.parse_args(argv)
+
+    t0 = time.time()
+    current = run_targets(args.targets)
+    elapsed = time.time() - t0
+
+    for tname, metrics in sorted(current.items()):
+        print(f"== {tname}")
+        for m, v in sorted(metrics.items()):
+            print(f"   {m:28s} {v}")
+
+    doc = {"tool": "perfgate", "version": 1, "elapsed_s": round(elapsed, 2),
+           "targets": current}
+
+    rc = 0
+    if args.write_baseline:
+        base_doc = {"tool": "perfgate", "version": 1,
+                    "tolerance": (args.tolerance
+                                  if args.tolerance is not None
+                                  else DEFAULT_TOLERANCE),
+                    "targets": current}
+        with open(args.baseline, "w", encoding="utf-8") as fh:
+            json.dump(base_doc, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"perfgate: baseline written to {args.baseline}")
+    elif args.check:
+        try:
+            with open(args.baseline, encoding="utf-8") as fh:
+                baseline = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"perfgate: cannot read baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2
+        tol = (args.tolerance if args.tolerance is not None
+               else baseline.get("tolerance", DEFAULT_TOLERANCE))
+        regressions, improvements, notes = compare(current, baseline, tol)
+        doc["regressions"] = [
+            {"target": t, "metric": m, "baseline": b, "current": c,
+             "why": why} for t, m, b, c, why in regressions]
+        for t, m, b, c, why in regressions:
+            print(f"REGRESSION {t}.{m}: {b} -> {c} ({why})")
+        for t, m, b, c, why in improvements:
+            print(f"improved   {t}.{m}: {b} -> {c} ({why}) — consider "
+                  f"--write-baseline to ratchet the budget")
+        for n in notes:
+            print(f"note       {n}")
+        if regressions:
+            print(f"perfgate: FAILED ({len(regressions)} regression(s) "
+                  f"vs {os.path.relpath(args.baseline, REPO)})")
+            rc = 1
+        else:
+            print(f"perfgate: clean vs "
+                  f"{os.path.relpath(args.baseline, REPO)} "
+                  f"(tolerance {100.0 * tol:.0f}%)")
+
+    if args.json:
+        payload = json.dumps(doc, indent=1, sort_keys=True)
+        if args.json == "-":
+            print(payload)
+        else:
+            with open(args.json, "w", encoding="utf-8") as fh:
+                fh.write(payload + "\n")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
