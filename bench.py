@@ -51,6 +51,7 @@ RACELINT_S = 90
 OBS_S = 150
 RESIL_S = 150
 PROFILE_S = 150
+REMAT_S = 150
 CPU_TIMEOUT_S = 150
 CAPTURE_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), ".bench_capture_tpu.json")
@@ -576,6 +577,27 @@ def worker_profile():
     return 0
 
 
+def worker_remat():
+    """Remat lane: remat-on vs remat-off bytes/step from the
+    deterministic cost model (tools/perfgate.remat_report) — the honest
+    replacement for the resnet lane's bare "remat" bool.  Pure CPU
+    trace, never touches the TPU claim; merged into every BENCH report
+    (incl. the cached-capture path, with stale-key eviction)."""
+    _init_backend()   # honors PTPU_FORCE_CPU (always set for this lane)
+    tools_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools")
+    sys.path.insert(0, tools_dir)
+    try:
+        import perfgate
+        out = perfgate.remat_report()
+    finally:
+        # remove by value: importing tools/perfgate.py prepends its own
+        # REPO entry, so pop(0) would evict the wrong path
+        sys.path.remove(tools_dir)
+    print(json.dumps(out), flush=True)
+    return 0
+
+
 def worker_racelint():
     """Static-analysis lane #2: racelint's host-concurrency audit of
     the whole package (finding count + per-rule breakdown).  Pure
@@ -760,11 +782,35 @@ def _read_last_json(path):
     return None
 
 
+def _kill_process_group(proc):
+    """SIGKILL `proc`'s whole process group (it was spawned with
+    start_new_session, so its pid IS the pgid and any children die with
+    it).  Returns True when the group was signalled.  ONLY the probe
+    uses this: a probe that missed its deadline is wedged INSIDE device
+    init — nothing was dispatched, so killing it cannot wedge an active
+    computation the way killing a mid-step worker does — and BENCH_r05
+    showed the abandoned-probe path leaking a live python holding the
+    claim indefinitely ("abandoned after 60s (left running, not
+    killed)").  Deadlined WORKERS stay abandoned, never killed."""
+    import signal
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (OSError, ProcessLookupError):
+        return False
+    try:
+        proc.wait(timeout=5)
+    except Exception:
+        pass
+    return True
+
+
 def _await_json(proc, deadline_s):
     """Poll `proc` until it exits or the deadline passes. On deadline the
     process is ABANDONED (detached via start_new_session), NEVER killed —
     killing a TPU-claim-holding python wedges the claim for hours. Any
-    JSON the worker printed before the deadline is still used.
+    JSON the worker printed before the deadline is still used.  (The
+    one exception is the PROBE, which main() kills via
+    _kill_process_group — see its rationale.)
 
     Returns (result, err, exited): `exited` False means the worker is
     STILL RUNNING (abandoned) — it may still hold the TPU claim, so no
@@ -882,6 +928,8 @@ def main():
         return worker_obs()
     if "--worker-profile" in sys.argv:
         return worker_profile()
+    if "--worker-remat" in sys.argv:
+        return worker_remat()
     if "--worker-resilience" in sys.argv:
         return worker_resilience()
     if "--probe" in sys.argv:
@@ -898,9 +946,19 @@ def main():
     obs_proc = _spawn("--worker-obs", force_cpu=True)
     resil_proc = _spawn("--worker-resilience", force_cpu=True)
     prof_proc = _spawn("--worker-profile", force_cpu=True)
+    remat_proc = _spawn("--worker-remat", force_cpu=True)
 
-    probe_res, probe_err, _ = _await_json(
-        _spawn("--probe", force_cpu=False), PROBE_BUDGET_S)
+    probe_proc = _spawn("--probe", force_cpu=False)
+    probe_res, probe_err, probe_exited = _await_json(
+        probe_proc, PROBE_BUDGET_S)
+    if probe_res is None and not probe_exited:
+        # a deadlined probe is wedged in device init and would otherwise
+        # keep the claim forever (the BENCH_r05 leak) — kill its whole
+        # process group and say so in the report
+        if _kill_process_group(probe_proc):
+            merged["probe_killed"] = True
+            probe_err = (f"{probe_err or 'probe timed out'}; "
+                         "probe process group killed")
 
     sl_res, sl_err, _ = _await_json(sl_proc, SHARDLINT_S)
     if sl_res is not None:
@@ -941,6 +999,14 @@ def main():
         # same rationale: a cost-model lane failure degrades only this
         # lane's keys, never the measurement run's status
         merged["profile_error"] = str(prof_err)
+
+    remat_res, remat_err, _ = _await_json(remat_proc, REMAT_S)
+    if remat_res is not None:
+        merged.update(remat_res)
+    else:
+        # same rationale: the remat cost-model lane failing degrades
+        # only its own keys
+        merged["remat_error"] = str(remat_err)
     tpu_ok = bool(probe_res
                   and (probe_res.get("ok") or probe_res.get("probe_ok"))
                   and probe_res.get("platform") != "cpu")
@@ -971,6 +1037,11 @@ def main():
         _adopt_lane("resilience_", "resilience_ckpt_write_ms",
                     resil_err)
         _adopt_lane("profile_", "profile_bytes_per_step", prof_err)
+        _adopt_lane("remat_", "remat_bytes_saved_pct", remat_err)
+        if merged.get("probe_killed"):
+            # the fallback note must record that the leaked probe was
+            # reaped — the next run starts against a clean claim
+            cached["probe_killed"] = True
         cached["live"] = False
         cached["note"] = (
             f"{reason} — reporting most recent full on-silicon capture "

@@ -1,3 +1,6 @@
 from paddle_tpu.amp.auto_cast import amp_guard, auto_cast, decorate  # noqa: F401
 from paddle_tpu.amp.grad_scaler import GradScaler  # noqa: F401
 from paddle_tpu.amp import debugging  # noqa: F401
+from paddle_tpu.amp.policy import (ActivationPolicy,  # noqa: F401
+                                   activation_residency, current_policy,
+                                   remat_active, residency_dtype)

@@ -1,0 +1,149 @@
+"""bf16 activation-residency + remat policy for to_static training.
+
+BENCH_r05 pinned the train step at ~98.5% HBM bandwidth — bytes, not
+flops, are the lever — and the PR 8 roofline attributed the biggest
+activation rows to f32-resident tensors that only ever feed bf16
+compute.  This module is the storage half of the fix:
+
+- **activation residency** — under an :class:`ActivationPolicy` with a
+  ``dtype``, every ``nn.Layer`` boundary casts f32 floating activation
+  inputs down to the residency dtype (one ``convert_element_type`` at
+  the FIRST boundary; downstream layers see the dtype and keep it).
+  Parameters are untouched — they stay f32 master weights, consumed
+  through the existing ``amp.auto_cast`` O1 white-list downcasts, and
+  the optimizer's f32 update math still reads them at full precision
+  (which is also what keeps shardlint SL303 quiet: a param with a
+  non-convert consumer is stored f32 on purpose).
+- **remat policy** — ``remat=True`` turns on per-block recomputation
+  (the model's existing ``distributed.recompute`` units) for the whole
+  traced step; ``remat="bf16"`` additionally stores the checkpointed
+  region's boundary activations in bf16, so the only live copies of
+  the residual stream between forward and backward are half-size.
+
+The policy is trace-scoped, never global: ``to_static(amp_policy=...,
+remat=...)`` pushes it for exactly the wrapped function's trace (and
+every re-trace), composing with dy2static — eager calls and other
+StaticFunctions are unaffected.  ``activation_residency(...)`` is the
+same thing as a context manager for eager experiments.
+
+Numerics contract (tested in tests/test_bytesopt.py, documented in
+docs/performance_guide.md): params and optimizer math stay f32; the
+bf16 activations bound the loss drift — the 20-step gpt-tiny
+trajectory stays within the documented tolerance of the f32 run, and
+the serving path (which never enables the policy) is token-identical.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+__all__ = ["ActivationPolicy", "activation_residency", "current_policy",
+           "remat_active", "residency_dtype"]
+
+_tls = threading.local()
+
+
+class ActivationPolicy:
+    """One trace's mixed-precision storage policy.
+
+    ``dtype``: residency dtype activations are cast to at Layer
+    boundaries (None = leave activations alone).  ``remat``: False
+    (off), True (recompute blocks, save f32 boundaries), or ``"bf16"``
+    (recompute blocks, save bf16 boundaries).
+    """
+
+    __slots__ = ("dtype", "remat")
+
+    def __init__(self, dtype="bfloat16", remat=False):
+        if dtype is None:
+            self.dtype = None
+        elif str(dtype) in ("bf16", "bfloat16"):
+            self.dtype = jnp.bfloat16
+        elif str(dtype) in ("fp16", "float16"):
+            self.dtype = jnp.float16
+        else:
+            # a typo ("bp16") or an unsupported request ("float32")
+            # must not silently become fp16 residency
+            raise ValueError(
+                "activation residency dtype must be None, 'bf16'/"
+                f"'bfloat16' or 'fp16'/'float16'; got {dtype!r}")
+        if remat not in (False, True, "bf16"):
+            raise ValueError(
+                f"remat must be False, True or 'bf16'; got {remat!r}")
+        self.remat = remat
+
+    # ---- hooks the framework calls ----
+    def cast_value(self, v):
+        """Residency cast for one raw array: f32 floating -> dtype."""
+        if self.dtype is not None and getattr(v, "dtype", None) == \
+                jnp.float32:
+            return v.astype(self.dtype)
+        return v
+
+    def cast_input(self, t):
+        """Layer-boundary cast for one positional input (Tensor-aware,
+        differentiable — the convert is a recorded op so gradients flow
+        back through it)."""
+        from paddle_tpu.core.tensor import Tensor
+        if self.dtype is None or not isinstance(t, Tensor):
+            return t
+        if t._value.dtype == jnp.float32:
+            return t.astype(self.dtype)
+        return t
+
+    def cast_saved(self, v):
+        """Storage cast for a recompute region's saved boundary value:
+        active only under ``remat="bf16"`` (f32 floating arrays only —
+        params lifted into the region are never narrowed)."""
+        if self.remat == "bf16" and getattr(v, "dtype", None) == \
+                jnp.float32:
+            return v.astype(jnp.bfloat16)
+        return v
+
+    def __repr__(self):
+        return (f"ActivationPolicy(dtype={self.dtype}, "
+                f"remat={self.remat!r})")
+
+
+def current_policy():
+    """The ActivationPolicy active on this thread, or None."""
+    return getattr(_tls, "policy", None)
+
+
+def residency_dtype():
+    """The active residency dtype, or None when no policy (or a
+    remat-only policy) is active."""
+    pol = current_policy()
+    return pol.dtype if pol is not None else None
+
+
+def remat_active():
+    """The active policy's remat mode (False / True / "bf16")."""
+    pol = current_policy()
+    return pol.remat if pol is not None else False
+
+
+@contextlib.contextmanager
+def activation_residency(dtype="bfloat16", remat=False):
+    """Context manager form of the policy: push an
+    :class:`ActivationPolicy` (plus the matching ``amp.auto_cast`` O1
+    white-list downcasts when a residency dtype is set) for the dynamic
+    extent.  ``to_static(amp_policy=..., remat=...)`` enters this
+    around every trace of the wrapped function."""
+    from paddle_tpu.amp.auto_cast import auto_cast
+    pol = dtype if isinstance(dtype, ActivationPolicy) else \
+        ActivationPolicy(dtype, remat=remat)
+    prev = getattr(_tls, "policy", None)
+    _tls.policy = pol
+    try:
+        if pol.dtype is not None:
+            with auto_cast(enable=True, level="O1",
+                           dtype="bfloat16" if pol.dtype == jnp.bfloat16
+                           else "float16"):
+                yield pol
+        else:
+            yield pol
+    finally:
+        _tls.policy = prev

@@ -8,10 +8,23 @@ rematerialized region in both eager-tape and to_static modes.
 """
 from __future__ import annotations
 
+import threading
+
 import jax
 
 from paddle_tpu.core.dispatch import apply
 from paddle_tpu.core.tensor import Tensor
+
+_rc_tls = threading.local()
+
+
+def recompute_active():
+    """True while a recompute region's forward (or backward re-run) is
+    executing on this thread — the guard ``Layer.__call__`` uses so a
+    per-Layer ``enable_recompute`` can wrap through ``recompute(self,
+    ...)`` without recursing, and nested remat layers are not
+    re-wrapped (the outermost region wins)."""
+    return getattr(_rc_tls, "depth", 0) > 0
 
 
 def _owner_layer(function):
@@ -57,6 +70,7 @@ def recompute(function, *args, **kwargs):
     # pulls the cotangent through it.
     def inner(arg_vals, state_vals):
         saved = [(t._value, t._version, t._node, t.stop_gradient) for t in state]
+        _rc_tls.depth = getattr(_rc_tls, "depth", 0) + 1
         try:
             for t, v in zip(state, state_vals):
                 t._value = v
@@ -86,6 +100,7 @@ def recompute(function, *args, **kwargs):
             new_buf = tuple(t._value for t in buffers)
             return outs + new_buf
         finally:
+            _rc_tls.depth -= 1
             for t, (v, ver, node, sg) in zip(state, saved):
                 t._value = v
                 t._version = ver
@@ -98,11 +113,30 @@ def recompute(function, *args, **kwargs):
 
     def ckpt_fwd(arg_vals, state_vals):
         # residuals = the region's INPUTS only — the jax.checkpoint
-        # memory contract
-        return inner(arg_vals, state_vals), (arg_vals, state_vals)
+        # memory contract.  Under an amp remat="bf16" policy the saved
+        # ACTIVATION boundaries narrow to bf16 (the only live copies of
+        # the residual stream between forward and backward are then
+        # half-size); lifted params/buffers are never narrowed — they
+        # are the master weights.
+        from paddle_tpu.amp.policy import current_policy
+        pol = current_policy()
+        saved_args = arg_vals
+        if pol is not None and pol.remat == "bf16":
+            saved_args = [pol.cast_saved(v) for v in arg_vals]
+        # scalar zero protos carry the primal dtypes to the bwd rule
+        # (residual leaves must be jax values, not dtype objects)
+        protos = [jax.numpy.zeros((), v.dtype) for v in arg_vals]
+        return inner(arg_vals, state_vals), \
+            (saved_args, state_vals, protos)
 
     def ckpt_bwd(res, ct):
-        arg_vals, state_vals = res
+        saved_args, state_vals, protos = res
+        # bf16-saved boundaries are cast back up before the re-run so
+        # the rematerialized region (and its cotangent structure)
+        # matches the forward's dtypes exactly — the precision loss is
+        # confined to the saved boundary value's bf16 round-trip
+        arg_vals = [v.astype(p.dtype) if v.dtype != p.dtype else v
+                    for v, p in zip(saved_args, protos)]
         # barrier: without it XLA CSEs the re-run against the forward's
         # values and silently un-remats the region
         arg_vals, state_vals = jax.lax.optimization_barrier(

@@ -120,8 +120,18 @@ class StaticFunction:
     """Callable wrapper produced by @to_static."""
 
     def __init__(self, function, input_spec=None, build_strategy=None,
-                 backend=None, donate_state=True, check=False, audit=False):
+                 backend=None, donate_state=True, check=False, audit=False,
+                 amp_policy=None, remat=None):
         self._raw_function = function
+        # trace-scoped mixed-precision storage policy (amp/policy.py):
+        # amp_policy="bf16" casts f32 activations to bf16 at Layer
+        # boundaries (params stay f32 master weights) and enables the
+        # O1 white-list downcasts; remat=True/"bf16" turns on the
+        # model's recompute units ("bf16" also narrows saved boundary
+        # activations).  Pushed around EVERY trace of this function —
+        # eager code and other StaticFunctions never see it.
+        self._amp_policy = amp_policy
+        self._remat = remat
         # opt-in tracelint (analysis/): AST pass now, jaxpr pass at the
         # first compile of each signature — findings surface as
         # TracelintWarning instead of opaque trace-time errors
@@ -172,7 +182,14 @@ class StaticFunction:
                 for s in static_leaves:
                     leaves.append(Tensor(next(ti)) if s is _ARRAY else s)
                 args, kwargs = _tree.tree_unflatten(in_treedef, leaves)
-                out = fn(*args, **kwargs)
+                if self._amp_policy or self._remat:
+                    from paddle_tpu.amp.policy import activation_residency
+                    with activation_residency(
+                            self._amp_policy if self._amp_policy
+                            else None, remat=self._remat or False):
+                        out = fn(*args, **kwargs)
+                else:
+                    out = fn(*args, **kwargs)
                 from paddle_tpu.jit.dy2static import UNDEF as _UNDEF
                 out_leaves, out_treedef = _tree.tree_flatten(out, is_leaf=_is_tensor)
                 if any(o is _UNDEF for o in out_leaves):
@@ -427,7 +444,8 @@ def _hashable(x):
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, check=False, audit=False, **kwargs):
+              backend=None, check=False, audit=False, amp_policy=None,
+              remat=None, **kwargs):
     """Decorator/wrapper: compile a dygraph function or Layer to one XLA program.
 
     Usage matches paddle.jit.to_static: bare decorator, decorator with
@@ -443,17 +461,25 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     jaxpr at first compile.  Findings surface as ``ShardlintWarning``
     and the latest :class:`analysis.CostReport` (estimated peak HBM,
     MXU padding waste) is kept on ``fn.last_audit``.
+
+    ``amp_policy="bf16"`` enables bf16 activation residency for the
+    traced step (params stay f32 master weights); ``remat=True`` /
+    ``remat="bf16"`` turns on the model's recompute units, the latter
+    saving boundary activations in bf16.  Both are trace-scoped — see
+    paddle_tpu/amp/policy.py and docs/performance_guide.md.
     """
     from paddle_tpu.nn.layer.layers import Layer
 
     def wrap(fn):
         if isinstance(fn, Layer):
             static = StaticFunction(fn.forward, input_spec, check=check,
-                                    audit=audit)
+                                    audit=audit, amp_policy=amp_policy,
+                                    remat=remat)
             fn.forward = static
             fn._static_forward = static
             return fn
-        return StaticFunction(fn, input_spec, check=check, audit=audit)
+        return StaticFunction(fn, input_spec, check=check, audit=audit,
+                              amp_policy=amp_policy, remat=remat)
 
     if function is not None:
         return wrap(function)

@@ -35,7 +35,8 @@ class GPTConfig:
                  num_heads=16, ffn_hidden_size=None, max_seq_len=2048,
                  dropout=0.1, attention_dropout=0.1, initializer_range=0.02,
                  layer_norm_epsilon=1e-5, use_recompute=False,
-                 tie_word_embeddings=True, moe_num_experts=0, moe_top_k=2,
+                 tie_word_embeddings=True, fused_ln=False,
+                 moe_num_experts=0, moe_top_k=2,
                  moe_every=2, moe_gate="gshard", moe_ep_axis="ep",
                  moe_capacity_factor=(2.0, 2.0)):
         self.vocab_size = vocab_size
@@ -50,6 +51,13 @@ class GPTConfig:
         self.layer_norm_epsilon = layer_norm_epsilon
         self.use_recompute = use_recompute
         self.tie_word_embeddings = tie_word_embeddings
+        # fused_ln=True routes the block's norms through the Pallas
+        # fused LN kernels (ops/pallas/norm.py): ln1/final_ln as plain
+        # fused layernorm, ln2 as the fused residual-add+LN whose
+        # custom VJP recomputes the normalized intermediate instead of
+        # materializing it.  Pure-JAX numerics on CPU via interpret
+        # mode; opt-in per model (docs/performance_guide.md).
+        self.fused_ln = fused_ln
         # MoE (GShard-style; reference incubate.distributed.models.moe):
         # every `moe_every`-th decoder block swaps its dense FFN for
         # `moe_num_experts` experts sharded over the `moe_ep_axis` mesh axis
@@ -167,11 +175,14 @@ class GPTDecoderLayer(nn.Layer):
 
     def __init__(self, config: GPTConfig, layer_idx: int = 0):
         super().__init__()
+        self._fused_ln = config.fused_ln
         self.ln1 = nn.LayerNorm(config.hidden_size,
-                                epsilon=config.layer_norm_epsilon)
+                                epsilon=config.layer_norm_epsilon,
+                                fused=config.fused_ln or None)
         self.attn = GPTAttention(config)
         self.ln2 = nn.LayerNorm(config.hidden_size,
-                                epsilon=config.layer_norm_epsilon)
+                                epsilon=config.layer_norm_epsilon,
+                                fused=config.fused_ln or None)
         use_moe = (config.moe_num_experts > 0
                    and (layer_idx + 1) % config.moe_every == 0)
         if use_moe:
@@ -190,6 +201,21 @@ class GPTDecoderLayer(nn.Layer):
         self.dropout = nn.Dropout(config.dropout)
 
     def forward(self, x, kv_ctx=None):
+        if self._fused_ln:
+            # fused residual-add + ln2: the attn sublayer's residual add
+            # and the second norm collapse into ONE kernel whose HBM
+            # traffic is its call boundary (x, attn_out, w, b -> stream,
+            # normed) — the normalized intermediate is recomputed by the
+            # custom VJP, never materialized.  Run under an explicit
+            # "ln2" scope so the roofline row keeps its pre-fusion name.
+            from paddle_tpu.observability import profile as _prof
+            a = self.dropout(self.attn(self.ln1(x), kv_ctx=kv_ctx))
+            with _prof.scope("ln2"):
+                x, h2 = F.fused_ln_residual(
+                    a, x, self.ln2.weight, self.ln2.bias,
+                    self.ln2._epsilon, fused=True)
+            x = x + self.dropout(self.mlp(h2))
+            return _constrain(x, "dp", "sp", None)
         x = x + self.dropout(self.attn(self.ln1(x), kv_ctx=kv_ctx))
         x = x + self.dropout(self.mlp(self.ln2(x)))
         return _constrain(x, "dp", "sp", None)
@@ -204,19 +230,25 @@ class GPTModel(nn.Layer):
             [GPTDecoderLayer(config, layer_idx=i)
              for i in range(config.num_layers)])
         self.final_ln = nn.LayerNorm(config.hidden_size,
-                                     epsilon=config.layer_norm_epsilon)
+                                     epsilon=config.layer_norm_epsilon,
+                                     fused=config.fused_ln or None)
 
     def forward(self, input_ids, position_ids=None, kv_ctx=None):
+        from paddle_tpu.amp.policy import remat_active
         h = self.embeddings(input_ids, position_ids)
-        if kv_ctx is not None and self.config.use_recompute and \
-                self.training:
+        # the model's declared recompute units are its decoder blocks:
+        # config.use_recompute turns them on statically, an ambient
+        # to_static(remat=...) policy turns them on for that trace only
+        use_rc = (self.config.use_recompute or bool(remat_active())) \
+            and self.training
+        if kv_ctx is not None and use_rc:
             # silently skipping the cache hook would leave the paged
             # pools unwritten and decode over garbage — fail loudly
             raise RuntimeError(
                 "kv_ctx serving forward requires eval mode (recompute "
                 "is active): call model.eval() before serving")
         for layer in self.layers:
-            if self.config.use_recompute and self.training:
+            if use_rc:
                 h = recompute(layer, h)
             elif kv_ctx is not None:
                 h = layer(h, kv_ctx=kv_ctx)

@@ -19,12 +19,15 @@ from paddle_tpu.nn.functional.input import embedding, gather_tree, one_hot  # no
 from paddle_tpu.nn.functional.loss import *  # noqa: F401,F403
 from paddle_tpu.nn.functional.norm import (  # noqa: F401
     batch_norm,
+    fused_ln_residual,
+    fused_norm_enabled,
     group_norm,
     instance_norm,
     layer_norm,
     local_response_norm,
     normalize,
     rms_norm,
+    set_fused_norm,
     spectral_norm,
 )
 from paddle_tpu.nn.functional.pooling import *  # noqa: F401,F403

@@ -84,23 +84,59 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     return apply(fn_eval, x, running_mean, running_var, weight, bias)
 
 
-def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
+# opt-in global flag for the Pallas fused-norm paths off-TPU (CPU runs
+# them in interpret mode — same numerics, and whole-program cost models
+# see the fused call boundary instead of the op-by-op composition).
+# On TPU the fused path is the default regardless.  Per-call `fused=`
+# (and nn.LayerNorm(fused=...)) overrides in either direction.
+_FUSED_NORM = [False]
+
+
+def set_fused_norm(flag=True):
+    """Globally enable/disable the Pallas fused LN/RMS-norm paths off
+    TPU; returns the previous value (docs/performance_guide.md,
+    "Cutting bytes/step")."""
+    prev = _FUSED_NORM[0]
+    _FUSED_NORM[0] = bool(flag)
+    return prev
+
+
+def fused_norm_enabled():
+    return _FUSED_NORM[0]
+
+
+def _use_fused(fused):
+    if fused is not None:
+        return bool(fused)
+    if _FUSED_NORM[0]:
+        return True
+    try:
+        from paddle_tpu.ops.pallas import on_tpu
+        return on_tpu()
+    except Exception:
+        return False
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
+               name=None, fused=None):
     if isinstance(normalized_shape, int):
         normalized_shape = (normalized_shape,)
     nd = len(tuple(normalized_shape))
 
-    if nd == 1:
-        # last-axis layernorm: fused Pallas kernel on TPU (custom VJP)
+    if nd == 1 and _use_fused(fused):
+        # last-axis layernorm: fused Pallas kernel (custom VJP whose
+        # backward recomputes the stats; interpret mode off-TPU)
         try:
-            from paddle_tpu.ops.pallas.norm import _on_tpu, fused_layer_norm
-            if _on_tpu():
-                return apply(lambda v, w, b: fused_layer_norm(
-                    v, w, b, epsilon), x, weight, bias)
+            from paddle_tpu.ops.pallas.norm import fused_layer_norm
+            return apply(lambda v, w, b: fused_layer_norm(
+                v, w, b, epsilon), x, weight, bias)
         except Exception:
             pass
 
     def fn(v, w, b):
         from paddle_tpu.amp.auto_cast import downcast_inputs
+        from paddle_tpu.amp.policy import residency_dtype
+        orig_dtype = v.dtype
         (v,) = downcast_inputs(v, opname="layer_norm")
         axes = tuple(range(v.ndim - nd, v.ndim))
         mean = jnp.mean(v, axis=axes, keepdims=True)
@@ -110,8 +146,48 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=
             out = out * w
         if b is not None:
             out = out + b
+        # bf16 activation residency: the blacklist upcast computed the
+        # norm in f32 for stability, but STORING the result f32 is what
+        # shardlint SL303 flags — under a policy the output returns to
+        # the residency-dtype stream
+        if residency_dtype() is not None and out.dtype != orig_dtype:
+            out = out.astype(orig_dtype)
         return out
     return apply(fn, x, weight, bias)
+
+
+def fused_ln_residual(x, residual, weight=None, bias=None, epsilon=1e-5,
+                      act=None, name=None, fused=None):
+    """``h = x + residual; y = act(LN(h))`` in one pass, returning
+    ``(h, y)`` — the residual-stream update and the next sublayer's
+    normalized input.  On the fused path (Pallas kernel, interpret mode
+    off-TPU) the custom VJP recomputes the normalized intermediate in
+    backward instead of materializing it; the pure-JAX composition is
+    the fallback (weight-free norms always use it).  ``act`` is None or
+    ``"gelu"`` (tanh approximation)."""
+    if _use_fused(fused) and weight is not None:
+        try:
+            from paddle_tpu.ops.pallas.norm import (
+                fused_ln_residual as _pallas_ln_res)
+            return apply(lambda a, r, w, b: _pallas_ln_res(
+                a, r, w, b, epsilon, act), x, residual, weight, bias)
+        except Exception:
+            pass
+
+    def fn(a, r, w, b):
+        h = a + r
+        hf = h.astype(jnp.float32)
+        mean = jnp.mean(hf, axis=-1, keepdims=True)
+        var = jnp.var(hf, axis=-1, keepdims=True)
+        out = (hf - mean) / jnp.sqrt(var + epsilon)
+        if w is not None:
+            out = out * w.astype(jnp.float32)
+        if b is not None:
+            out = out + b.astype(jnp.float32)
+        if act == "gelu":
+            out = jax.nn.gelu(out, approximate=True)
+        return h, out.astype(h.dtype)
+    return apply(fn, x, residual, weight, bias)
 
 
 def instance_norm(x, running_mean=None, running_var=None, weight=None,
@@ -177,14 +253,15 @@ def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
     return apply(fn, x)
 
 
-def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+def rms_norm(x, weight=None, epsilon=1e-6, name=None, fused=None):
     """RMSNorm (TPU-friendly LLM building block; also via pallas kernel)."""
-    try:
-        from paddle_tpu.ops.pallas.norm import _on_tpu, fused_rms_norm
-        if _on_tpu():
-            return apply(lambda v, w: fused_rms_norm(v, w, epsilon), x, weight)
-    except Exception:
-        pass
+    if _use_fused(fused):
+        try:
+            from paddle_tpu.ops.pallas.norm import fused_rms_norm
+            return apply(lambda v, w: fused_rms_norm(v, w, epsilon),
+                         x, weight)
+        except Exception:
+            pass
 
     def fn(v, w):
         ms = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=-1, keepdims=True)

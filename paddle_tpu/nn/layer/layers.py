@@ -310,7 +310,37 @@ class Layer:
     def forward(self, *inputs, **kwargs):
         raise NotImplementedError
 
+    def enable_recompute(self, mode=True):
+        """Per-Layer remat selection (ROADMAP item 5, bytes half):
+        ``True`` recomputes this layer's forward in backward whenever it
+        trains under gradients; ``"auto"`` only when an ambient
+        ``amp`` remat policy is active (``to_static(remat=...)``);
+        ``False`` turns it off.  Boundary activations are saved in bf16
+        under ``remat="bf16"`` (see amp/policy.py).  Nested remat is
+        not re-wrapped — the outermost recompute region wins."""
+        if mode not in (True, False, "auto"):
+            raise ValueError(f"mode must be True/False/'auto', got {mode!r}")
+        self.__dict__["_remat_mode"] = mode
+        return self
+
     def __call__(self, *inputs, **kwargs):
+        mode = self.__dict__.get("_remat_mode")
+        if mode and self.training:
+            from paddle_tpu.amp import policy as _amppol
+            from paddle_tpu.core import engine as _engine
+            from paddle_tpu.distributed.recompute import (recompute,
+                                                          recompute_active)
+            if _engine.is_grad_enabled() and not recompute_active() \
+                    and (mode is True or _amppol.remat_active()):
+                return recompute(self, *inputs, **kwargs)
+        from paddle_tpu.amp.policy import current_policy as _cur_policy
+        pol = _cur_policy()
+        if pol is not None and pol.dtype is not None:
+            # bf16 activation residency: the f32->bf16 convert happens at
+            # the FIRST layer boundary an f32 activation crosses; every
+            # layer downstream sees bf16 and keeps it (params are not
+            # inputs here and stay f32 master weights)
+            inputs = tuple(pol.cast_input(t) for t in inputs)
         for hook in list(self._forward_pre_hooks.values()):
             result = hook(self, inputs)
             if result is not None:

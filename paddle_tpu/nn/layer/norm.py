@@ -136,12 +136,16 @@ class SyncBatchNorm(_BatchNormBase):
 
 class LayerNorm(Layer):
     def __init__(self, normalized_shape, epsilon=1e-05, weight_attr=None,
-                 bias_attr=None, name=None):
+                 bias_attr=None, name=None, fused=None):
         super().__init__()
         if isinstance(normalized_shape, int):
             normalized_shape = [normalized_shape]
         self._normalized_shape = list(normalized_shape)
         self._epsilon = epsilon
+        # fused=True routes through the Pallas fused LN kernel even off
+        # TPU (interpret mode), False forces the pure-JAX composition,
+        # None follows F.set_fused_norm / the platform default
+        self._fused = fused
         self.weight = None
         self.bias = None
         if weight_attr is not False:
@@ -156,22 +160,25 @@ class LayerNorm(Layer):
 
     def forward(self, input):
         return F.layer_norm(input, self._normalized_shape, self.weight,
-                            self.bias, self._epsilon)
+                            self.bias, self._epsilon, fused=self._fused)
 
 
 class RMSNorm(Layer):
     """TPU-friendly RMSNorm (used by LLM blocks; pallas fused kernel backs
     the hot path)."""
 
-    def __init__(self, hidden_size, epsilon=1e-6, weight_attr=None, name=None):
+    def __init__(self, hidden_size, epsilon=1e-6, weight_attr=None,
+                 name=None, fused=None):
         super().__init__()
         self._epsilon = epsilon
+        self._fused = fused
         self.weight = self.create_parameter(
             shape=[hidden_size], attr=weight_attr,
             default_initializer=I.Constant(1.0))
 
     def forward(self, input):
-        return F.rms_norm(input, self.weight, self._epsilon)
+        return F.rms_norm(input, self.weight, self._epsilon,
+                          fused=self._fused)
 
 
 class GroupNorm(Layer):

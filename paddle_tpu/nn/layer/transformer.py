@@ -90,11 +90,15 @@ def _get_activation(name):
 class TransformerEncoderLayer(Layer):
     def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
                  activation="relu", attn_dropout=None, act_dropout=None,
-                 normalize_before=False, weight_attr=None, bias_attr=None):
+                 normalize_before=False, weight_attr=None, bias_attr=None,
+                 fused_ln=False):
         super().__init__()
         attn_dropout = dropout if attn_dropout is None else attn_dropout
         act_dropout = dropout if act_dropout is None else act_dropout
         self.normalize_before = normalize_before
+        # fused_ln=True collapses each post-LN residual join into the
+        # Pallas fused_ln_residual kernel (interpret mode off-TPU)
+        self._fused_ln = fused_ln
         self.self_attn = MultiHeadAttention(d_model, nhead, attn_dropout,
                                             weight_attr=weight_attr,
                                             bias_attr=bias_attr)
@@ -115,17 +119,21 @@ class TransformerEncoderLayer(Layer):
             src = self.self_attn(src, src, src, src_mask)
         else:
             src, cache = self.self_attn(src, src, src, src_mask, cache)
-        src = residual + self.dropout1(src)
         if not self.normalize_before:
-            src = self.norm1(src)
+            src = _residual_ln(self.norm1, residual, self.dropout1(src),
+                               self._fused_ln, "norm1")
+        else:
+            src = residual + self.dropout1(src)
         residual = src
         if self.normalize_before:
             src = self.norm2(src)
         src = self.linear2(self.dropout(_get_activation(self.activation)(
             self.linear1(src))))
-        src = residual + self.dropout2(src)
         if not self.normalize_before:
-            src = self.norm2(src)
+            src = _residual_ln(self.norm2, residual, self.dropout2(src),
+                               self._fused_ln, "norm2")
+        else:
+            src = residual + self.dropout2(src)
         return src if cache is None else (src, cache)
 
     def gen_cache(self, src):
@@ -159,6 +167,26 @@ class TransformerEncoder(Layer):
         return [l.gen_cache(src) for l in self.layers]
 
 
+def _residual_ln(norm, residual, delta, fused, scope_name):
+    """Post-LN residual join: ``norm(residual + delta)``.
+
+    With the fused flag (and an affine norm) the add and the norm
+    collapse into the Pallas fused_add_layer_norm kernel — one HBM
+    pass, custom VJP recomputing the stats, y-only return (post-norm
+    blocks never consume the raw sum, so backward pays no zeros
+    cotangent for it) — under the norm's scope name so roofline rows
+    keep their pre-fusion identity."""
+    if fused and norm.weight is not None:
+        from paddle_tpu.core.dispatch import apply
+        from paddle_tpu.observability.profile import layer_scope
+        from paddle_tpu.ops.pallas.norm import fused_add_layer_norm
+        with layer_scope(scope_name):
+            return apply(lambda a, r, w, b: fused_add_layer_norm(
+                a, r, w, b, norm._epsilon), delta, residual,
+                norm.weight, norm.bias)
+    return norm(residual + delta)
+
+
 def _clone_args(layer):
     if isinstance(layer, TransformerEncoderLayer):
         return dict(
@@ -166,25 +194,29 @@ def _clone_args(layer):
             dim_feedforward=layer.linear1.weight.shape[1],
             dropout=layer.dropout1.p, activation=layer.activation,
             attn_dropout=layer.self_attn.dropout, act_dropout=layer.dropout.p,
-            normalize_before=layer.normalize_before)
+            normalize_before=layer.normalize_before,
+            fused_ln=layer._fused_ln)
     if isinstance(layer, TransformerDecoderLayer):
         return dict(
             d_model=layer.self_attn.embed_dim, nhead=layer.self_attn.num_heads,
             dim_feedforward=layer.linear1.weight.shape[1],
             dropout=layer.dropout1.p, activation=layer.activation,
             attn_dropout=layer.self_attn.dropout, act_dropout=layer.dropout.p,
-            normalize_before=layer.normalize_before)
+            normalize_before=layer.normalize_before,
+            fused_ln=layer._fused_ln)
     raise TypeError(type(layer))
 
 
 class TransformerDecoderLayer(Layer):
     def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
                  activation="relu", attn_dropout=None, act_dropout=None,
-                 normalize_before=False, weight_attr=None, bias_attr=None):
+                 normalize_before=False, weight_attr=None, bias_attr=None,
+                 fused_ln=False):
         super().__init__()
         attn_dropout = dropout if attn_dropout is None else attn_dropout
         act_dropout = dropout if act_dropout is None else act_dropout
         self.normalize_before = normalize_before
+        self._fused_ln = fused_ln
         self.self_attn = MultiHeadAttention(d_model, nhead, attn_dropout,
                                             weight_attr=weight_attr,
                                             bias_attr=bias_attr)
@@ -212,9 +244,11 @@ class TransformerDecoderLayer(Layer):
         else:
             tgt, incremental_cache = self.self_attn(tgt, tgt, tgt, tgt_mask,
                                                     cache[0])
-        tgt = residual + self.dropout1(tgt)
         if not self.normalize_before:
-            tgt = self.norm1(tgt)
+            tgt = _residual_ln(self.norm1, residual, self.dropout1(tgt),
+                               self._fused_ln, "norm1")
+        else:
+            tgt = residual + self.dropout1(tgt)
         residual = tgt
         if self.normalize_before:
             tgt = self.norm2(tgt)
@@ -224,17 +258,21 @@ class TransformerDecoderLayer(Layer):
         else:
             tgt, static_cache = self.cross_attn(tgt, memory, memory,
                                                 memory_mask, cache[1])
-        tgt = residual + self.dropout2(tgt)
         if not self.normalize_before:
-            tgt = self.norm2(tgt)
+            tgt = _residual_ln(self.norm2, residual, self.dropout2(tgt),
+                               self._fused_ln, "norm2")
+        else:
+            tgt = residual + self.dropout2(tgt)
         residual = tgt
         if self.normalize_before:
             tgt = self.norm3(tgt)
         tgt = self.linear2(self.dropout(_get_activation(self.activation)(
             self.linear1(tgt))))
-        tgt = residual + self.dropout3(tgt)
         if not self.normalize_before:
-            tgt = self.norm3(tgt)
+            tgt = _residual_ln(self.norm3, residual, self.dropout3(tgt),
+                               self._fused_ln, "norm3")
+        else:
+            tgt = residual + self.dropout3(tgt)
         if cache is None:
             return tgt
         return tgt, (incremental_cache, static_cache)

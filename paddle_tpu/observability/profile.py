@@ -310,6 +310,19 @@ def _join(prefix, own):
     return prefix or own
 
 
+def _pallas_grid_size(eqn):
+    """Total grid-step count of a pallas_call (1 when unreadable)."""
+    gm = eqn.params.get("grid_mapping")
+    grid = getattr(gm, "grid", ()) or ()
+    n = 1
+    for d in grid:
+        try:
+            n *= max(1, int(d))
+        except (TypeError, ValueError):
+            pass
+    return n
+
+
 def _walk(jaxpr, prefix, mult, sink):
     """Accumulate ``sink[scope] = [flops, bytes, n_eqns]`` over `jaxpr`.
 
@@ -318,12 +331,34 @@ def _walk(jaxpr, prefix, mult, sink):
     so counting both would double the traffic.  ``scan`` bodies
     multiply by the trip count; ``while`` bodies count once (trip count
     is data-dependent — documented under-estimate); ``cond`` takes its
-    most expensive branch (only one runs)."""
+    most expensive branch (only one runs).
+
+    ``pallas_call`` is the one container costed at its CALL BOUNDARY:
+    a fused kernel's HBM traffic is its operands + results — the body
+    describes per-block VMEM/register ops that never round-trip HBM,
+    and walking it for bytes would both double-count (block reads) and
+    erase exactly the fusion the kernel exists for.  The body is still
+    walked for FLOPS (x grid steps), and the whole cost lands in the
+    CALLER's scope path (the eqn's own name stack), so a fused LN never
+    falls into ``<unattributed>``."""
     for eqn in jaxpr.eqns:
         own = normalize_scope(str(eqn.source_info.name_stack))
         path = _join(prefix, own)
         prim = eqn.primitive.name
         subs = list(_iter_sub_jaxprs(eqn.params))
+        if prim == "pallas_call":
+            flops = 0
+            grid = _pallas_grid_size(eqn)
+            for sub in subs:
+                trial = {}
+                _walk(sub, path, mult * grid, trial)
+                flops += sum(v[0] for v in trial.values())
+            _zero, nbytes = eqn_cost(eqn)
+            agg = sink.setdefault(path, [0, 0, 0])
+            agg[0] += flops
+            agg[1] += nbytes * mult
+            agg[2] += 1
+            continue
         if subs:
             m = mult
             if prim == "scan":

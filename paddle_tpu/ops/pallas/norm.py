@@ -35,6 +35,23 @@ def _on_tpu():
     return on_tpu()
 
 
+def _sublane(dtype):
+    """MXU/VPU sublane count for a dtype (8 for f32, 16 for bf16) —
+    the row granularity SL302-clean tile shapes are multiples of."""
+    return max(8, 32 // max(1, jnp.dtype(dtype).itemsize))
+
+
+def _auto_block_rows(rows, dtype, requested):
+    """Block-row choice: the caller's request, else the smallest
+    sublane multiple covering `rows` capped at DEFAULT_BLOCK_ROWS —
+    small inputs then pay (at most) sublane-1 rows of padding instead
+    of blowing up to a full 128-row block."""
+    if requested:
+        return int(requested)
+    sub = _sublane(dtype)
+    return min(DEFAULT_BLOCK_ROWS, -(-int(rows) // sub) * sub)
+
+
 def _ln_kernel(x_ref, w_ref, b_ref, o_ref, *, eps, has_affine):
     x = x_ref[:].astype(jnp.float32)
     mean = jnp.mean(x, axis=-1, keepdims=True)
@@ -110,7 +127,8 @@ def _ln_fwd_impl(x, weight, bias, eps, block_rows, interpret):
             _k(x_ref, None, None, o_ref)
         extras = []
     y2 = _run_rows_kernel(kernel, x2, extras,
-                          block_rows or DEFAULT_BLOCK_ROWS, interpret)
+                          _auto_block_rows(x2.shape[0], x2.dtype,
+                                           block_rows), interpret)
     return y2.reshape(x.shape), None, None
 
 
@@ -119,13 +137,13 @@ def _ln_fwd_rule(x, weight, bias, eps, block_rows, interpret):
     return y, (x, weight, bias)
 
 
-def _ln_bwd_rule(eps, block_rows, interpret, res, g):
-    x, weight, bias = res
+def _ln_bwd_jnp(x, weight, bias, g, eps):
+    """Analytic LN backward in plain jnp (the no-affine / fallback
+    path; the affine path runs the Pallas backward kernel below)."""
     xf = x.astype(jnp.float32)
     gf = g.astype(jnp.float32)
     mean, rstd = _ln_stats(x, eps)
     xhat = (xf - mean) * rstd
-    n = x.shape[-1]
     if weight is not None:
         gy = gf * weight.astype(jnp.float32)
     else:
@@ -141,7 +159,227 @@ def _ln_bwd_rule(eps, block_rows, interpret, res, g):
     return dx, dw, db
 
 
+def _ln_bwd_rule(eps, block_rows, interpret, res, g):
+    x, weight, bias = res
+    if weight is None:
+        return _ln_bwd_jnp(x, weight, bias, g, eps)
+    # Pallas backward: recompute mean/rstd/xhat in-kernel from the
+    # saved input (nothing normalized was materialized by the forward),
+    # one fused pass producing dx + per-block dw/db partial sums
+    dx, dw, db = _ln_bwd_pallas(x, weight, bias, g, None, eps, None,
+                                block_rows, interpret)
+    return dx, dw, db
+
+
 fused_layer_norm.defvjp(_ln_fwd_rule, _ln_bwd_rule)
+
+
+# ------------------------------------------------ fused residual + LN
+def _gelu_grad(u):
+    """(gelu(u), d gelu/du) — tanh approximation (the one F.gelu
+    approximate=True uses)."""
+    k = 0.7978845608028654   # sqrt(2/pi)
+    c = 0.044715
+    t = jnp.tanh(k * (u + c * u * u * u))
+    y = 0.5 * u * (1.0 + t)
+    dy = 0.5 * (1.0 + t) + 0.5 * u * (1.0 - t * t) * k \
+        * (1.0 + 3.0 * c * u * u)
+    return y, dy
+
+
+def _ln_res_kernel(x_ref, r_ref, w_ref, b_ref, h_ref, y_ref, *, eps, act):
+    """h = x + r; y = act(LN(h) * w + b) — one VMEM pass."""
+    h = x_ref[:].astype(jnp.float32) + r_ref[:].astype(jnp.float32)
+    mean = jnp.mean(h, axis=-1, keepdims=True)
+    xc = h - mean
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    y = xc * jax.lax.rsqrt(var + eps)
+    y = y * w_ref[:].astype(jnp.float32) + b_ref[:].astype(jnp.float32)
+    if act == "gelu":
+        y, _ = _gelu_grad(y)
+    h_ref[:] = h.astype(h_ref.dtype)
+    y_ref[:] = y.astype(y_ref.dtype)
+
+
+def _ln_bwd_core(h, w, b, gy, gh, dx_ref, dwp_ref, dbp_ref, *, eps, act):
+    mean = jnp.mean(h, axis=-1, keepdims=True)
+    xc = h - mean
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = xc * rstd
+    if act == "gelu":
+        u = xhat * w + b
+        _, du = _gelu_grad(u)
+        gy = gy * du
+    gw = gy * w
+    m1 = jnp.mean(gw, axis=-1, keepdims=True)
+    m2 = jnp.mean(gw * xhat, axis=-1, keepdims=True)
+    dx = rstd * (gw - m1 - xhat * m2)
+    if gh is not None:
+        dx = dx + gh
+    dx_ref[:] = dx.astype(dx_ref.dtype)
+    dwp_ref[:] = jnp.sum(gy * xhat, axis=0, keepdims=True)
+    dbp_ref[:] = jnp.sum(gy, axis=0, keepdims=True)
+
+
+def _ln_bwd_kernel_plain(h_ref, gy_ref, w_ref, b_ref, dx_ref, dwp_ref,
+                         dbp_ref, *, eps, act):
+    # operand order = _run_ln_multi's: row-blocked inputs, then vectors
+    _ln_bwd_core(h_ref[:].astype(jnp.float32), w_ref[:].astype(jnp.float32),
+                 b_ref[:].astype(jnp.float32), gy_ref[:].astype(jnp.float32),
+                 None, dx_ref, dwp_ref, dbp_ref, eps=eps, act=act)
+
+
+def _ln_bwd_kernel_res(h_ref, gy_ref, gh_ref, w_ref, b_ref, dx_ref,
+                       dwp_ref, dbp_ref, *, eps, act):
+    _ln_bwd_core(h_ref[:].astype(jnp.float32), w_ref[:].astype(jnp.float32),
+                 b_ref[:].astype(jnp.float32), gy_ref[:].astype(jnp.float32),
+                 gh_ref[:].astype(jnp.float32), dx_ref, dwp_ref, dbp_ref,
+                 eps=eps, act=act)
+
+
+def _run_ln_multi(kernel, rows_in, vecs, rows_out_dtypes, n_partials,
+                  block_rows, interpret):
+    """Row-block kernel with several [rows, hidden] inputs/outputs plus
+    per-block (grid, hidden) f32 partial-sum outputs (summed by the
+    caller — the cross-block reduction is one tiny eqn)."""
+    rows, hidden = rows_in[0].shape
+    xp = [_pad_rows(a, block_rows) for a in rows_in]
+    prows = xp[0].shape[0]
+    grid = (prows // block_rows,)
+    in_specs = [_vmem_spec((block_rows, hidden), lambda i: (i, 0))
+                for _ in rows_in]
+    in_specs += [_vmem_spec((1, hidden), lambda i: (0, 0)) for _ in vecs]
+    out_specs = [_vmem_spec((block_rows, hidden), lambda i: (i, 0))
+                 for _ in rows_out_dtypes]
+    out_specs += [_vmem_spec((1, hidden), lambda i: (i, 0))
+                  for _ in range(n_partials)]
+    out_shape = [jax.ShapeDtypeStruct((prows, hidden), dt)
+                 for dt in rows_out_dtypes]
+    out_shape += [jax.ShapeDtypeStruct((grid[0], hidden), jnp.float32)
+                  for _ in range(n_partials)]
+    outs = pl.pallas_call(
+        kernel, grid=grid, in_specs=in_specs, out_specs=out_specs,
+        out_shape=out_shape, interpret=interpret,
+    )(*xp, *[v[None, :] for v in vecs])
+    n_rows_out = len(rows_out_dtypes)
+    return ([o[:rows] for o in outs[:n_rows_out]]
+            + list(outs[n_rows_out:]))
+
+
+def _ln_bwd_pallas(h, weight, bias, gy, gh, eps, act, block_rows,
+                   interpret):
+    """Shared Pallas LN backward: dx (+gh when given), dw, db."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    hidden = h.shape[-1]
+    h2 = h.reshape(-1, hidden)
+    gy2 = gy.reshape(-1, hidden)
+    b = bias if bias is not None else jnp.zeros_like(weight)
+    br = _auto_block_rows(h2.shape[0], h2.dtype, block_rows)
+    if gh is None:
+        kernel = functools.partial(_ln_bwd_kernel_plain, eps=eps, act=act)
+        rows_in = [h2, gy2]
+    else:
+        kernel = functools.partial(_ln_bwd_kernel_res, eps=eps, act=act)
+        rows_in = [h2, gy2, gh.reshape(-1, hidden)]
+    dx2, dwp, dbp = _run_ln_multi(kernel, rows_in, [weight, b],
+                                  [h.dtype], 2, br, interpret)
+    dw = dwp.sum(axis=0).astype(weight.dtype)
+    db = dbp.sum(axis=0).astype(bias.dtype) if bias is not None else None
+    return dx2.reshape(h.shape), dw, db
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def fused_ln_residual(x, residual, weight, bias, eps=1e-5, act=None,
+                      block_rows=None, interpret=None):
+    """One-kernel ``h = x + residual; y = act(LN(h))`` returning
+    ``(h, y)`` — the residual-stream update and the normalized input of
+    the next sublayer in a single HBM pass.  The custom VJP saves only
+    ``h`` (live on the forward path anyway) and RECOMPUTES mean/rstd in
+    the backward kernel: no normalized intermediate is ever
+    materialized.  ``weight`` is required (fall back to the pure-JAX
+    composition for weight-free norms); ``act`` is None or ``"gelu"``
+    (tanh approximation, for blocks whose norm feeds an activation
+    directly)."""
+    h, y = _ln_res_fwd_impl(x, residual, weight, bias, eps, act,
+                            block_rows, interpret)
+    return h, y
+
+
+def _ln_res_fwd_impl(x, residual, weight, bias, eps, act, block_rows,
+                     interpret):
+    if interpret is None:
+        interpret = not _on_tpu()
+    hidden = x.shape[-1]
+    x2 = x.reshape(-1, hidden)
+    r2 = residual.reshape(-1, hidden)
+    out_dtype = jnp.promote_types(x.dtype, residual.dtype)
+    b = bias if bias is not None else jnp.zeros_like(weight)
+    br = _auto_block_rows(x2.shape[0], jnp.dtype(out_dtype), block_rows)
+    kernel = functools.partial(_ln_res_kernel, eps=eps, act=act)
+    h2, y2 = _run_ln_multi(kernel, [x2, r2], [weight, b],
+                           [out_dtype, out_dtype], 0, br, interpret)
+    return h2.reshape(x.shape), y2.reshape(x.shape)
+
+
+def _ln_res_fwd_rule(x, residual, weight, bias, eps, act, block_rows,
+                     interpret):
+    h, y = _ln_res_fwd_impl(x, residual, weight, bias, eps, act,
+                            block_rows, interpret)
+    # scalar zero sentinels carry the primal dtypes into the bwd rule
+    # (residual pytree leaves must be jax values, not dtype objects)
+    return (h, y), (h, weight, bias, jnp.zeros((), x.dtype),
+                    jnp.zeros((), residual.dtype))
+
+
+def _ln_res_bwd_rule(eps, act, block_rows, interpret, res, g):
+    h, weight, bias, x_proto, r_proto = res
+    gh, gy = g
+    dh, dw, db = _ln_bwd_pallas(h, weight, bias, gy, gh, eps, act,
+                                block_rows, interpret)
+    dx = dh if dh.dtype == x_proto.dtype else dh.astype(x_proto.dtype)
+    dres = dh if dh.dtype == r_proto.dtype else dh.astype(r_proto.dtype)
+    return dx, dres, dw, db
+
+
+fused_ln_residual.defvjp(_ln_res_fwd_rule, _ln_res_bwd_rule)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def fused_add_layer_norm(x, residual, weight, bias, eps=1e-5, act=None,
+                         block_rows=None, interpret=None):
+    """Post-LN join ``y = act(LN(x + residual))`` returning ONLY y.
+
+    Same kernels as :func:`fused_ln_residual`, for call sites where the
+    summed stream is not consumed downstream (post-norm transformer
+    blocks: the normalized value IS the stream).  Returning y alone
+    means backward never materializes a zeros cotangent for an unused h
+    output — h is still computed once and saved as the residual the
+    backward kernel recomputes stats from."""
+    _h, y = _ln_res_fwd_impl(x, residual, weight, bias, eps, act,
+                             block_rows, interpret)
+    return y
+
+
+def _add_ln_fwd_rule(x, residual, weight, bias, eps, act, block_rows,
+                     interpret):
+    h, y = _ln_res_fwd_impl(x, residual, weight, bias, eps, act,
+                            block_rows, interpret)
+    return y, (h, weight, bias, jnp.zeros((), x.dtype),
+               jnp.zeros((), residual.dtype))
+
+
+def _add_ln_bwd_rule(eps, act, block_rows, interpret, res, gy):
+    h, weight, bias, x_proto, r_proto = res
+    dh, dw, db = _ln_bwd_pallas(h, weight, bias, gy, None, eps, act,
+                                block_rows, interpret)
+    dx = dh if dh.dtype == x_proto.dtype else dh.astype(x_proto.dtype)
+    dres = dh if dh.dtype == r_proto.dtype else dh.astype(r_proto.dtype)
+    return dx, dres, dw, db
+
+
+fused_add_layer_norm.defvjp(_add_ln_fwd_rule, _add_ln_bwd_rule)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))

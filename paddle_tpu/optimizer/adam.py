@@ -10,7 +10,7 @@ class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-08, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, multi_precision=False,
-                 name=None, moment_dtype=None):
+                 name=None, moment_dtype=None, fused=False):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._beta1 = beta1
         self._beta2 = beta2
@@ -20,10 +20,19 @@ class Adam(Optimizer):
         # reference reaches the same end via sharding stage2/3 across
         # ranks); moment math still runs in fp32, only storage narrows
         self._moment_dtype = jnp.dtype(moment_dtype) if moment_dtype             else jnp.float32
+        # fused=True: rank-2 params update through the single-pass
+        # pallas kernel (ops/pallas/optim.py) — p/g/m/v read once,
+        # p'/m'/v' written once, same f32 math to the last op.  Rank-1
+        # params and hosts without pallas keep the loop below.
+        self._fused = bool(fused)
+
+    def _fused_decay(self, p):
+        """(decoupled_coeff, gate) the fused kernel applies — plain
+        Adam has none (coupled decay arrives in the gradient)."""
+        return 0.0, True
 
     def _update_param(self, p, g, lr_mult):
         lr = self._lr_value() * lr_mult
-        g = g.astype(jnp.float32)
         mdt = self._moment_dtype
         m = self._acc("moment1", p, dtype=mdt)
         v = self._acc("moment2", p, dtype=mdt)
@@ -31,6 +40,19 @@ class Adam(Optimizer):
         b2p = self._acc("beta2_pow", p, init=1.0, shape=(), dtype=jnp.float32)
         b1p._set_value(b1p._value * self._beta1)
         b2p._set_value(b2p._value * self._beta2)
+        if self._will_fuse(p):
+            from paddle_tpu.ops.pallas.optim import fused_adam_update
+            coeff, decay_on = self._fused_decay(p)
+            new_p, new_m, new_v = fused_adam_update(
+                p._value, g, m._value, v._value, lr,
+                1 - b1p._value, 1 - b2p._value,
+                beta1=self._beta1, beta2=self._beta2, eps=self._epsilon,
+                weight_decay=coeff, decay_on=decay_on)
+            p._set_value(new_p)
+            m._set_value(new_m)
+            v._set_value(new_v)
+            return
+        g = g.astype(jnp.float32)
         new_m = self._beta1 * m._value.astype(jnp.float32) + (1 - self._beta1) * g
         new_v = self._beta2 * v._value.astype(jnp.float32) + (1 - self._beta2) * g * g
         m._set_value(new_m.astype(mdt))
@@ -48,22 +70,31 @@ class AdamW(Adam):
                  epsilon=1e-08, parameters=None, weight_decay=0.01,
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
                  lazy_mode=False, multi_precision=False, name=None,
-                 moment_dtype=None):
+                 moment_dtype=None, fused=False):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          None, grad_clip, lazy_mode, multi_precision, name,
-                         moment_dtype)
+                         moment_dtype, fused)
         self._coeff = weight_decay if isinstance(weight_decay, float) else 0.01
         self._apply_decay_param_fun = apply_decay_param_fun
         self._lr_ratio = lr_ratio
 
+    def _fused_decay(self, p):
+        on = self._apply_decay_param_fun is None or \
+            self._apply_decay_param_fun(p.name)
+        return self._coeff, on
+
     def _update_param(self, p, g, lr_mult):
         if self._lr_ratio is not None:
             lr_mult = lr_mult * self._lr_ratio(p)
-        lr = self._lr_value() * lr_mult
-        if self._apply_decay_param_fun is None or self._apply_decay_param_fun(p.name):
-            p._set_value((p._value.astype(jnp.float32) *
-                          (1.0 - lr * self._coeff)).astype(p._value.dtype))
-        super()._update_param(p, g, lr_mult)
+        if not self._will_fuse(p):
+            # fused updates fold the decoupled decay into the kernel
+            # (same op order: decay BEFORE the adam update)
+            lr = self._lr_value() * lr_mult
+            if self._apply_decay_param_fun is None or \
+                    self._apply_decay_param_fun(p.name):
+                p._set_value((p._value.astype(jnp.float32) *
+                              (1.0 - lr * self._coeff)).astype(p._value.dtype))
+        Adam._update_param(self, p, g, lr_mult)
 
 
 class Adamax(Optimizer):

@@ -35,6 +35,20 @@ class Optimizer:
         self._weight_decay = weight_decay
         self._grad_clip = grad_clip
         self._accumulators = {}
+        # subclasses with a fused single-pass update kernel set this
+        # (Adam/AdamW `fused=True`); the base loop never fuses
+        self._fused = False
+
+    def _will_fuse(self, p):
+        """True when this param's update will run the fused single-pass
+        kernel (ops/pallas/optim.py) instead of the per-op loop."""
+        if not self._fused:
+            return False
+        try:
+            from paddle_tpu.ops.pallas.optim import supports_fused
+        except Exception:
+            return False
+        return supports_fused(jnp.shape(p._value))
 
     # ---- lr ----
     def get_lr(self):
@@ -141,8 +155,12 @@ class Optimizer:
             for p, g in pg:
                 lr_mult = getattr(p, "optimize_attr", {}).get("learning_rate", 1.0) \
                     if hasattr(p, "optimize_attr") else 1.0
-                gv = self._apply_decay(p, g._value.astype(jnp.float32)
-                                       if g._value.dtype != p._value.dtype else g._value)
+                gv = g._value
+                if gv.dtype != p._value.dtype and not self._will_fuse(p):
+                    # the fused kernel casts in-register; pre-casting
+                    # here would pay a full extra grad read+write
+                    gv = gv.astype(jnp.float32)
+                gv = self._apply_decay(p, gv)
                 self._update_param(p, gv, lr_mult)
 
     def _update_param(self, p, g, lr_mult):

@@ -48,12 +48,20 @@ DEFAULT_TOLERANCE = 0.05
 
 
 # ------------------------------------------------------------- targets
-def build_gpt_train_step():
+def build_gpt_train_step(optimized=True, remat=None):
     """The flagship hybrid-parallel train step — the SHARED builder
     other tools profile the same program from (tools/obs_report.py
     --roofline --demo, tests/test_profile.py), with the loss under an
     explicit profile scope so its softmax/gather traffic is attributed
-    rather than bucketed <unattributed>."""
+    rather than bucketed <unattributed>.
+
+    ``optimized=True`` (the shipped flagship since the PR 10 bytes/step
+    work) enables the three byte-cutting fronts: bf16 activation
+    residency (``to_static(amp_policy="bf16")``), the fused single-pass
+    AdamW update (``fused=True``), and the Pallas fused LN/residual
+    blocks (``fused_ln=True``).  ``optimized=False`` is the plain-f32
+    per-op build (the remat lane's baseline and the XLA-reconciliation
+    test use it).  ``remat`` threads to ``to_static(remat=...)``."""
     import numpy as np
 
     import paddle_tpu as P
@@ -62,12 +70,14 @@ def build_gpt_train_step():
     from paddle_tpu.observability import profile
 
     P.seed(0)
-    cfg = gpt3_tiny()
+    cfg = gpt3_tiny(fused_ln=bool(optimized))
     model = GPTForCausalLM(cfg)
     opt = P.optimizer.AdamW(learning_rate=1e-4,
-                            parameters=model.parameters())
+                            parameters=model.parameters(),
+                            fused=bool(optimized))
 
-    @P.jit.to_static
+    @P.jit.to_static(amp_policy="bf16" if optimized else None,
+                     remat=remat)
     def train_step(ids, labels):
         opt.clear_grad()
         logits = model(ids)
@@ -86,18 +96,49 @@ def build_gpt_train_step():
     return train_step, ids, labels
 
 
-def gpt_roofline_report():
+def gpt_roofline_report(optimized=True, remat=None):
     """(RooflineReport, CostReport) for the gpt hybrid train step —
     shared by the gate metrics and the bench.py --worker-profile lane."""
     from paddle_tpu.analysis.cost_audit import audit_memory
     from paddle_tpu.observability import profile
 
-    train_step, ids, labels = build_gpt_train_step()
+    train_step, ids, labels = build_gpt_train_step(optimized=optimized,
+                                                   remat=remat)
     jaxpr, infos = train_step.traced_program(ids, labels)
     report = profile.profile_traced(jaxpr, where="<gpt_hybrid_train>")
     _findings, cost = audit_memory(jaxpr, where="<gpt_hybrid_train>",
                                    inputs=infos)
     return report, cost
+
+
+def remat_report():
+    """The bench.py --worker-remat lane: remat-on vs remat-off COST
+    MODEL numbers for the gpt train step, reported honestly — remat
+    re-runs each block's forward inside backward, so bytes/step go UP
+    (that's the flops-for-HBM trade, not a win to hide), and on the
+    param-dominated TINY CI config the liveness peak estimate can rise
+    too (the anti-CSE barriers around each region count as copies).
+    The old bench "remat" key was a bare bool that implied a free win;
+    these numbers are what the trade actually costs on the audited
+    program.  ``remat="bf16"`` halves the saved boundary activations."""
+    t0 = time.time()
+    rep_off, cost_off = gpt_roofline_report(optimized=False)
+    rep_on, cost_on = gpt_roofline_report(optimized=False, remat="bf16")
+    bytes_saved = 100.0 * (1.0 - rep_on.total_bytes
+                           / max(1, rep_off.total_bytes))
+    peak_saved = 100.0 * (1.0 - cost_on.peak_hbm_bytes
+                          / max(1, cost_off.peak_hbm_bytes))
+    return {
+        "remat_bytes_per_step_off": rep_off.total_bytes,
+        "remat_bytes_per_step_on": rep_on.total_bytes,
+        "remat_bytes_saved_pct": round(bytes_saved, 2),
+        "remat_peak_hbm_off_mb": round(
+            cost_off.peak_hbm_bytes / (1 << 20), 3),
+        "remat_peak_hbm_on_mb": round(
+            cost_on.peak_hbm_bytes / (1 << 20), 3),
+        "remat_peak_hbm_saved_pct": round(peak_saved, 2),
+        "remat_elapsed_s": round(time.time() - t0, 2),
+    }
 
 
 def target_gpt_hybrid_train():
@@ -218,6 +259,37 @@ def compare(current, baseline, tolerance):
     return regressions, improvements, notes
 
 
+def render_diff(current, baseline):
+    """Print the old-vs-new per-metric table (--diff) and return the
+    rows as dicts (for --json).  Purely informational: the % delta
+    column is signed (negative = improvement, every metric is
+    lower-is-better); metrics present on only one side are labeled."""
+    rows = []
+    base_targets = baseline.get("targets", {})
+    for tname in sorted(set(base_targets) | set(current)):
+        bm = base_targets.get(tname, {})
+        cm = current.get(tname, {})
+        print(f"== {tname}")
+        print(f"   {'metric':28s} {'baseline':>14s} {'current':>14s} "
+              f"{'delta':>9s}")
+        for m in sorted(set(bm) | set(cm)):
+            b, c = bm.get(m), cm.get(m)
+            if b is None:
+                delta = "new"
+            elif c is None:
+                delta = "gone"
+            elif b == 0:
+                delta = "=" if c == 0 else "+inf"
+            else:
+                delta = f"{100.0 * (c / b - 1.0):+.1f}%"
+            rows.append({"target": tname, "metric": m, "baseline": b,
+                         "current": c, "delta": delta})
+            fmt = lambda v: "-" if v is None else f"{v:,}" \
+                if isinstance(v, int) else f"{v}"          # noqa: E731
+            print(f"   {m:28s} {fmt(b):>14s} {fmt(c):>14s} {delta:>9s}")
+    return rows
+
+
 # ----------------------------------------------------------------- CLI
 def main(argv=None):
     ap = argparse.ArgumentParser(
@@ -229,6 +301,11 @@ def main(argv=None):
     ap.add_argument("--check", action="store_true",
                     help="compare against the baseline; exit 1 on any "
                          "regression beyond tolerance")
+    ap.add_argument("--diff", action="store_true",
+                    help="render an old-vs-new per-metric table with % "
+                         "deltas against the baseline (informational: "
+                         "metric values never affect the exit code; an "
+                         "unreadable baseline is still usage-error 2)")
     ap.add_argument("--write-baseline", action="store_true",
                     help="write the current numbers as the new baseline")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
@@ -244,15 +321,25 @@ def main(argv=None):
     current = run_targets(args.targets)
     elapsed = time.time() - t0
 
-    for tname, metrics in sorted(current.items()):
-        print(f"== {tname}")
-        for m, v in sorted(metrics.items()):
-            print(f"   {m:28s} {v}")
+    if not args.diff:
+        for tname, metrics in sorted(current.items()):
+            print(f"== {tname}")
+            for m, v in sorted(metrics.items()):
+                print(f"   {m:28s} {v}")
 
     doc = {"tool": "perfgate", "version": 1, "elapsed_s": round(elapsed, 2),
            "targets": current}
 
     rc = 0
+    if args.diff:
+        try:
+            with open(args.baseline, encoding="utf-8") as fh:
+                baseline = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"perfgate: cannot read baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2
+        doc["diff"] = render_diff(current, baseline)
     if args.write_baseline:
         base_doc = {"tool": "perfgate", "version": 1,
                     "tolerance": (args.tolerance
