@@ -46,6 +46,7 @@ RESNET_TPU_S = 240
 BERT_TPU_S = 180
 ERNIE_TPU_S = 180
 SERVING_TPU_S = 150
+ROUTER_S = 240
 SHARDLINT_S = 150
 RACELINT_S = 90
 OBS_S = 150
@@ -598,6 +599,106 @@ def worker_remat():
     return 0
 
 
+def worker_router():
+    """Router lane: multi-replica serving through
+    paddle_tpu.serving.router — 3 replicas sharing one AOT program
+    cache, a mixed traffic trace, and one injected mid-decode replica
+    crash absorbed by failover.  Pure CPU (the lane tracks router
+    overhead, failover cost, and the cold-vs-warm AOT boot ratio, all
+    host-side effects) — never touches the TPU claim, so its numbers
+    ride along on every BENCH report.
+
+    Reports (merged into every BENCH line):
+      router_tokens_per_s          — fleet decode throughput under the
+                                     trace (incl. the failover stall)
+      router_failover_count        — replica crashes absorbed (>= 1 by
+                                     construction, or the lane fails)
+      router_boot_ms_cold          — replica boot compiling the ladder
+      router_boot_ms_warm          — replica boot loading the AOT cache
+      router_boot_ms_cold_vs_warm  — the scale-out payoff ratio
+      router_spillover_count       — admissions spilled on rejection
+    """
+    import shutil
+    import statistics
+    import tempfile
+
+    import numpy as np
+
+    _init_backend()   # honors PTPU_FORCE_CPU (always set for this lane)
+
+    import paddle_tpu as P
+    from paddle_tpu import resilience as R
+    from paddle_tpu import serving
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving.router import Router, RouterConfig
+
+    mcfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                     num_heads=4, max_seq_len=128, dropout=0.0,
+                     attention_dropout=0.0)
+    ecfg = serving.EngineConfig(max_num_seqs=4, page_size=8,
+                                max_model_len=64,
+                                prefill_buckets=(16, 32),
+                                crash_safe_decode=False)
+    P.seed(0)
+    model = GPTForCausalLM(mcfg)
+    cache_dir = tempfile.mkdtemp(prefix="ptpu_router_bench_")
+    try:
+        router = Router(model, ecfg, num_replicas=3,
+                        config=RouterConfig(sleep=lambda s: None),
+                        program_cache=cache_dir)
+        boots = [h.boot_info for h in router.replicas]
+        cold = [b["boot_ms"] for b in boots if not b.get("warm")]
+        warm = [b["boot_ms"] for b in boots if b.get("warm")]
+
+        rng = np.random.default_rng(0)
+        n_req, max_new = 24, 12
+        # worst-case replay (prompt + max_new - 1) must stay bucketable
+        prompts = [list(rng.integers(1, mcfg.vocab_size,
+                                     int(rng.integers(4, 21))))
+                   for _ in range(n_req)]
+        sps = [serving.SamplingParams(max_new_tokens=max_new,
+                                      temperature=0.8, top_p=0.95,
+                                      seed=i) for i in range(n_req)]
+        # one injected replica crash mid-trace: throughput is measured
+        # WITH the failover (migration + warm respawn) in the loop
+        plan = R.FaultPlan(
+            [R.FaultSpec("serving.decode", "exception", at=8)],
+            name="bench-router")
+        t0 = time.perf_counter()
+        with R.FaultInjector(plan):
+            results = router.generate(prompts, sps)
+        wall = time.perf_counter() - t0
+        generated = sum(len(r.output_token_ids) for r in results)
+        snap = router.snapshot()
+        out = {
+            "router_tokens_per_s": round(generated / wall, 2),
+            "router_replicas": 3,
+            "router_requests": n_req,
+            "router_failover_count": snap["failovers"],
+            "router_respawn_count": snap["respawns"],
+            "router_spillover_count": snap["spillovers"],
+            "router_boot_ms_cold": round(statistics.median(cold), 1)
+            if cold else None,
+            "router_boot_ms_warm": round(statistics.median(warm), 1)
+            if warm else None,
+        }
+        if cold and warm:
+            out["router_boot_ms_cold_vs_warm"] = round(
+                statistics.median(cold) / statistics.median(warm), 2)
+        # lane contracts, gated BEFORE the result line prints: the
+        # injected crash must actually have exercised failover, with
+        # zero data loss under it
+        assert snap["failovers"] >= 1, "injected crash never fired"
+        assert generated == n_req * max_new, (
+            f"data loss across failover: {generated} tokens != "
+            f"{n_req * max_new}")
+        router.shutdown()
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    print(json.dumps(out), flush=True)
+    return 0
+
+
 def worker_racelint():
     """Static-analysis lane #2: racelint's host-concurrency audit of
     the whole package (finding count + per-rule breakdown).  Pure
@@ -920,6 +1021,8 @@ def main():
         return worker_ernie()
     if "--worker-serving" in sys.argv:
         return worker_serving()
+    if "--worker-router" in sys.argv:
+        return worker_router()
     if "--worker-shardlint" in sys.argv:
         return worker_shardlint()
     if "--worker-racelint" in sys.argv:
@@ -947,6 +1050,7 @@ def main():
     resil_proc = _spawn("--worker-resilience", force_cpu=True)
     prof_proc = _spawn("--worker-profile", force_cpu=True)
     remat_proc = _spawn("--worker-remat", force_cpu=True)
+    router_proc = _spawn("--worker-router", force_cpu=True)
 
     probe_proc = _spawn("--probe", force_cpu=False)
     probe_res, probe_err, probe_exited = _await_json(
@@ -1007,6 +1111,13 @@ def main():
         # same rationale: the remat cost-model lane failing degrades
         # only its own keys
         merged["remat_error"] = str(remat_err)
+
+    router_res, router_err, _ = _await_json(router_proc, ROUTER_S)
+    if router_res is not None:
+        merged.update(router_res)
+    else:
+        # same rationale: a router-lane failure degrades only its keys
+        merged["router_error"] = str(router_err)
     tpu_ok = bool(probe_res
                   and (probe_res.get("ok") or probe_res.get("probe_ok"))
                   and probe_res.get("platform") != "cpu")
@@ -1038,6 +1149,7 @@ def main():
                     resil_err)
         _adopt_lane("profile_", "profile_bytes_per_step", prof_err)
         _adopt_lane("remat_", "remat_bytes_saved_pct", remat_err)
+        _adopt_lane("router_", "router_tokens_per_s", router_err)
         if merged.get("probe_killed"):
             # the fallback note must record that the leaked probe was
             # reaped — the next run starts against a clean claim

@@ -85,6 +85,99 @@ def get_world_size(group=None):
     return jax.process_count()
 
 
+# monotone per-process round counter for coordination-service
+# collectives; SPMD call order is identical on every process, so the
+# same round id names the same collective fleet-wide
+_COORD_ROUND = [0]
+
+
+def _coord_allgather(value):
+    """Eager cross-process allgather over the jax.distributed
+    coordination service's key-value store (the same coordinator
+    ``launch()`` / ``jax.distributed.initialize`` stood up).
+
+    XLA:CPU cannot execute multi-process SPMD programs, so the
+    ``multihost_utils`` path is TPU/GPU-only; this DCN fallback keeps
+    the eager collective API working in multi-process CPU worlds
+    (tests/test_distributed_multiprocess.py proves it end to end).
+    Stacks every process's array along a new leading axis."""
+    import pickle
+
+    import numpy as np
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    if client is None:
+        raise RuntimeError(
+            "jax.distributed is not initialized — multi-process "
+            "collectives need distributed.launch / "
+            "jax.distributed.initialize first")
+    rank, n = jax.process_index(), jax.process_count()
+    _COORD_ROUND[0] += 1
+    rnd = _COORD_ROUND[0]
+    prefix = f"ptpu/allgather/{rnd}"
+    arr = np.asarray(value)
+    client.key_value_set_bytes(f"{prefix}/{rank}", pickle.dumps(arr))
+    parts = []
+    for r in range(n):
+        raw = client.blocking_key_value_get_bytes(
+            f"{prefix}/{r}", 120_000)
+        parts.append(pickle.loads(raw))
+    _coord_reap(client, rank, rnd)
+    return np.stack(parts)
+
+
+def _coord_reap(client, rank, rnd):
+    """Reap coordination-service keys TWO rounds behind, never the
+    current one: a peer entering round `rnd` has by construction
+    finished consuming round `rnd - 2`, while round `rnd - 1` (or
+    `rnd`) may still have a straggler mid-read — deleting those would
+    strand it on a key that will never reappear.  Both collective
+    prefixes share the round counter, so both are swept."""
+    if rank != 0 or rnd <= 2:
+        return
+    for prefix in ("ptpu/allgather", "ptpu/bcast"):
+        try:
+            client.key_value_delete(f"{prefix}/{rnd - 2}")
+        except Exception:
+            pass
+
+
+def _coord_broadcast(value, src):
+    """Eager cross-process broadcast over the coordination service:
+    only `src` uploads its payload — one set + n gets, instead of the
+    n uploads + n*n downloads a full allgather would move through the
+    single gRPC coordinator for data only one rank actually has."""
+    import pickle
+
+    import numpy as np
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    if client is None:
+        raise RuntimeError(
+            "jax.distributed is not initialized — multi-process "
+            "collectives need distributed.launch / "
+            "jax.distributed.initialize first")
+    rank = jax.process_index()
+    _COORD_ROUND[0] += 1
+    rnd = _COORD_ROUND[0]
+    key = f"ptpu/bcast/{rnd}/{int(src)}"
+    if rank == int(src):
+        client.key_value_set_bytes(key, pickle.dumps(np.asarray(value)))
+    out = pickle.loads(client.blocking_key_value_get_bytes(key, 120_000))
+    _coord_reap(client, rank, rnd)
+    return out
+
+
+def _process_allgather(value):
+    """Backend-appropriate eager cross-process allgather."""
+    if jax.default_backend() == "cpu":
+        return _coord_allgather(value)
+    from jax.experimental import multihost_utils
+    return multihost_utils.process_allgather(value)
+
+
 def _reduce_fn(op):
     def pprod(v, axis):
         return jnp.exp(jax.lax.psum(jnp.log(v), axis))
@@ -112,8 +205,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         tensor._inplace_assign(out)
         return tensor
     if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-        g = multihost_utils.process_allgather(tensor._value)
+        g = _process_allgather(tensor._value)
         red = {ReduceOp.SUM: jnp.sum, ReduceOp.MAX: jnp.max,
                ReduceOp.MIN: jnp.min, ReduceOp.PROD: jnp.prod,
                ReduceOp.AVG: jnp.mean}
@@ -132,8 +224,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
             tensor_list.append(out[i])
         return tensor_list
     if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-        g = multihost_utils.process_allgather(tensor._value)
+        g = _process_allgather(tensor._value)
         for i in range(g.shape[0]):
             tensor_list.append(Tensor(g[i]))
         return tensor_list
@@ -169,8 +260,12 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
         tensor._inplace_assign(out)
         return tensor
     if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-        tensor._set_value(multihost_utils.broadcast_one_to_all(tensor._value))
+        if jax.default_backend() == "cpu":
+            tensor._set_value(_coord_broadcast(tensor._value, src))
+        else:
+            from jax.experimental import multihost_utils
+            tensor._set_value(
+                multihost_utils.broadcast_one_to_all(tensor._value))
     return tensor
 
 

@@ -22,8 +22,16 @@ Quickstart::
         serving.SamplingParams(max_new_tokens=16, temperature=0.8,
                                top_p=0.95, seed=1))
 
+Multi-replica serving lives one level up: :mod:`paddle_tpu.serving.
+router` fronts N engines with telemetry-driven admission balancing,
+failover, and elastic drain/respawn, with replicas booting warm from
+the persisted AOT program cache (:mod:`paddle_tpu.serving.aot_cache`).
+
 See docs/serving.md for the architecture and the request lifecycle.
 """
+from paddle_tpu.serving import router
+from paddle_tpu.serving.aot_cache import (AOTProgramCache,
+                                          engine_fingerprint)
 from paddle_tpu.serving.engine import (EngineConfig, LLMEngine,
                                        PagedKVContext)
 from paddle_tpu.serving.metrics import EngineMetrics, Histogram
@@ -34,6 +42,7 @@ from paddle_tpu.serving.scheduler import (AdmissionRejected, Scheduler,
                                           bucket_for, default_buckets)
 
 __all__ = [
+    "AOTProgramCache",
     "AdmissionRejected",
     "EngineConfig",
     "EngineMetrics",
@@ -47,5 +56,7 @@ __all__ = [
     "Scheduler",
     "bucket_for",
     "default_buckets",
+    "engine_fingerprint",
+    "router",
     "sample_tokens",
 ]

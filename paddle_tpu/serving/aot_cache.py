@@ -1,0 +1,229 @@
+"""AOT program cache — compiled engine programs as deployment
+artifacts.
+
+The Julia-to-TPU model (PAPERS.md, arXiv:1810.09868) treats the
+whole-program XLA compilation as THE deployment artifact; this module
+applies it to the serving engine's closed program set.  `LLMEngine`
+compiles a small, countable family of executables (one prefill per
+bucket + one decode + two sampler widths); every one of them is pure
+data once compiled, so a cache directory keyed by an engine fingerprint
+turns replica scale-out from "recompile the bucket ladder" into "mmap a
+few files":
+
+- **Fingerprint.**  :func:`engine_fingerprint` hashes everything a
+  compiled program's correctness depends on — model config, engine
+  geometry (slots/pages/buckets/dtype), parameter tree (names, shapes,
+  dtypes — never values), mesh spec, jax/jaxlib versions, backend
+  platform, device kind and count.  Any component changing produces a
+  DIFFERENT fingerprint directory, so invalidation is structural: stale
+  entries are never loaded, only orphaned (and reapable via
+  :meth:`AOTProgramCache.evict_stale`).
+- **Entries.**  One file per program
+  (``<cache_dir>/<fingerprint>/<program>.jaxprog``), written atomically
+  (tmp + rename, the resilience checkpoint discipline) and containing a
+  versioned pickle of ``jax.experimental.serialize_executable``'s
+  ``(payload, in_tree, out_tree)`` triple.
+- **Degradation.**  A backend whose executables refuse serialization, a
+  torn/corrupt entry, or a deserialize failure all degrade to a normal
+  compile (recorded as a ``serving.aot_cache_miss`` span) — the cache
+  can make a boot faster, never wronger.
+
+The observability contract: a cache HIT loads an executable without
+touching the recompile log at all — a warm replica boot registers ZERO
+compile events — while misses flow through the engine's usual
+``note_aot_compile`` choke point.  ``tests/test_serving_router.py``
+asserts both directions.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import tempfile
+
+import jax
+
+from paddle_tpu.observability import span
+
+__all__ = ["AOTProgramCache", "engine_fingerprint"]
+
+# bump when the on-disk entry layout changes; folded into every
+# fingerprint so old trees are orphaned wholesale, never half-read
+FORMAT_VERSION = 1
+
+
+def _mesh_desc(mesh):
+    if mesh is None:
+        return None
+    return tuple((str(a), int(s)) for a, s in mesh.shape.items())
+
+
+def engine_fingerprint(model_config, engine_config, params, mesh=None):
+    """Hex digest naming the compiled-program family of one engine.
+
+    `params` contributes structure only (sorted name/shape/dtype) —
+    weights can be hot-swapped under a fingerprint because XLA compiled
+    against their avals, not their values.
+    """
+    import jaxlib
+
+    devices = jax.devices()
+    ec = engine_config
+    material = {
+        "format": FORMAT_VERSION,
+        "model_config": sorted(
+            (k, repr(v)) for k, v in vars(model_config).items()
+            if not k.startswith("_")),
+        "params": [(k, tuple(int(d) for d in v.shape), str(v.dtype))
+                   for k, v in sorted(params.items())],
+        "engine": (ec.max_num_seqs, ec.page_size, ec.max_model_len,
+                   ec.num_pages, tuple(ec.prefill_buckets),
+                   str(ec.dtype.__name__ if hasattr(ec.dtype, "__name__")
+                       else ec.dtype)),
+        "mesh": _mesh_desc(mesh),
+        "jax": jax.__version__,
+        "jaxlib": getattr(jaxlib, "__version__", "?"),
+        "backend": jax.default_backend(),
+        "device_kind": getattr(devices[0], "device_kind", ""),
+        "n_devices": len(devices),
+    }
+    return hashlib.sha256(repr(material).encode()).hexdigest()[:24]
+
+
+class AOTProgramCache:
+    """Persisted AOT engine programs under one cache directory.
+
+    Safe to share between replicas (and between processes on one host):
+    stores are atomic renames, loads never read a half-written entry,
+    and a concurrent double-store of the same key is benign (last
+    rename wins, both files identical by construction).
+    """
+
+    def __init__(self, cache_dir):
+        self.cache_dir = str(cache_dir)
+        os.makedirs(self.cache_dir, exist_ok=True)
+        # telemetry counters (reporting only; exact counts come from the
+        # engine's registry instruments)
+        self.hit_count = 0
+        self.miss_count = 0
+        self.store_count = 0
+        self.error_count = 0
+        # flipped off after the first "backend refuses serialization" so
+        # a TPU runtime without executable serialization pays the failed
+        # attempt exactly once
+        self._serialize_supported = True
+
+    # ------------------------------------------------------------ paths
+    def _entry_path(self, fingerprint, program):
+        safe = "".join(c if (c.isalnum() or c in "._-") else "_"
+                       for c in str(program))
+        return os.path.join(self.cache_dir, fingerprint,
+                            f"{safe}.jaxprog")
+
+    def entries(self, fingerprint):
+        """Program names currently persisted under `fingerprint`."""
+        d = os.path.join(self.cache_dir, fingerprint)
+        try:
+            return sorted(f[:-len(".jaxprog")] for f in os.listdir(d)
+                          if f.endswith(".jaxprog"))
+        except OSError:
+            return []
+
+    # ------------------------------------------------------------- load
+    def load(self, fingerprint, program):
+        """Deserialize one program; returns a callable
+        ``jax.stages.Compiled`` or None (miss / corrupt / unsupported).
+        A corrupt entry is unlinked so the follow-up compile's store
+        replaces it."""
+        path = self._entry_path(fingerprint, program)
+        try:
+            with open(path, "rb") as fh:
+                version, payload, in_tree, out_tree = pickle.load(fh)
+            if version != FORMAT_VERSION:
+                raise ValueError(f"format {version} != {FORMAT_VERSION}")
+            from jax.experimental import serialize_executable as se
+            compiled = se.deserialize_and_load(payload, in_tree, out_tree)
+        except FileNotFoundError:
+            self.miss_count += 1
+            return None
+        except Exception as e:  # corrupt / incompatible entry
+            self.error_count += 1
+            with span("serving.aot_cache_miss", program=str(program),
+                      why=type(e).__name__):
+                pass
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return None
+        self.hit_count += 1
+        return compiled
+
+    # ------------------------------------------------------------ store
+    def store(self, fingerprint, program, compiled):
+        """Serialize `compiled` under (fingerprint, program); returns
+        True on success.  Never raises — an unserializable backend or a
+        full disk degrades to "no cache", not a serving failure."""
+        if not self._serialize_supported:
+            return False
+        try:
+            from jax.experimental import serialize_executable as se
+            payload, in_tree, out_tree = se.serialize(compiled)
+        except Exception as e:
+            # ValueError("Compilation does not support serialization")
+            # on backends without executable serialization
+            self._serialize_supported = False
+            self.error_count += 1
+            with span("serving.aot_cache_disabled", why=type(e).__name__):
+                pass
+            return False
+        entry = self._entry_path(fingerprint, program)
+        d = os.path.dirname(entry)
+        try:
+            os.makedirs(d, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "wb") as fh:
+                    pickle.dump(
+                        (FORMAT_VERSION, payload, in_tree, out_tree), fh)
+                os.replace(tmp, entry)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+        except Exception:
+            self.error_count += 1
+            return False
+        self.store_count += 1
+        return True
+
+    # ------------------------------------------------------- maintenance
+    def evict_stale(self, keep_fingerprint):
+        """Remove every fingerprint directory EXCEPT `keep_fingerprint`
+        (deploy hygiene after a model/config/backend change).  Returns
+        the evicted fingerprints."""
+        evicted = []
+        try:
+            names = os.listdir(self.cache_dir)
+        except OSError:
+            return evicted
+        for name in names:
+            d = os.path.join(self.cache_dir, name)
+            if name == keep_fingerprint or not os.path.isdir(d):
+                continue
+            import shutil
+            shutil.rmtree(d, ignore_errors=True)
+            evicted.append(name)
+        return evicted
+
+    def stats(self):
+        return {
+            "dir": self.cache_dir,
+            "hits": self.hit_count,
+            "misses": self.miss_count,
+            "stores": self.store_count,
+            "errors": self.error_count,
+            "serialize_supported": self._serialize_supported,
+        }

@@ -83,6 +83,12 @@ class EngineConfig:
     - `health_*`: thresholds for the HEALTHY→DEGRADED→DRAINING state
       machine driven by live page-pool occupancy; DRAINING rejects new
       admissions until pressure falls.
+    - `mesh`: tp-sharding groundwork — a ``jax.sharding.Mesh`` (or a
+      ``{"tp": n}`` dict resolved over the first n devices) over which
+      the engine shards the per-layer paged KV pools along the HEAD
+      axis and the weights along their trailing hidden-multiple axis;
+      every program lowers as one SPMD computation over the mesh.
+      `num_heads` must divide by the tp extent.
     """
 
     def __init__(self, max_num_seqs=8, page_size=16, max_model_len=256,
@@ -91,7 +97,7 @@ class EngineConfig:
                  dtype=jnp.float32, finished_retention=1024,
                  max_queue_depth=None, crash_safe_decode=True,
                  health_degraded_at=0.85, health_drain_at=0.97,
-                 health_recover_at=0.70):
+                 health_recover_at=0.70, mesh=None):
         if max_num_seqs < 1:
             raise ValueError("max_num_seqs must be >= 1")
         self.max_num_seqs = int(max_num_seqs)
@@ -122,6 +128,7 @@ class EngineConfig:
         self.health_degraded_at = float(health_degraded_at)
         self.health_drain_at = float(health_drain_at)
         self.health_recover_at = float(health_recover_at)
+        self.mesh = mesh                 # Mesh | {"tp": n} | None
 
     @property
     def compile_bound(self):
@@ -211,7 +218,8 @@ class LLMEngine:
     :attr:`metrics`, :meth:`shutdown`.
     """
 
-    def __init__(self, model, config=None, metrics_name=None):
+    def __init__(self, model, config=None, metrics_name=None,
+                 program_cache=None):
         self.config = config or EngineConfig()
         cfg = self.config
         self._model = model
@@ -227,13 +235,20 @@ class LLMEngine:
                 f"max_seq_len {mc.max_seq_len}")
 
         self._params = {k: t._value for k, t in model.state_dict().items()}
+        self._init_mesh(cfg.mesh)
+        if self._mesh is not None:
+            self._params = {k: jax.device_put(
+                v, self._param_sharding(v))
+                for k, v in self._params.items()}
 
         B, P = cfg.max_num_seqs, cfg.max_pages_per_seq
         pool_shape = (cfg.num_pages, self._num_heads, cfg.page_size,
                       self._head_dim)
-        self._k_pools = [jnp.zeros(pool_shape, cfg.dtype)
+        self._k_pools = [self._place(jnp.zeros(pool_shape, cfg.dtype),
+                                     self._pool_sharding)
                          for _ in range(self._num_layers)]
-        self._v_pools = [jnp.zeros(pool_shape, cfg.dtype)
+        self._v_pools = [self._place(jnp.zeros(pool_shape, cfg.dtype),
+                                     self._pool_sharding)
                          for _ in range(self._num_layers)]
         self._tables = np.zeros((B, P), np.int32)      # host-canonical
         self._lens = np.zeros((B,), np.int32)          # host-canonical
@@ -265,6 +280,20 @@ class LLMEngine:
             gauge=self.metrics.health_state)
         self._decode_fault_streak = 0
 
+        # AOT program cache (serving/aot_cache.py): a warm boot loads
+        # every program this engine would compile instead of compiling
+        # it — the whole-program-compilation-as-deployment-artifact
+        # model.  A str is a cache directory; None disables.
+        if isinstance(program_cache, str):
+            from paddle_tpu.serving.aot_cache import AOTProgramCache
+            program_cache = AOTProgramCache(program_cache)
+        self._program_cache = program_cache
+        self._program_fp = None
+        if program_cache is not None:
+            from paddle_tpu.serving.aot_cache import engine_fingerprint
+            self._program_fp = engine_fingerprint(
+                mc, cfg, self._params, self._mesh)
+
         self._compiled = {}
         self._requests = {}          # live (queued or running) only
         # finished requests move here (bounded by finished_retention);
@@ -292,6 +321,86 @@ class LLMEngine:
 
         self._snapshot_fn = _snapshot
         profiler.register_metrics_source(name, _snapshot)
+
+    # ------------------------------------------------- mesh groundwork
+    def _init_mesh(self, mesh):
+        """Resolve EngineConfig.mesh into (mesh, shardings).
+
+        tp groundwork (ROADMAP item 3): the paged KV pools shard along
+        the HEAD axis (pool axis 1) and every other operand is either
+        mesh-replicated or weight-sharded by :meth:`_param_sharding`;
+        all programs then lower as SPMD computations over the mesh.
+        A ``{"tp": n}`` dict builds a mesh over the first n devices
+        (virtual CPU devices in tests, real chips on TPU).
+        """
+        if mesh is None:
+            self._mesh = None
+            self._repl_sharding = None
+            self._pool_sharding = None
+            return
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+        if isinstance(mesh, dict):
+            axes = tuple(mesh.keys())
+            shape = tuple(int(s) for s in mesh.values())
+            n = 1
+            for s in shape:
+                n *= s
+            devices = jax.devices()
+            if n > len(devices):
+                raise ValueError(
+                    f"mesh {dict(mesh)} needs {n} devices but only "
+                    f"{len(devices)} are visible")
+            mesh = Mesh(np.asarray(devices[:n]).reshape(shape), axes)
+        tp = int(mesh.shape.get("tp", 1))
+        if tp > 1 and self._num_heads % tp:
+            raise ValueError(
+                f"num_heads {self._num_heads} must divide by the tp "
+                f"extent {tp} to shard KV pools along the head axis")
+        self._mesh = mesh
+        self._repl_sharding = NamedSharding(mesh, PartitionSpec())
+        # pool layout [num_pages, heads, page_size, head_dim]: axis 1
+        # IS the head axis
+        self._pool_sharding = NamedSharding(
+            mesh, PartitionSpec(None, "tp"))
+
+    def _param_sharding(self, arr):
+        """Head-axis weight sharding heuristic: shard the LAST axis
+        whose extent is a multiple of hidden (= heads * head_dim) over
+        tp — column-parallel projections and embeddings — and replicate
+        everything else (LN scales, biases, scalar state).  A
+        best-effort groundwork rule: any consistent choice is
+        numerically a relayout, and GSPMD inserts the collectives."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        tp = int(self._mesh.shape.get("tp", 1))
+        hidden = self._num_heads * self._head_dim
+        if tp > 1 and getattr(arr, "ndim", 0) >= 2:
+            for ax in range(arr.ndim - 1, -1, -1):
+                d = int(arr.shape[ax])
+                if d and d % hidden == 0 and (d // tp) % (
+                        self._head_dim) == 0:
+                    spec = [None] * arr.ndim
+                    spec[ax] = "tp"
+                    return NamedSharding(self._mesh,
+                                         PartitionSpec(*spec))
+        return self._repl_sharding
+
+    def _place(self, value, sharding=None):
+        """Device placement for program operands: plain ``asarray``
+        off-mesh; an explicit mesh placement (replicated by default) on
+        the mesh, so every input of an SPMD program lives on the same
+        device set."""
+        if self._mesh is None:
+            return jnp.asarray(value)
+        return jax.device_put(np.asarray(value) if not isinstance(
+            value, jax.Array) else value,
+            sharding if sharding is not None else self._repl_sharding)
+
+    @property
+    def program_fingerprint(self):
+        """The AOT-cache fingerprint (None when no cache is attached):
+        model config + param tree + engine geometry + mesh + jax/backend
+        versions — docs/serving.md 'AOT program cache' has the schema."""
+        return self._program_fp
 
     # ------------------------------------------------------------ API
     def _resolve_params(self, sampling_params):
@@ -366,9 +475,104 @@ class LLMEngine:
         self.metrics.requests_received += 1
         return rid
 
+    def adopt_request(self, prompt_token_ids, sampling_params=None,
+                      generated_token_ids=(), stream=None, streamed=None,
+                      arrive_t=None, arrival_index=None):
+        """Router failover hook: enqueue a request that already
+        generated tokens on ANOTHER replica.  The adopted request
+        enters at the queue FRONT in the evicted-replay posture —
+        ``generated_token_ids`` ride along in ``replay_token_ids``, the
+        replay prefill reconstructs the KV cache, and the (seed,
+        absolute-position) sampler regenerates the continuation
+        token-identically — so a replica crash or drain migrates work
+        with zero data loss and zero token divergence.
+
+        `streamed` marks how many tokens the ORIGIN already delivered
+        to the stream callback (default: all of `generated_token_ids`),
+        so the new replica never re-streams them.  `arrive_t` carries
+        the ORIGINAL arrival time (same `metrics.clock` timebase) so a
+        `deadline_s` TTL keeps counting from first arrival instead of
+        restarting on every migration, and `arrival_index` carries the
+        caller's global age ordering so the fleet-oldest request does
+        not become this engine's freshest — and therefore preferred —
+        LIFO preemption victim.  Raises
+        :class:`AdmissionRejected` while this engine is DRAINING, and
+        ``ValueError`` when the replayed request could never be served
+        here — both leave the request with the caller."""
+        sp = self._resolve_params(sampling_params)
+        prompt = [int(t) for t in prompt_token_ids]
+        generated = [int(t) for t in generated_token_ids]
+        self._validate_request(prompt, sp)
+        if len(generated) >= sp.max_new_tokens:
+            raise ValueError(
+                f"request already finished ({len(generated)} of "
+                f"{sp.max_new_tokens} tokens) — nothing to adopt")
+        if not self.health.admitting:
+            self.metrics.requests_rejected += 1
+            raise AdmissionRejected(
+                "draining",
+                f"engine {self._metrics_name} page-pool pressure "
+                f"{self.health.last_pressure:.2f}")
+        rid = f"req-{self._next_id}"
+        req = Request(rid, prompt, sp,
+                      arrival_index=(self._next_id if arrival_index
+                                     is None else int(arrival_index)),
+                      stream=stream)
+        req.output_token_ids = generated
+        req._streamed = len(generated) if streamed is None \
+            else min(int(streamed), len(generated))
+        # adopted == evicted-elsewhere: requests_admitted/ttft are the
+        # ORIGIN replica's events, not this one's
+        req.num_evictions = 1
+        req.arrive_t = (self.metrics.clock() if arrive_t is None
+                        else float(arrive_t))
+        if sp.deadline_s is not None:
+            req.deadline_t = req.arrive_t + sp.deadline_s
+        self.scheduler.requeue_front(req)
+        self._next_id += 1
+        self._requests[rid] = req
+        self.metrics.requests_adopted += 1
+        with span("serving.adopt", request=rid,
+                  generated=len(generated)):
+            pass
+        return rid
+
+    def release_waiting(self):
+        """Router drain hook: withdraw every still-QUEUED request
+        (freshly waiting or evicted-and-requeued — none own slots or
+        pages) and hand the Request objects to the caller, which now
+        owns their fate (typically ``adopt_request`` on another
+        replica).  Running requests are untouched: their pages are
+        local, so they finish here."""
+        reqs = self.scheduler.drain_waiting()
+        for r in reqs:
+            self._requests.pop(r.request_id, None)
+        if reqs:
+            with span("serving.release_waiting", count=len(reqs)):
+                pass
+        return reqs
+
     def has_unfinished(self):
         return (self.scheduler.has_waiting()
                 or any(r is not None for r in self._slots))
+
+    # live admission telemetry — the same signals the step-boundary
+    # scrape gauges export, read at the source so an in-process router
+    # balancing a BURST of admissions between steps sees each one land
+    @property
+    def queue_depth(self):
+        return self.scheduler.queue_depth
+
+    @property
+    def num_running(self):
+        return sum(1 for r in self._slots if r is not None)
+
+    @property
+    def page_occupancy(self):
+        total = self.config.num_pages - 1      # page 0 reserved
+        if not total:
+            return 0.0
+        return (total - self._alloc.num_free_pages) / total
 
     def step(self):
         """One engine iteration: admit + prefill new requests at the
@@ -506,8 +710,8 @@ class LLMEngine:
         fn = self._get_prefill(bucket)
         last_logits, self._k_pools, self._v_pools = fn(
             self._params, self._k_pools, self._v_pools,
-            jnp.asarray(self._tables[slot:slot + 1]), jnp.asarray(ids),
-            jnp.asarray(pos_ids), jnp.asarray(length))
+            self._place(self._tables[slot:slot + 1]), self._place(ids),
+            self._place(pos_ids), self._place(length))
         self._lens[slot] = L
 
         tok = self._sample(last_logits, [req], width=1)[0]
@@ -582,8 +786,8 @@ class LLMEngine:
             _fire("serving.decode", step=self.metrics.decode_steps)
             logits, self._k_pools, self._v_pools = fn(
                 self._params, self._k_pools, self._v_pools,
-                jnp.asarray(self._tables), jnp.asarray(self._lens),
-                jnp.asarray(tokens))
+                self._place(self._tables), self._place(self._lens),
+                self._place(tokens))
         except Exception as e:
             if not cfg.crash_safe_decode:
                 raise
@@ -656,9 +860,9 @@ class LLMEngine:
             top_ks[i] = sp.top_k
             top_ps[i] = sp.top_p
         fn = self._get_sampler(width)
-        out = fn(jnp.asarray(logits), jnp.asarray(seeds),
-                 jnp.asarray(pos), jnp.asarray(temps),
-                 jnp.asarray(top_ks), jnp.asarray(top_ps))
+        out = fn(self._place(logits), self._place(seeds),
+                 self._place(pos), self._place(temps),
+                 self._place(top_ks), self._place(top_ps))
         return [int(t) for t in np.asarray(out)]
 
     # ------------------------------------------------- finish / evict
@@ -733,10 +937,22 @@ class LLMEngine:
             for t, v in saved:
                 t._value = v
 
+    def _step_out_shardings(self):
+        """out_shardings for the prefill/decode step programs in mesh
+        mode (None otherwise): logits replicated, pools keeping their
+        head-axis sharding — pinning the output layout to the input
+        layout is what keeps the pool arrays reusable call-over-call
+        without a resharding copy (or a surprise cache miss)."""
+        if self._mesh is None:
+            return None
+        return (self._repl_sharding,
+                [self._pool_sharding] * self._num_layers,
+                [self._pool_sharding] * self._num_layers)
+
     def _prefill_program(self, bucket):
-        """(fn, example_args, donate) for one prefill bucket — shared by
-        the compile path and the shardlint self-audit (which traces the
-        SAME program, never a lookalike)."""
+        """(fn, example_args, donate, out_shardings) for one prefill
+        bucket — shared by the compile path and the shardlint self-audit
+        (which traces the SAME program, never a lookalike)."""
         cfg = self.config
 
         def prefill(params, k_pools, v_pools, row_table, ids, pos_ids,
@@ -755,7 +971,8 @@ class LLMEngine:
             jnp.zeros((1, cfg.max_pages_per_seq), jnp.int32),
             jnp.zeros((1, bucket), jnp.int32),
             jnp.zeros((1, bucket), jnp.int32),
-            jnp.zeros((1,), jnp.int32)), (1, 2)
+            jnp.zeros((1,), jnp.int32)), (1, 2), \
+            self._step_out_shardings()
 
     def _decode_program(self):
         cfg = self.config
@@ -772,7 +989,8 @@ class LLMEngine:
             jnp.zeros((cfg.max_num_seqs, cfg.max_pages_per_seq),
                       jnp.int32),
             jnp.zeros((cfg.max_num_seqs,), jnp.int32),
-            jnp.zeros((cfg.max_num_seqs, 1), jnp.int32)), (1, 2)
+            jnp.zeros((cfg.max_num_seqs, 1), jnp.int32)), (1, 2), \
+            self._step_out_shardings()
 
     def _sampler_program(self, width):
         V = int(self._model.config.vocab_size)
@@ -782,28 +1000,51 @@ class LLMEngine:
             jnp.zeros((width,), jnp.int32),
             jnp.zeros((width,), jnp.float32),
             jnp.zeros((width,), jnp.int32),
-            jnp.ones((width,), jnp.float32)), ()
+            jnp.ones((width,), jnp.float32)), (), \
+            (self._repl_sharding if self._mesh is not None else None)
 
     def _get_prefill(self, bucket):
         key = ("prefill", bucket)
         if key in self._compiled:
             return self._compiled[key]
-        fn, example, donate = self._prefill_program(bucket)
-        return self._compile(key, fn, example, donate=donate)
+        fn, example, donate, out_sh = self._prefill_program(bucket)
+        return self._compile(key, fn, example, donate=donate,
+                             out_shardings=out_sh)
 
     def _get_decode(self):
         key = ("decode",)
         if key in self._compiled:
             return self._compiled[key]
-        fn, example, donate = self._decode_program()
-        return self._compile(key, fn, example, donate=donate)
+        fn, example, donate, out_sh = self._decode_program()
+        return self._compile(key, fn, example, donate=donate,
+                             out_shardings=out_sh)
 
     def _get_sampler(self, width):
         key = ("sample", width)
         if key in self._compiled:
             return self._compiled[key]
-        fn, example, donate = self._sampler_program(width)
-        return self._compile(key, fn, example, donate=donate)
+        fn, example, donate, out_sh = self._sampler_program(width)
+        return self._compile(key, fn, example, donate=donate,
+                             out_shardings=out_sh)
+
+    def warmup(self):
+        """Boot hook: compile — or load from the AOT program cache —
+        EVERY program this engine can ever run (each prefill bucket,
+        the decode step, both sampler widths).  Returns a summary dict;
+        ``boot_ms`` is the cold-vs-warm number the router bench lane
+        reports.  Idempotent."""
+        t0 = time.perf_counter()
+        for b in self.config.prefill_buckets:
+            self._get_prefill(b)
+        self._get_decode()
+        self._get_sampler(1)
+        self._get_sampler(self.config.max_num_seqs)
+        return {
+            "programs": len(self._compiled),
+            "compiled": self.metrics.compile_count,
+            "cache_loads": self.metrics.aot_cache_loads,
+            "boot_ms": round((time.perf_counter() - t0) * 1e3, 3),
+        }
 
     # ---------------------------------------------------- self-audit
     @property
@@ -832,12 +1073,12 @@ class LLMEngine:
         import jax
         progs = {}
         for b in self.config.prefill_buckets:
-            fn, example, _ = self._prefill_program(b)
+            fn, example, *_ = self._prefill_program(b)
             progs[f"prefill_{b}"] = jax.jit(fn).trace(*example).jaxpr
-        fn, example, _ = self._decode_program()
+        fn, example, *_ = self._decode_program()
         progs["decode"] = jax.jit(fn).trace(*example).jaxpr
         for width in (1, self.config.max_num_seqs):
-            fn, example, _ = self._sampler_program(width)
+            fn, example, *_ = self._sampler_program(width)
             progs[f"sample_{width}"] = jax.jit(fn).trace(*example).jaxpr
         return progs
 
@@ -868,21 +1109,50 @@ class LLMEngine:
             out["programs"][name] = d
         return out
 
-    def _compile(self, key, fn, example_args, donate=()):
+    def _compile(self, key, fn, example_args, donate=(),
+                 out_shardings=None):
         """AOT compile + count: every program the engine will ever run
         passes through here, so `metrics.compile_count` is exact.
 
         `donate` names arg positions (the KV pools) XLA may alias
         in-place — without it every decode step materializes a second
         copy of the whole cache.  CPU's backend can't donate these and
-        would warn on every call, so donation is accelerator-only."""
-        shapes = jax.tree_util.tree_map(
-            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), example_args)
+        would warn on every call, so donation is accelerator-only.
+
+        With an AOT program cache attached, the cache is consulted
+        FIRST: a hit loads the persisted executable and records NO
+        compile event anywhere (the warm-boot contract the router's
+        zero-recompile acceptance test pins); a miss compiles as usual
+        and persists the result for the next replica."""
+        prog_name = "/".join(str(p) for p in key)
+        if self._program_cache is not None:
+            compiled = self._program_cache.load(self._program_fp,
+                                               prog_name)
+            if compiled is not None:
+                with span("serving.aot_load", program=str(key),
+                          fingerprint=self._program_fp):
+                    pass
+                self.metrics.note_aot_load()
+                self._compiled[key] = compiled
+                return compiled
+
+        def _struct(a):
+            if self._mesh is None:
+                return jax.ShapeDtypeStruct(a.shape, a.dtype)
+            sh = getattr(a, "sharding", None)
+            if not isinstance(sh, jax.sharding.NamedSharding):
+                sh = self._repl_sharding    # host-built example operand
+            return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh)
+
+        shapes = jax.tree_util.tree_map(_struct, example_args)
         if jax.default_backend() == "cpu":
             donate = ()
+        jit_kw = {"donate_argnums": donate}
+        if out_shardings is not None:
+            jit_kw["out_shardings"] = out_shardings
         t0 = time.perf_counter()
         with span("serving.compile", program=str(key)):
-            compiled = jax.jit(fn, donate_argnums=donate).lower(
+            compiled = jax.jit(fn, **jit_kw).lower(
                 *shapes).compile()
         # the serving compile choke point reports into the same
         # recompile log as StaticFunction cache misses: one timeline
@@ -892,10 +1162,13 @@ class LLMEngine:
         # a storm RuntimeError leaves no over-bound program behind that
         # a catch-and-retry caller could silently keep serving from
         note_aot_compile(
-            "/".join(str(p) for p in key),
+            prog_name,
             compile_ms=round((time.perf_counter() - t0) * 1e3, 3),
             cache_size=len(self._compiled) + 1,
             bound=self.config.compile_bound, engine=self._metrics_name)
         self.metrics.note_compile()
         self._compiled[key] = compiled
+        if self._program_cache is not None:
+            self._program_cache.store(self._program_fp, prog_name,
+                                      compiled)
         return compiled

@@ -82,6 +82,7 @@ class EngineMetrics:
         self.requests_evicted = 0
         self.requests_rejected = 0   # backpressure (queue_full/draining)
         self.requests_expired = 0    # deadline enforcement
+        self.requests_adopted = 0    # router failover migrations in
         self.decode_fault_recoveries = 0
         self.prefill_steps = 0
         self.decode_steps = 0
@@ -89,9 +90,13 @@ class EngineMetrics:
         self.generated_tokens = 0
         self.compile_count = 0
         self.compile_bound = 0
+        self.aot_cache_loads = 0     # warm-boot program-cache hits
         self._compile_counter = reg.counter(
             "serving_compile_total", labels=labels,
             help="XLA programs compiled by the serving engine")
+        self._aot_load_counter = reg.counter(
+            "serving_aot_load_total", labels=labels,
+            help="programs loaded from the AOT cache instead of compiled")
         # gauges (engine pushes current values)
         self.queue_depth = 0
         self.running = 0
@@ -147,6 +152,13 @@ class EngineMetrics:
             self.pages_in_use / self.pages_total if self.pages_total
             else 0.0)
 
+    def note_aot_load(self):
+        """One program loaded from the persisted AOT cache — NOT a
+        compile: deliberately outside `note_compile` and the recompile
+        log, so a warm boot's compile count stays zero."""
+        self.aot_cache_loads += 1
+        self._aot_load_counter.inc()
+
     def note_compile(self):
         self.compile_count += 1
         self._compile_counter.inc()
@@ -169,6 +181,7 @@ class EngineMetrics:
                 "evicted": self.requests_evicted,
                 "rejected": self.requests_rejected,
                 "expired": self.requests_expired,
+                "adopted": self.requests_adopted,
             },
             "queue_depth": self.queue_depth,
             "running": self.running,
@@ -193,6 +206,7 @@ class EngineMetrics:
             "compiles": {
                 "count": self.compile_count,
                 "bound": self.compile_bound,
+                "cache_loads": self.aot_cache_loads,
             },
             "ttft_ms": self.ttft.summary(),
             "inter_token_ms": self.inter_token.summary(),

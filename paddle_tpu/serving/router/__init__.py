@@ -1,0 +1,47 @@
+"""paddle_tpu.serving.router — telemetry-driven multi-replica serving.
+
+A :class:`Router` fronts N :class:`~paddle_tpu.serving.LLMEngine`
+replicas and balances admissions on the fleet's live telemetry (queue
+depth, page occupancy, health state), with sticky request→replica
+affinity, ``AdmissionRejected``-aware spillover + retry, failover
+migration that loses no tokens, and elastic drain/respawn — replicas
+booting WARM from the persisted AOT program cache
+(:mod:`paddle_tpu.serving.aot_cache`).
+
+Quickstart::
+
+    from paddle_tpu import serving
+    from paddle_tpu.serving.router import Router
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt3_tiny
+
+    router = Router(GPTForCausalLM(gpt3_tiny()),
+                    serving.EngineConfig(max_num_seqs=8,
+                                         max_model_len=128),
+                    num_replicas=3,
+                    program_cache="/var/cache/paddle_tpu/aot")
+    results = router.generate(
+        [[12, 7, 9], [4, 4, 8, 1]],
+        serving.SamplingParams(max_new_tokens=16, seed=1))
+    router.drain(0)          # elastic: finish work, respawn warm
+    router.shutdown()
+
+See docs/serving.md "Multi-replica routing" for the architecture and
+the token-identity / failover contracts.
+"""
+from paddle_tpu.serving.aot_cache import (AOTProgramCache,
+                                          engine_fingerprint)
+from paddle_tpu.serving.router.metrics import RouterMetrics
+from paddle_tpu.serving.router.replica import ReplicaHandle, ReplicaState
+from paddle_tpu.serving.router.router import (Router, RouterConfig,
+                                              RouterResult)
+
+__all__ = [
+    "AOTProgramCache",
+    "ReplicaHandle",
+    "ReplicaState",
+    "Router",
+    "RouterConfig",
+    "RouterMetrics",
+    "RouterResult",
+    "engine_fingerprint",
+]

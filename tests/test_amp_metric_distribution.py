@@ -8,6 +8,7 @@ from paddle_tpu import distribution as D
 
 
 class TestAutoCast:
+    @pytest.mark.smoke
     def test_matmul_runs_bf16_inside_autocast(self):
         x = paddle_tpu.ones([4, 4], dtype="float32")
         with amp.auto_cast(level="O1", dtype="bfloat16"):

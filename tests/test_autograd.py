@@ -1,4 +1,5 @@
 """Autograd engine tests: eager tape vs jax.grad oracle (SURVEY.md §4)."""
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -13,6 +14,7 @@ def leaf(a):
 
 
 class TestBackward:
+    @pytest.mark.smoke
     def test_simple_chain(self):
         x = leaf(np.asarray([1.0, 2.0, 3.0], np.float32))
         y = (x * x + 2 * x).sum()

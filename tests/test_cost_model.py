@@ -1,7 +1,9 @@
 """paddle.cost_model surface (r5; reference python/paddle/cost_model/)."""
+import pytest
 import paddle_tpu as P
 
 
+@pytest.mark.smoke
 def test_cost_model_profile_measure():
     cm = P.cost_model.CostModel()
     step, args = cm.build_program()

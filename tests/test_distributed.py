@@ -46,6 +46,7 @@ class TestCollectives:
         return shard_map(wrapped, mesh=mesh, in_specs=in_spec,
                          out_specs=out_spec)(x)
 
+    @pytest.mark.smoke
     def test_all_reduce_sum(self):
         x = np.arange(8, dtype=np.float32).reshape(8, 1)
 

@@ -1,0 +1,95 @@
+"""Real multi-process CPU collectives: 2 OS processes bootstrapped by
+``paddle_tpu.distributed.launch`` + ``jax.distributed.initialize``.
+
+Everything else in the suite runs multi-"device" inside ONE process
+(the 8 virtual CPU devices conftest forces); this test is the proof
+that the launcher's coordinator bootstrap and the eager multi-host
+collective path work across genuine process boundaries (VERDICT item
+9): two children rendezvous over a local gRPC coordinator, see
+``process_count() == 2``, and an ``all_reduce`` returns the
+cross-process sum on both ranks.
+
+Kept deliberately small (1 CPU device per child, one tiny collective)
+so the wall cost is coordinator startup, not compute; a generous
+deadline absorbs slow CI boxes, and failure modes (port clash, wedged
+rendezvous) surface as missing result files with captured child logs.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(HERE, "_multiprocess_worker.py")
+DEADLINE_S = 120.0
+
+
+def _free_port():
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(rank, port, out_dir):
+    env = dict(os.environ)
+    # fresh processes: pin the CPU backend explicitly (conftest's env
+    # is inherited but make the contract local), ONE device per process
+    # so the two-process world is unmistakably cross-process
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env.pop("PADDLE_MASTER", None)
+    env.pop("PADDLE_NNODES", None)
+    env.pop("PADDLE_TRAINER_ID", None)
+    return subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--master", f"127.0.0.1:{port}", "--nnodes", "2",
+         "--rank", str(rank), WORKER, out_dir],
+        cwd=os.path.dirname(HERE), env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+
+
+def test_two_process_all_reduce_via_launch(tmp_path):
+    port = _free_port()
+    procs = [_spawn(rank, port, str(tmp_path)) for rank in (0, 1)]
+    outputs = {}
+    try:
+        deadline = time.monotonic() + DEADLINE_S
+        for rank, p in enumerate(procs):
+            remaining = max(1.0, deadline - time.monotonic())
+            try:
+                out, _ = p.communicate(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+                pytest.fail(
+                    f"rank {rank} did not finish within {DEADLINE_S}s "
+                    f"— coordinator rendezvous wedged?\n--- child log "
+                    f"---\n{out[-2000:]}")
+            outputs[rank] = out
+            assert p.returncode == 0, (
+                f"rank {rank} exited rc={p.returncode}\n--- child log "
+                f"---\n{out[-2000:]}")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    results = {}
+    for rank in (0, 1):
+        path = tmp_path / f"rank{rank}.json"
+        assert path.exists(), (
+            f"rank {rank} wrote no result\n--- child log ---\n"
+            f"{outputs.get(rank, '')[-2000:]}")
+        results[rank] = json.loads(path.read_text())
+
+    for rank, res in results.items():
+        assert res["nprocs"] == 2, res
+        # SUM over ranks: [1, 10] + [2, 20] on every process
+        assert res["reduced"] == [3.0, 30.0], res
+        assert res["ranks_seen"] == [0, 1], res
+        assert res["broadcast"] == 101.0, res    # rank 1's value
+    assert {results[0]["rank"], results[1]["rank"]} == {0, 1}

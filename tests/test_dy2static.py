@@ -20,6 +20,7 @@ def _arr(*v):
 
 
 class TestTensorIf:
+    @pytest.mark.smoke
     def test_assignment_if_both_paths(self):
         @p.jit.to_static
         def f(x):

@@ -86,6 +86,7 @@ class TestErnie:
 
 
 class TestViT:
+    @pytest.mark.smoke
     def test_forward_backward(self):
         P.seed(0)
         cfg = vit_tiny(num_layers=1)

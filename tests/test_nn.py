@@ -15,6 +15,7 @@ def t(a, sg=True):
 
 
 class TestLinearConv:
+    @pytest.mark.smoke
     def test_linear(self):
         layer = nn.Linear(4, 3)
         x = t(np.random.default_rng(0).standard_normal((2, 4)))

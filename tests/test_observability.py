@@ -30,6 +30,7 @@ pytestmark = pytest.mark.obs
 
 # ===================================================================== spans
 class TestSpans:
+    @pytest.mark.smoke
     def test_nesting_depth_and_order(self):
         rec = obs.recorder()
         before = rec.total_recorded

@@ -25,6 +25,7 @@ def _dense_attn(q, ks, vs):
     return out
 
 
+@pytest.mark.smoke
 def test_ragged_decode_with_release_and_reuse():
     """Continuation batching proper: rows finish at different lengths,
     release their pages, and RESTART as new sequences — lengths diverge

@@ -57,6 +57,7 @@ def _run(cmd, timeout=240):
 
 
 # ------------------------------------------------------------ cost model
+@pytest.mark.smoke
 def test_eqn_cost_dot_general_exact():
     import jax
     import jax.numpy as jnp

@@ -7,6 +7,7 @@ from paddle_tpu import nn, quantization as Q
 
 
 class TestQuantizers:
+    @pytest.mark.smoke
     def test_absmax(self):
         q = Q.AbsmaxQuantizer()
         q.sample(paddle.to_tensor(np.array([-4.0, 2.0], np.float32))._value)

@@ -85,6 +85,7 @@ RL101_CLEAN = """
 
 
 class TestRL101:
+    @pytest.mark.smoke
     def test_flagged(self, tmp_path):
         fs = lint_src(tmp_path, RL101_FLAGGED)
         assert "RL101" in codes(fs)

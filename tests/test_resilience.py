@@ -23,6 +23,7 @@ pytestmark = pytest.mark.resilience
 
 # --------------------------------------------------------- checkpointing
 class TestCheckpointer:
+    @pytest.mark.smoke
     def test_atomic_roundtrip_and_manifest(self, tmp_path):
         ck = R.Checkpointer(str(tmp_path), keep=3)
         ck.save(1, {"w": np.arange(4.0), "step": 1})

@@ -55,6 +55,7 @@ def _req(i, prompt_len=4, **sp):
 
 
 class TestScheduler:
+    @pytest.mark.smoke
     def test_fcfs_order_and_head_of_line_blocking(self):
         s = Scheduler(buckets=(16,), page_size=4, growth_reserve_pages=0)
         big = _req(0, prompt_len=16)     # needs 4 pages

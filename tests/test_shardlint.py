@@ -52,6 +52,7 @@ def _big_param_inputs(sharded):
                       nbytes=600 * 1000 * 4)]
 
 
+@pytest.mark.smoke
 def test_sl101_large_replicated_param():
     jaxpr = jax.make_jaxpr(lambda w: w * 2)(
         jnp.ones((600, 1000), jnp.float32))

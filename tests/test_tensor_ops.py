@@ -11,6 +11,7 @@ def npt(*shape, seed=0):
 
 
 class TestCreation:
+    @pytest.mark.smoke
     def test_to_tensor(self):
         x = P.to_tensor([[1.0, 2.0], [3.0, 4.0]])
         assert x.shape == [2, 2]

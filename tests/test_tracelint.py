@@ -32,6 +32,7 @@ def codes_of(src):
 
 
 # --------------------------------------------------------------- TL0xx
+@pytest.mark.smoke
 def test_tl001_return_in_loop():
     src = """
     from paddle_tpu.jit import to_static

@@ -82,7 +82,8 @@ def has_attr_path(obj, name):
 # paddle_tpu-NATIVE namespaces with no reference-paddle analogue: their
 # declared public surface (__all__) is the contract; a name that stops
 # resolving is a regression exactly like a reference-parity gap.
-NATIVE_NAMESPACES = ("serving", "analysis", "observability")
+NATIVE_NAMESPACES = ("serving", "serving.router", "analysis",
+                     "observability")
 
 
 def collect_native():
