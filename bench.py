@@ -49,6 +49,7 @@ SERVING_TPU_S = 150
 ROUTER_S = 240
 SHARDLINT_S = 150
 RACELINT_S = 90
+NUMLINT_S = 150
 OBS_S = 150
 RESIL_S = 150
 PROFILE_S = 150
@@ -699,6 +700,25 @@ def worker_router():
     return 0
 
 
+def worker_numlint():
+    """Static-analysis lane #3: numlint's numerics & precision-flow
+    audit of the flagship programs (finding count + per-rule
+    breakdown).  Pure CPU trace, concurrent with the probe — every
+    BENCH run records the numerics-hazard picture next to the
+    shardlint cost audit."""
+    _init_backend()   # honors PTPU_FORCE_CPU (always set for this lane)
+    tools_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools")
+    sys.path.insert(0, tools_dir)
+    try:
+        import numlint
+        out = numlint.bench_report()
+    finally:
+        sys.path.remove(tools_dir)
+    print(json.dumps(out), flush=True)
+    return 0
+
+
 def worker_racelint():
     """Static-analysis lane #2: racelint's host-concurrency audit of
     the whole package (finding count + per-rule breakdown).  Pure
@@ -1027,6 +1047,8 @@ def main():
         return worker_shardlint()
     if "--worker-racelint" in sys.argv:
         return worker_racelint()
+    if "--worker-numlint" in sys.argv:
+        return worker_numlint()
     if "--worker-obs" in sys.argv:
         return worker_obs()
     if "--worker-profile" in sys.argv:
@@ -1046,6 +1068,7 @@ def main():
     # ride along on every report — live, cached, or degraded
     sl_proc = _spawn("--worker-shardlint", force_cpu=True)
     rl_proc = _spawn("--worker-racelint", force_cpu=True)
+    nl_proc = _spawn("--worker-numlint", force_cpu=True)
     obs_proc = _spawn("--worker-obs", force_cpu=True)
     resil_proc = _spawn("--worker-resilience", force_cpu=True)
     prof_proc = _spawn("--worker-profile", force_cpu=True)
@@ -1079,6 +1102,13 @@ def main():
     else:
         # same rationale as shardlint_error
         merged["racelint_error"] = str(rl_err)
+
+    nl_res, nl_err, _ = _await_json(nl_proc, NUMLINT_S)
+    if nl_res is not None:
+        merged.update(nl_res)
+    else:
+        # same rationale as shardlint_error
+        merged["numlint_error"] = str(nl_err)
 
     obs_res, obs_err, _ = _await_json(obs_proc, OBS_S)
     if obs_res is not None:
@@ -1144,6 +1174,7 @@ def main():
         # platform really was the TPU; only the freshness is degraded.
         _adopt_lane("shardlint_", "shardlint_findings", sl_err)
         _adopt_lane("racelint_", "racelint_finding_count", rl_err)
+        _adopt_lane("numlint_", "numlint_finding_count", nl_err)
         _adopt_lane("obs_", "obs_span_overhead_pct", obs_err)
         _adopt_lane("resilience_", "resilience_ckpt_write_ms",
                     resil_err)

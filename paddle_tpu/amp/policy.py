@@ -31,6 +31,12 @@ docs/performance_guide.md): params and optimizer math stay f32; the
 bf16 activations bound the loss drift — the 20-step gpt-tiny
 trajectory stays within the documented tolerance of the f32 run, and
 the serving path (which never enables the policy) is token-identical.
+Since PR 12 the contract is also enforced STATICALLY: numlint
+(analysis/num_rules.py, docs/numlint.md) proves on every audited trace
+that masters/moments stay f32 (NL103) and that the optimizer-facing
+grad reductions the policy's downcasts induce accumulate wide (NL101 —
+F.linear/paddle.matmul own the master downcast inside custom_vjps so
+dw/db contract in f32 and land f32).
 """
 from __future__ import annotations
 

@@ -18,9 +18,12 @@ Per-line suppression: `# tracelint: disable=TL101`; whole file:
 Siblings sharing the rule registry, the Finding/baseline machinery
 (`analysis/common.py`) and the suppression syntax: **shardlint**
 (`shard_rules.py`/`cost_audit.py`, SLxxx over traced jaxprs — see
-`tools/shardlint.py`) and **racelint** (`lock_model.py`/`race_rules.py`,
+`tools/shardlint.py`), **racelint** (`lock_model.py`/`race_rules.py`,
 RLxxx host-runtime concurrency audit, plus the runtime lock-order
-sanitizer in `lock_tracer.py` — see `tools/racelint.py`).
+sanitizer in `lock_tracer.py` — see `tools/racelint.py`) and
+**numlint** (`dtype_flow.py`/`num_rules.py`, NLxxx numerics &
+precision-flow audit over traced jaxprs — see `tools/numlint.py` and
+docs/numlint.md).
 """
 from __future__ import annotations
 
@@ -42,12 +45,21 @@ from paddle_tpu.analysis.shard_rules import (  # noqa: F401
 from paddle_tpu.analysis.cost_audit import CostReport  # noqa: F401
 from paddle_tpu.analysis import report  # noqa: F401
 
+
+def __getattr__(name):
+    # NumConfig lazily (num_rules imports nothing heavy, but keeping the
+    # light-import surface of this package flat is the house rule)
+    if name == "NumConfig":
+        from paddle_tpu.analysis.num_rules import NumConfig
+        return NumConfig
+    raise AttributeError(name)
+
 __all__ = [
     "RULES", "TraceHazardError", "Finding", "TracelintWarning",
-    "ShardlintWarning", "lint_paths", "lint_file", "lint_callable",
-    "check_jaxpr", "audit_jaxpr", "message_for", "report",
-    "AuditConfig", "MeshInfo", "InputInfo", "CostReport",
-    "input_infos_from_state",
+    "ShardlintWarning", "NumlintWarning", "lint_paths", "lint_file",
+    "lint_callable", "check_jaxpr", "audit_jaxpr", "check_numerics",
+    "message_for", "report", "AuditConfig", "MeshInfo", "InputInfo",
+    "CostReport", "NumConfig", "input_infos_from_state",
 ]
 
 AST_RULE_SETS = (check_subset, check_purity, check_recompile)
@@ -60,6 +72,12 @@ class TracelintWarning(UserWarning):
 class ShardlintWarning(TracelintWarning):
     """Emitted by to_static(audit=True) for each shardlint finding.
     Subclasses TracelintWarning so one warning filter governs both."""
+
+
+class NumlintWarning(TracelintWarning):
+    """Emitted by to_static(check=True) for each numlint (NLxxx)
+    finding, alongside the TL4xx jaxpr pass.  Subclasses
+    TracelintWarning so one warning filter governs the whole family."""
 
 
 def lint_file(path, base=None, rule_sets=AST_RULE_SETS):
@@ -116,6 +134,16 @@ def check_jaxpr(closed_jaxpr, where="<traced function>", **kw):
     """Post-trace jaxpr lint (TL4xx). Lazy import: jax only loads here."""
     from paddle_tpu.analysis.jaxpr_rules import check_jaxpr as _impl
     return _impl(closed_jaxpr, where=where, **kw)
+
+
+def check_numerics(closed_jaxpr, where="<traced program>", inputs=None,
+                   config=None, suppress=True):
+    """numlint: the NL-rule numerics & precision-flow audit of one
+    traced program (see analysis/num_rules.py).  Lazy import so the
+    light CLI path never pays for it."""
+    from paddle_tpu.analysis.num_rules import check_numerics as _impl
+    return _impl(closed_jaxpr, where=where, inputs=inputs, config=config,
+                 suppress=suppress)
 
 
 def audit_jaxpr(closed_jaxpr, where="<traced program>", inputs=None,

@@ -1,13 +1,16 @@
 """Shared CLI plumbing for the baseline-gated analyzers.
 
-tracelint, shardlint and racelint all ship the same surface: a finding
-list, a checked-in fingerprint baseline, ``--check`` (fail only on NEW
-findings), ``--write-baseline``, and a ``--json`` report carrying a
+tracelint, shardlint, racelint and numlint all ship the same surface: a
+finding list, a checked-in fingerprint baseline, ``--check`` (fail only
+on NEW findings), ``--write-baseline``, ``--diff`` (baseline-vs-current
+per-rule counts, informational), and a ``--json`` report carrying a
 ``"tool"`` discriminator over the shared ``analysis/report.to_json``
 schema.  Before this module each CLI re-implemented that flow; the
 third analyzer would have been the third copy.  The helpers here are
-the one implementation — byte-identical output to what the two
-original CLIs printed, which tests/test_racelint.py pins.
+the one implementation — byte-identical ``--check`` output to what the
+original CLIs printed, which tests/test_racelint.py pins.  The
+``--diff`` table renderer is perfgate's, promoted here so the four
+finding-based linters and the metric gate share one format.
 
 Pure stdlib (report.py is too): the CLIs must stay importable without
 jax so the AST passes can gate CI in milliseconds.
@@ -32,6 +35,10 @@ def add_baseline_args(ap, default_baseline):
                     help="write the current findings as the new baseline")
     ap.add_argument("--json", metavar="FILE", default=None,
                     help="also write findings as JSON ('-' for stdout)")
+    ap.add_argument("--diff", action="store_true",
+                    help="render a baseline-vs-current per-rule count "
+                         "table with signed deltas (informational: "
+                         "always exit 0)")
     return ap
 
 
@@ -47,18 +54,87 @@ def print_rules(rules, codes=None):
     return 0
 
 
+def render_diff_table(baseline_map, current_map, title=None,
+                      label="metric"):
+    """The old-vs-new table renderer — promoted from tools/perfgate.py
+    (which now delegates here) so every baseline-gated analyzer can
+    offer a ``--diff`` mode over the same format.  Values on only one
+    side are labeled "new"/"gone"; the % delta column is signed.
+    Returns the rows as dicts (for ``--json``)."""
+    rows = []
+    if title is not None:
+        print(f"== {title}")
+    print(f"   {label:28s} {'baseline':>14s} {'current':>14s} "
+          f"{'delta':>9s}")
+    for m in sorted(set(baseline_map) | set(current_map)):
+        b, c = baseline_map.get(m), current_map.get(m)
+        if b is None:
+            delta = "new"
+        elif c is None:
+            delta = "gone"
+        elif b == 0:
+            delta = "=" if c == 0 else "+inf"
+        else:
+            delta = f"{100.0 * (c / b - 1.0):+.1f}%"
+        rows.append({label: m, "baseline": b, "current": c,
+                     "delta": delta})
+        fmt = lambda v: "-" if v is None else f"{v:,}" \
+            if isinstance(v, int) else f"{v}"              # noqa: E731
+        print(f"   {m:28s} {fmt(b):>14s} {fmt(c):>14s} {delta:>9s}")
+    return rows
+
+
+def _rule_counts_from_fingerprints(baseline):
+    """Per-rule finding counts out of a fingerprint baseline — the
+    fingerprint format is ``path::CODE::hash`` (analysis/report.py), so
+    the rule code is recoverable without re-running the old tree."""
+    counts = {}
+    for fp, n in baseline.items():
+        parts = fp.split("::")
+        code = parts[1] if len(parts) == 3 else "?"
+        counts[code] = counts.get(code, 0) + int(n)
+    return counts
+
+
 def run_baseline_flow(findings, args, tool, repo, elapsed,
                       show_source=True, json_extra=None):
     """The write-baseline / check-diff / report / json tail every
     analyzer CLI ends with.  Returns the process exit code: 0 clean,
     1 findings (plain mode) or NEW findings beyond the baseline
-    (``--check``).
+    (``--check``); ``--diff`` is informational and always exits 0.
 
     - `args` must carry the :func:`add_baseline_args` flags.
     - `json_extra` is merged into the JSON doc AFTER the shared
       ``{"tool", "elapsed_s"}`` keys (shardlint appends its per-program
       cost reports there).
     """
+    if getattr(args, "diff", False):
+        baseline = report.load_baseline(args.baseline)
+        cur = {}
+        for f in findings:
+            cur[f.code] = cur.get(f.code, 0) + 1
+        rows = render_diff_table(_rule_counts_from_fingerprints(baseline),
+                                 cur, title=tool, label="rule")
+        print(f"{tool}: --diff is informational "
+              f"({len(findings)} current finding(s) in {elapsed:.2f}s)")
+        # --diff COMPOSES with --check/--write-baseline (perfgate
+        # semantics): the table is extra output, never a substitute for
+        # the gate — an operator adding --diff to the CI command must
+        # not silently disarm it.  The combined JSON comes from the
+        # gate flow below; standalone --diff owns it.
+        if not args.check and not args.write_baseline:
+            if args.json:
+                doc = {"tool": tool, "elapsed_s": round(elapsed, 3),
+                       "diff": rows}
+                if args.json == "-":
+                    json.dump(doc, sys.stdout, indent=1)
+                    print()
+                else:
+                    with open(args.json, "w", encoding="utf-8") as fh:
+                        json.dump(doc, fh, indent=1)
+                        fh.write("\n")
+            return 0
+
     if args.write_baseline:
         report.write_baseline(findings, args.baseline)
         print(f"wrote baseline: {len(findings)} finding(s) -> "

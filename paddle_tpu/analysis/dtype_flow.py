@@ -1,0 +1,625 @@
+"""numlint dataflow: per-value dtype provenance over a traced jaxpr.
+
+The whole-program trace makes every dtype decision in a training or
+serving step visible in ONE jaxpr — so precision invariants that the
+repo otherwise holds only by convention (master weights stay f32,
+reductions accumulate wide, stabilized interiors are not re-narrowed,
+quantized codes travel with their scales) can be PROVEN statically,
+before any silicon time.  This module is the provenance layer: it walks
+the program once and records, for every value,
+
+- the **cast lineage** — which wide dtype it was narrowed from, at
+  which eqn, and whether the wide original still has live consumers
+  (the double-rounding question);
+- the **stabilization state** — whether a max-subtraction or an
+  eps-guard sits upstream of it (the softmax/log/div overflow
+  question);
+- the **quantization lineage** — whether it is a raw int8/fp8 code, a
+  dequantized float derived from one, and whether a scale multiply has
+  been applied (the ROADMAP-item-2 KV-quantization questions).
+
+It also records the EVENTS the NL rule catalog judges: narrow
+reductions (NL101), narrow→wide round trips (NL102), narrow
+transcendentals (NL201), narrow scan carries with wide body math
+(NL202), and quantized-value consumptions / dequant→requant chains
+(NL301/NL302).  The judging itself — thresholds, allowlists, finding
+construction — lives in :mod:`num_rules`; this module only states
+facts about the program.
+
+Sub-jaxprs are walked with their operand provenance mapped through
+(pjit bodies, scan/while carries, cond branches, custom-vjp calls), so
+lineage survives jax's call-boundary plumbing.  ``pallas_call`` bodies
+are deliberately OPAQUE: a kernel's refs are not values, and the house
+kernels (ops/pallas/) pin their f32-stabilized interiors with their own
+tests — their call-boundary outputs enter the flow as fresh values.
+
+Module-level imports are stdlib-only (the jaxpr carries every jax type
+we touch) so the CLI can import the package light.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+from paddle_tpu.analysis.jaxpr_rules import _sub_jaxprs
+
+__all__ = ["DtypeFlow", "Prov", "NARROW_FLOATS", "WIDE_FLOATS",
+           "QUANT_DTYPES"]
+
+NARROW_FLOATS = ("bfloat16", "float16")
+WIDE_FLOATS = ("float32", "float64")
+# int8/uint8 double as mask/index carriers — quant lineage for them
+# starts only at a convert-to-float or float-math consumption; the fp8
+# family is unambiguous.
+QUANT_DTYPES = ("int8", "uint8", "float8_e4m3fn", "float8_e5m2",
+                "float8_e4m3", "float8_e4m3fnuz", "float8_e5m2fnuz",
+                "float8_e4m3b11fnuz")
+
+# reductions that serially accumulate in their OUTPUT dtype when
+# lowered (unlike the MXU's in-hardware wide dot accumulation, these
+# are exactly as narrow as they say)
+SERIAL_REDUCE_PRIMS = ("reduce_sum", "cumsum", "reduce_window_sum")
+
+# transcendentals whose narrow-dtype evaluation saturates/amplifies
+# without upstream stabilization (div is special-cased: only its
+# DENOMINATOR is judged, and literal/const denominators are safe)
+TRANSCENDENTAL_PRIMS = ("exp", "exp2", "expm1", "log", "log1p", "div",
+                        "rsqrt")
+
+_ELEMENTWISE_LINEAGE = frozenset((
+    "add", "sub", "mul", "neg", "max", "min", "select_n", "abs",
+    "broadcast_in_dim", "reshape", "transpose", "squeeze", "slice",
+    "dynamic_slice", "dynamic_update_slice", "concatenate", "pad",
+    "gather", "rev", "expand_dims", "copy", "stop_gradient",
+    "reduce_sum", "reduce_max", "reduce_min", "cumsum", "add_any",
+))
+
+
+@dataclass
+class Prov:
+    """What the flow knows about one value (jaxpr var)."""
+
+    dtype: str
+    origin: str = ""               # dtype at creation / program entry
+    # cast lineage
+    narrowed_from: str = None      # wide dtype lost on the path here
+    narrow_eqn: object = None      # the convert eqn that narrowed
+    wide_root: object = None       # the var holding the pre-narrow value
+    wide_root_is_input: bool = False
+    wide_live_hint: bool = False   # root proven live when the narrow
+    # value crossed a call boundary (the root var itself is only
+    # meaningful at its own level; the hint carries its liveness in)
+    # stability
+    stabilized: bool = False       # max-subtraction / eps-guard upstream
+    from_max: bool = False         # derives from a reduce_max (softmax)
+    # quantization lineage
+    quant: bool = False            # raw int8/fp8 codes
+    dequant_of: object = None      # quant var this float was converted from
+    descaled: bool = False         # a scale multiply has been applied
+
+    def clone(self, **kw):
+        return replace(self, **kw)
+
+
+@dataclass
+class ReduceEvent:
+    eqn: object
+    prim: str
+    operand_prov: Prov
+    reduce_elems: int              # addends per output element
+    out_dtype: str
+    widened: bool                  # accumulation/output is wide
+
+
+@dataclass
+class RoundTripEvent:
+    widen_eqn: object
+    narrow_eqn: object
+    wide_dtype: str
+    narrow_dtype: str
+    wide_root: object
+    wide_root_is_input: bool
+    wide_live: bool                # wide root has other live consumers
+
+
+@dataclass
+class TranscendentalEvent:
+    eqn: object
+    prim: str
+    operand_prov: Prov             # the judged operand (denominator for div)
+    stabilized: bool
+
+
+@dataclass
+class ScanCarryEvent:
+    eqn: object
+    slot: int
+    carry_dtype: str
+    body_dtype: str                # the wide dtype the body computes in
+
+
+@dataclass
+class QuantUseEvent:
+    eqn: object
+    prim: str
+    operand: object
+    operand_dtype: str
+    raw: bool                      # raw codes (True) vs un-descaled dequant
+    has_scale_operand: bool        # a scale-shaped float rides along
+
+
+@dataclass
+class RequantEvent:
+    eqn: object                    # the re-quantizing convert
+    dequant_eqn: object
+    intermediate_other_uses: int   # consumers of the float besides requant
+
+
+@dataclass
+class FlowResult:
+    reductions: list = field(default_factory=list)
+    round_trips: list = field(default_factory=list)
+    transcendentals: list = field(default_factory=list)
+    scan_carries: list = field(default_factory=list)
+    quant_uses: list = field(default_factory=list)
+    requants: list = field(default_factory=list)
+
+
+def _dtype_of(v):
+    return str(getattr(getattr(v, "aval", None), "dtype", ""))
+
+
+def _is_literal(v):
+    return hasattr(v, "val")
+
+
+def _size_of(v):
+    aval = getattr(v, "aval", None)
+    n = 1
+    for d in getattr(aval, "shape", ()) or ():
+        n *= int(d)
+    return n
+
+
+def _eps_literal(v, eps_max):
+    """A small positive literal/scalar (an eps-guard candidate)."""
+    if not _is_literal(v):
+        return False
+    try:
+        val = float(v.val)
+    except (TypeError, ValueError):
+        return False
+    return 0.0 < val <= eps_max
+
+
+class DtypeFlow:
+    """One pass over a (Closed)Jaxpr; facts land on :attr:`result`.
+
+    `inputs`: optional [InputInfo] aligned with the top-level invars
+    (names/kinds flow into provenance so NL103 can tell a param from an
+    activation).  `eps_max`: largest additive literal that counts as an
+    eps-guard for stabilization tracking.
+    """
+
+    def __init__(self, closed_jaxpr, inputs=None, eps_max=1e-2):
+        self.result = FlowResult()
+        self.eps_max = eps_max
+        self.input_infos = {}
+        jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+        provs = {}
+        for i, iv in enumerate(jaxpr.invars):
+            dt = _dtype_of(iv)
+            provs[iv] = Prov(dtype=dt, origin=dt,
+                             quant=dt in QUANT_DTYPES)
+            if inputs is not None and i < len(inputs):
+                self.input_infos[iv] = inputs[i]
+        for cv, c in zip(jaxpr.constvars,
+                         getattr(closed_jaxpr, "consts", []) or []):
+            dt = str(getattr(c, "dtype", "")) or _dtype_of(cv)
+            provs[cv] = Prov(dtype=dt, origin=dt,
+                             quant=dt in QUANT_DTYPES)
+        self._walk(jaxpr, provs, top=True)
+
+    # ------------------------------------------------------------ core walk
+    def _prov(self, env, v):
+        if _is_literal(v):
+            dt = str(getattr(v.val, "dtype", type(v.val).__name__))
+            return Prov(dtype=dt, origin=dt)
+        p = env.get(v)
+        if p is None:
+            dt = _dtype_of(v)
+            p = Prov(dtype=dt, origin=dt, quant=dt in QUANT_DTYPES)
+            env[v] = p
+        return p
+
+    def _walk(self, jaxpr, env, top=False):
+        # liveness for the double-rounding question: a wide root is
+        # "still live" at a re-widen if it has uses beyond the narrowing
+        # cast, or is an input of this level (owned by the caller)
+        use_count = {}
+        level_inputs = set(jaxpr.invars) | set(jaxpr.constvars)
+        for eqn in jaxpr.eqns:
+            for v in eqn.invars:
+                if not _is_literal(v):
+                    use_count[v] = use_count.get(v, 0) + 1
+        for v in jaxpr.outvars:
+            if not _is_literal(v):
+                use_count[v] = use_count.get(v, 0) + 1
+
+        for eqn in jaxpr.eqns:
+            self._eqn(eqn, env, use_count, level_inputs, top)
+
+    def _eqn(self, eqn, env, use_count, level_inputs, top):
+        prim = eqn.primitive.name
+        in_provs = [self._prov(env, v) for v in eqn.invars]
+
+        if prim == "convert_element_type":
+            self._convert(eqn, env, in_provs[0], use_count, level_inputs,
+                          top)
+            return
+        if prim in ("pjit", "closed_call", "core_call", "remat",
+                    "checkpoint", "custom_vjp_call", "custom_jvp_call",
+                    "custom_vjp_call_jaxpr", "scan", "while", "cond"):
+            # a narrowed value crossing a call boundary loses sight of
+            # its wide root's uses (var identity is per-level): record
+            # the liveness fact NOW so a re-widen inside the body still
+            # answers the NL102 question (use_count counts the
+            # narrowing cast itself once — >1 means another consumer)
+            for p in in_provs:
+                if p.narrowed_from and p.wide_root is not None and \
+                        use_count.get(p.wide_root, 0) > 1:
+                    p.wide_live_hint = True
+        if prim in ("pjit", "closed_call", "core_call", "remat",
+                    "checkpoint", "custom_vjp_call", "custom_jvp_call",
+                    "custom_vjp_call_jaxpr"):
+            if self._call_boundary(eqn, env, in_provs):
+                return
+        if prim == "scan":
+            self._scan(eqn, env, in_provs)
+            return
+        if prim == "while":
+            self._while(eqn, env, in_provs)
+            return
+        if prim == "cond":
+            self._cond(eqn, env, in_provs)
+            return
+        if prim == "pallas_call":
+            self._fresh_outputs(eqn, env)    # opaque: see module docstring
+            return
+
+        # ---- events on ordinary eqns ----
+        if prim in SERIAL_REDUCE_PRIMS or prim == "dot_general":
+            self._reduce_event(eqn, env, in_provs)
+        if prim in TRANSCENDENTAL_PRIMS:
+            self._transcendental_event(eqn, in_provs)
+        self._quant_use_event(eqn, in_provs)
+
+        # ---- provenance of the outputs ----
+        stabilized = self._stabilizes(eqn, env, in_provs)
+        for ov in eqn.outvars:
+            out_dt = _dtype_of(ov)
+            p = Prov(dtype=out_dt, origin=out_dt,
+                     quant=out_dt in QUANT_DTYPES)
+            if prim in _ELEMENTWISE_LINEAGE:
+                # narrow lineage survives elementwise math: the value is
+                # still "a narrowed value" until something re-widens it
+                for ip in in_provs:
+                    if ip.narrowed_from and ip.dtype == out_dt:
+                        p = ip.clone(dtype=out_dt)
+                        break
+                # dequant lineage: math over an un-descaled dequant is
+                # still un-descaled (NL301 judges the consumption site)
+                for ip in in_provs:
+                    if ip.dequant_of is not None:
+                        p.dequant_of = ip.dequant_of
+                        p.descaled = ip.descaled or p.descaled
+                if stabilized or any(ip.stabilized for ip in in_provs
+                                     if ip.dtype == out_dt):
+                    p.stabilized = True
+            if prim == "mul" and self._is_scale_mul(eqn, in_provs):
+                p.descaled = True
+            if prim == "reduce_max":
+                p.from_max = True
+            elif prim in ("stop_gradient", "broadcast_in_dim", "reshape",
+                          "max") and any(ip.from_max for ip in in_provs):
+                p.from_max = True
+            if prim in ("exp", "exp2", "expm1"):
+                # exp output is positive — a downstream sum of it is a
+                # safe softmax denominator when the operand was
+                # stabilized
+                p.stabilized = in_provs[0].stabilized
+            env[ov] = p
+
+        # unknown primitive with sub-jaxprs (no operand mapping known):
+        # walk the bodies with fresh provenance so interior rules still
+        # see their eqns
+        if prim not in ("scan", "while", "cond", "pallas_call"):
+            for v in eqn.params.values():
+                for sub in _sub_jaxprs(v):
+                    inner = getattr(sub, "jaxpr", sub)
+                    sub_env = {}
+                    self._walk(inner, sub_env)
+
+    # ------------------------------------------------------------ converts
+    def _convert(self, eqn, env, src, use_count, level_inputs, top):
+        new_dt = str(eqn.params.get("new_dtype", ""))
+        ov = eqn.outvars[0]
+        src_var = eqn.invars[0]
+        p = Prov(dtype=new_dt, origin=src.origin or src.dtype,
+                 stabilized=src.stabilized)
+
+        p.from_max = src.from_max
+
+        if src.dtype in WIDE_FLOATS and new_dt in NARROW_FLOATS:
+            # narrowing: remember the wide root for the round-trip check
+            p.narrowed_from = src.dtype
+            p.narrow_eqn = eqn
+            p.wide_root = src_var
+            p.wide_root_is_input = (not _is_literal(src_var)
+                                    and src_var in level_inputs)
+        elif src.dtype in NARROW_FLOATS and new_dt in WIDE_FLOATS:
+            if src.narrowed_from == new_dt:
+                root = src.wide_root
+                other_uses = 0
+                if root is not None and not _is_literal(root):
+                    # uses beyond the narrowing cast itself
+                    other_uses = use_count.get(root, 0) - 1
+                self.result.round_trips.append(RoundTripEvent(
+                    widen_eqn=eqn, narrow_eqn=src.narrow_eqn,
+                    wide_dtype=new_dt, narrow_dtype=src.dtype,
+                    wide_root=root,
+                    wide_root_is_input=src.wide_root_is_input,
+                    wide_live=(src.wide_root_is_input
+                               or src.wide_live_hint
+                               or other_uses > 0)))
+        elif src.quant and (new_dt in WIDE_FLOATS
+                            or new_dt in NARROW_FLOATS):
+            # dequantization: the float carries its code lineage until a
+            # scale multiply lands
+            p.dequant_of = src_var
+            p.descaled = False
+
+        if new_dt in QUANT_DTYPES:
+            p.quant = True
+            # requantization of a dequantized float: the NL302 chain
+            if src.dequant_of is not None:
+                other = use_count.get(src_var, 0) - 1
+                self.result.requants.append(RequantEvent(
+                    eqn=eqn, dequant_eqn=src.dequant_of,
+                    intermediate_other_uses=max(0, other)))
+        env[ov] = p
+
+    # ------------------------------------------------------------ reductions
+    def _reduce_event(self, eqn, env, in_provs):
+        prim = eqn.primitive.name
+        out_dt = _dtype_of(eqn.outvars[0])
+        if prim == "dot_general":
+            lhs = eqn.invars[0]
+            dn = eqn.params.get("dimension_numbers")
+            k = 1
+            try:
+                for d in dn[0][0]:
+                    k *= int(lhs.aval.shape[d])
+            except Exception:
+                k = 0
+            op = in_provs[0]
+            if in_provs[1].dtype in NARROW_FLOATS and \
+                    op.dtype not in NARROW_FLOATS:
+                op = in_provs[1]
+            pet = eqn.params.get("preferred_element_type")
+            widened = out_dt in WIDE_FLOATS or \
+                (pet is not None and str(pet) in WIDE_FLOATS)
+            self.result.reductions.append(ReduceEvent(
+                eqn=eqn, prim=prim, operand_prov=op, reduce_elems=k,
+                out_dtype=out_dt, widened=widened))
+        else:
+            op_v = eqn.invars[0]
+            out_v = eqn.outvars[0]
+            k = max(1, _size_of(op_v) // max(1, _size_of(out_v)))
+            if prim == "cumsum":
+                try:
+                    ax = int(eqn.params.get("axis", 0))
+                    k = int(op_v.aval.shape[ax])
+                except Exception:
+                    k = max(1, k)
+            self.result.reductions.append(ReduceEvent(
+                eqn=eqn, prim=prim, operand_prov=in_provs[0],
+                reduce_elems=k, out_dtype=out_dt,
+                widened=out_dt in WIDE_FLOATS))
+
+    # --------------------------------------------------------- stability
+    def _stabilizes(self, eqn, env, in_provs):
+        """Does this eqn itself stabilize its output?  sub(x, max-of-
+        lineage) and add/max with a small positive eps both count."""
+        prim = eqn.primitive.name
+        if prim == "sub" and len(eqn.invars) == 2:
+            # max-subtraction: the subtrahend derives from a reduce_max
+            # (softmax's x - max(x) pattern; jax.nn.softmax emits
+            # stop_gradient(reduce_max) — lineage flows through both)
+            if in_provs[1].from_max:
+                return True
+        if prim in ("add", "max") and len(eqn.invars) == 2:
+            if any(_eps_literal(v, self.eps_max) for v in eqn.invars):
+                return True
+        if prim in ("clamp",):
+            return True
+        return False
+
+    def _transcendental_event(self, eqn, in_provs):
+        prim = eqn.primitive.name
+        if prim == "div":
+            # the denominator is the hazard; literal denominators are a
+            # known quantity (a constant cannot be a stray zero)
+            den = eqn.invars[1]
+            if _is_literal(den):
+                return
+            p = in_provs[1]
+        else:
+            if _is_literal(eqn.invars[0]):
+                return
+            p = in_provs[0]
+        if p.dtype not in NARROW_FLOATS:
+            return
+        self.result.transcendentals.append(TranscendentalEvent(
+            eqn=eqn, prim=prim, operand_prov=p, stabilized=p.stabilized))
+
+    # ------------------------------------------------------ quantization
+    def _is_scale_mul(self, eqn, in_provs):
+        """mul(dequant, small-float) — a per-tensor/group/page scale is
+        orders of magnitude smaller than the codes it rescales."""
+        if eqn.primitive.name != "mul" or len(eqn.invars) != 2:
+            return False
+        a, b = eqn.invars
+        pa, pb = in_provs
+        for q, s in ((a, b), (b, a)):
+            qp = pa if q is a else pb
+            if qp.dequant_of is None:
+                continue
+            if _is_literal(s):
+                return True
+            if _size_of(s) * 8 <= max(1, _size_of(q)):
+                return True
+        return False
+
+    def _quant_use_event(self, eqn, in_provs):
+        prim = eqn.primitive.name
+        if prim in ("convert_element_type", "mul"):
+            return      # the dequant/rescale machinery itself
+        out_dt = _dtype_of(eqn.outvars[0]) if eqn.outvars else ""
+        is_float_math = prim in ("dot_general", "add", "sub", "div",
+                                 "conv_general_dilated", "reduce_sum",
+                                 "cumsum", "dot", "exp", "log", "tanh",
+                                 "max", "min") or \
+            ("float" in out_dt and prim not in
+             ("broadcast_in_dim", "reshape", "transpose", "slice",
+              "gather", "dynamic_slice", "concatenate", "squeeze",
+              "pad", "select_n", "dynamic_update_slice", "iota",
+              "scatter", "scatter-add", "rev", "copy",
+              "stop_gradient"))
+        if not is_float_math:
+            return
+        small = [v for v in eqn.invars
+                 if _is_literal(v) or "float" in _dtype_of(v)]
+        for v, p in zip(eqn.invars, in_provs):
+            raw = p.quant and p.dtype in QUANT_DTYPES
+            undescaled = p.dequant_of is not None and not p.descaled
+            if not raw and not undescaled:
+                continue
+            # int8/uint8 feeding pure integer/index math is a mask or
+            # an id, not a code — only float-consuming math counts
+            if raw and p.dtype in ("int8", "uint8") and \
+                    "float" not in out_dt:
+                continue
+            has_scale = any(
+                s is not v and (_is_literal(s)
+                                or _size_of(s) * 8 <= max(1, _size_of(v)))
+                for s in small)
+            self.result.quant_uses.append(QuantUseEvent(
+                eqn=eqn, prim=prim, operand=v, operand_dtype=p.dtype,
+                raw=raw, has_scale_operand=has_scale))
+
+    # --------------------------------------------------- call boundaries
+    def _map_into(self, sub, outer_provs):
+        """env for a sub-jaxpr whose invars align with `outer_provs`."""
+        inner = getattr(sub, "jaxpr", sub)
+        env = {}
+        for cv, c in zip(inner.constvars,
+                         getattr(sub, "consts", []) or []):
+            dt = str(getattr(c, "dtype", "")) or _dtype_of(cv)
+            env[cv] = Prov(dtype=dt, origin=dt,
+                           quant=dt in QUANT_DTYPES)
+        for iv, p in zip(inner.invars, outer_provs):
+            env[iv] = p.clone(dtype=_dtype_of(iv) or p.dtype)
+        for iv in inner.invars[len(outer_provs):]:
+            dt = _dtype_of(iv)
+            env[iv] = Prov(dtype=dt, origin=dt,
+                           quant=dt in QUANT_DTYPES)
+        return env, inner
+
+    def _call_boundary(self, eqn, env, in_provs):
+        sub = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr") \
+            or eqn.params.get("fun_jaxpr")
+        if sub is None:
+            return False
+        num_consts = int(eqn.params.get("num_consts", 0) or 0)
+        sub_env, inner = self._map_into(sub, in_provs[num_consts:]
+                                        if num_consts else in_provs)
+        self._walk(inner, sub_env)
+        for ov, iv in zip(eqn.outvars, inner.outvars):
+            p = sub_env.get(iv) if not _is_literal(iv) else None
+            env[ov] = (p.clone(dtype=_dtype_of(ov)) if p is not None
+                       else Prov(dtype=_dtype_of(ov),
+                                 origin=_dtype_of(ov)))
+        return True
+
+    def _scan(self, eqn, env, in_provs):
+        body = eqn.params.get("jaxpr")
+        if body is None:
+            self._fresh_outputs(eqn, env)
+            return
+        inner = getattr(body, "jaxpr", body)
+        num_consts = int(eqn.params.get("num_consts", 0))
+        num_carry = int(eqn.params.get("num_carry", 0))
+        sub_env, inner = self._map_into(body, in_provs)
+        self._walk(inner, sub_env)
+        # NL202: a narrow carry the body widens for its math
+        carries = inner.invars[num_consts:num_consts + num_carry]
+        for slot, cv in enumerate(carries):
+            cdt = _dtype_of(cv)
+            if cdt not in NARROW_FLOATS:
+                continue
+            for beqn in inner.eqns:
+                if beqn.primitive.name == "convert_element_type" and \
+                        cv in beqn.invars and \
+                        str(beqn.params.get("new_dtype", "")) in \
+                        WIDE_FLOATS:
+                    self.result.scan_carries.append(ScanCarryEvent(
+                        eqn=eqn, slot=slot, carry_dtype=cdt,
+                        body_dtype=str(beqn.params["new_dtype"])))
+                    break
+        for ov, iv in zip(eqn.outvars, inner.outvars):
+            p = sub_env.get(iv) if not _is_literal(iv) else None
+            env[ov] = (p.clone(dtype=_dtype_of(ov)) if p is not None
+                       else Prov(dtype=_dtype_of(ov),
+                                 origin=_dtype_of(ov)))
+
+    def _while(self, eqn, env, in_provs):
+        body = eqn.params.get("body_jaxpr")
+        cond = eqn.params.get("cond_jaxpr")
+        bn = int(eqn.params.get("body_nconsts", 0))
+        cn = int(eqn.params.get("cond_nconsts", 0))
+        if cond is not None:
+            sub_env, inner = self._map_into(cond, in_provs[:cn] +
+                                            in_provs[cn + bn:])
+            self._walk(inner, sub_env)
+        if body is None:
+            self._fresh_outputs(eqn, env)
+            return
+        sub_env, inner = self._map_into(body, in_provs[cn:])
+        self._walk(inner, sub_env)
+        for ov, iv in zip(eqn.outvars, inner.outvars):
+            p = sub_env.get(iv) if not _is_literal(iv) else None
+            env[ov] = (p.clone(dtype=_dtype_of(ov)) if p is not None
+                       else Prov(dtype=_dtype_of(ov),
+                                 origin=_dtype_of(ov)))
+
+    def _cond(self, eqn, env, in_provs):
+        branches = eqn.params.get("branches", ())
+        outs = None
+        for b in branches:
+            sub_env, inner = self._map_into(b, in_provs[1:])
+            self._walk(inner, sub_env)
+            if outs is None:
+                outs = [sub_env.get(iv) if not _is_literal(iv) else None
+                        for iv in inner.outvars]
+        for ov, p in zip(eqn.outvars, outs or []):
+            env[ov] = (p.clone(dtype=_dtype_of(ov)) if p is not None
+                       else Prov(dtype=_dtype_of(ov),
+                                 origin=_dtype_of(ov)))
+        if outs is None:
+            self._fresh_outputs(eqn, env)
+
+    def _fresh_outputs(self, eqn, env):
+        for ov in eqn.outvars:
+            dt = _dtype_of(ov)
+            env[ov] = Prov(dtype=dt, origin=dt,
+                           quant=dt in QUANT_DTYPES)

@@ -1,0 +1,246 @@
+"""numlint NL1xx/NL2xx/NL3xx: numerics & precision-flow audit of jaxprs.
+
+tracelint asks "will it trace", shardlint asks "will it scale" — numlint
+asks "will the numbers still be right": a judgment pass over the dtype
+provenance :mod:`dtype_flow` extracts from a traced program.
+
+- **NL1xx precision loss** — serial reductions and dot contractions
+  accumulating in a narrow dtype without a widening cast (NL101),
+  f32→bf16→f32 double-rounding round trips whose wide original was
+  still live (NL102), and optimizer-plane state (params / moments)
+  stored narrow without the explicit ``moment_dtype`` opt-in (NL103 —
+  the invariant PR 10 pinned dynamically via SL303=0, proven statically
+  here on every audited program).
+- **NL2xx stability** — exp/log/div/rsqrt on a narrow dtype with no
+  max-subtraction or eps-guard upstream (NL201), and scan carries
+  narrower than the body math that updates them (NL202).
+- **NL3xx quantization readiness** — int8/fp8 codes consumed with no
+  adjacent scale (NL301) and dequant→requant chains that should fuse
+  (NL302).  Written against HYPOTHETICAL quantized pools: the rules
+  gate ROADMAP item 2's KV-quantization PR before it lands, the same
+  way shardlint audits CPU traces against a hypothetical mesh.
+
+Findings resolve to real file:line through eqn source_info, so the
+ordinary ``# tracelint: disable=NL101`` (and the NL-scoped
+``# numlint:`` alias) suppressions apply.  Thresholds live on
+:class:`NumConfig`; deliberate narrow accumulation registers once via
+``core.dispatch.allow_narrow_accum`` (the same promotion-metadata shape
+TL401's wide-dtype allowlist uses).
+
+Module-level imports are stdlib-only (jax arrives via the jaxpr).
+"""
+from __future__ import annotations
+
+import fnmatch
+from dataclasses import dataclass
+
+from paddle_tpu.analysis.dtype_flow import (DtypeFlow, NARROW_FLOATS,
+                                            QUANT_DTYPES)
+from paddle_tpu.analysis.shard_rules import (_aval_sig, _eqn_site,
+                                             _mk_finding,
+                                             apply_suppressions)
+
+__all__ = ["NumConfig", "check_numerics"]
+
+
+@dataclass(frozen=True)
+class NumConfig:
+    """Thresholds for the NL rule families (one knob set shared by the
+    CLI, the to_static(check=True) hook, and the bench lane).  The
+    defaults are production-scale; the CLI scales them down so the same
+    defect classes fire on the tiny CI configs (shardlint's pattern)."""
+
+    # NL101: smallest reduction depth (addends per output element)
+    # worth flagging — bf16's 8-bit mantissa absorbs small addends once
+    # the running total is ~256x larger, so short reductions are safe
+    reduce_min_elems: int = 1024
+    # NL103: fnmatch patterns of opt-state names whose narrow storage
+    # is an explicit, tested opt-in (Adam/AdamW moment_dtype)
+    moment_optin: tuple = ()
+    # NL201: largest additive literal that counts as an eps-guard
+    eps_max: float = 1e-2
+    # NL302: flag only chains whose intermediate float has no other
+    # consumer (True) or every chain (False)
+    requant_fused_only: bool = True
+
+
+def _detail_site(eqn):
+    path, line = _eqn_site(eqn)
+    return f" at {path}:{line}" if path else ""
+
+
+def check_numerics(closed_jaxpr, where="<traced program>", inputs=None,
+                   config=None, suppress=True):
+    """Run the NL rule families over one traced program.
+
+    - `inputs`: [InputInfo] aligned with the jaxpr invars (the NL103
+      master-state pass reads kinds/names/dtypes from it; pass the
+      second element of :meth:`StaticFunction.traced_program`).
+    - `suppress`: apply per-line ``# tracelint: disable=NLxxx`` /
+      ``# numlint: disable=...`` comments at each finding's resolved
+      source site.
+
+    Returns ``[Finding]`` sorted by (path, line, code).
+    """
+    config = config or NumConfig()
+    flow = DtypeFlow(closed_jaxpr, inputs=inputs, eps_max=config.eps_max)
+    findings = []
+    findings += _nl101(flow, config, where)
+    findings += _nl102(flow, where)
+    findings += _nl103(inputs, config, where)
+    findings += _nl201(flow, where)
+    findings += _nl202(flow, where)
+    findings += _nl301(flow, where)
+    findings += _nl302(flow, config, where)
+    if suppress:
+        findings = apply_suppressions(findings)
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return findings
+
+
+# ------------------------------------------------------------------ NL101
+def _nl101(flow, config, where):
+    from paddle_tpu.core import dispatch
+    allowed = dispatch.narrow_accum_allowed_ops()
+    findings, seen = [], set()
+    for ev in flow.result.reductions:
+        if ev.widened or ev.prim in allowed:
+            continue
+        if ev.operand_prov.dtype not in NARROW_FLOATS:
+            continue
+        if ev.out_dtype not in NARROW_FLOATS:
+            continue
+        if ev.reduce_elems < config.reduce_min_elems:
+            continue
+        out = ev.eqn.outvars[0]
+        key = (ev.prim, _aval_sig(out), _eqn_site(ev.eqn))
+        if key in seen:
+            continue
+        seen.add(key)
+        findings.append(_mk_finding(
+            "NL101",
+            f"`{ev.prim}` -> {_aval_sig(out)} "
+            f"({ev.reduce_elems} addends in {ev.out_dtype})",
+            where, eqn=ev.eqn,
+            sig=f"narrow-accum {ev.prim} {_aval_sig(out)} "
+                f"k={ev.reduce_elems}"))
+    return findings
+
+
+# ------------------------------------------------------------------ NL102
+def _nl102(flow, where):
+    findings, seen = [], set()
+    for ev in flow.result.round_trips:
+        if not ev.wide_live:
+            continue      # residency round trip: the narrow copy is
+            # the only survivor, re-widening it is the point
+        if ev.wide_root_is_input:
+            continue      # cast chains rooted at a program input are
+            # shardlint SL303's finding (docs/shardlint.md: one
+            # fingerprint owns a given chain)
+        key = _eqn_site(ev.widen_eqn)
+        if key in seen:
+            continue
+        seen.add(key)
+        findings.append(_mk_finding(
+            "NL102",
+            f"{ev.wide_dtype} -> {ev.narrow_dtype} -> {ev.wide_dtype}"
+            f"{_detail_site(ev.narrow_eqn)}",
+            where, eqn=ev.widen_eqn,
+            sig=f"roundtrip {ev.wide_dtype}->{ev.narrow_dtype}"))
+    return findings
+
+
+# ------------------------------------------------------------------ NL103
+def _nl103(inputs, config, where):
+    findings = []
+    for info in inputs or ():
+        dt = str(info.dtype)
+        narrow = dt in NARROW_FLOATS or dt in QUANT_DTYPES
+        if not narrow:
+            continue
+        if info.kind == "opt_state":
+            if any(fnmatch.fnmatch(info.name, pat)
+                   for pat in config.moment_optin):
+                continue
+            findings.append(_mk_finding(
+                "NL103",
+                f"moment `{info.name}` ({dt}{list(info.shape)})",
+                where, sig=f"narrow-moment {info.name}"))
+        elif info.kind == "param":
+            findings.append(_mk_finding(
+                "NL103",
+                f"param `{info.name}` ({dt}{list(info.shape)}) has no "
+                f"f32 master copy",
+                where, sig=f"narrow-param {info.name}"))
+    return findings
+
+
+# ------------------------------------------------------------------ NL201
+def _nl201(flow, where):
+    findings, seen = [], set()
+    for ev in flow.result.transcendentals:
+        if ev.stabilized:
+            continue
+        key = (ev.prim, _eqn_site(ev.eqn))
+        if key in seen:
+            continue
+        seen.add(key)
+        findings.append(_mk_finding(
+            "NL201",
+            f"{ev.prim}({ev.operand_prov.dtype})",
+            where, eqn=ev.eqn,
+            sig=f"unstabilized {ev.prim} {ev.operand_prov.dtype}"))
+    return findings
+
+
+# ------------------------------------------------------------------ NL202
+def _nl202(flow, where):
+    findings = []
+    for ev in flow.result.scan_carries:
+        findings.append(_mk_finding(
+            "NL202",
+            f"slot {ev.slot} ({ev.carry_dtype}) vs {ev.body_dtype} "
+            f"body math",
+            where, eqn=ev.eqn,
+            sig=f"narrow-carry slot{ev.slot} {ev.carry_dtype}"))
+    return findings
+
+
+# ------------------------------------------------------------------ NL301
+def _nl301(flow, where):
+    findings, seen = [], set()
+    for ev in flow.result.quant_uses:
+        if ev.has_scale_operand:
+            continue
+        key = (ev.prim, ev.operand_dtype, _eqn_site(ev.eqn))
+        if key in seen:
+            continue
+        seen.add(key)
+        kind = "raw codes" if ev.raw else "un-descaled dequant"
+        findings.append(_mk_finding(
+            "NL301",
+            f"({ev.operand_dtype} {kind}) in `{ev.prim}`",
+            where, eqn=ev.eqn,
+            sig=f"scale-free {ev.prim} {ev.operand_dtype}"))
+    return findings
+
+
+# ------------------------------------------------------------------ NL302
+def _nl302(flow, config, where):
+    findings, seen = [], set()
+    for ev in flow.result.requants:
+        if config.requant_fused_only and ev.intermediate_other_uses > 0:
+            continue
+        key = _eqn_site(ev.eqn)
+        if key in seen:
+            continue
+        seen.add(key)
+        out_dt = str(ev.eqn.params.get("new_dtype", ""))
+        findings.append(_mk_finding(
+            "NL302",
+            f"-> {out_dt} (intermediate float has "
+            f"{ev.intermediate_other_uses} other consumer(s))",
+            where, eqn=ev.eqn,
+            sig=f"requant {out_dt}"))
+    return findings

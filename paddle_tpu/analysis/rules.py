@@ -242,6 +242,79 @@ RULES = {r.code: r for r in [
        "step",
        "store the parameter in bf16 (keep an f32 master copy only where "
        "the optimizer needs it)"),
+    # ---- NL1xx: precision loss (numlint, num_rules.py/dtype_flow.py) ----
+    _R("NL101", "narrow-accumulation",
+       "reduction {detail} accumulates in a narrow dtype",
+       "summing N values in bf16 keeps an 8-bit mantissa on the RUNNING "
+       "total: past a few hundred addends the small contributions are "
+       "absorbed entirely (classic bias-grad / loss-mean corruption); "
+       "the MXU accumulates dot products wide in hardware, but a "
+       "reduce_sum lowers to exactly the narrow serial sum it says",
+       "accumulate wide: preferred_element_type=float32 on the "
+       "dot_general, or cast the operand up before the reduce and back "
+       "down after (one rounding of the result, not one per addend)"),
+    _R("NL102", "double-rounding-roundtrip",
+       "f32 value narrowed then re-widened ({detail}) while the wide "
+       "value was still live",
+       "float32(bfloat16(x)) != x — the round trip costs 16 mantissa "
+       "bits; when the original wide value still has live consumers the "
+       "narrow copy existed only in passing, so downstream math pays "
+       "double rounding for zero residency savings",
+       "consume the original wide value directly; narrow only at a "
+       "residency boundary where the wide copy genuinely dies "
+       "(a cast chain rooted at a PROGRAM INPUT is shardlint SL303's "
+       "finding, not this one — see docs/shardlint.md)"),
+    _R("NL103", "narrow-master-state",
+       "optimizer-plane state {detail} is stored narrow without a "
+       "moment_dtype opt-in",
+       "param update math below ~1e-3 relative step size rounds to ZERO "
+       "in bf16 — narrow master weights stop learning late in training, "
+       "and narrow moments bias the adaptive scale; PR 10 pinned this "
+       "invariant dynamically (SL303=0 on the flagship), numlint proves "
+       "it statically on every audited program",
+       "store params and moments f32 (master weights); narrow moments "
+       "only through the explicit Adam/AdamW moment_dtype opt-in, which "
+       "declares the tolerance contract"),
+
+    # ---- NL2xx: stability ----
+    _R("NL201", "unstabilized-narrow-transcendental",
+       "`{detail}` on a narrow dtype with no stabilization upstream",
+       "exp overflows bf16 at x>88 ln2-scaled and float16 at x>11; "
+       "log/div amplify near zero — without a max-subtraction (softmax) "
+       "or eps-guard (denominators) the narrow evaluation saturates to "
+       "inf/nan exactly on the outlier activations that matter",
+       "subtract the row max before exp (jax.nn.softmax does), add an "
+       "eps before log/div, or upcast the operand to f32 for the "
+       "transcendental and narrow the result"),
+    _R("NL202", "narrow-scan-carry",
+       "scan carry {detail} is narrower than its body math",
+       "a carry that the body widens, updates, and re-narrows rounds "
+       "the running value EVERY iteration — error compounds linearly "
+       "with loop length, unlike a single end-of-loop rounding",
+       "keep the carry at the body's compute dtype and narrow once "
+       "after the scan (the carry is live-range-bounded; residency "
+       "savings are per-iteration only)"),
+
+    # ---- NL3xx: quantization readiness ----
+    _R("NL301", "scale-free-quantized-consumption",
+       "quantized value {detail} consumed with no adjacent scale "
+       "operand",
+       "int8/fp8 codes are meaningless without their quantization "
+       "scale: math on raw codes silently treats quantization bins as "
+       "real units — the KV-quantization plane (ROADMAP item 2) must "
+       "carry a per-page scale next to every pool read",
+       "dequantize first (convert + multiply by the scale), or pass "
+       "the scale into the consuming kernel alongside the codes"),
+    _R("NL302", "dequant-requant-roundtrip",
+       "dequantized value {detail} immediately requantized",
+       "a dequant->requant chain whose intermediate float has no other "
+       "consumer materializes a full-width tensor only to round it "
+       "away again — and the two roundings need not compose to the "
+       "identity even at equal scales",
+       "fuse the rescale into one integer/fp8-domain op (or one "
+       "convert with the combined scale) instead of bouncing through "
+       "floats"),
+
     # ---- RL1xx: host-runtime concurrency (racelint, race_rules.py) ----
     _R("RL101", "unguarded-shared-attribute",
        "{detail} is accessed from multiple thread roots with no "
@@ -317,3 +390,4 @@ JAXPR_CODES = tuple(c for c in RULES
                                               and c >= "TL400"))
 SHARDLINT_CODES = tuple(c for c in RULES if c.startswith("SL"))
 RACELINT_CODES = tuple(c for c in RULES if c.startswith("RL"))
+NUMLINT_CODES = tuple(c for c in RULES if c.startswith("NL"))

@@ -495,10 +495,14 @@ def _file_suppressions(path):
 
 def apply_suppressions(findings):
     """Drop findings whose resolved source line carries a
-    `# tracelint: disable=<code>` (or `# shardlint:`, SL-scoped)
-    comment, exactly like the AST pass.  Findings without a real file
-    site pass through untouched — their baseline fingerprints hash the
-    stable `sig` every _mk_finding sets as source_line."""
+    `# tracelint: disable=<code>` (or a family-scoped alias —
+    `# shardlint:` for SL codes, `# numlint:` for NL codes) comment,
+    exactly like the AST pass.  The family-wide marker (`ALL:SL` /
+    `ALL:NL`, produced by an alias-spelled `disable=ALL`) only waives
+    findings of ITS family, keyed on the code prefix.  Findings without
+    a real file site pass through untouched — their baseline
+    fingerprints hash the stable `sig` every _mk_finding sets as
+    source_line."""
     out = []
     for f in findings:
         path = None
@@ -513,7 +517,8 @@ def apply_suppressions(findings):
         if skip:
             continue
         codes = sup.get(f.line, ())
-        if "ALL" in codes or "ALL:SL" in codes or f.code in codes:
+        if "ALL" in codes or f"ALL:{f.code[:2]}" in codes \
+                or f.code in codes:
             continue
         out.append(f)
     return out

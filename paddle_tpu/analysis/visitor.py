@@ -40,19 +40,20 @@ class Finding:
 
 
 # one suppression syntax for EVERY analyzer: `# tracelint: disable=...`
-# silences TLxxx, SLxxx and RLxxx codes alike (shardlint findings
-# resolve back to a source line via the eqn's jax source_info; racelint
-# findings are AST sites already).  `# shardlint:` / `# racelint:` are
-# accepted aliases but scoped to their own family only — their `ALL`
-# becomes the marker 'ALL:SL' / 'ALL:RL' and foreign codes are dropped,
-# so a shardlint-spelled comment can never waive a trace-safety (TL)
-# finding and vice versa.  skip-file stays tracelint-spelled only, for
-# the same reason.
+# silences TLxxx, SLxxx, RLxxx and NLxxx codes alike (shardlint/numlint
+# findings resolve back to a source line via the eqn's jax source_info;
+# racelint findings are AST sites already).  `# shardlint:` /
+# `# racelint:` / `# numlint:` are accepted aliases but scoped to their
+# own family only — their `ALL` becomes the marker 'ALL:SL' / 'ALL:RL' /
+# 'ALL:NL' and foreign codes are dropped, so a shardlint-spelled comment
+# can never waive a trace-safety (TL) or numerics (NL) finding and vice
+# versa.  skip-file stays tracelint-spelled only, for the same reason.
 _DISABLE_RE = re.compile(
-    r"#\s*(tracelint|shardlint|racelint):\s*disable=([A-Za-z0-9,\s]+)")
+    r"#\s*(tracelint|shardlint|racelint|numlint):\s*disable="
+    r"([A-Za-z0-9,\s]+)")
 _SKIP_FILE_RE = re.compile(r"^\s*#\s*tracelint:\s*skip-file\s*$")
 
-_FAMILY = {"shardlint": "SL", "racelint": "RL"}
+_FAMILY = {"shardlint": "SL", "racelint": "RL", "numlint": "NL"}
 
 
 def parse_suppressions(source):

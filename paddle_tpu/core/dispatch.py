@@ -177,6 +177,25 @@ def default_float_dtype():
     return "float64" if jax.config.jax_enable_x64 else "float32"
 
 
+# Ops that accumulate in a NARROW dtype on purpose (a measured, tested
+# tolerance contract — e.g. a stochastic-rounding experiment).  The
+# numlint dtype-flow pass (analysis/num_rules.py, rule NL101) skips
+# primitives registered here, the same shape as the TL401 wide-dtype
+# allowlist above: declare the intent once, stay unflagged everywhere.
+_NARROW_ACCUM_ALLOWED_OPS: set = set()
+
+
+def allow_narrow_accum(op_name):
+    """Mark `op_name` (a jaxpr primitive name) as intentionally
+    accumulating in a narrow float dtype; numlint NL101 skips it."""
+    _NARROW_ACCUM_ALLOWED_OPS.add(op_name)
+    return op_name
+
+
+def narrow_accum_allowed_ops():
+    return frozenset(_NARROW_ACCUM_ALLOWED_OPS)
+
+
 def unwrap(x):
     """Tensor -> jax array (pass through others, recursively on lists/tuples)."""
     if isinstance(x, Tensor):

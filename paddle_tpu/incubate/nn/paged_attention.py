@@ -279,11 +279,18 @@ def paged_attend(q, k_pages, v_pages, tables, lens, page_size, scale=None):
     v_seq = jnp.moveaxis(v_seq, 2, 1).reshape(b, h, P * page_size, d)
     pos = jnp.arange(P * page_size)
     mask = pos[None, None, None, :] < lens[:, None, None, None]
-    s = (q * sc) @ jnp.swapaxes(k_seq, -1, -2)            # [b, h, 1, Pp]
+    # narrow (bf16/fp16/quantized-dequant) pools: accumulate both
+    # contractions WIDE and round once (numlint NL101) — the value
+    # matmul reduces over the ENTIRE cached history, the deepest sum in
+    # the serving path.  f32 pools take the identical pre-fix jaxpr.
+    narrow = q.dtype in (jnp.bfloat16, jnp.float16)
+    pet = {"preferred_element_type": jnp.float32} if narrow else {}
+    s = jnp.matmul(q * sc, jnp.swapaxes(k_seq, -1, -2),
+                   **pet)                                 # [b, h, 1, Pp]
     s = jnp.where(mask, s.astype(jnp.float32),
                   jnp.finfo(jnp.float32).min)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-    return p @ v_seq                                      # [b, h, 1, d]
+    return jnp.matmul(p, v_seq, **pet).astype(q.dtype)    # [b, h, 1, d]
 
 
 _attend_pages = paged_attend  # back-compat alias (pre-serving name)
@@ -302,9 +309,12 @@ def paged_decode_step(q, k_new, v_new, k_pages, v_pages, tables, lens,
     offs = lens % page_size
     page_ids = jnp.take_along_axis(tables, page_idx[:, None],
                                    axis=1)[:, 0]          # [b]
-    # scatter each row's token into its page/offset
-    kt = jnp.swapaxes(k_new, 1, 2)[:, 0]                  # [b, h, d]
-    vt = jnp.swapaxes(v_new, 1, 2)[:, 0]
+    # scatter each row's token into its page/offset — the pool-dtype
+    # narrowing is EXPLICIT (numlint-visible cast, and jax deprecates
+    # the implicit f32->bf16 scatter cast) rather than hidden in the
+    # scatter
+    kt = jnp.swapaxes(k_new, 1, 2)[:, 0].astype(k_pages.dtype)
+    vt = jnp.swapaxes(v_new, 1, 2)[:, 0].astype(v_pages.dtype)
     k_pages = k_pages.at[page_ids, :, offs].set(kt)
     v_pages = v_pages.at[page_ids, :, offs].set(vt)
     out = paged_attend(q, k_pages, v_pages, tables, lens + 1,
@@ -340,8 +350,11 @@ def paged_prefill_append(k_new, v_new, k_pages, v_pages, tables, lens,
     page_ids = jnp.where(valid, page_ids, 0)
     flat_pages = page_ids.reshape(-1)                      # [b*S]
     flat_offs = jnp.tile(offs, b)
-    kt = jnp.swapaxes(k_new, 1, 2).reshape(b * S, h, d)    # [b*S, h, d]
-    vt = jnp.swapaxes(v_new, 1, 2).reshape(b * S, h, d)
+    # explicit pool-dtype narrowing (see paged_decode_step)
+    kt = jnp.swapaxes(k_new, 1, 2).reshape(b * S, h, d) \
+        .astype(k_pages.dtype)                             # [b*S, h, d]
+    vt = jnp.swapaxes(v_new, 1, 2).reshape(b * S, h, d) \
+        .astype(v_pages.dtype)
     k_pages = k_pages.at[flat_pages, :, flat_offs].set(kt)
     v_pages = v_pages.at[flat_pages, :, flat_offs].set(vt)
     return k_pages, v_pages

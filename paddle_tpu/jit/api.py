@@ -324,11 +324,21 @@ class StaticFunction:
                     traced = jitted.trace(state_vals, tensor_vals)
                     from paddle_tpu import analysis
                     where = f"<to_static {self.__name__}>"
+                    infos = _audit_input_infos(state_list, tensor_vals)
                     if self._check:
                         analysis.warn_findings(
                             analysis.check_jaxpr(traced.jaxpr, where=where))
+                        # numlint rides the same opt-in: the numerics &
+                        # precision-flow pass over the same traced
+                        # program (NLxxx), warned alongside the TL4xx
+                        # jaxpr findings
+                        analysis.warn_findings(
+                            analysis.check_numerics(traced.jaxpr,
+                                                    where=where,
+                                                    inputs=infos),
+                            category=analysis.NumlintWarning,
+                            prefix="numlint")
                     if self._audit:
-                        infos = _audit_input_infos(state_list, tensor_vals)
                         findings, self.last_audit = analysis.audit_jaxpr(
                             traced.jaxpr, where=where, inputs=infos)
                         analysis.warn_findings(
@@ -454,7 +464,11 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     ``check=True`` opts into tracelint (paddle_tpu.analysis): an AST
     pass over the function and its module-local reach at wrap time, and
     a jaxpr pass after each first-compile — hazards are reported as
-    ``TracelintWarning`` with TLxxx codes and file:line.
+    ``TracelintWarning`` with TLxxx codes and file:line.  The numlint
+    numerics & precision-flow pass (NLxxx — narrow accumulation,
+    double-rounding, unstabilized narrow transcendentals, quantization
+    readiness) runs on the same trace and warns as
+    ``NumlintWarning``.
 
     ``audit=True`` opts into shardlint: the SL-rule sharding /
     collective-safety / memory-layout audit of each signature's traced

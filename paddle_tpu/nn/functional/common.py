@@ -13,12 +13,120 @@ def linear(x, weight, bias=None, name=None):
     """y = x @ W + b with W: [in, out] (paddle layout -> MXU matmul)."""
     def fn(v, w, b):
         from paddle_tpu.amp.auto_cast import downcast_inputs
-        v, w = downcast_inputs(v, w, opname="linear")
-        y = jnp.matmul(v, w)
+        v2, w2 = downcast_inputs(v, w, opname="linear")
+        if _is_master_downcast(v2, w2, w):
+            # master-weight mixed precision (the amp-policy flagship):
+            # grads for w/b accumulate WIDE and land f32 directly
+            if b is not None:
+                return _linear_master(v2, w, b)
+            return _mm_master(False, v2, w)
+        y = jnp.matmul(v2, w2)
         if b is not None:
             y = y + b.astype(y.dtype)
         return y
     return apply(fn, x, weight, bias)
+
+
+def _is_master_downcast(a2, w2, w):
+    """True when `downcast_inputs` narrowed an f32 master weight for a
+    narrow-float matmul — the ONE predicate gating the wide-grad
+    custom_vjp path for F.linear and paddle.matmul/mm.  Requires a
+    genuine DOWNcast (a black-list upcast of a narrow-stored weight
+    must keep stock AD so grad dtype == param dtype) AND a matching
+    narrow-float lhs (an integer/other lhs must keep jnp.matmul's
+    stock promotion — the master path would truncate the weights to
+    the lhs dtype)."""
+    return (w2.dtype != w.dtype
+            and w2.dtype in (jnp.bfloat16, jnp.float16)
+            and a2.dtype == w2.dtype
+            and w.ndim == 2 and a2.ndim >= 2)
+
+
+# ---- wide-accumulating gradients for master-weight matmul/bias ----
+# numlint NL101 (the flagship self-audit's finding at this site): under
+# bf16 activation residency the weight- and bias-grad reductions
+# contract over EVERY token in the batch — a bf16 serial sum whose
+# running total absorbs small addends once it is ~256x larger than
+# them, silently corrupting exactly the grads that feed the f32 master
+# weights step after step.  The fix moves the master downcast INSIDE a
+# custom_vjp: the forward math is unchanged eqn-for-eqn (cast, matmul,
+# bias add — same values as before), but the backward contracts dw/db
+# with an f32 accumulator (preferred_element_type, the MXU's native
+# wide accumulation) and hands them to the f32 masters WITHOUT ever
+# rounding through bf16 — strictly better than the pre-fix chain
+# (bf16-serial sum, then an upcast of the already-rounded result).
+# The activation cotangent da stays the stock narrow dot: it lives for
+# one backward step in residency dtype by design, matching the forward
+# (docs/numlint.md documents this split and the baseline entries for
+# the forward dots).  The f32 path never enters these wrappers: its
+# jaxpr is byte-identical to before.
+
+@jax.custom_vjp
+def _linear_master(a, w, b):
+    wc = w.astype(a.dtype)
+    return jnp.matmul(a, wc) + b.astype(a.dtype)
+
+
+def _linear_master_fwd(a, w, b):
+    wc = w.astype(a.dtype)
+    return jnp.matmul(a, wc) + b.astype(a.dtype), (a, wc)
+
+
+def _linear_master_bwd(res, g):
+    a, wc = res
+    lead = tuple(range(a.ndim - 1))
+    da = jax.lax.dot_general(
+        g, wc, (((g.ndim - 1,), (1,)), ((), ())))
+    dw = jax.lax.dot_general(
+        a, g, ((lead, lead), ((), ())),
+        preferred_element_type=jnp.float32)
+    # db via a ones-dot: wide accumulation over the lead dims without
+    # materializing an f32 copy of the cotangent
+    ones = jnp.ones(g.shape[:-1], g.dtype)
+    db = jax.lax.dot_general(
+        ones, g, ((lead, lead), ((), ())),
+        preferred_element_type=jnp.float32)
+    return da.astype(a.dtype), dw, db
+
+
+_linear_master.defvjp(_linear_master_fwd, _linear_master_bwd)
+
+
+from functools import partial as _partial  # noqa: E402
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _mm_master(trans_y, a, w):
+    wc = w.astype(a.dtype)
+    return jnp.matmul(a, jnp.swapaxes(wc, -1, -2) if trans_y else wc)
+
+
+def _mm_master_fwd(trans_y, a, w):
+    wc = w.astype(a.dtype)
+    y = jnp.matmul(a, jnp.swapaxes(wc, -1, -2) if trans_y else wc)
+    return y, (a, wc)
+
+
+def _mm_master_bwd(trans_y, res, g):
+    a, wc = res
+    lead = tuple(range(a.ndim - 1))
+    if trans_y:
+        # y = a @ w^T with w: [n, k]
+        da = jax.lax.dot_general(
+            g, wc, (((g.ndim - 1,), (0,)), ((), ())))
+        dw = jax.lax.dot_general(
+            g, a, ((lead, lead), ((), ())),
+            preferred_element_type=jnp.float32)
+    else:
+        da = jax.lax.dot_general(
+            g, wc, (((g.ndim - 1,), (1,)), ((), ())))
+        dw = jax.lax.dot_general(
+            a, g, ((lead, lead), ((), ())),
+            preferred_element_type=jnp.float32)
+    return da.astype(a.dtype), dw
+
+
+_mm_master.defvjp(_mm_master_fwd, _mm_master_bwd)
 
 
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):

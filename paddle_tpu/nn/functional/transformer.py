@@ -18,7 +18,14 @@ def _sdpa_ref(q, k, v, mask, dropout_p, causal, scale, dropout_key=None):
     # q, k, v: [batch, seq, heads, head_dim] (paddle layout)
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * jnp.asarray(s, q.dtype)
+    # narrow (bf16/fp16) q/k: accumulate the score contraction WIDE
+    # (numlint NL101) — the pre-fix chain (bf16-accumulated logits, one
+    # rounding, then the softmax's f32 upcast) was also a double
+    # rounding (NL102); f32 inputs take the identical old path
+    narrow = q.dtype in (jnp.bfloat16, jnp.float16)
+    pet = {"preferred_element_type": jnp.float32} if narrow else {}
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, **pet) \
+        * jnp.asarray(s, jnp.float32 if narrow else q.dtype)
     if causal:
         ql, kl = logits.shape[-2], logits.shape[-1]
         cm = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
@@ -27,13 +34,15 @@ def _sdpa_ref(q, k, v, mask, dropout_p, causal, scale, dropout_key=None):
         if mask.dtype == jnp.bool_:
             logits = jnp.where(mask, logits, jnp.asarray(-1e30, logits.dtype))
         else:
-            logits = logits + mask
+            logits = logits + mask.astype(logits.dtype)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
     if dropout_key is not None and dropout_p > 0.0:
         keep = jax.random.bernoulli(
             dropout_key, 1.0 - dropout_p, probs.shape).astype(probs.dtype)
         probs = probs * keep / (1.0 - dropout_p)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    # probs @ v contracts over the WHOLE key length — the deepest
+    # reduction in the model; accumulate wide, round once at the output
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v, **pet).astype(q.dtype)
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,

@@ -340,20 +340,31 @@ def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
 def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
     def fn(a, b):
         from paddle_tpu.amp.auto_cast import downcast_inputs
-        a, b = downcast_inputs(a, b, opname="matmul")
+        from paddle_tpu.nn.functional.common import (_is_master_downcast,
+                                                     _mm_master)
+        a2, b2 = downcast_inputs(a, b, opname="matmul")
+        if _is_master_downcast(a2, b2, b) and not transpose_x:
+            # master-weight case (e.g. the tied lm head): the weight
+            # grad accumulates WIDE and lands f32 directly — numlint
+            # NL101 (see F.linear's custom_vjp block)
+            return _mm_master(bool(transpose_y), a2, b)
         if transpose_x:
-            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+            a2 = jnp.swapaxes(a2, -1, -2) if a2.ndim > 1 else a2
         if transpose_y:
-            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
-        return jnp.matmul(a, b)
+            b2 = jnp.swapaxes(b2, -1, -2) if b2.ndim > 1 else b2
+        return jnp.matmul(a2, b2)
     return apply(fn, x, y)
 
 
 def mm(input, mat2, name=None):
     def fn(a, b):
         from paddle_tpu.amp.auto_cast import downcast_inputs
-        a, b = downcast_inputs(a, b, opname="mm")
-        return jnp.matmul(a, b)
+        from paddle_tpu.nn.functional.common import (_is_master_downcast,
+                                                     _mm_master)
+        a2, b2 = downcast_inputs(a, b, opname="mm")
+        if _is_master_downcast(a2, b2, b):
+            return _mm_master(False, a2, b)
+        return jnp.matmul(a2, b2)
     return apply(fn, input, mat2)
 
 

@@ -1,12 +1,13 @@
 """The unified gate: tools/lint_all.py chains tracelint --check,
-shardlint --check, racelint --check, perfgate --check, api_coverage
---baseline and the chaos suite (pytest -m chaos, run under the
-racelint lock-order tracer) into ONE exit code.  This `lint`-marked
-test is how tier-1 enforces the five static baselines; the chaos gate
+shardlint --check, racelint --check, numlint --check, perfgate --check,
+api_coverage --baseline and the chaos suite (pytest -m chaos, run under
+the racelint lock-order tracer) into ONE exit code.  This `lint`-marked
+test is how tier-1 enforces the six static baselines; the chaos gate
 is skipped here because tier-1 runs the chaos tests directly (they
 live in tests/test_resilience.py under the `chaos` marker) —
-standalone `python tools/lint_all.py` runs all six.
+standalone `python tools/lint_all.py` runs all seven.
 """
+import json
 import os
 import subprocess
 import sys
@@ -24,7 +25,7 @@ def test_lint_all_gate_clean():
     # (tests/test_resilience.py carries the marker), so re-running it
     # nested here would double its cost inside the tier-1 budget for no
     # added coverage.  Standalone `python tools/lint_all.py` (the CI
-    # entry point) still runs all six gates.
+    # entry point) still runs all seven gates.
     proc = subprocess.run([sys.executable, LINT_ALL, "--skip", "chaos"],
                           cwd=REPO, capture_output=True, text=True,
                           timeout=420)
@@ -33,6 +34,7 @@ def test_lint_all_gate_clean():
     assert "tracelint: ok" in out
     assert "shardlint: ok" in out
     assert "racelint: ok" in out
+    assert "numlint: ok" in out
     assert "perfgate: ok" in out
     assert "coverage: ok" in out
     assert "chaos: SKIPPED" in out
@@ -42,7 +44,41 @@ def test_lint_all_gate_clean():
 def test_lint_all_skip_flag():
     proc = subprocess.run(
         [sys.executable, LINT_ALL, "--skip", "tracelint", "shardlint",
-         "racelint", "perfgate", "coverage", "chaos"],
+         "racelint", "numlint", "perfgate", "coverage", "chaos"],
         cwd=REPO, capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0
+    assert proc.stdout.count("SKIPPED") == 7
+
+
+def test_lint_all_only_empty_is_usage_error():
+    """`--only` with no gates (an empty shell variable) must fail fast,
+    never print a false 'all gates clean'."""
+    proc = subprocess.run([sys.executable, LINT_ALL, "--only"],
+                          cwd=REPO, capture_output=True, text=True,
+                          timeout=60)
+    assert proc.returncode == 2
+    assert "all gates clean" not in proc.stdout
+
+
+def test_lint_all_only_and_json(tmp_path):
+    """--only runs just the named gates; --json emits the unified
+    {gate: {ok, findings, elapsed_s}} document with the shared "tool"
+    schema key.  tracelint is the cheapest real gate (pure AST)."""
+    out_json = tmp_path / "gates.json"
+    proc = subprocess.run(
+        [sys.executable, LINT_ALL, "--only", "tracelint",
+         "--json", str(out_json)],
+        cwd=REPO, capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "tracelint: ok" in proc.stdout
     assert proc.stdout.count("SKIPPED") == 6
+    doc = json.loads(out_json.read_text())
+    assert doc["tool"] == "lint_all"
+    assert set(doc["gates"]) == {"tracelint", "shardlint", "racelint",
+                                 "numlint", "perfgate", "coverage",
+                                 "chaos"}
+    tl = doc["gates"]["tracelint"]
+    assert tl["ok"] is True
+    assert isinstance(tl["findings"], int)
+    assert tl["elapsed_s"] >= 0
+    assert doc["gates"]["chaos"]["skipped"] is True

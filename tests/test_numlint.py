@@ -1,0 +1,632 @@
+"""numlint (paddle_tpu/analysis dtype_flow + num_rules): rule unit
+tests per NL family (one flagged + one clean case each), suppression
+scoping (the `# shardlint:`/`# racelint:` spellings must NOT waive NL
+rules), the dispatch narrow-accum allowlist, the to_static(check=True)
+NumlintWarning hook, the shared `--diff` renderer, the fixed-numerics
+regressions (pre-fix-failing: narrow bias/weight-grad accumulation,
+narrow serving attention accumulation, implicit scatter narrowing),
+the bench report lane, and the CLI baseline gate run exactly as CI
+runs it.
+
+Everything traces tiny jaxprs on CPU — nothing compiles.
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import analysis
+from paddle_tpu.analysis import InputInfo, NumConfig
+
+pytestmark = pytest.mark.numlint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CFG = NumConfig(reduce_min_elems=64)
+
+
+def codes_of(jaxpr, inputs=None, config=CFG):
+    return [f.code for f in analysis.check_numerics(
+        jaxpr, where="<test>", inputs=inputs, config=config)]
+
+
+# --------------------------------------------------------------- NL101
+@pytest.mark.smoke
+def test_nl101_narrow_dot_flagged_wide_clean():
+    a = jnp.ones((8, 512), jnp.bfloat16)
+    b = jnp.ones((512, 8), jnp.bfloat16)
+    flagged = jax.make_jaxpr(jnp.matmul)(a, b)
+    assert "NL101" in codes_of(flagged)
+    wide = jax.make_jaxpr(
+        lambda x, y: jax.lax.dot_general(
+            x, y, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32))(a, b)
+    assert "NL101" not in codes_of(wide)
+
+
+def test_nl101_narrow_reduce_sum_flagged():
+    # the bias-grad shape: jax's broadcast transpose emits a RAW
+    # reduce_sum in the operand dtype (jnp.sum would upcast)
+    def f(b):
+        return (jnp.zeros((4096, 8), jnp.bfloat16) + b) \
+            .astype(jnp.float32).sum()
+    jaxpr = jax.make_jaxpr(jax.grad(f))(jnp.zeros((8,), jnp.bfloat16))
+    assert "NL101" in codes_of(jaxpr)
+
+
+def test_nl101_upcast_sum_and_short_reduce_clean():
+    jaxpr = jax.make_jaxpr(lambda x: jnp.sum(x, axis=-1))(
+        jnp.ones((4, 4096), jnp.bfloat16))      # jnp.sum upcasts: clean
+    assert "NL101" not in codes_of(jaxpr)
+    short = jax.make_jaxpr(jnp.matmul)(
+        jnp.ones((8, 16), jnp.bfloat16), jnp.ones((16, 8), jnp.bfloat16))
+    assert "NL101" not in codes_of(short)       # K=16 < threshold
+
+
+def test_nl101_dispatch_allowlist():
+    from paddle_tpu.core import dispatch
+    a = jnp.ones((8, 512), jnp.bfloat16)
+    b = jnp.ones((512, 8), jnp.bfloat16)
+    jaxpr = jax.make_jaxpr(jnp.matmul)(a, b)
+    dispatch.allow_narrow_accum("dot_general")
+    try:
+        assert "NL101" not in codes_of(jaxpr)
+    finally:
+        dispatch._NARROW_ACCUM_ALLOWED_OPS.discard("dot_general")
+    assert "NL101" in codes_of(jaxpr)
+
+
+# --------------------------------------------------------------- NL102
+def _roundtrip_live(x):
+    y = x * 2.0
+    z = y.astype(jnp.bfloat16).astype(jnp.float32)
+    return z + y            # the wide y is still live at the re-widen
+
+
+@pytest.mark.smoke
+def test_nl102_live_roundtrip_flagged():
+    jaxpr = jax.make_jaxpr(_roundtrip_live)(jnp.ones((8, 8), jnp.float32))
+    assert "NL102" in codes_of(jaxpr)
+
+
+def test_nl102_dead_wide_and_input_rooted_clean():
+    def dead(x):
+        y = x * 2.0
+        return y.astype(jnp.bfloat16).astype(jnp.float32)
+    jaxpr = jax.make_jaxpr(dead)(jnp.ones((8, 8), jnp.float32))
+    assert "NL102" not in codes_of(jaxpr)       # residency round trip
+    # input-rooted chains belong to shardlint SL303 (one fingerprint
+    # owns a given cast chain — docs/shardlint.md)
+    jaxpr = jax.make_jaxpr(
+        lambda x: x.astype(jnp.bfloat16).astype(jnp.float32) + x)(
+        jnp.ones((8, 8), jnp.float32))
+    assert "NL102" not in codes_of(jaxpr)
+
+
+def test_nl102_roundtrip_across_call_boundary():
+    """A re-widen INSIDE a jit sub-jaxpr still sees the outer wide
+    root's liveness (the cross-level hint) — and stays clean when the
+    wide root really is dead."""
+    def live(x):
+        w = x + 1.0
+        n = w.astype(jnp.bfloat16)
+        z = jax.jit(lambda t: t.astype(jnp.float32) + 1.0)(n)
+        return z + w                 # w live across the boundary
+    jaxpr = jax.make_jaxpr(live)(jnp.ones((8, 8), jnp.float32))
+    assert "NL102" in codes_of(jaxpr)
+
+    def dead(x):
+        w = x + 1.0
+        n = w.astype(jnp.bfloat16)   # w's ONLY consumer
+        return jax.jit(lambda t: t.astype(jnp.float32) + 1.0)(n)
+    jaxpr = jax.make_jaxpr(dead)(jnp.ones((8, 8), jnp.float32))
+    assert "NL102" not in codes_of(jaxpr)
+
+
+def test_nl102_sl303_single_ownership():
+    """The dedupe satellite, end to end: an input whose only consumers
+    are bf16 casts is SL303's finding (shardlint) and must NOT also be
+    NL102's, even when the narrow copy is re-widened downstream."""
+    def f(w):
+        return w.astype(jnp.bfloat16).astype(jnp.float32) * 2.0
+    big = jnp.ones((256, 256), jnp.float32)
+    jaxpr = jax.make_jaxpr(f)(big)
+    infos = [InputInfo(name="w", kind="param", shape=(256, 256),
+                       dtype="float32", nbytes=big.size * 4)]
+    sl, _ = analysis.audit_jaxpr(
+        jaxpr, where="<own>", inputs=infos,
+        config=analysis.AuditConfig(f32_param_min_bytes=1 << 10))
+    nl = analysis.check_numerics(jaxpr, where="<own>", inputs=infos,
+                                 config=CFG)
+    assert "SL303" in [f.code for f in sl]
+    assert "NL102" not in [f.code for f in nl]
+
+
+# --------------------------------------------------------------- NL103
+def _trivial_jaxpr():
+    return jax.make_jaxpr(lambda x: x * 2)(jnp.ones((2,), jnp.float32))
+
+
+@pytest.mark.smoke
+def test_nl103_narrow_moment_flagged_optin_clean():
+    infos = [InputInfo(name="fc_w_moment1", kind="opt_state",
+                       shape=(64, 64), dtype="bfloat16", nbytes=8192)]
+    assert "NL103" in codes_of(_trivial_jaxpr(), inputs=infos)
+    optin = NumConfig(reduce_min_elems=64, moment_optin=("*_moment?",))
+    assert "NL103" not in codes_of(_trivial_jaxpr(), inputs=infos,
+                                   config=optin)
+
+
+def test_nl103_narrow_param_flagged_f32_clean():
+    narrow = [InputInfo(name="w", kind="param", shape=(8, 8),
+                        dtype="bfloat16", nbytes=128)]
+    assert "NL103" in codes_of(_trivial_jaxpr(), inputs=narrow)
+    wide = [InputInfo(name="w", kind="param", shape=(8, 8),
+                      dtype="float32", nbytes=256),
+            InputInfo(name="w_moment1", kind="opt_state", shape=(8, 8),
+                      dtype="float32", nbytes=256)]
+    assert "NL103" not in codes_of(_trivial_jaxpr(), inputs=wide)
+
+
+# --------------------------------------------------------------- NL201
+@pytest.mark.smoke
+def test_nl201_bare_narrow_exp_flagged_softmax_clean():
+    x = jnp.ones((8, 8), jnp.bfloat16)
+    assert "NL201" in codes_of(jax.make_jaxpr(jnp.exp)(x))
+    # jax.nn.softmax subtracts the row max — stabilized, clean
+    assert "NL201" not in codes_of(
+        jax.make_jaxpr(lambda v: jax.nn.softmax(v, axis=-1))(x))
+
+
+def test_nl201_div_eps_guard_and_literal_denominator():
+    x = jnp.ones((8, 8), jnp.bfloat16)
+    d = jnp.ones((8, 8), jnp.bfloat16)
+    assert "NL201" in codes_of(jax.make_jaxpr(lambda a, b: a / b)(x, d))
+    assert "NL201" not in codes_of(
+        jax.make_jaxpr(lambda a, b: a / jnp.maximum(b, 1e-3))(x, d))
+    # a literal denominator cannot be a stray zero
+    assert "NL201" not in codes_of(jax.make_jaxpr(lambda a: a / 8.0)(x))
+
+
+def test_nl201_f32_is_clean():
+    x = jnp.ones((8, 8), jnp.float32)
+    assert "NL201" not in codes_of(jax.make_jaxpr(jnp.exp)(x))
+
+
+# --------------------------------------------------------------- NL202
+@pytest.mark.smoke
+def test_nl202_narrow_carry_wide_body_flagged():
+    def body(c, x):
+        c2 = (c.astype(jnp.float32) + x.astype(jnp.float32)) \
+            .astype(jnp.bfloat16)
+        return c2, c2
+    def f(xs):
+        return jax.lax.scan(body, jnp.zeros((8,), jnp.bfloat16), xs)
+    jaxpr = jax.make_jaxpr(f)(jnp.ones((100, 8), jnp.bfloat16))
+    assert "NL202" in codes_of(jaxpr)
+
+
+def test_nl202_wide_carry_clean():
+    def body(c, x):
+        c2 = c + x.astype(jnp.float32)
+        return c2, c2.astype(jnp.bfloat16)
+    def f(xs):
+        return jax.lax.scan(body, jnp.zeros((8,), jnp.float32), xs)
+    jaxpr = jax.make_jaxpr(f)(jnp.ones((100, 8), jnp.bfloat16))
+    assert "NL202" not in codes_of(jaxpr)
+
+
+# --------------------------------------------------------------- NL301
+@pytest.mark.smoke
+def test_nl301_scale_free_quant_flagged_descaled_clean():
+    q = jnp.ones((16, 16), jnp.int8)
+    x = jnp.ones((16, 16), jnp.float32)
+    # un-descaled dequant consumed by math
+    flagged = jax.make_jaxpr(
+        lambda a, b: jnp.matmul(a.astype(jnp.float32), b))(q, x)
+    assert "NL301" in codes_of(flagged)
+    # dequant * scale first: properly descaled
+    clean = jax.make_jaxpr(
+        lambda a, b: jnp.matmul(a.astype(jnp.float32) * 0.05, b))(q, x)
+    assert "NL301" not in codes_of(clean)
+
+
+def test_nl301_int8_index_use_clean():
+    idx = jnp.zeros((4,), jnp.int8)
+    table = jnp.ones((8, 16), jnp.float32)
+    jaxpr = jax.make_jaxpr(
+        lambda i, t: jnp.take(t, i.astype(jnp.int32), axis=0))(idx, table)
+    assert "NL301" not in codes_of(jaxpr)
+
+
+# --------------------------------------------------------------- NL302
+@pytest.mark.smoke
+def test_nl302_dequant_requant_flagged_shared_intermediate_clean():
+    q = jnp.ones((16, 16), jnp.int8)
+    flagged = jax.make_jaxpr(
+        lambda a: (a.astype(jnp.float32) * 0.5).astype(jnp.int8))(q)
+    assert "NL302" in codes_of(flagged)
+    def shared(a):
+        d = a.astype(jnp.float32) * 0.5
+        return d.astype(jnp.int8), d.sum()   # the float has another use
+    assert "NL302" not in codes_of(jax.make_jaxpr(shared)(q))
+
+
+# ------------------------------------------------- suppression scoping
+_SUPP_SRC = """
+import jax.numpy as jnp
+
+
+def risky(x):
+    return jnp.exp(x){comment}
+"""
+
+
+def _supp_codes(tmp_path, name, comment):
+    path = tmp_path / f"{name}.py"
+    path.write_text(_SUPP_SRC.format(comment=comment))
+    spec = importlib.util.spec_from_file_location(name, str(path))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    jaxpr = jax.make_jaxpr(mod.risky)(jnp.ones((4,), jnp.bfloat16))
+    return codes_of(jaxpr)
+
+
+def test_numlint_and_tracelint_spellings_waive(tmp_path):
+    for i, comment in enumerate(("  # numlint: disable=NL201",
+                                 "  # tracelint: disable=NL201",
+                                 "  # numlint: disable=ALL")):
+        assert "NL201" not in _supp_codes(tmp_path, f"waive{i}", comment)
+
+
+def test_foreign_spellings_cannot_waive_nl(tmp_path):
+    """The scoping mirror of PR 7's racelint test: a shardlint- or
+    racelint-spelled comment must NOT silence a numerics finding."""
+    for i, comment in enumerate(("  # shardlint: disable=NL201",
+                                 "  # racelint: disable=NL201",
+                                 "  # shardlint: disable=ALL",
+                                 "  # racelint: disable=ALL")):
+        assert "NL201" in _supp_codes(tmp_path, f"keep{i}", comment)
+
+
+def test_finding_points_into_fixture_file(tmp_path):
+    path = tmp_path / "site_fixture.py"
+    path.write_text(_SUPP_SRC.format(comment=""))
+    spec = importlib.util.spec_from_file_location("site_fixture",
+                                                  str(path))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    jaxpr = jax.make_jaxpr(mod.risky)(jnp.ones((4,), jnp.bfloat16))
+    findings = analysis.check_numerics(jaxpr, where="<pair>", config=CFG)
+    f = next(f for f in findings if f.code == "NL201")
+    assert "site_fixture.py" in f.path and f.line > 0
+
+
+# ------------------------------------------------ to_static(check=True)
+def test_to_static_check_emits_numlint_warning():
+    paddle.seed(0)
+    x = paddle.to_tensor(np.ones((8, 8), np.float32)).astype("bfloat16")
+
+    @paddle.jit.to_static(check=True)
+    def f(v):
+        return paddle.exp(v)
+
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        f(x)
+    msgs = [str(w.message) for w in rec
+            if isinstance(w.message, analysis.NumlintWarning)]
+    assert any("NL201" in m for m in msgs), msgs
+
+
+# -------------------------------------------------- fixed numerics
+class TestFixedNumerics:
+    """PR 12's self-audit fixes, each with its pre-fix failure mode
+    reproduced deterministically (the racelint PR 7 pattern)."""
+
+    def test_bias_grad_accumulates_wide(self):
+        """3000 unit cotangents: the pre-fix bf16 serial/tree sum
+        CANNOT represent 3000 (ulp at 2048 is 16); the fixed master
+        path lands the exact f32 sum."""
+        from paddle_tpu.amp.policy import activation_residency
+        import paddle_tpu.nn.functional as F
+        paddle.seed(0)
+        x = paddle.to_tensor(
+            np.ones((1, 3000, 8), np.float32)).astype("bfloat16")
+        x.stop_gradient = False
+        w = paddle.to_tensor(np.zeros((8, 4), np.float32),
+                             stop_gradient=False)
+        b = paddle.to_tensor(np.zeros((4,), np.float32),
+                             stop_gradient=False)
+        with activation_residency("bf16"):
+            y = F.linear(x, w, b)
+            y.astype("float32").sum().backward()
+        assert str(b.grad.dtype).endswith("float32")
+        assert np.allclose(np.asarray(b.grad._value), 3000.0), \
+            np.asarray(b.grad._value)
+        # the pre-fix computation (a raw bf16 reduce over the bf16
+        # cotangent) demonstrably cannot produce 3000
+        def prefix(bb):
+            return (jnp.zeros((3000,), jnp.bfloat16) + bb) \
+                .astype(jnp.float32).sum()
+        narrow = jax.grad(prefix)(jnp.zeros((), jnp.bfloat16))
+        assert abs(float(narrow) - 3000.0) >= 8.0, float(narrow)
+
+    def test_weight_grad_accumulates_wide_and_lands_f32(self):
+        from paddle_tpu.amp.policy import activation_residency
+        import paddle_tpu.nn.functional as F
+        paddle.seed(0)
+        x = paddle.to_tensor(
+            np.ones((1, 3000, 1), np.float32)).astype("bfloat16")
+        x.stop_gradient = False
+        w = paddle.to_tensor(np.zeros((1, 1), np.float32),
+                             stop_gradient=False)
+        with activation_residency("bf16"):
+            y = F.linear(x, w)
+            y.astype("float32").sum().backward()
+        assert str(w.grad.dtype).endswith("float32")
+        assert np.allclose(np.asarray(w.grad._value), 3000.0), \
+            np.asarray(w.grad._value)
+        # pre-fix: the same contraction as one bf16 dot
+        ones = jnp.ones((3000,), jnp.bfloat16)
+        narrow = jax.lax.dot_general(ones, ones, (((0,), (0,)), ((), ())))
+        assert abs(float(narrow) - 3000.0) >= 8.0, float(narrow)
+
+    def test_upcast_weight_keeps_stock_ad(self):
+        """The master path fires only on a genuine DOWNcast: a narrow-
+        stored weight that the amp black-list UPcasts must keep stock
+        AD — grad dtype stays the param's dtype."""
+        from paddle_tpu.amp.auto_cast import auto_cast
+        import paddle_tpu.nn.functional as F
+        paddle.seed(0)
+        x = paddle.to_tensor(
+            np.ones((1, 4, 2), np.float32)).astype("bfloat16")
+        x.stop_gradient = False
+        w = paddle.to_tensor(np.ones((2, 2), np.float32),
+                             dtype="bfloat16", stop_gradient=False)
+        with auto_cast(enable=True, level="O1", dtype="bfloat16",
+                       custom_black_list={"linear"}):
+            y = F.linear(x, w)      # black list upcasts w to f32
+            y.astype("float32").sum().backward()
+        assert str(w.grad.dtype).endswith("bfloat16"), w.grad.dtype
+
+    def test_integer_lhs_keeps_stock_promotion(self):
+        """The master path requires a matching narrow-float lhs: an
+        integer lhs under auto_cast must keep jnp.matmul's stock
+        promotion (the master path would truncate the f32 weights to
+        the lhs dtype)."""
+        from paddle_tpu.amp.auto_cast import auto_cast
+        paddle.seed(0)
+        ids = paddle.to_tensor(np.array([[1, 2, 3, 4]], np.int32))
+        w = paddle.to_tensor(
+            np.array([[0.5], [0.25], [0.125], [0.0625]], np.float32))
+        with auto_cast(enable=True, level="O1", dtype="bfloat16"):
+            out = paddle.matmul(ids, w)
+        expect = 1 * 0.5 + 2 * 0.25 + 3 * 0.125 + 4 * 0.0625
+        assert np.allclose(np.asarray(out._value, np.float64),
+                           expect, rtol=1e-2), np.asarray(out._value)
+
+    def test_lm_head_transposed_master_grad(self):
+        from paddle_tpu.amp.policy import activation_residency
+        paddle.seed(0)
+        h = paddle.to_tensor(
+            np.ones((1, 3000, 2), np.float32)).astype("bfloat16")
+        h.stop_gradient = False
+        w = paddle.to_tensor(np.zeros((4, 2), np.float32),
+                             stop_gradient=False)
+        with activation_residency("bf16"):
+            logits = paddle.matmul(h, w, transpose_y=True)
+            logits.astype("float32").sum().backward()
+        assert str(w.grad.dtype).endswith("float32")
+        assert np.allclose(np.asarray(w.grad._value), 3000.0)
+
+    def test_flagship_numlint_clean_at_fixed_sites(self):
+        """The self-audit acceptance: the optimized train step carries
+        ZERO narrow reduce_sum accumulations (the pre-fix bias-grad
+        finding class) — only the baselined forward/da dots remain."""
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            from perfgate import build_gpt_train_step
+        finally:
+            sys.path.pop(0)
+        step, ids, labels = build_gpt_train_step(optimized=True)
+        jaxpr, infos = step.traced_program(ids, labels)
+        findings = analysis.check_numerics(
+            jaxpr, where="<gpt>", inputs=infos,
+            config=NumConfig(reduce_min_elems=32))
+        assert not [f for f in findings
+                    if "reduce_sum" in f.message], findings
+        assert not [f for f in findings if f.code != "NL101"], findings
+
+    def test_paged_attend_bf16_accumulates_wide(self):
+        """Serving-path fix pair: the PRE-FIX attention core (narrow
+        score/value dots) flags NL101 under bf16 pools; the shipped one
+        is clean — and at f32 its jaxpr is byte-identical to pre-fix."""
+        from paddle_tpu.incubate.nn.paged_attention import paged_attend
+
+        def prefix_attend(q, k_pages, v_pages, tables, lens):
+            b, h, one, d = q.shape
+            sc = 1.0 / float(d) ** 0.5
+            k_seq = k_pages[tables]
+            v_seq = v_pages[tables]
+            P = tables.shape[1]
+            k_seq = jnp.moveaxis(k_seq, 2, 1).reshape(b, h, P * 8, d)
+            v_seq = jnp.moveaxis(v_seq, 2, 1).reshape(b, h, P * 8, d)
+            pos = jnp.arange(P * 8)
+            mask = pos[None, None, None, :] < lens[:, None, None, None]
+            s = (q * sc) @ jnp.swapaxes(k_seq, -1, -2)
+            s = jnp.where(mask, s.astype(jnp.float32),
+                          jnp.finfo(jnp.float32).min)
+            p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+            return p @ v_seq
+
+        def args(dt):
+            return (jnp.ones((2, 2, 1, 128), dt),
+                    jnp.ones((16, 2, 8, 128), dt),
+                    jnp.ones((16, 2, 8, 128), dt),
+                    jnp.zeros((2, 8), jnp.int32),
+                    jnp.full((2,), 63, jnp.int32))
+
+        old = jax.make_jaxpr(prefix_attend)(*args(jnp.bfloat16))
+        new = jax.make_jaxpr(
+            lambda *a: paged_attend(*a, page_size=8))(*args(jnp.bfloat16))
+        assert "NL101" in codes_of(old)
+        assert "NL101" not in codes_of(new)
+        # f32 pools: the fix is invisible — identical program
+        old32 = jax.make_jaxpr(prefix_attend)(*args(jnp.float32))
+        new32 = jax.make_jaxpr(
+            lambda *a: paged_attend(*a, page_size=8))(*args(jnp.float32))
+        assert str(old32) == str(new32)
+
+    def test_scatter_narrowing_is_explicit(self):
+        """bf16 pools + f32 K/V: the page scatter must narrow through
+        an explicit convert (jax deprecates the implicit scatter cast);
+        every scatter update dtype matches its pool."""
+        from paddle_tpu.incubate.nn.paged_attention import \
+            paged_prefill_append
+
+        def f(k_new, v_new, kp, vp, tables, lens):
+            return paged_prefill_append(k_new, v_new, kp, vp, tables,
+                                        lens, 8)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", FutureWarning)
+            jaxpr = jax.make_jaxpr(f)(
+                jnp.ones((2, 2, 16, 4), jnp.float32),
+                jnp.ones((2, 2, 16, 4), jnp.float32),
+                jnp.zeros((8, 2, 8, 4), jnp.bfloat16),
+                jnp.zeros((8, 2, 8, 4), jnp.bfloat16),
+                jnp.zeros((2, 2), jnp.int32),
+                jnp.full((2,), 16, jnp.int32))
+        from paddle_tpu.analysis.jaxpr_rules import _iter_eqns
+        for eqn in _iter_eqns(jaxpr):
+            if eqn.primitive.name.startswith("scatter"):
+                op_dt = str(eqn.invars[0].aval.dtype)
+                upd_dt = str(eqn.invars[-1].aval.dtype)
+                assert op_dt == upd_dt, (op_dt, upd_dt)
+
+
+# ------------------------------------------------------- shared --diff
+def test_diff_mode_per_rule_counts(tmp_path, capsys):
+    from argparse import Namespace
+
+    from paddle_tpu.analysis import common, report
+    from paddle_tpu.analysis.visitor import Finding
+
+    def mk(code, line):
+        return Finding(path="pkg/m.py", line=line, col=0, code=code,
+                       message="m", source_line=f"src{code}{line}")
+
+    base = tmp_path / "base.json"
+    report.write_baseline([mk("NL101", 1), mk("NL101", 2),
+                           mk("NL201", 3)], str(base))
+    args = Namespace(check=False, baseline=str(base),
+                     write_baseline=False, json=None, diff=True)
+    rc = common.run_baseline_flow(
+        [mk("NL101", 1), mk("NL302", 9)], args, tool="numlint",
+        repo=REPO, elapsed=0.1)
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "baseline" in out and "current" in out
+    assert "-50.0%" in out          # NL101 2 -> 1
+    assert "gone" in out            # NL201 vanished
+    assert "new" in out             # NL302 appeared
+
+
+def test_diff_composes_with_check(tmp_path, capsys):
+    """--diff never disarms the gate: combined with --check, the table
+    prints AND new findings still fail."""
+    from argparse import Namespace
+
+    from paddle_tpu.analysis import common, report
+    from paddle_tpu.analysis.visitor import Finding
+
+    def mk(code, line):
+        return Finding(path="pkg/m.py", line=line, col=0, code=code,
+                       message="m", source_line=f"src{code}{line}")
+
+    base = tmp_path / "base.json"
+    report.write_baseline([mk("NL101", 1)], str(base))
+    args = Namespace(check=True, baseline=str(base),
+                     write_baseline=False, json=None, diff=True)
+    rc = common.run_baseline_flow(
+        [mk("NL101", 1), mk("NL302", 9)], args, tool="numlint",
+        repo=REPO, elapsed=0.1)
+    out = capsys.readouterr().out
+    assert rc == 1                  # the NEW NL302 still gates
+    assert "baseline" in out and "current" in out
+
+
+def test_check_output_unchanged_by_diff_flag(tmp_path, capsys):
+    """--check output stays byte-identical with the --diff flag merely
+    PRESENT (False) on the namespace — the three pre-existing CLIs pin
+    this via their own gate tests; this is the unit-level guard."""
+    from argparse import Namespace
+
+    from paddle_tpu.analysis import common, report
+    from paddle_tpu.analysis.visitor import Finding
+
+    f = Finding(path="pkg/m.py", line=1, col=0, code="NL101",
+                message="m", source_line="src")
+    base = tmp_path / "base.json"
+    report.write_baseline([f], str(base))
+    args = Namespace(check=True, baseline=str(base),
+                     write_baseline=False, json=None, diff=False)
+    rc = common.run_baseline_flow([f], args, tool="numlint", repo=REPO,
+                                  elapsed=0.1)
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "numlint: 0 finding(s) (1 total, 1 baselined)" in out
+
+
+# ----------------------------------------------------- CLI & bench lane
+NUMLINT = os.path.join(REPO, "tools", "numlint.py")
+
+
+def test_rules_catalogue():
+    proc = subprocess.run([sys.executable, NUMLINT, "--rules"],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0
+    for code in ("NL101", "NL102", "NL103", "NL201", "NL202", "NL301",
+                 "NL302"):
+        assert code in proc.stdout
+    assert "SL101" not in proc.stdout and "RL101" not in proc.stdout
+
+
+def test_cli_check_gate_clean():
+    """The self-audit gate exactly as lint_all runs it: the shipped
+    tree must be clean against the reviewed baseline."""
+    proc = subprocess.run([sys.executable, NUMLINT, "--check"],
+                          cwd=REPO, capture_output=True, text=True,
+                          timeout=280)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "numlint: 0 finding(s)" in proc.stdout
+
+
+def test_cli_diff_informational():
+    proc = subprocess.run(
+        [sys.executable, NUMLINT, "--diff", "--targets",
+         "gpt_hybrid_train"],
+        cwd=REPO, capture_output=True, text=True, timeout=280)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "baseline" in proc.stdout and "current" in proc.stdout
+
+
+def test_bench_report_lane_keys():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import numlint
+    finally:
+        sys.path.pop(0)
+    rep = numlint.bench_report(targets=("serving",))
+    assert rep["numlint_finding_count"] == 0
+    assert rep["numlint_rule_breakdown"] == {}
+    assert rep["numlint_elapsed_s"] >= 0

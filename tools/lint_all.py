@@ -6,13 +6,17 @@ Chains every baseline-gated analyzer in the repo, plus the chaos suite:
   1. tracelint  --check paddle_tpu examples   (AST trace-safety, TLxxx)
   2. shardlint  --check                       (sharding/memory audit, SLxxx)
   3. racelint   --check paddle_tpu            (host concurrency audit, RLxxx)
-  4. perfgate   --check                       (deterministic cost-model
+  4. numlint    --check                       (numerics & precision-flow
+                                               audit over the traced
+                                               flagship + serving
+                                               programs, NLxxx)
+  5. perfgate   --check                       (deterministic cost-model
                                                perf budgets: bytes/flops
                                                per step, padding waste,
                                                compile bounds vs
                                                tools/perf_baseline.json)
-  5. api_coverage --baseline                  (public-surface regressions)
-  6. pytest -m chaos                          (deterministic fault-injection
+  6. api_coverage --baseline                  (public-surface regressions)
+  7. pytest -m chaos                          (deterministic fault-injection
                                                acceptance proofs, run under
                                                the racelint lock-order
                                                tracer — tests/conftest.py
@@ -33,12 +37,19 @@ enforces every gate at once.  The chaos gate deselects itself there via
 carry no `lint` marker, so the recursion terminates.
 
 Usage: python tools/lint_all.py
-       [--skip tracelint shardlint racelint perfgate coverage chaos]
+       [--skip tracelint shardlint racelint numlint perfgate coverage chaos]
+       [--only <gate> [<gate> ...]]
+       [--json FILE|-]   one unified {"tool": "lint_all", "gates":
+                         {gate: {ok, findings, elapsed_s}}} document —
+                         `findings` parsed from a gate's own summary
+                         line where it prints one, else null
 """
 from __future__ import annotations
 
 import argparse
+import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -53,6 +64,8 @@ GATES = {
                   "--check"],
     "racelint": [sys.executable, os.path.join(TOOLS, "racelint.py"),
                  "--check", "paddle_tpu"],
+    "numlint": [sys.executable, os.path.join(TOOLS, "numlint.py"),
+                "--check"],
     "perfgate": [sys.executable, os.path.join(TOOLS, "perfgate.py"),
                  "--check"],
     "coverage": [sys.executable, os.path.join(TOOLS, "api_coverage.py"),
@@ -66,17 +79,37 @@ GATES = {
               os.path.join(REPO, "tests", "test_resilience.py")],
 }
 
+# the analyzers' shared summary line: "{tool}: N finding(s) ..."
+_FINDINGS_RE = re.compile(r"^\w+: (\d+) finding\(s\)", re.MULTILINE)
+
 
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="lint_all", description=__doc__)
     ap.add_argument("--skip", nargs="*", default=(),
                     choices=sorted(GATES), help="gates to skip")
+    ap.add_argument("--only", nargs="*", default=None,
+                    choices=sorted(GATES),
+                    help="run ONLY these gates (everything else is "
+                         "reported as SKIPPED)")
+    ap.add_argument("--json", metavar="FILE", default=None,
+                    help="also write the unified per-gate report as "
+                         "JSON ('-' for stdout)")
     args = ap.parse_args(argv)
 
+    if args.only is not None and not args.only:
+        # `--only` with no gates (e.g. an empty shell variable) would
+        # skip EVERYTHING and still print "all gates clean" — a false
+        # green; fail fast instead
+        ap.error("--only requires at least one gate")
+
+    doc = {"tool": "lint_all", "version": 1, "gates": {}}
     failures = []
     for name, cmd in GATES.items():
-        if name in args.skip:
+        if name in args.skip or \
+                (args.only is not None and name not in args.only):
             print(f"-- {name}: SKIPPED")
+            doc["gates"][name] = {"ok": None, "findings": None,
+                                  "elapsed_s": 0.0, "skipped": True}
             continue
         t0 = time.time()
         try:
@@ -86,13 +119,33 @@ def main(argv=None):
         except subprocess.TimeoutExpired:
             print(f"-- {name}: FAIL (timed out after 300s)")
             failures.append(name)
+            doc["gates"][name] = {"ok": False, "findings": None,
+                                  "elapsed_s": round(time.time() - t0, 2),
+                                  "error": "timeout"}
             continue
+        elapsed = time.time() - t0
         status = "ok" if proc.returncode == 0 else f"FAIL rc={proc.returncode}"
-        print(f"-- {name}: {status} in {time.time() - t0:.1f}s")
+        print(f"-- {name}: {status} in {elapsed:.1f}s")
+        m = _FINDINGS_RE.search(proc.stdout)
+        doc["gates"][name] = {
+            "ok": proc.returncode == 0,
+            "findings": int(m.group(1)) if m else None,
+            "elapsed_s": round(elapsed, 2),
+        }
         if proc.returncode != 0:
             failures.append(name)
             sys.stdout.write(proc.stdout)
             sys.stderr.write(proc.stderr)
+
+    if args.json:
+        if args.json == "-":
+            json.dump(doc, sys.stdout, indent=1)
+            print()
+        else:
+            with open(args.json, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh, indent=1)
+                fh.write("\n")
+
     if failures:
         print(f"lint_all: FAILED ({', '.join(failures)})")
         return 1

@@ -263,30 +263,21 @@ def render_diff(current, baseline):
     """Print the old-vs-new per-metric table (--diff) and return the
     rows as dicts (for --json).  Purely informational: the % delta
     column is signed (negative = improvement, every metric is
-    lower-is-better); metrics present on only one side are labeled."""
+    lower-is-better); metrics present on only one side are labeled.
+    The table renderer itself was promoted to analysis/common.py so
+    tracelint/shardlint/racelint/numlint share the format for their
+    own ``--diff`` modes."""
+    from paddle_tpu.analysis.common import render_diff_table
     rows = []
     base_targets = baseline.get("targets", {})
     for tname in sorted(set(base_targets) | set(current)):
-        bm = base_targets.get(tname, {})
-        cm = current.get(tname, {})
-        print(f"== {tname}")
-        print(f"   {'metric':28s} {'baseline':>14s} {'current':>14s} "
-              f"{'delta':>9s}")
-        for m in sorted(set(bm) | set(cm)):
-            b, c = bm.get(m), cm.get(m)
-            if b is None:
-                delta = "new"
-            elif c is None:
-                delta = "gone"
-            elif b == 0:
-                delta = "=" if c == 0 else "+inf"
-            else:
-                delta = f"{100.0 * (c / b - 1.0):+.1f}%"
-            rows.append({"target": tname, "metric": m, "baseline": b,
-                         "current": c, "delta": delta})
-            fmt = lambda v: "-" if v is None else f"{v:,}" \
-                if isinstance(v, int) else f"{v}"          # noqa: E731
-            print(f"   {m:28s} {fmt(b):>14s} {fmt(c):>14s} {delta:>9s}")
+        sub = render_diff_table(base_targets.get(tname, {}),
+                                current.get(tname, {}), title=tname,
+                                label="metric")
+        for r in sub:
+            rows.append({"target": tname, "metric": r["metric"],
+                         "baseline": r["baseline"],
+                         "current": r["current"], "delta": r["delta"]})
     return rows
 
 
