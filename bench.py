@@ -54,6 +54,7 @@ OBS_S = 150
 RESIL_S = 150
 PROFILE_S = 150
 REMAT_S = 150
+QUANT_S = 150
 CPU_TIMEOUT_S = 150
 CAPTURE_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), ".bench_capture_tpu.json")
@@ -700,6 +701,78 @@ def worker_router():
     return 0
 
 
+def worker_quant():
+    """Quantization lane: the two quantized memory planes' density
+    numbers (paddle_tpu/quantization — ROADMAP item 2).  Pure CPU
+    accounting over the serving-target geometry, never touches the TPU
+    claim, so every BENCH report records what quantized storage buys:
+
+      quant_kv_bytes_per_token_{f32,bf16,int8} — pool storage per token
+      quant_kv_vs_{bf16,f32}_ratio             — the perfgate-gated
+                                                 density win (<= 0.55x
+                                                 bf16 asserted here too)
+      quant_seqs_at_budget_{f32,bf16,int8}     — concurrent max-length
+                                                 sequences inside the
+                                                 FIXED default-f32-pool
+                                                 HBM budget
+      quant_allreduce_bytes / _wide / _ratio   — EQuARX wire model for
+                                                 a 1M-element gradient
+                                                 sync at axis size 8
+    """
+    _init_backend()   # honors PTPU_FORCE_CPU (always set for this lane)
+    t0 = time.time()
+    tools_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools")
+    sys.path.insert(0, tools_dir)
+    try:
+        import perfgate
+        import jax.numpy as jnp
+
+        from paddle_tpu.quantization.collectives import \
+            quantized_all_reduce_wire_bytes
+
+        build = perfgate._quant_engines()
+        engines = {}
+        try:
+            engines["f32"] = build()
+            engines["bf16"] = build(dtype=jnp.bfloat16)
+            engines["int8"] = build(kv_cache_dtype="int8")
+            bpt = {k: e.kv_bytes_per_token for k, e in engines.items()}
+            # fixed HBM budget = the default f32 pool's bytes; capacity
+            # = whole max-length sequences that fit inside it
+            budget = engines["f32"].kv_pool_bytes
+            seq_len = engines["f32"].config.max_model_len
+            caps = {k: int(budget // (bpt[k] * seq_len))
+                    for k in engines}
+        finally:
+            for e in engines.values():
+                e.shutdown()
+        wire = quantized_all_reduce_wire_bytes(1 << 20, axis_size=8)
+        out = {
+            "quant_kv_bytes_per_token_f32": round(bpt["f32"], 2),
+            "quant_kv_bytes_per_token_bf16": round(bpt["bf16"], 2),
+            "quant_kv_bytes_per_token_int8": round(bpt["int8"], 2),
+            "quant_kv_vs_bf16_ratio": round(bpt["int8"] / bpt["bf16"], 4),
+            "quant_kv_vs_f32_ratio": round(bpt["int8"] / bpt["f32"], 4),
+            "quant_seqs_at_budget_f32": caps["f32"],
+            "quant_seqs_at_budget_bf16": caps["bf16"],
+            "quant_seqs_at_budget_int8": caps["int8"],
+            "quant_allreduce_bytes": wire["allreduce_bytes"],
+            "quant_allreduce_bytes_wide": wire["allreduce_bytes_wide"],
+            "quant_allreduce_vs_wide_ratio":
+                wire["allreduce_quant_vs_wide_ratio"],
+            "quant_elapsed_s": round(time.time() - t0, 2),
+        }
+        # lane contracts, checked BEFORE the result line prints: the
+        # density win the docs claim must hold on the numbers reported
+        assert out["quant_kv_vs_bf16_ratio"] <= 0.55, out
+        assert caps["int8"] >= 2 * caps["f32"], out
+    finally:
+        sys.path.remove(tools_dir)
+    print(json.dumps(out), flush=True)
+    return 0
+
+
 def worker_numlint():
     """Static-analysis lane #3: numlint's numerics & precision-flow
     audit of the flagship programs (finding count + per-rule
@@ -1049,6 +1122,8 @@ def main():
         return worker_racelint()
     if "--worker-numlint" in sys.argv:
         return worker_numlint()
+    if "--worker-quant" in sys.argv:
+        return worker_quant()
     if "--worker-obs" in sys.argv:
         return worker_obs()
     if "--worker-profile" in sys.argv:
@@ -1074,6 +1149,7 @@ def main():
     prof_proc = _spawn("--worker-profile", force_cpu=True)
     remat_proc = _spawn("--worker-remat", force_cpu=True)
     router_proc = _spawn("--worker-router", force_cpu=True)
+    quant_proc = _spawn("--worker-quant", force_cpu=True)
 
     probe_proc = _spawn("--probe", force_cpu=False)
     probe_res, probe_err, probe_exited = _await_json(
@@ -1148,6 +1224,14 @@ def main():
     else:
         # same rationale: a router-lane failure degrades only its keys
         merged["router_error"] = str(router_err)
+
+    quant_res, quant_err, _ = _await_json(quant_proc, QUANT_S)
+    if quant_res is not None:
+        merged.update(quant_res)
+    else:
+        # same rationale: the quantization accounting lane failing
+        # degrades only its own keys
+        merged["quant_error"] = str(quant_err)
     tpu_ok = bool(probe_res
                   and (probe_res.get("ok") or probe_res.get("probe_ok"))
                   and probe_res.get("platform") != "cpu")
@@ -1181,6 +1265,7 @@ def main():
         _adopt_lane("profile_", "profile_bytes_per_step", prof_err)
         _adopt_lane("remat_", "remat_bytes_saved_pct", remat_err)
         _adopt_lane("router_", "router_tokens_per_s", router_err)
+        _adopt_lane("quant_", "quant_kv_bytes_per_token_int8", quant_err)
         if merged.get("probe_killed"):
             # the fallback note must record that the leaked probe was
             # reaped — the next run starts against a clean claim

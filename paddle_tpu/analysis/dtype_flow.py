@@ -95,6 +95,10 @@ class Prov:
     quant: bool = False            # raw int8/fp8 codes
     dequant_of: object = None      # quant var this float was converted from
     descaled: bool = False         # a scale multiply has been applied
+    bcast_src_size: int = None     # pre-broadcast element count — a
+    # per-page/per-block scale is tiny until jnp broadcasting expands it
+    # to the code shape right before the mul; the source size is what
+    # the scale-shape judgments below must see
 
     def clone(self, **kw):
         return replace(self, **kw)
@@ -317,6 +321,12 @@ class DtypeFlow:
                     p.stabilized = True
             if prim == "mul" and self._is_scale_mul(eqn, in_provs):
                 p.descaled = True
+            if prim == "broadcast_in_dim" and eqn.invars and \
+                    not _is_literal(eqn.invars[0]):
+                src = in_provs[0]
+                p.bcast_src_size = min(
+                    _size_of(eqn.invars[0]),
+                    src.bcast_src_size or _size_of(eqn.invars[0]))
             if prim == "reduce_max":
                 p.from_max = True
             elif prim in ("stop_gradient", "broadcast_in_dim", "reshape",
@@ -463,6 +473,17 @@ class DtypeFlow:
             eqn=eqn, prim=prim, operand_prov=p, stabilized=p.stabilized))
 
     # ------------------------------------------------------ quantization
+    @staticmethod
+    def _eff_size(v, prov):
+        """A value's size for the is-it-a-scale judgment: the PRE-
+        broadcast element count when jnp broadcasting expanded it to
+        the code shape right before the consuming eqn (a per-page
+        [pages, heads] scale is tiny; its broadcast copy is not)."""
+        n = _size_of(v)
+        if prov is not None and prov.bcast_src_size:
+            n = min(n, prov.bcast_src_size)
+        return n
+
     def _is_scale_mul(self, eqn, in_provs):
         """mul(dequant, small-float) — a per-tensor/group/page scale is
         orders of magnitude smaller than the codes it rescales."""
@@ -472,11 +493,12 @@ class DtypeFlow:
         pa, pb = in_provs
         for q, s in ((a, b), (b, a)):
             qp = pa if q is a else pb
+            sp = pb if q is a else pa
             if qp.dequant_of is None:
                 continue
             if _is_literal(s):
                 return True
-            if _size_of(s) * 8 <= max(1, _size_of(q)):
+            if self._eff_size(s, sp) * 8 <= max(1, _size_of(q)):
                 return True
         return False
 
@@ -497,7 +519,7 @@ class DtypeFlow:
               "stop_gradient"))
         if not is_float_math:
             return
-        small = [v for v in eqn.invars
+        small = [(v, p) for v, p in zip(eqn.invars, in_provs)
                  if _is_literal(v) or "float" in _dtype_of(v)]
         for v, p in zip(eqn.invars, in_provs):
             raw = p.quant and p.dtype in QUANT_DTYPES
@@ -511,8 +533,9 @@ class DtypeFlow:
                 continue
             has_scale = any(
                 s is not v and (_is_literal(s)
-                                or _size_of(s) * 8 <= max(1, _size_of(v)))
-                for s in small)
+                                or self._eff_size(s, sp) * 8
+                                <= max(1, _size_of(v)))
+                for s, sp in small)
             self.result.quant_uses.append(QuantUseEvent(
                 eqn=eqn, prim=prim, operand=v, operand_dtype=p.dtype,
                 raw=raw, has_scale_operand=has_scale))

@@ -197,9 +197,41 @@ def _reduce_fn(op):
     return table[op]
 
 
+def _quantized_policy_for(value, op):
+    """The active CollectivePolicy when it covers this reduction:
+    mesh-axis float SUM/AVG above the policy's size floor.  Everything
+    else (integer payloads, MAX/MIN/PROD, tiny tensors, no policy)
+    keeps the plain-XLA path — selection is explicit, never ambient."""
+    if op not in (ReduceOp.SUM, ReduceOp.AVG):
+        return None
+    from paddle_tpu.quantization.policy import current_collective_policy
+    pol = current_collective_policy()
+    if pol is None:
+        return None
+    if not jnp.issubdtype(value.dtype, jnp.floating):
+        return None
+    if value.size < pol.min_elems:
+        return None
+    return pol
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     axis = _axis_of(group)
     if axis is not None:
+        pol = _quantized_policy_for(tensor._value, op)
+        if pol is not None:
+            # EQuARX-style int8-payload path (quantization/collectives:
+            # block-scale -> all_to_all narrow -> f32 reduce -> requant
+            # -> all_gather narrow), selected by the trace-scoped
+            # quantization.quantized_collectives() policy
+            from paddle_tpu.quantization.collectives import \
+                quantized_all_reduce
+            out = apply(
+                lambda v: quantized_all_reduce(
+                    v, axis, bits=pol.bits, block=pol.block, key=pol.key,
+                    mean=(op == ReduceOp.AVG)).astype(v.dtype), tensor)
+            tensor._inplace_assign(out)
+            return tensor
         fn = _reduce_fn(op)
         out = apply(lambda v: fn(v, axis), tensor)
         tensor._inplace_assign(out)

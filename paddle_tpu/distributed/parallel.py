@@ -14,10 +14,12 @@ from paddle_tpu.core.engine import no_grad
 from paddle_tpu.nn.layer.layers import Layer
 
 
-def _int8_grad_sync(grad, group, ws):
+def _int8_grad_sync(grad, group, ws, bits=8, key=None):
     """Quantized mean-allreduce of one grad tensor over the collective
     layer: shared MAX-allreduced scale, int32 SUM, dequant/ws — the
-    eager-path form of quantized_collective.quantized_all_reduce_mean."""
+    eager-path form of quantized_collective.quantized_all_reduce_mean.
+    `bits`/`key` thread a CollectivePolicy's code width and stochastic-
+    rounding key through (defaults reproduce comm_dtype="int8")."""
     import jax.numpy as jnp
 
     from paddle_tpu.core.tensor import Tensor
@@ -27,12 +29,12 @@ def _int8_grad_sync(grad, group, ws):
     # the shard_map-level collective — one definition, two transports
     from paddle_tpu.distributed.quantized_collective import _quantize
 
-    qmax = 127.0
+    qmax = float(2 ** (int(bits) - 1) - 1)
     g = grad._value.astype(jnp.float32)
     smax = Tensor(jnp.max(jnp.abs(g)))
     all_reduce(smax, op=ReduceOp.MAX, group=group)
     scale = smax._value
-    q = Tensor(_quantize(g, scale, qmax, None))
+    q = Tensor(_quantize(g, scale, qmax, key))
     all_reduce(q, group=group)
     grad._set_value(
         (q._value.astype(jnp.float32) * (jnp.maximum(scale, 1e-30)
@@ -81,11 +83,31 @@ class DataParallel(Layer):
         ws = get_world_size(self.group)
         if ws <= 1:
             return
-        for p in self._inner.parameters():
+        # the trace-scoped quantization policy selects the int8 sync
+        # per tensor, honoring its whole contract — min_elems keeps
+        # tiny (latency-bound) grads full-precision, bits/key thread
+        # through — while comm_dtype="int8" keeps its historical
+        # quantize-everything-at-8-bits behavior
+        # (quantization.quantized_collectives(); docs/quantization.md)
+        import jax.numpy as jnp
+
+        from paddle_tpu.quantization.policy import \
+            current_collective_policy
+        pol = current_collective_policy()
+        for i, p in enumerate(self._inner.parameters()):
             if p.grad is None:
                 continue
+            g = p.grad._value
             if self._comm_dtype == "int8":
                 _int8_grad_sync(p.grad, self.group, ws)
+            elif pol is not None and \
+                    jnp.issubdtype(g.dtype, jnp.floating) and \
+                    g.size >= pol.min_elems:
+                import jax
+                key = (None if pol.key is None
+                       else jax.random.fold_in(pol.key, i))
+                _int8_grad_sync(p.grad, self.group, ws,
+                                bits=pol.bits, key=key)
             else:
                 all_reduce(p.grad, group=self.group)
                 p.grad._set_value(p.grad._value / ws)

@@ -38,14 +38,11 @@ __all__ = ["quantized_all_reduce_mean", "quantized_all_reduce_sum"]
 
 def _quantize(x, scale, qmax, key):
     xs = x.astype(jnp.float32) / jnp.maximum(scale, 1e-30) * qmax
-    if key is not None:
-        # stochastic rounding: floor + Bernoulli(frac) — unbiased
-        lo = jnp.floor(xs)
-        frac = xs - lo
-        xs = lo + jax.random.bernoulli(key, frac).astype(jnp.float32)
-    else:
-        xs = jnp.round(xs)
-    return jnp.clip(xs, -qmax, qmax).astype(jnp.int32)
+    # the rounding/clip core (incl. stochastic floor+Bernoulli) is the
+    # ONE shared definition in quantization.kv_cache — int32 here
+    # because this legacy wire format psums the codes directly
+    from paddle_tpu.quantization.kv_cache import encode_int_codes
+    return encode_int_codes(xs, qmax, key, dtype=jnp.int32)
 
 
 def quantized_all_reduce_sum(x, axis_name="dp", bits=8, key=None):

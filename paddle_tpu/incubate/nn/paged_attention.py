@@ -32,6 +32,12 @@ Three layers of API, outermost first:
 - pure jnp step functions (:func:`paged_decode_step`,
   :func:`paged_prefill_append`, :func:`paged_attend`) — trace-safe
   building blocks usable inside any jit/to_static program.
+
+Quantized pools: the per-page-scaled int8/fp8 variants of the step
+functions live in :mod:`paddle_tpu.quantization.kv_cache` (same page
+geometry, pools become ``(codes, scales)`` pairs, ~0.52x bytes/token
+vs bf16) — the serving engine selects them via
+``EngineConfig(kv_cache_dtype=)``; see docs/quantization.md.
 """
 from __future__ import annotations
 

@@ -1,15 +1,26 @@
-"""Quantization: PTQ observers + imperative PTQ / QAT.
+"""paddle_tpu.quantization — the two quantized memory planes + PTQ/QAT.
 
-Reference parity: python/paddle/quantization/__init__.py (PTQConfig,
-AbsmaxQuantizer, PerChannelAbsmaxQuantizer, HistQuantizer, KLQuantizer,
-ImperativePTQ, ImperativeQuantAware from the slim imperative suite).
+Three sub-surfaces (docs/quantization.md):
 
-TPU-native design: observers are tiny jnp reductions collected during
-eager calibration; fake-quant in QAT uses the straight-through estimator
-as a custom VJP; and CONVERTED linears run a REAL int8 x int8 -> int32
-matmul — the MXU executes int8 at double bf16 throughput, so converted
-inference is a genuine TPU speed path, not just a simulation (the
-reference's converted program targets cuDNN int8 the same way).
+- :mod:`~paddle_tpu.quantization.kv_cache` — **Plane 1**: per-page-
+  scaled int8/fp8 paged KV pools behind
+  ``serving.EngineConfig(kv_cache_dtype=)`` — 2-4x concurrent
+  sequences per chip at a documented decode-divergence tolerance.
+- :mod:`~paddle_tpu.quantization.collectives` — **Plane 2**: the
+  EQuARX-style quantized AllReduce (arXiv:2506.17615, PAPERS.md) —
+  block-scaled int8 payloads through all_to_all/all_gather for dp
+  gradient sync and tp decode all-reduce, selectable per trace via the
+  :mod:`~paddle_tpu.quantization.policy` context (the ``amp/policy.py``
+  trace-scoped shape), with a plain-XLA fallback off-mesh.
+- the original PTQ observers + imperative PTQ/QAT below (reference
+  parity: python/paddle/quantization — observers are tiny jnp
+  reductions; converted linears run REAL int8 x int8 -> int32 on the
+  MXU at double bf16 throughput).
+
+Both planes are accounted and gated: cost_audit/SL301 and perfgate's
+``kv_bytes_per_token`` / ``allreduce_bytes`` budgets see the narrow
+storage, and numlint's NL301/NL302 run over every quantized serving
+program (tools/numlint.py `serving_quant` target).
 """
 from __future__ import annotations
 
@@ -21,11 +32,29 @@ import jax.numpy as jnp
 from paddle_tpu.core.dispatch import apply
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.nn.layer.layers import Layer
+from paddle_tpu.quantization.collectives import (  # noqa: F401
+    collective_wire_bytes, quantized_all_reduce,
+    quantized_all_reduce_wire_bytes)
+from paddle_tpu.quantization.kv_cache import (  # noqa: F401
+    KV_CACHE_DTYPES, KVQuantSpec, dequantize_codes, kv_bytes_per_token,
+    quantize_block, quantized_attend, quantized_decode_step,
+    quantized_prefill_append, resolve_kv_cache_dtype)
+from paddle_tpu.quantization.policy import (  # noqa: F401
+    CollectivePolicy, current_collective_policy, quantized_collectives)
 
 __all__ = ["PTQConfig", "default_ptq_config", "BaseQuantizer",
            "AbsmaxQuantizer", "PerChannelAbsmaxQuantizer", "HistQuantizer",
            "KLQuantizer", "ImperativePTQ", "ImperativeQuantAware",
-           "fake_quant", "QuantizedLinear"]
+           "fake_quant", "QuantizedLinear",
+           # plane 1: quantized KV pages
+           "KVQuantSpec", "KV_CACHE_DTYPES", "resolve_kv_cache_dtype",
+           "quantize_block", "dequantize_codes", "kv_bytes_per_token",
+           "quantized_attend", "quantized_decode_step",
+           "quantized_prefill_append",
+           # plane 2: quantized collectives + policy
+           "quantized_all_reduce", "quantized_all_reduce_wire_bytes",
+           "collective_wire_bytes", "CollectivePolicy",
+           "quantized_collectives", "current_collective_policy"]
 
 
 # ------------------------------------------------------------- quantizers

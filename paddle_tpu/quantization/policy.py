@@ -1,0 +1,90 @@
+"""Trace-scoped quantized-collective policy (the amp/policy.py shape).
+
+The EQuARX quantized AllReduce (:mod:`quantization.collectives`) is an
+accuracy/bandwidth trade, so it must be SELECTED, never ambient: a
+:class:`CollectivePolicy` pushed with :func:`quantized_collectives`
+covers exactly the dynamic extent it wraps — one ``to_static`` trace,
+one eager gradient sync, one shard_map body — and
+``distributed.collective.all_reduce`` (mesh-axis SUM/AVG on floats) and
+``DataParallel.apply_collective_grads`` consult it at their choke
+points.  Everything else — integer payloads, MAX/MIN/PROD reductions,
+tensors below ``min_elems``, and every collective OFF a mesh axis —
+keeps the plain-XLA path, so correctness never depends on the policy
+being installed (the "plain-XLA fallback off-mesh" contract).
+
+Like the amp residency policy, the TLS is thread-local and re-entrant:
+traces started inside the context (including re-traces of a
+StaticFunction that entered it) see the policy; other threads and
+outer code never do.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+__all__ = ["CollectivePolicy", "current_collective_policy",
+           "quantized_collectives"]
+
+_tls = threading.local()
+
+
+class CollectivePolicy:
+    """One trace's quantized-collective configuration.
+
+    - ``bits``: code width (<= 8; codes travel as int8 either way).
+    - ``block``: elements per scale block — smaller blocks track local
+      magnitude tighter at 4/block bytes of scale overhead per element.
+    - ``key``: optional PRNG key enabling stochastic rounding of the
+      stage-1 payload (pass a STEP-VARYING key; see
+      collectives.quantized_all_reduce).
+    - ``min_elems``: tensors smaller than this keep the plain psum —
+      tiny payloads are latency-bound, not bandwidth-bound, and padding
+      to a block grid would only add error.
+    """
+
+    __slots__ = ("bits", "block", "key", "min_elems")
+
+    def __init__(self, bits=8, block=256, key=None, min_elems=1024):
+        bits = int(bits)
+        if not 2 <= bits <= 8:
+            raise ValueError(
+                f"CollectivePolicy bits must be in [2, 8] (codes travel "
+                f"as int8), got {bits}")
+        block = int(block)
+        if block < 8:
+            raise ValueError(
+                f"CollectivePolicy block must be >= 8, got {block}")
+        self.bits = bits
+        self.block = block
+        self.key = key
+        self.min_elems = int(min_elems)
+
+    def __repr__(self):
+        return (f"CollectivePolicy(bits={self.bits}, block={self.block}, "
+                f"min_elems={self.min_elems}, "
+                f"stochastic={self.key is not None})")
+
+
+def current_collective_policy():
+    """The CollectivePolicy active on this thread, or None."""
+    return getattr(_tls, "policy", None)
+
+
+@contextlib.contextmanager
+def quantized_collectives(bits=8, block=256, key=None, min_elems=1024):
+    """Push a :class:`CollectivePolicy` for the dynamic extent.
+
+    ``with quantized_collectives(): train_step(...)`` quantizes the dp
+    gradient all-reduce (and any tp decode all-reduce routed through
+    ``distributed.collective.all_reduce``) inside the wrapped trace;
+    an existing :class:`CollectivePolicy` instance may be passed as
+    ``bits``.
+    """
+    pol = bits if isinstance(bits, CollectivePolicy) else \
+        CollectivePolicy(bits, block=block, key=key, min_elems=min_elems)
+    prev = getattr(_tls, "policy", None)
+    _tls.policy = pol
+    try:
+        yield pol
+    finally:
+        _tls.policy = prev

@@ -79,7 +79,10 @@ def engine_fingerprint(model_config, engine_config, params, mesh=None):
         "engine": (ec.max_num_seqs, ec.page_size, ec.max_model_len,
                    ec.num_pages, tuple(ec.prefill_buckets),
                    str(ec.dtype.__name__ if hasattr(ec.dtype, "__name__")
-                       else ec.dtype)),
+                       else ec.dtype),
+                   # an int8-pool program must never load for an f32
+                   # engine (or vice versa) — the pool pytree differs
+                   getattr(ec, "kv_cache_dtype", None)),
         "mesh": _mesh_desc(mesh),
         "jax": jax.__version__,
         "jaxlib": getattr(jaxlib, "__version__", "?"),

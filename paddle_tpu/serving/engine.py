@@ -50,6 +50,9 @@ from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.incubate.nn.paged_attention import (PageAllocator,
                                                     paged_decode_step,
                                                     paged_prefill_append)
+from paddle_tpu.quantization.kv_cache import (quantized_decode_step,
+                                              quantized_prefill_append,
+                                              resolve_kv_cache_dtype)
 from paddle_tpu.resilience.faultinject import fire as _fire
 from paddle_tpu.resilience.faultinject import note_recovery
 from paddle_tpu.resilience.health import HealthMonitor
@@ -89,6 +92,13 @@ class EngineConfig:
       axis and the weights along their trailing hidden-multiple axis;
       every program lowers as one SPMD computation over the mesh.
       `num_heads` must divide by the tp extent.
+    - `kv_cache_dtype`: None (pools stored at `dtype`) or a
+      quantization code dtype ("int8", and "fp8_e4m3"/"fp8_e5m2" where
+      this jax has the dtype) — the per-layer pools become
+      per-page-scaled ``(codes, scales)`` pairs
+      (paddle_tpu/quantization/kv_cache.py; docs/quantization.md has
+      the storage format and the tolerance contract).  Activations and
+      logits stay at `dtype`; only KV storage narrows.
     """
 
     def __init__(self, max_num_seqs=8, page_size=16, max_model_len=256,
@@ -97,7 +107,7 @@ class EngineConfig:
                  dtype=jnp.float32, finished_retention=1024,
                  max_queue_depth=None, crash_safe_decode=True,
                  health_degraded_at=0.85, health_drain_at=0.97,
-                 health_recover_at=0.70, mesh=None):
+                 health_recover_at=0.70, mesh=None, kv_cache_dtype=None):
         if max_num_seqs < 1:
             raise ValueError("max_num_seqs must be >= 1")
         self.max_num_seqs = int(max_num_seqs)
@@ -129,6 +139,11 @@ class EngineConfig:
         self.health_drain_at = float(health_drain_at)
         self.health_recover_at = float(health_recover_at)
         self.mesh = mesh                 # Mesh | {"tp": n} | None
+        # resolve eagerly so a typo'd dtype fails at config build, not
+        # first step; the spec itself is re-derived by the engine
+        self.kv_cache_dtype = (None if kv_cache_dtype is None
+                               else resolve_kv_cache_dtype(
+                                   kv_cache_dtype).name)
 
     @property
     def compile_bound(self):
@@ -149,15 +164,23 @@ class PagedKVContext:
       batched scatter of the real tokens' K/V into the pages.
     - mode "decode": one-token append + attention over the row's pages
       at its own length (ragged).
+
+    `quant` (a :class:`~paddle_tpu.quantization.kv_cache.KVQuantSpec`,
+    None for plain pools) switches the pool entries to per-page-scaled
+    ``(codes, scales)`` pairs and routes writes/reads through the
+    quantized step functions — decode dequantizes in-trace with f32
+    score/value accumulation.
     """
 
-    def __init__(self, k_pools, v_pools, tables, lens, page_size, mode):
+    def __init__(self, k_pools, v_pools, tables, lens, page_size, mode,
+                 quant=None):
         self.k_pools = list(k_pools)
         self.v_pools = list(v_pools)
         self.tables = tables
         self.lens = lens
         self.page_size = page_size
         self.mode = mode
+        self.quant = quant
         self._layer = 0
 
     def attend(self, q, k, v):
@@ -176,9 +199,19 @@ class PagedKVContext:
             vT = jnp.swapaxes(vv, 1, 2)
             if self.mode == "prefill":
                 out = _dense_causal_attention(qT, kT, vT)
-                kp, vp = paged_prefill_append(
-                    kT, vT, self.k_pools[li], self.v_pools[li],
-                    self.tables, self.lens, self.page_size)
+                if self.quant is not None:
+                    kp, vp = quantized_prefill_append(
+                        kT, vT, self.k_pools[li], self.v_pools[li],
+                        self.tables, self.lens, self.page_size,
+                        self.quant)
+                else:
+                    kp, vp = paged_prefill_append(
+                        kT, vT, self.k_pools[li], self.v_pools[li],
+                        self.tables, self.lens, self.page_size)
+            elif self.quant is not None:
+                out, kp, vp = quantized_decode_step(
+                    qT, kT, vT, self.k_pools[li], self.v_pools[li],
+                    self.tables, self.lens, self.page_size, self.quant)
             else:
                 out, kp, vp = paged_decode_step(
                     qT, kT, vT, self.k_pools[li], self.v_pools[li],
@@ -251,12 +284,22 @@ class LLMEngine:
         B, P = cfg.max_num_seqs, cfg.max_pages_per_seq
         pool_shape = (cfg.num_pages, self._num_heads, cfg.page_size,
                       self._head_dim)
-        self._k_pools = [self._place(jnp.zeros(pool_shape, cfg.dtype),
-                                     self._pool_sharding)
-                         for _ in range(self._num_layers)]
-        self._v_pools = [self._place(jnp.zeros(pool_shape, cfg.dtype),
-                                     self._pool_sharding)
-                         for _ in range(self._num_layers)]
+        # kv_cache_dtype narrows the pool STORAGE only: quantized pools
+        # are (codes, scales) pairs with one f32 scale per (page, head)
+        self._kv_quant = resolve_kv_cache_dtype(cfg.kv_cache_dtype)
+
+        def _pool():
+            if self._kv_quant is None:
+                return self._place(jnp.zeros(pool_shape, cfg.dtype),
+                                   self._pool_sharding)
+            return (self._place(jnp.zeros(pool_shape,
+                                          self._kv_quant.code_dtype),
+                                self._pool_sharding),
+                    self._place(jnp.zeros(pool_shape[:2], jnp.float32),
+                                self._pool_sharding))
+
+        self._k_pools = [_pool() for _ in range(self._num_layers)]
+        self._v_pools = [_pool() for _ in range(self._num_layers)]
         self._tables = np.zeros((B, P), np.int32)      # host-canonical
         self._lens = np.zeros((B,), np.int32)          # host-canonical
         self._alloc = PageAllocator(cfg.num_pages, B, P)
@@ -952,9 +995,15 @@ class LLMEngine:
         without a resharding copy (or a surprise cache miss)."""
         if self._mesh is None:
             return None
+        # quantized pool entries are (codes, scales) pairs; the same
+        # P(None, "tp") spec shards codes on the head axis (axis 1 of
+        # [pages, heads, page, dim]) and scales on theirs (axis 1 of
+        # [pages, heads])
+        pool_sh = (self._pool_sharding if self._kv_quant is None
+                   else (self._pool_sharding, self._pool_sharding))
         return (self._repl_sharding,
-                [self._pool_sharding] * self._num_layers,
-                [self._pool_sharding] * self._num_layers)
+                [pool_sh] * self._num_layers,
+                [pool_sh] * self._num_layers)
 
     def _prefill_program(self, bucket):
         """(fn, example_args, donate, out_shardings) for one prefill
@@ -965,7 +1014,8 @@ class LLMEngine:
         def prefill(params, k_pools, v_pools, row_table, ids, pos_ids,
                     length):
             ctx = PagedKVContext(k_pools, v_pools, row_table, length,
-                                 cfg.page_size, "prefill")
+                                 cfg.page_size, "prefill",
+                                 quant=self._kv_quant)
             logits = self._run_model(params, ids, pos_ids, ctx)
             # logits [1, bucket, V] -> the last REAL token's row
             last = jnp.take_along_axis(
@@ -986,7 +1036,8 @@ class LLMEngine:
 
         def decode(params, k_pools, v_pools, tables, lens, tokens):
             ctx = PagedKVContext(k_pools, v_pools, tables, lens,
-                                 cfg.page_size, "decode")
+                                 cfg.page_size, "decode",
+                                 quant=self._kv_quant)
             logits = self._run_model(params, tokens, lens[:, None], ctx)
             return (logits[:, 0].astype(jnp.float32),
                     ctx.k_pools, ctx.v_pools)
@@ -1061,9 +1112,23 @@ class LLMEngine:
     @property
     def kv_pool_bytes(self):
         """Total bytes of the paged K+V pools across all layers (the
-        page budget, in bytes)."""
-        return sum(int(p.nbytes) for p in self._k_pools) + \
-            sum(int(p.nbytes) for p in self._v_pools)
+        page budget, in bytes).  Quantized pools count codes AND their
+        per-page scales — the honest narrow-storage number the
+        hbm_budget/perfgate gates see."""
+        return sum(int(leaf.nbytes) for leaf in
+                   jax.tree_util.tree_leaves(self._k_pools)) + \
+            sum(int(leaf.nbytes) for leaf in
+                jax.tree_util.tree_leaves(self._v_pools))
+
+    @property
+    def kv_bytes_per_token(self):
+        """Pool storage bytes per token of KV capacity across all
+        layers — the serving-density metric the perfgate `quantization`
+        target and the bench `--worker-quant` lane budget.  (Page 0 is
+        reserved, but its bytes and its capacity cancel exactly, so
+        this is total pool bytes over total page slots.)"""
+        return self.kv_pool_bytes / (self.config.num_pages
+                                     * self.config.page_size)
 
     @property
     def hbm_budget_bytes(self):
@@ -1103,6 +1168,8 @@ class LLMEngine:
             "pages_total": self.config.num_pages - 1,
             "params_mb": round(self.params_bytes / (1 << 20), 3),
             "kv_pool_mb": round(self.kv_pool_bytes / (1 << 20), 3),
+            "kv_cache_dtype": self.config.kv_cache_dtype,
+            "kv_bytes_per_token": round(self.kv_bytes_per_token, 3),
             "hbm_budget_mb": round(self.hbm_budget_bytes / (1 << 20), 3),
             "programs": {},
         }

@@ -240,6 +240,29 @@ def test_nl301_scale_free_quant_flagged_descaled_clean():
     assert "NL301" not in codes_of(clean)
 
 
+@pytest.mark.smoke
+def test_nl301_broadcast_page_scale_clean_full_size_mul_flagged():
+    """A per-page/per-block scale VAR (not a literal) broadcast to the
+    code shape right before the mul still counts as a scale — the shape
+    the real quantized KV pools dequantize in (quantization/kv_cache) —
+    while a full-size elementwise multiplier does NOT descale."""
+    codes = jnp.ones((16, 4, 8, 32), jnp.int8)    # [pages, h, p, d]
+    scales = jnp.ones((16, 4), jnp.float32)       # [pages, h]
+    x = jnp.ones((16, 4, 8, 32), jnp.float32)
+
+    def descaled(c, s, b):
+        return (c.astype(jnp.float32) * s[:, :, None, None]) + b
+    clean = jax.make_jaxpr(descaled)(codes, scales, x)
+    assert "NL301" not in codes_of(clean)
+
+    def full_mul(c, m, b):
+        # a same-size multiplier is data, not a scale: consumption of
+        # the product is still un-descaled
+        return (c.astype(jnp.float32) * m) + b
+    flagged = jax.make_jaxpr(full_mul)(codes, x, x)
+    assert "NL301" in codes_of(flagged)
+
+
 def test_nl301_int8_index_use_clean():
     idx = jnp.zeros((4,), jnp.int8)
     table = jnp.ones((8, 16), jnp.float32)

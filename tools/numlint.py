@@ -20,9 +20,10 @@ by the NL rule catalog (analysis/num_rules.py) —
 Audit targets: the optimized gpt_hybrid_train step (perfgate's shared
 builder — bf16 activation residency, fused AdamW, Pallas fused LN: the
 program that ships), every serving-engine program via
-`LLMEngine.audit_programs()`, and the same serving set at
-bf16-residency pool dtype (`serving_bf16` — the config the
-KV-quantization roadmap item starts from).
+`LLMEngine.audit_programs()`, the same serving set at bf16-residency
+pool dtype (`serving_bf16`), and the set over per-page-scaled int8 KV
+pools (`serving_quant` — EngineConfig(kv_cache_dtype="int8"), the
+quantized plane ROADMAP item 2 shipped; docs/quantization.md).
 
 Usage:
   python tools/numlint.py                     # report everything
@@ -90,7 +91,7 @@ def target_gpt_hybrid_train():
     return [("gpt_hybrid_train", findings)]
 
 
-def _serving_targets(dtype_name, label):
+def _serving_targets(dtype_name, label, kv_cache_dtype=None):
     import jax.numpy as jnp
 
     import paddle_tpu as P
@@ -105,7 +106,8 @@ def _serving_targets(dtype_name, label):
         GPTForCausalLM(mcfg),
         serving.EngineConfig(max_num_seqs=4, page_size=8,
                              max_model_len=64, prefill_buckets=(16, 32),
-                             dtype=getattr(jnp, dtype_name)))
+                             dtype=getattr(jnp, dtype_name),
+                             kv_cache_dtype=kv_cache_dtype))
     cfg = _audit_config(analysis)
     out = []
     try:
@@ -131,10 +133,24 @@ def target_serving_bf16():
     return _serving_targets("bfloat16", "serving_bf16")
 
 
+def target_serving_quant():
+    """The serving program set over per-page-scaled int8 KV pools
+    (EngineConfig(kv_cache_dtype="int8") — the quantized plane ROADMAP
+    item 2 shipped).  The NL3xx rules were written against hypothetical
+    quantized pools BEFORE this plane landed; here they audit the real
+    thing: every dequant must ride adjacent to its per-page scale
+    (NL301) and the only dequant->requant chain is the documented
+    page-rescale-on-append (NL302-silent by construction, see
+    docs/quantization.md).  Zero findings, zero baseline growth."""
+    return _serving_targets("float32", "serving_quant",
+                            kv_cache_dtype="int8")
+
+
 TARGETS = {
     "gpt_hybrid_train": target_gpt_hybrid_train,
     "serving": target_serving,
     "serving_bf16": target_serving_bf16,
+    "serving_quant": target_serving_quant,
 }
 
 

@@ -185,9 +185,82 @@ def target_serving():
         engine.shutdown()
 
 
+def _quant_engines():
+    """(engine factory, shared model) for the quantization target and
+    the bench --worker-quant lane — the SAME tiny geometry as
+    target_serving, so the kv numbers compare apples to apples."""
+    import paddle_tpu as P
+    from paddle_tpu import serving
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    P.seed(0)
+    mcfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                     num_heads=4, max_seq_len=128, dropout=0.0,
+                     attention_dropout=0.0)
+    model = GPTForCausalLM(mcfg)
+
+    def build(**kw):
+        return serving.LLMEngine(
+            model, serving.EngineConfig(
+                max_num_seqs=4, page_size=8, max_model_len=64,
+                prefill_buckets=(16, 32), **kw))
+
+    return build
+
+
+def target_quantization():
+    """Both quantized memory planes, deterministically accounted.
+
+    Plane 1 — int8 KV pages: pool-storage bytes per token of capacity
+    and the ratios vs the bf16/f32 pools at identical geometry (the
+    acceptance bar is <= 0.55x vs bf16), plus the cost-model peak HBM
+    of the int8 decode program — proof the narrow storage reaches the
+    SL301 liveness estimate, not just the allocator.  Plane 2 — the
+    EQuARX all-reduce wire model for a reference 1M-element gradient at
+    axis size 8 (analytic, device-count-independent; the traced
+    cross-check lives in tests/test_quantized_kv.py).  Every metric is
+    lower-is-better."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.analysis.cost_audit import audit_memory
+    from paddle_tpu.quantization.collectives import \
+        quantized_all_reduce_wire_bytes
+
+    build = _quant_engines()
+    out = {}
+    engines = {}
+    try:
+        engines["f32"] = build()
+        engines["bf16"] = build(dtype=jnp.bfloat16)
+        engines["int8"] = build(kv_cache_dtype="int8")
+        bpt = {k: e.kv_bytes_per_token for k, e in engines.items()}
+        out["kv_bytes_per_token"] = round(bpt["int8"], 3)
+        out["kv_quant_vs_bf16_ratio"] = round(bpt["int8"] / bpt["bf16"], 4)
+        out["kv_quant_vs_f32_ratio"] = round(bpt["int8"] / bpt["f32"], 4)
+        progs = engines["int8"].audit_programs()
+        _f, cost = audit_memory(progs["decode"],
+                                where="<quant decode>")
+        out["quant_decode_peak_hbm_mb"] = round(
+            cost.peak_hbm_bytes / (1 << 20), 3)
+        _f, cost_f32 = audit_memory(
+            engines["f32"].audit_programs()["decode"],
+            where="<f32 decode>")
+        out["quant_vs_f32_decode_peak_ratio"] = round(
+            cost.peak_hbm_bytes / max(1, cost_f32.peak_hbm_bytes), 4)
+    finally:
+        for e in engines.values():
+            e.shutdown()
+    wire = quantized_all_reduce_wire_bytes(1 << 20, axis_size=8)
+    out["allreduce_bytes"] = wire["allreduce_bytes"]
+    out["allreduce_quant_vs_wide_ratio"] = \
+        wire["allreduce_quant_vs_wide_ratio"]
+    return out
+
+
 TARGETS = {
     "gpt_hybrid_train": target_gpt_hybrid_train,
     "serving": target_serving,
+    "quantization": target_quantization,
 }
 
 
