@@ -52,6 +52,7 @@ RACELINT_S = 90
 NUMLINT_S = 150
 OBS_S = 150
 RESIL_S = 150
+FLEET_S = 150
 PROFILE_S = 150
 REMAT_S = 150
 QUANT_S = 150
@@ -533,6 +534,148 @@ def worker_resilience():
         "resilience_ckpt_restore_ms": round(clean_ms, 2),
         "resilience_recovery_overhead_ms": round(
             max(0.0, recovery_ms - clean_ms), 2),
+    }), flush=True)
+    return 0
+
+
+def worker_fleet():
+    """Fleet fault-tolerance lane: the rank-kill → detect →
+    reconfigure → resume ladder as a rank-per-thread world over
+    ``fleet.LocalKVClient`` (same blocking semantics as the
+    coordination-service client, zero gRPC).  Pure CPU and
+    deterministic in structure; the wall numbers are the real cost of
+    the fleet machinery (watchdog classification latency, join-barrier
+    rendezvous, quorum manifest commit).  The multi-PROCESS version of
+    this ladder — real SIGKILL through a real coordinator — is the
+    chaos gate's job; this lane keeps its cost trended on every BENCH
+    report.
+
+    Reports (merged into every BENCH line):
+      fleet_detection_ms       — publisher death → watchdog DEAD verdict
+      fleet_reconfigure_ms     — slowest survivor's join-barrier
+                                 reconfigure to world size 2
+      fleet_ckpt_commit_ms     — rank 0 wall for a 3-shard quorum
+                                 checkpoint save (digest gather +
+                                 manifest commit)
+      fleet_resume_identical   — 1.0 iff both survivors restored the
+                                 identical replicated state and exact
+                                 resharded dp rows (asserted before
+                                 printing)
+      fleet_world_size_after   — post-reconfigure world size (2)
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    _init_backend()   # honors PTPU_FORCE_CPU (always set for this lane)
+
+    from paddle_tpu.resilience import fleet
+
+    kv = fleet.LocalKVClient()
+    cfg = fleet.FleetConfig(
+        collective_timeout_s=10.0, kv_slice_s=0.05,
+        heartbeat_interval_s=0.05, suspect_after_s=0.2,
+        dead_after_s=0.4, rendezvous_timeout_s=10.0)
+    worlds = {r: fleet.WorldView([0, 1, 2], r) for r in range(3)}
+    pubs = {r: fleet.HeartbeatPublisher(
+        client=kv, rank=r, interval_s=cfg.heartbeat_interval_s).start()
+        for r in range(3)}
+    mon = fleet.FleetMonitor(client=kv, config=cfg,
+                             world_fn=lambda: worlds[0])
+    tdir = None
+    try:
+        # warm up: every publisher has actually beaten at least twice
+        # (a first-poll HEALTHY is grace, not evidence) and the
+        # watchdog has observed the fleet healthy
+        deadline = time.monotonic() + 10.0
+        while any(p.seq < 2 for p in pubs.values()) or \
+                any(s is not fleet.RankState.HEALTHY
+                    for s in mon.poll().values()):
+            assert time.monotonic() < deadline, "fleet never healthy"
+            time.sleep(0.02)
+
+        # ---- quorum checkpoint at world size 3 ----
+        tdir = tempfile.mkdtemp(prefix="ptpu_fleet_bench_")
+        rng = np.random.default_rng(0)
+        wref = rng.standard_normal((256, 256)).astype(np.float32)
+        cks, commit_ms = {}, {}
+
+        def save(r):
+            ck = fleet.DistributedCheckpointer(
+                tdir, client=kv, world=worlds[r], timeout_s=10.0)
+            cks[r] = ck
+            t0 = time.perf_counter()
+            ck.save(1, sharded={"rows": np.full((4,), r, np.int64)},
+                    replicated={"w": wref} if r == 0 else None)
+            commit_ms[r] = (time.perf_counter() - t0) * 1e3
+
+        ts = [threading.Thread(target=save, args=(r,))
+              for r in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert len(commit_ms) == 3, "quorum save did not complete"
+
+        # ---- kill rank 2, time the DEAD verdict ----
+        t_kill = time.perf_counter()
+        pubs[2].stop()
+        deadline = time.monotonic() + 15.0
+        while 2 not in mon.dead_ranks():
+            assert time.monotonic() < deadline, "no DEAD verdict"
+            mon.poll()
+            time.sleep(0.01)
+        detection_ms = (time.perf_counter() - t_kill) * 1e3
+        # the verdict must land within the configured window (+ slack)
+        assert detection_ms / 1e3 <= cfg.dead_after_s + 5.0
+
+        # ---- survivors reconfigure + reload resharded ----
+        recfg_ms, states = {}, {}
+
+        def recover(r):
+            t0 = time.perf_counter()
+            nw = fleet.reconfigure([2], client=kv, config=cfg,
+                                   world_view=worlds[r],
+                                   install=False)
+            recfg_ms[r] = (time.perf_counter() - t0) * 1e3
+            _, st = cks[r].load(world_size=nw.size, rank=nw.rank)
+            states[r] = (nw, st)
+
+        ts = [threading.Thread(target=recover, args=(r,))
+              for r in (0, 1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert len(states) == 2, "a survivor failed to recover"
+
+        identical = True
+        for r, (nw, st) in states.items():
+            identical &= nw.size == 2
+            identical &= bool(np.array_equal(st["replicated"]["w"],
+                                             wref))
+            want = ([0, 0, 0, 0, 1, 1] if nw.rank == 0
+                    else [1, 1, 2, 2, 2, 2])
+            identical &= bool(np.array_equal(st["sharded"]["rows"],
+                                             want))
+        # identity is a correctness gate, not a metric: fail the lane
+        # loudly rather than print a lying number
+        assert identical, "resumed state diverged from the checkpoint"
+    finally:
+        for p in pubs.values():
+            p.stop()
+        mon.stop()
+        if tdir is not None:
+            shutil.rmtree(tdir, ignore_errors=True)
+
+    print(json.dumps({
+        "fleet_detection_ms": round(detection_ms, 2),
+        "fleet_reconfigure_ms": round(max(recfg_ms.values()), 2),
+        "fleet_ckpt_commit_ms": round(commit_ms[0], 2),
+        "fleet_resume_identical": 1.0,
+        "fleet_world_size_after": 2,
     }), flush=True)
     return 0
 
@@ -1132,6 +1275,8 @@ def main():
         return worker_remat()
     if "--worker-resilience" in sys.argv:
         return worker_resilience()
+    if "--worker-fleet" in sys.argv:
+        return worker_fleet()
     if "--probe" in sys.argv:
         return probe()
 
@@ -1146,6 +1291,7 @@ def main():
     nl_proc = _spawn("--worker-numlint", force_cpu=True)
     obs_proc = _spawn("--worker-obs", force_cpu=True)
     resil_proc = _spawn("--worker-resilience", force_cpu=True)
+    fleet_proc = _spawn("--worker-fleet", force_cpu=True)
     prof_proc = _spawn("--worker-profile", force_cpu=True)
     remat_proc = _spawn("--worker-remat", force_cpu=True)
     router_proc = _spawn("--worker-router", force_cpu=True)
@@ -1201,6 +1347,14 @@ def main():
         # same rationale again: checkpoint-cost telemetry failing must
         # not mark a live measurement run as degraded
         merged["resilience_error"] = str(resil_err)
+
+    fleet_res, fleet_err, _ = _await_json(fleet_proc, FLEET_S)
+    if fleet_res is not None:
+        merged.update(fleet_res)
+    else:
+        # same rationale: the fleet fault-tolerance lane failing
+        # degrades only its own keys
+        merged["fleet_error"] = str(fleet_err)
 
     prof_res, prof_err, _ = _await_json(prof_proc, PROFILE_S)
     if prof_res is not None:
@@ -1262,6 +1416,7 @@ def main():
         _adopt_lane("obs_", "obs_span_overhead_pct", obs_err)
         _adopt_lane("resilience_", "resilience_ckpt_write_ms",
                     resil_err)
+        _adopt_lane("fleet_", "fleet_detection_ms", fleet_err)
         _adopt_lane("profile_", "profile_bytes_per_step", prof_err)
         _adopt_lane("remat_", "remat_bytes_saved_pct", remat_err)
         _adopt_lane("router_", "router_tokens_per_s", router_err)

@@ -37,7 +37,7 @@ class Group:
     def nranks(self):
         if self.axis is not None:
             return dmesh.axis_size(self.axis)
-        return jax.process_count()
+        return get_world_size()
 
     @property
     def world_size(self):
@@ -75,20 +75,68 @@ def get_rank(group=None):
         # it as-is so rank-dependent code computes with the true rank on each
         # shard (an int() here would silently collapse every shard to rank 0).
         return jax.lax.axis_index(axis)
-    return jax.process_index()
+    # eager path: the FLEET rank — contiguous within the survivor set
+    # after an elastic reconfigure (== jax.process_index() at launch)
+    from paddle_tpu.resilience import fleet
+    return fleet.world().rank
 
 
 def get_world_size(group=None):
     axis = _axis_of(group)
     if axis is not None:
         return dmesh.axis_size(axis)
-    return jax.process_count()
+    from paddle_tpu.resilience import fleet
+    return fleet.world().size
 
 
 # monotone per-process round counter for coordination-service
 # collectives; SPMD call order is identical on every process, so the
-# same round id names the same collective fleet-wide
+# same round id names the same collective fleet-wide.  Keys are
+# namespaced by the fleet launch id + generation (fleet.coord_namespace)
+# so an aborted run's debris can't collide with the next, and a clean
+# exit / reconfigure reaps the whole namespace in one delete.
+# _COORD_REAPED tracks the newest round PROVEN globally complete and
+# already swept (see _coord_reap for the proof obligation).
 _COORD_ROUND = [0]
+_COORD_REAPED = [0]
+_REAP_BATCH = 64     # max rounds swept per allgather (no delete storms)
+
+
+def reset_coord_rounds():
+    """Fresh round counters for a fresh key namespace — called by
+    ``resilience.fleet.reconfigure`` after the generation bump (every
+    survivor resets identically; the new namespace guarantees no
+    collision with in-flight old-generation keys)."""
+    _COORD_ROUND[0] = 0
+    _COORD_REAPED[0] = 0
+
+
+def _coord_client():
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    if client is None:
+        raise RuntimeError(
+            "jax.distributed is not initialized — multi-process "
+            "collectives need distributed.launch / "
+            "jax.distributed.initialize first")
+    return client
+
+
+def _coord_get(client, key, missing_rank, rnd):
+    """One peer contribution, timeout-bounded (fleet.kv_get_bytes):
+    sliced blocking gets under the configured deadline, aborting early
+    the moment the fleet watchdog holds a DEAD verdict for the awaited
+    rank — raises ``CollectiveTimeout`` naming it, never hangs."""
+    from paddle_tpu.resilience import fleet
+
+    mon = fleet.get_monitor()
+    abort_if = (None if mon is None
+                else (lambda: mon.is_dead(missing_rank)))
+    return fleet.kv_get_bytes(
+        client, key, fleet.get_config().collective_timeout_s,
+        site="fleet.kv_get", missing_rank=missing_rank,
+        abort_if=abort_if, seed=rnd)
 
 
 def _coord_allgather(value):
@@ -100,73 +148,92 @@ def _coord_allgather(value):
     ``multihost_utils`` path is TPU/GPU-only; this DCN fallback keeps
     the eager collective API working in multi-process CPU worlds
     (tests/test_distributed_multiprocess.py proves it end to end).
-    Stacks every process's array along a new leading axis."""
+    Stacks every member's array along a new leading axis, in fleet
+    member order — after an elastic reconfigure the world is the
+    survivor set, not ``jax.process_count()``."""
     import pickle
 
     import numpy as np
-    from jax._src import distributed
 
-    client = distributed.global_state.client
-    if client is None:
-        raise RuntimeError(
-            "jax.distributed is not initialized — multi-process "
-            "collectives need distributed.launch / "
-            "jax.distributed.initialize first")
-    rank, n = jax.process_index(), jax.process_count()
+    from paddle_tpu.resilience import fleet
+
+    client = _coord_client()
+    wv = fleet.world()
     _COORD_ROUND[0] += 1
     rnd = _COORD_ROUND[0]
-    prefix = f"ptpu/allgather/{rnd}"
+    prefix = f"{fleet.coord_namespace()}/allgather/{rnd}"
     arr = np.asarray(value)
-    client.key_value_set_bytes(f"{prefix}/{rank}", pickle.dumps(arr))
+    fleet.kv_set_bytes(client, f"{prefix}/{wv.global_rank}",
+                       pickle.dumps(arr))
     parts = []
-    for r in range(n):
-        raw = client.blocking_key_value_get_bytes(
-            f"{prefix}/{r}", 120_000)
+    for r in wv.members:
+        raw = _coord_get(client, f"{prefix}/{r}", r, rnd)
         parts.append(pickle.loads(raw))
-    _coord_reap(client, rank, rnd)
+    _coord_reap(client, wv.rank, rnd)
     return np.stack(parts)
 
 
 def _coord_reap(client, rank, rnd):
-    """Reap coordination-service keys TWO rounds behind, never the
-    current one: a peer entering round `rnd` has by construction
-    finished consuming round `rnd - 2`, while round `rnd - 1` (or
-    `rnd`) may still have a straggler mid-read — deleting those would
-    strand it on a key that will never reappear.  Both collective
-    prefixes share the round counter, so both are swept."""
-    if rank != 0 or rnd <= 2:
+    """Reap all rounds strictly BEFORE `rnd`, from inside an allgather
+    whose every member key has just been received.  That receipt is
+    the proof that makes the sweep safe: each member publishes its
+    round-`rnd` key on ENTERING round `rnd`, so possession of all of
+    them means every member has COMPLETED every earlier round —
+    including broadcast rounds, whose non-src readers nothing else
+    synchronizes (a calendar-style "two rounds behind" sweep could
+    delete a bcast key a descheduled reader had not consumed, stranding
+    it into a spurious CollectiveTimeout on a healthy fleet).  Round
+    `rnd` itself is never touched: peers may still be mid-read on it.
+    Both collective prefixes share the round counter, so both are
+    swept, at most _REAP_BATCH rounds per call (a long broadcast-only
+    streak must not turn the next allgather into a delete storm; the
+    backlog amortizes over subsequent allgathers).  Known limitation:
+    a workload that ONLY broadcasts accrues keys until its next
+    allgather/barrier or the namespace reap at finalize/reconfigure —
+    keys stay bounded by the namespace lifetime either way.  (Keys a
+    mid-round abort leaves behind stay namespaced to this launch id +
+    generation, and the whole namespace is reaped on clean exit and on
+    reconfigure — this sweep only bounds STEADY-STATE growth.)"""
+    if rank != 0:
         return
-    for prefix in ("ptpu/allgather", "ptpu/bcast"):
-        try:
-            client.key_value_delete(f"{prefix}/{rnd - 2}")
-        except Exception:
-            pass
+    from paddle_tpu.resilience import fleet
+    ns = fleet.coord_namespace()
+    sweep = range(_COORD_REAPED[0] + 1,
+                  min(rnd, _COORD_REAPED[0] + 1 + _REAP_BATCH))
+    for old in sweep:
+        for prefix in (f"{ns}/allgather", f"{ns}/bcast"):
+            try:
+                client.key_value_delete(f"{prefix}/{old}")
+            except Exception:
+                pass
+    if sweep:
+        _COORD_REAPED[0] = sweep[-1]
 
 
 def _coord_broadcast(value, src):
     """Eager cross-process broadcast over the coordination service:
     only `src` uploads its payload — one set + n gets, instead of the
     n uploads + n*n downloads a full allgather would move through the
-    single gRPC coordinator for data only one rank actually has."""
+    single gRPC coordinator for data only one rank actually has.
+    `src` is a FLEET rank (index into the current member list)."""
     import pickle
 
     import numpy as np
-    from jax._src import distributed
 
-    client = distributed.global_state.client
-    if client is None:
-        raise RuntimeError(
-            "jax.distributed is not initialized — multi-process "
-            "collectives need distributed.launch / "
-            "jax.distributed.initialize first")
-    rank = jax.process_index()
+    from paddle_tpu.resilience import fleet
+
+    client = _coord_client()
+    wv = fleet.world()
+    src_global = wv.members[int(src)]
     _COORD_ROUND[0] += 1
     rnd = _COORD_ROUND[0]
-    key = f"ptpu/bcast/{rnd}/{int(src)}"
-    if rank == int(src):
-        client.key_value_set_bytes(key, pickle.dumps(np.asarray(value)))
-    out = pickle.loads(client.blocking_key_value_get_bytes(key, 120_000))
-    _coord_reap(client, rank, rnd)
+    key = f"{fleet.coord_namespace()}/bcast/{rnd}/{src_global}"
+    if wv.global_rank == src_global:
+        fleet.kv_set_bytes(client, key, pickle.dumps(np.asarray(value)))
+    out = pickle.loads(_coord_get(client, key, src_global, rnd))
+    # no reap here: only an allgather proves every member has passed a
+    # round (broadcast synchronizes nobody but the reader and src) —
+    # _coord_reap fires from _coord_allgather, where the proof holds
     return out
 
 
@@ -375,6 +442,14 @@ def ppermute(tensor, perm, axis=None, group=None):
 
 def barrier(group=None):
     if jax.process_count() > 1:
+        if jax.default_backend() == "cpu":
+            # coordination-service barrier: a tiny allgather round is
+            # timeout-bounded and fleet-membership-aware, unlike
+            # sync_global_devices (which needs an SPMD-capable backend
+            # and the full launch-time process set)
+            import numpy as np
+            _coord_allgather(np.zeros((1,), np.int8))
+            return
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices("paddle_tpu_barrier")
 

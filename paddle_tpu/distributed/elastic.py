@@ -218,6 +218,13 @@ def notify_progress():
     if _active_manager is not None:
         _step_counter[0] += 1
         _active_manager.beat(_step_counter[0])
+    # every watchdog beat is ALSO fleet progress: the rank heartbeat
+    # publisher's progress counter advances per microbatch (e.g. each
+    # GradientMergeOptimizer accumulate step), so a slow k-step
+    # accumulate window — where Optimizer.step never fires — cannot be
+    # misclassified SUSPECT by a progress-aware FleetMonitor
+    from paddle_tpu.resilience import fleet
+    fleet.notify_fleet_progress()
 
 
 class Command:

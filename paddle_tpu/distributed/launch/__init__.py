@@ -45,6 +45,76 @@ def _from_env(args):
     return args
 
 
+def _rendezvous(master, nnodes, rank):
+    """``jax.distributed.initialize`` under a deadline + seeded-backoff
+    retry (PR 6 RetryPolicy): a transient coordinator (slow boot, port
+    not yet bound, packet loss) is retried; a fleet that never forms
+    raises a machine-readable ``resilience.fleet.CollectiveTimeout``
+    instead of the historical behavior (hang for jax's 300s default,
+    then an opaque backend error).  Budget knobs:
+    ``PTPU_RENDEZVOUS_TIMEOUT_S`` (per-attempt, default 120) and
+    ``PTPU_RENDEZVOUS_ATTEMPTS`` (default 3)."""
+    import random
+    import time
+
+    import jax
+
+    from paddle_tpu.resilience.fleet import CollectiveTimeout, _env_float
+    from paddle_tpu.resilience.retry import RetryPolicy, compute_backoff
+
+    timeout_s = _env_float("PTPU_RENDEZVOUS_TIMEOUT_S", 120.0)
+    attempts = int(_env_float("PTPU_RENDEZVOUS_ATTEMPTS", 3))
+    policy = RetryPolicy(max_attempts=max(1, attempts), backoff=0.5,
+                         multiplier=2.0, max_backoff=10.0, jitter=0.5)
+    rng = random.Random(rank or 0)
+    t0 = time.monotonic()
+    last = None
+    use_timeout = True
+    for attempt in range(policy.max_attempts):
+        try:
+            if use_timeout:
+                try:
+                    jax.distributed.initialize(
+                        coordinator_address=master,
+                        num_processes=nnodes, process_id=rank,
+                        initialization_timeout=max(1, int(timeout_s)))
+                    return
+                except TypeError:
+                    # older jax without initialization_timeout: fall
+                    # through to the plain call — still INSIDE this
+                    # attempt's failure handling, so a coordinator
+                    # slow-boot there retries like any other attempt
+                    use_timeout = False
+            jax.distributed.initialize(coordinator_address=master,
+                                       num_processes=nnodes,
+                                       process_id=rank)
+            return
+        except Exception as e:
+            last = e
+            # a half-initialized global_state would make the retry a
+            # "called twice" error, not a reconnect
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+            if attempt + 1 < policy.max_attempts:
+                time.sleep(compute_backoff(policy, attempt, rng))
+    waited = time.monotonic() - t0
+    if waited < 0.5 * timeout_s:
+        # attempts failed FAST — a config error (bad address, port in
+        # use, version mismatch), not a slow fleet.  Supervisors treat
+        # CollectiveTimeout as transient-and-retryable; mislabeling a
+        # permanently misconfigured launch would restart it forever
+        raise RuntimeError(
+            f"launch rendezvous to {master!r} failed "
+            f"{policy.max_attempts}x in {waited:.1f}s (well under the "
+            f"{timeout_s:.0f}s budget) — a configuration error, not a "
+            f"timeout") from last
+    raise CollectiveTimeout(
+        "launch.rendezvous", key=master, waited_s=waited,
+        timeout_s=timeout_s * policy.max_attempts) from last
+
+
 def launch(master=None, nnodes=None, rank=None, watchdog_timeout=None):
     """Initialize multi-host coordination; returns (process_index,
     process_count). Safe to call on single host (no-op init)."""
@@ -55,8 +125,17 @@ def launch(master=None, nnodes=None, rank=None, watchdog_timeout=None):
             "launch needs --nnodes >= 2 (or PADDLE_NNODES); refusing to "
             "silently train standalone")
     if master is not None and nnodes and nnodes > 1:
-        jax.distributed.initialize(coordinator_address=master,
-                                   num_processes=nnodes, process_id=rank)
+        _rendezvous(master, nnodes, rank)
+        # agree on the per-run launch id (namespaces every coordination
+        # key) and reap the whole namespace on clean exit — an aborted
+        # run leaves only keys the NEXT run can never collide with.
+        # The reap rides the finalize() done-barrier: a bare delete at
+        # first-exiter atexit would strand slower peers mid-collective
+        import atexit
+
+        from paddle_tpu.resilience import fleet
+        fleet._ensure_launch_id()
+        atexit.register(fleet.finalize)
     else:
         try:
             jax.distributed.initialize()  # TPU metadata autodetect

@@ -18,7 +18,14 @@ Four pieces (docs/resilience.md has the architecture):
 - :mod:`faultinject` — seeded, deterministic fault plans executed
   through hook points in ``framework/io.py``, ``optimizer/`` and
   ``serving/engine.py``, with every injected fault and recovery
-  recorded through ``paddle_tpu.observability``.
+  recorded through ``paddle_tpu.observability``;
+- :mod:`fleet` — distributed fault tolerance: timeout-bounded
+  coordination (:class:`fleet.CollectiveTimeout` instead of a hung
+  collective), rank heartbeats + the HEALTHY→SUSPECT→DEAD fleet
+  watchdog, sharded quorum-manifested :class:`fleet
+  .DistributedCheckpointer` with reshard-on-shrink, and elastic
+  :func:`fleet.reconfigure` so survivors of a dead rank re-form at the
+  smaller world size and resume.
 
 Quickstart::
 
@@ -36,11 +43,16 @@ Quickstart::
                                         "optimizer": opt.state_dict()}):
                 break
 """
-from paddle_tpu.resilience import faultinject
+from paddle_tpu.resilience import faultinject, fleet
 from paddle_tpu.resilience.checkpoint import (CheckpointCorruption,
                                               Checkpointer, auto_resume)
 from paddle_tpu.resilience.faultinject import (FaultInjector, FaultPlan,
                                                FaultSpec, WorkerFault)
+from paddle_tpu.resilience.fleet import (CollectiveTimeout,
+                                         DistributedCheckpointer,
+                                         FleetMonitor,
+                                         HeartbeatPublisher, RankState,
+                                         WorldView, reconfigure)
 from paddle_tpu.resilience.health import HealthMonitor, HealthState
 from paddle_tpu.resilience.preemption import (PreemptionHandler,
                                               request_preemption)
@@ -50,17 +62,25 @@ from paddle_tpu.resilience.retry import (RetryExhausted, RetryPolicy,
 __all__ = [
     "CheckpointCorruption",
     "Checkpointer",
+    "CollectiveTimeout",
+    "DistributedCheckpointer",
     "FaultInjector",
     "FaultPlan",
     "FaultSpec",
+    "FleetMonitor",
     "HealthMonitor",
     "HealthState",
+    "HeartbeatPublisher",
     "PreemptionHandler",
+    "RankState",
     "RetryExhausted",
     "RetryPolicy",
     "WorkerFault",
+    "WorldView",
     "auto_resume",
     "faultinject",
+    "fleet",
+    "reconfigure",
     "request_preemption",
     "retry",
 ]

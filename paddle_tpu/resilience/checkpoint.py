@@ -39,7 +39,8 @@ import time
 
 from paddle_tpu.framework import io as fio
 
-__all__ = ["CheckpointCorruption", "Checkpointer", "auto_resume"]
+__all__ = ["CheckpointCorruption", "Checkpointer", "auto_resume",
+           "digest_bytes", "read_manifest", "write_manifest"]
 
 _MANIFEST = "MANIFEST.json"
 _FORMAT = 1
@@ -51,8 +52,40 @@ class CheckpointCorruption(RuntimeError):
     callers can cold-start)."""
 
 
-def _digest(data):
+def digest_bytes(data):
+    """sha256 hex digest — THE checkpoint content-digest function,
+    shared with :class:`~paddle_tpu.resilience.fleet
+    .DistributedCheckpointer` so single-process and fleet manifests
+    stay mutually verifiable."""
     return hashlib.sha256(data).hexdigest()
+
+
+_digest = digest_bytes
+
+
+def read_manifest(directory, fmt=_FORMAT):
+    """Parse ``<directory>/MANIFEST.json``; unreadable/absent yields an
+    empty manifest of format `fmt` (cold start is not an error)."""
+    path = os.path.join(directory, _MANIFEST)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {"format": fmt, "checkpoints": []}
+
+
+def write_manifest(directory, manifest):
+    """Atomic manifest rewrite through the shared durable-write choke
+    point (distinct ``io.manifest`` fault site: occurrence-indexed
+    plans can tear the Nth payload without counting interleaved
+    manifest rewrites).  Callers hold their checkpointer lock across
+    this write ON PURPOSE: the manifest read-modify-write must be
+    serialized or a concurrent save's entry is silently dropped — the
+    deliberate ordering PR 7 reviewed (write-under-lock, baselined for
+    the method form this helper replaces)."""
+    fio.write_atomic(os.path.join(directory, _MANIFEST),  # racelint: disable=RL103
+                     json.dumps(manifest, indent=1).encode(),
+                     site="io.manifest")
 
 
 class Checkpointer:
@@ -189,19 +222,10 @@ class Checkpointer:
 
     # ------------------------------------------------------------ load
     def _read_manifest(self):
-        path = os.path.join(self.directory, _MANIFEST)
-        try:
-            with open(path) as f:
-                return json.load(f)
-        except (OSError, json.JSONDecodeError):
-            return {"format": _FORMAT, "checkpoints": []}
+        return read_manifest(self.directory)
 
     def _write_manifest(self, manifest):
-        # distinct fault site: occurrence-indexed plans can tear the
-        # Nth PAYLOAD without counting interleaved manifest rewrites
-        fio.write_atomic(os.path.join(self.directory, _MANIFEST),
-                         json.dumps(manifest, indent=1).encode(),
-                         site="io.manifest")
+        write_manifest(self.directory, manifest)
 
     def steps(self):
         """Manifest-recorded steps, ascending (unverified)."""

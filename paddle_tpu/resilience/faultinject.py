@@ -26,6 +26,19 @@ site                   where / supported kinds
 ``serving.pool``       ``LLMEngine`` decode capacity pass —
                        ``pool_exhaust`` (forces one preemption round
                        through the REAL victim-selection path)
+``fleet.heartbeat``    ``resilience.fleet.HeartbeatPublisher`` beat —
+                       ``exception`` (beat skipped, counted in
+                       ``missed_beats``; the publisher thread
+                       survives), ``slow``
+``fleet.kv_get``       every timeout-bounded coordination-service get
+                       (``fleet.kv_get_bytes``: eager collectives,
+                       checkpoint quorum, reconfigure join) —
+                       ``exception``, ``slow``
+``fleet.rank_kill``    chaos-worker per-step hook — ``rank_kill``
+                       delivers a REAL ``SIGKILL`` to the calling
+                       process (the dead-host fault of the
+                       multi-process chaos suite; only meaningful in a
+                       sacrificial worker subprocess)
 =====================  ====================================================
 
 Usage::
@@ -53,7 +66,8 @@ __all__ = [
     "fire", "active_plan", "note_recovery",
 ]
 
-KINDS = ("torn_write", "exception", "preempt", "pool_exhaust", "slow")
+KINDS = ("torn_write", "exception", "preempt", "pool_exhaust", "slow",
+         "rank_kill")
 
 
 class WorkerFault(RuntimeError):
@@ -248,6 +262,17 @@ def fire(site, **ctx):
         from paddle_tpu.resilience import preemption
         preemption.request_preemption(reason=f"injected at {site}")
         return spec
+    if spec.kind == "rank_kill":
+        # the dead-host fault: a REAL, unhandleable SIGKILL — no atexit,
+        # no flushes, no drain; exactly what a preempted host looks like
+        # to its peers.  Flush the injection record first (it is this
+        # process's last testimony).
+        import os
+        import signal
+        import sys
+        sys.stderr.flush()
+        sys.stdout.flush()
+        os.kill(os.getpid(), signal.SIGKILL)
     return spec
 
 

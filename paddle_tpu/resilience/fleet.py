@@ -1,0 +1,1385 @@
+"""Fleet-grade fault tolerance: timeout-bounded coordination, rank
+heartbeats with a fleet watchdog, sharded distributed checkpoints, and
+elastic reconfigure-and-resume.
+
+Every multi-process path in this repo rides the jax.distributed
+coordination service's key-value store (``distributed/collective.py``'s
+``_coord_*`` eager collectives, the launch rendezvous, the elastic
+heartbeat server).  Before this module, every one of those blocking
+gets assumed no rank ever dies — one SIGKILLed host stranded every
+survivor forever.  The fleet layer turns that into a bounded, observable,
+recoverable protocol (docs/resilience.md "Distributed fault tolerance"):
+
+1. **Timeout-bounded KV gets** — :func:`kv_get_bytes` slices a blocking
+   get into short coordinator round-trips with seeded-backoff retries
+   (PR 6 :class:`~paddle_tpu.resilience.retry.RetryPolicy` shape) under
+   one deadline, raising a machine-readable :class:`CollectiveTimeout`
+   naming the missing rank instead of hanging.  An ``abort_if`` hook
+   lets a caller bail out early the moment the fleet watchdog reaches a
+   DEAD verdict for the awaited peer.
+2. **Rank heartbeats + fleet watchdog** — every rank runs a
+   :class:`HeartbeatPublisher` (monotonic sequence + progress counter
+   through the KV store; ``elastic.notify_progress()`` feeds the
+   progress counter so a slow gradient-accumulate window is still
+   progress); a :class:`FleetMonitor` classifies peers
+   HEALTHY → SUSPECT → DEAD with hysteresis (PR 6
+   :class:`~paddle_tpu.resilience.health.HealthMonitor` shape) and
+   drives the ``fleet_rank_state{rank=}`` /
+   ``fleet_last_heartbeat_age_s{rank=}`` gauges plus
+   ``resilience.fleet.*`` spans through the observability registry.
+3. **Sharded distributed checkpoints** — :class:`DistributedCheckpointer`
+   writes one shard per rank through ``framework.io.write_atomic``;
+   rank 0 commits a quorum MANIFEST (sha256 per shard, world size,
+   mesh spec) only after every shard digest is durable, and ``load()``
+   can reconstruct state at a *different* world size by resharding the
+   dp-stacked leaves — skipping torn entries per the PR 6 last-good
+   contract.
+4. **Elastic reconfigure-and-resume** — on a :class:`CollectiveTimeout`
+   or DEAD verdict, :func:`reconfigure` re-rendezvouses the survivors
+   under a fresh key namespace at the shrunk world size; the training
+   loop reloads the last-good distributed checkpoint and resumes.
+
+Scope note: the coordination service lives in global rank 0's process
+(jax.distributed's design), so rank 0 itself dying is unrecoverable
+in-process — that failure mode needs the external launcher to restart
+the job (exit-code protocol, PR 6).  Every *other* rank's death is
+recoverable here, and that is the failure mode that dominates real
+fleets (preemption of one host).
+
+jaxlib quirk (pinned by tests/test_fleet.py): this jaxlib's
+``blocking_key_value_get_bytes`` segfaults on ONE-byte stored values
+(the compressed-payload path), so :func:`kv_set_bytes` pads every
+payload to >= 2 bytes.  All fleet payloads are JSON and naturally
+bigger; the pad is a guard for callers storing raw flags.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import random
+import threading
+import time
+import uuid
+from enum import IntEnum
+
+from paddle_tpu.resilience import faultinject
+from paddle_tpu.resilience.retry import RetryPolicy, compute_backoff
+
+__all__ = [
+    "CollectiveTimeout",
+    "DistributedCheckpointer",
+    "FleetConfig",
+    "FleetMonitor",
+    "HeartbeatPublisher",
+    "LocalKVClient",
+    "RankState",
+    "WorldView",
+    "configure",
+    "coord_namespace",
+    "coord_shutdown",
+    "finalize",
+    "get_config",
+    "get_monitor",
+    "get_publisher",
+    "install_monitor",
+    "install_publisher",
+    "kv_get_bytes",
+    "kv_set_bytes",
+    "notify_fleet_progress",
+    "reconfigure",
+    "world",
+]
+
+
+# --------------------------------------------------------------- config
+def _env_float(name, default):
+    v = os.environ.get(name)
+    try:
+        return float(v) if v else float(default)
+    except ValueError:
+        return float(default)
+
+
+class FleetConfig:
+    """Timeout budgets for the coordination path.
+
+    Every knob has an env override so the launcher (and the chaos
+    suite) can shrink the budgets without touching training code:
+
+    - ``collective_timeout_s`` (``PTPU_FLEET_TIMEOUT_S``): total wait
+      for one peer's contribution to an eager collective before
+      :class:`CollectiveTimeout`;
+    - ``kv_slice_s`` (``PTPU_FLEET_KV_SLICE_S``): one blocking-get
+      round trip — the granularity at which ``abort_if`` (the DEAD
+      verdict) is polled;
+    - ``heartbeat_interval_s`` (``PTPU_FLEET_HB_INTERVAL_S``) and the
+      derived SUSPECT/DEAD ages (3x / 6x by default, overridable);
+    - ``rendezvous_timeout_s`` (``PTPU_FLEET_RENDEZVOUS_TIMEOUT_S``):
+      reconfigure join-barrier budget;
+    - ``progress_timeout_s`` (``PTPU_FLEET_PROGRESS_TIMEOUT_S``,
+      0/unset = disabled): frozen-progress → SUSPECT livelock window.
+    """
+
+    def __init__(self, collective_timeout_s=None, kv_slice_s=None,
+                 heartbeat_interval_s=None, suspect_after_s=None,
+                 dead_after_s=None, rendezvous_timeout_s=None,
+                 progress_timeout_s=None, retry=None):
+        self.collective_timeout_s = (
+            collective_timeout_s if collective_timeout_s is not None
+            else _env_float("PTPU_FLEET_TIMEOUT_S", 60.0))
+        self.kv_slice_s = (kv_slice_s if kv_slice_s is not None
+                           else _env_float("PTPU_FLEET_KV_SLICE_S", 1.0))
+        self.heartbeat_interval_s = (
+            heartbeat_interval_s if heartbeat_interval_s is not None
+            else _env_float("PTPU_FLEET_HB_INTERVAL_S", 5.0))
+        self.suspect_after_s = (
+            suspect_after_s if suspect_after_s is not None
+            else _env_float("PTPU_FLEET_SUSPECT_AFTER_S",
+                            3.0 * self.heartbeat_interval_s))
+        self.dead_after_s = (
+            dead_after_s if dead_after_s is not None
+            else _env_float("PTPU_FLEET_DEAD_AFTER_S",
+                            6.0 * self.heartbeat_interval_s))
+        self.rendezvous_timeout_s = (
+            rendezvous_timeout_s if rendezvous_timeout_s is not None
+            else _env_float("PTPU_FLEET_RENDEZVOUS_TIMEOUT_S",
+                            self.collective_timeout_s))
+        # progress staleness -> SUSPECT (None/0 = heartbeat liveness
+        # only; env-enabled like every other knob)
+        if progress_timeout_s is None:
+            progress_timeout_s = _env_float(
+                "PTPU_FLEET_PROGRESS_TIMEOUT_S", 0.0) or None
+        self.progress_timeout_s = progress_timeout_s
+        if not (0 < self.suspect_after_s < self.dead_after_s):
+            raise ValueError(
+                "need 0 < suspect_after_s < dead_after_s, got "
+                f"{self.suspect_after_s}/{self.dead_after_s}")
+        # short max_backoff: the slice-get itself blocks server-side,
+        # backoff only spaces the coordinator round trips
+        self.retry = retry or RetryPolicy(
+            max_attempts=1_000_000, backoff=0.02, multiplier=2.0,
+            max_backoff=0.5, jitter=0.5)
+
+
+_config = FleetConfig()
+_config_lock = threading.Lock()
+
+
+def get_config():
+    return _config
+
+
+def configure(**overrides):
+    """Replace the process-wide :class:`FleetConfig` (call before the
+    training loop; returns the new config)."""
+    global _config
+    with _config_lock:
+        _config = FleetConfig(**overrides)
+        return _config
+
+
+# --------------------------------------------------------------- errors
+class CollectiveTimeout(RuntimeError):
+    """A coordination-path wait exceeded its deadline (or the fleet
+    watchdog reached a DEAD verdict for the awaited peer).  Machine-
+    readable: ``site``/``key``/``missing_rank``/``waited_s``/
+    ``timeout_s``/``namespace``/``verdict`` — the elastic recovery path
+    branches on these, never on the message text."""
+
+    def __init__(self, site, key=None, missing_rank=None, waited_s=0.0,
+                 timeout_s=0.0, namespace=None, verdict=None):
+        self.site = str(site)
+        self.key = key
+        self.missing_rank = missing_rank
+        self.waited_s = float(waited_s)
+        self.timeout_s = float(timeout_s)
+        self.namespace = namespace
+        self.verdict = verdict       # e.g. "deadline" or "dead-verdict"
+        who = (f"rank {missing_rank}" if missing_rank is not None
+               else f"key {key!r}")
+        super().__init__(
+            f"collective timeout at {self.site!r}: {who} missing after "
+            f"{self.waited_s:.2f}s (budget {self.timeout_s:.2f}s, "
+            f"verdict={self.verdict or 'deadline'})")
+
+    def to_dict(self):
+        return {"site": self.site, "key": self.key,
+                "missing_rank": self.missing_rank,
+                "waited_s": round(self.waited_s, 3),
+                "timeout_s": self.timeout_s,
+                "namespace": self.namespace,
+                "verdict": self.verdict or "deadline"}
+
+
+# ---------------------------------------------------------------- world
+class WorldView:
+    """The fleet's current membership: the GLOBAL ranks (launch-time
+    process ids — stable across reconfigurations) that are members,
+    this process's global rank, and the contiguous fleet rank derived
+    from the member list.  Generation 0 is the launch world; every
+    :func:`reconfigure` bumps the generation and shrinks ``members``."""
+
+    def __init__(self, members, global_rank, generation=0,
+                 launch_id="local"):
+        self.members = tuple(int(m) for m in members)
+        self.global_rank = int(global_rank)
+        if self.global_rank not in self.members:
+            raise ValueError(
+                f"global rank {self.global_rank} not in members "
+                f"{self.members}")
+        self.generation = int(generation)
+        self.launch_id = str(launch_id)
+
+    @property
+    def rank(self):
+        """Contiguous fleet rank (index into the member list)."""
+        return self.members.index(self.global_rank)
+
+    @property
+    def size(self):
+        return len(self.members)
+
+    @property
+    def namespace(self):
+        return f"ptpu/{self.launch_id}/g{self.generation}"
+
+    def to_dict(self):
+        return {"members": list(self.members),
+                "global_rank": self.global_rank, "rank": self.rank,
+                "size": self.size, "generation": self.generation,
+                "launch_id": self.launch_id}
+
+    def __repr__(self):
+        return (f"WorldView(members={self.members}, "
+                f"global_rank={self.global_rank}, "
+                f"generation={self.generation})")
+
+
+_world = None
+_world_lock = threading.Lock()
+_launch_id = [None]
+
+
+def _client():
+    """The jax.distributed coordination-service client, or None."""
+    try:
+        from jax._src import distributed as jd
+        return jd.global_state.client
+    except Exception:
+        return None
+
+
+def _ensure_launch_id(client=None):
+    """A per-run id namespacing every coordination key, so an aborted
+    run's debris can never collide with (or strand) the next run on a
+    long-lived coordinator.  Agreement order: the launcher's
+    ``PADDLE_LAUNCH_ID`` env wins; else rank 0 publishes a fresh uuid
+    through the (fresh-per-run) KV store and peers read it; else
+    single-process ``local``."""
+    if _launch_id[0] is not None:
+        return _launch_id[0]
+    # agreement happens OUTSIDE _world_lock (it blocks on the KV
+    # store; holding the lock across network waits is the RL103 class
+    # this module polices).  launch() calls this once at bootstrap; a
+    # concurrent duplicate agreement is harmless (same env value, or
+    # peers read whichever uuid rank 0 published last).
+    lid = os.environ.get("PADDLE_LAUNCH_ID")
+    if not lid:
+        client = client if client is not None else _client()
+        if client is not None:
+            import jax
+            key = "ptpu/launch/current"
+            if jax.process_index() == 0:
+                lid = uuid.uuid4().hex[:8]
+                _kv_set_str(client, key, lid)
+            else:
+                # timeout-bounded like every other coordination wait:
+                # a coordinator that dies before rank 0 publishes must
+                # surface as CollectiveTimeout, not a 120s opaque hang
+                lid = kv_get_bytes(
+                    client, key, get_config().rendezvous_timeout_s,
+                    site="fleet.kv_get", missing_rank=0).decode()
+        else:
+            lid = "local"
+    with _world_lock:
+        if _launch_id[0] is None:
+            _launch_id[0] = str(lid)
+        return _launch_id[0]
+
+
+def world():
+    """The installed :class:`WorldView` (after a reconfigure) or the
+    launch-time default derived from jax.distributed."""
+    wv = _world
+    if wv is not None:
+        return wv
+    try:
+        import jax
+        n, r = jax.process_count(), jax.process_index()
+    except Exception:
+        n, r = 1, 0
+    return WorldView(range(n), r, generation=0,
+                     launch_id=_launch_id[0] or "local")
+
+
+def _set_world(wv):
+    global _world
+    with _world_lock:
+        _world = wv
+    return wv
+
+
+def coord_namespace():
+    """Key namespace for the CURRENT world generation — every
+    coordination key (collectives, heartbeats, checkpoints, joins)
+    lives under it, so one ``key_value_delete`` of the namespace reaps
+    a whole generation (clean exit, reconfigure)."""
+    wv = _world
+    if wv is not None:
+        return wv.namespace
+    return f"ptpu/{_launch_id[0] or 'local'}/g0"
+
+
+def coord_shutdown(client=None):
+    """Clean-exit reap: fleet rank 0 deletes the current generation's
+    whole key namespace (registered via atexit by the launcher — an
+    aborted run skips it, which is exactly why keys are launch-id
+    namespaced)."""
+    client = client if client is not None else _client()
+    if client is None:
+        return
+    wv = world()
+    if wv.rank != 0:
+        return
+    try:
+        client.key_value_delete(coord_namespace())
+    except Exception:
+        pass
+
+
+_finalized = [False]
+
+
+def finalize(timeout_s=30.0, client=None):
+    """Fleet check-out barrier — the ONLY safe place for the clean-exit
+    namespace reap, and mandatory before ``os._exit`` once a peer has
+    died (the jax client's destructor-time shutdown barrier can never
+    complete against a dead task).  Every member publishes a done
+    marker; the COORDINATOR HOST (global rank 0 — the process whose
+    death takes the whole KV service with it, and the only rank that
+    may not exit early) lingers until all members checked out (bounded,
+    best-effort), THEN reaps the namespace — reaping before the
+    check-out would delete keys a slower peer is still mid-read on
+    (the exact leak-vs-strand tension the per-run namespace exists
+    for).  Registered via atexit by ``launch()``; idempotent."""
+    if _finalized[0]:
+        return
+    _finalized[0] = True
+    client = client if client is not None else _client()
+    if client is None:
+        return
+    wv = world()
+    try:
+        kv_set_bytes(client,
+                     f"{wv.namespace}/fleet/done/{wv.global_rank}",
+                     b"ok")
+    except Exception:
+        return
+    if wv.global_rank != 0:
+        return
+    # ONE shared deadline across all members — per-member budgets
+    # would stack to (n-1) * timeout_s when many peers died, wedging
+    # rank 0's atexit for minutes on a large fleet
+    deadline = time.monotonic() + float(timeout_s)
+    for m in wv.members:
+        if m == wv.global_rank:
+            continue
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        try:
+            kv_get_bytes(client, f"{wv.namespace}/fleet/done/{m}",
+                         remaining, site="fleet.kv_get",
+                         missing_rank=m)
+        except Exception:
+            pass      # best-effort: a wedged peer must not trap rank 0
+    coord_shutdown(client)
+    # one beat of grace so peers' in-flight RPC cycles drain before the
+    # service goes away with this process
+    time.sleep(0.2)
+
+
+# ----------------------------------------------------------- KV helpers
+def _kv_set_str(client, key, value):
+    try:
+        client.key_value_set(key, value, allow_overwrite=True)
+    except TypeError:        # older client without the kwarg
+        try:
+            client.key_value_delete(key)
+        except Exception:
+            pass
+        client.key_value_set(key, value)
+
+
+def kv_set_bytes(client, key, data):
+    """Store bytes, overwriting (heartbeats re-publish the same key
+    every interval) and padded to >= 2 bytes: this jaxlib segfaults
+    inside ``blocking_key_value_get_bytes`` on a one-byte stored value
+    (the compressed-payload path), so the choke point guarantees no
+    fleet payload can ever trip it.  Readers must tolerate a trailing
+    pad byte on sub-2-byte payloads (JSON/pickle payloads never
+    are)."""
+    if len(data) < 2:
+        data = bytes(data) + b"\x00" * (2 - len(data))
+    try:
+        client.key_value_set_bytes(key, bytes(data),
+                                   allow_overwrite=True)
+    except TypeError:            # older client without the kwarg
+        try:
+            client.key_value_delete(key)
+        except Exception:
+            pass
+        client.key_value_set_bytes(key, bytes(data))
+
+
+def kv_get_bytes(client, key, timeout_s=None, *, site="fleet.kv_get",
+                 missing_rank=None, abort_if=None, config=None,
+                 seed=None):
+    """Deadline-bounded blocking get: short coordinator round trips
+    (``config.kv_slice_s``) under one deadline, seeded-backoff spacing
+    between attempts (deterministic — chaos replayable), and an
+    ``abort_if()`` poll after each MISSED slice so a DEAD verdict from
+    the fleet watchdog aborts the wait within one slice instead of
+    burning the full budget — but never before trying: data a peer
+    published before dying must still be returned.  Raises
+    :class:`CollectiveTimeout` naming ``missing_rank`` (or the key) —
+    never hangs.
+
+    Fault site ``fleet.kv_get``: ``exception`` raises
+    :class:`~paddle_tpu.resilience.faultinject.WorkerFault` before the
+    first round trip; ``slow`` delays it — both deterministic on the
+    per-site occurrence counter.
+    """
+    config = config or get_config()
+    timeout_s = (float(timeout_s) if timeout_s is not None
+                 else config.collective_timeout_s)
+    faultinject.fire(site, key=key, missing_rank=missing_rank)
+    t0 = time.monotonic()
+    deadline = t0 + timeout_s
+    # stable key-derived default seed (NOT hash(): str hashes are
+    # salted per process, which would unseed the chaos-replayable
+    # backoff sequence this function documents)
+    import zlib
+    rng = random.Random(seed if seed is not None
+                        else zlib.crc32(key.encode()) & 0xffff)
+    attempt = 0
+    last_exc = None
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            exc = CollectiveTimeout(
+                site, key=key, missing_rank=missing_rank,
+                waited_s=time.monotonic() - t0, timeout_s=timeout_s,
+                namespace=coord_namespace(), verdict="deadline")
+            _record_timeout(exc)
+            # chain the last underlying client error: a dead
+            # coordinator must not masquerade as a merely-absent key
+            raise exc from last_exc
+        slice_ms = max(1, int(min(remaining, config.kv_slice_s) * 1000))
+        try:
+            return client.blocking_key_value_get_bytes(key, slice_ms)
+        except Exception as e:
+            # the DEAD verdict is consulted only AFTER a missed slice:
+            # data a peer published before dying (a durable shard
+            # digest, an already-complete allgather round) must still
+            # be returned, not discarded into a spurious timeout — a
+            # dead publisher's key does not disappear
+            if abort_if is not None and abort_if():
+                exc = CollectiveTimeout(
+                    site, key=key, missing_rank=missing_rank,
+                    waited_s=time.monotonic() - t0,
+                    timeout_s=timeout_s, namespace=coord_namespace(),
+                    verdict="dead-verdict")
+                _record_timeout(exc)
+                raise exc from e
+            # DEADLINE_EXCEEDED for this slice (or a transient
+            # coordinator error): back off deterministically and retry
+            # until OUR deadline decides.  The exponent is clamped —
+            # max_backoff saturates the VALUE long before, but
+            # multiplier**attempt itself overflows float at ~1024
+            last_exc = e
+            delay = min(compute_backoff(config.retry, min(attempt, 32),
+                                        rng),
+                        max(0.0, deadline - time.monotonic()))
+            attempt += 1
+            if delay > 0:
+                time.sleep(delay)
+
+
+def _record_timeout(exc):
+    try:
+        from paddle_tpu import observability as obs
+        with obs.span("resilience.fleet.timeout", **exc.to_dict()):
+            pass
+        obs.registry().counter(
+            "fleet_collective_timeouts_total",
+            labels={"site": exc.site},
+            help="coordination waits that exceeded their deadline").inc()
+    except Exception:
+        pass
+
+
+class LocalKVClient:
+    """In-process stand-in for the jax.distributed coordination-service
+    client (same method subset, same blocking semantics) so the fleet
+    machinery — publisher, watchdog, distributed checkpoints,
+    reconfigure — is unit-testable and benchable with rank-per-thread
+    worlds, no gRPC coordinator needed."""
+
+    def __init__(self):
+        self._data = {}
+        self._cond = threading.Condition()
+
+    def key_value_set(self, key, value, allow_overwrite=False):
+        with self._cond:
+            if key in self._data and not allow_overwrite:
+                raise ValueError(f"key {key!r} already set")
+            self._data[key] = str(value)
+            self._cond.notify_all()
+
+    def key_value_set_bytes(self, key, value, allow_overwrite=False):
+        with self._cond:
+            if key in self._data and not allow_overwrite:
+                raise ValueError(f"key {key!r} already set")
+            self._data[key] = bytes(value)
+            self._cond.notify_all()
+
+    def _blocking_get(self, key, timeout_in_ms):
+        deadline = time.monotonic() + timeout_in_ms / 1000.0
+        with self._cond:
+            while key not in self._data:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cond.wait(remaining):
+                    if key not in self._data:
+                        raise TimeoutError(
+                            f"DEADLINE_EXCEEDED waiting for {key!r}")
+            return self._data[key]
+
+    def blocking_key_value_get(self, key, timeout_in_ms):
+        return str(self._blocking_get(key, timeout_in_ms))
+
+    def blocking_key_value_get_bytes(self, key, timeout_in_ms):
+        v = self._blocking_get(key, timeout_in_ms)
+        return v if isinstance(v, bytes) else str(v).encode()
+
+    def key_value_dir_get(self, prefix):
+        with self._cond:
+            return sorted((k, str(v)) for k, v in self._data.items()
+                          if k.startswith(prefix))
+
+    def key_value_dir_get_bytes(self, prefix):
+        with self._cond:
+            return sorted(
+                (k, v if isinstance(v, bytes) else str(v).encode())
+                for k, v in self._data.items() if k.startswith(prefix))
+
+    def key_value_delete(self, key):
+        """Key plus directory semantics (the coordination service reaps
+        ``key`` and every ``key/...`` child)."""
+        with self._cond:
+            for k in [k for k in self._data
+                      if k == key or k.startswith(key.rstrip("/") + "/")]:
+                del self._data[k]
+
+
+# ----------------------------------------------------- heartbeat plane
+class RankState(IntEnum):
+    HEALTHY = 0
+    SUSPECT = 1
+    DEAD = 2
+
+
+class HeartbeatPublisher:
+    """One per rank: a daemon thread publishes
+    ``<ns>/fleet/hb/<global_rank>`` every ``interval_s`` with a JSON
+    payload ``{"seq": n, "t": wall, "progress": p}`` — ``seq`` is the
+    publisher's own monotonic beat counter (the watchdog measures
+    staleness by LOCAL time since it last saw ``seq`` advance, so
+    cross-host clock skew cannot fake liveness), ``progress`` is bumped
+    by :meth:`beat` / ``elastic.notify_progress()`` so a slow
+    gradient-accumulate window (k-1 of every k microbatches never reach
+    ``Optimizer.step``) still reads as forward progress.
+
+    The key namespace is re-read every publish, so a reconfigure's
+    generation bump redirects beats automatically.
+
+    Fault site ``fleet.heartbeat`` (kinds ``exception`` / ``slow``):
+    an injected exception skips that beat (counted in
+    ``missed_beats``) — the publisher thread itself must survive, a
+    dead publisher is indistinguishable from a dead rank.
+    """
+
+    def __init__(self, client=None, rank=None, interval_s=None,
+                 world_fn=None, time_fn=time.time):
+        self._client = client if client is not None else _client()
+        self._world_fn = world_fn or world
+        self._rank = (int(rank) if rank is not None
+                      else self._world_fn().global_rank)
+        self._interval = (float(interval_s) if interval_s is not None
+                          else get_config().heartbeat_interval_s)
+        self._time = time_fn
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._progress = 0
+        self.missed_beats = 0
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread = None
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def seq(self):
+        with self._lock:
+            return self._seq
+
+    @property
+    def progress(self):
+        with self._lock:
+            return self._progress
+
+    def start(self):
+        if self._thread is None and self._client is not None:
+            self._stop.clear()       # restartable after stop()
+            self._wake.clear()
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name=f"paddle_tpu-fleet-hb-{self._rank}")
+            self._thread.start()
+        return self
+
+    def beat(self):
+        """Record forward progress (called from
+        ``elastic.notify_progress()`` every optimizer/microbatch step).
+        Deliberately does NOT wake the publisher thread: the next
+        interval beat carries the updated counter — waking per step
+        would turn the publish rate into the training-step rate and
+        flood the single gRPC coordinator exactly when the fleet is
+        busiest."""
+        with self._lock:
+            self._progress += 1
+
+    def publish_once(self):
+        """One beat, synchronously (the thread loop body; also callable
+        directly in tests)."""
+        try:
+            faultinject.fire("fleet.heartbeat", rank=self._rank)
+        except faultinject.WorkerFault:
+            with self._lock:
+                self.missed_beats += 1
+            return False
+        now = self._time()          # user-supplied clock: never call it
+        with self._lock:            # under the publisher lock (RL103)
+            self._seq += 1
+            payload = {"seq": self._seq, "t": now,
+                       "progress": self._progress}
+        key = f"{coord_namespace()}/fleet/hb/{self._rank}"
+        try:
+            kv_set_bytes(self._client, key,
+                         json.dumps(payload).encode())
+            return True
+        except Exception:
+            with self._lock:
+                self.missed_beats += 1
+            return False
+
+    def _run(self):
+        while not self._stop.is_set():
+            self.publish_once()
+            self._wake.wait(self._interval)
+            self._wake.clear()
+
+    def stop(self):
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+_publisher = None
+_monitor = None
+
+
+def install_publisher(pub):
+    global _publisher
+    with _world_lock:
+        _publisher = pub
+    return pub
+
+
+def get_publisher():
+    return _publisher
+
+
+def notify_fleet_progress():
+    """``distributed.elastic.notify_progress()`` forwards here: every
+    watchdog beat is also fleet progress (near-free without an
+    installed publisher)."""
+    pub = _publisher
+    if pub is not None:
+        pub.beat()
+
+
+def install_monitor(mon):
+    global _monitor
+    with _world_lock:
+        _monitor = mon
+    return mon
+
+
+def get_monitor():
+    return _monitor
+
+
+class FleetMonitor:
+    """The fleet watchdog: reads every member's heartbeat key and
+    classifies peers HEALTHY → SUSPECT → DEAD with hysteresis.
+
+    Staleness is LOCAL-clock time since this monitor last observed the
+    peer's ``seq`` advance (never a cross-host wall-clock difference):
+
+    - HEALTHY → SUSPECT at age > ``suspect_after_s``
+    - SUSPECT → DEAD    at age > ``dead_after_s``
+    - SUSPECT → HEALTHY the moment a fresh ``seq`` lands
+    - DEAD is terminal for the generation — a DEAD verdict feeds
+      :func:`reconfigure`, never silent resurrection (a rank that
+      was declared dead may have been evicted for cause).
+
+    With ``progress_timeout_s`` set, a peer whose beats flow but whose
+    ``progress`` counter is frozen for that long is demoted to SUSPECT
+    (livelock: the host is alive, training is not) — it recovers the
+    moment progress advances.
+
+    Every poll refreshes ``fleet_rank_state{rank=}`` and
+    ``fleet_last_heartbeat_age_s{rank=}`` gauges (scraped by
+    ``observability.export.serve_prometheus`` with zero extra
+    plumbing); every transition records a
+    ``resilience.fleet.transition`` span.  ``on_dead(ranks)`` fires
+    outside the lock (PR 7 health-callback lesson) once per newly-DEAD
+    set.
+    """
+
+    def __init__(self, client=None, config=None, world_fn=None,
+                 on_dead=None, time_fn=time.monotonic,
+                 poll_interval_s=None):
+        self._client = client if client is not None else _client()
+        self._config = config or get_config()
+        self._world_fn = world_fn or world
+        self.on_dead = on_dead
+        self._time = time_fn
+        self._poll_interval = (
+            float(poll_interval_s) if poll_interval_s is not None
+            else self._config.heartbeat_interval_s / 2.0)
+        self._lock = threading.Lock()
+        self._seen = {}      # rank -> (seq, progress, first_seen_local,
+        #                               seq_local, progress_local)
+        self._states = {}    # rank -> RankState
+        self.transitions = []  # [(rank, old, new, age_s)]
+        self._stop = threading.Event()
+        self._thread = None
+
+    # ---- classification ----
+    def poll(self):
+        """One watchdog pass; returns ``{global_rank: RankState}`` for
+        the current world's members."""
+        wv = self._world_fn()
+        now = self._time()
+        beats = {}
+        try:
+            pairs = self._client.key_value_dir_get_bytes(
+                f"{coord_namespace()}/fleet/hb/")
+        except Exception:
+            # a failed read is OUR outage, not peer silence: aging
+            # peers on zero evidence would condemn the whole healthy
+            # fleet (DEAD is terminal) after one coordinator blip
+            # longer than dead_after_s — no evidence, no verdict change
+            with self._lock:
+                return {r: self._states.get(r, RankState.HEALTHY)
+                        for r in wv.members}
+        for key, raw in pairs:
+            try:
+                r = int(key.rsplit("/", 1)[-1])
+                beats[r] = json.loads(bytes(raw).decode())
+            except (ValueError, json.JSONDecodeError):
+                continue
+        newly_dead = []
+        events = []
+        gauge_updates = []
+        with self._lock:
+            for r in wv.members:
+                old = self._states.get(r, RankState.HEALTHY)
+                seen = self._seen.get(r)
+                b = beats.get(r)
+                if b is not None:
+                    if seen is None or b["seq"] > seen[0]:
+                        prog_local = (now if seen is None
+                                      or b.get("progress", 0) > seen[1]
+                                      else seen[4])
+                        seen = (b["seq"], b.get("progress", 0),
+                                seen[2] if seen else now, now, prog_local)
+                    elif b.get("progress", 0) > seen[1]:
+                        seen = (seen[0], b.get("progress", 0), seen[2],
+                                seen[3], now)
+                    self._seen[r] = seen
+                elif seen is None:
+                    # no beat yet: grace-period from first observation
+                    seen = (0, 0, now, now, now)
+                    self._seen[r] = seen
+                age = now - seen[3]
+                new = self._classify(old, age, now - seen[4])
+                if new is not old:
+                    self._states[r] = new
+                    self.transitions.append((r, old, new, age))
+                    events.append((r, old, new, age))
+                    if new is RankState.DEAD:
+                        newly_dead.append(r)
+                elif r not in self._states:
+                    self._states[r] = new
+                gauge_updates.append((r, self._states[r], age))
+            states = {r: self._states[r] for r in wv.members}
+        # telemetry strictly OUTSIDE the monitor lock: is_dead() sits
+        # on every blocked collective's abort path, and the registry
+        # takes its own lock (a contended scrape must not freeze the
+        # dead-verdict machinery) — same discipline as _record/on_dead
+        for upd in gauge_updates:
+            self._set_gauges(*upd)
+        for evt in events:
+            self._record(*evt)
+        if newly_dead and self.on_dead is not None:
+            try:
+                self.on_dead(sorted(newly_dead))
+            except Exception:
+                pass
+        return states
+
+    def _classify(self, old, age, progress_age):
+        if old is RankState.DEAD:
+            return old
+        if age > self._config.dead_after_s and old is RankState.SUSPECT:
+            return RankState.DEAD
+        if age > self._config.suspect_after_s:
+            return RankState.SUSPECT
+        pt = self._config.progress_timeout_s
+        if pt is not None and progress_age > pt:
+            return RankState.SUSPECT
+        return RankState.HEALTHY
+
+    def states(self):
+        with self._lock:
+            return dict(self._states)
+
+    def dead_ranks(self):
+        with self._lock:
+            return sorted(r for r, s in self._states.items()
+                          if s is RankState.DEAD)
+
+    def is_dead(self, rank):
+        with self._lock:
+            return self._states.get(rank) is RankState.DEAD
+
+    # ---- telemetry ----
+    def _set_gauges(self, rank, state, age):
+        try:
+            from paddle_tpu import observability as obs
+            reg = obs.registry()
+            reg.gauge("fleet_rank_state", labels={"rank": str(rank)},
+                      help="fleet watchdog verdict per rank "
+                           "(0=HEALTHY 1=SUSPECT 2=DEAD)"
+                      ).set(int(state))
+            reg.gauge("fleet_last_heartbeat_age_s",
+                      labels={"rank": str(rank)},
+                      help="seconds since this rank's heartbeat seq "
+                           "last advanced").set(round(max(0.0, age), 3))
+        except Exception:
+            pass
+
+    def _record(self, rank, old, new, age):
+        try:
+            from paddle_tpu import observability as obs
+            with obs.span("resilience.fleet.transition", rank=rank,
+                          from_state=old.name, to_state=new.name,
+                          age_s=round(age, 3)):
+                pass
+            obs.registry().counter(
+                "fleet_rank_transitions_total",
+                labels={"to": new.name},
+                help="fleet watchdog state transitions").inc()
+        except Exception:
+            pass
+
+    # ---- optional thread ----
+    def start(self):
+        if self._thread is None and self._client is not None:
+            self._stop.clear()       # restartable after stop()
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name="paddle_tpu-fleet-watchdog")
+            self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(self._poll_interval):
+            try:
+                self.poll()
+            except Exception:
+                # the watchdog must outlive transient coordinator
+                # errors; a persistently failing poll shows up as
+                # frozen gauges, not a dead thread
+                pass
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+# ------------------------------------------- distributed checkpointing
+# one source of truth for the manifest filename: the single-process
+# checkpointer's module (whose read/write helpers this class reuses)
+from paddle_tpu.resilience.checkpoint import _MANIFEST  # noqa: E402
+
+_FLEET_FORMAT = "fleet-1"
+
+
+class DistributedCheckpointer:
+    """Sharded, quorum-committed, reshardable checkpoints.
+
+    ``save(step, sharded=..., replicated=...)`` is collective:
+
+    1. every fleet rank writes its OWN shard
+       (``step-<step>/shard-<rank>-of-<size>.pkl``) through
+       ``framework.io.write_atomic`` (the ``io.save`` fault site — torn
+       shards are injectable and detectable) and publishes the shard's
+       sha256 through the coordination KV;
+    2. fleet rank 0 gathers every digest (timeout-bounded — a dead
+       rank fails the save with :class:`CollectiveTimeout` instead of
+       wedging it), then commits the quorum MANIFEST entry recording
+       step, world size, mesh spec, and one ``{rank, file, bytes,
+       sha256}`` row per shard — the manifest can under-promise but
+       never over-promise (PR 6 invariant);
+    3. every other rank blocks (timeout-bounded) on the commit marker,
+       so a returned ``save()`` means globally durable.
+
+    ``sharded`` leaves are arrays whose axis 0 is the dp axis: shard r
+    holds its slice.  ``load(world_size=W)`` re-stacks every verified
+    shard along axis 0 and re-splits into W equal parts — resuming at a
+    SHRUNK (or grown) world size after a reconfigure.  ``replicated``
+    state (identical on every rank — params, optimizer moments) is
+    stored once, in rank 0's shard.  An entry restores only if EVERY
+    shard verifies; a torn shard fails the whole entry and ``load()``
+    falls back to the previous one (recorded recovery, last-good
+    contract).
+    """
+
+    def __init__(self, directory, keep=3, client=None, world=None,
+                 timeout_s=None, mesh_spec=None):
+        if keep < 1:
+            raise ValueError("keep must be >= 1")
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.keep = int(keep)
+        self._client = client if client is not None else _client()
+        self._world = world            # None -> fleet.world() per call
+        self._timeout_s = timeout_s
+        self.mesh_spec = mesh_spec
+        self._lock = threading.Lock()
+        # per-instance save round: SPMD call order is identical on
+        # every rank (the _COORD_ROUND assumption), so the same round
+        # id names the same collective save fleet-wide — and versions
+        # the digest/commit keys, so re-saving the SAME step can never
+        # race against a previous save's leftover markers
+        self._save_round = 0
+
+    def _wv(self):
+        return self._world if self._world is not None else world()
+
+    def _timeout(self):
+        return (self._timeout_s if self._timeout_s is not None
+                else get_config().collective_timeout_s)
+
+    # ------------------------------------------------------------ save
+    def _shard_file(self, step, rank, size):
+        return os.path.join(f"step-{int(step):08d}",
+                            f"shard-{rank:05d}-of-{size:05d}.pkl")
+
+    def save(self, step, sharded=None, replicated=None):
+        """Collective checkpoint at `step`; returns the manifest path
+        once the quorum entry is durably committed fleet-wide."""
+        from paddle_tpu import observability as obs
+        from paddle_tpu.framework import io as fio
+        wv = self._wv()
+        t0 = time.perf_counter()
+        payload = {"rank": wv.rank, "world_size": wv.size,
+                   "sharded": fio._to_saveable(sharded)}
+        if wv.rank == 0:
+            payload["replicated"] = fio._to_saveable(replicated)
+        data = pickle.dumps(payload, protocol=4)
+        rel = self._shard_file(step, wv.rank, wv.size)
+        path = os.path.join(self.directory, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fio.write_atomic(path, data)
+        from paddle_tpu.resilience.checkpoint import digest_bytes
+        entry = {"rank": wv.rank, "file": rel, "bytes": len(data),
+                 "sha256": digest_bytes(data)}
+        if wv.size == 1:
+            self._commit(step, wv, [entry])
+        elif self._client is None:
+            raise RuntimeError(
+                "distributed save at world size > 1 needs a "
+                "coordination client (jax.distributed or a shared "
+                "LocalKVClient) — without one, each rank would race "
+                "its own single-shard manifest commit")
+        else:
+            self._save_round += 1
+            base = (f"{coord_namespace()}/fleet/ckpt/"
+                    f"r{self._save_round}/{int(step)}")
+            kv_set_bytes(self._client, f"{base}/{wv.rank}",
+                         json.dumps(entry).encode())
+            if wv.rank == 0:
+                shards = [entry]
+                # ONE shared deadline across the whole gather (several
+                # dead peers must not stack per-peer budgets) and the
+                # watchdog's DEAD verdict aborts a doomed wait in
+                # seconds — the _coord_get/finalize discipline
+                mon = get_monitor()
+                gather_deadline = time.monotonic() + self._timeout()
+                for peer in range(1, wv.size):
+                    g = wv.members[peer]
+                    raw = kv_get_bytes(
+                        self._client, f"{base}/{peer}",
+                        max(0.001,
+                            gather_deadline - time.monotonic()),
+                        site="fleet.kv_get", missing_rank=g,
+                        abort_if=(None if mon is None
+                                  else lambda g=g: mon.is_dead(g)))
+                    shards.append(json.loads(raw.decode()))
+                # every peer has published a round-r digest, which it
+                # only does AFTER finishing round r-1 (it read r-1's
+                # commit marker) — so round r-1's keys are provably
+                # consumed and reaping them bounds coordinator growth
+                # to one round per checkpointer
+                if self._save_round > 1:
+                    try:
+                        self._client.key_value_delete(
+                            f"{coord_namespace()}/fleet/ckpt/"
+                            f"r{self._save_round - 1}")
+                    except Exception:
+                        pass
+                self._commit(step, wv, shards)
+                kv_set_bytes(self._client, f"{base}/committed", b"ok")
+            else:
+                kv_get_bytes(
+                    self._client, f"{base}/committed",
+                    self._timeout(), site="fleet.kv_get",
+                    missing_rank=wv.members[0])
+        obs.registry().counter(
+            "fleet_checkpoint_saves_total",
+            help="distributed checkpoint save() calls").inc()
+        with obs.span("resilience.fleet.ckpt.save", step=int(step),
+                      rank=wv.rank, world_size=wv.size,
+                      bytes=len(data),
+                      save_ms=round((time.perf_counter() - t0) * 1e3,
+                                    3)):
+            pass
+        return os.path.join(self.directory, _MANIFEST)
+
+    def _commit(self, step, wv, shards):
+        """Rank 0 only: quorum manifest entry + retention, one atomic
+        rewrite (the PR 6 ``_commit`` shape — prune folded into the
+        same write, payload dirs deleted after)."""
+        from paddle_tpu.resilience.checkpoint import (read_manifest,
+                                                      write_manifest)
+        with self._lock:
+            manifest = read_manifest(self.directory,
+                                     fmt=_FLEET_FORMAT)
+            ckpts = [c for c in manifest.get("checkpoints", ())
+                     if c["step"] != int(step)]
+            ckpts.append({
+                "step": int(step),
+                "world_size": wv.size,
+                "members": list(wv.members),
+                "generation": wv.generation,
+                "mesh": self.mesh_spec,
+                "shards": sorted(shards, key=lambda s: s["rank"]),
+                "time_utc": time.strftime("%Y-%m-%d %H:%M:%S UTC",
+                                          time.gmtime()),
+            })
+            ckpts.sort(key=lambda c: c["step"])
+            drop, ckpts = ckpts[:-self.keep], ckpts[-self.keep:]
+            write_manifest(self.directory,
+                           {"format": _FLEET_FORMAT,
+                            "checkpoints": ckpts})
+            for c in drop:
+                d = os.path.join(self.directory,
+                                 f"step-{int(c['step']):08d}")
+                for s in c.get("shards", ()):
+                    try:
+                        os.remove(os.path.join(self.directory,
+                                               s["file"]))
+                    except OSError:
+                        pass
+                try:
+                    os.rmdir(d)
+                except OSError:
+                    pass
+
+    # ------------------------------------------------------------ load
+    def steps(self):
+        from paddle_tpu.resilience.checkpoint import read_manifest
+        man = read_manifest(self.directory, fmt=_FLEET_FORMAT)
+        return [c["step"] for c in man["checkpoints"]]
+
+    def _verify_entry(self, entry):
+        """All-or-nothing: the shard list must cover every rank of the
+        recorded world size and every shard must exist with its
+        manifested digest; returns {rank: payload_bytes} or None (an
+        incomplete entry — e.g. corrupted manifest debris — is
+        unverified, feeding the last-good fallback, never a crash)."""
+        from paddle_tpu.resilience.checkpoint import digest_bytes
+        shards = entry.get("shards")
+        world_size = entry.get("world_size")
+        if not isinstance(shards, list) or world_size is None:
+            # not a fleet entry at all (e.g. a single-process format-1
+            # manifest sharing the directory): unverified, not a crash
+            return None
+        out = {}
+        try:
+            if sorted(s["rank"] for s in shards) != \
+                    list(range(world_size)):
+                return None
+            for s in shards:
+                path = os.path.join(self.directory, s["file"])
+                try:
+                    with open(path, "rb") as f:
+                        data = f.read()
+                except OSError:
+                    return None
+                if (len(data) != s["bytes"]
+                        or digest_bytes(data) != s["sha256"]):
+                    return None
+                out[s["rank"]] = data
+        except (KeyError, TypeError):
+            # truncated-but-valid-JSON debris (shard rows missing
+            # fields): exactly the torn state the last-good fallback
+            # exists for — unverified, never a crash out of load()
+            return None
+        return out
+
+    def load(self, step=None, world_size=None, rank=None,
+             strict=False):
+        """Restore the newest fully-verified entry (or exactly `step`)
+        resharded for ``world_size`` (default: the current fleet
+        world).  Returns ``(step, {"sharded": ..., "replicated": ...,
+        "world_size": saved_ws})`` or None.  A torn shard fails its
+        whole entry and falls back to the previous one, recorded as a
+        recovery.
+
+        Cost note: the all-or-nothing quorum contract makes every rank
+        read (and digest-verify) every shard of the entry it restores —
+        W-fold read amplification on the recovery path.  Acceptable at
+        current state sizes; a future per-shard leaf-metadata sidecar
+        could keep verification whole-entry while unpickling only the
+        shards whose dp rows the new rank actually needs."""
+        from paddle_tpu import observability as obs
+        from paddle_tpu.resilience.checkpoint import read_manifest
+        wv = self._wv()
+        world_size = int(world_size) if world_size is not None \
+            else wv.size
+        rank = int(rank) if rank is not None else wv.rank
+        if not 0 <= rank < world_size:
+            raise ValueError(f"rank {rank} outside world {world_size}")
+        entries = read_manifest(self.directory,
+                                fmt=_FLEET_FORMAT)["checkpoints"]
+        if step is not None:
+            entries = [c for c in entries if c["step"] == int(step)]
+        skipped = 0
+        for entry in reversed(entries):
+            blobs = self._verify_entry(entry)
+            if blobs is None:
+                skipped += 1
+                obs.registry().counter(
+                    "fleet_checkpoint_corrupt_total",
+                    help="distributed checkpoint entries that failed "
+                         "shard digest verification").inc()
+                continue
+            if skipped:
+                faultinject.note_recovery(
+                    "io.save", "torn_write",
+                    fallback_step=entry["step"], skipped=skipped,
+                    distributed=True)
+            payloads = {r: pickle.loads(b) for r, b in blobs.items()}
+            sharded = self._reshard(entry, payloads, world_size, rank)
+            state = {"sharded": sharded,
+                     "replicated": payloads[0].get("replicated"),
+                     "world_size": entry["world_size"]}
+            with obs.span("resilience.fleet.ckpt.load",
+                          step=entry["step"], skipped=skipped,
+                          saved_world=entry["world_size"],
+                          world_size=world_size):
+                return entry["step"], state
+        if strict and entries:
+            from paddle_tpu.resilience.checkpoint import \
+                CheckpointCorruption
+            raise CheckpointCorruption(
+                f"all {len(entries)} distributed manifest entries "
+                f"under {self.directory} failed verification")
+        return None
+
+    @staticmethod
+    def _reshard(entry, payloads, world_size, rank):
+        """Re-split the dp axis: stack every saved shard's leaves along
+        axis 0 (saved rank order), then slice the new rank's equal
+        chunk.  Leaf structure must match across shards (same save()
+        call produced them)."""
+        import jax
+        import numpy as np
+        shards = [payloads[r]["sharded"]
+                  for r in range(entry["world_size"])]
+        if shards[0] is None:
+            return None
+
+        def merge(*leaves):
+            if not all(isinstance(v, np.ndarray) for v in leaves):
+                if len(set(map(repr, leaves))) == 1:
+                    return leaves[0]     # identical non-array leaf
+                raise TypeError(
+                    "sharded checkpoint leaves must be arrays (dp axis "
+                    f"0); got {[type(v).__name__ for v in leaves]}")
+            total = np.concatenate(leaves, axis=0)
+            if total.shape[0] % world_size:
+                raise ValueError(
+                    f"cannot reshard axis-0 length {total.shape[0]} "
+                    f"into {world_size} equal parts")
+            per = total.shape[0] // world_size
+            return total[rank * per:(rank + 1) * per]
+
+        return jax.tree_util.tree_map(merge, *shards)
+
+
+# --------------------------------------------- elastic reconfiguration
+def reconfigure(dead_ranks, client=None, config=None, world_view=None,
+                install=True, reap=None):
+    """Re-form the fleet without the DEAD ranks: bump the generation,
+    re-rendezvous the survivors under the fresh key namespace
+    (timeout-bounded join barrier — a survivor that fails to appear
+    raises :class:`CollectiveTimeout` naming it), reap the previous
+    generation's keys, and install the shrunk :class:`WorldView` (new
+    contiguous fleet ranks = survivor order).  Returns the new view;
+    the caller then reloads the last-good distributed checkpoint at
+    the new world size and resumes.
+
+    ``world_view``/``install=False`` support rank-per-thread tests and
+    the bench lane, where a process-global world would be shared.
+    ``reap`` (default: same as ``install``) controls the old-generation
+    key sweep — with ``install=False`` the process-global namespace is
+    STILL the old generation (shared publishers/monitors/in-flight
+    saves keep using it), so only the caller who owns the global world
+    may safely delete it."""
+    from paddle_tpu import observability as obs
+    config = config or get_config()
+    client = client if client is not None else _client()
+    old = world_view if world_view is not None else world()
+    dead = {int(r) for r in dead_ranks}
+    if old.global_rank in dead:
+        raise ValueError(
+            f"this rank ({old.global_rank}) is in the dead set {dead}")
+    survivors = [m for m in old.members if m not in dead]
+    if not set(dead) & set(old.members):
+        raise ValueError(f"dead ranks {sorted(dead)} not in world "
+                         f"{old.members}")
+    t0 = time.perf_counter()
+    new = WorldView(survivors, old.global_rank,
+                    generation=old.generation + 1,
+                    launch_id=old.launch_id)
+    ns = new.namespace
+    if client is not None and new.size > 1:
+        # each survivor's join marker carries its PROPOSED member list:
+        # divergent watchdog verdicts (rank A holds {2,3} dead, rank B
+        # only {3}) would otherwise let two different worlds install at
+        # the same generation and silently desynchronize every later
+        # collective — a loud mismatch error here converts split-brain
+        # into a restartable failure
+        proposal = json.dumps(list(new.members)).encode()
+        kv_set_bytes(client, f"{ns}/fleet/join/{old.global_rank}",
+                     proposal)
+        # one shared join deadline (not per-survivor — deaths DURING
+        # the reconfigure must not stack budgets), with the watchdog's
+        # DEAD verdict aborting a doomed wait early
+        mon = get_monitor()
+        join_deadline = time.monotonic() + config.rendezvous_timeout_s
+        for peer in survivors:
+            if peer == old.global_rank:
+                continue
+            raw = kv_get_bytes(client, f"{ns}/fleet/join/{peer}",
+                               max(0.001,
+                                   join_deadline - time.monotonic()),
+                               site="fleet.kv_get", missing_rank=peer,
+                               abort_if=(None if mon is None
+                                         else (lambda p=peer:
+                                               mon.is_dead(p))),
+                               config=config)
+            theirs = json.loads(raw.decode())
+            if tuple(theirs) != new.members:
+                raise RuntimeError(
+                    f"fleet reconfigure split-brain: rank "
+                    f"{old.global_rank} proposes members "
+                    f"{list(new.members)} but rank {peer} proposes "
+                    f"{theirs} (divergent DEAD verdicts) — refusing "
+                    f"to install generation {new.generation}; "
+                    f"restart the job")
+    if install:
+        _set_world(new)
+        # fresh namespace -> fresh round counters for the eager
+        # coordination collectives
+        from paddle_tpu.distributed import collective
+        collective.reset_coord_rounds()
+    reap = install if reap is None else reap
+    if reap and client is not None and new.rank == 0:
+        try:
+            client.key_value_delete(old.namespace)
+        except Exception:
+            pass
+    elapsed_ms = round((time.perf_counter() - t0) * 1e3, 3)
+    try:
+        reg = obs.registry()
+        reg.gauge("fleet_world_size",
+                  help="current fleet world size").set(new.size)
+        reg.gauge("fleet_generation",
+                  help="fleet reconfiguration generation").set(
+                      new.generation)
+        reg.counter("fleet_reconfigures_total",
+                    help="elastic fleet reconfigurations").inc()
+        with obs.span("resilience.fleet.reconfigure",
+                      dead=sorted(dead), world_size=new.size,
+                      generation=new.generation,
+                      reconfigure_ms=elapsed_ms):
+            pass
+    except Exception:
+        pass
+    return new
+
+
+def _reset_for_tests():
+    """Test hook: drop installed world/publisher/monitor/launch id."""
+    global _world, _publisher, _monitor
+    with _world_lock:
+        _world = None
+        _publisher = None
+        _monitor = None
+        _launch_id[0] = None
+        _finalized[0] = False

@@ -1,0 +1,172 @@
+"""Worker script for the fleet-grade fault-tolerance acceptance proof
+(tests/test_distributed_multiprocess.py::test_fleet_sigkill_reconfigure_resume).
+
+Launched through ``python -m paddle_tpu.distributed.launch`` as 3 (or,
+in baseline mode, 2) OS processes.  Each rank runs a tiny closed-form
+linear-regression training loop whose ONLY cross-rank traffic is one
+eager ``dist.all_reduce`` (AVG over [loss, grad]) per step — i.e. the
+coordination-service collective path the fleet layer bounds.
+
+chaos mode (3 ranks):
+  - every rank starts a HeartbeatPublisher + FleetMonitor and installs
+    them (the monitor's DEAD verdict aborts blocked collective gets);
+  - a quorum DistributedCheckpointer.save fires after ``ckpt_step``
+    (replicated: weights; sharded: a per-rank marker array exercising
+    reshard-on-shrink);
+  - a FaultPlan SIGKILLs rank ``kill_rank`` at the top of step
+    ``kill_step`` (site ``fleet.rank_kill`` — a real dead host);
+  - survivors catch ``CollectiveTimeout`` naming the dead rank, wait
+    for the watchdog's DEAD verdict, ``fleet.reconfigure`` to world
+    size 2, reload the step-``ckpt_step`` checkpoint resharded to the
+    new world, and re-run steps ``ckpt_step+1 .. total_steps`` —
+    recording the resumed loss trajectory.
+
+baseline mode (2 ranks): load the SAME checkpoint directory (written
+by the chaos phase at world size 3) at world size 2 and run the same
+steps fault-free.  The parent asserts resumed == baseline exactly.
+
+Workers exit via ``os._exit`` — after a peer died, the jax client's
+shutdown barrier can never complete, and the test's contract is "no
+indefinite hang anywhere on the coordination path".
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+DIM = 4
+SHARD_ROWS = 4
+LR = 0.05
+
+
+def batch(step, rank):
+    """Deterministic per-(step, fleet-rank) batch — identical between
+    the post-reconfigure survivors and the fault-free baseline run."""
+    rng = np.random.RandomState(1000 + 17 * step + rank)
+    w_true = np.arange(1.0, DIM + 1.0, dtype=np.float64)
+    X = rng.randn(8, DIM)
+    y = X @ w_true
+    return X, y
+
+
+def train_step(dist, P, w, step, rank):
+    """One step: local loss+grad, ONE eager AVG all_reduce over the
+    concatenated [loss, grad] vector, SGD update.  Returns (loss, w)."""
+    X, y = batch(step, rank)
+    err = X @ w - y
+    loss = float(np.mean(err * err))
+    grad = (2.0 / X.shape[0]) * (X.T @ err)
+    vec = P.to_tensor(np.concatenate([[loss], grad]).astype(np.float64))
+    dist.all_reduce(vec, op=dist.ReduceOp.AVG)
+    out = np.asarray(vec.numpy())
+    return float(out[0]), w - LR * out[1:]
+
+
+def main():
+    out_dir, ckpt_dir, mode = sys.argv[1], sys.argv[2], sys.argv[3]
+    kill_rank = int(sys.argv[4])
+    kill_step = int(sys.argv[5])
+    ckpt_step = int(sys.argv[6])
+    total_steps = int(sys.argv[7])
+
+    import jax
+
+    import paddle_tpu as P  # noqa: F401  (installs shims)
+    from paddle_tpu import distributed as dist
+    from paddle_tpu.resilience import faultinject, fleet
+
+    grank = jax.process_index()
+    result = {"mode": mode, "global_rank": grank,
+              "launch_world": jax.process_count(), "detection": None,
+              "reconfigure_s": None, "reshard_ok": None,
+              "losses_resumed": []}
+
+    pub = fleet.install_publisher(fleet.HeartbeatPublisher().start())
+    mon = fleet.install_monitor(fleet.FleetMonitor().start())
+    ckpt = fleet.DistributedCheckpointer(ckpt_dir, keep=3)
+
+    if mode == "chaos":
+        injector = faultinject.FaultInjector(faultinject.FaultPlan(
+            [faultinject.FaultSpec("fleet.rank_kill", "rank_kill",
+                                   at=kill_step - 1)]
+            if grank == kill_rank else [], seed=grank,
+            name="fleet-sigkill"))
+        faultinject.install(injector)
+
+        w = np.zeros(DIM)
+        step = 1
+        while step <= total_steps:
+            faultinject.fire("fleet.rank_kill", step=step)
+            pub.beat()
+            try:
+                loss, w = train_step(dist, P, w, step, fleet.world().rank)
+            except fleet.CollectiveTimeout as exc:
+                # ---- detection ----
+                result["detection"] = exc.to_dict()
+                t0 = time.monotonic()
+                # settle until the watchdog verdict covers the missing
+                # rank (bounded — the exception may have fired on the
+                # deadline before the DEAD classification landed)
+                deadline = time.monotonic() + 30.0
+                while (exc.missing_rank not in mon.dead_ranks()
+                       and time.monotonic() < deadline):
+                    time.sleep(0.1)
+                dead = mon.dead_ranks() or [exc.missing_rank]
+                # ---- reconfigure ----
+                new_wv = fleet.reconfigure(dead)
+                result["reconfigure_s"] = round(
+                    time.monotonic() - t0, 3)
+                result["new_world"] = new_wv.to_dict()
+                # ---- reload last-good + resume ----
+                got = ckpt.load(step=ckpt_step)
+                assert got is not None, "no restorable quorum ckpt"
+                _, state = got
+                w = np.asarray(state["replicated"]["w"])
+                marker = np.asarray(state["sharded"]["marker"])
+                want = np.sort(np.concatenate(
+                    [np.full(SHARD_ROWS, m, np.int64)
+                     for m in range(3)]))
+                per = want.size // new_wv.size
+                mine = want[new_wv.rank * per:(new_wv.rank + 1) * per]
+                result["reshard_ok"] = bool(
+                    np.array_equal(marker, mine))
+                result["losses_resumed"] = []
+                step = ckpt_step + 1
+                continue
+            if step > ckpt_step:
+                result["losses_resumed"].append(loss)
+            if step == ckpt_step:
+                ckpt.save(step, sharded={
+                    "marker": np.full(SHARD_ROWS, grank, np.int64)},
+                    replicated={"w": w, "step": step})
+            step += 1
+        result["final_world"] = fleet.world().to_dict()
+    else:  # baseline: fault-free world-size-2 resume from the quorum ckpt
+        got = ckpt.load(step=ckpt_step, world_size=2, rank=grank)
+        assert got is not None, "baseline found no quorum ckpt"
+        _, state = got
+        w = np.asarray(state["replicated"]["w"])
+        for step in range(ckpt_step + 1, total_steps + 1):
+            pub.beat()
+            loss, w = train_step(dist, P, w, step, grank)
+            result["losses_resumed"].append(loss)
+        result["final_world"] = fleet.world().to_dict()
+
+    path = os.path.join(out_dir, f"{mode}-rank{grank}.json")
+    with open(path + ".tmp", "w") as fh:
+        json.dump(result, fh)
+    os.replace(path + ".tmp", path)
+    # check-out barrier: the coordinator host (global rank 0) must not
+    # exit — taking the KV service with it — while a peer is still
+    # writing results; then exit WITHOUT the jax shutdown barrier,
+    # which can never complete once a peer has died
+    fleet.finalize()
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

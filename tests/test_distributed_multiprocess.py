@@ -1,21 +1,34 @@
-"""Real multi-process CPU collectives: 2 OS processes bootstrapped by
-``paddle_tpu.distributed.launch`` + ``jax.distributed.initialize``.
+"""Real multi-process CPU collectives and fleet fault tolerance:
+OS processes bootstrapped by ``paddle_tpu.distributed.launch`` +
+``jax.distributed.initialize``.
 
 Everything else in the suite runs multi-"device" inside ONE process
-(the 8 virtual CPU devices conftest forces); this test is the proof
+(the 8 virtual CPU devices conftest forces); these tests are the proof
 that the launcher's coordinator bootstrap and the eager multi-host
 collective path work across genuine process boundaries (VERDICT item
-9): two children rendezvous over a local gRPC coordinator, see
-``process_count() == 2``, and an ``all_reduce`` returns the
-cross-process sum on both ranks.
+9): children rendezvous over a local gRPC coordinator, see the true
+``process_count()``, and ``all_reduce`` returns the cross-process sum
+on every rank.
 
-Kept deliberately small (1 CPU device per child, one tiny collective)
-so the wall cost is coordinator startup, not compute; a generous
-deadline absorbs slow CI boxes, and failure modes (port clash, wedged
+``test_fleet_sigkill_reconfigure_resume`` is the chaos acceptance
+proof for PR 14 (fleet-grade fault tolerance): one of 3 ranks is
+SIGKILLed mid-training, the survivors detect it within the configured
+timeout budget (no indefinite hang anywhere on the coordination path),
+reconfigure to world size 2, reload the quorum checkpoint, and the
+resumed loss trajectory is IDENTICAL to a fault-free world-size-2 run
+restored from the same checkpoint.  Measured ~10-15s wall for both
+phases, inside the whole chaos gate's 480s wall budget
+(tools/lint_all.py `_GATE_TIMEOUT_S`, which also covers
+test_resilience.py + test_fleet.py).
+
+Kept deliberately small (1 CPU device per child, tiny collectives)
+so the wall cost is coordinator startup, not compute; generous
+deadlines absorb slow CI boxes, and failure modes (port clash, wedged
 rendezvous) surface as missing result files with captured child logs.
 """
 import json
 import os
+import signal
 import socket
 import subprocess
 import sys
@@ -25,7 +38,9 @@ import pytest
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 WORKER = os.path.join(HERE, "_multiprocess_worker.py")
+FLEET_WORKER = os.path.join(HERE, "_fleet_worker.py")
 DEADLINE_S = 120.0
+FLEET_DEADLINE_S = 150.0
 
 
 def _free_port():
@@ -34,21 +49,27 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def _spawn(rank, port, out_dir):
+def _child_env(extra=None):
     env = dict(os.environ)
     # fresh processes: pin the CPU backend explicitly (conftest's env
     # is inherited but make the contract local), ONE device per process
-    # so the two-process world is unmistakably cross-process
+    # so the multi-process world is unmistakably cross-process
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
     env.pop("PADDLE_MASTER", None)
     env.pop("PADDLE_NNODES", None)
     env.pop("PADDLE_TRAINER_ID", None)
+    env.pop("PADDLE_LAUNCH_ID", None)
+    env.update(extra or {})
+    return env
+
+
+def _spawn(rank, port, out_dir):
     return subprocess.Popen(
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
          "--master", f"127.0.0.1:{port}", "--nnodes", "2",
          "--rank", str(rank), WORKER, out_dir],
-        cwd=os.path.dirname(HERE), env=env,
+        cwd=os.path.dirname(HERE), env=_child_env(),
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
 
 
@@ -93,3 +114,136 @@ def test_two_process_all_reduce_via_launch(tmp_path):
         assert res["ranks_seen"] == [0, 1], res
         assert res["broadcast"] == 101.0, res    # rank 1's value
     assert {results[0]["rank"], results[1]["rank"]} == {0, 1}
+
+
+# ---------------------------------------------------------------------------
+# Fleet fault tolerance: SIGKILL -> detect -> reconfigure -> resume
+# ---------------------------------------------------------------------------
+
+# tight-but-realistic budgets: heartbeat every 0.4s, SUSPECT at 1.2s,
+# DEAD at 2.4s, collective deadline 10s — detection is expected at
+# ~2.5-4s via the DEAD-verdict abort, always under the 10s hard budget
+FLEET_ENV = {
+    "PTPU_FLEET_TIMEOUT_S": "10",
+    "PTPU_FLEET_KV_SLICE_S": "0.25",
+    "PTPU_FLEET_HB_INTERVAL_S": "0.4",
+    "PTPU_FLEET_RENDEZVOUS_TIMEOUT_S": "20",
+}
+KILL_RANK, KILL_STEP, CKPT_STEP, TOTAL_STEPS = 2, 8, 5, 12
+
+
+def _spawn_fleet(rank, port, nnodes, out_dir, ckpt_dir, mode,
+                 launch_id):
+    env = _child_env({**FLEET_ENV, "PADDLE_LAUNCH_ID": launch_id})
+    return subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--master", f"127.0.0.1:{port}", "--nnodes", str(nnodes),
+         "--rank", str(rank), FLEET_WORKER, out_dir, ckpt_dir, mode,
+         str(KILL_RANK), str(KILL_STEP), str(CKPT_STEP),
+         str(TOTAL_STEPS)],
+        cwd=os.path.dirname(HERE), env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+
+
+def _collect(procs, deadline_s, expect_killed=()):
+    """Wait for every child under ONE deadline; any overrun is an
+    indefinite-hang failure (the thing the fleet layer forbids)."""
+    outputs, codes = {}, {}
+    deadline = time.monotonic() + deadline_s
+    for rank, p in procs.items():
+        remaining = max(1.0, deadline - time.monotonic())
+        try:
+            out, _ = p.communicate(timeout=remaining)
+        except subprocess.TimeoutExpired:
+            for q in procs.values():
+                if q.poll() is None:
+                    q.kill()
+            out, _ = p.communicate()
+            pytest.fail(
+                f"rank {rank} still running after {deadline_s}s — a "
+                f"coordination-path hang the fleet layer must prevent"
+                f"\n--- child log ---\n{out[-2000:]}")
+        outputs[rank], codes[rank] = out, p.returncode
+    for rank, p in procs.items():
+        if rank in expect_killed:
+            assert codes[rank] == -signal.SIGKILL, (
+                f"rank {rank} should have died by SIGKILL, rc="
+                f"{codes[rank]}\n{outputs[rank][-2000:]}")
+        else:
+            assert codes[rank] == 0, (
+                f"rank {rank} rc={codes[rank]}\n--- child log ---\n"
+                f"{outputs[rank][-2000:]}")
+    return outputs
+
+
+@pytest.mark.chaos
+def test_fleet_sigkill_reconfigure_resume(tmp_path):
+    out_dir, ckpt_dir = tmp_path / "out", tmp_path / "ckpt"
+    out_dir.mkdir()
+
+    # ---- phase A: 3 ranks, rank 2 SIGKILLed at step 8 ----
+    port = _free_port()
+    procs = {r: _spawn_fleet(r, port, 3, str(out_dir), str(ckpt_dir),
+                             "chaos", "fleetA")
+             for r in range(3)}
+    try:
+        outputs = _collect(procs, FLEET_DEADLINE_S,
+                           expect_killed={KILL_RANK})
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+
+    chaos = {}
+    for r in (0, 1):
+        path = out_dir / f"chaos-rank{r}.json"
+        assert path.exists(), (
+            f"survivor {r} wrote no result\n--- child log ---\n"
+            f"{outputs[r][-2000:]}")
+        chaos[r] = json.loads(path.read_text())
+    assert not (out_dir / f"chaos-rank{KILL_RANK}.json").exists()
+
+    budget = float(FLEET_ENV["PTPU_FLEET_TIMEOUT_S"])
+    for r, res in chaos.items():
+        det = res["detection"]
+        assert det is not None, f"survivor {r} never detected the kill"
+        assert det["missing_rank"] == KILL_RANK, det
+        # detection within the configured budget (+ one slice of slack)
+        assert det["waited_s"] <= budget + 1.0, det
+        assert det["verdict"] in ("dead-verdict", "deadline"), det
+        nw = res["new_world"]
+        assert nw["size"] == 2 and nw["members"] == [0, 1], nw
+        assert nw["generation"] == 1, nw
+        assert res["reshard_ok"] is True, res
+        assert res["final_world"]["size"] == 2, res
+        assert len(res["losses_resumed"]) == TOTAL_STEPS - CKPT_STEP
+    # the all_reduce'd trajectory is fleet-global: survivors agree
+    assert chaos[0]["losses_resumed"] == chaos[1]["losses_resumed"]
+
+    # ---- phase B: fault-free world-size-2 run from the SAME ckpt ----
+    port = _free_port()
+    procs = {r: _spawn_fleet(r, port, 2, str(out_dir), str(ckpt_dir),
+                             "baseline", "fleetB")
+             for r in range(2)}
+    try:
+        outputs = _collect(procs, FLEET_DEADLINE_S)
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+
+    base = {}
+    for r in (0, 1):
+        path = out_dir / f"baseline-rank{r}.json"
+        assert path.exists(), (
+            f"baseline rank {r} wrote no result\n--- child log ---\n"
+            f"{outputs[r][-2000:]}")
+        base[r] = json.loads(path.read_text())
+
+    # THE acceptance identity: survivors' resumed trajectory is exactly
+    # the fault-free world-size-2 trajectory from the same quorum
+    # checkpoint — elastic recovery loses nothing and invents nothing
+    assert base[0]["losses_resumed"] == base[1]["losses_resumed"]
+    assert chaos[0]["losses_resumed"] == base[0]["losses_resumed"], (
+        "resumed-after-SIGKILL trajectory diverged from the fault-free "
+        "world-size-2 trajectory")

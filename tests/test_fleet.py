@@ -1,0 +1,985 @@
+"""paddle_tpu.resilience.fleet — timeout-bounded coordination, rank
+heartbeats + fleet watchdog, sharded distributed checkpoints, and
+elastic reconfigure (PR 14).
+
+Single-process tests: multi-rank scenarios run as rank-per-thread
+worlds over :class:`fleet.LocalKVClient` (same blocking semantics as
+the jax.distributed coordination-service client).  The REAL
+multi-process SIGKILL acceptance proof lives in
+tests/test_distributed_multiprocess.py::test_fleet_sigkill_reconfigure_resume.
+
+The `chaos`-marked tests here run the full detect → reconfigure →
+reload → resume ladder under the racelint LockOrderTracer (armed by
+conftest), so the threaded fleet machinery doubles as a lock-order
+stress run.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu import observability as obs
+from paddle_tpu import resilience as R
+from paddle_tpu.resilience import faultinject, fleet
+
+pytestmark = pytest.mark.resilience
+
+
+@pytest.fixture(autouse=True)
+def _fleet_reset():
+    fleet._reset_for_tests()
+    yield
+    fleet._reset_for_tests()
+
+
+def _cfg(**kw):
+    kw.setdefault("collective_timeout_s", 0.5)
+    kw.setdefault("kv_slice_s", 0.05)
+    kw.setdefault("heartbeat_interval_s", 0.05)
+    kw.setdefault("rendezvous_timeout_s", 1.0)
+    return fleet.FleetConfig(**kw)
+
+
+# ---------------------------------------------------------- LocalKV
+class TestLocalKVClient:
+    @pytest.mark.smoke
+    def test_blocking_semantics(self):
+        kv = fleet.LocalKVClient()
+        kv.key_value_set_bytes("a/x", b"hello")
+        assert kv.blocking_key_value_get_bytes("a/x", 10) == b"hello"
+        with pytest.raises(Exception):
+            kv.blocking_key_value_get_bytes("a/missing", 30)
+        # a late set unblocks a waiting get
+        t = threading.Timer(0.05,
+                            lambda: kv.key_value_set_bytes("a/y", b"vv"))
+        t.start()
+        assert kv.blocking_key_value_get_bytes("a/y", 2000) == b"vv"
+        t.join()
+
+    def test_dir_get_overwrite_and_prefix_delete(self):
+        kv = fleet.LocalKVClient()
+        kv.key_value_set("ns/hb/0", "1")
+        kv.key_value_set("ns/hb/1", "2")
+        with pytest.raises(ValueError):
+            kv.key_value_set("ns/hb/0", "x")          # no overwrite
+        kv.key_value_set("ns/hb/0", "3", allow_overwrite=True)
+        assert kv.key_value_dir_get("ns/hb/") == [("ns/hb/0", "3"),
+                                                  ("ns/hb/1", "2")]
+        kv.key_value_delete("ns")                     # directory reap
+        assert kv.key_value_dir_get("ns/") == []
+
+
+# ------------------------------------------------- timeout-bounded get
+class TestKvGetBytes:
+    @pytest.mark.smoke
+    def test_deadline_raises_machine_readable_timeout(self):
+        kv = fleet.LocalKVClient()
+        t0 = time.monotonic()
+        with pytest.raises(fleet.CollectiveTimeout) as ei:
+            fleet.kv_get_bytes(kv, "w/never", 0.3, missing_rank=2,
+                               config=_cfg())
+        waited = time.monotonic() - t0
+        assert 0.25 <= waited < 2.0          # bounded, never hangs
+        d = ei.value.to_dict()
+        assert d["missing_rank"] == 2
+        assert d["verdict"] == "deadline"
+        assert d["timeout_s"] == 0.3
+        assert d["site"] == "fleet.kv_get"
+        # the underlying client error is chained, not swallowed — a
+        # dead coordinator must not masquerade as an absent key
+        assert isinstance(ei.value.__cause__, TimeoutError)
+
+    def test_late_value_is_returned(self):
+        kv = fleet.LocalKVClient()
+        t = threading.Timer(
+            0.1, lambda: kv.key_value_set_bytes("w/late", b"ok!"))
+        t.start()
+        got = fleet.kv_get_bytes(kv, "w/late", 5.0, config=_cfg())
+        assert got == b"ok!"
+        t.join()
+
+    def test_dead_verdict_aborts_before_deadline(self):
+        kv = fleet.LocalKVClient()
+        t0 = time.monotonic()
+        with pytest.raises(fleet.CollectiveTimeout) as ei:
+            fleet.kv_get_bytes(kv, "w/never", 30.0, missing_rank=1,
+                               abort_if=lambda: True, config=_cfg())
+        assert time.monotonic() - t0 < 1.0   # way under the 30s budget
+        assert ei.value.verdict == "dead-verdict"
+        assert ei.value.missing_rank == 1
+
+    def test_dead_verdict_still_returns_published_data(self):
+        """Data a peer published BEFORE dying must be returned — a
+        durable shard digest or complete allgather round is not lost to
+        a spurious dead-verdict timeout."""
+        kv = fleet.LocalKVClient()
+        kv.key_value_set_bytes("w/posthumous", b"durable")
+        got = fleet.kv_get_bytes(kv, "w/posthumous", 5.0,
+                                 missing_rank=1,
+                                 abort_if=lambda: True, config=_cfg())
+        assert got == b"durable"
+
+    def test_one_byte_payload_is_padded(self):
+        # jaxlib's blocking get segfaults on 1-byte stored values; the
+        # choke point pads, and the pad is visible to byte-level readers
+        kv = fleet.LocalKVClient()
+        fleet.kv_set_bytes(kv, "w/flag", b"k")
+        assert kv.blocking_key_value_get_bytes("w/flag", 10) == b"k\x00"
+
+    def test_fault_site_flagged(self):
+        kv = fleet.LocalKVClient()
+        kv.key_value_set_bytes("w/x", b"ok")
+        plan = R.FaultPlan([R.FaultSpec("fleet.kv_get", "exception",
+                                        at=1)])
+        with R.FaultInjector(plan) as inj:
+            assert fleet.kv_get_bytes(kv, "w/x", 1.0,
+                                      config=_cfg()) == b"ok"
+            with pytest.raises(R.WorkerFault):
+                fleet.kv_get_bytes(kv, "w/x", 1.0, config=_cfg())
+        assert [(s, o) for s, _, o in inj.injected] == \
+            [("fleet.kv_get", 1)]
+
+    def test_fault_site_clean(self):
+        kv = fleet.LocalKVClient()
+        kv.key_value_set_bytes("w/x", b"ok")
+        plan = R.FaultPlan([R.FaultSpec("fleet.kv_get", "exception",
+                                        at=99)])
+        with R.FaultInjector(plan) as inj:
+            for _ in range(3):
+                assert fleet.kv_get_bytes(kv, "w/x", 1.0,
+                                          config=_cfg()) == b"ok"
+        assert inj.injected == []
+        assert inj.occurrences("fleet.kv_get") == 3
+
+
+# ------------------------------------------------------- heartbeats
+def _hb_key(rank):
+    return f"{fleet.coord_namespace()}/fleet/hb/{rank}"
+
+
+class TestHeartbeatPublisher:
+    @pytest.mark.smoke
+    def test_publish_sequence_and_progress(self):
+        kv = fleet.LocalKVClient()
+        pub = fleet.HeartbeatPublisher(client=kv, rank=3,
+                                       interval_s=10.0)
+        assert pub.publish_once()
+        pub.beat()
+        pub.beat()
+        assert pub.publish_once()
+        payload = json.loads(
+            kv.blocking_key_value_get_bytes(_hb_key(3), 10).decode())
+        assert payload["seq"] == 2
+        assert payload["progress"] == 2
+        assert pub.missed_beats == 0
+
+    def test_heartbeat_fault_skips_beat_but_survives(self):
+        kv = fleet.LocalKVClient()
+        pub = fleet.HeartbeatPublisher(client=kv, rank=0,
+                                       interval_s=10.0)
+        plan = R.FaultPlan([R.FaultSpec("fleet.heartbeat", "exception",
+                                        at=1)])
+        with R.FaultInjector(plan) as inj:
+            assert pub.publish_once() is True
+            assert pub.publish_once() is False     # injected: skipped
+            assert pub.publish_once() is True      # publisher survives
+        assert pub.missed_beats == 1
+        assert pub.seq == 2
+        assert len(inj.injected) == 1
+
+    def test_heartbeat_fault_clean(self):
+        kv = fleet.LocalKVClient()
+        pub = fleet.HeartbeatPublisher(client=kv, rank=0,
+                                       interval_s=10.0)
+        plan = R.FaultPlan([R.FaultSpec("fleet.heartbeat", "exception",
+                                        at=50)])
+        with R.FaultInjector(plan) as inj:
+            for _ in range(4):
+                assert pub.publish_once()
+        assert inj.injected == []
+        assert pub.missed_beats == 0
+
+    def test_thread_publishes_and_stops(self):
+        kv = fleet.LocalKVClient()
+        pub = fleet.HeartbeatPublisher(client=kv, rank=7,
+                                       interval_s=0.02).start()
+        deadline = time.monotonic() + 5.0
+        while pub.seq < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        pub.stop()
+        assert pub.seq >= 3
+        assert pub._thread is None
+
+    def test_beat_does_not_flood_publish_rate(self):
+        """beat() records progress but must NOT wake the publisher —
+        per-step beats would turn the publish rate into the
+        training-step rate against the single gRPC coordinator."""
+        kv = fleet.LocalKVClient()
+        pub = fleet.HeartbeatPublisher(client=kv, rank=0,
+                                       interval_s=30.0).start()
+        deadline = time.monotonic() + 5.0
+        while pub.seq < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        for _ in range(50):
+            pub.beat()
+        time.sleep(0.1)
+        assert pub.seq == 1            # still one interval beat
+        assert pub.progress == 50
+        pub.stop()
+
+    def test_stop_then_start_resumes_beats(self):
+        """A stopped publisher must be restartable — a start() that
+        spawns an instantly-exiting thread would silently stop beating
+        and get the rank declared DEAD."""
+        kv = fleet.LocalKVClient()
+        pub = fleet.HeartbeatPublisher(client=kv, rank=0,
+                                       interval_s=0.02).start()
+        deadline = time.monotonic() + 5.0
+        while pub.seq < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        pub.stop()
+        at_stop = pub.seq
+        pub.start()
+        deadline = time.monotonic() + 5.0
+        while pub.seq < at_stop + 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        pub.stop()
+        assert pub.seq >= at_stop + 2
+
+    def test_notify_progress_feeds_installed_publisher(self):
+        kv = fleet.LocalKVClient()
+        pub = fleet.install_publisher(
+            fleet.HeartbeatPublisher(client=kv, rank=0,
+                                     interval_s=10.0))
+        from paddle_tpu.distributed import elastic
+        for _ in range(5):
+            elastic.notify_progress()
+        assert pub.progress == 5
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def _mon(kv, clock, members=(0, 1, 2), me=0, **cfg_kw):
+    cfg = _cfg(heartbeat_interval_s=1.0, suspect_after_s=3.0,
+               dead_after_s=6.0, **cfg_kw)
+    wv = fleet.WorldView(members, me)
+    return fleet.FleetMonitor(client=kv, config=cfg,
+                              world_fn=lambda: wv, time_fn=clock)
+
+
+def _beat(kv, rank, seq, progress=0):
+    fleet.kv_set_bytes(
+        kv, _hb_key(rank),
+        json.dumps({"seq": seq, "t": 0.0,
+                    "progress": progress}).encode())
+
+
+class TestFleetMonitor:
+    @pytest.mark.smoke
+    def test_healthy_suspect_dead_ladder(self):
+        kv = fleet.LocalKVClient()
+        clock = _FakeClock()
+        deaths = []
+        mon = _mon(kv, clock)
+        mon.on_dead = deaths.append
+        for r in (0, 1, 2):
+            _beat(kv, r, 1)
+        assert set(mon.poll().values()) == {fleet.RankState.HEALTHY}
+        # ranks 0/1 keep beating; rank 2 goes silent
+        clock.t += 4.0
+        _beat(kv, 0, 2)
+        _beat(kv, 1, 2)
+        states = mon.poll()
+        assert states[0] is fleet.RankState.HEALTHY
+        assert states[2] is fleet.RankState.SUSPECT
+        clock.t += 3.5              # age(2) = 7.5 > dead_after
+        _beat(kv, 0, 3)
+        _beat(kv, 1, 3)
+        states = mon.poll()
+        assert states[2] is fleet.RankState.DEAD
+        assert states[0] is fleet.RankState.HEALTHY
+        assert deaths == [[2]]
+        assert mon.dead_ranks() == [2]
+        assert mon.is_dead(2) and not mon.is_dead(0)
+        # DEAD is sticky: a late beat cannot resurrect the verdict
+        _beat(kv, 2, 99)
+        clock.t += 0.1
+        assert mon.poll()[2] is fleet.RankState.DEAD
+        # on_dead fired exactly once
+        assert deaths == [[2]]
+
+    def test_suspect_recovers_on_fresh_beat(self):
+        kv = fleet.LocalKVClient()
+        clock = _FakeClock()
+        mon = _mon(kv, clock)
+        for r in (0, 1, 2):
+            _beat(kv, r, 1)
+        mon.poll()
+        clock.t += 4.0
+        _beat(kv, 0, 2)
+        _beat(kv, 1, 2)
+        assert mon.poll()[2] is fleet.RankState.SUSPECT
+        _beat(kv, 2, 2)             # the straggler catches up
+        clock.t += 0.1
+        assert mon.poll()[2] is fleet.RankState.HEALTHY
+
+    def test_no_beat_yet_gets_grace_from_first_observation(self):
+        kv = fleet.LocalKVClient()
+        clock = _FakeClock()
+        mon = _mon(kv, clock)
+        assert set(mon.poll().values()) == {fleet.RankState.HEALTHY}
+        clock.t += 4.0              # grace expired, still nothing
+        assert mon.poll()[1] is fleet.RankState.SUSPECT
+
+    def test_progress_stall_is_suspect_not_dead(self):
+        kv = fleet.LocalKVClient()
+        clock = _FakeClock()
+        mon = _mon(kv, clock, progress_timeout_s=5.0)
+        _beat(kv, 0, 1, progress=1)
+        _beat(kv, 1, 1, progress=1)
+        _beat(kv, 2, 1, progress=1)
+        mon.poll()
+        # beats keep flowing but rank 2's progress counter is frozen
+        for step in range(2, 6):
+            clock.t += 2.0
+            for r in (0, 1, 2):
+                _beat(kv, r, step,
+                      progress=step if r != 2 else 1)
+            states = mon.poll()
+        assert states[2] is fleet.RankState.SUSPECT     # livelock
+        assert states[0] is fleet.RankState.HEALTHY
+        # progress resumes -> recovers
+        clock.t += 2.0
+        for r in (0, 1, 2):
+            _beat(kv, r, 7, progress=7)
+        assert mon.poll()[2] is fleet.RankState.HEALTHY
+
+    def test_kv_read_outage_does_not_age_peers(self):
+        """A failed dir read is the MONITOR's outage, not peer silence
+        — DEAD is terminal, so aging on zero evidence would condemn a
+        healthy fleet after one coordinator blip."""
+        kv = fleet.LocalKVClient()
+        clock = _FakeClock()
+        mon = _mon(kv, clock)
+        for r in (0, 1, 2):
+            _beat(kv, r, 1)
+        mon.poll()
+        # coordinator blip far longer than dead_after while beats
+        # actually keep flowing
+        real_dir_get = kv.key_value_dir_get_bytes
+        kv.key_value_dir_get_bytes = lambda p: (_ for _ in ()).throw(
+            RuntimeError("UNAVAILABLE"))
+        for _ in range(5):
+            clock.t += 4.0
+            states = mon.poll()
+        assert set(states.values()) == {fleet.RankState.HEALTHY}
+        # blip ends; fresh beats observed; still healthy
+        kv.key_value_dir_get_bytes = real_dir_get
+        for r in (0, 1, 2):
+            _beat(kv, r, 2)
+        clock.t += 0.1
+        assert set(mon.poll().values()) == {fleet.RankState.HEALTHY}
+
+    def test_gauges_exported_to_prometheus(self):
+        kv = fleet.LocalKVClient()
+        clock = _FakeClock()
+        mon = _mon(kv, clock)
+        for r in (0, 1, 2):
+            _beat(kv, r, 1)
+        mon.poll()
+        clock.t += 7.0
+        mon.poll()                   # everyone SUSPECT now
+        from paddle_tpu.observability.export import prometheus_text
+        text = prometheus_text()
+        assert 'fleet_rank_state{rank="2"}' in text
+        assert "fleet_last_heartbeat_age_s" in text
+
+    def test_watchdog_thread_start_stop(self):
+        kv = fleet.LocalKVClient()
+        mon = fleet.FleetMonitor(
+            client=kv, config=_cfg(heartbeat_interval_s=0.02,
+                                   suspect_after_s=5.0,
+                                   dead_after_s=10.0),
+            world_fn=lambda: fleet.WorldView([0], 0))
+        mon.start()
+        _beat(kv, 0, 1)
+        time.sleep(0.1)
+        mon.stop()
+        assert mon._thread is None
+        assert mon.states()[0] is fleet.RankState.HEALTHY
+
+
+# ------------------------------------- gradient-merge progress wiring
+class TestGradientMergeFleetProgress:
+    def test_k8_accumulate_window_feeds_progress(self):
+        """PR 6 made GradientMergeOptimizer.step beat the elastic
+        watchdog every microbatch; those beats must ALSO advance the
+        fleet heartbeat publisher's progress counter, so a k=8
+        accumulate window (7 of 8 steps never reach Optimizer.step)
+        cannot be misclassified SUSPECT by a progress-aware monitor."""
+        kv = fleet.LocalKVClient()
+        clock = _FakeClock()
+        pub = fleet.install_publisher(fleet.HeartbeatPublisher(
+            client=kv, rank=0, interval_s=10.0, time_fn=clock))
+        mon = _mon(kv, clock, members=(0,), me=0,
+                   progress_timeout_s=3.0)
+
+        P.seed(0)
+        model = P.nn.Linear(4, 2)
+        gm = P.optimizer.GradientMergeOptimizer(
+            P.optimizer.SGD(learning_rate=0.1,
+                            parameters=model.parameters()), k_steps=8)
+        x = P.to_tensor(np.random.randn(2, 4).astype(np.float32))
+        before = pub.progress
+        for _ in range(8):
+            gm.clear_grad()
+            loss = (model(x) ** 2).sum()
+            loss.backward()
+            gm.step()                      # accumulate path included
+            pub.publish_once()
+            clock.t += 2.0                 # slow microbatches
+            states = mon.poll()
+            assert states[0] is fleet.RankState.HEALTHY
+        assert pub.progress - before >= 8
+
+    def test_without_progress_beats_goes_suspect(self):
+        kv = fleet.LocalKVClient()
+        clock = _FakeClock()
+        pub = fleet.HeartbeatPublisher(client=kv, rank=0,
+                                       interval_s=10.0, time_fn=clock)
+        mon = _mon(kv, clock, members=(0,), me=0,
+                   progress_timeout_s=3.0)
+        for _ in range(4):
+            pub.publish_once()             # beats WITHOUT progress
+            clock.t += 2.0
+            states = mon.poll()
+        assert states[0] is fleet.RankState.SUSPECT
+
+
+# --------------------------------------- distributed checkpointing
+def _wv(members, me):
+    return fleet.WorldView(members, me)
+
+
+class TestDistributedCheckpointer:
+    @pytest.mark.smoke
+    def test_single_rank_roundtrip_and_manifest_schema(self, tmp_path):
+        ck = fleet.DistributedCheckpointer(
+            str(tmp_path), world=_wv([0], 0), mesh_spec={"dp": 1})
+        ck.save(5, sharded={"rows": np.arange(6.0).reshape(3, 2)},
+                replicated={"w": np.ones(4)})
+        man = json.load(open(tmp_path / "MANIFEST.json"))
+        assert man["format"] == "fleet-1"
+        (entry,) = man["checkpoints"]
+        assert entry["step"] == 5
+        assert entry["world_size"] == 1
+        assert entry["mesh"] == {"dp": 1}
+        (shard,) = entry["shards"]
+        assert shard["rank"] == 0 and shard["sha256"] and \
+            shard["bytes"] > 0
+        step, state = ck.load()
+        assert step == 5
+        np.testing.assert_array_equal(state["sharded"]["rows"],
+                                      np.arange(6.0).reshape(3, 2))
+        np.testing.assert_array_equal(state["replicated"]["w"],
+                                      np.ones(4))
+        assert state["world_size"] == 1
+
+    def _save_3rank(self, tmp_path, step=10, keep=3):
+        kv = fleet.LocalKVClient()
+        cks, errs = {}, []
+
+        def run(r):
+            try:
+                ck = fleet.DistributedCheckpointer(
+                    str(tmp_path), keep=keep, client=kv,
+                    world=_wv([0, 1, 2], r), timeout_s=10.0)
+                cks[r] = ck
+                ck.save(step,
+                        sharded={"rows": np.full((2, 2), r, np.int64)},
+                        replicated={"w": np.arange(3.0)} if r == 0
+                        else None)
+            except BaseException as e:       # surfaced by the test
+                errs.append((r, e))
+
+        ts = [threading.Thread(target=run, args=(r,)) for r in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert not errs, errs
+        return kv, cks
+
+    def test_quorum_save_and_reshard_on_shrink(self, tmp_path):
+        kv, cks = self._save_3rank(tmp_path)
+        man = json.load(open(tmp_path / "MANIFEST.json"))
+        (entry,) = man["checkpoints"]
+        assert entry["world_size"] == 3
+        assert [s["rank"] for s in entry["shards"]] == [0, 1, 2]
+        assert len({s["sha256"] for s in entry["shards"]}) == 3
+        # reshard 3 -> 2: rank 0 gets rows [0,0,1], rank 1 [1,2,2]
+        for new_rank, want in ((0, [0, 0, 1]), (1, [1, 2, 2])):
+            step, state = cks[0].load(world_size=2, rank=new_rank)
+            assert step == 10
+            got = state["sharded"]["rows"]
+            assert got.shape == (3, 2)
+            np.testing.assert_array_equal(got[:, 0], want)
+            np.testing.assert_array_equal(state["replicated"]["w"],
+                                          np.arange(3.0))
+            assert state["world_size"] == 3
+        # same world size back: identity per rank
+        _, state = cks[0].load(world_size=3, rank=2)
+        np.testing.assert_array_equal(state["sharded"]["rows"],
+                                      np.full((2, 2), 2))
+        # indivisible reshard is a loud error, not silent corruption
+        with pytest.raises(ValueError, match="reshard"):
+            cks[0].load(world_size=4)
+
+    def test_torn_shard_fails_whole_entry_falls_back(self, tmp_path):
+        kv, cks = self._save_3rank(tmp_path, step=10)
+        # second quorum save at step 20, then tear ONE shard of it
+        def run(r):
+            cks[r].save(20,
+                        sharded={"rows": np.full((2, 2), 10 + r,
+                                                 np.int64)},
+                        replicated={"w": np.arange(3.0) * 2} if r == 0
+                        else None)
+        ts = [threading.Thread(target=run, args=(r,)) for r in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        shard = tmp_path / "step-00000020" / "shard-00001-of-00003.pkl"
+        data = shard.read_bytes()
+        shard.write_bytes(data[:len(data) // 2])     # torn
+        step, state = cks[0].load(world_size=1, rank=0)
+        assert step == 10                            # last-good fallback
+        np.testing.assert_array_equal(state["replicated"]["w"],
+                                      np.arange(3.0))
+        # exact-step load of the torn entry yields nothing
+        assert cks[0].load(step=20) is None
+        with pytest.raises(R.CheckpointCorruption):
+            cks[0].load(step=20, strict=True)
+
+    def test_torn_write_fault_injection_single_rank(self, tmp_path):
+        ck = fleet.DistributedCheckpointer(str(tmp_path),
+                                           world=_wv([0], 0))
+        ck.save(1, replicated={"v": 1.0})
+        plan = R.FaultPlan([R.FaultSpec("io.save", "torn_write", at=0)])
+        with R.FaultInjector(plan) as inj:
+            ck.save(2, replicated={"v": 2.0})
+        assert len(inj.injected) == 1
+        step, state = ck.load()
+        assert step == 1 and state["replicated"]["v"] == 1.0
+
+    def test_retention_prunes_step_dirs(self, tmp_path):
+        ck = fleet.DistributedCheckpointer(str(tmp_path), keep=2,
+                                           world=_wv([0], 0))
+        for s in (1, 2, 3, 4):
+            ck.save(s, replicated={"s": s})
+        assert ck.steps() == [3, 4]
+        dirs = sorted(d for d in os.listdir(tmp_path)
+                      if d.startswith("step-"))
+        assert dirs == ["step-00000003", "step-00000004"]
+
+    def test_resave_same_step_uses_versioned_keys(self, tmp_path):
+        """Re-saving the SAME step must not race the previous save's
+        digest/commit markers: every collective save runs under its own
+        round-versioned key prefix."""
+        kv, cks = self._save_3rank(tmp_path, step=10)
+
+        def run(r):
+            cks[r].save(10, sharded={
+                "rows": np.full((2, 2), 100 + r, np.int64)},
+                replicated={"w": np.arange(3.0) * 5} if r == 0
+                else None)
+        ts = [threading.Thread(target=run, args=(r,)) for r in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        rounds = {k.split("/fleet/ckpt/")[1].split("/")[0]
+                  for k, _ in kv.key_value_dir_get("ptpu/local/g0/"
+                                                   "fleet/ckpt/")}
+        # round-versioned AND growth-bounded: r2's digest gather proved
+        # r1 fully consumed, so rank 0 reaped r1's keys
+        assert rounds == {"r2"}
+        man = json.load(open(tmp_path / "MANIFEST.json"))
+        entries = [c for c in man["checkpoints"] if c["step"] == 10]
+        assert len(entries) == 1                 # replaced, not dup'd
+        _, state = cks[0].load(step=10, world_size=3, rank=0)
+        np.testing.assert_array_equal(state["replicated"]["w"],
+                                      np.arange(3.0) * 5)
+        np.testing.assert_array_equal(state["sharded"]["rows"],
+                                      np.full((2, 2), 100))
+
+    def test_incomplete_entry_falls_back_not_crashes(self, tmp_path):
+        """A manifest entry whose shard list does not cover the
+        recorded world size is UNVERIFIED (last-good fallback), never a
+        KeyError inside reshard."""
+        kv, cks = self._save_3rank(tmp_path, step=10)
+        man = json.load(open(tmp_path / "MANIFEST.json"))
+        broken = dict(man["checkpoints"][0])
+        broken["step"] = 20
+        broken["shards"] = broken["shards"][:1]   # 1 shard, claims ws 3
+        man["checkpoints"].append(broken)
+        (tmp_path / "MANIFEST.json").write_text(json.dumps(man))
+        step, state = cks[0].load(world_size=1, rank=0)
+        assert step == 10
+        assert cks[0].load(step=20) is None
+
+    def test_foreign_format_manifest_is_unverified_not_a_crash(
+            self, tmp_path):
+        """A single-process format-1 manifest sharing the directory
+        (same MANIFEST.json filename and helpers) must read as
+        nothing-restorable, never a KeyError."""
+        R.Checkpointer(str(tmp_path)).save(7, {"v": 7.0})
+        ck = fleet.DistributedCheckpointer(str(tmp_path),
+                                           world=_wv([0], 0))
+        assert ck.load() is None
+
+    def test_malformed_shard_rows_fall_back_not_crash(self, tmp_path):
+        """Valid-JSON debris with shard rows missing fields is exactly
+        the torn state the last-good fallback exists for."""
+        kv, cks = self._save_3rank(tmp_path, step=10)
+        man = json.load(open(tmp_path / "MANIFEST.json"))
+        man["checkpoints"].append(
+            {"step": 20, "world_size": 3, "shards": [{}]})
+        (tmp_path / "MANIFEST.json").write_text(json.dumps(man))
+        step, _ = cks[0].load(world_size=1, rank=0)
+        assert step == 10
+
+    def test_multirank_save_without_client_is_an_error(self, tmp_path):
+        ck = fleet.DistributedCheckpointer(str(tmp_path),
+                                           world=_wv([0, 1], 0))
+        ck._client = None
+        with pytest.raises(RuntimeError, match="coordination client"):
+            ck.save(1, replicated={"v": 1.0})
+
+    def test_missing_peer_fails_save_with_timeout(self, tmp_path):
+        kv = fleet.LocalKVClient()
+        ck = fleet.DistributedCheckpointer(
+            str(tmp_path), client=kv, world=_wv([0, 1], 0),
+            timeout_s=0.3)
+        with pytest.raises(fleet.CollectiveTimeout) as ei:
+            ck.save(1, replicated={"v": 1.0})
+        assert ei.value.missing_rank == 1
+
+    def test_save_gather_shares_one_deadline(self, tmp_path):
+        """Several dead peers must not stack per-peer gather budgets on
+        rank 0's quorum save."""
+        kv = fleet.LocalKVClient()
+        ck = fleet.DistributedCheckpointer(
+            str(tmp_path), client=kv, world=_wv([0, 1, 2, 3], 0),
+            timeout_s=0.4)
+        t0 = time.monotonic()
+        with pytest.raises(fleet.CollectiveTimeout):
+            ck.save(1, replicated={"v": 1.0})
+        assert time.monotonic() - t0 < 1.5       # not 3 x 0.4 + slack
+
+
+# --------------------------------------------------- reconfigure
+class TestReconfigure:
+    def test_survivors_reform_and_reap_old_namespace(self):
+        kv = fleet.LocalKVClient()
+        # old-generation debris that the reconfigure must reap
+        kv.key_value_set_bytes("ptpu/local/g0/allgather/7/2", b"zz")
+        out, errs = {}, []
+
+        def run(gr):
+            try:
+                # reap=True is explicit here: with install=False the
+                # reap defaults OFF (the process-global namespace may
+                # still be the old generation); this test's threads do
+                # no further old-generation work, so the sweep is safe
+                out[gr] = fleet.reconfigure(
+                    [1], client=kv, config=_cfg(),
+                    world_view=_wv([0, 1, 2], gr), install=False,
+                    reap=True)
+            except BaseException as e:
+                errs.append((gr, e))
+
+        ts = [threading.Thread(target=run, args=(gr,))
+              for gr in (0, 2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=10)
+        assert not errs, errs
+        assert out[0].members == (0, 2) == out[2].members
+        assert out[0].rank == 0 and out[2].rank == 1
+        assert out[0].size == 2
+        assert out[0].generation == 1
+        assert out[0].namespace.endswith("/g1")
+        # old-generation keys reaped by the new rank 0
+        assert kv.key_value_dir_get("ptpu/local/g0/") == []
+        # join markers live under the NEW namespace
+        assert len(kv.key_value_dir_get("ptpu/local/g1/fleet/join/")) \
+            == 2
+
+    def test_missing_survivor_raises_named_timeout(self):
+        kv = fleet.LocalKVClient()
+        with pytest.raises(fleet.CollectiveTimeout) as ei:
+            fleet.reconfigure([1], client=kv,
+                              config=_cfg(rendezvous_timeout_s=0.3),
+                              world_view=_wv([0, 1, 2], 0),
+                              install=False)
+        assert ei.value.missing_rank == 2       # the absent survivor
+
+    def test_join_barrier_shares_one_deadline(self):
+        """Multiple missing survivors must not stack per-peer
+        rendezvous budgets."""
+        kv = fleet.LocalKVClient()
+        t0 = time.monotonic()
+        with pytest.raises(fleet.CollectiveTimeout):
+            fleet.reconfigure([1], client=kv,
+                              config=_cfg(rendezvous_timeout_s=0.4),
+                              world_view=_wv([0, 1, 2, 3, 4], 0),
+                              install=False)
+        assert time.monotonic() - t0 < 1.5       # not 3 x 0.4 + slack
+
+    def test_divergent_dead_sets_fail_loudly_not_split_brain(self):
+        """Survivors whose watchdogs reached DIFFERENT dead sets must
+        not install two different worlds at the same generation — the
+        join barrier compares proposed member lists and refuses."""
+        kv = fleet.LocalKVClient()
+        errs = {}
+
+        def run(gr, dead):
+            try:
+                fleet.reconfigure(dead, client=kv, config=_cfg(),
+                                  world_view=_wv([0, 1, 2, 3], gr),
+                                  install=False)
+                errs[gr] = None
+            except Exception as e:
+                errs[gr] = e
+
+        # rank 0 believes {2,3} died; ranks 1 and 2 believe only {3}
+        ts = [threading.Thread(target=run, args=args)
+              for args in ((0, [2, 3]), (1, [3]), (2, [3]))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=15)
+        assert all(isinstance(e, (RuntimeError, fleet.CollectiveTimeout))
+                   for e in errs.values()), errs
+        assert any("split-brain" in str(e) for e in errs.values()), errs
+
+    def test_finalize_shares_one_deadline_across_members(self):
+        """Many dead peers must not stack per-member budgets — rank 0's
+        atexit check-out waits ONE shared timeout, not (n-1) of them."""
+        kv = fleet.LocalKVClient()
+        fleet._set_world(fleet.WorldView([0, 1, 2, 3, 4], 0))
+        t0 = time.monotonic()
+        fleet.finalize(timeout_s=0.4, client=kv)   # 4 peers, all dead
+        assert time.monotonic() - t0 < 1.5         # not 4 x 0.4 + slack
+
+    def test_own_rank_dead_is_an_error(self):
+        with pytest.raises(ValueError):
+            fleet.reconfigure([0], client=fleet.LocalKVClient(),
+                              world_view=_wv([0, 1], 0), install=False)
+
+    @pytest.mark.chaos
+    def test_elastic_detect_reconfigure_resume_threads(self, tmp_path):
+        """The full single-process ladder under the LockOrderTracer
+        (conftest arms it for chaos tests): 3 rank-threads train with
+        heartbeats, rank 1's publisher dies, survivors reach a DEAD
+        verdict, reconfigure to world size 2, and reload the quorum
+        checkpoint resharded — every fleet lock participates."""
+        kv = fleet.LocalKVClient()
+        cfg = _cfg(heartbeat_interval_s=0.03, suspect_after_s=0.12,
+                   dead_after_s=0.25, rendezvous_timeout_s=5.0,
+                   collective_timeout_s=5.0)
+        results, errs = {}, []
+        barrier = threading.Barrier(3, timeout=20)
+
+        def run(gr):
+            try:
+                wv = _wv([0, 1, 2], gr)
+                pub = fleet.HeartbeatPublisher(
+                    client=kv, rank=gr,
+                    interval_s=cfg.heartbeat_interval_s).start()
+                ck = fleet.DistributedCheckpointer(
+                    str(tmp_path), client=kv, world=wv,
+                    timeout_s=5.0)
+                ck.save(3, sharded={
+                    "m": np.full((2,), gr, np.int64)},
+                    replicated={"w": np.arange(4.0)} if gr == 0
+                    else None)
+                barrier.wait()
+                if gr == 1:
+                    pub.stop()               # the dying rank
+                    return
+                mon = fleet.FleetMonitor(client=kv, config=cfg,
+                                         world_fn=lambda: wv)
+                deadline = time.monotonic() + 15.0
+                while 1 not in mon.dead_ranks():
+                    assert time.monotonic() < deadline, \
+                        "DEAD verdict never reached"
+                    mon.poll()
+                    time.sleep(0.02)
+                new_wv = fleet.reconfigure(
+                    mon.dead_ranks(), client=kv, config=cfg,
+                    world_view=wv, install=False)
+                step, state = ck.load(world_size=new_wv.size,
+                                      rank=new_wv.rank)
+                results[gr] = (new_wv, step, state)
+                pub.stop()
+            except BaseException as e:
+                errs.append((gr, e))
+
+        ts = [threading.Thread(target=run, args=(gr,))
+              for gr in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert not errs, errs
+        assert set(results) == {0, 2}
+        for gr, (new_wv, step, state) in results.items():
+            assert new_wv.members == (0, 2)
+            assert step == 3
+            np.testing.assert_array_equal(state["replicated"]["w"],
+                                          np.arange(4.0))
+            got = state["sharded"]["m"]      # [0,0,1,1,2,2] resplit
+            want = [0, 0, 1] if new_wv.rank == 0 else [1, 2, 2]
+            np.testing.assert_array_equal(got, want)
+
+
+# ------------------------------------------------ rank_kill fixture
+class TestRankKillFault:
+    def _run(self, at):
+        code = (
+            "import os\n"
+            "os.environ['JAX_PLATFORMS']='cpu'\n"
+            "from paddle_tpu.resilience import faultinject as FI\n"
+            "FI.install(FI.FaultInjector(FI.FaultPlan(["
+            "FI.FaultSpec('fleet.rank_kill', 'rank_kill', "
+            f"at={at})])))\n"
+            "for step in range(3):\n"
+            "    FI.fire('fleet.rank_kill', step=step)\n"
+            "    print('alive after step', step, flush=True)\n"
+            "print('completed', flush=True)\n"
+        )
+        return subprocess.run(
+            [sys.executable, "-c", code],
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+            capture_output=True, text=True, timeout=120)
+
+    def test_rank_kill_delivers_real_sigkill(self):
+        proc = self._run(at=1)
+        assert proc.returncode == -9, proc.stderr[-1000:]
+        assert "alive after step 0" in proc.stdout
+        assert "alive after step 1" not in proc.stdout
+        assert "completed" not in proc.stdout
+
+    def test_rank_kill_clean_when_occurrence_never_reached(self):
+        proc = self._run(at=99)
+        assert proc.returncode == 0, proc.stderr[-1000:]
+        assert "completed" in proc.stdout
+
+
+# -------------------------------------------- launch rendezvous retry
+class TestRendezvousRetry:
+    def test_fast_failures_are_config_errors_not_timeouts(
+            self, monkeypatch):
+        """A permanently misconfigured master fails every attempt in
+        ~1s — labeling that CollectiveTimeout would make supervisors
+        retry a job that can never form."""
+        from paddle_tpu.distributed import launch as L
+        calls = []
+
+        def fake_init(**kw):
+            calls.append(kw)
+            raise RuntimeError("DNS: no such host")
+
+        import jax
+        monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+        monkeypatch.setattr(jax.distributed, "shutdown", lambda: None)
+        monkeypatch.setenv("PTPU_RENDEZVOUS_ATTEMPTS", "3")
+        monkeypatch.setenv("PTPU_RENDEZVOUS_TIMEOUT_S", "30")
+        with pytest.raises(RuntimeError, match="configuration error"):
+            L._rendezvous("10.0.0.1:1234", 2, 1)
+        assert len(calls) == 3                    # bounded retry
+        assert calls[0]["initialization_timeout"] == 30
+
+    def test_slow_failures_raise_machine_readable_timeout(
+            self, monkeypatch):
+        from paddle_tpu.distributed import launch as L
+
+        def fake_init(**kw):
+            raise RuntimeError("coordinator never answered")
+
+        import jax
+        monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+        monkeypatch.setattr(jax.distributed, "shutdown", lambda: None)
+        monkeypatch.setenv("PTPU_RENDEZVOUS_ATTEMPTS", "3")
+        # the two backoff sleeps (>=0.75s total) dominate a 1s budget,
+        # so the exhaustion is timeout-shaped -> CollectiveTimeout
+        monkeypatch.setenv("PTPU_RENDEZVOUS_TIMEOUT_S", "1")
+        with pytest.raises(fleet.CollectiveTimeout) as ei:
+            L._rendezvous("10.0.0.1:1234", 2, 1)
+        assert ei.value.site == "launch.rendezvous"
+        assert ei.value.key == "10.0.0.1:1234"
+        assert ei.value.__cause__ is not None
+
+    def test_success_after_transient_failure(self, monkeypatch):
+        from paddle_tpu.distributed import launch as L
+        calls = []
+
+        def flaky_init(**kw):
+            calls.append(kw)
+            if len(calls) < 2:
+                raise RuntimeError("transient")
+
+        import jax
+        monkeypatch.setattr(jax.distributed, "initialize", flaky_init)
+        monkeypatch.setattr(jax.distributed, "shutdown", lambda: None)
+        L._rendezvous("10.0.0.1:1234", 2, 0)
+        assert len(calls) == 2
+
+
+# ---------------------------------------------------- world/namespace
+class TestWorldAndNamespace:
+    @pytest.mark.smoke
+    def test_world_view_contract(self):
+        wv = fleet.WorldView([0, 2, 5], 5, generation=2,
+                             launch_id="abc")
+        assert wv.rank == 2 and wv.size == 3
+        assert wv.namespace == "ptpu/abc/g2"
+        assert wv.to_dict()["members"] == [0, 2, 5]
+        with pytest.raises(ValueError):
+            fleet.WorldView([0, 1], 7)
+
+    def test_progress_timeout_env_knob(self, monkeypatch):
+        monkeypatch.setenv("PTPU_FLEET_PROGRESS_TIMEOUT_S", "2.5")
+        assert fleet.FleetConfig().progress_timeout_s == 2.5
+        monkeypatch.setenv("PTPU_FLEET_PROGRESS_TIMEOUT_S", "0")
+        assert fleet.FleetConfig().progress_timeout_s is None
+        monkeypatch.delenv("PTPU_FLEET_PROGRESS_TIMEOUT_S")
+        assert fleet.FleetConfig().progress_timeout_s is None
+
+    def test_default_world_is_single_process(self):
+        wv = fleet.world()
+        assert wv.size >= 1
+        assert wv.global_rank in wv.members
+
+    def test_collective_timeout_repr_names_rank(self):
+        e = fleet.CollectiveTimeout("fleet.kv_get", key="k",
+                                    missing_rank=3, waited_s=1.2,
+                                    timeout_s=5.0, namespace="ns")
+        assert "rank 3" in str(e)
+        assert e.to_dict()["verdict"] == "deadline"

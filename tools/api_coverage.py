@@ -83,7 +83,7 @@ def has_attr_path(obj, name):
 # declared public surface (__all__) is the contract; a name that stops
 # resolving is a regression exactly like a reference-parity gap.
 NATIVE_NAMESPACES = ("serving", "serving.router", "analysis",
-                     "observability", "quantization")
+                     "observability", "quantization", "resilience")
 
 
 def collect_native():
