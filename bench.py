@@ -53,6 +53,7 @@ NUMLINT_S = 150
 OBS_S = 150
 RESIL_S = 150
 FLEET_S = 150
+SENTINEL_S = 240
 PROFILE_S = 150
 REMAT_S = 150
 QUANT_S = 150
@@ -680,6 +681,136 @@ def worker_fleet():
     return 0
 
 
+def worker_sentinel():
+    """Training-sentinel lane: the detect → skip → rollback → resume
+    ladder on a tiny eager model under a deterministic nan_grad fault
+    plan, plus the in-trace probe's cost-model overhead on the
+    optimized gpt flagship (tools/perfgate.py ``sentinel`` target).
+
+    Reports (merged into every BENCH line):
+      sentinel_detect_steps       — steps from injection to the first
+                                    AnomalyDetected (contract: 1)
+      sentinel_skips              — zero-update steps the guard gated
+      sentinel_rollbacks          — checkpoint rollbacks triggered
+      sentinel_rollback_identity  — 1.0 iff the rolled-back-and-resumed
+                                    trajectory + final weights EXACTLY
+                                    match the fault-free run (asserted
+                                    before printing)
+      sentinel_overhead_pct       — guarded-vs-unguarded cost-model
+                                    bytes/step on the gpt target,
+                                    asserted < 2.0 before printing
+    """
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    _init_backend()   # honors PTPU_FORCE_CPU (always set for this lane)
+    t_start = time.time()
+
+    import paddle_tpu as P
+    import paddle_tpu.nn as nn
+    from paddle_tpu import resilience as R
+
+    CKPT_STEP, FAULT_STEP, TOTAL, SKIPS = 4, 7, 10, 2
+
+    def batch(step):
+        rng = np.random.default_rng(1000 + step)
+        X = rng.standard_normal((8, 6)).astype(np.float32)
+        y = rng.standard_normal((8, 3)).astype(np.float32)
+        return P.to_tensor(X), P.to_tensor(y)
+
+    def run(ckpt_dir, plan):
+        P.seed(0)
+        model = nn.Linear(6, 3)
+        opt = P.optimizer.AdamW(learning_rate=0.05,
+                                parameters=model.parameters(),
+                                guard=True)
+        ck = R.Checkpointer(ckpt_dir, keep=2)
+        # lr_cooldown 1.0: the identity contract is exact-match for a
+        # TRANSIENT fault (docs/resilience.md); a cooldown would
+        # deliberately change the resumed trajectory
+        sent = R.TrainingSentinel(checkpointer=ck, model=model,
+                                  optimizer=opt, skip_limit=SKIPS,
+                                  lr_cooldown=1.0)
+        inj = R.FaultInjector(plan) if plan is not None else None
+        if inj is not None:
+            R.faultinject.install(inj)
+        losses = {}
+        try:
+            step = 1
+            while step <= TOTAL:
+                X, y = batch(step)
+                opt.clear_grad()
+                loss = ((model(X) - y) ** 2).mean()
+                loss.backward()
+                opt.step()
+                act = sent.observe(step, loss=float(loss.numpy()),
+                                   summary=opt.guard_summary())
+                if act is R.SentinelAction.ROLLBACK:
+                    step = sent.resume_step
+                    continue
+                if act is R.SentinelAction.OK:
+                    losses[step] = float(loss.numpy())
+                    if step == CKPT_STEP:
+                        ck.save_train_state(step, model, opt)
+                        sent.note_checkpoint(step)
+                step += 1
+        finally:
+            if inj is not None:
+                R.faultinject.uninstall(inj)
+        w = np.asarray(model.weight._value).copy()
+        return losses, w, sent
+
+    tdir = tempfile.mkdtemp(prefix="ptpu_sentinel_bench_")
+    try:
+        clean_losses, clean_w, _ = run(os.path.join(tdir, "a"), None)
+        plan = R.FaultPlan([R.FaultSpec("optimizer.grads", "nan_grad",
+                                        at=FAULT_STEP - 1,
+                                        times=SKIPS)],
+                           seed=3, name="bench-sentinel")
+        fault_losses, fault_w, sent = run(os.path.join(tdir, "b"), plan)
+
+        assert sent.anomalies, "guard never detected the injected NaN"
+        detect_steps = sent.anomalies[0].step - FAULT_STEP + 1
+        assert detect_steps == 1, (
+            f"detection took {detect_steps} steps (contract: 1)")
+        assert sent.rollbacks == 1, sent.rollbacks
+        identical = (fault_losses == clean_losses
+                     and bool(np.array_equal(fault_w, clean_w)))
+        # identity is a correctness gate, not a metric: fail the lane
+        # loudly rather than print a lying number
+        assert identical, "rollback-resume diverged from fault-free run"
+    finally:
+        shutil.rmtree(tdir, ignore_errors=True)
+
+    # probe overhead on the flagship (deterministic cost model)
+    tools_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools")
+    sys.path.insert(0, tools_dir)
+    try:
+        import perfgate
+        overhead = perfgate.target_sentinel()
+    finally:
+        sys.path.remove(tools_dir)
+    pct = overhead["guard_bytes_overhead_pct"]
+    assert pct < 2.0, (
+        f"guard overhead {pct}% breaches the <2% detection-cost "
+        f"contract")
+
+    print(json.dumps({
+        "sentinel_detect_steps": detect_steps,
+        "sentinel_skips": sent.skips_total,
+        "sentinel_rollbacks": sent.rollbacks,
+        "sentinel_rollback_identity": 1.0,
+        "sentinel_overhead_pct": pct,
+        "sentinel_guard_bytes_per_step": overhead[
+            "guard_bytes_per_step"],
+        "sentinel_elapsed_s": round(time.time() - t_start, 2),
+    }), flush=True)
+    return 0
+
+
 def worker_shardlint():
     """Static-analysis lane: shardlint's cost audit of the flagship
     programs (GPT hybrid train step + serving prefill/decode).  Pure
@@ -1277,6 +1408,8 @@ def main():
         return worker_resilience()
     if "--worker-fleet" in sys.argv:
         return worker_fleet()
+    if "--worker-sentinel" in sys.argv:
+        return worker_sentinel()
     if "--probe" in sys.argv:
         return probe()
 
@@ -1292,6 +1425,7 @@ def main():
     obs_proc = _spawn("--worker-obs", force_cpu=True)
     resil_proc = _spawn("--worker-resilience", force_cpu=True)
     fleet_proc = _spawn("--worker-fleet", force_cpu=True)
+    sentinel_proc = _spawn("--worker-sentinel", force_cpu=True)
     prof_proc = _spawn("--worker-profile", force_cpu=True)
     remat_proc = _spawn("--worker-remat", force_cpu=True)
     router_proc = _spawn("--worker-router", force_cpu=True)
@@ -1356,6 +1490,15 @@ def main():
         # degrades only its own keys
         merged["fleet_error"] = str(fleet_err)
 
+    sentinel_res, sentinel_err, _ = _await_json(sentinel_proc,
+                                                SENTINEL_S)
+    if sentinel_res is not None:
+        merged.update(sentinel_res)
+    else:
+        # same rationale: the sentinel lane failing degrades only its
+        # own keys, never the measurement run's status
+        merged["sentinel_error"] = str(sentinel_err)
+
     prof_res, prof_err, _ = _await_json(prof_proc, PROFILE_S)
     if prof_res is not None:
         merged.update(prof_res)
@@ -1417,6 +1560,7 @@ def main():
         _adopt_lane("resilience_", "resilience_ckpt_write_ms",
                     resil_err)
         _adopt_lane("fleet_", "fleet_detection_ms", fleet_err)
+        _adopt_lane("sentinel_", "sentinel_detect_steps", sentinel_err)
         _adopt_lane("profile_", "profile_bytes_per_step", prof_err)
         _adopt_lane("remat_", "remat_bytes_saved_pct", remat_err)
         _adopt_lane("router_", "router_tokens_per_s", router_err)

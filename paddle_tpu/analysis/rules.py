@@ -81,6 +81,18 @@ RULES = {r.code: r for r in [
        "generators cannot be traced into one XLA program; convert_call "
        "skips them, so tensor control flow inside stays eager",
        "materialize the sequence into a list before the traced region"),
+    _R("TL005", "identity-test-of-branch-bound-name",
+       "identity test (`is` / `is not`) of {detail}, which is bound in "
+       "only one branch of a convertible `if`",
+       "a variable bound in only one branch of a tensor-converted `if` "
+       "merges to dy2static's poison sentinel; every ordinary read "
+       "raises NameError, but Python's `is` operator cannot be "
+       "intercepted — `maybe_bound is None` would silently evaluate "
+       "False and take the wrong path",
+       "bind the variable on every path (e.g. initialize it to None "
+       "before the `if`) when its identity is tested afterwards; if "
+       "the test is provably unreachable when unbound (a short-circuit "
+       "guard), waive with `# tracelint: disable=TL005` on its line"),
 
     # ---- TL1xx: host syncs & trace-time side effects ----
     _R("TL101", "host-sync-numpy",

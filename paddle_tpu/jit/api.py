@@ -121,8 +121,18 @@ class StaticFunction:
 
     def __init__(self, function, input_spec=None, build_strategy=None,
                  backend=None, donate_state=True, check=False, audit=False,
-                 amp_policy=None, remat=None):
+                 amp_policy=None, remat=None, guard=False):
         self._raw_function = function
+        # guard=True arms the training-sentinel loss probe
+        # (resilience/sentinel.py): every scalar float output leaf
+        # (the loss) gets its value + finite flag computed INSIDE the
+        # compiled program and returned as one tiny extra output — so
+        # detection adds zero lifetime compiles (same trace, same
+        # cache key) and the host reads a (n, 2) f32 array it was
+        # going to sync anyway.  The parsed probe lands on
+        # ``fn.last_guard`` and feeds the ambient TrainingSentinel.
+        self._guard = bool(guard)
+        self.last_guard = None
         # trace-scoped mixed-precision storage policy (amp/policy.py):
         # amp_policy="bf16" casts f32 activations to bf16 at Layer
         # boundaries (params stay f32 master weights) and enables the
@@ -218,7 +228,27 @@ class StaticFunction:
                 self._grad_idx = tuple(grad_idx)
                 self._grad_cleared = frozenset(_trace_state.cleared_uids)
                 arrays = [v for v, s in zip(out_vals, out_static) if s is _ARRAY]
-                return arrays, new_state, grad_vals
+                if not self._guard:
+                    return arrays, new_state, grad_vals
+                # sentinel probe: (value, isfinite) per scalar float
+                # output leaf, f32, computed in-trace — NL-clean (one
+                # scalar convert, no narrow reductions)
+                probes = []
+                for v, s in zip(out_vals, out_static):
+                    if s is not _ARRAY:
+                        continue
+                    shp = jnp.shape(v)
+                    if any(int(d) != 1 for d in shp):
+                        continue
+                    dt = getattr(v, "dtype", None)
+                    if dt is None or not jnp.issubdtype(dt, jnp.floating):
+                        continue
+                    val = jnp.reshape(v, ()).astype(jnp.float32)
+                    probes.append(jnp.stack(
+                        [val, jnp.isfinite(val).astype(jnp.float32)]))
+                guard_arr = (jnp.stack(probes) if probes
+                             else jnp.zeros((0, 2), jnp.float32))
+                return arrays, new_state, grad_vals, [guard_arr]
             finally:
                 _trace_state.active = False
                 snap.restore()
@@ -363,8 +393,13 @@ class StaticFunction:
                         (time.perf_counter() - t_trace0) * 1e3, 3))
             jitted = entry.jitted
             t_run0 = time.perf_counter()
-            out_arrays, new_state, grad_vals = jitted(state_vals,
-                                                      tensor_vals)
+            if self._guard:
+                (out_arrays, new_state, grad_vals,
+                 guard_out) = jitted(state_vals, tensor_vals)
+            else:
+                out_arrays, new_state, grad_vals = jitted(state_vals,
+                                                          tensor_vals)
+                guard_out = None
             if event is not None:
                 # first execution of a fresh entry: XLA compiles here
                 # (the lower() above only traced), so this wall time is
@@ -372,8 +407,32 @@ class StaticFunction:
                 event.compile_ms = round(
                     (time.perf_counter() - t_run0) * 1e3, 3)
             self._apply(entry, out_arrays, new_state, grad_vals)
+            if guard_out is not None:
+                self._note_guard(guard_out)
             return self._rewrap(entry, out_arrays)
         raise RuntimeError("to_static: state registry kept changing during trace")
+
+    def _note_guard(self, guard_out):
+        """Parse the in-trace probe outputs onto ``last_guard`` and
+        hand them to the ambient TrainingSentinel (informational —
+        the policy runs through explicit ``observe()`` calls)."""
+        import numpy as np
+        ga = np.asarray(guard_out[0], np.float64)
+        values = [float(x) for x in ga[:, 0]] if ga.size else []
+        finite = [bool(x >= 0.5) for x in ga[:, 1]] if ga.size else []
+        self.last_guard = {
+            "values": values,
+            "finite": finite,
+            "loss": values[0] if values else None,
+            "loss_finite": finite[0] if finite else True,
+        }
+        try:
+            from paddle_tpu.resilience import sentinel as _sentinel
+            s = _sentinel.current()
+            if s is not None:
+                s.note_probe(self.__name__, self.last_guard)
+        except Exception:
+            pass
 
     def _apply(self, entry, out_arrays, new_state, grad_vals):
         state_list, grad_idx = entry.state_list, entry.grad_idx
@@ -455,7 +514,7 @@ def _hashable(x):
 
 def to_static(function=None, input_spec=None, build_strategy=None,
               backend=None, check=False, audit=False, amp_policy=None,
-              remat=None, **kwargs):
+              remat=None, guard=False, **kwargs):
     """Decorator/wrapper: compile a dygraph function or Layer to one XLA program.
 
     Usage matches paddle.jit.to_static: bare decorator, decorator with
@@ -481,6 +540,14 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     ``remat="bf16"`` turns on the model's recompute units, the latter
     saving boundary activations in bf16.  Both are trace-scoped — see
     paddle_tpu/amp/policy.py and docs/performance_guide.md.
+
+    ``guard=True`` arms the training-sentinel loss probe: each scalar
+    float output's value + finite flag is computed inside the compiled
+    program (zero extra compiles — the probe is part of the one traced
+    program) and parsed onto ``fn.last_guard``.  Pair with
+    ``Optimizer(guard=True)`` for the gradient-side probe and the
+    in-trace zero-update skip — docs/resilience.md "Numerics
+    sentinel".
     """
     from paddle_tpu.nn.layer.layers import Layer
 
@@ -488,12 +555,13 @@ def to_static(function=None, input_spec=None, build_strategy=None,
         if isinstance(fn, Layer):
             static = StaticFunction(fn.forward, input_spec, check=check,
                                     audit=audit, amp_policy=amp_policy,
-                                    remat=remat)
+                                    remat=remat, guard=guard)
             fn.forward = static
             fn._static_forward = static
             return fn
         return StaticFunction(fn, input_spec, check=check, audit=audit,
-                              amp_policy=amp_policy, remat=remat)
+                              amp_policy=amp_policy, remat=remat,
+                              guard=guard)
 
     if function is not None:
         return wrap(function)

@@ -59,12 +59,15 @@ unavailable, or any construct outside the supported subset (e.g.
 left as plain Python — correct eagerly, and a tensor-valued condition
 there still raises the usual concretization error pointing here.
 
-Known dark corner: a variable bound in only ONE branch of a tensor-`if`
-merges to a poison sentinel — every ordinary read (arithmetic,
-comparison by value, bool, str/format, hash, call, index) raises
-NameError, but Python's `is` operator cannot be intercepted, so
-`maybe_bound is None` silently evaluates False instead of raising.
-Bind the variable on every path when its identity is tested.
+A variable bound in only ONE branch of a converted `if` merges to a
+poison sentinel whose every ordinary read (arithmetic, comparison by
+value, bool, str/format, hash, call, index) raises NameError — and the
+one read Python does not let the sentinel intercept, the `is` operator,
+is rejected at CONVERSION time instead: an identity test against a
+maybe-unbound name raises `TraceHazardError` (TL005) naming the
+variable, so `maybe_bound is None` can never silently evaluate False
+under a trace.  Bind the variable on every path when its identity is
+tested.
 """
 from __future__ import annotations
 
@@ -472,7 +475,13 @@ def transform_func(fn):
         _span_cm = None
     try:
         new = _do_transform(fn)
-    except Exception:
+    except Exception as e:
+        from paddle_tpu.analysis.rules import TraceHazardError
+        if isinstance(e, TraceHazardError):
+            # conversion-time rejections (TL005 identity-test hole)
+            # must surface to the user, not fall back to plain Python
+            # — the fallback is exactly the silent-wrong-branch hazard
+            raise
         _fail_cache.add(fn)
         return fn
     finally:
@@ -503,6 +512,9 @@ def _do_transform(fn):
     if not isinstance(fdef, (ast.FunctionDef,)):
         raise TypeError("not a plain def")
     fdef.decorator_list = []
+    _check_identity_tests(fdef, fn.__code__.co_filename,
+                          fn.__code__.co_firstlineno,
+                          src.splitlines())
 
     # pre-passes: normalize guard-clause early returns into the
     # both-branches-return form, then desugar break/continue into
@@ -569,6 +581,106 @@ def _do_transform(fn):
     new.__defaults__ = fn.__defaults__
     new.__kwdefaults__ = fn.__kwdefaults__
     return new
+
+
+def _same_scope_walk(stmts):
+    """ast.walk over a statement list that does NOT descend into nested
+    scopes (defs/lambdas/classes own their names)."""
+    stack = list(stmts)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Lambda)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _check_identity_tests(fdef, src_file, src_base, src_lines=()):
+    """Conversion-time rejection of the `is`-operator poison-sentinel
+    hole: a name bound in only ONE branch of an `if` (and nowhere
+    before it) merges to the UNDEF sentinel under conversion, and a
+    later identity test (`name is None`) is the one read the sentinel
+    cannot intercept — it would silently compare the sentinel object.
+    Detected here, on the ORIGINAL AST, as a named ``TraceHazardError``
+    (TL005) instead: the fix (bind on every path) is cheap and the
+    silent-wrong-branch failure is not.
+
+    Scope-approximation contract: a store anywhere EARLIER in source
+    order counts as "bound before" (mis-approximations err toward NOT
+    flagging), and a rebind between the `if` and the identity test
+    clears the hazard.  The check is deliberately conservative (it
+    cannot see that a short-circuit guard makes a particular read
+    safe), so a ``# tracelint: disable=TL005`` comment on the identity
+    test's line waives it — the same suppression spelling every other
+    TL rule honors."""
+    # the ONE suppression parser every analyzer shares — same
+    # lowercase/alias/skip-file semantics as file-level tracelint
+    from paddle_tpu.analysis.visitor import parse_suppressions
+    sup, skip_file = parse_suppressions("\n".join(src_lines))
+    if skip_file:
+        return
+
+    def suppressed(lineno):
+        codes = sup.get(lineno, ())
+        return "TL005" in codes or "ALL" in codes
+    a = fdef.args
+    params = {arg.arg for arg in (
+        a.posonlyargs + a.args + a.kwonlyargs
+        + ([a.vararg] if a.vararg else [])
+        + ([a.kwarg] if a.kwarg else []))}
+    stores = {}          # name -> sorted store linenos (same scope)
+    for n in _same_scope_walk(fdef.body):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+            stores.setdefault(n.id, []).append(n.lineno)
+        elif isinstance(n, ast.ExceptHandler) and n.name:
+            stores.setdefault(n.name, []).append(n.lineno)
+        elif isinstance(n, (ast.Import, ast.ImportFrom)):
+            for al in n.names:
+                stores.setdefault(
+                    (al.asname or al.name).split(".")[0],
+                    []).append(n.lineno)
+    compares = []        # (node, names, lineno)
+    for n in _same_scope_walk(fdef.body):
+        if isinstance(n, ast.Compare) and any(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in n.ops):
+            names = {x.id for x in ast.walk(n)
+                     if isinstance(x, ast.Name)
+                     and isinstance(x.ctx, ast.Load)}
+            if names:
+                compares.append((n, names))
+    if not compares:
+        return
+    for node in _same_scope_walk(fdef.body):
+        if not isinstance(node, ast.If):
+            continue
+        b = _collect_bound(node.body)
+        o = _collect_bound(node.orelse)
+        maybe = (b | o) - (b & o)
+        if not maybe:
+            continue
+        before = params | {nm for nm, lns in stores.items()
+                           if any(ln < node.lineno for ln in lns)}
+        maybe -= before
+        if not maybe:
+            continue
+        end = max((x.lineno for x in ast.walk(node)
+                   if hasattr(x, "lineno")), default=node.lineno)
+        for cmp_node, names in compares:
+            if cmp_node.lineno <= end:
+                continue     # inside (or before) the if itself
+            if suppressed(cmp_node.lineno):
+                continue
+            bad = sorted(
+                nm for nm in names & maybe
+                # a rebind between the if and the test clears it
+                if not any(end < ln < cmp_node.lineno
+                           for ln in stores.get(nm, ())))
+            if bad:
+                from paddle_tpu.analysis.rules import TraceHazardError
+                raise TraceHazardError(
+                    "TL005", src_file, src_base + cmp_node.lineno - 1,
+                    detail=f"`{bad[0]}`")
 
 
 def _function_bound_names(fdef):

@@ -59,20 +59,30 @@ def _pick_block_rows(rows, row_bytes):
 
 
 def _adam_kernel(sc_ref, p_ref, g_ref, m_ref, v_ref, po_ref, mo_ref,
-                 vo_ref, *, beta1, beta2, eps, weight_decay):
+                 vo_ref, *gq_ref, beta1, beta2, eps, weight_decay,
+                 guard=False):
     """One fused AdamW step for one row block.
 
     sc = [lr, 1-beta1^t, 1-beta2^t, decay_on] — the traced scalars.
     Matches the unfused loop exactly: decoupled decay first (AdamW),
-    then moment updates, bias correction by DIVISION, update, apply."""
+    then moment updates, bias correction by DIVISION, update, apply.
+
+    ``guard=True`` (the training-sentinel probe) additionally reduces
+    the block's gradient sum-of-squares in f32 — g is ALREADY in
+    registers, so the probe adds zero extra HBM traffic — writes it to
+    the per-block partials output, and GATES the block's commit on its
+    finiteness: a block whose gradients are non-finite writes back the
+    UNMODIFIED p/m/v (the zero-update skip), selected per step by data
+    so the compiled program never changes."""
     lr = sc_ref[0, 0]
     c1 = sc_ref[0, 1]
     c2 = sc_ref[0, 2]
     decay_on = sc_ref[0, 3]
-    p = p_ref[:].astype(jnp.float32)
+    p0 = p_ref[:].astype(jnp.float32)
     g = g_ref[:].astype(jnp.float32)
     m = m_ref[:].astype(jnp.float32)
     v = v_ref[:].astype(jnp.float32)
+    p = p0
     if weight_decay:
         # decoupled (AdamW) decay; decay_on gates it per-param
         # (apply_decay_param_fun) without a second kernel variant
@@ -82,13 +92,23 @@ def _adam_kernel(sc_ref, p_ref, g_ref, m_ref, v_ref, po_ref, mo_ref,
     mhat = new_m / c1
     vhat = new_v / c2
     upd = lr * mhat / (jnp.sqrt(vhat) + eps)
-    po_ref[:] = (p - upd).astype(po_ref.dtype)
+    new_p = p - upd
+    if guard:
+        gsq = jnp.sum(g * g)
+        good = jnp.isfinite(gsq)
+        # jnp.where, not multiply: NaN * 0 is NaN, select is clean
+        new_p = jnp.where(good, new_p, p0)
+        new_m = jnp.where(good, new_m, m)
+        new_v = jnp.where(good, new_v, v)
+        gq_ref[0][:] = jnp.full(gq_ref[0].shape, gsq, jnp.float32)
+    po_ref[:] = new_p.astype(po_ref.dtype)
     mo_ref[:] = new_m.astype(mo_ref.dtype)
     vo_ref[:] = new_v.astype(vo_ref.dtype)
 
 
 def fused_adam_update(p, g, m, v, lr, c1, c2, *, beta1, beta2, eps,
-                      weight_decay=0.0, decay_on=True, interpret=None):
+                      weight_decay=0.0, decay_on=True, guard=False,
+                      interpret=None):
     """Single-pass Adam/AdamW update of one rank-2 parameter.
 
     Returns ``(p', m', v')``.  ``lr``/``c1``/``c2`` are traced scalars
@@ -98,6 +118,15 @@ def fused_adam_update(p, g, m, v, lr, c1, c2, *, beta1, beta2, eps,
     and handles coupled decay in the gradient as before.  Moments keep
     their storage dtype (bf16 moments read/write half the bytes; math
     stays f32 in-kernel).
+
+    ``guard=True`` returns ``(p', m', v', partials)`` where
+    ``partials[i, 0]`` is row-block ``i``'s gradient sum-of-squares
+    (f32, reduced in-kernel — the sentinel probe's zero-extra-read
+    path) and each block's commit is gated on its own finiteness (the
+    zero-update skip; docs/resilience.md "Numerics sentinel" has the
+    region-granularity contract).  The partials rows are 128 lanes
+    wide (the block scalar broadcast) to stay a legal TPU tile; the
+    caller reads column 0.
     """
     if interpret is None:
         from paddle_tpu.ops.pallas import on_tpu
@@ -113,19 +142,26 @@ def fused_adam_update(p, g, m, v, lr, c1, c2, *, beta1, beta2, eps,
     ]).reshape(1, 4)
     kernel = functools.partial(_adam_kernel, beta1=float(beta1),
                                beta2=float(beta2), eps=float(eps),
-                               weight_decay=float(weight_decay))
+                               weight_decay=float(weight_decay),
+                               guard=bool(guard))
     blk = lambda i: (i, 0)          # noqa: E731 — row-block index map
+    out_specs = [_vmem_spec((br, cols), blk) for _ in range(3)]
+    out_shape = [
+        jax.ShapeDtypeStruct(p.shape, p.dtype),
+        jax.ShapeDtypeStruct(m.shape, m.dtype),
+        jax.ShapeDtypeStruct(v.shape, v.dtype),
+    ]
+    if guard:
+        out_specs.append(_vmem_spec((1, 128), blk))
+        out_shape.append(
+            jax.ShapeDtypeStruct((grid[0], 128), jnp.float32))
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[_vmem_spec((1, 4), lambda i: (0, 0))]
         + [_vmem_spec((br, cols), blk) for _ in range(4)],
-        out_specs=[_vmem_spec((br, cols), blk) for _ in range(3)],
-        out_shape=[
-            jax.ShapeDtypeStruct(p.shape, p.dtype),
-            jax.ShapeDtypeStruct(m.shape, m.dtype),
-            jax.ShapeDtypeStruct(v.shape, v.dtype),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         # in-place param/moment updates: the donated input buffers ARE
         # the outputs on TPU (no extra HBM copies)
         input_output_aliases={1: 0, 3: 1, 4: 2},

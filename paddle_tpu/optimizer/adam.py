@@ -10,8 +10,9 @@ class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-08, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, multi_precision=False,
-                 name=None, moment_dtype=None, fused=False):
-        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+                 name=None, moment_dtype=None, fused=False, guard=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, guard=guard)
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
@@ -38,20 +39,54 @@ class Adam(Optimizer):
         v = self._acc("moment2", p, dtype=mdt)
         b1p = self._acc("beta1_pow", p, init=1.0, shape=(), dtype=jnp.float32)
         b2p = self._acc("beta2_pow", p, init=1.0, shape=(), dtype=jnp.float32)
-        b1p._set_value(b1p._value * self._beta1)
-        b2p._set_value(b2p._value * self._beta2)
         if self._will_fuse(p):
             from paddle_tpu.ops.pallas.optim import fused_adam_update
             coeff, decay_on = self._fused_decay(p)
-            new_p, new_m, new_v = fused_adam_update(
-                p._value, g, m._value, v._value, lr,
-                1 - b1p._value, 1 - b2p._value,
-                beta1=self._beta1, beta2=self._beta2, eps=self._epsilon,
-                weight_decay=coeff, decay_on=decay_on)
+            # bias-correction powers advance only on a COMMITTED step:
+            # under guard their update is gated on this param's WHOLE-
+            # param finite verdict, so the kernel consumes the
+            # candidate corrections and the powers follow the commit.
+            # Partial-commit caveat (kernel gates per row-block): when
+            # only SOME blocks are bad, the good blocks commit with
+            # this step's corrections while the powers hold — a
+            # bounded one-decay correction offset on those blocks,
+            # always finite, and erased by the policy machine's
+            # rollback (the default remedy past skip_limit).  The
+            # overwhelmingly common anomaly (NaN loss => every grad
+            # NaN) gates every block and is an exact zero-update.
+            b1_new = b1p._value * self._beta1
+            b2_new = b2p._value * self._beta2
+            if self._guard:
+                new_p, new_m, new_v, parts = fused_adam_update(
+                    p._value, g, m._value, v._value, lr,
+                    1 - b1_new, 1 - b2_new,
+                    beta1=self._beta1, beta2=self._beta2,
+                    eps=self._epsilon, weight_decay=coeff,
+                    decay_on=decay_on, guard=True)
+                blocks = parts[:, 0]         # per-block grad sumsq
+                psum = jnp.sum(blocks)
+                good = jnp.isfinite(psum)
+                self._guard_parts.append(psum)
+                self._guard_bad.append(jnp.sum(
+                    1.0 - jnp.isfinite(blocks).astype(jnp.float32)))
+                self._guard_regions += int(blocks.shape[0])
+                b1p._set_value(jnp.where(good, b1_new, b1p._value))
+                b2p._set_value(jnp.where(good, b2_new, b2p._value))
+            else:
+                b1p._set_value(b1_new)
+                b2p._set_value(b2_new)
+                new_p, new_m, new_v = fused_adam_update(
+                    p._value, g, m._value, v._value, lr,
+                    1 - b1p._value, 1 - b2p._value,
+                    beta1=self._beta1, beta2=self._beta2,
+                    eps=self._epsilon, weight_decay=coeff,
+                    decay_on=decay_on)
             p._set_value(new_p)
             m._set_value(new_m)
             v._set_value(new_v)
             return
+        b1p._set_value(b1p._value * self._beta1)
+        b2p._set_value(b2p._value * self._beta2)
         g = g.astype(jnp.float32)
         new_m = self._beta1 * m._value.astype(jnp.float32) + (1 - self._beta1) * g
         new_v = self._beta2 * v._value.astype(jnp.float32) + (1 - self._beta2) * g * g
@@ -70,10 +105,10 @@ class AdamW(Adam):
                  epsilon=1e-08, parameters=None, weight_decay=0.01,
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
                  lazy_mode=False, multi_precision=False, name=None,
-                 moment_dtype=None, fused=False):
+                 moment_dtype=None, fused=False, guard=False):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          None, grad_clip, lazy_mode, multi_precision, name,
-                         moment_dtype, fused)
+                         moment_dtype, fused, guard)
         self._coeff = weight_decay if isinstance(weight_decay, float) else 0.01
         self._apply_decay_param_fun = apply_decay_param_fun
         self._lr_ratio = lr_ratio

@@ -18,7 +18,7 @@ from paddle_tpu.observability.profile import layer_scope
 
 class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
-                 grad_clip=None, name=None):
+                 grad_clip=None, name=None, guard=False):
         from paddle_tpu.optimizer.lr import LRScheduler
         self._parameter_list = list(parameters) if parameters is not None else None
         self._lr_scheduler = None
@@ -38,6 +38,18 @@ class Optimizer:
         # subclasses with a fused single-pass update kernel set this
         # (Adam/AdamW `fused=True`); the base loop never fuses
         self._fused = False
+        # guard=True arms the training-sentinel probe + skip gate
+        # (resilience/sentinel.py): every step computes the global
+        # gradient sum-of-squares IN-TRACE and commits a ZERO update
+        # for any parameter region whose gradients are non-finite —
+        # the GradScaler-shaped skip, but inside the one compiled
+        # program (works under to_static, where GradScaler's host-side
+        # found_inf bool cannot).  The per-step verdict lands in a
+        # registered (4,) f32 state tensor read via guard_summary().
+        self._guard = bool(guard)
+        self._guard_summary_t = None
+        self._guard_parts = []      # per-region traced sumsq scalars
+        self._guard_bad = []        # per-region traced 0/1 bad flags
 
     def _will_fuse(self, p):
         """True when this param's update will run the fused single-pass
@@ -141,6 +153,13 @@ class Optimizer:
         # recovery, `preempt` faults the drain path.  Under to_static
         # this fires at TRACE time only — chaos loops run eager.
         faultinject.fire("optimizer.step")
+        # chaos hook: `bitflip`/`nan_grad` faults corrupt one gradient
+        # element BEFORE the update (the SDC the sentinel's finite
+        # guard + digest vote must catch).  Eager-only like every
+        # occurrence-counted fault.
+        spec = faultinject.fire("optimizer.grads")
+        if spec is not None and spec.kind in ("bitflip", "nan_grad"):
+            self._inject_grad_fault(spec)
         # under to_static this span fires at TRACE time (the update math
         # is fused into the step program); in eager mode it times every
         # parameter update pass.  The named scope puts the update math's
@@ -152,6 +171,10 @@ class Optimizer:
             pg = self._params_grads()
             if self._grad_clip is not None:
                 pg = self._grad_clip(pg)
+            if self._guard:
+                self._guard_parts = []
+                self._guard_bad = []
+                self._guard_regions = 0
             for p, g in pg:
                 lr_mult = getattr(p, "optimize_attr", {}).get("learning_rate", 1.0) \
                     if hasattr(p, "optimize_attr") else 1.0
@@ -161,7 +184,134 @@ class Optimizer:
                     # here would pay a full extra grad read+write
                     gv = gv.astype(jnp.float32)
                 gv = self._apply_decay(p, gv)
-                self._update_param(p, gv, lr_mult)
+                if self._guard and not self._will_fuse(p):
+                    # fused params gate inside the kernel (Adam); the
+                    # generic wrapper covers every unfused update rule
+                    self._guarded_update(p, gv, lr_mult)
+                else:
+                    self._update_param(p, gv, lr_mult)
+            if self._guard:
+                self._commit_guard_summary()
+
+    # ---- sentinel guard (resilience/sentinel.py) ----
+    def _inject_grad_fault(self, spec):
+        """Apply a bitflip/nan_grad fault spec to the target gradient
+        (payload "param" names it; default: the first param with a
+        grad).  Deterministic via (plan seed, occurrence)."""
+        from paddle_tpu.resilience import faultinject
+        plan = faultinject.active_plan()
+        seed = plan.seed if plan is not None else 0
+        target = spec.payload.get("param")
+        for p, g in self._params_grads():
+            if target is not None and p.name != target:
+                continue
+            g._set_value(jnp.asarray(faultinject.corrupt_array(
+                spec, g._value, seed=seed)).astype(g._value.dtype))
+            return
+
+    def _summary_tensor(self):
+        if self._guard_summary_t is None:
+            t = Tensor(jnp.zeros((4,), jnp.float32),
+                       name="sentinel_summary")
+            t.persistable = True
+            t.stop_gradient = True
+            # lazy creation can happen inside a to_static trace
+            t.__dict__["_reinit"] = lambda: jnp.zeros((4,), jnp.float32)
+            register_state_tensor(t)
+            self._guard_summary_t = t
+        return self._guard_summary_t
+
+    def guard_summary(self):
+        """The last guarded step's probe as a
+        :class:`~paddle_tpu.resilience.sentinel.GuardSummary`
+        (None before the first guarded step) — the value
+        ``TrainingSentinel.observe(summary=...)`` consumes."""
+        if self._guard_summary_t is None:
+            return None
+        from paddle_tpu.resilience.sentinel import GuardSummary
+        import numpy as np
+        return GuardSummary.from_array(
+            np.asarray(self._guard_summary_t._value))
+
+    def _param_state_tensors(self, p):
+        """`p` plus its registered accumulators (the tensors one
+        parameter's update may mutate).  The pid -> tensors index is
+        cached and rebuilt only when an accumulator lands (first step,
+        lazy creation) — a linear scan of `_accumulators` here would
+        make every guarded eager step O(params x accumulators)."""
+        cached = getattr(self, "_guard_acc_index", None)
+        if cached is None or cached[0] != len(self._accumulators):
+            index = {}
+            for (_name, tid), t in self._accumulators.items():
+                index.setdefault(tid, []).append(t)
+            cached = (len(self._accumulators), index)
+            self._guard_acc_index = cached
+        return [p] + cached[1].get(id(p), [])
+
+    def _guarded_update(self, p, g, lr_mult):
+        """Generic zero-update gate around ANY subclass update rule:
+        reduce the gradient (f32 sum-of-squares — one reduction serves
+        both the finite verdict and the grad-norm probe, since any
+        non-finite element makes the sum non-finite), run the update,
+        then select every mutated state tensor back to its prior value
+        when the verdict is bad.  ``jnp.where`` (not multiply) so NaNs
+        in the discarded branch cannot leak."""
+        gsq = jnp.sum(jnp.square(g.astype(jnp.float32)))
+        good = jnp.isfinite(gsq)
+        self._guard_parts.append(gsq)
+        self._guard_bad.append(1.0 - good.astype(jnp.float32))
+        self._guard_regions += 1
+        before = {id(t): (t, t._value)
+                  for t in self._param_state_tensors(p)}
+        self._update_param(p, g, lr_mult)
+        import jax
+        concrete = not isinstance(good, jax.core.Tracer)
+        if concrete and bool(good):
+            # eager clean step (the ~100% case): the verdict is a
+            # concrete scalar, so skip the select entirely — the
+            # jnp.where below would materialize a full copy of every
+            # mutated state tensor per param per step
+            return
+        for t in self._param_state_tensors(p):
+            prior = before.get(id(t))
+            if prior is not None:
+                old = prior[1]
+            else:
+                # accumulator created lazily INSIDE this update: its
+                # pre-step value is its recorded fresh init
+                reinit = t.__dict__.get("_reinit")
+                if reinit is None:
+                    continue
+                old = reinit().astype(t._value.dtype)
+            if t._value is old:
+                continue                     # untouched this step
+            # traced: data-dependent select (jnp.where, not multiply,
+            # so NaNs in the discarded branch cannot leak).  Eager-bad:
+            # restore the priors outright.
+            t._value = old if concrete else jnp.where(good, t._value,
+                                                      old)
+
+    def _commit_guard_summary(self):
+        """Fold the per-region probe scalars into the (4,) summary
+        state tensor: [good, grad_sumsq, bad_regions, regions].  All
+        f32 scalar math — bytes-free at cost-model scale."""
+        if self._guard_parts:
+            total = self._guard_parts[0]
+            for x in self._guard_parts[1:]:
+                total = total + x
+            bad = self._guard_bad[0]
+            for x in self._guard_bad[1:]:
+                bad = bad + x
+        else:
+            total = jnp.asarray(0.0, jnp.float32)
+            bad = jnp.asarray(0.0, jnp.float32)
+        good = jnp.isfinite(total).astype(jnp.float32)
+        t = self._summary_tensor()
+        t._value = jnp.stack([
+            good, total.astype(jnp.float32),
+            jnp.asarray(bad, jnp.float32),
+            jnp.asarray(float(self._guard_regions), jnp.float32)])
+        t._version += 1
 
     def _update_param(self, p, g, lr_mult):
         raise NotImplementedError

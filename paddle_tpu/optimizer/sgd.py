@@ -8,8 +8,10 @@ from paddle_tpu.optimizer.optimizer import Optimizer
 
 class SGD(Optimizer):
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
-                 grad_clip=None, name=None, multi_precision=False):
-        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+                 grad_clip=None, name=None, multi_precision=False,
+                 guard=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, guard=guard)
 
     def _update_param(self, p, g, lr_mult):
         lr = self._lr_value() * lr_mult

@@ -1,7 +1,7 @@
 """paddle_tpu.resilience — fault-tolerant training & serving, plus the
 deterministic fault-injection harness that proves it.
 
-Four pieces (docs/resilience.md has the architecture):
+The pieces (docs/resilience.md has the architecture):
 
 - :mod:`checkpoint` — crash-safe checkpointing: atomic write-then-
   rename payloads, a digest-bearing manifest with retention, corruption
@@ -19,6 +19,12 @@ Four pieces (docs/resilience.md has the architecture):
   through hook points in ``framework/io.py``, ``optimizer/`` and
   ``serving/engine.py``, with every injected fault and recovery
   recorded through ``paddle_tpu.observability``;
+- :mod:`sentinel` — the training sentinel: in-trace anomaly probes
+  (``to_static(guard=True)`` / ``Optimizer(guard=True)``), the
+  :class:`sentinel.TrainingSentinel` skip/rollback policy machine,
+  deterministic replay bisection to name a poison batch, and the
+  cross-rank parameter/gradient digest vote that localizes silent data
+  corruption to a rank (SUSPECT ⇒ quarantine ⇒ reconfigure);
 - :mod:`fleet` — distributed fault tolerance: timeout-bounded
   coordination (:class:`fleet.CollectiveTimeout` instead of a hung
   collective), rank heartbeats + the HEALTHY→SUSPECT→DEAD fleet
@@ -43,7 +49,15 @@ Quickstart::
                                         "optimizer": opt.state_dict()}):
                 break
 """
-from paddle_tpu.resilience import faultinject, fleet
+from paddle_tpu.resilience import faultinject, fleet, sentinel
+from paddle_tpu.resilience.sentinel import (AnomalyDetected,
+                                            BatchLineage, DigestVote,
+                                            GuardSummary,
+                                            SentinelAction,
+                                            TrainingSentinel,
+                                            digest_vote,
+                                            localize_poison,
+                                            replay_bisect, tree_digest)
 from paddle_tpu.resilience.checkpoint import (CheckpointCorruption,
                                               Checkpointer, auto_resume)
 from paddle_tpu.resilience.faultinject import (FaultInjector, FaultPlan,
@@ -60,14 +74,18 @@ from paddle_tpu.resilience.retry import (RetryExhausted, RetryPolicy,
                                          retry)
 
 __all__ = [
+    "AnomalyDetected",
+    "BatchLineage",
     "CheckpointCorruption",
     "Checkpointer",
     "CollectiveTimeout",
+    "DigestVote",
     "DistributedCheckpointer",
     "FaultInjector",
     "FaultPlan",
     "FaultSpec",
     "FleetMonitor",
+    "GuardSummary",
     "HealthMonitor",
     "HealthState",
     "HeartbeatPublisher",
@@ -75,12 +93,19 @@ __all__ = [
     "RankState",
     "RetryExhausted",
     "RetryPolicy",
+    "SentinelAction",
+    "TrainingSentinel",
     "WorkerFault",
     "WorldView",
     "auto_resume",
+    "digest_vote",
     "faultinject",
     "fleet",
+    "localize_poison",
     "reconfigure",
+    "replay_bisect",
     "request_preemption",
     "retry",
+    "sentinel",
+    "tree_digest",
 ]

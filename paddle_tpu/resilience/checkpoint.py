@@ -298,7 +298,7 @@ class Checkpointer:
         return self.save(step, state)
 
 
-def auto_resume(checkpointer, model=None, optimizer=None):
+def auto_resume(checkpointer, model=None, optimizer=None, step=None):
     """Resume a training loop from the newest good checkpoint.
 
     Restores model/optimizer state in place and returns
@@ -312,8 +312,12 @@ def auto_resume(checkpointer, model=None, optimizer=None):
             ...
             if step % 10 == 9:
                 ckpt.save_train_state(step, model, opt)
+
+    ``step`` pins the restore to exactly that checkpointed step (the
+    sentinel's last-good anchor); cold-start (0) when that entry is
+    gone or corrupt.
     """
-    got = checkpointer.load()
+    got = checkpointer.load(step=step)
     if got is None:
         return 0, None
     step, state = got

@@ -39,6 +39,24 @@ site                   where / supported kinds
                        process (the dead-host fault of the
                        multi-process chaos suite; only meaningful in a
                        sacrificial worker subprocess)
+``optimizer.grads``    ``Optimizer.step`` gradient intake (eager) —
+                       ``bitflip`` flips one mantissa/exponent bit of
+                       one gradient element (silent data corruption:
+                       values change, nothing is NaN), ``nan_grad``
+                       poisons one element to NaN; both are applied by
+                       :func:`corrupt_array` at the call site, are
+                       deterministic on (plan seed, occurrence), and
+                       target ``payload["param"]`` by name (default:
+                       the first parameter with a gradient)
+``serving.logits``     ``LLMEngine`` guarded decode step — ``nan_grad``
+                       poisons the victim request's logits row to NaN,
+                       ``bitflip`` to +inf, through a traced poison
+                       operand (zeros when clean, so the compiled
+                       program never changes); the victim is
+                       ``payload["request_id"]`` or the latest-arrived
+                       live request.  Requires
+                       ``EngineConfig(guard=True)`` — unguarded
+                       engines never consult the site
 =====================  ====================================================
 
 Usage::
@@ -63,11 +81,11 @@ import time
 
 __all__ = [
     "FaultSpec", "FaultPlan", "FaultInjector", "WorkerFault",
-    "fire", "active_plan", "note_recovery",
+    "corrupt_array", "fire", "active_plan", "note_recovery",
 ]
 
 KINDS = ("torn_write", "exception", "preempt", "pool_exhaust", "slow",
-         "rank_kill")
+         "rank_kill", "bitflip", "nan_grad")
 
 
 class WorkerFault(RuntimeError):
@@ -274,6 +292,52 @@ def fire(site, **ctx):
         sys.stdout.flush()
         os.kill(os.getpid(), signal.SIGKILL)
     return spec
+
+
+def corrupt_array(spec, value, seed=0, occurrence=0):
+    """Apply a ``bitflip`` / ``nan_grad`` spec to ONE element of
+    `value` (any array-like); returns a numpy copy in the input's own
+    float dtype (non-float inputs corrupt through float32) — every
+    other element is bit-identical to the input, which is what makes
+    the digest-vote proofs sound.
+
+    Deterministic: the target element and (for ``bitflip``) the flipped
+    bit come from ``payload["index"]`` / ``payload["bit"]`` when given,
+    else from a PRNG seeded by (plan seed, spec.at, occurrence) — and
+    since call sites leave `occurrence` at 0, every firing of one
+    ``times=N`` spec hits the SAME element: persistent-fault semantics
+    (one sticky bad lane), replay-stable like every other kind.  A
+    bitflip targets a HIGH exponent bit by default (bit width-2: 30
+    for f32 words, 62 for f64): depending on the victim's exponent the
+    element becomes huge-but-finite (the grad-norm channel catches it)
+    or NaN/inf (the finite guard does) — both are one real hardware
+    flip.  Pass a low ``payload["bit"]`` for the strictly-silent
+    variant only a digest vote can see; ``nan_grad`` is the always-
+    loud variant the finite guard must catch within one step.
+    """
+    import numpy as np
+    if spec.kind not in ("bitflip", "nan_grad"):
+        raise ValueError(f"corrupt_array cannot apply kind {spec.kind!r}")
+    arr = np.array(value, copy=True)
+    if arr.dtype not in (np.float32, np.float64):
+        arr = arr.astype(np.float32)
+    flat = arr.reshape(-1)
+    if flat.size == 0:
+        return arr
+    import random as _random
+    rng = _random.Random(int(seed) * 1000003
+                         + int(spec.at) * 101 + int(occurrence))
+    idx = int(spec.payload.get("index", rng.randrange(flat.size)))
+    idx %= flat.size
+    if spec.kind == "nan_grad":
+        flat[idx] = np.nan
+    else:
+        utype = np.uint32 if arr.dtype == np.float32 else np.uint64
+        width = 32 if arr.dtype == np.float32 else 64
+        bit = int(spec.payload.get("bit", width - 2)) % width
+        word = flat[idx:idx + 1].view(utype)
+        word ^= utype(1 << bit)
+    return arr
 
 
 # ---- observability wiring ------------------------------------------------

@@ -788,6 +788,7 @@ class FleetMonitor:
         self._seen = {}      # rank -> (seq, progress, first_seen_local,
         #                               seq_local, progress_local)
         self._states = {}    # rank -> RankState
+        self._quarantined = {}   # rank -> reason (sticky SUSPECT)
         self.transitions = []  # [(rank, old, new, age_s)]
         self._stop = threading.Event()
         self._thread = None
@@ -841,6 +842,12 @@ class FleetMonitor:
                     self._seen[r] = seen
                 age = now - seen[3]
                 new = self._classify(old, age, now - seen[4])
+                if r in self._quarantined and new is not RankState.DEAD:
+                    # externally quarantined (SDC digest vote): pinned
+                    # at SUSPECT — a fresh heartbeat must NOT clear it
+                    # (the host is alive; its math is not trusted).
+                    # Silence still escalates SUSPECT -> DEAD above.
+                    new = RankState.SUSPECT
                 if new is not old:
                     self._states[r] = new
                     self.transitions.append((r, old, new, age))
@@ -890,6 +897,45 @@ class FleetMonitor:
     def is_dead(self, rank):
         with self._lock:
             return self._states.get(rank) is RankState.DEAD
+
+    # ---- external quarantine (SDC digest vote) ----
+    def mark_suspect(self, rank, reason=None):
+        """Quarantine `rank` at SUSPECT on EXTERNAL evidence (the
+        sentinel's cross-rank digest vote names an SDC-suspect whose
+        heartbeats are perfectly healthy).  Sticky: heartbeat recovery
+        does not clear it — only :meth:`clear_suspect` or the terminal
+        DEAD verdict supersedes.  The caller decides the next move
+        (typically :func:`reconfigure` excluding the suspect)."""
+        rank = int(rank)
+        evt = None
+        with self._lock:
+            self._quarantined[rank] = str(reason or "quarantined")
+            old = self._states.get(rank, RankState.HEALTHY)
+            if old not in (RankState.SUSPECT, RankState.DEAD):
+                self._states[rank] = RankState.SUSPECT
+                evt = (rank, old, RankState.SUSPECT, 0.0)
+                self.transitions.append(evt)
+        if evt is not None:
+            # telemetry outside the monitor lock (poll() discipline)
+            self._set_gauges(rank, RankState.SUSPECT, 0.0)
+            self._record(*evt)
+        return RankState.SUSPECT
+
+    def clear_suspect(self, rank):
+        """Lift an external quarantine; the rank's state recovers
+        through the ordinary heartbeat classification at the next
+        poll (DEAD stays terminal)."""
+        with self._lock:
+            self._quarantined.pop(int(rank), None)
+
+    def suspect_ranks(self):
+        with self._lock:
+            return sorted(r for r, s in self._states.items()
+                          if s is RankState.SUSPECT)
+
+    def quarantined_ranks(self):
+        with self._lock:
+            return sorted(self._quarantined)
 
     # ---- telemetry ----
     def _set_gauges(self, rank, state, age):

@@ -82,7 +82,10 @@ def engine_fingerprint(model_config, engine_config, params, mesh=None):
                        else ec.dtype),
                    # an int8-pool program must never load for an f32
                    # engine (or vice versa) — the pool pytree differs
-                   getattr(ec, "kv_cache_dtype", None)),
+                   getattr(ec, "kv_cache_dtype", None),
+                   # a guarded decode program has an extra operand and
+                   # an extra output — structurally different family
+                   bool(getattr(ec, "guard", False))),
         "mesh": _mesh_desc(mesh),
         "jax": jax.__version__,
         "jaxlib": getattr(jaxlib, "__version__", "?"),

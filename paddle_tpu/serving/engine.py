@@ -99,6 +99,16 @@ class EngineConfig:
       (paddle_tpu/quantization/kv_cache.py; docs/quantization.md has
       the storage format and the tolerance contract).  Activations and
       logits stay at `dtype`; only KV storage narrows.
+    - `guard`: the serving half of the training sentinel
+      (docs/resilience.md "Numerics sentinel") — the decode program
+      additionally returns a per-slot anomaly flag pair (non-finite
+      logits row; quantized-KV page-scale overflow) computed in-trace,
+      and a flagged request is evicted-and-requeued through the
+      crash-safe-decode path instead of poisoning the shared pools.
+      After ``guard_requeue_limit`` guard evictions the request
+      finishes with ``finish_reason="anomaly"`` (a deterministic
+      poison would otherwise replay forever).  ``guard_scale_limit``
+      additionally bounds quantized page scales (None = finite-only).
     """
 
     def __init__(self, max_num_seqs=8, page_size=16, max_model_len=256,
@@ -107,7 +117,9 @@ class EngineConfig:
                  dtype=jnp.float32, finished_retention=1024,
                  max_queue_depth=None, crash_safe_decode=True,
                  health_degraded_at=0.85, health_drain_at=0.97,
-                 health_recover_at=0.70, mesh=None, kv_cache_dtype=None):
+                 health_recover_at=0.70, mesh=None, kv_cache_dtype=None,
+                 guard=False, guard_scale_limit=None,
+                 guard_requeue_limit=2):
         if max_num_seqs < 1:
             raise ValueError("max_num_seqs must be >= 1")
         self.max_num_seqs = int(max_num_seqs)
@@ -144,6 +156,11 @@ class EngineConfig:
         self.kv_cache_dtype = (None if kv_cache_dtype is None
                                else resolve_kv_cache_dtype(
                                    kv_cache_dtype).name)
+        self.guard = bool(guard)
+        self.guard_scale_limit = (float(guard_scale_limit)
+                                  if guard_scale_limit is not None
+                                  else None)
+        self.guard_requeue_limit = int(guard_requeue_limit)
 
     @property
     def compile_bound(self):
@@ -830,19 +847,27 @@ class LLMEngine:
             tokens[s, 0] = r.output_token_ids[-1]
 
         fn = self._get_decode()
+        guard_args = ()
+        if cfg.guard:
+            guard_args = (self._place(self._poison_vector(live)),)
         try:
             # chaos hook: `exception` faults here simulate a crashed
             # decode (payload `request_id` names the offender)
             _fire("serving.decode", step=self.metrics.decode_steps)
-            logits, self._k_pools, self._v_pools = fn(
+            out = fn(
                 self._params, self._k_pools, self._v_pools,
                 self._place(self._tables), self._place(self._lens),
-                self._place(tokens))
+                self._place(tokens), *guard_args)
         except Exception as e:
             if not cfg.crash_safe_decode:
                 raise
             self._recover_decode_fault(e, events)
             return
+        if cfg.guard:
+            logits, self._k_pools, self._v_pools, flags = out
+            live = self._quarantine_flagged(live, flags, events)
+        else:
+            logits, self._k_pools, self._v_pools = out
         self._decode_fault_streak = 0
 
         reqs = [self._slots[s] for s in range(cfg.max_num_seqs)]
@@ -858,6 +883,70 @@ class LLMEngine:
             r.append_token(toks[s], now=now)
             self.metrics.generated_tokens += 1
             self._post_token(r, events, now)
+
+    def _poison_vector(self, live):
+        """The guarded decode's injection operand: zeros in production;
+        a ``serving.logits`` fault poisons the victim's row (nan_grad →
+        NaN, bitflip → +inf) so detection is exercised through the REAL
+        compiled program — deterministic, and the program never
+        changes."""
+        cfg = self.config
+        poison = np.zeros((cfg.max_num_seqs, 1), np.float32)
+        spec = _fire("serving.logits", step=self.metrics.decode_steps)
+        if spec is not None and spec.kind in ("bitflip", "nan_grad") \
+                and live:
+            rid = spec.payload.get("request_id")
+            if rid is not None:
+                # request-targeted fault: if the target is no longer
+                # live (finished/quarantined), the fault is spent —
+                # never redirect the poison onto an innocent request
+                victim = next((r for _s, r in live
+                               if r.request_id == rid), None)
+            else:
+                victim = max((r for _s, r in live),
+                             key=lambda r: r.arrival_index)
+            if victim is not None:
+                poison[victim.slot, 0] = (np.nan
+                                          if spec.kind == "nan_grad"
+                                          else np.inf)
+        return poison
+
+    def _quarantine_flagged(self, live, flags, events):
+        """Guard verdicts -> evictions: every flagged live request is
+        evicted-and-requeued (the crash-safe path — its replay prefill
+        rebuilds clean pools from prompt + generated tokens, and its
+        freed pages are rewritten before any read), EXCEPT a request
+        already guard-evicted ``guard_requeue_limit`` times, which
+        finishes with ``finish_reason="anomaly"`` (a deterministic
+        poison must not replay forever).  Returns the surviving live
+        list."""
+        fl = np.asarray(flags)
+        flagged = [(s, r) for s, r in live if fl[s].any()]
+        if not flagged:
+            return live
+        from paddle_tpu.resilience.sentinel import note_anomaly
+        now = self.metrics.clock()
+        for s, r in flagged:
+            kind = ("nan_logits" if fl[s, 0]
+                    else "scale_overflow")
+            note_anomaly(kind, "serving.decode",
+                         step=self.metrics.decode_steps,
+                         request=r.request_id)
+            r.num_guard_evictions = getattr(
+                r, "num_guard_evictions", 0) + 1
+            self.metrics.guard_anomalies += 1
+            with span("serving.guard", request=r.request_id, kind=kind,
+                      evictions=r.num_guard_evictions):
+                if r.num_guard_evictions > \
+                        self.config.guard_requeue_limit:
+                    self._finish(r, "anomaly", now)
+                    r.deliver(finished=True)
+                    events.append((r.request_id, None, True))
+                else:
+                    self._evict(r, events)
+            note_recovery("serving.decode", kind,
+                          request=r.request_id)
+        return [(s, r) for s, r in live if self._slots[s] is r]
 
     def _recover_decode_fault(self, exc, events):
         """Crash-safe decode: a failed decode program left no state
@@ -1031,8 +1120,64 @@ class LLMEngine:
             jnp.zeros((1,), jnp.int32)), (1, 2), \
             self._step_out_shardings()
 
+    def _guard_flags(self, logits, k_pools, v_pools, tables, lens):
+        """Traced per-slot anomaly flags ``[B, 2]`` f32: column 0 is
+        the logit finite-check (any non-finite value in the row's
+        logits), column 1 the quantized-KV scale-overflow check (a
+        non-finite — or above ``guard_scale_limit`` — page scale on
+        any page the row actually uses, any layer).  Gathers touch
+        only the tiny ``[N, h]`` scale planes, so the guard's decode
+        bytes are noise next to the attention reads."""
+        cfg = self.config
+        bad_logits = jnp.any(~jnp.isfinite(logits), axis=-1)     # [B]
+        if self._kv_quant is None:
+            bad_scale = jnp.zeros_like(bad_logits)
+        else:
+            P_ = tables.shape[1]
+            used = ((jnp.arange(P_, dtype=jnp.int32) * cfg.page_size)
+                    [None, :] < (lens + 1)[:, None])             # [B, P]
+            limit = cfg.guard_scale_limit
+            bad_scale = jnp.zeros(logits.shape[0], jnp.bool_)
+            for kq, vq in zip(k_pools, v_pools):
+                for _codes, scales in (kq, vq):
+                    s = scales[tables]                           # [B,P,h]
+                    bad = ~jnp.isfinite(s)
+                    if limit is not None:
+                        bad = bad | (s > limit)
+                    bad_scale = bad_scale | jnp.any(
+                        bad & used[:, :, None], axis=(1, 2))
+        return jnp.stack([bad_logits, bad_scale],
+                         axis=-1).astype(jnp.float32)
+
     def _decode_program(self):
         cfg = self.config
+
+        if cfg.guard:
+            # sentinel-guarded decode: one extra [B, 1] poison operand
+            # (all zeros in production — the fault-injection hook adds
+            # NaN/inf to a victim row, so injection never changes the
+            # compiled program) and one extra [B, 2] anomaly-flag
+            # output.  Still ONE decode program for the engine's life.
+            def decode(params, k_pools, v_pools, tables, lens, tokens,
+                       poison):
+                ctx = PagedKVContext(k_pools, v_pools, tables, lens,
+                                     cfg.page_size, "decode",
+                                     quant=self._kv_quant)
+                logits = self._run_model(params, tokens, lens[:, None],
+                                         ctx)
+                logits = logits[:, 0].astype(jnp.float32) + poison
+                flags = self._guard_flags(logits, ctx.k_pools,
+                                          ctx.v_pools, tables, lens)
+                return (logits, ctx.k_pools, ctx.v_pools, flags)
+
+            return decode, (
+                self._params, self._k_pools, self._v_pools,
+                jnp.zeros((cfg.max_num_seqs, cfg.max_pages_per_seq),
+                          jnp.int32),
+                jnp.zeros((cfg.max_num_seqs,), jnp.int32),
+                jnp.zeros((cfg.max_num_seqs, 1), jnp.int32),
+                jnp.zeros((cfg.max_num_seqs, 1), jnp.float32)), (1, 2), \
+                self._guarded_out_shardings()
 
         def decode(params, k_pools, v_pools, tables, lens, tokens):
             ctx = PagedKVContext(k_pools, v_pools, tables, lens,
@@ -1049,6 +1194,14 @@ class LLMEngine:
             jnp.zeros((cfg.max_num_seqs,), jnp.int32),
             jnp.zeros((cfg.max_num_seqs, 1), jnp.int32)), (1, 2), \
             self._step_out_shardings()
+
+    def _guarded_out_shardings(self):
+        """Decode out_shardings with the guard-flag output appended
+        (replicated, like the logits)."""
+        base = self._step_out_shardings()
+        if base is None:
+            return None
+        return (*base, self._repl_sharding)
 
     def _sampler_program(self, width):
         V = int(self._model.config.vocab_size)

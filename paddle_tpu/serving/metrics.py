@@ -84,6 +84,7 @@ class EngineMetrics:
         self.requests_expired = 0    # deadline enforcement
         self.requests_adopted = 0    # router failover migrations in
         self.decode_fault_recoveries = 0
+        self.guard_anomalies = 0     # sentinel guard-flagged requests
         self.prefill_steps = 0
         self.decode_steps = 0
         self.prompt_tokens = 0
@@ -187,6 +188,7 @@ class EngineMetrics:
             "running": self.running,
             "health": self.health,
             "decode_fault_recoveries": self.decode_fault_recoveries,
+            "guard_anomalies": self.guard_anomalies,
             "steps": {
                 "prefill": self.prefill_steps,
                 "decode": self.decode_steps,

@@ -1,0 +1,209 @@
+"""Worker script for the SDC digest-vote acceptance proof
+(tests/test_distributed_multiprocess.py::
+test_sentinel_digest_vote_names_sdc_rank).
+
+Launched through ``python -m paddle_tpu.distributed.launch`` as 3 OS
+processes.  Each rank runs the tiny closed-form dp loop from
+``_fleet_worker.py`` (ONE eager AVG all_reduce over [loss, grad] per
+step), keeping a per-rank REPLICA of the weights — bit-identical
+across ranks by construction, which is exactly what makes the digest
+vote sound.
+
+At step ``sdc_step``, rank ``sdc_rank``'s replica suffers a silent
+bitflip (``faultinject.corrupt_array``, low mantissa bit: the value
+changes, nothing goes non-finite — invisible to every finite/norm
+guard).  After every step each rank votes
+``sentinel.digest_vote({"w": w}, step=...)`` through the coordination
+KV:
+
+- every rank's vote (including the corrupted one) names ``sdc_rank``
+  as the sole suspect;
+- the suspect writes its result and exits (quarantined — no finalize:
+  it never joins the next generation);
+- survivors ``mark_suspect`` on their FleetMonitor, ``reconfigure`` to
+  world size 2 (generation 1), and resume the remaining steps on the
+  shrunk world with finite losses.
+
+Workers exit via ``os._exit`` for the same reason as _fleet_worker:
+after a peer leaves, the jax client's shutdown barrier can never
+complete, and the contract is "no indefinite hang anywhere".
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+DIM = 4
+LR = 0.05
+
+
+def batch(step, rank):
+    rng = np.random.RandomState(2000 + 13 * step + rank)
+    w_true = np.arange(1.0, DIM + 1.0, dtype=np.float64)
+    X = rng.randn(8, DIM)
+    y = X @ w_true
+    return X, y
+
+
+def train_step(dist, P, w, step, rank):
+    X, y = batch(step, rank)
+    err = X @ w - y
+    loss = float(np.mean(err * err))
+    grad = (2.0 / X.shape[0]) * (X.T @ err)
+    vec = P.to_tensor(np.concatenate([[loss], grad]).astype(np.float64))
+    dist.all_reduce(vec, op=dist.ReduceOp.AVG)
+    out = np.asarray(vec.numpy())
+    return float(out[0]), w - LR * out[1:]
+
+
+def main():
+    out_dir = sys.argv[1]
+    sdc_rank = int(sys.argv[2])
+    sdc_step = int(sys.argv[3])
+    total_steps = int(sys.argv[4])
+
+    import jax
+
+    import paddle_tpu as P  # noqa: F401  (installs shims)
+    from paddle_tpu import distributed as dist
+    from paddle_tpu.resilience import faultinject, fleet, sentinel
+
+    grank = jax.process_index()
+    result = {"global_rank": grank, "launch_world": jax.process_count(),
+              "vote": None, "monitor_suspects": None, "new_world": None,
+              "losses_resumed": [], "exited_as_suspect": False}
+
+    pub = fleet.install_publisher(fleet.HeartbeatPublisher().start())
+    mon = fleet.install_monitor(fleet.FleetMonitor().start())
+
+    # the silent fault: a low mantissa-bit flip in THIS rank's weight
+    # replica — finite, small, invisible to the loss/grad guards; only
+    # the cross-rank digest can see it
+    injector = faultinject.FaultInjector(faultinject.FaultPlan(
+        [faultinject.FaultSpec("optimizer.grads", "bitflip",
+                               at=sdc_step - 1,
+                               payload={"index": 1, "bit": 18})]
+        if grank == sdc_rank else [], seed=grank, name="sentinel-sdc"))
+    faultinject.install(injector)
+
+    def qkey(rank):
+        # OUTSIDE the generation namespaces: reconfigure/finalize reap
+        # those, and this key must survive into the survivors' endgame
+        return f"ptpu/{fleet.world().launch_id}/quarantine/r{rank}"
+
+    def finish(checkout=False):
+        path = os.path.join(out_dir, f"vote-rank{grank}.json")
+        with open(path + ".tmp", "w") as fh:
+            json.dump(result, fh)
+        os.replace(path + ".tmp", path)
+        if checkout:
+            # quarantine check-out: the LAST act before exit.  The
+            # coordinator host (global rank 0) must not exit while this
+            # process is still alive — jax's error-poll thread SIGABRTs
+            # any live client the moment the leader's service socket
+            # closes — so the leader blocks on this key before its own
+            # exit (the PR 14 finalize lesson, extended to quarantined
+            # non-members that can never join the new generation's
+            # done-barrier).  The value is this process's PID: the key
+            # alone is not enough — between this RPC and the _exit
+            # syscall the suspect can be descheduled arbitrarily long
+            # (observed live past a 0.3s grace), so the leader polls
+            # /proc/<pid> until the suspect is actually gone.
+            try:
+                fleet.kv_set_bytes(fleet._client(), qkey(grank),
+                                   str(os.getpid()).encode())
+            except Exception:
+                pass
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(0)
+
+    w = np.zeros(DIM)
+    suspect_pids = {}
+    step = 1
+    while step <= total_steps:
+        pub.beat()
+        loss, w = train_step(dist, P, w, step, fleet.world().rank)
+        spec = faultinject.fire("optimizer.grads", step=step)
+        if spec is not None:
+            w = np.asarray(
+                faultinject.corrupt_array(spec, w, seed=grank),
+                np.float64)
+        # the vote is a per-step collective over the REPLICATED state
+        vote = sentinel.digest_vote({"w": w}, step=step,
+                                    monitor=mon)
+        if vote.suspects:
+            result["vote"] = vote.to_dict()
+            if vote.self_suspect:
+                # quarantined: record testimony and leave — never join
+                # the next generation (and never finalize: generation 0
+                # is reaped by the survivors' reconfigure)
+                result["exited_as_suspect"] = True
+                finish(checkout=True)
+            # survivors: wait (bounded) for the suspect's quarantine
+            # check-out BEFORE reconfiguring — reconfigure reaps
+            # generation 0's keys, and a descheduled suspect may still
+            # be READING them (its own copy of this vote round);
+            # reaping mid-read strands it in a CollectiveTimeout
+            # instead of a clean quarantine exit (observed live).  The
+            # check-out value is the suspect's PID, kept for the
+            # leader's endgame death-poll.
+            for s in vote.suspects:
+                try:
+                    raw = fleet.kv_get_bytes(
+                        fleet._client(), qkey(s), timeout_s=20.0,
+                        site="sentinel.vote", missing_rank=s)
+                    suspect_pids[s] = int(
+                        raw.decode().strip("\x00").strip())
+                except Exception:
+                    pass
+                mon.mark_suspect(s, reason=f"digest vote w@{step}")
+            result["monitor_suspects"] = mon.suspect_ranks()
+            new_wv = fleet.reconfigure(sorted(vote.suspects))
+            result["new_world"] = new_wv.to_dict()
+            step += 1
+            continue
+        if step > sdc_step:
+            result["losses_resumed"].append(loss)
+        step += 1
+
+    result["final_world"] = fleet.world().to_dict()
+    fleet.finalize()
+    if grank == 0:
+        # leader lingers for the quarantined rank's check-out: its exit
+        # takes the coordination service with it, and a still-alive
+        # suspect would be SIGABRTed by its error-poll thread (observed
+        # live: the suspect descheduled past the survivors' whole
+        # resume).  Bounded — a crashed suspect surfaces as rc != 0 in
+        # the parent either way.
+        import time as _t
+        try:
+            spid = suspect_pids.get(sdc_rank)
+            if spid is None:
+                raw = fleet.kv_get_bytes(
+                    fleet._client(), qkey(sdc_rank), timeout_s=20.0,
+                    site="sentinel.vote", missing_rank=sdc_rank)
+                spid = int(raw.decode().strip("\x00").strip())
+            # wait for the suspect PROCESS to die, not just for its
+            # check-out RPC: a fixed grace loses whenever the suspect
+            # is descheduled between the RPC and its _exit syscall.
+            # Zombie counts as dead — its threads (incl. the jax
+            # error poll) are gone, only the parent's reap remains.
+            deadline = _t.monotonic() + 15.0
+            while _t.monotonic() < deadline:
+                try:
+                    with open(f"/proc/{spid}/stat") as fh:
+                        state = fh.read().rsplit(")", 1)[1].split()[0]
+                except OSError:
+                    break
+                if state == "Z":
+                    break
+                _t.sleep(0.05)
+        except Exception:
+            pass
+    finish()
+
+
+if __name__ == "__main__":
+    main()

@@ -428,3 +428,66 @@ class TestHybridParallel:
         mesh = _mesh({"dp": 2, "tp": 2, "sp": 2})
         got = one_step(mesh)
         np.testing.assert_allclose(ref, got, rtol=2e-3)
+
+
+class TestStrategyFlagWarnings:
+    """PR 15 satellite (VERDICT Weak #3): DistributedStrategy flags the
+    TPU-native fleet mapping does not wire must WARN, never no-op
+    silently."""
+
+    @pytest.mark.smoke
+    def test_unwired_flags_warn_once_each(self):
+        import warnings as _w
+        from paddle_tpu.distributed import fleet as F
+        s = F.DistributedStrategy()
+        s.amp = True
+        s.recompute = True
+        s.dgc = True
+        s.localsgd = True
+        s.sharding = True
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            ignored = F._warn_ignored_flags(s)
+        assert sorted(ignored) == ["amp", "dgc", "localsgd",
+                                   "recompute", "sharding"]
+        msgs = [str(x.message) for x in rec
+                if issubclass(x.category, UserWarning)]
+        assert len(msgs) == 5
+        for flag in ignored:
+            assert any(f"DistributedStrategy.{flag} " in m
+                       for m in msgs), (flag, msgs)
+
+    def test_wired_flags_and_defaults_stay_silent(self):
+        import warnings as _w
+        from paddle_tpu.distributed import fleet as F
+        s = F.DistributedStrategy()
+        s.lars = True               # wired via distributed_optimizer
+        s.gradient_merge = True     # wired via distributed_optimizer
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            assert F._warn_ignored_flags(s) == []
+        assert [x for x in rec
+                if issubclass(x.category, UserWarning)] == []
+
+    def test_sharding_degree_warns(self):
+        import warnings as _w
+        from paddle_tpu.distributed import fleet as F
+        s = F.DistributedStrategy()
+        s.hybrid_configs["sharding_degree"] = 2
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            ignored = F._warn_ignored_flags(s)
+        assert ignored == ["hybrid_configs.sharding_degree"]
+        assert any("sharding_degree" in str(x.message) for x in rec)
+
+    def test_fleet_init_emits_the_warnings(self):
+        import warnings as _w
+        from paddle_tpu.distributed import fleet as F
+        s = F.DistributedStrategy()
+        s.amp = True
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            F.fleet.init(strategy=s)
+        assert any("DistributedStrategy.amp " in str(x.message)
+                   for x in rec
+                   if issubclass(x.category, UserWarning))

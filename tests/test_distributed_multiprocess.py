@@ -34,11 +34,13 @@ import subprocess
 import sys
 import time
 
+import numpy as np
 import pytest
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 WORKER = os.path.join(HERE, "_multiprocess_worker.py")
 FLEET_WORKER = os.path.join(HERE, "_fleet_worker.py")
+SENTINEL_WORKER = os.path.join(HERE, "_sentinel_worker.py")
 DEADLINE_S = 120.0
 FLEET_DEADLINE_S = 150.0
 
@@ -247,3 +249,73 @@ def test_fleet_sigkill_reconfigure_resume(tmp_path):
     assert chaos[0]["losses_resumed"] == base[0]["losses_resumed"], (
         "resumed-after-SIGKILL trajectory diverged from the fault-free "
         "world-size-2 trajectory")
+
+
+# ---------------------------------------------------------------------------
+# Sentinel: SDC digest vote -> quarantine -> reconfigure -> resume
+# ---------------------------------------------------------------------------
+
+SDC_RANK, SDC_STEP, SDC_TOTAL = 2, 4, 8
+
+
+def _spawn_sentinel(rank, port, out_dir):
+    env = _child_env({**FLEET_ENV, "PADDLE_LAUNCH_ID": "sentinelA"})
+    return subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--master", f"127.0.0.1:{port}", "--nnodes", "3",
+         "--rank", str(rank), SENTINEL_WORKER, out_dir,
+         str(SDC_RANK), str(SDC_STEP), str(SDC_TOTAL)],
+        cwd=os.path.dirname(HERE), env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+
+
+@pytest.mark.chaos
+def test_sentinel_digest_vote_names_sdc_rank(tmp_path):
+    """The PR 15 SDC-localization proof on a REAL 3-process fleet: a
+    silent (finite, low-bit) bitflip lands in one rank's weight
+    replica; the per-step cross-rank digest vote names that rank on
+    EVERY process (including the corrupted one), the survivors
+    quarantine it (sticky SUSPECT on the watchdog) and
+    reconfigure-and-resume at world size 2 with finite, fleet-agreed
+    losses — the corruption never reaches a gradient sync."""
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    port = _free_port()
+    procs = {r: _spawn_sentinel(r, port, str(out_dir))
+             for r in range(3)}
+    try:
+        outputs = _collect(procs, FLEET_DEADLINE_S)
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+
+    res = {}
+    for r in range(3):
+        path = out_dir / f"vote-rank{r}.json"
+        assert path.exists(), (
+            f"rank {r} wrote no result\n--- child log ---\n"
+            f"{outputs[r][-2000:]}")
+        res[r] = json.loads(path.read_text())
+
+    # every rank's vote named the injected rank — including itself
+    for r in range(3):
+        vote = res[r]["vote"]
+        assert vote is not None, f"rank {r} never saw a dissent"
+        assert vote["suspects"] == [SDC_RANK], (r, vote)
+        assert vote["step"] == SDC_STEP, (r, vote)
+        assert vote["self_suspect"] == (r == SDC_RANK), (r, vote)
+
+    # the suspect quarantined itself out; survivors reconfigured
+    assert res[SDC_RANK]["exited_as_suspect"] is True
+    assert res[SDC_RANK]["new_world"] is None
+    for r in (0, 1):
+        assert res[r]["monitor_suspects"] == [SDC_RANK], res[r]
+        nw = res[r]["new_world"]
+        assert nw["members"] == [0, 1] and nw["size"] == 2, nw
+        assert nw["generation"] == 1, nw
+        assert res[r]["final_world"]["size"] == 2, res[r]
+        assert len(res[r]["losses_resumed"]) == SDC_TOTAL - SDC_STEP
+        assert all(np.isfinite(v) for v in res[r]["losses_resumed"])
+    # the all_reduce'd resumed trajectory is fleet-global
+    assert res[0]["losses_resumed"] == res[1]["losses_resumed"]

@@ -330,3 +330,99 @@ class TestEdgeCases:
         ft = convert_to_static(f)
         assert np.allclose(ft(_arr(1.0), 5).numpy(), [6.0])
         assert np.allclose(ft(_arr(1.0), 3).numpy(), [2.0])
+
+
+class TestIdentityTestRejection:
+    """TL005 (PR 15 satellite): identity tests against names bound in
+    only one branch of a convertible `if` are rejected at CONVERSION
+    time with the variable named — the one poison-sentinel read the
+    UNDEF sentinel cannot intercept (`maybe_bound is None` would
+    silently evaluate False under a trace)."""
+
+    def _raises_tl005(self, fn, name):
+        from paddle_tpu.analysis.rules import TraceHazardError
+        with pytest.raises(TraceHazardError) as ei:
+            convert_to_static(fn)
+        assert ei.value.code == "TL005"
+        assert f"`{name}`" in str(ei.value)
+
+    def test_one_branch_binding_then_is_none_rejected(self):
+        def f(x):
+            if (x > 0).all():
+                status = x * 2
+            return status is None
+
+        self._raises_tl005(f, "status")
+
+    def test_is_not_and_either_side_rejected(self):
+        def f(x):
+            if (x > 0).all():
+                pass
+            else:
+                marker = x + 1
+            return None is not marker
+
+        self._raises_tl005(f, "marker")
+
+    def test_bound_on_every_path_converts(self):
+        def f(x):
+            y = None
+            if (x.sum() > 0):
+                y = x * 2
+            if y is None:
+                return x
+            return y
+
+        ft = convert_to_static(f)
+        assert np.allclose(ft(_arr(1.0)).numpy(), [2.0])
+        # eager semantics for the python-valued read stay intact
+        assert np.allclose(ft(_arr(-1.0)).numpy(), [-1.0])
+
+    def test_rebind_between_if_and_test_converts(self):
+        def f(x):
+            if (x.sum() > 0):
+                y = x * 2
+            y = x + 1.0
+            t = 1.0 if y is None else 0.0
+            return y + t
+
+        ft = convert_to_static(f)
+        assert np.allclose(ft(_arr(1.0)).numpy(), [2.0])
+
+    def test_identity_test_before_the_if_converts(self):
+        def f(x, flag=None):
+            use = flag is None
+            if (x.sum() > 0):
+                y = x * 2
+            else:
+                y = x
+            return y if use else x
+
+        ft = convert_to_static(f)
+        assert np.allclose(ft(_arr(3.0)).numpy(), [6.0])
+
+    def test_to_static_wrap_surfaces_the_error(self):
+        from paddle_tpu.analysis.rules import TraceHazardError
+
+        def f(x):
+            if (x > 0).all():
+                out = x + 1
+            return out is not None
+
+        with pytest.raises(TraceHazardError):
+            p.jit.to_static(f)
+
+    def test_suppression_comment_waives_tl005(self):
+        # a short-circuit-guarded identity test is provably safe but
+        # outside the checker's sight — the standard tracelint
+        # suppression spelling waives it on that line
+        def f(x, debug=False):
+            if debug:
+                aux = x * 2
+            if debug and aux is not None:  # tracelint: disable=TL005
+                return aux
+            return x
+
+        ft = convert_to_static(f)
+        assert np.allclose(ft(_arr(3.0)).numpy(), [3.0])
+        assert np.allclose(ft(_arr(3.0), True).numpy(), [6.0])

@@ -89,6 +89,7 @@ GATES = {
               "-p", "no:cacheprovider",
               os.path.join(REPO, "tests", "test_resilience.py"),
               os.path.join(REPO, "tests", "test_fleet.py"),
+              os.path.join(REPO, "tests", "test_sentinel.py"),
               os.path.join(REPO, "tests",
                            "test_distributed_multiprocess.py")],
 }

@@ -48,7 +48,7 @@ DEFAULT_TOLERANCE = 0.05
 
 
 # ------------------------------------------------------------- targets
-def build_gpt_train_step(optimized=True, remat=None):
+def build_gpt_train_step(optimized=True, remat=None, guard=False):
     """The flagship hybrid-parallel train step — the SHARED builder
     other tools profile the same program from (tools/obs_report.py
     --roofline --demo, tests/test_profile.py), with the loss under an
@@ -61,7 +61,11 @@ def build_gpt_train_step(optimized=True, remat=None):
     AdamW update (``fused=True``), and the Pallas fused LN/residual
     blocks (``fused_ln=True``).  ``optimized=False`` is the plain-f32
     per-op build (the remat lane's baseline and the XLA-reconciliation
-    test use it).  ``remat`` threads to ``to_static(remat=...)``."""
+    test use it).  ``remat`` threads to ``to_static(remat=...)``;
+    ``guard=True`` arms the training sentinel's in-trace anomaly
+    probes on both halves (``to_static(guard=True)`` +
+    ``AdamW(guard=True)``) — the ``sentinel`` perfgate target measures
+    their cost-model overhead against the unguarded flagship."""
     import numpy as np
 
     import paddle_tpu as P
@@ -74,10 +78,10 @@ def build_gpt_train_step(optimized=True, remat=None):
     model = GPTForCausalLM(cfg)
     opt = P.optimizer.AdamW(learning_rate=1e-4,
                             parameters=model.parameters(),
-                            fused=bool(optimized))
+                            fused=bool(optimized), guard=bool(guard))
 
     @P.jit.to_static(amp_policy="bf16" if optimized else None,
-                     remat=remat)
+                     remat=remat, guard=bool(guard))
     def train_step(ids, labels):
         opt.clear_grad()
         logits = model(ids)
@@ -96,14 +100,15 @@ def build_gpt_train_step(optimized=True, remat=None):
     return train_step, ids, labels
 
 
-def gpt_roofline_report(optimized=True, remat=None):
+def gpt_roofline_report(optimized=True, remat=None, guard=False):
     """(RooflineReport, CostReport) for the gpt hybrid train step —
     shared by the gate metrics and the bench.py --worker-profile lane."""
     from paddle_tpu.analysis.cost_audit import audit_memory
     from paddle_tpu.observability import profile
 
     train_step, ids, labels = build_gpt_train_step(optimized=optimized,
-                                                   remat=remat)
+                                                   remat=remat,
+                                                   guard=guard)
     jaxpr, infos = train_step.traced_program(ids, labels)
     report = profile.profile_traced(jaxpr, where="<gpt_hybrid_train>")
     _findings, cost = audit_memory(jaxpr, where="<gpt_hybrid_train>",
@@ -257,10 +262,44 @@ def target_quantization():
     return out
 
 
+def target_sentinel():
+    """The training sentinel's detection-cost contract, measured on the
+    SAME optimized flagship the gpt_hybrid_train target gates: trace
+    the guarded build (``to_static(guard=True)`` +
+    ``AdamW(guard=True)``) and compare its cost-model bytes/step
+    against the unguarded one.  The headline metric is
+    ``guard_bytes_overhead_pct`` — the <2% acceptance bar of the
+    in-trace-probes design (the fused Adam kernel reduces grad
+    sum-of-squares while g is already in registers, so the probe's
+    bytes are the tiny partials/summary plumbing plus the rank-1
+    unfused reductions).  The zero-extra-compiles half of the contract
+    is a recompile-log proof, pinned in tests/test_sentinel.py."""
+    import gc
+
+    rep_off, _cost_off = gpt_roofline_report()
+    # the unguarded build's model holds reference cycles; un-collected,
+    # its state tensors are still registry-live and ride into the
+    # guarded trace as extra lifted inputs, inflating the liveness
+    # peak estimate by a whole phantom model
+    gc.collect()
+    rep_on, cost_on = gpt_roofline_report(guard=True)
+    overhead = 100.0 * (rep_on.total_bytes
+                        / max(1, rep_off.total_bytes) - 1.0)
+    return {
+        "guard_bytes_per_step": rep_on.total_bytes,
+        "guard_bytes_overhead_pct": round(max(0.0, overhead), 3),
+        "guard_flops_overhead_pct": round(max(0.0, 100.0 * (
+            rep_on.total_flops / max(1, rep_off.total_flops) - 1.0)), 3),
+        "guard_peak_hbm_mb": round(cost_on.peak_hbm_bytes / (1 << 20),
+                                   3),
+    }
+
+
 TARGETS = {
     "gpt_hybrid_train": target_gpt_hybrid_train,
     "serving": target_serving,
     "quantization": target_quantization,
+    "sentinel": target_sentinel,
 }
 
 
