@@ -47,6 +47,7 @@ BERT_TPU_S = 180
 ERNIE_TPU_S = 180
 SERVING_TPU_S = 150
 ROUTER_S = 240
+FLEETSERVING_S = 300
 SHARDLINT_S = 150
 RACELINT_S = 90
 NUMLINT_S = 150
@@ -975,6 +976,156 @@ def worker_router():
     return 0
 
 
+def worker_fleetserving():
+    """Multi-host serving-fleet lane: a REAL 4-process fleet
+    (controller + 2 replica workers + 1 prespawned spare, each its own
+    OS process rendezvousing through ``paddle_tpu.distributed.launch``)
+    driven through a mixed trace with one SIGKILL and one SIGSTOP-wedge
+    mid-decode.  Pure CPU (the lane tracks cross-process failover
+    detection latency, zero-loss migration, and warm respawn-elsewhere
+    cost — all host-side effects), so its numbers ride along on every
+    BENCH report.
+
+    Reports (merged into every BENCH line):
+      fleetserving_tokens_per_s       — fleet decode throughput under
+                                        the trace, BOTH failovers in
+                                        the measured window
+      fleetserving_failover_detect_ms — median RPC-abort latency from
+                                        fault to watchdog DEAD verdict
+      fleetserving_respawn_ms         — respawn-elsewhere wall (boot on
+                                        the spare rank, warm from the
+                                        shared AOT cache)
+      fleetserving_failover_count     — failovers absorbed (>= 2 by
+                                        construction, or the lane fails)
+    """
+    import shutil
+    import signal
+    import socket
+    import statistics
+    import tempfile
+
+    import numpy as np
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(repo, "paddle_tpu", "serving", "fleet",
+                          "worker.py")
+    tdir = tempfile.mkdtemp(prefix="ptpu_fleetsrv_bench_")
+    out_dir = os.path.join(tdir, "out")
+    cache_dir = os.path.join(tdir, "cache")
+    os.makedirs(out_dir)
+    os.makedirs(cache_dir)
+
+    kill_rank, wedge_rank, spare_rank = 1, 2, 3
+    rng = np.random.default_rng(0)
+    prompts = [list(int(t) for t in rng.integers(1, 256, ln))
+               for ln in (3, 7, 12, 5, 9, 2, 11, 6)]
+    scenario = {
+        "seed": 0,
+        "model": {"vocab_size": 256, "hidden_size": 64,
+                  "num_layers": 2, "num_heads": 4, "max_seq_len": 128,
+                  "dropout": 0.0, "attention_dropout": 0.0},
+        "engine": {"max_num_seqs": 4, "page_size": 4,
+                   "max_model_len": 48,
+                   "prefill_buckets": [8, 16, 32]},
+        "cache_dir": cache_dir, "out_dir": out_dir,
+        "controller_rank": 0, "worker_ranks": [kill_rank, wedge_rank],
+        "spare_ranks": [spare_rank],
+        "prompts": prompts,
+        "sampling": [{"max_new_tokens": 10,
+                      "temperature": 0.7 if i % 2 else 0.0,
+                      "top_k": 20 if i % 3 else 0, "seed": i}
+                     for i in range(len(prompts))],
+        # one replica SIGKILLed, the other SIGSTOP-wedged mid-decode:
+        # throughput is measured with BOTH recoveries in the loop
+        "faults": {
+            str(kill_rank): [{"site": "serving.fleet.step",
+                              "kind": "rank_kill", "at": 5}],
+            str(wedge_rank): [{"site": "serving.fleet.step",
+                               "kind": "wedge", "at": 8}],
+        },
+        "serve_budget_s": 120.0, "finalize_s": 6.0,
+    }
+    scenario_path = os.path.join(tdir, "scenario.json")
+    with open(scenario_path, "w") as fh:
+        json.dump(scenario, fh)
+
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "PTPU_FLEET_TIMEOUT_S": "10",
+        "PTPU_FLEET_KV_SLICE_S": "0.25",
+        "PTPU_FLEET_HB_INTERVAL_S": "0.4",
+        "PTPU_FLEET_RENDEZVOUS_TIMEOUT_S": "20",
+        "PADDLE_LAUNCH_ID": f"benchfleetsrv{os.getpid()}",
+    })
+    for k in ("PADDLE_MASTER", "PADDLE_NNODES", "PADDLE_TRAINER_ID"):
+        env.pop(k, None)
+    procs = {
+        r: subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--master", f"127.0.0.1:{port}", "--nnodes", "4",
+             "--rank", str(r), worker, scenario_path],
+            cwd=repo, env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        for r in range(4)}
+    ctl_path = os.path.join(out_dir, "controller.json")
+    try:
+        deadline = time.monotonic() + 180.0
+        while not os.path.exists(ctl_path):
+            assert procs[0].poll() is None, (
+                f"controller exited rc={procs[0].returncode} without "
+                f"a result")
+            assert time.monotonic() < deadline, "fleet lane wedged"
+            time.sleep(0.2)
+        # the wedged rank is frozen by a real SIGSTOP — put it down so
+        # the reap below can finish
+        if procs[wedge_rank].poll() is None:
+            procs[wedge_rank].kill()
+        for r, p in procs.items():
+            p.wait(timeout=max(1.0, deadline - time.monotonic()))
+        for r in (kill_rank, wedge_rank):
+            assert procs[r].returncode == -signal.SIGKILL, (
+                f"rank {r} rc={procs[r].returncode}")
+
+        with open(ctl_path) as fh:
+            res = json.load(fh)
+        # lane contracts, gated BEFORE the result line prints
+        assert len(res["fleet"]) == len(res["ref"]) == len(prompts)
+        for want, got in zip(res["ref"], res["fleet"]):
+            assert got["tokens"] == want["tokens"], (
+                "data loss across failover")
+            assert got["stream_tokens"] == got["tokens"], got
+            assert got["stream_fins"] == 1, got
+        dets = res["detections"]
+        assert {d["rank"] for d in dets} == {kill_rank, wedge_rank}
+        assert all(d["detect_s"] <= 11.0 for d in dets), dets
+        assert res["snapshot"]["failovers"] >= 2, res["snapshot"]
+        assert res["respawn_ms"], "no respawn recorded"
+        assert res["boots"][0].get("warm") is True, (
+            f"respawn on the spare was a cold boot: {res['boots']}")
+        out = {
+            "fleetserving_tokens_per_s": res["tokens_per_s"],
+            "fleetserving_failover_detect_ms": round(
+                statistics.median(d["detect_s"] for d in dets) * 1e3,
+                1),
+            "fleetserving_respawn_ms": round(res["respawn_ms"][0], 1),
+            "fleetserving_failover_count": res["snapshot"]["failovers"],
+            "fleetserving_replicas": 2,
+            "fleetserving_requests": len(prompts),
+        }
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        shutil.rmtree(tdir, ignore_errors=True)
+    print(json.dumps(out), flush=True)
+    return 0
+
+
 def worker_quant():
     """Quantization lane: the two quantized memory planes' density
     numbers (paddle_tpu/quantization — ROADMAP item 2).  Pure CPU
@@ -1390,6 +1541,8 @@ def main():
         return worker_serving()
     if "--worker-router" in sys.argv:
         return worker_router()
+    if "--worker-fleetserving" in sys.argv:
+        return worker_fleetserving()
     if "--worker-shardlint" in sys.argv:
         return worker_shardlint()
     if "--worker-racelint" in sys.argv:
@@ -1429,6 +1582,7 @@ def main():
     prof_proc = _spawn("--worker-profile", force_cpu=True)
     remat_proc = _spawn("--worker-remat", force_cpu=True)
     router_proc = _spawn("--worker-router", force_cpu=True)
+    fleetsrv_proc = _spawn("--worker-fleetserving", force_cpu=True)
     quant_proc = _spawn("--worker-quant", force_cpu=True)
 
     probe_proc = _spawn("--probe", force_cpu=False)
@@ -1522,6 +1676,15 @@ def main():
         # same rationale: a router-lane failure degrades only its keys
         merged["router_error"] = str(router_err)
 
+    fleetsrv_res, fleetsrv_err, _ = _await_json(fleetsrv_proc,
+                                                FLEETSERVING_S)
+    if fleetsrv_res is not None:
+        merged.update(fleetsrv_res)
+    else:
+        # same rationale: a serving-fleet-lane failure degrades only
+        # its own keys
+        merged["fleetserving_error"] = str(fleetsrv_err)
+
     quant_res, quant_err, _ = _await_json(quant_proc, QUANT_S)
     if quant_res is not None:
         merged.update(quant_res)
@@ -1564,6 +1727,8 @@ def main():
         _adopt_lane("profile_", "profile_bytes_per_step", prof_err)
         _adopt_lane("remat_", "remat_bytes_saved_pct", remat_err)
         _adopt_lane("router_", "router_tokens_per_s", router_err)
+        _adopt_lane("fleetserving_", "fleetserving_tokens_per_s",
+                    fleetsrv_err)
         _adopt_lane("quant_", "quant_kv_bytes_per_token_int8", quant_err)
         if merged.get("probe_killed"):
             # the fallback note must record that the leaked probe was

@@ -39,6 +39,16 @@ site                   where / supported kinds
                        process (the dead-host fault of the
                        multi-process chaos suite; only meaningful in a
                        sacrificial worker subprocess)
+``serving.fleet.step`` serving-fleet replica step loop
+                       (``serving/fleet/server.py``) — ``rank_kill``
+                       (dead serving host), ``wedge`` (SIGSTOP the
+                       whole process: alive to the OS, frozen to the
+                       fleet — the watchdog-TIMEOUT fault, as opposed
+                       to the crash fault; ``payload["park_s"]``
+                       parks only the calling thread for that many
+                       seconds instead, the in-process variant whose
+                       heartbeats stop because the chaos harness beats
+                       from the parked loop), ``exception``, ``slow``
 ``optimizer.grads``    ``Optimizer.step`` gradient intake (eager) —
                        ``bitflip`` flips one mantissa/exponent bit of
                        one gradient element (silent data corruption:
@@ -85,7 +95,7 @@ __all__ = [
 ]
 
 KINDS = ("torn_write", "exception", "preempt", "pool_exhaust", "slow",
-         "rank_kill", "bitflip", "nan_grad")
+         "rank_kill", "wedge", "bitflip", "nan_grad")
 
 
 class WorkerFault(RuntimeError):
@@ -291,6 +301,27 @@ def fire(site, **ctx):
         sys.stderr.flush()
         sys.stdout.flush()
         os.kill(os.getpid(), signal.SIGKILL)
+    if spec.kind == "wedge":
+        # the wedged-host fault: unlike rank_kill the process stays
+        # ALIVE to the OS but stops making progress — heartbeats cease
+        # and only the watchdog's bounded-timeout DEAD verdict can
+        # unblock the fleet (the timeout path, not the crash path).
+        # Default is a real SIGSTOP (freezes every thread, including a
+        # heartbeat publisher thread); ``payload["park_s"]`` parks just
+        # the calling thread for a bounded time instead — the
+        # in-process variant for tests whose beats are driven from the
+        # parked loop itself.
+        park_s = spec.payload.get("park_s")
+        if park_s is not None:
+            time.sleep(float(park_s))
+            return spec
+        import os
+        import signal
+        import sys
+        sys.stderr.flush()
+        sys.stdout.flush()
+        os.kill(os.getpid(), signal.SIGSTOP)
+        return spec
     return spec
 
 

@@ -614,6 +614,13 @@ class HeartbeatPublisher:
     The key namespace is re-read every publish, so a reconfigure's
     generation bump redirects beats automatically.
 
+    ``payload_fn`` (optional) is called once per beat, outside the
+    publisher lock, and its dict rides along as
+    ``payload["telemetry"]`` — the serving fleet uses it to ship queue
+    depth / page occupancy / health state with every heartbeat
+    (docs/serving.md "Multi-host fleet").  A failing payload_fn never
+    suppresses the beat: liveness must not hinge on telemetry.
+
     Fault site ``fleet.heartbeat`` (kinds ``exception`` / ``slow``):
     an injected exception skips that beat (counted in
     ``missed_beats``) — the publisher thread itself must survive, a
@@ -621,7 +628,7 @@ class HeartbeatPublisher:
     """
 
     def __init__(self, client=None, rank=None, interval_s=None,
-                 world_fn=None, time_fn=time.time):
+                 world_fn=None, time_fn=time.time, payload_fn=None):
         self._client = client if client is not None else _client()
         self._world_fn = world_fn or world
         self._rank = (int(rank) if rank is not None
@@ -629,6 +636,11 @@ class HeartbeatPublisher:
         self._interval = (float(interval_s) if interval_s is not None
                           else get_config().heartbeat_interval_s)
         self._time = time_fn
+        # optional per-beat telemetry (serving fleet: queue depth, page
+        # occupancy, health state) merged as payload["telemetry"]; the
+        # callable runs OUTSIDE the publisher lock — it reads engine
+        # state that takes its own locks (RL103)
+        self._payload_fn = payload_fn
         self._lock = threading.Lock()
         self._seq = 0
         self._progress = 0
@@ -682,10 +694,19 @@ class HeartbeatPublisher:
                 self.missed_beats += 1
             return False
         now = self._time()          # user-supplied clock: never call it
-        with self._lock:            # under the publisher lock (RL103)
+        telemetry = None            # under the publisher lock (RL103)
+        if self._payload_fn is not None:
+            try:
+                telemetry = self._payload_fn()
+            except Exception:
+                telemetry = None    # beat still goes out (liveness
+                #                     must not hinge on telemetry)
+        with self._lock:
             self._seq += 1
             payload = {"seq": self._seq, "t": now,
                        "progress": self._progress}
+            if telemetry is not None:
+                payload["telemetry"] = telemetry
         key = f"{coord_namespace()}/fleet/hb/{self._rank}"
         try:
             kv_set_bytes(self._client, key,
@@ -787,6 +808,9 @@ class FleetMonitor:
         self._lock = threading.Lock()
         self._seen = {}      # rank -> (seq, progress, first_seen_local,
         #                               seq_local, progress_local)
+        self._payloads = {}  # rank -> latest full beat payload (carries
+        #                      the serving "telemetry" dict when the
+        #                      publisher has a payload_fn)
         self._states = {}    # rank -> RankState
         self._quarantined = {}   # rank -> reason (sticky SUSPECT)
         self.transitions = []  # [(rank, old, new, age_s)]
@@ -826,6 +850,7 @@ class FleetMonitor:
                 seen = self._seen.get(r)
                 b = beats.get(r)
                 if b is not None:
+                    self._payloads[r] = b
                     if seen is None or b["seq"] > seen[0]:
                         prog_local = (now if seen is None
                                       or b.get("progress", 0) > seen[1]
@@ -897,6 +922,18 @@ class FleetMonitor:
     def is_dead(self, rank):
         with self._lock:
             return self._states.get(rank) is RankState.DEAD
+
+    def telemetry(self, rank):
+        """Latest beat payload's ``telemetry`` dict for `rank` (the
+        serving fleet publishes queue depth / page occupancy / health
+        state per beat), or None when the rank has never beaten or
+        beats carry no telemetry."""
+        with self._lock:
+            b = self._payloads.get(int(rank))
+        if b is None:
+            return None
+        t = b.get("telemetry")
+        return dict(t) if isinstance(t, dict) else None
 
     # ---- external quarantine (SDC digest vote) ----
     def mark_suspect(self, rank, reason=None):

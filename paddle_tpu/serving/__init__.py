@@ -29,7 +29,7 @@ the persisted AOT program cache (:mod:`paddle_tpu.serving.aot_cache`).
 
 See docs/serving.md for the architecture and the request lifecycle.
 """
-from paddle_tpu.serving import router
+from paddle_tpu.serving import fleet, router
 from paddle_tpu.serving.aot_cache import (AOTProgramCache,
                                           engine_fingerprint)
 from paddle_tpu.serving.engine import (EngineConfig, LLMEngine,
@@ -57,6 +57,7 @@ __all__ = [
     "bucket_for",
     "default_buckets",
     "engine_fingerprint",
+    "fleet",
     "router",
     "sample_tokens",
 ]

@@ -619,6 +619,183 @@ class LLMEngine:
                 pass
         return reqs
 
+    # ------------------------------------- disaggregated page handoff
+    def export_page_state(self, request_id, release=True):
+        """Disaggregated prefill→decode hook: snapshot one RUNNING
+        request's KV pages + scheduler state into a host dict a DECODE
+        engine can :meth:`import_page_state` — the page-moving
+        counterpart of token-only adoption, for when re-running prefill
+        on the target is the cost being disaggregated away.
+
+        The payload carries, per layer, the request's owned pages
+        gathered from the (possibly quantized ``(codes, scales)``)
+        pools, plus prompt/generated tokens, sampling params, stream
+        watermark, deadline AGE (``metrics.clock`` is per-process — the
+        absolute ``arrive_t`` never crosses a process boundary), and
+        the pool geometry the importer validates against.  With
+        `release` (default) the request leaves this engine entirely —
+        slot, pages and live-table entry — so prefill workers stay
+        empty-handed between handoffs."""
+        req = self._requests.get(request_id)
+        if req is None or req.slot is None:
+            raise ValueError(
+                f"request {request_id!r} is not running here — only a "
+                f"RUNNING (slot-owning) request has pages to export")
+        slot = req.slot
+        cfg = self.config
+        L = int(self._lens[slot])
+        pages = list(self._alloc.owned_pages(slot))
+        layers = []
+        for k_pool, v_pool in zip(self._k_pools, self._v_pools):
+            if self._kv_quant is None:
+                layers.append({
+                    "k": np.asarray(k_pool)[pages],
+                    "v": np.asarray(v_pool)[pages]})
+            else:
+                layers.append({
+                    "k_codes": np.asarray(k_pool[0])[pages],
+                    "k_scales": np.asarray(k_pool[1])[pages],
+                    "v_codes": np.asarray(v_pool[0])[pages],
+                    "v_scales": np.asarray(v_pool[1])[pages]})
+        sp = req.sampling_params
+        state = {
+            "prompt_token_ids": list(req.prompt_token_ids),
+            "output_token_ids": list(req.output_token_ids),
+            "streamed": int(req._streamed),
+            "age_s": max(0.0, self.metrics.clock() - req.arrive_t),
+            "arrival_index": int(req.arrival_index),
+            "len": L,
+            "sampling_params": {
+                "max_new_tokens": sp.max_new_tokens,
+                "temperature": sp.temperature,
+                "top_k": sp.top_k, "top_p": sp.top_p, "seed": sp.seed,
+                "eos_token_id": sp.eos_token_id,
+                "deadline_s": sp.deadline_s,
+            },
+            "geometry": {
+                "page_size": cfg.page_size,
+                "num_layers": self._num_layers,
+                "num_heads": self._num_heads,
+                "head_dim": self._head_dim,
+                "kv_cache_dtype": cfg.kv_cache_dtype,
+                "dtype": str(np.dtype(cfg.dtype)),
+            },
+            "layers": layers,
+        }
+        with span("serving.page_export", request=request_id,
+                  pages=len(pages), tokens=L, release=bool(release)):
+            if release:
+                req.transition(RequestState.EVICTED)
+                self._release_slot(req)
+                self._requests.pop(request_id, None)
+        return state
+
+    def import_page_state(self, state, stream=None):
+        """Decode-side half of the disaggregated handoff: rebuild the
+        exported request in THIS engine — allocate fresh pages, write
+        the shipped KV blocks into the local pools (eager ``.at[]``
+        scatter: no new compiled program, the bounded-compile contract
+        is untouched), and enter the request directly at DECODE.  Token
+        identity is inherited from the deterministic ``(seed, absolute
+        position)`` sampler: the next sampled position is exactly where
+        the prefill engine left off.  Returns the new request id.
+
+        Raises ``ValueError`` on a geometry mismatch and
+        :class:`AdmissionRejected` when no slot/pages are free or this
+        engine is DRAINING (the exporter still holds the state dict and
+        can retry elsewhere)."""
+        cfg = self.config
+        geo = state["geometry"]
+        mine = {"page_size": cfg.page_size,
+                "num_layers": self._num_layers,
+                "num_heads": self._num_heads,
+                "head_dim": self._head_dim,
+                "kv_cache_dtype": cfg.kv_cache_dtype,
+                "dtype": str(np.dtype(cfg.dtype))}
+        for k, want in mine.items():
+            if geo.get(k) != want:
+                raise ValueError(
+                    f"page-state geometry mismatch on {k!r}: exporter "
+                    f"{geo.get(k)!r} vs importer {want!r}")
+        sp = SamplingParams(**state["sampling_params"])
+        prompt = [int(t) for t in state["prompt_token_ids"]]
+        generated = [int(t) for t in state["output_token_ids"]]
+        self._validate_request(prompt, sp)
+        L = int(state["len"])
+        if L != len(prompt) + len(generated) - 1:
+            raise ValueError(
+                f"page-state cache length {L} does not match "
+                f"prompt+generated-1 = "
+                f"{len(prompt) + len(generated) - 1} (the newest "
+                f"token's KV is written by the NEXT decode step)")
+        if not self.health.admitting:
+            self.metrics.requests_rejected += 1
+            raise AdmissionRejected(
+                "draining",
+                f"engine {self._metrics_name} page-pool pressure "
+                f"{self.health.last_pressure:.2f}")
+        n_pages = len(state["layers"][0][
+            "k" if self._kv_quant is None else "k_codes"])
+        try:
+            slot = self._slots.index(None)
+        except ValueError:
+            self.metrics.requests_rejected += 1
+            raise AdmissionRejected(
+                "no_slot", f"engine {self._metrics_name} has no free "
+                f"decode slot for an imported request")
+        if not self._alloc.can_allocate(slot, n_pages):
+            self.metrics.requests_rejected += 1
+            raise AdmissionRejected(
+                "no_pages",
+                f"engine {self._metrics_name} cannot allocate "
+                f"{n_pages} pages for an imported request")
+        rid = f"req-{self._next_id}"
+        req = Request(rid, prompt, sp,
+                      arrival_index=int(state.get(
+                          "arrival_index", self._next_id)),
+                      stream=stream)
+        req.output_token_ids = generated
+        req._streamed = min(int(state.get("streamed", len(generated))),
+                            len(generated))
+        req.num_evictions = 1     # admitted/ttft were the exporter's
+        req.arrive_t = self.metrics.clock() - float(
+            state.get("age_s", 0.0))
+        if sp.deadline_s is not None:
+            req.deadline_t = req.arrive_t + sp.deadline_s
+        self._next_id += 1
+        self._slots[slot] = req
+        req.slot = slot
+        pages = [page for _pos, page in self._alloc.allocate(slot,
+                                                             n_pages)]
+        for pos, page in enumerate(pages):
+            self._tables[slot, pos] = page
+        idx = np.asarray(pages)
+        for li in range(self._num_layers):
+            blk = state["layers"][li]
+            if self._kv_quant is None:
+                self._k_pools[li] = self._k_pools[li].at[idx].set(
+                    jnp.asarray(blk["k"]))
+                self._v_pools[li] = self._v_pools[li].at[idx].set(
+                    jnp.asarray(blk["v"]))
+            else:
+                kc, ks = self._k_pools[li]
+                vc, vs = self._v_pools[li]
+                self._k_pools[li] = (
+                    kc.at[idx].set(jnp.asarray(blk["k_codes"])),
+                    ks.at[idx].set(jnp.asarray(blk["k_scales"])))
+                self._v_pools[li] = (
+                    vc.at[idx].set(jnp.asarray(blk["v_codes"])),
+                    vs.at[idx].set(jnp.asarray(blk["v_scales"])))
+        self._lens[slot] = L
+        req.transition(RequestState.PREFILL)
+        req.transition(RequestState.DECODE)
+        self._requests[rid] = req
+        self.metrics.requests_adopted += 1
+        with span("serving.page_import", request=rid, pages=n_pages,
+                  tokens=L):
+            pass
+        return rid
+
     def has_unfinished(self):
         return (self.scheduler.has_waiting()
                 or any(r is not None for r in self._slots))

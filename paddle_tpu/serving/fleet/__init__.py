@@ -1,0 +1,49 @@
+"""paddle_tpu.serving.fleet — elastic multi-host serving.
+
+Runs :class:`~paddle_tpu.serving.engine.LLMEngine` replicas in
+separate OS processes and fronts them with the STOCK
+:mod:`paddle_tpu.serving.router` — the router never learns its
+engines are remote.  The pieces (docs/serving.md "Multi-host fleet"):
+
+- :mod:`.wire` — a tiny ordered RPC over the coordination-service KV
+  store plus the npz page-handoff format;
+- :class:`.RemoteEngineClient` — the controller-side process-replica
+  handle with the engine surface the router drives (exactly-once
+  stream delivery from seq-numbered step responses, watchdog-aborted
+  waits, ``age_s`` deadline re-anchoring across migrations);
+- :class:`.ReplicaServer` — the worker-process serve loop around a
+  real engine, with heartbeat telemetry and the
+  ``serving.fleet.step`` chaos hook;
+- :class:`.ServingFleet` — the controller: router + fleet watchdog +
+  respawn-elsewhere onto prespawned spare ranks, booting warm from
+  the shared AOT program cache;
+- :class:`.DisaggregatedEngine` — disaggregated prefill/decode over
+  the quantized page handoff, token-identical to a monolithic run
+  within the bounded-compile contract.
+
+The multi-process entrypoint is :mod:`.worker` (spawned under
+``paddle_tpu.distributed.launch`` by the chaos proof in
+tests/test_distributed_multiprocess.py and the bench lane).
+"""
+from paddle_tpu.serving.fleet.controller import (FleetServingConfig,
+                                                 ServingFleet)
+from paddle_tpu.serving.fleet.disagg import (DisaggregatedEngine,
+                                             DisaggResult)
+from paddle_tpu.serving.fleet.handle import (FinishedRemote,
+                                             RemoteEngineClient)
+from paddle_tpu.serving.fleet.server import ReplicaServer
+from paddle_tpu.serving.fleet.wire import (RemoteReplicaError,
+                                           pack_state, unpack_state)
+
+__all__ = [
+    "DisaggResult",
+    "DisaggregatedEngine",
+    "FinishedRemote",
+    "FleetServingConfig",
+    "RemoteEngineClient",
+    "RemoteReplicaError",
+    "ReplicaServer",
+    "ServingFleet",
+    "pack_state",
+    "unpack_state",
+]

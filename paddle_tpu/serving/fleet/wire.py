@@ -1,0 +1,187 @@
+"""Serving-fleet wire protocol — a tiny RPC over the coordination KV.
+
+Every replica worker owns one request/response lane in the fleet
+namespace (docs/serving.md "Multi-host fleet"):
+
+- request  ``<ns>/serve/r<rank>/req/<seq>``   controller → replica
+- response ``<ns>/serve/r<rank>/rsp/<seq>``   replica → controller
+
+``seq`` is a per-lane monotonic counter owned by the controller, so
+the lane is strictly ordered and exactly-once by construction: each
+side deletes a key the moment it has consumed it (the coordination
+service's ``key_value_delete``), and a response is read exactly once
+before the next request is posted.  Messages are JSON dicts
+``{"m": method, "p": payload}`` / ``{"ok": bool, "r": result}`` —
+bulk binary (the disaggregated page handoff) never rides the RPC
+lane; it goes to its own ``<ns>/serve/handoff/<hid>`` key as raw npz
+bytes and the RPC carries only the ``hid``.
+
+The controller-side wait is :func:`resilience.fleet.kv_get_bytes`
+with ``abort_if`` wired to the fleet watchdog's DEAD verdict — a
+wedged replica (SIGSTOP: alive to the OS, silent to the fleet) fails
+the pending call with a :class:`~paddle_tpu.resilience.fleet.
+CollectiveTimeout` carrying ``verdict="dead-verdict"`` within one KV
+slice of the verdict, instead of burning the full RPC budget.
+
+Clock discipline: ``arrive_t`` values are per-process
+``metrics.clock`` readings and NEVER cross the wire; deadlines travel
+as ``age_s`` (time already consumed) and are re-anchored against the
+receiver's clock — which is exactly what keeps a ``deadline_s`` TTL
+counting from FIRST arrival across any number of migrations.
+"""
+from __future__ import annotations
+
+import io
+import json
+
+import numpy as np
+
+from paddle_tpu.resilience import fleet as _fleet
+from paddle_tpu.serving.request import SamplingParams
+from paddle_tpu.serving.scheduler import AdmissionRejected
+
+__all__ = [
+    "RemoteReplicaError", "req_key", "rsp_key", "handoff_key",
+    "sp_to_dict", "sp_from_dict", "post_request", "await_response",
+    "read_request", "post_response", "pack_state", "unpack_state",
+]
+
+RPC_SITE = "serving.fleet.rpc"
+
+
+class RemoteReplicaError(RuntimeError):
+    """A replica-side exception that has no typed equivalent on the
+    controller (typed backpressure — ``AdmissionRejected`` /
+    ``ValueError`` — re-raises as itself; everything else lands here
+    with the remote type name in the message)."""
+
+
+def req_key(namespace, rank, seq):
+    return f"{namespace}/serve/r{int(rank)}/req/{int(seq)}"
+
+
+def rsp_key(namespace, rank, seq):
+    return f"{namespace}/serve/r{int(rank)}/rsp/{int(seq)}"
+
+
+def handoff_key(namespace, hid):
+    return f"{namespace}/serve/handoff/{hid}"
+
+
+# ------------------------------------------------------- marshalling
+def sp_to_dict(sp):
+    if sp is None:
+        return None
+    return {"max_new_tokens": sp.max_new_tokens,
+            "temperature": sp.temperature,
+            "top_k": sp.top_k, "top_p": sp.top_p, "seed": sp.seed,
+            "eos_token_id": sp.eos_token_id,
+            "deadline_s": sp.deadline_s}
+
+
+def sp_from_dict(d):
+    if d is None:
+        return None
+    return SamplingParams(**d)
+
+
+def _marshal_error(exc):
+    err = {"type": type(exc).__name__, "msg": str(exc)}
+    if isinstance(exc, AdmissionRejected):
+        err["reason"] = exc.reason
+    return err
+
+
+def _unmarshal_error(err):
+    t = err.get("type")
+    if t == "AdmissionRejected":
+        raise AdmissionRejected(err.get("reason", "remote"),
+                                err.get("msg", ""))
+    if t == "ValueError":
+        raise ValueError(err.get("msg", ""))
+    raise RemoteReplicaError(f"{t}: {err.get('msg', '')}")
+
+
+# ------------------------------------------------- controller side
+def post_request(client, namespace, rank, seq, method, payload):
+    msg = {"m": str(method), "p": payload}
+    _fleet.kv_set_bytes(client, req_key(namespace, rank, seq),
+                        json.dumps(msg).encode())
+
+
+def await_response(client, namespace, rank, seq, timeout_s, *,
+                   abort_if=None, config=None):
+    """Block for the replica's response to `seq`; raises
+    ``CollectiveTimeout`` (watchdog verdict or deadline) or the
+    re-raised remote exception; returns the result value."""
+    key = rsp_key(namespace, rank, seq)
+    raw = _fleet.kv_get_bytes(client, key, timeout_s, site=RPC_SITE,
+                              missing_rank=int(rank),
+                              abort_if=abort_if, config=config)
+    try:
+        client.key_value_delete(key)
+    except Exception:
+        pass            # namespace reap at finalize() catches leaks
+    rsp = json.loads(bytes(raw).decode())
+    if not rsp.get("ok"):
+        _unmarshal_error(rsp.get("err", {}))
+    return rsp.get("r")
+
+
+# ---------------------------------------------------- replica side
+def read_request(client, namespace, rank, seq, timeout_s, *,
+                 config=None):
+    """Replica-side blocking read of request `seq` (short, so the
+    serve loop can interleave heartbeat/stop checks); raises
+    ``CollectiveTimeout`` on an empty slice window."""
+    key = req_key(namespace, rank, seq)
+    raw = _fleet.kv_get_bytes(client, key, timeout_s,
+                              site="serving.fleet.recv",
+                              config=config)
+    try:
+        client.key_value_delete(key)
+    except Exception:
+        pass
+    msg = json.loads(bytes(raw).decode())
+    return msg["m"], msg.get("p")
+
+
+def post_response(client, namespace, rank, seq, result=None,
+                  error=None):
+    rsp = ({"ok": False, "err": _marshal_error(error)}
+           if error is not None else {"ok": True, "r": result})
+    _fleet.kv_set_bytes(client, rsp_key(namespace, rank, seq),
+                        json.dumps(rsp).encode())
+
+
+# --------------------------------------- page-handoff serialization
+def pack_state(state):
+    """``LLMEngine.export_page_state`` dict → one npz byte blob (JSON
+    header under ``__meta__``, per-layer KV blocks as arrays) — the
+    handoff wire format (docs/serving.md)."""
+    arrays = {}
+    for li, blk in enumerate(state["layers"]):
+        for name, arr in blk.items():
+            arrays[f"L{li}.{name}"] = np.asarray(arr)
+    meta = {k: v for k, v in state.items() if k != "layers"}
+    meta["num_layers"] = len(state["layers"])
+    arrays["__meta__"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8)
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def unpack_state(blob):
+    """Inverse of :func:`pack_state`."""
+    with np.load(io.BytesIO(bytes(blob))) as z:
+        meta = json.loads(bytes(z["__meta__"].tobytes()).decode())
+        n = int(meta.pop("num_layers"))
+        layers = []
+        for li in range(n):
+            prefix = f"L{li}."
+            layers.append({k[len(prefix):]: z[k] for k in z.files
+                           if k.startswith(prefix)})
+    state = dict(meta)
+    state["layers"] = layers
+    return state

@@ -1,0 +1,289 @@
+"""Serving-fleet worker — the per-process entrypoint of the
+multi-host serving chaos proof and the ``--worker-fleetserving``
+bench lane.
+
+Launched one OS process per coordination rank through ``python -m
+paddle_tpu.distributed.launch <this file> <scenario.json>``.  The
+scenario file assigns roles by rank:
+
+- ``controller_rank`` (global rank 0, so the coordination service
+  outlives every peer): computes the MONOLITHIC reference run first
+  (same seed → same weights; its warmup also compiles the program
+  ladder into the shared AOT cache so every replica boots warm), then
+  drives a :class:`~paddle_tpu.serving.fleet.controller.ServingFleet`
+  through the full trace, the disaggregated prefill/decode phase, and
+  the per-replica compile audit, and writes ``controller.json``;
+- every ``worker_ranks`` / ``spare_ranks`` member runs a
+  :class:`~paddle_tpu.serving.fleet.server.ReplicaServer` (spares
+  idle until a respawn's ``boot`` claims them) with a heartbeat
+  publisher shipping live engine telemetry, and writes
+  ``replica-rank<N>.json`` on clean shutdown.
+
+Chaos comes from the scenario's ``faults`` table (rank → FaultSpec
+dicts, fired at the ``serving.fleet.step`` site): ``rank_kill``
+SIGKILLs a replica mid-decode, ``wedge`` SIGSTOPs one — the parent
+test must SIGKILL a wedged child once ``controller.json`` appears.
+
+Everything exits via ``fleet.finalize()`` + ``os._exit`` — after a
+peer died by design, the jax shutdown barrier can never complete.
+"""
+import json
+import os
+import sys
+import time
+
+
+def _load_cfg():
+    with open(sys.argv[1]) as fh:
+        return json.load(fh)
+
+
+def _write_result(out_dir, name, result):
+    path = os.path.join(out_dir, name)
+    with open(path + ".tmp", "w") as fh:
+        json.dump(result, fh, default=str)
+    os.replace(path + ".tmp", path)
+
+
+def _sps(dicts):
+    from paddle_tpu.serving.fleet import wire
+    return [wire.sp_from_dict(d) for d in dicts]
+
+
+# ------------------------------------------------------------ replica
+def run_replica(cfg, grank):
+    from paddle_tpu.resilience import faultinject
+    from paddle_tpu.resilience import fleet as flt
+    from paddle_tpu.serving.fleet.server import ReplicaServer
+
+    specs = [faultinject.FaultSpec(**d)
+             for d in (cfg.get("faults") or {}).get(str(grank), [])]
+    if specs:
+        faultinject.install(faultinject.FaultInjector(
+            faultinject.FaultPlan(specs, seed=grank,
+                                  name="fleetserving-chaos")))
+
+    def factory(payload):
+        import paddle_tpu as P
+        from paddle_tpu import serving
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+        P.seed(int(cfg["seed"]))       # identical weights fleet-wide
+        model = GPTForCausalLM(GPTConfig(**cfg["model"]))
+        return serving.LLMEngine(
+            model, serving.EngineConfig(**cfg["engine"]),
+            program_cache=cfg.get("cache_dir"),
+            metrics_name=f"serving.fleet.r{grank}")
+
+    server = ReplicaServer(flt._client(), grank, factory)
+    flt.install_publisher(
+        flt.HeartbeatPublisher(payload_fn=server.telemetry).start())
+    server.serve()
+
+    result = {"role": "replica", "rank": grank, "steps": server.steps,
+              "requests_served": server.requests_served}
+    eng = server.engine
+    if eng is not None:
+        m = eng.metrics
+        result.update(compiled=int(m.compile_count),
+                      bound=int(m.compile_bound),
+                      cache_loads=int(m.aot_cache_loads),
+                      generated_tokens=int(m.generated_tokens))
+    _write_result(cfg["out_dir"], f"replica-rank{grank}.json", result)
+
+
+# --------------------------------------------------------- controller
+def run_controller(cfg, grank):
+    import paddle_tpu as P
+    from paddle_tpu import serving
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.resilience import fleet as flt
+    from paddle_tpu.serving.fleet import (DisaggregatedEngine,
+                                          FleetServingConfig,
+                                          ServingFleet)
+    from paddle_tpu.serving.router.router import RouterConfig
+    from paddle_tpu.serving.scheduler import AdmissionRejected
+
+    prompts = [[int(t) for t in p] for p in cfg["prompts"]]
+    sps = _sps(cfg["sampling"])
+    dprompts = [[int(t) for t in p]
+                for p in cfg.get("disagg_prompts", [])]
+    dsps = _sps(cfg.get("disagg_sampling", []))
+
+    # ---- monolithic reference: the zero-loss yardstick (same seed →
+    # same weights), and its warmup compiles the ladder INTO the
+    # shared AOT cache so every replica boot — respawns included —
+    # classifies warm
+    P.seed(int(cfg["seed"]))
+    model = GPTForCausalLM(GPTConfig(**cfg["model"]))
+    ref_engine = serving.LLMEngine(
+        model, serving.EngineConfig(**cfg["engine"]),
+        program_cache=cfg.get("cache_dir"),
+        metrics_name="serving.fleet.reference")
+    ref_engine.warmup()
+    ref = ref_engine.generate(prompts, sps)
+    dref = ref_engine.generate(dprompts, dsps) if dprompts else []
+    result = {"role": "controller", "rank": grank,
+              "ref": [{"tokens": r.output_token_ids,
+                       "finish_reason": r.finish_reason} for r in ref],
+              "disagg_ref": [{"tokens": r.output_token_ids,
+                              "finish_reason": r.finish_reason}
+                             for r in dref]}
+    ref_engine.shutdown()
+
+    flt.install_publisher(flt.HeartbeatPublisher().start())
+    sfleet = ServingFleet(
+        flt._client(),
+        FleetServingConfig(cfg["worker_ranks"],
+                           cfg.get("spare_ranks", ()),
+                           boot_payload={}),
+        router_config=RouterConfig(sleep=lambda s: None))
+
+    # per-request stream collectors: the exactly-once evidence (the
+    # streamed prefix must equal the final token history, with exactly
+    # one fin, across any number of mid-stream failovers)
+    streams = {}
+
+    def _collector():
+        rec = {"tokens": [], "fins": 0}
+
+        def _stream(rid, tok, fin):
+            if tok is not None:
+                rec["tokens"].append(int(tok))
+            if fin:
+                rec["fins"] += 1
+
+        return rec, _stream
+
+    t0 = time.perf_counter()
+    rids = []
+    for p, sp in zip(prompts, sps):
+        rec, stream = _collector()
+        deadline = time.monotonic() + 30.0
+        while True:
+            try:
+                rid = sfleet.router.add_request(p, sp, stream=stream)
+                break
+            except AdmissionRejected:
+                if time.monotonic() > deadline:
+                    raise
+                sfleet.step()      # productive backpressure wait
+        rids.append(rid)
+        streams[rid] = rec
+
+    budget = float(cfg.get("serve_budget_s", 120.0))
+    while sfleet.router.has_unfinished():
+        if time.perf_counter() - t0 > budget:
+            break                  # report partial state, never hang
+        sfleet.step()
+    serve_s = time.perf_counter() - t0
+
+    fleet_res = []
+    total = 0
+    for rid in rids:
+        rr = sfleet.router.finished_results.pop(rid, None)
+        rec = streams[rid]
+        toks = (None if rr is None
+                else [int(t) for t in rr.output_token_ids])
+        total += len(toks or ())
+        fleet_res.append({
+            "rid": rid, "tokens": toks,
+            "finish_reason": None if rr is None else rr.finish_reason,
+            "migrations": None if rr is None else rr.migrations,
+            "stream_tokens": rec["tokens"],
+            "stream_fins": rec["fins"]})
+    result["fleet"] = fleet_res
+    result["serve_s"] = round(serve_s, 3)
+    result["tokens_per_s"] = (round(total / serve_s, 2)
+                              if serve_s > 0 else None)
+
+    # ---- disaggregated prefill/decode across two live replicas
+    live = [h for h in sfleet.router.replicas if h.alive]
+    if dprompts and live:
+        prefill, decode = live[0].engine, live[-1].engine
+        disagg = DisaggregatedEngine(prefill, decode,
+                                     client=sfleet.client)
+        dres = disagg.generate(dprompts, dsps)
+        result["disagg"] = [{"tokens": r.tokens,
+                             "finish_reason": r.finish_reason,
+                             "finished_on": r.finished_on}
+                            for r in dres]
+        result["disagg_ranks"] = [prefill.rank, decode.rank]
+        result["handoffs"] = disagg.handoffs
+        result["handoff_bytes"] = disagg.handoff_bytes
+
+    audits = {}
+    for h in live:
+        try:
+            audits[str(h.engine.rank)] = h.engine.call("audit")
+        except Exception as e:            # audit must not mask results
+            audits[str(h.engine.rank)] = {"error": str(e)}
+    result["audits"] = audits
+    result["detections"] = sfleet.detections()
+    result["respawn_ms"] = sfleet.respawn_ms
+    result["boots"] = [dict(h.boot_info or {})
+                       for h in sfleet.router.replicas]
+    snap = sfleet.router.snapshot()
+    result["snapshot"] = {k: snap.get(k)
+                          for k in ("failovers", "respawns",
+                                    "adoptions", "spillovers",
+                                    "requests_finished")}
+    result["assigned"] = {str(i): sfleet.rank_of(i)
+                          for i in range(len(cfg["worker_ranks"]))}
+
+    sfleet.shutdown()
+    _write_result(cfg["out_dir"], "controller.json", result)
+
+
+def _detach_local_backend():
+    """Detach XLA from the multi-process world, keeping ONLY the
+    coordination client.  Replicas are independent single-host engines
+    — the fleet shares a KV fabric, never an XLA collective domain —
+    and a single-host backend is what makes AOT-cache executables
+    PORTABLE across the fleet: a multihost backend pins global device
+    ids into serialized programs, which no OTHER process can address
+    ("Device assignment ... does not have any local devices"), so
+    every boot would cold-compile and warm respawn would be a lie."""
+    import jax
+    from jax._src import distributed as jd
+    from jax._src import xla_bridge as xb
+    client = jd.global_state.client
+    jd.global_state.client = None
+    jd.global_state.process_id = 0
+    jd.global_state.num_processes = 1
+    xb._clear_backends()
+    jax.devices()            # rebuild: plain single-host CPU client
+    jd.global_state.client = client
+
+
+def main():
+    cfg = _load_cfg()
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    import paddle_tpu as P  # noqa: F401  (installs shims)
+    from paddle_tpu.distributed import mesh as _mesh
+    from paddle_tpu.resilience import fleet as flt
+
+    grank = jax.process_index()
+    # pin the TRUE world before detaching: after the detach,
+    # jax.process_index()/count() read the single-host backend, so the
+    # fleet layer must carry the launch-time membership explicitly
+    flt._set_world(flt.WorldView(range(jax.process_count()), grank,
+                                 launch_id=flt._ensure_launch_id()))
+    _detach_local_backend()
+    _mesh.set_mesh(Mesh(np.asarray(jax.local_devices()), ("dp",)))
+    if grank == int(cfg.get("controller_rank", 0)):
+        run_controller(cfg, grank)
+        # bounded linger: dead-by-design peers never check out
+        flt.finalize(timeout_s=float(cfg.get("finalize_s", 6.0)))
+    else:
+        run_replica(cfg, grank)
+        flt.finalize()
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

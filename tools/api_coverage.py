@@ -82,8 +82,9 @@ def has_attr_path(obj, name):
 # paddle_tpu-NATIVE namespaces with no reference-paddle analogue: their
 # declared public surface (__all__) is the contract; a name that stops
 # resolving is a regression exactly like a reference-parity gap.
-NATIVE_NAMESPACES = ("serving", "serving.router", "analysis",
-                     "observability", "quantization", "resilience")
+NATIVE_NAMESPACES = ("serving", "serving.router", "serving.fleet",
+                     "analysis", "observability", "quantization",
+                     "resilience")
 
 
 def collect_native():

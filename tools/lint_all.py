@@ -39,7 +39,10 @@ The static gates compare against their checked-in baselines and fail
 only on REGRESSIONS; the chaos gate re-proves the resilience contracts
 (torn-checkpoint + preemption training resume matches the fault-free
 trajectory; serving pool-exhaustion + mid-decode-fault recovery stays
-token-identical under the compile bound — docs/resilience.md).  So
+token-identical under the compile bound — docs/resilience.md; the
+multi-host serving fleet keeps streams exactly-once and output
+token-identical through SIGKILL and SIGSTOP-wedge failovers —
+docs/serving.md "Multi-host fleet").  So
 `python tools/lint_all.py` exits 0 on a healthy tree and nonzero the
 moment any gate slips.  The `lint`-marked pytest test
 (tests/test_lint_all.py) shells out to this script, which is how tier-1
@@ -90,6 +93,7 @@ GATES = {
               os.path.join(REPO, "tests", "test_resilience.py"),
               os.path.join(REPO, "tests", "test_fleet.py"),
               os.path.join(REPO, "tests", "test_sentinel.py"),
+              os.path.join(REPO, "tests", "test_serving_fleet.py"),
               os.path.join(REPO, "tests",
                            "test_distributed_multiprocess.py")],
 }
