@@ -51,6 +51,7 @@ FLEETSERVING_S = 300
 SHARDLINT_S = 150
 RACELINT_S = 90
 NUMLINT_S = 150
+KERNLINT_S = 150
 OBS_S = 150
 RESIL_S = 150
 FLEET_S = 150
@@ -1217,6 +1218,26 @@ def worker_numlint():
     return 0
 
 
+def worker_kernlint():
+    """Static-analysis lane #4: kernlint's KLxxx audit of every Pallas
+    kernel interior (finding count + per-rule breakdown over the
+    flagship, the serving programs, and each ops/pallas kernel traced
+    standalone in interpret mode).  Pure CPU trace, concurrent with
+    the probe — every BENCH run records the kernel-interior hazard
+    picture next to the numerics audit."""
+    _init_backend()   # honors PTPU_FORCE_CPU (always set for this lane)
+    tools_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools")
+    sys.path.insert(0, tools_dir)
+    try:
+        import kernlint
+        out = kernlint.bench_report()
+    finally:
+        sys.path.remove(tools_dir)
+    print(json.dumps(out), flush=True)
+    return 0
+
+
 def worker_racelint():
     """Static-analysis lane #2: racelint's host-concurrency audit of
     the whole package (finding count + per-rule breakdown).  Pure
@@ -1549,6 +1570,8 @@ def main():
         return worker_racelint()
     if "--worker-numlint" in sys.argv:
         return worker_numlint()
+    if "--worker-kernlint" in sys.argv:
+        return worker_kernlint()
     if "--worker-quant" in sys.argv:
         return worker_quant()
     if "--worker-obs" in sys.argv:
@@ -1575,6 +1598,7 @@ def main():
     sl_proc = _spawn("--worker-shardlint", force_cpu=True)
     rl_proc = _spawn("--worker-racelint", force_cpu=True)
     nl_proc = _spawn("--worker-numlint", force_cpu=True)
+    kl_proc = _spawn("--worker-kernlint", force_cpu=True)
     obs_proc = _spawn("--worker-obs", force_cpu=True)
     resil_proc = _spawn("--worker-resilience", force_cpu=True)
     fleet_proc = _spawn("--worker-fleet", force_cpu=True)
@@ -1619,6 +1643,13 @@ def main():
     else:
         # same rationale as shardlint_error
         merged["numlint_error"] = str(nl_err)
+
+    kl_res, kl_err, _ = _await_json(kl_proc, KERNLINT_S)
+    if kl_res is not None:
+        merged.update(kl_res)
+    else:
+        # same rationale as shardlint_error
+        merged["kernlint_error"] = str(kl_err)
 
     obs_res, obs_err, _ = _await_json(obs_proc, OBS_S)
     if obs_res is not None:
@@ -1719,6 +1750,7 @@ def main():
         _adopt_lane("shardlint_", "shardlint_findings", sl_err)
         _adopt_lane("racelint_", "racelint_finding_count", rl_err)
         _adopt_lane("numlint_", "numlint_finding_count", nl_err)
+        _adopt_lane("kernlint_", "kernlint_finding_count", kl_err)
         _adopt_lane("obs_", "obs_span_overhead_pct", obs_err)
         _adopt_lane("resilience_", "resilience_ckpt_write_ms",
                     resil_err)

@@ -23,7 +23,10 @@ RLxxx host-runtime concurrency audit, plus the runtime lock-order
 sanitizer in `lock_tracer.py` — see `tools/racelint.py`) and
 **numlint** (`dtype_flow.py`/`num_rules.py`, NLxxx numerics &
 precision-flow audit over traced jaxprs — see `tools/numlint.py` and
-docs/numlint.md).
+docs/numlint.md) and **kernlint** (`kernel_rules.py`/`vmem_model.py`,
+KLxxx audit of Pallas kernel interiors — tile alignment, VMEM budgets,
+grid coverage, in-kernel numerics; see `tools/kernlint.py` and
+docs/kernlint.md).
 """
 from __future__ import annotations
 
@@ -52,14 +55,19 @@ def __getattr__(name):
     if name == "NumConfig":
         from paddle_tpu.analysis.num_rules import NumConfig
         return NumConfig
+    if name == "KernelConfig":
+        from paddle_tpu.analysis.kernel_rules import KernelConfig
+        return KernelConfig
     raise AttributeError(name)
 
 __all__ = [
     "RULES", "TraceHazardError", "Finding", "TracelintWarning",
-    "ShardlintWarning", "NumlintWarning", "lint_paths", "lint_file",
-    "lint_callable", "check_jaxpr", "audit_jaxpr", "check_numerics",
-    "message_for", "report", "AuditConfig", "MeshInfo", "InputInfo",
-    "CostReport", "NumConfig", "input_infos_from_state",
+    "ShardlintWarning", "NumlintWarning", "KernlintWarning",
+    "lint_paths", "lint_file", "lint_callable", "check_jaxpr",
+    "audit_jaxpr", "check_numerics", "check_kernels",
+    "check_kernel_files", "message_for", "report", "AuditConfig",
+    "MeshInfo", "InputInfo", "CostReport", "NumConfig", "KernelConfig",
+    "input_infos_from_state",
 ]
 
 AST_RULE_SETS = (check_subset, check_purity, check_recompile)
@@ -77,6 +85,12 @@ class ShardlintWarning(TracelintWarning):
 class NumlintWarning(TracelintWarning):
     """Emitted by to_static(check=True) for each numlint (NLxxx)
     finding, alongside the TL4xx jaxpr pass.  Subclasses
+    TracelintWarning so one warning filter governs the whole family."""
+
+
+class KernlintWarning(TracelintWarning):
+    """Emitted by to_static(check=True) for each kernlint (KLxxx)
+    finding over the program's ``pallas_call`` interiors.  Subclasses
     TracelintWarning so one warning filter governs the whole family."""
 
 
@@ -144,6 +158,23 @@ def check_numerics(closed_jaxpr, where="<traced program>", inputs=None,
     from paddle_tpu.analysis.num_rules import check_numerics as _impl
     return _impl(closed_jaxpr, where=where, inputs=inputs, config=config,
                  suppress=suppress)
+
+
+def check_kernels(closed_jaxpr, where="<traced program>", config=None,
+                  suppress=True):
+    """kernlint: the KL-rule audit of every Pallas kernel interior
+    reachable from one traced program (see analysis/kernel_rules.py).
+    Lazy import so the light CLI path never pays for it."""
+    from paddle_tpu.analysis.kernel_rules import check_kernels as _impl
+    return _impl(closed_jaxpr, where=where, config=config,
+                 suppress=suppress)
+
+
+def check_kernel_files(paths=None):
+    """kernlint AST pass: trace-free KL lint over Pallas kernel sources
+    (defaults to ``paddle_tpu/ops/pallas/*.py``)."""
+    from paddle_tpu.analysis.kernel_rules import check_kernel_files as _impl
+    return _impl(paths)
 
 
 def audit_jaxpr(closed_jaxpr, where="<traced program>", inputs=None,

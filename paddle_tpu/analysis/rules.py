@@ -327,6 +327,74 @@ RULES = {r.code: r for r in [
        "convert with the combined scale) instead of bouncing through "
        "floats"),
 
+    # ---- KL1xx: Pallas kernel interiors (kernlint, kernel_rules.py) ----
+    _R("KL101", "block-tile-misalignment",
+       "block shape {detail} is not a multiple of the dtype's native "
+       "TPU tile",
+       "VMEM tiles are (sublane, 128-lane) blocks — (8,128) f32, "
+       "(16,128) bf16, (32,128) int8/fp8; a BlockSpec dim that is "
+       "neither 1, the full array dim, nor a tile multiple forces "
+       "Mosaic to pad every block copy, wasting VMEM and MXU cycles "
+       "on every grid step (the in-kernel twin of SL302)",
+       "round the block dim to the dtype's sublane multiple / 128 "
+       "lanes (ops/pallas/norm.py `_sublane` + `_auto_block_rows` are "
+       "the house helpers), or pad the array so the full dim is the "
+       "block"),
+    _R("KL102", "vmem-over-budget",
+       "estimated VMEM footprint {detail}",
+       "Pallas double-buffers every grid-iterated block, and scratch "
+       "lives alongside — the static estimate (tile-padded block "
+       "buffers x2 + scratch) exceeding the per-core VMEM budget means "
+       "Mosaic either spills or refuses to compile, discovered only "
+       "after a full XLA lowering on real silicon",
+       "shrink the block shape (fewer rows per grid step), move large "
+       "accumulators to f32 scratch only where needed, or iterate an "
+       "extra grid dimension instead of widening blocks"),
+    _R("KL103", "narrow-in-kernel-accumulation",
+       "kernel body {detail} accumulates in a narrow dtype",
+       "numlint's NL101 deliberately stops at the pallas_call boundary "
+       "(the body is VMEM-resident, not HBM traffic) — but inside the "
+       "kernel the same math rules hold: a dot without "
+       "preferred_element_type=f32 or a bf16 += reduction carry rounds "
+       "the running total every block, and the wrong answer never "
+       "surfaces as an error",
+       "pass preferred_element_type=jnp.float32 to in-kernel dots, "
+       "keep accumulator refs/scratch f32, and cast once when storing "
+       "the block result"),
+    _R("KL104", "input-output-alias-hazard",
+       "input_output_aliases {detail}",
+       "an aliased pair shares one buffer: a shape/dtype mismatch "
+       "corrupts the donated storage layout, and a read of the aliased "
+       "input AFTER the aliased output's block was stored observes the "
+       "new value on TPU while interpret mode still shows the old one "
+       "— a silent TPU-only wrong answer",
+       "alias only identically-shaped/dtyped pairs, and finish every "
+       "read of the aliased input ref before the first store to its "
+       "aliased output ref"),
+    _R("KL105", "grid-coverage-mismatch",
+       "grid x block {detail}",
+       "Pallas writes exactly the blocks the index maps name: an "
+       "output region no grid step covers keeps uninitialized garbage, "
+       "an input tail never mapped is silently unprocessed, and two "
+       "NON-consecutive grid steps naming the same output block "
+       "overwrite each other's result (consecutive revisits are the "
+       "legal accumulation pattern)",
+       "make ceil(array_dim / block_dim) grid steps per dim with an "
+       "identity-ish index map, or mask the overlap; data-dependent "
+       "(scalar-prefetch) maps are skipped — keep them total by "
+       "construction"),
+    _R("KL106", "unguarded-ragged-tail",
+       "partial final block {detail} read without a guard",
+       "when block x grid overshoots the array, the final block's "
+       "out-of-range rows are padding with undefined contents; a "
+       "reduction or dot that consumes them unmasked folds garbage "
+       "into real outputs — the exact hazard class a ragged "
+       "paged-attention kernel lives in",
+       "guard tail loads with @pl.when(pid < full_blocks), mask with "
+       "broadcasted_iota against the true length, or pad the operand "
+       "to a block multiple before the call (the norm.py _pad_rows "
+       "pattern)"),
+
     # ---- RL1xx: host-runtime concurrency (racelint, race_rules.py) ----
     _R("RL101", "unguarded-shared-attribute",
        "{detail} is accessed from multiple thread roots with no "
@@ -403,3 +471,4 @@ JAXPR_CODES = tuple(c for c in RULES
 SHARDLINT_CODES = tuple(c for c in RULES if c.startswith("SL"))
 RACELINT_CODES = tuple(c for c in RULES if c.startswith("RL"))
 NUMLINT_CODES = tuple(c for c in RULES if c.startswith("NL"))
+KERNLINT_CODES = tuple(c for c in RULES if c.startswith("KL"))

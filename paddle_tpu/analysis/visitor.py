@@ -40,20 +40,22 @@ class Finding:
 
 
 # one suppression syntax for EVERY analyzer: `# tracelint: disable=...`
-# silences TLxxx, SLxxx, RLxxx and NLxxx codes alike (shardlint/numlint
-# findings resolve back to a source line via the eqn's jax source_info;
-# racelint findings are AST sites already).  `# shardlint:` /
-# `# racelint:` / `# numlint:` are accepted aliases but scoped to their
-# own family only — their `ALL` becomes the marker 'ALL:SL' / 'ALL:RL' /
-# 'ALL:NL' and foreign codes are dropped, so a shardlint-spelled comment
-# can never waive a trace-safety (TL) or numerics (NL) finding and vice
+# silences TLxxx, SLxxx, RLxxx, NLxxx and KLxxx codes alike (shardlint/
+# numlint/kernlint findings resolve back to a source line via the eqn's
+# jax source_info; racelint findings are AST sites already).
+# `# shardlint:` / `# racelint:` / `# numlint:` / `# kernlint:` are
+# accepted aliases but scoped to their own family only — their `ALL`
+# becomes the marker 'ALL:SL' / 'ALL:RL' / 'ALL:NL' / 'ALL:KL' and
+# foreign codes are dropped, so a shardlint-spelled comment can never
+# waive a trace-safety (TL) or kernel-interior (KL) finding and vice
 # versa.  skip-file stays tracelint-spelled only, for the same reason.
 _DISABLE_RE = re.compile(
-    r"#\s*(tracelint|shardlint|racelint|numlint):\s*disable="
+    r"#\s*(tracelint|shardlint|racelint|numlint|kernlint):\s*disable="
     r"([A-Za-z0-9,\s]+)")
 _SKIP_FILE_RE = re.compile(r"^\s*#\s*tracelint:\s*skip-file\s*$")
 
-_FAMILY = {"shardlint": "SL", "racelint": "RL", "numlint": "NL"}
+_FAMILY = {"shardlint": "SL", "racelint": "RL", "numlint": "NL",
+           "kernlint": "KL"}
 
 
 def parse_suppressions(source):

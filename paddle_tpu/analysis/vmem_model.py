@@ -1,0 +1,165 @@
+"""Static VMEM footprint model for Pallas TPU kernels (kernlint KL102).
+
+XLA never tells you a kernel's VMEM bill until Mosaic compiles it on
+real silicon — by then the trace, lowering and compile time are spent
+and the failure mode is a cryptic allocation error (or a silent spill).
+This module prices a ``pallas_call`` eqn *at trace time* from exactly
+the facts the eqn already carries:
+
+- every in/out :class:`BlockMapping`'s ``block_shape`` + array dtype,
+  padded up to the dtype's native VMEM tile ((8,128) f32, (16,128)
+  bf16, (32,128) int8/fp8 — sublane = 32 // itemsize, lane = 128; see
+  the TPU Pallas guide's tiling table);
+- **double-buffering**: the Pallas pipeline keeps two copies of every
+  grid-iterated block so the next block's DMA overlaps this block's
+  compute — any call with more than one grid step pays 2x per operand
+  block (a single-step call has nothing to overlap);
+- scratch operands (``pltpu.VMEM`` / ``scratch_shapes``), read off the
+  tail of the kernel jaxpr's invars — allocated once, never
+  double-buffered.
+
+The estimate is deliberately a *lower bound* sharpened to be useful:
+Mosaic's own spills (register pressure, retiling copies) come on top,
+so a kernel whose static estimate already exceeds the per-core budget
+is guaranteed trouble.  Deterministic by construction — the same eqn
+always prices the same, which is what the kernlint baseline gates on.
+
+Pure stdlib at module level (the eqn objects bring jax types with
+them); unit-pinned by hand-computed footprints in
+tests/test_kernlint.py.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+__all__ = [
+    "LANE", "VmemEstimate", "native_tile", "padded_block_bytes",
+    "estimate_vmem", "sublane",
+]
+
+LANE = 128                      # minor-most tile dim, every dtype
+
+_MIB = 1 << 20
+
+
+def sublane(dtype):
+    """Second-minor tile dim for `dtype`: 32 // itemsize, floored at 8
+    (f32 tiles are (8,128); bf16 (16,128); int8/fp8 (32,128))."""
+    itemsize = int(getattr(dtype, "itemsize", 4) or 4)
+    return max(8, 32 // max(1, itemsize))
+
+
+def native_tile(dtype):
+    """The dtype's native (sublane, lane) VMEM tile."""
+    return (sublane(dtype), LANE)
+
+
+def _ceil_to(n, m):
+    return -(-int(n) // int(m)) * int(m)
+
+
+def _int_dims(block_shape):
+    """Block dims as ints: Pallas marks squeezed/mapped dims with a
+    non-int sentinel — those occupy one element of the block."""
+    out = []
+    for d in block_shape or ():
+        try:
+            out.append(max(1, int(d)))
+        except (TypeError, ValueError):
+            out.append(1)
+    return out
+
+
+def padded_block_bytes(block_shape, dtype):
+    """Bytes one VMEM copy of this block occupies: the two minor dims
+    round up to the dtype's native tile (Mosaic stores nothing
+    smaller), every major dim counts as-is."""
+    dims = _int_dims(block_shape)
+    itemsize = int(getattr(dtype, "itemsize", 4) or 4)
+    if not dims:
+        return itemsize
+    dims[-1] = _ceil_to(dims[-1], LANE)
+    if len(dims) >= 2:
+        dims[-2] = _ceil_to(dims[-2], sublane(dtype))
+    n = 1
+    for d in dims:
+        n *= d
+    return n * itemsize
+
+
+@dataclass
+class VmemEstimate:
+    """Itemized static VMEM bill of one ``pallas_call``."""
+
+    grid: tuple = ()
+    # (origin, one-copy bytes, buffered bytes) per in/out block
+    blocks: list = field(default_factory=list)
+    scratch_bytes: int = 0
+    double_buffered: bool = False
+
+    @property
+    def block_bytes(self):
+        return sum(b for _, _, b in self.blocks)
+
+    @property
+    def total_bytes(self):
+        return self.block_bytes + self.scratch_bytes
+
+    def describe(self):
+        mib = self.total_bytes / _MIB
+        buf = "x2 double-buffered" if self.double_buffered else "x1"
+        return (f"{mib:.2f} MiB ({len(self.blocks)} block buffer(s) "
+                f"{buf} + {self.scratch_bytes / _MIB:.2f} MiB scratch)")
+
+    def to_dict(self):
+        return {
+            "grid": [int(g) for g in self.grid],
+            "blocks": [{"origin": o, "bytes": b, "buffered_bytes": bb}
+                       for o, b, bb in self.blocks],
+            "scratch_bytes": self.scratch_bytes,
+            "block_bytes": self.block_bytes,
+            "total_bytes": self.total_bytes,
+            "double_buffered": self.double_buffered,
+        }
+
+
+def _grid_steps(grid):
+    n = 1
+    for d in grid or ():
+        try:
+            n *= max(1, int(d))
+        except (TypeError, ValueError):
+            pass
+    return n
+
+
+def estimate_vmem(eqn):
+    """Price one ``pallas_call`` eqn; returns a :class:`VmemEstimate`
+    (zeros when the eqn's params are unreadable — never raises)."""
+    est = VmemEstimate()
+    gm = eqn.params.get("grid_mapping")
+    if gm is None:
+        return est
+    grid = tuple(getattr(gm, "grid", ()) or ())
+    est.grid = grid
+    est.double_buffered = _grid_steps(grid) > 1
+    factor = 2 if est.double_buffered else 1
+    for bm in getattr(gm, "block_mappings", ()) or ():
+        sd = getattr(bm, "array_shape_dtype", None)
+        dtype = getattr(sd, "dtype", None)
+        one = padded_block_bytes(getattr(bm, "block_shape", ()), dtype)
+        origin = str(getattr(bm, "origin", "") or "")
+        est.blocks.append((origin, one, one * factor))
+    # scratch refs are the tail of the kernel jaxpr invars, after the
+    # scalar-prefetch operands and the in/out block refs
+    n_scratch = int(getattr(gm, "num_scratch_operands", 0) or 0)
+    if n_scratch:
+        kjaxpr = eqn.params.get("jaxpr")
+        kjaxpr = getattr(kjaxpr, "jaxpr", kjaxpr)
+        invars = list(getattr(kjaxpr, "invars", ()) or ())
+        for v in invars[len(invars) - n_scratch:]:
+            aval = getattr(v, "aval", None)
+            shape = tuple(getattr(aval, "shape", ()) or ())
+            dtype = getattr(aval, "dtype", None)
+            est.scratch_bytes += padded_block_bytes(shape, dtype)
+    return est

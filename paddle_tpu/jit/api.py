@@ -368,6 +368,14 @@ class StaticFunction:
                                                     inputs=infos),
                             category=analysis.NumlintWarning,
                             prefix="numlint")
+                        # kernlint: the KL pass over every pallas_call
+                        # interior the program reaches (numlint keeps
+                        # the body opaque; KL103 owns it)
+                        analysis.warn_findings(
+                            analysis.check_kernels(traced.jaxpr,
+                                                   where=where),
+                            category=analysis.KernlintWarning,
+                            prefix="kernlint")
                     if self._audit:
                         findings, self.last_audit = analysis.audit_jaxpr(
                             traced.jaxpr, where=where, inputs=infos)

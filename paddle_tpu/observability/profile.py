@@ -44,9 +44,10 @@ import jax
 __all__ = [
     "ChipSpec", "CHIP_SPECS", "LayerCost", "RooflineReport",
     "backward_scope", "current_scope", "default_chip", "eqn_cost",
-    "layer_scope", "normalize_scope", "profile_engine",
-    "profile_static_function", "profile_traced", "reconcile", "scope",
-    "scope_tagging", "set_scope_tagging", "xla_cost_totals",
+    "kernel_interiors", "layer_scope", "normalize_scope",
+    "profile_engine", "profile_static_function", "profile_traced",
+    "reconcile", "scope", "scope_tagging", "set_scope_tagging",
+    "xla_cost_totals",
 ]
 
 
@@ -176,6 +177,9 @@ class ChipSpec:
     name: str
     peak_tflops: float          # bf16 peak, TFLOP/s per chip
     hbm_gbs: float              # HBM bandwidth, GB/s per chip
+    # conservative per-core VMEM budget (the figure kernlint's KL102
+    # prices Pallas block buffers against); ~16 MiB across generations
+    vmem_mb: float = 16.0
 
     @property
     def peak_flops(self):
@@ -190,10 +194,15 @@ class ChipSpec:
         """Arithmetic intensity (flop/byte) where compute == memory."""
         return self.peak_flops / self.bw_bytes
 
+    @property
+    def vmem_bytes(self):
+        return int(self.vmem_mb * (1 << 20))
+
     def to_dict(self):
         return {"name": self.name, "peak_tflops": self.peak_tflops,
                 "hbm_gbs": self.hbm_gbs,
-                "ridge_flop_per_byte": round(self.ridge, 1)}
+                "ridge_flop_per_byte": round(self.ridge, 1),
+                "vmem_mb": self.vmem_mb}
 
 
 CHIP_SPECS = {
@@ -387,6 +396,62 @@ def _walk(jaxpr, prefix, mult, sink):
         agg[2] += 1
 
 
+def _iter_eqns_rec(jaxpr):
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _iter_sub_jaxprs(eqn.params):
+            yield from _iter_eqns_rec(sub)
+
+
+def kernel_interiors(closed_jaxpr, chip=None):
+    """Opt-in per-kernel INTERIOR roofline rows — the dual of the
+    call-boundary cost ``_walk`` books for ``pallas_call``.
+
+    The boundary row says what a fused kernel costs the *program*
+    (operands + results over HBM); the interior row says what each grid
+    step moves through *VMEM* (one copy of every in/out block) and the
+    arithmetic intensity the kernel body achieves against that traffic.
+    ``reuse_factor`` = interior bytes / boundary bytes — how many times
+    the kernel re-touches each HBM byte inside VMEM, i.e. exactly the
+    reuse that justifies fusing (a factor near 1.0 means the kernel
+    gains nothing over the unfused composition)."""
+    chip = chip or default_chip()
+    from paddle_tpu.analysis.vmem_model import estimate_vmem
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    rows = []
+    for eqn in _iter_eqns_rec(jaxpr):
+        if eqn.primitive.name != "pallas_call":
+            continue
+        grid = _pallas_grid_size(eqn)
+        flops = 0
+        for sub in _iter_sub_jaxprs(eqn.params):
+            trial = {}
+            _walk(sub, "", grid, trial)
+            flops += sum(v[0] for v in trial.values())
+        est = estimate_vmem(eqn)
+        per_step = sum(one for _o, one, _b in est.blocks)
+        interior_bytes = per_step * max(1, grid)
+        _zero, boundary_bytes = eqn_cost(eqn)
+        name = (str(eqn.params.get("name_and_src_info", "") or "")
+                .split(" at ")[0]) or "<kernel>"
+        intensity = flops / interior_bytes if interior_bytes else 0.0
+        rows.append({
+            "kernel": name,
+            "grid_steps": int(max(1, grid)),
+            "vmem_step_bytes": int(per_step),
+            "interior_bytes": int(interior_bytes),
+            "boundary_bytes": int(boundary_bytes),
+            "flops": int(flops),
+            "interior_intensity": round(intensity, 3),
+            "bound": "compute" if intensity >= chip.ridge else "memory",
+            "reuse_factor": round(interior_bytes / boundary_bytes, 2)
+            if boundary_bytes else 0.0,
+            "vmem_total_bytes": int(est.total_bytes),
+            "double_buffered": bool(est.double_buffered),
+        })
+    return rows
+
+
 # --------------------------------------------------------------- reports
 UNATTRIBUTED = "<unattributed>"
 
@@ -428,6 +493,8 @@ class RooflineReport:
     xla: dict = None            # {"flops", "bytes_accessed"} | None
     measured_ms: float = None
     measured_source: str = None
+    # opt-in per-kernel interior rows (kernel_interiors() dicts)
+    interiors: list = None
 
     def __post_init__(self):
         if self.unattributed is None:
@@ -509,6 +576,8 @@ class RooflineReport:
         if self.measured_ms is not None:
             d["measured_ms"] = round(self.measured_ms, 3)
             d["measured_source"] = self.measured_source
+        if self.interiors:
+            d["interiors"] = self.interiors
         return d
 
     @classmethod
@@ -530,16 +599,19 @@ class RooflineReport:
                   unattributed=unattributed,
                   xla=d.get("xla"),
                   measured_ms=d.get("measured_ms"),
-                  measured_source=d.get("measured_source"))
+                  measured_source=d.get("measured_source"),
+                  interiors=d.get("interiors"))
         return rep
 
 
 # ---------------------------------------------------------- entry points
 def profile_traced(closed_jaxpr, where="<traced program>", chip=None,
-                   include_xla=False):
+                   include_xla=False, include_interiors=False):
     """Roofline-profile one traced program: per-eqn cost model,
     attributed to the normalized ``jax.named_scope`` paths the layer
-    tree threaded through tracing."""
+    tree threaded through tracing.  ``include_interiors=True`` adds the
+    per-kernel INTERIOR rows (:func:`kernel_interiors`) next to the
+    call-boundary attribution."""
     chip = chip or default_chip()
     jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
     sink = {}
@@ -555,6 +627,8 @@ def profile_traced(closed_jaxpr, where="<traced program>", chip=None,
                          unattributed=unattributed)
     if include_xla:
         rep.xla = xla_cost_totals(closed_jaxpr)
+    if include_interiors:
+        rep.interiors = kernel_interiors(closed_jaxpr, chip=chip)
     return rep
 
 

@@ -1,11 +1,12 @@
 """The unified gate: tools/lint_all.py chains tracelint --check,
-shardlint --check, racelint --check, numlint --check, perfgate --check,
-api_coverage --baseline and the chaos suite (pytest -m chaos, run under
-the racelint lock-order tracer) into ONE exit code.  This `lint`-marked
-test is how tier-1 enforces the six static baselines; the chaos gate
-is skipped here because tier-1 runs the chaos tests directly (they
-live in tests/test_resilience.py under the `chaos` marker) —
-standalone `python tools/lint_all.py` runs all seven.
+shardlint --check, racelint --check, numlint --check, kernlint --check,
+perfgate --check, api_coverage --baseline and the chaos suite (pytest
+-m chaos, run under the racelint lock-order tracer) into ONE exit
+code.  This `lint`-marked test is how tier-1 enforces the seven static
+baselines; the chaos gate is skipped here because tier-1 runs the
+chaos tests directly (they live in tests/test_resilience.py under the
+`chaos` marker) — standalone `python tools/lint_all.py` runs all
+eight.
 """
 import json
 import os
@@ -25,7 +26,7 @@ def test_lint_all_gate_clean():
     # (tests/test_resilience.py carries the marker), so re-running it
     # nested here would double its cost inside the tier-1 budget for no
     # added coverage.  Standalone `python tools/lint_all.py` (the CI
-    # entry point) still runs all seven gates.
+    # entry point) still runs all eight gates.
     proc = subprocess.run([sys.executable, LINT_ALL, "--skip", "chaos"],
                           cwd=REPO, capture_output=True, text=True,
                           timeout=420)
@@ -35,6 +36,7 @@ def test_lint_all_gate_clean():
     assert "shardlint: ok" in out
     assert "racelint: ok" in out
     assert "numlint: ok" in out
+    assert "kernlint: ok" in out
     assert "perfgate: ok" in out
     assert "coverage: ok" in out
     assert "chaos: SKIPPED" in out
@@ -44,10 +46,11 @@ def test_lint_all_gate_clean():
 def test_lint_all_skip_flag():
     proc = subprocess.run(
         [sys.executable, LINT_ALL, "--skip", "tracelint", "shardlint",
-         "racelint", "numlint", "perfgate", "coverage", "chaos"],
+         "racelint", "numlint", "kernlint", "perfgate", "coverage",
+         "chaos"],
         cwd=REPO, capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0
-    assert proc.stdout.count("SKIPPED") == 7
+    assert proc.stdout.count("SKIPPED") == 8
 
 
 def test_lint_all_only_empty_is_usage_error():
@@ -71,12 +74,12 @@ def test_lint_all_only_and_json(tmp_path):
         cwd=REPO, capture_output=True, text=True, timeout=180)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "tracelint: ok" in proc.stdout
-    assert proc.stdout.count("SKIPPED") == 6
+    assert proc.stdout.count("SKIPPED") == 7
     doc = json.loads(out_json.read_text())
     assert doc["tool"] == "lint_all"
     assert set(doc["gates"]) == {"tracelint", "shardlint", "racelint",
-                                 "numlint", "perfgate", "coverage",
-                                 "chaos"}
+                                 "numlint", "kernlint", "perfgate",
+                                 "coverage", "chaos"}
     tl = doc["gates"]["tracelint"]
     assert tl["ok"] is True
     assert isinstance(tl["findings"], int)

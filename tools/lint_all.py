@@ -10,13 +10,22 @@ Chains every baseline-gated analyzer in the repo, plus the chaos suite:
                                                audit over the traced
                                                flagship + serving
                                                programs, NLxxx)
-  5. perfgate   --check                       (deterministic cost-model
+  5. kernlint   --check                       (Pallas kernel-interior
+                                               audit: tile alignment,
+                                               VMEM budgets, in-kernel
+                                               numerics, alias hazards,
+                                               grid coverage, ragged
+                                               tails — KLxxx over the
+                                               flagship + serving + each
+                                               ops/pallas kernel traced
+                                               standalone)
+  6. perfgate   --check                       (deterministic cost-model
                                                perf budgets: bytes/flops
                                                per step, padding waste,
                                                compile bounds vs
                                                tools/perf_baseline.json)
-  6. api_coverage --baseline                  (public-surface regressions)
-  7. pytest -m chaos                          (deterministic fault-injection
+  7. api_coverage --baseline                  (public-surface regressions)
+  8. pytest -m chaos                          (deterministic fault-injection
                                                acceptance proofs, run under
                                                the racelint lock-order
                                                tracer — tests/conftest.py
@@ -51,7 +60,8 @@ enforces every gate at once.  The chaos gate deselects itself there via
 carry no `lint` marker, so the recursion terminates.
 
 Usage: python tools/lint_all.py
-       [--skip tracelint shardlint racelint numlint perfgate coverage chaos]
+       [--skip tracelint shardlint racelint numlint kernlint perfgate
+        coverage chaos]
        [--only <gate> [<gate> ...]]
        [--json FILE|-]   one unified {"tool": "lint_all", "gates":
                          {gate: {ok, findings, elapsed_s}}} document —
@@ -80,6 +90,8 @@ GATES = {
                  "--check", "paddle_tpu"],
     "numlint": [sys.executable, os.path.join(TOOLS, "numlint.py"),
                 "--check"],
+    "kernlint": [sys.executable, os.path.join(TOOLS, "kernlint.py"),
+                 "--check"],
     "perfgate": [sys.executable, os.path.join(TOOLS, "perfgate.py"),
                  "--check"],
     "coverage": [sys.executable, os.path.join(TOOLS, "api_coverage.py"),

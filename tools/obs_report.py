@@ -98,7 +98,8 @@ def live_roofline():
     train_step(ids, labels)                 # compile + step 1
     train_step(ids, labels)                 # warm step 2
     jaxpr, _ = train_step.traced_program(ids, labels)
-    report = profile.profile_traced(jaxpr, where="<gpt_hybrid_train>")
+    report = profile.profile_traced(jaxpr, where="<gpt_hybrid_train>",
+                                    include_interiors=True)
     return profile.reconcile(report, "jit.train_step")
 
 
@@ -133,6 +134,21 @@ def render_rooflines(reports):
         if d.get("xla"):
             print(f"  xla cost_analysis: flops {d['xla']['flops']:.4g}, "
                   f"bytes accessed {d['xla']['bytes_accessed']:.4g}")
+        if d.get("interiors"):
+            print(f"  -- kernel interiors (per-grid-step VMEM traffic "
+                  f"vs the call-boundary row) --")
+            print(f"  {'kernel':<28s} {'grid':>6s} {'KiB/step':>9s} "
+                  f"{'MFLOP':>9s} {'flop/B':>7s} {'bound':>8s} "
+                  f"{'reuse':>6s} {'VMEM KiB':>9s}")
+            for k in d["interiors"]:
+                print(f"  {k['kernel'][:28]:<28s} "
+                      f"{k['grid_steps']:>6d} "
+                      f"{k['vmem_step_bytes'] / 1024:>9.1f} "
+                      f"{k['flops'] / 1e6:>9.3f} "
+                      f"{k.get('interior_intensity', 0):>7.2f} "
+                      f"{k.get('bound', '?'):>8s} "
+                      f"{k.get('reuse_factor', 0):>5.1f}x "
+                      f"{k.get('vmem_total_bytes', 0) / 1024:>9.1f}")
         print()
 
 
