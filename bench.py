@@ -47,6 +47,7 @@ BERT_TPU_S = 180
 ERNIE_TPU_S = 180
 SERVING_TPU_S = 150
 ROUTER_S = 240
+TRAFFIC_S = 300
 FLEETSERVING_S = 300
 SHARDLINT_S = 150
 RACELINT_S = 90
@@ -977,6 +978,158 @@ def worker_router():
     return 0
 
 
+def worker_traffic():
+    """Traffic lane: the deterministic load-generation harness
+    (paddle_tpu.serving.traffic) driven on a VIRTUAL clock — a
+    workload-model burst trace against the router with the SLO
+    autoscaler in the loop, a binary-search capacity probe at 1 vs 3
+    replicas, and the same spec chaos-composed with a mid-decode
+    replica crash plus a qps_surge.  Pure CPU and virtual-time, so
+    every latency number below is a property of the SCHEDULE, not of
+    this host — byte-stable across runs and machines.
+
+    Reports (merged into every BENCH line):
+      traffic_goodput_under_slo_pct    — finished complete AND under the
+                                         class TTFT SLO, burst trace
+      traffic_ttft_p99_ms              — p99 TTFT (virtual ms)
+      traffic_scaleup_reaction_ticks   — burst onset -> spare replica
+                                         admitting, in driver ticks
+      traffic_capacity_qps_1r / _3r    — max sustained QPS at the TTFT
+                                         SLO per replica count
+      traffic_chaos_goodput_pct        — goodput with crash + qps_surge
+                                         composed onto the same spec
+    """
+    import shutil
+    import tempfile
+
+    _init_backend()   # honors PTPU_FORCE_CPU (always set for this lane)
+
+    import paddle_tpu as P
+    from paddle_tpu import serving
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import traffic
+    from paddle_tpu.serving.router import Router, RouterConfig
+
+    mcfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                     num_heads=4, max_seq_len=128, dropout=0.0,
+                     attention_dropout=0.0)
+    ecfg = serving.EngineConfig(max_num_seqs=4, page_size=8,
+                                max_model_len=64,
+                                prefill_buckets=(16, 32),
+                                crash_safe_decode=False)
+    P.seed(0)
+    model = GPTForCausalLM(mcfg)
+    cache_dir = tempfile.mkdtemp(prefix="ptpu_traffic_bench_")
+    quantum = 0.01
+    burst = traffic.TrafficSpec(
+        name="bench-burst", seed=11,
+        arrival={"kind": "onoff", "base_qps": 2.0, "burst_qps": 40.0,
+                 "period_s": 2.0, "duty": 0.35},
+        duration_s=2.0, prompt_len=((1.0, 4, 16),),
+        output_tokens=((1.0, 4, 8),),
+        classes=(traffic.DeadlineClass("interactive", ttft_slo_s=0.5),))
+
+    def factory(n, clock):
+        return Router(model, ecfg, num_replicas=n,
+                      config=RouterConfig(sleep=lambda s: None),
+                      program_cache=cache_dir, clock=clock)
+
+    try:
+        # -- phase A: burst trace with the autoscaler in the loop ------
+        clock = traffic.VirtualClock()
+        router = factory(3, clock)
+        router.park(1)
+        router.park(2)
+        router.step()           # drain the parked slots into the pool
+        scaler = traffic.SLOAutoscaler(
+            router,
+            slo=traffic.SLO(ttft_p99_s=0.5, queue_high=3.0,
+                            queue_low=0.5),
+            config=traffic.AutoscalerConfig(min_replicas=1, up_after=2,
+                                            down_after=30, cooldown=5),
+            clock=clock, name="bench")
+        driver = traffic.TrafficDriver(
+            router, burst, clock, quantum_s=quantum, name="bench-burst",
+            on_tick=lambda d: scaler.observe())
+        rep = driver.run()
+        snap = scaler.snapshot()
+        reaction = (max(snap["reaction_times_s"])
+                    if snap["reaction_times_s"] else None)
+        driver.release()
+        scaler.release()
+        router.shutdown()
+
+        # -- phase B: capacity probe, 1 vs 3 replicas ------------------
+        probe = burst.with_rate(8.0, duration_s=1.2)
+        cap = traffic.probe_capacity(
+            factory, probe, slo_ttft_s=0.25, replica_counts=(1, 3),
+            qps_lo=1.0, qps_hi=150.0, iters=5, goodput_min=0.95,
+            quantum_s=quantum, name="bench-capacity")
+
+        # -- phase C: same spec chaos-composed -------------------------
+        chaos = traffic.TrafficSpec.from_dict(burst.to_dict())
+        chaos.name = "bench-chaos"
+        chaos.fault_plan = {
+            "name": "bench-traffic-chaos",
+            "faults": [
+                {"site": "serving.decode", "kind": "exception", "at": 8},
+                {"site": "serving.traffic.tick", "kind": "qps_surge",
+                 "at": 30, "payload": {"requests": 6}},
+            ],
+        }
+        clock2 = traffic.VirtualClock()
+        router2 = factory(2, clock2)
+        driver2 = traffic.TrafficDriver(router2, chaos, clock2,
+                                        quantum_s=quantum,
+                                        name="bench-chaos")
+        chaos_rep = driver2.run()
+        failovers = router2.snapshot()["failovers"]
+        driver2.release()
+        router2.shutdown()
+
+        out = {
+            "traffic_goodput_under_slo_pct": round(
+                100.0 * rep["goodput_frac"], 2),
+            "traffic_offered_qps": rep["offered_qps"],
+            "traffic_ttft_p99_ms": rep["ttft_p99_ms"],
+            "traffic_scale_ups": snap["scale_ups"],
+            "traffic_scale_downs": snap["scale_downs"],
+            "traffic_scaleup_reaction_ticks": (
+                int(round(reaction / quantum))
+                if reaction is not None else None),
+            "traffic_scaleup_reaction_ms": (
+                round(reaction * 1e3, 3) if reaction is not None
+                else None),
+            "traffic_capacity_qps_1r": cap.max_qps(1),
+            "traffic_capacity_qps_3r": cap.max_qps(3),
+            "traffic_chaos_goodput_pct": round(
+                100.0 * chaos_rep["goodput_frac"], 2),
+            "traffic_chaos_token_loss": chaos_rep["token_loss"],
+            "traffic_chaos_surges": chaos_rep["surge_injected"],
+        }
+        # lane contracts, gated BEFORE the result line prints
+        assert snap["scale_ups"] >= 1 and reaction is not None, (
+            "burst never triggered a scale-up")
+        assert snap["scale_downs"] >= 1, (
+            "autoscaler never drained the spare back after the burst")
+        assert rep["goodput_frac"] >= 0.95, (
+            f"goodput under SLO collapsed: {rep['goodput_frac']}")
+        assert (cap.max_qps(1) or 0) > 0, "1-replica capacity probe dead"
+        assert (cap.max_qps(3) or 0) >= (cap.max_qps(1) or 0), (
+            "capacity not monotone in replica count: "
+            f"{cap.max_qps(3)} < {cap.max_qps(1)}")
+        assert failovers >= 1, "injected chaos crash never fired"
+        assert chaos_rep["surge_injected"] >= 1, "qps_surge never fired"
+        assert chaos_rep["goodput_frac"] >= 0.90, (
+            f"chaos goodput out of budget: {chaos_rep['goodput_frac']}")
+        assert chaos_rep["token_loss"] == 0, (
+            f"token loss under chaos: {chaos_rep['token_loss']}")
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    print(json.dumps(out), flush=True)
+    return 0
+
+
 def worker_fleetserving():
     """Multi-host serving-fleet lane: a REAL 4-process fleet
     (controller + 2 replica workers + 1 prespawned spare, each its own
@@ -1562,6 +1715,8 @@ def main():
         return worker_serving()
     if "--worker-router" in sys.argv:
         return worker_router()
+    if "--worker-traffic" in sys.argv:
+        return worker_traffic()
     if "--worker-fleetserving" in sys.argv:
         return worker_fleetserving()
     if "--worker-shardlint" in sys.argv:
@@ -1606,6 +1761,7 @@ def main():
     prof_proc = _spawn("--worker-profile", force_cpu=True)
     remat_proc = _spawn("--worker-remat", force_cpu=True)
     router_proc = _spawn("--worker-router", force_cpu=True)
+    traffic_proc = _spawn("--worker-traffic", force_cpu=True)
     fleetsrv_proc = _spawn("--worker-fleetserving", force_cpu=True)
     quant_proc = _spawn("--worker-quant", force_cpu=True)
 
@@ -1707,6 +1863,14 @@ def main():
         # same rationale: a router-lane failure degrades only its keys
         merged["router_error"] = str(router_err)
 
+    traffic_res, traffic_err, _ = _await_json(traffic_proc, TRAFFIC_S)
+    if traffic_res is not None:
+        merged.update(traffic_res)
+    else:
+        # same rationale: a traffic-harness failure degrades only its
+        # own keys (all virtual-time, never the TPU measurement)
+        merged["traffic_error"] = str(traffic_err)
+
     fleetsrv_res, fleetsrv_err, _ = _await_json(fleetsrv_proc,
                                                 FLEETSERVING_S)
     if fleetsrv_res is not None:
@@ -1759,6 +1923,8 @@ def main():
         _adopt_lane("profile_", "profile_bytes_per_step", prof_err)
         _adopt_lane("remat_", "remat_bytes_saved_pct", remat_err)
         _adopt_lane("router_", "router_tokens_per_s", router_err)
+        _adopt_lane("traffic_", "traffic_goodput_under_slo_pct",
+                    traffic_err)
         _adopt_lane("fleetserving_", "fleetserving_tokens_per_s",
                     fleetsrv_err)
         _adopt_lane("quant_", "quant_kv_bytes_per_token_int8", quant_err)

@@ -37,9 +37,9 @@ __all__ = [
 
 # ------------------------------------------------------------------ JSONL
 def dump_jsonl(path, spans=None, recompiles=None, registry=None,
-               rooflines=None):
-    """Write spans + recompile events + metrics (+ optional roofline
-    reports) as JSON-lines; returns `path`.  Defaults to the
+               rooflines=None, capacities=None):
+    """Write spans + recompile events + metrics (+ optional roofline /
+    capacity reports) as JSON-lines; returns `path`.  Defaults to the
     process-wide recorder/log/registry."""
     spans = _spans.recorder().spans() if spans is None else spans
     recompiles = (_recompile.recompile_log().events()
@@ -73,15 +73,19 @@ def dump_jsonl(path, spans=None, recompiles=None, registry=None,
             d = rep if isinstance(rep, dict) else rep.to_dict()
             fh.write(json.dumps({"kind": "roofline", "report": d},
                                 default=str) + "\n")
+        for rep in capacities or ():
+            d = rep if isinstance(rep, dict) else rep.to_dict()
+            fh.write(json.dumps({"kind": "capacity", "report": d},
+                                default=str) + "\n")
     return path
 
 
 def load_jsonl(path):
     """Parse a :func:`dump_jsonl` file back into plain dict lists:
     ``{"meta": dict|None, "spans": [...], "recompiles": [...],
-    "metrics": [...], "rooflines": [...]}``."""
+    "metrics": [...], "rooflines": [...], "capacities": [...]}``."""
     out = {"meta": None, "spans": [], "recompiles": [], "metrics": [],
-           "rooflines": []}
+           "rooflines": [], "capacities": []}
     with open(path, encoding="utf-8") as fh:
         for line in fh:
             line = line.strip()
@@ -101,6 +105,8 @@ def load_jsonl(path):
                 out["metrics"].append(rec)
             elif kind == "roofline":
                 out["rooflines"].append(rec.get("report", rec))
+            elif kind == "capacity":
+                out["capacities"].append(rec.get("report", rec))
     return out
 
 

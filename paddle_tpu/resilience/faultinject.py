@@ -58,6 +58,12 @@ site                   where / supported kinds
                        deterministic on (plan seed, occurrence), and
                        target ``payload["param"]`` by name (default:
                        the first parameter with a gradient)
+``serving.traffic.tick``  traffic-driver scheduling quantum
+                       (``serving/traffic/driver.py``) — ``qps_surge``
+                       (returned to the driver, which injects
+                       ``payload["requests"]`` extra arrivals compiled
+                       from the spec's own seed: even the surge is
+                       replay-identical), ``slow``, ``exception``
 ``serving.logits``     ``LLMEngine`` guarded decode step — ``nan_grad``
                        poisons the victim request's logits row to NaN,
                        ``bitflip`` to +inf, through a traced poison
@@ -95,7 +101,7 @@ __all__ = [
 ]
 
 KINDS = ("torn_write", "exception", "preempt", "pool_exhaust", "slow",
-         "rank_kill", "wedge", "bitflip", "nan_grad")
+         "rank_kill", "wedge", "bitflip", "nan_grad", "qps_surge")
 
 
 class WorkerFault(RuntimeError):
@@ -272,8 +278,8 @@ def fire(site, **ctx):
     - ``preempt``   → requests preemption on the installed
       :class:`~paddle_tpu.resilience.preemption.PreemptionHandler`.
 
-    Site-specific kinds (``torn_write``, ``pool_exhaust``) return the
-    spec for the caller to interpret.
+    Site-specific kinds (``torn_write``, ``pool_exhaust``,
+    ``qps_surge``) return the spec for the caller to interpret.
     """
     inj = _active
     if inj is None:

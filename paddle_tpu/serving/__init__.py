@@ -26,10 +26,13 @@ Multi-replica serving lives one level up: :mod:`paddle_tpu.serving.
 router` fronts N engines with telemetry-driven admission balancing,
 failover, and elastic drain/respawn, with replicas booting warm from
 the persisted AOT program cache (:mod:`paddle_tpu.serving.aot_cache`).
+:mod:`paddle_tpu.serving.traffic` is the measurement harness over it
+all: deterministic workload-model load generation, an SLO autoscaler,
+and binary-search capacity reports (max sustained QPS at a TTFT SLO).
 
 See docs/serving.md for the architecture and the request lifecycle.
 """
-from paddle_tpu.serving import fleet, router
+from paddle_tpu.serving import fleet, router, traffic
 from paddle_tpu.serving.aot_cache import (AOTProgramCache,
                                           engine_fingerprint)
 from paddle_tpu.serving.engine import (EngineConfig, LLMEngine,
@@ -60,4 +63,5 @@ __all__ = [
     "fleet",
     "router",
     "sample_tokens",
+    "traffic",
 ]

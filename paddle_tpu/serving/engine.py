@@ -276,7 +276,7 @@ class LLMEngine:
     """
 
     def __init__(self, model, config=None, metrics_name=None,
-                 program_cache=None):
+                 program_cache=None, clock=None):
         self.config = config or EngineConfig()
         cfg = self.config
         self._model = model
@@ -335,7 +335,15 @@ class LLMEngine:
         # observability registry under this engine's label — the
         # snapshot-source registration below is the coarse view of the
         # SAME instruments, so the two can never diverge
-        self.metrics = EngineMetrics(name=self._metrics_name)
+        # `clock` injects the engine's whole timebase (arrive_t stamps,
+        # deadline TTLs, TTFT/ITL histograms — everything reads
+        # metrics.clock): the virtual-time traffic driver passes a
+        # VirtualClock so latency accounting is deterministic; None =
+        # wall clock, exactly as before
+        self.metrics = (EngineMetrics(clock=clock,
+                                      name=self._metrics_name)
+                        if clock is not None
+                        else EngineMetrics(name=self._metrics_name))
         self.metrics.compile_bound = cfg.compile_bound
         self.metrics.pages_total = cfg.num_pages - 1   # page 0 reserved
         # health state machine over live page-pool occupancy; the gauge

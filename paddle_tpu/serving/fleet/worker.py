@@ -91,6 +91,84 @@ def run_replica(cfg, grank):
     _write_result(cfg["out_dir"], f"replica-rank{grank}.json", result)
 
 
+# ----------------------------------------------------- traffic mode
+class _FleetStepAdapter:
+    """Router facade for the traffic driver over a ServingFleet:
+    stepping must go through :meth:`ServingFleet.step` (watchdog
+    verdicts + failover live there), everything else — admission,
+    finished results, telemetry — is the fleet's stock router."""
+
+    def __init__(self, sfleet):
+        self._sfleet = sfleet
+
+    def step(self):
+        return self._sfleet.step()
+
+    def __getattr__(self, name):
+        return getattr(self._sfleet.router, name)
+
+
+def run_traffic_controller(cfg, grank):
+    """Traffic-mode controller (scenario has a ``traffic`` key): replay
+    a seeded :class:`TrafficSpec` through the multi-process fleet —
+    arrivals on the driver's virtual clock, service and watchdog
+    verdicts on the wall clock — and report the driver's goodput /
+    token-loss accounting next to the fleet's failover evidence.  This
+    is how the PR 14-16 chaos proofs become capacity-planning numbers:
+    the scenario's ``faults`` table SIGKILLs / wedges replicas
+    mid-run, and the report must keep goodput within the declared
+    budget with zero token loss."""
+    import paddle_tpu as P
+    from paddle_tpu import serving
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.resilience import fleet as flt
+    from paddle_tpu.serving import traffic
+    from paddle_tpu.serving.fleet import (FleetServingConfig,
+                                          ServingFleet)
+    from paddle_tpu.serving.router.router import RouterConfig
+
+    # warm the shared AOT cache first, so every replica boot —
+    # respawns included — classifies warm (same seed → same weights)
+    P.seed(int(cfg["seed"]))
+    model = GPTForCausalLM(GPTConfig(**cfg["model"]))
+    warm = serving.LLMEngine(
+        model, serving.EngineConfig(**cfg["engine"]),
+        program_cache=cfg.get("cache_dir"),
+        metrics_name="serving.fleet.warmcache")
+    warm.warmup()
+    warm.shutdown()
+
+    flt.install_publisher(flt.HeartbeatPublisher().start())
+    sfleet = ServingFleet(
+        flt._client(),
+        FleetServingConfig(cfg["worker_ranks"],
+                           cfg.get("spare_ranks", ()),
+                           boot_payload={}),
+        router_config=RouterConfig(sleep=lambda s: None))
+
+    spec = traffic.TrafficSpec.from_dict(cfg["traffic"])
+    clock = traffic.VirtualClock()
+    driver = traffic.TrafficDriver(
+        _FleetStepAdapter(sfleet), spec, clock,
+        quantum_s=float(cfg.get("quantum_s", 0.01)),
+        name="fleet-traffic")
+    report = driver.run()
+    driver.release()
+    snap = sfleet.router.snapshot()
+    result = {"role": "controller", "rank": grank,
+              "traffic": report,
+              "detections": sfleet.detections(),
+              "respawn_ms": sfleet.respawn_ms,
+              "boots": [dict(h.boot_info or {})
+                        for h in sfleet.router.replicas],
+              "snapshot": {k: snap.get(k)
+                           for k in ("failovers", "respawns",
+                                     "adoptions", "spillovers",
+                                     "requests_finished")}}
+    sfleet.shutdown()
+    _write_result(cfg["out_dir"], "controller.json", result)
+
+
 # --------------------------------------------------------- controller
 def run_controller(cfg, grank):
     import paddle_tpu as P
@@ -274,7 +352,10 @@ def main():
     _detach_local_backend()
     _mesh.set_mesh(Mesh(np.asarray(jax.local_devices()), ("dp",)))
     if grank == int(cfg.get("controller_rank", 0)):
-        run_controller(cfg, grank)
+        if cfg.get("traffic"):
+            run_traffic_controller(cfg, grank)
+        else:
+            run_controller(cfg, grank)
         # bounded linger: dead-by-design peers never check out
         flt.finalize(timeout_s=float(cfg.get("finalize_s", 6.0)))
     else:

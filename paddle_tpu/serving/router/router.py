@@ -157,10 +157,16 @@ class Router:
 
     def __init__(self, model=None, engine_config=None, num_replicas=2,
                  config=None, engine_factory=None, program_cache=None,
-                 metrics_name=None):
+                 metrics_name=None, clock=None):
         if num_replicas < 1:
             raise ValueError("num_replicas must be >= 1")
         self.config = config or RouterConfig()
+        # injectable timebase: arrive_t stamps, RouterMetrics uptime,
+        # and (through the default factory) every engine's
+        # EngineMetrics.clock — the virtual-time traffic driver passes
+        # one shared VirtualClock so TTFT/deadline accounting is
+        # deterministic; None = wall clock, exactly as before
+        self._clock = clock if clock is not None else time.perf_counter
         if engine_factory is None:
             if model is None:
                 raise ValueError(
@@ -173,7 +179,8 @@ class Router:
 
             def engine_factory(index):
                 return LLMEngine(model, engine_config,
-                                 program_cache=program_cache)
+                                 program_cache=program_cache,
+                                 clock=clock)
 
         self._factory = engine_factory
         self._lock = threading.RLock()
@@ -181,13 +188,15 @@ class Router:
         self._thread = None
         self._metrics_name = (metrics_name
                               or next_instance_label("serving.router"))
-        self.metrics = RouterMetrics(name=self._metrics_name)
+        self.metrics = RouterMetrics(clock=self._clock,
+                                     name=self._metrics_name)
         self._records = {}                 # live rid -> _RequestRecord
         self.finished_results = OrderedDict()    # rid -> RouterResult
         self._by_engine = {}     # (replica, generation, engine_rid) -> rid
         self._pending = []       # rids awaiting (re-)placement
         self._respawns = []      # (index, generation) boots step() owes
         self._reserved = set()   # rids generate() has yet to collect
+        self._parked = set()     # replica indices held out of respawn
         self._next_id = 0
         replicas = [self._boot(i, generation=0)
                     for i in range(int(num_replicas))]
@@ -258,7 +267,10 @@ class Router:
                 h.engine.shutdown()
             except Exception:
                 pass
-            if self.config.auto_respawn:
+            # a PARKED slot is the autoscaler's spare pool: its drain-
+            # out must not auto-respawn — unpark() re-queues the boot
+            # when the scale-up policy wants the capacity back
+            if self.config.auto_respawn and h.index not in self._parked:
                 self._respawns.append((h.index, h.generation + 1))
 
     def _run_respawns(self):
@@ -332,6 +344,7 @@ class Router:
         (``rr-N``).  `stream` receives ``(router_request_id, token,
         finished)`` — already-delivered tokens are never re-streamed
         across a migration."""
+        arrive_t = self._clock()  # user callback: never under _lock
         with self._lock:
             self.metrics.requests_received += 1
             candidates = self._candidates()
@@ -343,7 +356,7 @@ class Router:
             rid = f"rr-{self._next_id}"
             prompt = [int(t) for t in prompt_token_ids]
             rec = _RequestRecord(rid, prompt, sampling_params, stream,
-                                 arrive_t=time.perf_counter())
+                                 arrive_t=arrive_t)
             last = None
             for h in candidates:
                 try:
@@ -559,6 +572,47 @@ class Router:
             self._retry_pending()
         return h
 
+    def park(self, index, migrate_waiting=True):
+        """Scale-down: drain replica `index` AND hold its emptied slot
+        out of auto-respawn — the slot becomes spare capacity (the
+        autoscaler's spare pool) until :meth:`unpark` reclaims it.  A
+        normal :meth:`drain` in every other respect: running work
+        finishes in place, queued work migrates."""
+        with self._lock:
+            self._parked.add(int(index))
+            return self.drain(index, migrate_waiting)
+
+    def unpark(self, index):
+        """Scale-up: reclaim a parked slot through the EXISTING respawn
+        queue — the next :meth:`step` boots it outside the lock, warm
+        from the shared AOT cache, so admissions never stall behind the
+        boot.  A slot still draining is simply returned to rotation
+        (the drain is cancelled — cheaper than a boot).  Idempotent on
+        non-parked live slots."""
+        with self._lock:
+            index = int(index)
+            self._parked.discard(index)
+            h = self._replicas[index]
+            if h.state is ReplicaState.DRAINING:
+                h.state = ReplicaState.ACTIVE
+                with span("serving.router.unpark", replica=index,
+                          cancelled_drain=True):
+                    pass
+                return h
+            if not h.alive and \
+                    (index, h.generation + 1) not in self._respawns:
+                self._respawns.append((index, h.generation + 1))
+                with span("serving.router.unpark", replica=index,
+                          cancelled_drain=False):
+                    pass
+            return h
+
+    @property
+    def parked(self):
+        """Indices currently held out of auto-respawn (spare pool)."""
+        with self._lock:
+            return set(self._parked)
+
     # ---------------------------------------------------------- facade
     def has_unfinished(self):
         with self._lock:
@@ -688,6 +742,7 @@ class Router:
             snap["replica_detail"] = [h.describe()
                                       for h in self._replicas]
             snap["pending_migrations"] = len(self._pending)
+            snap["parked"] = sorted(self._parked)
             return snap
 
     @property

@@ -1,0 +1,62 @@
+"""paddle_tpu.serving.traffic — deterministic workload models, SLO
+autoscaling, and capacity reports for the serving fleet.
+
+The harness every serving claim is measured by (ROADMAP item 4): a
+seeded, JSON-able :class:`TrafficSpec` (arrival process, length
+mixtures, shared-prefix ratio, deadline classes) compiles into a
+byte-identical request trace; the :class:`TrafficDriver` replays it
+open-loop against a stock :class:`~paddle_tpu.serving.router.Router`
+on a :class:`VirtualClock` (same seed ⇒ identical goodput/SLO
+counters); the :class:`SLOAutoscaler` parks/unparks replicas through
+the router's own respawn queue with hysteresis; and
+:func:`probe_capacity` binary-searches max sustained QPS at a declared
+TTFT SLO per replica count into a :class:`CapacityReport`.
+
+Quickstart::
+
+    from paddle_tpu.serving import traffic
+
+    spec = traffic.TrafficSpec(
+        seed=0, arrival={"kind": "poisson", "rate_qps": 12.0},
+        duration_s=2.0, prompt_len=[[1.0, 4, 16]],
+        output_tokens=[[1.0, 4, 8]],
+        classes=[traffic.DeadlineClass("interactive", ttft_slo_s=0.5)])
+    clock = traffic.VirtualClock()
+    router = Router(model, engine_config, num_replicas=2, clock=clock)
+    report = traffic.TrafficDriver(router, spec, clock).run()
+
+Chaos composes: put a FaultPlan dict on ``spec.fault_plan`` (e.g. a
+``rank_kill`` or a ``serving.traffic.tick`` ``qps_surge``) and the same
+run measures goodput under faults.  See docs/serving.md "Traffic, SLOs
+& capacity planning".
+"""
+from paddle_tpu.serving.traffic.autoscaler import (SLO, AutoscalerConfig,
+                                                   SLOAutoscaler)
+from paddle_tpu.serving.traffic.capacity import (CapacityReport,
+                                                 probe_capacity,
+                                                 run_traffic)
+from paddle_tpu.serving.traffic.driver import (TrafficDriver,
+                                               TrafficMetrics,
+                                               VirtualClock)
+from paddle_tpu.serving.traffic.workload import (DeadlineClass,
+                                                 TraceRequest,
+                                                 TrafficSpec,
+                                                 compile_trace,
+                                                 trace_digest)
+
+__all__ = [
+    "AutoscalerConfig",
+    "CapacityReport",
+    "DeadlineClass",
+    "SLO",
+    "SLOAutoscaler",
+    "TraceRequest",
+    "TrafficDriver",
+    "TrafficMetrics",
+    "TrafficSpec",
+    "VirtualClock",
+    "compile_trace",
+    "probe_capacity",
+    "run_traffic",
+    "trace_digest",
+]

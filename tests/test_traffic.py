@@ -1,0 +1,700 @@
+"""paddle_tpu.serving.traffic — seeded workload compiler, virtual-clock
+open-loop driver, SLO autoscaler, and capacity reports.
+
+Acceptance contracts pinned here (ISSUE 18):
+
+- spec round-trip + seeded determinism: the same ``TrafficSpec``
+  compiles to a byte-identical trace (``trace_digest``), and two
+  same-seed driver runs produce IDENTICAL reports and identical
+  registry metric snapshots (the injectable-clock regression — TTFT /
+  ITL / deadline outcomes are properties of the schedule, not the
+  host);
+- arrival statistics: Poisson traces hit the configured rate, on/off
+  traces are measurably denser inside the burst window;
+- autoscaler hysteresis: an oscillating load crossing the dead band
+  every tick causes ZERO scale actions (no flap), a sustained breach
+  exactly one scale-up, a sustained clear exactly one scale-down —
+  and under a real burst the spare replica is claimed within a few
+  ticks (warm AOT respawn) and drained back after;
+- capacity reports are monotone in replica count, with the binary
+  search actually BINDING below the bracket ceiling at 1 replica;
+- chaos composition: the same spec run under a ``spec.fault_plan``
+  (mid-decode replica crash + ``qps_surge``) keeps goodput within the
+  declared budget with ZERO token loss; the REAL multi-process
+  ``rank_kill`` proof (SIGKILL mid-run through the PR 16 fleet) lives
+  in the chaos-marked test at the bottom, run by the tools/lint_all.py
+  chaos gate.
+"""
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+
+import paddle_tpu as P
+from paddle_tpu import serving
+from paddle_tpu.models.gpt import GPTForCausalLM, gpt3_tiny
+from paddle_tpu.observability import export, metrics as obs_metrics
+from paddle_tpu.serving import traffic
+from paddle_tpu.serving.router import ReplicaState, Router, RouterConfig
+from paddle_tpu.serving.traffic import (AutoscalerConfig, CapacityReport,
+                                        DeadlineClass, SLO, SLOAutoscaler,
+                                        TrafficDriver, TrafficSpec,
+                                        VirtualClock, compile_trace,
+                                        probe_capacity, trace_digest)
+
+pytestmark = pytest.mark.traffic
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    P.seed(0)
+    return GPTForCausalLM(gpt3_tiny())
+
+
+@pytest.fixture(scope="module")
+def warm_cache(tiny_model):
+    """Shared AOT cache, prewarmed ONCE: every router boot in this
+    module (probes included) then loads instead of compiling."""
+    d = tempfile.mkdtemp(prefix="ptpu_traffic_cache_")
+    e = serving.LLMEngine(tiny_model, _cfg(), program_cache=d)
+    e.warmup()
+    e.shutdown()
+    yield d
+    shutil.rmtree(d, ignore_errors=True)
+
+
+def _cfg(**kw):
+    d = dict(max_num_seqs=4, page_size=4, max_model_len=48,
+             prefill_buckets=(8, 16, 32), crash_safe_decode=False)
+    d.update(kw)
+    return serving.EngineConfig(**d)
+
+
+def _router(model, n, clock, cache):
+    return Router(model, _cfg(), num_replicas=n,
+                  config=RouterConfig(sleep=lambda s: None),
+                  program_cache=cache, clock=clock)
+
+
+def _spec(**kw):
+    d = dict(name="t", seed=3,
+             arrival={"kind": "poisson", "rate_qps": 10.0},
+             duration_s=1.0, prompt_len=((1.0, 4, 12),),
+             output_tokens=((1.0, 4, 6),),
+             classes=(DeadlineClass("interactive", ttft_slo_s=1.0),))
+    d.update(kw)
+    return TrafficSpec(**d)
+
+
+def _metric_snapshot(name):
+    """Every registry instrument this traffic lane owns, as plain
+    values — the cross-run identity evidence."""
+    snap = {}
+    for m in obs_metrics.registry().collect():
+        if m.labels.get("traffic") != name:
+            continue
+        key = (m.name, tuple(sorted(m.labels.items())))
+        snap[key] = m.summary() if m.kind == "histogram" else m.value
+    return snap
+
+
+# ------------------------------------------------------------ workload
+class TestWorkload:
+    @pytest.mark.smoke
+    def test_spec_json_roundtrip_byte_identical_trace(self):
+        """Acceptance: the spec survives a JSON wire trip and the
+        recompiled trace is byte-identical (digest equality)."""
+        spec = _spec(shared_prefix={"ratio": 0.4, "length": 5},
+                     classes=(DeadlineClass("a", 0.5, weight=2.0),
+                              DeadlineClass("b", 1.0, deadline_s=3.0)),
+                     fault_plan={"name": "p", "faults": [
+                         {"site": "serving.traffic.tick",
+                          "kind": "qps_surge", "at": 9}]})
+        wire = json.loads(json.dumps(spec.to_dict()))
+        spec2 = TrafficSpec.from_dict(wire)
+        assert spec2.to_dict() == spec.to_dict()
+        t1, t2 = compile_trace(spec), compile_trace(spec2)
+        assert trace_digest(t1) == trace_digest(t2)
+        assert [r.to_dict() for r in t1] == [r.to_dict() for r in t2]
+        # compiled requests are well-formed and arrival-ordered
+        lo, hi = spec.vocab
+        for r in t1:
+            assert all(lo <= t < hi for t in r.prompt)
+            assert r.cls in ("a", "b")
+        assert [r.arrive_s for r in t1] == \
+            sorted(r.arrive_s for r in t1)
+
+    def test_seed_determinism_and_sensitivity(self):
+        a = compile_trace(_spec(seed=7))
+        b = compile_trace(_spec(seed=7))
+        c = compile_trace(_spec(seed=8))
+        assert trace_digest(a) == trace_digest(b)
+        assert trace_digest(a) != trace_digest(c)
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError, match="arrival kind"):
+            _spec(arrival={"kind": "uniform", "rate_qps": 1.0})
+        with pytest.raises(ValueError, match="rate_qps"):
+            _spec(arrival={"kind": "poisson", "rate_qps": 0.0})
+        with pytest.raises(ValueError, match="duty"):
+            _spec(arrival={"kind": "onoff", "base_qps": 1, "burst_qps": 2,
+                           "period_s": 1.0, "duty": 1.5})
+        with pytest.raises(ValueError, match="mixture"):
+            _spec(prompt_len=((0.0, 4, 8),))
+        with pytest.raises(ValueError, match="ttft_slo_s"):
+            DeadlineClass("x", ttft_slo_s=0.0)
+
+    def test_poisson_rate_statistic(self):
+        """Empirical arrival rate over a long horizon matches the
+        configured rate (law of large numbers, fixed seed)."""
+        spec = _spec(arrival={"kind": "poisson", "rate_qps": 8.0},
+                     duration_s=400.0)
+        n = len(compile_trace(spec))
+        assert abs(n / 400.0 - 8.0) / 8.0 < 0.15, n
+
+    def test_onoff_burst_window_denser(self):
+        """Arrivals inside the burst window (first `duty` fraction of
+        each period) are much denser than the base window."""
+        spec = _spec(arrival={"kind": "onoff", "base_qps": 1.0,
+                              "burst_qps": 40.0, "period_s": 2.0,
+                              "duty": 0.25}, duration_s=60.0)
+        burst = base = 0
+        for r in compile_trace(spec):
+            if (r.arrive_s % 2.0) < 0.5:
+                burst += 1
+            else:
+                base += 1
+        # burst window is 1/3 the wall length of the base window but
+        # 40x the rate: per-second density must dominate clearly
+        assert burst / 15.0 > 5 * (base / 45.0), (burst, base)
+
+    def test_shared_prefix_ratio_and_identity(self):
+        spec = _spec(shared_prefix={"ratio": 0.5, "length": 6},
+                     duration_s=40.0)
+        trace = compile_trace(spec)
+        shared = [r for r in trace if r.shared_prefix]
+        frac = len(shared) / len(trace)
+        assert 0.35 < frac < 0.65, frac
+        prefixes = {tuple(r.prompt[:6]) for r in shared}
+        assert len(prefixes) == 1, "shared prefix must be spec-wide"
+
+    def test_with_rate_derivation(self):
+        spec = _spec(arrival={"kind": "onoff", "base_qps": 1.0,
+                              "burst_qps": 9.0, "period_s": 1.0,
+                              "duty": 0.5})
+        flat = spec.with_rate(32.0, duration_s=0.5)
+        assert flat.arrival == {"kind": "poisson", "rate_qps": 32.0}
+        assert flat.duration_s == 0.5
+        assert flat.seed == spec.seed
+        # derivation, not mutation
+        assert spec.arrival["kind"] == "onoff"
+        assert spec.duration_s == 1.0
+
+
+# -------------------------------------------------------------- driver
+class TestDriver:
+    @pytest.mark.smoke
+    def test_virtual_clock_contract(self):
+        clk = VirtualClock()
+        assert clk() == 0.0 and clk.now == 0.0
+        clk.advance(0.25)
+        assert clk() == 0.25
+        with pytest.raises(ValueError):
+            clk.advance(-1.0)
+
+    def test_same_seed_runs_identical_reports_and_metrics(
+            self, tiny_model, warm_cache):
+        """THE injectable-clock regression: two same-seed runs against
+        fresh routers produce identical report dicts AND identical
+        registry metric snapshots (counters, gauges, every TTFT/ITL
+        histogram) — arrive_t, deadline TTLs, and TTFT all ride the
+        virtual clock, never the wall."""
+        spec = _spec(seed=5, duration_s=1.2)
+
+        def one():
+            clock = VirtualClock()
+            router = _router(tiny_model, 2, clock, warm_cache)
+            driver = TrafficDriver(router, spec, clock, quantum_s=0.01,
+                                   name="det")
+            rep = driver.run()
+            snap = _metric_snapshot("det")
+            driver.release()
+            router.shutdown()
+            return rep, snap
+
+        rep1, snap1 = one()
+        rep2, snap2 = one()
+        assert rep1 == rep2
+        assert snap1 == snap2
+        assert rep1["offered"] > 0
+        assert rep1["token_loss"] == 0
+
+    def test_strict_slo_counts_violations(self, tiny_model, warm_cache):
+        """TTFT is measured from the INTENDED arrival on the virtual
+        clock: a sub-quantum SLO is unmeetable, so every completion
+        books as an SLO violation, never goodput."""
+        spec = _spec(duration_s=0.6,
+                     classes=(DeadlineClass("strict",
+                                            ttft_slo_s=1e-6),))
+        clock = VirtualClock()
+        router = _router(tiny_model, 1, clock, warm_cache)
+        driver = TrafficDriver(router, spec, clock, quantum_s=0.01,
+                               name="strict")
+        rep = driver.run()
+        driver.release()
+        router.shutdown()
+        assert rep["offered"] > 0
+        assert rep["goodput"] == 0
+        assert rep["violations"] == rep["offered"]
+        assert rep["token_loss"] == 0      # tokens still all generated
+
+    def test_deadline_class_expires_on_virtual_clock(self, tiny_model,
+                                                     warm_cache):
+        """An enforced engine deadline shorter than service time fires
+        on the VIRTUAL clock (the TTL rides arrive_t through the
+        injected clock) — expiries are accounted separately and never
+        booked as token loss."""
+        spec = _spec(duration_s=0.6,
+                     classes=(DeadlineClass("ttl", ttft_slo_s=1.0,
+                                            deadline_s=0.02),))
+        clock = VirtualClock()
+        router = _router(tiny_model, 1, clock, warm_cache)
+        driver = TrafficDriver(router, spec, clock, quantum_s=0.01,
+                               name="ttl")
+        rep = driver.run()
+        driver.release()
+        router.shutdown()
+        assert rep["expired"] > 0
+        assert rep["token_loss"] == 0
+
+
+# ---------------------------------------------------------- autoscaler
+class _FakeHandle:
+    def __init__(self, index):
+        self.index = index
+        self.state = ReplicaState.ACTIVE
+        self.queue = 0.0
+        self.occ = 0.0
+        self.admitting = True
+
+    def telemetry(self):
+        return {"health": "ok", "queue_depth": self.queue, "running": 0,
+                "page_occupancy": self.occ}
+
+
+class _FakeRouter:
+    """Telemetry-scriptable stand-in implementing exactly the router
+    surface the autoscaler reads (replicas / parked / park / unpark)."""
+
+    def __init__(self, n_active=1, n_parked=1):
+        self.replicas = [_FakeHandle(i)
+                         for i in range(n_active + n_parked)]
+        self._parked = set(range(n_active, n_active + n_parked))
+        self.actions = []
+
+    @property
+    def parked(self):
+        return set(self._parked)
+
+    def park(self, idx):
+        self._parked.add(idx)
+        self.actions.append(("park", idx))
+
+    def unpark(self, idx):
+        self._parked.discard(idx)
+        self.actions.append(("unpark", idx))
+
+    def set_queue(self, q):
+        for h in self.replicas:
+            h.queue = q
+
+
+class TestAutoscaler:
+    @pytest.mark.smoke
+    def test_hysteresis_never_flaps_on_oscillating_load(self):
+        """Acceptance: a load crossing the dead band EVERY observation
+        (breach, clear, breach, ...) causes zero scale actions — both
+        streaks reset each flip, so neither threshold is ever reached."""
+        fake = _FakeRouter(n_active=1, n_parked=1)
+        scaler = SLOAutoscaler(
+            fake, slo=SLO(ttft_p99_s=1.0, queue_high=3.0, queue_low=0.5),
+            config=AutoscalerConfig(up_after=2, down_after=4, cooldown=2),
+            clock=lambda: 0.0, name="osc")
+        try:
+            for i in range(40):
+                fake.set_queue(5.0 if i % 2 else 0.2)
+                scaler.observe()
+            assert scaler.scale_ups == 0
+            assert scaler.scale_downs == 0
+            assert fake.actions == []
+        finally:
+            scaler.release()
+
+    @pytest.mark.smoke
+    def test_sustained_breach_then_clear_scales_once_each_way(self):
+        """One sustained breach → exactly one scale-up (lowest parked
+        index); one sustained clear → exactly one scale-down (highest
+        active index). No thrash in between: cooldown + streak resets."""
+        fake = _FakeRouter(n_active=1, n_parked=1)
+        scaler = SLOAutoscaler(
+            fake, slo=SLO(queue_high=3.0, queue_low=0.5),
+            config=AutoscalerConfig(min_replicas=1, up_after=2,
+                                    down_after=4, cooldown=2),
+            clock=lambda: 0.0, name="once")
+        try:
+            fake.set_queue(5.0)
+            for _ in range(10):
+                scaler.observe()
+            assert scaler.scale_ups == 1
+            assert fake.actions == [("unpark", 1)]
+            fake.set_queue(0.1)
+            for _ in range(20):
+                scaler.observe()
+            assert scaler.scale_downs == 1
+            assert fake.actions == [("unpark", 1), ("park", 1)]
+            assert len(scaler.reaction_times) == 1
+        finally:
+            scaler.release()
+
+    @pytest.mark.smoke
+    def test_min_replicas_floor(self):
+        fake = _FakeRouter(n_active=1, n_parked=0)
+        scaler = SLOAutoscaler(
+            fake, slo=SLO(queue_high=3.0, queue_low=0.5),
+            config=AutoscalerConfig(min_replicas=1, up_after=2,
+                                    down_after=2, cooldown=0),
+            clock=lambda: 0.0, name="floor")
+        try:
+            fake.set_queue(0.0)
+            for _ in range(20):
+                scaler.observe()
+            assert scaler.scale_downs == 0 and fake.actions == []
+        finally:
+            scaler.release()
+
+    def test_burst_claims_spare_within_budget_and_drains_back(
+            self, tiny_model, warm_cache):
+        """Acceptance: under a real burst the autoscaler unparks the
+        spare within the pinned reaction budget (the respawn boots WARM
+        from the AOT cache, so reaction is ticks, not compile time),
+        goodput holds, and the spare is drained back once the burst
+        subsides — no admission stalls anywhere."""
+        spec = _spec(seed=11,
+                     arrival={"kind": "onoff", "base_qps": 2.0,
+                              "burst_qps": 40.0, "period_s": 2.0,
+                              "duty": 0.35},
+                     duration_s=2.0,
+                     classes=(DeadlineClass("i", ttft_slo_s=0.5),))
+        clock = VirtualClock()
+        router = _router(tiny_model, 2, clock, warm_cache)
+        router.park(1)
+        router.step()
+        assert sorted(router.parked) == [1]
+        scaler = SLOAutoscaler(
+            router, slo=SLO(ttft_p99_s=0.5, queue_high=3.0,
+                            queue_low=0.5),
+            config=AutoscalerConfig(min_replicas=1, up_after=2,
+                                    down_after=30, cooldown=5),
+            clock=clock, name="burst")
+        driver = TrafficDriver(router, spec, clock, quantum_s=0.01,
+                               name="burst",
+                               on_tick=lambda d: scaler.observe())
+        rep = driver.run()
+        snap = scaler.snapshot()
+        driver.release()
+        scaler.release()
+        router.shutdown()
+        assert snap["scale_ups"] >= 1
+        assert snap["reaction_times_s"], "reaction never recorded"
+        # pinned budget: spare admitting within 3 ticks of the decision
+        assert max(snap["reaction_times_s"]) <= 3 * 0.01 + 1e-9
+        assert snap["scale_downs"] >= 1, "spare never drained back"
+        assert rep["goodput_frac"] >= 0.95
+        assert rep["token_loss"] == 0
+
+    def test_park_unpark_router_semantics(self, tiny_model, warm_cache):
+        """park drains the replica out of rotation (no auto-respawn
+        while parked); unpark re-queues a WARM boot on the existing
+        respawn queue."""
+        router = _router(tiny_model, 2, VirtualClock(), warm_cache)
+        try:
+            router.park(1)
+            router.step()
+            snap = router.snapshot()
+            assert snap["parked"] == [1]
+            h = router.replicas[1]
+            assert h.state is not ReplicaState.ACTIVE
+            router.unpark(1)
+            for _ in range(50):
+                router.step()
+                if router.replicas[1].state is ReplicaState.ACTIVE:
+                    break
+            h = router.replicas[1]
+            assert h.state is ReplicaState.ACTIVE
+            assert router.snapshot()["parked"] == []
+            assert h.boot_info.get("warm") is True
+        finally:
+            router.shutdown()
+
+
+# ------------------------------------------------------------ capacity
+class TestCapacity:
+    @pytest.mark.smoke
+    def test_report_roundtrip_render_and_export(self, tmp_path):
+        rows = [{"replicas": 1, "max_qps": 12.5, "goodput_frac": 0.97,
+                 "ttft_p99_ms": 41.2, "probes": 6},
+                {"replicas": 2, "max_qps": 25.0, "goodput_frac": 0.98,
+                 "ttft_p99_ms": 18.9, "probes": 6}]
+        rep = CapacityReport("cap", slo_ttft_s=0.25, goodput_min=0.95,
+                             rows=rows)
+        rep2 = CapacityReport.from_dict(
+            json.loads(json.dumps(rep.to_dict())))
+        assert rep2.to_dict() == rep.to_dict()
+        assert rep.max_qps(2) == 25.0
+        with pytest.raises(KeyError):
+            rep.max_qps(9)
+        text = rep.render()
+        assert "replicas" in text and "12.5" in text
+        # obs export interchange: capacity records survive the JSONL
+        # dump and come back as plain report dicts
+        path = str(tmp_path / "dump.jsonl")
+        export.dump_jsonl(path, spans=[], recompiles=[],
+                          capacities=[rep])
+        loaded = export.load_jsonl(path)
+        assert loaded["capacities"] == [rep.to_dict()]
+
+    def test_obs_report_cli_renders_capacity(self, tmp_path, capsys):
+        # in-process (test_observability.py idiom): a subprocess here
+        # would re-import jax and pay ~2.5s of tier-1 wall for nothing
+        import importlib.util
+        mod_spec = importlib.util.spec_from_file_location(
+            "obs_report", os.path.join(REPO, "tools", "obs_report.py"))
+        mod = importlib.util.module_from_spec(mod_spec)
+        mod_spec.loader.exec_module(mod)
+        rep = CapacityReport(
+            "cli", slo_ttft_s=0.5, goodput_min=0.95,
+            rows=[{"replicas": 1, "max_qps": 7.75,
+                   "goodput_frac": 1.0, "ttft_p99_ms": 9.9,
+                   "probes": 5}])
+        path = str(tmp_path / "dump.jsonl")
+        export.dump_jsonl(path, spans=[], recompiles=[],
+                          capacities=[rep])
+        assert mod.main(["--capacity", path]) == 0
+        assert "7.75" in capsys.readouterr().out
+        # and the degraded path: no capacity records -> exit 1
+        empty = str(tmp_path / "empty.jsonl")
+        export.dump_jsonl(empty, spans=[], recompiles=[])
+        assert mod.main(["--capacity", empty]) == 1
+
+    def test_capacity_monotone_in_replicas_and_binding(
+            self, tiny_model, warm_cache):
+        """Acceptance: max sustained QPS at the TTFT SLO is monotone in
+        replica count, and the search BINDS at 1 replica (the reported
+        capacity is a real saturation point below the bracket ceiling,
+        not the ceiling echoed back)."""
+        # short spec + iters=3 keeps this inside the tier-1 wall budget;
+        # the full-length sweep lives in the bench lane (--worker-traffic)
+        spec = _spec(seed=9, duration_s=0.7)
+
+        def factory(n, clock):
+            return _router(tiny_model, n, clock, warm_cache)
+
+        rep = probe_capacity(factory, spec, slo_ttft_s=0.25,
+                             replica_counts=(1, 2), qps_lo=1.0,
+                             qps_hi=150.0, iters=2, goodput_min=0.95,
+                             quantum_s=0.01, name="mono")
+        q1, q2 = rep.max_qps(1), rep.max_qps(2)
+        assert q1 is not None and q2 is not None
+        assert 0.0 < q1 < 150.0, f"search never bound: {q1}"
+        assert q2 >= q1, (q1, q2)
+        for row in rep.rows:
+            assert row["probes"] >= 2
+        # probe determinism (same spec -> same report) rides on driver
+        # determinism, pinned by TestDriver::test_same_seed_runs_…;
+        # repeating a sweep here would only re-pay its wall cost
+
+
+# --------------------------------------------------------------- chaos
+class TestChaosCompose:
+    def test_fault_plan_composed_run_keeps_goodput(self, tiny_model,
+                                                   warm_cache):
+        """Acceptance: the SAME spec chaos-composed via spec.fault_plan
+        (a mid-decode replica crash + a qps_surge burst) keeps goodput
+        within the declared budget with zero token loss — the driver
+        arms the plan itself, so the whole chaos run is one JSON file."""
+        spec = _spec(seed=4, duration_s=1.0)
+        chaos = TrafficSpec.from_dict(spec.to_dict())
+        chaos.fault_plan = {"name": "compose", "faults": [
+            {"site": "serving.decode", "kind": "exception", "at": 6},
+            {"site": "serving.traffic.tick", "kind": "qps_surge",
+             "at": 40, "payload": {"requests": 6}}]}
+        clock = VirtualClock()
+        router = _router(tiny_model, 2, clock, warm_cache)
+        driver = TrafficDriver(router, chaos, clock, quantum_s=0.01,
+                               name="compose")
+        rep = driver.run()
+        failovers = router.snapshot()["failovers"]
+        driver.release()
+        router.shutdown()
+        assert failovers >= 1, "injected crash never fired"
+        assert rep["surge_injected"] == 1
+        assert rep["offered"] > 6          # surge extras were offered
+        assert rep["goodput_frac"] >= 0.90
+        assert rep["token_loss"] == 0
+
+    def test_qps_surge_deterministic(self, tiny_model, warm_cache):
+        """The surge's extra requests are compiled from the spec seed at
+        disjoint indices: two chaos-composed runs are identical."""
+        spec = _spec(seed=6, duration_s=0.8)
+        chaos = TrafficSpec.from_dict(spec.to_dict())
+        chaos.fault_plan = {"name": "surge", "faults": [
+            {"site": "serving.traffic.tick", "kind": "qps_surge",
+             "at": 20, "payload": {"requests": 5}}]}
+
+        def one():
+            clock = VirtualClock()
+            router = _router(tiny_model, 1, clock, warm_cache)
+            driver = TrafficDriver(router, chaos, clock,
+                                   quantum_s=0.01, name="surge")
+            rep = driver.run()
+            driver.release()
+            router.shutdown()
+            return rep
+
+        rep1, rep2 = one(), one()
+        assert rep1 == rep2
+        assert rep1["surge_injected"] == 1
+
+
+# ------------------------------------- multi-process rank_kill proof
+TRAFFIC_FLEET_ENV = {
+    "PTPU_FLEET_TIMEOUT_S": "10",
+    "PTPU_FLEET_KV_SLICE_S": "0.05",
+    "PTPU_FLEET_HB_INTERVAL_S": "0.3",
+    "PTPU_FLEET_RENDEZVOUS_TIMEOUT_S": "20",
+}
+TRAFFIC_FLEET_DEADLINE_S = 240.0
+TRAFFIC_KILL_RANK = 2
+FLEET_WORKER = os.path.join(REPO, "paddle_tpu", "serving", "fleet",
+                            "worker.py")
+
+
+def _free_port():
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _child_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    for k in ("PADDLE_MASTER", "PADDLE_NNODES", "PADDLE_TRAINER_ID",
+              "PADDLE_LAUNCH_ID"):
+        env.pop(k, None)
+    env.update(TRAFFIC_FLEET_ENV)
+    env["PADDLE_LAUNCH_ID"] = "trafficchaos"
+    return env
+
+
+def _traffic_scenario(out_dir, cache_dir):
+    spec = TrafficSpec(
+        name="fleet-chaos", seed=13,
+        arrival={"kind": "poisson", "rate_qps": 10.0}, duration_s=1.5,
+        prompt_len=[[1.0, 4, 12]], output_tokens=[[1.0, 4, 6]],
+        # generous VIRTUAL ttft slo: the budget under test is goodput /
+        # token loss across a real SIGKILL, not tail latency
+        classes=[{"name": "chaos", "ttft_slo_s": 30.0}])
+    return {
+        "seed": 0,
+        "model": {"vocab_size": 256, "hidden_size": 64, "num_layers": 2,
+                  "num_heads": 4, "max_seq_len": 128, "dropout": 0.0,
+                  "attention_dropout": 0.0},
+        "engine": {"max_num_seqs": 4, "page_size": 4,
+                   "max_model_len": 48, "prefill_buckets": [8, 16, 32]},
+        "cache_dir": cache_dir, "out_dir": out_dir,
+        "controller_rank": 0, "worker_ranks": [1, 2],
+        "spare_ranks": [3], "quantum_s": 0.05,
+        "traffic": spec.to_dict(),
+        "faults": {str(TRAFFIC_KILL_RANK): [
+            {"site": "serving.fleet.step", "kind": "rank_kill",
+             "at": 5}]},
+        "finalize_s": 6.0,
+    }
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_traffic_rank_kill_goodput_within_budget(tmp_path):
+    """The ISSUE 18 chaos acceptance proof on a REAL 4-process fleet
+    (controller + 2 replicas + 1 spare): a seeded TrafficSpec replayed
+    through the ServingFleet while one replica is SIGKILLed mid-decode.
+    The run must keep goodput within the declared budget (>= 0.9) with
+    ZERO token loss — every in-flight request migrates and replays —
+    and the watchdog's verdict + failover evidence rides the same
+    report, turning the PR 14-16 chaos proofs into capacity-planning
+    numbers.  `slow`-marked: runs in the tools/lint_all.py chaos gate,
+    outside the tier-1 wall budget."""
+    out_dir, cache_dir = tmp_path / "out", tmp_path / "cache"
+    out_dir.mkdir()
+    cache_dir.mkdir()
+    scenario = _traffic_scenario(str(out_dir), str(cache_dir))
+    scenario_path = tmp_path / "scenario.json"
+    scenario_path.write_text(json.dumps(scenario))
+
+    port = _free_port()
+    procs = {
+        r: subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--master", f"127.0.0.1:{port}", "--nnodes", "4",
+             "--rank", str(r), FLEET_WORKER, str(scenario_path)],
+            cwd=REPO, env=_child_env(),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for r in range(4)}
+    ctl_path = out_dir / "controller.json"
+    try:
+        deadline = time.monotonic() + TRAFFIC_FLEET_DEADLINE_S
+        while not ctl_path.exists():
+            if procs[0].poll() is not None:
+                out, _ = procs[0].communicate()
+                pytest.fail(
+                    f"controller exited rc={procs[0].returncode} "
+                    f"without a result\n--- controller log ---\n"
+                    f"{out[-3000:]}")
+            if time.monotonic() > deadline:
+                out, _ = procs[0].communicate() \
+                    if procs[0].poll() is not None else ("", None)
+                pytest.fail("controller wrote no result within "
+                            f"{TRAFFIC_FLEET_DEADLINE_S}s")
+            time.sleep(0.2)
+        for r, p in procs.items():
+            if r != TRAFFIC_KILL_RANK:
+                try:
+                    p.wait(timeout=30.0)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+
+    res = json.loads(ctl_path.read_text())
+    rep = res["traffic"]
+    assert rep["offered"] > 0
+    assert rep["goodput_frac"] >= 0.90, rep
+    assert rep["token_loss"] == 0, rep
+    assert rep["expired"] == 0, rep
+    assert res["snapshot"]["failovers"] >= 1, res["snapshot"]
+    dets = res["detections"]
+    assert any(d["rank"] == TRAFFIC_KILL_RANK for d in dets), dets
+    # the SIGKILLed child really died by signal
+    assert procs[TRAFFIC_KILL_RANK].returncode != 0

@@ -83,6 +83,7 @@ def has_attr_path(obj, name):
 # declared public surface (__all__) is the contract; a name that stops
 # resolving is a regression exactly like a reference-parity gap.
 NATIVE_NAMESPACES = ("serving", "serving.router", "serving.fleet",
+                     "serving.traffic",
                      "analysis", "observability", "quantization",
                      "resilience")
 

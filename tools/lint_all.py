@@ -106,6 +106,7 @@ GATES = {
               os.path.join(REPO, "tests", "test_fleet.py"),
               os.path.join(REPO, "tests", "test_sentinel.py"),
               os.path.join(REPO, "tests", "test_serving_fleet.py"),
+              os.path.join(REPO, "tests", "test_traffic.py"),
               os.path.join(REPO, "tests",
                            "test_distributed_multiprocess.py")],
 }

@@ -26,6 +26,8 @@ Usage:
   python tools/obs_report.py --demo --prom       # Prometheus text
   python tools/obs_report.py --demo --roofline   # live roofline table
   python tools/obs_report.py obs.jsonl --roofline  # from dump records
+  python tools/obs_report.py obs.jsonl --capacity  # CapacityReport
+                                                 # tables from a dump
 
 The demo compiles the tiny-config GPT hybrid train step, perturbs ONE
 input's shape to force a retrace, and shows the resulting recompile
@@ -234,7 +236,36 @@ def main(argv=None):
                     help="render roofline reports (per-layer bytes/flops "
                          "attribution) instead: from the dump's roofline "
                          "records, or live from the gpt target with --demo")
+    ap.add_argument("--capacity", action="store_true",
+                    help="render serving CapacityReport tables (max "
+                         "sustained QPS at the TTFT SLO per replica "
+                         "count) from the dump's capacity records "
+                         "(dump_jsonl(..., capacities=[report]))")
     args = ap.parse_args(argv)
+
+    if args.capacity:
+        if not args.dump:
+            ap.error("--capacity needs a JSONL dump path")
+        from paddle_tpu.observability import export
+        from paddle_tpu.serving.traffic import CapacityReport
+        reports = export.load_jsonl(args.dump).get("capacities", [])
+        if not reports:
+            print(f"obs_report: no capacity records in {args.dump} "
+                  f"(dump_jsonl(..., capacities=[report]) writes them)",
+                  file=sys.stderr)
+            return 1
+        for d in reports:
+            print(CapacityReport.from_dict(d).render())
+            print()
+        if args.json:
+            payload = json.dumps({"capacities": reports}, indent=1,
+                                 sort_keys=True)
+            if args.json == "-":
+                print(payload)
+            else:
+                with open(args.json, "w", encoding="utf-8") as fh:
+                    fh.write(payload + "\n")
+        return 0
 
     if args.roofline:
         if args.demo:

@@ -295,11 +295,87 @@ def target_sentinel():
     }
 
 
+def target_traffic():
+    """The traffic harness's SLO contract on the VIRTUAL clock: a burst
+    trace against a router with one active replica and one parked
+    spare, the SLO autoscaler in the loop.  Every number is a property
+    of the deterministic schedule (seeded trace + virtual time), not of
+    the host, so the gate pins behavior, not wall time.  All metrics
+    are lower-is-better: ``goodput_shortfall_pct`` is 100x(1 -
+    goodput-under-SLO fraction), ``scaleup_reaction_ticks`` is the
+    burst-onset -> spare-admitting reaction time in driver ticks (the
+    warm-AOT-respawn payoff the autoscaler rides), and
+    ``slo_violations`` / ``ttft_p99_ms`` pin the tail."""
+    import shutil
+    import tempfile
+
+    import paddle_tpu as P
+    from paddle_tpu import serving
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import traffic
+    from paddle_tpu.serving.router import Router, RouterConfig
+
+    P.seed(0)
+    mcfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                     num_heads=4, max_seq_len=128, dropout=0.0,
+                     attention_dropout=0.0)
+    ecfg = serving.EngineConfig(max_num_seqs=4, page_size=8,
+                                max_model_len=64, prefill_buckets=(16, 32),
+                                crash_safe_decode=False)
+    model = GPTForCausalLM(mcfg)
+    spec = traffic.TrafficSpec(
+        name="perfgate", seed=11,
+        arrival={"kind": "onoff", "base_qps": 2.0, "burst_qps": 40.0,
+                 "period_s": 2.0, "duty": 0.35},
+        duration_s=2.0, prompt_len=((1.0, 4, 16),),
+        output_tokens=((1.0, 4, 8),),
+        classes=(traffic.DeadlineClass("interactive", ttft_slo_s=0.5),))
+    quantum = 0.01
+    cache = tempfile.mkdtemp(prefix="ptpu_perfgate_traffic_")
+    clock = traffic.VirtualClock()
+    try:
+        router = Router(model, ecfg, num_replicas=2,
+                        config=RouterConfig(sleep=lambda s: None),
+                        program_cache=cache, clock=clock)
+        router.park(1)
+        router.step()
+        scaler = traffic.SLOAutoscaler(
+            router,
+            slo=traffic.SLO(ttft_p99_s=0.5, queue_high=3.0,
+                            queue_low=0.5),
+            config=traffic.AutoscalerConfig(min_replicas=1, up_after=2,
+                                            down_after=30, cooldown=5),
+            clock=clock, name="perfgate")
+        driver = traffic.TrafficDriver(
+            router, spec, clock, quantum_s=quantum, name="perfgate",
+            on_tick=lambda d: scaler.observe())
+        rep = driver.run()
+        snap = scaler.snapshot()
+        reaction_ticks = (max(int(round(t / quantum))
+                              for t in snap["reaction_times_s"])
+                          if snap["reaction_times_s"] else 10 ** 6)
+        out = {
+            "goodput_shortfall_pct": round(
+                100.0 * (1.0 - rep["goodput_frac"]), 3),
+            "slo_violations": rep["violations"] + rep["expired"],
+            "ttft_p99_ms": rep["ttft_p99_ms"],
+            "scaleup_reaction_ticks": reaction_ticks,
+            "token_loss": rep["token_loss"],
+        }
+        driver.release()
+        scaler.release()
+        router.shutdown()
+    finally:
+        shutil.rmtree(cache, ignore_errors=True)
+    return out
+
+
 TARGETS = {
     "gpt_hybrid_train": target_gpt_hybrid_train,
     "serving": target_serving,
     "quantization": target_quantization,
     "sentinel": target_sentinel,
+    "traffic": target_traffic,
 }
 
 
