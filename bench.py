@@ -51,6 +51,7 @@ TRAFFIC_S = 300
 FLEETSERVING_S = 300
 SHARDLINT_S = 150
 RACELINT_S = 90
+PROTOLINT_S = 90
 NUMLINT_S = 150
 KERNLINT_S = 150
 OBS_S = 150
@@ -1410,6 +1411,26 @@ def worker_racelint():
     return 0
 
 
+def worker_protolint():
+    """Static-analysis lane #5: protolint's coordination-KV protocol
+    audit of the whole package (finding count + per-rule breakdown).
+    Pure stdlib AST — no jax import at all — so every BENCH run
+    records the KV-protocol hygiene picture next to the concurrency
+    audit."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    tools_dir = os.path.join(repo, "tools")
+    sys.path.insert(0, tools_dir)
+    try:
+        from _bootstrap import light_paddle_tpu
+        light_paddle_tpu(repo)
+        from paddle_tpu.analysis import proto_rules
+        out = proto_rules.bench_report()
+    finally:
+        sys.path.remove(tools_dir)
+    print(json.dumps(out), flush=True)
+    return 0
+
+
 def _init_backend():
     import jax
 
@@ -1723,6 +1744,8 @@ def main():
         return worker_shardlint()
     if "--worker-racelint" in sys.argv:
         return worker_racelint()
+    if "--worker-protolint" in sys.argv:
+        return worker_protolint()
     if "--worker-numlint" in sys.argv:
         return worker_numlint()
     if "--worker-kernlint" in sys.argv:
@@ -1752,6 +1775,7 @@ def main():
     # ride along on every report — live, cached, or degraded
     sl_proc = _spawn("--worker-shardlint", force_cpu=True)
     rl_proc = _spawn("--worker-racelint", force_cpu=True)
+    pl_proc = _spawn("--worker-protolint", force_cpu=True)
     nl_proc = _spawn("--worker-numlint", force_cpu=True)
     kl_proc = _spawn("--worker-kernlint", force_cpu=True)
     obs_proc = _spawn("--worker-obs", force_cpu=True)
@@ -1792,6 +1816,13 @@ def main():
     else:
         # same rationale as shardlint_error
         merged["racelint_error"] = str(rl_err)
+
+    pl_res, pl_err, _ = _await_json(pl_proc, PROTOLINT_S)
+    if pl_res is not None:
+        merged.update(pl_res)
+    else:
+        # same rationale as shardlint_error
+        merged["protolint_error"] = str(pl_err)
 
     nl_res, nl_err, _ = _await_json(nl_proc, NUMLINT_S)
     if nl_res is not None:
@@ -1913,6 +1944,7 @@ def main():
         # platform really was the TPU; only the freshness is degraded.
         _adopt_lane("shardlint_", "shardlint_findings", sl_err)
         _adopt_lane("racelint_", "racelint_finding_count", rl_err)
+        _adopt_lane("protolint_", "protolint_finding_count", pl_err)
         _adopt_lane("numlint_", "numlint_finding_count", nl_err)
         _adopt_lane("kernlint_", "kernlint_finding_count", kl_err)
         _adopt_lane("obs_", "obs_span_overhead_pct", obs_err)

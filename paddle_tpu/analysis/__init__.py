@@ -26,7 +26,12 @@ precision-flow audit over traced jaxprs — see `tools/numlint.py` and
 docs/numlint.md) and **kernlint** (`kernel_rules.py`/`vmem_model.py`,
 KLxxx audit of Pallas kernel interiors — tile alignment, VMEM budgets,
 grid coverage, in-kernel numerics; see `tools/kernlint.py` and
-docs/kernlint.md).
+docs/kernlint.md) and **protolint** (`kv_model.py`/`proto_rules.py`,
+PLxxx audit of the cross-process coordination-KV protocols — key
+lifecycle, wait boundedness, role cycles, liveness budgets, error
+envelopes — plus the runtime KV event tracer in `kv_tracer.py` the
+chaos suite cross-checks the model against; see `tools/protolint.py`
+and docs/protolint.md).
 """
 from __future__ import annotations
 

@@ -1,0 +1,1081 @@
+"""kv_model — protolint's whole-package world model of coordination-KV
+usage (the PLxxx family's substrate; rules live in :mod:`proto_rules`).
+
+racelint's :mod:`lock_model` answers "which locks does this package
+take, from which threads, in what order"; this module answers the
+multi-process analogue for the coordination key-value store: **which
+keys does the package construct, from which process roles, with what
+set/get/delete lifecycle** — so the PL rules can audit the seven
+hand-rolled KV protocols (fleet wire/disagg/server, the `_coord_*`
+collectives, elastic heartbeats, sentinel votes, resilience.fleet)
+without executing any of them.
+
+Three ideas, mirroring lock_model's shape:
+
+- **Key identity is the construction site.**  Every key the package
+  ever writes is built by an f-string (or a tiny helper returning
+  one), so a symbolic evaluation of the key expression yields a
+  *pattern*: literal segments kept, interpolated values replaced by
+  placeholders named from the expression (``rank``-ish names →
+  ``<rank>``, ``seq``/``round``/``step`` → ``<seq>``, namespace
+  producers → ``<ns>``, else ``<v>``).  ``f"{ns}/serve/r{rank}/req/
+  {seq}"`` becomes ``<ns>/serve/r<rank>/req/<seq>`` — the same
+  identity :mod:`kv_tracer` recovers from concrete runtime keys, so
+  the static model and the dynamic event streams cross-check.
+- **Ops flow through wrappers.**  The sanctioned primitives
+  (``key_value_set*``, ``blocking_key_value_get*``,
+  ``key_value_delete``, ``key_value_dir_get*`` and the bounded fleet
+  helpers ``kv_get_bytes``/``kv_set_bytes``) are leaves; package
+  functions that call them (``wire.post_request``, ``_coord_get`` …)
+  are *wrappers* whose ops are expanded at each call site — so
+  ``RemoteEngineClient.call`` is seen to set the req key, block on
+  the rsp key, and delete it, in that order, under the caller's role.
+- **Roles come from entry points.**  The way lock_model discovers
+  thread roots from ``Thread(target=)``, this model classifies each
+  function into a process role — ``controller`` (ServingFleet /
+  RemoteEngineClient / disagg orchestration), ``replica-server``
+  (ReplicaServer / run_replica), ``monitor`` (FleetMonitor /
+  Heartbeat* / Watchdog) or ``worker`` (SPMD ranks: collectives,
+  sentinel votes, checkpointer) — so PL104 can reason about *which
+  process* blocks on a key *which other process* sets.
+
+Pure stdlib (ast only, no jax import): cheap enough for the bench
+lane and the lint_all gate.  Over-approximation is deliberate; the
+checked-in baseline (tools/protolint_baseline.json) absorbs the
+reviewed remainder.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field, replace
+
+__all__ = [
+    "KeyOp", "FuncRec", "LivenessPair", "PackageModel", "ModuleBuilder",
+    "PRIMS", "canon", "covers", "normalize_concrete_key",
+    "patterns_compatible", "role_of",
+]
+
+
+# ------------------------------------------------------ primitives
+@dataclass(frozen=True)
+class _Prim:
+    kind: str                  # set | get | get_raw | delete | dir_get
+    key_index: int             # positional index of the key argument
+    timeout_index: int = -1    # positional index of the timeout, -1 none
+    timeout_kw: str = ""
+    overwrite: bool = False    # set always overwrites by construction
+
+
+# The sanctioned KV surface.  Helper entries (key at index 1) take the
+# client as their first argument; the rest are client methods (key at
+# index 0).  Functions *named* like a primitive are treated as its
+# implementation and never scanned — fleet.kv_get_bytes' interior
+# slicing loop is the timeout machinery itself, not a protocol site.
+PRIMS = {
+    "key_value_set": _Prim("set", 0),
+    "key_value_set_bytes": _Prim("set", 0),
+    "kv_set_bytes": _Prim("set", 1, overwrite=True),
+    "_kv_set_str": _Prim("set", 1, overwrite=True),
+    "blocking_key_value_get": _Prim("get_raw", 0, 1, "timeout_in_ms"),
+    "blocking_key_value_get_bytes": _Prim("get_raw", 0, 1,
+                                          "timeout_in_ms"),
+    "kv_get_bytes": _Prim("get", 1, 2, "timeout_s"),
+    "key_value_delete": _Prim("delete", 0),
+    "key_value_dir_get": _Prim("dir_get", 0),
+    "key_value_dir_get_bytes": _Prim("dir_get", 0),
+}
+
+_OPAQUE = "<opaque>"
+
+# names whose value is a bounded wait budget — a raw blocking get whose
+# timeout expression references one of these is deadline-driven
+_BOUNDED_NAME_RE = re.compile(
+    r"timeout|deadline|remaining|slice|budget|expiry|_ms$|_s$", re.I)
+_RAW_TIMEOUT_CAP_MS = 600_000   # constants above 10 min are "unbounded"
+
+_ENVELOPE_KEYS = {"ok", "err", "error", "status", "type"}
+
+
+# ------------------------------------------------------------ roles
+# Ordered: first match wins.  Probed against "modname.Class.func".
+_ROLE_RULES = (
+    ("controller", re.compile(
+        r"controller|servingfleet|remoteengine|router|disagg", re.I)),
+    ("monitor", re.compile(r"monitor|watchdog|heartbeat", re.I)),
+    ("replica-server", re.compile(r"server|replica", re.I)),
+)
+
+
+def role_of(modname, class_name, func_name):
+    """Process-role of a function, from the entry-point naming
+    conventions the serving/resilience layers follow (docs/
+    protolint.md "Role discovery")."""
+    probe = ".".join(p for p in (modname, class_name or "",
+                                 func_name or "") if p)
+    for role, rx in _ROLE_RULES:
+        if rx.search(probe):
+            return role
+    return "worker"
+
+
+# ------------------------------------------------- pattern algebra
+def canon(pattern):
+    """Collapse every placeholder-bearing segment to ``<*>`` — the
+    identity under which static patterns and runtime keys compare."""
+    return "/".join("<*>" if "<" in seg else seg
+                    for seg in pattern.strip("/").split("/") if seg)
+
+
+def _seg_match(a, b):
+    return a == b or a == "<*>" or b == "<*>"
+
+
+def covers(prefix_canon, key_canon):
+    """True when a delete of `prefix_canon` reclaims keys of
+    `key_canon` (the coordination service's ``key_value_delete`` has
+    directory semantics: it removes the key and every child)."""
+    p = prefix_canon.split("/")
+    k = key_canon.split("/")
+    return len(p) <= len(k) and all(_seg_match(a, b)
+                                    for a, b in zip(p, k))
+
+
+def patterns_compatible(static_canon, runtime_canon):
+    """Segment-wise wildcard match between a model pattern and a
+    normalized runtime key (kv_tracer's conformance direction)."""
+    s = static_canon.split("/")
+    r = runtime_canon.split("/")
+    return len(s) == len(r) and all(_seg_match(a, b)
+                                    for a, b in zip(s, r))
+
+
+_NS_CONCRETE_RE = re.compile(r"^ptpu/[^/]+/g\d+(/|$)")
+_SEG_RULES = (
+    (re.compile(r"^\d+$"), "<seq>"),
+    (re.compile(r"^r\d+$"), "r<rank>"),
+    (re.compile(r"^s\d+$"), "s<seq>"),
+    (re.compile(r"^g\d+$"), "g<seq>"),
+    (re.compile(r"^h\d+$"), "h<id>"),
+    (re.compile(r"^[0-9a-f]{6,}$"), "<id>"),
+    (re.compile(r"^\d+\.\d+$"), "<v>"),
+)
+
+
+def normalize_concrete_key(key):
+    """A concrete runtime key → the construction-site pattern shape
+    (the tracer half of the shared identity: ``ptpu/ab12/g0/serve/r3/
+    req/17`` → ``<ns>/serve/r<rank>/req/<seq>``-compatible)."""
+    key = str(key).strip("/")
+    m = _NS_CONCRETE_RE.match(key + "/")
+    if m:
+        rest = key.split("/", 3)
+        key = "<ns>" + ("/" + rest[3] if len(rest) > 3 else "")
+    segs = []
+    for seg in key.split("/"):
+        if seg == "<ns>":
+            segs.append(seg)
+            continue
+        for rx, repl in _SEG_RULES:
+            if rx.match(seg):
+                seg = repl
+                break
+        segs.append(seg)
+    return "/".join(segs)
+
+
+# -------------------------------------------------------- records
+@dataclass
+class KeyOp:
+    """One KV operation against one key pattern, at one source site."""
+    kind: str                   # set | get | get_raw | delete | dir_get
+    pattern: str                # display pattern (or <opaque>)
+    path: str
+    line: int
+    col: int
+    func: str                   # qualname of the *defining* function
+    timed: bool = True          # gets: wait is deadline-bounded
+    watchdog: bool = False      # gets: an abort/watchdog callback is
+    #                             threaded through the same call
+    overwrite: bool = False     # sets: overwrite-latest semantics
+    envelope: bool = False      # sets: value carries an ok/err envelope
+    in_except: bool = False
+    shim: bool = False          # deletes: overwrite-compat fallback
+    kv_param: str = ""          # kind-1 wrapper: key is this parameter
+    seq_src: tuple = ()         # provenance of a <seq> slot, or ()
+
+    @property
+    def canon(self):
+        return canon(self.pattern)
+
+    @property
+    def opaque(self):
+        return self.pattern.startswith(_OPAQUE)
+
+
+@dataclass
+class FuncRec:
+    """One function's protocol-relevant content: its own primitive
+    ops plus calls into other package wrappers, in statement order."""
+    node: object
+    qualname: str
+    name: str
+    modname: str
+    class_name: str
+    path: str
+    params: tuple = ()
+    items: list = field(default_factory=list)   # ("op", KeyOp) |
+    #                                             ("call", name, node)
+    single_return: object = None                # key-helper body expr
+    env: dict = field(default_factory=dict)
+    local_assigns: dict = field(default_factory=dict)
+    #   name -> [(lineno, is_const, is_augmented)] in source order
+    called: bool = False        # expanded under some in-package caller
+
+    @property
+    def role(self):
+        return role_of(self.modname, self.class_name, self.name)
+
+
+@dataclass
+class LivenessPair:
+    """An (interval, deadline) constant pair from one config scope —
+    PL105's input."""
+    path: str
+    line: int
+    scope: str
+    interval_name: str
+    interval: float
+    deadline_name: str
+    deadline: float
+
+
+@dataclass
+class PatternInfo:
+    canon: str
+    display: str
+    sets: list = field(default_factory=list)
+    gets: list = field(default_factory=list)        # get + get_raw
+    deletes: list = field(default_factory=list)     # non-shim
+    dir_gets: list = field(default_factory=list)
+    set_roles: set = field(default_factory=set)
+    get_roles: set = field(default_factory=set)
+
+    @property
+    def ns_rooted(self):
+        return any(op.pattern.startswith("<ns>") for op in self.sets)
+
+    @property
+    def seq_lane(self):
+        return any("<seq>" in op.pattern for op in self.sets)
+
+
+# ------------------------------------------------ name → placeholder
+def _hint(name):
+    n = name.lower().lstrip("_")
+    if (n in ("ns", "namespace", "base", "prefix")
+            or n.endswith("namespace") or n.endswith("_ns")):
+        return "<ns>"
+    if ("rank" in n or n in ("pid", "r", "src", "peer", "m", "i",
+                             "member", "members", "grank", "host",
+                             "src_global")):
+        return "<rank>"
+    if ("seq" in n or "round" in n or "step" in n
+            or n in ("rnd", "idx", "old", "n")):
+        return "<seq>"
+    if n == "hid" or n.endswith("id") or "uuid" in n:
+        return "<id>"
+    return "<v>"
+
+
+def _callee_name(func):
+    """Bare name of a call target (last dotted segment)."""
+    while isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _callee_base(func):
+    """Qualifier of an attribute call (``wire`` in ``wire.f(...)``,
+    ``self`` in ``self.f(...)``), else ''. """
+    if isinstance(func, ast.Attribute) and isinstance(func.value,
+                                                      ast.Name):
+        return func.value.id
+    return ""
+
+
+def _is_namespace_producer(func):
+    name = _callee_name(func)
+    return (name.endswith("namespace") or name == "coord_namespace"
+            or name == "_ns")
+
+
+class _KeyEval:
+    """Symbolic evaluation of a key expression into display patterns.
+
+    Returns a list of ``(pattern, seq_src)`` — usually one element;
+    a For-loop binding over a literal tuple (the ``_coord_reap``
+    two-prefix sweep) yields one per binding.  Empty when the
+    expression is outside the supported shape (caller records an
+    opaque op)."""
+
+    _MAX_DEPTH = 8
+    _MAX_BRANCH = 4
+
+    def __init__(self, model, func):
+        self.model = model
+        self.func = func
+
+    # -- public -----------------------------------------------------
+    def eval_key(self, node):
+        out = self._eval(node, 0)
+        return [(p.rstrip("/"), src) for p, src in out if p]
+
+    # -- internals --------------------------------------------------
+    def _eval(self, node, depth):
+        """→ [(pattern, seq_src)]"""
+        if depth > self._MAX_DEPTH:
+            return []
+        if isinstance(node, ast.Constant) and isinstance(node.value,
+                                                         str):
+            return [(node.value, ())]
+        if isinstance(node, ast.JoinedStr):
+            return self._joined(node, depth)
+        if isinstance(node, ast.Name):
+            bindings = self.func.env.get(node.id)
+            if bindings:
+                out = []
+                for b in bindings[:self._MAX_BRANCH]:
+                    out.extend(self._eval(b, depth + 1))
+                if out:
+                    return out
+            if node.id in self.func.params:
+                frag, src = self._fragment(node, depth)
+                return [(frag, src)] if frag else []
+            return []
+        if isinstance(node, ast.Call):
+            name = _callee_name(node.func)
+            if name in ("str", "format", "int", "float", "abs") \
+                    and node.args:
+                return self._eval(node.args[0], depth + 1)
+            if _is_namespace_producer(node.func):
+                return [("<ns>", ())]
+            helper = self.model.resolve_helper(name, self.func,
+                                              _callee_base(node.func))
+            if helper is not None:
+                inner = _KeyEval(self.model, _helper_scope(helper,
+                                                           self.func))
+                return inner._eval(helper.single_return, depth + 1)
+            return []
+        if isinstance(node, ast.IfExp):
+            return (self._eval(node.body, depth + 1)
+                    + self._eval(node.orelse, depth + 1))
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+            left = self._eval(node.left, depth + 1)
+            right = self._eval(node.right, depth + 1)
+            return [(a + b, sa or sb) for a, sa in left[:2]
+                    for b, sb in right[:2]]
+        if isinstance(node, ast.Attribute):
+            frag, src = self._fragment(node, depth)
+            return [(frag, src)] if frag else []
+        return []
+
+    def _joined(self, node, depth):
+        outs = [("", ())]
+        for piece in node.values:
+            if isinstance(piece, ast.Constant):
+                outs = [(p + str(piece.value), s) for p, s in outs]
+                continue
+            if not isinstance(piece, ast.FormattedValue):
+                return []
+            frags = self._fragments(piece.value, depth)
+            if not frags:
+                return []
+            outs = [(p + f, s or fs) for p, s in outs
+                    for f, fs in frags[:self._MAX_BRANCH]]
+            if len(outs) > self._MAX_BRANCH:
+                outs = outs[:self._MAX_BRANCH]
+        return outs
+
+    def _fragments(self, node, depth):
+        """An interpolated value → [(text fragment, seq_src)]."""
+        if depth > self._MAX_DEPTH:
+            return [("<v>", ())]
+        if isinstance(node, ast.Name):
+            bindings = self.func.env.get(node.id)
+            # an int-constant binding (``seq = 0`` before the loop's
+            # ``seq += 1``) is a COUNTER SEED, not the key's value —
+            # keep the name's placeholder, don't bake in the literal
+            if bindings and node.id not in self.func.params and not \
+                    all(isinstance(b, ast.Constant)
+                        and isinstance(b.value, (int, float))
+                        for b in bindings):
+                out = []
+                for b in bindings[:self._MAX_BRANCH]:
+                    out.extend(self._fragments(b, depth + 1))
+                if out:
+                    return out
+            frag, src = self._fragment(node, depth)
+            return [(frag, src)]
+        full = self._eval(node, depth + 1)
+        if full:
+            return full
+        frag, src = self._fragment(node, depth)
+        return [(frag, src)]
+
+    def _fragment(self, node, depth):
+        """One placeholder (with <seq> provenance when derivable)."""
+        if isinstance(node, ast.Constant):
+            return str(node.value), ()
+        if isinstance(node, ast.Name):
+            h = _hint(node.id)
+            src = ()
+            if h == "<seq>":
+                src = (("param", self.func.qualname, node.id)
+                       if node.id in self.func.params
+                       else ("local", self.func.qualname, node.id))
+            return h, src
+        if isinstance(node, ast.Attribute):
+            h = ("<ns>" if node.attr.endswith("namespace")
+                 else _hint(node.attr))
+            src = ()
+            if (h == "<seq>" and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"):
+                src = ("attr", self.func.class_name, node.attr)
+            return h, src
+        if isinstance(node, ast.Subscript):
+            base = node.value
+            if isinstance(base, ast.Name):
+                h = _hint(base.id)
+                src = ()
+                if (h == "<seq>" and base.id
+                        in self.model.module_globals.get(
+                            self.func.modname, ())):
+                    src = ("global", self.func.modname, base.id)
+                return h, src
+            if isinstance(base, ast.Attribute):
+                return _hint(base.attr), ()
+            return "<v>", ()
+        if isinstance(node, ast.BinOp):
+            return self._fragment(node.left, depth + 1)
+        return "<v>", ()
+
+
+def _helper_scope(helper, caller):
+    """Evaluation scope for inlining a key helper: the helper's own
+    params (mapped to name hints) see through to the CALLER's env for
+    closure variables (sentinel's nested ``key_for`` reads ``ns`` /
+    ``site`` from ``digest_vote``'s scope)."""
+    merged_env = dict(caller.env)
+    merged_env.update(helper.env)
+    return replace(helper, env=merged_env)
+
+
+# -------------------------------------------------- module builder
+class ModuleBuilder:
+    """AST pass over one module: function records, env maps, module
+    globals, attribute-assignment index (PL202), liveness constants
+    (PL105)."""
+
+    def __init__(self, path, modname, tree):
+        self.path = path
+        self.modname = modname
+        self.tree = tree
+        self.funcs = []
+        self.globals = set()
+        self.attr_assigns = {}     # (class, attr) -> [(method, lineno,
+        #                             const, augmented)]
+        self.global_assigns = {}   # global name -> [(func, lineno)]
+        #   const stores into a module-global container
+        #   (``_COORD_ROUND[0] = 0``) — PL202's reset evidence
+        self.liveness = []
+        self.import_aliases = {}   # alias -> dotted module
+
+    def build(self):
+        for node in self.tree.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.globals.add(t.id)
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                self._imports(node)
+        self._walk(self.tree, class_name="", qual_prefix="")
+        return self
+
+    def _imports(self, node):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                self.import_aliases[a.asname or a.name.split(".")[0]] \
+                    = a.name
+        else:
+            mod = node.module or ""
+            for a in node.names:
+                self.import_aliases[a.asname or a.name] = \
+                    f"{mod}.{a.name}" if mod else a.name
+
+    def _walk(self, node, class_name, qual_prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                self._walk(child, class_name=child.name,
+                           qual_prefix=f"{qual_prefix}{child.name}.")
+                self._liveness_scan(child)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                self._func(child, class_name, qual_prefix)
+                # nested defs (sentinel's key_for) and methods
+                self._walk(child, class_name,
+                           f"{qual_prefix}{child.name}.")
+            else:
+                self._walk(child, class_name, qual_prefix)
+
+    # -- functions ---------------------------------------------------
+    def _func(self, node, class_name, qual_prefix):
+        if node.name in PRIMS:
+            return      # the sanctioned implementation, not a user
+        a = node.args
+        params = tuple(x.arg for x in (a.posonlyargs + a.args
+                                       + a.kwonlyargs))
+        rec = FuncRec(node=node,
+                      qualname=f"{self.modname}.{qual_prefix}"
+                               f"{node.name}",
+                      name=node.name, modname=self.modname,
+                      class_name=class_name, path=self.path,
+                      params=params)
+        rec.env = self._env(node, rec.local_assigns)
+        # a key helper may carry a docstring and a lazy import above
+        # its return (elastic._hb_prefix) — neither changes the key
+        body = [s for s in node.body
+                if not (isinstance(s, ast.Expr)
+                        and isinstance(s.value, ast.Constant))
+                and not isinstance(s, (ast.Import, ast.ImportFrom))]
+        if (len(body) == 1 and isinstance(body[0], ast.Return)
+                and body[0].value is not None):
+            rec.single_return = body[0].value
+        self._collect(node, rec)
+        if class_name:
+            self._attr_scan(node, class_name)
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Assign) and len(sub.targets) == 1
+                    and isinstance(sub.targets[0], ast.Subscript)
+                    and isinstance(sub.targets[0].value, ast.Name)
+                    and isinstance(sub.value, ast.Constant)
+                    and sub.targets[0].value.id in self.globals):
+                self.global_assigns.setdefault(
+                    sub.targets[0].value.id, []).append(
+                    (node.name, sub.lineno))
+        self.funcs.append(rec)
+
+    def _env(self, node, local_assigns):
+        """name → [bound exprs] from Assigns and literal-tuple For
+        targets, for this function's DIRECT body (nested defs keep
+        their own env); `local_assigns` gains the source-ordered
+        assignment log PL202's local-counter check reads."""
+        env = {}
+
+        def visit(n):
+            for child in ast.iter_child_nodes(n):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    continue
+                if isinstance(child, ast.Assign):
+                    for t in child.targets:
+                        if isinstance(t, ast.Name):
+                            env.setdefault(t.id, []).append(child.value)
+                            local_assigns.setdefault(t.id, []).append(
+                                (child.lineno,
+                                 isinstance(child.value, ast.Constant),
+                                 False))
+                elif isinstance(child, ast.AugAssign) and isinstance(
+                        child.target, ast.Name):
+                    local_assigns.setdefault(
+                        child.target.id, []).append(
+                        (child.lineno, False, True))
+                elif isinstance(child, ast.For) and isinstance(
+                        child.target, ast.Name) and isinstance(
+                        child.iter, (ast.Tuple, ast.List)):
+                    env.setdefault(child.target.id, []).extend(
+                        child.iter.elts)
+                visit(child)
+
+        visit(node)
+        return env
+
+    def _collect(self, node, rec):
+        """Ordered (op|call) items, with except-handler context."""
+        items = []
+
+        def visit(n, except_of):
+            for child in ast.iter_child_nodes(n):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    continue
+                ctx = except_of
+                if isinstance(child, ast.ExceptHandler):
+                    ctx = n        # the owning Try node
+                if isinstance(child, ast.Call):
+                    items.append((child, ctx))
+                visit(child, ctx)
+
+        def walk_try_aware(n, except_of):
+            # ast.iter_child_nodes on a Try yields body stmts then
+            # handlers; the recursion above flags handler bodies via
+            # the ExceptHandler hop
+            visit(n, except_of)
+
+        walk_try_aware(node, None)
+        items.sort(key=lambda it: (it[0].lineno, it[0].col_offset))
+        for call, try_node in items:
+            name = _callee_name(call.func)
+            if name in PRIMS:
+                rec.items.append(
+                    ("op", self._prim_op(call, name, rec, try_node)))
+            elif name:
+                rec.items.append(("call", name, call))
+
+    def _prim_op(self, call, name, rec, try_node):
+        prim = PRIMS[name]
+        key_node = self._arg(call, prim.key_index, "key")
+        evaluator = _KeyEval(_ModelView(self), rec)
+        patterns = (evaluator.eval_key(key_node)
+                    if key_node is not None else [])
+        if not patterns:
+            patterns = [(f"{_OPAQUE}:{rec.qualname}", ())]
+        pattern, seq_src = patterns[0]
+        op = KeyOp(kind=prim.kind, pattern=pattern, path=self.path,
+                   line=call.lineno, col=call.col_offset,
+                   func=rec.qualname, seq_src=seq_src)
+        op._alt_patterns = [p for p, _ in patterns[1:]]
+        # cross-module key helpers (disagg's wire.handoff_key) can't
+        # resolve until the whole package is loaded — keep the AST so
+        # PackageModel.finalize can retry opaque evaluations
+        op._key_node = key_node
+        op._rec = rec
+        if (key_node is not None and isinstance(key_node, ast.Name)
+                and key_node.id in rec.params):
+            op.kv_param = key_node.id
+        if prim.kind == "set":
+            op.overwrite = prim.overwrite or any(
+                kw.arg == "allow_overwrite" for kw in call.keywords)
+            value_node = self._arg(call, prim.key_index + 1, "value")
+            op.envelope = self._has_envelope(value_node, rec)
+        if prim.kind == "get_raw":
+            op.timed = self._raw_timed(call, prim)
+            op.watchdog = any(
+                re.search(r"abort|watchdog", sub.id if isinstance(
+                    sub, ast.Name) else sub.attr, re.I) is not None
+                for sub in ast.walk(call)
+                if isinstance(sub, (ast.Name, ast.Attribute)))
+        if prim.kind == "delete":
+            op.in_except = try_node is not None
+            if try_node is not None:
+                op.shim = self._is_shim(try_node, op, rec)
+        return op
+
+    def _arg(self, call, index, kwname):
+        if index < len(call.args):
+            a = call.args[index]
+            return None if isinstance(a, ast.Starred) else a
+        for kw in call.keywords:
+            if kw.arg == kwname:
+                return kw.value
+        return None
+
+    def _raw_timed(self, call, prim):
+        node = None
+        if prim.timeout_index < len(call.args):
+            node = call.args[prim.timeout_index]
+        else:
+            for kw in call.keywords:
+                if kw.arg == prim.timeout_kw:
+                    node = kw.value
+        if node is None:
+            return False
+        if isinstance(node, ast.Constant):
+            try:
+                return 0 < float(node.value) <= _RAW_TIMEOUT_CAP_MS
+            except (TypeError, ValueError):
+                return False
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and _BOUNDED_NAME_RE.search(
+                    sub.id):
+                return True
+            if isinstance(sub, ast.Attribute) and \
+                    _BOUNDED_NAME_RE.search(sub.attr):
+                return True
+        return False
+
+    def _has_envelope(self, value_node, rec):
+        if value_node is None:
+            return False
+        seen = [value_node]
+        for sub in ast.walk(value_node):
+            if isinstance(sub, ast.Name):
+                seen.extend(rec.env.get(sub.id, ()))
+        for root in seen:
+            for sub in ast.walk(root):
+                if isinstance(sub, ast.Dict):
+                    keys = {k.value for k in sub.keys
+                            if isinstance(k, ast.Constant)}
+                    if keys & _ENVELOPE_KEYS:
+                        return True
+        return False
+
+    def _is_shim(self, try_node, delete_op, rec):
+        """A delete in an except handler whose try body SETS the same
+        pattern is the allow_overwrite compatibility fallback — not a
+        lifecycle delete."""
+        evaluator = _KeyEval(_ModelView(self), rec)
+        for stmt in getattr(try_node, "body", ()):
+            for sub in ast.walk(stmt):
+                if not isinstance(sub, ast.Call):
+                    continue
+                name = _callee_name(sub.func)
+                prim = PRIMS.get(name)
+                if prim is None or prim.kind != "set":
+                    continue
+                key_node = self._arg(sub, prim.key_index, "key")
+                if key_node is None:
+                    continue
+                for p, _src in evaluator.eval_key(key_node):
+                    if canon(p) == delete_op.canon:
+                        return True
+        return False
+
+    # -- PL202 index -------------------------------------------------
+    def _attr_scan(self, node, class_name):
+        for sub in ast.walk(node):
+            target = None
+            augmented = False
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                target = sub.targets[0]
+                const = isinstance(sub.value, ast.Constant)
+            elif isinstance(sub, ast.AugAssign):
+                target = sub.target
+                const = False
+                augmented = True
+            else:
+                continue
+            if (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"):
+                self.attr_assigns.setdefault(
+                    (class_name, target.attr), []).append(
+                    (node.name, sub.lineno, const, augmented))
+
+    # -- PL105 constants ---------------------------------------------
+    _INTERVAL_RE = re.compile(r"interval", re.I)
+    _DEADLINE_RE = re.compile(r"stale|suspect|(^|_)dead", re.I)
+
+    def _liveness_scan(self, cls):
+        init = next((m for m in cls.body
+                     if isinstance(m, ast.FunctionDef)
+                     and m.name == "__init__"), None)
+        if init is None:
+            return
+        scope = {}
+        a = init.args
+        pos = a.posonlyargs + a.args
+        defaults = a.defaults
+        for arg, dflt in zip(pos[len(pos) - len(defaults):], defaults):
+            v = self._const(dflt, scope)
+            if v is not None:
+                scope[arg.arg] = v
+        for stmt in init.body:
+            if (isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Attribute)
+                    and isinstance(stmt.targets[0].value, ast.Name)
+                    and stmt.targets[0].value.id == "self"):
+                v = self._const(stmt.value, scope)
+                if v is not None:
+                    scope[stmt.targets[0].attr] = v
+        intervals = [(k, v) for k, v in scope.items()
+                     if self._INTERVAL_RE.search(k) and v > 0]
+        deadlines = [(k, v) for k, v in scope.items()
+                     if self._DEADLINE_RE.search(k) and v > 0]
+        for iname, ival in intervals:
+            for dname, dval in deadlines:
+                self.liveness.append(LivenessPair(
+                    path=self.path, line=cls.lineno, scope=cls.name,
+                    interval_name=iname, interval=ival,
+                    deadline_name=dname, deadline=dval))
+
+    def _const(self, node, scope, depth=0):
+        if depth > 6 or node is None:
+            return None
+        if isinstance(node, ast.Constant):
+            return (float(node.value)
+                    if isinstance(node.value, (int, float))
+                    and not isinstance(node.value, bool) else None)
+        if isinstance(node, ast.Name):
+            return scope.get(node.id)
+        if isinstance(node, ast.Attribute) and isinstance(
+                node.value, ast.Name) and node.value.id == "self":
+            return scope.get(node.attr)
+        if isinstance(node, ast.IfExp):
+            v = self._const(node.body, scope, depth + 1)
+            return v if v is not None else self._const(node.orelse,
+                                                      scope, depth + 1)
+        if isinstance(node, ast.BinOp):
+            left = self._const(node.left, scope, depth + 1)
+            right = self._const(node.right, scope, depth + 1)
+            if left is None or right is None:
+                return None
+            try:
+                if isinstance(node.op, ast.Mult):
+                    return left * right
+                if isinstance(node.op, ast.Div):
+                    return left / right
+                if isinstance(node.op, ast.Add):
+                    return left + right
+                if isinstance(node.op, ast.Sub):
+                    return left - right
+            except ZeroDivisionError:
+                return None
+            return None
+        if isinstance(node, ast.Call):
+            name = _callee_name(node.func)
+            if name in ("_env_float", "_env_int") and len(node.args) \
+                    >= 2:
+                return self._const(node.args[1], scope, depth + 1)
+            if name in ("float", "int", "abs") and node.args:
+                return self._const(node.args[0], scope, depth + 1)
+            if name in ("min", "max") and node.args:
+                vals = [self._const(x, scope, depth + 1)
+                        for x in node.args]
+                if all(v is not None for v in vals):
+                    return (min if name == "min" else max)(vals)
+            return None
+        return None
+
+
+class _ModelView:
+    """Helper-resolution view a ModuleBuilder hands its evaluators
+    before the PackageModel exists (same-module helpers only at build
+    time; the PackageModel swaps in cross-module resolution)."""
+
+    def __init__(self, builder):
+        self.builder = builder
+        self.module_globals = {builder.modname: builder.globals}
+
+    def resolve_helper(self, name, caller, base):
+        for f in self.builder.funcs:
+            if f.name == name and f.single_return is not None:
+                return f
+        return None
+
+
+# --------------------------------------------------- package model
+class PackageModel:
+    def __init__(self):
+        self.modules = []            # ModuleBuilder
+        self.funcs = []
+        self.module_globals = {}
+        self.global_const_assigns = {}   # (modname, global) ->
+        #                                  [(func, lineno)]
+        self.attr_assigns = {}
+        self.liveness_pairs = []
+        self._by_name = {}
+        self._by_mod = {}
+        self._expanded = {}
+        self.pattern_table = {}
+
+    def add(self, builder):
+        self.modules.append(builder)
+        self.funcs.extend(builder.funcs)
+        self.module_globals[builder.modname] = builder.globals
+        for k, v in builder.global_assigns.items():
+            self.global_const_assigns.setdefault(
+                (builder.modname, k), []).extend(v)
+        for k, v in builder.attr_assigns.items():
+            self.attr_assigns.setdefault(k, []).extend(v)
+        self.liveness_pairs.extend(builder.liveness)
+
+    # -- helper / wrapper resolution --------------------------------
+    def resolve_helper(self, name, caller, base):
+        """A key-construction helper (single-return function) by bare
+        name: same module first, then an import-alias-qualified
+        module, then anywhere unique."""
+        f = self._lookup(name, caller, base)
+        return f if f is not None and f.single_return is not None \
+            else None
+
+    def _lookup(self, name, caller, base):
+        mod = self._by_mod.get(caller.modname, {})
+        if name in mod:
+            return mod[name]
+        if base and base not in ("self", "cls"):
+            builder = next((b for b in self.modules
+                            if b.modname == caller.modname), None)
+            alias = (builder.import_aliases.get(base, "")
+                     if builder else "")
+            if alias:
+                for m, table in self._by_mod.items():
+                    if (m == alias or m.endswith("." + alias)
+                            or alias.endswith(m)) and name in table:
+                        return table[name]
+        cands = self._by_name.get(name, ())
+        return cands[0] if len(cands) == 1 else None
+
+    # -- finalize ----------------------------------------------------
+    def finalize(self):
+        for f in self.funcs:
+            self._by_mod.setdefault(f.modname, {})[f.name] = f
+            self._by_name.setdefault(f.name, []).append(f)
+        self._reeval_opaque()
+        for f in self.funcs:
+            self.expanded_ops(f)
+        self._build_pattern_table()
+        return self
+
+    def _reeval_opaque(self):
+        """Retry key evaluation for ops that needed a helper from
+        another module (build time only sees one module at a time)."""
+        for f in self.funcs:
+            for item in f.items:
+                if item[0] != "op":
+                    continue
+                op = item[1]
+                node = getattr(op, "_key_node", None)
+                if not op.opaque or node is None:
+                    continue
+                patterns = _KeyEval(self, op._rec).eval_key(node)
+                if patterns:
+                    op.pattern, op.seq_src = patterns[0]
+                    op._alt_patterns = [p for p, _ in patterns[1:]]
+
+    def expanded_ops(self, func):
+        """The function's KV ops with package wrappers expanded at
+        their call sites (key-parameter substitution for kind-1
+        wrappers), in statement order."""
+        return self._expand(func, frozenset())
+
+    def _expand(self, func, stack):
+        key = func.qualname
+        if key in self._expanded:
+            return self._expanded[key]
+        if key in stack:
+            return []
+        out = []
+        for item in func.items:
+            if item[0] == "op":
+                out.append(item[1])
+                continue
+            _tag, name, call = item
+            callee = self._lookup(name, func, _callee_base(call.func))
+            if callee is None or callee is func:
+                continue
+            inner = self._expand(callee, stack | {key})
+            if not inner:
+                continue
+            callee.called = True
+            for op in inner:
+                out.append(self._substitute(op, callee, call, func))
+        self._expanded[key] = out
+        return out
+
+    def _substitute(self, op, callee, call, caller):
+        if not op.kv_param:
+            return op
+        arg = self._bound_arg(call, callee, op.kv_param)
+        if arg is None:
+            return replace(op, kv_param="",
+                           pattern=f"{_OPAQUE}:{caller.qualname}")
+        if isinstance(arg, ast.Name) and arg.id in caller.params \
+                and arg.id not in caller.env:
+            return replace(op, kv_param=arg.id)   # re-parameterize
+        patterns = _KeyEval(self, caller).eval_key(arg)
+        if not patterns:
+            return replace(op, kv_param="",
+                           pattern=f"{_OPAQUE}:{caller.qualname}")
+        pattern, seq_src = patterns[0]
+        return replace(op, kv_param="", pattern=pattern,
+                       seq_src=seq_src or op.seq_src)
+
+    def _bound_arg(self, call, callee, param):
+        try:
+            idx = callee.params.index(param)
+        except ValueError:
+            return None
+        # methods are called without their `self` slot
+        if callee.class_name and callee.params \
+                and callee.params[0] in ("self", "cls"):
+            idx -= 1
+        if 0 <= idx < len(call.args):
+            a = call.args[idx]
+            return None if isinstance(a, ast.Starred) else a
+        for kw in call.keywords:
+            if kw.arg == param:
+                return kw.value
+        return None
+
+    # -- aggregation -------------------------------------------------
+    def top_funcs(self):
+        """Functions that are not expanded under an in-package caller
+        — the per-role op sequences PL104/PL201 reason over."""
+        return [f for f in self.funcs if not f.called]
+
+    def _build_pattern_table(self):
+        table = {}
+        seen = set()
+        for f in self.top_funcs():
+            role = f.role
+            for op in self.expanded_ops(f):
+                if op.opaque:
+                    continue
+                for pattern in [op.pattern] + getattr(
+                        op, "_alt_patterns", []):
+                    c = canon(pattern)
+                    info = table.get(c)
+                    if info is None:
+                        info = table[c] = PatternInfo(canon=c,
+                                                      display=pattern)
+                    dedupe = (op.path, op.line, op.col, op.kind,
+                              pattern, role)
+                    if dedupe in seen:
+                        continue
+                    seen.add(dedupe)
+                    this = (replace(op, pattern=pattern)
+                            if pattern != op.pattern else op)
+                    if op.kind == "set":
+                        info.sets.append(this)
+                        info.set_roles.add(role)
+                    elif op.kind in ("get", "get_raw"):
+                        info.gets.append(this)
+                        info.get_roles.add(role)
+                    elif op.kind == "delete":
+                        if not op.shim:
+                            info.deletes.append(this)
+                    elif op.kind == "dir_get":
+                        info.dir_gets.append(this)
+        self.pattern_table = table
+
+    # -- queries the rules use --------------------------------------
+    def all_deletes(self):
+        for info in self.pattern_table.values():
+            for op in info.deletes:
+                yield op
+
+    def delete_covers(self, pattern_canon, include_root=False):
+        """Non-shim deletes reclaiming keys of `pattern_canon`; the
+        bare-namespace root reap (``<*>``) is the end-of-run backstop,
+        not a lifecycle policy, and excluded by default."""
+        out = []
+        for op in self.all_deletes():
+            if not include_root and op.canon == "<*>":
+                continue
+            if covers(op.canon, pattern_canon):
+                out.append(op)
+        return out
+
+    def dir_get_covers(self, pattern_canon):
+        out = []
+        for info in self.pattern_table.values():
+            for op in info.dir_gets:
+                if covers(op.canon, pattern_canon):
+                    out.append(op)
+        return out

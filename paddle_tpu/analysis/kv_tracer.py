@@ -1,0 +1,408 @@
+"""Runtime coordination-KV event tracer — protolint's dynamic half.
+
+The static pass (:mod:`kv_model` + :mod:`proto_rules`) proves
+properties of the key patterns the package *constructs*; what it
+cannot see is the ACTUAL per-process event stream a live run produces
+— the order one process sets, consumes and deletes each concrete key,
+including runs where a peer is SIGKILLed mid-protocol.  The tracer
+closes that gap the way :mod:`lock_tracer` does for lock order:
+
+- :class:`KVEventTracer` monkey-patches the KV client surface
+  (``fleet.LocalKVClient``'s methods) for its ``with`` scope, so every
+  in-process rank-per-thread fleet the tests build is recorded with
+  zero test changes.
+- :class:`TracedKVClient` wraps an arbitrary client object (the real
+  jax.distributed coordination client in a spawned worker process);
+  :func:`arm_from_env` installs it behind ``fleet._client`` /
+  ``collective._coord_client`` when ``PTPU_KV_TRACE_DIR`` is set, so
+  the multiprocess chaos workers append their streams as JSONL files
+  the parent test collects after the kill.
+- Every event is identified by :func:`kv_model.normalize_concrete_key`
+  — the same construction-site pattern identity the static model keys
+  on, which is what makes the static/dynamic cross-check possible.
+
+Verdicts:
+
+- :func:`lifecycle_violations` — per-process streams replayed against
+  the key-lifecycle rules: a successful get AFTER this process
+  deleted the key (no re-set in between), and a DOUBLE-CONSUME on an
+  exactly-once lane (two gets, no intervening set) — the dynamic
+  PL102 evidence.  Exactly-once lanes come from the static model's
+  consume-then-delete idiom (:func:`consume_once_canons`), or, with
+  no model, from the stream itself (a lane this run get-then-deleted
+  is a consume lane).
+- :func:`check_static` — both conformance directions: observed SET
+  patterns the model does not contain (unmodeled protocol surface),
+  plus the lifecycle violations above.
+- :func:`residual_keys` — the end-of-test "nothing left in the
+  store" assertion the multiprocess tests use: every surviving
+  ``ptpu/`` key except the reviewed persistent set is a leak.
+
+Event files are append-mode, one JSON object per line, flushed per
+event — a SIGKILL loses at most the in-flight line, and the parent's
+reader skips torn trailing lines, so kill-chaos runs stay analyzable.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+from paddle_tpu.analysis.kv_model import (canon,
+                                          normalize_concrete_key,
+                                          patterns_compatible)
+
+__all__ = ["KVEventTracer", "TracedKVClient", "active_tracer",
+           "arm_from_env", "lifecycle_violations", "consume_once_canons",
+           "check_static", "residual_keys"]
+
+_active = None
+
+# (method name, event op, is-prefix op) — the sanctioned client
+# surface; everything else forwards untraced
+_METHODS = (
+    ("key_value_set", "set", False),
+    ("key_value_set_bytes", "set", False),
+    ("blocking_key_value_get", "get", False),
+    ("blocking_key_value_get_bytes", "get", False),
+    ("key_value_dir_get", "dir", True),
+    ("key_value_dir_get_bytes", "dir", True),
+    ("key_value_delete", "delete", False),
+)
+
+# keys that are DESIGNED to outlive a run (reviewed in
+# tools/protolint_baseline.json) — residual_keys ignores them
+PERSISTENT_KEYS = ("ptpu/launch/current",)
+
+
+def active_tracer():
+    return _active
+
+
+class _Sink:
+    """Append-mode JSONL event sink, flushed per line (kill-safe)."""
+
+    def __init__(self, path):
+        self._fh = open(path, "a", encoding="utf-8")
+        self._lock = threading.Lock()
+
+    def write(self, event):
+        line = json.dumps(event, sort_keys=True)
+        with self._lock:
+            self._fh.write(line + "\n")
+            self._fh.flush()
+
+    def close(self):
+        try:
+            self._fh.close()
+        except Exception:
+            pass
+
+
+class _Recorder:
+    """Shared event log: in-memory list plus optional JSONL sink."""
+
+    def __init__(self, sink=None, pid=None):
+        self.events = []
+        self._lock = threading.Lock()
+        self._sink = sink
+        self._pid = pid if pid is not None else os.getpid()
+        self._n = 0
+
+    def record(self, op, key):
+        ev = {"op": op, "key": str(key), "pid": self._pid,
+              "i": self._n}
+        with self._lock:
+            ev["i"] = self._n
+            self._n += 1
+            self.events.append(ev)
+        if self._sink is not None:
+            self._sink.write(ev)
+
+
+class TracedKVClient:
+    """Proxy over a coordination-KV client: forwards everything,
+    records each SUCCESSFUL sanctioned-surface call (timeouts and
+    errors raise through unrecorded — a failed get consumed
+    nothing)."""
+
+    def __init__(self, client, recorder):
+        self._client = client
+        self._recorder = recorder
+
+    def __getattr__(self, name):
+        return getattr(self._client, name)
+
+
+def _traced_method(name, op):
+    def method(self, *args, **kwargs):
+        out = getattr(self._client, name)(*args, **kwargs)
+        key = args[0] if args else kwargs.get(
+            "key", kwargs.get("prefix", ""))
+        self._recorder.record(op, key)
+        return out
+    method.__name__ = name
+    return method
+
+
+for _name, _op, _ in _METHODS:
+    setattr(TracedKVClient, _name, _traced_method(_name, _op))
+
+
+class KVEventTracer:
+    """Context manager recording every LocalKVClient operation in
+    this process (class-level patch: all instances, no test
+    changes).  `trace_dir` adds the kill-safe JSONL sink the
+    multiprocess workers use."""
+
+    def __init__(self, trace_dir=None, tag=""):
+        sink = None
+        if trace_dir:
+            os.makedirs(trace_dir, exist_ok=True)
+            suffix = f"-{tag}" if tag else ""
+            sink = _Sink(os.path.join(
+                trace_dir, f"kv-{os.getpid()}{suffix}.jsonl"))
+        self._sink = sink
+        self.recorder = _Recorder(sink=sink)
+        self._orig = {}
+
+    @property
+    def events(self):
+        return list(self.recorder.events)
+
+    def __enter__(self):
+        global _active
+        if _active is not None:
+            raise RuntimeError("a KVEventTracer is already active "
+                               "(nesting tracers is not supported)")
+        from paddle_tpu.resilience import fleet
+
+        cls = fleet.LocalKVClient
+        for name, op, _ in _METHODS:
+            orig = getattr(cls, name)
+            self._orig[name] = orig
+
+            def patched(inst, *args, _orig=orig, _op=op, **kwargs):
+                out = _orig(inst, *args, **kwargs)
+                tracer = _active
+                if tracer is not None:
+                    key = args[0] if args else kwargs.get(
+                        "key", kwargs.get("prefix", ""))
+                    tracer.recorder.record(_op, key)
+                return out
+
+            patched.__name__ = name
+            setattr(cls, name, patched)
+        _active = self
+        return self
+
+    def __exit__(self, *exc):
+        global _active
+        from paddle_tpu.resilience import fleet
+
+        for name, orig in self._orig.items():
+            setattr(fleet.LocalKVClient, name, orig)
+        _active = None
+        if self._sink is not None:
+            self._sink.close()
+        return False
+
+    # ---- verdicts ----
+    def violations(self, model=None):
+        return lifecycle_violations(self.events, model=model)
+
+    def check_static(self, model):
+        return check_static(model, self.events)
+
+    def snapshot(self, model=None):
+        evs = self.events
+        ops = {}
+        for ev in evs:
+            ops[ev["op"]] = ops.get(ev["op"], 0) + 1
+        return {
+            "events": len(evs),
+            "ops": dict(sorted(ops.items())),
+            "violations": lifecycle_violations(evs, model=model),
+        }
+
+
+def arm_from_env():
+    """Worker-process arming: when ``PTPU_KV_TRACE_DIR`` is set,
+    wrap the real coordination client behind ``fleet._client`` and
+    ``collective._coord_client`` in a recording proxy whose JSONL
+    stream lands in that directory.  No-op (returns None) when the
+    env var is absent, so worker entry points call this
+    unconditionally."""
+    trace_dir = os.environ.get("PTPU_KV_TRACE_DIR")
+    if not trace_dir:
+        return None
+    os.makedirs(trace_dir, exist_ok=True)
+    sink = _Sink(os.path.join(trace_dir,
+                              f"kv-{os.getpid()}.jsonl"))
+    recorder = _Recorder(sink=sink)
+
+    from paddle_tpu.distributed import collective
+    from paddle_tpu.resilience import fleet
+
+    def wrapping(orig):
+        def wrapped(*args, **kwargs):
+            client = orig(*args, **kwargs)
+            if client is None or isinstance(client, TracedKVClient):
+                return client
+            return TracedKVClient(client, recorder)
+        return wrapped
+
+    fleet._client = wrapping(fleet._client)
+    collective._coord_client = wrapping(collective._coord_client)
+    return recorder
+
+
+# ----------------------------------------------------------- verdicts
+def consume_once_canons(model):
+    """Canons the static model consumes with the get-then-delete
+    idiom (some function gets the key, then deletes it): the
+    exactly-once lanes double-consume applies to."""
+    out = set()
+    for f in model.funcs:
+        gets = {}
+        for item in f.items:
+            if item[0] != "op":
+                continue
+            op = item[1]
+            if op.opaque:
+                continue
+            if op.kind in ("get", "get_raw"):
+                gets.setdefault(op.canon, op.line)
+            elif op.kind == "delete" and not op.shim:
+                if op.canon in gets and op.line > gets[op.canon]:
+                    out.add(op.canon)
+    return out
+
+
+def _covers_key(deleted, key):
+    d = deleted.rstrip("/")
+    return key == d or key.startswith(d + "/")
+
+
+def lifecycle_violations(events, model=None):
+    """Replay per-process event streams against the lifecycle rules.
+
+    Returns a sorted list of violation strings (empty == clean):
+
+    - ``get-after-delete``: this process read a key after deleting it
+      (or a covering prefix) with no re-set in between — it consumed
+      a payload the protocol already reclaimed.
+    - ``double-consume``: two successful gets of the same concrete
+      key on an exactly-once lane with no intervening set — the
+      SIGSTOP-resume / retry double-delivery PL102 polices.
+    """
+    if model is not None:
+        consume = consume_once_canons(model)
+    else:
+        consume = None      # derive from each stream below
+    streams = {}
+    for ev in events:
+        streams.setdefault(ev.get("pid", 0), []).append(ev)
+    out = []
+    for pid, evs in sorted(streams.items()):
+        evs = sorted(evs, key=lambda e: e.get("i", 0))
+        lanes = consume
+        if lanes is None:
+            # a key this run got and then deleted BY EXACT NAME is a
+            # consume-once lane; prefix reaps (the two-rounds-behind
+            # sweep) deliberately do not qualify — keys under them
+            # are broadcast-read
+            lanes = set()
+            got = set()
+            for ev in evs:
+                if ev["op"] == "get":
+                    got.add(ev["key"])
+                elif ev["op"] == "delete" and ev["key"] in got:
+                    lanes.add(canon(normalize_concrete_key(
+                        ev["key"])))
+        deleted = set()     # concrete keys this process reclaimed
+        consumed = set()    # concrete keys this process already got
+        for ev in evs:
+            op, key = ev["op"], ev["key"]
+            if op == "set":
+                deleted.discard(key)
+                consumed.discard(key)
+            elif op == "delete":
+                for k in list(consumed):
+                    if _covers_key(key, k):
+                        consumed.discard(k)
+                deleted.add(key)
+            elif op == "get":
+                hit = [d for d in deleted if _covers_key(d, key)]
+                if hit:
+                    out.append(
+                        f"get-after-delete pid={pid}: '{key}' read "
+                        f"after this process deleted '{hit[0]}'")
+                pat = canon(normalize_concrete_key(key))
+                if key in consumed and pat in lanes:
+                    out.append(
+                        f"double-consume pid={pid}: exactly-once key "
+                        f"'{key}' read twice with no re-set")
+                consumed.add(key)
+    return sorted(out)
+
+
+def check_static(model, events):
+    """Cross-check observed streams against the static world model.
+
+    Both directions: every observed SET pattern must be compatible
+    with some modeled construction-site pattern (`unmodeled` lists
+    the strays — protocol surface the model misses), and the observed
+    lifecycles must be clean (`violations`, as
+    :func:`lifecycle_violations` with the model's exactly-once
+    lanes).  Both empty == the run agrees with the model.
+    """
+    canons = set(model.pattern_table)
+    unmodeled = set()
+    for ev in events:
+        if ev["op"] != "set":
+            continue
+        pat = normalize_concrete_key(ev["key"])
+        if not any(patterns_compatible(c, pat) for c in canons):
+            unmodeled.add(pat)
+    return {
+        "unmodeled": sorted(unmodeled),
+        "violations": lifecycle_violations(events, model=model),
+    }
+
+
+def read_trace_dir(trace_dir):
+    """Parse every kv-*.jsonl stream a multiprocess run left in
+    `trace_dir`, skipping torn trailing lines (SIGKILL mid-write)."""
+    events = []
+    try:
+        names = sorted(os.listdir(trace_dir))
+    except OSError:
+        return events
+    for name in names:
+        if not (name.startswith("kv-") and name.endswith(".jsonl")):
+            continue
+        with open(os.path.join(trace_dir, name), encoding="utf-8",
+                  errors="replace") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue        # torn tail of a killed writer
+                if isinstance(ev, dict) and "op" in ev:
+                    events.append(ev)
+    return events
+
+
+def residual_keys(client, prefix="ptpu/", ignore=PERSISTENT_KEYS):
+    """Keys still in the store under `prefix`, minus the reviewed
+    persistent set — the end-of-test leak assertion
+    (``assert not residual_keys(client)``)."""
+    try:
+        pairs = client.key_value_dir_get(prefix)
+    except Exception:
+        pairs = client.key_value_dir_get_bytes(prefix)
+    return sorted(k for k, _v in pairs if k not in ignore)

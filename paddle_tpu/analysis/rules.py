@@ -451,6 +451,84 @@ RULES = {r.code: r for r in [
        "take the attribute's lock around the WHOLE check+act sequence, "
        "or use an atomic primitive (dict.setdefault, dict.pop(k, "
        "None))"),
+
+    # ================= PLxxx: protolint (coordination-KV protocols) ====
+    # Cross-process protocol audit over the coordination-KV surfaces
+    # (kv_model.py / proto_rules.py; tools/protolint.py; docs/
+    # protolint.md).  PL1xx: key lifecycle & liveness; PL2xx: wire
+    # payload & ordering discipline.
+    _R("PL101", "kv-key-leak",
+       "KV key {detail} is set but never reclaimed",
+       "a key nobody consumes or reaps accrues in the coordination "
+       "store for the life of the service: per-round keys grow O(steps),"
+       " and keys outside the launch namespace survive the end-of-run "
+       "namespace reap entirely — next launch reads this run's debris "
+       "(stale heartbeats flag healthy hosts dead, stale round keys "
+       "corrupt fresh rendezvous)",
+       "give every set key a consumer AND a reap: delete-on-consume for "
+       "exactly-once lanes, a two-rounds-behind prefix sweep for round "
+       "keys (collective._coord_reap is the model), and root every key "
+       "under coord_namespace() so finalize()'s namespace reap is the "
+       "backstop"),
+    _R("PL102", "consume-without-delete",
+       "exactly-once key {detail} is consumed but never deleted",
+       "a seq-numbered lane key left in the store after its one "
+       "legitimate read is a double-delivery hazard: a wedged peer that "
+       "resumes (SIGSTOP→SIGCONT) or a retried reader re-consumes the "
+       "same payload — the exactly-once contract of the wire lane "
+       "silently becomes at-least-once",
+       "delete the key the moment it is consumed (wire.await_response/"
+       "read_request pattern), or cover the whole round with a "
+       "non-root prefix reap that runs before the seq can recycle"),
+    _R("PL103", "unbounded-kv-wait",
+       "unbounded blocking KV get: {detail}",
+       "a blocking_key_value_get with no finite deadline wedges the "
+       "process forever when the peer died before setting the key — "
+       "the exact failure the fleet watchdog exists to convert into a "
+       "typed CollectiveTimeout with a DEAD verdict",
+       "route every wait through resilience.fleet.kv_get_bytes (sliced "
+       "deadline + RetryPolicy backoff + abort_if watchdog hook) or "
+       "pass an explicit finite timeout_in_ms"),
+    _R("PL104", "cross-role-wait-cycle",
+       "cross-role KV wait cycle: {detail}",
+       "role A blocking on a key only role B sets while B blocks on "
+       "one only A sets is the multi-process analogue of a lock-order "
+       "inversion (RL102): with unbounded waits the fleet deadlocks "
+       "the first time both sides enter their waits, and no "
+       "single-process tracer can see it",
+       "break the cycle by ordering the protocol (set your side's key "
+       "BEFORE blocking on the peer's — the wire req/rsp lane's "
+       "set-then-get shape) or bound one side with a deadline + retry"),
+    _R("PL105", "heartbeat-deadline-mismatch",
+       "liveness deadline vs heartbeat interval mismatch: {detail}",
+       "a staleness deadline that is not comfortably larger than the "
+       "publish interval (deadline >= interval x miss-budget) flags "
+       "healthy hosts dead on a single delayed beat — one GC pause or "
+       "slow KV round trip away from a spurious fleet reconfigure",
+       "derive the deadline from the interval with an explicit miss "
+       "budget (FleetConfig's suspect_after_s = 3x / dead_after_s = 6x "
+       "heartbeat_interval_s is the house pattern) and validate the "
+       "ratio at config time"),
+    _R("PL201", "untyped-error-envelope",
+       "wire response without a typed-error envelope: {detail}",
+       "an RPC lane whose responses carry only the success payload has "
+       "no way to ship a replica-side exception: the caller times out "
+       "on application errors and every failure collapses into "
+       "'peer dead', losing the typed backpressure (AdmissionRejected) "
+       "the routing layer dispatches on",
+       "marshal every response through an ok/err discriminated "
+       "envelope (wire.post_response + _marshal_error/_unmarshal_error "
+       "is the house pattern) and post the error branch from the "
+       "serve loop's except handler"),
+    _R("PL202", "seq-reuse",
+       "seq counter feeding {detail} can be reused non-monotonically",
+       "a sequence slot rewound outside construction lets a fresh "
+       "request collide with an undeleted key from the previous life "
+       "of the counter — the lane silently pairs a new request with a "
+       "stale response (or vice versa), breaking exactly-once pairing",
+       "make the counter monotonic for the lifetime of the key "
+       "namespace: reset it only together with a namespace/generation "
+       "bump (collective.reset_coord_rounds documents that coupling)"),
 ]}
 
 
@@ -472,3 +550,4 @@ SHARDLINT_CODES = tuple(c for c in RULES if c.startswith("SL"))
 RACELINT_CODES = tuple(c for c in RULES if c.startswith("RL"))
 NUMLINT_CODES = tuple(c for c in RULES if c.startswith("NL"))
 KERNLINT_CODES = tuple(c for c in RULES if c.startswith("KL"))
+PROTOLINT_CODES = tuple(c for c in RULES if c.startswith("PL"))

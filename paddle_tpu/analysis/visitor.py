@@ -50,12 +50,12 @@ class Finding:
 # waive a trace-safety (TL) or kernel-interior (KL) finding and vice
 # versa.  skip-file stays tracelint-spelled only, for the same reason.
 _DISABLE_RE = re.compile(
-    r"#\s*(tracelint|shardlint|racelint|numlint|kernlint):\s*disable="
-    r"([A-Za-z0-9,\s]+)")
+    r"#\s*(tracelint|shardlint|racelint|numlint|kernlint|protolint):"
+    r"\s*disable=([A-Za-z0-9,\s]+)")
 _SKIP_FILE_RE = re.compile(r"^\s*#\s*tracelint:\s*skip-file\s*$")
 
 _FAMILY = {"shardlint": "SL", "racelint": "RL", "numlint": "NL",
-           "kernlint": "KL"}
+           "kernlint": "KL", "protolint": "PL"}
 
 
 def parse_suppressions(source):

@@ -87,45 +87,70 @@ class Watchdog:
         return False
 
 
+def _hb_prefix():
+    """Heartbeat keys live under the run's coordination namespace
+    (protolint PL101): un-namespaced ``ptpu/hb/*`` keys survive the
+    end-of-run namespace reap on a long-lived coordinator, so the
+    NEXT launch's rank 0 reads this run's final beats as fresh-enough
+    liveness and delays dead-host detection by a full grace period."""
+    from paddle_tpu.resilience import fleet
+    return f"{fleet.coord_namespace()}/hb"
+
+
 class HeartbeatServer:
     """Multi-host liveness over the jax.distributed KV store: every host
     publishes a timestamp; rank 0 flags hosts whose heartbeat is stale.
-    Degrades to a no-op in single-process runs."""
+    Degrades to a no-op in single-process runs.
 
-    def __init__(self, interval=30.0, stale_after=120.0, on_dead=None):
+    Keys are run-namespaced (:func:`_hb_prefix`) and each host reaps
+    its own key in :meth:`stop`, so a clean shutdown leaves nothing in
+    the store and a SIGKILLed host's key still dies with the
+    namespace reap."""
+
+    def __init__(self, interval=30.0, stale_after=120.0, on_dead=None,
+                 client=None):
         self.interval = interval
         self.stale_after = stale_after
         self.on_dead = on_dead
-        self._client = None
+        self._client = client
         self._stop = threading.Event()
         self._start_time = time.time()
-        try:
-            from jax._src.distributed import global_state
-            self._client = global_state.client
-        except Exception:
-            self._client = None
+        self._pid = None
+        if self._client is None:
+            try:
+                from jax._src.distributed import global_state
+                self._client = global_state.client
+            except Exception:
+                self._client = None
+        self._thread = None
         if self._client is not None:
+            # publish-then-spawn: the beat loop and stop() both read
+            # _pid, so it must be set before the thread starts
+            import jax
+            self._pid = jax.process_index()
             self._thread = threading.Thread(target=self._run, daemon=True)
             self._thread.start()
 
     def _run(self):
         import jax
-        pid = jax.process_index()
+        pid = self._pid
         nproc = jax.process_count()
         consecutive_failures = 0
         while not self._stop.wait(self.interval):
             now = str(time.time())
             try:
+                prefix = _hb_prefix()
                 # fixed key per rank (overwritten each beat) — O(nranks)
                 # store size, not O(beats)
                 try:
-                    self._client.key_value_set(f"ptpu/hb/{pid}", now,
+                    self._client.key_value_set(f"{prefix}/{pid}", now,
                                                allow_overwrite=True)
                 except TypeError:  # older client without the kwarg
-                    self._client.key_value_delete(f"ptpu/hb/{pid}")
-                    self._client.key_value_set(f"ptpu/hb/{pid}", now)
+                    self._client.key_value_delete(f"{prefix}/{pid}")
+                    self._client.key_value_set(f"{prefix}/{pid}", now)
                 if pid == 0:
-                    dirs = self._client.key_value_dir_get("ptpu/hb/")
+                    dirs = self._client.key_value_dir_get(
+                        f"{_hb_prefix()}/")
                     latest = {}
                     for k, v in dirs:
                         r = int(k.rsplit("/", 1)[-1])
@@ -160,6 +185,15 @@ class HeartbeatServer:
 
     def stop(self):
         self._stop.set()
+        if self._thread is not None:
+            # a beat in flight after the delete below would resurrect
+            # the key; wait the loop out first
+            self._thread.join(timeout=5)
+        if self._client is not None and self._pid is not None:
+            try:
+                self._client.key_value_delete(f"{_hb_prefix()}/{self._pid}")
+            except Exception:
+                pass
 
 
 class ElasticManager:

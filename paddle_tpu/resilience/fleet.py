@@ -813,6 +813,7 @@ class FleetMonitor:
         #                      publisher has a payload_fn)
         self._states = {}    # rank -> RankState
         self._quarantined = {}   # rank -> reason (sticky SUSPECT)
+        self._holds = {}     # rank -> local-clock hold deadline (boot)
         self.transitions = []  # [(rank, old, new, age_s)]
         self._stop = threading.Event()
         self._thread = None
@@ -867,6 +868,19 @@ class FleetMonitor:
                     self._seen[r] = seen
                 age = now - seen[3]
                 new = self._classify(old, age, now - seen[4])
+                hold = self._holds.get(r)
+                if hold is not None:
+                    if now >= hold:
+                        self._holds.pop(r, None)
+                    elif new is RankState.DEAD:
+                        # verdicts held (mid-boot): a replica building
+                        # its engine legitimately goes silent longer
+                        # than dead_after_s — DEAD here would be
+                        # terminal for a rank that is about to come up.
+                        # Cap at SUSPECT; the first post-boot beat
+                        # clears it, and the hold expires with the
+                        # boot deadline for a rank that never does.
+                        new = RankState.SUSPECT
                 if r in self._quarantined and new is not RankState.DEAD:
                     # externally quarantined (SDC digest vote): pinned
                     # at SUSPECT — a fresh heartbeat must NOT clear it
@@ -964,6 +978,32 @@ class FleetMonitor:
         poll (DEAD stays terminal)."""
         with self._lock:
             self._quarantined.pop(int(rank), None)
+
+    def hold_verdict(self, rank, for_s):
+        """Suspend DEAD escalation for `rank` for `for_s` seconds —
+        the boot-phase grace.  A replica building its engine (AOT
+        cache load, first compile) can legitimately starve its beat
+        publisher longer than ``dead_after_s``, and DEAD is terminal:
+        one spurious verdict during a slow boot would permanently
+        evict a rank that is seconds from coming up.  The caller's
+        boot deadline (``rendezvous_timeout_s``) bounds the hold, so
+        a rank that never boots still dies on schedule."""
+        deadline = self._time() + float(for_s)
+        with self._lock:
+            self._holds[int(rank)] = deadline
+
+    def release_verdict_hold(self, rank):
+        """End a boot-phase hold and restart the rank's staleness
+        clock: the held window's silence was sanctioned, so the first
+        post-boot beat must not race ``dead_after_s`` worth of
+        leftover age."""
+        rank = int(rank)
+        now = self._time()
+        with self._lock:
+            self._holds.pop(rank, None)
+            seen = self._seen.get(rank)
+            if seen is not None:
+                self._seen[rank] = (seen[0], seen[1], seen[2], now, now)
 
     def suspect_ranks(self):
         with self._lock:

@@ -107,6 +107,7 @@ class ServingFleet:
         t0 = time.perf_counter()
         with self._lock:
             respawn = index in self._assigned
+            prev_rank = self._assigned.get(index)
             if respawn:
                 if not self._spares:
                     # leave _assigned/_retired untouched: the router
@@ -114,7 +115,7 @@ class ServingFleet:
                     raise RuntimeError(
                         f"replica slot {index} needs a respawn but the "
                         f"spare pool is empty — retrying next step")
-                self._retired.append((index, self._assigned[index]))
+                self._retired.append((index, prev_rank))
                 rank = self._spares.pop(0)
             else:
                 rank = self.config.worker_ranks[index]
@@ -122,13 +123,40 @@ class ServingFleet:
         proxy = RemoteEngineClient(
             self.client, rank, namespace_fn=self._ns,
             config=self.config.fleet_config,
-            abort_if=lambda r=rank: self.monitor.is_dead(r))
+            abort_if=lambda r=rank: self.monitor.is_dead(r),
+            hold_verdict=lambda s, r=rank:
+                self.monitor.hold_verdict(r, s),
+            release_verdict=lambda r=rank:
+                self.monitor.release_verdict_hold(r))
         payload = dict(self.config.boot_payload)
         payload.update(replica_index=int(index), rank=int(rank),
                        respawn=bool(respawn))
-        proxy.call("boot", payload,
-                   timeout_s=self.config.fleet_config
-                   .rendezvous_timeout_s)
+        # verdicts held for the boot window: the worker goes silent
+        # while it builds its engine, and a spurious terminal DEAD
+        # mid-boot would wedge the rank forever (the rendezvous
+        # deadline below still bounds a boot that never completes)
+        self.monitor.hold_verdict(
+            rank, self.config.fleet_config.rendezvous_timeout_s)
+        try:
+            proxy.call("boot", payload,
+                       timeout_s=self.config.fleet_config
+                       .rendezvous_timeout_s)
+        except Exception:
+            # un-claim on boot failure: a transient boot abort must
+            # not leak the claim — the spare goes back in the pool
+            # (same one is retried next attempt) and the slot's
+            # previous owner is restored, or every failed first boot
+            # would burn a spare until the pool reads empty
+            with self._lock:
+                if respawn:
+                    self._spares.insert(0, rank)
+                    self._retired.pop()
+                    self._assigned[index] = prev_rank
+                else:
+                    self._assigned.pop(index, None)
+            raise
+        finally:
+            self.monitor.release_verdict_hold(rank)
         with self._lock:
             self.proxies[rank] = proxy
         if respawn:

@@ -195,6 +195,25 @@ class DisaggregatedEngine:
             # loudly rather than spin
             stall = 0 if progressed else stall + 1
             if stall > 1024:
+                # abandoned-handoff reap (protolint PL101): every
+                # handle still awaiting import has its page state
+                # parked in the coordination KV; the caller is about
+                # to fail this batch over, and nobody will ever
+                # import these hids — without the delete the blobs
+                # (the LARGEST keys in the store, full page state)
+                # outlive the batch until the end-of-run namespace
+                # reap.  Best effort: the import side's own
+                # delete-on-consume makes a double delete a no-op.
+                if self._client is not None:
+                    for _rid, handle in ready:
+                        kind, payload = handle
+                        if kind != "kv":
+                            continue
+                        try:
+                            self._client.key_value_delete(
+                                wire.handoff_key(self._ns(), payload))
+                        except Exception:
+                            pass
                 raise RuntimeError(
                     f"disaggregated generate stalled: {len(pending)} "
                     f"prefilling, {len(ready)} awaiting import, "

@@ -81,12 +81,19 @@ class RemoteEngineClient:
 
     def __init__(self, client, rank, *, namespace_fn, config,
                  abort_if=None, clock=time.perf_counter,
-                 metrics_name=None):
+                 metrics_name=None, hold_verdict=None,
+                 release_verdict=None):
         self._client = client
         self.rank = int(rank)
         self._ns = namespace_fn
         self._config = config
         self._abort_if = abort_if
+        # boot-phase verdict guards (the controller wires these to the
+        # fleet monitor): warmup compiles/cache-loads silence the
+        # replica's inline beats, and a spurious DEAD verdict there is
+        # terminal — see FleetMonitor.hold_verdict
+        self._hold_verdict = hold_verdict or (lambda for_s: None)
+        self._release_verdict = release_verdict or (lambda: None)
         self._clock = clock
         self._metrics_name = metrics_name or f"serving.remote.r{rank}"
         self._lock = threading.Lock()
@@ -124,6 +131,19 @@ class RemoteEngineClient:
                     self.last_timeout = to_dict()
                     self.detect_s = time.monotonic() - t0
                     self._dead = True
+            # reap the abandoned request (protolint PL102): the
+            # controller is about to fail this stream over, but a
+            # merely-wedged (SIGSTOP) replica that resumes would still
+            # read the request and serve it a second time elsewhere —
+            # delete-on-abandon keeps the lane exactly-once.  Best
+            # effort: if the replica already consumed it, the delete
+            # is a no-op; if the coordinator itself is gone, the
+            # namespace reap is the backstop.
+            try:
+                self._client.key_value_delete(
+                    wire.req_key(ns, self.rank, seq))
+            except Exception:
+                pass
             raise
 
     # -------------------------------------------- router engine surface
@@ -229,8 +249,14 @@ class RemoteEngineClient:
         return out
 
     def warmup(self):
-        return self.call("warmup",
-                         timeout_s=self._config.rendezvous_timeout_s)
+        # warmup is boot-phase work: the replica compiles or loads the
+        # AOT cache inside the dispatch, beat-silent the whole time
+        self._hold_verdict(self._config.rendezvous_timeout_s)
+        try:
+            return self.call("warmup",
+                             timeout_s=self._config.rendezvous_timeout_s)
+        finally:
+            self._release_verdict()
 
     def shutdown(self):
         """Best-effort, short-fuse: the router calls this on DEAD

@@ -340,9 +340,11 @@ def main():
     from jax.sharding import Mesh
 
     import paddle_tpu as P  # noqa: F401  (installs shims)
+    from paddle_tpu.analysis import kv_tracer
     from paddle_tpu.distributed import mesh as _mesh
     from paddle_tpu.resilience import fleet as flt
 
+    kv_tracer.arm_from_env()   # no-op unless PTPU_KV_TRACE_DIR is set
     grank = jax.process_index()
     # pin the TRUE world before detaching: after the detach,
     # jax.process_index()/count() read the single-host backend, so the

@@ -75,8 +75,10 @@ def main():
 
     import paddle_tpu as P  # noqa: F401  (installs shims)
     from paddle_tpu import distributed as dist
+    from paddle_tpu.analysis import kv_tracer
     from paddle_tpu.resilience import faultinject, fleet
 
+    kv_tracer.arm_from_env()   # no-op unless PTPU_KV_TRACE_DIR is set
     grank = jax.process_index()
     result = {"mode": mode, "global_rank": grank,
               "launch_world": jax.process_count(), "detection": None,

@@ -30,7 +30,9 @@ def main():
 
     import paddle_tpu as P
     from paddle_tpu import distributed as dist
+    from paddle_tpu.analysis import kv_tracer
 
+    kv_tracer.arm_from_env()   # no-op unless PTPU_KV_TRACE_DIR is set
     rank = jax.process_index()
     nprocs = jax.process_count()
 

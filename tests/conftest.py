@@ -70,3 +70,38 @@ def _lock_order_sanitizer(request):
     snap = tracer.snapshot()
     assert not snap["violations"], (
         f"lock-order inversion observed during chaos run: {snap}")
+
+
+@pytest.fixture(autouse=True)
+def _kv_lifecycle_sanitizer(request, tmp_path_factory):
+    # Every chaos-marked test ALSO runs under protolint's KV event
+    # tracer: the in-process half patches LocalKVClient (rank-per-
+    # thread fleets), and PTPU_KV_TRACE_DIR makes the multiprocess
+    # workers (which inherit os.environ through _child_env) append
+    # their real-coordination-client streams as kill-safe JSONL the
+    # parent collects here.  Any key-lifecycle violation — a get after
+    # this process deleted the key, or a double-consume on an
+    # exactly-once lane — fails the gate: that is the dynamic
+    # double-delivery/stale-read evidence PL101/PL102 police
+    # statically.  PADDLE_TPU_KV_TRACE=0 opts out.
+    if "chaos" not in request.keywords \
+            or os.environ.get("PADDLE_TPU_KV_TRACE") == "0":
+        yield
+        return
+    from paddle_tpu.analysis import kv_tracer
+    trace_dir = str(tmp_path_factory.mktemp("kvtrace"))
+    prev = os.environ.get("PTPU_KV_TRACE_DIR")
+    os.environ["PTPU_KV_TRACE_DIR"] = trace_dir
+    try:
+        with kv_tracer.KVEventTracer() as tracer:
+            yield
+        events = tracer.events + kv_tracer.read_trace_dir(trace_dir)
+        violations = kv_tracer.lifecycle_violations(events)
+        assert not violations, (
+            f"KV lifecycle violation observed during chaos run "
+            f"({len(events)} events): {violations}")
+    finally:
+        if prev is None:
+            os.environ.pop("PTPU_KV_TRACE_DIR", None)
+        else:
+            os.environ["PTPU_KV_TRACE_DIR"] = prev

@@ -179,7 +179,10 @@ def _collect(procs, deadline_s, expect_killed=()):
 
 
 @pytest.mark.chaos
+@pytest.mark.slow
 def test_fleet_sigkill_reconfigure_resume(tmp_path):
+    # slow: ~12s of two 3-process spawn phases; the chaos marker keeps
+    # it in the lint_all chaos gate, which runs slow chaos tests too
     out_dir, ckpt_dir = tmp_path / "out", tmp_path / "ckpt"
     out_dir.mkdir()
 
@@ -390,9 +393,13 @@ def _spawn_fleetserving(rank, port, scenario_path):
 
 
 @pytest.mark.chaos
+@pytest.mark.slow
 def test_serving_fleet_sigkill_wedge_failover(tmp_path):
     """The ISSUE 16 acceptance proof on a REAL 5-process fleet
-    (controller + 3 replicas + 1 spare): one replica SIGKILLed and one
+    (controller + 3 replicas + 1 spare).  Slow-marked (~30s of 5-way
+    process spawn + wedge deadlines); the chaos marker keeps it in the
+    lint_all chaos gate, so every standalone `python tools/lint_all.py`
+    still runs it.  One replica SIGKILLed and one
     SIGSTOP-wedged mid-decode, both drawn DEAD verdicts within the
     configured budget, every affected request migrated with zero token
     loss (streams exactly-once), the fleet output token-identical to

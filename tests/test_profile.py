@@ -359,7 +359,11 @@ def test_perfgate_check_clean_against_checked_in_baseline():
     assert "perfgate: clean" in proc.stdout
 
 
+@pytest.mark.slow
 def test_perfgate_fails_on_synthetic_20pct_bytes_regression(tmp_path):
+    # slow: a full perfgate probe subprocess (~10s) just to exercise the
+    # detection branch; the checked-in-baseline gate above keeps the
+    # perfgate contract in tier-1
     with open(BASELINE, encoding="utf-8") as fh:
         base = json.load(fh)
     # shrink the baselined budget so the CURRENT (unchanged) numbers
@@ -374,7 +378,10 @@ def test_perfgate_fails_on_synthetic_20pct_bytes_regression(tmp_path):
     assert "perfgate: FAILED" in proc.stdout
 
 
+@pytest.mark.slow
 def test_perfgate_write_then_check_roundtrip(tmp_path):
+    # slow: TWO full perfgate probe subprocesses (~19s); the
+    # checked-in-baseline gate above keeps the contract in tier-1
     out = tmp_path / "fresh_baseline.json"
     proc = _run([PERFGATE, "--write-baseline", "--baseline", str(out)])
     assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -1,0 +1,597 @@
+"""protolint: the coordination-KV protocol auditor + event tracer.
+
+Covers, per the shipped contract (docs/protolint.md):
+
+- one flagged/clean fixture pair per PL rule (PL101/102/103/104/105/
+  201/202);
+- suppression comments (`# protolint: disable=...` scoped to PL,
+  `# tracelint: disable=...` universal, `# racelint:` NOT honored for
+  PL codes);
+- the KV event tracer: static/dynamic conformance in both directions
+  (a clean run agrees with the model; an unmodeled set and a
+  lifecycle violation are both detected), plus the residual-keys
+  end-of-test leak assertion;
+- the self-audit gate: `tools/protolint.py --check paddle_tpu` green
+  against the checked-in baseline;
+- regression tests for the protocol bugs the self-audit surfaced and
+  this PR fixed (heartbeat-key debris outside the run namespace, the
+  abandoned-RPC-request double-delivery window, abandoned disagg
+  handoff blobs leaking on stall failover) — each written to fail on
+  the pre-fix code.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.protolint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROTOLINT = os.path.join(REPO, "tools", "protolint.py")
+
+from paddle_tpu.analysis import kv_tracer, proto_rules  # noqa: E402
+
+
+def lint_src(tmp_path, src, name="mod.py"):
+    (tmp_path / name).write_text(textwrap.dedent(src))
+    return proto_rules.lint_package([str(tmp_path)], base=str(tmp_path))
+
+
+def model_src(tmp_path, src, name="mod.py"):
+    (tmp_path / name).write_text(textwrap.dedent(src))
+    pm, _sups, _errs = proto_rules.build_package_model(
+        [str(tmp_path)], base=str(tmp_path))
+    return pm
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+# ---------------------------------------------------------------- PL101
+PL101_FLAGGED = """
+    def publish(client, rank):
+        client.key_value_set(f"jobs/claim/{rank}", "mine")
+"""
+
+PL101_CLEAN = """
+    def publish(client, rank):
+        client.key_value_set(f"jobs/claim/{rank}", "mine")
+
+    def settle(client, rank):
+        v = client.blocking_key_value_get(f"jobs/claim/{rank}", 5_000)
+        client.key_value_delete(f"jobs/claim/{rank}")
+        return v
+"""
+
+
+class TestPL101:
+    @pytest.mark.smoke
+    def test_flagged(self, tmp_path):
+        fs = lint_src(tmp_path, PL101_FLAGGED)
+        assert "PL101" in codes(fs)
+        (hit,) = [f for f in fs if f.code == "PL101"]
+        assert "jobs/claim" in hit.message
+        assert hit.line > 0 and hit.path.endswith("mod.py")
+
+    def test_clean(self, tmp_path):
+        fs = lint_src(tmp_path, PL101_CLEAN)
+        assert "PL101" not in codes(fs)
+
+    def test_namespace_rooted_set_with_reader_is_clean(self, tmp_path):
+        # under the run namespace the end-of-run reap reclaims it, so
+        # a consumed-but-not-deleted key is not a leak
+        fs = lint_src(tmp_path, """
+            def publish(client, namespace, rank):
+                client.key_value_set(f"{namespace}/st/{rank}", "x")
+
+            def poll(client, namespace, rank):
+                return client.blocking_key_value_get(
+                    f"{namespace}/st/{rank}", 5_000)
+        """)
+        assert "PL101" not in codes(fs)
+
+
+# ---------------------------------------------------------------- PL102
+PL102_FLAGGED = """
+    def post(client, namespace, seq, blob):
+        client.key_value_set(f"{namespace}/rpc/{seq}", blob)
+
+    def consume(client, namespace, seq):
+        return client.blocking_key_value_get(
+            f"{namespace}/rpc/{seq}", 5_000)
+"""
+
+PL102_CLEAN = """
+    def post(client, namespace, seq, blob):
+        client.key_value_set(f"{namespace}/rpc/{seq}", blob)
+
+    def consume(client, namespace, seq):
+        v = client.blocking_key_value_get(
+            f"{namespace}/rpc/{seq}", 5_000)
+        client.key_value_delete(f"{namespace}/rpc/{seq}")
+        return v
+"""
+
+
+class TestPL102:
+    @pytest.mark.smoke
+    def test_flagged(self, tmp_path):
+        fs = lint_src(tmp_path, PL102_FLAGGED)
+        assert "PL102" in codes(fs)
+
+    def test_clean(self, tmp_path):
+        fs = lint_src(tmp_path, PL102_CLEAN)
+        assert "PL102" not in codes(fs)
+
+
+# ---------------------------------------------------------------- PL103
+PL103_FLAGGED = """
+    def wait_boot(client):
+        return client.blocking_key_value_get("boot/config", 86_400_000)
+"""
+
+PL103_CLEAN = """
+    def wait_boot(client, timeout_ms):
+        return client.blocking_key_value_get("boot/config", timeout_ms)
+"""
+
+
+class TestPL103:
+    @pytest.mark.smoke
+    def test_flagged(self, tmp_path):
+        fs = lint_src(tmp_path, PL103_FLAGGED)
+        assert "PL103" in codes(fs)
+
+    def test_clean(self, tmp_path):
+        fs = lint_src(tmp_path, PL103_CLEAN)
+        assert "PL103" not in codes(fs)
+
+    def test_watchdog_aborted_get_is_exempt(self, tmp_path):
+        # a get whose call site threads an abort/watchdog predicate is
+        # bounded by the DEAD verdict even without a numeric deadline
+        fs = lint_src(tmp_path, """
+            def wait_peer(client, key, watchdog_dead):
+                return client.blocking_key_value_get(
+                    key, 86_400_000 if watchdog_dead else 86_400_000)
+        """)
+        assert "PL103" not in codes(fs)
+
+
+# ---------------------------------------------------------------- PL104
+PL104_FLAGGED = """
+    class Controller:
+        def run(self, client):
+            client.key_value_set("x/ctl", "1")
+            client.blocking_key_value_get("x/srv", 86_400_000)
+
+    class ReplicaServer:
+        def run(self, client):
+            client.key_value_set("x/srv", "1")
+            client.blocking_key_value_get("x/ctl", 86_400_000)
+"""
+
+PL104_CLEAN = """
+    class Controller:
+        def run(self, client, timeout_ms):
+            client.key_value_set("x/ctl", "1")
+            client.blocking_key_value_get("x/srv", timeout_ms)
+
+    class ReplicaServer:
+        def run(self, client, timeout_ms):
+            client.key_value_set("x/srv", "1")
+            client.blocking_key_value_get("x/ctl", timeout_ms)
+"""
+
+
+class TestPL104:
+    def test_flagged(self, tmp_path):
+        fs = lint_src(tmp_path, PL104_FLAGGED)
+        assert "PL104" in codes(fs)
+
+    def test_clean(self, tmp_path):
+        # both waits deadline-bounded: the cycle cannot deadlock
+        # forever, so no PL104 (the timeouts make it PL-clean)
+        fs = lint_src(tmp_path, PL104_CLEAN)
+        assert "PL104" not in codes(fs)
+
+
+# ---------------------------------------------------------------- PL105
+PL105_FLAGGED = """
+    class Monitor:
+        def __init__(self):
+            self.poll_interval = 10.0
+            self.stale_after = 15.0
+"""
+
+PL105_CLEAN = """
+    class Monitor:
+        def __init__(self):
+            self.poll_interval = 10.0
+            self.stale_after = 30.0
+"""
+
+
+class TestPL105:
+    @pytest.mark.smoke
+    def test_flagged(self, tmp_path):
+        fs = lint_src(tmp_path, PL105_FLAGGED)
+        assert "PL105" in codes(fs)
+
+    def test_clean(self, tmp_path):
+        fs = lint_src(tmp_path, PL105_CLEAN)
+        assert "PL105" not in codes(fs)
+
+
+# ---------------------------------------------------------------- PL201
+PL201_FLAGGED = """
+    def controller_call(client, seq, timeout_ms):
+        client.key_value_set(f"rpc/req/{seq}", "step")
+        return client.blocking_key_value_get(
+            f"rpc/rsp/{seq}", timeout_ms)
+
+    def server_loop(client, seq, timeout_ms, result):
+        client.blocking_key_value_get(f"rpc/req/{seq}", timeout_ms)
+        client.key_value_set(f"rpc/rsp/{seq}", result)
+"""
+
+PL201_CLEAN = """
+    def controller_call(client, seq, timeout_ms):
+        client.key_value_set(f"rpc/req/{seq}", "step")
+        return client.blocking_key_value_get(
+            f"rpc/rsp/{seq}", timeout_ms)
+
+    def server_loop(client, seq, timeout_ms, result):
+        client.blocking_key_value_get(f"rpc/req/{seq}", timeout_ms)
+        client.key_value_set(f"rpc/rsp/{seq}",
+                             {"ok": True, "r": result})
+"""
+
+
+class TestPL201:
+    def test_flagged(self, tmp_path):
+        fs = lint_src(tmp_path, PL201_FLAGGED)
+        assert "PL201" in codes(fs)
+
+    def test_clean(self, tmp_path):
+        fs = lint_src(tmp_path, PL201_CLEAN)
+        assert "PL201" not in codes(fs)
+
+
+# ---------------------------------------------------------------- PL202
+PL202_FLAGGED = """
+    class Lane:
+        def __init__(self):
+            self._seq = 0
+
+        def reset(self):
+            self._seq = 0
+
+        def push(self, client, blob):
+            self._seq += 1
+            client.key_value_set(f"lane/{self._seq}", blob)
+"""
+
+PL202_CLEAN = """
+    class Lane:
+        def __init__(self):
+            self._seq = 0
+
+        def push(self, client, blob):
+            self._seq += 1
+            client.key_value_set(f"lane/{self._seq}", blob)
+"""
+
+
+class TestPL202:
+    @pytest.mark.smoke
+    def test_flagged(self, tmp_path):
+        fs = lint_src(tmp_path, PL202_FLAGGED)
+        assert "PL202" in codes(fs)
+
+    def test_clean(self, tmp_path):
+        fs = lint_src(tmp_path, PL202_CLEAN)
+        assert "PL202" not in codes(fs)
+
+
+# ---------------------------------------------------------- suppression
+class TestSuppression:
+    @pytest.mark.smoke
+    def test_protolint_spelling_waives_pl(self, tmp_path):
+        fs = lint_src(tmp_path, """
+            def publish(client, rank):
+                client.key_value_set(f"jobs/claim/{rank}", "m")  # protolint: disable=PL101
+        """)
+        assert "PL101" not in codes(fs)
+
+    def test_tracelint_spelling_is_universal(self, tmp_path):
+        fs = lint_src(tmp_path, """
+            def publish(client, rank):
+                client.key_value_set(f"jobs/claim/{rank}", "m")  # tracelint: disable=PL101
+        """)
+        assert "PL101" not in codes(fs)
+
+    def test_racelint_spelling_cannot_waive_pl(self, tmp_path):
+        # family scoping: a racelint-spelled comment drops foreign
+        # codes, so it can never waive a protocol finding
+        fs = lint_src(tmp_path, """
+            def publish(client, rank):
+                client.key_value_set(f"jobs/claim/{rank}", "m")  # racelint: disable=PL101
+        """)
+        assert "PL101" in codes(fs)
+
+    def test_protolint_all_is_family_scoped(self, tmp_path):
+        fs = lint_src(tmp_path, """
+            def publish(client, rank):
+                client.key_value_set(f"jobs/claim/{rank}", "m")  # protolint: disable=ALL
+        """)
+        assert "PL101" not in codes(fs)
+
+
+# ------------------------------------------------------------- tracer
+RPC_MODEL_SRC = """
+    def post(client, namespace, seq, blob):
+        client.key_value_set(f"{namespace}/rpc/{seq}", blob)
+
+    def consume(client, namespace, seq):
+        v = client.blocking_key_value_get(
+            f"{namespace}/rpc/{seq}", 5_000)
+        client.key_value_delete(f"{namespace}/rpc/{seq}")
+        return v
+"""
+
+
+class TestTracer:
+    def _fresh_client(self):
+        from paddle_tpu.resilience import fleet
+        return fleet.LocalKVClient()
+
+    @pytest.mark.smoke
+    def test_records_local_client_ops(self):
+        client = self._fresh_client()
+        with kv_tracer.KVEventTracer() as tracer:
+            client.key_value_set("ptpu/t/g0/rpc/1", "x")
+            client.blocking_key_value_get("ptpu/t/g0/rpc/1", 1000)
+            client.key_value_delete("ptpu/t/g0/rpc/1")
+        ops = [e["op"] for e in tracer.events]
+        assert ops == ["set", "get", "delete"]
+        assert tracer.violations() == []
+
+    def test_clean_run_conforms_to_model(self, tmp_path):
+        pm = model_src(tmp_path, RPC_MODEL_SRC)
+        client = self._fresh_client()
+        with kv_tracer.KVEventTracer() as tracer:
+            client.key_value_set("ptpu/t/g0/rpc/1", "x")
+            client.blocking_key_value_get("ptpu/t/g0/rpc/1", 1000)
+            client.key_value_delete("ptpu/t/g0/rpc/1")
+        verdict = tracer.check_static(pm)
+        assert verdict["unmodeled"] == []
+        assert verdict["violations"] == []
+
+    def test_unmodeled_set_detected(self, tmp_path):
+        pm = model_src(tmp_path, RPC_MODEL_SRC)
+        client = self._fresh_client()
+        with kv_tracer.KVEventTracer() as tracer:
+            client.key_value_set("rogue/side/channel", "x")
+        verdict = tracer.check_static(pm)
+        assert verdict["unmodeled"], (
+            "a set the static model does not contain must be reported")
+
+    def test_double_consume_detected(self, tmp_path):
+        # an exactly-once lane (the model consumes it get-then-delete)
+        # read twice with no intervening set: the SIGSTOP-resume
+        # double-delivery the dynamic half must catch
+        pm = model_src(tmp_path, RPC_MODEL_SRC)
+        events = [
+            {"op": "set", "key": "ptpu/t/g0/rpc/1", "pid": 7, "i": 0},
+            {"op": "get", "key": "ptpu/t/g0/rpc/1", "pid": 7, "i": 1},
+            {"op": "get", "key": "ptpu/t/g0/rpc/1", "pid": 7, "i": 2},
+            {"op": "delete", "key": "ptpu/t/g0/rpc/1", "pid": 7,
+             "i": 3},
+        ]
+        vs = kv_tracer.lifecycle_violations(events, model=pm)
+        assert any("double-consume" in v for v in vs)
+
+    def test_get_after_delete_detected(self):
+        events = [
+            {"op": "set", "key": "ptpu/t/g0/st/1", "pid": 3, "i": 0},
+            {"op": "delete", "key": "ptpu/t/g0/st", "pid": 3, "i": 1},
+            {"op": "get", "key": "ptpu/t/g0/st/1", "pid": 3, "i": 2},
+        ]
+        vs = kv_tracer.lifecycle_violations(events)
+        assert any("get-after-delete" in v for v in vs)
+
+    def test_reset_clears_delete_mark(self):
+        events = [
+            {"op": "set", "key": "k/1", "pid": 3, "i": 0},
+            {"op": "delete", "key": "k/1", "pid": 3, "i": 1},
+            {"op": "set", "key": "k/1", "pid": 3, "i": 2},
+            {"op": "get", "key": "k/1", "pid": 3, "i": 3},
+        ]
+        assert kv_tracer.lifecycle_violations(events) == []
+
+    def test_trace_dir_roundtrip_skips_torn_lines(self, tmp_path):
+        client = self._fresh_client()
+        with kv_tracer.KVEventTracer(trace_dir=str(tmp_path)):
+            client.key_value_set("a/b", "1")
+        # simulate a SIGKILL mid-write: torn trailing line
+        (files,) = [n for n in os.listdir(tmp_path)
+                    if n.endswith(".jsonl")],
+        path = os.path.join(tmp_path, files[0][0]) \
+            if isinstance(files[0], tuple) else \
+            os.path.join(tmp_path, files[0])
+        with open(path, "a") as fh:
+            fh.write('{"op": "set", "key": "a/tor')
+        events = kv_tracer.read_trace_dir(str(tmp_path))
+        assert [e["op"] for e in events] == ["set"]
+
+    @pytest.mark.smoke
+    def test_residual_keys(self):
+        client = self._fresh_client()
+        client.key_value_set("ptpu/t/g0/st/1", "x")
+        client.key_value_set("ptpu/launch/current", "abc")
+        assert kv_tracer.residual_keys(client) == ["ptpu/t/g0/st/1"]
+        client.key_value_delete("ptpu/t/g0")
+        assert kv_tracer.residual_keys(client) == []
+
+
+# ------------------------------------------------------- self-audit
+class TestSelfAudit:
+    def test_package_check_green(self):
+        proc = subprocess.run(
+            [sys.executable, PROTOLINT, "--check", "paddle_tpu"],
+            cwd=REPO, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_rules_catalogue(self):
+        proc = subprocess.run(
+            [sys.executable, PROTOLINT, "--rules"],
+            cwd=REPO, capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0
+        for code in ("PL101", "PL102", "PL103", "PL104", "PL105",
+                     "PL201", "PL202"):
+            assert code in proc.stdout
+
+    @pytest.mark.slow
+    def test_bench_report_shape(self):
+        # slow: a second whole-package scan (~6s) on top of the --check
+        # subprocess gate above; every bench run exercises this path
+        out = proto_rules.bench_report()
+        assert isinstance(out["protolint_finding_count"], int)
+        assert isinstance(out["protolint_rule_breakdown"], dict)
+        assert out["protolint_elapsed_s"] >= 0
+
+
+# ---------------------------------------------- self-audit regressions
+class TestHeartbeatKeyLifecycle:
+    """Self-audit fix #1 (PL101): heartbeat keys must live under the
+    run's coordination namespace and be reaped on stop() — pre-fix
+    they were un-namespaced ``ptpu/hb/*`` debris a clean shutdown left
+    in the store forever."""
+
+    def test_namespaced_and_reaped_on_stop(self):
+        from paddle_tpu.distributed import elastic
+        from paddle_tpu.resilience import fleet
+
+        client = fleet.LocalKVClient()
+        hb = elastic.HeartbeatServer(interval=0.02, stale_after=5.0,
+                                     client=client)
+        try:
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                keys = [k for k, _ in client.key_value_dir_get("")]
+                if keys:
+                    break
+                time.sleep(0.01)
+            assert keys, "heartbeat never published"
+            prefix = fleet.coord_namespace() + "/hb/"
+            assert all(k.startswith(prefix) for k in keys), (
+                f"heartbeat keys outside the run namespace: {keys}")
+        finally:
+            hb.stop()
+        assert kv_tracer.residual_keys(client) == [], (
+            "stop() must reap this host's heartbeat key")
+
+
+class TestAbandonedRequestReap:
+    """Self-audit fix #2 (PL102): a controller abandoning an RPC on a
+    timeout verdict must delete the posted request — pre-fix a
+    SIGSTOP-wedged replica that resumed would still read it and serve
+    the already-failed-over stream a second time."""
+
+    def test_request_deleted_on_timeout(self):
+        from paddle_tpu.resilience import fleet
+        from paddle_tpu.serving.fleet.handle import RemoteEngineClient
+
+        client = fleet.LocalKVClient()
+        cfg = fleet.FleetConfig(collective_timeout_s=0.3,
+                                kv_slice_s=0.1)
+        eng = RemoteEngineClient(client, 1,
+                                 namespace_fn=lambda: "ptpu/t/g0",
+                                 config=cfg)
+        with pytest.raises(Exception):
+            eng.call("step")        # nobody serving: verdict raises
+        assert eng.last_timeout is not None
+        assert kv_tracer.residual_keys(client) == [], (
+            "the abandoned request must not stay readable")
+
+
+class TestAbandonedHandoffReap:
+    """Self-audit fix #3 (PL101): page-state blobs parked for a
+    disaggregated handoff must be reaped when generate() fails the
+    batch over on a stall — pre-fix the largest keys in the store
+    (full KV page state) leaked until the end-of-run namespace
+    reap."""
+
+    class _StubPrefill:
+        finished_requests = {}
+
+        def __init__(self):
+            self._emitted = False
+
+        def add_request(self, toks, sp=None):
+            return "p0"
+
+        def step(self):
+            if not self._emitted:
+                self._emitted = True
+                return [("p0", 7, False)]
+            return []
+
+        def export_page_state(self, rid):
+            return {"rid": rid,
+                    "layers": [{"k": np.zeros((2, 2), np.float32)}]}
+
+    class _RefusingDecode:
+        finished_requests = {}
+
+        def import_page_state(self, state, stream=None):
+            from paddle_tpu.serving.scheduler import AdmissionRejected
+            raise AdmissionRejected("no_slot", "always full")
+
+        def step(self):
+            return []
+
+    def test_parked_blob_reaped_on_stall_failover(self):
+        from paddle_tpu.resilience import fleet
+        from paddle_tpu.serving.fleet.disagg import DisaggregatedEngine
+
+        client = fleet.LocalKVClient()
+        eng = DisaggregatedEngine(
+            self._StubPrefill(), self._RefusingDecode(),
+            client=client, namespace_fn=lambda: "ptpu/t/g0")
+        with pytest.raises(RuntimeError, match="stalled"):
+            eng.generate([[1, 2, 3]])
+        assert kv_tracer.residual_keys(client) == [], (
+            "the abandoned handoff blob must be reaped on failover")
+
+
+class TestCoordReapSweepsBothPrefixes:
+    """Satellite 2: the two-rounds-behind sweep must reap BOTH
+    collective prefixes — allgather rounds AND the broadcast rounds
+    nothing else synchronizes."""
+
+    def test_allgather_and_bcast_rounds_reaped(self):
+        from paddle_tpu.distributed import collective
+        from paddle_tpu.resilience import fleet
+
+        client = fleet.LocalKVClient()
+        ns = fleet.coord_namespace()
+        collective.reset_coord_rounds()
+        try:
+            for rnd in (1, 2):
+                client.key_value_set(f"{ns}/allgather/{rnd}/0", "a")
+                client.key_value_set(f"{ns}/bcast/{rnd}/0", "b")
+            # rank 0, now in round 3: rounds 1-2 are provably complete
+            collective._coord_reap(client, 0, 3)
+            left = [k for k, _ in client.key_value_dir_get(ns)]
+            assert left == [], (
+                f"stale round keys survived the sweep: {left}")
+        finally:
+            collective.reset_coord_rounds()

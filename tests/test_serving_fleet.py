@@ -706,6 +706,101 @@ class TestServingFleet:
         assert sf._retired == []       # no phantom retirements
         assert sf._assigned == {0: 1}  # slot still owned by rank 1
 
+    def test_boot_failure_rolls_back_claim(self):
+        """A transient boot failure must not burn the claim: a failed
+        FIRST boot leaves the slot unassigned so the retry is a first
+        boot of the SAME rank (pre-fix it became a phantom respawn,
+        and with no spares the second attempt died on "spare pool
+        empty" — the deadline-failover flake), and a failed respawn
+        boot puts the spare back in the pool."""
+        kv = fleet.LocalKVClient()
+        cfg = FleetServingConfig(
+            [9], spare_ranks=(),
+            fleet_config=_fc(rendezvous_timeout_s=0.4))
+        sf = ServingFleet.__new__(ServingFleet)
+        sf.client = kv
+        sf.config = cfg
+        sf._ns = fleet.coord_namespace
+        sf._lock = threading.Lock()
+        sf._spares = []
+        sf._assigned = {}
+        sf._retired = []
+        sf.proxies = {}
+        sf.respawn_ms = []
+        sf.monitor = fleet.FleetMonitor(
+            client=kv, config=cfg.fleet_config,
+            world_fn=lambda: fleet.WorldView([9], 9))
+        for _ in range(2):             # rank 9 has no server: timeout
+            with pytest.raises(Exception) as ei:
+                sf._factory(0)
+            assert "spare pool" not in str(ei.value)
+        assert sf._assigned == {} and sf._retired == []
+        # respawn flavor: the failed spare boot goes back in the pool
+        sf._assigned = {0: 1}
+        sf._spares = [3]
+        with pytest.raises(Exception) as ei:
+            sf._factory(0)
+        assert "spare pool" not in str(ei.value)
+        assert sf._spares == [3]       # not leaked
+        assert sf._assigned == {0: 1} and sf._retired == []
+
+    def test_warmup_holds_verdicts(self):
+        """warmup() is boot-phase work — the replica compiles or
+        cache-loads inside the dispatch, beat-silent throughout — so
+        the proxy must hold fleet verdicts across the RPC and release
+        them afterwards, success or failure."""
+        kv = fleet.LocalKVClient()
+        calls = []
+        p = RemoteEngineClient(
+            kv, 9, namespace_fn=fleet.coord_namespace,
+            config=_fc(rendezvous_timeout_s=0.2),
+            hold_verdict=lambda s: calls.append(("hold", s)),
+            release_verdict=lambda: calls.append(("release",)))
+        with pytest.raises(Exception):
+            p.warmup()             # nobody serves rank 9: times out
+        assert calls == [("hold", 0.2), ("release",)]
+
+    def test_monitor_hold_verdict_spans_boot_silence(self):
+        """A rank mid-boot goes beat-silent for longer than
+        dead_after_s; the boot-phase hold must cap it at SUSPECT
+        (DEAD is terminal — a spurious verdict would wedge the rank
+        forever), and releasing the hold restarts the staleness clock
+        so the first post-boot beat is not raced by leftover age."""
+        kv = fleet.LocalKVClient()
+        clock = [0.0]
+        mon = fleet.FleetMonitor(
+            client=kv, config=_fc(), time_fn=lambda: clock[0],
+            world_fn=lambda: fleet.WorldView([1], 1))
+        mon.poll()                     # first observation at t=0
+        mon.hold_verdict(1, for_s=10.0)
+        clock[0] = 2.0
+        assert mon.poll()[1] is fleet.RankState.SUSPECT
+        clock[0] = 5.0                 # age 5 > dead_after 2.4: held
+        assert mon.poll()[1] is fleet.RankState.SUSPECT
+        assert not mon.is_dead(1)
+        mon.release_verdict_hold(1)    # boot returned at t=5
+        clock[0] = 6.0                 # age counts from release, not t=0
+        assert mon.poll()[1] is not fleet.RankState.DEAD
+        clock[0] = 7.8                 # real post-boot silence...
+        assert mon.poll()[1] is fleet.RankState.SUSPECT
+        clock[0] = 9.0                 # ...still escalates on schedule
+        assert mon.poll()[1] is fleet.RankState.DEAD
+
+    def test_monitor_hold_expires_with_boot_deadline(self):
+        """A rank that never finishes boot still dies on schedule:
+        the hold lapses with the boot deadline it was sized to."""
+        kv = fleet.LocalKVClient()
+        clock = [0.0]
+        mon = fleet.FleetMonitor(
+            client=kv, config=_fc(), time_fn=lambda: clock[0],
+            world_fn=lambda: fleet.WorldView([1], 1))
+        mon.poll()
+        mon.hold_verdict(1, for_s=3.0)
+        clock[0] = 2.0
+        assert mon.poll()[1] is fleet.RankState.SUSPECT
+        clock[0] = 4.0                 # hold expired, age 4 > 2.4
+        assert mon.poll()[1] is fleet.RankState.DEAD
+
     def test_fleet_serving_config_validates(self):
         with pytest.raises(ValueError, match="at least one"):
             FleetServingConfig([])

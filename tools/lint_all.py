@@ -19,13 +19,20 @@ Chains every baseline-gated analyzer in the repo, plus the chaos suite:
                                                flagship + serving + each
                                                ops/pallas kernel traced
                                                standalone)
-  6. perfgate   --check                       (deterministic cost-model
+  6. protolint  --check paddle_tpu            (coordination-KV protocol
+                                               audit: key leaks, consume-
+                                               without-delete, unbounded
+                                               blocking gets, cross-role
+                                               wait cycles, liveness
+                                               budgets, error envelopes,
+                                               seq reuse — PLxxx)
+  7. perfgate   --check                       (deterministic cost-model
                                                perf budgets: bytes/flops
                                                per step, padding waste,
                                                compile bounds vs
                                                tools/perf_baseline.json)
-  7. api_coverage --baseline                  (public-surface regressions)
-  8. pytest -m chaos                          (deterministic fault-injection
+  8. api_coverage --baseline                  (public-surface regressions)
+  9. pytest -m chaos                          (deterministic fault-injection
                                                acceptance proofs, run under
                                                the racelint lock-order
                                                tracer — tests/conftest.py
@@ -60,8 +67,8 @@ enforces every gate at once.  The chaos gate deselects itself there via
 carry no `lint` marker, so the recursion terminates.
 
 Usage: python tools/lint_all.py
-       [--skip tracelint shardlint racelint numlint kernlint perfgate
-        coverage chaos]
+       [--skip tracelint shardlint racelint numlint kernlint protolint
+        perfgate coverage chaos]
        [--only <gate> [<gate> ...]]
        [--json FILE|-]   one unified {"tool": "lint_all", "gates":
                          {gate: {ok, findings, elapsed_s}}} document —
@@ -92,6 +99,8 @@ GATES = {
                 "--check"],
     "kernlint": [sys.executable, os.path.join(TOOLS, "kernlint.py"),
                  "--check"],
+    "protolint": [sys.executable, os.path.join(TOOLS, "protolint.py"),
+                  "--check", "paddle_tpu"],
     "perfgate": [sys.executable, os.path.join(TOOLS, "perfgate.py"),
                  "--check"],
     "coverage": [sys.executable, os.path.join(TOOLS, "api_coverage.py"),

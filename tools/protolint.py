@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""protolint CLI — coordination-KV protocol audit for paddle_tpu.
+
+Whole-package AST pass (no jax import, no trace): models every
+coordination-KV key the package constructs — identity from the
+construction-site f-string/helper, normalized to a
+``prefix/<seq>/<rank>``-shaped pattern — with its set/get/delete flow
+and the process role of each site (controller, replica-server,
+worker, monitor, discovered from entry-point naming the way racelint
+discovers thread roots), and reports the PLxxx family — leaked keys
+(PL101), consume-without-delete double-delivery hazards (PL102),
+unbounded blocking gets (PL103), cross-role wait cycles (PL104),
+heartbeat/deadline budget mismatches (PL105), wire responses without
+a typed-error envelope (PL201), and non-monotonic seq reuse (PL202).
+
+Usage:
+  python tools/protolint.py paddle_tpu            # report everything
+  python tools/protolint.py --check paddle_tpu    # vs baseline, CI gate
+  python tools/protolint.py --write-baseline paddle_tpu
+  python tools/protolint.py --json - paddle_tpu
+  python tools/protolint.py --rules               # PL rule catalogue
+
+Exit codes: 0 clean, 1 findings (plain) / NEW findings vs baseline
+(--check), 2 usage error.
+
+Suppression: the same `# tracelint: disable=PL101` per-line comments
+the other analyzers honor (`# protolint: disable=...` is an accepted
+alias, scoped to PL codes; foreign spellings like `# racelint:`
+cannot waive PL rules).  The checked-in baseline
+(tools/protolint_baseline.json) holds reviewed findings; `--check`
+reports only regressions beyond it.  The `--json` report uses the
+shared analyzer schema (analysis/report.to_json, "tool": "protolint").
+
+The dynamic half — the KV event tracer that records per-process
+set/get/delete streams during the chaos suite and cross-checks them
+against this model — lives in paddle_tpu/analysis/kv_tracer.py and is
+armed by the chaos-marked tests (see docs/protolint.md).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(1, os.path.join(REPO, "tools"))
+
+DEFAULT_BASELINE = os.path.join(REPO, "tools", "protolint_baseline.json")
+
+
+def main(argv=None):
+    from _bootstrap import light_paddle_tpu
+    light_paddle_tpu(REPO)
+    from paddle_tpu.analysis import common, proto_rules
+    from paddle_tpu.analysis.rules import PROTOLINT_CODES, RULES
+
+    ap = argparse.ArgumentParser(
+        prog="protolint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*", help="files/directories to lint")
+    common.add_baseline_args(ap, DEFAULT_BASELINE)
+    ap.add_argument("--rules", action="store_true",
+                    help="print the PL rule catalogue and exit")
+    ap.add_argument("--no-source", action="store_true",
+                    help="omit source lines from the text report")
+    args = ap.parse_args(argv)
+
+    if args.rules:
+        return common.print_rules(RULES, codes=set(PROTOLINT_CODES))
+    if not args.paths:
+        ap.print_usage()
+        return 2
+
+    t0 = time.time()
+    findings = proto_rules.lint_package(args.paths, base=REPO)
+    elapsed = time.time() - t0
+
+    return common.run_baseline_flow(
+        findings, args, tool="protolint", repo=REPO, elapsed=elapsed,
+        show_source=not args.no_source)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
