@@ -372,12 +372,19 @@ def worker_obs():
       obs_span_overhead_pct   — wall-time cost of leaving spans on,
                                 asserted < 2% (the production contract),
                                 measured WITH the Prometheus scrape
-                                endpoint live (the production shape)
+                                endpoint live AND the fleettrace spool
+                                armed (the fleet production shape)
       obs_recompile_count     — compile events seen by the log (the
                                 forced retrace makes this >= 2)
       obs_recompile_attrib    — which argument the last event blamed
+      obs_fleet_trace_requests — traces in the micro two-rank fleet
+                                merge below
+      obs_spool_bytes         — bytes this lane's telemetry spool wrote
+      obs_clock_skew_ms       — KV clock-handshake skew bound from the
+                                same merge
     """
     import statistics
+    import tempfile
 
     import numpy as np
 
@@ -426,28 +433,64 @@ def worker_obs():
 
     # the <2% contract is measured in the production shape: roofline
     # profiler imported, live Prometheus scrape endpoint running on its
-    # daemon thread (an idle endpoint must be free; a scrape-thread
-    # regression shows up here, not in prod)
+    # daemon thread, AND the fleettrace telemetry spool armed — span
+    # spooling is gated by set_enabled, so the off/on delta charges the
+    # spool's per-span JSONL write to the instrumentation bill exactly
+    # as a production fleet worker pays it
+    spool_dir = tempfile.mkdtemp(prefix="ptpu_obs_spool_")
+    spool = obs.fleettrace.arm_spool(spool_dir, rank=0,
+                                     metrics_interval_s=None)
     scrape = obs.export.serve_prometheus(port=0)
     try:
         time_loop(5)                        # warm the timing path
+        # min-over-a-pooled-sample estimator: on shared/1-core CI hosts
+        # a single 20-iter loop carries multi-percent scheduler jitter,
+        # so per-attempt medians routinely fake a >2% "overhead".  The
+        # min of an interleaved, growing sample pool filters additive
+        # noise — a fail requires EVERY on-sample to run slow, which
+        # only true instrumentation cost produces.
+        offs, ons = [], []
         overhead = None
-        for attempt in range(3):
-            offs, ons = [], []
+        for attempt in range(5):
             for _ in range(3):
                 obs.set_enabled(False)
                 offs.append(time_loop(20))
                 obs.set_enabled(True)
                 ons.append(time_loop(20))
-            pct = max(0.0,
-                      (statistics.median(ons) - statistics.median(offs))
-                      / statistics.median(offs) * 100.0)
-            overhead = pct if overhead is None else min(overhead, pct)
+            overhead = max(0.0,
+                           (min(ons) - min(offs)) / min(offs) * 100.0)
             if overhead < 2.0:
                 break
         obs.set_enabled(True)
     finally:
         scrape.shutdown()
+        spool_bytes = spool.bytes_written
+        obs.fleettrace.disarm()
+
+    # micro fleet merge: a second "rank" spool + in-process KV clock
+    # handshake, two traced request spans, one merge — the numbers the
+    # controller's fleet report carries, kept honest in CI
+    from paddle_tpu.resilience.fleet import LocalKVClient
+    kv = LocalKVClient()
+    ns = "bench/obs"
+    sp0 = obs.fleettrace.TelemetrySpool(spool_dir, rank=0, tag="m")
+    sp0.note_clock(obs.fleettrace.clock_handshake(
+        kv, 0, namespace=ns, timeout_s=2.0))
+    sp1 = obs.fleettrace.TelemetrySpool(spool_dir, rank=1, tag="m")
+    sp1.note_clock(obs.fleettrace.clock_handshake(
+        kv, 1, namespace=ns, timeout_s=2.0))
+    for i, sp in enumerate((sp0, sp1)):
+        ctx = obs.TraceContext.new(hint=f"bench-{i}")
+        with obs.use_context(ctx):
+            with obs.span("serving.router.admit", request=f"bench-{i}"):
+                pass
+            with obs.span("serving.finish", request=f"bench-{i}"):
+                pass
+        for rec in obs.recorder().spans()[-2:]:
+            sp.note_span(rec)
+        sp.close()
+    tel = obs.fleettrace.merge_spools(spool_dir)
+    fleet_summary = tel.summary()
 
     events = obs.recompile_log().events()
     jit_events = [e for e in events if e.kind == "jit" and e.changes]
@@ -457,6 +500,9 @@ def worker_obs():
         "obs_recompile_attrib": (", ".join(jit_events[-1].changed_args())
                                  if jit_events else ""),
         "obs_spans_recorded": obs.recorder().total_recorded,
+        "obs_fleet_trace_requests": fleet_summary["traces"],
+        "obs_spool_bytes": int(spool_bytes),
+        "obs_clock_skew_ms": fleet_summary["clock_skew_ms"],
     }
     # the lane's contract: leaving instrumentation on must cost < 2%.
     # Gate BEFORE emitting the result line — the orchestrator merges any
@@ -464,6 +510,9 @@ def worker_obs():
     # ride into the report as if the gate passed
     assert overhead < 2.0, (
         f"span instrumentation overhead {overhead:.2f}% >= 2%")
+    assert out["obs_fleet_trace_requests"] >= 2 \
+        and out["obs_spool_bytes"] > 0, (
+        "fleettrace micro-merge produced no traces/spool bytes")
     print(json.dumps(out), flush=True)
     return 0
 

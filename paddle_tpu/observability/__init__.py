@@ -18,6 +18,12 @@ lanes) with four pieces:
 - :mod:`export` — JSONL, Prometheus text exposition (plus a live
   scrape endpoint, :func:`export.serve_prometheus`), and Chrome-trace
   exporters; rendered by the ``tools/obs_report.py`` CLI;
+- :mod:`fleettrace` — the cross-PROCESS layer: trace-context
+  propagation (:class:`TraceContext` / :class:`use_context`), durable
+  per-rank telemetry spools with a coordination-KV clock handshake,
+  the fleet aggregator (``merge_spools`` → one Chrome trace, merged
+  metrics, per-request TTFT timelines via ``obs_report --fleet``),
+  and the crash flight recorder;
 - :mod:`profile` — the whole-program roofline profiler: deterministic
   per-op flops/bytes attributed back to model layers through
   ``jax.named_scope`` threading, classified compute- vs memory-bound
@@ -38,6 +44,7 @@ Quickstart::
 See docs/observability.md for the architecture.
 """
 from paddle_tpu.observability import export
+from paddle_tpu.observability import fleettrace
 from paddle_tpu.observability import profile
 from paddle_tpu.observability.metrics import (Counter, Gauge, Histogram,
                                               MetricsRegistry, registry)
@@ -52,8 +59,10 @@ from paddle_tpu.observability.recompile import (RecompileEvent,
                                                 note_jit_compile,
                                                 recompile_log)
 from paddle_tpu.observability.spans import (SpanRecord, SpanRecorder,
-                                            enabled, recorder,
-                                            set_enabled, span)
+                                            TraceContext,
+                                            current_context, enabled,
+                                            recorder, set_enabled,
+                                            span, use_context)
 
 __all__ = [
     "ChipSpec",
@@ -67,8 +76,11 @@ __all__ = [
     "RooflineReport",
     "SpanRecord",
     "SpanRecorder",
+    "TraceContext",
+    "current_context",
     "enabled",
     "export",
+    "fleettrace",
     "note_aot_compile",
     "note_jit_compile",
     "profile",
@@ -81,6 +93,7 @@ __all__ = [
     "registry",
     "set_enabled",
     "span",
+    "use_context",
 ]
 
 # built-in metrics sources: the span aggregates and the recompile log

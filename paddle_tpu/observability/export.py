@@ -198,8 +198,16 @@ def chrome_trace(spans=None, recompiles=None):
             "ts": d["start_ns"] / 1e3,      # us
             "dur": d["dur_ns"] / 1e3,
         }
-        if d.get("attrs"):
-            ev["args"] = d["attrs"]
+        args = dict(d.get("attrs") or {})
+        if d.get("trace") is not None:
+            # distributed-trace identity (fleettrace): clickable in
+            # Perfetto's args pane next to the span's own attrs
+            args["trace"] = d["trace"]
+            args["span"] = d.get("span")
+            if d.get("parent") is not None:
+                args["parent"] = d["parent"]
+        if args:
+            ev["args"] = args
         events.append(ev)
     for e in recompiles:
         d = e.to_dict() if isinstance(e, _recompile.RecompileEvent) \

@@ -103,7 +103,20 @@ class RecompileLog:
         # _seq must stay unique and the counter exact
         self._lock = threading.Lock()
         self._buf = deque(maxlen=int(cap))
+        self._sinks = ()            # immutable tuple: lock-free read
         self._seq = 0
+
+    def add_sink(self, fn):
+        """Attach ``fn(RecompileEvent)``, called on every record — the
+        fleet telemetry spool's tap, so worker-process compile events
+        survive the process (fleet-wide warm-boot assertions).  Sinks
+        run outside the log lock."""
+        with self._lock:
+            self._sinks = self._sinks + (fn,)
+
+    def remove_sink(self, fn):
+        with self._lock:
+            self._sinks = tuple(s for s in self._sinks if s is not fn)
 
     def record(self, fn, kind, cause, changes, **kw):
         with self._lock:
@@ -114,6 +127,11 @@ class RecompileLog:
         _metrics.registry().counter(
             "obs_recompile_total",
             help="compile events observed (jit cache misses + AOT)").inc()
+        for s in self._sinks:
+            try:
+                s(ev)
+            except Exception:
+                pass                # a broken spool must not block compiles
         return ev
 
     def events(self):

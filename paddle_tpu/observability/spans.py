@@ -18,20 +18,99 @@ compile) is paid; the per-execution device time is the profiler's job.
 ``set_enabled(False)`` turns span recording into a near-free boolean
 check — the bench overhead lane flips this to measure instrumentation
 cost honestly.
+
+Distributed tracing (docs/observability.md "Fleet tracing"): a
+:class:`TraceContext` names one end-to-end request trace.  Install one
+ambiently with :class:`use_context` (thread-local), or pass it to a
+single span via ``span(..., ctx=...)`` — every span closed under a
+context records the trace id, a fresh span id, and its parent span id,
+and NESTED spans automatically parent to it.  With no context set
+(the default everywhere outside the serving fleet) nothing changes:
+one extra thread-local read per span.
 """
 from __future__ import annotations
 
+import itertools
+import os
 import threading
 import time
+import uuid
 from collections import deque
 
 __all__ = [
     "span", "SpanRecord", "SpanRecorder", "recorder",
     "set_enabled", "enabled",
+    "TraceContext", "use_context", "current_context",
 ]
 
 _state = [True]                 # list, not bool: mutation without `global`
 _tls = threading.local()
+_span_seq = itertools.count(1)
+
+
+def _new_span_id():
+    # unique across processes (fleet spools merge): pid + local counter
+    return f"{os.getpid():x}.{next(_span_seq)}"
+
+
+class TraceContext:
+    """Identity of one distributed trace: ``(trace_id,
+    parent_span_id)``.  Generated once per request at admission
+    (:meth:`new`), then carried across processes on the KV-RPC wire
+    envelope / handoff blob and re-installed with :class:`use_context`
+    so every replica's spans land under the originating request's
+    trace id."""
+
+    __slots__ = ("trace_id", "parent_span_id")
+
+    def __init__(self, trace_id, parent_span_id=None):
+        self.trace_id = str(trace_id)
+        self.parent_span_id = (None if parent_span_id is None
+                               else str(parent_span_id))
+
+    @classmethod
+    def new(cls, hint=None):
+        tid = uuid.uuid4().hex[:16]
+        return cls(f"{hint}-{tid}" if hint else tid)
+
+    def to_dict(self):
+        return {"t": self.trace_id, "s": self.parent_span_id}
+
+    @classmethod
+    def from_dict(cls, d):
+        if not d:
+            return None
+        return cls(d["t"], d.get("s"))
+
+    def __repr__(self):
+        return (f"TraceContext({self.trace_id!r}, "
+                f"parent={self.parent_span_id!r})")
+
+
+def current_context():
+    """The thread's ambient :class:`TraceContext` (or None)."""
+    return getattr(_tls, "ctx", None)
+
+
+class use_context:
+    """Install `ctx` as the thread's ambient trace context for the
+    ``with`` scope (``None`` clears it — safe to pass through).  Spans
+    opened inside record under it; the previous context is restored on
+    exit, so nesting is safe."""
+
+    __slots__ = ("ctx", "_prev")
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "ctx", None)
+        _tls.ctx = self.ctx
+        return self.ctx
+
+    def __exit__(self, exc_type, exc, tb):
+        _tls.ctx = self._prev
+        return False
 
 
 def set_enabled(flag=True):
@@ -46,18 +125,24 @@ def enabled():
 
 
 class SpanRecord:
-    """One closed span (times in ns, perf_counter_ns clock base)."""
+    """One closed span (times in ns, perf_counter_ns clock base).
+    ``trace_id``/``span_id``/``parent_id`` are set only for spans
+    closed under a :class:`TraceContext`."""
 
     __slots__ = ("name", "start_ns", "dur_ns", "depth", "thread_id",
-                 "attrs")
+                 "attrs", "trace_id", "span_id", "parent_id")
 
-    def __init__(self, name, start_ns, dur_ns, depth, thread_id, attrs):
+    def __init__(self, name, start_ns, dur_ns, depth, thread_id, attrs,
+                 trace_id=None, span_id=None, parent_id=None):
         self.name = name
         self.start_ns = start_ns
         self.dur_ns = dur_ns
         self.depth = depth
         self.thread_id = thread_id
         self.attrs = attrs
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
 
     def to_dict(self):
         d = {"name": self.name, "start_ns": self.start_ns,
@@ -65,6 +150,11 @@ class SpanRecord:
              "thread_id": self.thread_id}
         if self.attrs:
             d["attrs"] = self.attrs
+        if self.trace_id is not None:
+            d["trace"] = self.trace_id
+            d["span"] = self.span_id
+            if self.parent_id is not None:
+                d["parent"] = self.parent_id
         return d
 
     def __repr__(self):
@@ -85,6 +175,7 @@ class SpanRecorder:
         self._lock = threading.Lock()
         self._buf = deque(maxlen=int(cap))
         self._agg = {}              # name -> [count, total_ns]
+        self._sinks = ()            # immutable tuple: lock-free read
         self.total_recorded = 0
 
     @property
@@ -94,6 +185,18 @@ class SpanRecorder:
     def set_capacity(self, cap):
         with self._lock:
             self._buf = deque(self._buf, maxlen=int(cap))
+
+    def add_sink(self, fn):
+        """Attach ``fn(SpanRecord)``, called on every record — the
+        fleet telemetry spool's tap.  Sinks run OUTSIDE the recorder
+        lock (they do file IO) and a raising sink is dropped from the
+        record path's fast tuple read only by :meth:`remove_sink`."""
+        with self._lock:
+            self._sinks = self._sinks + (fn,)
+
+    def remove_sink(self, fn):
+        with self._lock:
+            self._sinks = tuple(s for s in self._sinks if s is not fn)
 
     def record(self, rec):
         with self._lock:
@@ -105,6 +208,11 @@ class SpanRecorder:
             else:
                 agg[0] += 1
                 agg[1] += rec.dur_ns
+        for s in self._sinks:       # tuple snapshot: safe lock-free
+            try:
+                s(rec)
+            except Exception:
+                pass                # a broken spool must not kill serving
 
     def spans(self):
         """Snapshot list of buffered spans, oldest first."""
@@ -143,13 +251,24 @@ class span:
     """Context manager: ``with span("serving.decode", batch=8): ...``.
 
     Reentrant by construction (each ``with`` entry uses its own
-    instance); nesting depth is tracked per thread."""
+    instance); nesting depth is tracked per thread.  ``ctx`` ties the
+    span to a :class:`TraceContext` explicitly; with no ``ctx`` the
+    thread's ambient context (see :class:`use_context`) applies, and
+    with neither the record carries no trace identity — exactly the
+    pre-tracing behavior."""
 
-    __slots__ = ("name", "attrs", "_t0", "_depth", "_ann")
+    __slots__ = ("name", "attrs", "_t0", "_depth", "_ann", "_ctx",
+                 "_sid", "_prev")
 
-    def __init__(self, name, **attrs):
+    def __init__(self, name, ctx=None, **attrs):
         self.name = name
         self.attrs = attrs or None
+        self._ctx = ctx
+
+    @property
+    def span_id(self):
+        """This span's id under its trace (None untraced / unentered)."""
+        return getattr(self, "_sid", None)
 
     def __enter__(self):
         if not _state[0]:
@@ -158,6 +277,17 @@ class span:
         depth = getattr(_tls, "depth", 0)
         _tls.depth = depth + 1
         self._depth = depth
+        ctx = self._ctx
+        if ctx is None:
+            ctx = getattr(_tls, "ctx", None)
+        if ctx is not None:
+            self._ctx = ctx
+            self._sid = _new_span_id()
+            # nested spans parent to THIS span for the with scope
+            self._prev = getattr(_tls, "ctx", None)
+            _tls.ctx = TraceContext(ctx.trace_id, self._sid)
+        else:
+            self._sid = None
         self._ann = None
         # under an active jax capture the span also lands on the
         # device-side timeline; import resolved lazily once so a bare
@@ -176,9 +306,18 @@ class span:
         if self._ann is not None:
             self._ann.__exit__(exc_type, exc, tb)
         _tls.depth = self._depth
-        _RECORDER.record(SpanRecord(
-            self.name, self._t0, dur, self._depth,
-            threading.get_ident(), self.attrs))
+        if self._sid is not None:
+            _tls.ctx = self._prev
+            ctx = self._ctx
+            _RECORDER.record(SpanRecord(
+                self.name, self._t0, dur, self._depth,
+                threading.get_ident(), self.attrs,
+                trace_id=ctx.trace_id, span_id=self._sid,
+                parent_id=ctx.parent_span_id))
+        else:
+            _RECORDER.record(SpanRecord(
+                self.name, self._t0, dur, self._depth,
+                threading.get_ident(), self.attrs))
         return False
 
 

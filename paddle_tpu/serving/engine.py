@@ -44,7 +44,8 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.autograd import no_grad
-from paddle_tpu.observability import note_aot_compile, span
+from paddle_tpu.observability import (TraceContext, current_context,
+                                      note_aot_compile, span)
 from paddle_tpu.core.dispatch import apply
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.incubate.nn.paged_attention import (PageAllocator,
@@ -537,6 +538,10 @@ class LLMEngine:
         rid = f"req-{self._next_id}"
         req = Request(rid, prompt, sp, arrival_index=self._next_id,
                       stream=stream)
+        # distributed-trace identity: the router installs the request's
+        # TraceContext ambiently (use_context) around this call — local
+        # single-engine use leaves it None and nothing changes
+        req.trace = current_context()
         req.arrive_t = self.metrics.clock()
         if sp.deadline_s is not None:
             req.deadline_t = req.arrive_t + sp.deadline_s
@@ -599,15 +604,19 @@ class LLMEngine:
         # adopted == evicted-elsewhere: requests_admitted/ttft are the
         # ORIGIN replica's events, not this one's
         req.num_evictions = 1
+        req.trace = current_context()
         req.arrive_t = (self.metrics.clock() if arrive_t is None
                         else float(arrive_t))
+        # resume latency: adoption on THIS engine to its first token
+        # (the ttft_decode stage; absent for never-migrated requests)
+        req._resume_t = self.metrics.clock()
         if sp.deadline_s is not None:
             req.deadline_t = req.arrive_t + sp.deadline_s
         self.scheduler.requeue_front(req)
         self._next_id += 1
         self._requests[rid] = req
         self.metrics.requests_adopted += 1
-        with span("serving.adopt", request=rid,
+        with span("serving.adopt", ctx=req.trace, request=rid,
                   generated=len(generated)):
             pass
         return rid
@@ -690,8 +699,13 @@ class LLMEngine:
             },
             "layers": layers,
         }
-        with span("serving.page_export", request=request_id,
-                  pages=len(pages), tokens=L, release=bool(release)):
+        if req.trace is not None:
+            # trace identity rides the handoff blob so the decode
+            # engine's spans join the originating request's trace
+            state["trace"] = req.trace.to_dict()
+        with span("serving.page_export", ctx=req.trace,
+                  request=request_id, pages=len(pages), tokens=L,
+                  release=bool(release)):
             if release:
                 req.transition(RequestState.EVICTED)
                 self._release_slot(req)
@@ -766,8 +780,11 @@ class LLMEngine:
         req._streamed = min(int(state.get("streamed", len(generated))),
                             len(generated))
         req.num_evictions = 1     # admitted/ttft were the exporter's
+        req.trace = (TraceContext.from_dict(state.get("trace"))
+                     or current_context())
         req.arrive_t = self.metrics.clock() - float(
             state.get("age_s", 0.0))
+        req._resume_t = self.metrics.clock()
         if sp.deadline_s is not None:
             req.deadline_t = req.arrive_t + sp.deadline_s
         self._next_id += 1
@@ -799,8 +816,8 @@ class LLMEngine:
         req.transition(RequestState.DECODE)
         self._requests[rid] = req
         self.metrics.requests_adopted += 1
-        with span("serving.page_import", request=rid, pages=n_pages,
-                  tokens=L):
+        with span("serving.page_import", ctx=req.trace, request=rid,
+                  pages=n_pages, tokens=L):
             pass
         return rid
 
@@ -941,8 +958,8 @@ class LLMEngine:
         tokens = req.replay_token_ids
         L = len(tokens)
         bucket = self.scheduler.bucket_for_len(L)
-        with span("serving.prefill", request=req.request_id,
-                  bucket=bucket, tokens=L):
+        with span("serving.prefill", ctx=req.trace,
+                  request=req.request_id, bucket=bucket, tokens=L):
             self._prefill_inner(req, events, cfg, t0, tokens, L, bucket)
 
     def _prefill_inner(self, req, events, cfg, t0, tokens, L, bucket):
@@ -974,7 +991,12 @@ class LLMEngine:
         if req.num_evictions == 0:
             self.metrics.requests_admitted += 1
             self.metrics.ttft.observe(now - req.arrive_t)
+            # stage decomposition: for a fresh request TTFT is exactly
+            # queue-wait (arrival -> prefill start) + prefill
+            self.metrics.ttft_queue.observe(max(0.0, t0 - req.arrive_t))
+            self.metrics.ttft_prefill.observe(max(0.0, now - t0))
         req.append_token(tok, now=now)
+        self._observe_resume(req, now)
         self.metrics.generated_tokens += 1
         self._post_token(req, events, now)
         if not req.is_finished:
@@ -1066,6 +1088,7 @@ class LLMEngine:
             if r.last_token_t is not None:
                 self.metrics.inter_token.observe(now - r.last_token_t)
             r.append_token(toks[s], now=now)
+            self._observe_resume(r, now)
             self.metrics.generated_tokens += 1
             self._post_token(r, events, now)
 
@@ -1190,6 +1213,14 @@ class LLMEngine:
         return [int(t) for t in np.asarray(out)]
 
     # ------------------------------------------------- finish / evict
+    def _observe_resume(self, req, now):
+        """First token after an adoption/import on THIS engine closes
+        the ttft_decode stage (resume latency of migrated work)."""
+        if req._resume_t is not None:
+            self.metrics.ttft_decode.observe(max(0.0,
+                                                 now - req._resume_t))
+            req._resume_t = None
+
     def _post_token(self, req, events, now):
         reason = req.should_stop()
         if reason is not None:
@@ -1206,6 +1237,14 @@ class LLMEngine:
         req.finish_t = now
         self.metrics.requests_finished += 1
         self.metrics.e2e_latency.observe(now - req.arrive_t)
+        if req.trace is not None:
+            # the trace's terminal marker (fleettrace timelines key on
+            # it) — recorded ONLY for traced requests, so untraced
+            # engines see zero new spans
+            with span("serving.finish", ctx=req.trace,
+                      request=req.request_id, reason=reason,
+                      tokens=len(req.output_token_ids)):
+                pass
         # move out of the live table so a perpetual serving loop cannot
         # accumulate one Request (+ stream closure) per request served
         self._requests.pop(req.request_id, None)

@@ -86,10 +86,23 @@ class ServingFleet:
         self._retired = []        # (index, rank) of replaced workers
         self.proxies = {}         # rank -> RemoteEngineClient
         self.respawn_ms = []      # boot wall time of each respawn
+        self.postmortems = {}     # rank -> flight-record dict
         self.monitor = monitor
         if self.monitor is None:
             self.monitor = _fleet.FleetMonitor(
                 client=client, config=config.fleet_config)
+        # crash flight recorder: a DEAD verdict finalizes the dead
+        # rank's telemetry spool into a post-mortem (last spans, last
+        # metric snapshot, in-flight request ids).  Chained IN FRONT of
+        # any hook an externally-provided monitor already has.
+        prev_on_dead = self.monitor.on_dead
+
+        def _on_dead(ranks, _prev=prev_on_dead):
+            self._flight_record(ranks)
+            if _prev is not None:
+                _prev(ranks)
+
+        self.monitor.on_dead = _on_dead
         if start_monitor:
             self.monitor.start()
         # import here so a fleet-less serving install stays light
@@ -167,6 +180,34 @@ class ServingFleet:
                       rank=rank, boot_ms=ms):
                 pass
         return proxy
+
+    # ----------------------------------------------- flight recorder
+    def _flight_record(self, ranks):
+        """Watchdog ``on_dead`` hook (monitor thread, outside its
+        lock): recover each dead rank's post-mortem from its telemetry
+        spool.  A no-op when spooling is not armed fleet-wide."""
+        import os
+        from paddle_tpu.observability import fleettrace
+        spool_dir = os.environ.get(fleettrace.SPOOL_ENV)
+        if not spool_dir or not os.path.isdir(spool_dir):
+            return
+        for rank in ranks:
+            if rank in self.postmortems:
+                continue
+            try:
+                report = fleettrace.flight_record(spool_dir, rank)
+            except Exception:
+                continue        # a torn spool must not break failover
+            if report is None:
+                continue
+            self.postmortems[int(rank)] = report
+            # the failover span's post-mortem rider: WHAT the rank was
+            # doing when it died, on the controller's own timeline
+            with span("serving.fleet.postmortem", rank=int(rank),
+                      in_flight=len(report["in_flight_requests"]),
+                      spans=report["spans_total"],
+                      path=report.get("path")):
+                pass
 
     # ------------------------------------------------------- serving
     def step(self):

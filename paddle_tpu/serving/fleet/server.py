@@ -31,7 +31,7 @@ from __future__ import annotations
 import threading
 import time
 
-from paddle_tpu.observability import span
+from paddle_tpu.observability import span, use_context
 from paddle_tpu.resilience import fleet as _fleet
 from paddle_tpu.resilience.faultinject import fire as _fire
 from paddle_tpu.serving.fleet import wire
@@ -96,13 +96,17 @@ class ReplicaServer:
                     self._publisher.publish_once()
                     last_beat = now
             try:
-                method, payload = wire.read_request(
+                method, payload, ctx = wire.read_request(
                     self._client, self._ns(), self.rank, seq, recv_s,
                     config=self._config)
             except _fleet.CollectiveTimeout:
                 continue            # empty slice window: poll stop/beat
             try:
-                result = self._dispatch(method, payload or {})
+                # the envelope's trace context (if any) becomes ambient
+                # for the verb, so engine spans on THIS process record
+                # under the originating request's trace
+                with use_context(ctx):
+                    result = self._dispatch(method, payload or {})
             except Exception as e:
                 wire.post_response(self._client, self._ns(), self.rank,
                                    seq, error=e)

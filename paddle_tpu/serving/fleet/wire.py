@@ -11,7 +11,10 @@ the lane is strictly ordered and exactly-once by construction: each
 side deletes a key the moment it has consumed it (the coordination
 service's ``key_value_delete``), and a response is read exactly once
 before the next request is posted.  Messages are JSON dicts
-``{"m": method, "p": payload}`` / ``{"ok": bool, "r": result}`` —
+``{"m": method, "p": payload}`` (plus ``"tc"``, the request's
+distributed-trace context, when one is ambient at the caller — see
+:mod:`paddle_tpu.observability.fleettrace`) /
+``{"ok": bool, "r": result}`` —
 bulk binary (the disaggregated page handoff) never rides the RPC
 lane; it goes to its own ``<ns>/serve/handoff/<hid>`` key as raw npz
 bytes and the RPC carries only the ``hid``.
@@ -36,6 +39,7 @@ import json
 
 import numpy as np
 
+from paddle_tpu.observability import spans as _spans
 from paddle_tpu.resilience import fleet as _fleet
 from paddle_tpu.serving.request import SamplingParams
 from paddle_tpu.serving.scheduler import AdmissionRejected
@@ -103,8 +107,19 @@ def _unmarshal_error(err):
 
 
 # ------------------------------------------------- controller side
-def post_request(client, namespace, rank, seq, method, payload):
+def post_request(client, namespace, rank, seq, method, payload,
+                 ctx=None):
+    """Post one RPC.  The caller's ambient
+    :class:`~paddle_tpu.observability.TraceContext` (or an explicit
+    `ctx`) rides the envelope as ``"tc"`` so the replica's spans record
+    under the originating request's trace — absent entirely (and
+    byte-identical to the pre-tracing envelope) when no trace is
+    active."""
+    if ctx is None:
+        ctx = _spans.current_context()
     msg = {"m": str(method), "p": payload}
+    if ctx is not None:
+        msg["tc"] = ctx.to_dict()
     _fleet.kv_set_bytes(client, req_key(namespace, rank, seq),
                         json.dumps(msg).encode())
 
@@ -143,7 +158,8 @@ def read_request(client, namespace, rank, seq, timeout_s, *,
     except Exception:
         pass
     msg = json.loads(bytes(raw).decode())
-    return msg["m"], msg.get("p")
+    return (msg["m"], msg.get("p"),
+            _spans.TraceContext.from_dict(msg.get("tc")))
 
 
 def post_response(client, namespace, rank, seq, result=None,

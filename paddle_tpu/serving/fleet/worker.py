@@ -307,6 +307,14 @@ def run_controller(cfg, grank):
                                     "requests_finished")}
     result["assigned"] = {str(i): sfleet.rank_of(i)
                           for i in range(len(cfg["worker_ranks"]))}
+    # flight-recorder summaries (full postmortem-r<N>.json files live
+    # in the spool dir): what each DEAD-verdict rank was doing
+    result["postmortems"] = {
+        str(r): {"in_flight_requests": pm["in_flight_requests"],
+                 "in_flight_traces": pm["in_flight_traces"],
+                 "spans_total": pm["spans_total"],
+                 "path": pm.get("path")}
+        for r, pm in sorted(sfleet.postmortems.items())}
 
     sfleet.shutdown()
     _write_result(cfg["out_dir"], "controller.json", result)
@@ -351,6 +359,11 @@ def main():
     # fleet layer must carry the launch-time membership explicitly
     flt._set_world(flt.WorldView(range(jax.process_count()), grank,
                                  launch_id=flt._ensure_launch_id()))
+    # telemetry spooling (no-op unless PTPU_OBS_SPOOL_DIR is set): the
+    # KV clock handshake runs against the still-attached coordination
+    # client, so every rank's spool aligns to the controller's clock
+    from paddle_tpu.observability import fleettrace
+    fleettrace.arm_from_env(rank=grank, client=flt._client())
     _detach_local_backend()
     _mesh.set_mesh(Mesh(np.asarray(jax.local_devices()), ("dp",)))
     if grank == int(cfg.get("controller_rank", 0)):
@@ -358,10 +371,12 @@ def main():
             run_traffic_controller(cfg, grank)
         else:
             run_controller(cfg, grank)
+        fleettrace.disarm()    # flush the final metrics snapshot
         # bounded linger: dead-by-design peers never check out
         flt.finalize(timeout_s=float(cfg.get("finalize_s", 6.0)))
     else:
         run_replica(cfg, grank)
+        fleettrace.disarm()
         flt.finalize()
     sys.stdout.flush()
     sys.stderr.flush()

@@ -120,6 +120,19 @@ class EngineMetrics:
         self.ttft = reg.histogram(
             "serving_ttft_seconds", labels=labels,
             help="time to first token")
+        # TTFT stage decomposition (fleettrace): for a fresh request
+        # TTFT = queue + prefill exactly; decode is the resume latency
+        # of migrated/adopted work (time from adoption on THIS engine
+        # to the first token it produces) and is absent otherwise
+        self.ttft_queue = reg.histogram(
+            "serving_ttft_queue_seconds", labels=labels,
+            help="TTFT stage: arrival to prefill start (queue wait)")
+        self.ttft_prefill = reg.histogram(
+            "serving_ttft_prefill_seconds", labels=labels,
+            help="TTFT stage: prefill start to first token")
+        self.ttft_decode = reg.histogram(
+            "serving_ttft_decode_seconds", labels=labels,
+            help="TTFT stage: adoption/import to first resumed token")
         self.inter_token = reg.histogram(
             "serving_inter_token_seconds", labels=labels,
             help="inter-token latency")

@@ -109,6 +109,12 @@ class Request:
         self.first_token_t = None
         self.finish_t = None
         self.last_token_t = None
+        # distributed-trace identity (observability.TraceContext or
+        # None) — set at admission, carried across adoption/handoff
+        self.trace = None
+        # set when adopted/imported onto this engine; cleared when the
+        # first resumed token observes the ttft_decode stage histogram
+        self._resume_t = None
 
     # ---- state machine ----
     def transition(self, new_state):

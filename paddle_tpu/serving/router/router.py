@@ -55,7 +55,7 @@ import time
 import weakref
 from collections import OrderedDict
 
-from paddle_tpu.observability import span
+from paddle_tpu.observability import TraceContext, span, use_context
 from paddle_tpu.observability.metrics import next_instance_label
 from paddle_tpu.resilience.retry import RetryPolicy, compute_backoff
 from paddle_tpu.serving.router.metrics import RouterMetrics
@@ -125,7 +125,7 @@ class _RequestRecord:
 
     __slots__ = ("rid", "prompt", "sp", "user_stream", "tokens",
                  "finished", "finish_reason", "replica", "engine_rid",
-                 "migrations", "arrive_t")
+                 "migrations", "arrive_t", "trace")
 
     def __init__(self, rid, prompt, sp, user_stream, arrive_t):
         self.rid = rid
@@ -139,6 +139,7 @@ class _RequestRecord:
         self.engine_rid = None
         self.migrations = 0
         self.arrive_t = arrive_t     # router clock; survives migration
+        self.trace = None            # TraceContext; survives migration
 
 
 class Router:
@@ -357,26 +358,32 @@ class Router:
             prompt = [int(t) for t in prompt_token_ids]
             rec = _RequestRecord(rid, prompt, sampling_params, stream,
                                  arrive_t=arrive_t)
+            # one distributed trace per request, born at admission: the
+            # admit span installs it ambiently, so the engine (local
+            # call or KV-RPC wire envelope) records under it
+            rec.trace = TraceContext.new(hint=rid)
             last = None
-            for h in candidates:
-                try:
-                    erid = h.engine.add_request(
-                        prompt, sampling_params,
-                        stream=self._wrap_stream(rec))
-                except AdmissionRejected as e:
-                    last = e
-                    self.metrics.note_spillover()
-                    with span("serving.router.spillover",
-                              replica=h.index, reason=e.reason):
-                        pass
-                    continue
-                rec.replica = h
-                rec.engine_rid = erid
-                self._records[rid] = rec
-                self._by_engine[(h.index, h.generation, erid)] = rid
-                self._next_id += 1
-                self.metrics.requests_routed += 1
-                return rid
+            with span("serving.router.admit", ctx=rec.trace,
+                      request=rid, prompt_tokens=len(prompt)):
+                for h in candidates:
+                    try:
+                        erid = h.engine.add_request(
+                            prompt, sampling_params,
+                            stream=self._wrap_stream(rec))
+                    except AdmissionRejected as e:
+                        last = e
+                        self.metrics.note_spillover()
+                        with span("serving.router.spillover",
+                                  replica=h.index, reason=e.reason):
+                            pass
+                        continue
+                    rec.replica = h
+                    rec.engine_rid = erid
+                    self._records[rid] = rec
+                    self._by_engine[(h.index, h.generation, erid)] = rid
+                    self._next_id += 1
+                    self.metrics.requests_routed += 1
+                    return rid
             self.metrics.requests_rejected += 1
             raise AdmissionRejected(
                 "all_replicas",
@@ -523,13 +530,17 @@ class Router:
                     # admission": a migrated request already paid its
                     # queueing dues, so it must not become the target
                     # engine's preferred (latest-arrived) preemption
-                    # victim; router submission order breaks ties
-                    erid = h.engine.adopt_request(
-                        rec.prompt, sp, generated_token_ids=rec.tokens,
-                        stream=self._wrap_stream(rec),
-                        arrive_t=rec.arrive_t,
-                        arrival_index=int(rec.rid.split("-")[1])
-                        - (1 << 30))
+                    # victim; router submission order breaks ties.
+                    # use_context: the adopting engine's spans (local or
+                    # across the wire) rejoin the request's birth trace
+                    with use_context(rec.trace):
+                        erid = h.engine.adopt_request(
+                            rec.prompt, sp,
+                            generated_token_ids=rec.tokens,
+                            stream=self._wrap_stream(rec),
+                            arrive_t=rec.arrive_t,
+                            arrival_index=int(rec.rid.split("-")[1])
+                            - (1 << 30))
                 except (AdmissionRejected, ValueError):
                     continue
                 rec.replica = h

@@ -80,6 +80,8 @@ def main():
 
     kv_tracer.arm_from_env()   # no-op unless PTPU_KV_TRACE_DIR is set
     grank = jax.process_index()
+    from paddle_tpu.observability import fleettrace
+    fleettrace.arm_from_env(rank=grank)   # needs PTPU_OBS_SPOOL_DIR
     result = {"mode": mode, "global_rank": grank,
               "launch_world": jax.process_count(), "detection": None,
               "reconfigure_s": None, "reshard_ok": None,

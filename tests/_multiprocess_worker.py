@@ -34,6 +34,8 @@ def main():
 
     kv_tracer.arm_from_env()   # no-op unless PTPU_KV_TRACE_DIR is set
     rank = jax.process_index()
+    from paddle_tpu.observability import fleettrace
+    fleettrace.arm_from_env(rank=rank)    # needs PTPU_OBS_SPOOL_DIR
     nprocs = jax.process_count()
 
     t = P.to_tensor(np.array([float(rank + 1), 10.0 * (rank + 1)],

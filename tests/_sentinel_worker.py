@@ -72,6 +72,8 @@ def main():
 
     kv_tracer.arm_from_env()   # no-op unless PTPU_KV_TRACE_DIR is set
     grank = jax.process_index()
+    from paddle_tpu.observability import fleettrace
+    fleettrace.arm_from_env(rank=grank)   # needs PTPU_OBS_SPOOL_DIR
     result = {"global_rank": grank, "launch_world": jax.process_count(),
               "vote": None, "monitor_suspects": None, "new_world": None,
               "losses_resumed": [], "exited_as_suspect": False}

@@ -382,8 +382,9 @@ def _fleetserving_scenario(out_dir, cache_dir):
     }
 
 
-def _spawn_fleetserving(rank, port, scenario_path):
-    env = _child_env({**FLEET_ENV, "PADDLE_LAUNCH_ID": "fleetsrvA"})
+def _spawn_fleetserving(rank, port, scenario_path, extra_env=None):
+    env = _child_env({**FLEET_ENV, "PADDLE_LAUNCH_ID": "fleetsrvA",
+                      **(extra_env or {})})
     return subprocess.Popen(
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
          "--master", f"127.0.0.1:{port}", "--nnodes", "5",
@@ -410,12 +411,16 @@ def test_serving_fleet_sigkill_wedge_failover(tmp_path):
     out_dir, cache_dir = tmp_path / "out", tmp_path / "cache"
     out_dir.mkdir()
     cache_dir.mkdir()
+    spool_dir = tmp_path / "spool"            # PR 20: fleet tracing ON
+    spool_dir.mkdir()
     scenario = _fleetserving_scenario(str(out_dir), str(cache_dir))
     scenario_path = tmp_path / "scenario.json"
     scenario_path.write_text(json.dumps(scenario))
 
     port = _free_port()
-    procs = {r: _spawn_fleetserving(r, port, str(scenario_path))
+    procs = {r: _spawn_fleetserving(
+                 r, port, str(scenario_path),
+                 extra_env={"PTPU_OBS_SPOOL_DIR": str(spool_dir)})
              for r in range(5)}
     ctl_path = out_dir / "controller.json"
     try:
@@ -516,3 +521,62 @@ def test_serving_fleet_sigkill_wedge_failover(tmp_path):
     assert not (out_dir / f"replica-rank{SRV_KILL_RANK}.json").exists()
     assert not (out_dir
                 / f"replica-rank{SRV_WEDGE_RANK}.json").exists()
+
+    # ================================================= PR 20 fleettrace
+    # the same chaos run, with telemetry spooling armed in every
+    # process, must yield the three observability acceptance artifacts
+    from paddle_tpu.observability import fleettrace
+
+    tel = fleettrace.merge_spools(str(spool_dir))
+    summary = tel.summary()
+
+    # ---- (a) merged chrome trace with spans from ALL 5 processes on
+    # aligned clocks: every rank spooled (the SIGKILLed and wedged
+    # spools survive as flushed prefixes), every non-ref rank completed
+    # the KV clock handshake (a real offset, not the wall fallback)
+    assert summary["processes"] == 5, summary
+    assert sorted(summary["ranks"]) == [0, 1, 2, 3, 4], summary
+    for p in tel.processes:
+        assert p.spans, f"rank {p.rank} spooled no spans"
+        assert p.clock is not None, f"rank {p.rank} has no clock anchor"
+        if p.rank != 0:
+            assert p.clock.get("offset_ns") is not None, (
+                f"rank {p.rank} never completed the clock handshake")
+    chrome = tel.chrome_trace()
+    span_pids = {e["pid"] for e in chrome["traceEvents"]
+                 if e.get("cat") == "span"}
+    assert span_pids == {0, 1, 2, 3, 4}, span_pids
+
+    # ---- (b) a COMPLETE per-request timeline for a request migrated
+    # across the dead rank: admission -> prefill -> failover adoption
+    # -> finish, exactly-once, spanning >= 2 processes
+    tls = [tel.timeline(t) for t in tel.traces()]
+    migrated = [t for t in tls
+                if t and t["complete"] and t["migrations"] >= 1]
+    assert migrated, (
+        f"no complete migrated-request timeline among "
+        f"{[(t['request'], t['complete'], t['migrations']) for t in tls if t]}")
+    mt = migrated[0]
+    assert mt["admissions"] == 1 and mt["finishes"] == 1, mt
+    assert len(mt["processes"]) >= 2, mt
+    span_names = {e["name"] for e in mt["spans"]}
+    assert {"serving.router.admit", "serving.prefill", "serving.adopt",
+            "serving.finish"} <= span_names, span_names
+    assert mt["stages"].get("total_s", 0) > 0, mt["stages"]
+    assert "adoption_s" in mt["stages"], mt["stages"]
+
+    # ---- (c) the crash flight recorder: the controller's DEAD-verdict
+    # hook wrote a post-mortem for the SIGKILLed rank naming the
+    # requests in flight on it at death
+    pms = res.get("postmortems", {})
+    assert str(SRV_KILL_RANK) in pms, (
+        f"controller recorded no post-mortem for the SIGKILLed rank: "
+        f"{sorted(pms)}")
+    pm = pms[str(SRV_KILL_RANK)]
+    assert pm["in_flight_requests"], pm
+    assert pm["spans_total"] > 0, pm
+    pm_path = spool_dir / f"postmortem-r{SRV_KILL_RANK}.json"
+    assert pm_path.exists(), "post-mortem file missing next to spools"
+    on_disk = json.loads(pm_path.read_text())
+    assert on_disk["in_flight_requests"] == pm["in_flight_requests"]
+    assert on_disk["last_spans"], on_disk.keys()

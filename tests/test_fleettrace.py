@@ -1,0 +1,373 @@
+"""fleettrace — durable per-rank telemetry spools, cross-process trace
+aggregation, and the crash flight recorder (PR 20).
+
+Everything here is CPU-only and compiles nothing: spools are plain
+JSONL files under tmp_path, the "fleet" is synthetic ProcessSpool data
+with hand-picked clocks (deterministic stage math), and the KV clock
+handshake runs against the in-process LocalKVClient.  The arming tests
+touch the process-wide span recorder / recompile log sinks, so every
+one of them disarms in a ``finally`` — a leaked sink would spool every
+later test's spans.
+"""
+import json
+import os
+
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import fleettrace
+from paddle_tpu.observability.spans import SpanRecord
+from paddle_tpu.resilience.fleet import LocalKVClient
+
+pytestmark = pytest.mark.obs
+
+MS = 1_000_000          # ns per ms
+
+
+# ------------------------------------------------------------ helpers
+def _span(name, start_ms, dur_ms, request=None, trace=None, span=None,
+          parent=None, **attrs):
+    if request is not None:
+        attrs["request"] = request
+    return SpanRecord(name, int(start_ms * MS), int(dur_ms * MS), 0, 1,
+                      attrs or None, trace_id=trace, span_id=span,
+                      parent_id=parent)
+
+
+def _mk_fleet(tmp_path):
+    """Two synthetic rank spools carrying one migrated request:
+    admitted + prefilled on rank 0, handed off to and finished on
+    rank 1 whose perf_counter epoch lags the reference by 5 ms
+    (offset_ns = +5 ms).  All stage durations are hand-picked so the
+    timeline decomposition is exact."""
+    sp0 = fleettrace.TelemetrySpool(str(tmp_path), rank=0)
+    sp0.note_clock({"rank": 0, "ref_rank": 0, "anchor_perf_ns": MS,
+                    "anchor_wall_ns": 1_000 * MS, "offset_ns": 0,
+                    "rtt_ms": 0.0})
+    t = "rr-0-cafe01"
+    for rec in (
+            _span("serving.router.admit", 10, 1, request="rr-0",
+                  trace=t, span="a.1", prompt_tokens=8),
+            _span("serving.prefill", 12, 3, request="req-0",
+                  trace=t, span="a.2", parent="a.1"),
+            _span("serving.page_export", 20, 1, request="req-0",
+                  trace=t, span="a.3", parent="a.1")):
+        sp0.note_span(rec)
+    sp0.close()
+
+    sp1 = fleettrace.TelemetrySpool(str(tmp_path), rank=1, tag="r1")
+    sp1.note_clock({"rank": 1, "ref_rank": 0,
+                    "anchor_perf_ns": 2 * MS,
+                    "anchor_wall_ns": 1_006 * MS,
+                    "offset_ns": 5 * MS, "rtt_ms": 0.2})
+    for rec in (       # local clock: ref time = local + 5 ms
+            _span("serving.page_import", 17, 1, request="req-7",
+                  trace=t, span="b.1", parent="a.1"),
+            _span("serving.adopt", 18.5, 0.5, request="req-7",
+                  trace=t, span="b.2", parent="a.1"),
+            _span("serving.finish", 25, 0.1, request="req-7",
+                  trace=t, span="b.3", parent="a.1", reason="eos")):
+        sp1.note_span(rec)
+    sp1.close()
+    return t
+
+
+# ======================================================= spool writing
+class TestSpool:
+    def test_lines_are_durable_before_close(self, tmp_path):
+        # kill-safe contract: every line is flushed as written — the
+        # file is complete on disk BEFORE close (a SIGKILL now loses
+        # nothing already noted)
+        sp = fleettrace.TelemetrySpool(str(tmp_path), rank=3)
+        sp.note_span(_span("serving.prefill", 1, 2, request="req-1"))
+        with open(sp.path, encoding="utf-8") as fh:
+            kinds = [json.loads(l)["kind"] for l in fh]
+        assert kinds == ["meta", "span"]
+        sp.close()
+
+    def test_torn_tail_round_trip(self, tmp_path):
+        # SIGKILL mid-write leaves a torn final line: the reader skips
+        # it and every prior line survives intact
+        sp = fleettrace.TelemetrySpool(str(tmp_path), rank=0)
+        sp.note_clock({"rank": 0, "offset_ns": 0})
+        sp.note_span(_span("serving.prefill", 1, 2, request="req-0"))
+        sp.note_span(_span("serving.decode", 4, 1))
+        sp.close()
+        with open(sp.path, "a", encoding="utf-8") as fh:
+            fh.write('{"kind": "span", "name": "serving.fin')  # torn
+        parsed = fleettrace.read_spool(sp.path)
+        assert parsed["torn_lines"] == 1
+        assert [s["name"] for s in parsed["spans"]] == [
+            "serving.prefill", "serving.decode"]
+        assert parsed["meta"]["rank"] == 0
+        assert parsed["clock"]["offset_ns"] == 0
+
+    def test_write_after_close_is_dropped(self, tmp_path):
+        sp = fleettrace.TelemetrySpool(str(tmp_path), rank=0)
+        sp.close()
+        sp.note_span(_span("late", 1, 1))       # must not raise
+        assert fleettrace.read_spool(sp.path)["spans"] == []
+
+
+# ===================================================== arming / disarm
+class TestArming:
+    def test_arm_taps_spans_and_recompiles(self, tmp_path):
+        spool = fleettrace.arm_spool(str(tmp_path), rank=0)
+        try:
+            with obs.span("fleettrace-armed-probe"):
+                pass
+            obs.recompile_log().record("probe_fn", "jit", "first call",
+                                       [])
+        finally:
+            fleettrace.disarm()
+        parsed = fleettrace.read_spool(spool.path)
+        assert any(s["name"] == "fleettrace-armed-probe"
+                   for s in parsed["spans"])
+        assert any(r["event"]["fn"] == "probe_fn"
+                   for r in parsed["recompiles"])
+        # disarm appended the final metrics snapshot
+        assert parsed["metrics"], "disarm() must snapshot metrics"
+        # and detached the sinks: spans after disarm stay out
+        with obs.span("fleettrace-after-disarm"):
+            pass
+        parsed = fleettrace.read_spool(spool.path)
+        assert not any(s["name"] == "fleettrace-after-disarm"
+                       for s in parsed["spans"])
+
+    def test_set_enabled_false_fully_disarms(self, tmp_path):
+        # the near-free contract: set_enabled(False) silences EVERY
+        # spool write — spans, recompiles, metrics — not just the ring
+        spool = fleettrace.arm_spool(str(tmp_path), rank=0)
+        try:
+            prev = obs.set_enabled(False)
+            n = spool.events_written
+            with obs.span("disabled-probe"):
+                pass
+            obs.recompile_log().record("disabled_fn", "jit", "x", [])
+            spool.snapshot_metrics()
+            assert spool.events_written == n
+        finally:
+            obs.set_enabled(prev)
+            fleettrace.disarm()
+
+    def test_arm_from_env_suppression_spellings(self, tmp_path,
+                                                monkeypatch):
+        # flagged: every documented "off" spelling vetoes arming even
+        # with the spool dir set
+        monkeypatch.setenv(fleettrace.SPOOL_ENV, str(tmp_path))
+        for spelling in fleettrace.SUPPRESS_SPELLINGS:
+            monkeypatch.setenv(fleettrace.SUPPRESS_ENV, spelling)
+            assert fleettrace.arm_from_env(rank=0) is None
+            assert fleettrace.active_spool() is None
+        # clean: no suppression -> arms into the env dir
+        monkeypatch.delenv(fleettrace.SUPPRESS_ENV)
+        spool = fleettrace.arm_from_env(rank=0,
+                                        metrics_interval_s=None)
+        try:
+            assert spool is not None
+            assert fleettrace.active_spool() is spool
+            assert os.path.dirname(spool.path) == str(tmp_path)
+        finally:
+            fleettrace.disarm()
+
+    def test_arm_from_env_noop_without_dir(self, monkeypatch):
+        monkeypatch.delenv(fleettrace.SPOOL_ENV, raising=False)
+        monkeypatch.delenv(fleettrace.SUPPRESS_ENV, raising=False)
+        assert fleettrace.arm_from_env(rank=0) is None
+
+
+# ===================================================== clock handshake
+class TestClockHandshake:
+    def test_ref_and_peer_offsets(self, tmp_path):
+        kv = LocalKVClient()
+        ev0 = fleettrace.clock_handshake(kv, 0, namespace="tc",
+                                         timeout_s=2.0)
+        assert ev0["offset_ns"] == 0 and ev0["rtt_ms"] == 0.0
+        ev1 = fleettrace.clock_handshake(kv, 1, namespace="tc",
+                                         timeout_s=2.0)
+        # same process, same clocks: the wall/perf bridge cancels to
+        # ~0 (well under a second) and the local KV round trip is fast
+        assert ev1["offset_ns"] is not None
+        assert abs(ev1["offset_ns"]) < 1_000 * MS
+        assert 0.0 <= ev1["rtt_ms"] < 2_000.0
+
+    def test_missing_ref_degrades_to_anchor_only(self):
+        kv = LocalKVClient()
+        ev = fleettrace.clock_handshake(kv, 5, namespace="tc-miss",
+                                        ref_rank=9, timeout_s=0.2)
+        assert ev["offset_ns"] is None and ev["rtt_ms"] is None
+        assert ev["anchor_perf_ns"] > 0 and ev["anchor_wall_ns"] > 0
+
+
+# ================================================== merge + timelines
+class TestFleetMerge:
+    def test_summary_and_alignment(self, tmp_path):
+        _mk_fleet(tmp_path)
+        tel = fleettrace.merge_spools(str(tmp_path))
+        s = tel.summary()
+        assert s["processes"] == 2 and s["ranks"] == [0, 1]
+        assert s["spans"] == 6 and s["traces"] == 1
+        assert s["ref_rank"] == 0 and s["torn_lines"] == 0
+        assert s["clock_skew_ms"] == 0.1          # rtt 0.2 / 2
+        offsets = {p.rank: p.offset_ns for p in tel.processes}
+        assert offsets == {0: 0, 1: 5 * MS}
+
+    def test_wall_anchor_fallback_alignment(self, tmp_path):
+        # a spool whose handshake never completed (offset_ns None)
+        # aligns through the wall anchors instead
+        sp0 = fleettrace.TelemetrySpool(str(tmp_path), rank=0)
+        sp0.note_clock({"rank": 0, "ref_rank": 0, "anchor_perf_ns": MS,
+                        "anchor_wall_ns": 1_000 * MS, "offset_ns": 0,
+                        "rtt_ms": 0.0})
+        sp0.close()
+        sp1 = fleettrace.TelemetrySpool(str(tmp_path), rank=1, tag="b")
+        sp1.note_clock({"rank": 1, "ref_rank": 0,
+                        "anchor_perf_ns": 4 * MS,
+                        "anchor_wall_ns": 1_010 * MS,
+                        "offset_ns": None, "rtt_ms": None})
+        sp1.close()
+        tel = fleettrace.merge_spools(str(tmp_path))
+        p1 = [p for p in tel.processes if p.rank == 1][0]
+        # (wall1 - wall0) + (perf0 - perf1) = 10ms + (-3ms) = 7ms
+        assert p1.offset_ns == 7 * MS
+
+    def test_chrome_trace_tracks_all_processes(self, tmp_path):
+        _mk_fleet(tmp_path)
+        doc = fleettrace.merge_spools(str(tmp_path)).chrome_trace()
+        evs = doc["traceEvents"]
+        assert {e["pid"] for e in evs} == {0, 1}   # rank == track
+        names = {e["args"]["name"] for e in evs
+                 if e["name"] == "process_name"}
+        assert any("rank 0" in n for n in names)
+        finish = [e for e in evs if e["name"] == "serving.finish"][0]
+        # aligned: local 25ms + 5ms offset, in chrome trace us
+        assert finish["ts"] == 30_000.0
+        assert finish["args"]["trace"].startswith("rr-0-")
+
+    def test_migrated_request_timeline_exact(self, tmp_path):
+        trace = _mk_fleet(tmp_path)
+        tel = fleettrace.merge_spools(str(tmp_path))
+        # resolvable by router rid, engine rid, and trace id alike
+        assert tel.find_trace("rr-0") == trace
+        assert tel.find_trace("req-7") == trace
+        tl = tel.timeline("rr-0")
+        assert tl["trace"] == trace
+        assert tl["request"] == "rr-0"     # router rid, not engine's
+        assert tl["complete"] is True
+        # exactly-once across the migration
+        assert tl["admissions"] == 1 and tl["finishes"] == 1
+        assert tl["migrations"] == 1 and tl["handoffs"] == 2
+        assert tl["processes"] == [0, 1]
+        st = tl["stages"]
+        assert st["queue_wait_s"] == pytest.approx(0.002)
+        assert st["prefill_s"] == pytest.approx(0.003)
+        assert st["handoff_s"] == pytest.approx(0.002)
+        assert st["adoption_s"] == pytest.approx(0.0005)
+        # finish starts at ref 30ms; last work ends at adopt end 24ms
+        assert st["decode_s"] == pytest.approx(0.006)
+        assert st["total_s"] == pytest.approx(0.0201)
+
+    def test_prometheus_text_rank_labels(self, tmp_path):
+        sp = fleettrace.TelemetrySpool(str(tmp_path), rank=2)
+        sp._write({"kind": "metrics", "t_ns": 1, "wall_time": 1.0,
+                   "metrics": {
+                       "serving_requests_total": 4,
+                       "serving_ttft_seconds": {"count": 4, "p50": 8.0,
+                                                "p99": 9.0}}})
+        sp.close()
+        text = fleettrace.merge_spools(str(tmp_path)).prometheus_text()
+        assert 'serving_requests_total{rank="2"} 4' in text
+        assert 'serving_ttft_seconds_count{rank="2"} 4' in text
+        assert 'serving_ttft_seconds_p99_ms{rank="2"} 9.0' in text
+
+
+# ==================================================== flight recorder
+class TestFlightRecorder:
+    def test_in_flight_requests_named(self, tmp_path):
+        _mk_fleet(tmp_path)
+        # rank 0 died mid-request: prefill seen, finish never —
+        # the post-mortem names req-0 in flight with its trace id
+        report = fleettrace.flight_record(str(tmp_path), 0)
+        assert report["rank"] == 0
+        assert report["in_flight_requests"] == ["req-0"]
+        assert report["in_flight_traces"]["req-0"].startswith("rr-0-")
+        assert report["spans_total"] == 3
+        assert report["last_spans"][-1]["name"] == "serving.page_export"
+        # persisted next to the spools
+        path = os.path.join(str(tmp_path), "postmortem-r0.json")
+        assert report["path"] == path
+        with open(path, encoding="utf-8") as fh:
+            assert json.load(fh)["in_flight_requests"] == ["req-0"]
+        # rank 1 finished its adopted request: nothing in flight
+        r1 = fleettrace.flight_record(str(tmp_path), 1, write=False)
+        assert r1["in_flight_requests"] == []
+
+    def test_unknown_rank_is_none(self, tmp_path):
+        _mk_fleet(tmp_path)
+        assert fleettrace.flight_record(str(tmp_path), 9,
+                                        write=False) is None
+
+
+# ================================================== obs_report --fleet
+class TestObsReportFleet:
+    def _mod(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "obs_report_fleet_test",
+            os.path.join(os.path.dirname(__file__), os.pardir,
+                         "tools", "obs_report.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_fleet_golden_output(self, tmp_path, capsys):
+        trace = _mk_fleet(tmp_path)
+        mod = self._mod()
+        assert mod.main(["--fleet", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        # golden lines: summary header, clock line, per-process rows,
+        # and the migrated request's timeline with its stage table
+        assert "== fleet telemetry (2 processes, ranks [0, 1])" in out
+        assert "traces 1  ref rank 0  clock skew bound 0.1 ms" in out
+        assert "rank 0 (pid" in out and "rank 1 (pid" in out
+        assert "offset +5.000 ms" in out
+        assert f"== request rr-0 (trace {trace})" in out
+        assert ("complete=True  admissions=1  finishes=1  "
+                "migrations=1  handoffs=2") in out
+        assert "queue_wait_s       2.000 ms" in out
+        assert "adoption_s         0.500 ms" in out
+        assert "total_s           20.100 ms" in out
+        assert "serving.adopt" in out and "serving.finish" in out
+
+    def test_fleet_request_and_json(self, tmp_path, capsys):
+        _mk_fleet(tmp_path)
+        mod = self._mod()
+        assert mod.main(["--fleet", str(tmp_path), "--request",
+                         "req-7", "--json", "-"]) == 0
+        out = capsys.readouterr().out
+        payload = json.loads(out[out.index("{"):])
+        assert payload["summary"]["traces"] == 1
+        assert payload["timelines"][0]["migrations"] == 1
+
+    def test_fleet_trace_file(self, tmp_path, capsys):
+        _mk_fleet(tmp_path)
+        mod = self._mod()
+        trace_path = str(tmp_path / "fleet.trace.json")
+        assert mod.main(["--fleet", str(tmp_path), "--trace",
+                         trace_path]) == 0
+        capsys.readouterr()
+        with open(trace_path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        assert {e["pid"] for e in doc["traceEvents"]} == {0, 1}
+
+    def test_fleet_missing_request_errors(self, tmp_path, capsys):
+        _mk_fleet(tmp_path)
+        mod = self._mod()
+        assert mod.main(["--fleet", str(tmp_path), "--request",
+                         "rr-404"]) == 1
+        assert "no trace for request" in capsys.readouterr().err
+
+    def test_fleet_empty_dir_errors(self, tmp_path, capsys):
+        mod = self._mod()
+        assert mod.main(["--fleet", str(tmp_path)]) == 1
+        assert "no spool-" in capsys.readouterr().err

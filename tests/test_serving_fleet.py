@@ -182,12 +182,25 @@ class TestWire:
         kv = fleet.LocalKVClient()
         ns = "test/ns"
         wire.post_request(kv, ns, 3, 0, "ping", {"x": 1})
-        m, p = wire.read_request(kv, ns, 3, 0, 1.0)
+        m, p, ctx = wire.read_request(kv, ns, 3, 0, 1.0)
         assert (m, p) == ("ping", {"x": 1})
+        assert ctx is None      # no ambient trace -> bare envelope
         assert kv.key_value_dir_get_bytes(wire.req_key(ns, 3, 0)) == []
         wire.post_response(kv, ns, 3, 0, result={"rank": 3})
         assert wire.await_response(kv, ns, 3, 0, 1.0) == {"rank": 3}
         assert kv.key_value_dir_get_bytes(wire.rsp_key(ns, 3, 0)) == []
+
+    def test_trace_context_rides_the_envelope(self):
+        from paddle_tpu.observability import TraceContext, use_context
+        kv = fleet.LocalKVClient()
+        ns = "test/ns"
+        tc = TraceContext("rr-7-abc", parent_span_id="1a.2")
+        with use_context(tc):
+            wire.post_request(kv, ns, 1, 0, "step", {})
+        m, p, ctx = wire.read_request(kv, ns, 1, 0, 1.0)
+        assert m == "step"
+        assert ctx.trace_id == "rr-7-abc"
+        assert ctx.parent_span_id == "1a.2"
 
     def test_typed_errors_reraise_on_controller(self):
         kv = fleet.LocalKVClient()

@@ -84,7 +84,8 @@ def has_attr_path(obj, name):
 # resolving is a regression exactly like a reference-parity gap.
 NATIVE_NAMESPACES = ("serving", "serving.router", "serving.fleet",
                      "serving.traffic",
-                     "analysis", "observability", "quantization",
+                     "analysis", "observability",
+                     "observability.fleettrace", "quantization",
                      "resilience")
 
 

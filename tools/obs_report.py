@@ -18,6 +18,14 @@ JSONL dump's ``roofline`` records, or captured live from the gpt
 hybrid train target with ``--demo`` (traces, runs two steps for the
 measured span time, and reconciles predicted vs measured).
 
+With ``--fleet <spool_dir>`` it instead merges every per-rank
+telemetry spool (observability.fleettrace) into one fleet view: the
+per-process inventory on aligned clocks, per-request distributed
+timelines with the TTFT stage decomposition (``--request <id>``
+focuses one request by router rid / engine rid / trace id), the
+rank-labeled merged metrics exposition (``--prom``), and a merged
+Chrome trace (``--trace FILE``).
+
 Usage:
   python tools/obs_report.py obs.jsonl           # render a dump
   python tools/obs_report.py --demo              # gpt-hybrid forced-
@@ -28,6 +36,9 @@ Usage:
   python tools/obs_report.py obs.jsonl --roofline  # from dump records
   python tools/obs_report.py obs.jsonl --capacity  # CapacityReport
                                                  # tables from a dump
+  python tools/obs_report.py --fleet spools/     # merged fleet view
+  python tools/obs_report.py --fleet spools/ --request rr-3
+  python tools/obs_report.py --fleet spools/ --trace fleet.json
 
 The demo compiles the tiny-config GPT hybrid train step, perturbs ONE
 input's shape to force a retrace, and shows the resulting recompile
@@ -168,6 +179,93 @@ def live_doc():
     }
 
 
+# ----------------------------------------------------------------- fleet
+def render_fleet(tel, limit):
+    s = tel.summary()
+    print(f"== fleet telemetry ({s['processes']} processes, ranks "
+          f"{s['ranks']}) " + "=" * 12)
+    print(f"  spans {s['spans']}  recompiles {s['recompiles']}  "
+          f"metric snapshots {s['metric_snapshots']}  torn lines "
+          f"{s['torn_lines']}")
+    print(f"  traces {s['traces']}  ref rank {s['ref_rank']}  "
+          f"clock skew bound {s['clock_skew_ms']} ms")
+    for p in tel.processes:
+        off = "?" if p.clock is None else f"{p.offset_ns / 1e6:+.3f}"
+        print(f"  {p.label:<24s} {len(p.spans):>6d} spans  "
+              f"{len(p.recompiles):>3d} recompiles  "
+              f"{len(p.metrics):>3d} snapshots  offset {off} ms"
+              + (f"  [{p.torn_lines} torn]" if p.torn_lines else ""))
+    print()
+
+
+def render_timeline(tl, limit):
+    print(f"== request {tl['request']} (trace {tl['trace']}) " + "=" * 8)
+    print(f"  complete={tl['complete']}  admissions={tl['admissions']}"
+          f"  finishes={tl['finishes']}  migrations={tl['migrations']}"
+          f"  handoffs={tl['handoffs']}  processes={tl['processes']}")
+    for k in ("queue_wait_s", "prefill_s", "handoff_s", "adoption_s",
+              "decode_s", "total_s"):
+        if k in tl["stages"]:
+            print(f"  {k:<13s} {tl['stages'][k] * 1e3:10.3f} ms")
+    spans = tl["spans"][:limit]
+    t0 = spans[0]["start_ns"] if spans else 0
+    for e in spans:
+        attrs = e.get("attrs") or {}
+        attr_s = ("  " + " ".join(f"{k}={v}"
+                                  for k, v in sorted(attrs.items()))
+                  if attrs else "")
+        print(f"  +{(e['start_ns'] - t0) / 1e6:9.3f}ms "
+              f"r{e['rank'] if e['rank'] is not None else '?'} "
+              f"{e['name']:<28s} {e['dur_ns'] / 1e6:9.3f} ms{attr_s}")
+    print()
+
+
+def run_fleet(args, ap):
+    from paddle_tpu.observability import fleettrace
+    if not os.path.isdir(args.fleet):
+        ap.error(f"--fleet: {args.fleet} is not a directory")
+    tel = fleettrace.merge_spools(args.fleet)
+    if not tel.processes:
+        print(f"obs_report: no spool-*.jsonl files in {args.fleet}",
+              file=sys.stderr)
+        return 1
+    if args.prom:
+        sys.stdout.write(tel.prometheus_text())
+        return 0
+    render_fleet(tel, args.limit)
+    timelines = []
+    if args.request:
+        tl = tel.timeline(args.request)
+        if tl is None:
+            print(f"obs_report: no trace for request {args.request!r} "
+                  f"in {args.fleet}", file=sys.stderr)
+            return 1
+        timelines = [tl]
+    else:
+        # no --request: render every complete distributed timeline
+        # (bounded by --limit), most-travelled first
+        tls = [tel.timeline(t) for t in tel.traces()]
+        tls = [t for t in tls if t and t["complete"]]
+        tls.sort(key=lambda t: (-t["migrations"], str(t["request"])))
+        timelines = tls[:max(1, args.limit // 8)]
+    for tl in timelines:
+        render_timeline(tl, args.limit)
+    if args.trace:
+        tel.write_chrome_trace(args.trace)
+        print(f"merged chrome trace -> {args.trace}")
+    if args.json:
+        payload = json.dumps(
+            {"summary": tel.summary(), "timelines": timelines,
+             "recompiles_by_rank": tel.recompiles_by_rank()},
+            indent=1, sort_keys=True, default=str)
+        if args.json == "-":
+            print(payload)
+        else:
+            with open(args.json, "w", encoding="utf-8") as fh:
+                fh.write(payload + "\n")
+    return 0
+
+
 # ---------------------------------------------------------------- render
 def render_recompiles(recompiles, limit):
     print(f"== recompile log ({len(recompiles)} events) " + "=" * 24)
@@ -241,7 +339,19 @@ def main(argv=None):
                          "sustained QPS at the TTFT SLO per replica "
                          "count) from the dump's capacity records "
                          "(dump_jsonl(..., capacities=[report]))")
+    ap.add_argument("--fleet", metavar="SPOOL_DIR", default=None,
+                    help="merge per-rank telemetry spools "
+                         "(PTPU_OBS_SPOOL_DIR) into one fleet view")
+    ap.add_argument("--request", metavar="ID", default=None,
+                    help="with --fleet: focus one request's distributed "
+                         "timeline (router rid, engine rid, or trace id)")
+    ap.add_argument("--trace", metavar="FILE", default=None,
+                    help="with --fleet: write the merged multi-process "
+                         "Chrome trace here")
     args = ap.parse_args(argv)
+
+    if args.fleet:
+        return run_fleet(args, ap)
 
     if args.capacity:
         if not args.dump:
